@@ -1,0 +1,54 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities of
+PaddlePaddle Fluid 1.5 (reference: /root/reference), built on JAX/XLA/Pallas.
+
+The top-level module doubles as the `fluid` namespace: `import paddle_tpu as
+fluid` makes reference recipes (layers/executor/optimizer/io) run unchanged —
+but everything underneath is a ground-up TPU design (see SURVEY.md §1):
+whole-program XLA compilation, jax.grad autodiff, SPMD parallelism over
+jax.sharding meshes, Pallas kernels for the hot paths.
+"""
+
+from . import initializer
+from .core import (framework, unique_name)
+from .core.framework import (Program, Variable, Parameter, program_guard,
+                             name_scope, default_main_program,
+                             default_startup_program, in_dygraph_mode)
+from .core.place import (CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace,
+                         cpu_places, cuda_places, tpu_places,
+                         is_compiled_with_cuda, is_compiled_with_tpu)
+from .core.executor import Executor, Scope, global_scope, scope_guard
+from .core.backward import append_backward, gradients
+from .core.param_attr import ParamAttr, WeightNormParamAttr
+from .core.data_feeder import DataFeeder
+from .core.compiler import (CompiledProgram, ParallelExecutor, BuildStrategy,
+                            ExecutionStrategy)
+from . import layers
+from .layers.io import data  # fluid.data-style (but with batch dim implicit off)
+from . import optimizer
+from .optimizer import clip
+from .optimizer import regularizer
+from . import metrics
+from . import io
+from .io.state import (save_params, save_persistables, save_vars, load_params,
+                       load_persistables, load_vars)
+from .io.inference_io import save_inference_model, load_inference_model
+from . import dataset
+from . import reader
+from . import dygraph
+from . import parallel
+from . import profiler
+from . import amp
+
+# fluid-compat: `fluid.data` in 2.x has no implicit batch dim. Keep both:
+data = layers.io.fluid_data
+
+
+def embedding(*args, **kwargs):
+    return layers.embedding(*args, **kwargs)
+
+
+def one_hot(*args, **kwargs):
+    return layers.one_hot(*args, **kwargs)
+
+
+__version__ = "0.1.0"

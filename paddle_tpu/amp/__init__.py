@@ -1,0 +1,10 @@
+"""Automatic mixed precision.
+
+Parity: python/paddle/fluid/contrib/mixed_precision/ (decorate, fp16 lists,
+dynamic loss scaling). TPU-first: the native mode is **bf16** (no loss
+scaling needed — bf16 shares fp32's exponent range); the fp16-style dynamic
+loss scaling API is provided for parity and for fp16-on-TPU experiments.
+"""
+
+from .decorator import decorate, CustomOpLists, AutoMixedPrecisionLists
+from .policy import cast_model_to_bf16, bf16_guard, get_compute_dtype

@@ -1,0 +1,41 @@
+"""bf16 compute policy.
+
+The MXU consumes bf16 natively; params stay fp32, matmul/conv inputs are
+cast to bf16 and accumulate in fp32 (preferred_element_type). This module
+holds the global compute-dtype switch the op kernels consult.
+"""
+
+import contextlib
+
+_compute_dtype = "float32"
+
+
+def get_compute_dtype():
+    return _compute_dtype
+
+
+def set_compute_dtype(dtype):
+    global _compute_dtype
+    _compute_dtype = dtype
+
+
+@contextlib.contextmanager
+def bf16_guard():
+    old = _compute_dtype
+    set_compute_dtype("bfloat16")
+    try:
+        yield
+    finally:
+        set_compute_dtype(old)
+
+
+def cast_model_to_bf16(program, amp_lists=None):
+    """Flip matmul-path op inputs to bf16 by tagging ops; the executor's op
+    context applies the cast at trace time (white-list ops only)."""
+    from .decorator import AutoMixedPrecisionLists
+    lists = amp_lists or AutoMixedPrecisionLists()
+    for block in program.blocks:
+        for op in block.ops:
+            if op.type in lists.white_list:
+                op.attrs["__amp_dtype__"] = "bfloat16"
+    return program

@@ -1,0 +1,11 @@
+from . import framework, unique_name, place
+from .framework import (Program, Block, Operator, Variable, Parameter,
+                        program_guard, name_scope, default_main_program,
+                        default_startup_program, in_dygraph_mode)
+from .place import (CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace,
+                    cpu_places, cuda_places, tpu_places,
+                    is_compiled_with_cuda, is_compiled_with_tpu)
+from .executor import Executor, Scope, global_scope, scope_guard
+from .backward import append_backward, gradients
+from .param_attr import ParamAttr, WeightNormParamAttr
+from .layer_helper import LayerHelper

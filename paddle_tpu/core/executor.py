@@ -1,0 +1,247 @@
+"""Executor & Scope.
+
+Parity: python/paddle/fluid/executor.py + paddle/fluid/framework/executor.cc.
+
+The reference Executor walks the ProgramDesc op-by-op, dispatching a C++/CUDA
+kernel per op on a device stream. The TPU-native Executor instead *traces*
+the whole Program (forward + jax.grad backward + optimizer updates) into a
+single jitted step function per (program version, feed signature):
+
+    step(state, feeds, rng) -> (new_state, fetches)
+
+- `state` is the Scope's persistable variables (params, optimizer moments,
+  batch-norm running stats, LR counters) as one pytree; it is donated to XLA
+  so parameter updates are in-place in HBM, like fluid's in-place ops.
+- feeds/fetches keep the fluid API: exe.run(program, feed={...},
+  fetch_list=[...]).
+- RNG: a PRNGKey derived from (program.random_seed, step counter) is threaded
+  in; each random op folds in its own static op_seed (see ops/random_ops.py).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import framework
+from .framework import (Program, Variable, grad_var_name, BACKWARD_MARKER,
+                        default_main_program)
+from .. import ops as ops_registry
+
+
+class Scope:
+    """Name -> device array store for persistable variables.
+
+    Parity: paddle/fluid/framework/scope.h. Flat (no kid scopes): the jit
+    owns all temporary storage, so only persistables live here.
+    """
+
+    def __init__(self):
+        self._vars = {}
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+    def var(self, name):
+        return self._vars.setdefault(name, None)
+
+    def set(self, name, value):
+        self._vars[name] = value
+
+    def get(self, name, default=None):
+        return self._vars.get(name, default)
+
+    def __contains__(self, name):
+        return name in self._vars
+
+    def names(self):
+        return list(self._vars)
+
+    def drop(self, name):
+        self._vars.pop(name, None)
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+def scope_guard(scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        global _global_scope
+        old = _global_scope
+        _global_scope = scope
+        try:
+            yield
+        finally:
+            _global_scope = old
+    return guard()
+
+
+def _as_fetch_name(f):
+    if isinstance(f, Variable):
+        return f.name
+    return str(f)
+
+
+def _slice_ops(block, fetch_names):
+    """Backward slice of a block's op list: ops needed for fetches or that
+    write persistable vars (stat/counter updates keep running)."""
+    needed = set(fetch_names)
+    keep = []
+    for op in reversed(block.ops):
+        out_names = set(op.output_names)
+        writes_persistable = any(
+            (n in block.vars and block.vars[n].persistable)
+            for n in out_names)
+        if writes_persistable or (out_names & needed):
+            keep.append(op)
+            needed |= set(op.input_names)
+    return list(reversed(keep))
+
+
+def _lower_block(block, env, program, is_test):
+    """Trace every op of a block into env (jit-traceable)."""
+    for op in block.ops:
+        if op.type == BACKWARD_MARKER:
+            raise RuntimeError("backward marker must be handled by caller")
+        ops_registry.run_op(op, env, program, is_test)
+
+
+class Executor:
+    """Parity: fluid.Executor. place selects the device; XLA owns streams."""
+
+    def __init__(self, place=None):
+        from .place import TPUPlace
+        self.place = place if place is not None else TPUPlace(0)
+        self._cache = {}
+        self._step_counter = 0
+
+    # ------------------------------------------------------------------
+    def close(self):
+        self._cache.clear()
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            feed_var_name="feed", fetch_var_name="fetch", return_numpy=True,
+            use_program_cache=True):
+        program = program if program is not None else default_main_program()
+        scope = scope if scope is not None else global_scope()
+        feed = feed or {}
+        fetch_names = tuple(_as_fetch_name(f) for f in (fetch_list or []))
+
+        feeds = {k: jnp.asarray(v) for k, v in feed.items()}
+        feed_sig = tuple(sorted((k, v.shape, str(v.dtype)) for k, v in feeds.items()))
+
+        # early, friendly validation (parity: fluid's check_feed_shape_type)
+        gb = program.global_block()
+        for f in fetch_names:
+            base = f[:-5] if f.endswith("@GRAD") else f
+            if not gb.has_var(base):
+                raise ValueError(
+                    f"fetch target '{f}' is not a variable of this program")
+        live_ops = gb.ops if program.backward_marker() is not None \
+            else _slice_ops(gb, fetch_names)
+        for v in program.list_vars():
+            if v.is_data and v.name not in feeds and not v.persistable:
+                if any(v.name in op.input_names for op in live_ops):
+                    raise ValueError(
+                        f"feed variable '{v.name}' is required by the "
+                        f"program but missing from feed={{...}}")
+
+        persist_names = tuple(sorted(
+            v.name for v in program.list_vars() if v.persistable))
+        state = {n: scope.get(n) for n in persist_names if scope.get(n) is not None}
+        state_sig = tuple(sorted(state))
+
+        key = (id(program), program.version, feed_sig, fetch_names, state_sig)
+        entry = self._cache.get(key) if use_program_cache else None
+        if entry is None:
+            entry = self._build(program, fetch_names, persist_names, state_sig)
+            if use_program_cache:
+                self._cache[key] = entry
+        step_fn = entry
+
+        seed = program.random_seed or framework.default_seed()
+        rng = jax.random.fold_in(jax.random.PRNGKey(seed), self._step_counter)
+        self._step_counter += 1
+
+        new_state, fetches = step_fn(state, feeds, rng)
+        for n, v in new_state.items():
+            scope.set(n, v)
+
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    # ------------------------------------------------------------------
+    def _build(self, program, fetch_names, persist_names, state_sig):
+        gb = program.global_block()
+        marker_idx = None
+        for i, op in enumerate(gb.ops):
+            if op.type == BACKWARD_MARKER:
+                marker_idx = i
+                break
+        is_test = program._is_test
+        state_keys = set(state_sig)
+        if marker_idx is None:
+            # dead-code-eliminate to the fetch set (+ persistable writers):
+            # an inference/test run must not demand feeds its fetches don't
+            # need (parity: fluid Executor prunes feed/fetch targets).
+            run_ops = _slice_ops(gb, fetch_names)
+        else:
+            run_ops = gb.ops
+
+        def step(state, feeds, rng):
+            env = {}
+            env.update(state)
+            env.update(feeds)
+            env["@RNG@"] = rng
+            if marker_idx is None:
+                for op in run_ops:
+                    ops_registry.run_op(op, env, program, is_test)
+            else:
+                marker = gb.ops[marker_idx]
+                loss_name = marker.attr("loss")
+                param_names = [n for n in marker.attr("params") if n in env]
+                base_env = {k: v for k, v in env.items() if k not in param_names}
+
+                def fwd(params):
+                    env2 = dict(base_env)
+                    env2.update(params)
+                    for op in gb.ops[:marker_idx]:
+                        ops_registry.run_op(op, env2, program, is_test)
+                    loss = jnp.sum(env2[loss_name])
+                    return loss, env2
+
+                params = {n: env[n] for n in param_names}
+                (loss_val, env), grads = jax.value_and_grad(
+                    fwd, has_aux=True)(params)
+                del loss_val
+                env = dict(env)
+                for n in param_names:
+                    env[grad_var_name(n)] = grads[n]
+                for op in gb.ops[marker_idx + 1:]:
+                    ops_registry.run_op(op, env, program, is_test)
+
+            new_state = {n: env[n] for n in persist_names if n in env}
+            fetches = tuple(env[f] for f in fetch_names)
+            return new_state, fetches
+
+        # Donate the state pytree: param/opt-state updates reuse HBM buffers,
+        # matching fluid's in-place update semantics with zero copies.
+        donate = (0,) if marker_idx is not None and state_keys else ()
+        return jax.jit(step, donate_argnums=donate)
+
+
+# Convenience mirroring fluid.executor._run helpers -------------------------
+
+def run_startup(startup_program=None, scope=None, place=None):
+    from .framework import default_startup_program
+    exe = Executor(place)
+    exe.run(startup_program or default_startup_program(), scope=scope)
+    return exe

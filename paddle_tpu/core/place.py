@@ -1,0 +1,82 @@
+"""Device places.
+
+Parity: paddle/fluid/platform/place.h — Place/CPUPlace/CUDAPlace. On TPU the
+native place is TPUPlace; CUDAPlace is accepted as an alias so reference
+recipes run unchanged with place=TPUPlace(0) (or even CUDAPlace(0), which we
+map onto the available accelerator).
+
+Unlike the reference there are no per-place DeviceContexts with streams:
+XLA owns scheduling. A Place here just selects a jax.Device.
+"""
+
+import jax
+
+
+class Place:
+    _kind = "undefined"
+
+    def __init__(self, device_id=0):
+        self.device_id = int(device_id)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.device_id})"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.device_id == other.device_id
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def jax_device(self):
+        """Resolve to a concrete jax.Device (best effort)."""
+        devs = jax.devices()
+        if self._kind == "cpu":
+            try:
+                devs = jax.devices("cpu")
+            except RuntimeError:
+                pass
+        return devs[min(self.device_id, len(devs) - 1)]
+
+
+class CPUPlace(Place):
+    _kind = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+    def __repr__(self):
+        return "CPUPlace"
+
+
+class TPUPlace(Place):
+    _kind = "accelerator"
+
+
+class CUDAPlace(TPUPlace):
+    """Alias: reference recipes using CUDAPlace(0) get the accelerator."""
+
+
+class CUDAPinnedPlace(CPUPlace):
+    pass
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_tpu():
+    return any(d.platform == "tpu" for d in jax.devices())
+
+
+def tpu_places(device_ids=None):
+    """Parity with fluid.cuda_places(): list of accelerator places."""
+    n = len(jax.devices())
+    ids = range(n) if device_ids is None else device_ids
+    return [TPUPlace(i) for i in ids]
+
+
+cuda_places = tpu_places
+
+
+def cpu_places(device_count=1):
+    return [CPUPlace() for _ in range(device_count)]

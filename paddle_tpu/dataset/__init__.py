@@ -1,0 +1,17 @@
+"""Datasets with the paddle.dataset API (synthetic, offline).
+
+Parity: python/paddle/dataset/__init__.py.
+"""
+
+from . import common
+from . import mnist
+from . import cifar
+from . import uci_housing
+from . import imdb
+from . import imikolov
+from . import movielens
+from . import wmt14
+from . import wmt16
+from . import flowers
+from . import conll05
+from . import sentiment

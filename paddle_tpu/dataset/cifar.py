@@ -1,0 +1,18 @@
+"""CIFAR-10/100 (synthetic). Parity: python/paddle/dataset/cifar.py."""
+from .common import synthetic_image_reader
+
+
+def train10():
+    return synthetic_image_reader(8192, (3, 32, 32), 10, seed=52)
+
+
+def test10():
+    return synthetic_image_reader(1024, (3, 32, 32), 10, seed=53)
+
+
+def train100():
+    return synthetic_image_reader(8192, (3, 32, 32), 100, seed=54)
+
+
+def test100():
+    return synthetic_image_reader(1024, (3, 32, 32), 100, seed=55)

@@ -1,0 +1,82 @@
+"""Synthetic dataset machinery.
+
+Parity: python/paddle/dataset/* API (train()/test() reader creators).
+The environment has zero egress, so every dataset is a *deterministic
+synthetic* with the exact shapes/dtypes/vocab sizes of the original —
+recipes, tests and benchmarks run unchanged; accuracy targets are checked on
+learnable synthetic structure (labels correlated with inputs), not noise.
+"""
+
+import numpy as np
+
+DATA_HOME = "/tmp/paddle_tpu_dataset"
+
+
+def _rng(seed):
+    return np.random.RandomState(seed)
+
+
+def synthetic_image_reader(num, shape, num_classes, seed, flatten=False,
+                           template_seed=None):
+    """Images whose class signal is a per-class template + noise, so simple
+    models can actually fit them (MNIST-style learnability). The templates
+    are keyed by dataset (template_seed), NOT by split — train and test
+    must share them or the task is unlearnable."""
+    if template_seed is None:
+        template_seed = 1000 + num_classes * 17 + int(np.prod(shape)) % 997
+    def reader():
+        rng = _rng(seed)
+        templates = _rng(template_seed).randn(num_classes, *shape).astype("float32")
+        for i in range(num):
+            label = int(rng.randint(num_classes))
+            img = templates[label] + 0.5 * rng.randn(*shape).astype("float32")
+            if flatten:
+                img = img.reshape(-1)
+            yield img.astype("float32"), label
+    return reader
+
+
+def synthetic_sequence_reader(num, vocab_size, seq_len, num_classes, seed,
+                              template_seed=None):
+    """Token sequences where the label depends on token statistics.
+    Class centers are shared across splits (see synthetic_image_reader)."""
+    if template_seed is None:
+        template_seed = 2000 + num_classes * 13 + vocab_size % 991
+    def reader():
+        rng = _rng(seed)
+        class_centers = _rng(template_seed).randint(
+            0, vocab_size, size=(num_classes, seq_len))
+        for i in range(num):
+            label = int(rng.randint(num_classes))
+            base = class_centers[label]
+            noise = rng.randint(0, vocab_size, size=seq_len)
+            mask = rng.rand(seq_len) < 0.3
+            seq = np.where(mask, noise, base)
+            yield seq.astype("int64"), label
+    return reader
+
+
+def synthetic_regression_reader(num, dim, seed, template_seed=None):
+    if template_seed is None:
+        template_seed = 3000 + dim  # shared across train/test splits
+    def reader():
+        rng = _rng(seed)
+        w = _rng(template_seed).randn(dim).astype("float32")
+        for i in range(num):
+            x = rng.randn(dim).astype("float32")
+            y = float(x @ w + 0.1 * rng.randn())
+            yield x, np.array([y], dtype="float32")
+    return reader
+
+
+def synthetic_pair_reader(num, src_vocab, trg_vocab, src_len, trg_len, seed):
+    """Translation pairs: target is a deterministic function of source
+    (reversal + offset mod vocab) — learnable by seq2seq models."""
+    def reader():
+        rng = _rng(seed)
+        for i in range(num):
+            n = int(rng.randint(max(2, src_len // 2), src_len + 1))
+            src = rng.randint(2, src_vocab, size=n)
+            trg = (src[::-1] + 7) % (trg_vocab - 2) + 2
+            yield src.astype("int64"), trg.astype("int64"), trg.astype("int64")
+    return reader

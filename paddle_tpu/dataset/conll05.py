@@ -1,0 +1,28 @@
+"""CoNLL-05 SRL (synthetic). Parity: python/paddle/dataset/conll05.py."""
+import numpy as np
+from .common import _rng
+
+WORD_DICT_LEN = 44068
+LABEL_DICT_LEN = 59
+PRED_DICT_LEN = 3162
+
+
+def get_dict():
+    return ({f"w{i}": i for i in range(WORD_DICT_LEN)},
+            {f"v{i}": i for i in range(PRED_DICT_LEN)},
+            {f"l{i}": i for i in range(LABEL_DICT_LEN)})
+
+
+def test():
+    def reader():
+        rng = _rng(132)
+        for _ in range(512):
+            n = int(rng.randint(8, 32))
+            words = rng.randint(0, WORD_DICT_LEN, n).astype("int64")
+            ctx = [rng.randint(0, WORD_DICT_LEN, n).astype("int64")
+                   for _ in range(5)]
+            pred = np.full(n, rng.randint(PRED_DICT_LEN), "int64")
+            mark = rng.randint(0, 2, n).astype("int64")
+            labels = ((words + pred) % LABEL_DICT_LEN).astype("int64")
+            yield (words, *ctx, pred, mark, labels)
+    return reader

@@ -1,0 +1,18 @@
+"""IMDB sentiment (synthetic). Parity: python/paddle/dataset/imdb.py."""
+from .common import synthetic_sequence_reader
+
+WORD_DICT_SIZE = 5147
+
+
+def word_dict():
+    return {f"w{i}": i for i in range(WORD_DICT_SIZE)}
+
+
+def train(word_idx=None):
+    n = len(word_idx) if word_idx else WORD_DICT_SIZE
+    return synthetic_sequence_reader(4096, n, 128, 2, seed=72)
+
+
+def test(word_idx=None):
+    n = len(word_idx) if word_idx else WORD_DICT_SIZE
+    return synthetic_sequence_reader(512, n, 128, 2, seed=73)

@@ -1,0 +1,33 @@
+"""imikolov (PTB-style LM n-grams, synthetic).
+Parity: python/paddle/dataset/imikolov.py."""
+import numpy as np
+from .common import _rng
+
+WORD_DICT_SIZE = 2073
+
+
+def build_dict(min_word_freq=50):
+    return {f"w{i}": i for i in range(WORD_DICT_SIZE)}
+
+
+def _ngram_reader(num, n, vocab, seed):
+    def reader():
+        rng = _rng(seed)
+        # Markov-ish stream: next word = f(prev) + noise, learnable
+        for _ in range(num):
+            start = int(rng.randint(vocab))
+            seq = [start]
+            for _ in range(n - 1):
+                nxt = (seq[-1] * 31 + 7) % vocab if rng.rand() < 0.8 \
+                    else int(rng.randint(vocab))
+                seq.append(nxt)
+            yield tuple(np.int64(w) for w in seq)
+    return reader
+
+
+def train(word_idx, n):
+    return _ngram_reader(8192, n, len(word_idx), seed=82)
+
+
+def test(word_idx, n):
+    return _ngram_reader(1024, n, len(word_idx), seed=83)

@@ -1,0 +1,10 @@
+"""MNIST (synthetic). Parity: python/paddle/dataset/mnist.py."""
+from .common import synthetic_image_reader
+
+
+def train():
+    return synthetic_image_reader(8192, (784,), 10, seed=42)
+
+
+def test():
+    return synthetic_image_reader(1024, (784,), 10, seed=43)

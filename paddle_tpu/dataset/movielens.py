@@ -1,0 +1,62 @@
+"""MovieLens (synthetic). Parity: python/paddle/dataset/movielens.py."""
+import numpy as np
+from .common import _rng
+
+MAX_USER_ID = 6040
+MAX_MOVIE_ID = 3952
+AGE_TABLE = [1, 18, 25, 35, 45, 50, 56]
+MAX_JOB_ID = 20
+CATEGORIES = 18
+TITLE_DICT_SIZE = 5174
+
+
+def max_user_id():
+    return MAX_USER_ID
+
+
+def max_movie_id():
+    return MAX_MOVIE_ID
+
+
+def max_job_id():
+    return MAX_JOB_ID
+
+
+def age_table():
+    return AGE_TABLE
+
+
+def movie_categories():
+    return {f"cat{i}": i for i in range(CATEGORIES)}
+
+
+def get_movie_title_dict():
+    return {f"t{i}": i for i in range(TITLE_DICT_SIZE)}
+
+
+def _reader(num, seed):
+    def r():
+        rng = _rng(seed)
+        for _ in range(num):
+            uid = int(rng.randint(1, MAX_USER_ID + 1))
+            gender = int(rng.randint(2))
+            age = int(rng.randint(len(AGE_TABLE)))
+            job = int(rng.randint(MAX_JOB_ID + 1))
+            mid = int(rng.randint(1, MAX_MOVIE_ID + 1))
+            cat = [int(rng.randint(CATEGORIES))]
+            title = rng.randint(0, TITLE_DICT_SIZE, size=5).astype("int64")
+            # rating correlated with (uid+mid) parity for learnability
+            score = float(((uid + mid + age) % 5) + 1)
+            yield (np.int64(uid), np.int64(gender), np.int64(age),
+                   np.int64(job), np.int64(mid),
+                   np.asarray(cat, "int64"), title,
+                   np.array([score], "float32"))
+    return r
+
+
+def train():
+    return _reader(8192, seed=92)
+
+
+def test():
+    return _reader(1024, seed=93)

@@ -1,0 +1,13 @@
+"""UCI housing (synthetic). Parity: python/paddle/dataset/uci_housing.py."""
+from .common import synthetic_regression_reader
+
+feature_names = ['CRIM', 'ZN', 'INDUS', 'CHAS', 'NOX', 'RM', 'AGE', 'DIS',
+                 'RAD', 'TAX', 'PTRATIO', 'B', 'LSTAT']
+
+
+def train():
+    return synthetic_regression_reader(404, 13, seed=62)
+
+
+def test():
+    return synthetic_regression_reader(102, 13, seed=63)

@@ -1,0 +1,13 @@
+"""WMT14 En-De (synthetic). Parity: python/paddle/dataset/wmt14.py."""
+from .common import synthetic_pair_reader
+
+SRC_VOCAB = 30000
+TRG_VOCAB = 30000
+
+
+def train(dict_size=SRC_VOCAB):
+    return synthetic_pair_reader(4096, dict_size, dict_size, 32, 32, seed=102)
+
+
+def test(dict_size=SRC_VOCAB):
+    return synthetic_pair_reader(512, dict_size, dict_size, 32, 32, seed=103)

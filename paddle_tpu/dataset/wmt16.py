@@ -1,0 +1,12 @@
+"""WMT16 (synthetic). Parity: python/paddle/dataset/wmt16.py."""
+from .common import synthetic_pair_reader
+
+
+def train(src_dict_size=10000, trg_dict_size=10000, src_lang="en"):
+    return synthetic_pair_reader(4096, src_dict_size, trg_dict_size, 32, 32,
+                                 seed=112)
+
+
+def test(src_dict_size=10000, trg_dict_size=10000, src_lang="en"):
+    return synthetic_pair_reader(512, src_dict_size, trg_dict_size, 32, 32,
+                                 seed=113)

@@ -1,0 +1,18 @@
+"""Imperative (dygraph) mode.
+
+Parity: python/paddle/fluid/dygraph/.
+"""
+
+from .base import guard, enabled, to_variable, no_grad, enable_dygraph, disable_dygraph
+from .layers import Layer, Sequential, LayerList, ParameterList
+from .nn import (Linear, FC, Conv2D, Pool2D, BatchNorm, Embedding, LayerNorm,
+                 GroupNorm, PRelu, BilinearTensorProduct, Conv2DTranspose,
+                 SpectralNorm, GRUUnit, NCE, Dropout)
+from .checkpoint import save_dygraph, load_dygraph
+from .jit import to_static, TracedLayer
+from .parallel import DataParallel
+from . import learning_rate_scheduler
+from .learning_rate_scheduler import (NoamDecay, ExponentialDecay,
+                                      PiecewiseDecay, CosineDecay,
+                                      PolynomialDecay, InverseTimeDecay,
+                                      NaturalExpDecay)

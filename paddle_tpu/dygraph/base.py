@@ -1,0 +1,219 @@
+"""Dygraph core: eager variables + replay-tape autograd.
+
+Parity: python/paddle/fluid/dygraph/base.py + imperative tracer
+(paddle/fluid/imperative/). The reference's tracer builds grad-op chains and
+runs CUDA kernels eagerly. TPU-native redesign: eager ops execute immediately
+as JAX calls (dispatched to the same paddle_tpu.ops kernels the static mode
+uses), while a lightweight tape records (fn, inputs, output). `loss.backward()`
+replays the tape as a pure function of the leaf parameters under jax.grad —
+autodiff by transform, no per-op grad kernels, and `to_static` can jit the
+same tape for production speed.
+"""
+
+import contextlib
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import framework
+
+
+class Tape:
+    def __init__(self):
+        self.entries = []   # (fn, arg_spec, kwargs, out_ref) — arg_spec items
+        #                     are ('v', var) or ('c', const)
+        self.enabled = True
+
+    def record(self, fn, args, kwargs, out_var):
+        if self.enabled:
+            self.entries.append((fn, args, kwargs, out_var))
+
+
+_tape = None
+_no_grad_depth = 0
+
+
+def current_tape():
+    return _tape
+
+
+def enabled():
+    return framework.in_dygraph_mode()
+
+
+def enable_dygraph(place=None):
+    global _tape
+    framework._set_dygraph_mode(True)
+    if _tape is None:
+        _tape = Tape()
+
+
+def disable_dygraph():
+    framework._set_dygraph_mode(False)
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    global _tape
+    old_tape = _tape
+    _tape = Tape()
+    framework._set_dygraph_mode(True)
+    try:
+        yield
+    finally:
+        framework._set_dygraph_mode(False)
+        _tape = old_tape
+
+
+@contextlib.contextmanager
+def no_grad():
+    global _no_grad_depth
+    _no_grad_depth += 1
+    try:
+        yield
+    finally:
+        _no_grad_depth -= 1
+
+
+def _grad_enabled():
+    return _no_grad_depth == 0 and _tape is not None
+
+
+class EagerVariable:
+    """Parity: dygraph VarBase. Wraps a jax.Array; remembers whether it is a
+    leaf (parameter) for backward."""
+
+    _next_id = 0
+
+    def __init__(self, value, name=None, persistable=False, trainable=False,
+                 is_leaf=False):
+        self.value = jnp.asarray(value)
+        EagerVariable._next_id += 1
+        self.id = EagerVariable._next_id
+        self.name = name or f"eager_var_{self.id}"
+        self.persistable = persistable
+        self.trainable = trainable
+        self.is_leaf = is_leaf
+        self.stop_gradient = not trainable
+        self._grad = None
+
+    # -- tensor protocol ----------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return str(self.value.dtype)
+
+    @property
+    def ndim(self):
+        return self.value.ndim
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    def astype(self, dtype):
+        from . import functional as F
+        return F.cast(self, dtype)
+
+    def detach(self):
+        return EagerVariable(self.value, name=self.name + ".detach")
+
+    def __repr__(self):
+        return f"EagerVariable(name={self.name}, shape={self.shape}, dtype={self.dtype})"
+
+    def __len__(self):
+        return int(self.value.shape[0])
+
+    def __getitem__(self, idx):
+        from . import functional as F
+        return F._getitem(self, idx)
+
+    # -- autograd -----------------------------------------------------------
+    @property
+    def grad(self):
+        return self._grad
+
+    def gradient(self):
+        return None if self._grad is None else np.asarray(self._grad)
+
+    def clear_gradient(self):
+        self._grad = None
+
+    def backward(self, backward_strategy=None):
+        run_backward(self)
+
+    # arithmetic operators are attached by dygraph.functional.
+
+
+def to_variable(value, name=None, block=None, zero_copy=None):
+    if isinstance(value, EagerVariable):
+        return value
+    return EagerVariable(np.asarray(value), name=name)
+
+
+def run_backward(loss):
+    """Replay the tape as fn(leaf params) -> loss; jax.grad it; stash grads
+    on the leaves (accumulating, fluid semantics)."""
+    tape = current_tape()
+    if tape is None:
+        raise RuntimeError("backward() outside dygraph.guard()")
+
+    # find leaves (trainable params) reachable in the tape
+    leaves = {}
+    for fn, args, kwargs, out in tape.entries:
+        for kind, v in args:
+            if kind == "v" and v.is_leaf and v.trainable and not v.stop_gradient:
+                leaves[v.id] = v
+    if not leaves:
+        return
+
+    entries = tape.entries
+
+    def replay(leaf_vals):
+        vals = dict(leaf_vals)
+
+        def get(kind, v):
+            if kind == "c":
+                return v
+            return vals.get(v.id, v.value)
+
+        for fn, args, kwargs, out in entries:
+            vals[out.id] = fn(*[get(k, v) for k, v in args], **kwargs)
+        out_val = vals.get(loss.id, loss.value)
+        return jnp.sum(out_val)
+
+    leaf_vals = {vid: v.value for vid, v in leaves.items()}
+    grads = jax.grad(replay)(leaf_vals)
+    for vid, g in grads.items():
+        v = leaves[vid]
+        v._grad = g if v._grad is None else v._grad + g
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
+         create_graph=False, allow_unused=True):
+    """Parity: paddle.grad — grads of outputs w.r.t. given inputs."""
+    tape = current_tape()
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    entries = tape.entries
+
+    def replay(in_vals):
+        vals = dict(in_vals)
+
+        def get(kind, v):
+            if kind == "c":
+                return v
+            return vals.get(v.id, v.value)
+
+        for fn, args, kwargs, out in entries:
+            if out.id not in in_vals:
+                vals[out.id] = fn(*[get(k, v) for k, v in args], **kwargs)
+        return sum(jnp.sum(vals.get(o.id, o.value)) for o in outputs)
+
+    in_vals = {v.id: v.value for v in inputs}
+    gs = jax.grad(replay)(in_vals)
+    return [EagerVariable(gs[v.id]) for v in inputs]

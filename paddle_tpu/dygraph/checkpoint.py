@@ -1,0 +1,24 @@
+"""save_dygraph / load_dygraph.
+
+Parity: python/paddle/fluid/dygraph/checkpoint.py.
+"""
+
+import os
+
+import numpy as np
+
+
+def save_dygraph(state_dict, model_path):
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    arrays = {}
+    for k, v in state_dict.items():
+        arrays[k] = np.asarray(v.value if hasattr(v, "value") else v)
+    np.savez(model_path + ".pdparams.npz", **arrays)
+
+
+def load_dygraph(model_path):
+    path = model_path + ".pdparams.npz"
+    if not os.path.exists(path):
+        path = model_path
+    data = np.load(path)
+    return {k: data[k] for k in data.files}, None

@@ -1,0 +1,234 @@
+"""Eager functional ops: execute now, record on the tape.
+
+Every function dispatches to the SAME kernel implementations as static mode
+(paddle_tpu.ops registry) through a minimal ctx shim — one source of truth
+for numerics across declarative and imperative modes (the reference shares
+C++ kernels between Executor and dygraph tracer the same way).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import ops as ops_registry
+from .base import EagerVariable, current_tape, _grad_enabled
+
+
+class MiniCtx:
+    """OpContext-compatible shim over plain dicts of arrays."""
+
+    def __init__(self, ins, attrs, rng=None, is_test=False):
+        self._ins = ins
+        self._attrs = attrs
+        self._rng = rng
+        self.is_test = is_test
+        self.op = _FakeOp(attrs)
+
+    def in_(self, slot, default=None):
+        v = self._ins.get(slot)
+        if v is None:
+            return default
+        return v[0] if isinstance(v, list) else v
+
+    def in_list(self, slot):
+        v = self._ins.get(slot, [])
+        return v if isinstance(v, list) else [v]
+
+    def has_in(self, slot):
+        return self._ins.get(slot) is not None
+
+    def attr(self, name, default=None):
+        return self._attrs.get(name, default)
+
+    def out_name(self, slot):
+        return None
+
+    def out_var(self, slot):
+        return self._attrs.get("__out_var__")
+
+    def rng(self):
+        return self._rng if self._rng is not None else jax.random.PRNGKey(0)
+
+
+class _FakeOp:
+    def __init__(self, attrs):
+        self.attrs = attrs
+
+
+def run_op_eager(op_type, ins, attrs, out_slot="Out", rng=None, is_test=False):
+    """Execute a registry kernel eagerly on EagerVariables; record on tape."""
+    arg_spec = []   # parallel structure for replay
+    slots = []      # (slot, is_list, count)
+    flat = []
+    for slot, v in ins.items():
+        if isinstance(v, (list, tuple)):
+            slots.append((slot, True, len(v)))
+            for item in v:
+                flat.append(item)
+        else:
+            slots.append((slot, False, 1))
+            flat.append(v)
+    for item in flat:
+        if isinstance(item, EagerVariable):
+            arg_spec.append(("v", item))
+        else:
+            arg_spec.append(("c", jnp.asarray(item)))
+
+    impl = ops_registry.get(op_type)
+
+    def fn(*arrays):
+        d = {}
+        i = 0
+        for slot, is_list, cnt in slots:
+            if is_list:
+                d[slot] = list(arrays[i:i + cnt])
+                i += cnt
+            else:
+                d[slot] = arrays[i]
+                i += 1
+        outs = impl(MiniCtx(d, attrs, rng=rng, is_test=is_test))
+        v = outs[out_slot]
+        return v[0] if isinstance(v, list) else v
+
+    values = [v.value if isinstance(v, EagerVariable) else jnp.asarray(v)
+              for v in flat]
+    out_val = fn(*values)
+    out = EagerVariable(out_val)
+    if _grad_enabled():
+        current_tape().record(fn, arg_spec, {}, out)
+    return out
+
+
+def run_op_eager_multi(op_type, ins, attrs, out_slots, rng=None, is_test=False):
+    """Multi-output variant: each requested slot is recorded separately."""
+    outs = {}
+    for slot in out_slots:
+        a = dict(attrs)
+        outs[slot] = run_op_eager(op_type, ins, a, out_slot=slot, rng=rng,
+                                  is_test=is_test)
+    return outs
+
+
+# -- convenience wrappers ----------------------------------------------------
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0):
+    return run_op_eager("matmul", {"X": x, "Y": y},
+                        {"transpose_X": transpose_x, "transpose_Y": transpose_y,
+                         "alpha": alpha})
+
+
+def add(x, y, axis=-1):
+    return run_op_eager("elementwise_add", {"X": x, "Y": y}, {"axis": axis})
+
+
+def sub(x, y, axis=-1):
+    return run_op_eager("elementwise_sub", {"X": x, "Y": y}, {"axis": axis})
+
+
+def mul(x, y, axis=-1):
+    return run_op_eager("elementwise_mul", {"X": x, "Y": y}, {"axis": axis})
+
+
+def div(x, y, axis=-1):
+    return run_op_eager("elementwise_div", {"X": x, "Y": y}, {"axis": axis})
+
+
+def relu(x):
+    return run_op_eager("relu", {"X": x}, {})
+
+
+def sigmoid(x):
+    return run_op_eager("sigmoid", {"X": x}, {})
+
+
+def tanh(x):
+    return run_op_eager("tanh", {"X": x}, {})
+
+
+def softmax(x, axis=-1):
+    return run_op_eager("softmax", {"X": x}, {"axis": axis})
+
+
+def cast(x, dtype):
+    from ..core.framework import convert_dtype
+    return run_op_eager("cast", {"X": x}, {"out_dtype": convert_dtype(dtype)})
+
+
+def reshape(x, shape):
+    return run_op_eager("reshape2", {"X": x}, {"shape": list(shape)})
+
+
+def transpose(x, perm):
+    return run_op_eager("transpose2", {"X": x}, {"axis": list(perm)})
+
+
+def concat(xs, axis=0):
+    return run_op_eager("concat", {"X": list(xs)}, {"axis": axis})
+
+
+def mean(x):
+    return run_op_eager("mean", {"X": x}, {})
+
+
+def reduce_sum(x, dim=None, keep_dim=False):
+    attrs = {"keep_dim": keep_dim}
+    if dim is None:
+        attrs["reduce_all"] = True
+        attrs["dim"] = [0]
+    else:
+        attrs["dim"] = dim if isinstance(dim, (list, tuple)) else [dim]
+    return run_op_eager("reduce_sum", {"X": x}, attrs)
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    return run_op_eager("cross_entropy", {"X": input, "Label": label},
+                        {"soft_label": soft_label,
+                         "ignore_index": ignore_index}, out_slot="Y")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1):
+    return run_op_eager("softmax_with_cross_entropy",
+                        {"Logits": logits, "Label": label},
+                        {"soft_label": soft_label, "axis": axis},
+                        out_slot="Loss")
+
+
+def square_error_cost(x, y):
+    return run_op_eager("square_error_cost", {"X": x, "Y": y}, {})
+
+
+def scale_op(x, scale=1.0, bias=0.0):
+    return run_op_eager("scale", {"X": x}, {"scale": scale, "bias": bias})
+
+
+def _getitem(x, idx):
+    def fn(v):
+        return v[idx]
+    out_val = fn(x.value)
+    out = EagerVariable(out_val)
+    if _grad_enabled():
+        current_tape().record(fn, [("v", x)], {}, out)
+    return out
+
+
+def _attach_operators():
+    EagerVariable.__add__ = lambda s, o: add(s, _wrap(o))
+    EagerVariable.__radd__ = lambda s, o: add(_wrap(o), s)
+    EagerVariable.__sub__ = lambda s, o: sub(s, _wrap(o))
+    EagerVariable.__rsub__ = lambda s, o: sub(_wrap(o), s)
+    EagerVariable.__mul__ = lambda s, o: mul(s, _wrap(o))
+    EagerVariable.__rmul__ = lambda s, o: mul(_wrap(o), s)
+    EagerVariable.__truediv__ = lambda s, o: div(s, _wrap(o))
+    EagerVariable.__rtruediv__ = lambda s, o: div(_wrap(o), s)
+    EagerVariable.__neg__ = lambda s: scale_op(s, scale=-1.0)
+    EagerVariable.__matmul__ = matmul
+
+
+def _wrap(o):
+    if isinstance(o, EagerVariable):
+        return o
+    return EagerVariable(jnp.asarray(o))
+
+
+_attach_operators()

@@ -1,0 +1,222 @@
+"""Layer base class (dygraph modules).
+
+Parity: python/paddle/fluid/dygraph/layers.py.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core import unique_name
+from .base import EagerVariable
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self._full_name = unique_name.generate(
+            (name_scope or self.__class__.__name__.lower()))
+        self._dtype = dtype
+        self._parameters = {}
+        self._sub_layers = {}
+        self._buffers = {}
+        self.training = True
+
+    def full_name(self):
+        return self._full_name
+
+    # -- parameter management -----------------------------------------------
+    def create_parameter(self, shape, dtype="float32", attr=None,
+                         is_bias=False, default_initializer=None):
+        from ..core.param_attr import ParamAttr
+        from .. import initializer as init_mod
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        init = attr.initializer or default_initializer or (
+            init_mod.ConstantInitializer(0.0) if is_bias
+            else init_mod.XavierInitializer())
+        value = _materialize_init(init, shape, dtype)
+        name = attr.name or unique_name.generate(self._full_name + ".w")
+        p = EagerVariable(value, name=name, persistable=True,
+                          trainable=attr.trainable, is_leaf=True)
+        p.optimize_attr = {"learning_rate": attr.learning_rate}
+        p.regularizer = attr.regularizer
+        return p
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, value):
+        self._buffers[name] = value
+        return value
+
+    def parameters(self, include_sublayers=True):
+        out = list(self._parameters.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.parameters())
+        return out
+
+    def named_parameters(self, prefix=""):
+        for name, p in self._parameters.items():
+            yield (prefix + name if not prefix else prefix + "." + name), p
+        for lname, l in self._sub_layers.items():
+            sub_prefix = lname if not prefix else prefix + "." + lname
+            yield from l.named_parameters(sub_prefix)
+
+    def sublayers(self, include_sublayers=True):
+        out = list(self._sub_layers.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.sublayers())
+        return out
+
+    # -- train/eval ---------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self, include_sublayers=True):
+        out = {}
+        for name, p in self.named_parameters():
+            out[name] = np.asarray(p.value)
+        for name, b in self._buffers.items():
+            out[name] = np.asarray(b.value if isinstance(b, EagerVariable) else b)
+        return out
+
+    def set_dict(self, state_dict, include_sublayers=True):
+        named = dict(self.named_parameters())
+        for k, v in state_dict.items():
+            if k in named:
+                named[k].value = jnp.asarray(v)
+        return self
+
+    load_dict = set_dict
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    # -- call protocol ------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __setattr__(self, name, value):
+        if isinstance(value, EagerVariable) and value.is_leaf:
+            self.__dict__.setdefault("_parameters", {})[name] = value
+        elif isinstance(value, Layer):
+            self.__dict__.setdefault("_sub_layers", {})[name] = value
+        object.__setattr__(self, name, value)
+
+
+def _materialize_init(init, shape, dtype):
+    """Run an initializer spec eagerly (no program) via a scratch program."""
+    from .. import initializer as init_mod
+    shape = tuple(int(s) for s in shape)
+    if isinstance(init, init_mod.ConstantInitializer):
+        return np.full(shape, init.value, dtype=dtype)
+    if isinstance(init, init_mod.UniformInitializer):
+        return np.random.uniform(init.low, init.high, shape).astype(dtype)
+    if isinstance(init, init_mod.NormalInitializer):
+        return np.random.normal(init.loc, init.scale, shape).astype(dtype)
+    if isinstance(init, init_mod.TruncatedNormalInitializer):
+        v = np.clip(np.random.normal(0, 1, shape), -2, 2)
+        return (init.loc + init.scale * v).astype(dtype)
+    if isinstance(init, init_mod.XavierInitializer):
+        class _V:  # _fan_in_out expects .shape
+            pass
+        v = _V()
+        v.shape = shape
+        fi, fo = init_mod._fan_in_out(v)
+        fi = init.fan_in or fi
+        fo = init.fan_out or fo
+        if init.uniform:
+            limit = float(np.sqrt(6.0 / (fi + fo)))
+            return np.random.uniform(-limit, limit, shape).astype(dtype)
+        return np.random.normal(0, np.sqrt(2.0 / (fi + fo)), shape).astype(dtype)
+    if isinstance(init, init_mod.MSRAInitializer):
+        class _V:
+            pass
+        v = _V()
+        v.shape = shape
+        fi, _ = init_mod._fan_in_out(v)
+        fi = init.fan_in or fi
+        if init.uniform:
+            limit = float(np.sqrt(6.0 / fi))
+            return np.random.uniform(-limit, limit, shape).astype(dtype)
+        return np.random.normal(0, np.sqrt(2.0 / fi), shape).astype(dtype)
+    if isinstance(init, init_mod.NumpyArrayInitializer):
+        return np.asarray(init.value, dtype=dtype).reshape(shape)
+    raise NotImplementedError(f"initializer {type(init).__name__} in dygraph")
+
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        for i, l in enumerate(layers):
+            if isinstance(l, tuple):
+                name, l = l
+            else:
+                name = str(i)
+            self.add_sublayer(name, l)
+
+    def forward(self, x):
+        for l in self._sub_layers.values():
+            x = l(x)
+        return x
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        for i, l in enumerate(sublayers or []):
+            self.add_sublayer(str(i), l)
+
+    def append(self, sublayer):
+        self.add_sublayer(str(len(self._sub_layers)), sublayer)
+        return self
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __getitem__(self, idx):
+        return list(self._sub_layers.values())[idx]
+
+    def forward(self, *a, **k):
+        raise RuntimeError("LayerList is a container")
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        for i, p in enumerate(parameters or []):
+            self.add_parameter(str(i), p)
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __getitem__(self, idx):
+        return list(self._parameters.values())[idx]

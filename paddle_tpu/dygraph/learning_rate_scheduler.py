@@ -1,0 +1,114 @@
+"""Dygraph LR schedulers.
+
+Parity: python/paddle/fluid/dygraph/learning_rate_scheduler.py. Host-side
+python objects: `scheduler()` returns the current LR and `step()` advances.
+"""
+
+import math
+
+
+class LearningRateDecay:
+    def __init__(self, begin=0, step=1):
+        self.step_num = begin
+        self.step_size = step
+
+    def step(self):
+        self.step_num += self.step_size
+
+    def __call__(self):
+        return self.get_lr()
+
+    def get_lr(self):
+        raise NotImplementedError
+
+
+class NoamDecay(LearningRateDecay):
+    def __init__(self, d_model, warmup_steps, begin=1, step=1):
+        super().__init__(begin, step)
+        self.d_model = d_model
+        self.warmup_steps = warmup_steps
+
+    def get_lr(self):
+        s = max(self.step_num, 1)
+        return (self.d_model ** -0.5) * min(s ** -0.5,
+                                            s * self.warmup_steps ** -1.5)
+
+
+class ExponentialDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1):
+        super().__init__(begin, step)
+        self.lr = learning_rate
+        self.decay_steps = decay_steps
+        self.decay_rate = decay_rate
+        self.staircase = staircase
+
+    def get_lr(self):
+        d = self.step_num / self.decay_steps
+        if self.staircase:
+            d = math.floor(d)
+        return self.lr * (self.decay_rate ** d)
+
+
+class NaturalExpDecay(ExponentialDecay):
+    def get_lr(self):
+        d = self.step_num / self.decay_steps
+        if self.staircase:
+            d = math.floor(d)
+        return self.lr * math.exp(-self.decay_rate * d)
+
+
+class InverseTimeDecay(ExponentialDecay):
+    def get_lr(self):
+        d = self.step_num / self.decay_steps
+        if self.staircase:
+            d = math.floor(d)
+        return self.lr / (1 + self.decay_rate * d)
+
+
+class PolynomialDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, end_learning_rate=0.0001,
+                 power=1.0, cycle=False, begin=0, step=1):
+        super().__init__(begin, step)
+        self.lr = learning_rate
+        self.decay_steps = decay_steps
+        self.end_lr = end_learning_rate
+        self.power = power
+        self.cycle = cycle
+
+    def get_lr(self):
+        step = self.step_num
+        decay_steps = self.decay_steps
+        if self.cycle:
+            div = max(math.ceil(step / decay_steps), 1)
+            decay_steps = decay_steps * div
+        else:
+            step = min(step, decay_steps)
+        frac = (1 - step / decay_steps) ** self.power
+        return (self.lr - self.end_lr) * frac + self.end_lr
+
+
+class PiecewiseDecay(LearningRateDecay):
+    def __init__(self, boundaries, values, begin=0, step=1):
+        super().__init__(begin, step)
+        self.boundaries = boundaries
+        self.values = values
+
+    def get_lr(self):
+        for b, v in zip(self.boundaries, self.values):
+            if self.step_num < b:
+                return v
+        return self.values[len(self.boundaries)]
+
+
+class CosineDecay(LearningRateDecay):
+    def __init__(self, learning_rate, step_each_epoch, epochs, begin=0,
+                 step=1):
+        super().__init__(begin, step)
+        self.lr = learning_rate
+        self.step_each_epoch = step_each_epoch
+        self.epochs = epochs
+
+    def get_lr(self):
+        epoch = math.floor(self.step_num / self.step_each_epoch)
+        return self.lr * 0.5 * (math.cos(epoch * math.pi / self.epochs) + 1)

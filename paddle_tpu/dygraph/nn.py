@@ -1,0 +1,410 @@
+"""Dygraph NN modules.
+
+Parity: python/paddle/fluid/dygraph/nn.py (Conv2D, Pool2D, FC, BatchNorm,
+Embedding, LayerNorm, GRUUnit, NCE, PRelu, BilinearTensorProduct,
+Conv2DTranspose, GroupNorm, SpectralNorm).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import initializer as init_mod
+from .base import EagerVariable
+from .layers import Layer
+from . import functional as F
+from .functional import run_op_eager
+
+
+_rng_counter = [0]
+
+
+def _next_rng():
+    _rng_counter[0] += 1
+    return jax.random.PRNGKey(_rng_counter[0])
+
+
+class Linear(Layer):
+    def __init__(self, input_dim, output_dim, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__()
+        self.weight = self.create_parameter([input_dim, output_dim], dtype,
+                                            param_attr)
+        self.bias = self.create_parameter([output_dim], dtype, bias_attr,
+                                          is_bias=True)
+        self._act = act
+
+    def forward(self, x):
+        out = F.matmul(x, self.weight)
+        if self.bias is not None:
+            out = run_op_eager("elementwise_add", {"X": out, "Y": self.bias},
+                               {"axis": -1})
+        return _act(out, self._act)
+
+
+class FC(Layer):
+    """fluid 1.5 dygraph FC (flattens trailing dims)."""
+
+    def __init__(self, name_scope, size, num_flatten_dims=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(name_scope)
+        self._size = size
+        self._nfd = num_flatten_dims
+        self._act = act
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._dtype = dtype
+        self.weight = None
+        self.bias = None
+
+    def forward(self, x):
+        if self.weight is None:
+            in_features = int(np.prod(x.shape[self._nfd:]))
+            self.weight = self.create_parameter(
+                [in_features, self._size], self._dtype, self._param_attr)
+            self.bias = self.create_parameter([self._size], self._dtype,
+                                              self._bias_attr, is_bias=True)
+        out = run_op_eager("mul", {"X": x, "Y": self.weight},
+                           {"x_num_col_dims": self._nfd, "y_num_col_dims": 1})
+        if self.bias is not None:
+            out = run_op_eager("elementwise_add", {"X": out, "Y": self.bias},
+                               {"axis": self._nfd})
+        return _act(out, self._act)
+
+
+def _act(x, act):
+    if act is None:
+        return x
+    return run_op_eager(act, {"X": x}, {})
+
+
+class Conv2D(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__()
+        fs = filter_size if isinstance(filter_size, (list, tuple)) else (
+            filter_size, filter_size)
+        groups = groups or 1
+        fan_in = (num_channels // groups) * fs[0] * fs[1]
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // groups, fs[0], fs[1]], dtype,
+            param_attr,
+            default_initializer=init_mod.NormalInitializer(
+                0.0, (2.0 / fan_in) ** 0.5))
+        self.bias = self.create_parameter([num_filters], dtype, bias_attr,
+                                          is_bias=True)
+        self._attrs = {"strides": _pair(stride), "paddings": _pair(padding),
+                       "dilations": _pair(dilation), "groups": groups}
+        self._act = act
+
+    def forward(self, x):
+        ins = {"Input": x, "Filter": self.weight}
+        if self.bias is not None:
+            ins["Bias"] = self.bias
+        out = run_op_eager("conv2d", ins, dict(self._attrs), out_slot="Output")
+        return _act(out, self._act)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__()
+        fs = _pair(filter_size)
+        self.weight = self.create_parameter(
+            [num_channels, num_filters // (groups or 1), fs[0], fs[1]], dtype,
+            param_attr)
+        self.bias = self.create_parameter([num_filters], dtype, bias_attr,
+                                          is_bias=True)
+        self._attrs = {"strides": _pair(stride), "paddings": _pair(padding),
+                       "dilations": _pair(dilation), "groups": groups or 1}
+        self._act = act
+
+    def forward(self, x):
+        ins = {"Input": x, "Filter": self.weight}
+        if self.bias is not None:
+            ins["Bias"] = self.bias
+        out = run_op_eager("conv2d_transpose", ins, dict(self._attrs),
+                           out_slot="Output")
+        return _act(out, self._act)
+
+
+class Pool2D(Layer):
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, use_cudnn=True,
+                 ceil_mode=False, exclusive=True):
+        super().__init__()
+        self._attrs = {"pooling_type": pool_type, "ksize": _pair(pool_size),
+                       "strides": _pair(pool_stride),
+                       "paddings": _pair(pool_padding),
+                       "global_pooling": global_pooling,
+                       "exclusive": exclusive}
+
+    def forward(self, x):
+        return run_op_eager("pool2d", {"X": x}, dict(self._attrs))
+
+
+class BatchNorm(Layer):
+    def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 dtype="float32", data_layout="NCHW", use_global_stats=False):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [num_channels], dtype, param_attr,
+            default_initializer=init_mod.ConstantInitializer(1.0))
+        self.bias = self.create_parameter([num_channels], dtype, bias_attr,
+                                          is_bias=True)
+        self._mean = EagerVariable(np.zeros(num_channels, dtype))
+        self._variance = EagerVariable(np.ones(num_channels, dtype))
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._layout = data_layout
+        self._use_global_stats = use_global_stats
+        self._act = act
+
+    def forward(self, x):
+        is_test = not self.training
+        ins = {"X": x, "Scale": self.weight, "Bias": self.bias,
+               "Mean": self._mean, "Variance": self._variance}
+        attrs = {"momentum": self._momentum, "epsilon": self._epsilon,
+                 "data_layout": self._layout, "is_test": is_test,
+                 "use_global_stats": self._use_global_stats}
+        out = run_op_eager("batch_norm", ins, attrs, out_slot="Y")
+        if not is_test:
+            # update running stats eagerly (no grad through them)
+            from ..ops import get as get_op
+            from .functional import MiniCtx
+            stats = get_op("batch_norm")(MiniCtx(
+                {k: (v.value if isinstance(v, EagerVariable) else v)
+                 for k, v in ins.items()}, attrs))
+            self._mean.value = stats["MeanOut"]
+            self._variance.value = stats["VarianceOut"]
+        return _act(out, self._act)
+
+
+class Embedding(Layer):
+    def __init__(self, size=None, is_sparse=False, is_distributed=False,
+                 padding_idx=None, param_attr=None, dtype="float32",
+                 name_scope=None):
+        super().__init__(name_scope)
+        self._size = size
+        self._padding_idx = -1 if padding_idx is None else padding_idx
+        self.weight = self.create_parameter(
+            list(size), dtype, param_attr,
+            default_initializer=init_mod.XavierInitializer())
+
+    def forward(self, ids):
+        return run_op_eager("lookup_table",
+                            {"W": self.weight, "Ids": ids},
+                            {"padding_idx": self._padding_idx})
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape=None, scale=True, shift=True,
+                 begin_norm_axis=1, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32",
+                 name_scope=None):
+        super().__init__(name_scope)
+        if normalized_shape is not None:
+            n = int(np.prod(np.atleast_1d(normalized_shape)))
+        else:
+            n = None
+        self._n = n
+        self._scale = scale
+        self._shift = shift
+        self._begin = begin_norm_axis
+        self._epsilon = epsilon
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._dtype = dtype
+        self._act = act
+        self.weight = None
+        self.bias = None
+        if n is not None:
+            self._build(n)
+
+    def _build(self, n):
+        if self._scale:
+            self.weight = self.create_parameter(
+                [n], self._dtype, self._param_attr,
+                default_initializer=init_mod.ConstantInitializer(1.0))
+        if self._shift:
+            self.bias = self.create_parameter([n], self._dtype,
+                                              self._bias_attr, is_bias=True)
+
+    def forward(self, x):
+        if self.weight is None and self._scale:
+            self._build(int(np.prod(x.shape[self._begin:])))
+        ins = {"X": x}
+        if self.weight is not None:
+            ins["Scale"] = self.weight
+        if self.bias is not None:
+            ins["Bias"] = self.bias
+        out = run_op_eager("layer_norm", ins,
+                           {"begin_norm_axis": self._begin,
+                            "epsilon": self._epsilon}, out_slot="Y")
+        return _act(out, self._act)
+
+
+class GroupNorm(Layer):
+    def __init__(self, channels, groups, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32", name_scope=None):
+        super().__init__(name_scope)
+        self.weight = self.create_parameter(
+            [channels], dtype, param_attr,
+            default_initializer=init_mod.ConstantInitializer(1.0))
+        self.bias = self.create_parameter([channels], dtype, bias_attr,
+                                          is_bias=True)
+        self._groups = groups
+        self._epsilon = epsilon
+        self._act = act
+
+    def forward(self, x):
+        ins = {"X": x, "Scale": self.weight, "Bias": self.bias}
+        out = run_op_eager("group_norm", ins,
+                           {"groups": self._groups, "epsilon": self._epsilon},
+                           out_slot="Y")
+        return _act(out, self._act)
+
+
+class SpectralNorm(Layer):
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32", name_scope=None):
+        super().__init__(name_scope)
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        self._u = EagerVariable(np.random.randn(h).astype(dtype))
+        self._v = EagerVariable(np.random.randn(w).astype(dtype))
+        self._attrs = {"dim": dim, "power_iters": power_iters, "eps": eps}
+
+    def forward(self, weight):
+        return run_op_eager("spectral_norm",
+                            {"Weight": weight, "U": self._u, "V": self._v},
+                            dict(self._attrs))
+
+
+class PRelu(Layer):
+    def __init__(self, mode="all", channel=None, input_shape=None,
+                 param_attr=None, dtype="float32", name_scope=None):
+        super().__init__(name_scope)
+        if mode == "all":
+            shape = [1]
+        elif mode == "channel":
+            shape = [channel]
+        else:
+            shape = list(input_shape)
+        self.weight = self.create_parameter(
+            shape, dtype, param_attr,
+            default_initializer=init_mod.ConstantInitializer(0.25))
+        self._mode = mode
+
+    def forward(self, x):
+        return run_op_eager("prelu", {"X": x, "Alpha": self.weight},
+                            {"mode": self._mode})
+
+
+class BilinearTensorProduct(Layer):
+    def __init__(self, input1_dim, input2_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32", name_scope=None):
+        super().__init__(name_scope)
+        self.weight = self.create_parameter(
+            [output_dim, input1_dim, input2_dim], dtype, param_attr)
+        self.bias = self.create_parameter([output_dim], dtype, bias_attr,
+                                          is_bias=True)
+        self._act = act
+
+    def forward(self, x, y):
+        ins = {"X": x, "Y": y, "Weight": self.weight}
+        if self.bias is not None:
+            ins["Bias"] = self.bias
+        out = run_op_eager("bilinear_tensor_product", ins, {})
+        return _act(out, self._act)
+
+
+class GRUUnit(Layer):
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 activation="tanh", gate_activation="sigmoid",
+                 dtype="float32", name_scope=None):
+        super().__init__(name_scope)
+        self._hidden = size // 3
+        d = self._hidden
+        self.weight = self.create_parameter([d, 3 * d], dtype, param_attr)
+        self.bias = self.create_parameter([1, 3 * d], dtype, bias_attr,
+                                          is_bias=True)
+
+    def forward(self, input, hidden):
+        d = self._hidden
+
+        # GRU math (fluid gru_unit): input already = x @ W_in + b_in (3d)
+        def gru(x, h, w, b):
+            xu, xr, xc = jnp.split(x + b.reshape(-1), 3, axis=-1)
+            hu = h @ w[:, :d]
+            hr = h @ w[:, d:2 * d]
+            u = jax.nn.sigmoid(xu + hu)
+            r = jax.nn.sigmoid(xr + hr)
+            c = jnp.tanh(xc + (r * h) @ w[:, 2 * d:])
+            new_h = u * h + (1 - u) * c
+            return new_h
+
+        from .base import current_tape, _grad_enabled
+        args = [input, hidden, self.weight, self.bias]
+        vals = [a.value for a in args]
+        out = EagerVariable(gru(*vals))
+        if _grad_enabled():
+            current_tape().record(gru, [("v", a) for a in args], {}, out)
+        return out
+
+
+class NCE(Layer):
+    """Noise-contrastive estimation head (training-time sampled softmax)."""
+
+    def __init__(self, num_total_classes, dim, num_neg_samples=10,
+                 param_attr=None, bias_attr=None, dtype="float32",
+                 name_scope=None):
+        super().__init__(name_scope)
+        self.weight = self.create_parameter([num_total_classes, dim], dtype,
+                                            param_attr)
+        self.bias = self.create_parameter([num_total_classes], dtype,
+                                          bias_attr, is_bias=True)
+        self._num_neg = num_neg_samples
+        self._num_classes = num_total_classes
+
+    def forward(self, input, label):
+        key = _next_rng()
+        neg = jax.random.randint(key, (self._num_neg,), 0, self._num_classes)
+
+        def nce(x, lbl, w, b):
+            lbl = lbl.reshape(-1).astype(jnp.int32)
+            pos_logit = jnp.sum(x * w[lbl], axis=-1) + b[lbl]
+            neg_logit = x @ w[neg].T + b[neg]
+            pos_loss = jax.nn.softplus(-pos_logit)
+            neg_loss = jax.nn.softplus(neg_logit).sum(axis=-1)
+            return (pos_loss + neg_loss).reshape(-1, 1)
+
+        from .base import current_tape, _grad_enabled
+        args = [input, label, self.weight, self.bias]
+        out = EagerVariable(nce(*[a.value for a in args]))
+        if _grad_enabled():
+            current_tape().record(nce, [("v", a) for a in args], {}, out)
+        return out
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, mode="downgrade_in_infer"):
+        super().__init__()
+        self._p = p
+        self._mode = mode
+
+    def forward(self, x):
+        return run_op_eager("dropout", {"X": x},
+                            {"dropout_prob": self._p,
+                             "dropout_implementation": self._mode},
+                            rng=_next_rng(), is_test=not self.training)
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v] * n

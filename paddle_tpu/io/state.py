@@ -1,0 +1,102 @@
+"""Save/load parameters & persistables.
+
+Parity: python/paddle/fluid/io.py (save_vars/save_params/save_persistables,
+load_*). Storage format: one .npy per var under dirname, or a single
+combined .npz when filename is given — a portable host-side format (the
+reference writes LoDTensor protobufs).
+"""
+
+import os
+
+import numpy as np
+
+from ..core.framework import Parameter, default_main_program
+from ..core.executor import global_scope
+
+
+def is_parameter(var):
+    return isinstance(var, Parameter)
+
+
+def is_persistable(var):
+    return bool(getattr(var, "persistable", False))
+
+
+def _resolve(executor, dirname, main_program, predicate, filename, save):
+    program = main_program or default_main_program()
+    scope = global_scope()
+    names = [v.name for v in program.list_vars() if predicate(v)]
+    os.makedirs(dirname, exist_ok=True)
+    return program, scope, names
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    program = main_program or default_main_program()
+    scope = global_scope()
+    if vars is None:
+        vars = [v for v in program.list_vars() if (predicate or is_persistable)(v)]
+    os.makedirs(dirname, exist_ok=True)
+    arrays = {}
+    for v in vars:
+        name = v.name if not isinstance(v, str) else v
+        val = scope.get(name)
+        if val is None:
+            continue
+        arrays[name] = np.asarray(val)
+    if filename is not None:
+        np.savez(os.path.join(dirname, filename), **arrays)
+    else:
+        for name, arr in arrays.items():
+            np.save(os.path.join(dirname, name.replace("/", "__") + ".npy"), arr)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=is_parameter, filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=is_persistable, filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    import jax.numpy as jnp
+    program = main_program or default_main_program()
+    scope = global_scope()
+    if vars is None:
+        vars = [v for v in program.list_vars() if (predicate or is_persistable)(v)]
+    if filename is not None:
+        data = np.load(os.path.join(dirname, filename)
+                       if not filename.endswith(".npz")
+                       else os.path.join(dirname, filename), allow_pickle=False)
+        for v in vars:
+            name = v.name if not isinstance(v, str) else v
+            if name in data:
+                scope.set(name, jnp.asarray(data[name]))
+        return
+    for v in vars:
+        name = v.name if not isinstance(v, str) else v
+        path = os.path.join(dirname, name.replace("/", "__") + ".npy")
+        if os.path.exists(path):
+            scope.set(name, jnp.asarray(np.load(path)))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=is_parameter, filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=is_persistable, filename=filename)
+
+
+def get_parameter_value(para, executor=None):
+    return np.asarray(global_scope().get(para.name))
+
+
+def get_parameter_value_by_name(name, executor=None, program=None):
+    return np.asarray(global_scope().get(name))

@@ -1,0 +1,27 @@
+"""fluid.layers-compatible API surface.
+
+Parity: python/paddle/fluid/layers/__init__.py — everything re-exported flat,
+so `layers.fc(...)`, `layers.data(...)` etc. work like the reference.
+"""
+
+from .io import data, fluid_data
+from .nn import *          # noqa: F401,F403
+from .tensor import (create_tensor, create_parameter, create_global_var,
+                     fill_constant, fill_constant_batch_size_like, assign,
+                     zeros, ones, zeros_like, ones_like, sums, linspace,
+                     range, eye, diag, reverse, has_inf, has_nan, isfinite)
+from .ops import *         # noqa: F401,F403
+from .loss import (cross_entropy, softmax_with_cross_entropy,
+                   square_error_cost, sigmoid_cross_entropy_with_logits,
+                   huber_loss, log_loss, bpr_loss, kldiv_loss, rank_loss,
+                   margin_rank_loss, dice_loss, npair_loss, mse_loss,
+                   teacher_student_sigmoid_loss, cos_sim, center_loss)
+from .metric_op import accuracy, auc, mean_iou
+from . import learning_rate_scheduler
+from .learning_rate_scheduler import (noam_decay, exponential_decay,
+                                      natural_exp_decay, inverse_time_decay,
+                                      polynomial_decay, piecewise_decay,
+                                      cosine_decay, linear_lr_warmup)
+from .math_op_patch import monkey_patch_variable
+
+monkey_patch_variable()

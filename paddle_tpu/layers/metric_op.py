@@ -1,0 +1,56 @@
+"""Metric layers.
+
+Parity: python/paddle/fluid/layers/metric_op.py (accuracy, auc).
+"""
+
+from ..core.layer_helper import LayerHelper
+from .. import initializer as init_mod
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    helper = LayerHelper("accuracy")
+    topk_out = helper.create_variable_for_type_inference(input.dtype)
+    topk_idx = helper.create_variable_for_type_inference("int64")
+    helper.append_op("top_k", {"X": input},
+                     {"Out": topk_out, "Indices": topk_idx}, {"k": k})
+    acc = helper.create_variable_for_type_inference("float32", (1,))
+    correct = correct or helper.create_variable_for_type_inference("int32", (1,))
+    total = total or helper.create_variable_for_type_inference("int32", (1,))
+    helper.append_op("accuracy",
+                     {"Out": topk_out, "Indices": topk_idx, "Label": label},
+                     {"Accuracy": acc, "Correct": correct, "Total": total})
+    return acc
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    helper = LayerHelper("auc")
+    n = num_thresholds + 1
+    stat_pos = helper.create_or_get_global_variable(
+        helper.name + ".stat_pos", shape=(n,), dtype="float32", persistable=True)
+    stat_neg = helper.create_or_get_global_variable(
+        helper.name + ".stat_neg", shape=(n,), dtype="float32", persistable=True)
+    stat_pos.stop_gradient = True
+    stat_neg.stop_gradient = True
+    init_mod.ConstantInitializer(0.0)(stat_pos)
+    init_mod.ConstantInitializer(0.0)(stat_neg)
+    auc_out = helper.create_variable_for_type_inference("float32", (1,))
+    helper.append_op("auc",
+                     {"Predict": input, "Label": label, "StatPos": stat_pos,
+                      "StatNeg": stat_neg},
+                     {"AUC": auc_out, "StatPosOut": stat_pos,
+                      "StatNegOut": stat_neg},
+                     {"curve": curve, "num_thresholds": num_thresholds})
+    return auc_out, auc_out, [stat_pos, stat_neg]
+
+
+def mean_iou(input, label, num_classes):
+    helper = LayerHelper("mean_iou")
+    miou = helper.create_variable_for_type_inference("float32", (1,))
+    wrong = helper.create_variable_for_type_inference("float32", (num_classes,))
+    correct = helper.create_variable_for_type_inference("float32", (num_classes,))
+    helper.append_op("mean_iou", {"Predictions": input, "Labels": label},
+                     {"OutMeanIou": miou, "OutWrong": wrong,
+                      "OutCorrect": correct},
+                     {"num_classes": num_classes})
+    return miou, wrong, correct

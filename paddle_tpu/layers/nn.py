@@ -1,0 +1,983 @@
+"""Neural-network layers (declarative API).
+
+Parity: python/paddle/fluid/layers/nn.py (fc, embedding, conv2d, pool2d,
+batch_norm, layer_norm, dropout, ...). Each function appends ops to the
+default main program; kernels live in paddle_tpu/ops/ as fused-friendly JAX.
+"""
+
+import numpy as np
+
+from ..core.framework import Variable
+from ..core.layer_helper import LayerHelper
+from .. import initializer as init_mod
+
+
+def _prod(xs):
+    r = 1
+    for x in xs:
+        r *= int(x)
+    return r
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v] * n
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """Parity: fluid.layers.fc (ref python/paddle/fluid/layers/nn.py:fc).
+    mul lowers onto the MXU; bias+act fuse into the matmul epilogue."""
+    helper = LayerHelper("fc", param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    dtype = inputs[0].dtype
+    mul_results = []
+    for inp, p_attr in zip(inputs, (helper.param_attr if isinstance(helper.param_attr, list)
+                                    else [helper.param_attr] * len(inputs))):
+        in_features = _prod(inp.shape[num_flatten_dims:])
+        w = helper.create_parameter(attr=p_attr, shape=[in_features, size],
+                                    dtype=dtype)
+        out_shape = tuple(inp.shape[:num_flatten_dims]) + (size,)
+        tmp = helper.create_variable_for_type_inference(dtype, out_shape)
+        helper.append_op("mul", {"X": inp, "Y": w}, {"Out": tmp},
+                         {"x_num_col_dims": num_flatten_dims,
+                          "y_num_col_dims": 1})
+        mul_results.append(tmp)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_variable_for_type_inference(dtype, mul_results[0].shape)
+        helper.append_op("sum", {"X": mul_results}, {"Out": pre_bias})
+    pre_act = _append_bias(helper, pre_bias, size, dim_start=num_flatten_dims)
+    return helper.append_activation(pre_act)
+
+
+def _append_bias(helper, pre_bias, size, dim_start=1):
+    bias_attr = helper.bias_attr
+    if bias_attr is False:
+        return pre_bias
+    b = helper.create_parameter(attr=bias_attr, shape=[size],
+                                dtype=pre_bias.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(pre_bias.dtype, pre_bias.shape)
+    helper.append_op("elementwise_add", {"X": pre_bias, "Y": b}, {"Out": out},
+                     {"axis": dim_start})
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32", name=None):
+    """Parity: fluid.layers.embedding. is_sparse is accepted but moot: on TPU
+    the gather rides HBM and grads flow as dense rows (XLA scatter-add)."""
+    helper = LayerHelper("embedding", param_attr=param_attr, name=name)
+    w = helper.create_parameter(attr=helper.param_attr, shape=list(size),
+                                dtype=dtype,
+                                default_initializer=init_mod.XavierInitializer())
+    in_shape = tuple(input.shape)
+    if in_shape and in_shape[-1] == 1:
+        in_shape = in_shape[:-1]
+    out = helper.create_variable_for_type_inference(dtype, in_shape + (size[1],))
+    pidx = -1 if padding_idx is None else (
+        padding_idx if padding_idx >= 0 else size[0] + padding_idx)
+    helper.append_op("lookup_table", {"W": w, "Ids": input}, {"Out": out},
+                     {"padding_idx": pidx, "is_sparse": is_sparse})
+    return out
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    helper = LayerHelper("one_hot")
+    shape = tuple(input.shape)
+    if shape and shape[-1] == 1:
+        shape = shape[:-1]
+    out = helper.create_variable_for_type_inference("float32", shape + (depth,))
+    helper.append_op("one_hot", {"X": input}, {"Out": out}, {"depth": depth})
+    return out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper("matmul", name=name)
+    xs = list(x.shape)
+    ys = list(y.shape)
+    if transpose_x and len(xs) >= 2:
+        xs[-1], xs[-2] = xs[-2], xs[-1]
+    if transpose_y and len(ys) >= 2:
+        ys[-1], ys[-2] = ys[-2], ys[-1]
+    out_shape = tuple(xs[:-1] + ys[-1:]) if len(ys) >= 2 else tuple(xs[:-1])
+    out = helper.create_variable_for_type_inference(x.dtype, out_shape)
+    helper.append_op("matmul", {"X": x, "Y": y}, {"Out": out},
+                     {"transpose_X": transpose_x, "transpose_Y": transpose_y,
+                      "alpha": float(alpha)})
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    helper = LayerHelper("mul", name=name)
+    out_shape = tuple(x.shape[:x_num_col_dims]) + tuple(y.shape[y_num_col_dims:])
+    out = helper.create_variable_for_type_inference(x.dtype, out_shape)
+    helper.append_op("mul", {"X": x, "Y": y}, {"Out": out},
+                     {"x_num_col_dims": x_num_col_dims,
+                      "y_num_col_dims": y_num_col_dims})
+    return out
+
+
+def _conv_out_size(in_size, k, pad, stride, dilation=1):
+    if in_size is None or in_size < 0:
+        return -1
+    return (in_size + 2 * pad - (dilation * (k - 1) + 1)) // stride + 1
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCHW"):
+    """Parity: fluid.layers.conv2d (nn.py:conv2d). use_cudnn ignored: XLA
+    picks the TPU conv algorithm."""
+    helper = LayerHelper("conv2d", param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    groups = groups or 1
+    fsize = _pair(filter_size)
+    stride = _pair(stride)
+    padding = _pair(padding)
+    dilation = _pair(dilation)
+    num_channels = input.shape[1]
+    filter_shape = [num_filters, num_channels // groups] + fsize
+    fan_in = (num_channels // groups) * fsize[0] * fsize[1]
+    std = (2.0 / fan_in) ** 0.5
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=filter_shape, dtype=input.dtype,
+        default_initializer=init_mod.NormalInitializer(0.0, std))
+    h = _conv_out_size(input.shape[2], fsize[0], padding[0], stride[0], dilation[0])
+    wd = _conv_out_size(input.shape[3], fsize[1], padding[1], stride[1], dilation[1])
+    out_shape = (input.shape[0], num_filters, h, wd)
+    pre_bias = helper.create_variable_for_type_inference(input.dtype, out_shape)
+    op_type = "depthwise_conv2d" if (groups == num_channels and num_filters % num_channels == 0) else "conv2d"
+    helper.append_op(op_type, {"Input": input, "Filter": w},
+                     {"Output": pre_bias},
+                     {"strides": stride, "paddings": padding,
+                      "dilations": dilation, "groups": groups})
+    if helper.bias_attr is not False:
+        b = helper.create_parameter(attr=helper.bias_attr, shape=[num_filters],
+                                    dtype=input.dtype, is_bias=True)
+        pre_act = helper.create_variable_for_type_inference(input.dtype, out_shape)
+        helper.append_op("elementwise_add", {"X": pre_bias, "Y": b},
+                         {"Out": pre_act}, {"axis": 1})
+    else:
+        pre_act = pre_bias
+    return helper.append_activation(pre_act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None):
+    helper = LayerHelper("conv3d", param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    groups = groups or 1
+    fsize = _pair(filter_size, 3)
+    stride = _pair(stride, 3)
+    padding = _pair(padding, 3)
+    dilation = _pair(dilation, 3)
+    num_channels = input.shape[1]
+    w = helper.create_parameter(
+        attr=helper.param_attr,
+        shape=[num_filters, num_channels // groups] + fsize, dtype=input.dtype,
+        default_initializer=init_mod.NormalInitializer(
+            0.0, (2.0 / ((num_channels // groups) * _prod(fsize))) ** 0.5))
+    dims = [_conv_out_size(input.shape[2 + i], fsize[i], padding[i], stride[i],
+                           dilation[i]) for i in range(3)]
+    out_shape = (input.shape[0], num_filters) + tuple(dims)
+    pre_bias = helper.create_variable_for_type_inference(input.dtype, out_shape)
+    helper.append_op("conv3d", {"Input": input, "Filter": w},
+                     {"Output": pre_bias},
+                     {"strides": stride, "paddings": padding,
+                      "dilations": dilation, "groups": groups})
+    if helper.bias_attr is not False:
+        b = helper.create_parameter(attr=helper.bias_attr, shape=[num_filters],
+                                    dtype=input.dtype, is_bias=True)
+        pre_act = helper.create_variable_for_type_inference(input.dtype, out_shape)
+        helper.append_op("elementwise_add", {"X": pre_bias, "Y": b},
+                         {"Out": pre_act}, {"axis": 1})
+    else:
+        pre_act = pre_bias
+    return helper.append_activation(pre_act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    helper = LayerHelper("conv2d_transpose", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    groups = groups or 1
+    stride = _pair(stride)
+    padding = _pair(padding)
+    dilation = _pair(dilation)
+    num_channels = input.shape[1]
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError("output_size or filter_size required")
+        output_size = _pair(output_size)
+        filter_size = [output_size[i] - (input.shape[2 + i] - 1) * stride[i] +
+                       2 * padding[i] for i in range(2)]
+    fsize = _pair(filter_size)
+    w = helper.create_parameter(
+        attr=helper.param_attr,
+        shape=[num_channels, num_filters // groups] + fsize, dtype=input.dtype,
+        default_initializer=init_mod.XavierInitializer())
+    oh = (input.shape[2] - 1) * stride[0] - 2 * padding[0] + dilation[0] * (fsize[0] - 1) + 1
+    ow = (input.shape[3] - 1) * stride[1] - 2 * padding[1] + dilation[1] * (fsize[1] - 1) + 1
+    out_shape = (input.shape[0], num_filters, oh, ow)
+    pre_bias = helper.create_variable_for_type_inference(input.dtype, out_shape)
+    helper.append_op("conv2d_transpose", {"Input": input, "Filter": w},
+                     {"Output": pre_bias},
+                     {"strides": stride, "paddings": padding,
+                      "dilations": dilation, "groups": groups})
+    if helper.bias_attr is not False:
+        b = helper.create_parameter(attr=helper.bias_attr, shape=[num_filters],
+                                    dtype=input.dtype, is_bias=True)
+        pre_act = helper.create_variable_for_type_inference(input.dtype, out_shape)
+        helper.append_op("elementwise_add", {"X": pre_bias, "Y": b},
+                         {"Out": pre_act}, {"axis": 1})
+    else:
+        pre_act = pre_bias
+    return helper.append_activation(pre_act)
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, exclusive=True, name=None):
+    helper = LayerHelper("pool2d", name=name)
+    ksize = _pair(pool_size)
+    stride = _pair(pool_stride)
+    padding = _pair(pool_padding)
+    if global_pooling:
+        out_shape = tuple(input.shape[:2]) + (1, 1)
+    else:
+        oh = _conv_out_size(input.shape[2], ksize[0], padding[0], stride[0])
+        ow = _conv_out_size(input.shape[3], ksize[1], padding[1], stride[1])
+        out_shape = tuple(input.shape[:2]) + (oh, ow)
+    out = helper.create_variable_for_type_inference(input.dtype, out_shape)
+    helper.append_op("pool2d", {"X": input}, {"Out": out},
+                     {"pooling_type": pool_type, "ksize": ksize,
+                      "strides": stride, "paddings": padding,
+                      "global_pooling": global_pooling, "exclusive": exclusive})
+    return out
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, exclusive=True, name=None):
+    helper = LayerHelper("pool3d", name=name)
+    ksize = _pair(pool_size, 3)
+    stride = _pair(pool_stride, 3)
+    padding = _pair(pool_padding, 3)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("pool3d", {"X": input}, {"Out": out},
+                     {"pooling_type": pool_type, "ksize": ksize,
+                      "strides": stride, "paddings": padding,
+                      "global_pooling": global_pooling, "exclusive": exclusive})
+    return out
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    helper = LayerHelper("adaptive_pool2d", name=name)
+    psize = _pair(pool_size)
+    out_shape = tuple(input.shape[:2]) + tuple(psize)
+    out = helper.create_variable_for_type_inference(input.dtype, out_shape)
+    helper.append_op("adaptive_pool2d", {"X": input}, {"Out": out},
+                     {"pool_size": psize, "pooling_type": pool_type})
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=False,
+               use_global_stats=False):
+    """Parity: fluid.layers.batch_norm. Running stats are persistable vars
+    updated in the same jitted step (functional in-place)."""
+    helper = LayerHelper("batch_norm", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    dtype = "float32"
+    scale = helper.create_parameter(
+        attr=helper.param_attr, shape=[c], dtype=dtype,
+        default_initializer=init_mod.ConstantInitializer(1.0))
+    bias = helper.create_parameter(attr=helper.bias_attr, shape=[c],
+                                   dtype=dtype, is_bias=True)
+    from ..core import unique_name as un
+    mean_name = moving_mean_name or un.generate(helper.name + ".mean")
+    var_name = moving_variance_name or un.generate(helper.name + ".variance")
+    mean = helper.create_or_get_global_variable(mean_name, shape=(c,),
+                                                dtype=dtype, persistable=True)
+    variance = helper.create_or_get_global_variable(var_name, shape=(c,),
+                                                    dtype=dtype, persistable=True)
+    mean.stop_gradient = True
+    variance.stop_gradient = True
+    init_mod.ConstantInitializer(0.0)(mean)
+    init_mod.ConstantInitializer(1.0)(variance)
+    saved_mean = helper.create_variable_for_type_inference(dtype)
+    saved_var = helper.create_variable_for_type_inference(dtype)
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op(
+        "batch_norm",
+        {"X": input, "Scale": scale, "Bias": bias, "Mean": mean,
+         "Variance": variance},
+        {"Y": out, "MeanOut": mean, "VarianceOut": variance,
+         "SavedMean": saved_mean, "SavedVariance": saved_var},
+        {"momentum": momentum, "epsilon": epsilon, "is_test": is_test,
+         "data_layout": data_layout, "use_global_stats": use_global_stats})
+    return helper.append_activation(out)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, epsilon=1e-5,
+               param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper("layer_norm", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    norm_size = _prod(input.shape[begin_norm_axis:])
+    inputs = {"X": input}
+    if scale:
+        s = helper.create_parameter(
+            attr=helper.param_attr, shape=[norm_size], dtype="float32",
+            default_initializer=init_mod.ConstantInitializer(1.0))
+        inputs["Scale"] = s
+    if shift:
+        b = helper.create_parameter(attr=helper.bias_attr, shape=[norm_size],
+                                    dtype="float32", is_bias=True)
+        inputs["Bias"] = b
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    mean = helper.create_variable_for_type_inference("float32")
+    var = helper.create_variable_for_type_inference("float32")
+    helper.append_op("layer_norm", inputs,
+                     {"Y": out, "Mean": mean, "Variance": var},
+                     {"begin_norm_axis": begin_norm_axis, "epsilon": epsilon})
+    return helper.append_activation(out)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    helper = LayerHelper("group_norm", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    c = input.shape[1]
+    inputs = {"X": input}
+    if helper.param_attr is not False:
+        inputs["Scale"] = helper.create_parameter(
+            attr=helper.param_attr, shape=[c], dtype="float32",
+            default_initializer=init_mod.ConstantInitializer(1.0))
+    if helper.bias_attr is not False:
+        inputs["Bias"] = helper.create_parameter(
+            attr=helper.bias_attr, shape=[c], dtype="float32", is_bias=True)
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    mean = helper.create_variable_for_type_inference("float32")
+    var = helper.create_variable_for_type_inference("float32")
+    helper.append_op("group_norm", inputs,
+                     {"Y": out, "Mean": mean, "Variance": var},
+                     {"groups": groups, "epsilon": epsilon})
+    return helper.append_activation(out)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    helper = LayerHelper("instance_norm", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    c = input.shape[1]
+    inputs = {"X": input}
+    if helper.param_attr is not False:
+        inputs["Scale"] = helper.create_parameter(
+            attr=helper.param_attr, shape=[c], dtype="float32",
+            default_initializer=init_mod.ConstantInitializer(1.0))
+    if helper.bias_attr is not False:
+        inputs["Bias"] = helper.create_parameter(
+            attr=helper.bias_attr, shape=[c], dtype="float32", is_bias=True)
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op("instance_norm", inputs, {"Y": out}, {"epsilon": epsilon})
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    helper = LayerHelper("spectral_norm", name=name)
+    h = weight.shape[dim]
+    w = _prod(weight.shape) // h
+    u = helper.create_parameter(attr=None, shape=[h], dtype="float32",
+                                default_initializer=init_mod.NormalInitializer(0, 1))
+    v = helper.create_parameter(attr=None, shape=[w], dtype="float32",
+                                default_initializer=init_mod.NormalInitializer(0, 1))
+    u.trainable = False
+    v.trainable = False
+    out = helper.create_variable_for_type_inference(weight.dtype, weight.shape)
+    helper.append_op("spectral_norm", {"Weight": weight, "U": u, "V": v},
+                     {"Out": out},
+                     {"dim": dim, "power_iters": power_iters, "eps": eps})
+    return out
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    mask = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op("dropout", {"X": x}, {"Out": out, "Mask": mask},
+                     {"dropout_prob": dropout_prob, "is_test": is_test,
+                      "dropout_implementation": dropout_implementation,
+                      "op_seed": helper.next_op_seed()})
+    return out
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    helper = LayerHelper("l2_normalize", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    norm = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("l2_normalize", {"X": x}, {"Out": out, "Norm": norm},
+                     {"axis": axis, "epsilon": epsilon})
+    return out
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
+                 name=None):
+    helper = LayerHelper("label_smooth", name=name)
+    inputs = {"X": label}
+    if prior_dist is not None:
+        inputs["PriorDist"] = prior_dist
+    out = helper.create_variable_for_type_inference(dtype, label.shape)
+    helper.append_op("label_smooth", inputs, {"Out": out}, {"epsilon": epsilon})
+    return out
+
+
+# ---- reductions ------------------------------------------------------------
+
+def _reduce_shape(shape, dim, keep_dim):
+    if dim is None:
+        return () if not keep_dim else tuple(1 for _ in shape)
+    dims = [d % len(shape) for d in (dim if isinstance(dim, (list, tuple)) else [dim])]
+    if keep_dim:
+        return tuple(1 if i in dims else s for i, s in enumerate(shape))
+    return tuple(s for i, s in enumerate(shape) if i not in dims)
+
+
+def _make_reduce(op_type):
+    def fn(input, dim=None, keep_dim=False, name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(
+            input.dtype, _reduce_shape(input.shape, dim, keep_dim))
+        attrs = {"keep_dim": keep_dim}
+        if dim is None:
+            attrs["reduce_all"] = True
+            attrs["dim"] = [0]
+        else:
+            attrs["dim"] = dim if isinstance(dim, (list, tuple)) else [dim]
+        helper.append_op(op_type, {"X": input}, {"Out": out}, attrs)
+        return out
+    fn.__name__ = op_type
+    return fn
+
+
+reduce_sum = _make_reduce("reduce_sum")
+reduce_mean = _make_reduce("reduce_mean")
+reduce_max = _make_reduce("reduce_max")
+reduce_min = _make_reduce("reduce_min")
+reduce_prod = _make_reduce("reduce_prod")
+reduce_all = _make_reduce("reduce_all")
+reduce_any = _make_reduce("reduce_any")
+
+
+def mean(x, name=None):
+    helper = LayerHelper("mean", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, ())
+    helper.append_op("mean", {"X": x}, {"Out": out})
+    return out
+
+
+# ---- elementwise -----------------------------------------------------------
+
+def _make_elementwise(op_type):
+    def fn(x, y, axis=-1, act=None, name=None):
+        helper = LayerHelper(op_type, act=act, name=name)
+        shape = x.shape if len(x.shape) >= len(y.shape) else y.shape
+        out = helper.create_variable_for_type_inference(x.dtype, shape)
+        helper.append_op(op_type, {"X": x, "Y": y}, {"Out": out}, {"axis": axis})
+        return helper.append_activation(out)
+    fn.__name__ = op_type
+    return fn
+
+
+elementwise_add = _make_elementwise("elementwise_add")
+elementwise_sub = _make_elementwise("elementwise_sub")
+elementwise_mul = _make_elementwise("elementwise_mul")
+elementwise_div = _make_elementwise("elementwise_div")
+elementwise_max = _make_elementwise("elementwise_max")
+elementwise_min = _make_elementwise("elementwise_min")
+elementwise_pow = _make_elementwise("elementwise_pow")
+elementwise_mod = _make_elementwise("elementwise_mod")
+elementwise_floordiv = _make_elementwise("elementwise_floordiv")
+
+
+def _make_logical(op_type, binary=True):
+    if binary:
+        def fn(x, y, out=None, name=None):
+            helper = LayerHelper(op_type, name=name)
+            out = out or helper.create_variable_for_type_inference("bool", x.shape)
+            helper.append_op(op_type, {"X": x, "Y": y}, {"Out": out})
+            return out
+    else:
+        def fn(x, out=None, name=None):
+            helper = LayerHelper(op_type, name=name)
+            out = out or helper.create_variable_for_type_inference("bool", x.shape)
+            helper.append_op(op_type, {"X": x}, {"Out": out})
+            return out
+    fn.__name__ = op_type
+    return fn
+
+
+logical_and = _make_logical("logical_and")
+logical_or = _make_logical("logical_or")
+logical_xor = _make_logical("logical_xor")
+logical_not = _make_logical("logical_not", binary=False)
+
+
+def clip(x, min, max, name=None):
+    helper = LayerHelper("clip", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op("clip", {"X": x}, {"Out": out},
+                     {"min": float(min), "max": float(max)})
+    return out
+
+
+def clip_by_norm(x, max_norm, name=None):
+    helper = LayerHelper("clip_by_norm", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op("clip_by_norm", {"X": x}, {"Out": out},
+                     {"max_norm": float(max_norm)})
+    return out
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    helper = LayerHelper("scale", act=act, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op("scale", {"X": x}, {"Out": out},
+                     {"scale": float(scale), "bias": float(bias),
+                      "bias_after_scale": bias_after_scale})
+    return helper.append_activation(out)
+
+
+def sum(x, name=None):
+    helper = LayerHelper("sum", name=name)
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    out = helper.create_variable_for_type_inference(xs[0].dtype, xs[0].shape)
+    helper.append_op("sum", {"X": xs}, {"Out": out})
+    return out
+
+
+def cumsum(x, axis=None, exclusive=False, reverse=False, name=None):
+    helper = LayerHelper("cumsum", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    attrs = {"exclusive": exclusive, "reverse": reverse}
+    if axis is None:
+        attrs["flatten"] = True
+        attrs["axis"] = 0
+    else:
+        attrs["axis"] = axis
+    helper.append_op("cumsum", {"X": x}, {"Out": out}, attrs)
+    return out
+
+
+# ---- shape manipulation (also exposed from layers.tensor) ------------------
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
+    helper = LayerHelper("reshape", act=act, name=name)
+    out_shape = list(shape)
+    known = _prod([s for s in out_shape if s > 0]) or 1
+    if -1 in out_shape and all(s >= 0 for s in x.shape):
+        total = _prod(x.shape)
+        out_shape[out_shape.index(-1)] = total // known
+    out = helper.create_variable_for_type_inference(x.dtype, tuple(out_shape))
+    xshape = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("reshape2", {"X": x}, {"Out": out, "XShape": xshape},
+                     {"shape": list(shape)})
+    return helper.append_activation(out)
+
+
+def transpose(x, perm, name=None):
+    helper = LayerHelper("transpose", name=name)
+    out_shape = tuple(x.shape[p] for p in perm) if x.shape else ()
+    out = helper.create_variable_for_type_inference(x.dtype, out_shape)
+    xshape = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("transpose2", {"X": x}, {"Out": out, "XShape": xshape},
+                     {"axis": list(perm)})
+    return out
+
+
+def flatten(x, axis=1, name=None):
+    helper = LayerHelper("flatten", name=name)
+    lead = _prod(x.shape[:axis]) if all(s >= 0 for s in x.shape[:axis]) else -1
+    rest = _prod(x.shape[axis:]) if all(s >= 0 for s in x.shape[axis:]) else -1
+    out = helper.create_variable_for_type_inference(x.dtype, (lead, rest))
+    xshape = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("flatten2", {"X": x}, {"Out": out, "XShape": xshape},
+                     {"axis": axis})
+    return out
+
+
+def squeeze(input, axes, name=None):
+    helper = LayerHelper("squeeze", name=name)
+    shape = tuple(s for i, s in enumerate(input.shape)
+                  if not (i in [a % len(input.shape) for a in axes] and s == 1))
+    out = helper.create_variable_for_type_inference(input.dtype, shape)
+    xshape = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("squeeze2", {"X": input}, {"Out": out, "XShape": xshape},
+                     {"axes": list(axes)})
+    return out
+
+
+def unsqueeze(input, axes, name=None):
+    helper = LayerHelper("unsqueeze", name=name)
+    shape = list(input.shape)
+    for a in sorted(axes):
+        shape.insert(a, 1)
+    out = helper.create_variable_for_type_inference(input.dtype, tuple(shape))
+    xshape = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("unsqueeze2", {"X": input}, {"Out": out, "XShape": xshape},
+                     {"axes": list(axes)})
+    return out
+
+
+def stack(x, axis=0):
+    helper = LayerHelper("stack")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    shape = list(xs[0].shape)
+    shape.insert(axis % (len(shape) + 1), len(xs))
+    out = helper.create_variable_for_type_inference(xs[0].dtype, tuple(shape))
+    helper.append_op("stack", {"X": xs}, {"Y": out}, {"axis": axis})
+    return out
+
+
+def unstack(x, axis=0, num=None):
+    helper = LayerHelper("unstack")
+    n = num or x.shape[axis]
+    shape = tuple(s for i, s in enumerate(x.shape) if i != axis % len(x.shape))
+    outs = [helper.create_variable_for_type_inference(x.dtype, shape)
+            for _ in range(n)]
+    helper.append_op("unstack", {"X": x}, {"Y": outs}, {"axis": axis})
+    return outs
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper("split", name=name)
+    dim = dim % len(input.shape)
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        sections = None
+        sizes = [input.shape[dim] // n] * n
+    else:
+        sections = list(num_or_sections)
+        n = len(sections)
+        sizes = sections
+    outs = []
+    for s in sizes:
+        shape = list(input.shape)
+        shape[dim] = s
+        outs.append(helper.create_variable_for_type_inference(input.dtype, tuple(shape)))
+    attrs = {"axis": dim}
+    if sections:
+        attrs["sections"] = sections
+    else:
+        attrs["num"] = n
+    helper.append_op("split", {"X": input}, {"Out": outs}, attrs)
+    return outs
+
+
+def concat(input, axis=0, name=None):
+    helper = LayerHelper("concat", name=name)
+    xs = input if isinstance(input, (list, tuple)) else [input]
+    shape = list(xs[0].shape)
+    if shape:
+        shape[axis % len(shape)] = builtins_sum(
+            x.shape[axis % len(shape)] for x in xs)
+    out = helper.create_variable_for_type_inference(xs[0].dtype, tuple(shape))
+    helper.append_op("concat", {"X": xs}, {"Out": out}, {"axis": axis})
+    return out
+
+
+def builtins_sum(it):
+    import builtins
+    return builtins.sum(it)
+
+
+def slice(input, axes, starts, ends):
+    helper = LayerHelper("slice")
+    shape = list(input.shape)
+    for a, s, e in zip(axes, starts, ends):
+        dim = shape[a]
+        if dim >= 0:
+            s2 = max(s + dim, 0) if s < 0 else min(s, dim)
+            e2 = max(e + dim, 0) if e < 0 else min(e, dim)
+            shape[a] = max(e2 - s2, 0)
+    out = helper.create_variable_for_type_inference(input.dtype, tuple(shape))
+    helper.append_op("slice", {"Input": input}, {"Out": out},
+                     {"axes": list(axes), "starts": list(starts),
+                      "ends": list(ends)})
+    return out
+
+
+def gather(input, index, overwrite=True):
+    helper = LayerHelper("gather")
+    shape = (index.shape[0] if index.shape else -1,) + tuple(input.shape[1:])
+    out = helper.create_variable_for_type_inference(input.dtype, shape)
+    helper.append_op("gather", {"X": input, "Index": index}, {"Out": out})
+    return out
+
+
+def gather_nd(input, index, name=None):
+    helper = LayerHelper("gather_nd", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("gather_nd", {"X": input, "Index": index}, {"Out": out})
+    return out
+
+
+def scatter(input, index, updates, name=None, overwrite=True):
+    helper = LayerHelper("scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op("scatter", {"X": input, "Ids": index, "Updates": updates},
+                     {"Out": out}, {"overwrite": overwrite})
+    return out
+
+
+def scatter_nd_add(ref, index, updates, name=None):
+    helper = LayerHelper("scatter_nd_add", name=name)
+    out = helper.create_variable_for_type_inference(ref.dtype, ref.shape)
+    helper.append_op("scatter_nd_add",
+                     {"X": ref, "Index": index, "Updates": updates},
+                     {"Out": out})
+    return out
+
+
+def expand(x, expand_times, name=None):
+    helper = LayerHelper("expand", name=name)
+    shape = tuple(s * t if s >= 0 else -1 for s, t in zip(x.shape, expand_times))
+    out = helper.create_variable_for_type_inference(x.dtype, shape)
+    helper.append_op("expand", {"X": x}, {"Out": out},
+                     {"expand_times": list(expand_times)})
+    return out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    helper = LayerHelper("pad", name=name)
+    shape = tuple(s + paddings[2 * i] + paddings[2 * i + 1] if s >= 0 else -1
+                  for i, s in enumerate(x.shape))
+    out = helper.create_variable_for_type_inference(x.dtype, shape)
+    helper.append_op("pad", {"X": x}, {"Out": out},
+                     {"paddings": list(paddings), "pad_value": float(pad_value)})
+    return out
+
+
+def pad2d(input, paddings=[0, 0, 0, 0], mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    helper = LayerHelper("pad2d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("pad2d", {"X": input}, {"Out": out},
+                     {"paddings": list(paddings), "mode": mode,
+                      "pad_value": float(pad_value), "data_format": data_format})
+    return out
+
+
+def topk(input, k, name=None):
+    helper = LayerHelper("top_k", name=name)
+    shape = tuple(input.shape[:-1]) + (k,)
+    out = helper.create_variable_for_type_inference(input.dtype, shape)
+    idx = helper.create_variable_for_type_inference("int64", shape)
+    helper.append_op("top_k", {"X": input}, {"Out": out, "Indices": idx},
+                     {"k": k})
+    return out, idx
+
+
+def argmax(x, axis=0, name=None):
+    helper = LayerHelper("arg_max", name=name)
+    shape = tuple(s for i, s in enumerate(x.shape) if i != axis % len(x.shape))
+    out = helper.create_variable_for_type_inference("int64", shape)
+    helper.append_op("arg_max", {"X": x}, {"Out": out}, {"axis": axis})
+    return out
+
+
+def argmin(x, axis=0, name=None):
+    helper = LayerHelper("arg_min", name=name)
+    shape = tuple(s for i, s in enumerate(x.shape) if i != axis % len(x.shape))
+    out = helper.create_variable_for_type_inference("int64", shape)
+    helper.append_op("arg_min", {"X": x}, {"Out": out}, {"axis": axis})
+    return out
+
+
+def argsort(input, axis=-1, descending=False, name=None):
+    helper = LayerHelper("argsort", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    idx = helper.create_variable_for_type_inference("int64", input.shape)
+    helper.append_op("argsort", {"X": input}, {"Out": out, "Indices": idx},
+                     {"axis": axis, "descending": descending})
+    return out, idx
+
+
+def where(condition):
+    helper = LayerHelper("where")
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op("where", {"Condition": condition}, {"Out": out})
+    return out
+
+
+def sign(x):
+    helper = LayerHelper("sign")
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op("sign", {"X": x}, {"Out": out})
+    return out
+
+
+def shape(input):
+    helper = LayerHelper("shape")
+    out = helper.create_variable_for_type_inference("int32", (len(input.shape),))
+    helper.append_op("shape", {"Input": input}, {"Out": out})
+    return out
+
+
+def rank(input):
+    helper = LayerHelper("rank")
+    out = helper.create_variable_for_type_inference("int32", ())
+    helper.append_op("rank", {"Input": input}, {"Out": out})
+    return out
+
+
+def size(input):
+    helper = LayerHelper("size")
+    out = helper.create_variable_for_type_inference("int64", ())
+    helper.append_op("size", {"Input": input}, {"Out": out})
+    return out
+
+
+def cast(x, dtype):
+    helper = LayerHelper("cast")
+    out = helper.create_variable_for_type_inference(dtype, x.shape)
+    helper.append_op("cast", {"X": x}, {"Out": out}, {"out_dtype": dtype})
+    return out
+
+
+def softmax(input, use_cudnn=False, name=None, axis=-1):
+    helper = LayerHelper("softmax", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op("softmax", {"X": input}, {"Out": out}, {"axis": axis})
+    return out
+
+
+def relu(x, name=None):
+    helper = LayerHelper("relu", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op("relu", {"X": x}, {"Out": out})
+    return out
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    helper = LayerHelper("leaky_relu", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op("leaky_relu", {"X": x}, {"Out": out}, {"alpha": alpha})
+    return out
+
+
+def prelu(x, mode, param_attr=None, name=None):
+    helper = LayerHelper("prelu", param_attr=param_attr, name=name)
+    if mode == "all":
+        alpha_shape = [1]
+    elif mode == "channel":
+        alpha_shape = [x.shape[1]]
+    else:
+        alpha_shape = list(x.shape[1:])
+    alpha = helper.create_parameter(
+        attr=helper.param_attr, shape=alpha_shape, dtype="float32",
+        default_initializer=init_mod.ConstantInitializer(0.25))
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op("prelu", {"X": x, "Alpha": alpha}, {"Out": out},
+                     {"mode": mode})
+    return out
+
+
+def maxout(x, groups, name=None):
+    helper = LayerHelper("maxout", name=name)
+    shape = (x.shape[0], x.shape[1] // groups) + tuple(x.shape[2:])
+    out = helper.create_variable_for_type_inference(x.dtype, shape)
+    helper.append_op("maxout", {"X": x}, {"Out": out}, {"groups": groups})
+    return out
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    helper = LayerHelper("lrn", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    mid = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op("lrn", {"X": input}, {"Out": out, "MidOut": mid},
+                     {"n": n, "k": k, "alpha": alpha, "beta": beta})
+    return out
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    align_corners=True, align_mode=1):
+    return _resize(input, out_shape, scale, "bilinear_interp", name)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   align_corners=True):
+    return _resize(input, out_shape, scale, "nearest_interp", name)
+
+
+def _resize(input, out_shape, scale, op_type, name):
+    helper = LayerHelper(op_type, name=name)
+    attrs = {}
+    if out_shape is not None:
+        attrs["out_h"], attrs["out_w"] = int(out_shape[0]), int(out_shape[1])
+        shape = tuple(input.shape[:2]) + (attrs["out_h"], attrs["out_w"])
+    else:
+        attrs["scale"] = float(scale)
+        shape = tuple(input.shape[:2]) + tuple(
+            int(s * scale) if s and s > 0 else -1 for s in input.shape[2:])
+    out = helper.create_variable_for_type_inference(input.dtype, shape)
+    helper.append_op(op_type, {"X": input}, {"Out": out}, attrs)
+    return out
+
+
+image_resize = resize_bilinear
+
+
+def pixel_shuffle(x, upscale_factor):
+    helper = LayerHelper("pixel_shuffle")
+    r = upscale_factor
+    n, c, h, w = x.shape
+    out = helper.create_variable_for_type_inference(
+        x.dtype, (n, c // (r * r), h * r, w * r))
+    helper.append_op("pixel_shuffle", {"X": x}, {"Out": out},
+                     {"upscale_factor": r})
+    return out
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Parity: fluid.layers.py_func — host callback via jax.pure_callback."""
+    from ..core.framework import Operator
+    helper = LayerHelper("py_func")
+    func_id = len(Operator.CALLABLE_TABLE)
+    Operator.CALLABLE_TABLE[func_id] = func
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    helper.append_op("py_func", {"X": xs}, {"Out": out}, {"func_id": func_id})
+    return out
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    helper = LayerHelper("add_position_encoding", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op("add_position_encoding", {"X": input}, {"Out": out},
+                     {"alpha": alpha, "beta": beta})
+    return out
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    helper = LayerHelper("smooth_l1")
+    inputs = {"X": x, "Y": y}
+    if inside_weight is not None:
+        inputs["InsideWeight"] = inside_weight
+    if outside_weight is not None:
+        inputs["OutsideWeight"] = outside_weight
+    out = helper.create_variable_for_type_inference(x.dtype, (x.shape[0], 1))
+    diff = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op("smooth_l1_loss", inputs, {"Out": out, "Diff": diff},
+                     {"sigma": sigma or 1.0})
+    return out

@@ -1,0 +1,177 @@
+"""Tensor creation/manipulation layers.
+
+Parity: python/paddle/fluid/layers/tensor.py.
+"""
+
+import numpy as np
+
+from ..core.framework import Variable
+from ..core.layer_helper import LayerHelper
+from .. import initializer as init_mod
+from .nn import (cast, concat, argmin, argmax, argsort)  # re-export parity
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper("create_tensor", name=name)
+    return helper.create_variable(name=name, dtype=dtype,
+                                  persistable=persistable)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..core.param_attr import ParamAttr
+    if attr is None and name is not None:
+        attr = ParamAttr(name=name)
+    helper = LayerHelper("create_parameter", param_attr=attr, name=name)
+    if default_initializer is None:
+        default_initializer = (init_mod.ConstantInitializer(0.0) if is_bias
+                               else init_mod.XavierInitializer())
+    return helper.create_parameter(helper.param_attr, shape, dtype, is_bias,
+                                   default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    helper = LayerHelper("global_var", name=name)
+    var = helper.create_global_variable(
+        persistable=persistable, name=name, shape=shape, dtype=dtype)
+    init_mod.ConstantInitializer(value)(var)
+    return var
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None):
+    helper = LayerHelper("fill_constant")
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype, tuple(shape))
+    helper.append_op("fill_constant", {}, {"Out": out},
+                     {"shape": list(shape), "dtype": dtype,
+                      "value": float(value)})
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    helper = LayerHelper("fill_constant_batch_size_like")
+    out = helper.create_variable_for_type_inference(dtype, tuple(shape))
+    helper.append_op("fill_constant_batch_size_like", {"Input": input},
+                     {"Out": out},
+                     {"shape": list(shape), "dtype": dtype,
+                      "value": float(value), "input_dim_idx": input_dim_idx,
+                      "output_dim_idx": output_dim_idx})
+    return out
+
+
+def assign(input, output=None):
+    helper = LayerHelper("assign")
+    if isinstance(input, Variable):
+        if output is None:
+            output = helper.create_variable_for_type_inference(
+                input.dtype, input.shape)
+        helper.append_op("assign", {"X": input}, {"Out": output})
+    else:
+        arr = np.asarray(input)
+        if output is None:
+            output = helper.create_variable_for_type_inference(
+                str(arr.dtype), arr.shape)
+        helper.append_op("assign_value", {}, {"Out": output},
+                         {"shape": list(arr.shape), "dtype": str(arr.dtype),
+                          "values": arr.reshape(-1).tolist()})
+    return output
+
+
+def zeros(shape, dtype, force_cpu=False):
+    return fill_constant(shape, dtype, 0.0)
+
+
+def ones(shape, dtype, force_cpu=False):
+    return fill_constant(shape, dtype, 1.0)
+
+
+def zeros_like(x, out=None):
+    helper = LayerHelper("zeros_like")
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op("zeros_like", {"X": x}, {"Out": out})
+    return out
+
+
+def ones_like(x, out=None):
+    helper = LayerHelper("ones_like")
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op("ones_like", {"X": x}, {"Out": out}, {"value": 1.0})
+    return out
+
+
+def sums(input, out=None):
+    helper = LayerHelper("sums")
+    xs = input if isinstance(input, (list, tuple)) else [input]
+    if out is None:
+        out = helper.create_variable_for_type_inference(xs[0].dtype, xs[0].shape)
+    helper.append_op("sum", {"X": xs}, {"Out": out})
+    return out
+
+
+def linspace(start, stop, num, dtype="float32"):
+    helper = LayerHelper("linspace")
+    out = helper.create_variable_for_type_inference(dtype, (num,))
+    helper.append_op("linspace", {}, {"Out": out},
+                     {"start": float(start), "stop": float(stop),
+                      "num": int(num), "dtype": dtype})
+    return out
+
+
+def range(start, end, step, dtype="float32"):
+    helper = LayerHelper("range")
+    n = int(max(0, np.ceil((end - start) / step)))
+    out = helper.create_variable_for_type_inference(dtype, (n,))
+    helper.append_op("range", {}, {"Out": out},
+                     {"start": start, "end": end, "step": step, "dtype": dtype})
+    return out
+
+
+def eye(num_rows, num_columns=None, batch_shape=None, dtype="float32"):
+    helper = LayerHelper("eye")
+    num_columns = num_columns if num_columns is not None else num_rows
+    out = helper.create_variable_for_type_inference(dtype, (num_rows, num_columns))
+    helper.append_op("eye", {}, {"Out": out},
+                     {"num_rows": num_rows, "num_columns": num_columns,
+                      "dtype": dtype})
+    return out
+
+
+def diag(diagonal):
+    helper = LayerHelper("diag")
+    n = diagonal.shape[0] if diagonal.shape else -1
+    out = helper.create_variable_for_type_inference(diagonal.dtype, (n, n))
+    helper.append_op("diag", {"Diagonal": diagonal}, {"Out": out})
+    return out
+
+
+def reverse(x, axis):
+    helper = LayerHelper("reverse")
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    helper.append_op("reverse", {"X": x}, {"Out": out}, {"axis": list(axes)})
+    return out
+
+
+def has_inf(x):
+    helper = LayerHelper("has_inf")
+    out = helper.create_variable_for_type_inference("bool", ())
+    helper.append_op("has_inf", {"X": x}, {"Out": out})
+    return out
+
+
+def has_nan(x):
+    helper = LayerHelper("has_nan")
+    out = helper.create_variable_for_type_inference("bool", ())
+    helper.append_op("has_nan", {"X": x}, {"Out": out})
+    return out
+
+
+def isfinite(x):
+    helper = LayerHelper("isfinite")
+    out = helper.create_variable_for_type_inference("bool", ())
+    helper.append_op("isfinite", {"X": x}, {"Out": out})
+    return out

@@ -1,0 +1,232 @@
+"""Host-side streaming metrics.
+
+Parity: python/paddle/fluid/metrics.py.
+"""
+
+import numpy as np
+
+
+class MetricBase:
+    def __init__(self, name=None):
+        self._name = name or self.__class__.__name__
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+    def get_config(self):
+        return {k: v for k, v in self.__dict__.items()
+                if not k.startswith("_")}
+
+
+class CompositeMetric(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        self._metrics.append(metric)
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds, labels)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]
+
+
+class Accuracy(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight=1.0):
+        self.value += float(np.asarray(value).reshape(-1)[0]) * weight
+        self.weight += weight
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("no updates to Accuracy metric")
+        return self.value / self.weight
+
+
+class Precision(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        self.tp += int(np.sum((preds == 1) & (labels == 1)))
+        self.fp += int(np.sum((preds == 1) & (labels == 0)))
+
+    def eval(self):
+        return self.tp / max(self.tp + self.fp, 1)
+
+
+class Recall(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        self.tp += int(np.sum((preds == 1) & (labels == 1)))
+        self.fn += int(np.sum((preds == 0) & (labels == 1)))
+
+    def eval(self):
+        return self.tp / max(self.tp + self.fn, 1)
+
+
+class Auc(MetricBase):
+    def __init__(self, name=None, curve="ROC", num_thresholds=4095):
+        super().__init__(name)
+        self._num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        n = self._num_thresholds + 1
+        self._stat_pos = np.zeros(n)
+        self._stat_neg = np.zeros(n)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        labels = np.asarray(labels).reshape(-1)
+        probs = preds[:, -1] if preds.ndim > 1 else preds
+        idx = np.clip((probs * self._num_thresholds).astype(int), 0,
+                      self._num_thresholds)
+        for i, l in zip(idx, labels):
+            if l:
+                self._stat_pos[i] += 1
+            else:
+                self._stat_neg[i] += 1
+
+    def eval(self):
+        tot_pos = np.cumsum(self._stat_pos[::-1])[::-1]
+        area = np.sum(self._stat_neg * (tot_pos - self._stat_pos / 2.0))
+        denom = max(self._stat_pos.sum() * self._stat_neg.sum(), 1.0)
+        return area / denom
+
+
+class EditDistance(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num):
+        distances = np.asarray(distances).reshape(-1)
+        self.total_distance += float(distances.sum())
+        self.seq_num += int(seq_num)
+        self.instance_error += int(np.sum(distances > 0))
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError("no updates to EditDistance metric")
+        return (self.total_distance / self.seq_num,
+                self.instance_error / self.seq_num)
+
+
+class ChunkEvaluator(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
+        self.num_infer_chunks += int(np.asarray(num_infer_chunks).sum())
+        self.num_label_chunks += int(np.asarray(num_label_chunks).sum())
+        self.num_correct_chunks += int(np.asarray(num_correct_chunks).sum())
+
+    def eval(self):
+        precision = self.num_correct_chunks / max(self.num_infer_chunks, 1)
+        recall = self.num_correct_chunks / max(self.num_label_chunks, 1)
+        f1 = 2 * precision * recall / max(precision + recall, 1e-9)
+        return precision, recall, f1
+
+
+class DetectionMAP(MetricBase):
+    """Simplified host-side mAP accumulator (VOC-style, 11-point)."""
+
+    def __init__(self, name=None, overlap_threshold=0.5):
+        super().__init__(name)
+        self._iou_thr = overlap_threshold
+        self.reset()
+
+    def reset(self):
+        self._records = []  # (score, is_tp) per detection
+        self._num_gt = 0
+
+    def update(self, detections, gt_boxes):
+        """detections: (N,6) [label,score,x1,y1,x2,y2]; gt: (M,5)."""
+        det = np.asarray(detections)
+        gt = np.asarray(gt_boxes)
+        self._num_gt += len(gt)
+        matched = np.zeros(len(gt), bool)
+        for row in det[np.argsort(-det[:, 1])] if len(det) else []:
+            best, best_iou = -1, self._iou_thr
+            for j, g in enumerate(gt):
+                if matched[j] or g[0] != row[0]:
+                    continue
+                iou = _iou(row[2:6], g[1:5])
+                if iou >= best_iou:
+                    best, best_iou = j, iou
+            if best >= 0:
+                matched[best] = True
+                self._records.append((row[1], 1))
+            else:
+                self._records.append((row[1], 0))
+
+    def eval(self):
+        if not self._records:
+            return 0.0
+        rec = sorted(self._records, key=lambda r: -r[0])
+        tps = np.cumsum([r[1] for r in rec])
+        fps = np.cumsum([1 - r[1] for r in rec])
+        recall = tps / max(self._num_gt, 1)
+        precision = tps / np.maximum(tps + fps, 1)
+        ap = 0.0
+        for t in np.linspace(0, 1, 11):
+            mask = recall >= t
+            ap += (precision[mask].max() if mask.any() else 0.0) / 11
+        return ap
+
+
+def _iou(a, b):
+    lt = np.maximum(a[:2], b[:2])
+    rb = np.minimum(a[2:], b[2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[0] * wh[1]
+    ua = ((a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+    return inter / max(ua, 1e-10)

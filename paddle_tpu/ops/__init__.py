@@ -1,0 +1,115 @@
+"""Op registry: op type -> pure JAX implementation.
+
+Parity: paddle/fluid/operators/* (REGISTER_OPERATOR / REGISTER_OP_*_KERNEL).
+The reference implements ~500 C++/CUDA kernels dispatched per-op on a device
+stream. Here every op is a small pure-JAX function invoked while the Executor
+traces the whole Program under jit, so XLA sees one graph and fuses across op
+boundaries (elementwise into matmul/conv epilogues, etc.) — no per-op launch.
+
+An op impl has signature ``fn(ctx) -> {output_slot: array-or-list}``.
+"""
+
+import jax
+
+_REGISTRY = {}
+
+
+def register(*names):
+    def deco(fn):
+        for n in names:
+            _REGISTRY[n] = fn
+        return fn
+    return deco
+
+
+def get(name):
+    if name not in _REGISTRY:
+        raise NotImplementedError(
+            f"op '{name}' has no TPU implementation registered in paddle_tpu.ops")
+    return _REGISTRY[name]
+
+
+def has(name):
+    return name in _REGISTRY
+
+
+def registered_ops():
+    return sorted(_REGISTRY)
+
+
+class OpContext:
+    """Execution context handed to an op impl during program tracing."""
+
+    __slots__ = ("op", "env", "program", "is_test")
+
+    def __init__(self, op, env, program, is_test=False):
+        self.op = op
+        self.env = env
+        self.program = program
+        self.is_test = is_test or bool(op.attrs.get("is_test", False))
+
+    # -- inputs -------------------------------------------------------------
+    def _maybe_amp(self, v):
+        # White-listed ops tagged by amp.cast_model_to_bf16 consume bf16 on
+        # the MXU; params/grads stay fp32 outside (master weights).
+        amp = self.op.attrs.get("__amp_dtype__")
+        if amp and hasattr(v, "dtype") and str(v.dtype) in ("float32", "float64"):
+            import jax.numpy as jnp
+            return v.astype(jnp.dtype(amp))
+        return v
+
+    def in_list(self, slot):
+        return [self._maybe_amp(self.env[n]) for n in self.op.input(slot)]
+
+    def in_(self, slot, default=None):
+        names = self.op.input(slot)
+        return self._maybe_amp(self.env[names[0]]) if names else default
+
+    def has_in(self, slot):
+        return bool(self.op.input(slot))
+
+    def attr(self, name, default=None):
+        return self.op.attrs.get(name, default)
+
+    def out_name(self, slot):
+        names = self.op.output(slot)
+        return names[0] if names else None
+
+    def out_var(self, slot):
+        name = self.out_name(slot)
+        return self.op.block._find_var_recursive(name) if name else None
+
+    # -- rng ----------------------------------------------------------------
+    def rng(self):
+        """Deterministic per-op PRNG key: base key folded with this op's seed."""
+        base = self.env["@RNG@"]
+        return jax.random.fold_in(base, self.op.attrs.get("op_seed", 0))
+
+
+def run_op(op, env, program, is_test=False):
+    """Execute one op into env (called during jit tracing)."""
+    impl = get(op.type)
+    ctx = OpContext(op, env, program, is_test)
+    outs = impl(ctx)
+    if outs:
+        for slot, vals in outs.items():
+            names = op.output(slot)
+            if not isinstance(vals, (list, tuple)):
+                vals = [vals]
+            for name, val in zip(names, vals):
+                env[name] = val
+
+
+# Populate the registry.
+from . import math_ops        # noqa: E402,F401
+from . import activation_ops  # noqa: E402,F401
+from . import tensor_ops      # noqa: E402,F401
+from . import nn_ops          # noqa: E402,F401
+from . import loss_ops        # noqa: E402,F401
+from . import random_ops      # noqa: E402,F401
+from . import optimizer_ops   # noqa: E402,F401
+from . import sequence_ops    # noqa: E402,F401
+from . import control_flow_ops  # noqa: E402,F401
+from . import collective_ops  # noqa: E402,F401
+from . import metric_ops      # noqa: E402,F401
+from . import detection_ops   # noqa: E402,F401

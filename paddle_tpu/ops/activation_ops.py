@@ -1,0 +1,165 @@
+"""Activation ops.
+
+Parity: paddle/fluid/operators/activation_op.cc (the full fluid.layers.ops
+activation list). Pure elementwise jnp/lax — XLA fuses these into the
+preceding matmul/conv epilogue on TPU.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import register
+
+
+def _unary(fn):
+    def impl(ctx):
+        return {"Out": fn(ctx.in_("X"))}
+    return impl
+
+
+register("relu")(_unary(jax.nn.relu))
+register("sigmoid")(_unary(jax.nn.sigmoid))
+register("logsigmoid")(_unary(jax.nn.log_sigmoid))
+register("tanh")(_unary(jnp.tanh))
+register("tanh_shrink")(_unary(lambda x: x - jnp.tanh(x)))
+register("exp")(_unary(jnp.exp))
+register("log")(_unary(jnp.log))
+register("sqrt")(_unary(jnp.sqrt))
+register("rsqrt")(_unary(lax.rsqrt))
+register("abs")(_unary(jnp.abs))
+register("ceil")(_unary(jnp.ceil))
+register("floor")(_unary(jnp.floor))
+register("round")(_unary(jnp.round))
+register("cos")(_unary(jnp.cos))
+register("sin")(_unary(jnp.sin))
+register("acos")(_unary(jnp.arccos))
+register("asin")(_unary(jnp.arcsin))
+register("atan")(_unary(jnp.arctan))
+register("reciprocal")(_unary(jnp.reciprocal))
+register("square")(_unary(jnp.square))
+register("softplus")(_unary(jax.nn.softplus))
+register("softsign")(_unary(jax.nn.soft_sign))
+register("sign")(_unary(jnp.sign))
+register("gelu")(_unary(jax.nn.gelu))
+register("erf")(_unary(lax.erf))
+
+
+@register("pow")
+def pow_op(ctx):
+    factor = ctx.in_("FactorTensor", ctx.attr("factor", 1.0))
+    return {"Out": jnp.power(ctx.in_("X"), factor)}
+
+
+@register("leaky_relu")
+def leaky_relu(ctx):
+    alpha = ctx.attr("alpha", 0.02)
+    x = ctx.in_("X")
+    return {"Out": jnp.where(x > 0, x, alpha * x)}
+
+
+@register("elu")
+def elu(ctx):
+    return {"Out": jax.nn.elu(ctx.in_("X"), ctx.attr("alpha", 1.0))}
+
+
+@register("selu")
+def selu(ctx):
+    return {"Out": jax.nn.selu(ctx.in_("X"))}
+
+
+@register("relu6")
+def relu6(ctx):
+    t = ctx.attr("threshold", 6.0)
+    return {"Out": jnp.clip(ctx.in_("X"), 0.0, t)}
+
+
+@register("brelu")
+def brelu(ctx):
+    return {"Out": jnp.clip(ctx.in_("X"), ctx.attr("t_min", 0.0), ctx.attr("t_max", 24.0))}
+
+
+@register("soft_relu")
+def soft_relu(ctx):
+    t = ctx.attr("threshold", 40.0)
+    x = jnp.clip(ctx.in_("X"), -t, t)
+    return {"Out": jnp.log1p(jnp.exp(x))}
+
+
+@register("swish")
+def swish(ctx):
+    beta = ctx.attr("beta", 1.0)
+    x = ctx.in_("X")
+    return {"Out": x * jax.nn.sigmoid(beta * x)}
+
+
+@register("hard_swish")
+def hard_swish(ctx):
+    x = ctx.in_("X")
+    t = ctx.attr("threshold", 6.0)
+    s = ctx.attr("scale", 6.0)
+    o = ctx.attr("offset", 3.0)
+    return {"Out": x * jnp.clip(x + o, 0.0, t) / s}
+
+
+@register("hard_sigmoid")
+def hard_sigmoid(ctx):
+    slope = ctx.attr("slope", 0.2)
+    offset = ctx.attr("offset", 0.5)
+    return {"Out": jnp.clip(slope * ctx.in_("X") + offset, 0.0, 1.0)}
+
+
+@register("hard_shrink")
+def hard_shrink(ctx):
+    t = ctx.attr("threshold", 0.5)
+    x = ctx.in_("X")
+    return {"Out": jnp.where(jnp.abs(x) > t, x, 0.0)}
+
+
+@register("softshrink")
+def softshrink(ctx):
+    lam = ctx.attr("lambda", 0.5)
+    x = ctx.in_("X")
+    return {"Out": jnp.where(x > lam, x - lam, jnp.where(x < -lam, x + lam, 0.0))}
+
+
+@register("thresholded_relu")
+def thresholded_relu(ctx):
+    t = ctx.attr("threshold", 1.0)
+    x = ctx.in_("X")
+    return {"Out": jnp.where(x > t, x, 0.0)}
+
+
+@register("stanh")
+def stanh(ctx):
+    a = ctx.attr("scale_a", 0.67)
+    b = ctx.attr("scale_b", 1.7159)
+    return {"Out": b * jnp.tanh(a * ctx.in_("X"))}
+
+
+@register("prelu")
+def prelu(ctx):
+    x = ctx.in_("X")
+    alpha = ctx.in_("Alpha")
+    mode = ctx.attr("mode", "all")
+    if mode == "channel" and alpha.size > 1:
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return {"Out": jnp.where(x > 0, x, alpha * x)}
+
+
+@register("maxout")
+def maxout(ctx):
+    x = ctx.in_("X")  # NCHW
+    groups = ctx.attr("groups")
+    n, c, h, w = x.shape
+    return {"Out": x.reshape(n, c // groups, groups, h, w).max(axis=2)}
+
+
+@register("softmax")
+def softmax(ctx):
+    return {"Out": jax.nn.softmax(ctx.in_("X"), axis=ctx.attr("axis", -1))}
+
+
+@register("log_softmax")
+def log_softmax(ctx):
+    return {"Out": jax.nn.log_softmax(ctx.in_("X"), axis=ctx.attr("axis", -1))}

@@ -1,0 +1,109 @@
+"""Collective communication ops.
+
+Parity: paddle/fluid/operators/collective/{c_allreduce,c_broadcast,
+c_allgather,c_reducescatter,c_sync_*}_op.* — the NCCL ring collectives.
+
+TPU-first redesign: these lower to XLA collectives (psum/all_gather/
+ppermute/psum_scatter) which ride the ICI mesh. They are meaningful inside a
+shard_map/pmap context where the named axis exists; when traced outside any
+mapped context (single-chip program) they degrade to identity, mirroring how
+a 1-GPU NCCL ring is a no-op.
+"""
+
+import jax
+from jax import lax
+
+from . import register
+
+
+def _axis(ctx):
+    return ctx.attr("ring_id_axis", ctx.attr("axis_name", "dp"))
+
+
+def _in_mapped_context(axis):
+    try:
+        jax.core.get_axis_env().axis_size(axis) if hasattr(jax.core, "get_axis_env") else lax.axis_index(axis)
+        return True
+    except (NameError, Exception):
+        return False
+
+
+def _maybe(fn, x, axis):
+    try:
+        return fn(x, axis)
+    except NameError:
+        return x  # axis not bound: single-device trace
+
+
+@register("c_allreduce_sum", "c_allreduce", "allreduce")
+def c_allreduce_sum(ctx):
+    x = ctx.in_("X")
+    return {"Out": _maybe(lax.psum, x, _axis(ctx))}
+
+
+@register("c_allreduce_max")
+def c_allreduce_max(ctx):
+    return {"Out": _maybe(lax.pmax, ctx.in_("X"), _axis(ctx))}
+
+
+@register("c_allreduce_min")
+def c_allreduce_min(ctx):
+    return {"Out": _maybe(lax.pmin, ctx.in_("X"), _axis(ctx))}
+
+
+@register("c_allreduce_prod")
+def c_allreduce_prod(ctx):
+    x = ctx.in_("X")
+    axis = _axis(ctx)
+    try:
+        import jax.numpy as jnp
+        return {"Out": jnp.exp(lax.psum(jnp.log(x), axis))}
+    except NameError:
+        return {"Out": x}
+
+
+@register("c_broadcast", "broadcast")
+def c_broadcast(ctx):
+    x = ctx.in_("X")
+    axis = _axis(ctx)
+    root = ctx.attr("root", 0)
+
+    def bcast(v, ax):
+        idx = lax.axis_index(ax)
+        import jax.numpy as jnp
+        src = lax.psum(jnp.where(idx == root, v, jnp.zeros_like(v)), ax)
+        return src
+    return {"Out": _maybe(bcast, x, axis)}
+
+
+@register("c_allgather")
+def c_allgather(ctx):
+    x = ctx.in_("X")
+
+    def gather(v, ax):
+        return lax.all_gather(v, ax, axis=0, tiled=True)
+    return {"Out": _maybe(gather, x, _axis(ctx))}
+
+
+@register("c_reducescatter")
+def c_reducescatter(ctx):
+    x = ctx.in_("X")
+
+    def rs(v, ax):
+        return lax.psum_scatter(v, ax, scatter_dimension=0, tiled=True)
+    return {"Out": _maybe(rs, x, _axis(ctx))}
+
+
+@register("alltoall")
+def alltoall(ctx):
+    x = ctx.in_("X")
+
+    def a2a(v, ax):
+        return lax.all_to_all(v, ax, split_axis=0, concat_axis=0, tiled=True)
+    return {"Out": _maybe(a2a, x, _axis(ctx))}
+
+
+@register("c_sync_calc_stream", "c_sync_comm_stream")
+def c_sync(ctx):
+    # XLA schedules compute/comm overlap itself; sync is a no-op by design.
+    return {"Out": ctx.in_("X")}

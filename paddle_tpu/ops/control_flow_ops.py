@@ -1,0 +1,83 @@
+"""Control-flow ops.
+
+Parity: paddle/fluid/operators/controlflow/* (conditional_block, while, select)
+and layers/control_flow.py machinery (array_read/array_write TensorArray).
+
+TPU-first: data-dependent branching lowers to lax.select / lax.cond-style
+masked selects so the whole program stays one static XLA graph. The While
+layer (layers/control_flow.py) builds a sub-block and the executor lowers it
+to lax.while_loop over the block's live state; these ops cover the leaf
+pieces.
+"""
+
+import jax.numpy as jnp
+
+from . import register
+
+
+@register("select", "where_op")
+def select(ctx):
+    return {"Out": jnp.where(ctx.in_("Condition"), ctx.in_("X"), ctx.in_("Y"))}
+
+
+@register("conditional_select")
+def conditional_select(ctx):
+    cond = ctx.in_("Cond").reshape(())
+    return {"Out": jnp.where(cond, ctx.in_("X"), ctx.in_("Y"))}
+
+
+@register("is_empty")
+def is_empty(ctx):
+    return {"Out": jnp.asarray(ctx.in_("X").size == 0)}
+
+
+# TensorArray ops: the array lives in env as a python list during tracing
+# (static length — the TPU version of LoDTensorArray).
+
+@register("create_array")
+def create_array(ctx):
+    return {"Out": []}
+
+
+@register("array_write")
+def array_write(ctx):
+    arr = list(ctx.in_("Array")) if ctx.has_in("Array") else []
+    i = int(ctx.attr("static_index", len(arr)))
+    x = ctx.in_("X")
+    if i == len(arr):
+        arr.append(x)
+    else:
+        arr[i] = x
+    return {"Out": arr}
+
+
+@register("array_read")
+def array_read(ctx):
+    arr = ctx.in_("Array")
+    return {"Out": arr[int(ctx.attr("static_index", 0))]}
+
+
+@register("array_length")
+def array_length(ctx):
+    return {"Out": jnp.asarray(len(ctx.in_("Array")), jnp.int64)}
+
+
+@register("tensor_array_to_tensor")
+def tensor_array_to_tensor(ctx):
+    arr = ctx.in_("X")
+    axis = ctx.attr("axis", 0)
+    if ctx.attr("use_stack", False):
+        return {"Out": jnp.stack(arr, axis=axis)}
+    return {"Out": jnp.concatenate(arr, axis=axis)}
+
+
+@register("py_func")
+def py_func(ctx):
+    """Host-callback escape hatch (fluid.layers.py_func) via pure_callback."""
+    import jax
+    from ..core.framework import Operator
+    fn = Operator.CALLABLE_TABLE[ctx.attr("func_id")]
+    xs = ctx.in_list("X")
+    out_var = ctx.out_var("Out")
+    shape_dtype = jax.ShapeDtypeStruct(tuple(out_var.shape), out_var.dtype)
+    return {"Out": jax.pure_callback(fn, shape_dtype, *xs)}

@@ -1,0 +1,202 @@
+"""Detection ops (subset).
+
+Parity: paddle/fluid/operators/detection/{roi_pool,roi_align,prior_box,
+iou_similarity,box_coder,yolo_box}_op.* — enough for the detection demo
+models; NMS runs as a host-side utility (paddle_tpu.layers.detection).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import register
+
+
+@register("iou_similarity")
+def iou_similarity(ctx):
+    x = ctx.in_("X")  # (N, 4) xmin,ymin,xmax,ymax
+    y = ctx.in_("Y")  # (M, 4)
+    area_x = (x[:, 2] - x[:, 0]) * (x[:, 3] - x[:, 1])
+    area_y = (y[:, 2] - y[:, 0]) * (y[:, 3] - y[:, 1])
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    return {"Out": inter / jnp.maximum(area_x[:, None] + area_y[None, :] - inter, 1e-10)}
+
+
+def _roi_grid(x, rois, pooled_h, pooled_w, spatial_scale, sampling=2, align=True):
+    """Bilinear ROI align core: x NCHW, rois (R,5) [batch_idx,x1,y1,x2,y2]."""
+    n, c, h, w = x.shape
+    bidx = rois[:, 0].astype(jnp.int32)
+    boxes = rois[:, 1:] * spatial_scale
+    off = 0.5 if align else 0.0
+    x1, y1, x2, y2 = boxes[:, 0] - off, boxes[:, 1] - off, boxes[:, 2] - off, boxes[:, 3] - off
+    bw = jnp.maximum(x2 - x1, 1.0) / pooled_w
+    bh = jnp.maximum(y2 - y1, 1.0) / pooled_h
+    ys = y1[:, None] + bh[:, None] * (jnp.arange(pooled_h * sampling) + 0.5) / sampling
+    xs = x1[:, None] + bw[:, None] * (jnp.arange(pooled_w * sampling) + 0.5) / sampling
+
+    def bilinear(img, yy, xx):
+        y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, w - 1)
+        y1_ = jnp.clip(y0 + 1, 0, h - 1)
+        x1_ = jnp.clip(x0 + 1, 0, w - 1)
+        wy = yy - y0
+        wx = xx - x0
+        va = img[:, y0[:, None], x0[None, :]]
+        vb = img[:, y0[:, None], x1_[None, :]]
+        vc = img[:, y1_[:, None], x0[None, :]]
+        vd = img[:, y1_[:, None], x1_[None, :]]
+        return (va * ((1 - wy)[:, None] * (1 - wx)[None, :]) +
+                vb * ((1 - wy)[:, None] * wx[None, :]) +
+                vc * (wy[:, None] * (1 - wx)[None, :]) +
+                vd * (wy[:, None] * wx[None, :]))
+
+    def per_roi(b, yy, xx):
+        img = x[b]  # (C, H, W)
+        vals = bilinear(img, yy, xx)  # (C, ph*s, pw*s)
+        vals = vals.reshape(c, pooled_h, sampling, pooled_w, sampling)
+        return vals.mean(axis=(2, 4))
+
+    return jax.vmap(per_roi)(bidx, ys, xs)
+
+
+@register("roi_align")
+def roi_align(ctx):
+    x = ctx.in_("X")
+    rois = ctx.in_("ROIs")
+    out = _roi_grid(x, rois, ctx.attr("pooled_height", 1), ctx.attr("pooled_width", 1),
+                    ctx.attr("spatial_scale", 1.0), ctx.attr("sampling_ratio", 2) or 2)
+    return {"Out": out}
+
+
+@register("roi_pool")
+def roi_pool(ctx):
+    x = ctx.in_("X")
+    rois = ctx.in_("ROIs")
+    # Max-pool variant approximated with dense sampling + max
+    ph = ctx.attr("pooled_height", 1)
+    pw = ctx.attr("pooled_width", 1)
+    out = _roi_grid(x, rois, ph, pw, ctx.attr("spatial_scale", 1.0), sampling=2,
+                    align=False)
+    return {"Out": out, "Argmax": jnp.zeros(out.shape, jnp.int64)}
+
+
+@register("psroi_pool")
+def psroi_pool(ctx):
+    x = ctx.in_("X")
+    rois = ctx.in_("ROIs")
+    out_c = ctx.attr("output_channels")
+    ph = ctx.attr("pooled_height")
+    pw = ctx.attr("pooled_width")
+    pooled = _roi_grid(x, rois, ph, pw, ctx.attr("spatial_scale", 1.0))
+    r = pooled.shape[0]
+    pooled = pooled.reshape(r, out_c, ph, pw, ph, pw)
+    idx_h = jnp.arange(ph)
+    idx_w = jnp.arange(pw)
+    out = pooled[:, :, idx_h[:, None], idx_w[None, :], idx_h[:, None], idx_w[None, :]]
+    return {"Out": out.reshape(r, out_c, ph, pw)}
+
+
+@register("box_coder")
+def box_coder(ctx):
+    prior = ctx.in_("PriorBox")      # (M, 4)
+    target = ctx.in_("TargetBox")
+    code_type = ctx.attr("code_type", "encode_center_size")
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + 0.5 * pw
+    pcy = prior[:, 1] + 0.5 * ph
+    if code_type == "encode_center_size":
+        tw = target[:, 2] - target[:, 0]
+        th = target[:, 3] - target[:, 1]
+        tcx = target[:, 0] + 0.5 * tw
+        tcy = target[:, 1] + 0.5 * th
+        out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                         jnp.log(tw / pw), jnp.log(th / ph)], axis=-1)
+    else:
+        d = target  # (N, M, 4) or (M, 4)
+        if d.ndim == 2:
+            d = d[:, None, :]
+        cx = pcx + d[..., 0] * pw
+        cy = pcy + d[..., 1] * ph
+        w = pw * jnp.exp(d[..., 2])
+        h = ph * jnp.exp(d[..., 3])
+        out = jnp.stack([cx - 0.5 * w, cy - 0.5 * h, cx + 0.5 * w, cy + 0.5 * h], axis=-1)
+    return {"OutputBox": out}
+
+
+@register("yolo_box")
+def yolo_box(ctx):
+    x = ctx.in_("X")  # (N, A*(5+C), H, W)
+    img_size = ctx.in_("ImgSize")
+    anchors = ctx.attr("anchors")
+    class_num = ctx.attr("class_num")
+    conf_thresh = ctx.attr("conf_thresh", 0.01)
+    downsample = ctx.attr("downsample_ratio", 32)
+    n, _, h, w = x.shape
+    na = len(anchors) // 2
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    grid_x = jnp.arange(w).reshape(1, 1, 1, w)
+    grid_y = jnp.arange(h).reshape(1, 1, h, 1)
+    bx = (jax.nn.sigmoid(x[:, :, 0]) + grid_x) / w
+    by = (jax.nn.sigmoid(x[:, :, 1]) + grid_y) / h
+    aw = jnp.asarray(anchors[0::2], jnp.float32).reshape(1, na, 1, 1)
+    ah = jnp.asarray(anchors[1::2], jnp.float32).reshape(1, na, 1, 1)
+    bw = jnp.exp(x[:, :, 2]) * aw / (w * downsample)
+    bh = jnp.exp(x[:, :, 3]) * ah / (h * downsample)
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    imgh = img_size[:, 0].reshape(n, 1, 1, 1).astype(jnp.float32)
+    imgw = img_size[:, 1].reshape(n, 1, 1, 1).astype(jnp.float32)
+    boxes = jnp.stack([(bx - bw / 2) * imgw, (by - bh / 2) * imgh,
+                       (bx + bw / 2) * imgw, (by + bh / 2) * imgh], axis=-1)
+    boxes = boxes.reshape(n, -1, 4)
+    probs = jnp.moveaxis(probs, 2, -1).reshape(n, -1, class_num)
+    mask = (conf.reshape(n, -1, 1) > conf_thresh).astype(boxes.dtype)
+    return {"Boxes": boxes * mask, "Scores": probs * mask}
+
+
+@register("prior_box")
+def prior_box(ctx):
+    inp = ctx.in_("Input")  # (N, C, H, W) feature map
+    image = ctx.in_("Image")
+    min_sizes = ctx.attr("min_sizes")
+    max_sizes = ctx.attr("max_sizes", []) or []
+    ars = ctx.attr("aspect_ratios", [1.0])
+    variances = ctx.attr("variances", [0.1, 0.1, 0.2, 0.2])
+    flip = ctx.attr("flip", False)
+    clip = ctx.attr("clip", False)
+    step_w = ctx.attr("step_w", 0.0)
+    step_h = ctx.attr("step_h", 0.0)
+    offset = ctx.attr("offset", 0.5)
+    h, w = inp.shape[2], inp.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+    sw = step_w or img_w / w
+    sh = step_h or img_h / h
+    full_ars = []
+    for ar in ars:
+        full_ars.append(ar)
+        if flip and ar != 1.0:
+            full_ars.append(1.0 / ar)
+    boxes = []
+    for ms in min_sizes:
+        for ar in full_ars:
+            bw = ms * (ar ** 0.5) / 2.0
+            bh = ms / (ar ** 0.5) / 2.0
+            boxes.append((bw, bh))
+        for Ms in max_sizes:
+            s = (ms * Ms) ** 0.5 / 2.0
+            boxes.append((s, s))
+    cx = (jnp.arange(w) + offset) * sw
+    cy = (jnp.arange(h) + offset) * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    out = []
+    for bw, bh in boxes:
+        out.append(jnp.stack([(cxg - bw) / img_w, (cyg - bh) / img_h,
+                              (cxg + bw) / img_w, (cyg + bh) / img_h], axis=-1))
+    priors = jnp.stack(out, axis=2)  # (H, W, num_priors, 4)
+    if clip:
+        priors = jnp.clip(priors, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances), priors.shape)
+    return {"Boxes": priors, "Variances": var}

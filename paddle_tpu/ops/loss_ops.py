@@ -1,0 +1,225 @@
+"""Loss ops.
+
+Parity: paddle/fluid/operators/{cross_entropy,softmax_with_cross_entropy,
+squared_l2,smooth_l1,huber_loss,log_loss,bpr_loss,kldiv_loss,...}_op.*
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import register
+
+
+def _squeeze_label(label):
+    if label.ndim >= 1 and label.shape[-1] == 1:
+        return label.reshape(label.shape[:-1])
+    return label
+
+
+@register("cross_entropy", "cross_entropy2")
+def cross_entropy(ctx):
+    x = ctx.in_("X")  # probabilities
+    label = ctx.in_("Label")
+    soft = ctx.attr("soft_label", False)
+    ignore_index = ctx.attr("ignore_index", -100)
+    logp = jnp.log(jnp.clip(x, 1e-15, 1.0))
+    if soft:
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        lbl = _squeeze_label(label).astype(jnp.int32)
+        picked = jnp.take_along_axis(logp, lbl[..., None], axis=-1)
+        mask = (lbl != ignore_index)[..., None]
+        loss = -picked * mask
+    return {"Y": loss, "Out": loss}
+
+
+@register("softmax_with_cross_entropy")
+def softmax_with_cross_entropy(ctx):
+    logits = ctx.in_("Logits")
+    label = ctx.in_("Label")
+    soft = ctx.attr("soft_label", False)
+    ignore_index = ctx.attr("ignore_index", -100)
+    axis = ctx.attr("axis", -1)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+    softmax = jnp.exp(logp)
+    if soft:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lbl = _squeeze_label(label).astype(jnp.int32)
+        picked = jnp.take_along_axis(logp, lbl[..., None], axis=axis)
+        mask = (lbl != ignore_index)[..., None]
+        loss = -picked * mask
+    return {"Softmax": softmax.astype(logits.dtype), "Loss": loss}
+
+
+@register("sigmoid_cross_entropy_with_logits")
+def sigmoid_cross_entropy_with_logits(ctx):
+    x = ctx.in_("X")
+    label = ctx.in_("Label")
+    ignore_index = ctx.attr("ignore_index", -100)
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    mask = (label != ignore_index).astype(loss.dtype)
+    loss = loss * mask
+    if ctx.attr("normalize", False):
+        loss = loss / jnp.maximum(mask.sum(), 1.0)
+    return {"Out": loss}
+
+
+@register("square_error_cost", "squared_l2_distance")
+def square_error_cost(ctx):
+    x, y = ctx.in_("X"), ctx.in_("Y")
+    d = x - y
+    return {"Out": d * d, "sub_result": d}
+
+
+@register("smooth_l1_loss", "smooth_l1")
+def smooth_l1(ctx):
+    x, y = ctx.in_("X"), ctx.in_("Y")
+    sigma = ctx.attr("sigma", 1.0)
+    s2 = sigma * sigma
+    d = x - y
+    if ctx.has_in("InsideWeight"):
+        d = d * ctx.in_("InsideWeight")
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < 1.0 / s2, 0.5 * s2 * d * d, ad - 0.5 / s2)
+    if ctx.has_in("OutsideWeight"):
+        loss = loss * ctx.in_("OutsideWeight")
+    loss = loss.reshape(loss.shape[0], -1).sum(axis=1, keepdims=True)
+    return {"Out": loss, "Diff": d}
+
+
+@register("huber_loss")
+def huber_loss(ctx):
+    x, y = ctx.in_("X"), ctx.in_("Y")
+    delta = ctx.attr("delta", 1.0)
+    d = y - x
+    ad = jnp.abs(d)
+    loss = jnp.where(ad <= delta, 0.5 * d * d, delta * (ad - 0.5 * delta))
+    return {"Out": loss, "Residual": d}
+
+
+@register("log_loss")
+def log_loss(ctx):
+    p = ctx.in_("Predicted")
+    label = ctx.in_("Labels")
+    eps = ctx.attr("epsilon", 1e-4)
+    loss = -label * jnp.log(p + eps) - (1 - label) * jnp.log(1 - p + eps)
+    return {"Loss": loss}
+
+
+@register("bpr_loss")
+def bpr_loss(ctx):
+    x = ctx.in_("X")  # (N, C) scores
+    label = _squeeze_label(ctx.in_("Label")).astype(jnp.int32)
+    pos = jnp.take_along_axis(x, label[:, None], axis=1)
+    diff = -(x - pos)
+    loss = jnp.mean(jnp.log1p(jnp.exp(-jnp.abs(diff))) + jnp.maximum(-diff, 0),
+                    axis=1, keepdims=True)
+    return {"Y": loss}
+
+
+@register("kldiv_loss")
+def kldiv_loss(ctx):
+    x = ctx.in_("X")  # log-probabilities
+    target = ctx.in_("Target")
+    loss = target * (jnp.log(jnp.clip(target, 1e-10, None)) - x)
+    loss = jnp.where(target > 0, loss, 0.0)
+    red = ctx.attr("reduction", "mean")
+    if red == "mean":
+        loss = jnp.mean(loss)
+    elif red == "sum":
+        loss = jnp.sum(loss)
+    elif red == "batchmean":
+        loss = jnp.sum(loss) / x.shape[0]
+    return {"Loss": loss}
+
+
+@register("rank_loss")
+def rank_loss(ctx):
+    label = ctx.in_("Label")
+    left, right = ctx.in_("Left"), ctx.in_("Right")
+    d = left - right
+    # log(1 + e^d) - label*d, computed stably
+    loss = jnp.log1p(jnp.exp(-jnp.abs(d))) + jnp.maximum(d, 0) - label * d
+    return {"Out": loss}
+
+
+@register("margin_rank_loss")
+def margin_rank_loss(ctx):
+    label = ctx.in_("Label")
+    x1, x2 = ctx.in_("X1"), ctx.in_("X2")
+    margin = ctx.attr("margin", 0.1)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": out, "Activated": (out > 0).astype(x1.dtype)}
+
+
+@register("dice_loss")
+def dice_loss(ctx):
+    x = ctx.in_("X")
+    label = ctx.in_("Label").astype(x.dtype)
+    eps = ctx.attr("epsilon", 1e-5)
+    reduce_dims = tuple(range(1, x.ndim))
+    inter = 2.0 * jnp.sum(x * label, axis=reduce_dims)
+    union = jnp.sum(x, axis=reduce_dims) + jnp.sum(label, axis=reduce_dims)
+    return {"Out": 1.0 - jnp.mean(inter / (union + eps))}
+
+
+@register("npair_loss")
+def npair_loss(ctx):
+    anchor = ctx.in_("Anchor")
+    positive = ctx.in_("Positive")
+    labels = ctx.in_("Labels").reshape(-1)
+    l2_reg = ctx.attr("l2_reg", 0.002)
+    sim = anchor @ positive.T
+    same = (labels[:, None] == labels[None, :]).astype(sim.dtype)
+    same = same / jnp.maximum(same.sum(axis=1, keepdims=True), 1.0)
+    xent = -jnp.mean(jnp.sum(same * jax.nn.log_softmax(sim, axis=1), axis=1))
+    reg = l2_reg * (jnp.mean(jnp.sum(anchor * anchor, axis=1)) +
+                    jnp.mean(jnp.sum(positive * positive, axis=1))) / 2
+    return {"Out": xent + reg}
+
+
+@register("center_loss")
+def center_loss(ctx):
+    x = ctx.in_("X")
+    label = _squeeze_label(ctx.in_("Label")).astype(jnp.int32)
+    centers = ctx.in_("Centers")
+    alpha = ctx.in_("CenterUpdateRate", jnp.asarray(0.1))
+    picked = centers[label]
+    diff = x - picked
+    loss = 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
+    if ctx.attr("need_update", True) and not ctx.is_test:
+        counts = jnp.zeros(centers.shape[0], x.dtype).at[label].add(1.0)
+        upd = jnp.zeros_like(centers).at[label].add(diff)
+        centers_out = centers + jax.lax.stop_gradient(
+            alpha * upd / (counts[:, None] + 1.0))
+    else:
+        centers_out = centers
+    return {"Loss": loss, "SampleCenterDiff": diff, "CentersOut": centers_out}
+
+
+@register("teacher_student_sigmoid_loss")
+def teacher_student_sigmoid_loss(ctx):
+    x = ctx.in_("X").reshape(-1)
+    label = ctx.in_("Label").reshape(-1)
+    soft_max_up = ctx.attr("soft_max_up_bound", 15.0)
+    soft_max_lo = ctx.attr("soft_max_lower_bound", -15.0)
+    z = jnp.clip(x, soft_max_lo, soft_max_up)
+    teacher = (label > 0).astype(x.dtype)
+    sig = jnp.log1p(jnp.exp(-jnp.abs(z))) + jnp.maximum(z, 0) - z * teacher
+    return {"Y": sig.reshape(-1, 1)}
+
+
+@register("cos_sim")
+def cos_sim(ctx):
+    x, y = ctx.in_("X"), ctx.in_("Y")
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / jnp.maximum(xn * yn, 1e-12)
+    return {"Out": out, "XNorm": xn, "YNorm": yn}
+
+
+@register("mse_loss")
+def mse_loss(ctx):
+    d = ctx.in_("X") - ctx.in_("Y")
+    return {"Out": jnp.mean(d * d)}

@@ -1,0 +1,222 @@
+"""Elementwise / matmul / reduction ops.
+
+Parity: paddle/fluid/operators/elementwise/*, matmul_op.cc, mul_op.cc,
+reduce_ops/*, scale_op.cc, sum_op.cc, clip_op.cc, cumsum_op.cc, compare_op.cc.
+All are thin jnp calls — XLA fuses chains of these into single kernels and
+folds them into MXU matmul epilogues, which is the whole point of tracing the
+program instead of dispatching per-op.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+from . import register
+
+
+def _bcast_y(x, y, axis):
+    """Fluid elementwise broadcast: align y's dims to x starting at `axis`."""
+    if x.ndim == y.ndim or y.ndim == 0:
+        return y
+    if axis is None or axis == -1:
+        axis = x.ndim - y.ndim
+    shape = [1] * axis + list(y.shape) + [1] * (x.ndim - axis - y.ndim)
+    return y.reshape(shape)
+
+
+def _ew(fn):
+    def impl(ctx):
+        x, y = ctx.in_("X"), ctx.in_("Y")
+        y = _bcast_y(x, y, ctx.attr("axis", -1))
+        return {"Out": fn(x, y)}
+    return impl
+
+
+register("elementwise_add")(_ew(jnp.add))
+register("elementwise_sub")(_ew(jnp.subtract))
+register("elementwise_mul")(_ew(jnp.multiply))
+register("elementwise_div")(_ew(jnp.divide))
+register("elementwise_max")(_ew(jnp.maximum))
+register("elementwise_min")(_ew(jnp.minimum))
+register("elementwise_pow")(_ew(jnp.power))
+register("elementwise_mod")(_ew(jnp.mod))
+register("elementwise_floordiv")(_ew(jnp.floor_divide))
+
+
+@register("scale")
+def scale(ctx):
+    x = ctx.in_("X")
+    s = ctx.attr("scale", 1.0)
+    b = ctx.attr("bias", 0.0)
+    if ctx.attr("bias_after_scale", True):
+        return {"Out": x * s + b}
+    return {"Out": (x + b) * s}
+
+
+@register("mul")
+def mul(ctx):
+    """fluid mul: flatten x to 2-D at x_num_col_dims, matmul. MXU-bound."""
+    x, y = ctx.in_("X"), ctx.in_("Y")
+    xnc = ctx.attr("x_num_col_dims", 1)
+    ync = ctx.attr("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((-1, int(_prod(xs[xnc:]))))
+    y2 = y.reshape((int(_prod(ys[:ync])), -1))
+    out = x2 @ y2
+    return {"Out": out.reshape(tuple(xs[:xnc]) + tuple(ys[ync:]))}
+
+
+def _prod(t):
+    r = 1
+    for v in t:
+        r *= int(v)
+    return r
+
+
+@register("matmul")
+def matmul(ctx):
+    x, y = ctx.in_("X"), ctx.in_("Y")
+    if ctx.attr("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if ctx.attr("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y)
+    alpha = ctx.attr("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": out}
+
+
+@register("sum")
+def sum_op(ctx):
+    xs = ctx.in_list("X")
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": out}
+
+
+def _reduce(fn, keep_dtype=False):
+    def impl(ctx):
+        x = ctx.in_("X")
+        dims = ctx.attr("dim", [0])
+        keep = ctx.attr("keep_dim", False)
+        if ctx.attr("reduce_all", False) or dims is None:
+            axes = None
+        else:
+            axes = tuple(d % x.ndim for d in (dims if isinstance(dims, (list, tuple)) else [dims]))
+        out = fn(x, axis=axes, keepdims=keep)
+        return {"Out": out}
+    return impl
+
+
+register("reduce_sum")(_reduce(jnp.sum))
+register("reduce_mean")(_reduce(jnp.mean))
+register("reduce_max")(_reduce(jnp.max))
+register("reduce_min")(_reduce(jnp.min))
+register("reduce_prod")(_reduce(jnp.prod))
+register("reduce_all")(_reduce(jnp.all))
+register("reduce_any")(_reduce(jnp.any))
+
+
+@register("mean")
+def mean(ctx):
+    return {"Out": jnp.mean(ctx.in_("X"))}
+
+
+@register("cumsum")
+def cumsum(ctx):
+    x = ctx.in_("X")
+    axis = ctx.attr("axis", -1)
+    if ctx.attr("flatten", False):
+        x = x.reshape(-1)
+        axis = 0
+    out = jnp.cumsum(x, axis=axis)
+    if ctx.attr("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+    if ctx.attr("exclusive", False):
+        out = out - x
+    return {"Out": out}
+
+
+@register("clip")
+def clip(ctx):
+    return {"Out": jnp.clip(ctx.in_("X"), ctx.attr("min"), ctx.attr("max"))}
+
+
+@register("clip_by_norm")
+def clip_by_norm(ctx):
+    x = ctx.in_("X")
+    max_norm = ctx.attr("max_norm")
+    norm = jnp.sqrt(jnp.sum(x * x))
+    scale = jnp.minimum(max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": x * scale}
+
+
+# -- comparisons / logical ---------------------------------------------------
+
+def _cmp(fn):
+    def impl(ctx):
+        x, y = ctx.in_("X"), ctx.in_("Y")
+        return {"Out": fn(x, y)}
+    return impl
+
+
+register("less_than")(_cmp(jnp.less))
+register("less_equal")(_cmp(jnp.less_equal))
+register("greater_than")(_cmp(jnp.greater))
+register("greater_equal")(_cmp(jnp.greater_equal))
+register("equal")(_cmp(jnp.equal))
+register("not_equal")(_cmp(jnp.not_equal))
+register("logical_and")(_cmp(jnp.logical_and))
+register("logical_or")(_cmp(jnp.logical_or))
+register("logical_xor")(_cmp(jnp.logical_xor))
+
+
+@register("logical_not")
+def logical_not(ctx):
+    return {"Out": jnp.logical_not(ctx.in_("X"))}
+
+
+@register("isfinite")
+def isfinite(ctx):
+    return {"Out": jnp.all(jnp.isfinite(ctx.in_("X")))}
+
+
+@register("has_inf")
+def has_inf(ctx):
+    return {"Out": jnp.any(jnp.isinf(ctx.in_("X")))}
+
+
+@register("has_nan")
+def has_nan(ctx):
+    return {"Out": jnp.any(jnp.isnan(ctx.in_("X")))}
+
+
+@register("l2_normalize", "norm")
+def l2_normalize(ctx):
+    x = ctx.in_("X")
+    axis = ctx.attr("axis", -1)
+    eps = ctx.attr("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True))
+    return {"Out": x / jnp.maximum(norm, eps), "Norm": norm}
+
+
+@register("bilinear_tensor_product")
+def bilinear_tensor_product(ctx):
+    x, y, w = ctx.in_("X"), ctx.in_("Y"), ctx.in_("Weight")
+    out = jnp.einsum("bi,oij,bj->bo", x, w, y)
+    if ctx.has_in("Bias"):
+        out = out + ctx.in_("Bias")
+    return {"Out": out}
+
+
+@register("dot")
+def dot(ctx):
+    x, y = ctx.in_("X"), ctx.in_("Y")
+    return {"Out": jnp.sum(x * y, axis=-1, keepdims=True)}
+
+
+@register("increment")
+def increment(ctx):
+    x = ctx.in_("X")
+    return {"Out": x + jnp.asarray(ctx.attr("step", 1.0), x.dtype)}

@@ -1,0 +1,61 @@
+"""Metric ops.
+
+Parity: paddle/fluid/operators/metrics/{accuracy,auc}_op.*
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import register
+
+
+@register("accuracy")
+def accuracy(ctx):
+    pred_idx = ctx.in_("Indices")  # (N, k) top-k indices
+    label = ctx.in_("Label")
+    if label.ndim > 1 and label.shape[-1] == 1:
+        label = label.reshape(-1)
+    correct = jnp.any(pred_idx.astype(jnp.int64) == label.astype(jnp.int64)[:, None], axis=1)
+    num_correct = correct.sum().astype(jnp.float32)
+    total = jnp.asarray(label.shape[0], jnp.float32)
+    return {"Accuracy": (num_correct / total).reshape(1),
+            "Correct": num_correct.astype(jnp.int32).reshape(1),
+            "Total": total.astype(jnp.int32).reshape(1)}
+
+
+@register("auc")
+def auc(ctx):
+    """Streaming AUC via histogram buckets (same scheme as the reference)."""
+    probs = ctx.in_("Predict")[:, -1]  # P(positive)
+    label = ctx.in_("Label").reshape(-1)
+    stat_pos = ctx.in_("StatPos")
+    stat_neg = ctx.in_("StatNeg")
+    num_buckets = stat_pos.shape[-1]
+    bucket = jnp.clip((probs * (num_buckets - 1)).astype(jnp.int32), 0, num_buckets - 1)
+    pos_hist = jnp.zeros(num_buckets, stat_pos.dtype).at[bucket].add(label.astype(stat_pos.dtype))
+    neg_hist = jnp.zeros(num_buckets, stat_neg.dtype).at[bucket].add((1 - label).astype(stat_neg.dtype))
+    new_pos = stat_pos.reshape(-1) + pos_hist
+    new_neg = stat_neg.reshape(-1) + neg_hist
+    # AUC = (sum over thresholds of TP*FP_delta trapezoid) via cumulative sums
+    tot_pos = jnp.cumsum(new_pos[::-1])[::-1]
+    auc_val = jnp.sum(new_neg * (tot_pos - new_pos / 2.0))
+    denom = jnp.maximum(new_pos.sum() * new_neg.sum(), 1.0)
+    return {"AUC": (auc_val / denom).reshape(1),
+            "StatPosOut": new_pos.reshape(stat_pos.shape),
+            "StatNegOut": new_neg.reshape(stat_neg.shape)}
+
+
+@register("mean_iou")
+def mean_iou(ctx):
+    pred = ctx.in_("Predictions").reshape(-1).astype(jnp.int32)
+    label = ctx.in_("Labels").reshape(-1).astype(jnp.int32)
+    n = ctx.attr("num_classes")
+    idx = label * n + pred
+    cm = jnp.zeros((n * n,), jnp.float32).at[idx].add(1.0).reshape(n, n)
+    inter = jnp.diag(cm)
+    union = cm.sum(axis=0) + cm.sum(axis=1) - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1.0), 0.0)
+    miou = iou.sum() / jnp.maximum(valid.sum(), 1)
+    return {"OutMeanIou": miou.reshape(1), "OutWrong": cm.sum(axis=1) - inter,
+            "OutCorrect": inter}

@@ -1,0 +1,384 @@
+"""NN ops: conv / pool / normalization / dropout / resize.
+
+Parity: paddle/fluid/operators/{conv,pool,batch_norm,layer_norm,group_norm,
+dropout,interpolate,lrn,...}_op.* . Convs lower to lax.conv_general_dilated
+(MXU); XLA's TPU layout assignment picks the fast layout, so the public NCHW
+semantics of fluid are preserved without a manual transpose dance.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import register
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+@register("conv2d", "depthwise_conv2d")
+def conv2d(ctx):
+    x, w = ctx.in_("Input"), ctx.in_("Filter")
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    pads = _pair(ctx.attr("paddings", [0, 0]))
+    dilations = _pair(ctx.attr("dilations", [1, 1]))
+    groups = ctx.attr("groups", 1) or 1
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dilations, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    out = out.astype(x.dtype)
+    if ctx.has_in("Bias"):
+        out = out + ctx.in_("Bias").reshape(1, -1, 1, 1)
+    return {"Output": out, "Out": out}
+
+
+@register("conv3d")
+def conv3d(ctx):
+    x, w = ctx.in_("Input"), ctx.in_("Filter")
+    strides = _pair(ctx.attr("strides", [1, 1, 1]), 3)
+    pads = _pair(ctx.attr("paddings", [0, 0, 0]), 3)
+    dilations = _pair(ctx.attr("dilations", [1, 1, 1]), 3)
+    groups = ctx.attr("groups", 1) or 1
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCDHW", "OIDHW", "NCDHW"))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(p, p) for p in pads], rhs_dilation=dilations,
+        dimension_numbers=dn, feature_group_count=groups)
+    return {"Output": out, "Out": out}
+
+
+@register("conv2d_transpose")
+def conv2d_transpose(ctx):
+    x, w = ctx.in_("Input"), ctx.in_("Filter")  # w: [C_in, C_out/g, kH, kW]
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    pads = _pair(ctx.attr("paddings", [0, 0]))
+    dilations = _pair(ctx.attr("dilations", [1, 1]))
+    groups = ctx.attr("groups", 1) or 1
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "IOHW", "NCHW"))
+    out = lax.conv_transpose(
+        x, w, strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dilations, dimension_numbers=dn,
+        transpose_kernel=True)
+    if groups != 1:
+        raise NotImplementedError("grouped conv2d_transpose")
+    if ctx.has_in("Bias"):
+        out = out + ctx.in_("Bias").reshape(1, -1, 1, 1)
+    return {"Output": out, "Out": out}
+
+
+def _pool(x, pool_type, ksize, strides, pads, exclusive=True, global_pool=False, nd=2):
+    spatial = x.shape[2:]
+    if global_pool:
+        ksize = spatial
+        strides = spatial
+        pads = (0,) * nd
+    window = (1, 1) + tuple(ksize)
+    strides_ = (1, 1) + tuple(strides)
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    if pool_type == "max":
+        init = -jnp.inf
+        out = lax.reduce_window(x, init, lax.max, window, strides_, padding)
+    else:
+        s = lax.reduce_window(x, 0.0, lax.add, window, strides_, padding)
+        if exclusive and any(pads):
+            ones = jnp.ones_like(x)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides_, padding)
+            out = s / cnt
+        else:
+            out = s / float(jnp.prod(jnp.asarray(ksize)))
+    return out
+
+
+@register("pool2d")
+def pool2d(ctx):
+    x = ctx.in_("X")
+    out = _pool(x, ctx.attr("pooling_type", "max"),
+                _pair(ctx.attr("ksize", [2, 2])),
+                _pair(ctx.attr("strides", [1, 1])),
+                _pair(ctx.attr("paddings", [0, 0])),
+                ctx.attr("exclusive", True),
+                ctx.attr("global_pooling", False), nd=2)
+    return {"Out": out}
+
+
+@register("pool3d")
+def pool3d(ctx):
+    x = ctx.in_("X")
+    out = _pool(x, ctx.attr("pooling_type", "max"),
+                _pair(ctx.attr("ksize", [2, 2, 2]), 3),
+                _pair(ctx.attr("strides", [1, 1, 1]), 3),
+                _pair(ctx.attr("paddings", [0, 0, 0]), 3),
+                ctx.attr("exclusive", True),
+                ctx.attr("global_pooling", False), nd=3)
+    return {"Out": out}
+
+
+@register("adaptive_pool2d")
+def adaptive_pool2d(ctx):
+    x = ctx.in_("X")
+    oh, ow = _pair(ctx.attr("pool_size"))
+    n, c, h, w = x.shape
+    # TPU-friendly: require divisibility (reference kernels special-case too)
+    kh, kw = h // oh, w // ow
+    x = x.reshape(n, c, oh, kh, ow, kw)
+    if ctx.attr("pooling_type", "avg") == "max":
+        return {"Out": x.max(axis=(3, 5))}
+    return {"Out": x.mean(axis=(3, 5))}
+
+
+@register("batch_norm")
+def batch_norm(ctx):
+    x = ctx.in_("X")
+    scale, bias = ctx.in_("Scale"), ctx.in_("Bias")
+    mean, var = ctx.in_("Mean"), ctx.in_("Variance")
+    eps = ctx.attr("epsilon", 1e-5)
+    momentum = ctx.attr("momentum", 0.9)
+    layout = ctx.attr("data_layout", "NCHW")
+    axes = tuple(i for i in range(x.ndim)
+                 if i != (1 if layout == "NCHW" else x.ndim - 1))
+    cshape = [1] * x.ndim
+    cshape[1 if layout == "NCHW" else -1] = -1
+
+    if ctx.is_test or ctx.attr("use_global_stats", False):
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = jnp.zeros_like(mean)
+        saved_var = jnp.zeros_like(var)
+    else:
+        xf = x.astype(jnp.float32)
+        bmean = jnp.mean(xf, axis=axes)
+        bvar = jnp.var(xf, axis=axes)
+        use_mean, use_var = bmean, bvar
+        mean_out = lax.stop_gradient(momentum * mean + (1 - momentum) * bmean)
+        var_out = lax.stop_gradient(momentum * var + (1 - momentum) * bvar)
+        saved_mean, saved_var = bmean, bvar
+    inv = lax.rsqrt(use_var.astype(jnp.float32) + eps)
+    y = (x.astype(jnp.float32) - use_mean.reshape(cshape)) * inv.reshape(cshape)
+    y = (y * scale.reshape(cshape) + bias.reshape(cshape)).astype(x.dtype)
+    return {"Y": y, "MeanOut": mean_out, "VarianceOut": var_out,
+            "SavedMean": saved_mean, "SavedVariance": saved_var}
+
+
+@register("layer_norm")
+def layer_norm(ctx):
+    x = ctx.in_("X")
+    begin = ctx.attr("begin_norm_axis", 1)
+    eps = ctx.attr("epsilon", 1e-5)
+    axes = tuple(range(begin, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps)
+    norm_shape = x.shape[begin:]
+    if ctx.has_in("Scale"):
+        y = y * ctx.in_("Scale").reshape(norm_shape)
+    if ctx.has_in("Bias"):
+        y = y + ctx.in_("Bias").reshape(norm_shape)
+    return {"Y": y.astype(x.dtype), "Mean": mean.reshape(x.shape[:begin]),
+            "Variance": var.reshape(x.shape[:begin])}
+
+
+@register("group_norm")
+def group_norm(ctx):
+    x = ctx.in_("X")  # NCHW
+    g = ctx.attr("groups")
+    eps = ctx.attr("epsilon", 1e-5)
+    n, c = x.shape[:2]
+    xg = x.reshape((n, g, c // g) + x.shape[2:]).astype(jnp.float32)
+    axes = tuple(range(2, xg.ndim))
+    mean = xg.mean(axis=axes, keepdims=True)
+    var = xg.var(axis=axes, keepdims=True)
+    y = ((xg - mean) * lax.rsqrt(var + eps)).reshape(x.shape)
+    cshape = [1, c] + [1] * (x.ndim - 2)
+    if ctx.has_in("Scale"):
+        y = y * ctx.in_("Scale").reshape(cshape)
+    if ctx.has_in("Bias"):
+        y = y + ctx.in_("Bias").reshape(cshape)
+    return {"Y": y.astype(x.dtype), "Mean": mean.reshape(n, g),
+            "Variance": var.reshape(n, g)}
+
+
+@register("instance_norm")
+def instance_norm(ctx):
+    x = ctx.in_("X")
+    eps = ctx.attr("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mean = x.mean(axis=axes, keepdims=True)
+    var = x.var(axis=axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    cshape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+    if ctx.has_in("Scale"):
+        y = y * ctx.in_("Scale").reshape(cshape)
+    if ctx.has_in("Bias"):
+        y = y + ctx.in_("Bias").reshape(cshape)
+    return {"Y": y}
+
+
+@register("data_norm")
+def data_norm(ctx):
+    x = ctx.in_("X")
+    bsize = ctx.in_("BatchSize")
+    bsum = ctx.in_("BatchSum")
+    bsqsum = ctx.in_("BatchSquareSum")
+    mean = bsum / bsize
+    scale = lax.rsqrt(bsqsum / bsize - mean * mean + 1e-4)
+    return {"Y": (x - mean) * scale, "Means": mean, "Scales": scale}
+
+
+@register("spectral_norm")
+def spectral_norm(ctx):
+    w = ctx.in_("Weight")
+    u = ctx.in_("U")
+    v = ctx.in_("V")
+    dim = ctx.attr("dim", 0)
+    power_iters = ctx.attr("power_iters", 1)
+    eps = ctx.attr("eps", 1e-12)
+    wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+
+    def body(i, uv):
+        u_, v_ = uv
+        v_ = wm.T @ u_
+        v_ = v_ / jnp.maximum(jnp.linalg.norm(v_), eps)
+        u_ = wm @ v_
+        u_ = u_ / jnp.maximum(jnp.linalg.norm(u_), eps)
+        return (u_, v_)
+
+    u2, v2 = lax.fori_loop(0, power_iters, body, (u.reshape(-1), v.reshape(-1)))
+    sigma = u2 @ wm @ v2
+    return {"Out": w / sigma}
+
+
+@register("lrn")
+def lrn(ctx):
+    x = ctx.in_("X")  # NCHW
+    n = ctx.attr("n", 5)
+    k = ctx.attr("k", 1.0)
+    alpha = ctx.attr("alpha", 1e-4)
+    beta = ctx.attr("beta", 0.75)
+    sq = x * x
+    half = n // 2
+    pad = jnp.pad(sq, [(0, 0), (half, half), (0, 0), (0, 0)])
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    return {"Out": x / jnp.power(k + alpha * acc, beta), "MidOut": acc}
+
+
+@register("dropout")
+def dropout(ctx):
+    x = ctx.in_("X")
+    p = ctx.attr("dropout_prob", 0.5)
+    impl = ctx.attr("dropout_implementation", "downgrade_in_infer")
+    if ctx.is_test:
+        out = x if impl == "upscale_in_train" else x * (1.0 - p)
+        return {"Out": out, "Mask": jnp.ones_like(x)}
+    if p == 0.0:
+        return {"Out": x, "Mask": jnp.ones_like(x)}
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(ctx.rng(), keep, x.shape)
+    out = jnp.where(mask, x / keep if impl == "upscale_in_train" else x, 0.0)
+    return {"Out": out.astype(x.dtype), "Mask": mask.astype(x.dtype)}
+
+
+def _resize(ctx, method):
+    x = ctx.in_("X")  # NCHW
+    out_h = ctx.attr("out_h", -1)
+    out_w = ctx.attr("out_w", -1)
+    scale = ctx.attr("scale", 0.0)
+    n, c, h, w = x.shape
+    if scale and scale > 0:
+        out_h, out_w = int(h * scale), int(w * scale)
+    return {"Out": jax.image.resize(x, (n, c, out_h, out_w), method=method)}
+
+
+@register("bilinear_interp")
+def bilinear_interp(ctx):
+    return _resize(ctx, "bilinear")
+
+
+@register("nearest_interp")
+def nearest_interp(ctx):
+    return _resize(ctx, "nearest")
+
+
+@register("trilinear_interp")
+def trilinear_interp(ctx):
+    x = ctx.in_("X")  # NCDHW
+    n, c = x.shape[:2]
+    shape = (n, c, ctx.attr("out_d"), ctx.attr("out_h"), ctx.attr("out_w"))
+    return {"Out": jax.image.resize(x, shape, method="trilinear")}
+
+
+@register("affine_channel")
+def affine_channel(ctx):
+    x = ctx.in_("X")
+    cshape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+    return {"Out": x * ctx.in_("Scale").reshape(cshape) + ctx.in_("Bias").reshape(cshape)}
+
+
+@register("temporal_shift")
+def temporal_shift(ctx):
+    x = ctx.in_("X")  # (N*T, C, H, W)
+    t = ctx.attr("seg_num")
+    ratio = ctx.attr("shift_ratio", 0.25)
+    nt, c, h, w = x.shape
+    n = nt // t
+    x5 = x.reshape(n, t, c, h, w)
+    c1 = int(c * ratio)
+    fwd = jnp.roll(x5[:, :, :c1], 1, axis=1).at[:, 0].set(0.0)
+    bwd = jnp.roll(x5[:, :, c1:2 * c1], -1, axis=1).at[:, -1].set(0.0)
+    rest = x5[:, :, 2 * c1:]
+    return {"Out": jnp.concatenate([fwd, bwd, rest], axis=2).reshape(x.shape)}
+
+
+@register("grid_sampler")
+def grid_sampler(ctx):
+    x = ctx.in_("X")  # NCHW
+    grid = ctx.in_("Grid")  # NHW2 in [-1, 1]
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1) * (w - 1) / 2
+    gy = (grid[..., 1] + 1) * (h - 1) / 2
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+
+    def sample(yy, xx):
+        yy = jnp.clip(yy, 0, h - 1)
+        xx = jnp.clip(xx, 0, w - 1)
+        bidx = jnp.arange(n).reshape(n, 1, 1)
+        return x[bidx, :, yy, xx]  # (N, Hg, Wg, C)
+
+    wa = ((x1 - gx) * (y1 - gy))[..., None]
+    wb = ((x1 - gx) * (gy - y0))[..., None]
+    wc = ((gx - x0) * (y1 - gy))[..., None]
+    wd = ((gx - x0) * (gy - y0))[..., None]
+    out = (sample(y0, x0) * wa + sample(y1, x0) * wb +
+           sample(y0, x1) * wc + sample(y1, x1) * wd)
+    return {"Output": jnp.moveaxis(out, -1, 1)}
+
+
+@register("pad_hwc", "im2sequence")
+def im2sequence(ctx):
+    raise NotImplementedError("im2sequence: use unfold")
+
+
+@register("unfold")
+def unfold(ctx):
+    x = ctx.in_("X")  # NCHW
+    k = _pair(ctx.attr("kernel_sizes"))
+    s = _pair(ctx.attr("strides", [1, 1]))
+    p = ctx.attr("paddings", [0, 0, 0, 0])
+    d = _pair(ctx.attr("dilations", [1, 1]))
+    patches = lax.conv_general_dilated_patches(
+        x, k, s, [(p[0], p[2] if len(p) > 2 else p[0]),
+                  (p[1], p[3] if len(p) > 3 else p[1])],
+        rhs_dilation=d, dimension_numbers=lax.conv_dimension_numbers(
+            x.shape, (1, x.shape[1]) + k, ("NCHW", "OIHW", "NCHW")))
+    n, ckk = patches.shape[:2]
+    return {"Y": patches.reshape(n, ckk, -1)}

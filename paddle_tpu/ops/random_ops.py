@@ -1,0 +1,105 @@
+"""Random ops.
+
+Parity: paddle/fluid/operators/{gaussian_random,uniform_random,truncated_
+gaussian_random,random_crop,sampling_id}_op.* — re-keyed onto JAX's counter
+based PRNG: each op gets a deterministic fold_in of the step key, so runs are
+reproducible per (seed, step, op) — the TPU answer to cuRAND states.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import register
+from .tensor_ops import _np_dtype
+
+
+@register("gaussian_random")
+def gaussian_random(ctx):
+    shape = tuple(ctx.attr("shape"))
+    mean = ctx.attr("mean", 0.0)
+    std = ctx.attr("std", 1.0)
+    dtype = _np_dtype(ctx.attr("dtype", "float32"))
+    return {"Out": mean + std * jax.random.normal(ctx.rng(), shape, dtype=dtype)}
+
+
+@register("uniform_random", "uniform_random_batch_size_like")
+def uniform_random(ctx):
+    if ctx.has_in("Input"):
+        ref = ctx.in_("Input")
+        shape = list(ctx.attr("shape"))
+        shape[ctx.attr("output_dim_idx", 0)] = ref.shape[ctx.attr("input_dim_idx", 0)]
+        shape = tuple(shape)
+    else:
+        shape = tuple(ctx.attr("shape"))
+    lo = ctx.attr("min", -1.0)
+    hi = ctx.attr("max", 1.0)
+    dtype = _np_dtype(ctx.attr("dtype", "float32"))
+    return {"Out": jax.random.uniform(ctx.rng(), shape, dtype=dtype,
+                                      minval=lo, maxval=hi)}
+
+
+@register("gaussian_random_batch_size_like")
+def gaussian_random_batch_size_like(ctx):
+    ref = ctx.in_("Input")
+    shape = list(ctx.attr("shape"))
+    shape[ctx.attr("output_dim_idx", 0)] = ref.shape[ctx.attr("input_dim_idx", 0)]
+    mean = ctx.attr("mean", 0.0)
+    std = ctx.attr("std", 1.0)
+    return {"Out": mean + std * jax.random.normal(ctx.rng(), tuple(shape))}
+
+
+@register("truncated_gaussian_random")
+def truncated_gaussian_random(ctx):
+    shape = tuple(ctx.attr("shape"))
+    mean = ctx.attr("mean", 0.0)
+    std = ctx.attr("std", 1.0)
+    dtype = _np_dtype(ctx.attr("dtype", "float32"))
+    out = jax.random.truncated_normal(ctx.rng(), -2.0, 2.0, shape, dtype=dtype)
+    return {"Out": mean + std * out}
+
+
+@register("randint")
+def randint(ctx):
+    return {"Out": jax.random.randint(ctx.rng(), tuple(ctx.attr("shape")),
+                                      ctx.attr("low", 0), ctx.attr("high"))}
+
+
+@register("sampling_id")
+def sampling_id(ctx):
+    x = ctx.in_("X")  # (N, C) probabilities
+    idx = jax.random.categorical(ctx.rng(), jnp.log(jnp.clip(x, 1e-20, None)), axis=-1)
+    return {"Out": idx.astype(jnp.int64)}
+
+
+@register("random_crop")
+def random_crop(ctx):
+    x = ctx.in_("X")
+    shape = ctx.attr("shape")  # crop shape for trailing dims
+    ndim_crop = len(shape)
+    key = ctx.rng()
+    starts = []
+    for i, s in enumerate(shape):
+        dim = x.shape[x.ndim - ndim_crop + i]
+        k = jax.random.fold_in(key, i)
+        starts.append(jax.random.randint(k, (), 0, dim - s + 1))
+    start_idx = [0] * (x.ndim - ndim_crop) + [int(0)] * ndim_crop
+    full = list(x.shape[:x.ndim - ndim_crop]) + list(shape)
+    dyn_start = [jnp.asarray(0)] * (x.ndim - ndim_crop) + starts
+    out = jax.lax.dynamic_slice(x, dyn_start, full)
+    return {"Out": out}
+
+
+@register("multinomial")
+def multinomial(ctx):
+    x = ctx.in_("X")
+    n = ctx.attr("num_samples", 1)
+    keys = jax.random.split(ctx.rng(), n)
+    logits = jnp.log(jnp.clip(x, 1e-20, None))
+    samples = jnp.stack([jax.random.categorical(k, logits, axis=-1) for k in keys], -1)
+    return {"Out": samples.astype(jnp.int64)}
+
+
+@register("bernoulli")
+def bernoulli(ctx):
+    x = ctx.in_("X")
+    return {"Out": jax.random.bernoulli(ctx.rng(), x).astype(x.dtype)}

@@ -1,0 +1,174 @@
+"""Sequence ops — the LoD-tensor family, re-expressed with static shapes.
+
+Parity: paddle/fluid/operators/sequence_ops/*. The reference encodes ragged
+batches as LoDTensor (flat data + offset table) and every sequence kernel
+walks the offsets. TPU/XLA wants static shapes, so the paddle_tpu convention
+is ``(batch, max_len, ...)`` padded data + an int32 ``Length`` tensor; every
+sequence op takes the lengths and masks. This is the standard JAX treatment
+of raggedness (same trick as flax attention masks).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import register
+
+
+def _mask(lengths, max_len, dtype=jnp.float32):
+    # (B, T) 1/0 validity mask from per-example lengths
+    return (jnp.arange(max_len)[None, :] < lengths.reshape(-1, 1)).astype(dtype)
+
+
+@register("sequence_mask")
+def sequence_mask(ctx):
+    x = ctx.in_("X").reshape(-1)
+    maxlen = ctx.attr("maxlen", -1)
+    if maxlen is None or maxlen < 0:
+        maxlen = int(ctx.attr("static_maxlen", 0)) or int(x.max())
+    from .tensor_ops import _np_dtype
+    dtype = _np_dtype(ctx.attr("out_dtype", "int64"))
+    return {"Y": _mask(x, maxlen, dtype)}
+
+
+@register("sequence_pool")
+def sequence_pool(ctx):
+    x = ctx.in_("X")  # (B, T, D)
+    lengths = ctx.in_("Length")
+    ptype = ctx.attr("pooltype", "AVERAGE").upper()
+    m = _mask(lengths, x.shape[1], x.dtype)[..., None]
+    if ptype == "SUM":
+        out = jnp.sum(x * m, axis=1)
+    elif ptype == "AVERAGE":
+        out = jnp.sum(x * m, axis=1) / jnp.maximum(m.sum(axis=1), 1.0)
+    elif ptype == "SQRT":
+        out = jnp.sum(x * m, axis=1) / jnp.sqrt(jnp.maximum(m.sum(axis=1), 1.0))
+    elif ptype == "MAX":
+        out = jnp.max(jnp.where(m > 0, x, -jnp.inf), axis=1)
+        out = jnp.where(jnp.isfinite(out), out, 0.0)
+    elif ptype == "LAST":
+        idx = jnp.maximum(lengths.reshape(-1) - 1, 0).astype(jnp.int32)
+        out = x[jnp.arange(x.shape[0]), idx]
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise NotImplementedError(f"sequence_pool type {ptype}")
+    return {"Out": out, "MaxIndex": jnp.zeros_like(lengths)}
+
+
+@register("sequence_softmax")
+def sequence_softmax(ctx):
+    x = ctx.in_("X")  # (B, T)
+    lengths = ctx.in_("Length")
+    m = _mask(lengths, x.shape[-1], jnp.bool_)
+    neg = jnp.asarray(-1e9, x.dtype)
+    return {"Out": jax.nn.softmax(jnp.where(m, x, neg), axis=-1) * m.astype(x.dtype)}
+
+
+@register("sequence_reverse")
+def sequence_reverse(ctx):
+    x = ctx.in_("X")  # (B, T, ...)
+    lengths = ctx.in_("Length")
+    t = x.shape[1]
+    idx = jnp.arange(t)[None, :]
+    lens = lengths.reshape(-1, 1)
+    rev = jnp.where(idx < lens, lens - 1 - idx, idx)
+    return {"Y": jnp.take_along_axis(x, rev.reshape(rev.shape + (1,) * (x.ndim - 2)).astype(jnp.int32), axis=1)}
+
+
+@register("sequence_expand")
+def sequence_expand(ctx):
+    x = ctx.in_("X")      # (B, ...) one row per sequence
+    y_len = ctx.in_("YLength")  # (B,) times to repeat each row
+    # Static-shape variant: ref_level expansion with uniform repeat counts.
+    reps = int(ctx.attr("static_repeat", 0))
+    if reps:
+        return {"Out": jnp.repeat(x, reps, axis=0)}
+    # Fallback: mask-weighted gather (requires uniform lengths at trace time)
+    return {"Out": jnp.repeat(x, int(y_len[0]), axis=0)}
+
+
+@register("sequence_pad")
+def sequence_pad(ctx):
+    # In paddle_tpu data is already padded; this validates/returns.
+    x = ctx.in_("X")
+    lengths = ctx.in_("Length")
+    return {"Out": x, "Length": lengths}
+
+
+@register("sequence_unpad")
+def sequence_unpad(ctx):
+    x = ctx.in_("X")
+    lengths = ctx.in_("Length")
+    m = _mask(lengths, x.shape[1], x.dtype)
+    return {"Out": x * m.reshape(m.shape + (1,) * (x.ndim - 2))}
+
+
+@register("sequence_concat")
+def sequence_concat(ctx):
+    return {"Out": jnp.concatenate(ctx.in_list("X"), axis=1)}
+
+
+@register("sequence_slice")
+def sequence_slice(ctx):
+    x = ctx.in_("X")
+    offset = ctx.attr("static_offset", 0)
+    length = ctx.attr("static_length", x.shape[1])
+    return {"Out": jax.lax.dynamic_slice_in_dim(x, offset, length, axis=1)}
+
+
+@register("sequence_conv")
+def sequence_conv(ctx):
+    x = ctx.in_("X")          # (B, T, D)
+    w = ctx.in_("Filter")     # (ctx_len*D, M)
+    ctx_len = ctx.attr("contextLength", 3)
+    ctx_start = ctx.attr("contextStart", -(ctx_len // 2))
+    b, t, d = x.shape
+    cols = []
+    for i in range(ctx_len):
+        shift = ctx_start + i
+        cols.append(jnp.roll(x, -shift, axis=1) *
+                    ((jnp.arange(t) + shift >= 0) & (jnp.arange(t) + shift < t))[None, :, None])
+    ctx_mat = jnp.concatenate(cols, axis=-1)  # (B, T, ctx_len*D)
+    return {"Out": ctx_mat @ w}
+
+
+@register("sequence_enumerate")
+def sequence_enumerate(ctx):
+    x = ctx.in_("X")  # (B, T)
+    win = ctx.attr("win_size")
+    pad = ctx.attr("pad_value", 0)
+    t = x.shape[-1]
+    outs = []
+    for i in range(win):
+        shifted = jnp.roll(x, -i, axis=-1)
+        valid = (jnp.arange(t) + i) < t
+        outs.append(jnp.where(valid, shifted, pad))
+    return {"Out": jnp.stack(outs, axis=-1)}
+
+
+@register("sequence_reshape")
+def sequence_reshape(ctx):
+    x = ctx.in_("X")
+    new_dim = ctx.attr("new_dim")
+    return {"Out": x.reshape(x.shape[0], -1, new_dim)}
+
+
+@register("sequence_expand_as")
+def sequence_expand_as(ctx):
+    x, y = ctx.in_("X"), ctx.in_("Y")
+    reps = y.shape[1] if y.ndim > 1 else 1
+    return {"Out": jnp.repeat(x[:, None], reps, axis=1).reshape((-1,) + x.shape[1:])}
+
+
+@register("row_conv")
+def row_conv(ctx):
+    x = ctx.in_("X")       # (B, T, D)
+    w = ctx.in_("Filter")  # (future_len, D)
+    future = w.shape[0]
+    t = x.shape[1]
+    out = jnp.zeros_like(x)
+    for i in range(future):
+        shifted = jnp.roll(x, -i, axis=1)
+        valid = ((jnp.arange(t) + i) < t)[None, :, None]
+        out = out + shifted * valid * w[i][None, None, :]
+    return {"Out": out}

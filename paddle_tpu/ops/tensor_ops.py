@@ -1,0 +1,415 @@
+"""Tensor manipulation ops.
+
+Parity: paddle/fluid/operators/{concat,split,reshape,transpose,slice,gather,
+scatter,expand,pad,stack,squeeze,unsqueeze,cast,one_hot,top_k,arg_min_max,
+fill_constant,assign,...}_op.cc. Static-shape jnp — all attrs are compile-time
+constants so XLA can tile/fuse freely.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import register
+from ..core.framework import convert_dtype
+
+
+def _np_dtype(d):
+    return {"bool": jnp.bool_}.get(d, jnp.dtype(convert_dtype(d)))
+
+
+@register("cast")
+def cast(ctx):
+    return {"Out": ctx.in_("X").astype(_np_dtype(ctx.attr("out_dtype")))}
+
+
+@register("fill_constant")
+def fill_constant(ctx):
+    shape = ctx.attr("shape", [1])
+    value = ctx.attr("value", 0.0)
+    dtype = _np_dtype(ctx.attr("dtype", "float32"))
+    return {"Out": jnp.full(tuple(shape), value, dtype=dtype)}
+
+
+@register("fill_constant_batch_size_like")
+def fill_constant_batch_size_like(ctx):
+    ref = ctx.in_("Input")
+    shape = list(ctx.attr("shape"))
+    in_idx = ctx.attr("input_dim_idx", 0)
+    out_idx = ctx.attr("output_dim_idx", 0)
+    shape[out_idx] = ref.shape[in_idx]
+    return {"Out": jnp.full(tuple(shape), ctx.attr("value", 0.0),
+                            dtype=_np_dtype(ctx.attr("dtype", "float32")))}
+
+
+@register("assign")
+def assign(ctx):
+    return {"Out": ctx.in_("X")}
+
+
+@register("shape")
+def shape_op(ctx):
+    return {"Out": jnp.asarray(ctx.in_("Input").shape, dtype=jnp.int32)}
+
+
+@register("rank")
+def rank_op(ctx):
+    return {"Out": jnp.asarray(ctx.in_("Input").ndim, dtype=jnp.int32)}
+
+
+@register("size")
+def size_op(ctx):
+    return {"Out": jnp.asarray(ctx.in_("Input").size, dtype=jnp.int64)}
+
+
+@register("concat")
+def concat(ctx):
+    return {"Out": jnp.concatenate(ctx.in_list("X"), axis=ctx.attr("axis", 0))}
+
+
+@register("split")
+def split(ctx):
+    x = ctx.in_("X")
+    axis = ctx.attr("axis", 0)
+    sections = ctx.attr("sections")
+    num = ctx.attr("num")
+    if sections:
+        idx = np.cumsum(sections[:-1]).tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register("reshape", "reshape2")
+def reshape(ctx):
+    x = ctx.in_("X")
+    shape = list(ctx.attr("shape"))
+    # fluid: 0 means copy input dim; -1 inferred.
+    shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape[:x.ndim])] + \
+            [s for s in shape[x.ndim:]]
+    return {"Out": x.reshape(tuple(shape)), "XShape": jnp.zeros((0,) + x.shape)}
+
+
+@register("transpose", "transpose2")
+def transpose(ctx):
+    return {"Out": jnp.transpose(ctx.in_("X"), ctx.attr("axis")),
+            "XShape": jnp.zeros((0,))}
+
+
+@register("flatten", "flatten2")
+def flatten(ctx):
+    x = ctx.in_("X")
+    axis = ctx.attr("axis", 1)
+    lead = 1
+    for s in x.shape[:axis]:
+        lead *= s
+    return {"Out": x.reshape((lead, -1)), "XShape": jnp.zeros((0,))}
+
+
+@register("squeeze", "squeeze2")
+def squeeze(ctx):
+    x = ctx.in_("X")
+    axes = ctx.attr("axes", [])
+    if axes:
+        axes = tuple(a % x.ndim for a in axes if x.shape[a % x.ndim] == 1)
+        out = jnp.squeeze(x, axis=axes) if axes else x
+    else:
+        out = jnp.squeeze(x)
+    return {"Out": out, "XShape": jnp.zeros((0,))}
+
+
+@register("unsqueeze", "unsqueeze2")
+def unsqueeze(ctx):
+    x = ctx.in_("X")
+    for a in sorted(ctx.attr("axes")):
+        x = jnp.expand_dims(x, a)
+    return {"Out": x, "XShape": jnp.zeros((0,))}
+
+
+@register("stack")
+def stack(ctx):
+    return {"Y": jnp.stack(ctx.in_list("X"), axis=ctx.attr("axis", 0))}
+
+
+@register("unstack")
+def unstack(ctx):
+    x = ctx.in_("X")
+    axis = ctx.attr("axis", 0)
+    n = x.shape[axis]
+    return {"Y": [jnp.squeeze(a, axis) for a in jnp.split(x, n, axis=axis)]}
+
+
+@register("slice")
+def slice_op(ctx):
+    x = ctx.in_("Input")
+    axes = ctx.attr("axes")
+    starts = ctx.attr("starts")
+    ends = ctx.attr("ends")
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    return {"Out": x[tuple(idx)]}
+
+
+@register("strided_slice")
+def strided_slice(ctx):
+    x = ctx.in_("Input")
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(ctx.attr("axes"), ctx.attr("starts"),
+                           ctx.attr("ends"), ctx.attr("strides")):
+        idx[a] = slice(s, e, st)
+    return {"Out": x[tuple(idx)]}
+
+
+@register("gather")
+def gather(ctx):
+    x, idx = ctx.in_("X"), ctx.in_("Index")
+    return {"Out": jnp.take(x, idx.reshape(-1).astype(jnp.int32), axis=0)}
+
+
+@register("gather_nd")
+def gather_nd(ctx):
+    x, idx = ctx.in_("X"), ctx.in_("Index")
+    idx = idx.astype(jnp.int32)
+    k = idx.shape[-1]
+    out = x[tuple(jnp.moveaxis(idx, -1, 0))] if k == x.ndim else \
+        x[tuple(jnp.moveaxis(idx, -1, 0))]
+    return {"Out": out}
+
+
+@register("scatter")
+def scatter(ctx):
+    x, idx, upd = ctx.in_("X"), ctx.in_("Ids"), ctx.in_("Updates")
+    idx = idx.reshape(-1).astype(jnp.int32)
+    if ctx.attr("overwrite", True):
+        return {"Out": x.at[idx].set(upd)}
+    return {"Out": x.at[idx].add(upd)}
+
+
+@register("scatter_nd_add")
+def scatter_nd_add(ctx):
+    x, idx, upd = ctx.in_("X"), ctx.in_("Index"), ctx.in_("Updates")
+    idx = idx.astype(jnp.int32)
+    return {"Out": x.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)}
+
+
+@register("expand")
+def expand(ctx):
+    x = ctx.in_("X")
+    times = ctx.attr("expand_times")
+    return {"Out": jnp.tile(x, tuple(times))}
+
+
+@register("expand_as")
+def expand_as(ctx):
+    x, y = ctx.in_("X"), ctx.in_("target_tensor")
+    times = [t // s for s, t in zip(x.shape, y.shape)]
+    return {"Out": jnp.tile(x, tuple(times))}
+
+
+@register("tile")
+def tile(ctx):
+    return {"Out": jnp.tile(ctx.in_("X"), tuple(ctx.attr("repeat_times")))}
+
+
+@register("pad")
+def pad(ctx):
+    x = ctx.in_("X")
+    p = ctx.attr("paddings")
+    pairs = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": jnp.pad(x, pairs, constant_values=ctx.attr("pad_value", 0.0))}
+
+
+@register("pad2d")
+def pad2d(ctx):
+    x = ctx.in_("X")  # NCHW
+    p = ctx.attr("paddings", [0, 0, 0, 0])  # top,bottom,left,right
+    mode = ctx.attr("mode", "constant")
+    pairs = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if ctx.attr("data_format", "NCHW") == "NHWC":
+        pairs = [(0, 0), (p[0], p[1]), (p[2], p[3]), (0, 0)]
+    jmode = {"constant": "constant", "reflect": "reflect", "edge": "edge"}[mode]
+    kw = {"constant_values": ctx.attr("pad_value", 0.0)} if mode == "constant" else {}
+    return {"Out": jnp.pad(x, pairs, mode=jmode, **kw)}
+
+
+@register("pad_constant_like")
+def pad_constant_like(ctx):
+    x, y = ctx.in_("X"), ctx.in_("Y")
+    pairs = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return {"Out": jnp.pad(y, pairs, constant_values=ctx.attr("pad_value", 0.0))}
+
+
+@register("one_hot", "one_hot_v2")
+def one_hot(ctx):
+    x = ctx.in_("X")
+    depth = ctx.attr("depth")
+    x = x.reshape(x.shape[:-1]) if x.shape and x.shape[-1] == 1 else x
+    return {"Out": jax.nn.one_hot(x.astype(jnp.int32), depth, dtype=jnp.float32)}
+
+
+@register("top_k")
+def top_k(ctx):
+    x = ctx.in_("X")
+    k = ctx.attr("k", 1)
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+
+
+@register("arg_max")
+def arg_max(ctx):
+    return {"Out": jnp.argmax(ctx.in_("X"), axis=ctx.attr("axis", -1)).astype(jnp.int64)}
+
+
+@register("arg_min")
+def arg_min(ctx):
+    return {"Out": jnp.argmin(ctx.in_("X"), axis=ctx.attr("axis", -1)).astype(jnp.int64)}
+
+
+@register("argsort")
+def argsort(ctx):
+    x = ctx.in_("X")
+    axis = ctx.attr("axis", -1)
+    descending = ctx.attr("descending", False)
+    idx = jnp.argsort(-x if descending else x, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": out, "Indices": idx.astype(jnp.int64)}
+
+
+@register("where")
+def where(ctx):
+    # fluid.layers.where(cond) -> indices of true (static upper bound: all)
+    cond = ctx.in_("Condition")
+    n = cond.size
+    idx = jnp.nonzero(cond.reshape(-1), size=n, fill_value=-1)[0]
+    return {"Out": idx.reshape(-1, 1).astype(jnp.int64)}
+
+
+@register("where_index_select", "select")
+def select(ctx):
+    return {"Out": jnp.where(ctx.in_("Condition"), ctx.in_("X"), ctx.in_("Y"))}
+
+
+@register("reverse")
+def reverse(ctx):
+    x = ctx.in_("X")
+    return {"Out": jnp.flip(x, axis=tuple(a % x.ndim for a in ctx.attr("axis")))}
+
+
+@register("linspace")
+def linspace(ctx):
+    return {"Out": jnp.linspace(ctx.attr("start"), ctx.attr("stop"),
+                                ctx.attr("num"),
+                                dtype=_np_dtype(ctx.attr("dtype", "float32")))}
+
+
+@register("range")
+def range_op(ctx):
+    return {"Out": jnp.arange(ctx.attr("start"), ctx.attr("end"),
+                              ctx.attr("step"),
+                              dtype=_np_dtype(ctx.attr("dtype", "float32")))}
+
+
+@register("eye")
+def eye(ctx):
+    return {"Out": jnp.eye(ctx.attr("num_rows"), ctx.attr("num_columns"),
+                           dtype=_np_dtype(ctx.attr("dtype", "float32")))}
+
+
+@register("diag")
+def diag(ctx):
+    return {"Out": jnp.diag(ctx.in_("Diagonal"))}
+
+
+@register("zeros_like", "fill_zeros_like")
+def zeros_like(ctx):
+    return {"Out": jnp.zeros_like(ctx.in_("X"))}
+
+
+@register("ones_like", "fill_any_like")
+def ones_like(ctx):
+    return {"Out": jnp.full_like(ctx.in_("X"), ctx.attr("value", 1.0))}
+
+
+@register("lookup_table", "lookup_table_v2", "embedding")
+def lookup_table(ctx):
+    w = ctx.in_("W")
+    ids = ctx.in_("Ids").astype(jnp.int32)
+    if ids.shape and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    padding_idx = ctx.attr("padding_idx", -1)
+    out = jnp.take(w, jnp.clip(ids, 0, w.shape[0] - 1), axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return {"Out": out}
+
+
+@register("label_smooth")
+def label_smooth(ctx):
+    x = ctx.in_("X")
+    eps = ctx.attr("epsilon", 0.1)
+    if ctx.has_in("PriorDist"):
+        prior = ctx.in_("PriorDist")
+        return {"Out": (1 - eps) * x + eps * prior}
+    return {"Out": (1 - eps) * x + eps / x.shape[-1]}
+
+
+@register("unique_with_counts", "unique")
+def unique(ctx):
+    x = ctx.in_("X")
+    n = x.size
+    out, idx, counts = jnp.unique(x, return_inverse=True, return_counts=True,
+                                  size=n, fill_value=0)
+    return {"Out": out, "Index": idx.astype(jnp.int32),
+            "Count": counts.astype(jnp.int32)}
+
+
+@register("shard_index")
+def shard_index(ctx):
+    x = ctx.in_("X")
+    index_num = ctx.attr("index_num")
+    nshards = ctx.attr("nshards")
+    shard_id = ctx.attr("shard_id")
+    ignore_value = ctx.attr("ignore_value", -1)
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return {"Out": jnp.where(in_shard, x % shard_size, ignore_value)}
+
+
+@register("space_to_depth")
+def space_to_depth(ctx):
+    x = ctx.in_("X")  # NCHW
+    bs = ctx.attr("blocksize")
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // bs, bs, w // bs, bs)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return {"Out": x.reshape(n, c * bs * bs, h // bs, w // bs)}
+
+
+@register("pixel_shuffle")
+def pixel_shuffle(ctx):
+    x = ctx.in_("X")  # NCHW
+    r = ctx.attr("upscale_factor")
+    n, c, h, w = x.shape
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = x.transpose(0, 1, 4, 2, 5, 3)
+    return {"Out": x.reshape(n, c // (r * r), h * r, w * r)}
+
+
+@register("shuffle_channel")
+def shuffle_channel(ctx):
+    x = ctx.in_("X")
+    g = ctx.attr("group")
+    n, c, h, w = x.shape
+    return {"Out": x.reshape(n, g, c // g, h, w).swapaxes(1, 2).reshape(n, c, h, w)}
+
+
+@register("assign_value")
+def assign_value(ctx):
+    vals = jnp.asarray(ctx.attr("values"), dtype=_np_dtype(ctx.attr("dtype", "float32")))
+    return {"Out": vals.reshape(tuple(ctx.attr("shape")))}

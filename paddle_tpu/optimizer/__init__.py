@@ -1,0 +1,20 @@
+"""Optimizers package.
+
+Parity: python/paddle/fluid/optimizer.py + regularizer.py + clip.py.
+"""
+
+from .optimizers import (Optimizer, SGDOptimizer, MomentumOptimizer,
+                         LarsMomentumOptimizer, AdagradOptimizer,
+                         DecayedAdagradOptimizer, AdadeltaOptimizer,
+                         AdamOptimizer, AdamaxOptimizer, RMSPropOptimizer,
+                         FtrlOptimizer, LambOptimizer,
+                         SGD, Momentum, Adagrad, Adam, Adamax, RMSProp,
+                         Ftrl, Lamb)
+from .wrappers import (ExponentialMovingAverage, ModelAverage,
+                       LookaheadOptimizer)
+from .regularizer import (L1Decay, L2Decay, L1DecayRegularizer,
+                          L2DecayRegularizer, WeightDecayRegularizer)
+from . import clip
+from .clip import (GradientClipByValue, GradientClipByNorm,
+                   GradientClipByGlobalNorm, ErrorClipByValue,
+                   set_gradient_clip)

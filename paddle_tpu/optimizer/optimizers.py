@@ -1,0 +1,586 @@
+"""Optimizers (declarative API).
+
+Parity: python/paddle/fluid/optimizer.py. Each optimizer appends its update
+ops after the backward marker; the Executor jits forward+backward+update into
+one XLA program, and accumulators are persistable Scope vars (fluid
+semantics) updated functionally in HBM.
+"""
+
+import numpy as np
+
+from ..core import unique_name
+from ..core.framework import (Variable, Parameter, default_main_program,
+                              default_startup_program, program_guard,
+                              grad_var_name)
+from ..core.backward import append_backward
+from ..core.layer_helper import LayerHelper
+from .. import initializer as init_mod
+from .regularizer import append_regularization_ops
+from .clip import append_gradient_clip_ops, ErrorClipByValue
+
+
+class Optimizer:
+    """Base. Parity: fluid.optimizer.Optimizer."""
+
+    def __init__(self, learning_rate, regularization=None, name=None):
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self._name = name
+        self._accumulators = {}  # acc_name -> {param_name: var}
+        self._lr_var = None
+        self.type = getattr(self, "type", "optimizer")
+
+    # -- learning rate ------------------------------------------------------
+    def _create_global_learning_rate(self, program):
+        if isinstance(self._learning_rate, Variable):
+            self._lr_var = self._learning_rate
+            return
+        if self._lr_var is not None:
+            return
+        helper = LayerHelper("learning_rate")
+        name = unique_name.generate("learning_rate")
+        self._lr_var = helper.create_global_variable(
+            persistable=True, name=name, shape=(), dtype="float32")
+        self._lr_var.stop_gradient = True
+        init_mod.ConstantInitializer(float(self._learning_rate))(self._lr_var)
+
+    def _global_learning_rate(self):
+        return self._lr_var
+
+    current_step_lr = _global_learning_rate
+
+    def set_lr(self, value):
+        """Update the LR scope var between steps (dygraph/static parity)."""
+        from ..core.executor import global_scope
+        import jax.numpy as jnp
+        if self._lr_var is not None:
+            global_scope().set(self._lr_var.name, jnp.asarray(float(value)))
+
+    # -- accumulators -------------------------------------------------------
+    def _add_accumulator(self, name, param, fill_value=0.0, shape=None,
+                         dtype="float32"):
+        if name not in self._accumulators:
+            self._accumulators[name] = {}
+        if param.name in self._accumulators[name]:
+            return self._accumulators[name][param.name]
+        helper = LayerHelper("accumulator")
+        var = helper.create_global_variable(
+            persistable=True,
+            name=unique_name.generate(f"{param.name}_{name}"),
+            shape=shape if shape is not None else param.shape, dtype=dtype)
+        var.stop_gradient = True
+        init_mod.ConstantInitializer(float(fill_value))(var)
+        self._accumulators[name][param.name] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # -- core ---------------------------------------------------------------
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _finish_update(self, block, params_grads):
+        pass
+
+    def _param_lr(self, param_and_grad):
+        param = param_and_grad[0]
+        lr_scale = param.optimize_attr.get("learning_rate", 1.0)
+        if lr_scale == 1.0:
+            return self._lr_var
+        from ..layers import nn as nn_layers
+        return nn_layers.scale(self._lr_var, scale=float(lr_scale))
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        with program_guard(loss.block.program,
+                           startup_program or default_startup_program()):
+            return append_backward(loss, parameter_list, no_grad_set)
+
+    def apply_gradients(self, params_grads):
+        program = params_grads[0][0].block.program
+        block = program.global_block()
+        params_grads = append_gradient_clip_ops(params_grads)
+        params_grads = append_regularization_ops(params_grads,
+                                                 self.regularization)
+        self._create_accumulators(block, [p for p, _ in params_grads])
+        optimize_ops = []
+        for pg in params_grads:
+            optimize_ops.append(self._append_optimize_op(block, pg))
+        self._finish_update(block, params_grads)
+        return optimize_ops
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        with program_guard(loss.block.program,
+                           startup_program or default_startup_program()):
+            self._create_global_learning_rate(loss.block.program)
+            return self.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, grad_clip=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        optimize_ops = self.apply_optimize(loss, startup_program, params_grads)
+        return optimize_ops, params_grads
+
+
+class SGDOptimizer(Optimizer):
+    type = "sgd"
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "sgd",
+            {"Param": p, "Grad": g, "LearningRate": self._param_lr(param_and_grad)},
+            {"ParamOut": p})
+
+
+class MomentumOptimizer(Optimizer):
+    type = "momentum"
+
+    def __init__(self, learning_rate, momentum, use_nesterov=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "momentum",
+            {"Param": p, "Grad": g, "Velocity": v,
+             "LearningRate": self._param_lr(param_and_grad)},
+            {"ParamOut": p, "VelocityOut": v},
+            {"mu": self._momentum, "use_nesterov": self._use_nesterov})
+
+
+class LarsMomentumOptimizer(Optimizer):
+    type = "lars_momentum"
+
+    def __init__(self, learning_rate, momentum, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "lars_momentum",
+            {"Param": p, "Grad": g, "Velocity": v,
+             "LearningRate": self._param_lr(param_and_grad)},
+            {"ParamOut": p, "VelocityOut": v},
+            {"mu": self._momentum, "lars_coeff": self._lars_coeff,
+             "lars_weight_decay": self._lars_weight_decay})
+
+
+class AdagradOptimizer(Optimizer):
+    type = "adagrad"
+
+    def __init__(self, learning_rate, epsilon=1e-6, initial_accumulator_value=0.0,
+                 **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p, fill_value=self._initial)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            "adagrad",
+            {"Param": p, "Grad": g, "Moment": m,
+             "LearningRate": self._param_lr(param_and_grad)},
+            {"ParamOut": p, "MomentOut": m}, {"epsilon": self._epsilon})
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    type = "decayed_adagrad"
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._decay = decay
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            "decayed_adagrad",
+            {"Param": p, "Grad": g, "Moment": m,
+             "LearningRate": self._param_lr(param_and_grad)},
+            {"ParamOut": p, "MomentOut": m},
+            {"decay": self._decay, "epsilon": self._epsilon})
+
+
+class AdadeltaOptimizer(Optimizer):
+    type = "adadelta"
+
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("avg_squared_grad", p)
+            self._add_accumulator("avg_squared_update", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "adadelta",
+            {"Param": p, "Grad": g,
+             "AvgSquaredGrad": self._get_accumulator("avg_squared_grad", p),
+             "AvgSquaredUpdate": self._get_accumulator("avg_squared_update", p)},
+            {"ParamOut": p,
+             "AvgSquaredGradOut": self._get_accumulator("avg_squared_grad", p),
+             "AvgSquaredUpdateOut": self._get_accumulator("avg_squared_update", p)},
+            {"rho": self._rho, "epsilon": self._epsilon})
+
+
+class AdamOptimizer(Optimizer):
+    type = "adam"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_mode=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1,
+                                  shape=())
+            self._add_accumulator("beta2_pow_acc", p, fill_value=self._beta2,
+                                  shape=())
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "adam",
+            {"Param": p, "Grad": g,
+             "Moment1": self._get_accumulator("moment1", p),
+             "Moment2": self._get_accumulator("moment2", p),
+             "Beta1Pow": self._get_accumulator("beta1_pow_acc", p),
+             "Beta2Pow": self._get_accumulator("beta2_pow_acc", p),
+             "LearningRate": self._param_lr(param_and_grad)},
+            {"ParamOut": p,
+             "Moment1Out": self._get_accumulator("moment1", p),
+             "Moment2Out": self._get_accumulator("moment2", p),
+             "Beta1PowOut": self._get_accumulator("beta1_pow_acc", p),
+             "Beta2PowOut": self._get_accumulator("beta2_pow_acc", p)},
+            {"beta1": self._beta1, "beta2": self._beta2,
+             "epsilon": self._epsilon})
+
+
+class AdamaxOptimizer(Optimizer):
+    type = "adamax"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1,
+                                  shape=())
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "adamax",
+            {"Param": p, "Grad": g,
+             "Moment": self._get_accumulator("moment", p),
+             "InfNorm": self._get_accumulator("inf_norm", p),
+             "Beta1Pow": self._get_accumulator("beta1_pow_acc", p),
+             "LearningRate": self._param_lr(param_and_grad)},
+            {"ParamOut": p,
+             "MomentOut": self._get_accumulator("moment", p),
+             "InfNormOut": self._get_accumulator("inf_norm", p),
+             "Beta1PowOut": self._get_accumulator("beta1_pow_acc", p)},
+            {"beta1": self._beta1, "beta2": self._beta2,
+             "epsilon": self._epsilon})
+
+
+class RMSPropOptimizer(Optimizer):
+    type = "rmsprop"
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("moment", p)
+            if self._centered:
+                self._add_accumulator("mean_grad", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        inputs = {"Param": p, "Grad": g,
+                  "MeanSquare": self._get_accumulator("mean_square", p),
+                  "Moment": self._get_accumulator("moment", p),
+                  "LearningRate": self._param_lr(param_and_grad)}
+        outputs = {"ParamOut": p,
+                   "MeanSquareOut": self._get_accumulator("mean_square", p),
+                   "MomentOut": self._get_accumulator("moment", p)}
+        if self._centered:
+            inputs["MeanGrad"] = self._get_accumulator("mean_grad", p)
+            outputs["MeanGradOut"] = self._get_accumulator("mean_grad", p)
+        return block.append_op(
+            "rmsprop", inputs, outputs,
+            {"decay": self._rho, "epsilon": self._epsilon,
+             "momentum": self._momentum, "centered": self._centered})
+
+
+class FtrlOptimizer(Optimizer):
+    type = "ftrl"
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._l1 = l1
+        self._l2 = l2
+        self._lr_power = lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "ftrl",
+            {"Param": p, "Grad": g,
+             "SquaredAccumulator": self._get_accumulator("squared", p),
+             "LinearAccumulator": self._get_accumulator("linear", p),
+             "LearningRate": self._param_lr(param_and_grad)},
+            {"ParamOut": p,
+             "SquaredAccumOut": self._get_accumulator("squared", p),
+             "LinearAccumOut": self._get_accumulator("linear", p)},
+            {"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power})
+
+
+class LambOptimizer(AdamOptimizer):
+    type = "lamb"
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6,
+                 exclude_from_weight_decay_fn=None, **kwargs):
+        super().__init__(learning_rate, beta1, beta2, epsilon, **kwargs)
+        self._weight_decay = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        wd = self._weight_decay
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        return block.append_op(
+            "lamb",
+            {"Param": p, "Grad": g,
+             "Moment1": self._get_accumulator("moment1", p),
+             "Moment2": self._get_accumulator("moment2", p),
+             "Beta1Pow": self._get_accumulator("beta1_pow_acc", p),
+             "Beta2Pow": self._get_accumulator("beta2_pow_acc", p),
+             "LearningRate": self._param_lr(param_and_grad)},
+            {"ParamOut": p,
+             "Moment1Out": self._get_accumulator("moment1", p),
+             "Moment2Out": self._get_accumulator("moment2", p),
+             "Beta1PowOut": self._get_accumulator("beta1_pow_acc", p),
+             "Beta2PowOut": self._get_accumulator("beta2_pow_acc", p)},
+            {"beta1": self._beta1, "beta2": self._beta2,
+             "epsilon": self._epsilon, "weight_decay": wd})
+
+
+# 2.x-style aliases
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+Adamax = AdamaxOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer
+
+
+# ---------------------------------------------------------------------------
+# Dygraph (eager) path — parity with fluid dygraph optimizer.minimize(loss):
+# reuses the SAME ops-registry update kernels via a MiniCtx shim, with
+# accumulators held per-parameter on the optimizer instance.
+# ---------------------------------------------------------------------------
+
+_EAGER_SPECS = {
+    "sgd": {"accs": {}, "outs": {"ParamOut": None}},
+    "momentum": {"accs": {"Velocity": ("velocity", 0.0)},
+                 "outs": {"ParamOut": None, "VelocityOut": "Velocity"}},
+    "lars_momentum": {"accs": {"Velocity": ("velocity", 0.0)},
+                      "outs": {"ParamOut": None, "VelocityOut": "Velocity"}},
+    "adagrad": {"accs": {"Moment": ("moment", 0.0)},
+                "outs": {"ParamOut": None, "MomentOut": "Moment"}},
+    "decayed_adagrad": {"accs": {"Moment": ("moment", 0.0)},
+                        "outs": {"ParamOut": None, "MomentOut": "Moment"}},
+    "adadelta": {"accs": {"AvgSquaredGrad": ("asg", 0.0),
+                          "AvgSquaredUpdate": ("asu", 0.0)},
+                 "outs": {"ParamOut": None, "AvgSquaredGradOut": "AvgSquaredGrad",
+                          "AvgSquaredUpdateOut": "AvgSquaredUpdate"}},
+    "adam": {"accs": {"Moment1": ("m1", 0.0), "Moment2": ("m2", 0.0),
+                      "Beta1Pow": ("b1p", "beta1"), "Beta2Pow": ("b2p", "beta2")},
+             "outs": {"ParamOut": None, "Moment1Out": "Moment1",
+                      "Moment2Out": "Moment2", "Beta1PowOut": "Beta1Pow",
+                      "Beta2PowOut": "Beta2Pow"}},
+    "lamb": {"accs": {"Moment1": ("m1", 0.0), "Moment2": ("m2", 0.0),
+                      "Beta1Pow": ("b1p", "beta1"), "Beta2Pow": ("b2p", "beta2")},
+             "outs": {"ParamOut": None, "Moment1Out": "Moment1",
+                      "Moment2Out": "Moment2", "Beta1PowOut": "Beta1Pow",
+                      "Beta2PowOut": "Beta2Pow"}},
+    "adamax": {"accs": {"Moment": ("m", 0.0), "InfNorm": ("inf", 0.0),
+                        "Beta1Pow": ("b1p", "beta1")},
+               "outs": {"ParamOut": None, "MomentOut": "Moment",
+                        "InfNormOut": "InfNorm", "Beta1PowOut": "Beta1Pow"}},
+    "rmsprop": {"accs": {"MeanSquare": ("ms", 0.0), "Moment": ("mom", 0.0)},
+                "outs": {"ParamOut": None, "MeanSquareOut": "MeanSquare",
+                         "MomentOut": "Moment"}},
+    "ftrl": {"accs": {"SquaredAccumulator": ("sq", 0.0),
+                      "LinearAccumulator": ("lin", 0.0)},
+             "outs": {"ParamOut": None, "SquaredAccumOut": "SquaredAccumulator",
+                      "LinearAccumOut": "LinearAccumulator"}},
+}
+
+
+def _eager_op_attrs(opt):
+    t = opt.type
+    if t == "sgd":
+        return {}
+    if t in ("momentum",):
+        return {"mu": opt._momentum, "use_nesterov": opt._use_nesterov}
+    if t == "lars_momentum":
+        return {"mu": opt._momentum, "lars_coeff": opt._lars_coeff,
+                "lars_weight_decay": opt._lars_weight_decay}
+    if t == "adagrad":
+        return {"epsilon": opt._epsilon}
+    if t == "decayed_adagrad":
+        return {"decay": opt._decay, "epsilon": opt._epsilon}
+    if t == "adadelta":
+        return {"rho": opt._rho, "epsilon": opt._epsilon}
+    if t in ("adam",):
+        return {"beta1": opt._beta1, "beta2": opt._beta2,
+                "epsilon": opt._epsilon}
+    if t == "lamb":
+        return {"beta1": opt._beta1, "beta2": opt._beta2,
+                "epsilon": opt._epsilon, "weight_decay": opt._weight_decay}
+    if t == "adamax":
+        return {"beta1": opt._beta1, "beta2": opt._beta2,
+                "epsilon": opt._epsilon}
+    if t == "rmsprop":
+        return {"decay": opt._rho, "epsilon": opt._epsilon,
+                "momentum": opt._momentum, "centered": opt._centered}
+    if t == "ftrl":
+        return {"l1": opt._l1, "l2": opt._l2, "lr_power": opt._lr_power}
+    raise NotImplementedError(f"eager update for {t}")
+
+
+def _dygraph_minimize(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None, grad_clip=None):
+    import jax.numpy as jnp
+    from ..dygraph.functional import MiniCtx
+    from ..dygraph.base import current_tape
+    from .. import ops as ops_registry
+
+    if not hasattr(self, "_eager_state"):
+        self._eager_state = {}
+    spec = _EAGER_SPECS[self.type]
+    attrs = _eager_op_attrs(self)
+    impl = ops_registry.get(self.type)
+    lr = self._learning_rate() if callable(self._learning_rate) \
+        else float(self._learning_rate)
+
+    if parameter_list is None:
+        # all leaf params touched by the tape that hold grads
+        tape = current_tape()
+        seen = {}
+        if tape is not None:
+            for fn, args, kwargs, out in tape.entries:
+                for kind, v in args:
+                    if kind == "v" and v.is_leaf and v._grad is not None:
+                        seen[v.id] = v
+        parameter_list = list(seen.values())
+
+    for p in parameter_list:
+        g = p._grad
+        if g is None:
+            continue
+        if self.regularization is not None or getattr(p, "regularizer", None):
+            reg = getattr(p, "regularizer", None) or self.regularization
+            from .regularizer import L2DecayRegularizer, L1DecayRegularizer
+            if isinstance(reg, L2DecayRegularizer):
+                g = g + reg._coeff * p.value
+            elif isinstance(reg, L1DecayRegularizer):
+                g = g + reg._coeff * jnp.sign(p.value)
+        state = self._eager_state.setdefault(p.id, {})
+        ins = {"Param": p.value, "Grad": g,
+               "LearningRate": jnp.asarray(lr, jnp.float32)}
+        for slot, (key, fill) in spec["accs"].items():
+            if key not in state:
+                if isinstance(fill, str):  # beta power seeded with beta value
+                    state[key] = jnp.asarray(attrs[fill], jnp.float32)
+                else:
+                    state[key] = jnp.full(p.value.shape, fill, jnp.float32) \
+                        if slot not in ("Beta1Pow", "Beta2Pow") \
+                        else jnp.asarray(fill, jnp.float32)
+            ins[slot] = state[key]
+        outs = impl(MiniCtx(ins, attrs))
+        p.value = outs["ParamOut"]
+        for out_slot, in_slot in spec["outs"].items():
+            if in_slot is not None and out_slot in outs:
+                key = spec["accs"][in_slot][0]
+                state[key] = outs[out_slot]
+    return None, None
+
+
+def _minimize_dispatch(self, loss, startup_program=None, parameter_list=None,
+                       no_grad_set=None, grad_clip=None):
+    from ..core.framework import in_dygraph_mode
+    if in_dygraph_mode():
+        return _dygraph_minimize(self, loss, startup_program, parameter_list,
+                                 no_grad_set, grad_clip)
+    return Optimizer._static_minimize(self, loss, startup_program,
+                                      parameter_list, no_grad_set, grad_clip)
+
+
+Optimizer._static_minimize = Optimizer.minimize
+Optimizer.minimize = _minimize_dispatch

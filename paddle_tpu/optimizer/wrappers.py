@@ -1,0 +1,199 @@
+"""Optimizer wrappers: EMA / ModelAverage / Lookahead.
+
+Parity: fluid.optimizer.{ExponentialMovingAverage, ModelAverage,
+LookaheadOptimizer}. State lives in persistable Scope vars; the periodic
+Lookahead sync is a branch-free select on a step counter (TPU-friendly —
+no host round-trip, stays inside the jitted step).
+"""
+
+import contextlib
+
+import numpy as np
+
+from ..core import unique_name
+from ..core.framework import default_main_program, grad_var_name
+from ..core.layer_helper import LayerHelper
+from ..core.executor import global_scope
+from .. import initializer as init_mod
+from .optimizers import Optimizer
+
+
+class ExponentialMovingAverage:
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._name = name or ""
+        self._ema_vars = {}
+        self._params = []
+
+    def update(self):
+        """Append EMA update ops for every trainable param (call after
+        optimizer.minimize, fluid parity)."""
+        helper = LayerHelper("ema")
+        program = default_main_program()
+        block = program.global_block()
+        for p in program.all_parameters():
+            if not p.trainable:
+                continue
+            ema = helper.create_global_variable(
+                persistable=True,
+                name=unique_name.generate(p.name + ".ema"),
+                shape=p.shape, dtype=p.dtype)
+            ema.stop_gradient = True
+            init_mod.ConstantInitializer(0.0)(ema)
+            self._ema_vars[p.name] = ema.name
+            self._params.append(p)
+            # ema = decay*ema + (1-decay)*p
+            scaled = helper.create_variable_for_type_inference(p.dtype, p.shape)
+            block.append_op("scale", {"X": ema}, {"Out": scaled},
+                            {"scale": self._decay})
+            contrib = helper.create_variable_for_type_inference(p.dtype, p.shape)
+            block.append_op("scale", {"X": p}, {"Out": contrib},
+                            {"scale": 1.0 - self._decay})
+            block.append_op("elementwise_add", {"X": scaled, "Y": contrib},
+                            {"Out": ema}, {"axis": -1})
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        scope = global_scope()
+        backup = {}
+        for p in self._params:
+            ema_name = self._ema_vars[p.name]
+            if scope.get(ema_name) is None or scope.get(p.name) is None:
+                continue
+            backup[p.name] = scope.get(p.name)
+            scope.set(p.name, scope.get(ema_name))
+        try:
+            yield
+        finally:
+            if need_restore:
+                for name, val in backup.items():
+                    scope.set(name, val)
+
+    restore = apply
+
+
+class ModelAverage:
+    """Parity: fluid.optimizer.ModelAverage — running average of params."""
+
+    def __init__(self, average_window_rate=0.15, min_average_window=10000,
+                 max_average_window=10000, **kwargs):
+        self._sums = {}
+        self._count_name = unique_name.generate("model_average_count")
+        self._params = []
+        helper = LayerHelper("model_average")
+        program = default_main_program()
+        block = program.global_block()
+        cnt = helper.create_global_variable(persistable=True,
+                                            name=self._count_name, shape=(),
+                                            dtype="float32")
+        cnt.stop_gradient = True
+        init_mod.ConstantInitializer(0.0)(cnt)
+        block.append_op("increment", {"X": cnt}, {"Out": cnt}, {"step": 1.0})
+        for p in program.all_parameters():
+            if not p.trainable:
+                continue
+            s = helper.create_global_variable(
+                persistable=True, name=unique_name.generate(p.name + ".sum"),
+                shape=p.shape, dtype=p.dtype)
+            s.stop_gradient = True
+            init_mod.ConstantInitializer(0.0)(s)
+            block.append_op("elementwise_add", {"X": s, "Y": p}, {"Out": s},
+                            {"axis": -1})
+            self._sums[p.name] = s.name
+            self._params.append(p)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        import jax.numpy as jnp
+        scope = global_scope()
+        backup = {}
+        cnt = np.maximum(np.asarray(scope.get(self._count_name)), 1.0)
+        for p in self._params:
+            if scope.get(self._sums[p.name]) is None:
+                continue
+            backup[p.name] = scope.get(p.name)
+            scope.set(p.name, scope.get(self._sums[p.name]) / cnt)
+        try:
+            yield
+        finally:
+            if need_restore:
+                for name, val in backup.items():
+                    scope.set(name, val)
+
+    def restore(self, executor=None):
+        pass
+
+
+class LookaheadOptimizer:
+    """Parity: fluid.optimizer.LookaheadOptimizer (k-step slow/fast sync)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+
+    def minimize(self, loss, startup_program=None):
+        opt_ops, params_grads = self.inner_optimizer.minimize(
+            loss, startup_program)
+        helper = LayerHelper("lookahead")
+        program = loss.block.program
+        block = program.global_block()
+        cnt = helper.create_global_variable(
+            persistable=True, name=unique_name.generate("lookahead_step"),
+            shape=(), dtype="float32")
+        cnt.stop_gradient = True
+        init_mod.ConstantInitializer(0.0)(cnt)
+        block.append_op("increment", {"X": cnt}, {"Out": cnt}, {"step": 1.0})
+        # sync = (cnt mod k == 0) as float
+        modk = helper.create_variable_for_type_inference("float32", ())
+        kconst = helper.create_variable_for_type_inference("float32", ())
+        block.append_op("fill_constant", {}, {"Out": kconst},
+                        {"shape": [], "dtype": "float32", "value": float(self.k)})
+        block.append_op("elementwise_mod", {"X": cnt, "Y": kconst},
+                        {"Out": modk}, {"axis": -1})
+        zero = helper.create_variable_for_type_inference("float32", ())
+        block.append_op("fill_constant", {}, {"Out": zero},
+                        {"shape": [], "dtype": "float32", "value": 0.0})
+        sync_b = helper.create_variable_for_type_inference("bool", ())
+        block.append_op("equal", {"X": modk, "Y": zero}, {"Out": sync_b})
+        sync = helper.create_variable_for_type_inference("float32", ())
+        block.append_op("cast", {"X": sync_b}, {"Out": sync},
+                        {"out_dtype": "float32"})
+        for p, _ in params_grads:
+            slow = helper.create_global_variable(
+                persistable=True, name=unique_name.generate(p.name + ".slow"),
+                shape=p.shape, dtype=p.dtype)
+            slow.stop_gradient = True
+            init_mod.ConstantInitializer(0.0)(slow)
+            # slow' = slow + alpha*(fast-slow); applied only on sync steps
+            diff = helper.create_variable_for_type_inference(p.dtype, p.shape)
+            block.append_op("elementwise_sub", {"X": p, "Y": slow},
+                            {"Out": diff}, {"axis": -1})
+            step_ = helper.create_variable_for_type_inference(p.dtype, p.shape)
+            block.append_op("scale", {"X": diff}, {"Out": step_},
+                            {"scale": self.alpha})
+            cand = helper.create_variable_for_type_inference(p.dtype, p.shape)
+            block.append_op("elementwise_add", {"X": slow, "Y": step_},
+                            {"Out": cand}, {"axis": -1})
+            # blend = sync*cand + (1-sync)*old
+            picked = helper.create_variable_for_type_inference(p.dtype, p.shape)
+            block.append_op("elementwise_mul", {"X": cand, "Y": sync},
+                            {"Out": picked}, {"axis": -1})
+            inv = helper.create_variable_for_type_inference("float32", ())
+            block.append_op("scale", {"X": sync}, {"Out": inv},
+                            {"scale": -1.0, "bias": 1.0})
+            keep_slow = helper.create_variable_for_type_inference(p.dtype, p.shape)
+            block.append_op("elementwise_mul", {"X": slow, "Y": inv},
+                            {"Out": keep_slow}, {"axis": -1})
+            block.append_op("elementwise_add", {"X": picked, "Y": keep_slow},
+                            {"Out": slow}, {"axis": -1})
+            # fast = sync*slow' + (1-sync)*fast
+            pf = helper.create_variable_for_type_inference(p.dtype, p.shape)
+            block.append_op("elementwise_mul", {"X": slow, "Y": sync},
+                            {"Out": pf}, {"axis": -1})
+            kf = helper.create_variable_for_type_inference(p.dtype, p.shape)
+            block.append_op("elementwise_mul", {"X": p, "Y": inv},
+                            {"Out": kf}, {"axis": -1})
+            block.append_op("elementwise_add", {"X": pf, "Y": kf},
+                            {"Out": p}, {"axis": -1})
+        return opt_ops, params_grads

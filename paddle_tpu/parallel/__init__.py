@@ -1,0 +1,20 @@
+"""Distributed / parallel execution.
+
+Parity: the reference's transpiler/ (pserver), incubate/fleet/, and
+layers/collective.py — re-designed as SPMD over jax.sharding meshes (see
+SURVEY.md §2.6): dp/fsdp/tp/pp/sp/ep axes, XLA collectives over ICI.
+"""
+
+from .mesh import (MeshConfig, get_mesh, set_mesh, make_mesh, mesh_axes,
+                   multihost_initialize)
+from .collective import (allreduce, broadcast, allgather, reducescatter,
+                         alltoall, barrier, send_recv)
+from .data_parallel import data_parallel_step
+from .tensor_parallel import (ShardRules, column_parallel_spec,
+                              row_parallel_spec, shard_params_spec,
+                              apply_shard_rules)
+from .ring_attention import ring_attention, blockwise_attention
+from .pipeline import PipelineOptimizer, pipeline_step
+from .moe import MoELayer, expert_parallel_dispatch
+from . import fleet
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig

@@ -1,0 +1,65 @@
+"""Collective communication python API.
+
+Parity: python/paddle/fluid/layers/collective.py (_allreduce, _broadcast,
+_c_allgather, _c_reducescatter) + the NCCL wrappers in
+paddle/fluid/operators/collective/.
+
+TPU-native: these are jax.lax collectives over named mesh axes — XLA lowers
+them to ICI ring/tree primitives and overlaps them with compute. Valid
+inside shard_map/pmap; outside a mapped context they raise (same as calling
+NCCL without a communicator).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def allreduce(x, op="sum", axis_name="dp"):
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "mean":
+        return lax.pmean(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    if op == "prod":
+        return jnp.exp(lax.psum(jnp.log(x), axis_name))
+    raise ValueError(f"unknown allreduce op {op}")
+
+
+def broadcast(x, root=0, axis_name="dp"):
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name)
+
+
+def allgather(x, axis_name="dp", axis=0, tiled=True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reducescatter(x, axis_name="dp", scatter_axis=0):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=scatter_axis,
+                            tiled=True)
+
+
+def alltoall(x, axis_name="ep", split_axis=0, concat_axis=0):
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def send_recv(x, perm, axis_name="sp"):
+    """Neighbour exchange (ppermute) — the ring-attention building block."""
+    return lax.ppermute(x, axis_name, perm)
+
+
+def ring_shift(x, axis_name="sp", shift=1):
+    n = lax.psum(1, axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def barrier(axis_name="dp"):
+    """Semantic barrier: a tiny psum forces cross-device sync."""
+    return lax.psum(jnp.zeros((), jnp.float32), axis_name)

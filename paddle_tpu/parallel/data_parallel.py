@@ -1,0 +1,40 @@
+"""Data-parallel step builder (functional API).
+
+Parity: ParallelExecutor's allreduce graph, as a reusable functional helper
+for models written directly against jax (models/, __graft_entry__).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def data_parallel_step(step_fn, mesh, batch_axis="dp", donate_state=True):
+    """Wrap step_fn(state, batch) -> (state', metrics) with dp sharding:
+    batch sharded on its leading axis, state replicated (or honoring
+    existing NamedShardings); XLA inserts the grad all-reduce."""
+
+    state_sharding = NamedSharding(mesh, P())
+
+    def batch_spec(x):
+        if hasattr(x, "ndim") and x.ndim >= 1:
+            return NamedSharding(mesh, P(batch_axis))
+        return NamedSharding(mesh, P())
+
+    jitted = jax.jit(step_fn, donate_argnums=(0,) if donate_state else ())
+
+    def run(state, batch):
+        batch = jax.tree_util.tree_map(
+            lambda x: jax.device_put(jnp.asarray(x), batch_spec(x)), batch)
+        state = jax.tree_util.tree_map(
+            lambda x: x if _sharded(x, mesh) else jax.device_put(
+                jnp.asarray(x), state_sharding), state)
+        with mesh:
+            return jitted(state, batch)
+
+    return run
+
+
+def _sharded(x, mesh):
+    s = getattr(x, "sharding", None)
+    return isinstance(s, NamedSharding) and s.mesh == mesh

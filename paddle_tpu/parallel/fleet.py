@@ -1,0 +1,123 @@
+"""Fleet distributed-training facade.
+
+Parity: python/paddle/fluid/incubate/fleet/ (base/role_maker.py,
+collective/__init__.py, parameter_server/). fleet.init / distributed_optimizer
+/ worker_num etc. keep their shape; underneath everything is the SPMD mesh.
+"""
+
+import jax
+
+from .mesh import get_mesh, make_mesh, set_mesh, multihost_initialize
+
+
+class RoleMakerBase:
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def is_first_worker(self):
+        return jax.process_index() == 0
+
+    def worker_num(self):
+        return jax.process_count()
+
+    def worker_index(self):
+        return jax.process_index()
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    def __init__(self, is_collective=True):
+        self._is_collective = is_collective
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, current_id=0, role=None, worker_num=1,
+                 server_endpoints=None):
+        self._id = current_id
+        self._num = worker_num
+
+
+class DistributedStrategy:
+    """Parity: fleet DistributedStrategy — knobs map onto mesh shape + jit
+    options instead of nccl/pserver config."""
+
+    def __init__(self):
+        self.tp_degree = 1
+        self.pp_degree = 1
+        self.sp_degree = 1
+        self.ep_degree = 1
+        self.use_fsdp = False
+        self.amp = False
+        self.recompute = False
+        self.gradient_merge_steps = 1
+
+
+class Fleet:
+    def __init__(self):
+        self._role = None
+        self._strategy = None
+        self._inited = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        self._role = role_maker or PaddleCloudRoleMaker(is_collective)
+        self._strategy = strategy or DistributedStrategy()
+        s = self._strategy
+        mesh = make_mesh(tp=s.tp_degree, pp=s.pp_degree, sp=s.sp_degree,
+                         ep=s.ep_degree)
+        set_mesh(mesh)
+        self._inited = True
+        return self
+
+    def is_first_worker(self):
+        return self._role.is_first_worker() if self._role else True
+
+    def worker_num(self):
+        return self._role.worker_num() if self._role else 1
+
+    def worker_index(self):
+        return self._role.worker_index() if self._role else 0
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def barrier_worker(self):
+        pass
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """The returned optimizer is unchanged: SPMD makes grad sync a
+        compiler concern (psum inserted by GSPMD), matching the semantics of
+        fleet's allreduce DistributedOptimizer."""
+        if strategy is not None:
+            self._strategy = strategy
+        return optimizer
+
+    def init_worker(self):
+        pass
+
+    def init_server(self, *args, **kwargs):
+        pass
+
+    def run_server(self):
+        raise RuntimeError("TPU pods have no parameter servers; "
+                           "use sharded optimizer states (fsdp) instead")
+
+    def stop_worker(self):
+        pass
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None):
+        from ..io.inference_io import save_inference_model
+        return save_inference_model(dirname, feeded_var_names, target_vars,
+                                    executor, main_program)
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        from ..io.state import save_persistables
+        return save_persistables(executor, dirname, main_program)
+
+
+fleet = Fleet()

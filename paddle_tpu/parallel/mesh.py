@@ -1,0 +1,93 @@
+"""Device-mesh management.
+
+Parity: the reference's device/topology handling (transpiler endpoints,
+nccl rings, fleet role makers) — redesigned as a single jax.sharding.Mesh
+with named axes:
+
+    dp    data parallel (batch)
+    fsdp  parameter sharding along dp (ZeRO-3 style)
+    tp    tensor (megatron) parallel
+    pp    pipeline stages
+    sp    sequence/context parallel (ring attention)
+    ep    expert parallel (MoE)
+
+Multi-host: ICI-contiguous axes (tp/sp) are laid innermost so their
+collectives ride ICI; dp/pp outermost can span DCN (scaling-book recipe).
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+AXES = ("dp", "tp", "pp", "sp", "ep")
+
+_current_mesh = None
+
+
+class MeshConfig:
+    def __init__(self, dp=1, tp=1, pp=1, sp=1, ep=1):
+        self.dp, self.tp, self.pp, self.sp, self.ep = dp, tp, pp, sp, ep
+
+    @property
+    def shape(self):
+        return {"dp": self.dp, "tp": self.tp, "pp": self.pp, "sp": self.sp,
+                "ep": self.ep}
+
+    def size(self):
+        return self.dp * self.tp * self.pp * self.sp * self.ep
+
+
+def make_mesh(dp=None, tp=1, pp=1, sp=1, ep=1, devices=None):
+    """Build a Mesh; dp defaults to 'whatever is left'."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    rest = tp * pp * sp * ep
+    if dp is None:
+        if n % rest:
+            raise ValueError(f"{n} devices not divisible by tp*pp*sp*ep={rest}")
+        dp = n // rest
+    if dp * rest != n:
+        raise ValueError(f"mesh {dp}x{tp}x{pp}x{sp}x{ep} != {n} devices")
+    arr = np.array(devices).reshape(dp, pp, ep, sp, tp)
+    # axis order: slower-varying outermost (dp/pp over DCN), tp innermost
+    # so tensor-parallel collectives use nearest-neighbour ICI links.
+    return Mesh(arr, ("dp", "pp", "ep", "sp", "tp"))
+
+
+def set_mesh(mesh):
+    global _current_mesh
+    _current_mesh = mesh
+    return mesh
+
+
+def get_mesh():
+    global _current_mesh
+    if _current_mesh is None:
+        _current_mesh = make_mesh()
+    return _current_mesh
+
+
+def mesh_axes(mesh=None):
+    return tuple((mesh or get_mesh()).axis_names)
+
+
+def multihost_initialize(coordinator_address=None, num_processes=None,
+                         process_id=None):
+    """Parity: transpiler endpoints / fleet.init on a multi-host pod.
+    Wraps jax.distributed.initialize; a no-op when single-process."""
+    if num_processes in (None, 1):
+        return False
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True
+
+
+def named_sharding(spec, mesh=None):
+    return NamedSharding(mesh or get_mesh(), spec)
+
+
+def replicated(mesh=None):
+    return NamedSharding(mesh or get_mesh(), P())

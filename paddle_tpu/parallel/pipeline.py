@@ -1,0 +1,95 @@
+"""Pipeline parallelism (GPipe-style microbatching).
+
+Parity: fluid.optimizer.PipelineOptimizer (python/paddle/fluid/optimizer.py:
+PipelineOptimizer) + section_worker. The reference streams microbatches
+through device-resident program sections over queues. TPU-native: stages are
+a stacked parameter pytree sharded over the 'pp' mesh axis; the schedule is
+a lax.scan over (microbatches + stages - 1) ticks where each tick every
+stage computes its microbatch and hands activations to the next stage via
+ppermute — the classic SPMD pipeline (GSPMD paper / scaling-book recipe).
+Bubbles are the standard (S-1)/(M+S-1) GPipe overhead.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+
+def pipeline_step(stage_fn, stacked_params, x_microbatches, axis_name="pp"):
+    """Run INSIDE shard_map with params sharded over `axis_name` (leading
+    stage dim of every leaf already consumed, i.e. local stage params).
+
+    stage_fn(params, x) -> y, applied by every stage to its current slot.
+    x_microbatches: (M, ...) local copy of all microbatches (only stage 0
+    actually consumes them; later stages receive from the ring).
+    Returns (M, ...) outputs valid on the LAST stage.
+    """
+    pp = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    m = x_microbatches.shape[0]
+    ticks = m + pp - 1
+    buf_shape = x_microbatches.shape[1:]
+
+    def body(carry, t):
+        state = carry  # activation arriving at this stage this tick
+        # stage 0 injects microbatch t (when in range), others use incoming
+        inject = jnp.where(t < m, t, m - 1)
+        x0 = x_microbatches[inject]
+        x_in = jnp.where(idx == 0, x0, state)
+        y = stage_fn(stacked_params, x_in)
+        # pass activations down the ring: stage i -> i+1
+        perm = [(j, (j + 1) % pp) for j in range(pp)]
+        nxt = lax.ppermute(y, axis_name, perm)
+        # last stage's output for microbatch (t - pp + 1)
+        return nxt, y
+
+    _, ys = lax.scan(body, jnp.zeros(buf_shape, x_microbatches.dtype),
+                     jnp.arange(ticks))
+    # on the last stage, outputs for microbatch k appear at tick k + pp - 1
+    out = lax.dynamic_slice_in_dim(ys, pp - 1, m, axis=0)
+    return out
+
+
+def pipeline_apply(stage_fn, params_stacked, x, mesh, microbatches,
+                   axis_name="pp"):
+    """Host-level wrapper: shard the stacked stage params over pp and run the
+    scan schedule. x: (B, ...) global batch; split into `microbatches`."""
+    b = x.shape[0]
+    mb = b // microbatches
+    xm = x.reshape((microbatches, mb) + x.shape[1:])
+
+    pspec = jax.tree_util.tree_map(lambda _: P(axis_name), params_stacked)
+
+    def inner(params_local, xm_local):
+        # params_local leaves have leading dim 1 (this stage); drop it
+        params = jax.tree_util.tree_map(lambda a: a[0], params_local)
+        return pipeline_step(lambda p, xx: stage_fn(p, xx), params, xm_local,
+                             axis_name)
+
+    fn = shard_map(inner, mesh=mesh,
+                   in_specs=(pspec, P()), out_specs=P(),
+                   check_rep=False)
+    ym = fn(params_stacked, xm)
+    return ym.reshape((b,) + ym.shape[2:])
+
+
+class PipelineOptimizer:
+    """Parity: fluid.optimizer.PipelineOptimizer — wraps an optimizer and
+    carries the microbatch/section config; the TPU execution path is
+    pipeline_apply (SPMD scan), not device-queue workers."""
+
+    def __init__(self, optimizer, cut_list=None, place_list=None,
+                 concurrency_list=None, queue_size=30, sync_steps=1,
+                 start_cpu_core_id=0, num_microbatches=None):
+        self._optimizer = optimizer
+        self.cut_list = cut_list
+        self.num_microbatches = num_microbatches or queue_size
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self._optimizer.minimize(loss, startup_program,
+                                        parameter_list, no_grad_set)

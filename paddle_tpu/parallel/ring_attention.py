@@ -1,0 +1,121 @@
+"""Sequence/context parallelism: blockwise + ring attention.
+
+Parity target: long-context scaling (the reference scales sequence length
+only by bigger cards; TPU-native answer is ring attention over the 'sp' mesh
+axis — each chip holds a sequence shard, K/V blocks rotate around the ICI
+ring via ppermute while the online-softmax accumulator stays local, so
+attention memory is O(T/sp) per chip and comm overlaps compute).
+
+References (public technique): RingAttention (Liu et al.), blockwise
+flash-style online softmax. Implemented in pure lax (runs on TPU and the
+CPU test mesh); the Pallas fused kernel lives in ops/pallas_attention.py
+and is used automatically on TPU for the local block math.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+
+def _online_block(q, k, v, m, l, o, mask, scale):
+    """One flash-attention block update. q:(...,Tq,d) k,v:(...,Tk,d)."""
+    s = jnp.einsum("...qd,...kd->...qk", q, k) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -jnp.inf)
+    m_blk = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    # guard fully-masked rows (m_new == -inf)
+    safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - safe_m[..., None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    corr = jnp.exp(jnp.where(jnp.isfinite(m), m - safe_m, -jnp.inf))
+    corr = jnp.where(jnp.isfinite(m), corr, 0.0)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum("...qk,...kd->...qd", p, v)
+    return m_new, l_new, o_new
+
+
+def blockwise_attention(q, k, v, block_size=512, causal=False, scale=None):
+    """Single-device flash-style attention via lax.scan over KV blocks.
+    q,k,v: (B, H, T, d). O(T*block) memory instead of O(T^2)."""
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    scale = scale if scale is not None else d ** -0.5
+    block = min(block_size, tk)
+    nblk = (tk + block - 1) // block
+    pad = nblk * block - tk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = k.reshape(b, h, nblk, block, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(b, h, nblk, block, d).transpose(2, 0, 1, 3, 4)
+    q_pos = jnp.arange(tq)
+
+    def body(carry, blk):
+        m, l, o = carry
+        kblk, vblk, i = blk
+        k_pos = i * block + jnp.arange(block)
+        mask = (k_pos[None, :] < tk)
+        if causal:
+            mask = mask & (k_pos[None, :] <= q_pos[:, None])
+        mask = jnp.broadcast_to(mask, (b, h, tq, block))
+        m, l, o = _online_block(q, kblk, vblk, m, l, o, mask, scale)
+        return (m, l, o), None
+
+    init = (jnp.full((b, h, tq), -jnp.inf, q.dtype),
+            jnp.zeros((b, h, tq), q.dtype),
+            jnp.zeros((b, h, tq, d), q.dtype))
+    (m, l, o), _ = lax.scan(body, init, (kb, vb, jnp.arange(nblk)))
+    return o / jnp.maximum(l, 1e-20)[..., None]
+
+
+def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None):
+    """Ring attention over a sequence-sharded axis. Call INSIDE shard_map:
+    q,k,v are the local shards (B, H, T_local, d); the sequence axis is
+    sharded over `axis_name`. K/V rotate around the ring; per-step partial
+    softmax is merged online."""
+    sp = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, t_local, d = q.shape
+    scale = scale if scale is not None else d ** -0.5
+    q_pos = idx * t_local + jnp.arange(t_local)
+
+    def body(i, carry):
+        m, l, o, kk, vv = carry
+        kv_owner = (idx - i) % sp  # whose shard we hold at step i
+        k_pos = kv_owner * t_local + jnp.arange(t_local)
+        if causal:
+            mask = (k_pos[None, :] <= q_pos[:, None])
+            mask = jnp.broadcast_to(mask, (b, h, t_local, t_local))
+        else:
+            mask = None
+        m, l, o = _online_block(q, kk, vv, m, l, o, mask, scale)
+        perm = [(j, (j + 1) % sp) for j in range(sp)]
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        return (m, l, o, kk, vv)
+
+    init = (jnp.full((b, h, t_local), -jnp.inf, q.dtype),
+            jnp.zeros((b, h, t_local), q.dtype),
+            jnp.zeros((b, h, t_local, d), q.dtype),
+            k, v)
+    m, l, o, _, _ = lax.fori_loop(0, sp, body, init)
+    return o / jnp.maximum(l, 1e-20)[..., None]
+
+
+def ring_attention_sharded(q, k, v, mesh, causal=False,
+                           batch_axis="dp", seq_axis="sp", head_axis="tp"):
+    """shard_map wrapper: q,k,v are global (B, H, T, d) arrays; returns the
+    globally-correct attention output with T sharded over `seq_axis`."""
+    spec = P(batch_axis, head_axis, seq_axis, None)
+
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False)
+    return fn(q, k, v)

@@ -1,0 +1,64 @@
+"""Profiler.
+
+Parity: python/paddle/fluid/profiler.py (profiler.start_profiler /
+stop_profiler / profiler context). Wraps jax.profiler traces (viewable in
+TensorBoard/XProf) plus a host-side per-run timing table, the TPU equivalent
+of the reference's CUDA event timeline.
+"""
+
+import contextlib
+import time
+
+import jax
+
+
+_timings = []
+_trace_dir = None
+_active = False
+
+
+def start_profiler(state="All", tracer_option="Default",
+                   trace_dir="/tmp/paddle_tpu_profile"):
+    global _active, _trace_dir
+    _trace_dir = trace_dir
+    try:
+        jax.profiler.start_trace(trace_dir)
+        _active = True
+    except Exception:
+        _active = False
+    _timings.clear()
+
+
+def stop_profiler(sorted_key="total", profile_path=None):
+    global _active
+    if _active:
+        jax.profiler.stop_trace()
+        _active = False
+    if _timings:
+        rows = sorted(_timings, key=lambda r: -r[1])
+        total = sum(r[1] for r in rows)
+        print(f"{'Event':<40}{'Time(ms)':>12}{'Ratio':>8}")
+        for name, dt in rows[:50]:
+            print(f"{name:<40}{dt * 1e3:>12.3f}{dt / max(total, 1e-12):>8.2%}")
+
+
+def reset_profiler():
+    _timings.clear()
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key="total", profile_path=None):
+    start_profiler(state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def record_event(name):
+    """Host-side timing of a region (also annotates the XLA trace)."""
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name):
+        yield
+    _timings.append((name, time.perf_counter() - t0))

@@ -1,0 +1,4 @@
+from .decorator import (batch, shuffle, buffered, cache, chain, compose,
+                        map_readers, firstn, xmap_readers,
+                        multiprocess_reader)
+from .dataloader import DataLoader

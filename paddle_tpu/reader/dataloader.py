@@ -1,0 +1,77 @@
+"""DataLoader: async host->device feeding.
+
+Parity: fluid.io.DataLoader / py_reader (python/paddle/fluid/reader.py +
+the C++ double-buffered reader ops). The native prefetch ring (csrc/ via
+reader/native.py) overlaps host batching with device compute; the python
+fallback uses a bounded background thread.
+"""
+
+import queue
+import threading
+
+import numpy as np
+
+
+class DataLoader:
+    def __init__(self, feed_list=None, capacity=4, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        self.feed_list = feed_list or []
+        self.capacity = capacity
+        self._generator = None
+        self._places = None
+        self._batch_reader = None
+
+    @staticmethod
+    def from_generator(feed_list=None, capacity=4, use_double_buffer=True,
+                       iterable=True, return_list=False):
+        return DataLoader(feed_list, capacity, use_double_buffer, iterable,
+                          return_list)
+
+    def set_batch_generator(self, reader, places=None):
+        self._batch_reader = reader
+        self._places = places
+        return self
+
+    def set_sample_list_generator(self, reader, places=None):
+        from ..core.data_feeder import DataFeeder
+        feeder = DataFeeder(self.feed_list)
+
+        def batch_reader():
+            for samples in reader():
+                yield feeder.feed(samples)
+        self._batch_reader = batch_reader
+        self._places = places
+        return self
+
+    def __iter__(self):
+        if self._batch_reader is None:
+            raise RuntimeError("no generator set on DataLoader")
+        return iter(_Prefetcher(self._batch_reader, self.capacity))
+
+
+class _Prefetcher:
+    """Bounded background-thread prefetch; keeps the accelerator fed while
+    the host assembles the next batch (double buffering)."""
+
+    def __init__(self, batch_reader, capacity):
+        self._reader = batch_reader
+        self._capacity = max(2, capacity)
+
+    def __iter__(self):
+        q = queue.Queue(maxsize=self._capacity)
+        END = object()
+
+        def producer():
+            try:
+                for item in self._reader():
+                    q.put(item)
+            finally:
+                q.put(END)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is END:
+                return
+            yield item

@@ -1,0 +1,126 @@
+"""Reader decorators.
+
+Parity: python/paddle/reader/decorator.py (batch, shuffle, buffered, cache,
+chain, compose, map_readers, firstn, xmap_readers).
+"""
+
+import itertools
+import queue
+import random
+import threading
+
+
+def batch(reader, batch_size, drop_last=False):
+    def batch_reader():
+        b = []
+        for item in reader():
+            b.append(item)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    return batch_reader
+
+
+def shuffle(reader, buf_size):
+    def shuffle_reader():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                yield from buf
+                buf = []
+        random.shuffle(buf)
+        yield from buf
+    return shuffle_reader
+
+
+def buffered(reader, size):
+    """Background-thread prefetch with a bounded queue."""
+    class _End:
+        pass
+
+    def buffered_reader():
+        q = queue.Queue(maxsize=size)
+
+        def producer():
+            try:
+                for item in reader():
+                    q.put(item)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _End:
+                break
+            yield item
+    return buffered_reader
+
+
+def cache(reader):
+    all_data = []
+    filled = []
+
+    def cache_reader():
+        if not filled:
+            all_data.extend(reader())
+            filled.append(True)
+        yield from all_data
+    return cache_reader
+
+
+def chain(*readers):
+    def chain_reader():
+        for r in readers:
+            yield from r()
+    return chain_reader
+
+
+def compose(*readers, check_alignment=True):
+    def compose_reader():
+        its = [r() for r in readers]
+        for items in zip(*its):
+            out = ()
+            for item in items:
+                out += item if isinstance(item, tuple) else (item,)
+            yield out
+    return compose_reader
+
+
+def map_readers(func, *readers):
+    def map_reader():
+        its = [r() for r in readers]
+        for items in zip(*its):
+            yield func(*items)
+    return map_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        yield from itertools.islice(reader(), n)
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Thread-pool mapper (the reference uses processes; threads suffice for
+    numpy transforms and avoid fork issues with a live TPU client)."""
+    def xmap_reader():
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(process_num) as pool:
+            window = []
+            for item in reader():
+                window.append(pool.submit(mapper, item))
+                if len(window) >= buffer_size:
+                    yield window.pop(0).result()
+            for fut in window:
+                yield fut.result()
+    return xmap_reader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    return chain(*readers)

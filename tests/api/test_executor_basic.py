@@ -1,0 +1,98 @@
+"""Core slice: program build -> startup -> train step -> fetch."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_import_surface():
+    assert fluid.Program is not None
+    assert callable(layers.fc)
+
+
+def test_forward_only():
+    x = layers.data("x", shape=[4], dtype="float32", append_batch_size=True)
+    y = layers.scale(x, scale=2.0, bias=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    out, = exe.run(feed={"x": np.ones((3, 4), np.float32)}, fetch_list=[y])
+    np.testing.assert_allclose(out, np.full((3, 4), 3.0), rtol=1e-6)
+
+
+def test_fc_shapes_and_params():
+    x = layers.data("x", shape=[8], dtype="float32")
+    out = layers.fc(x, size=16, act="relu")
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    res, = exe.run(feed={"x": np.random.randn(2, 8).astype(np.float32)},
+                   fetch_list=[out])
+    assert res.shape == (2, 16)
+    assert (res >= 0).all()
+    params = fluid.default_main_program().all_parameters()
+    assert len(params) == 2  # w + b
+
+
+def test_linear_regression_converges():
+    np.random.seed(0)
+    w_true = np.array([[2.0], [-3.0]], np.float32)
+    x = layers.data("x", shape=[2], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1, bias_attr=False)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    opt = fluid.optimizer.SGDOptimizer(learning_rate=0.1)
+    opt.minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for i in range(60):
+        xs = np.random.randn(32, 2).astype(np.float32)
+        ys = xs @ w_true
+        l, = exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+        losses.append(float(l))
+    assert losses[-1] < 1e-3, losses[-5:]
+
+
+def test_fetch_gradient():
+    x = layers.data("x", shape=[3], dtype="float32")
+    w = layers.create_parameter([3, 3], "float32", name="w_fetchgrad")
+    out = layers.mean(layers.matmul(x, w))
+    fluid.append_backward(out)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xs = np.random.randn(2, 3).astype(np.float32)
+    g, = exe.run(feed={"x": xs}, fetch_list=["w_fetchgrad@GRAD"])
+    # d(mean)/dw[i,j] = mean over batch of x[:, i] / 3
+    expect = np.repeat(xs.mean(0)[:, None], 3, axis=1) / (2 * 3) * 2
+    np.testing.assert_allclose(g, expect, rtol=1e-5)
+
+
+def test_program_clone_for_test_drops_optimizer():
+    x = layers.data("x", shape=[4], dtype="float32")
+    out = layers.fc(x, size=2)
+    loss = layers.mean(out)
+    test_prog = fluid.default_main_program().clone(for_test=True)
+    fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    assert test_prog.backward_marker() is None
+    assert fluid.default_main_program().backward_marker() is not None
+
+
+def test_adam_converges():
+    np.random.seed(1)
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    h = layers.fc(x, size=8, act="tanh")
+    pred = layers.fc(h, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.AdamOptimizer(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    first = None
+    for i in range(100):
+        xs = np.random.randn(16, 4).astype(np.float32)
+        ys = np.sin(xs.sum(1, keepdims=True)).astype(np.float32)
+        l, = exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+        if first is None:
+            first = float(l)
+    assert float(l) < first * 0.5

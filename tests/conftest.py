@@ -1,0 +1,41 @@
+"""Test config: force a virtual 8-device CPU mesh before jax initializes.
+
+Mirrors SURVEY.md §4 — parallel tests run on
+xla_force_host_platform_device_count=8 CPU devices; TPU perf is bench.py's
+job, correctness is this suite's job.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np
+import pytest
+
+import jax
+
+# Numeric tests compare against fp64/numpy goldens; force fp32 matmuls
+# (production path uses bf16 on the MXU — precision is bench.py's concern).
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    """Each test gets fresh default programs + scope (fluid tests reset
+    similarly via new Program/Scope per unit test)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import framework, executor, unique_name
+    old_main = framework.switch_main_program(framework.Program())
+    old_startup = framework.switch_startup_program(framework.Program())
+    old_scope = executor._global_scope
+    executor._global_scope = executor.Scope()
+    unique_name.switch()
+    yield
+    framework.switch_main_program(old_main)
+    framework.switch_startup_program(old_startup)
+    executor._global_scope = old_scope
