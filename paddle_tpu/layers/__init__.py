@@ -4,7 +4,8 @@ Parity: python/paddle/fluid/layers/__init__.py — everything re-exported flat,
 so `layers.fc(...)`, `layers.data(...)` etc. work like the reference.
 """
 
-from .io import data, fluid_data
+from .io import (data, fluid_data, py_reader, create_py_reader_by_data,
+                 double_buffer, read_file, PyReader)
 from .nn import *          # noqa: F401,F403
 from .tensor import (create_tensor, create_parameter, create_global_var,
                      fill_constant, fill_constant_batch_size_like, assign,
@@ -22,6 +23,42 @@ from .learning_rate_scheduler import (noam_decay, exponential_decay,
                                       natural_exp_decay, inverse_time_decay,
                                       polynomial_decay, piecewise_decay,
                                       cosine_decay, linear_lr_warmup)
+from . import sequence
+from .sequence import (sequence_mask, sequence_pad, sequence_unpad,
+                       sequence_pool, sequence_first_step,
+                       sequence_last_step, sequence_softmax,
+                       sequence_expand, sequence_expand_as,
+                       sequence_reverse, sequence_conv, sequence_concat,
+                       sequence_slice, sequence_enumerate, sequence_reshape)
+from . import control_flow
+from .control_flow import (While, Switch, IfElse, StaticRNN, cond, case,
+                           switch_case, increment, array_write, array_read,
+                           array_length, create_array, less_than, less_equal,
+                           greater_than, greater_equal, equal, not_equal,
+                           is_empty, autoincreased_step_counter)
+from . import rnn
+from .rnn import (dynamic_lstm, dynamic_gru, lstm, gru, lstm_unit, gru_unit)
+from . import attention
+from .attention import (scaled_dot_product_attention, multi_head_attention,
+                        add_position_encoding)
+from . import beam_search as beam_search_mod
+from .beam_search import beam_search, beam_search_decode
+from . import detection
+from .detection import (prior_box, density_prior_box, box_coder,
+                        iou_similarity, multiclass_nms, yolo_box, roi_pool,
+                        roi_align, psroi_pool, ssd_loss, multi_box_head,
+                        detection_output)
+from .nn import topk as top_k  # fluid exposes both spellings
 from .math_op_patch import monkey_patch_variable
 
 monkey_patch_variable()
+
+
+def tile(x, repeat_times, name=None):
+    """Parity: paddle.tile / fluid expand with per-dim repeats."""
+    from ..core.layer_helper import LayerHelper
+    helper = LayerHelper("tile", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("tile", {"X": x}, {"Out": out},
+                     {"repeat_times": list(repeat_times)})
+    return out

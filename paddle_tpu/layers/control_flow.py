@@ -1,0 +1,482 @@
+"""Control-flow layers.
+
+Parity: python/paddle/fluid/layers/control_flow.py (While, StaticRNN,
+IfElse, Switch, increment, array_read/array_write/array_length, less_than,
+equal, ...).
+
+TPU-first: the reference executes sub-blocks with a nested C++ executor per
+iteration. Here every construct stays inside ONE traced XLA graph:
+
+  While      -> lax.while_loop   (carry = parent vars the body writes)
+  cond/case  -> lax.cond
+  StaticRNN  -> lax.scan         (memories = carry, step inputs = xs)
+  Switch     -> guarded selects  (the LR-schedule construct)
+
+so there is no per-step host dispatch and XLA can fuse/pipeline the loop
+body.
+"""
+
+import contextlib
+
+from ..core.framework import Variable, default_main_program
+from ..core.layer_helper import LayerHelper
+from . import tensor as tensor_layers
+
+__all__ = [
+    "While", "Switch", "IfElse", "StaticRNN", "cond", "case", "switch_case",
+    "increment", "array_write", "array_read", "array_length", "create_array",
+    "less_than", "less_equal", "greater_than", "greater_equal", "equal",
+    "not_equal", "is_empty", "autoincreased_step_counter",
+]
+
+
+# -- scalar helpers ---------------------------------------------------------
+
+def increment(x, value=1.0, in_place=True):
+    """Parity: fluid.layers.increment (ref layers/control_flow.py)."""
+    helper = LayerHelper("increment")
+    out = x if in_place else helper.create_variable_for_type_inference(
+        x.dtype, x.shape)
+    helper.append_op("increment", {"X": x}, {"Out": out}, {"step": float(value)})
+    return out
+
+
+def _cmp(op_type, x, y, cond=None):
+    helper = LayerHelper(op_type)
+    if cond is None:
+        cond = helper.create_variable_for_type_inference("bool", x.shape)
+    helper.append_op(op_type, {"X": x, "Y": y}, {"Out": cond})
+    return cond
+
+
+def less_than(x, y, force_cpu=None, cond=None):
+    return _cmp("less_than", x, y, cond)
+
+
+def less_equal(x, y, cond=None):
+    return _cmp("less_equal", x, y, cond)
+
+
+def greater_than(x, y, cond=None):
+    return _cmp("greater_than", x, y, cond)
+
+
+def greater_equal(x, y, cond=None):
+    return _cmp("greater_equal", x, y, cond)
+
+
+def equal(x, y, cond=None):
+    return _cmp("equal", x, y, cond)
+
+
+def not_equal(x, y, cond=None):
+    return _cmp("not_equal", x, y, cond)
+
+
+def is_empty(x, cond=None):
+    helper = LayerHelper("is_empty")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference("bool", ())
+    helper.append_op("is_empty", {"X": x}, {"Out": cond})
+    return cond
+
+
+# -- TensorArray ------------------------------------------------------------
+
+def create_array(dtype="float32"):
+    """Parity: fluid.layers.create_array (LoDTensorArray). Static-length
+    python list during tracing; see ops/control_flow_ops.py."""
+    helper = LayerHelper("create_array")
+    arr = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("create_array", {}, {"Out": arr})
+    arr._is_array = True
+    return arr
+
+
+def array_write(x, i, array=None):
+    """i must be a python int or a fill_constant var with static value
+    (TPU arrays are trace-time structures)."""
+    helper = LayerHelper("array_write")
+    if array is None:
+        array = create_array(x.dtype)
+    idx = i if isinstance(i, int) else getattr(i, "_static_value", None)
+    helper.append_op("array_write", {"Array": array, "X": x}, {"Out": array},
+                     {"static_index": idx} if idx is not None else {})
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(array.dtype)
+    idx = i if isinstance(i, int) else getattr(i, "_static_value", 0)
+    helper.append_op("array_read", {"Array": array}, {"Out": out},
+                     {"static_index": int(idx)})
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference("int64", ())
+    helper.append_op("array_length", {"Array": array}, {"Out": out})
+    return out
+
+
+def _free_vars(blocks, parent_block):
+    """Names a set of sub-blocks read from enclosing scope. Needed so the
+    executor's dead-code slicer keeps the producing ops: sub-block bodies
+    are traced lazily by the structured op, so their reads must surface as
+    inputs of the structured op itself. Nested constructs already list
+    their own frees as inputs (built inner-first), so one level suffices."""
+    free = []
+    for block in blocks:
+        local = set(block.vars)
+        for op in block.ops:
+            for n in op.input_names:
+                if n not in local and n not in free:
+                    free.append(n)
+            local |= set(op.output_names)
+    return [v for v in (parent_block._find_var_recursive(n) for n in free)
+            if v is not None]
+
+
+# -- While ------------------------------------------------------------------
+
+class While:
+    """Parity: fluid.layers.While.
+
+    with while_op.block(): body layers. Vars that exist BEFORE the loop and
+    are written inside the body (via layers.assign etc.) become loop carry;
+    the condition var must be re-assigned in the body.
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.helper = LayerHelper("while", name=name)
+        if cond.dtype != "bool":
+            raise TypeError("While condition must be a bool Variable")
+        self.cond_var = cond
+        self.is_test = is_test
+
+    @contextlib.contextmanager
+    def block(self):
+        program = self.helper.main_program
+        parent_block = program.current_block()
+        sub_block = program._create_block()
+        try:
+            yield
+        finally:
+            program._rollback()
+        # carry = parent vars written in the body (+ the condition var)
+        carry = []
+        for op in sub_block.ops:
+            for name in op.output_names:
+                if name in sub_block.vars:
+                    continue  # block-local temp
+                v = parent_block._find_var_recursive(name)
+                if v is not None and name not in carry:
+                    carry.append(name)
+        if self.cond_var.name not in carry:
+            carry.append(self.cond_var.name)
+        out_vars = [parent_block._find_var_recursive(n) for n in carry]
+        parent_block.append_op(
+            "while",
+            {"Cond": self.cond_var,
+             "X": out_vars + _free_vars([sub_block], parent_block)},
+            {"Out": out_vars},
+            {"sub_block": sub_block.idx, "carry_names": carry,
+             "cond_name": self.cond_var.name})
+
+
+# -- cond / case / switch_case ---------------------------------------------
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """Parity: fluid.layers.cond — functional two-branch conditional.
+    Both branches must return matching structures (lax.cond contract)."""
+    helper = LayerHelper("cond", name=name)
+    program = helper.main_program
+
+    def trace(fn):
+        block = program._create_block()
+        try:
+            rets = fn() if fn is not None else None
+        finally:
+            program._rollback()
+        if rets is None:
+            rets = []
+        if isinstance(rets, Variable):
+            rets = [rets]
+        return block, list(rets)
+
+    t_block, t_rets = trace(true_fn)
+    f_block, f_rets = trace(false_fn)
+    if len(t_rets) != len(f_rets):
+        raise ValueError("cond: true_fn and false_fn must return the same "
+                         f"number of values ({len(t_rets)} vs {len(f_rets)})")
+    outs = [helper.create_variable_for_type_inference(v.dtype, v.shape)
+            for v in t_rets]
+    parent = program.current_block()
+    helper.append_op(
+        "cond_pair",
+        {"Cond": pred, "X": _free_vars([t_block, f_block], parent)},
+        {"Out": outs},
+        {"true_block": t_block.idx, "false_block": f_block.idx,
+         "true_outs": [v.name for v in t_rets],
+         "false_outs": [v.name for v in f_rets]})
+    if not outs:
+        return None
+    return outs[0] if len(outs) == 1 else outs
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """Parity: fluid.layers.case — first true predicate wins."""
+    if not pred_fn_pairs:
+        raise ValueError("case: pred_fn_pairs must be non-empty")
+    pred, fn = pred_fn_pairs[0]
+    rest = pred_fn_pairs[1:]
+    if rest:
+        return cond(pred, fn, lambda: case(rest, default), name=name)
+    if default is None:
+        return cond(pred, fn, fn, name=name)
+    return cond(pred, fn, default, name=name)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Parity: fluid.layers.switch_case."""
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    else:
+        pairs = list(enumerate(branch_fns))
+    pred_fn_pairs = []
+    for idx, fn in pairs:
+        idx_var = tensor_layers.fill_constant((), "int64", int(idx))
+        pred_fn_pairs.append((equal(branch_index, idx_var), fn))
+    if default is None:
+        default = pairs[-1][1]
+    return case(pred_fn_pairs, default, name=name)
+
+
+class Switch:
+    """Parity: fluid.layers.Switch (used by LR schedules). Sequential
+    guarded assignment blocks; first true case wins."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self.cases = []          # [(cond_name or None, block_idx)]
+        self.target_names = []   # parent vars assigned in any case
+        self._inside = False
+
+    @contextlib.contextmanager
+    def _case_block(self, condition):
+        program = self.helper.main_program
+        parent = program.current_block()
+        block = program._create_block()
+        try:
+            yield
+        finally:
+            program._rollback()
+        for op in block.ops:
+            for name in op.output_names:
+                if name in block.vars:
+                    continue
+                if parent._find_var_recursive(name) is not None \
+                        and name not in self.target_names:
+                    self.target_names.append(name)
+        self.cases.append(
+            [condition.name if condition is not None else None, block.idx])
+
+    def case(self, condition):
+        return self._case_block(condition)
+
+    def default(self):
+        return self._case_block(None)
+
+    @contextlib.contextmanager
+    def block(self):
+        self.cases = []
+        self.target_names = []
+        try:
+            yield self
+        finally:
+            parent = self.helper.main_program.current_block()
+            out_vars = [parent._find_var_recursive(n) for n in self.target_names]
+            cond_vars = [parent._find_var_recursive(c)
+                         for c, _ in self.cases if c is not None]
+            program = self.helper.main_program
+            case_blocks = [program.blocks[i] for _, i in self.cases]
+            self.helper.append_op(
+                "switch",
+                {"Cond": cond_vars,
+                 "X": out_vars + _free_vars(case_blocks, parent)},
+                {"Out": out_vars},
+                {"cases": self.cases, "target_names": self.target_names})
+
+
+class IfElse:
+    """Parity: fluid.layers.IfElse. The reference physically splits the
+    batch by the bool mask and runs each sub-batch through its block
+    (split_lod_tensor/merge_lod_tensor). TPU-first both blocks run on the
+    FULL batch and output() merges rows with where(cond) — identical
+    results for row-wise computation, with no dynamic shapes."""
+
+    def __init__(self, cond, name=None):
+        self.helper = LayerHelper("ifelse", name=name)
+        self.cond = cond
+        self._true_outs = []
+        self._false_outs = []
+        self._in_true = None
+
+    @contextlib.contextmanager
+    def true_block(self):
+        self._in_true = True
+        yield
+        self._in_true = None
+
+    @contextlib.contextmanager
+    def false_block(self):
+        self._in_true = False
+        yield
+        self._in_true = None
+
+    def input(self, x):
+        return x
+
+    def output(self, *outs):
+        target = self._true_outs if self._in_true else self._false_outs
+        target.extend(outs)
+
+    def __call__(self):
+        from . import nn as nn_layers
+        merged = []
+        for t, f in zip(self._true_outs, self._false_outs):
+            helper = LayerHelper("ifelse_merge")
+            out = helper.create_variable_for_type_inference(t.dtype, t.shape)
+            helper.append_op("select",
+                             {"Condition": self.cond, "X": t, "Y": f},
+                             {"Out": out})
+            merged.append(out)
+        return merged
+
+
+# -- StaticRNN --------------------------------------------------------------
+
+class StaticRNN:
+    """Parity: fluid.layers.StaticRNN — unrolled RNN over axis 0 of its
+    step inputs, lowered to ONE lax.scan (ops/control_flow_ops.py
+    static_rnn), not T copies of the cell."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self._block = None
+        self._step_inputs = []   # [outer_name, inner_name]
+        self._memories = []      # [inner_name, init_name, updated_name]
+        self._step_outputs = []  # inner names
+        self._out_vars = []
+        self._seq_len = None
+
+    @contextlib.contextmanager
+    def step(self):
+        program = self.helper.main_program
+        self._parent = program.current_block()
+        self._block = program._create_block()
+        try:
+            yield
+        finally:
+            program._rollback()
+            self._finalize()
+
+    def step_input(self, x):
+        """x: (T, B, ...) — sliced along axis 0 each step."""
+        if self._seq_len is None:
+            self._seq_len = x.shape[0] if x.shape else None
+        inner = self._block.create_var(
+            name=self.helper.name + ".in." + x.name, dtype=x.dtype,
+            shape=tuple(x.shape[1:]))
+        self._step_inputs.append([x.name, inner.name])
+        return inner
+
+    def memory(self, init=None, shape=None, batch_ref=None, value=0.0,
+               dtype="float32"):
+        if init is None:
+            if shape is None:
+                raise ValueError("StaticRNN.memory needs init or shape")
+            # The init constant belongs OUTSIDE the scan body: swap the
+            # program's current block to the parent while building it.
+            program = self.helper.main_program
+            saved = program.current_block_idx
+            program.current_block_idx = self._parent.idx
+            try:
+                init = tensor_layers.fill_constant(shape, dtype, value)
+            finally:
+                program.current_block_idx = saved
+        inner = self._block.create_var(
+            name=self.helper.name + ".mem." + init.name, dtype=init.dtype,
+            shape=tuple(init.shape))
+        self._memories.append([inner.name, init.name, None])
+        return inner
+
+    def update_memory(self, mem, var):
+        for m in self._memories:
+            if m[0] == mem.name:
+                m[2] = var.name
+                return
+        raise ValueError(f"{mem.name} is not a memory of this StaticRNN")
+
+    def step_output(self, o):
+        self._step_outputs.append(o.name)
+        ov = self._parent.create_var(
+            name=self.helper.name + ".out." + o.name, dtype=o.dtype,
+            shape=(self._seq_len,) + tuple(o.shape))
+        self._out_vars.append(ov)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _finalize(self):
+        for m in self._memories:
+            if m[2] is None:
+                raise ValueError("StaticRNN memory never updated "
+                                 "(call update_memory)")
+        last_vars = []
+        for inner, init_n, _ in self._memories:
+            iv = self._parent._find_var_recursive(init_n)
+            lv = self._parent.create_var(
+                name=self.helper.name + ".last." + inner, dtype=iv.dtype,
+                shape=tuple(iv.shape))
+            last_vars.append(lv)
+        self._last_vars = last_vars
+        in_vars = [self._parent._find_var_recursive(n)
+                   for n, _ in self._step_inputs]
+        init_vars = [self._parent._find_var_recursive(n)
+                     for _, n, _ in self._memories]
+        self._parent.append_op(
+            "static_rnn",
+            {"X": in_vars, "Init": init_vars,
+             "Free": _free_vars([self._block], self._parent)},
+            {"Out": self._out_vars + last_vars},
+            {"sub_block": self._block.idx,
+             "step_inputs": self._step_inputs,
+             "memories": self._memories,
+             "step_outputs": self._step_outputs})
+
+    def __call__(self):
+        if len(self._out_vars) == 1:
+            return self._out_vars[0]
+        return self._out_vars
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Parity: fluid.layers.autoincreased_step_counter — persistable int64
+    counter bumped once per Executor.run (the @LR_DECAY_COUNTER@ mechanism)."""
+    helper = LayerHelper("global_step_counter")
+    name = counter_name or "@STEP_COUNTER@"
+    counter = helper.create_or_get_global_variable(
+        name, dtype="int64", shape=(1,), persistable=True)
+    if counter.op is None:
+        from .. import initializer as init_mod
+        init_mod.ConstantInitializer(float(begin - step))(counter)
+        helper.main_program.global_block().prepend_op(
+            "increment", {"X": counter}, {"Out": counter},
+            {"step": float(step)})
+        counter.op = helper.main_program.global_block().ops[0]
+        counter.stop_gradient = True
+    return counter

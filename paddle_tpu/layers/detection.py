@@ -1,0 +1,228 @@
+"""Detection layers.
+
+Parity: python/paddle/fluid/layers/detection.py (prior_box, multi_box_head,
+box_coder, iou_similarity, multiclass_nms, yolo_box, roi_pool/align,
+ssd_loss). Kernels in ops/detection_ops.py. Variable-length outputs
+(NMS keeps) are static-shape padded with -1 rows — the TPU replacement for
+LoD outputs.
+"""
+
+from ..core.layer_helper import LayerHelper
+
+__all__ = ["prior_box", "density_prior_box", "box_coder", "iou_similarity",
+           "multiclass_nms", "yolo_box", "roi_pool", "roi_align",
+           "psroi_pool", "ssd_loss", "multi_box_head", "detection_output"]
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.0],
+              variance=[0.1, 0.1, 0.2, 0.2], flip=False, clip=False,
+              steps=[0.0, 0.0], offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    helper = LayerHelper("prior_box", name=name)
+    boxes = helper.create_variable_for_type_inference(input.dtype)
+    variances = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("prior_box", {"Input": input, "Image": image},
+                     {"Boxes": boxes, "Variances": variances},
+                     {"min_sizes": list(min_sizes),
+                      "max_sizes": list(max_sizes or []),
+                      "aspect_ratios": list(aspect_ratios),
+                      "variances": list(variance), "flip": flip,
+                      "clip": clip, "step_w": steps[0], "step_h": steps[1],
+                      "offset": offset})
+    return boxes, variances
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=[0.1, 0.1, 0.2, 0.2],
+                      clip=False, steps=[0.0, 0.0], offset=0.5,
+                      flatten_to_2d=False, name=None):
+    helper = LayerHelper("density_prior_box", name=name)
+    boxes = helper.create_variable_for_type_inference(input.dtype)
+    variances = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("density_prior_box", {"Input": input, "Image": image},
+                     {"Boxes": boxes, "Variances": variances},
+                     {"densities": list(densities or [1]),
+                      "fixed_sizes": list(fixed_sizes or []),
+                      "fixed_ratios": list(fixed_ratios or [1.0]),
+                      "variances": list(variance), "clip": clip,
+                      "step_w": steps[0], "step_h": steps[1],
+                      "offset": offset})
+    return boxes, variances
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    helper = LayerHelper("box_coder", name=name)
+    out = helper.create_variable_for_type_inference(target_box.dtype)
+    inputs = {"PriorBox": prior_box, "TargetBox": target_box}
+    if prior_box_var is not None and not isinstance(prior_box_var, (list, tuple)):
+        inputs["PriorBoxVar"] = prior_box_var
+    helper.append_op("box_coder", inputs, {"Out": out},
+                     {"code_type": code_type.lower(),
+                      "box_normalized": box_normalized, "axis": axis})
+    return out
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    helper = LayerHelper("iou_similarity", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("iou_similarity", {"X": x, "Y": y}, {"Out": out},
+                     {"box_normalized": box_normalized})
+    return out
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.01, nms_top_k=64,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, name=None):
+    """Static-shape NMS: returns (N, keep_top_k, 6) padded with -1."""
+    helper = LayerHelper("multiclass_nms", name=name)
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    helper.append_op("multiclass_nms", {"BBoxes": bboxes, "Scores": scores},
+                     {"Out": out},
+                     {"score_threshold": score_threshold,
+                      "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+                      "nms_threshold": nms_threshold,
+                      "background_label": background_label})
+    return out
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, name=None):
+    helper = LayerHelper("yolo_box", name=name)
+    boxes = helper.create_variable_for_type_inference(x.dtype)
+    scores = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("yolo_box", {"X": x, "ImgSize": img_size},
+                     {"Boxes": boxes, "Scores": scores},
+                     {"anchors": list(anchors), "class_num": class_num,
+                      "conf_thresh": conf_thresh,
+                      "downsample_ratio": downsample_ratio,
+                      "clip_bbox": clip_bbox})
+    return boxes, scores
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0,
+             name=None):
+    helper = LayerHelper("roi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("roi_pool", {"X": input, "ROIs": rois}, {"Out": out},
+                     {"pooled_height": pooled_height,
+                      "pooled_width": pooled_width,
+                      "spatial_scale": spatial_scale})
+    return out
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, name=None):
+    helper = LayerHelper("roi_align", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("roi_align", {"X": input, "ROIs": rois}, {"Out": out},
+                     {"pooled_height": pooled_height,
+                      "pooled_width": pooled_width,
+                      "spatial_scale": spatial_scale,
+                      "sampling_ratio": sampling_ratio})
+    return out
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, name=None):
+    helper = LayerHelper("psroi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("psroi_pool", {"X": input, "ROIs": rois}, {"Out": out},
+                     {"output_channels": output_channels,
+                      "spatial_scale": spatial_scale,
+                      "pooled_height": pooled_height,
+                      "pooled_width": pooled_width})
+    return out
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True, sample_size=None,
+             name=None):
+    helper = LayerHelper("ssd_loss", name=name)
+    out = helper.create_variable_for_type_inference(location.dtype)
+    helper.append_op("ssd_loss",
+                     {"Location": location, "Confidence": confidence,
+                      "GtBox": gt_box, "GtLabel": gt_label,
+                      "PriorBox": prior_box},
+                     {"Out": out},
+                     {"overlap_threshold": overlap_threshold,
+                      "neg_pos_ratio": neg_pos_ratio,
+                      "background_label": background_label})
+    return out
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=[0.1, 0.1, 0.2, 0.2], flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """Parity: fluid.layers.multi_box_head — SSD heads over multiple
+    feature maps: per-map 3x3 convs predicting loc (4A) + conf (CA), plus
+    the matching prior boxes, all flattened and concatenated."""
+    from . import nn as nn_layers
+    if min_sizes is None:
+        # reference formula: evenly spaced ratios between min_ratio/max_ratio
+        num_layer = len(inputs)
+        min_sizes, max_sizes = [], []
+        step = int((max_ratio - min_ratio) / (num_layer - 2))
+        min_sizes = [base_size * 0.1]
+        max_sizes = [base_size * 0.2]
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, feat in enumerate(inputs):
+        ms = min_sizes[i]
+        ms = ms if isinstance(ms, (list, tuple)) else [ms]
+        Ms = max_sizes[i] if max_sizes else []
+        Ms = Ms if isinstance(Ms, (list, tuple)) else [Ms]
+        ar = aspect_ratios[i]
+        ar = ar if isinstance(ar, (list, tuple)) else [ar]
+        box, var = prior_box(feat, image, ms, Ms, ar, variance, flip, clip,
+                             steps=[step_w[i] if step_w else 0.0,
+                                    step_h[i] if step_h else 0.0],
+                             offset=offset)
+        num_priors = 1
+        full_ar = []
+        for a in ar:
+            full_ar.append(a)
+            if flip and a != 1.0:
+                full_ar.append(1.0 / a)
+        num_priors = len(ms) * len(full_ar) + len(ms) * len(Ms)
+        loc = nn_layers.conv2d(feat, num_priors * 4, kernel_size,
+                               padding=pad, stride=stride)
+        conf = nn_layers.conv2d(feat, num_priors * num_classes, kernel_size,
+                                padding=pad, stride=stride)
+        n = feat.shape[0]
+        loc = nn_layers.reshape(nn_layers.transpose(loc, [0, 2, 3, 1]),
+                                [n, -1, 4])
+        conf = nn_layers.reshape(nn_layers.transpose(conf, [0, 2, 3, 1]),
+                                 [n, -1, num_classes])
+        locs.append(loc)
+        confs.append(conf)
+        boxes_all.append(nn_layers.reshape(box, [-1, 4]))
+        vars_all.append(nn_layers.reshape(var, [-1, 4]))
+    mbox_locs = nn_layers.concat(locs, axis=1)
+    mbox_confs = nn_layers.concat(confs, axis=1)
+    boxes = nn_layers.concat(boxes_all, axis=0)
+    variances = nn_layers.concat(vars_all, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
+    """Parity: fluid.layers.detection_output — decode + softmax + NMS."""
+    from . import nn as nn_layers
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    probs = nn_layers.softmax(scores, axis=-1)
+    probs_t = nn_layers.transpose(probs, [0, 2, 1])  # (N, C, M)
+    return multiclass_nms(decoded, probs_t, score_threshold, nms_top_k,
+                          keep_top_k, nms_threshold,
+                          background_label=background_label)

@@ -25,3 +25,74 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
 def fluid_data(name, shape, dtype="float32", lod_level=0):
     """Parity: fluid.data (2.x-style, no implicit batch dim)."""
     return data(name, shape, dtype, lod_level, append_batch_size=False)
+
+
+class PyReader:
+    """Parity: fluid.io.PyReader / layers.py_reader (reader/read ops +
+    C++ double-buffer queue). TPU-native: the async prefetch lives in
+    paddle_tpu.reader.DataLoader (csrc/prefetch.cc ring); this object just
+    owns feed slots and iterates a decorated reader into feed dicts."""
+
+    def __init__(self, feed_list, capacity=64, use_double_buffer=True,
+                 iterable=True):
+        self.feed_list = list(feed_list)
+        self.capacity = capacity
+        self._reader = None
+        self._places = None
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        self._reader = reader
+        self._places = places
+
+    decorate_batch_generator = decorate_sample_list_generator
+    decorate_paddle_reader = decorate_sample_list_generator
+
+    def __iter__(self):
+        from ..reader.dataloader import DataLoader
+        if self._reader is None:
+            raise RuntimeError("PyReader: call decorate_*_generator first")
+        loader = DataLoader(self.feed_list, capacity=self.capacity)
+        loader.set_batch_generator(self._reader, self._places)
+        names = [v.name for v in self.feed_list]
+        for batch in loader:
+            if isinstance(batch, dict):
+                yield batch
+            else:
+                yield dict(zip(names, batch))
+
+    def start(self):
+        pass
+
+    def reset(self):
+        pass
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """Parity: fluid.layers.py_reader — returns a PyReader whose feed
+    slots are freshly created data vars."""
+    from ..core import unique_name
+    base = name if name is not None else unique_name.generate("py_reader")
+    feed_list = []
+    for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
+        feed_list.append(fluid_data(
+            name=f"{base}_slot_{i}", shape=shape, dtype=dtype))
+    return PyReader(feed_list, capacity, use_double_buffer)
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    """Parity: fluid.layers.create_py_reader_by_data."""
+    return PyReader(feed_list, capacity, use_double_buffer)
+
+
+def double_buffer(reader, place=None, name=None):
+    """Parity: fluid.layers.double_buffer — on TPU, device prefetch is owned
+    by reader.DataLoader; this is an identity marker for API compat."""
+    return reader
+
+
+def read_file(reader):
+    """Parity: fluid.layers.read_file — with PyReader feeding feed dicts,
+    the feed slots ARE the read results."""
+    return reader.feed_list

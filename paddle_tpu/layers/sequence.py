@@ -1,0 +1,182 @@
+"""Sequence layers — the LoD family with static shapes.
+
+Parity: python/paddle/fluid/layers/sequence_lod.py / nn.py sequence_* APIs.
+paddle_tpu convention (SURVEY.md §1 decision 4): data is ``(batch, max_len,
+...)`` padded, raggedness travels as an explicit int32 ``length`` tensor
+(instead of LoD offsets riding inside the tensor). Kernels mask/segment-
+reduce (ops/sequence_ops.py) — the XLA-friendly formulation.
+"""
+
+from ..core.layer_helper import LayerHelper
+
+__all__ = [
+    "sequence_mask", "sequence_pad", "sequence_unpad", "sequence_pool",
+    "sequence_first_step", "sequence_last_step", "sequence_softmax",
+    "sequence_expand", "sequence_expand_as", "sequence_reverse",
+    "sequence_conv", "sequence_concat", "sequence_slice",
+    "sequence_enumerate", "sequence_reshape",
+]
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """Parity: fluid.layers.sequence_mask. x: (B,) lengths -> (B, maxlen)."""
+    helper = LayerHelper("sequence_mask", name=name)
+    static_maxlen = maxlen if isinstance(maxlen, int) else 0
+    n = x.shape[0] if x.shape else 0
+    out = helper.create_variable_for_type_inference(dtype,
+                                                    (n, static_maxlen))
+    helper.append_op("sequence_mask", {"X": x}, {"Y": out},
+                     {"maxlen": static_maxlen or -1,
+                      "static_maxlen": static_maxlen, "out_dtype": dtype})
+    return out
+
+
+def _seq_op(op_type, x, length, extra_inputs=None, attrs=None, n_outs=1,
+            out_shape=None, out_dtype=None, out_slots=("Out",)):
+    helper = LayerHelper(op_type)
+    inputs = {"X": x}
+    if length is not None:
+        inputs["Length"] = length
+    inputs.update(extra_inputs or {})
+    outs = [helper.create_variable_for_type_inference(
+        out_dtype or (x.dtype if not isinstance(x, (list, tuple)) else x[0].dtype),
+        out_shape) for _ in range(n_outs)]
+    helper.append_op(op_type, inputs,
+                     {slot: o for slot, o in zip(out_slots, outs)},
+                     attrs or {})
+    return outs[0] if n_outs == 1 else outs
+
+
+def _full_length(helper, x):
+    """Default lengths = max_len for every row (un-ragged batch)."""
+    from . import tensor as tensor_layers
+    return tensor_layers.fill_constant((x.shape[0],), "int32", x.shape[1])
+
+
+def sequence_pool(input, pool_type, length=None, is_test=False, pad_value=0.0):
+    """Parity: fluid.layers.sequence_pool. input (B, T, D) + lengths ->
+    (B, D)."""
+    helper = LayerHelper("sequence_pool")
+    if length is None:
+        length = _full_length(helper, input)
+    out, _ = _seq_op("sequence_pool", input, length,
+                     attrs={"pooltype": pool_type.upper()}, n_outs=2,
+                     out_shape=(input.shape[0],) + tuple(input.shape[2:]),
+                     out_slots=("Out", "MaxIndex"))
+    return out
+
+
+def sequence_first_step(input, length=None):
+    return sequence_pool(input, "first", length)
+
+
+def sequence_last_step(input, length=None):
+    return sequence_pool(input, "last", length)
+
+
+def sequence_softmax(input, length=None, use_cudnn=False, name=None):
+    helper = LayerHelper("sequence_softmax", name=name)
+    if length is None:
+        length = _full_length(helper, input)
+    return _seq_op("sequence_softmax", input, length,
+                   out_shape=tuple(input.shape))
+
+
+def sequence_reverse(x, length=None, name=None):
+    helper = LayerHelper("sequence_reverse", name=name)
+    if length is None:
+        length = _full_length(helper, x)
+    return _seq_op("sequence_reverse", x, length, out_shape=tuple(x.shape),
+                   out_slots=("Y",))
+
+
+def sequence_pad(x, pad_value=None, maxlen=None, length=None, name=None):
+    """Parity: fluid.layers.sequence_pad. Data is already padded in the
+    paddle_tpu convention; this validates and returns (x, length)."""
+    helper = LayerHelper("sequence_pad", name=name)
+    if length is None:
+        length = _full_length(helper, x)
+    out, out_len = _seq_op("sequence_pad", x, length, n_outs=2,
+                           out_shape=tuple(x.shape),
+                           out_slots=("Out", "Length"))
+    return out, length
+
+
+def sequence_unpad(x, length, name=None):
+    """Zeroes padding positions (static-shape 'unpad')."""
+    return _seq_op("sequence_unpad", x, length, out_shape=tuple(x.shape))
+
+
+def sequence_expand(x, y, ref_level=-1, static_repeat=0, name=None):
+    """Parity: fluid.layers.sequence_expand. Static variant: each row of x
+    repeats `static_repeat` times (or y's per-row count at trace time)."""
+    helper = LayerHelper("sequence_expand", name=name)
+    n = x.shape[0] * static_repeat if static_repeat else x.shape[0]
+    out = helper.create_variable_for_type_inference(
+        x.dtype, (n,) + tuple(x.shape[1:]))
+    helper.append_op("sequence_expand", {"X": x, "YLength": y}, {"Out": out},
+                     {"ref_level": ref_level, "static_repeat": static_repeat})
+    return out
+
+
+def sequence_expand_as(x, y, name=None):
+    helper = LayerHelper("sequence_expand_as", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("sequence_expand_as", {"X": x, "Y": y}, {"Out": out})
+    return out
+
+
+def sequence_concat(input, name=None):
+    """Concat along the time axis."""
+    helper = LayerHelper("sequence_concat", name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op("sequence_concat", {"X": list(input)}, {"Out": out})
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper("sequence_slice", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("sequence_slice", {"X": input}, {"Out": out},
+                     {"static_offset": int(offset), "static_length": int(length)})
+    return out
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, param_attr=None,
+                  bias_attr=None, act=None, name=None):
+    """Parity: fluid.layers.sequence_conv — context-window projection."""
+    helper = LayerHelper("sequence_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    d = input.shape[-1]
+    w = helper.create_parameter(helper.param_attr,
+                                [filter_size * d, num_filters], input.dtype)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, tuple(input.shape[:2]) + (num_filters,))
+    start = padding_start if padding_start is not None else -(filter_size // 2)
+    helper.append_op("sequence_conv", {"X": input, "Filter": w}, {"Out": out},
+                     {"contextLength": filter_size, "contextStart": start,
+                      "contextStride": filter_stride})
+    pre_act = out
+    bias_attr = helper.bias_attr
+    if bias_attr is not False:
+        from .nn import _append_bias
+        pre_act = _append_bias(helper, out, num_filters, dim_start=2)
+    return helper.append_activation(pre_act)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    helper = LayerHelper("sequence_enumerate", name=name)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, tuple(input.shape) + (win_size,))
+    helper.append_op("sequence_enumerate", {"X": input}, {"Out": out},
+                     {"win_size": win_size, "pad_value": pad_value})
+    return out
+
+
+def sequence_reshape(input, new_dim, name=None):
+    helper = LayerHelper("sequence_reshape", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("sequence_reshape", {"X": input}, {"Out": out},
+                     {"new_dim": new_dim})
+    return out

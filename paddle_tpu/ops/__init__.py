@@ -113,3 +113,6 @@ from . import control_flow_ops  # noqa: E402,F401
 from . import collective_ops  # noqa: E402,F401
 from . import metric_ops      # noqa: E402,F401
 from . import detection_ops   # noqa: E402,F401
+from . import rnn_ops         # noqa: E402,F401
+from . import attention_ops   # noqa: E402,F401
+from . import beam_search_ops  # noqa: E402,F401

@@ -1,0 +1,115 @@
+"""Attention ops.
+
+Parity: the reference composes attention from matmul/softmax primitives
+(python/paddle/fluid/layers/nn.py scaled_dot_product_attention and the
+book/machine-translation transformer recipe); there is no fused CUDA kernel
+in Fluid 1.5. Here attention IS a first-class op so the executor can route
+it to a fused Pallas flash-attention kernel on TPU (ops/pallas/flash.py)
+— O(T) memory, blockwise softmax in VMEM — with a pure-XLA fallback
+everywhere else.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from . import register
+
+
+def _use_pallas():
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _xla_attention(q, k, v, bias=None, scale=None, causal=False):
+    """Reference-path attention: (B, H, T, D) q/k/v. XLA fuses the softmax
+    chain; fine for CPU tests and a correctness oracle for the Pallas path."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if bias is not None:
+        logits = logits + bias
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), jnp.bool_), k=tk - tq)
+        logits = jnp.where(mask, logits, jnp.asarray(-1e9, logits.dtype))
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def dot_product_attention(q, k, v, bias=None, scale=None, causal=False):
+    """Dispatch: Pallas flash kernel on TPU, XLA composition elsewhere."""
+    if _use_pallas():
+        try:
+            from .pallas.flash import flash_attention
+            return flash_attention(q, k, v, bias=bias, scale=scale,
+                                   causal=causal)
+        except Exception:
+            pass
+    return _xla_attention(q, k, v, bias=bias, scale=scale, causal=causal)
+
+
+@register("scaled_dot_product_attention")
+def scaled_dot_product_attention_op(ctx):
+    """Q/K/V: (B, H, T, D). Optional Bias broadcastable to (B, H, Tq, Tk)."""
+    q, k, v = ctx.in_("Q"), ctx.in_("K"), ctx.in_("V")
+    bias = ctx.in_("Bias")
+    out = dot_product_attention(
+        q, k, v, bias=bias, scale=ctx.attr("scale"),
+        causal=bool(ctx.attr("causal", False)))
+    return {"Out": out}
+
+
+@register("multihead_attention")
+def multihead_attention_op(ctx):
+    """Fused projections + attention. Inputs: Query (B, Tq, M),
+    Key/Value (B, Tk, M), packed weights WQ/WK/WV (M, M), WO (M, M),
+    optional biases and attention Bias. num_heads attr splits M."""
+    q_in = ctx.in_("Query")
+    k_in = ctx.in_("Key")
+    v_in = ctx.in_("Value")
+    k_in = q_in if k_in is None else k_in
+    v_in = k_in if v_in is None else v_in
+    n_heads = ctx.attr("num_heads")
+    wq, wk, wv, wo = (ctx.in_("WQ"), ctx.in_("WK"), ctx.in_("WV"),
+                      ctx.in_("WO"))
+    bq, bk, bv, bo = (ctx.in_("BQ"), ctx.in_("BK"), ctx.in_("BV"),
+                      ctx.in_("BO"))
+    bias = ctx.in_("Bias")
+
+    def proj(x, w, b):
+        y = x @ w
+        return y if b is None else y + b
+
+    def split_heads(x):
+        b_, t, m = x.shape
+        return x.reshape(b_, t, n_heads, m // n_heads).transpose(0, 2, 1, 3)
+
+    q = split_heads(proj(q_in, wq, bq))
+    k = split_heads(proj(k_in, wk, bk))
+    v = split_heads(proj(v_in, wv, bv))
+    o = dot_product_attention(q, k, v, bias=bias,
+                              causal=bool(ctx.attr("causal", False)))
+    b_, h, t, d = o.shape
+    o = o.transpose(0, 2, 1, 3).reshape(b_, t, h * d)
+    return {"Out": proj(o, wo, bo)}
+
+
+@register("add_position_encoding")
+def add_position_encoding(ctx):
+    """Parity: paddle/fluid/operators/add_position_encoding_op.h —
+    out = alpha * x + beta * sinusoid(position)."""
+    x = ctx.in_("X")  # (B, T, D)
+    alpha = ctx.attr("alpha", 1.0)
+    beta = ctx.attr("beta", 1.0)
+    b, t, d = x.shape
+    half = d // 2
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / half)
+    enc = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)], axis=-1)
+    if enc.shape[-1] < d:  # odd d
+        enc = jnp.pad(enc, ((0, 0), (0, d - enc.shape[-1])))
+    return {"Out": alpha * x + beta * enc[None].astype(x.dtype)}

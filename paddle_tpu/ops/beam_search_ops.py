@@ -1,0 +1,93 @@
+"""Beam-search ops.
+
+Parity: paddle/fluid/operators/beam_search_op.cc + beam_search_decode_op.cc.
+The reference implements beam search as LoD-tensor surgery inside a While
+block (variable beam widths per source, pruned via LoD offsets). The
+TPU-native design keeps everything static-shape: beams are a dense
+(batch, beam) lane, finished beams are masked (score frozen at their final
+value), and the whole decode is a single ``lax.scan``/``while_loop`` — no
+host round-trips per step.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import register
+
+NEG_INF = -1e9
+
+
+def beam_search_step(log_probs, beam_scores, finished, beam_size, end_id,
+                     length_penalty=0.0, step=None):
+    """One expansion: (B, K, V) token log-probs + (B, K) running scores
+    -> top-K of the K*V continuations.
+
+    Returns (new_scores, parent_idx (B, K), token_ids (B, K), finished).
+    Finished beams only propose <end> (score unchanged) so they occupy
+    exactly one slot, the static-shape analogue of the reference's LoD prune.
+    """
+    b, k, v = log_probs.shape
+    # Finished beams: freeze — only continuation is <end> with prob 1.
+    frozen = jnp.full((b, k, v), NEG_INF, log_probs.dtype)
+    frozen = frozen.at[:, :, end_id].set(0.0)
+    log_probs = jnp.where(finished[..., None], frozen, log_probs)
+    cand = beam_scores[..., None] + log_probs  # (B, K, V)
+    flat = cand.reshape(b, k * v)
+    new_scores, flat_idx = jax.lax.top_k(flat, k)
+    parent = flat_idx // v
+    tokens = flat_idx % v
+    new_finished = jnp.take_along_axis(finished, parent, axis=1) | (tokens == end_id)
+    return new_scores, parent, tokens, new_finished
+
+
+@register("beam_search")
+def beam_search_op(ctx):
+    """Single-step parity op. Inputs: Scores (B*K, V) post-softmax probs
+    (fluid feeds probs; we take log inside), PreScores (B*K, 1),
+    PreIds (B*K, 1). Attr beam_size, end_id."""
+    scores = ctx.in_("Scores")
+    pre_scores = ctx.in_("PreScores")
+    pre_ids = ctx.in_("PreIds")
+    k = ctx.attr("beam_size")
+    end_id = ctx.attr("end_id")
+    v = scores.shape[-1]
+    b = scores.shape[0] // k
+    log_probs = jnp.log(jnp.maximum(scores, 1e-20)).reshape(b, k, v)
+    beam_scores = pre_scores.reshape(b, k)
+    finished = (pre_ids.reshape(b, k) == end_id)
+    new_scores, parent, tokens, _ = beam_search_step(
+        log_probs, beam_scores, finished, k, end_id)
+    batch_offset = (jnp.arange(b) * k)[:, None]
+    return {"SelectedIds": tokens.reshape(b * k, 1).astype(jnp.int64),
+            "SelectedScores": new_scores.reshape(b * k, 1),
+            "ParentIdx": (parent + batch_offset).reshape(b * k).astype(jnp.int32)}
+
+
+@register("beam_search_decode")
+def beam_search_decode_op(ctx):
+    """Backtrack stacked (T, B, K) ids/parents into final sequences.
+
+    Inputs: Ids (T, B, K) int tokens, ParentIdx (T, B, K), Scores (B, K).
+    Outputs: SentenceIds (B, K, T) backtracked, SentenceScores (B, K).
+    """
+    ids = ctx.in_("Ids")
+    parents = ctx.in_("ParentIdx")
+    scores = ctx.in_("Scores")
+    t, b, k = ids.shape
+
+    # Parents may arrive in either convention: per-batch beam index [0, K)
+    # or flattened (batch*K + beam) as the beam_search op emits for
+    # flat-tensor gathers. Reduce mod K to per-batch.
+    parents = parents % k
+
+    def back(carry, inp):
+        beam_ptr = carry  # (B, K) which beam each final lane follows
+        ids_t, par_t = inp
+        tok = jnp.take_along_axis(ids_t, beam_ptr, axis=1)
+        beam_ptr = jnp.take_along_axis(par_t, beam_ptr, axis=1)
+        return beam_ptr, tok
+
+    init = jnp.tile(jnp.arange(k)[None], (b, 1))
+    _, toks = jax.lax.scan(back, init, (ids[::-1], parents[::-1]))
+    seqs = jnp.transpose(toks[::-1], (1, 2, 0))  # (B, K, T)
+    return {"SentenceIds": seqs, "SentenceScores": scores}

@@ -81,3 +81,131 @@ def py_func(ctx):
     out_var = ctx.out_var("Out")
     shape_dtype = jax.ShapeDtypeStruct(tuple(out_var.shape), out_var.dtype)
     return {"Out": jax.pure_callback(fn, shape_dtype, *xs)}
+
+
+# ---------------------------------------------------------------------------
+# Structured control flow: sub-block ops lowered to lax primitives.
+#
+# Parity: paddle/fluid/operators/controlflow/while_op.cc and
+# conditional_block_op.cc execute their sub-BlockDesc with a nested C++
+# Executor per iteration/branch. TPU-first, the sub-block is *traced* into
+# the SAME XLA graph as the parent: While -> lax.while_loop, cond ->
+# lax.cond, StaticRNN -> lax.scan. Loop state ("carry") is exactly the set
+# of parent-block variables the sub-block writes; block-local temporaries
+# stay local to the body trace.
+# ---------------------------------------------------------------------------
+
+
+def _run_block(block, env, program, is_test):
+    from . import run_op
+    for op in block.ops:
+        run_op(op, env, program, is_test)
+
+
+@register("while")
+def while_op(ctx):
+    import jax
+    prog = ctx.program
+    block = prog.blocks[ctx.attr("sub_block")]
+    carry_names = list(ctx.attr("carry_names"))
+    cond_name = ctx.attr("cond_name")
+    outer = dict(ctx.env)
+
+    def cond_fun(carry):
+        return carry[cond_name].reshape(()).astype(bool)
+
+    def body_fun(carry):
+        env2 = dict(outer)
+        env2.update(carry)
+        _run_block(block, env2, prog, ctx.is_test)
+        return {n: env2[n] for n in carry_names}
+
+    init = {n: outer[n] for n in carry_names}
+    out = jax.lax.while_loop(cond_fun, body_fun, init)
+    return {"Out": [out[n] for n in carry_names]}
+
+
+@register("cond_pair")
+def cond_pair(ctx):
+    import jax
+    prog = ctx.program
+    tb = prog.blocks[ctx.attr("true_block")]
+    fb = prog.blocks[ctx.attr("false_block")]
+    t_outs = list(ctx.attr("true_outs"))
+    f_outs = list(ctx.attr("false_outs"))
+    outer = dict(ctx.env)
+
+    def branch(block, names):
+        def fn(_):
+            env2 = dict(outer)
+            _run_block(block, env2, prog, ctx.is_test)
+            return tuple(env2[n] for n in names)
+        return fn
+
+    pred = ctx.in_("Cond").reshape(()).astype(bool)
+    outs = jax.lax.cond(pred, branch(tb, t_outs), branch(fb, f_outs),
+                        operand=None)
+    return {"Out": list(outs)}
+
+
+@register("static_rnn")
+def static_rnn(ctx):
+    """lax.scan over a sub-block. attrs:
+    step_inputs: [[outer_name, inner_name], ...]  sliced on axis 0
+    memories:    [[inner_name, init_name, updated_name], ...]
+    step_outputs:[inner_name, ...]                 stacked on axis 0
+    """
+    import jax
+    import jax.numpy as jnp
+    prog = ctx.program
+    block = prog.blocks[ctx.attr("sub_block")]
+    step_inputs = ctx.attr("step_inputs")
+    memories = ctx.attr("memories")
+    step_outputs = list(ctx.attr("step_outputs"))
+    outer = dict(ctx.env)
+
+    def body(carry, xs):
+        env2 = dict(outer)
+        for (inner, _, _), c in zip(memories, carry):
+            env2[inner] = c
+        for (_, inner), x_t in zip(step_inputs, xs):
+            env2[inner] = x_t
+        _run_block(block, env2, prog, ctx.is_test)
+        new_carry = tuple(env2[upd] for (_, _, upd) in memories)
+        ys = tuple(env2[o] for o in step_outputs)
+        return new_carry, ys
+
+    init = tuple(outer[init_n] for (_, init_n, _) in memories)
+    xs = tuple(outer[outer_n] for (outer_n, _) in step_inputs)
+    last_carry, ys = jax.lax.scan(body, init, xs)
+    outs = list(ys) + [c for c in last_carry]
+    return {"Out": outs}
+
+
+@register("switch")
+def switch_op(ctx):
+    """Sequential guarded blocks (fluid.layers.Switch). attrs:
+    cases: [[cond_name_or_None, block_idx], ...]; target_names: vars each
+    case may assign. First true case wins — lowered to nested selects with
+    a running 'done' mask, all branches traced (sizes are tiny: Switch is
+    the LR-schedule construct)."""
+    import jax.numpy as jnp
+    prog = ctx.program
+    cases = ctx.attr("cases")
+    targets = list(ctx.attr("target_names"))
+    env = dict(ctx.env)
+    done = jnp.asarray(False)
+    current = {n: env[n] for n in targets}
+    for cond_name, block_idx in cases:
+        env2 = dict(env)
+        _run_block(prog.blocks[block_idx], env2, prog, ctx.is_test)
+        if cond_name is None:
+            take = jnp.logical_not(done)
+        else:
+            take = jnp.logical_and(env[cond_name].reshape(()).astype(bool),
+                                   jnp.logical_not(done))
+            done = jnp.logical_or(done, env[cond_name].reshape(()).astype(bool))
+        for n in targets:
+            if n in env2:
+                current[n] = jnp.where(take, env2[n], current[n])
+    return {"Out": [current[n] for n in targets]}

@@ -200,3 +200,172 @@ def prior_box(ctx):
         priors = jnp.clip(priors, 0.0, 1.0)
     var = jnp.broadcast_to(jnp.asarray(variances), priors.shape)
     return {"Boxes": priors, "Variances": var}
+
+
+@register("density_prior_box")
+def density_prior_box(ctx):
+    """Parity: paddle/fluid/operators/detection/density_prior_box_op.cc —
+    dense grids of fixed-size priors per cell (PyramidBox-style)."""
+    inp = ctx.in_("Input")
+    image = ctx.in_("Image")
+    fixed_sizes = ctx.attr("fixed_sizes", [])
+    fixed_ratios = ctx.attr("fixed_ratios", [1.0])
+    densities = ctx.attr("densities", [1])
+    variances = ctx.attr("variances", [0.1, 0.1, 0.2, 0.2])
+    clip = ctx.attr("clip", False)
+    step_w = ctx.attr("step_w", 0.0)
+    step_h = ctx.attr("step_h", 0.0)
+    offset = ctx.attr("offset", 0.5)
+    h, w = inp.shape[2], inp.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+    sw = step_w or img_w / w
+    sh = step_h or img_h / h
+    cx = (jnp.arange(w) + offset) * sw
+    cy = (jnp.arange(h) + offset) * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    out = []
+    for size, density in zip(fixed_sizes, densities):
+        shift_w = sw / density
+        shift_h = sh / density
+        for ratio in fixed_ratios:
+            bw = size * (ratio ** 0.5)
+            bh = size / (ratio ** 0.5)
+            for di in range(density):
+                for dj in range(density):
+                    ccx = cxg - sw / 2.0 + shift_w / 2.0 + dj * shift_w
+                    ccy = cyg - sh / 2.0 + shift_h / 2.0 + di * shift_h
+                    out.append(jnp.stack(
+                        [(ccx - bw / 2.0) / img_w, (ccy - bh / 2.0) / img_h,
+                         (ccx + bw / 2.0) / img_w, (ccy + bh / 2.0) / img_h],
+                        axis=-1))
+    priors = jnp.stack(out, axis=2)  # (H, W, num_priors, 4)
+    if clip:
+        priors = jnp.clip(priors, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances), priors.shape)
+    return {"Boxes": priors, "Variances": var}
+
+
+def _nms_single(boxes, scores, score_thresh, nms_thresh, top_k):
+    """Static-shape class-wise NMS core: returns (keep_mask, order) for one
+    class. Runs as regular XLA ops (sort + O(K^2) IoU suppress over the
+    top_k candidates) — no host round-trip, TPU-friendly."""
+    k = min(top_k, scores.shape[0])
+    top_scores, order = jax.lax.top_k(scores, k)
+    cand = boxes[order]  # (K, 4)
+    x1, y1, x2, y2 = cand[:, 0], cand[:, 1], cand[:, 2], cand[:, 3]
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    iou = inter / jnp.maximum(area[:, None] + area[None, :] - inter, 1e-10)
+
+    def body(i, keep):
+        sup = (iou[i] > nms_thresh) & keep[i] & (jnp.arange(k) > i)
+        return keep & ~sup
+
+    keep = top_scores > score_thresh
+    keep = jax.lax.fori_loop(0, k, body, keep)
+    return keep, order, top_scores
+
+
+@register("multiclass_nms")
+def multiclass_nms(ctx):
+    """Parity: paddle/fluid/operators/detection/multiclass_nms_op.cc.
+    Static-shape output: (N, keep_top_k, 6) [class, score, x1, y1, x2, y2]
+    padded with -1 rows (the TPU replacement for the reference's LoD
+    variable-length output)."""
+    bboxes = ctx.in_("BBoxes")   # (N, M, 4)
+    scores = ctx.in_("Scores")   # (N, C, M)
+    score_thresh = ctx.attr("score_threshold", 0.01)
+    nms_thresh = ctx.attr("nms_threshold", 0.3)
+    nms_top_k = ctx.attr("nms_top_k", 64)
+    keep_top_k = ctx.attr("keep_top_k", 100)
+    background = ctx.attr("background_label", 0)
+    n, c, m = scores.shape
+
+    def per_image(boxes_i, scores_i):
+        all_scores, all_cls, all_boxes = [], [], []
+        for cls in range(c):
+            if cls == background:
+                continue
+            keep, order, top_scores = _nms_single(
+                boxes_i, scores_i[cls], score_thresh, nms_thresh, nms_top_k)
+            kept_scores = jnp.where(keep, top_scores, -1.0)
+            all_scores.append(kept_scores)
+            all_cls.append(jnp.full_like(kept_scores, cls))
+            all_boxes.append(boxes_i[order])
+        cat_scores = jnp.concatenate(all_scores)
+        cat_cls = jnp.concatenate(all_cls)
+        cat_boxes = jnp.concatenate(all_boxes, axis=0)
+        kk = min(keep_top_k, cat_scores.shape[0])
+        final_scores, idx = jax.lax.top_k(cat_scores, kk)
+        out = jnp.concatenate(
+            [cat_cls[idx][:, None], final_scores[:, None], cat_boxes[idx]],
+            axis=-1)
+        out = jnp.where(final_scores[:, None] > 0, out, -1.0)
+        if kk < keep_top_k:
+            out = jnp.pad(out, ((0, keep_top_k - kk), (0, 0)),
+                          constant_values=-1.0)
+        return out
+
+    return {"Out": jax.vmap(per_image)(bboxes, scores)}
+
+
+@register("ssd_loss")
+def ssd_loss(ctx):
+    """Parity: fluid.layers.ssd_loss (detection/target_assign + conf/loc
+    loss). Simplified static-shape variant: anchors matched to the best gt
+    box by IoU; conf = softmax CE, loc = smooth-l1 on matched anchors."""
+    loc = ctx.in_("Location")        # (N, M, 4) predicted offsets
+    conf = ctx.in_("Confidence")     # (N, M, C) logits
+    gt_box = ctx.in_("GtBox")        # (N, G, 4)
+    gt_label = ctx.in_("GtLabel")    # (N, G)
+    prior = ctx.in_("PriorBox")      # (M, 4)
+    overlap_thresh = ctx.attr("overlap_threshold", 0.5)
+    neg_ratio = ctx.attr("neg_pos_ratio", 3.0)
+
+    def iou_mat(a, b):
+        ix1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+        iy1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+        ix2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+        iy2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+        inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+        aa = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(a[:, 3] - a[:, 1], 0)
+        ab = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(b[:, 3] - b[:, 1], 0)
+        return inter / jnp.maximum(aa[:, None] + ab[None, :] - inter, 1e-10)
+
+    def per_image(loc_i, conf_i, gt_b, gt_l):
+        iou = iou_mat(prior, gt_b)            # (M, G)
+        best_iou = iou.max(axis=1)
+        best_gt = iou.argmax(axis=1)
+        pos = best_iou > overlap_thresh       # (M,)
+        target_label = jnp.where(pos, gt_l[best_gt], 0)
+        # localization target: encode matched gt vs prior (center-size)
+        pw = prior[:, 2] - prior[:, 0]
+        ph = prior[:, 3] - prior[:, 1]
+        pcx = (prior[:, 0] + prior[:, 2]) / 2
+        pcy = (prior[:, 1] + prior[:, 3]) / 2
+        g = gt_b[best_gt]
+        gw = jnp.maximum(g[:, 2] - g[:, 0], 1e-10)
+        gh = jnp.maximum(g[:, 3] - g[:, 1], 1e-10)
+        gcx = (g[:, 0] + g[:, 2]) / 2
+        gcy = (g[:, 1] + g[:, 3]) / 2
+        t = jnp.stack([(gcx - pcx) / pw, (gcy - pcy) / ph,
+                       jnp.log(gw / pw), jnp.log(gh / ph)], axis=-1)
+        diff = jnp.abs(loc_i - t)
+        loc_l = jnp.where(diff < 1.0, 0.5 * diff * diff, diff - 0.5).sum(-1)
+        logp = jax.nn.log_softmax(conf_i, axis=-1)
+        conf_l = -jnp.take_along_axis(logp, target_label[:, None].astype(jnp.int32),
+                                      axis=-1)[:, 0]
+        # hard negative mining: top (neg_ratio * num_pos) negatives by loss
+        num_pos = jnp.maximum(pos.sum(), 1)
+        neg_loss = jnp.where(pos, -jnp.inf, conf_l)
+        rank = jnp.argsort(jnp.argsort(-neg_loss))
+        neg = rank < (neg_ratio * num_pos)
+        total = (jnp.where(pos, loc_l + conf_l, 0.0).sum() +
+                 jnp.where(neg, conf_l, 0.0).sum())
+        return total / num_pos
+
+    return {"Out": jax.vmap(per_image)(loc, conf, gt_box, gt_label)}

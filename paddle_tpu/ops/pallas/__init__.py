@@ -1,0 +1,3 @@
+"""Pallas TPU kernels for the hot ops (flash attention, ring attention
+blocks). Imported lazily — CPU test runs never touch these; the XLA
+fallback in ops/attention_ops.py covers correctness there."""

@@ -1,0 +1,130 @@
+"""Blockwise flash attention for TPU (Pallas).
+
+The reference (Fluid 1.5) composes attention from matmul+softmax CUDA
+kernels, materializing the (Tq, Tk) score matrix in HBM. This kernel is the
+TPU-native replacement: online-softmax over K/V blocks held in VMEM, so HBM
+traffic is O(T*D) instead of O(T^2) and the two matmuls per block ride the
+MXU back-to-back.
+
+Forward is Pallas; backward recomputes through the XLA composition under
+jax.custom_vjp (activation-free attention — the standard flash-training
+memory trade; a full Pallas backward is a later optimization, tracked in
+SURVEY.md §7 R2+).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
+                kv_len):
+    # Block shapes carry the leading mapped dim: q_ref (1, block_q, d),
+    # k_ref/v_ref (1, kv_len, d), o_ref (1, block_q, d).
+    q = q_ref[0].astype(jnp.float32) * scale
+    block_q, d = q.shape
+    q_idx = pl.program_id(1)
+    q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    num_kb = pl.cdiv(kv_len, block_k)
+
+    def body(kb, carry):
+        acc, m_prev, l_prev = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if causal:
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = jnp.where(k_pos < kv_len, s, NEG_INF)
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + p.sum(axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(p, v_blk,
+                                    preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q=128, block_k=128):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    bq = min(block_q, tq)
+    bk = min(block_k, tk)
+    q3 = q.reshape(b * h, tq, d)
+    k3 = k.reshape(b * h, tk, d)
+    v3 = v.reshape(b * h, tk, d)
+    grid = (b * h, pl.cdiv(tq, bq))
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_k=bk, kv_len=tk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, tk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+    )(q3, k3, v3)
+    return out.reshape(b, h, tq, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, scale, causal):
+    return _flash_fwd(q, k, v, scale, causal)
+
+
+def _xla_ref(q, k, v, scale, causal):
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), jnp.bool_), k=tk - tq)
+        logits = jnp.where(mask, logits, NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal):
+    return _flash_fwd(q, k, v, scale, causal), (q, k, v)
+
+
+def _flash_vjp_bwd(scale, causal, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q_, k_, v_: _xla_ref(q_, k_, v_, scale, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, bias=None, scale=None, causal=False):
+    """q/k/v: (B, H, T, D). bias falls back to the XLA path (bias blocks
+    would need their own BlockSpec; rare in the model zoo hot path where
+    masks are causal or padding handled upstream)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    if bias is not None:
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+        logits = logits + bias.astype(jnp.float32)
+        if causal:
+            tq, tk = logits.shape[-2], logits.shape[-1]
+            mask = jnp.tril(jnp.ones((tq, tk), jnp.bool_), k=tk - tq)
+            logits = jnp.where(mask, logits, NEG_INF)
+        p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return _flash(q, k, v, scale, causal)

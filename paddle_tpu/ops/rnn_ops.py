@@ -1,0 +1,189 @@
+"""Recurrent ops: LSTM / GRU cells and full sequences.
+
+Parity: paddle/fluid/operators/lstm_op.cc, gru_op.cc, lstm_unit_op.cc,
+gru_unit_op.cc, dynamic_lstm / dynamic_gru kernels.
+
+TPU-first: the reference runs cuDNN / hand-rolled CUDA recurrences step by
+step on a stream. Here the full-sequence ops are ONE ``lax.scan`` whose body
+is a fused (4h or 3h wide) matmul — the time loop compiles to a single XLA
+While with the gate matmuls on the MXU, and the input projection
+``x @ W_x`` is hoisted OUT of the scan (one big (B*T, D)x(D, 4H) matmul)
+so the sequential part touches only the (H, 4H) recurrent weight.
+
+Ragged batches use a ``Length`` tensor: steps past a row's length carry the
+previous state through unchanged (mask-select in the scan body), the static-
+shape equivalent of LoD-aware kernels.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import register
+
+
+def _len_mask(lengths, t, dtype):
+    # (B, 1) validity of timestep t given per-row lengths
+    return (t < lengths.reshape(-1, 1)).astype(dtype)
+
+
+def _lstm_scan(xw, h0, c0, w_h, bias, lengths, use_peepholes=False,
+               w_peep=None, gate_act=jax.nn.sigmoid, cell_act=jnp.tanh,
+               cand_act=jnp.tanh, reverse=False):
+    """Core LSTM recurrence. xw: (B, T, 4H) pre-projected inputs.
+
+    Gate order follows the fluid convention (lstm_op.h): i, f, c, o.
+    """
+    b, t, four_h = xw.shape
+    h = four_h // 4
+    if bias is not None:
+        xw = xw + bias[: 4 * h]
+    xs = jnp.swapaxes(xw, 0, 1)  # (T, B, 4H) — scan over leading axis
+    if reverse:
+        xs = xs[::-1]
+    steps = jnp.arange(t)
+    if reverse:
+        steps = steps[::-1]
+
+    def body(carry, inp):
+        h_prev, c_prev = carry
+        x_t, step = inp
+        gates = x_t + h_prev @ w_h  # (B, 4H), the only sequential matmul
+        i, f, c_hat, o = jnp.split(gates, 4, axis=-1)
+        if use_peepholes and w_peep is not None:
+            wi, wf, wo = jnp.split(w_peep, 3)
+            i = i + c_prev * wi
+            f = f + c_prev * wf
+        i, f = gate_act(i), gate_act(f)
+        c_new = f * c_prev + i * cand_act(c_hat)
+        if use_peepholes and w_peep is not None:
+            o = o + c_new * wo
+        o = gate_act(o)
+        h_new = o * cell_act(c_new)
+        if lengths is not None:
+            m = _len_mask(lengths, step, h_new.dtype)
+            h_new = m * h_new + (1 - m) * h_prev
+            c_new = m * c_new + (1 - m) * c_prev
+        return (h_new, c_new), (h_new, c_new)
+
+    (h_last, c_last), (hs, cs) = jax.lax.scan(body, (h0, c0), (xs, steps))
+    hs = jnp.swapaxes(hs, 0, 1)  # (B, T, H)
+    cs = jnp.swapaxes(cs, 0, 1)
+    if reverse:
+        hs, cs = hs[:, ::-1], cs[:, ::-1]
+    return hs, cs, h_last, c_last
+
+
+@register("lstm")
+def lstm(ctx):
+    """Full-sequence (possibly ragged) LSTM.
+
+    Inputs: Input (B, T, D), WeightX (D, 4H), WeightH (H, 4H), Bias (4H,)
+    [+ peephole tail (3H,)], optional H0/C0 (B, H), optional Length (B,).
+    Outputs: Hidden (B, T, H), Cell (B, T, H), LastH, LastC.
+    """
+    x = ctx.in_("Input")
+    w_x = ctx.in_("WeightX")
+    w_h = ctx.in_("WeightH")
+    bias = ctx.in_("Bias")
+    lengths = ctx.in_("Length")
+    use_peep = bool(ctx.attr("use_peepholes", False))
+    h = w_h.shape[0]
+    w_peep = None
+    if bias is not None and use_peep and bias.shape[0] == 7 * h:
+        bias, w_peep = bias[: 4 * h], bias[4 * h:]
+    b = x.shape[0]
+    h0 = ctx.in_("H0")
+    c0 = ctx.in_("C0")
+    if h0 is None:
+        h0 = jnp.zeros((b, h), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((b, h), x.dtype)
+    xw = x @ w_x  # hoisted: one (B*T, D)x(D, 4H) MXU matmul
+    hs, cs, h_last, c_last = _lstm_scan(
+        xw, h0, c0, w_h, bias, lengths, use_peepholes=use_peep, w_peep=w_peep,
+        reverse=bool(ctx.attr("is_reverse", False)))
+    return {"Hidden": hs, "Cell": cs, "LastH": h_last, "LastC": c_last}
+
+
+@register("gru")
+def gru(ctx):
+    """Full-sequence GRU. Gate order follows gru_op.h: update u, reset r,
+    candidate c; candidate uses (r * h_prev) @ W_c like the reference.
+
+    Inputs: Input (B,T,D), WeightX (D,3H), WeightH (H,3H), Bias (3H,),
+    optional H0 (B,H), Length (B,).
+    """
+    x = ctx.in_("Input")
+    w_x = ctx.in_("WeightX")
+    w_h = ctx.in_("WeightH")
+    bias = ctx.in_("Bias")
+    lengths = ctx.in_("Length")
+    h = w_h.shape[0]
+    b = x.shape[0]
+    h0 = ctx.in_("H0")
+    if h0 is None:
+        h0 = jnp.zeros((b, h), x.dtype)
+    xw = x @ w_x
+    if bias is not None:
+        xw = xw + bias
+    w_h_gates = w_h[:, : 2 * h]   # (H, 2H) for u, r
+    w_h_cand = w_h[:, 2 * h:]     # (H, H) for candidate
+    xs = jnp.swapaxes(xw, 0, 1)
+    reverse = bool(ctx.attr("is_reverse", False))
+    if reverse:
+        xs = xs[::-1]
+    steps = jnp.arange(x.shape[1])
+    if reverse:
+        steps = steps[::-1]
+
+    def body(h_prev, inp):
+        x_t, step = inp
+        ur = jax.nn.sigmoid(x_t[:, : 2 * h] + h_prev @ w_h_gates)
+        u, r = ur[:, :h], ur[:, h:]
+        c = jnp.tanh(x_t[:, 2 * h:] + (r * h_prev) @ w_h_cand)
+        h_new = u * h_prev + (1 - u) * c
+        if lengths is not None:
+            m = _len_mask(lengths, step, h_new.dtype)
+            h_new = m * h_new + (1 - m) * h_prev
+        return h_new, h_new
+
+    h_last, hs = jax.lax.scan(body, h0, (xs, steps))
+    hs = jnp.swapaxes(hs, 0, 1)
+    if reverse:
+        hs = hs[:, ::-1]
+    return {"Hidden": hs, "LastH": h_last}
+
+
+@register("lstm_unit")
+def lstm_unit(ctx):
+    """Single LSTM step. Inputs: X (B, 4H) pre-projected gates (x@Wx + h@Wh
+    done by the caller via fc, fluid-style), C_prev (B, H)."""
+    gates = ctx.in_("X")
+    c_prev = ctx.in_("C_prev")
+    forget_bias = ctx.attr("forget_bias", 0.0)
+    i, f, c_hat, o = jnp.split(gates, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f + forget_bias)
+    o = jax.nn.sigmoid(o)
+    c = f * c_prev + i * jnp.tanh(c_hat)
+    h = o * jnp.tanh(c)
+    return {"Hidden": h, "Cell": c}
+
+
+@register("gru_unit")
+def gru_unit(ctx):
+    """Single GRU step. Inputs: Input (B, 3H) = x@Wx (+bias), HiddenPrev
+    (B, H), Weight (H, 3H)."""
+    x = ctx.in_("Input")
+    h_prev = ctx.in_("HiddenPrev")
+    w = ctx.in_("Weight")
+    bias = ctx.in_("Bias")
+    if bias is not None:
+        x = x + bias
+    h = h_prev.shape[-1]
+    ur = jax.nn.sigmoid(x[:, : 2 * h] + h_prev @ w[:, : 2 * h])
+    u, r = ur[:, :h], ur[:, h:]
+    c = jnp.tanh(x[:, 2 * h:] + (r * h_prev) @ w[:, 2 * h:])
+    h_new = u * h_prev + (1 - u) * c
+    return {"Hidden": h_new, "Gate": jnp.concatenate([ur, c], -1),
+            "ResetHiddenPrev": r * h_prev}

@@ -1,0 +1,106 @@
+"""Control-flow layers: While -> lax.while_loop, cond -> lax.cond,
+StaticRNN -> lax.scan, Switch (parity: reference
+fluid/tests/unittests/test_while_op.py, test_cond.py, test_recurrent_op.py).
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.layers import tensor as T
+
+
+def test_while_accumulate():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = layers.fill_constant((1,), "int64", 0)
+        limit = layers.fill_constant((1,), "int64", 10)
+        acc = layers.fill_constant((1,), "float32", 0.0)
+        c = layers.less_than(i, limit)
+        w = layers.While(c)
+        with w.block():
+            T.assign(acc + layers.cast(i, "float32"), acc)
+            layers.increment(i, 1)
+            layers.less_than(i, limit, cond=c)
+    exe = fluid.Executor()
+    acc_v, i_v = exe.run(main, fetch_list=[acc, i])
+    assert acc_v[0] == 45.0
+    assert i_v[0] == 10
+
+
+def test_cond_branches():
+    exe = fluid.Executor()
+    for a_val, expect in [(3.0, 6.0), (7.0, 10.0)]:
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            a = layers.fill_constant((1,), "float32", a_val)
+            five = layers.fill_constant((1,), "float32", 5.0)
+            pred = layers.less_than(a, five)
+            out = layers.cond(pred, lambda: a * 2, lambda: five * 2)
+        assert exe.run(main, fetch_list=[out])[0][0] == expect
+
+
+def test_case_and_switch_case():
+    exe = fluid.Executor()
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        idx = layers.fill_constant((), "int64", 1)
+        out = layers.switch_case(idx, {
+            0: lambda: layers.fill_constant((1,), "float32", 10.0),
+            1: lambda: layers.fill_constant((1,), "float32", 20.0),
+            2: lambda: layers.fill_constant((1,), "float32", 30.0),
+        })
+    assert exe.run(main, fetch_list=[out])[0][0] == 20.0
+
+
+def test_static_rnn_cumsum():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = layers.data("x", [4, 2, 3], append_batch_size=False)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            mem = rnn.memory(shape=[2, 3], value=0.0)
+            new = mem + x_t
+            rnn.update_memory(mem, new)
+            rnn.step_output(new)
+        out = rnn()
+    x_np = np.arange(24).reshape(4, 2, 3).astype("float32")
+    r = fluid.Executor().run(main, feed={"x": x_np}, fetch_list=[out])[0]
+    np.testing.assert_allclose(r, np.cumsum(x_np, axis=0), rtol=1e-6)
+
+
+def test_switch_lr_style():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        step = layers.fill_constant((1,), "float32", 7.0)
+        lr = T.create_global_var((1,), 0.0, "float32", persistable=True,
+                                 name="lr")
+        boundary = layers.fill_constant((1,), "float32", 5.0)
+        sw = layers.Switch()
+        with sw.block():
+            with sw.case(layers.less_than(step, boundary)):
+                T.assign(layers.fill_constant((1,), "float32", 1.0), lr)
+            with sw.default():
+                T.assign(layers.fill_constant((1,), "float32", 0.1), lr)
+    exe = fluid.Executor()
+    exe.run(startup)
+    r = exe.run(main, fetch_list=[lr])[0]
+    np.testing.assert_allclose(r, [0.1], rtol=1e-6)
+
+
+def test_while_grad_flows():
+    """Gradients flow through lax.while_loop via the whole-program jax.grad."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [2, 2], append_batch_size=False)
+        w = T.create_parameter([2, 2], "float32", name="w_cf",
+                               default_initializer=fluid.initializer.ConstantInitializer(0.5))
+        y = layers.matmul(x, w)
+        loss = layers.reduce_mean(y * y)
+        fluid.append_backward(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    g = exe.run(main, feed={"x": np.eye(2, dtype="float32")},
+                fetch_list=["w_cf@GRAD"])[0]
+    assert g.shape == (2, 2) and np.abs(g).sum() > 0

@@ -1,0 +1,133 @@
+"""RNN + attention layers vs numpy goldens (parity: reference
+fluid/tests/unittests/test_lstm_op.py, test_gru_op.py, OpTest-style)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def test_lstm_matches_numpy():
+    np.random.seed(0)
+    b, t, d, h = 2, 4, 3, 5
+    x = np.random.randn(b, t, d).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", [b, t, d], append_batch_size=False)
+        hv, cv = layers.dynamic_lstm(xv, 4 * h, use_peepholes=False)
+    exe = fluid.Executor()
+    exe.run(startup)
+    params = [p.name for p in main.all_parameters()]
+    w_x_name = [p for p in params if ".w" in p][0]
+    w_h_name = [p for p in params if ".w" in p][1]
+    b_name = [p for p in params if ".b" in p][0]
+    scope = fluid.global_scope()
+    w_x = np.asarray(scope.get(w_x_name))
+    w_h = np.asarray(scope.get(w_h_name))
+    bias = np.asarray(scope.get(b_name))
+    got_h, got_c = exe.run(main, feed={"x": x}, fetch_list=[hv, cv])
+
+    # numpy golden, fluid gate order i,f,c,o
+    h_prev = np.zeros((b, h), "float32")
+    c_prev = np.zeros((b, h), "float32")
+    want = []
+    for step in range(t):
+        g = x[:, step] @ w_x + h_prev @ w_h + bias
+        i, f, ch, o = np.split(g, 4, axis=-1)
+        c_prev = _sigmoid(f) * c_prev + _sigmoid(i) * np.tanh(ch)
+        h_prev = _sigmoid(o) * np.tanh(c_prev)
+        want.append(h_prev.copy())
+    np.testing.assert_allclose(got_h, np.stack(want, 1), rtol=2e-5, atol=2e-5)
+
+
+def test_gru_matches_numpy():
+    np.random.seed(1)
+    b, t, d, h = 2, 3, 4, 6
+    x = np.random.randn(b, t, d).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", [b, t, d], append_batch_size=False)
+        hv = layers.dynamic_gru(xv, h)
+    exe = fluid.Executor()
+    exe.run(startup)
+    scope = fluid.global_scope()
+    params = [p.name for p in main.all_parameters()]
+    w_x = np.asarray(scope.get([p for p in params if ".w" in p][0]))
+    w_h = np.asarray(scope.get([p for p in params if ".w" in p][1]))
+    bias = np.asarray(scope.get([p for p in params if ".b" in p][0]))
+    got = exe.run(main, feed={"x": x}, fetch_list=[hv])[0]
+
+    h_prev = np.zeros((b, h), "float32")
+    want = []
+    for step in range(t):
+        xw = x[:, step] @ w_x + bias
+        ur = _sigmoid(xw[:, :2 * h] + h_prev @ w_h[:, :2 * h])
+        u, r = ur[:, :h], ur[:, h:]
+        c = np.tanh(xw[:, 2 * h:] + (r * h_prev) @ w_h[:, 2 * h:])
+        h_prev = u * h_prev + (1 - u) * c
+        want.append(h_prev.copy())
+    np.testing.assert_allclose(got, np.stack(want, 1), rtol=2e-5, atol=2e-5)
+
+
+def test_scaled_dot_product_attention_golden():
+    np.random.seed(2)
+    b, t, m, heads = 2, 5, 8, 2
+    q = np.random.randn(b, t, m).astype("float32")
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        qv = layers.data("q", [b, t, m], append_batch_size=False)
+        out = layers.scaled_dot_product_attention(qv, qv, qv,
+                                                  num_heads=heads)
+    got = fluid.Executor().run(main, feed={"q": q}, fetch_list=[out])[0]
+
+    d = m // heads
+    qh = q.reshape(b, t, heads, d).transpose(0, 2, 1, 3)
+    logits = qh @ qh.transpose(0, 1, 3, 2) / np.sqrt(d)
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    want = (p @ qh).transpose(0, 2, 1, 3).reshape(b, t, m)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_multi_head_attention_causal_masks_future():
+    b, t, m = 1, 6, 8
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        qv = layers.data("q", [b, t, m], append_batch_size=False)
+        out = layers.multi_head_attention(qv, num_heads=2, causal=True)
+    exe = fluid.Executor()
+    exe.run(startup)
+    x = np.random.randn(b, t, m).astype("float32")
+    base = exe.run(main, feed={"q": x}, fetch_list=[out])[0]
+    x2 = x.copy()
+    x2[:, -1] += 100.0  # perturb only the last position
+    pert = exe.run(main, feed={"q": x2}, fetch_list=[out])[0]
+    # causal: earlier positions must be unaffected by the future token
+    np.testing.assert_allclose(base[:, :-1], pert[:, :-1], rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_beam_search_decode_backtrack():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        ids = layers.data("ids", [3, 1, 2], dtype="int32",
+                          append_batch_size=False)
+        par = layers.data("par", [3, 1, 2], dtype="int32",
+                          append_batch_size=False)
+        sc = layers.data("sc", [1, 2], append_batch_size=False)
+        seqs, scores = layers.beam_search_decode(ids, par, sc, beam_size=2,
+                                                 end_id=0)
+    # lane0 path: t2 token 5 from parent 1, t1 token 3 parent 0, t0 token 1
+    ids_np = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], "int32")
+    par_np = np.array([[[0, 1]], [[0, 0]], [[1, 0]]], "int32")
+    sc_np = np.array([[0.9, 0.1]], "float32")
+    r = fluid.Executor().run(
+        main, feed={"ids": ids_np, "par": par_np, "sc": sc_np},
+        fetch_list=[seqs])[0]
+    # beam lane 0 at t2 took token 5 whose parent at t1 is lane 1 (token 4),
+    # whose parent at t0 is lane 0 (token 1)
+    np.testing.assert_array_equal(r[0, 0], [1, 4, 5])
