@@ -1,0 +1,89 @@
+"""Headline benchmark: BERT-base pretraining tokens/sec/chip (bf16, seq 512).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline (SURVEY.md §6 / BASELINE.json): the reference published no TPU
+numbers, so vs_baseline compares against the reference-era published V100
+fp32 per-card figure for BERT-base pretraining, ~2800 tokens/sec/card.
+
+The whole train step (fwd + grad + adam) runs as ONE donated XLA executable
+via the framework Executor; matmul path is bf16 (amp cast_model_to_bf16),
+params/accum fp32.
+"""
+
+import json
+import os
+import sys
+import time
+
+V100_BERT_BASE_TOKENS_PER_SEC = 2800.0
+
+
+def build_step():
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.core import framework
+    from paddle_tpu.models import bert
+    from paddle_tpu import amp
+
+    seq_len = int(os.environ.get("BENCH_SEQ_LEN", 512))
+    batch = int(os.environ.get("BENCH_BATCH", 8))
+
+    cfg = bert.BertConfig(max_position_embeddings=seq_len)
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        feeds, total_loss, _mlm, _acc = bert.build_pretrain_net(
+            cfg, seq_len=seq_len)
+        opt = fluid.optimizer.AdamOptimizer(learning_rate=1e-4)
+        opt.minimize(total_loss)
+    amp.cast_model_to_bf16(main)
+
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup)
+
+    rs = np.random.RandomState(0)
+    P = cfg.max_predictions_per_seq
+    feed = {
+        "src_ids": rs.randint(0, cfg.vocab_size, (batch, seq_len)).astype(np.int32),
+        "sent_ids": rs.randint(0, 2, (batch, seq_len)).astype(np.int32),
+        "input_mask": np.ones((batch, seq_len), np.float32),
+        "mask_pos": np.stack([np.arange(P) + i * seq_len
+                              for i in range(batch)]).astype(np.int32),
+        "mask_label": rs.randint(0, cfg.vocab_size, (batch, P)).astype(np.int32),
+        "mask_weight": np.ones((batch, P), np.float32),
+        "nsp_label": rs.randint(0, 2, (batch, 1)).astype(np.int32),
+    }
+
+    def step():
+        return exe.run(main, feed=feed, fetch_list=[total_loss])
+
+    return step, batch * seq_len
+
+
+def main():
+    import numpy as np
+
+    step, tokens_per_step = build_step()
+    # warmup: first call compiles (~20-40s on TPU), second confirms cache
+    step()
+    step()
+
+    n_steps = int(os.environ.get("BENCH_STEPS", 20))
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        out = step()
+    # out is numpy (return_numpy) so the step is host-synchronized
+    dt = time.perf_counter() - t0
+    assert np.isfinite(out[0]).all(), "loss went non-finite during bench"
+
+    tokens_per_sec = tokens_per_step * n_steps / dt
+    print(json.dumps({
+        "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(tokens_per_sec / V100_BERT_BASE_TOKENS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

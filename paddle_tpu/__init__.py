@@ -38,6 +38,9 @@ from . import dygraph
 from . import parallel
 from . import profiler
 from . import amp
+from . import models
+from . import utils
+from . import inference
 
 # fluid-compat: `fluid.data` in 2.x has no implicit batch dim. Keep both:
 data = layers.io.fluid_data

@@ -57,6 +57,12 @@ def default_seed():
     return _global_seed
 
 
+def set_default_seed(seed):
+    """Parity: fluid's global random seed (Program.random_seed default)."""
+    global _global_seed
+    _global_seed = int(seed)
+
+
 # ---------------------------------------------------------------------------
 # Variable
 # ---------------------------------------------------------------------------

@@ -1,0 +1,15 @@
+"""Inference engine (SURVEY.md §2.10).
+
+Parity: paddle/fluid/inference (AnalysisPredictor / NativePaddlePredictor).
+The reference's engine loads a ProgramDesc, runs IR passes, and executes
+op-by-op on a stream; TPU-native the 'engine' is: load program+params ->
+trace once -> one AOT-compiled XLA executable per input signature, with
+donated buffers and optional bf16. KV-cache autoregressive decoding lives in
+decoding.py.
+"""
+
+from .predictor import Predictor, create_predictor, AnalysisConfig
+from .decoding import greedy_decode, beam_decode
+
+__all__ = ["Predictor", "create_predictor", "AnalysisConfig",
+           "greedy_decode", "beam_decode"]

@@ -1,0 +1,154 @@
+"""Autoregressive decoding with a KV cache — greedy and beam search.
+
+Parity: the reference decodes with a While block doing LoD beam surgery
+per step (layers/beam_search + tests/book machine translation). TPU-native:
+the whole decode is ONE `lax.scan` over time with static shapes — dense
+(batch, beam) lanes, finished lanes masked, KV cache updated functionally
+via dynamic_update_slice. No host round-trips inside the loop.
+
+The model plugs in as `step_fn(ids_t, cache, t) -> (logits, cache)`:
+- ids_t: (B,) or (B*K,) current token ids
+- cache: arbitrary pytree (e.g. per-layer K/V of shape (B, H, T_max, D))
+- logits: (B, V) next-token logits
+Helpers `init_kv_cache` / `update_kv_cache` build that cache the standard
+way so model code stays three lines.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9
+
+
+# ---------------------------------------------------------------------------
+# KV cache helpers
+# ---------------------------------------------------------------------------
+
+def init_kv_cache(batch, num_layers, num_heads, max_len, head_dim,
+                  dtype=jnp.float32):
+    """Pytree: list of {'k','v'} with shape (B, H, T_max, D)."""
+    shape = (batch, num_heads, max_len, head_dim)
+    return [{"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+            for _ in range(num_layers)]
+
+
+def update_kv_cache(layer_cache, k_t, v_t, t):
+    """Write this step's K/V (B, H, 1, D) at time t. Returns new cache +
+    full (B, H, T_max, D) views for attention (mask out > t)."""
+    k = jax.lax.dynamic_update_slice(layer_cache["k"], k_t, (0, 0, t, 0))
+    v = jax.lax.dynamic_update_slice(layer_cache["v"], v_t, (0, 0, t, 0))
+    return {"k": k, "v": v}
+
+
+def cache_attention_bias(max_len, t):
+    """(1, 1, 1, T_max) additive bias masking positions > t."""
+    pos = jnp.arange(max_len)
+    return jnp.where(pos <= t, 0.0, NEG_INF)[None, None, None, :]
+
+
+# ---------------------------------------------------------------------------
+# Greedy
+# ---------------------------------------------------------------------------
+
+def greedy_decode(step_fn, init_cache, bos_ids, max_len, eos_id=None):
+    """Returns (ids (B, max_len), scores (B,)). Stops contributing after
+    EOS (lanes keep stepping — static shapes — but emit eos/score 0)."""
+    batch = bos_ids.shape[0]
+
+    def body(carry, t):
+        ids_t, cache, done, score = carry
+        logits, cache = step_fn(ids_t, cache, t)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        nxt = jnp.argmax(logp, axis=-1)
+        step_lp = jnp.take_along_axis(logp, nxt[:, None], -1)[:, 0]
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            score = score + jnp.where(done, 0.0, step_lp)
+            done = done | (nxt == eos_id)
+        else:
+            score = score + step_lp
+        return (nxt, cache, done, score), nxt
+
+    carry0 = (bos_ids, init_cache, jnp.zeros(batch, bool),
+              jnp.zeros(batch, jnp.float32))
+    (_, _, _, scores), ids = jax.lax.scan(body, carry0,
+                                          jnp.arange(max_len))
+    return ids.T, scores
+
+
+# ---------------------------------------------------------------------------
+# Beam search
+# ---------------------------------------------------------------------------
+
+def _gather_beams(tree, parent, batch, beams):
+    """Reorder the (B*K, ...) leading dim by parent beam indices (B, K)."""
+    flat_idx = (jnp.arange(batch)[:, None] * beams + parent).reshape(-1)
+    return jax.tree_util.tree_map(lambda x: x[flat_idx], tree)
+
+
+def beam_decode(step_fn, init_cache, bos_ids, max_len, beam_size, eos_id,
+                length_penalty=0.6):
+    """Standard beam search, dense lanes, GNMT length penalty.
+
+    init_cache leaves must already be (B*K, ...) — tile with
+    `jax.tree_util.tree_map(lambda x: jnp.repeat(x, K, 0), cache)`.
+    bos_ids: (B,). Returns (ids (B, K, max_len), scores (B, K)) sorted
+    best-first.
+    """
+    batch = bos_ids.shape[0]
+    K = beam_size
+
+    ids0 = jnp.repeat(bos_ids, K)                       # (B*K,)
+    # lane 0 active, others -inf so step 1 doesn't duplicate beams
+    scores0 = jnp.tile(jnp.array([0.0] + [NEG_INF] * (K - 1),
+                                 jnp.float32), (batch,))
+    done0 = jnp.zeros(batch * K, bool)
+
+    def body(carry, t):
+        ids_t, cache, done, scores = carry
+        logits, cache = step_fn(ids_t, cache, t)        # (B*K, V)
+        vocab = logits.shape[-1]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        # finished lanes may only emit EOS at zero cost
+        eos_only = jnp.full((vocab,), NEG_INF).at[eos_id].set(0.0)
+        logp = jnp.where(done[:, None], eos_only[None, :], logp)
+
+        total = scores[:, None] + logp                  # (B*K, V)
+        total = total.reshape(batch, K * vocab)
+        top_scores, top_idx = jax.lax.top_k(total, K)   # (B, K)
+        parent = top_idx // vocab
+        token = (top_idx % vocab).astype(ids_t.dtype)
+
+        cache = _gather_beams(cache, parent, batch, K)
+        done = _gather_beams(done, parent, batch, K)
+        done = done | (token.reshape(-1) == eos_id)
+        return ((token.reshape(-1), cache, done,
+                 top_scores.reshape(-1)),
+                (token, parent))
+
+    carry0 = (ids0, init_cache, done0, scores0)
+    (_, _, _, final_scores), (tokens, parents) = jax.lax.scan(
+        body, carry0, jnp.arange(max_len))
+    # tokens/parents: (T, B, K). Backtrack parent pointers into sequences.
+
+    def backtrack(carry, xs):
+        beam_idx = carry                                 # (B, K)
+        token_t, parent_t = xs
+        tok = jnp.take_along_axis(token_t, beam_idx, axis=1)
+        beam_idx = jnp.take_along_axis(parent_t, beam_idx, axis=1)
+        return beam_idx, tok
+
+    last = jnp.tile(jnp.arange(K)[None, :], (batch, 1))
+    _, seq_rev = jax.lax.scan(backtrack, last, (tokens, parents),
+                              reverse=True)
+    ids = jnp.moveaxis(seq_rev, 0, 2)                    # (B, K, T)
+
+    lengths = jnp.sum(ids != eos_id, axis=-1).astype(jnp.float32) + 1.0
+    lp = ((5.0 + lengths) / 6.0) ** length_penalty
+    final = final_scores.reshape(batch, K) / lp
+    order = jnp.argsort(-final, axis=1)
+    ids = jnp.take_along_axis(ids, order[:, :, None], axis=1)
+    final = jnp.take_along_axis(final, order, axis=1)
+    return ids, final

@@ -1,0 +1,22 @@
+"""Model zoo — the reference's book/ chapters + BASELINE.json configs,
+rebuilt on paddle_tpu's static-graph API (and dygraph where the reference
+ships both).
+
+Each module exposes `build_*` functions that append ops to the current
+default program and return the key variables (prediction/loss/...), mirroring
+how the reference's book tests compose `fluid.layers`. Training loops live in
+the callers (tests, bench.py) — the framework compiles the whole step to one
+XLA executable either way.
+"""
+
+from . import mnist
+from . import resnet
+from . import vgg
+from . import word2vec
+from . import recommender
+from . import lstm_text
+from . import transformer
+from . import bert
+from . import deepfm
+from . import gan
+from . import detection_demo

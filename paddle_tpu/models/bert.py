@@ -1,0 +1,176 @@
+"""BERT-base / ERNIE-1.0 pretraining (MLM + NSP) — the flagship model.
+
+Parity: the reference era's ERNIE/BERT fluid recipes (LARK/ERNIE
+model/bert.py idiom): token+position+sentence embeddings -> N transformer
+encoder layers (post-norm) -> (a) masked-LM head over gathered positions
+sharing the token embedding table, (b) NSP binary head on pooled [CLS].
+
+TPU notes (why this looks different from the CUDA recipe):
+- attention runs the Pallas flash kernel (ops/pallas/flash.py) — no (T,T)
+  score tensor in HBM at seq 512;
+- masked-position gather uses a static max_predictions_per_seq so the MLM
+  matmul (P, H) x (H, V) stays a fixed MXU shape;
+- matmul path runs bf16 under amp (bench.py wraps with amp bf16 mode),
+  params fp32;
+- the whole step (fwd+bwd+adam) is one donated XLA executable via Executor.
+"""
+
+from .. import layers
+from ..core.param_attr import ParamAttr
+
+
+class BertConfig:
+    """BERT-base (= ERNIE-1.0 size)."""
+    vocab_size = 30522
+    hidden_size = 768
+    num_hidden_layers = 12
+    num_attention_heads = 12
+    intermediate_size = 3072
+    hidden_act = "gelu"
+    hidden_dropout_prob = 0.1
+    attention_probs_dropout_prob = 0.1
+    max_position_embeddings = 512
+    type_vocab_size = 2
+    max_predictions_per_seq = 20
+
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+def bert_tiny():
+    """4-layer/256-wide config for tests and dryrun."""
+    return BertConfig(vocab_size=1024, hidden_size=256, num_hidden_layers=4,
+                      num_attention_heads=4, intermediate_size=1024,
+                      max_position_embeddings=128,
+                      max_predictions_per_seq=8)
+
+
+def _encoder_layer(x, attn_bias, cfg, idx):
+    # Post-norm (original BERT): sublayer -> add -> layer_norm.
+    attn = layers.multi_head_attention(
+        x, num_heads=cfg.num_attention_heads, d_model=cfg.hidden_size,
+        attn_bias=attn_bias,
+        dropout_rate=cfg.attention_probs_dropout_prob,
+        param_attr=ParamAttr(name=f"enc{idx}_attn"))
+    x = layers.layer_norm(layers.elementwise_add(x, attn),
+                          begin_norm_axis=2)
+    h = layers.fc(x, size=cfg.intermediate_size, num_flatten_dims=2,
+                  act=cfg.hidden_act, param_attr=ParamAttr(name=f"enc{idx}_ffn0_w"))
+    h = layers.fc(h, size=cfg.hidden_size, num_flatten_dims=2,
+                  param_attr=ParamAttr(name=f"enc{idx}_ffn1_w"))
+    if cfg.hidden_dropout_prob:
+        h = layers.dropout(h, cfg.hidden_dropout_prob)
+    return layers.layer_norm(layers.elementwise_add(x, h), begin_norm_axis=2)
+
+
+def bert_encoder(src_ids, sent_ids, input_mask, cfg):
+    """Returns (sequence_output (B,T,H), pooled [CLS] output (B,H))."""
+    token_emb = layers.embedding(
+        src_ids, size=[cfg.vocab_size, cfg.hidden_size],
+        param_attr=ParamAttr(name="word_embedding"))
+    # Position ids are a static iota — computed inline, not fed.
+    pos_table = layers.create_parameter(
+        [cfg.max_position_embeddings, cfg.hidden_size], "float32",
+        attr=ParamAttr(name="pos_embedding"))
+    seq_len = src_ids.shape[1]
+    pos_emb = layers.slice(pos_table, axes=[0], starts=[0], ends=[seq_len])
+    sent_emb = layers.embedding(
+        sent_ids, size=[cfg.type_vocab_size, cfg.hidden_size],
+        param_attr=ParamAttr(name="sent_embedding"))
+
+    emb = layers.elementwise_add(
+        layers.elementwise_add(token_emb, sent_emb), pos_emb)
+    emb = layers.layer_norm(emb, begin_norm_axis=2)
+    if cfg.hidden_dropout_prob:
+        emb = layers.dropout(emb, cfg.hidden_dropout_prob)
+
+    # input_mask (B, T) 1/0 -> additive bias (B, 1, 1, T)
+    bias = layers.reshape(input_mask, shape=[-1, 1, 1, seq_len])
+    bias = layers.scale(bias, scale=1e9, bias=-1e9)
+
+    x = emb
+    for i in range(cfg.num_hidden_layers):
+        x = _encoder_layer(x, bias, cfg, i)
+
+    cls = layers.slice(x, axes=[1], starts=[0], ends=[1])
+    cls = layers.reshape(cls, shape=[-1, cfg.hidden_size])
+    pooled = layers.fc(cls, size=cfg.hidden_size, act="tanh",
+                       param_attr=ParamAttr(name="pooled_fc_w"))
+    return x, pooled
+
+
+def build_pretrain_net(cfg=None, seq_len=128):
+    """Full MLM+NSP pretraining graph.
+
+    Feeds: src_ids, sent_ids, input_mask (B,T); mask_pos (B,P) flat indices
+    into the (B*T) token grid; mask_label (B,P); mask_weight (B,P) 1.0 for
+    real predictions 0.0 for padding; labels (B,1) NSP.
+    Returns (feed dict, total_loss, mlm_loss, nsp_acc).
+    """
+    cfg = cfg or BertConfig()
+    src_ids = layers.data("src_ids", shape=[seq_len], dtype="int64")
+    sent_ids = layers.data("sent_ids", shape=[seq_len], dtype="int64")
+    input_mask = layers.data("input_mask", shape=[seq_len], dtype="float32")
+    P = cfg.max_predictions_per_seq
+    mask_pos = layers.data("mask_pos", shape=[P], dtype="int64")
+    mask_label = layers.data("mask_label", shape=[P], dtype="int64")
+    mask_weight = layers.data("mask_weight", shape=[P], dtype="float32")
+    nsp_label = layers.data("nsp_label", shape=[1], dtype="int64")
+
+    seq_out, pooled = bert_encoder(src_ids, sent_ids, input_mask, cfg)
+
+    # ---- MLM head: gather masked positions from the flattened token grid.
+    flat = layers.reshape(seq_out, shape=[-1, cfg.hidden_size])
+    flat_pos = layers.reshape(mask_pos, shape=[-1])
+    masked_h = layers.gather(flat, flat_pos)          # (B*P, H)
+    trans = layers.fc(masked_h, size=cfg.hidden_size, act=cfg.hidden_act,
+                      param_attr=ParamAttr(name="mlm_trans_w"))
+    trans = layers.layer_norm(trans, begin_norm_axis=1)
+    mlm_logits = layers.fc(trans, size=cfg.vocab_size, bias_attr=True,
+                           param_attr=ParamAttr(name="mlm_out_w"))
+    mlm_loss_tok = layers.softmax_with_cross_entropy(
+        logits=mlm_logits,
+        label=layers.reshape(mask_label, shape=[-1, 1]))
+    w = layers.reshape(mask_weight, shape=[-1, 1])
+    mlm_loss = layers.elementwise_div(
+        layers.reduce_sum(layers.elementwise_mul(mlm_loss_tok, w)),
+        layers.elementwise_add(layers.reduce_sum(w),
+                               layers.fill_constant([1], "float32", 1e-6)))
+
+    # ---- NSP head.
+    nsp_logits = layers.fc(pooled, size=2,
+                           param_attr=ParamAttr(name="nsp_fc_w"))
+    nsp_loss = layers.mean(layers.softmax_with_cross_entropy(
+        logits=nsp_logits, label=nsp_label))
+    nsp_acc = layers.accuracy(input=layers.softmax(nsp_logits),
+                              label=nsp_label)
+
+    total_loss = layers.elementwise_add(mlm_loss, nsp_loss)
+    feeds = {"src_ids": src_ids, "sent_ids": sent_ids,
+             "input_mask": input_mask, "mask_pos": mask_pos,
+             "mask_label": mask_label, "mask_weight": mask_weight,
+             "nsp_label": nsp_label}
+    return feeds, total_loss, mlm_loss, nsp_acc
+
+
+def build_classifier_net(cfg=None, seq_len=128, num_labels=2):
+    """Fine-tune head (sentence classification — ERNIE downstream parity).
+    Returns (feeds, loss, accuracy, probs)."""
+    cfg = cfg or BertConfig()
+    src_ids = layers.data("src_ids", shape=[seq_len], dtype="int64")
+    sent_ids = layers.data("sent_ids", shape=[seq_len], dtype="int64")
+    input_mask = layers.data("input_mask", shape=[seq_len], dtype="float32")
+    label = layers.data("label", shape=[1], dtype="int64")
+    _seq, pooled = bert_encoder(src_ids, sent_ids, input_mask, cfg)
+    if cfg.hidden_dropout_prob:
+        pooled = layers.dropout(pooled, cfg.hidden_dropout_prob)
+    logits = layers.fc(pooled, size=num_labels,
+                       param_attr=ParamAttr(name="cls_out_w"))
+    loss = layers.mean(layers.softmax_with_cross_entropy(
+        logits=logits, label=label))
+    probs = layers.softmax(logits)
+    acc = layers.accuracy(input=probs, label=label)
+    feeds = {"src_ids": src_ids, "sent_ids": sent_ids,
+             "input_mask": input_mask, "label": label}
+    return feeds, loss, acc, probs

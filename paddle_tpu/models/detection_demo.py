@@ -1,0 +1,69 @@
+"""SSD-style detection demo (MobileNet-SSD-lite idiom on small images).
+
+Parity: the reference's fluid SSD recipe (layers.multi_box_head + ssd_loss +
+detection_output, as exercised by fluid/tests/unittests/test_ssd_loss +
+book high-level-api detection sample). A small conv backbone feeds two
+feature maps into multi_box_head; training uses ssd_loss over padded
+ground-truth boxes, inference uses detection_output (NMS runs host-side —
+the TPU-idiomatic split: dense box/score tensors come off the device,
+pruning is a host post-process).
+"""
+
+from .. import layers
+
+
+def _conv_bn(x, filters, stride=1):
+    x = layers.conv2d(x, num_filters=filters, filter_size=3, stride=stride,
+                      padding=1, bias_attr=False)
+    return layers.batch_norm(x, act="relu")
+
+
+def backbone(img):
+    """Returns two feature maps at 1/8 and 1/16 scale."""
+    x = _conv_bn(img, 32, stride=2)
+    x = _conv_bn(x, 64, stride=2)
+    f1 = _conv_bn(x, 128, stride=2)     # 1/8
+    f2 = _conv_bn(f1, 256, stride=2)    # 1/16
+    return f1, f2
+
+
+def build_ssd_net(num_classes=21, image_size=128, max_boxes=8):
+    """Returns (img, gt_box, gt_label, loss, locs, confs, box, box_var).
+
+    gt_box (B, max_boxes, 4) normalized xyxy, gt_label (B, max_boxes, 1)
+    int64, zero-padded (label 0 = background acts as padding class).
+    """
+    img = layers.data("img", shape=[3, image_size, image_size],
+                      dtype="float32")
+    gt_box = layers.data("gt_box", shape=[max_boxes, 4], dtype="float32")
+    gt_label = layers.data("gt_label", shape=[max_boxes, 1], dtype="int64")
+
+    f1, f2 = backbone(img)
+    locs, confs, box, box_var = layers.multi_box_head(
+        inputs=[f1, f2], image=img, base_size=image_size,
+        num_classes=num_classes,
+        aspect_ratios=[[2.0], [2.0, 3.0]],
+        min_sizes=[image_size * 0.2, image_size * 0.4],
+        max_sizes=[image_size * 0.4, image_size * 0.7],
+        offset=0.5, flip=True)
+
+    loss = layers.ssd_loss(locs, confs, gt_box, gt_label, box, box_var)
+    loss = layers.mean(loss)
+    return img, gt_box, gt_label, loss, locs, confs, box, box_var
+
+
+def build_infer_net(num_classes=21, image_size=128):
+    """Detection inference graph: device produces decoded boxes + scores;
+    multiclass NMS is applied by detection_output."""
+    img = layers.data("img", shape=[3, image_size, image_size],
+                      dtype="float32")
+    f1, f2 = backbone(img)
+    locs, confs, box, box_var = layers.multi_box_head(
+        inputs=[f1, f2], image=img, base_size=image_size,
+        num_classes=num_classes,
+        aspect_ratios=[[2.0], [2.0, 3.0]],
+        min_sizes=[image_size * 0.2, image_size * 0.4],
+        max_sizes=[image_size * 0.4, image_size * 0.7],
+        offset=0.5, flip=True)
+    nmsed = layers.detection_output(locs, confs, box, box_var)
+    return img, nmsed

@@ -1,0 +1,67 @@
+"""Program printing / graph dumps.
+
+Parity: python/paddle/fluid/debugger.py (pprint_program_codes, draw_block_graphviz)
+— the reference renders ProgramDesc protobufs; here the Program is plain
+Python, so printing is direct and the graphviz dump emits DOT text.
+"""
+
+from ..core.framework import Program
+
+
+def _fmt_var(v):
+    shape = "x".join(str(s) for s in (v.shape or ()))
+    flags = []
+    if v.persistable:
+        flags.append("persist")
+    if getattr(v, "is_data", False):
+        flags.append("data")
+    f = f" [{','.join(flags)}]" if flags else ""
+    return f"{v.name}:{v.dtype}({shape}){f}"
+
+
+def program_to_code(program, skip_op_callstack=True):
+    """Pretty-print a Program as pseudo-code (fluid's print-to-string)."""
+    lines = []
+    for bi, block in enumerate(program.blocks):
+        lines.append(f"// block {bi}")
+        for v in block.vars.values():
+            lines.append(f"var {_fmt_var(v)}")
+        for op in block.ops:
+            ins = ", ".join(
+                f"{k}={v}" for k, v in sorted(op.inputs.items()))
+            outs = ", ".join(
+                f"{k}={v}" for k, v in sorted(op.outputs.items()))
+            attrs = ", ".join(
+                f"{k}={v!r}" for k, v in sorted(op.attrs.items())
+                if not k.startswith("_"))
+            lines.append(f"{{{outs}}} = {op.type}({ins}) attrs: {{{attrs}}}")
+    return "\n".join(lines)
+
+
+def print_program(program=None, file=None):
+    from ..core.framework import default_main_program
+    print(program_to_code(program or default_main_program()), file=file)
+
+
+def draw_block_graphviz(block, path=None, highlights=None):
+    """Emit a DOT graph of a block's dataflow (fluid draw_block_graphviz).
+    Returns the DOT source; writes it to `path` if given."""
+    highlights = set(highlights or ())
+    lines = ["digraph G {", "  rankdir=TB;"]
+    for i, op in enumerate(block.ops):
+        color = "red" if op.type in highlights else "lightblue"
+        lines.append(
+            f'  op_{i} [label="{op.type}", shape=box, style=filled, '
+            f'fillcolor={color}];')
+        for names in op.inputs.values():
+            for n in names:
+                lines.append(f'  "{n}" -> op_{i};')
+        for names in op.outputs.values():
+            for n in names:
+                lines.append(f'  op_{i} -> "{n}";')
+    lines.append("}")
+    dot = "\n".join(lines)
+    if path:
+        with open(path, "w") as f:
+            f.write(dot)
+    return dot

@@ -1,0 +1,81 @@
+"""Determinism & cross-replica divergence detection.
+
+Parity goal (SURVEY.md §2.11 'race/divergence detection'): the reference
+relies on CUDA determinism flags + NCCL debug checks; on TPU the equivalent
+failure mode is replicas drifting apart (bad collective layout, non-replicated
+RNG, host data skew). Tools here:
+
+- `seed_everything`: one switch for python/numpy/framework seeds.
+- `replica_checksum`: in-graph per-replica parameter checksum (psum-compared)
+  usable under shard_map/pjit.
+- `assert_replicas_in_sync`: host-side check that a replicated jax.Array's
+  per-device shards are bit-identical (catches divergence after a step).
+- `fingerprint`: stable digest of a pytree for golden-run comparison
+  (deterministic-replay parity).
+"""
+
+import hashlib
+import random
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def seed_everything(seed):
+    random.seed(seed)
+    np.random.seed(seed & 0xFFFFFFFF)
+    from ..core import framework
+    framework.set_default_seed(seed)
+    return seed
+
+
+def fingerprint(tree):
+    """SHA1 over the concatenated byte view of every leaf (host transfer;
+    use for replay tests, not inside jit)."""
+    h = hashlib.sha1()
+    for leaf in jax.tree_util.tree_leaves(tree):
+        arr = np.asarray(leaf)
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def replica_checksum(tree, axis_name):
+    """In-graph divergence detector: returns (my_sum, max_abs_diff) where
+    max_abs_diff is the largest deviation of this replica's checksum from
+    the cross-replica mean. 0.0 ⇔ replicas agree (up to float assoc.)."""
+    total = jnp.float32(0)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        total = total + jnp.sum(jnp.abs(leaf.astype(jnp.float32)))
+    mean = jax.lax.pmean(total, axis_name)
+    return total, jnp.abs(total - mean)
+
+
+def assert_replicas_in_sync(arr, what="array"):
+    """Host check: all addressable shards of a replicated Array must be
+    bit-identical. Raises on divergence, naming the first bad device."""
+    shards = getattr(arr, "addressable_shards", None)
+    if not shards or len(shards) < 2:
+        return True
+    ref = np.asarray(shards[0].data)
+    for s in shards[1:]:
+        cur = np.asarray(s.data)
+        if ref.shape == cur.shape and not np.array_equal(ref, cur):
+            diff = float(np.max(np.abs(ref.astype(np.float64) -
+                                       cur.astype(np.float64))))
+            raise AssertionError(
+                f"replica divergence in {what}: device {s.device} differs "
+                f"from device {shards[0].device} (max abs diff {diff:g})")
+    return True
+
+
+def run_replay_check(fn, args, n=2):
+    """Run fn(*args) n times and assert bit-identical results — the
+    deterministic-replay harness used by tests/parallel."""
+    prints = [fingerprint(fn(*args)) for _ in range(n)]
+    if len(set(prints)) != 1:
+        raise AssertionError(f"non-deterministic execution: {prints}")
+    return prints[0]

@@ -1,0 +1,63 @@
+"""Install sanity self-test.
+
+Parity: python/paddle/fluid/install_check.py `run_check()` — the reference
+builds a tiny fc regression, runs it single- and multi-card, and prints a
+friendly verdict. Same here: single-device static graph, then (if >1 device)
+a data-parallel CompiledProgram run on the visible mesh.
+"""
+
+import numpy as np
+
+
+def run_check(verbose=True):
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.core import framework
+
+    def log(msg):
+        if verbose:
+            print(msg)
+
+    log(f"paddle_tpu is installed; jax backend: "
+        f"{jax.default_backend()} with {jax.device_count()} device(s)")
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = layers.data("x", shape=[2], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.01).minimize(loss)
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        xs = np.random.rand(8, 2).astype(np.float32)
+        ys = xs.sum(1, keepdims=True).astype(np.float32)
+        l0, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        for _ in range(3):
+            l1, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    assert np.isfinite(l0).all() and np.isfinite(l1).all()
+    log("single-device check: OK")
+
+    if jax.device_count() > 1:
+        from paddle_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh(("dp",))
+        compiled = fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name)
+        scope2 = fluid.Scope()
+        with fluid.scope_guard(scope2):
+            exe2 = fluid.Executor()
+            exe2.run(startup)
+            n = jax.device_count() * 4
+            xs = np.random.rand(n, 2).astype(np.float32)
+            ys = xs.sum(1, keepdims=True).astype(np.float32)
+            l2, = exe2.run(compiled, feed={"x": xs, "y": ys},
+                           fetch_list=[loss])
+        assert np.isfinite(l2).all()
+        log(f"multi-device data-parallel check on {jax.device_count()} "
+            "devices: OK")
+    log("paddle_tpu install check passed!")
+    return True

@@ -1,0 +1,22 @@
+"""Logging helper.
+
+Parity: python/paddle/fluid/log_helper.py get_logger — module-scoped loggers
+that don't propagate to root (so user logging config isn't polluted).
+"""
+
+import logging
+
+
+def get_logger(name, level=logging.INFO, fmt=None):
+    logger = logging.getLogger(name)
+    if getattr(logger, "_pt_configured", False):
+        logger.setLevel(level)
+        return logger
+    logger.setLevel(level)
+    handler = logging.StreamHandler()
+    handler.setFormatter(logging.Formatter(
+        fmt or "%(asctime)s %(name)s %(levelname)s: %(message)s"))
+    logger.addHandler(handler)
+    logger.propagate = False
+    logger._pt_configured = True
+    return logger

@@ -1,0 +1,85 @@
+"""NaN/Inf failure detection.
+
+Parity: the reference's FLAGS_check_nan_inf / tensor check machinery
+(paddle/fluid/framework/details/nan_inf_utils*) which scans op outputs per
+kernel launch. TPU-native: (a) `jax.debug_nans` mode for tracing the first
+NaN-producing op inside the jitted step, (b) a post-step host check over the
+fetched state that names the offending variable, (c) a `guard_loss` helper
+that hard-fails the step when the loss goes non-finite (failure-detection
+parity for long unattended runs).
+"""
+
+import contextlib
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+ENV_FLAG = "PT_CHECK_NAN_INF"  # parity: FLAGS_check_nan_inf
+
+
+def enabled():
+    return os.environ.get(ENV_FLAG, "0") not in ("0", "", "false", "False")
+
+
+@contextlib.contextmanager
+def debug_nans(enable=True):
+    """Trace-level NaN detection: XLA re-runs the failing computation
+    un-jitted and raises at the first NaN-producing primitive."""
+    old = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", bool(enable))
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", old)
+
+
+def check_numerics(tree, prefix=""):
+    """Host-side scan of a pytree (e.g. the Scope state dict); returns the
+    list of paths holding non-finite values."""
+    bad = []
+
+    def visit(path, leaf):
+        try:
+            arr = np.asarray(leaf)
+        except Exception:
+            return
+        if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+            n_nan = int(np.isnan(arr).sum())
+            n_inf = int(np.isinf(arr).sum())
+            bad.append((f"{prefix}{path}", n_nan, n_inf))
+
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            visit(k, v)
+    else:
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
+            visit(str(i), leaf)
+    return bad
+
+
+def assert_all_finite(tree, prefix=""):
+    bad = check_numerics(tree, prefix)
+    if bad:
+        lines = "\n".join(f"  {p}: {n} NaN, {i} Inf" for p, n, i in bad)
+        raise FloatingPointError(
+            f"non-finite values detected (parity: FLAGS_check_nan_inf):\n{lines}")
+
+
+def guard_loss(loss_value, step=None):
+    """Raise if the scalar loss is NaN/Inf — the cheap always-on failure
+    detector for training loops."""
+    v = float(loss_value)
+    if not np.isfinite(v):
+        at = f" at step {step}" if step is not None else ""
+        raise FloatingPointError(f"loss became {v}{at}; "
+                                 "enable PT_CHECK_NAN_INF=1 or "
+                                 "utils.nan_check.debug_nans() to locate it")
+    return v
+
+
+def isfinite_all(x):
+    """In-graph all-finite reduction (parity: layers.isfinite on a list)."""
+    return jnp.all(jnp.isfinite(x))

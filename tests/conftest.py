@@ -7,7 +7,9 @@ job, correctness is this suite's job.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even if the shell exports a TPU platform (e.g. axon): the
+# suite's job is correctness on the virtual 8-device mesh, not TPU perf.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
