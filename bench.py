@@ -41,18 +41,7 @@ def build_step():
     exe = fluid.Executor(fluid.TPUPlace(0))
     exe.run(startup)
 
-    rs = np.random.RandomState(0)
-    P = cfg.max_predictions_per_seq
-    feed = {
-        "src_ids": rs.randint(0, cfg.vocab_size, (batch, seq_len)).astype(np.int32),
-        "sent_ids": rs.randint(0, 2, (batch, seq_len)).astype(np.int32),
-        "input_mask": np.ones((batch, seq_len), np.float32),
-        "mask_pos": np.stack([np.arange(P) + i * seq_len
-                              for i in range(batch)]).astype(np.int32),
-        "mask_label": rs.randint(0, cfg.vocab_size, (batch, P)).astype(np.int32),
-        "mask_weight": np.ones((batch, P), np.float32),
-        "nsp_label": rs.randint(0, 2, (batch, 1)).astype(np.int32),
-    }
+    feed = bert.make_pretrain_feed(cfg, seq_len, batch, dtype=np.int32)
 
     def step():
         return exe.run(main, feed=feed, fetch_list=[total_loss])

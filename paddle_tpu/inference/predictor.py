@@ -80,8 +80,17 @@ class Predictor:
         if isinstance(feeds, (list, tuple)):
             feeds = dict(zip(self.feed_names, feeds))
         n = next(iter(feeds.values())).shape[0]
-        bucket = next((b for b in self.config.fixed_batch_sizes if b >= n),
-                      self.config.fixed_batch_sizes[-1])
+        largest = self.config.fixed_batch_sizes[-1]
+        if n > largest:
+            # Split oversized requests into largest-bucket chunks so the
+            # serving path never sees an uncompiled input signature.
+            chunks = []
+            for s in range(0, n, largest):
+                chunks.append(self.predict_batch(
+                    {k: np.asarray(v)[s:s + largest]
+                     for k, v in feeds.items()}))
+            return [np.concatenate(parts) for parts in zip(*chunks)]
+        bucket = next(b for b in self.config.fixed_batch_sizes if b >= n)
         padded = {k: np.concatenate(
             [np.asarray(v)] + [np.zeros_like(np.asarray(v)[:1])] * (bucket - n))
             if bucket > n else np.asarray(v) for k, v in feeds.items()}

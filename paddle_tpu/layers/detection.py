@@ -198,11 +198,13 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
                                padding=pad, stride=stride)
         conf = nn_layers.conv2d(feat, num_priors * num_classes, kernel_size,
                                 padding=pad, stride=stride)
-        n = feat.shape[0]
+        # Batch dim is symbolic (-1) at graph-build time; the prior count
+        # per map is static (H*W*A), so put -1 only on the batch axis.
+        n_boxes = feat.shape[2] * feat.shape[3] * num_priors
         loc = nn_layers.reshape(nn_layers.transpose(loc, [0, 2, 3, 1]),
-                                [n, -1, 4])
+                                [-1, n_boxes, 4])
         conf = nn_layers.reshape(nn_layers.transpose(conf, [0, 2, 3, 1]),
-                                 [n, -1, num_classes])
+                                 [-1, n_boxes, num_classes])
         locs.append(loc)
         confs.append(conf)
         boxes_all.append(nn_layers.reshape(box, [-1, 4]))

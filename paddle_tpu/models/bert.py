@@ -16,6 +16,7 @@ TPU notes (why this looks different from the CUDA recipe):
 """
 
 from .. import layers
+from ..core import framework
 from ..core.param_attr import ParamAttr
 
 
@@ -127,8 +128,15 @@ def build_pretrain_net(cfg=None, seq_len=128):
     trans = layers.fc(masked_h, size=cfg.hidden_size, act=cfg.hidden_act,
                       param_attr=ParamAttr(name="mlm_trans_w"))
     trans = layers.layer_norm(trans, begin_norm_axis=1)
-    mlm_logits = layers.fc(trans, size=cfg.vocab_size, bias_attr=True,
-                           param_attr=ParamAttr(name="mlm_out_w"))
+    # Output projection shares the token embedding table (tied weights, the
+    # BERT/ERNIE recipe): logits = trans @ word_embedding^T + bias.
+    word_emb = framework.default_main_program().global_block().var(
+        "word_embedding")
+    mlm_bias = layers.create_parameter(
+        [cfg.vocab_size], "float32", attr=ParamAttr(name="mlm_out_b"),
+        is_bias=True)
+    mlm_logits = layers.elementwise_add(
+        layers.matmul(trans, word_emb, transpose_y=True), mlm_bias)
     mlm_loss_tok = layers.softmax_with_cross_entropy(
         logits=mlm_logits,
         label=layers.reshape(mask_label, shape=[-1, 1]))
@@ -152,6 +160,26 @@ def build_pretrain_net(cfg=None, seq_len=128):
              "mask_label": mask_label, "mask_weight": mask_weight,
              "nsp_label": nsp_label}
     return feeds, total_loss, mlm_loss, nsp_acc
+
+
+def make_pretrain_feed(cfg, seq_len, batch, seed=0, dtype=None):
+    """Synthetic feed dict matching build_pretrain_net's contract — the one
+    place that knows the feed schema (used by bench.py, __graft_entry__ and
+    the model-zoo tests)."""
+    import numpy as np
+    dtype = dtype or np.int64
+    rs = np.random.RandomState(seed)
+    P_ = cfg.max_predictions_per_seq
+    return {
+        "src_ids": rs.randint(0, cfg.vocab_size, (batch, seq_len)).astype(dtype),
+        "sent_ids": rs.randint(0, 2, (batch, seq_len)).astype(dtype),
+        "input_mask": np.ones((batch, seq_len), np.float32),
+        "mask_pos": np.stack([np.arange(P_) + i * seq_len
+                              for i in range(batch)]).astype(dtype),
+        "mask_label": rs.randint(0, cfg.vocab_size, (batch, P_)).astype(dtype),
+        "mask_weight": np.ones((batch, P_), np.float32),
+        "nsp_label": rs.randint(0, 2, (batch, 1)).astype(dtype),
+    }
 
 
 def build_classifier_net(cfg=None, seq_len=128, num_labels=2):

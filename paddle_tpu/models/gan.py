@@ -28,12 +28,14 @@ def generator(z, ngf=64):
                           act="relu", param_attr=p("bn0_s"),
                           bias_attr=p("bn0_b"))
     h = layers.conv2d_transpose(h, num_filters=ngf, filter_size=4, stride=2,
-                                padding=1, param_attr=p("deconv1_w"))
+                                padding=1, param_attr=p("deconv1_w"),
+                                bias_attr=p("deconv1_b"))
     h = layers.batch_norm(h, act="relu", param_attr=p("bn1_s"),
                           bias_attr=p("bn1_b"))
     img = layers.conv2d_transpose(h, num_filters=1, filter_size=4, stride=2,
                                   padding=1, act="tanh",
-                                  param_attr=p("deconv2_w"))
+                                  param_attr=p("deconv2_w"),
+                                  bias_attr=p("deconv2_b"))
     return img
 
 
@@ -45,9 +47,11 @@ def discriminator(img, ndf=64):
         return ParamAttr(name=f"d_{n}")
 
     h = layers.conv2d(img, num_filters=ndf, filter_size=4, stride=2,
-                      padding=1, act="leaky_relu", param_attr=p("conv0_w"))
+                      padding=1, act="leaky_relu", param_attr=p("conv0_w"),
+                      bias_attr=p("conv0_b"))
     h = layers.conv2d(h, num_filters=ndf * 2, filter_size=4, stride=2,
-                      padding=1, param_attr=p("conv1_w"))
+                      padding=1, param_attr=p("conv1_w"),
+                      bias_attr=p("conv1_b"))
     h = layers.batch_norm(h, act="leaky_relu", param_attr=p("bn1_s"),
                           bias_attr=p("bn1_b"))
     return layers.fc(h, size=1, param_attr=p("fc_w"), bias_attr=p("fc_b"))

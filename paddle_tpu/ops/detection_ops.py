@@ -321,7 +321,9 @@ def ssd_loss(ctx):
     loc = ctx.in_("Location")        # (N, M, 4) predicted offsets
     conf = ctx.in_("Confidence")     # (N, M, C) logits
     gt_box = ctx.in_("GtBox")        # (N, G, 4)
-    gt_label = ctx.in_("GtLabel")    # (N, G)
+    gt_label = ctx.in_("GtLabel")    # (N, G) or (N, G, 1)
+    if gt_label.ndim == 3:
+        gt_label = gt_label[..., 0]
     prior = ctx.in_("PriorBox")      # (M, 4)
     overlap_thresh = ctx.attr("overlap_threshold", 0.5)
     neg_ratio = ctx.attr("neg_pos_ratio", 3.0)

@@ -61,10 +61,17 @@ def conv2d_transpose(ctx):
     pads = _pair(ctx.attr("paddings", [0, 0]))
     dilations = _pair(ctx.attr("dilations", [1, 1]))
     groups = ctx.attr("groups", 1) or 1
-    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "IOHW", "NCHW"))
+    # Fluid filter layout is (C_in, C_out/g, kH, kW) — the forward-conv
+    # kernel of the op this transposes, i.e. OIHW with O == lhs features.
+    # transpose_kernel=True makes conv_transpose swap O/I and flip spatial,
+    # exactly the gradient-of-conv semantics the reference kernel implements.
+    # The explicit padding of the dilated conv is (k-1)*d - p per side, which
+    # yields out = (in-1)*s - 2p + (k-1)*d + 1 (the reference's formula).
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    tpads = [dilations[i] * (w.shape[2 + i] - 1) - pads[i] for i in range(2)]
     out = lax.conv_transpose(
         x, w, strides=strides,
-        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        padding=[(tpads[0], tpads[0]), (tpads[1], tpads[1])],
         rhs_dilation=dilations, dimension_numbers=dn,
         transpose_kernel=True)
     if groups != 1:
@@ -93,7 +100,10 @@ def _pool(x, pool_type, ksize, strides, pads, exclusive=True, global_pool=False,
             cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides_, padding)
             out = s / cnt
         else:
-            out = s / float(jnp.prod(jnp.asarray(ksize)))
+            denom = 1.0
+            for k in ksize:
+                denom *= float(k)
+            out = s / denom
     return out
 
 

@@ -43,8 +43,6 @@ def run_check(verbose=True):
     log("single-device check: OK")
 
     if jax.device_count() > 1:
-        from paddle_tpu.parallel.mesh import make_mesh
-        mesh = make_mesh(("dp",))
         compiled = fluid.CompiledProgram(main).with_data_parallel(
             loss_name=loss.name)
         scope2 = fluid.Scope()

@@ -51,12 +51,9 @@ def check_numerics(tree, prefix=""):
             n_inf = int(np.isinf(arr).sum())
             bad.append((f"{prefix}{path}", n_nan, n_inf))
 
-    if isinstance(tree, dict):
-        for k, v in tree.items():
-            visit(k, v)
-    else:
-        for i, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
-            visit(str(i), leaf)
+    leaves_with_paths, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in leaves_with_paths:
+        visit(jax.tree_util.keystr(path, simple=True, separator="."), leaf)
     return bad
 
 

@@ -21,6 +21,13 @@ import pytest
 
 import jax
 
+# A TPU plugin registered by the interpreter's sitecustomize (e.g. axon)
+# may have force-set jax_platforms via config.update, which overrides the
+# JAX_PLATFORMS env var above. Re-assert cpu-only AFTER importing jax so
+# the suite never initializes the TPU backend (a wedged/absent TPU tunnel
+# must not hang correctness tests).
+jax.config.update("jax_platforms", "cpu")
+
 # Numeric tests compare against fp64/numpy goldens; force fp32 matmuls
 # (production path uses bf16 on the MXU — precision is bench.py's concern).
 jax.config.update("jax_default_matmul_precision", "highest")
