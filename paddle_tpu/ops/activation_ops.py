@@ -41,7 +41,9 @@ register("square")(_unary(jnp.square))
 register("softplus")(_unary(jax.nn.softplus))
 register("softsign")(_unary(jax.nn.soft_sign))
 register("sign")(_unary(jnp.sign))
-register("gelu")(_unary(jax.nn.gelu))
+# exact erf form (the reference's gelu op); the tanh approximation is what
+# jax defaults to, but fluid tests compare against erf
+register("gelu")(_unary(lambda x: jax.nn.gelu(x, approximate=False)))
 register("erf")(_unary(lax.erf))
 
 

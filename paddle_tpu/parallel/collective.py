@@ -25,7 +25,8 @@ def allreduce(x, op="sum", axis_name="dp"):
     if op == "min":
         return lax.pmin(x, axis_name)
     if op == "prod":
-        return jnp.exp(lax.psum(jnp.log(x), axis_name))
+        # gather+multiply (not exp∘psum∘log, which breaks on zeros/negatives)
+        return jnp.prod(lax.all_gather(x, axis_name), axis=0)
     raise ValueError(f"unknown allreduce op {op}")
 
 

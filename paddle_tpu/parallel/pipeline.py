@@ -26,7 +26,8 @@ def pipeline_step(stage_fn, stacked_params, x_microbatches, axis_name="pp"):
     stage_fn(params, x) -> y, applied by every stage to its current slot.
     x_microbatches: (M, ...) local copy of all microbatches (only stage 0
     actually consumes them; later stages receive from the ring).
-    Returns (M, ...) outputs valid on the LAST stage.
+    Returns (M, ...) outputs, broadcast from the last stage so every stage
+    holds the final values (safe to expose with out_specs=P()).
     """
     pp = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
@@ -51,6 +52,10 @@ def pipeline_step(stage_fn, stacked_params, x_microbatches, axis_name="pp"):
                      jnp.arange(ticks))
     # on the last stage, outputs for microbatch k appear at tick k + pp - 1
     out = lax.dynamic_slice_in_dim(ys, pp - 1, m, axis=0)
+    # only the last stage holds real outputs; broadcast so the result is
+    # truly replicated (out_specs=P() in the shard_map wrapper)
+    out = lax.psum(jnp.where(idx == pp - 1, out, jnp.zeros_like(out)),
+                   axis_name)
     return out
 
 

@@ -1,0 +1,145 @@
+"""Dygraph (imperative) tests (SURVEY.md §4 dygraph tier).
+
+Mirrors the reference's test_imperative_* suite: eager autograd vs static
+graph parity on the same params, checkpoint round-trip, to_static bridge.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, dygraph
+from paddle_tpu.dygraph import nn as dnn, functional as F
+
+
+def test_eager_autograd_matches_static():
+    """Same fc params: dygraph loss & param grads == static program's."""
+    rs = np.random.RandomState(0)
+    xs = rs.rand(8, 4).astype(np.float32)
+    ys = rs.rand(8, 1).astype(np.float32)
+
+    # -- dygraph
+    with dygraph.guard():
+        fc = dnn.FC("fc", size=1)
+        pred = fc(dygraph.to_variable(xs))
+        w = fc.parameters()[0]
+        diff = pred - dygraph.to_variable(ys)
+        loss = F.mean(diff * diff)
+        loss.backward()
+        dy_loss = float(loss.numpy())
+        dy_wgrad = np.asarray(w.gradient())
+        w_val = np.asarray(w.numpy())
+        b_val = np.asarray(fc.parameters()[1].numpy())
+
+    # -- static, same params
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred_s = layers.fc(x, size=1, param_attr=fluid.ParamAttr(name="w"),
+                       bias_attr=fluid.ParamAttr(name="b"))
+    loss_s = layers.mean(layers.square_error_cost(pred_s, y))
+    fluid.append_backward(loss_s)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    fluid.global_scope().set("w", jnp.asarray(w_val))
+    fluid.global_scope().set("b", jnp.asarray(b_val))
+    out = exe.run(feed={"x": xs, "y": ys},
+                  fetch_list=[loss_s, "w@GRAD"])
+    np.testing.assert_allclose(float(out[0]), dy_loss, rtol=1e-5)
+    np.testing.assert_allclose(out[1], dy_wgrad, rtol=1e-4, atol=1e-6)
+
+
+def test_dygraph_sgd_matches_static_sgd():
+    """One SGD step in both modes from identical init → identical params."""
+    rs = np.random.RandomState(1)
+    xs = rs.rand(16, 4).astype(np.float32)
+    ys = xs.sum(1, keepdims=True).astype(np.float32)
+
+    with dygraph.guard():
+        fc = dnn.FC("fc", size=1)
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=0.1)
+        diff = fc(dygraph.to_variable(xs)) - dygraph.to_variable(ys)
+        w0 = np.asarray(fc.parameters()[0].numpy()).copy()
+        b0 = np.asarray(fc.parameters()[1].numpy()).copy()
+        loss = F.mean(diff * diff)
+        loss.backward()
+        opt.minimize(loss)
+        w1_dy = np.asarray(fc.parameters()[0].numpy())
+
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1, param_attr=fluid.ParamAttr(name="w"),
+                     bias_attr=fluid.ParamAttr(name="b"))
+    loss_s = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss_s)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    fluid.global_scope().set("w", jnp.asarray(w0))
+    fluid.global_scope().set("b", jnp.asarray(b0))
+    exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss_s])
+    w1_st = np.asarray(fluid.global_scope().get("w"))
+    np.testing.assert_allclose(w1_dy, w1_st, rtol=1e-5, atol=1e-7)
+
+
+def test_layer_state_dict_roundtrip(tmp_path):
+    with dygraph.guard():
+        net = dnn.Conv2D(3, 8, 3)
+        sd = net.state_dict()
+        dygraph.save_dygraph(sd, str(tmp_path / "model"))
+        loaded, _ = dygraph.load_dygraph(str(tmp_path / "model"))
+        net2 = dnn.Conv2D(3, 8, 3)
+        net2.set_dict(loaded)
+        for (n1, p1), (n2, p2) in zip(sorted(net.state_dict().items()),
+                                      sorted(net2.state_dict().items())):
+            assert n1 == n2
+            np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
+
+
+def test_sequential_and_parameters():
+    from paddle_tpu.dygraph.layers import Sequential
+    with dygraph.guard():
+        seq = Sequential(dnn.Linear(4, 8), dnn.Linear(8, 2))
+        out = seq(dygraph.to_variable(np.ones((2, 4), np.float32)))
+        assert out.shape == (2, 2)
+        assert len(seq.parameters()) == 4
+
+
+def test_batchnorm_train_vs_eval():
+    rs = np.random.RandomState(0)
+    xs = rs.rand(8, 4, 5, 5).astype(np.float32) * 3 + 1
+    with dygraph.guard():
+        bn = dnn.BatchNorm(4)
+        out_train = bn(dygraph.to_variable(xs))
+        # training mode: output normalized by batch stats
+        got = np.asarray(out_train.numpy())
+        assert abs(got.mean()) < 1e-2
+        bn.eval()
+        out_eval = bn(dygraph.to_variable(xs))
+        # eval mode uses running stats (moving mean just updated once)
+        assert np.asarray(out_eval.numpy()).shape == xs.shape
+
+
+def test_to_static_bridge():
+    from paddle_tpu.dygraph.jit import to_static
+    with dygraph.guard():
+        fc = dnn.FC("fc", size=3)
+        x = np.ones((2, 4), np.float32)
+        eager_out = np.asarray(fc(dygraph.to_variable(x)).numpy())
+        jit_out = np.asarray(to_static(fc)(x))
+        np.testing.assert_allclose(jit_out, eager_out, rtol=1e-5)
+
+
+def test_gradient_accumulation_and_clear():
+    with dygraph.guard():
+        fc = dnn.FC("fc", size=1)
+        x = dygraph.to_variable(np.ones((2, 3), np.float32))
+        loss1 = F.mean(fc(x))
+        loss1.backward()
+        g1 = np.asarray(fc.parameters()[0].gradient())
+        loss2 = F.mean(fc(x))
+        loss2.backward()
+        g2 = np.asarray(fc.parameters()[0].gradient())
+        np.testing.assert_allclose(g2, 2 * g1, rtol=1e-5)
+        fc.clear_gradients()
+        assert fc.parameters()[0].gradient() is None

@@ -1,0 +1,374 @@
+"""Op numeric tests vs numpy goldens, fwd + grad (SURVEY.md §4 ops tier).
+
+Mirrors the reference's OpTest pattern (fluid/tests/unittests/test_*_op.py):
+build a one-op program, run it through the Executor, compare against a numpy
+golden; gradient checks go through append_backward and compare against
+finite differences.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _fresh_program():
+    from paddle_tpu.core import framework
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+
+
+def run_layer(build, feeds, n_out=1):
+    """build(vars...) -> output var(s); feeds: {name: (array)}."""
+    data_vars = [layers.data(n, shape=list(a.shape[1:]),
+                             dtype=str(a.dtype)) for n, a in feeds.items()]
+    outs = build(*data_vars)
+    outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    res = exe.run(feed=dict(feeds), fetch_list=list(outs))
+    return res[0] if n_out == 1 else res
+
+
+def check(build, feeds, golden, rtol=1e-5, atol=1e-6):
+    got = run_layer(build, feeds)
+    np.testing.assert_allclose(np.asarray(got), golden, rtol=rtol, atol=atol)
+
+
+RS = np.random.RandomState(7)
+
+
+# ---------------------------------------------------------------- activations
+def _softmax_np(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+@pytest.mark.parametrize("name,fn,golden", [
+    ("relu", layers.relu, lambda x: np.maximum(x, 0)),
+    ("sigmoid", layers.sigmoid, lambda x: 1 / (1 + np.exp(-x))),
+    ("tanh", layers.tanh, np.tanh),
+    ("leaky_relu", lambda v: layers.leaky_relu(v, alpha=0.1),
+     lambda x: np.where(x > 0, x, 0.1 * x)),
+    ("relu6", layers.relu6, lambda x: np.clip(x, 0, 6)),
+    ("softmax", layers.softmax, _softmax_np),
+    ("elu", layers.elu, lambda x: np.where(x > 0, x, np.exp(x) - 1)),
+    ("softplus", layers.softplus, lambda x: np.log1p(np.exp(x))),
+    ("square", layers.square, lambda x: x * x),
+    ("abs", layers.abs, np.abs),
+    ("exp", layers.exp, np.exp),
+])
+def test_activation(name, fn, golden):
+    x = RS.randn(4, 8).astype(np.float32) * 2
+    check(fn, {"x": x}, golden(x), rtol=1e-4, atol=1e-5)
+
+
+def test_gelu_matches_erf_form():
+    import math
+    x = RS.randn(4, 8).astype(np.float32)
+    erf = np.vectorize(math.erf)
+    golden = 0.5 * x * (1 + erf(x / np.sqrt(2)))
+    check(layers.gelu, {"x": x}, golden, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------- matmul / fc
+def test_matmul_transpose():
+    a = RS.randn(3, 4, 5).astype(np.float32)
+    b = RS.randn(3, 6, 5).astype(np.float32)
+    check(lambda x, y: layers.matmul(x, y, transpose_y=True),
+          {"a": a, "b": b}, a @ b.transpose(0, 2, 1), rtol=1e-4)
+
+
+def test_mul_flattens():
+    a = RS.randn(2, 3, 4).astype(np.float32)
+    b = RS.randn(12, 5).astype(np.float32)
+    check(lambda x, y: layers.mul(x, y, x_num_col_dims=1),
+          {"a": a, "b": b}, a.reshape(2, 12) @ b, rtol=1e-4)
+
+
+def test_elementwise_broadcast_axis():
+    x = RS.randn(2, 3, 4).astype(np.float32)
+    y = RS.randn(3).astype(np.float32)
+    check(lambda a, b: layers.elementwise_add(a, b, axis=1),
+          {"x": x, "y": y}, x + y[None, :, None], rtol=1e-5)
+
+
+# ---------------------------------------------------------------- reductions
+def test_reductions():
+    x = RS.randn(3, 4, 5).astype(np.float32)
+    for build, golden in [
+        (lambda v: layers.reduce_sum(v, dim=1), x.sum(1)),
+        (lambda v: layers.reduce_mean(v, dim=[1, 2]), x.mean((1, 2))),
+        (lambda v: layers.reduce_max(v, dim=0), x.max(0)),
+        (lambda v: layers.reduce_min(v, dim=-1, keep_dim=True),
+         x.min(-1, keepdims=True)),
+        (lambda v: layers.reduce_prod(v, dim=2), x.prod(2)),
+    ]:
+        _fresh_program()
+        check(build, {"x": x}, golden, rtol=1e-4, atol=1e-5)
+
+
+def test_cumsum():
+    x = RS.randn(3, 5).astype(np.float32)
+    check(lambda v: layers.cumsum(v, axis=1), {"x": x}, np.cumsum(x, 1),
+          rtol=1e-5)
+
+
+# ---------------------------------------------------------------- conv / pool
+def _conv2d_np(x, w, stride=1, pad=0):
+    n, c, h, wd = x.shape
+    o, _, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wd + 2 * pad - kw) // stride + 1
+    out = np.zeros((n, o, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + kh,
+                       j * stride:j * stride + kw]
+            out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+    return out
+
+
+def test_conv2d_matches_numpy():
+    x = RS.randn(2, 3, 8, 8).astype(np.float32)
+    w = RS.randn(4, 3, 3, 3).astype(np.float32)
+    golden = _conv2d_np(x, w, stride=2, pad=1)
+
+    def build(v):
+        out = layers.conv2d(v, num_filters=4, filter_size=3, stride=2,
+                            padding=1, bias_attr=False,
+                            param_attr=fluid.ParamAttr(name="cw"))
+        return out
+
+    x_var = layers.data("x", shape=[3, 8, 8], dtype="float32")
+    out = build(x_var)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    fluid.global_scope().set("cw", jnp.asarray(w))
+    got, = exe.run(feed={"x": x}, fetch_list=[out])
+    np.testing.assert_allclose(got, golden, rtol=1e-3, atol=1e-4)
+
+
+def test_pool2d_max_and_avg():
+    x = RS.randn(2, 3, 6, 6).astype(np.float32)
+    got_max = run_layer(
+        lambda v: layers.pool2d(v, pool_size=2, pool_stride=2,
+                                pool_type="max"), {"x": x})
+    golden = x.reshape(2, 3, 3, 2, 3, 2).max((3, 5))
+    np.testing.assert_allclose(got_max, golden, rtol=1e-6)
+
+    _fresh_program()
+    got_avg = run_layer(
+        lambda v: layers.pool2d(v, pool_size=2, pool_stride=2,
+                                pool_type="avg"), {"x": x})
+    golden = x.reshape(2, 3, 3, 2, 3, 2).mean((3, 5))
+    np.testing.assert_allclose(got_avg, golden, rtol=1e-5)
+
+
+def test_adaptive_pool_global():
+    x = RS.randn(2, 3, 7, 7).astype(np.float32)
+    got = run_layer(lambda v: layers.pool2d(v, global_pooling=True,
+                                            pool_type="avg"), {"x": x})
+    np.testing.assert_allclose(np.asarray(got)[:, :, 0, 0],
+                               x.mean((2, 3)), rtol=1e-5)
+
+
+# ---------------------------------------------------------------- norms
+def test_layer_norm_numeric():
+    x = RS.randn(4, 10).astype(np.float32)
+    got = run_layer(lambda v: layers.layer_norm(v, begin_norm_axis=1),
+                    {"x": x})
+    mu = x.mean(1, keepdims=True)
+    var = x.var(1, keepdims=True)
+    golden = (x - mu) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(got, golden, rtol=1e-4, atol=1e-5)
+
+
+def test_batch_norm_training_stats():
+    x = (RS.randn(8, 3, 4, 4) * 3 + 5).astype(np.float32)
+    got = run_layer(lambda v: layers.batch_norm(v), {"x": x})
+    got = np.asarray(got)
+    np.testing.assert_allclose(got.mean((0, 2, 3)), np.zeros(3), atol=1e-4)
+    np.testing.assert_allclose(got.std((0, 2, 3)), np.ones(3), atol=1e-3)
+
+
+def test_group_norm_numeric():
+    x = RS.randn(2, 4, 3, 3).astype(np.float32)
+    got = run_layer(lambda v: layers.group_norm(v, groups=2), {"x": x})
+    xg = x.reshape(2, 2, 2, 3, 3)
+    mu = xg.mean((2, 3, 4), keepdims=True)
+    var = xg.var((2, 3, 4), keepdims=True)
+    golden = ((xg - mu) / np.sqrt(var + 1e-5)).reshape(x.shape)
+    np.testing.assert_allclose(got, golden, rtol=1e-4, atol=1e-4)
+
+
+def test_l2_normalize():
+    x = RS.randn(4, 6).astype(np.float32)
+    got = run_layer(lambda v: layers.l2_normalize(v, axis=1), {"x": x})
+    golden = x / np.sqrt((x ** 2).sum(1, keepdims=True) + 1e-10)
+    np.testing.assert_allclose(got, golden, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------- losses
+def test_cross_entropy_and_softmax_ce():
+    logits = RS.randn(6, 5).astype(np.float32)
+    label = RS.randint(0, 5, (6, 1)).astype(np.int64)
+    p = _softmax_np(logits)
+    golden = -np.log(p[np.arange(6), label[:, 0]])[:, None]
+
+    got = run_layer(
+        lambda v, l: layers.softmax_with_cross_entropy(v, l),
+        {"logits": logits, "label": label})
+    np.testing.assert_allclose(got, golden, rtol=1e-4, atol=1e-5)
+
+    _fresh_program()
+    got2 = run_layer(lambda v, l: layers.cross_entropy(layers.softmax(v), l),
+                     {"logits": logits, "label": label})
+    np.testing.assert_allclose(got2, golden, rtol=1e-4, atol=1e-5)
+
+
+def test_sigmoid_ce_with_logits():
+    x = RS.randn(4, 3).astype(np.float32)
+    lbl = RS.rand(4, 3).astype(np.float32)
+    golden = np.maximum(x, 0) - x * lbl + np.log1p(np.exp(-np.abs(x)))
+    got = run_layer(
+        lambda v, l: layers.sigmoid_cross_entropy_with_logits(v, l),
+        {"x": x, "lbl": lbl})
+    np.testing.assert_allclose(got, golden, rtol=1e-4, atol=1e-5)
+
+
+def test_smooth_l1():
+    x = RS.randn(4, 3).astype(np.float32)
+    y = RS.randn(4, 3).astype(np.float32)
+    d = x - y
+    elt = np.where(np.abs(d) < 1, 0.5 * d * d, np.abs(d) - 0.5)
+    golden = elt.sum(1, keepdims=True)
+    got = run_layer(lambda a, b: layers.smooth_l1(a, b), {"x": x, "y": y})
+    np.testing.assert_allclose(got, golden, rtol=1e-4, atol=1e-5)
+
+
+def test_kldiv_loss():
+    x = np.log(_softmax_np(RS.randn(4, 5))).astype(np.float32)
+    t = _softmax_np(RS.randn(4, 5)).astype(np.float32)
+    golden = (t * (np.log(t) - x)).mean()
+    got = run_layer(lambda a, b: layers.kldiv_loss(a, b, reduction="mean"),
+                    {"x": x, "t": t})
+    np.testing.assert_allclose(np.asarray(got), golden, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------- tensor ops
+def test_concat_split_stack():
+    a = RS.randn(2, 3).astype(np.float32)
+    b = RS.randn(2, 5).astype(np.float32)
+    got = run_layer(lambda x, y: layers.concat([x, y], axis=1),
+                    {"a": a, "b": b})
+    np.testing.assert_array_equal(got, np.concatenate([a, b], 1))
+
+    _fresh_program()
+    outs = run_layer(lambda x: layers.split(x, num_or_sections=[2, 6], dim=1),
+                     {"x": np.arange(16, dtype=np.float32).reshape(2, 8)},
+                     n_out=2)
+    assert outs[0].shape == (2, 2) and outs[1].shape == (2, 6)
+
+    _fresh_program()
+    got = run_layer(lambda x, y: layers.stack([x, y], axis=0),
+                    {"a": a, "b": a})
+    np.testing.assert_array_equal(got, np.stack([a, a], 0))
+
+
+def test_gather_scatter_topk():
+    x = np.arange(20, dtype=np.float32).reshape(5, 4)
+    idx = np.array([0, 3], np.int64)
+    got = run_layer(lambda v, i: layers.gather(v, i), {"x": x, "idx": idx})
+    np.testing.assert_array_equal(got, x[[0, 3]])
+
+    _fresh_program()
+    vals, inds = run_layer(lambda v: layers.topk(v, k=2), {"x": x}, n_out=2)
+    np.testing.assert_array_equal(vals, np.sort(x, 1)[:, ::-1][:, :2])
+    np.testing.assert_array_equal(inds, np.argsort(-x, 1)[:, :2])
+
+
+def test_where_clip_sign():
+    x = RS.randn(3, 4).astype(np.float32)
+    got = run_layer(lambda v: layers.clip(v, min=-0.5, max=0.5), {"x": x})
+    np.testing.assert_allclose(got, np.clip(x, -0.5, 0.5))
+
+    _fresh_program()
+    got = run_layer(layers.sign, {"x": x})
+    np.testing.assert_array_equal(got, np.sign(x))
+
+
+def test_pad_expand_tile():
+    x = np.ones((2, 3), np.float32)
+    got = run_layer(lambda v: layers.pad(v, paddings=[0, 1, 2, 0],
+                                         pad_value=9.0), {"x": x})
+    assert got.shape == (3, 5)
+    assert got[-1, 0] == 9.0 and got[0, 1] == 9.0
+
+    _fresh_program()
+    got = run_layer(lambda v: layers.expand(v, expand_times=[2, 1]), {"x": x})
+    np.testing.assert_array_equal(got, np.tile(x, (2, 1)))
+
+
+def test_one_hot_and_embedding_lookup():
+    idx = np.array([[1], [3]], np.int64)
+    got = run_layer(lambda v: layers.one_hot(v, depth=5), {"idx": idx})
+    golden = np.zeros((2, 5), np.float32)
+    golden[0, 1] = golden[1, 3] = 1
+    np.testing.assert_array_equal(np.asarray(got).reshape(2, 5), golden)
+
+
+def test_arg_ops():
+    x = RS.randn(3, 6).astype(np.float32)
+    got = run_layer(lambda v: layers.argmax(v, axis=1), {"x": x})
+    np.testing.assert_array_equal(np.asarray(got).ravel(), x.argmax(1))
+
+    _fresh_program()
+    got = run_layer(lambda v: layers.argsort(v, axis=1)[1], {"x": x})
+    np.testing.assert_array_equal(got, x.argsort(1))
+
+
+# ---------------------------------------------------------------- grad checks
+def _num_grad(f, x, eps=1e-3):
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp = x.copy(); xp[i] += eps
+        xm = x.copy(); xm[i] -= eps
+        g[i] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+@pytest.mark.parametrize("layer_fn", [
+    lambda v: layers.tanh(v),
+    lambda v: layers.sigmoid(v),
+    lambda v: layers.softmax(v),
+    lambda v: layers.layer_norm(v, begin_norm_axis=1),
+])
+def test_grad_matches_finite_difference(layer_fn):
+    x0 = RS.randn(3, 4).astype(np.float32)
+
+    def run_loss(xv):
+        prog = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(prog, startup):
+            v = layers.data("x", shape=[4], dtype="float32")
+            loss = layers.reduce_sum(layer_fn(v) * layer_fn(v))
+            fluid.gradients(loss, [v])
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            out = exe.run(prog, feed={"x": xv},
+                          fetch_list=[loss, "x@GRAD"])
+        return float(np.asarray(out[0])), np.asarray(out[1])
+
+    _, analytic = run_loss(x0)
+    numeric = _num_grad(lambda xv: run_loss(xv.astype(np.float32))[0], x0)
+    np.testing.assert_allclose(analytic, numeric, rtol=2e-2, atol=2e-3)

@@ -1,0 +1,95 @@
+"""Collective API tests on the virtual 8-device CPU mesh (SURVEY.md §4).
+
+Parity model: the reference's collective op unit tests
+(test_collective_allreduce_api etc.) run NCCL ops across cards and compare
+against the single-process reduction; here the collectives are lax
+primitives under shard_map and the golden is numpy.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from paddle_tpu.parallel import collective
+from paddle_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh1d():
+    return Mesh(np.array(jax.devices()), ("dp",))
+
+
+def _smap(fn, mesh, in_spec, out_spec):
+    return shard_map(fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
+                     check_rep=False)
+
+
+def test_allreduce_ops(mesh1d):
+    # mixed signs and a zero: exercises prod's zero/negative handling
+    x = (np.arange(16, dtype=np.float32).reshape(8, 2) - 5.0)
+    for op, golden in [("sum", x.sum(0)), ("mean", x.mean(0)),
+                       ("max", x.max(0)), ("min", x.min(0)),
+                       ("prod", x.prod(0))]:
+        fn = _smap(lambda s, _op=op: collective.allreduce(s, _op),
+                   mesh1d, (P("dp", None),), P("dp", None))
+        out = np.asarray(fn(x))
+        # every shard holds the reduction
+        for r in range(8):
+            np.testing.assert_allclose(out[r], golden, rtol=1e-5,
+                                       err_msg=op)
+
+
+def test_broadcast(mesh1d):
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+    fn = _smap(lambda s: collective.broadcast(s, root=3),
+               mesh1d, (P("dp", None),), P("dp", None))
+    out = np.asarray(fn(x))
+    np.testing.assert_array_equal(out, np.full((8, 1), 3.0))
+
+
+def test_allgather(mesh1d):
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+    fn = _smap(lambda s: collective.allgather(s, axis=0),
+               mesh1d, (P("dp", None),), P("dp", None))
+    out = np.asarray(fn(x))  # each shard gathers the full 8-vector
+    assert out.shape == (64, 1)
+    np.testing.assert_array_equal(out[:8], x)
+
+
+def test_reducescatter(mesh1d):
+    # each device contributes an (8,)-vector; result: shard r holds sum[r]
+    x = np.tile(np.arange(8, dtype=np.float32), (8, 1))  # (dev, 8)
+    fn = _smap(lambda s: collective.reducescatter(s[0], scatter_axis=0),
+               mesh1d, (P("dp", None),), P("dp"))
+    out = np.asarray(fn(x))
+    np.testing.assert_allclose(out, np.arange(8) * 8.0)
+
+
+def test_alltoall(mesh1d):
+    # device i sends row j of its (8, 1) slab to device j
+    x = np.arange(64, dtype=np.float32).reshape(8, 8, 1)  # (dev, 8, 1)
+    fn = _smap(lambda s: collective.alltoall(s[0], axis_name="dp",
+                                             split_axis=0, concat_axis=0),
+               mesh1d, (P("dp", None, None),), P("dp", None))
+    out = np.asarray(fn(x)).reshape(8, 8)
+    np.testing.assert_array_equal(out, x.reshape(8, 8).T)
+
+
+def test_ring_shift(mesh1d):
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+    fn = _smap(lambda s: collective.ring_shift(s, axis_name="dp", shift=1),
+               mesh1d, (P("dp", None),), P("dp", None))
+    out = np.asarray(fn(x)).ravel()
+    np.testing.assert_array_equal(out, np.roll(np.arange(8), 1))
+
+
+def test_make_mesh_axes():
+    mesh = make_mesh(tp=2, sp=2)
+    assert mesh.shape["tp"] == 2 and mesh.shape["sp"] == 2
+    assert mesh.shape["dp"] == 2
+    with pytest.raises(ValueError):
+        make_mesh(tp=3)
