@@ -1,0 +1,126 @@
+// Host-side prefetch ring buffer for the input pipeline.
+//
+// Parity: the reference's C++ double-buffered reader stack
+// (paddle/fluid/operators/reader/buffered_reader.cc, blocking_queue.h,
+// py_reader): a producer thread decodes/serializes batches while the
+// consumer (device feed) drains them, so host input work overlaps device
+// compute. TPU-native framing: the device side is XLA's business (the
+// Executor donates buffers); this ring only has to keep the HOST side of
+// the pipe full, which is where the reference spent its reader threads too.
+//
+// Design: fixed-slot ring of byte buffers + mutex/condvar pair, exactly the
+// blocking_queue.h idiom. Slots are recycled (no per-batch malloc once the
+// ring warms up). Exposed as a flat C ABI for ctypes (no pybind11 in this
+// image). Thread-safety: one mutex, two condvars (not_full / not_empty);
+// close() wakes everyone and makes push fail / pop drain-then-EOF.
+//
+// Build: g++ -O2 -shared -fPIC -pthread prefetch.cc -o libprefetch.so
+// (reader/native.py does this automatically on first import).
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+struct Slot {
+  std::vector<uint8_t> data;
+  size_t len = 0;
+};
+
+struct Ring {
+  std::vector<Slot> slots;
+  size_t head = 0;      // next pop index
+  size_t tail = 0;      // next push index
+  size_t count = 0;     // filled slots
+  bool closed = false;
+  std::mutex mu;
+  std::condition_variable not_full;
+  std::condition_variable not_empty;
+
+  explicit Ring(size_t n, size_t reserve_bytes) : slots(n) {
+    for (auto& s : slots) s.data.reserve(reserve_bytes);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Create a ring with `nslots` slots, each pre-reserving `slot_bytes`.
+void* pt_ring_create(size_t nslots, size_t slot_bytes) {
+  if (nslots == 0) nslots = 2;
+  return new Ring(nslots, slot_bytes);
+}
+
+void pt_ring_destroy(void* r) { delete static_cast<Ring*>(r); }
+
+// Blocking push. Returns 0 on success, -1 if the ring is closed.
+int pt_ring_push(void* rp, const void* data, size_t len) {
+  Ring* r = static_cast<Ring*>(rp);
+  std::unique_lock<std::mutex> lk(r->mu);
+  r->not_full.wait(lk, [&] { return r->count < r->slots.size() || r->closed; });
+  if (r->closed) return -1;
+  Slot& s = r->slots[r->tail];
+  s.data.resize(len);
+  if (len) std::memcpy(s.data.data(), data, len);
+  s.len = len;
+  r->tail = (r->tail + 1) % r->slots.size();
+  ++r->count;
+  r->not_empty.notify_one();
+  return 0;
+}
+
+// Query the byte length of the next item without popping.
+// Returns >=0 length, -1 when closed AND drained (EOF).
+// Blocks while empty-but-open.
+int64_t pt_ring_peek_len(void* rp) {
+  Ring* r = static_cast<Ring*>(rp);
+  std::unique_lock<std::mutex> lk(r->mu);
+  r->not_empty.wait(lk, [&] { return r->count > 0 || r->closed; });
+  if (r->count == 0) return -1;  // closed + drained
+  return static_cast<int64_t>(r->slots[r->head].len);
+}
+
+// Blocking pop into `out` (caller sized it via pt_ring_peek_len).
+// Returns copied length, or -1 on EOF (closed and drained).
+int64_t pt_ring_pop(void* rp, void* out, size_t cap) {
+  Ring* r = static_cast<Ring*>(rp);
+  std::unique_lock<std::mutex> lk(r->mu);
+  r->not_empty.wait(lk, [&] { return r->count > 0 || r->closed; });
+  if (r->count == 0) return -1;
+  Slot& s = r->slots[r->head];
+  size_t n = s.len < cap ? s.len : cap;
+  if (n) std::memcpy(out, s.data.data(), n);
+  r->head = (r->head + 1) % r->slots.size();
+  --r->count;
+  r->not_full.notify_one();
+  return static_cast<int64_t>(n);
+}
+
+// Producer signals end-of-stream; consumers drain remaining slots then EOF.
+void pt_ring_close(void* rp) {
+  Ring* r = static_cast<Ring*>(rp);
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->closed = true;
+  }
+  r->not_full.notify_all();
+  r->not_empty.notify_all();
+}
+
+size_t pt_ring_count(void* rp) {
+  Ring* r = static_cast<Ring*>(rp);
+  std::lock_guard<std::mutex> lk(r->mu);
+  return r->count;
+}
+
+int pt_ring_closed(void* rp) {
+  Ring* r = static_cast<Ring*>(rp);
+  std::lock_guard<std::mutex> lk(r->mu);
+  return r->closed ? 1 : 0;
+}
+
+}  // extern "C"

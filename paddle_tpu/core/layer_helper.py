@@ -6,7 +6,8 @@ and appends ops to the current block of the default main program.
 """
 
 from . import unique_name
-from .framework import default_main_program, default_startup_program, Variable
+from .framework import (default_main_program, default_startup_program,
+                        Variable, in_dygraph_mode)
 from .param_attr import ParamAttr
 from .. import initializer as init_mod
 
@@ -53,6 +54,8 @@ class LayerHelper:
         attr._with_initializer(default_initializer)
         name = attr.name if attr.name else unique_name.generate(
             ".".join([self.name, "b" if is_bias else "w"]))
+        if in_dygraph_mode():
+            return self._eager_parameter(attr, name, shape, dtype)
         param = self.block.create_parameter(
             name=name, shape=shape, dtype=dtype, trainable=attr.trainable,
             optimize_attr={"learning_rate": attr.learning_rate},
@@ -63,8 +66,29 @@ class LayerHelper:
         attr.initializer(param)
         return param
 
+    def _eager_parameter(self, attr, name, shape, dtype):
+        """fluid.layers.* under dygraph.guard: materialize the parameter
+        now; named params are shared across calls via the guard's store
+        (the eager analogue of static name-based sharing)."""
+        from ..dygraph import base as dy_base
+        from ..dygraph.layers import _materialize_init
+        store = dy_base.parameter_store()
+        if name in store:
+            return store[name]
+        value = _materialize_init(attr.initializer, shape, dtype)
+        p = dy_base.EagerVariable(value, name=name, persistable=True,
+                                  trainable=attr.trainable, is_leaf=True)
+        p.optimize_attr = {"learning_rate": attr.learning_rate}
+        p.regularizer = attr.regularizer
+        store[name] = p
+        return p
+
     # -- vars ---------------------------------------------------------------
     def create_variable_for_type_inference(self, dtype="float32", shape=None):
+        if in_dygraph_mode():
+            from ..dygraph.base import EagerVariable
+            return EagerVariable(None,
+                                 name=unique_name.generate(self.name + ".tmp"))
         return self.block.create_var(
             name=unique_name.generate(".".join([self.name, "tmp"])),
             dtype=dtype, shape=shape or ())
@@ -72,20 +96,46 @@ class LayerHelper:
     create_tmp_variable = create_variable_for_type_inference
 
     def create_variable(self, **kwargs):
+        if in_dygraph_mode():
+            from ..dygraph.base import EagerVariable
+            return EagerVariable(None, name=kwargs.get("name"))
         return self.block.create_var(**kwargs)
 
     def create_global_variable(self, persistable=False, **kwargs):
+        if in_dygraph_mode():
+            return self._eager_global_var(kwargs.get("name"), kwargs)
         return self.main_program.global_block().create_var(
             persistable=persistable, **kwargs)
 
     def create_or_get_global_variable(self, name, **kwargs):
+        if in_dygraph_mode():
+            return self._eager_global_var(name, kwargs)
         gb = self.main_program.global_block()
         if name in gb.vars:
             return gb.vars[name]
         return gb.create_var(name=name, **kwargs)
 
+    def _eager_global_var(self, name, kwargs):
+        """Eager buffer (e.g. batch-norm moving stats): shared by name via
+        the guard's store; its initializer fills the value on first use."""
+        from ..dygraph import base as dy_base
+        store = dy_base.parameter_store()
+        name = name or unique_name.generate(self.name + ".gvar")
+        if name in store:
+            return store[name]
+        v = dy_base.EagerVariable(None, name=name, persistable=True)
+        v._shell_shape = tuple(kwargs.get("shape") or ())
+        v._shell_dtype = kwargs.get("dtype", "float32")
+        store[name] = v
+        return v
+
     # -- ops ----------------------------------------------------------------
     def append_op(self, type, inputs=None, outputs=None, attrs=None):
+        if in_dygraph_mode():
+            from ..dygraph import functional as F
+            from ..dygraph.nn import _next_rng
+            return F.run_op_into(type, inputs, dict(attrs or {}), outputs,
+                                 rng=_next_rng())
         return self.block.append_op(type, inputs, outputs, attrs)
 
     def append_activation(self, out_var):

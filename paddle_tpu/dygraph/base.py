@@ -33,10 +33,18 @@ class Tape:
 
 _tape = None
 _no_grad_depth = 0
+# named-parameter store for fluid.layers.* called under dygraph.guard:
+# repeated calls with the same ParamAttr name share one eager parameter
+# (mirrors static-mode name-based sharing). Reset per guard().
+_param_store = {}
 
 
 def current_tape():
     return _tape
+
+
+def parameter_store():
+    return _param_store
 
 
 def enabled():
@@ -56,15 +64,18 @@ def disable_dygraph():
 
 @contextlib.contextmanager
 def guard(place=None):
-    global _tape
+    global _tape, _param_store
     old_tape = _tape
+    old_store = _param_store
     _tape = Tape()
+    _param_store = {}
     framework._set_dygraph_mode(True)
     try:
         yield
     finally:
         framework._set_dygraph_mode(False)
         _tape = old_tape
+        _param_store = old_store
 
 
 @contextlib.contextmanager
@@ -89,7 +100,9 @@ class EagerVariable:
 
     def __init__(self, value, name=None, persistable=False, trainable=False,
                  is_leaf=False):
-        self.value = jnp.asarray(value)
+        # value=None creates an empty shell the eager LayerHelper fills in
+        # (static-style layer functions pre-create their output vars).
+        self.value = None if value is None else jnp.asarray(value)
         EagerVariable._next_id += 1
         self.id = EagerVariable._next_id
         self.name = name or f"eager_var_{self.id}"
@@ -182,7 +195,12 @@ def run_backward(loss):
             return vals.get(v.id, v.value)
 
         for fn, args, kwargs, out in entries:
-            vals[out.id] = fn(*[get(k, v) for k, v in args], **kwargs)
+            res = fn(*[get(k, v) for k, v in args], **kwargs)
+            if isinstance(out, tuple):   # multi-output op (run_op_into)
+                for o, r in zip(out, res):
+                    vals[o.id] = r
+            else:
+                vals[out.id] = res
         out_val = vals.get(loss.id, loss.value)
         return jnp.sum(out_val)
 
@@ -210,7 +228,11 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
             return vals.get(v.id, v.value)
 
         for fn, args, kwargs, out in entries:
-            if out.id not in in_vals:
+            if isinstance(out, tuple):
+                res = fn(*[get(k, v) for k, v in args], **kwargs)
+                for o, r in zip(out, res):
+                    vals.setdefault(o.id, r)
+            elif out.id not in in_vals:
                 vals[out.id] = fn(*[get(k, v) for k, v in args], **kwargs)
         return sum(jnp.sum(vals.get(o.id, o.value)) for o in outputs)
 

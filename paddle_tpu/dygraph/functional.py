@@ -22,7 +22,8 @@ class MiniCtx:
         self._ins = ins
         self._attrs = attrs
         self._rng = rng
-        self.is_test = is_test
+        # fold the op-level attr like static OpContext does (ops/__init__.py)
+        self.is_test = is_test or bool(attrs.get("is_test", False))
         self.op = _FakeOp(attrs)
 
     def in_(self, slot, default=None):
@@ -56,16 +57,13 @@ class _FakeOp:
         self.attrs = attrs
 
 
-def run_op_eager(op_type, ins, attrs, out_slot="Out", rng=None, is_test=False):
-    """Execute a registry kernel eagerly on EagerVariables; record on tape."""
-    arg_spec = []   # parallel structure for replay
-    slots = []      # (slot, is_list, count)
-    flat = []
-    for slot, v in ins.items():
+def _flatten_ins(ins):
+    """(slots, flat, arg_spec): slot layout + flat input list + replay spec."""
+    arg_spec, slots, flat = [], [], []
+    for slot, v in (ins or {}).items():
         if isinstance(v, (list, tuple)):
             slots.append((slot, True, len(v)))
-            for item in v:
-                flat.append(item)
+            flat.extend(v)
         else:
             slots.append((slot, False, 1))
             flat.append(v)
@@ -74,30 +72,84 @@ def run_op_eager(op_type, ins, attrs, out_slot="Out", rng=None, is_test=False):
             arg_spec.append(("v", item))
         else:
             arg_spec.append(("c", jnp.asarray(item)))
+    return slots, flat, arg_spec
 
+
+def _rebuild_ins(slots, arrays):
+    d, i = {}, 0
+    for slot, is_list, cnt in slots:
+        if is_list:
+            d[slot] = list(arrays[i:i + cnt])
+            i += cnt
+        else:
+            d[slot] = arrays[i]
+            i += 1
+    return d
+
+
+def _input_values(flat):
+    return [v.value if isinstance(v, EagerVariable) else jnp.asarray(v)
+            for v in flat]
+
+
+def run_op_eager(op_type, ins, attrs, out_slot="Out", rng=None, is_test=False):
+    """Execute a registry kernel eagerly on EagerVariables; record on tape."""
+    slots, flat, arg_spec = _flatten_ins(ins)
     impl = ops_registry.get(op_type)
 
     def fn(*arrays):
-        d = {}
-        i = 0
-        for slot, is_list, cnt in slots:
-            if is_list:
-                d[slot] = list(arrays[i:i + cnt])
-                i += cnt
-            else:
-                d[slot] = arrays[i]
-                i += 1
-        outs = impl(MiniCtx(d, attrs, rng=rng, is_test=is_test))
+        outs = impl(MiniCtx(_rebuild_ins(slots, arrays), attrs, rng=rng,
+                            is_test=is_test))
         v = outs[out_slot]
         return v[0] if isinstance(v, list) else v
 
-    values = [v.value if isinstance(v, EagerVariable) else jnp.asarray(v)
-              for v in flat]
-    out_val = fn(*values)
+    out_val = fn(*_input_values(flat))
     out = EagerVariable(out_val)
     if _grad_enabled():
         current_tape().record(fn, arg_spec, {}, out)
     return out
+
+
+def run_op_into(op_type, ins, attrs, outputs, rng=None, is_test=False):
+    """Eager execution path for static-style layer functions under
+    dygraph.guard: run the registry kernel now and fill the pre-created
+    output shells (see LayerHelper.append_op's dygraph branch).
+
+    `outputs`: {slot: shell-or-[shells]} of empty EagerVariables. All filled
+    shells are recorded as ONE tape entry (the closure returns a tuple), so
+    backward replays a multi-output op once, not once per output."""
+    slots, flat, arg_spec = _flatten_ins(ins)
+    impl = ops_registry.get(op_type)
+
+    result = impl(MiniCtx(_rebuild_ins(slots, _input_values(flat)), attrs,
+                          rng=rng, is_test=is_test))
+
+    filled, keys = [], []
+    for slot, shells in (outputs or {}).items():
+        if slot not in result:
+            continue
+        shell_list = shells if isinstance(shells, (list, tuple)) else [shells]
+        vals = result[slot]
+        val_list = vals if isinstance(vals, (list, tuple)) else [vals]
+        for idx, (shell, val) in enumerate(zip(shell_list, val_list)):
+            if not isinstance(shell, EagerVariable):
+                continue
+            shell.value = jnp.asarray(val)
+            filled.append(shell)
+            keys.append((slot, idx))
+
+    if filled and _grad_enabled():
+        def fn(*arrays):
+            outs = impl(MiniCtx(_rebuild_ins(slots, arrays), attrs, rng=rng,
+                                is_test=is_test))
+            picked = []
+            for slot, idx in keys:
+                v = outs[slot]
+                picked.append(v[idx] if isinstance(v, (list, tuple)) else v)
+            return tuple(picked)
+
+        current_tape().record(fn, arg_spec, {}, tuple(filled))
+    return filled
 
 
 def run_op_eager_multi(op_type, ins, attrs, out_slots, rng=None, is_test=False):

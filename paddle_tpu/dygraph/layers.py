@@ -164,6 +164,8 @@ def _materialize_init(init, shape, dtype):
         return np.random.normal(0, np.sqrt(2.0 / fi), shape).astype(dtype)
     if isinstance(init, init_mod.NumpyArrayInitializer):
         return np.asarray(init.value, dtype=dtype).reshape(shape)
+    if isinstance(init, init_mod.BilinearInitializer):
+        return init_mod._bilinear_kernel(shape).astype(dtype)
     raise NotImplementedError(f"initializer {type(init).__name__} in dygraph")
 
 

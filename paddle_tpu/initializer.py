@@ -146,18 +146,51 @@ class BilinearInitializer(Initializer):
     """Bilinear upsample kernel init for conv_transpose (ref: initializer.py)."""
 
     def __call__(self, var, block=None):
-        shape = var.shape
-        if len(shape) != 4:
-            raise ValueError("BilinearInitializer expects 4-D weight")
-        c_out, c_in, h, w = shape
-        f = np.ceil(w / 2.0)
-        c = (2 * f - 1 - f % 2) / (2.0 * f)
-        weight = np.zeros(shape, dtype=np.float32)
-        for i in range(h):
-            for j in range(w):
-                v = (1 - abs(i / f - c)) * (1 - abs(j / f - c))
-                weight[:, :, i, j] = v
-        return NumpyArrayInitializer(weight)(var, block)
+        shape = (getattr(var, "_shell_shape", None)
+                 if var.__class__.__name__ == "EagerVariable" else var.shape)
+        return NumpyArrayInitializer(_bilinear_kernel(shape))(var, block)
+
+
+def _bilinear_kernel(shape):
+    if shape is None or len(shape) != 4:
+        raise ValueError("BilinearInitializer expects 4-D weight")
+    c_out, c_in, h, w = shape
+    f = np.ceil(w / 2.0)
+    c = (2 * f - 1 - f % 2) / (2.0 * f)
+    weight = np.zeros(shape, dtype=np.float32)
+    for i in range(h):
+        for j in range(w):
+            v = (1 - abs(i / f - c)) * (1 - abs(j / f - c))
+            weight[:, :, i, j] = v
+    return weight
+
+
+def _eagerize(cls):
+    """Under dygraph.guard, initializers applied to EagerVariables set the
+    value immediately instead of appending a startup op (parity: the
+    imperative tracer initializes on creation)."""
+    orig = cls.__call__
+
+    def call(self, var, block=None):
+        if var.__class__.__name__ == "EagerVariable":
+            if var.value is not None:
+                return var  # already materialized (e.g. BN stats mid-training)
+            import jax.numpy as jnp
+            from .dygraph.layers import _materialize_init
+            shape = getattr(var, "_shell_shape", None) or ()
+            dtype = getattr(var, "_shell_dtype", None) or "float32"
+            var.value = jnp.asarray(_materialize_init(self, shape, dtype))
+            return var
+        return orig(self, var, block)
+
+    cls.__call__ = call
+    return cls
+
+
+for _cls in (ConstantInitializer, UniformInitializer, NormalInitializer,
+             TruncatedNormalInitializer, XavierInitializer, MSRAInitializer,
+             NumpyArrayInitializer, BilinearInitializer):
+    _eagerize(_cls)
 
 
 # fluid aliases

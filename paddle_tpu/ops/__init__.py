@@ -116,3 +116,4 @@ from . import detection_ops   # noqa: E402,F401
 from . import rnn_ops         # noqa: E402,F401
 from . import attention_ops   # noqa: E402,F401
 from . import beam_search_ops  # noqa: E402,F401
+from . import quant_ops       # noqa: E402,F401

@@ -1,0 +1,100 @@
+"""Fake-quantization ops (QAT/PTQ).
+
+Parity: paddle/fluid/operators/fake_quantize_op.* —
+fake_quantize_abs_max, fake_channel_wise_quantize_abs_max,
+fake_quantize_moving_average_abs_max, fake_quantize_dequantize variants.
+
+TPU-native: quantize-dequantize is a pure function with a straight-through
+estimator (custom_vjp identity) so jax.grad flows through the rounding; the
+moving-average scale is ordinary state threaded through the env like
+batch-norm stats (no mutable buffers inside jit).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import register
+
+
+@jax.custom_vjp
+def _ste_round(x):
+    return jnp.round(x)
+
+
+def _ste_round_fwd(x):
+    return jnp.round(x), None
+
+
+def _ste_round_bwd(_, g):
+    return (g,)
+
+
+_ste_round.defvjp(_ste_round_fwd, _ste_round_bwd)
+
+
+def quant_dequant(x, scale, bits=8):
+    """Symmetric fake quant: round(clip(x)/scale * qmax) * scale / qmax,
+    gradient = identity inside the clip range (STE)."""
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = jnp.maximum(scale, 1e-8)
+    xc = jnp.clip(x, -scale, scale)
+    return _ste_round(xc / scale * qmax) * scale / qmax
+
+
+def abs_max(x):
+    return jnp.max(jnp.abs(x))
+
+
+def channel_abs_max(x, channel_axis=0):
+    axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+    return jnp.max(jnp.abs(x), axis=axes)
+
+
+@register("fake_quantize_dequantize_abs_max", "fake_quantize_abs_max")
+def fake_quantize_abs_max(ctx):
+    x = ctx.in_("X")
+    bits = ctx.attr("bit_length", 8)
+    scale = abs_max(x)
+    out = quant_dequant(x, scale, bits)
+    return {"Out": out, "OutScale": scale}
+
+
+@register("fake_channel_wise_quantize_dequantize_abs_max",
+          "fake_channel_wise_quantize_abs_max")
+def fake_channel_wise_quantize_abs_max(ctx):
+    x = ctx.in_("X")
+    bits = ctx.attr("bit_length", 8)
+    axis = ctx.attr("quant_axis", 0)
+    scale = channel_abs_max(x, axis)
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    out = quant_dequant(x, scale.reshape(shape), bits)
+    return {"Out": out, "OutScale": scale}
+
+
+@register("quantize_dequantize_static_scale")
+def quantize_dequantize_static_scale(ctx):
+    """PTQ path: scale calibrated offline, carried as an attr."""
+    x = ctx.in_("X")
+    bits = ctx.attr("bit_length", 8)
+    scale = ctx.attr("scale", 1.0)
+    return {"Out": quant_dequant(x, jnp.float32(scale), bits)}
+
+
+@register("fake_quantize_dequantize_moving_average_abs_max",
+          "fake_quantize_moving_average_abs_max")
+def fake_quantize_moving_average_abs_max(ctx):
+    """Activation fake-quant: scale is an EMA of batch abs-max. State vars
+    (InScale/OutScale) thread through the env; in test mode the stored
+    scale is used without updating."""
+    x = ctx.in_("X")
+    bits = ctx.attr("bit_length", 8)
+    rate = ctx.attr("moving_rate", 0.9)
+    in_scale = ctx.in_("InScale")
+    if ctx.is_test:
+        scale = in_scale
+    else:
+        cur = abs_max(x)
+        scale = rate * in_scale + (1.0 - rate) * cur
+    out = quant_dequant(x, jnp.reshape(scale, ()), bits)
+    return {"Out": out, "OutScale": scale}

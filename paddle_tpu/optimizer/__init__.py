@@ -10,6 +10,7 @@ from .optimizers import (Optimizer, SGDOptimizer, MomentumOptimizer,
                          FtrlOptimizer, LambOptimizer,
                          SGD, Momentum, Adagrad, Adam, Adamax, RMSProp,
                          Ftrl, Lamb)
+from .dgc import DGCMomentumOptimizer
 from .wrappers import (ExponentialMovingAverage, ModelAverage,
                        LookaheadOptimizer)
 from .regularizer import (L1Decay, L2Decay, L1DecayRegularizer,

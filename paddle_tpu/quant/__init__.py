@@ -1,0 +1,9 @@
+"""Quantization (QAT + PTQ).
+
+Parity: the reference's contrib/slim quantization passes
+(QuantizationTransformPass / QuantizationFreezePass / post-training
+calibration). See qat.py and ptq.py.
+"""
+
+from .qat import quantize_program, QuantizationTransform  # noqa: F401
+from .ptq import calibrate_program, apply_ptq  # noqa: F401

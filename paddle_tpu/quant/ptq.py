@@ -1,0 +1,102 @@
+"""Post-training quantization: calibration + static-scale rewrite.
+
+Parity: the reference's post-training calibration flow (contrib calibration
+/ slim PTQ): run the FP model over a calibration set, record per-tensor
+abs-max ranges for the inputs of quantizable ops, then rewrite the
+inference program with fixed-scale quant-dequant ops.
+
+TPU-native: calibration fetches activation tensors straight from the traced
+program (no instrumentation pass needed — fetch_list can name any var), and
+the rewrite reuses the QAT insertion machinery with static scales.
+"""
+
+import numpy as np
+
+from ..core.framework import Operator, Parameter
+from .qat import QUANTIZABLE_OP_TYPES, _ACT_SLOTS, _WEIGHT_SLOTS
+
+
+def collect_activation_names(program,
+                             quantizable_op_types=QUANTIZABLE_OP_TYPES):
+    names = []
+    for op in program.global_block().ops:
+        if op.type in quantizable_op_types:
+            for slot in _ACT_SLOTS.get(op.type, ()):
+                names.extend(op.input(slot))
+    # preserve order, drop dups and feeds that may repeat
+    seen, out = set(), []
+    for n in names:
+        if n not in seen:
+            seen.add(n)
+            out.append(n)
+    return out
+
+
+def calibrate_program(exe, program, feed_list,
+                      quantizable_op_types=QUANTIZABLE_OP_TYPES):
+    """Run calibration batches; returns {var_name: abs_max_scale}.
+
+    feed_list: iterable of feed dicts (a few hundred samples is plenty,
+    same guidance as the reference calibration tool).
+    """
+    act_names = collect_activation_names(program, quantizable_op_types)
+    scales = {n: 0.0 for n in act_names}
+    for feed in feed_list:
+        outs = exe.run(program, feed=feed, fetch_list=list(act_names))
+        for name, val in zip(act_names, outs):
+            scales[name] = max(scales[name], float(np.max(np.abs(val))))
+    return scales
+
+
+def apply_ptq(program, scales, weight_bits=8, activation_bits=8,
+              quantizable_op_types=QUANTIZABLE_OP_TYPES):
+    """Insert fixed-scale quant-dequant on calibrated activations and
+    abs-max quant on weights. Rewrites in place; returns program."""
+    block = program.global_block()
+    quantized = {}
+    new_ops = []
+    for op in list(block.ops):
+        if op.type in quantizable_op_types:
+            for slot in _WEIGHT_SLOTS.get(op.type, ()):
+                names = op.input(slot)
+                if not names:
+                    continue
+                name = names[0]
+                var = block._find_var_recursive(name)
+                if not isinstance(var, Parameter):
+                    continue
+                if name not in quantized:
+                    qname = f"{name}.quantized"
+                    block.create_var(name=qname, shape=var.shape,
+                                     dtype=var.dtype)
+                    sname = f"{name}.quant_scale"
+                    block.create_var(name=sname, shape=[var.shape[0]],
+                                     dtype="float32")
+                    new_ops.append(Operator(
+                        block, "fake_channel_wise_quantize_dequantize_abs_max",
+                        {"X": [name]}, {"Out": [qname], "OutScale": [sname]},
+                        {"bit_length": weight_bits, "quant_axis": 0}))
+                    quantized[name] = qname
+                op.inputs[slot] = [quantized[name]]
+            for slot in _ACT_SLOTS.get(op.type, ()):
+                names = op.input(slot)
+                if not names or names[0] not in scales:
+                    continue
+                name = names[0]
+                if name not in quantized:
+                    var = block._find_var_recursive(name)
+                    qname = f"{name}.quantized"
+                    block.create_var(name=qname,
+                                     shape=getattr(var, "shape", ()),
+                                     dtype=getattr(var, "dtype", "float32"))
+                    new_ops.append(Operator(
+                        block, "quantize_dequantize_static_scale",
+                        {"X": [name]}, {"Out": [qname]},
+                        {"bit_length": activation_bits,
+                         "scale": float(scales[name])}))
+                    quantized[name] = qname
+                op.inputs[slot] = [quantized[name]]
+        new_ops.append(op)
+    block.ops = new_ops
+    program._bump_version()
+    return program

@@ -1,0 +1,123 @@
+"""Quantization-aware training as a Program transform.
+
+Parity: fluid contrib QuantizationTransformPass (reference:
+python/paddle/fluid/contrib/slim/quantization/quantization_pass.py) —
+inserts fake quant-dequant on the weight and activation inputs of
+quantizable ops so training sees int8 rounding error while grads flow via
+the straight-through estimator (ops/quant_ops.py).
+
+TPU-native shape: the transform rewrites our Program IR (pure-Python op
+list) instead of a C++ IrGraph; the quantized program still traces to ONE
+XLA executable — fake-quant is just extra fused elementwise work on the
+same graph, so QAT costs almost nothing on the MXU path.
+"""
+
+from ..core.framework import Parameter
+
+QUANTIZABLE_OP_TYPES = ("conv2d", "depthwise_conv2d", "mul", "matmul")
+
+# which input slots carry weights vs activations per op type
+_WEIGHT_SLOTS = {"conv2d": ("Filter",), "depthwise_conv2d": ("Filter",),
+                 "mul": ("Y",), "matmul": ("Y",)}
+_ACT_SLOTS = {"conv2d": ("Input",), "depthwise_conv2d": ("Input",),
+              "mul": ("X",), "matmul": ("X",)}
+
+
+class QuantizationTransform:
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_quantize_type="channel_wise_abs_max",
+                 moving_rate=0.9,
+                 quantizable_op_types=QUANTIZABLE_OP_TYPES,
+                 skip_pattern=("skip_quant",)):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.activation_quantize_type = activation_quantize_type
+        self.weight_quantize_type = weight_quantize_type
+        self.moving_rate = moving_rate
+        self.quantizable_op_types = tuple(quantizable_op_types)
+        self.skip_pattern = tuple(skip_pattern)
+
+    # ------------------------------------------------------------------
+    def apply(self, program, startup_program=None):
+        """Rewrite `program` in place; returns it. Call AFTER building the
+        forward and BEFORE optimizer.minimize / append_backward."""
+        self._startup_block = (startup_program.global_block()
+                               if startup_program is not None else None)
+        block = program.global_block()
+        quantized = {}   # original var name -> quantized var name
+        new_ops = []
+        for op in list(block.ops):
+            if op.type in self.quantizable_op_types and \
+                    not self._skipped(op):
+                for slot in _WEIGHT_SLOTS.get(op.type, ()):
+                    self._quant_input(block, op, slot, new_ops, quantized,
+                                      is_weight=True)
+                for slot in _ACT_SLOTS.get(op.type, ()):
+                    self._quant_input(block, op, slot, new_ops, quantized,
+                                      is_weight=False)
+            new_ops.append(op)
+        block.ops = new_ops
+        program._bump_version()
+        return program
+
+    __call__ = apply
+
+    # ------------------------------------------------------------------
+    def _skipped(self, op):
+        return any(op.attrs.get(p) for p in self.skip_pattern)
+
+    def _quant_input(self, block, op, slot, new_ops, quantized, is_weight):
+        names = op.input(slot)
+        if not names:
+            return
+        name = names[0]
+        var = block._find_var_recursive(name)
+        if var is None:
+            return
+        if is_weight and not isinstance(var, Parameter):
+            return
+        if name in quantized:
+            op.inputs[slot] = [quantized[name]]
+            return
+        qname = f"{name}.quantized"
+        block.create_var(name=qname, shape=var.shape, dtype=var.dtype)
+        if is_weight:
+            scale_name = f"{name}.quant_scale"
+            out_c = var.shape[0] if len(var.shape) else 1
+            block.create_var(name=scale_name, shape=[out_c],
+                             dtype="float32")
+            if self.weight_quantize_type == "channel_wise_abs_max":
+                op_type = "fake_channel_wise_quantize_dequantize_abs_max"
+            else:
+                op_type = "fake_quantize_dequantize_abs_max"
+            qop = _make_op(block, op_type, {"X": [name]},
+                           {"Out": [qname], "OutScale": [scale_name]},
+                           {"bit_length": self.weight_bits, "quant_axis": 0})
+        else:
+            from .. import initializer as init_mod
+            scale_name = f"{name}.quant_scale"
+            scale = block.create_parameter(
+                name=scale_name, shape=[1], dtype="float32", trainable=False)
+            # EMA scale starts at 1.0; startup materializes it like any param
+            init_mod.ConstantInitializer(1.0)(scale, self._startup_block)
+            qop = _make_op(
+                block, "fake_quantize_dequantize_moving_average_abs_max",
+                {"X": [name], "InScale": [scale_name]},
+                {"Out": [qname], "OutScale": [scale_name]},
+                {"bit_length": self.activation_bits,
+                 "moving_rate": self.moving_rate})
+        new_ops.append(qop)
+        quantized[name] = qname
+        op.inputs[slot] = [qname]
+
+
+def _make_op(block, type, inputs, outputs, attrs):
+    """Build an Operator WITHOUT appending (caller controls placement)."""
+    from ..core.framework import Operator
+    return Operator(block, type, inputs, outputs, attrs)
+
+
+def quantize_program(program, startup_program=None, **kwargs):
+    """One-shot helper: quantize_program(main) before minimize()."""
+    return QuantizationTransform(**kwargs).apply(program, startup_program)

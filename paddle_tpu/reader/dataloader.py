@@ -14,12 +14,14 @@ import numpy as np
 
 class DataLoader:
     def __init__(self, feed_list=None, capacity=4, use_double_buffer=True,
-                 iterable=True, return_list=False):
+                 iterable=True, return_list=False, use_native=True):
         self.feed_list = feed_list or []
         self.capacity = capacity
         self._generator = None
         self._places = None
         self._batch_reader = None
+        # native C++ ring (csrc/prefetch.cc) when buildable; else thread+queue
+        self._use_native = use_native and use_double_buffer
 
     @staticmethod
     def from_generator(feed_list=None, capacity=4, use_double_buffer=True,
@@ -46,6 +48,10 @@ class DataLoader:
     def __iter__(self):
         if self._batch_reader is None:
             raise RuntimeError("no generator set on DataLoader")
+        from . import native
+        if self._use_native and native.available():
+            return iter(native.native_buffered(self._batch_reader,
+                                               self.capacity)())
         return iter(_Prefetcher(self._batch_reader, self.capacity))
 
 

@@ -1,0 +1,198 @@
+"""ctypes binding for the C++ prefetch ring (csrc/prefetch.cc).
+
+Parity: the reference's C++ reader stack (buffered_reader.cc /
+blocking_queue.h behind py_reader): batches cross the Python/producer ->
+consumer boundary through a native fixed-slot ring with real backpressure,
+instead of a GIL-bound queue.Queue. Arrays are framed in a flat binary
+format (no pickle) so a batch is one memcpy in and one memcpy out of the
+ring.
+
+Build: compiled on first use with g++ (csrc/Makefile has the same line);
+falls back to ImportError for callers that want to gate on availability.
+"""
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+
+import numpy as np
+
+_CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
+_SO = os.path.join(_CSRC, "build", "libprefetch.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build_so():
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    src = os.path.join(_CSRC, "prefetch.cc")
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-pthread", "-std=c++17",
+           src, "-o", _SO]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def load_library():
+    """Load (building if needed) the native ring library."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO):
+            _build_so()
+        lib = ctypes.CDLL(_SO)
+        lib.pt_ring_create.restype = ctypes.c_void_p
+        lib.pt_ring_create.argtypes = [ctypes.c_size_t, ctypes.c_size_t]
+        lib.pt_ring_destroy.argtypes = [ctypes.c_void_p]
+        lib.pt_ring_push.restype = ctypes.c_int
+        lib.pt_ring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_size_t]
+        lib.pt_ring_peek_len.restype = ctypes.c_int64
+        lib.pt_ring_peek_len.argtypes = [ctypes.c_void_p]
+        lib.pt_ring_pop.restype = ctypes.c_int64
+        lib.pt_ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                    ctypes.c_size_t]
+        lib.pt_ring_close.argtypes = [ctypes.c_void_p]
+        lib.pt_ring_count.restype = ctypes.c_size_t
+        lib.pt_ring_count.argtypes = [ctypes.c_void_p]
+        lib.pt_ring_closed.restype = ctypes.c_int
+        lib.pt_ring_closed.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available():
+    try:
+        load_library()
+        return True
+    except Exception:
+        return False
+
+
+# --------------------------------------------------------------------------
+# batch (de)serialization: [n:u32] then per entry
+#   [klen:u16][key][dtype_len:u8][dtype][ndim:u8][dims:i64*ndim][raw bytes]
+# Keys are empty for positional (list/tuple) batches.
+# --------------------------------------------------------------------------
+
+def serialize_batch(batch):
+    if isinstance(batch, dict):
+        items = list(batch.items())
+    else:
+        items = [("", a) for a in batch]
+    parts = [struct.pack("<I", len(items))]
+    for key, arr in items:
+        a = np.ascontiguousarray(arr)
+        kb = key.encode()
+        db = str(a.dtype).encode()
+        parts.append(struct.pack("<H", len(kb)))
+        parts.append(kb)
+        parts.append(struct.pack("<B", len(db)))
+        parts.append(db)
+        parts.append(struct.pack("<B", a.ndim))
+        parts.append(struct.pack(f"<{a.ndim}q", *a.shape) if a.ndim else b"")
+        parts.append(a.tobytes())
+    return b"".join(parts)
+
+
+def deserialize_batch(buf):
+    off = 0
+    (n,) = struct.unpack_from("<I", buf, off); off += 4
+    keys, arrs = [], []
+    for _ in range(n):
+        (klen,) = struct.unpack_from("<H", buf, off); off += 2
+        key = bytes(buf[off:off + klen]).decode(); off += klen
+        (dlen,) = struct.unpack_from("<B", buf, off); off += 1
+        dtype = np.dtype(bytes(buf[off:off + dlen]).decode()); off += dlen
+        (ndim,) = struct.unpack_from("<B", buf, off); off += 1
+        shape = struct.unpack_from(f"<{ndim}q", buf, off) if ndim else ()
+        off += 8 * ndim
+        nbytes = dtype.itemsize * int(np.prod(shape, dtype=np.int64)) \
+            if ndim else dtype.itemsize
+        arr = np.frombuffer(buf, dtype=dtype, count=nbytes // dtype.itemsize,
+                            offset=off).reshape(shape)
+        off += nbytes
+        keys.append(key)
+        arrs.append(arr)
+    if any(keys):
+        return dict(zip(keys, arrs))
+    return arrs
+
+
+class NativeRing:
+    """Thin OO wrapper over the C ring."""
+
+    def __init__(self, slots=8, slot_bytes=1 << 20):
+        self._lib = load_library()
+        self._ptr = self._lib.pt_ring_create(slots, slot_bytes)
+
+    def push(self, data: bytes):
+        return self._lib.pt_ring_push(self._ptr, data, len(data)) == 0
+
+    def pop(self):
+        """Returns a writable buffer (memoryview over a fresh ctypes
+        allocation — deserialized arrays stay mutable, matching the python
+        queue path), or None on EOF (closed + drained)."""
+        ln = self._lib.pt_ring_peek_len(self._ptr)
+        if ln < 0:
+            return None
+        buf = ctypes.create_string_buffer(ln)
+        got = self._lib.pt_ring_pop(self._ptr, buf, ln)
+        if got < 0:
+            return None
+        return memoryview(buf).cast("B")[:got]
+
+    def close(self):
+        self._lib.pt_ring_close(self._ptr)
+
+    def __len__(self):
+        return self._lib.pt_ring_count(self._ptr)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_ptr", None):
+                self._lib.pt_ring_close(self._ptr)
+                self._lib.pt_ring_destroy(self._ptr)
+                self._ptr = None
+        except Exception:
+            pass
+
+
+def native_buffered(reader, size=8):
+    """Decorator parity with reader.buffered(), but the buffer is the C++
+    ring: the producer thread serializes+pushes while the consumer pops.
+    Use for numpy-array batches (samples pass through serialize_batch)."""
+
+    def reader_fn():
+        ring = NativeRing(slots=size)
+        exc = []
+
+        def produce():
+            try:
+                for item in reader():
+                    if not ring.push(serialize_batch(item)):
+                        break
+            except Exception as e:  # surfaced on the consumer side
+                exc.append(e)
+            finally:
+                ring.close()
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            while True:
+                raw = ring.pop()
+                if raw is None:
+                    break
+                yield deserialize_batch(raw)
+        finally:
+            # abandoning the iterator (break / GeneratorExit) must unblock
+            # the producer's pt_ring_push wait, or the thread leaks
+            ring.close()
+            t.join()
+        if exc:
+            raise exc[0]
+
+    return reader_fn

@@ -1,0 +1,8 @@
+"""Slim-lite: pruning masks + distillation losses.
+
+Parity: the reference's contrib/slim (PruneStrategy / distillation
+distill losses). See prune.py and distill.py.
+"""
+
+from .prune import Pruner, sensitivity_prune_ratios  # noqa: F401
+from .distill import (soft_label_loss, l2_hint_loss, fsp_loss)  # noqa: F401

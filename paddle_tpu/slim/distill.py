@@ -1,0 +1,45 @@
+"""Distillation loss helpers (static-graph layers).
+
+Parity: the reference's slim distillation strategies — soft-label
+(Hinton KD), FSP matrix, and L2 hint losses combined with the student's
+task loss.
+"""
+
+from .. import layers
+
+
+def soft_label_loss(student_logits, teacher_logits, temperature=4.0):
+    """KL(teacher_T || student_T) * T^2 (Hinton distillation)."""
+    t = float(temperature)
+    s = layers.softmax(layers.scale(student_logits, scale=1.0 / t))
+    p = layers.softmax(layers.scale(teacher_logits, scale=1.0 / t))
+    # KL(p||s) = sum p * (log p - log s)
+    log_s = layers.log(layers.clip(s, min=1e-8, max=1.0))
+    log_p = layers.log(layers.clip(p, min=1e-8, max=1.0))
+    kl = layers.reduce_sum(
+        layers.elementwise_mul(p, layers.elementwise_sub(log_p, log_s)),
+        dim=-1)
+    return layers.scale(layers.mean(kl), scale=t * t)
+
+
+def l2_hint_loss(student_feat, teacher_feat):
+    """FitNets hint: L2 between intermediate feature maps."""
+    return layers.mean(layers.square_error_cost(student_feat, teacher_feat))
+
+
+def fsp_loss(student_a, student_b, teacher_a, teacher_b):
+    """FSP (flow of solution procedure) matrix distance between two layer
+    pairs. Inputs are (N, C, H, W) feature maps; the FSP matrix is the
+    HW-averaged Gram matrix between the pair's channels."""
+    def fsp_matrix(a, b):
+        n = a.shape[0] if a.shape[0] and a.shape[0] > 0 else -1
+        ca, cb = a.shape[1], b.shape[1]
+        hw = a.shape[2] * a.shape[3]
+        af = layers.reshape(a, shape=[n, ca, hw])
+        bf = layers.reshape(b, shape=[n, cb, hw])
+        g = layers.matmul(af, layers.transpose(bf, perm=[0, 2, 1]))
+        return layers.scale(g, scale=1.0 / float(hw))
+
+    gs = fsp_matrix(student_a, student_b)
+    gt = fsp_matrix(teacher_a, teacher_b)
+    return layers.mean(layers.square_error_cost(gs, gt))

@@ -1,0 +1,99 @@
+"""DGC momentum tests (SURVEY.md §2.3/§2.6 gradient compression).
+
+Parity model: the reference's test_dgc_optimizer/test_dgc_op — sparsified
+updates still converge, residual accumulation preserves dropped gradient
+mass, dense phase before rampup matches plain momentum.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _net():
+    x = layers.data("x", shape=[8], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1, param_attr=fluid.ParamAttr(name="w"),
+                     bias_attr=fluid.ParamAttr(name="b"))
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    return loss
+
+
+def _data(seed=0, n=32):
+    rs = np.random.RandomState(seed)
+    xs = rs.rand(n, 8).astype(np.float32)
+    return xs, xs.sum(1, keepdims=True).astype(np.float32)
+
+
+def test_dgc_dense_phase_matches_momentum():
+    """Before rampup_begin_step DGC must be plain momentum."""
+    xs, ys = _data()
+
+    def run(opt):
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            loss = _net()
+            opt.minimize(loss)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            import jax.numpy as jnp
+            scope.set("w", jnp.zeros((8, 1), jnp.float32))
+            scope.set("b", jnp.zeros((1,), jnp.float32))
+            losses = [float(exe.run(prog, feed={"x": xs, "y": ys},
+                                    fetch_list=[loss])[0])
+                      for _ in range(4)]
+            w = np.asarray(scope.get("w"))
+        return losses, w
+
+    l_dgc, w_dgc = run(fluid.optimizer.DGCMomentumOptimizer(
+        learning_rate=0.05, momentum=0.9, rampup_begin_step=100))
+    l_mom, w_mom = run(fluid.optimizer.MomentumOptimizer(
+        learning_rate=0.05, momentum=0.9))
+    np.testing.assert_allclose(l_dgc, l_mom, rtol=1e-5)
+    np.testing.assert_allclose(w_dgc, w_mom, rtol=1e-5, atol=1e-7)
+
+
+def test_dgc_sparse_phase_converges():
+    """With 75% of updates dropped per step, training still converges
+    (residual accumulation keeps dropped mass)."""
+    xs, ys = _data(1)
+    loss = _net()
+    opt = fluid.optimizer.DGCMomentumOptimizer(
+        learning_rate=0.05, momentum=0.9, rampup_begin_step=0,
+        sparsity=(0.75,))
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    losses = [float(exe.run(feed={"x": xs, "y": ys},
+                            fetch_list=[loss])[0]) for _ in range(60)]
+    assert losses[-1] < losses[0] * 0.05, losses[::15]
+
+
+def test_dgc_residual_carries_dropped_mass():
+    """One step at extreme sparsity: most params don't move, residual holds
+    their would-be update."""
+    xs, ys = _data(2)
+    loss = _net()
+    opt = fluid.optimizer.DGCMomentumOptimizer(
+        learning_rate=0.1, momentum=0.0, rampup_begin_step=0,
+        sparsity=(0.93,))
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    import jax.numpy as jnp
+    fluid.global_scope().set("w", jnp.zeros((8, 1), jnp.float32))
+    w0 = np.zeros((8, 1), np.float32)
+    exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+    w1 = np.asarray(fluid.global_scope().get("w"))
+    moved = (np.abs(w1 - w0) > 1e-12).sum()
+    # 8 weight entries, ~93% dropped -> at most ~2 move
+    assert moved <= 2, f"{moved} entries moved under 0.93 sparsity"
+    # residual var holds mass for unmoved entries
+    resid_names = [p for p in fluid.global_scope().names()
+                   if "dgc_v" in p and p.startswith("w")]
+    assert resid_names
+    resid = np.asarray(fluid.global_scope().get(resid_names[0]))
+    assert np.abs(resid).sum() > 0

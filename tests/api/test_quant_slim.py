@@ -1,0 +1,188 @@
+"""QAT / PTQ / pruning / distillation tests (SURVEY.md §2.9).
+
+Parity model: the reference's test_quantization_pass / slim strategy tests:
+the quantized program still trains, rounding error is bounded by the bit
+width, calibration scales cover the observed ranges, pruned weights stay
+zero through optimizer steps.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, quant, slim
+from paddle_tpu.ops import quant_ops
+
+
+# ---------------------------------------------------------------- op level
+def test_quant_dequant_error_bound():
+    x = np.linspace(-2, 2, 101).astype(np.float32)
+    got = np.asarray(quant_ops.quant_dequant(jnp.asarray(x),
+                                             jnp.float32(2.0), bits=8))
+    assert np.abs(got - x).max() <= 2.0 / 127 + 1e-6
+    # 4-bit is much coarser
+    got4 = np.asarray(quant_ops.quant_dequant(jnp.asarray(x),
+                                              jnp.float32(2.0), bits=4))
+    assert np.abs(got4 - x).max() <= 2.0 / 7 + 1e-6
+
+
+def test_ste_gradient_is_identity_inside_range():
+    f = lambda v: jnp.sum(quant_ops.quant_dequant(v, jnp.float32(1.0)))
+    g = jax.grad(f)(jnp.asarray([0.3, -0.9, 0.5]))
+    np.testing.assert_allclose(np.asarray(g), np.ones(3), rtol=1e-6)
+    # outside the clip range the grad is zero
+    g2 = jax.grad(f)(jnp.asarray([1.7, -3.0]))
+    np.testing.assert_allclose(np.asarray(g2), np.zeros(2), atol=1e-6)
+
+
+def test_channel_wise_scales():
+    w = np.stack([np.full((3, 3), 0.1, np.float32),
+                  np.full((3, 3), 5.0, np.float32)])
+    s = np.asarray(quant_ops.channel_abs_max(jnp.asarray(w), 0))
+    np.testing.assert_allclose(s, [0.1, 5.0])
+
+
+# ---------------------------------------------------------------- QAT
+def _build_mlp():
+    x = layers.data("x", shape=[8], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    h = layers.fc(x, size=16, act="relu")
+    pred = layers.fc(h, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    return pred, loss
+
+
+def test_qat_program_inserts_fake_quant_and_trains():
+    pred, loss = _build_mlp()
+    main = fluid.default_main_program()
+    startup = fluid.default_startup_program()
+    n_ops_before = len(main.global_block().ops)
+    quant.quantize_program(main, startup)
+    types = [op.type for op in main.global_block().ops]
+    assert "fake_channel_wise_quantize_dequantize_abs_max" in types
+    assert "fake_quantize_dequantize_moving_average_abs_max" in types
+    assert len(types) > n_ops_before
+
+    fluid.optimizer.AdamOptimizer(1e-2).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rs = np.random.RandomState(0)
+    xs = rs.rand(32, 8).astype(np.float32)
+    ys = xs.sum(1, keepdims=True).astype(np.float32)
+    losses = [float(exe.run(feed={"x": xs, "y": ys},
+                            fetch_list=[loss])[0]) for _ in range(25)]
+    assert losses[-1] < losses[0] * 0.3, losses[::6]
+    # EMA scale moved off its init value
+    scale = float(np.asarray(
+        fluid.global_scope().get("x.quant_scale")).ravel()[0])
+    assert scale != pytest.approx(1.0)
+
+
+def test_qat_output_close_to_fp32():
+    pred, loss = _build_mlp()
+    main = fluid.default_main_program()
+    startup = fluid.default_startup_program()
+    exe = fluid.Executor()
+    exe.run(startup)
+    rs = np.random.RandomState(1)
+    feed = {"x": rs.rand(4, 8).astype(np.float32),
+            "y": np.zeros((4, 1), np.float32)}
+    fp32, = exe.run(main, feed=feed, fetch_list=[pred])
+
+    quant.quantize_program(main, startup)
+    # materialize the new EMA scale vars WITHOUT re-running startup (that
+    # would re-randomize the weights and break the fp32 comparison)
+    for p in main.all_parameters():
+        if p.name.endswith(".quant_scale"):
+            fluid.global_scope().set(p.name, jnp.ones(p.shape, jnp.float32))
+    # let the EMA activation scales converge to the observed ranges first
+    for _ in range(40):
+        exe.run(main, feed=feed, fetch_list=[pred])
+    q, = exe.run(main, feed=feed, fetch_list=[pred])
+    # int8 rounding error stays small relative to activation scale ~1
+    assert np.abs(np.asarray(q) - np.asarray(fp32)).max() < 0.1
+
+
+# ---------------------------------------------------------------- PTQ
+def test_ptq_calibrate_and_apply():
+    pred, loss = _build_mlp()
+    main = fluid.default_main_program()
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rs = np.random.RandomState(2)
+    feeds = [{"x": rs.rand(8, 8).astype(np.float32),
+              "y": np.zeros((8, 1), np.float32)} for _ in range(4)]
+
+    infer = main.clone(for_test=True)
+    scales = quant.calibrate_program(exe, infer, feeds)
+    assert scales and all(v > 0 for v in scales.values())
+
+    ref, = exe.run(infer, feed=feeds[0], fetch_list=[pred])
+    quant.apply_ptq(infer, scales)
+    types = [op.type for op in infer.global_block().ops]
+    assert "quantize_dequantize_static_scale" in types
+    got, = exe.run(infer, feed=feeds[0], fetch_list=[pred])
+    assert np.abs(np.asarray(got) - np.asarray(ref)).max() < 0.1
+
+
+# ---------------------------------------------------------------- pruning
+def test_pruner_masks_stick_through_training():
+    pred, loss = _build_mlp()
+    main = fluid.default_main_program()
+    startup = fluid.default_startup_program()
+    w_name = main.all_parameters()[0].name
+    fluid.optimizer.SGDOptimizer(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+
+    pruner = slim.Pruner()
+    pruner.prune(main, fluid.global_scope(), {w_name: 0.5},
+                 startup_program=startup)
+    mask = pruner.masks[w_name]
+    assert 0.4 <= (mask == 0).mean() <= 0.6
+
+    rs = np.random.RandomState(3)
+    xs = rs.rand(16, 8).astype(np.float32)
+    ys = xs.sum(1, keepdims=True).astype(np.float32)
+    for _ in range(5):
+        exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+    w = np.asarray(fluid.global_scope().get(w_name))
+    assert np.all(w[mask == 0] == 0.0), "pruned weights drifted off zero"
+    # unpruned weights actually updated
+    assert np.abs(w[mask == 1]).sum() > 0
+
+
+# ---------------------------------------------------------------- distill
+def test_soft_label_loss_zero_when_equal():
+    s = layers.data("s", shape=[10], dtype="float32")
+    t = layers.data("t", shape=[10], dtype="float32")
+    kd = slim.soft_label_loss(s, t, temperature=2.0)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    logits = np.random.RandomState(0).randn(4, 10).astype(np.float32)
+    out, = exe.run(feed={"s": logits, "t": logits}, fetch_list=[kd])
+    np.testing.assert_allclose(float(out), 0.0, atol=1e-6)
+    out2, = exe.run(feed={"s": logits, "t": -logits}, fetch_list=[kd])
+    assert float(out2) > 0.1
+
+
+def test_fsp_and_hint_losses_build():
+    a = layers.data("a", shape=[4, 5, 5], dtype="float32")
+    b = layers.data("b", shape=[8, 5, 5], dtype="float32")
+    ta = layers.data("ta", shape=[4, 5, 5], dtype="float32")
+    tb = layers.data("tb", shape=[8, 5, 5], dtype="float32")
+    floss = slim.fsp_loss(a, b, ta, tb)
+    hloss = slim.l2_hint_loss(a, ta)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rs = np.random.RandomState(1)
+    feed = {"a": rs.rand(2, 4, 5, 5).astype(np.float32),
+            "b": rs.rand(2, 8, 5, 5).astype(np.float32)}
+    feed["ta"] = feed["a"]
+    feed["tb"] = feed["b"]
+    f, h = exe.run(feed=feed, fetch_list=[floss, hloss])
+    np.testing.assert_allclose(float(f), 0.0, atol=1e-6)
+    np.testing.assert_allclose(float(h), 0.0, atol=1e-6)

@@ -1,0 +1,115 @@
+"""fluid.layers.* under dygraph.guard (SURVEY.md §2.5 parity).
+
+In the reference, fluid.layers functions run eagerly inside
+dygraph.guard() via the imperative tracer. Here the LayerHelper dispatches
+to the ops registry eagerly and records on the tape, so the same layer code
+works in both modes.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, dygraph
+from paddle_tpu.dygraph.base import parameter_store
+
+
+def test_fc_chain_trains_eagerly():
+    rs = np.random.RandomState(0)
+    xs = rs.rand(16, 8).astype(np.float32)
+    ys = xs.sum(1, keepdims=True).astype(np.float32)
+    with dygraph.guard():
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=0.05)
+        losses = []
+        for _ in range(30):
+            x = dygraph.to_variable(xs)
+            h = layers.fc(x, size=16, act="relu",
+                          param_attr=fluid.ParamAttr(name="l1_w"),
+                          bias_attr=fluid.ParamAttr(name="l1_b"))
+            pred = layers.fc(h, size=1,
+                             param_attr=fluid.ParamAttr(name="l2_w"),
+                             bias_attr=fluid.ParamAttr(name="l2_b"))
+            loss = layers.mean(
+                layers.square_error_cost(pred, dygraph.to_variable(ys)))
+            loss.backward()
+            opt.minimize(loss)
+            for p in parameter_store().values():
+                p.clear_gradient()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.1, losses[::8]
+
+
+def test_named_params_shared_across_calls():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.ones((2, 4), np.float32))
+        a = layers.fc(x, size=3, param_attr=fluid.ParamAttr(name="shared_w"),
+                      bias_attr=False)
+        b = layers.fc(x, size=3, param_attr=fluid.ParamAttr(name="shared_w"),
+                      bias_attr=False)
+        np.testing.assert_array_equal(np.asarray(a.numpy()),
+                                      np.asarray(b.numpy()))
+        assert len([k for k in parameter_store() if k == "shared_w"]) == 1
+
+
+def test_conv_pool_norm_eager():
+    rs = np.random.RandomState(1)
+    with dygraph.guard():
+        img = dygraph.to_variable(rs.rand(2, 3, 8, 8).astype(np.float32))
+        c = layers.conv2d(img, num_filters=4, filter_size=3, padding=1)
+        assert c.shape == (2, 4, 8, 8)
+        p = layers.pool2d(c, pool_size=2, pool_stride=2, pool_type="max")
+        assert p.shape == (2, 4, 4, 4)
+        bn = layers.batch_norm(p)
+        got = np.asarray(bn.numpy())
+        assert abs(got.mean()) < 1e-2
+        ln = layers.layer_norm(p, begin_norm_axis=1)
+        assert ln.shape == p.shape
+
+
+def test_eager_matches_static_same_params():
+    """fc forward: eager result == static Executor result, same weights."""
+    rs = np.random.RandomState(2)
+    xs = rs.rand(4, 6).astype(np.float32)
+    with dygraph.guard():
+        x = dygraph.to_variable(xs)
+        out = layers.fc(x, size=3, act="tanh",
+                        param_attr=fluid.ParamAttr(name="w"),
+                        bias_attr=fluid.ParamAttr(name="b"))
+        eager = np.asarray(out.numpy())
+        w = np.asarray(parameter_store()["w"].numpy())
+        b = np.asarray(parameter_store()["b"].numpy())
+
+    xv = layers.data("x", shape=[6], dtype="float32")
+    out_s = layers.fc(xv, size=3, act="tanh",
+                      param_attr=fluid.ParamAttr(name="w"),
+                      bias_attr=fluid.ParamAttr(name="b"))
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    import jax.numpy as jnp
+    fluid.global_scope().set("w", jnp.asarray(w))
+    fluid.global_scope().set("b", jnp.asarray(b))
+    static, = exe.run(feed={"x": xs}, fetch_list=[out_s])
+    np.testing.assert_allclose(eager, static, rtol=1e-5, atol=1e-6)
+
+
+def test_dropout_eager_respects_is_test_and_rng():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.ones((64, 64), np.float32))
+        d1 = np.asarray(layers.dropout(x, dropout_prob=0.5).numpy())
+        d2 = np.asarray(layers.dropout(x, dropout_prob=0.5).numpy())
+        # train mode: some zeros, different masks per call
+        assert (d1 == 0).mean() > 0.3
+        assert not np.array_equal(d1, d2)
+
+
+def test_tensor_ops_eager():
+    with dygraph.guard():
+        a = dygraph.to_variable(np.arange(6, np.float32).reshape(2, 3)
+                                if False else
+                                np.arange(6, dtype=np.float32).reshape(2, 3))
+        b = layers.concat([a, a], axis=0)
+        assert b.shape == (4, 3)
+        c = layers.reshape(b, shape=[3, 4])
+        assert c.shape == (3, 4)
+        s = layers.reduce_sum(c)
+        np.testing.assert_allclose(float(s.numpy()), 30.0)

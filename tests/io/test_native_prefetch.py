@@ -1,0 +1,134 @@
+"""C++ prefetch ring tests (csrc/prefetch.cc via reader/native.py).
+
+Parity model: the reference's reader-op unit tests (buffered_reader /
+blocking_queue): order preservation, backpressure, EOF drain semantics,
+and DataLoader integration.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.reader import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native ring unavailable (no g++?)")
+
+
+def test_serialize_roundtrip_positional():
+    batch = [np.arange(12, dtype=np.float32).reshape(3, 4),
+             np.array([1, 2, 3], np.int64)]
+    out = native.deserialize_batch(native.serialize_batch(batch))
+    assert isinstance(out, list)
+    np.testing.assert_array_equal(out[0], batch[0])
+    np.testing.assert_array_equal(out[1], batch[1])
+    assert out[0].dtype == np.float32 and out[1].dtype == np.int64
+
+
+def test_serialize_roundtrip_dict_and_scalar():
+    batch = {"x": np.float32(3.5) * np.ones((2, 2), np.float32),
+             "step": np.array(7, np.int32)}
+    out = native.deserialize_batch(native.serialize_batch(batch))
+    assert set(out) == {"x", "step"}
+    np.testing.assert_array_equal(out["x"], batch["x"])
+    assert out["step"] == 7
+
+
+def test_ring_order_and_eof():
+    ring = native.NativeRing(slots=4)
+    for i in range(3):
+        assert ring.push(bytes([i]) * (i + 1))
+    ring.close()
+    got = []
+    while True:
+        b = ring.pop()
+        if b is None:
+            break
+        got.append(b)
+    assert got == [b"\x00", b"\x01\x01", b"\x02\x02\x02"]
+    assert ring.pop() is None  # stays EOF
+    assert not ring.push(b"x")  # push after close fails
+
+
+def test_ring_backpressure():
+    """Producer blocks when the ring is full until the consumer drains."""
+    ring = native.NativeRing(slots=2)
+    assert ring.push(b"a") and ring.push(b"b")
+    state = {"pushed": False}
+
+    def produce():
+        ring.push(b"c")  # must block: ring full
+        state["pushed"] = True
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    assert not state["pushed"], "push should have blocked on a full ring"
+    assert ring.pop() == b"a"
+    t.join(timeout=2)
+    assert state["pushed"]
+    ring.close()
+
+
+def test_batches_are_writable():
+    """Parity with the python-queue path: consumers may mutate batches."""
+    def src():
+        yield [np.zeros((2, 2), np.float32)]
+
+    batch, = list(native.native_buffered(src, size=2)())
+    batch[0] += 1.0  # must not raise "read-only"
+    np.testing.assert_array_equal(batch[0], np.ones((2, 2), np.float32))
+
+
+def test_abandoned_iterator_unblocks_producer():
+    """break-ing out of the loop must close the ring so the producer
+    thread blocked in push exits instead of leaking."""
+    import threading as _threading
+    n_before = _threading.active_count()
+
+    def src():
+        for i in range(100):
+            yield [np.full((64,), i, np.float32)]
+
+    it = native.native_buffered(src, size=2)()
+    next(it)
+    it.close()  # GeneratorExit -> finally -> ring.close()
+    time.sleep(0.2)
+    assert _threading.active_count() <= n_before + 1
+
+
+def test_native_buffered_reader():
+    def src():
+        for i in range(10):
+            yield [np.full((4, 4), i, np.float32)]
+
+    out = list(native.native_buffered(src, size=3)())
+    assert len(out) == 10
+    for i, batch in enumerate(out):
+        np.testing.assert_array_equal(batch[0], np.full((4, 4), i, np.float32))
+
+
+def test_native_buffered_propagates_producer_error():
+    def src():
+        yield [np.zeros(2, np.float32)]
+        raise RuntimeError("boom")
+
+    it = native.native_buffered(src, size=2)()
+    next(it)
+    with pytest.raises(RuntimeError, match="boom"):
+        list(it)
+
+
+def test_dataloader_uses_native_ring():
+    from paddle_tpu.reader.dataloader import DataLoader
+
+    def batches():
+        for i in range(5):
+            yield {"x": np.full((2, 3), i, np.float32)}
+
+    loader = DataLoader.from_generator(capacity=4)
+    loader.set_batch_generator(batches)
+    seen = [b["x"][0, 0] for b in loader]
+    assert seen == [0.0, 1.0, 2.0, 3.0, 4.0]
