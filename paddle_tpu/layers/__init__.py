@@ -10,14 +10,17 @@ from .nn import *          # noqa: F401,F403
 from .tensor import (create_tensor, create_parameter, create_global_var,
                      fill_constant, fill_constant_batch_size_like, assign,
                      zeros, ones, zeros_like, ones_like, sums, linspace,
-                     range, eye, diag, reverse, has_inf, has_nan, isfinite)
+                     range, eye, diag, reverse, has_inf, has_nan, isfinite,
+                     scatter_nd, strided_slice, unique, unique_with_counts,
+                     shard_index, pad_constant_like)
 from .ops import *         # noqa: F401,F403
 from .loss import (cross_entropy, softmax_with_cross_entropy,
                    square_error_cost, sigmoid_cross_entropy_with_logits,
                    huber_loss, log_loss, bpr_loss, kldiv_loss, rank_loss,
                    margin_rank_loss, dice_loss, npair_loss, mse_loss,
                    teacher_student_sigmoid_loss, cos_sim, center_loss)
-from .metric_op import accuracy, auc, mean_iou
+from .metric_op import (accuracy, auc, mean_iou, edit_distance,
+                        chunk_eval)
 from . import learning_rate_scheduler
 from .learning_rate_scheduler import (noam_decay, exponential_decay,
                                       natural_exp_decay, inverse_time_decay,
@@ -35,7 +38,7 @@ from .control_flow import (While, Switch, IfElse, StaticRNN, cond, case,
                            switch_case, increment, array_write, array_read,
                            array_length, create_array, less_than, less_equal,
                            greater_than, greater_equal, equal, not_equal,
-                           is_empty, autoincreased_step_counter)
+                           is_empty, autoincreased_step_counter, while_loop)
 from . import rnn
 from .rnn import (dynamic_lstm, dynamic_gru, lstm, gru, lstm_unit, gru_unit)
 from . import attention
@@ -47,7 +50,11 @@ from . import detection
 from .detection import (prior_box, density_prior_box, box_coder,
                         iou_similarity, multiclass_nms, yolo_box, roi_pool,
                         roi_align, psroi_pool, ssd_loss, multi_box_head,
-                        detection_output)
+                        detection_output, yolov3_loss, anchor_generator,
+                        bipartite_match, target_assign, box_clip,
+                        polygon_box_transform, retinanet_detection_output,
+                        sigmoid_focal_loss, distribute_fpn_proposals,
+                        collect_fpn_proposals)
 from .nn import topk as top_k  # fluid exposes both spellings
 from .math_op_patch import monkey_patch_variable
 

@@ -480,3 +480,23 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
         counter.op = helper.main_program.global_block().ops[0]
         counter.stop_gradient = True
     return counter
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Functional while loop (lax.while_loop semantics with fluid's API
+    shape; the 1.5-era `While` block above is the op-graph form)."""
+    helper = LayerHelper("while_loop", name=name)
+    n = len(loop_vars)
+    outs = [helper.create_variable_for_type_inference(
+        getattr(v, "dtype", "float32"), getattr(v, "shape", None))
+        for v in loop_vars]
+    from ..core.framework import Operator
+    cond_id = Operator.CALLABLE_TABLE
+    key_c = f"while_cond_{id(cond)}"
+    key_b = f"while_body_{id(body)}"
+    cond_id[key_c] = cond
+    cond_id[key_b] = body
+    helper.append_op("while_loop", {"X": list(loop_vars)},
+                     {"Out": outs}, {"cond_fn": key_c, "body_fn": key_b,
+                                     "n_vars": n})
+    return outs

@@ -228,3 +228,150 @@ def detection_output(loc, scores, prior_box, prior_box_var,
     return multiclass_nms(decoded, probs_t, score_threshold, nms_top_k,
                           keep_top_k, nms_threshold,
                           background_label=background_label)
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=False, name=None):
+    """Parity: fluid.layers.yolov3_loss."""
+    from ..core.layer_helper import LayerHelper
+    helper = LayerHelper("yolov3_loss", name=name)
+    loss = helper.create_variable_for_type_inference(x.dtype,
+                                                     (x.shape[0],))
+    ins = {"X": x, "GTBox": gt_box, "GTLabel": gt_label}
+    if gt_score is not None:
+        ins["GTScore"] = gt_score
+    helper.append_op("yolov3_loss", ins, {"Loss": loss},
+                     {"anchors": list(anchors),
+                      "anchor_mask": list(anchor_mask),
+                      "class_num": class_num,
+                      "ignore_thresh": ignore_thresh,
+                      "downsample_ratio": downsample_ratio})
+    return loss
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=[0.1, 0.1, 0.2, 0.2], stride=None, offset=0.5,
+                     name=None):
+    """Parity: fluid.layers.anchor_generator."""
+    from ..core.layer_helper import LayerHelper
+    helper = LayerHelper("anchor_generator", name=name)
+    anchor_sizes = list(anchor_sizes or [64.0, 128.0, 256.0, 512.0])
+    aspect_ratios = list(aspect_ratios or [0.5, 1.0, 2.0])
+    a = len(anchor_sizes) * len(aspect_ratios)
+    shape = (input.shape[2], input.shape[3], a, 4)
+    anchors = helper.create_variable_for_type_inference("float32", shape)
+    variances = helper.create_variable_for_type_inference("float32", shape)
+    helper.append_op("anchor_generator", {"Input": input},
+                     {"Anchors": anchors, "Variances": variances},
+                     {"anchor_sizes": list(anchor_sizes or [64, 128, 256, 512]),
+                      "aspect_ratios": list(aspect_ratios or [0.5, 1.0, 2.0]),
+                      "variances": list(variance),
+                      "stride": list(stride or [16.0, 16.0]),
+                      "offset": offset})
+    return anchors, variances
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    """Parity: fluid.layers.bipartite_match."""
+    from ..core.layer_helper import LayerHelper
+    helper = LayerHelper("bipartite_match", name=name)
+    idx = helper.create_variable_for_type_inference("int32")
+    dist = helper.create_variable_for_type_inference(dist_matrix.dtype)
+    helper.append_op("bipartite_match", {"DistMat": dist_matrix},
+                     {"ColToRowMatchIndices": idx,
+                      "ColToRowMatchDist": dist},
+                     {"match_type": match_type or "bipartite"})
+    return idx, dist
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    """Parity: fluid.layers.target_assign."""
+    from ..core.layer_helper import LayerHelper
+    helper = LayerHelper("target_assign", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_wt = helper.create_variable_for_type_inference("float32")
+    helper.append_op("target_assign",
+                     {"X": input, "MatchIndices": matched_indices},
+                     {"Out": out, "OutWeight": out_wt},
+                     {"mismatch_value": mismatch_value})
+    return out, out_wt
+
+
+def box_clip(input, im_info, name=None):
+    """Parity: fluid.layers.box_clip."""
+    from ..core.layer_helper import LayerHelper
+    helper = LayerHelper("box_clip", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op("box_clip", {"Input": input, "ImInfo": im_info},
+                     {"Output": out}, {})
+    return out
+
+
+def polygon_box_transform(input, name=None):
+    from ..core.layer_helper import LayerHelper
+    helper = LayerHelper("polygon_box_transform", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op("polygon_box_transform", {"Input": input},
+                     {"Output": out}, {})
+    return out
+
+
+def retinanet_detection_output(bboxes, scores, im_info=None,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    """Parity: fluid.layers.retinanet_detection_output (decode+threshold on
+    device; NMS host-side like detection_output)."""
+    from ..core.layer_helper import LayerHelper
+    helper = LayerHelper("retinanet_detection_output")
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op("retinanet_detection_output",
+                     {"BBoxes": list(bboxes), "Scores": list(scores)},
+                     {"Out": out},
+                     {"score_threshold": score_threshold,
+                      "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+                      "nms_threshold": nms_threshold})
+    return out
+
+
+def sigmoid_focal_loss(x, label, fg_num=None, gamma=2.0, alpha=0.25):
+    """Parity: fluid.layers.sigmoid_focal_loss."""
+    from ..core.layer_helper import LayerHelper
+    helper = LayerHelper("sigmoid_focal_loss")
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    ins = {"X": x, "Label": label}
+    if fg_num is not None:
+        ins["FgNum"] = fg_num
+    helper.append_op("sigmoid_focal_loss", ins, {"Out": out},
+                     {"gamma": gamma, "alpha": alpha})
+    return out
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, name=None):
+    from ..core.layer_helper import LayerHelper
+    helper = LayerHelper("distribute_fpn_proposals")
+    n_lvl = max_level - min_level + 1
+    outs = [helper.create_variable_for_type_inference(fpn_rois.dtype)
+            for _ in range(n_lvl)]
+    idx = helper.create_variable_for_type_inference("int32")
+    helper.append_op("distribute_fpn_proposals", {"FpnRois": fpn_rois},
+                     {"MultiFpnRois": outs, "RestoreIndex": idx},
+                     {"min_level": min_level, "max_level": max_level,
+                      "refer_level": refer_level, "refer_scale": refer_scale})
+    return outs, idx
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, name=None):
+    from ..core.layer_helper import LayerHelper
+    helper = LayerHelper("collect_fpn_proposals")
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op("collect_fpn_proposals",
+                     {"MultiLevelRois": list(multi_rois),
+                      "MultiLevelScores": list(multi_scores)},
+                     {"FpnRois": out}, {"post_nms_topN": post_nms_top_n})
+    return out

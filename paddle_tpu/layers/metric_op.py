@@ -54,3 +54,41 @@ def mean_iou(input, label, num_classes):
                       "OutCorrect": correct},
                      {"num_classes": num_classes})
     return miou, wrong, correct
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """Parity: fluid.layers.edit_distance (Levenshtein on padded seqs)."""
+    helper = LayerHelper("edit_distance")
+    out = helper.create_variable_for_type_inference("float32",
+                                                    (input.shape[0], 1))
+    seq_num = helper.create_variable_for_type_inference("int32", (1,))
+    ins = {"Hyps": input, "Refs": label}
+    if input_length is not None:
+        ins["HypsLength"] = input_length
+    if label_length is not None:
+        ins["RefsLength"] = label_length
+    helper.append_op("edit_distance", ins,
+                     {"Out": out, "SequenceNum": seq_num},
+                     {"normalized": normalized})
+    return out, seq_num
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """Parity: fluid.layers.chunk_eval (IOB span P/R/F1)."""
+    helper = LayerHelper("chunk_eval")
+    mk = lambda d, s: helper.create_variable_for_type_inference(d, s)
+    precision, recall, f1 = mk("float32", (1,)), mk("float32", (1,)), mk("float32", (1,))
+    n_infer, n_label, n_correct = mk("int64", (1,)), mk("int64", (1,)), mk("int64", (1,))
+    ins = {"Inference": input, "Label": label}
+    if seq_length is not None:
+        ins["SeqLength"] = seq_length
+    helper.append_op("chunk_eval", ins,
+                     {"Precision": precision, "Recall": recall,
+                      "F1-Score": f1, "NumInferChunks": n_infer,
+                      "NumLabelChunks": n_label,
+                      "NumCorrectChunks": n_correct},
+                     {"chunk_scheme": chunk_scheme,
+                      "num_chunk_types": num_chunk_types})
+    return precision, recall, f1, n_infer, n_label, n_correct

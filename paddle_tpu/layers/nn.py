@@ -981,3 +981,270 @@ def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
     helper.append_op("smooth_l1_loss", inputs, {"Out": out, "Diff": diff},
                      {"sigma": sigma or 1.0})
     return out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    """Parity: fluid.layers.conv3d_transpose (conv_transpose_op.cc 3-D)."""
+    helper = LayerHelper("conv3d_transpose", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    stride = _pair(stride, 3)
+    padding = _pair(padding, 3)
+    dilation = _pair(dilation, 3)
+    num_channels = input.shape[1]
+    fsize = _pair(filter_size, 3)
+    w = helper.create_parameter(
+        attr=helper.param_attr,
+        shape=[num_channels, num_filters // (groups or 1)] + list(fsize),
+        dtype=input.dtype,
+        default_initializer=init_mod.XavierInitializer())
+    spatial = [(input.shape[2 + i] - 1) * stride[i] - 2 * padding[i] +
+               dilation[i] * (fsize[i] - 1) + 1 for i in range(3)]
+    out_shape = (input.shape[0], num_filters) + tuple(spatial)
+    pre_bias = helper.create_variable_for_type_inference(input.dtype, out_shape)
+    helper.append_op("conv3d_transpose", {"Input": input, "Filter": w},
+                     {"Output": pre_bias},
+                     {"strides": stride, "paddings": padding,
+                      "dilations": dilation, "groups": groups or 1})
+    if helper.bias_attr is not False:
+        b = helper.create_parameter(attr=helper.bias_attr,
+                                    shape=[num_filters],
+                                    dtype=input.dtype, is_bias=True)
+        pre_act = helper.create_variable_for_type_inference(input.dtype, out_shape)
+        helper.append_op("elementwise_add", {"X": pre_bias, "Y": b},
+                         {"Out": pre_act}, {"axis": 1})
+    else:
+        pre_act = pre_bias
+    return helper.append_activation(pre_act)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=False, slot_dim=-1,
+              summary_decay_rate=0.9999999):
+    """Parity: fluid.layers.data_norm (data_norm_op — CTR normalization by
+    accumulated batch statistics, no learned scale)."""
+    helper = LayerHelper("data_norm", name=name)
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    from ..core import unique_name as un
+    size_name = un.generate(helper.name + ".batch_size")
+    sum_name = un.generate(helper.name + ".batch_sum")
+    sqs_name = un.generate(helper.name + ".batch_square_sum")
+    bsize = helper.create_or_get_global_variable(size_name, shape=(c,),
+                                                 dtype="float32",
+                                                 persistable=True)
+    bsum = helper.create_or_get_global_variable(sum_name, shape=(c,),
+                                                dtype="float32",
+                                                persistable=True)
+    bsqs = helper.create_or_get_global_variable(sqs_name, shape=(c,),
+                                                dtype="float32",
+                                                persistable=True)
+    init_mod.ConstantInitializer(1e4)(bsize)
+    init_mod.ConstantInitializer(0.0)(bsum)
+    init_mod.ConstantInitializer(1e4)(bsqs)
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op(
+        "data_norm",
+        {"X": input, "BatchSize": bsize, "BatchSum": bsum,
+         "BatchSquareSum": bsqs},
+        {"Y": out, "BatchSizeOut": bsize, "BatchSumOut": bsum,
+         "BatchSquareSumOut": bsqs},
+        {"epsilon": epsilon, "summary_decay_rate": summary_decay_rate})
+    return helper.append_activation(out) if act else out
+
+
+def _simple_layer(op_type, ins, attrs, helper_name=None, out_slot="Out",
+                  dtype=None, shape=None):
+    helper = LayerHelper(helper_name or op_type)
+    ref = next(iter(ins.values()))
+    ref = ref[0] if isinstance(ref, (list, tuple)) else ref
+    out = helper.create_variable_for_type_inference(
+        dtype or ref.dtype, shape or getattr(ref, "shape", None))
+    helper.append_op(op_type, ins, {out_slot: out}, attrs)
+    return out
+
+
+def grid_sampler(x, grid, name=None):
+    """Parity: fluid.layers.grid_sampler (bilinear spatial sampling)."""
+    return _simple_layer("grid_sampler", {"X": x, "Grid": grid}, {},
+                         out_slot="Output")
+
+
+def affine_grid(theta, out_shape, name=None):
+    """Parity: fluid.layers.affine_grid."""
+    helper = LayerHelper("affine_grid")
+    if isinstance(out_shape, (list, tuple)):
+        attrs = {"output_shape": [int(s) for s in out_shape]}
+        ins = {"Theta": theta}
+        shape = (out_shape[0], out_shape[2], out_shape[3], 2)
+    else:
+        attrs = {}
+        ins = {"Theta": theta, "OutputShape": out_shape}
+        shape = None
+    out = helper.create_variable_for_type_inference(theta.dtype, shape)
+    helper.append_op("affine_grid", ins, {"Output": out}, attrs)
+    return out
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    """Parity: fluid.layers.temporal_shift (TSM video models)."""
+    return _simple_layer("temporal_shift", {"X": x},
+                         {"seg_num": seg_num, "shift_ratio": shift_ratio})
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Parity: fluid.layers.row_conv (lookahead conv, DeepSpeech2)."""
+    helper = LayerHelper("row_conv", param_attr=param_attr, act=act)
+    d = input.shape[-1]
+    w = helper.create_parameter(helper.param_attr,
+                                shape=[future_context_size + 1, d],
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op("row_conv", {"X": input, "Filter": w}, {"Out": out}, {})
+    return helper.append_activation(out)
+
+
+def multiplex(inputs, index):
+    """Parity: fluid.layers.multiplex — per-row select among candidates."""
+    return _simple_layer("multiplex", {"X": list(inputs), "Ids": index}, {},
+                         helper_name="multiplex")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Parity: fluid.layers.crop / crop_tensor. `shape` must be a static
+    list on TPU (XLA needs static slice sizes); `offsets` may be a tensor
+    (dynamic_slice starts)."""
+    if hasattr(shape, "dtype"):
+        raise TypeError(
+            "crop_tensor: tensor-valued `shape` is dynamic-shape; pass a "
+            "python list of ints (use -1 to keep a dim)")
+    ins = {"X": x}
+    attrs = {"shape": list(shape), "offsets": offsets}
+    if hasattr(offsets, "dtype"):
+        ins["Offsets"] = offsets
+        attrs["offsets"] = None
+    return _simple_layer("crop_tensor", ins, attrs, helper_name="crop")
+
+
+crop_tensor = crop
+
+
+def space_to_depth(x, blocksize, name=None):
+    return _simple_layer("space_to_depth", {"X": x}, {"blocksize": blocksize})
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None,
+                   act=None):
+    helper = LayerHelper("affine_channel", act=act)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op("affine_channel",
+                     {"X": x, "Scale": scale, "Bias": bias},
+                     {"Out": out}, {"data_layout": data_layout})
+    return helper.append_activation(out)
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    return _simple_layer("similarity_focus", {"X": input},
+                         {"axis": axis, "indexes": list(indexes)})
+
+
+def fsp_matrix(x, y):
+    """Parity: fluid.layers.fsp_matrix (distillation FSP Gram matrix)."""
+    helper = LayerHelper("fsp")
+    out = helper.create_variable_for_type_inference(
+        x.dtype, (x.shape[0], x.shape[1], y.shape[1]))
+    helper.append_op("fsp", {"X": x, "Y": y}, {"Out": out}, {})
+    return out
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    return _simple_layer("im2sequence", {"X": input},
+                         {"kernels": _pair(filter_size),
+                          "strides": _pair(stride),
+                          "paddings": _pair(padding, 4)},
+                         helper_name="im2sequence")
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size, stride=1,
+                    padding=0, dilation=1, groups=None, deformable_groups=1,
+                    im2col_step=1, param_attr=None, bias_attr=None,
+                    modulated=True, name=None):
+    """Parity: fluid.layers.deformable_conv (v1/v2)."""
+    helper = LayerHelper("deformable_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    c = input.shape[1]
+    fsize = _pair(filter_size)
+    w = helper.create_parameter(
+        helper.param_attr, shape=[num_filters, c] + list(fsize),
+        dtype=input.dtype, default_initializer=init_mod.XavierInitializer())
+    ins = {"Input": input, "Offset": offset, "Filter": w}
+    if modulated and mask is not None:
+        ins["Mask"] = mask
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("deformable_conv", ins, {"Output": out},
+                     {"strides": _pair(stride), "paddings": _pair(padding),
+                      "dilations": _pair(dilation),
+                      "deformable_groups": deformable_groups})
+    if helper.bias_attr is not False:
+        b = helper.create_parameter(helper.bias_attr, shape=[num_filters],
+                                    dtype=input.dtype, is_bias=True)
+        out2 = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("elementwise_add", {"X": out, "Y": b},
+                         {"Out": out2}, {"axis": 1})
+        return out2
+    return out
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=[1],
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1,
+                           position_sensitive=False, name=None):
+    helper = LayerHelper("deformable_roi_pooling")
+    ins = {"Input": input, "ROIs": rois}
+    if not no_trans and trans is not None:
+        ins["Trans"] = trans
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("deformable_psroi_pooling", ins, {"Output": out},
+                     {"spatial_scale": spatial_scale,
+                      "group_size": group_size,
+                      "pooled_height": pooled_height,
+                      "pooled_width": pooled_width,
+                      "part_size": part_size or [pooled_height],
+                      "trans_std": trans_std})
+    return out
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    """Parity: fluid.layers.hash (sparse id hashing)."""
+    return _simple_layer("hash", {"X": input},
+                         {"num_hash": num_hash, "mod_by": hash_size},
+                         helper_name="hash", dtype="int32")
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    return _simple_layer("continuous_value_model", {"X": input, "CVM": cvm},
+                         {"use_cvm": use_cvm}, helper_name="cvm",
+                         out_slot="Y")
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True, out_val_if_empty=0):
+    helper = LayerHelper("filter_by_instag")
+    out = helper.create_variable_for_type_inference(ins.dtype, ins.shape)
+    loss_weight = helper.create_variable_for_type_inference("float32")
+    index_map = helper.create_variable_for_type_inference("int32")
+    helper.append_op("filter_by_instag",
+                     {"Ins": ins, "Ins_tag": ins_tag,
+                      "Filter_tag": filter_tag},
+                     {"Out": out, "LossWeight": loss_weight,
+                      "IndexMap": index_map}, {})
+    return out, loss_weight, index_map
+
+
+def shuffle_channel(x, group, name=None):
+    """Parity: fluid.layers.shuffle_channel (ShuffleNet)."""
+    return _simple_layer("shuffle_channel", {"X": x}, {"group": group})

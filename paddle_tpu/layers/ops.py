@@ -10,7 +10,7 @@ _UNARY = [
     "sigmoid", "logsigmoid", "exp", "tanh", "tanh_shrink", "softplus",
     "softsign", "sqrt", "rsqrt", "abs", "ceil", "floor", "cos", "sin",
     "round", "reciprocal", "square", "acos", "asin", "atan", "gelu", "erf",
-    "log_softmax", "selu", "log",
+    "log_softmax", "selu", "log", "mish",
 ]
 
 

@@ -175,3 +175,64 @@ def isfinite(x):
     out = helper.create_variable_for_type_inference("bool", ())
     helper.append_op("isfinite", {"X": x}, {"Out": out})
     return out
+
+
+def scatter_nd(index, updates, shape, name=None):
+    """Parity: fluid.layers.scatter_nd."""
+    helper = LayerHelper("scatter_nd", name=name)
+    out = helper.create_variable_for_type_inference(updates.dtype, tuple(shape))
+    helper.append_op("scatter_nd", {"Index": index, "Updates": updates},
+                     {"Out": out}, {"shape": list(shape)})
+    return out
+
+
+def strided_slice(input, axes, starts, ends, strides, name=None):
+    """Parity: fluid.layers.strided_slice."""
+    helper = LayerHelper("strided_slice", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("strided_slice", {"Input": input}, {"Out": out},
+                     {"axes": list(axes), "starts": list(starts),
+                      "ends": list(ends), "strides": list(strides)})
+    return out
+
+
+def unique(x, dtype="int32"):
+    """Parity: fluid.layers.unique. Static-shape variant: returns (out,
+    index) where out is padded to x.size with the first element (jnp.unique
+    size= semantics — TPU needs static shapes)."""
+    helper = LayerHelper("unique")
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    index = helper.create_variable_for_type_inference(dtype, x.shape)
+    helper.append_op("unique", {"X": x}, {"Out": out, "Index": index},
+                     {"dtype": dtype})
+    return out, index
+
+
+def unique_with_counts(x, dtype="int32"):
+    helper = LayerHelper("unique_with_counts")
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    index = helper.create_variable_for_type_inference(dtype, x.shape)
+    count = helper.create_variable_for_type_inference(dtype, x.shape)
+    helper.append_op("unique_with_counts", {"X": x},
+                     {"Out": out, "Index": index, "Count": count},
+                     {"dtype": dtype})
+    return out, index, count
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Parity: fluid.layers.shard_index (sharded embedding ids)."""
+    helper = LayerHelper("shard_index")
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op("shard_index", {"X": input}, {"Out": out},
+                     {"index_num": index_num, "nshards": nshards,
+                      "shard_id": shard_id, "ignore_value": ignore_value})
+    return out
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """Parity: fluid.layers.pad_constant_like — pad y to x's shape."""
+    helper = LayerHelper("pad_constant_like", name=name)
+    out = helper.create_variable_for_type_inference(y.dtype, x.shape)
+    helper.append_op("pad_constant_like", {"X": x, "Y": y}, {"Out": out},
+                     {"pad_value": float(pad_value)})
+    return out

@@ -165,3 +165,6 @@ def softmax(ctx):
 @register("log_softmax")
 def log_softmax(ctx):
     return {"Out": jax.nn.log_softmax(ctx.in_("X"), axis=ctx.attr("axis", -1))}
+
+
+register("mish")(_unary(lambda x: x * jnp.tanh(jax.nn.softplus(x))))

@@ -10,6 +10,7 @@ to lax.while_loop over the block's live state; these ops cover the leaf
 pieces.
 """
 
+import jax
 import jax.numpy as jnp
 
 from . import register
@@ -209,3 +210,25 @@ def switch_op(ctx):
             if n in env2:
                 current[n] = jnp.where(take, env2[n], current[n])
     return {"Out": [current[n] for n in targets]}
+
+
+@register("while_loop")
+def while_loop_op(ctx):
+    """Functional while_loop: cond/body are python callables (from the
+    CALLABLE_TABLE, like py_func) traced once by lax.while_loop."""
+    from ..core.framework import Operator
+    cond = Operator.CALLABLE_TABLE[ctx.attr("cond_fn")]
+    body = Operator.CALLABLE_TABLE[ctx.attr("body_fn")]
+    xs = ctx.in_list("X")
+
+    def c(vals):
+        out = cond(*vals)
+        return jnp.asarray(out).reshape(())
+
+    def b(vals):
+        out = body(*vals)
+        out = out if isinstance(out, (list, tuple)) else [out]
+        return tuple(jnp.asarray(o) for o in out)
+
+    res = jax.lax.while_loop(c, b, tuple(jnp.asarray(x) for x in xs))
+    return {"Out": list(res)}

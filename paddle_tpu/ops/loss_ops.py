@@ -223,3 +223,24 @@ def cos_sim(ctx):
 def mse_loss(ctx):
     d = ctx.in_("X") - ctx.in_("Y")
     return {"Out": jnp.mean(d * d)}
+
+
+@register("sigmoid_focal_loss")
+def sigmoid_focal_loss(ctx):
+    """Focal loss on logits (reference: sigmoid_focal_loss_op, RetinaNet).
+    Label 0 is background; positive classes are 1..C mapped to channels."""
+    x = ctx.in_("X")                    # (N, C) logits
+    label = ctx.in_("Label").reshape(-1)  # (N,) int in [0, C]
+    fg_num = ctx.in_("FgNum") if ctx.has_in("FgNum") else None
+    gamma = ctx.attr("gamma", 2.0)
+    alpha = ctx.attr("alpha", 0.25)
+    c = x.shape[1]
+    t = jax.nn.one_hot(label - 1, c, dtype=x.dtype)   # label 0 -> all zeros
+    p = jax.nn.sigmoid(x)
+    pt = jnp.where(t > 0, p, 1 - p)
+    at = jnp.where(t > 0, alpha, 1 - alpha)
+    bce = jnp.maximum(x, 0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    loss = at * (1 - pt) ** gamma * bce
+    if fg_num is not None:
+        loss = loss / jnp.maximum(fg_num.astype(x.dtype).reshape(()), 1.0)
+    return {"Out": loss}

