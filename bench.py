@@ -13,10 +13,29 @@ params/accum fp32.
 
 import json
 import os
+import signal
 import sys
 import time
 
 V100_BERT_BASE_TOKENS_PER_SEC = 2800.0
+
+# Fail fast (non-zero, no JSON) if the TPU tunnel is wedged rather than
+# hanging the driver: device init normally takes seconds.
+DEVICE_INIT_TIMEOUT_S = int(os.environ.get("BENCH_DEVICE_TIMEOUT", 600))
+
+
+def _device_watchdog():
+    def _abort(signum, frame):
+        print("bench: jax device init exceeded "
+              f"{DEVICE_INIT_TIMEOUT_S}s (TPU tunnel wedged?)",
+              file=sys.stderr)
+        os._exit(2)
+
+    signal.signal(signal.SIGALRM, _abort)
+    signal.alarm(DEVICE_INIT_TIMEOUT_S)
+    import jax
+    jax.devices()
+    signal.alarm(0)
 
 
 def build_step():
@@ -52,6 +71,7 @@ def build_step():
 def main():
     import numpy as np
 
+    _device_watchdog()
     step, tokens_per_step = build_step()
     # warmup: first call compiles (~20-40s on TPU), second confirms cache
     step()

@@ -17,6 +17,8 @@ from .core.place import (CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace,
                          cpu_places, cuda_places, tpu_places,
                          is_compiled_with_cuda, is_compiled_with_tpu)
 from .core.executor import Executor, Scope, global_scope, scope_guard
+from .core.lod import (LoDTensor, create_lod_tensor,
+                       create_random_int_lodtensor)
 from .core.backward import append_backward, gradients
 from .core.param_attr import ParamAttr, WeightNormParamAttr
 from .core.data_feeder import DataFeeder

@@ -1,0 +1,45 @@
+"""LoDTensor host container tests (parity: test_lod_tensor.py in the
+reference)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_create_and_roundtrip_lengths():
+    t = fluid.create_lod_tensor(np.arange(10).reshape(10, 1),
+                                [[3, 2, 5]])
+    assert t.recursive_sequence_lengths() == [[3, 2, 5]]
+    assert t.lod() == [[0, 3, 5, 10]]
+    assert t.has_valid_recursive_sequence_lengths()
+    assert t.shape() == (10, 1)
+
+
+def test_create_from_list_of_sequences():
+    t = fluid.create_lod_tensor([[1, 2], [3, 4, 5]], None)
+    assert t.recursive_sequence_lengths() == [[2, 3]]
+    np.testing.assert_array_equal(np.asarray(t).ravel(), [1, 2, 3, 4, 5])
+
+
+def test_to_padded():
+    t = fluid.create_lod_tensor(np.arange(5).reshape(5, 1).astype(np.float32),
+                                [[2, 3]])
+    padded, lengths = t.to_padded(max_len=4, pad_value=-1)
+    assert padded.shape == (2, 4, 1)
+    np.testing.assert_array_equal(lengths, [2, 3])
+    np.testing.assert_array_equal(padded[0, :, 0], [0, 1, -1, -1])
+    np.testing.assert_array_equal(padded[1, :, 0], [2, 3, 4, -1])
+
+
+def test_random_int_lod_tensor():
+    t = fluid.create_random_int_lodtensor([[2, 4]], base_shape=[1],
+                                          low=0, high=9)
+    assert len(t) == 6
+    assert t.recursive_sequence_lengths() == [[2, 4]]
+    assert np.asarray(t).max() <= 9
+
+
+def test_invalid_lod_detected():
+    t = fluid.LoDTensor(np.zeros((4, 1)))
+    t.set_lod([[0, 3, 2]])
+    assert not t.has_valid_recursive_sequence_lengths()
