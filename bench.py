@@ -13,7 +13,6 @@ params/accum fp32.
 
 import json
 import os
-import signal
 import sys
 import time
 
@@ -25,17 +24,47 @@ DEVICE_INIT_TIMEOUT_S = int(os.environ.get("BENCH_DEVICE_TIMEOUT", 600))
 
 
 def _device_watchdog():
-    def _abort(signum, frame):
+    """Initialize jax devices with bounded retries under a hard watchdog.
+
+    Two failure modes of a flaky TPU tunnel:
+      * init RAISES (transient RPC error)  -> retry with backoff;
+      * init HANGS (wedged tunnel)         -> a timer thread os._exit(2)s
+        (a SIGALRM python handler can't fire while the main thread is
+        blocked inside the C init call, so use a thread, not alarm()).
+    """
+    import threading
+
+    def _abort():
         print("bench: jax device init exceeded "
               f"{DEVICE_INIT_TIMEOUT_S}s (TPU tunnel wedged?)",
               file=sys.stderr)
         os._exit(2)
 
-    signal.signal(signal.SIGALRM, _abort)
-    signal.alarm(DEVICE_INIT_TIMEOUT_S)
+    timer = threading.Timer(DEVICE_INIT_TIMEOUT_S, _abort)
+    timer.daemon = True
+    timer.start()
+    attempts = int(os.environ.get("BENCH_INIT_RETRIES", 3))
+    last_err = None
     import jax
-    jax.devices()
-    signal.alarm(0)
+    for i in range(attempts):
+        try:
+            devs = jax.devices()
+            timer.cancel()
+            return devs
+        except Exception as e:          # transient tunnel error: retry
+            last_err = e
+            print(f"bench: device init attempt {i + 1}/{attempts} "
+                  f"failed: {e}", file=sys.stderr)
+            try:                        # drop the cached failed backend
+                from jax.extend import backend as _jex_backend
+                _jex_backend.clear_backends()
+            except Exception as ce:
+                print(f"bench: clear_backends failed: {ce}", file=sys.stderr)
+            time.sleep(min(15.0, 3.0 * (i + 1)))
+    timer.cancel()
+    print(f"bench: device init failed after {attempts} attempts: {last_err}",
+          file=sys.stderr)
+    os._exit(2)
 
 
 def build_step():

@@ -70,6 +70,20 @@ class CompiledProgram:
         self.places = places
         return self
 
+    def with_mesh(self, mesh):
+        """Run this program over an arbitrary named mesh (dp/tp/sp/...).
+
+        Persistable vars are placed according to their `dist_attr`
+        PartitionSpec (annotated by parallel.tensor_parallel.apply_shard_rules,
+        transpiler.shard_optimizer_state (ZeRO-1) or shard_params_fsdp),
+        falling back to replicated; feeds shard their batch axis over 'dp'.
+        XLA GSPMD propagates the layouts and inserts the collectives — the
+        TPU-native replacement for the reference's transpiler program rewrite
+        (ref: python/paddle/fluid/transpiler/distribute_transpiler.py)."""
+        self._data_parallel = True
+        self._mesh = mesh
+        return self
+
     @property
     def mesh(self):
         if self._mesh is None:
@@ -100,13 +114,39 @@ class ParallelExecutor:
 def _shard_feeds_spec(feeds, mesh):
     """Leading-axis batch sharding for every feed; scalars replicated."""
     specs = {}
+    dp = mesh.shape.get("dp", 1) if "dp" in mesh.axis_names else 1
     for k, v in feeds.items():
-        if hasattr(v, "ndim") and v.ndim >= 1 and v.shape[0] % mesh.devices.size == 0:
+        if dp > 1 and hasattr(v, "ndim") and v.ndim >= 1 \
+                and v.shape[0] % dp == 0:
             specs[k] = NamedSharding(mesh, P("dp", *([None] * (v.ndim - 1))))
         else:
             specs[k] = NamedSharding(mesh, P())
         # note: uneven batches fall back to replication (still correct)
     return specs
+
+
+def _var_sharding(var, value, mesh):
+    """NamedSharding for a persistable var: its dist_attr PartitionSpec when
+    set (axes filtered to this mesh, non-divisible dims dropped to
+    replicated), else fully replicated."""
+    spec = getattr(var, "dist_attr", None)
+    shape = getattr(value, "shape", ())
+    if spec is None:
+        return NamedSharding(mesh, P())
+    entries = []
+    for i, entry in enumerate(tuple(spec)):
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        axes = tuple(a for a in axes if a is not None and a in mesh.axis_names)
+        size = 1
+        for a in axes:
+            size *= mesh.shape[a]
+        if not axes or i >= len(shape) or size <= 1 or shape[i] % size != 0:
+            entries.append(None)
+        elif len(axes) == 1:
+            entries.append(axes[0])
+        else:
+            entries.append(axes)
+    return NamedSharding(mesh, P(*entries))
 
 
 # Executor integration: Executor.run accepts a CompiledProgram transparently.
@@ -126,28 +166,37 @@ def _run_maybe_compiled(self, program=None, feed=None, fetch_list=None,
 
 
 def _run_data_parallel(self, compiled, feed, fetch_list, scope, **kwargs):
-    """pjit path: replicate state, shard feeds on batch, run the same step."""
+    """pjit path: place state per dist_attr (replicated by default), shard
+    feeds on batch, run the same step. GSPMD inserts the collectives."""
     mesh = compiled.mesh
     scope = scope if scope is not None else global_scope()
     feed = feed or {}
     feeds = {k: jnp.asarray(v) for k, v in feed.items()}
     in_specs = _shard_feeds_spec(feeds, mesh)
-    replicated = NamedSharding(mesh, P())
     feeds = {k: jax.device_put(v, in_specs[k]) for k, v in feeds.items()}
-    # Replicate state across the mesh once; afterwards it stays sharded.
+    # Place state across the mesh once; afterwards it stays sharded.
     program = compiled.program
     for v in program.list_vars():
         if v.persistable:
             val = scope.get(v.name)
-            if val is not None and not _is_on_mesh(val, mesh):
-                scope.set(v.name, jax.device_put(jnp.asarray(val), replicated))
-    with mesh:
-        return _orig_run(self, program, feeds, fetch_list, scope, **kwargs)
+            if val is None:
+                continue
+            want = _var_sharding(v, val, mesh)
+            if not _has_sharding(val, want):
+                scope.set(v.name, jax.device_put(jnp.asarray(val), want))
+    self._active_mesh = mesh
+    try:
+        with mesh:
+            return _orig_run(self, program, feeds, fetch_list, scope,
+                             **kwargs)
+    finally:
+        self._active_mesh = None
 
 
-def _is_on_mesh(val, mesh):
+def _has_sharding(val, want):
     sharding = getattr(val, "sharding", None)
-    return isinstance(sharding, NamedSharding) and sharding.mesh == mesh
+    return isinstance(sharding, NamedSharding) and sharding.mesh == want.mesh \
+        and sharding.spec == want.spec
 
 
 Executor.run = _run_maybe_compiled

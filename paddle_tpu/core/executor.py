@@ -121,10 +121,20 @@ class Executor:
         self.place = place if place is not None else TPUPlace(0)
         self._cache = {}
         self._step_counter = 0
+        self._last_call = None
 
     # ------------------------------------------------------------------
     def close(self):
         self._cache.clear()
+        self._last_call = None
+
+    def last_compiled_text(self):
+        """Optimized HLO of the most recent step executable (post-XLA-opt;
+        what actually ran). Used by bench.py's self-audit and kernel tests."""
+        if self._last_call is None:
+            raise RuntimeError("no program has been run yet")
+        step_fn, args = self._last_call
+        return step_fn.lower(*args).compile().as_text()
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             feed_var_name="feed", fetch_var_name="fetch", return_numpy=True,
@@ -158,7 +168,10 @@ class Executor:
         state = {n: scope.get(n) for n in persist_names if scope.get(n) is not None}
         state_sig = tuple(sorted(state))
 
-        key = (id(program), program.version, feed_sig, fetch_names, state_sig)
+        mesh = getattr(self, "_active_mesh", None)
+        mesh_key = None if mesh is None else (id(mesh), tuple(mesh.axis_names))
+        key = (id(program), program.version, feed_sig, fetch_names, state_sig,
+               mesh_key)
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
             entry = self._build(program, fetch_names, persist_names, state_sig)
@@ -170,6 +183,7 @@ class Executor:
         rng = jax.random.fold_in(jax.random.PRNGKey(seed), self._step_counter)
         self._step_counter += 1
 
+        self._last_call = (step_fn, (state, feeds, rng))
         new_state, fetches = step_fn(state, feeds, rng)
         for n, v in new_state.items():
             scope.set(n, v)
@@ -188,6 +202,34 @@ class Executor:
                 break
         is_test = program._is_test
         state_keys = set(state_sig)
+
+        # Pipeline parallelism: when PipelineOptimizer attached a config and
+        # the active mesh has a pp axis, lower the forward section to the
+        # SPMD scan schedule (parallel/pipeline.py) instead of the plain
+        # op-by-op trace.
+        pipelined_fwd = None
+        pcfg = getattr(program, "_pipeline", None)
+        mesh = getattr(self, "_active_mesh", None)
+        if pcfg is not None and marker_idx is not None and mesh is not None \
+                and "pp" in mesh.axis_names and mesh.shape["pp"] > 1:
+            from ..parallel.pipeline import build_pipelined_forward
+            ploss = gb.ops[marker_idx].attr("loss")
+            # Forward intermediates live per-microbatch inside the scan;
+            # only the loss, persistables, feeds, and grads are fetchable.
+            data_names = {v.name for v in program.list_vars()
+                          if getattr(v, "is_data", False)}
+            bad_fetch = [f for f in fetch_names
+                         if f != ploss and not f.endswith("@GRAD")
+                         and f not in persist_names and f not in data_names]
+            if bad_fetch:
+                raise ValueError(
+                    f"cannot fetch forward intermediates {bad_fetch} from a "
+                    f"pipelined program — they exist only per-microbatch "
+                    f"inside the pipeline scan; fetch the loss, params or "
+                    f"gradients instead")
+            pipelined_fwd = build_pipelined_forward(
+                program, marker_idx, pcfg, mesh, ploss, is_test=is_test)
+
         if marker_idx is None:
             # dead-code-eliminate to the fetch set (+ persistable writers):
             # an inference/test run must not demand feeds its fetches don't
@@ -210,13 +252,27 @@ class Executor:
                 param_names = [n for n in marker.attr("params") if n in env]
                 base_env = {k: v for k, v in env.items() if k not in param_names}
 
-                def fwd(params):
-                    env2 = dict(base_env)
-                    env2.update(params)
-                    for op in gb.ops[:marker_idx]:
-                        ops_registry.run_op(op, env2, program, is_test)
-                    loss = jnp.sum(env2[loss_name])
-                    return loss, env2
+                if pipelined_fwd is not None:
+                    feed_keys = set(feeds)
+
+                    def fwd(params):
+                        genv = {k: v for k, v in base_env.items()
+                                if k not in feed_keys and k != "@RNG@"}
+                        genv.update(params)
+                        fd = {k: env[k] for k in feed_keys}
+                        loss = pipelined_fwd(genv, fd, env["@RNG@"])
+                        env2 = dict(base_env)
+                        env2.update(params)
+                        env2[loss_name] = loss
+                        return loss, env2
+                else:
+                    def fwd(params):
+                        env2 = dict(base_env)
+                        env2.update(params)
+                        for op in gb.ops[:marker_idx]:
+                            ops_registry.run_op(op, env2, program, is_test)
+                        loss = jnp.sum(env2[loss_name])
+                        return loss, env2
 
                 params = {n: env[n] for n in param_names}
                 (loss_val, env), grads = jax.value_and_grad(

@@ -1,15 +1,22 @@
-"""Blockwise flash attention for TPU (Pallas).
+"""Blockwise flash attention for TPU (Pallas): forward + backward kernels.
 
 The reference (Fluid 1.5) composes attention from matmul+softmax CUDA
-kernels, materializing the (Tq, Tk) score matrix in HBM. This kernel is the
-TPU-native replacement: online-softmax over K/V blocks held in VMEM, so HBM
-traffic is O(T*D) instead of O(T^2) and the two matmuls per block ride the
-MXU back-to-back.
+kernels, materializing the (Tq, Tk) score matrix in HBM
+(python/paddle/fluid/layers/nn.py scaled_dot_product_attention). This module
+is the TPU-native replacement:
 
-Forward is Pallas; backward recomputes through the XLA composition under
-jax.custom_vjp (activation-free attention — the standard flash-training
-memory trade; a full Pallas backward is a later optimization, tracked in
-SURVEY.md §7 R2+).
+* forward: online-softmax over K/V blocks held in VMEM — HBM traffic is
+  O(T*D) instead of O(T^2); the two matmuls per block ride the MXU
+  back-to-back. The per-row logsumexp is saved for the backward.
+* backward: two Pallas kernels (dQ over q-blocks, dK/dV over k-blocks) that
+  recompute probabilities blockwise from the saved logsumexp — training
+  memory stays O(T*block), never a (B, H, T, T) tensor.
+* additive bias (padding masks, relative-position biases) is applied INSIDE
+  the kernels. A (B, 1, 1, Tk) padding bias — the BERT/ERNIE hot path —
+  stays O(T) end to end.
+
+Off-TPU the same kernels run under the Pallas interpreter so the CPU test
+suite exercises the real kernel code, not a shadow path.
 """
 
 import functools
@@ -17,21 +24,71 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+# Incremented each time flash_attention is TRACED — bench.py asserts the
+# flash path actually engaged for the headline model (VERDICT r1 weak #7).
+TRACE_COUNT = 0
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
-                kv_len):
-    # Block shapes carry the leading mapped dim: q_ref (1, block_q, d),
-    # k_ref/v_ref (1, kv_len, d), o_ref (1, block_q, d).
+
+def _interpret():
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:  # pragma: no cover
+        return True
+
+
+def _pad_to(x, axis, mult):
+    n = x.shape[axis]
+    pad = (-n) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _bias_index_fn(bb, hb, h):
+    """Index map over the collapsed (bb*hb) bias batch dim for grid index
+    bh in [0, b*h)."""
+    if bb > 1 and hb > 1:
+        return lambda bh: bh
+    if bb > 1:
+        return lambda bh: bh // h
+    if hb > 1:
+        return lambda bh: bh % h
+    return lambda bh: 0
+
+
+def _mask(s, q0, block_q, kb, block_k, q_len, kv_len, causal):
+    """Apply validity + causal masking to a (block_q, block_k) score tile.
+    Causal convention matches the XLA oracle: key j visible to query i iff
+    j <= i + (kv_len - q_len) (bottom-right aligned, = lower-triangular
+    when q_len == kv_len)."""
+    q_pos = q0 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_pos = kb * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    valid = (k_pos < kv_len) & (q_pos < q_len)
+    if causal:
+        valid &= k_pos <= q_pos + (kv_len - q_len)
+    return jnp.where(valid, s, NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(*refs, scale, causal, block_k, q_len, kv_len,
+                has_bias, bias_per_q):
+    if has_bias:
+        q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
+        b_ref = None
     q = q_ref[0].astype(jnp.float32) * scale
     block_q, d = q.shape
-    q_idx = pl.program_id(1)
-    q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-
+    q0 = pl.program_id(1) * block_q
     num_kb = pl.cdiv(kv_len, block_k)
 
     def body(kb, carry):
@@ -39,11 +96,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
         k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
-        k_pos = kb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        if causal:
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        s = jnp.where(k_pos < kv_len, s, NEG_INF)
+        if b_ref is not None:
+            if bias_per_q:
+                bblk = b_ref[0, :, pl.ds(kb * block_k, block_k)]
+            else:
+                bblk = b_ref[0, 0:1, pl.ds(kb * block_k, block_k)]
+            s = s + bblk.astype(jnp.float32)
+        s = _mask(s, q0, block_q, kb, block_k, q_len, kv_len, causal)
         m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
@@ -57,39 +116,300 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q=128, block_k=128):
+def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k):
     b, h, tq, d = q.shape
     tk = k.shape[2]
-    bq = min(block_q, tq)
-    bk = min(block_k, tk)
-    q3 = q.reshape(b * h, tq, d)
-    k3 = k.reshape(b * h, tk, d)
-    v3 = v.reshape(b * h, tk, d)
-    grid = (b * h, pl.cdiv(tq, bq))
-    out = pl.pallas_call(
+    bq = min(block_q, max(tq, 1))
+    bk = min(block_k, max(tk, 1))
+    q_p = _pad_to(q, 2, bq)
+    k_p = _pad_to(k, 2, bk)
+    v_p = _pad_to(v, 2, bk)
+    tq_p, tk_p = q_p.shape[2], k_p.shape[2]
+    q3 = q_p.reshape(b * h, tq_p, d)
+    k3 = k_p.reshape(b * h, tk_p, d)
+    v3 = v_p.reshape(b * h, tk_p, d)
+    grid = (b * h, tq_p // bq)
+
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+        pl.BlockSpec((1, tk_p, d), lambda bh, i: (bh, 0, 0)),
+        pl.BlockSpec((1, tk_p, d), lambda bh, i: (bh, 0, 0)),
+    ]
+    operands = [q3, k3, v3]
+    has_bias = bias is not None
+    per_q = False
+    if has_bias:
+        bb, hb, tqb, _ = bias.shape
+        per_q = tqb > 1
+        bias3 = _pad_to(_pad_to(bias, 3, bk), 2, bq if per_q else 1)
+        bias3 = bias3.reshape(bb * hb, bias3.shape[2], tk_p)
+        bidx = _bias_index_fn(bb, hb, h)
+        if per_q:
+            in_specs.append(pl.BlockSpec(
+                (1, bq, tk_p), lambda bh, i, f=bidx: (f(bh), i, 0)))
+        else:
+            in_specs.append(pl.BlockSpec(
+                (1, 1, tk_p), lambda bh, i, f=bidx: (f(bh), 0, 0)))
+        operands.append(bias3)
+
+    out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_k=bk, kv_len=tk),
+                          block_k=bk, q_len=tq, kv_len=tk,
+                          has_bias=has_bias, bias_per_q=per_q),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, tk, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, tk, d), lambda bh, i: (bh, 0, 0)),
-        ],
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+                   pl.BlockSpec((1, bq), lambda bh, i: (bh, i))],
+        out_shape=[jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
+                   jax.ShapeDtypeStruct((b * h, tq_p), jnp.float32)],
+        interpret=_interpret(),
+    )(*operands)
+    out = out[:, :tq].reshape(b, h, tq, d)
+    lse = lse[:, :tq].reshape(b, h, tq)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(*refs, scale, causal, block_k, q_len, kv_len,
+               has_bias, bias_per_q):
+    if has_bias:
+        q_ref, k_ref, v_ref, b_ref, lse_ref, dlt_ref, do_ref, dq_ref = refs
+    else:
+        q_ref, k_ref, v_ref, lse_ref, dlt_ref, do_ref, dq_ref = refs
+        b_ref = None
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]
+    dlt = dlt_ref[0][:, None]
+    block_q, d = q.shape
+    q0 = pl.program_id(1) * block_q
+    num_kb = pl.cdiv(kv_len, block_k)
+
+    def body(kb, acc):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        if b_ref is not None:
+            if bias_per_q:
+                bblk = b_ref[0, :, pl.ds(kb * block_k, block_k)]
+            else:
+                bblk = b_ref[0, 0:1, pl.ds(kb * block_k, block_k)]
+            s = s + bblk.astype(jnp.float32)
+        s = _mask(s, q0, block_q, kb, block_k, q_len, kv_len, causal)
+        p = jnp.exp(s - lse)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - dlt)
+        return acc + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+    acc = jax.lax.fori_loop(0, num_kb, body, jnp.zeros((block_q, d),
+                                                       jnp.float32))
+    dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(*refs, scale, causal, block_q, q_len, kv_len,
+                has_bias, bias_per_q):
+    if has_bias:
+        q_ref, k_ref, v_ref, b_ref, lse_ref, dlt_ref, do_ref, \
+            dk_ref, dv_ref = refs
+    else:
+        q_ref, k_ref, v_ref, lse_ref, dlt_ref, do_ref, dk_ref, dv_ref = refs
+        b_ref = None
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    block_k, d = k.shape
+    kb = pl.program_id(1)
+    num_qb = pl.cdiv(q_len, block_q)
+
+    def body(qb, carry):
+        dk_acc, dv_acc = carry
+        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(
+            jnp.float32)
+        lse_blk = lse_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        dlt_blk = dlt_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * scale
+        if b_ref is not None:
+            if bias_per_q:
+                bblk = b_ref[0, pl.ds(qb * block_q, block_q), :]
+            else:
+                bblk = b_ref[0, 0:1, :]
+            s = s + bblk.astype(jnp.float32)
+        s = _mask(s, qb * block_q, block_q, kb, block_k, q_len, kv_len,
+                  causal)
+        p = jnp.exp(s - lse_blk)
+        dv_acc = dv_acc + jnp.dot(p.T, do_blk,
+                                  preferred_element_type=jnp.float32)
+        dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - dlt_blk)
+        dk_acc = dk_acc + jnp.dot(ds.T, q_blk,
+                                  preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    z = jnp.zeros((block_k, d), jnp.float32)
+    dk_acc, dv_acc = jax.lax.fori_loop(0, num_qb, body, (z, z))
+    dk_ref[0] = (dk_acc * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, bias, lse, out, do, scale, causal, block_q, block_k):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    bq = min(block_q, max(tq, 1))
+    bk = min(block_k, max(tk, 1))
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    q_p = _pad_to(q, 2, bq).reshape(b * h, -1, d)
+    do_p = _pad_to(do, 2, bq).reshape(b * h, -1, d)
+    k_p = _pad_to(k, 2, bk).reshape(b * h, -1, d)
+    v_p = _pad_to(v, 2, bk).reshape(b * h, -1, d)
+    lse_p = _pad_to(lse.reshape(b * h, tq), 1, bq)
+    dlt_p = _pad_to(delta.reshape(b * h, tq), 1, bq)
+    tq_p, tk_p = q_p.shape[1], k_p.shape[1]
+
+    has_bias = bias is not None
+    per_q = False
+    bias3 = None
+    bidx = None
+    if has_bias:
+        bb, hb, tqb, _ = bias.shape
+        per_q = tqb > 1
+        bias3 = _pad_to(_pad_to(bias, 3, bk), 2, bq if per_q else 1)
+        bias3 = bias3.reshape(bb * hb, bias3.shape[2], tk_p)
+        bidx = _bias_index_fn(bb, hb, h)
+
+    # -- dQ: grid over q blocks, loop over k blocks.
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+        pl.BlockSpec((1, tk_p, d), lambda bh, i: (bh, 0, 0)),
+        pl.BlockSpec((1, tk_p, d), lambda bh, i: (bh, 0, 0)),
+    ]
+    operands = [q_p, k_p, v_p]
+    if has_bias:
+        if per_q:
+            in_specs.append(pl.BlockSpec(
+                (1, bq, tk_p), lambda bh, i, f=bidx: (f(bh), i, 0)))
+        else:
+            in_specs.append(pl.BlockSpec(
+                (1, 1, tk_p), lambda bh, i, f=bidx: (f(bh), 0, 0)))
+        operands.append(bias3)
+    in_specs += [
+        pl.BlockSpec((1, bq), lambda bh, i: (bh, i)),
+        pl.BlockSpec((1, bq), lambda bh, i: (bh, i)),
+        pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+    ]
+    operands += [lse_p, dlt_p, do_p]
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_k=bk, q_len=tq, kv_len=tk,
+                          has_bias=has_bias, bias_per_q=per_q),
+        grid=(b * h, tq_p // bq),
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
-    )(q3, k3, v3)
-    return out.reshape(b, h, tq, d)
+        out_shape=jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
+        interpret=_interpret(),
+    )(*operands)
+
+    # -- dK/dV: grid over k blocks, loop over q blocks.
+    in_specs = [
+        pl.BlockSpec((1, tq_p, d), lambda bh, j: (bh, 0, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
+    ]
+    operands = [q_p, k_p, v_p]
+    if has_bias:
+        if per_q:
+            in_specs.append(pl.BlockSpec(
+                (1, tq_p, bk), lambda bh, j, f=bidx: (f(bh), 0, j)))
+        else:
+            in_specs.append(pl.BlockSpec(
+                (1, 1, bk), lambda bh, j, f=bidx: (f(bh), 0, j)))
+        operands.append(bias3)
+    in_specs += [
+        pl.BlockSpec((1, tq_p), lambda bh, j: (bh, 0)),
+        pl.BlockSpec((1, tq_p), lambda bh, j: (bh, 0)),
+        pl.BlockSpec((1, tq_p, d), lambda bh, j: (bh, 0, 0)),
+    ]
+    operands += [lse_p, dlt_p, do_p]
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, q_len=tq, kv_len=tk,
+                          has_bias=has_bias, bias_per_q=per_q),
+        grid=(b * h, tk_p // bk),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
+                   pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0))],
+        out_shape=[jax.ShapeDtypeStruct((b * h, tk_p, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, tk_p, d), v.dtype)],
+        interpret=_interpret(),
+    )(*operands)
+
+    dq = dq[:, :tq].reshape(b, h, tq, d)
+    dk = dk[:, :tk].reshape(b, h, tk, d)
+    dv = dv[:, :tk].reshape(b, h, tk, d)
+    return dq, dk, dv, delta
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash(q, k, v, scale, causal):
-    return _flash_fwd(q, k, v, scale, causal)
+def _dbias_xla(q, k, v, bias, lse, do, delta, scale, causal):
+    """Bias cotangent, straight from the flash identities:
+    dS = P * (dP - delta). O(T^2) — but this expression is only kept alive
+    by XLA when something downstream actually differentiates w.r.t. the
+    bias (padding masks built from feed data are DCE'd away)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    s = s + bias.astype(jnp.float32)
+    tq, tk = s.shape[-2], s.shape[-1]
+    if causal:
+        i = jnp.arange(tq)[:, None]
+        j = jnp.arange(tk)[None, :]
+        s = jnp.where(j <= i + (tk - tq), s, NEG_INF)
+    p = jnp.exp(s - lse[..., None])
+    dp = jnp.einsum("bhqd,bhkd->bhqk", do.astype(jnp.float32),
+                    v.astype(jnp.float32))
+    ds = p * (dp - delta[..., None])
+    # Reduce over the dims the bias was broadcast along.
+    axes = tuple(i for i in range(4) if bias.shape[i] == 1 and ds.shape[i] > 1)
+    db = jnp.sum(ds, axis=axes, keepdims=True) if axes else ds
+    return db.astype(bias.dtype)
 
 
-def _xla_ref(q, k, v, scale, causal):
+# ---------------------------------------------------------------------------
+# custom_vjp plumbing + public API
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, bias, scale, causal, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, bias, scale, causal, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k)
+    return out, (q, k, v, bias, lse, out)
+
+
+def _flash_vjp_bwd(scale, causal, block_q, block_k, res, g):
+    q, k, v, bias, lse, out = res
+    dq, dk, dv, delta = _flash_bwd(q, k, v, bias, lse, out, g, scale, causal,
+                                   block_q, block_k)
+    if bias is None:
+        return dq, dk, dv, None
+    db = _dbias_xla(q, k, v, bias, lse, g, delta, scale, causal)
+    return dq, dk, dv, db
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def _xla_ref(q, k, v, scale, causal, bias=None):
+    """O(T^2) XLA oracle (tests compare the kernels against this)."""
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
     if causal:
         tq, tk = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((tq, tk), jnp.bool_), k=tk - tq)
@@ -98,33 +418,43 @@ def _xla_ref(q, k, v, scale, causal):
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal):
-    return _flash_fwd(q, k, v, scale, causal), (q, k, v)
+def _canonical_bias(bias, b, h, tq, tk):
+    bias = jnp.asarray(bias)
+    while bias.ndim < 4:
+        bias = bias[None]
+    bb, hb, tqb, tkb = bias.shape
+    if tkb == 1:
+        bias = jnp.broadcast_to(bias, (bb, hb, tqb, tk))
+    elif tkb != tk:
+        raise ValueError(f"bias key dim {tkb} != {tk}")
+    if bb not in (1, b) or hb not in (1, h) or tqb not in (1, tq):
+        bias = jnp.broadcast_to(bias, (b, h, tq, tk))
+    return bias
 
 
-def _flash_vjp_bwd(scale, causal, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q_, k_, v_: _xla_ref(q_, k_, v_, scale, causal),
-                     q, k, v)
-    return vjp(g)
-
-
-_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
-
-
-def flash_attention(q, k, v, bias=None, scale=None, causal=False):
-    """q/k/v: (B, H, T, D). bias falls back to the XLA path (bias blocks
-    would need their own BlockSpec; rare in the model zoo hot path where
-    masks are causal or padding handled upstream)."""
+def flash_attention(q, k, v, bias=None, scale=None, causal=False,
+                    block_q=128, block_k=128):
+    """Fused blockwise attention. q/k/v: (B, H, T, D); bias broadcastable to
+    (B, H, Tq, Tk) is applied inside the kernel (additive, pre-softmax)."""
+    global TRACE_COUNT
+    TRACE_COUNT += 1
     d = q.shape[-1]
-    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    scale = float(scale) if scale is not None else 1.0 / (d ** 0.5)
     if bias is not None:
-        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
-        logits = logits + bias.astype(jnp.float32)
-        if causal:
-            tq, tk = logits.shape[-2], logits.shape[-1]
-            mask = jnp.tril(jnp.ones((tq, tk), jnp.bool_), k=tk - tq)
-            logits = jnp.where(mask, logits, NEG_INF)
-        p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
-    return _flash(q, k, v, scale, causal)
+        bias = _canonical_bias(bias, q.shape[0], q.shape[1], q.shape[2],
+                               k.shape[2])
+    return _flash(q, k, v, bias, scale, bool(causal), int(block_q),
+                  int(block_k))
+
+
+def flash_attention_with_lse(q, k, v, bias=None, scale=None, causal=False,
+                             block_q=128, block_k=128):
+    """Forward-only variant returning (out, logsumexp (B,H,Tq) fp32) — the
+    building block for ring attention's cross-device online combine."""
+    d = q.shape[-1]
+    scale = float(scale) if scale is not None else 1.0 / (d ** 0.5)
+    if bias is not None:
+        bias = _canonical_bias(bias, q.shape[0], q.shape[1], q.shape[2],
+                               k.shape[2])
+    return _flash_fwd(q, k, v, bias, scale, bool(causal), int(block_q),
+                      int(block_k))

@@ -82,19 +82,348 @@ def pipeline_apply(stage_fn, params_stacked, x, mesh, microbatches,
     return ym.reshape((b,) + ym.shape[2:])
 
 
+def pipeline_1f1b(stage_fn, loss_fn, stacked_params, x_microbatches, aux,
+                  mesh, axis_name="pp"):
+    """1F1B interleaved pipeline training step (homogeneous stages).
+
+    Parity target: the reference PipelineOptimizer's section workers
+    (python/paddle/fluid/optimizer.py PipelineOptimizer) stream microbatches
+    through device-resident sections; 1F1B bounds in-flight activations at
+    S - stage instead of GPipe's M. TPU-native: one lax.scan over
+    2M + 2S - 2 ticks inside shard_map; each tick a stage runs either one
+    Forward or one Backward (classic non-interleaved 1F1B), activations flow
+    down the ring and gradients flow back up via ppermute. Residual inputs
+    are kept in a size-S rotating buffer and the backward recomputes the
+    stage (rematerialized 1F1B — the standard TPU memory trade).
+
+    stage_fn(params, x) -> y        (same activation shape at every cut)
+    loss_fn(y, aux_k) -> scalar     (applied to the LAST stage's output)
+    stacked_params: leaves (S, ...) sharded over `axis_name`
+    x_microbatches: (M, mb, ...) stage-0 inputs;  aux: (M, ...) per-mb extras
+    Returns (mean_loss, param_grads_stacked) — grads laid out like
+    stacked_params, ready for any optimizer update.
+    """
+    m = x_microbatches.shape[0]
+
+    pspec = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
+
+    def inner(params_local, xm, aux_m):
+        params = jax.tree_util.tree_map(lambda a: a[0], params_local)
+        pp = lax.psum(1, axis_name)
+        s_idx = lax.axis_index(axis_name)
+        # last event: stage 0's B of mb M-1 at tick 2(M-1) + 2S - 1.
+        ticks = 2 * m + 2 * pp - 2
+        act_shape = xm.shape[1:]
+
+        def fwd_only(p, x):
+            return stage_fn(p, x)
+
+        def bwd_mid(p, x, g):
+            _, vjp = jax.vjp(stage_fn, p, x)
+            return vjp(g)
+
+        def bwd_last(p, x, k):
+            def f(p_, x_):
+                return loss_fn(stage_fn(p_, x_), jax.tree_util.tree_map(
+                    lambda a: a[k], aux_m))
+            val, vjp = jax.vjp(f, p, x)
+            dp, dx = vjp(jnp.ones_like(val))
+            return val, dp, dx
+
+        zero_g = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+        def body(carry, t):
+            act_in, grad_in, buf, gacc, loss_acc = carry
+            # ---- forward slot: stage s runs F of mb k at tick 2k + s.
+            kf = (t - s_idx) // 2
+            do_f = ((t - s_idx) % 2 == 0) & (kf >= 0) & (kf < m)
+            kf_c = jnp.clip(kf, 0, m - 1)
+            x_in = jnp.where(s_idx == 0, xm[kf_c], act_in)
+            # F and B are mutually exclusive per tick (opposite parities),
+            # so both slots are lax.cond'ed — one stage computation/tick.
+            y = jax.lax.cond(do_f, lambda: fwd_only(params, x_in),
+                             lambda: jnp.zeros(act_shape, xm.dtype))
+            buf = jax.lax.cond(
+                do_f,
+                lambda b: jax.lax.dynamic_update_index_in_dim(
+                    b, x_in, kf_c % pp, axis=0),
+                lambda b: b, buf)
+            act_out = y
+
+            # ---- backward slot: stage s runs B of mb k at tick
+            # 2k + 2*pp - 1 - s.
+            kb = (t - 2 * pp + 1 + s_idx) // 2
+            do_b = (((t - 2 * pp + 1 + s_idx) % 2) == 0) & (kb >= 0) & (kb < m)
+            kb_c = jnp.clip(kb, 0, m - 1)
+            x_saved = buf[kb_c % pp]
+
+            def run_bwd(_):
+                def last(_):
+                    lval, dp, dx = bwd_last(params, x_saved, kb_c)
+                    return lval, dp, dx
+
+                def mid(_):
+                    dp, dx = bwd_mid(params, x_saved, grad_in)
+                    return jnp.zeros(()), dp, dx
+
+                return jax.lax.cond(s_idx == pp - 1, last, mid, operand=None)
+
+            def skip_bwd(_):
+                return jnp.zeros(()), zero_g, jnp.zeros(act_shape, xm.dtype)
+
+            lval, dp, dx = jax.lax.cond(do_b, run_bwd, skip_bwd, operand=None)
+            gacc = jax.tree_util.tree_map(lambda a, b_: a + b_, gacc, dp)
+            loss_acc = loss_acc + lval
+
+            # rings: activations stage s -> s+1, gradients stage s -> s-1.
+            down = [(j, (j + 1) % pp) for j in range(pp)]
+            up = [(j, (j - 1) % pp) for j in range(pp)]
+            act_nxt = lax.ppermute(act_out, axis_name, down)
+            grad_nxt = lax.ppermute(
+                jnp.where(do_b, dx, jnp.zeros_like(dx)), axis_name, up)
+            return (act_nxt, grad_nxt, buf, gacc, loss_acc), ()
+
+        buf0 = jnp.zeros((pp,) + act_shape, xm.dtype)
+        z_act = jnp.zeros(act_shape, xm.dtype)
+        carry0 = (z_act, z_act, buf0, zero_g, jnp.zeros(()))
+        (_, _, _, gacc, loss_acc), _ = lax.scan(body, carry0,
+                                                jnp.arange(ticks))
+        # loss lives on the last stage; grads live per-stage. Broadcast the
+        # loss; restack grads with a leading local-stage dim for P('pp').
+        loss = lax.psum(jnp.where(s_idx == pp - 1, loss_acc, 0.0),
+                        axis_name) / m
+        gstk = jax.tree_util.tree_map(lambda a: a[None] / m, gacc)
+        return loss, gstk
+
+    fn = shard_map(inner, mesh=mesh,
+                   in_specs=(pspec, P(), P()),
+                   out_specs=(P(), pspec), check_rep=False)
+    return fn(stacked_params, x_microbatches, aux)
+
+
+# ---------------------------------------------------------------------------
+# Framework (static-graph) path: Program partitioning + scan schedule
+# ---------------------------------------------------------------------------
+
+class PipelineConfig:
+    """Attached to a Program by PipelineOptimizer.minimize; consumed by the
+    Executor when the active mesh has a pp axis (core/executor.py)."""
+
+    def __init__(self, cut_names, num_microbatches):
+        self.cut_names = list(cut_names)
+        self.num_microbatches = num_microbatches
+
+
+def partition_forward_ops(block, fwd_ops, cut_names, global_names,
+                          feed_names):
+    """Split a topologically-ordered op list into stages at the ops that
+    produce each cut var. Validates that every cross-stage value flows
+    through the single cut tensor (params/persistables/feeds may be read
+    anywhere) — the contract fluid's PipelineOptimizer imposes on
+    cut_list."""
+    param_names = global_names
+    cut_set = list(cut_names)
+    boundaries = []
+    for c in cut_set:
+        idx = None
+        for i, op in enumerate(fwd_ops):
+            if c in op.output_names:
+                idx = i
+        if idx is None:
+            raise ValueError(f"cut var '{c}' is not produced by any op")
+        boundaries.append((idx, c))
+    boundaries.sort()
+    segments = []
+    start = 0
+    for idx, c in boundaries:
+        segments.append((fwd_ops[start:idx + 1], c))
+        start = idx + 1
+    segments.append((fwd_ops[start:], None))
+
+    produced_before = set()
+    for si, (seg_ops, _out_cut) in enumerate(segments):
+        in_cut = segments[si - 1][1] if si > 0 else None
+        local = set()
+        for op in seg_ops:
+            for name in op.input_names:
+                if name in local or name in param_names \
+                        or name in feed_names or name == in_cut:
+                    continue
+                if name in produced_before:
+                    raise ValueError(
+                        f"stage {si} op '{op.type}' reads '{name}' from an "
+                        f"earlier stage; cut_list must separate the program "
+                        f"into a chain (only the cut tensor crosses stages)")
+            local |= set(op.output_names)
+        produced_before |= local
+    return segments
+
+
+def build_pipelined_forward(program, marker_idx, pipeline_cfg, mesh,
+                            loss_name, is_test=False, axis_name="pp"):
+    """Compile the Program's forward section into a GPipe scan schedule over
+    the mesh's pp axis. Returns fwd(params, feeds, rng) -> mean loss.
+
+    Each stage executes its op segment via the op registry (heterogeneous
+    stages supported through lax.switch); the single cut tensor rides a
+    ppermute ring. Feeds are microbatched on their leading (batch) dim.
+    Constraints (validated): all cut vars share one shape/dtype; per-mb mean
+    losses only (the fluid contract for pipelines); no persistable writes
+    inside the forward section.
+    """
+    from .. import ops as ops_registry
+
+    gb = program.global_block()
+    fwd_ops = gb.ops[:marker_idx]
+    global_names = {v.name for v in program.list_vars() if v.persistable}
+    feed_names = {v.name for v in program.list_vars()
+                  if getattr(v, "is_data", False)}
+    # Persistable writes inside the pipelined forward (e.g. batch-norm
+    # running stats) would be computed per-microbatch inside the scan and
+    # silently dropped — reject them up front.
+    for op in fwd_ops:
+        bad = [n for n in op.output_names
+               if n in global_names and n not in feed_names]
+        if bad:
+            raise NotImplementedError(
+                f"forward op '{op.type}' writes persistable var(s) {bad}; "
+                f"stateful forward ops (batch-norm stats, counters) are not "
+                f"supported inside a pipelined section — move them out or "
+                f"use use_global_stats/is_test variants")
+    # GPipe numerics: per-microbatch losses are averaged, which equals the
+    # full-batch loss only when the loss is batch-mean-normalized.
+    loss_op_types = [op.type for op in fwd_ops if loss_name in op.output_names]
+    if loss_op_types and loss_op_types[-1] not in (
+            "mean", "reduce_mean", "elementwise_div"):
+        import warnings
+        warnings.warn(
+            f"pipeline loss '{loss_name}' is produced by "
+            f"'{loss_op_types[-1]}', not a batch mean; microbatch averaging "
+            f"scales a sum-style loss by 1/num_microbatches — normalize the "
+            f"loss by batch size", RuntimeWarning, stacklevel=3)
+    segments = partition_forward_ops(gb, fwd_ops, pipeline_cfg.cut_names,
+                                     global_names, feed_names)
+    n_stages = len(segments)
+    pp = mesh.shape[axis_name]
+    if n_stages != pp:
+        raise ValueError(f"{n_stages} pipeline stages but mesh has "
+                         f"{axis_name}={pp}")
+    cuts = [c for _, c in segments if c is not None]
+    cut_vars = [gb.vars[c] for c in cuts]
+    shapes = {tuple(v.shape) for v in cut_vars}
+    if len(shapes) != 1:
+        raise ValueError(f"all cut tensors must share one shape, got "
+                         f"{sorted(shapes)} — pad the boundary activations")
+    dtypes = {str(v.dtype) for v in cut_vars}
+    if len(dtypes) != 1:
+        raise ValueError(f"all cut tensors must share one dtype, got "
+                         f"{sorted(dtypes)}")
+    cut_dtype = jnp.dtype(dtypes.pop())
+
+    m = pipeline_cfg.num_microbatches
+
+    def fwd(globals_env, feeds, rng):
+        """globals_env: params + other persistable state (replicated)."""
+        params = globals_env
+        feeds_m = {}
+        for name, v in feeds.items():
+            b = v.shape[0]
+            if b % m:
+                raise ValueError(f"batch {b} of feed '{name}' not divisible "
+                                 f"by num_microbatches={m}")
+            feeds_m[name] = v.reshape((m, b // m) + v.shape[1:])
+
+        # cut shape per microbatch: program shapes use the full batch on
+        # dim 0 — rescale it.
+        cshape = list(cut_vars[0].shape)
+        for name, v in feeds.items():
+            if cshape and cshape[0] in (-1, v.shape[0]):
+                cshape[0] = v.shape[0] // m
+                break
+        cshape = tuple(int(x) if x and x > 0 else 1 for x in cshape)
+
+        def seg_runner(si):
+            seg_ops, out_cut = segments[si]
+            in_cut = segments[si - 1][1] if si > 0 else None
+            is_last = out_cut is None
+
+            def run(genv, rng_t, x_ring, feeds_mb):
+                env = dict(genv)
+                env.update(feeds_mb)
+                env["@RNG@"] = rng_t
+                if in_cut is not None:
+                    env[in_cut] = x_ring
+                for op in seg_ops:
+                    ops_registry.run_op(op, env, program, is_test)
+                if is_last:
+                    return jnp.zeros(cshape, cut_dtype), \
+                        jnp.sum(env[loss_name])
+                return env[out_cut].astype(cut_dtype), jnp.zeros(())
+
+            return run
+
+        runners = [seg_runner(si) for si in range(n_stages)]
+
+        # params/state and rng ride in as explicit (replicated) shard_map
+        # operands — closure capture of sharded values breaks under AD
+        # inside the Manual mesh context.
+        def inner(genv, rng_in, feeds_m_local):
+            s_idx = lax.axis_index(axis_name)
+            ticks = m + pp - 1
+
+            def body(carry, t):
+                act_in = carry
+                # stage s processes microbatch t - s at tick t.
+                inject = jnp.clip(t - s_idx, 0, m - 1)
+                feeds_mb = {k: v[inject] for k, v in feeds_m_local.items()}
+                rng_t = jax.random.fold_in(rng_in, inject)
+                y_ring, y_loss = lax.switch(
+                    s_idx, runners, genv, rng_t, act_in, feeds_mb)
+                nxt = lax.ppermute(y_ring, axis_name,
+                                   [(j, (j + 1) % pp) for j in range(pp)])
+                return nxt, y_loss
+
+            z = jnp.zeros(cshape, cut_dtype)
+            _, losses = lax.scan(body, z, jnp.arange(ticks))
+            # stage pp-1 emits mb k's loss at tick k + pp - 1
+            mine = lax.dynamic_slice_in_dim(losses, pp - 1, m, axis=0)
+            total = lax.psum(jnp.where(s_idx == pp - 1, jnp.sum(mine), 0.0),
+                             axis_name)
+            return total / m
+
+        fn = shard_map(inner, mesh=mesh, in_specs=(P(), P(), P()),
+                       out_specs=P(), check_rep=False)
+        return fn(params, rng, feeds_m)
+
+    return fwd
+
+
 class PipelineOptimizer:
-    """Parity: fluid.optimizer.PipelineOptimizer — wraps an optimizer and
-    carries the microbatch/section config; the TPU execution path is
-    pipeline_apply (SPMD scan), not device-queue workers."""
+    """Parity: fluid.optimizer.PipelineOptimizer
+    (python/paddle/fluid/optimizer.py PipelineOptimizer). The reference
+    rewrites the Program into device-queue section workers; here minimize()
+    partitions the forward at `cut_list` and attaches a PipelineConfig that
+    the Executor lowers to the SPMD scan schedule over the mesh's 'pp' axis
+    (run via CompiledProgram.with_mesh(make_mesh(pp=...)))."""
 
     def __init__(self, optimizer, cut_list=None, place_list=None,
                  concurrency_list=None, queue_size=30, sync_steps=1,
                  start_cpu_core_id=0, num_microbatches=None):
         self._optimizer = optimizer
         self.cut_list = cut_list
-        self.num_microbatches = num_microbatches or queue_size
+        self.num_microbatches = num_microbatches or 4
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
-        return self._optimizer.minimize(loss, startup_program,
-                                        parameter_list, no_grad_set)
+        ret = self._optimizer.minimize(loss, startup_program,
+                                       parameter_list, no_grad_set)
+        if self.cut_list:
+            cut_names = []
+            for c in self.cut_list:
+                # fluid's cut_list nests: [[var], [var2]]; accept flat too.
+                items = c if isinstance(c, (list, tuple)) else [c]
+                for it in items:
+                    cut_names.append(it if isinstance(it, str) else it.name)
+            prog = loss.block.program
+            prog._pipeline = PipelineConfig(cut_names, self.num_microbatches)
+        return ret

@@ -33,15 +33,24 @@ class ShardRules:
     """Ordered (regex, PartitionSpec) rules applied to param names."""
 
     DEFAULT = [
-        (r".*(word_embedding|embedding|emb).*w.*", P("tp", None)),
-        (r".*(qkv|query_key_value|q_proj|k_proj|v_proj|query|key|value).*w.*",
-         P(None, "tp")),
-        (r".*(out_proj|output|attn_out|proj_out).*w.*", P("tp", None)),
-        (r".*(ffn1|fc1|mlp_up|h_to_4h|inner).*w.*", P(None, "tp")),
-        (r".*(ffn2|fc2|mlp_down|4h_to_h).*w.*", P("tp", None)),
-        (r".*(qkv|query|key|value|ffn1|fc1|mlp_up).*b.*", P("tp")),
+        # norms / biases / position & sentence tables: replicated.
         (r".*norm.*", P()),
-        (r".*\.b.*", P()),
+        (r".*(pos_embedding|sent_embedding).*", P()),
+        (r".*(_b|\.b_).*", P()),
+        # embeddings: shard the vocab dim.
+        (r".*(word_embedding|embedding|emb_table).*", P("tp", None)),
+        # attention q/k/v projections (models/bert.py enc{i}_attn_{q,k,v}):
+        # column-parallel — heads split over tp.
+        (r".*(qkv|query_key_value).*", P(None, "tp")),
+        (r".*attn_(q|k|v)($|_w.*)", P(None, "tp")),
+        (r".*(q_proj|k_proj|v_proj|query|key|value).*w.*", P(None, "tp")),
+        # attention output projection: row-parallel (one psum after).
+        (r".*attn_o($|ut.*|_w.*)", P("tp", None)),
+        (r".*(out_proj|proj_out).*w.*", P("tp", None)),
+        # mlp up (d -> 4d, models/bert.py enc{i}_ffn0_w): column-parallel.
+        (r".*(ffn0|fc1|mlp_up|h_to_4h|inner).*w.*", P(None, "tp")),
+        # mlp down (4d -> d, enc{i}_ffn1_w): row-parallel.
+        (r".*(ffn1|ffn2|fc2|mlp_down|4h_to_h).*w.*", P("tp", None)),
     ]
 
     def __init__(self, rules=None, default=P()):
@@ -53,12 +62,28 @@ class ShardRules:
             if re.match(pat, name):
                 if shape is not None and not _spec_fits(spec, shape):
                     continue
-                return spec
+                return _orient(spec, shape)
         return self.default
 
 
 def _spec_fits(spec, shape):
     return len([s for s in spec if s is not None]) <= len(shape)
+
+
+def _orient(spec, shape):
+    """For rectangular 2-D weights matched by a single-'tp' rule, orient by
+    the actual in/out dims: a fan-out (d_in < d_out, e.g. mlp up d->4d)
+    weight is column-parallel, a fan-in weight row-parallel. Naming
+    conventions for the first/second mlp matmul differ across zoos (ffn0/
+    ffn1 vs ffn1/ffn2) — the shape is unambiguous. Square weights keep the
+    rule's orientation."""
+    if shape is None or len(shape) != 2 or tuple(spec) not in (
+            (None, "tp"), ("tp", None), ("tp",)):
+        return spec
+    d0, d1 = shape
+    if not d0 or not d1 or d0 in (-1,) or d1 in (-1,) or d0 == d1:
+        return spec
+    return P(None, "tp") if d1 > d0 else P("tp", None)
 
 
 def shard_params_spec(param_names_shapes, rules=None):

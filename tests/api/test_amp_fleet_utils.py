@@ -78,8 +78,9 @@ def test_model_stat_counts():
     n, per_param = model_stat.count_params(main)
     assert n == 8 * 16 + 16 + 16 * 1 + 1
     assert per_param["fc_0.w_0"] == 128
-    flops = model_stat.count_flops(main, batch_size=4)
+    flops, per_op = model_stat.count_flops(main, batch_size=4)
     assert flops >= 2 * 4 * (8 * 16 + 16)
+    assert per_op.get("mul", 0) > 0
 
 
 def test_nan_check_guard_and_debugger():

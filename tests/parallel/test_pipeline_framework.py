@@ -1,0 +1,131 @@
+"""Pipeline parallelism through the framework path (VERDICT r1 weak #5).
+
+- PipelineOptimizer with a cut_list on a 2-stage split over the pp mesh axis
+  must match the sequential Executor's numerics.
+- pipeline_1f1b (functional 1F1B schedule) must match plain grads.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core import framework
+from paddle_tpu.core.executor import Scope, scope_guard
+from paddle_tpu.parallel.mesh import make_mesh
+from paddle_tpu.parallel import pipeline as pp_mod
+
+
+def _mlp_program(din=8, dh=16, dout=4):
+    """2-stage MLP: stage 0 = fc1+tanh (cut at 'cut0'), stage 1 = fc2+loss.
+    Mean loss so microbatching preserves numerics."""
+    x = layers.data("x", shape=[din], dtype="float32")
+    label = layers.data("label", shape=[dout], dtype="float32")
+    h = layers.fc(x, size=dh, act="tanh",
+                  param_attr=fluid.ParamAttr(name="pipe_fc1_w"))
+    cut = layers.assign(h)  # named boundary tensor
+    y = layers.fc(cut, size=dout,
+                  param_attr=fluid.ParamAttr(name="pipe_fc2_w"))
+    loss = layers.mean(layers.square_error_cost(y, label))
+    return x, label, cut, loss
+
+
+def _feed(batch=8, din=8, dout=4, seed=0):
+    rs = np.random.RandomState(seed)
+    return {"x": rs.randn(batch, din).astype(np.float32),
+            "label": rs.randn(batch, dout).astype(np.float32)}
+
+
+def test_pipeline_optimizer_matches_sequential():
+    feed = _feed(batch=8)
+
+    def run(pipelined):
+        main, startup = framework.Program(), framework.Program()
+        with framework.program_guard(main, startup):
+            x, label, cut, loss = _mlp_program()
+            sgd = fluid.optimizer.SGDOptimizer(learning_rate=0.1)
+            if pipelined:
+                opt = pp_mod.PipelineOptimizer(sgd, cut_list=[[cut]],
+                                               num_microbatches=4)
+                opt.minimize(loss)
+            else:
+                sgd.minimize(loss)
+        scope = Scope()
+        losses = []
+        with scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            prog = main
+            if pipelined:
+                mesh = make_mesh(pp=2, devices=jax.devices()[:2])
+                prog = fluid.CompiledProgram(main).with_mesh(mesh)
+            for _ in range(3):
+                out, = exe.run(prog, feed=feed, fetch_list=[loss])
+                losses.append(float(np.asarray(out).reshape(-1)[0]))
+            w = np.asarray(scope.get("pipe_fc1_w"))
+        return losses, w
+
+    seq_losses, seq_w = run(False)
+    pipe_losses, pipe_w = run(True)
+    np.testing.assert_allclose(seq_losses, pipe_losses, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(seq_w, pipe_w, rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_optimizer_bad_cut_raises():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = layers.data("x", shape=[8], dtype="float32")
+        label = layers.data("label", shape=[4], dtype="float32")
+        h = layers.fc(x, size=16, act="tanh")
+        # h is used AFTER the cut tensor as well -> not a chain
+        cut = layers.assign(h)
+        y = layers.fc(layers.elementwise_add(cut, h), size=4)
+        loss = layers.mean(layers.square_error_cost(y, label))
+        opt = pp_mod.PipelineOptimizer(
+            fluid.optimizer.SGDOptimizer(learning_rate=0.1),
+            cut_list=[[cut]], num_microbatches=2)
+        opt.minimize(loss)
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        mesh = make_mesh(pp=2, devices=jax.devices()[:2])
+        prog = fluid.CompiledProgram(main).with_mesh(mesh)
+        with pytest.raises(ValueError, match="chain|separate"):
+            exe.run(prog, feed=_feed(batch=4), fetch_list=[loss])
+
+
+def test_pipeline_1f1b_matches_plain_grads():
+    """1F1B schedule over 4 stages == direct grads of the stacked forward."""
+    S, M, mb, d = 4, 8, 2, 8
+    mesh = make_mesh(pp=S, devices=jax.devices()[:S])
+    ws = jax.random.normal(jax.random.PRNGKey(0), (S, d, d)) * 0.5
+    xm = jax.random.normal(jax.random.PRNGKey(1), (M, mb, d))
+    aux = jax.random.normal(jax.random.PRNGKey(2), (M, mb, d))
+
+    def stage_fn(w, x):
+        return jnp.tanh(x @ w)
+
+    def loss_fn(y, a):
+        return jnp.mean((y - a) ** 2)
+
+    loss, grads = jax.jit(lambda ws_: pp_mod.pipeline_1f1b(
+        stage_fn, loss_fn, ws_, xm, aux, mesh))(ws)
+
+    def ref(ws_):
+        total = 0.0
+        for k in range(M):
+            h = xm[k]
+            for s in range(S):
+                h = stage_fn(ws_[s], h)
+            total = total + loss_fn(h, aux[k])
+        return total / M
+
+    ref_loss = ref(ws)
+    ref_grads = jax.grad(ref)(ws)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads), np.asarray(ref_grads),
+                               rtol=1e-4, atol=1e-5)
