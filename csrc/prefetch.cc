@@ -36,12 +36,26 @@ struct Ring {
   size_t tail = 0;      // next push index
   size_t count = 0;     // filled slots
   bool closed = false;
+  int waiters = 0;      // threads currently inside push/peek/pop
   std::mutex mu;
   std::condition_variable not_full;
   std::condition_variable not_empty;
+  std::condition_variable no_waiters;
 
   explicit Ring(size_t n, size_t reserve_bytes) : slots(n) {
     for (auto& s : slots) s.data.reserve(reserve_bytes);
+  }
+};
+
+// RAII waiter census: destroy() blocks until every thread already inside a
+// blocking call has left, closing the use-after-free window where a
+// producer blocked in push wakes after the ring is freed. Must be
+// constructed/destructed while the ring mutex is held.
+struct WaiterGuard {
+  Ring* r;
+  explicit WaiterGuard(Ring* r_) : r(r_) { ++r->waiters; }
+  ~WaiterGuard() {
+    if (--r->waiters == 0) r->no_waiters.notify_all();
   }
 };
 
@@ -55,12 +69,26 @@ void* pt_ring_create(size_t nslots, size_t slot_bytes) {
   return new Ring(nslots, slot_bytes);
 }
 
-void pt_ring_destroy(void* r) { delete static_cast<Ring*>(r); }
+// Blocks until no thread is inside push/peek/pop (they are woken by the
+// close), then frees. Calls STARTED after destroy begins are still caller
+// misuse; this guards the threads already blocked inside.
+void pt_ring_destroy(void* rp) {
+  Ring* r = static_cast<Ring*>(rp);
+  {
+    std::unique_lock<std::mutex> lk(r->mu);
+    r->closed = true;
+    r->not_full.notify_all();
+    r->not_empty.notify_all();
+    r->no_waiters.wait(lk, [&] { return r->waiters == 0; });
+  }
+  delete r;
+}
 
 // Blocking push. Returns 0 on success, -1 if the ring is closed.
 int pt_ring_push(void* rp, const void* data, size_t len) {
   Ring* r = static_cast<Ring*>(rp);
   std::unique_lock<std::mutex> lk(r->mu);
+  WaiterGuard wg(r);
   r->not_full.wait(lk, [&] { return r->count < r->slots.size() || r->closed; });
   if (r->closed) return -1;
   Slot& s = r->slots[r->tail];
@@ -79,6 +107,7 @@ int pt_ring_push(void* rp, const void* data, size_t len) {
 int64_t pt_ring_peek_len(void* rp) {
   Ring* r = static_cast<Ring*>(rp);
   std::unique_lock<std::mutex> lk(r->mu);
+  WaiterGuard wg(r);
   r->not_empty.wait(lk, [&] { return r->count > 0 || r->closed; });
   if (r->count == 0) return -1;  // closed + drained
   return static_cast<int64_t>(r->slots[r->head].len);
@@ -89,6 +118,7 @@ int64_t pt_ring_peek_len(void* rp) {
 int64_t pt_ring_pop(void* rp, void* out, size_t cap) {
   Ring* r = static_cast<Ring*>(rp);
   std::unique_lock<std::mutex> lk(r->mu);
+  WaiterGuard wg(r);
   r->not_empty.wait(lk, [&] { return r->count > 0 || r->closed; });
   if (r->count == 0) return -1;
   Slot& s = r->slots[r->head];

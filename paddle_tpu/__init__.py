@@ -25,6 +25,7 @@ from .core.data_feeder import DataFeeder
 from .core.compiler import (CompiledProgram, ParallelExecutor, BuildStrategy,
                             ExecutionStrategy)
 from . import layers
+from . import nets
 from .layers.io import data  # fluid.data-style (but with batch dim implicit off)
 from . import optimizer
 from .optimizer import clip

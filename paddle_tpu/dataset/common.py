@@ -7,9 +7,23 @@ recipes, tests and benchmarks run unchanged; accuracy targets are checked on
 learnable synthetic structure (labels correlated with inputs), not noise.
 """
 
+import os
+
 import numpy as np
 
-DATA_HOME = "/tmp/paddle_tpu_dataset"
+DATA_HOME = os.environ.get("PADDLE_TPU_DATA_HOME", "/tmp/paddle_tpu_dataset")
+
+
+def data_file(*names):
+    """First existing real dataset file under DATA_HOME (or an absolute
+    candidate), else None — decoders parse the real format when the user
+    has dropped the original files in, and fall back to synthetic
+    otherwise (zero-egress environment)."""
+    for name in names:
+        path = name if os.path.isabs(name) else os.path.join(DATA_HOME, name)
+        if os.path.exists(path):
+            return path
+    return None
 
 
 def _rng(seed):

@@ -3,4 +3,6 @@ from .state import (save_vars, save_params, save_persistables, load_vars,
                     is_persistable, get_parameter_value,
                     get_parameter_value_by_name)
 from .inference_io import save_inference_model, load_inference_model
-from .checkpoint import save_checkpoint, load_checkpoint
+from .checkpoint import (save_checkpoint, load_checkpoint,
+                         save_checkpoint_async, save_checkpoint_sharded,
+                         load_checkpoint_sharded, CheckpointHandle)

@@ -56,6 +56,7 @@ from .detection import (prior_box, density_prior_box, box_coder,
                         sigmoid_focal_loss, distribute_fpn_proposals,
                         collect_fpn_proposals)
 from .nn import topk as top_k  # fluid exposes both spellings
+from . import distributions
 from .math_op_patch import monkey_patch_variable
 
 monkey_patch_variable()

@@ -1,0 +1,214 @@
+"""Probability distributions.
+
+Parity: python/paddle/fluid/layers/distributions.py (Uniform:113, Normal:246,
+Categorical:401, MultivariateNormalDiag:494) — same math, same API
+(sample/entropy/log_prob/kl_divergence), built from paddle_tpu layers so the
+graphs work in both static programs and dygraph. TPU notes: samples come from
+the framework's seeded RNG ops (static shapes; no host sync), and the
+clamped-uniform log_prob keeps the reference's log(0) = -inf behavior for
+out-of-support values.
+"""
+
+import math
+
+import numpy as np
+
+from ..core.framework import Variable
+
+__all__ = ["Uniform", "Normal", "Categorical", "MultivariateNormalDiag"]
+
+
+def _to_var(value, dtype="float32"):
+    from . import tensor as tensor_layers
+    if isinstance(value, Variable):
+        return value
+    arr = np.asarray(value, dtype=dtype)
+    if arr.ndim == 0:
+        arr = arr.reshape(1)
+    return tensor_layers.assign(arr)
+
+
+class Distribution:
+    """Abstract base (parity: layers/distributions.py:28)."""
+
+    def sample(self, shape, seed=0):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+    @staticmethod
+    def _is_float_like(*args):
+        return all(isinstance(a, (float, int)) for a in args)
+
+
+class Uniform(Distribution):
+    """U(low, high); see reference layers/distributions.py:113."""
+
+    def __init__(self, low, high):
+        self.all_arg_is_float = self._is_float_like(low, high)
+        self.low = _to_var(low)
+        self.high = _to_var(high)
+
+    def sample(self, shape, seed=0):
+        from . import nn as nn_layers, ops as ops_layers
+        batch_shape = list((self.low + self.high).shape)
+        output_shape = list(shape) + batch_shape
+        u = ops_layers.uniform_random(output_shape, min=0.0, max=1.0,
+                                      seed=seed)
+        out = u * (self.high - self.low) + self.low
+        if self.all_arg_is_float:
+            return nn_layers.reshape(out, list(shape))
+        return out
+
+    def log_prob(self, value):
+        from . import nn as nn_layers, ops as ops_layers
+        from . import control_flow
+        lb = nn_layers.cast(control_flow.less_than(self.low, value),
+                            value.dtype)
+        ub = nn_layers.cast(control_flow.less_than(value, self.high),
+                            value.dtype)
+        return ops_layers.log(lb * ub) - ops_layers.log(self.high - self.low)
+
+    def entropy(self):
+        from . import ops as ops_layers
+        return ops_layers.log(self.high - self.low)
+
+
+class Normal(Distribution):
+    """N(loc, scale); see reference layers/distributions.py:246."""
+
+    def __init__(self, loc, scale):
+        self.all_arg_is_float = self._is_float_like(loc, scale)
+        self.loc = _to_var(loc)
+        self.scale = _to_var(scale)
+
+    def sample(self, shape, seed=0):
+        from . import nn as nn_layers, ops as ops_layers
+        batch_shape = list((self.loc + self.scale).shape)
+        output_shape = list(shape) + batch_shape
+        z = ops_layers.gaussian_random(output_shape, mean=0.0, std=1.0,
+                                       seed=seed)
+        out = z * self.scale + self.loc
+        if self.all_arg_is_float:
+            return nn_layers.reshape(out, list(shape))
+        return out
+
+    def entropy(self):
+        from . import ops as ops_layers
+        return 0.5 + 0.5 * math.log(2 * math.pi) + ops_layers.log(self.scale)
+
+    def log_prob(self, value):
+        from . import ops as ops_layers
+        var = self.scale * self.scale
+        log_scale = ops_layers.log(self.scale)
+        return (value - self.loc) * (value - self.loc) / (var * -2.0) \
+            - log_scale - math.log(math.sqrt(2.0 * math.pi))
+
+    def kl_divergence(self, other):
+        from . import ops as ops_layers
+        assert isinstance(other, Normal), \
+            "another distribution must be Normal"
+        var_ratio = self.scale / other.scale
+        var_ratio = var_ratio * var_ratio
+        t1 = (self.loc - other.loc) / other.scale
+        t1 = t1 * t1
+        return (var_ratio + t1 - 1.0 - ops_layers.log(var_ratio)) * 0.5
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalized logits; reference
+    layers/distributions.py:401 (fluid 1.5 exposes entropy + kl only)."""
+
+    def __init__(self, logits):
+        self.logits = _to_var(logits)
+
+    def _norm(self, logits):
+        from . import nn as nn_layers, ops as ops_layers
+        shifted = logits - nn_layers.reduce_max(logits, dim=-1, keep_dim=True)
+        e = ops_layers.exp(shifted)
+        z = nn_layers.reduce_sum(e, dim=-1, keep_dim=True)
+        return shifted, e, z
+
+    def entropy(self):
+        from . import nn as nn_layers, ops as ops_layers
+        logits, e, z = self._norm(self.logits)
+        prob = e / z
+        return nn_layers.reduce_sum(prob * (logits - ops_layers.log(z)),
+                                    dim=-1, keep_dim=True) * -1.0
+
+    def kl_divergence(self, other):
+        from . import nn as nn_layers, ops as ops_layers
+        assert isinstance(other, Categorical)
+        logits, e, z = self._norm(self.logits)
+        o_logits, o_e, o_z = other._norm(other.logits)
+        prob = e / z
+        return nn_layers.reduce_sum(
+            prob * (logits - ops_layers.log(z) - o_logits
+                    + ops_layers.log(o_z)), dim=-1, keep_dim=True)
+
+    def sample(self, shape, seed=0):
+        """TPU extension (the reference left Categorical.sample
+        unimplemented): Gumbel-max over the last axis."""
+        from . import nn as nn_layers, ops as ops_layers
+        logits = self.logits
+        out_shape = list(shape) + list(logits.shape[:-1]) + [logits.shape[-1]]
+        u = ops_layers.uniform_random(out_shape, min=1e-6, max=1.0 - 1e-6,
+                                      seed=seed)
+        g = ops_layers.log(ops_layers.log(u) * -1.0) * -1.0
+        return nn_layers.argmax(logits + g, axis=-1)
+
+    def log_prob(self, value):
+        """TPU extension: log p(value) for int class indices."""
+        from . import nn as nn_layers, ops as ops_layers
+        logits, e, z = self._norm(self.logits)
+        logp = logits - ops_layers.log(z)
+        oh = nn_layers.one_hot(value, depth=int(self.logits.shape[-1]))
+        return nn_layers.reduce_sum(logp * oh, dim=-1)
+
+
+class MultivariateNormalDiag(Distribution):
+    """MVN with diagonal covariance given as a (k, k) diagonal matrix;
+    reference layers/distributions.py:494 (entropy + kl)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _to_var(loc)
+        self.scale = _to_var(scale)
+
+    def _det(self, value):
+        from . import nn as nn_layers, tensor as tensor_layers
+        shape = list(value.shape)
+        ones_all = tensor_layers.ones(shape, "float32")
+        eye = tensor_layers.diag(tensor_layers.ones([shape[0]], "float32"))
+        return nn_layers.reduce_prod(value + ones_all - eye)
+
+    def _inv(self, value):
+        from . import nn as nn_layers, tensor as tensor_layers
+        shape = list(value.shape)
+        ones_all = tensor_layers.ones(shape, "float32")
+        eye = tensor_layers.diag(tensor_layers.ones([shape[0]], "float32"))
+        return nn_layers.elementwise_pow(value, ones_all - eye * 2.0)
+
+    def entropy(self):
+        from . import ops as ops_layers
+        k = int(self.scale.shape[0])
+        return (ops_layers.log(self._det(self.scale))
+                + k * (1.0 + math.log(2 * math.pi))) * 0.5
+
+    def kl_divergence(self, other):
+        from . import nn as nn_layers, ops as ops_layers
+        assert isinstance(other, MultivariateNormalDiag)
+        tr = nn_layers.reduce_sum(self._inv(other.scale) * self.scale)
+        diff = other.loc - self.loc
+        quad = nn_layers.matmul(
+            nn_layers.matmul(diff, self._inv(other.scale)), diff)
+        k = int(self.scale.shape[0])
+        ln_cov = ops_layers.log(self._det(other.scale)) \
+            - ops_layers.log(self._det(self.scale))
+        return (tr + quad - float(k) + ln_cov) * 0.5

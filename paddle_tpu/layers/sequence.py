@@ -50,7 +50,12 @@ def _seq_op(op_type, x, length, extra_inputs=None, attrs=None, n_outs=1,
 def _full_length(helper, x):
     """Default lengths = max_len for every row (un-ragged batch)."""
     from . import tensor as tensor_layers
-    return tensor_layers.fill_constant((x.shape[0],), "int32", x.shape[1])
+    b = x.shape[0] if x.shape else -1
+    if isinstance(b, int) and b > 0:
+        return tensor_layers.fill_constant((b,), "int32", x.shape[1])
+    # dynamic batch (-1): take the runtime batch size from x itself
+    return tensor_layers.fill_constant_batch_size_like(
+        x, [-1], "int32", x.shape[1])
 
 
 def sequence_pool(input, pool_type, length=None, is_test=False, pad_value=0.0):

@@ -1,0 +1,84 @@
+"""Composite network helpers.
+
+Parity: python/paddle/fluid/nets.py — simple_img_conv_pool (:28),
+img_conv_group (:136), sequence_conv_pool (:249), glu (:307). Each is a
+composition of paddle_tpu layers (XLA fuses the chains; conv+pool ride the
+MXU), same signatures and defaults as the reference; cudnn knobs are
+accepted and ignored. scaled_dot_product_attention lives in
+layers/attention.py (flash-kernel path).
+"""
+
+from . import layers
+
+__all__ = ["simple_img_conv_pool", "img_conv_group", "sequence_conv_pool",
+           "glu"]
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type="max",
+                         global_pooling=False, conv_stride=1, conv_padding=0,
+                         conv_dilation=1, conv_groups=1, param_attr=None,
+                         bias_attr=None, act=None, use_cudnn=True):
+    """Conv2d -> Pool2d (ref nets.py:28)."""
+    conv_out = layers.conv2d(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        stride=conv_stride, padding=conv_padding, dilation=conv_dilation,
+        groups=conv_groups, param_attr=param_attr, bias_attr=bias_attr,
+        act=act)
+    return layers.pool2d(
+        input=conv_out, pool_size=pool_size, pool_type=pool_type,
+        pool_stride=pool_stride, pool_padding=pool_padding,
+        global_pooling=global_pooling)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max", use_cudnn=True):
+    """[Conv2d (+BatchNorm) (+Dropout)]*N -> Pool2d (ref nets.py:136) —
+    the VGG building block."""
+    assert isinstance(conv_num_filter, (list, tuple))
+
+    def _extend(obj):
+        if not hasattr(obj, "__len__"):
+            return [obj] * len(conv_num_filter)
+        assert len(obj) == len(conv_num_filter)
+        return list(obj)
+
+    conv_padding = _extend(conv_padding)
+    conv_filter_size = _extend(conv_filter_size)
+    param_attr = _extend(param_attr)
+    conv_with_batchnorm = _extend(conv_with_batchnorm)
+    conv_batchnorm_drop_rate = _extend(conv_batchnorm_drop_rate)
+
+    tmp = input
+    for i in range(len(conv_num_filter)):
+        local_conv_act = None if conv_with_batchnorm[i] else conv_act
+        tmp = layers.conv2d(
+            input=tmp, num_filters=conv_num_filter[i],
+            filter_size=conv_filter_size[i], padding=conv_padding[i],
+            param_attr=param_attr[i], act=local_conv_act)
+        if conv_with_batchnorm[i]:
+            tmp = layers.batch_norm(input=tmp, act=conv_act)
+            drop_rate = conv_batchnorm_drop_rate[i]
+            if abs(drop_rate) > 1e-5:
+                tmp = layers.dropout(tmp, drop_rate)
+
+    return layers.pool2d(input=tmp, pool_size=pool_size,
+                         pool_type=pool_type, pool_stride=pool_stride)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max", bias_attr=None):
+    """sequence_conv -> sequence_pool (ref nets.py:249) — the text-CNN
+    block (mask-based sequence ops, SURVEY.md decision 4)."""
+    conv_out = layers.sequence_conv(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        param_attr=param_attr, bias_attr=bias_attr, act=act)
+    return layers.sequence_pool(input=conv_out, pool_type=pool_type)
+
+
+def glu(input, dim=-1):
+    """Gated Linear Unit: split -> a * sigmoid(b) (ref nets.py:307)."""
+    a, b = layers.split(input, num_or_sections=2, dim=dim)
+    return layers.elementwise_mul(a, layers.sigmoid(b))

@@ -396,18 +396,25 @@ def anchor_generator(ctx):
     offset = ctx.attr("offset", 0.5)
     variances = ctx.attr("variances", [0.1, 0.1, 0.2, 0.2])
     h, w = feat.shape[2], feat.shape[3]
-    cx = (jnp.arange(w) + offset) * stride[0]
-    cy = (jnp.arange(h) + offset) * stride[1]
+    # Reference kernel semantics (anchor_generator_op.h): centers at
+    # i*stride + offset*(stride-1); per-anchor w/h from the rounded
+    # stride-area base (area/ar, ar = h/w) scaled by size/stride; corners
+    # pixel-inclusive (+/- 0.5*(wh-1)); anchor channel order iterates
+    # RATIOS outer, SIZES inner.
+    import math
+    sw, sh = float(stride[0]), float(stride[1])
+    cx = jnp.arange(w) * sw + offset * (sw - 1.0)
+    cy = jnp.arange(h) * sh + offset * (sh - 1.0)
     whs = []
-    for s in sizes:
-        for r in ratios:
-            aw = s * (r ** 0.5)
-            ah = s / (r ** 0.5)
-            whs.append((aw, ah))
-    whs = jnp.asarray(whs)             # (A, 2)
+    for r in ratios:
+        base_w = round(math.sqrt(sw * sh / r))
+        base_h = round(base_w * r)
+        for s in sizes:
+            whs.append((s / sw * base_w, s / sh * base_h))
+    whs = jnp.asarray(whs)             # (A, 2) ratios-outer/sizes-inner
     gx, gy = jnp.meshgrid(cx, cy)      # (H, W)
     centers = jnp.stack([gx, gy], -1)[:, :, None, :]          # (H, W, 1, 2)
-    half = whs[None, None] / 2                                 # (1, 1, A, 2)
+    half = (whs[None, None] - 1.0) / 2                         # (1, 1, A, 2)
     boxes = jnp.concatenate([centers - half, centers + half], -1)
     var = jnp.broadcast_to(jnp.asarray(variances), boxes.shape)
     return {"Anchors": boxes, "Variances": var}
@@ -515,6 +522,11 @@ def yolov3_loss(ctx):
     num_classes = ctx.attr("class_num")
     ignore_thresh = ctx.attr("ignore_thresh", 0.7)
     downsample = ctx.attr("downsample_ratio", 32)
+    if ctx.in_("GTScore") is not None or ctx.attr("use_label_smooth", False):
+        import warnings
+        warnings.warn(
+            "yolov3_loss: gt_score / use_label_smooth are not supported "
+            "and will be ignored", RuntimeWarning, stacklevel=2)
     n, _, h, w = x.shape
     na = len(mask)
     all_anchors = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
@@ -574,12 +586,41 @@ def yolov3_loss(ctx):
     obj_t = jax.vmap(lambda o, i, r: o.at[jnp.where(r, i, 0)].max(
         jnp.where(r, 1.0, 0.0)))(obj_t, idx, resp)
     obj_t = obj_t.reshape(n, na, h, w)
-    # ignore: predicted boxes with high IoU vs any gt (approx: the target
-    # cell neighbourhood) — simplified to responsible-cell mask like many
-    # reimplementations; BCE elsewhere.
+    # ignore threshold (reference semantics): decode every predicted box
+    # and exclude non-responsible predictions whose best IoU against any
+    # gt exceeds ignore_thresh from the objectness BCE.
+    gx = jnp.arange(w, dtype=x.dtype)
+    gy = jnp.arange(h, dtype=x.dtype)
+    bx_p = (px + gx[None, None, None, :]) / w                 # (N,na,H,W)
+    by_p = (py + gy[None, None, :, None]) / h
+    bw_p = jnp.exp(jnp.clip(pw, -10, 10)) * anc[None, :, 0, None, None] / in_w
+    bh_p = jnp.exp(jnp.clip(ph, -10, 10)) * anc[None, :, 1, None, None] / in_h
+    pred = jnp.stack([bx_p, by_p, bw_p, bh_p], -1).reshape(n, -1, 4)
+
+    def _cxcywh_iou(p, g):
+        # p: (M,4), g: (G,4) normalized cx,cy,w,h
+        px1, py1 = p[:, 0] - p[:, 2] / 2, p[:, 1] - p[:, 3] / 2
+        px2, py2 = p[:, 0] + p[:, 2] / 2, p[:, 1] + p[:, 3] / 2
+        gx1, gy1 = g[:, 0] - g[:, 2] / 2, g[:, 1] - g[:, 3] / 2
+        gx2, gy2 = g[:, 0] + g[:, 2] / 2, g[:, 1] + g[:, 3] / 2
+        ix = jnp.maximum(jnp.minimum(px2[:, None], gx2[None]) -
+                         jnp.maximum(px1[:, None], gx1[None]), 0)
+        iy = jnp.maximum(jnp.minimum(py2[:, None], gy2[None]) -
+                         jnp.maximum(py1[:, None], gy1[None]), 0)
+        inter = ix * iy
+        ap = jnp.maximum(p[:, 2] * p[:, 3], 0)
+        ag = jnp.maximum(g[:, 2] * g[:, 3], 0)
+        return inter / jnp.maximum(ap[:, None] + ag[None] - inter, 1e-10)
+
+    iou_pg = jax.vmap(_cxcywh_iou)(pred, gt_box)              # (N,M,G)
+    iou_pg = jnp.where(valid[:, None, :], iou_pg, 0.0)
+    best_iou = iou_pg.max(-1).reshape(n, na, h, w)
+    obj_t_flat = obj_t
+    ignore = (best_iou > ignore_thresh) & (obj_t_flat < 0.5)
     pobj_f = pobj
     bce_obj = jnp.maximum(pobj_f, 0) - pobj_f * obj_t + \
         jnp.log1p(jnp.exp(-jnp.abs(pobj_f)))
+    bce_obj = jnp.where(ignore, 0.0, bce_obj)
     obj_loss = bce_obj.reshape(n, -1).sum(-1)
 
     tcls = jax.nn.one_hot(gt_label, num_classes)

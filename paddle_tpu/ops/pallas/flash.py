@@ -257,12 +257,17 @@ def _dkv_kernel(*refs, scale, causal, block_q, q_len, kv_len,
     dv_ref[0] = dv_acc.astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, bias, lse, out, do, scale, causal, block_q, block_k):
+def _flash_bwd(q, k, v, bias, lse, out, do, scale, causal, block_q, block_k,
+               dlse=None):
     b, h, tq, d = q.shape
     tk = k.shape[2]
     bq = min(block_q, max(tq, 1))
     bk = min(block_k, max(tk, 1))
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        # lse cotangent: d lse / d s = softmax = p, so it enters every
+        # kernel exactly as ds = p*(dp - (delta - dlse)).
+        delta = delta - dlse.astype(jnp.float32)
 
     q_p = _pad_to(q, 2, bq).reshape(b * h, -1, d)
     do_p = _pad_to(do, 2, bq).reshape(b * h, -1, d)
@@ -383,22 +388,25 @@ def _dbias_xla(q, k, v, bias, lse, do, delta, scale, causal):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def _flash(q, k, v, bias, scale, causal, block_q, block_k):
-    out, _ = _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k)
-    return out
+    """Differentiable (out, lse). The lse output is what makes the ring-
+    attention online combine differentiable: its cotangent folds into the
+    backward's delta term (ds = p*(dp - delta + dlse))."""
+    return _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k)
 
 
 def _flash_vjp_fwd(q, k, v, bias, scale, causal, block_q, block_k):
     out, lse = _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k)
-    return out, (q, k, v, bias, lse, out)
+    return (out, lse), (q, k, v, bias, lse, out)
 
 
 def _flash_vjp_bwd(scale, causal, block_q, block_k, res, g):
     q, k, v, bias, lse, out = res
-    dq, dk, dv, delta = _flash_bwd(q, k, v, bias, lse, out, g, scale, causal,
-                                   block_q, block_k)
+    do, dlse = g
+    dq, dk, dv, delta = _flash_bwd(q, k, v, bias, lse, out, do, scale,
+                                   causal, block_q, block_k, dlse=dlse)
     if bias is None:
         return dq, dk, dv, None
-    db = _dbias_xla(q, k, v, bias, lse, g, delta, scale, causal)
+    db = _dbias_xla(q, k, v, bias, lse, do, delta, scale, causal)
     return dq, dk, dv, db
 
 
@@ -436,6 +444,16 @@ def flash_attention(q, k, v, bias=None, scale=None, causal=False,
                     block_q=128, block_k=128):
     """Fused blockwise attention. q/k/v: (B, H, T, D); bias broadcastable to
     (B, H, Tq, Tk) is applied inside the kernel (additive, pre-softmax)."""
+    return flash_attention_with_lse(q, k, v, bias=bias, scale=scale,
+                                    causal=causal, block_q=block_q,
+                                    block_k=block_k)[0]
+
+
+def flash_attention_with_lse(q, k, v, bias=None, scale=None, causal=False,
+                             block_q=128, block_k=128):
+    """Variant returning (out, logsumexp (B,H,Tq) fp32) — the building block
+    for ring attention's cross-device online combine. Fully differentiable
+    (the lse cotangent rides the same Pallas backward kernels)."""
     global TRACE_COUNT
     TRACE_COUNT += 1
     d = q.shape[-1]
@@ -445,16 +463,3 @@ def flash_attention(q, k, v, bias=None, scale=None, causal=False,
                                k.shape[2])
     return _flash(q, k, v, bias, scale, bool(causal), int(block_q),
                   int(block_k))
-
-
-def flash_attention_with_lse(q, k, v, bias=None, scale=None, causal=False,
-                             block_q=128, block_k=128):
-    """Forward-only variant returning (out, logsumexp (B,H,Tq) fp32) — the
-    building block for ring attention's cross-device online combine."""
-    d = q.shape[-1]
-    scale = float(scale) if scale is not None else 1.0 / (d ** 0.5)
-    if bias is not None:
-        bias = _canonical_bias(bias, q.shape[0], q.shape[1], q.shape[2],
-                               k.shape[2])
-    return _flash_fwd(q, k, v, bias, scale, bool(causal), int(block_q),
-                      int(block_k))

@@ -442,9 +442,17 @@ def crop_tensor(ctx):
     if ctx.has_in("Offsets"):
         # offsets may be traced — dynamic_slice starts accept tracers, only
         # the slice SIZES must be static
+        if any(s in (-1, 0) for s in shape):
+            # a -1/0 shape entry means "rest of the dim from the offset";
+            # with a runtime offset that size cannot be static, and
+            # dynamic_slice would silently clamp the start back to 0.
+            raise NotImplementedError(
+                "crop/crop_tensor: -1/0 entries in `shape` cannot be "
+                "combined with a tensor Offsets input (the slice size "
+                "would be dynamic); pass explicit sizes")
         off = ctx.in_("Offsets").reshape(-1).astype(jnp.int32)
         offsets = [off[i] for i in range(x.ndim)]
-        static_off = [0] * x.ndim   # -1 sizes fall back to full extent
+        static_off = [0] * x.ndim
     else:
         static_off = offsets
     shape = [x.shape[i] - static_off[i] if s in (-1, 0) else s

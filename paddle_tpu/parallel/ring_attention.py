@@ -74,38 +74,92 @@ def blockwise_attention(q, k, v, block_size=512, causal=False, scale=None):
     return o / jnp.maximum(l, 1e-20)[..., None]
 
 
+def _use_flash_inner():
+    import os
+    if os.environ.get("PADDLE_TPU_FORCE_FLASH") == "1":
+        return True
+    if os.environ.get("PADDLE_TPU_DISABLE_FLASH") == "1":
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _ring_step_flash(q, kk, vv, kv_owner, idx, causal, scale):
+    """One ring step through the fused Pallas kernel: returns the chunk's
+    normalized output + logsumexp for the cross-step online combine. The
+    causal structure is block-level (past owner: full; self: in-chunk
+    causal; future owner: skip) so no (T_local, T_local) mask tensor is
+    ever materialized in HBM."""
+    from ..ops.pallas.flash import flash_attention_with_lse
+    b, h, t_local, _ = q.shape
+
+    def full(_):
+        return flash_attention_with_lse(q, kk, vv, scale=scale, causal=False)
+
+    def diag(_):
+        return flash_attention_with_lse(q, kk, vv, scale=scale, causal=True)
+
+    def skip(_):
+        return (jnp.zeros_like(q),
+                jnp.full((b, h, t_local), -jnp.inf, jnp.float32))
+
+    if not causal:
+        return full(None)
+    return lax.cond(kv_owner == idx, diag,
+                    lambda _: lax.cond(kv_owner < idx, full, skip, None),
+                    None)
+
+
 def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None):
     """Ring attention over a sequence-sharded axis. Call INSIDE shard_map:
     q,k,v are the local shards (B, H, T_local, d); the sequence axis is
     sharded over `axis_name`. K/V rotate around the ring; per-step partial
-    softmax is merged online."""
+    softmax is merged online. On TPU (or PADDLE_TPU_FORCE_FLASH=1) the
+    local block runs the fused Pallas flash kernel (SURVEY §7 R2 item)."""
     sp = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     b, h, t_local, d = q.shape
     scale = scale if scale is not None else d ** -0.5
     q_pos = idx * t_local + jnp.arange(t_local)
+    use_flash = _use_flash_inner()
 
     def body(i, carry):
         m, l, o, kk, vv = carry
         kv_owner = (idx - i) % sp  # whose shard we hold at step i
-        k_pos = kv_owner * t_local + jnp.arange(t_local)
-        if causal:
-            mask = (k_pos[None, :] <= q_pos[:, None])
-            mask = jnp.broadcast_to(mask, (b, h, t_local, t_local))
+        if use_flash:
+            o_s, lse_s = _ring_step_flash(q, kk, vv, kv_owner, idx, causal,
+                                          scale)
+            # combine normalized chunk outputs via lse weights
+            m_new = jnp.maximum(m, lse_s)
+            safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+            alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+            beta = jnp.where(jnp.isfinite(lse_s),
+                             jnp.exp(lse_s - safe_m), 0.0)
+            o = o * alpha[..., None] + o_s * beta[..., None]
+            l = l * alpha + beta
+            m = m_new
         else:
-            mask = None
-        m, l, o = _online_block(q, kk, vv, m, l, o, mask, scale)
+            k_pos = kv_owner * t_local + jnp.arange(t_local)
+            if causal:
+                mask = (k_pos[None, :] <= q_pos[:, None])
+                mask = jnp.broadcast_to(mask, (b, h, t_local, t_local))
+            else:
+                mask = None
+            m, l, o = _online_block(q, kk, vv, m, l, o, mask, scale)
         perm = [(j, (j + 1) % sp) for j in range(sp)]
         kk = lax.ppermute(kk, axis_name, perm)
         vv = lax.ppermute(vv, axis_name, perm)
         return (m, l, o, kk, vv)
 
-    init = (jnp.full((b, h, t_local), -jnp.inf, q.dtype),
-            jnp.zeros((b, h, t_local), q.dtype),
-            jnp.zeros((b, h, t_local, d), q.dtype),
+    acc_dtype = jnp.float32 if use_flash else q.dtype
+    init = (jnp.full((b, h, t_local), -jnp.inf, acc_dtype),
+            jnp.zeros((b, h, t_local), acc_dtype),
+            jnp.zeros((b, h, t_local, d), acc_dtype),
             k, v)
     m, l, o, _, _ = lax.fori_loop(0, sp, body, init)
-    return o / jnp.maximum(l, 1e-20)[..., None]
+    return (o / jnp.maximum(l, 1e-20)[..., None]).astype(q.dtype)
 
 
 def ring_attention_sharded(q, k, v, mesh, causal=False,

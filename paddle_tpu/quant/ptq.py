@@ -13,7 +13,7 @@ the rewrite reuses the QAT insertion machinery with static scales.
 import numpy as np
 
 from ..core.framework import Operator, Parameter
-from .qat import QUANTIZABLE_OP_TYPES, _ACT_SLOTS, _WEIGHT_SLOTS
+from .qat import QUANTIZABLE_OP_TYPES, _ACT_SLOTS, _CONV_OPS, _WEIGHT_SLOTS
 
 
 def collect_activation_names(program,
@@ -70,10 +70,18 @@ def apply_ptq(program, scales, weight_bits=8, activation_bits=8,
                     block.create_var(name=qname, shape=var.shape,
                                      dtype=var.dtype)
                     sname = f"{name}.quant_scale"
-                    block.create_var(name=sname, shape=[var.shape[0]],
+                    # channel-wise only on conv filters; mul/matmul (in,out)
+                    # weights get per-tensor abs_max (reference fallback)
+                    if op.type in _CONV_OPS:
+                        qtype = "fake_channel_wise_quantize_dequantize_abs_max"
+                        out_c = var.shape[0]
+                    else:
+                        qtype = "fake_quantize_dequantize_abs_max"
+                        out_c = 1
+                    block.create_var(name=sname, shape=[out_c],
                                      dtype="float32")
                     new_ops.append(Operator(
-                        block, "fake_channel_wise_quantize_dequantize_abs_max",
+                        block, qtype,
                         {"X": [name]}, {"Out": [qname], "OutScale": [sname]},
                         {"bit_length": weight_bits, "quant_axis": 0}))
                     quantized[name] = qname

@@ -15,6 +15,7 @@ same graph, so QAT costs almost nothing on the MXU path.
 from ..core.framework import Parameter
 
 QUANTIZABLE_OP_TYPES = ("conv2d", "depthwise_conv2d", "mul", "matmul")
+_CONV_OPS = ("conv2d", "depthwise_conv2d")
 
 # which input slots carry weights vs activations per op type
 _WEIGHT_SLOTS = {"conv2d": ("Filter",), "depthwise_conv2d": ("Filter",),
@@ -84,13 +85,21 @@ class QuantizationTransform:
         block.create_var(name=qname, shape=var.shape, dtype=var.dtype)
         if is_weight:
             scale_name = f"{name}.quant_scale"
-            out_c = var.shape[0] if len(var.shape) else 1
-            block.create_var(name=scale_name, shape=[out_c],
-                             dtype="float32")
-            if self.weight_quantize_type == "channel_wise_abs_max":
+            # Channel-wise quantization is only meaningful on conv filters
+            # (dim 0 = output channels); mul/matmul Y weights are (in, out),
+            # so the reference QuantizationTransformPass falls back to
+            # per-tensor abs_max for them — match that.
+            channel_wise = (
+                self.weight_quantize_type == "channel_wise_abs_max"
+                and op.type in _CONV_OPS)
+            if channel_wise:
                 op_type = "fake_channel_wise_quantize_dequantize_abs_max"
+                out_c = var.shape[0] if len(var.shape) else 1
             else:
                 op_type = "fake_quantize_dequantize_abs_max"
+                out_c = 1
+            block.create_var(name=scale_name, shape=[out_c],
+                             dtype="float32")
             qop = _make_op(block, op_type, {"X": [name]},
                            {"Out": [qname], "OutScale": [scale_name]},
                            {"bit_length": self.weight_bits, "quant_axis": 0})

@@ -40,8 +40,11 @@ def load_library():
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_SO):
-            _build_so()
+        src = os.path.join(_CSRC, "prefetch.cc")
+        if not os.path.exists(_SO) or (
+                os.path.exists(src)
+                and os.path.getmtime(src) > os.path.getmtime(_SO)):
+            _build_so()  # (re)build when the source is newer
         lib = ctypes.CDLL(_SO)
         lib.pt_ring_create.restype = ctypes.c_void_p
         lib.pt_ring_create.argtypes = [ctypes.c_size_t, ctypes.c_size_t]

@@ -51,6 +51,21 @@ def count_flops(program, batch_size=1):
             w = _var_shape(gb, op.input("Filter")[0])
             if out and w:
                 flops = 2 * _numel(out, batch_size) * _numel(w[1:])
+        elif op.type == "multihead_attention":
+            # 4 projections (M x M) + the 2 score/value matmuls — counted at
+            # algorithmic T^2 cost regardless of the flash kernel (standard
+            # MFU accounting).
+            qs = _var_shape(gb, op.input("Query")[0])
+            if qs and len(qs) >= 2:
+                t, m = int(qs[-2]), int(qs[-1])
+                bsz = batch_size if qs[0] in (-1, None) else int(qs[0])
+                flops = bsz * (4 * 2 * t * m * m + 2 * 2 * t * t * m)
+        elif op.type == "scaled_dot_product_attention":
+            qs = _var_shape(gb, op.input("Q")[0])
+            if qs and len(qs) == 4:
+                bsz = batch_size if qs[0] in (-1, None) else int(qs[0])
+                h, t, dh = int(qs[1]), int(qs[2]), int(qs[3])
+                flops = 2 * 2 * bsz * h * t * t * dh
         elif op.type.startswith(_ELEMENTWISE_PREFIXES):
             out = _var_shape(gb, op.output_names[0])
             if out:

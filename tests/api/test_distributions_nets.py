@@ -1,0 +1,135 @@
+"""fluid.layers.distributions + fluid.nets parity (VERDICT r1 missing #2/#4).
+
+Numeric goldens computed against closed forms / scipy-free numpy.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, nets
+from paddle_tpu.layers.distributions import (Uniform, Normal, Categorical,
+                                             MultivariateNormalDiag)
+
+
+def _run(fetch):
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    outs = exe.run(fluid.default_main_program(), feed={}, fetch_list=fetch)
+    return [np.asarray(o) for o in outs]
+
+
+def test_uniform():
+    u = Uniform(1.0, 3.0)
+    s = u.sample([200], seed=7)
+    ent = u.entropy()
+    lp_in = u.log_prob(layers.assign(np.array([2.0], np.float32)))
+    lp_out = u.log_prob(layers.assign(np.array([5.0], np.float32)))
+    sv, ev, li, lo = _run([s, ent, lp_in, lp_out])
+    assert sv.shape == (200,)
+    assert sv.min() >= 1.0 and sv.max() <= 3.0
+    np.testing.assert_allclose(ev, math.log(2.0), rtol=1e-6)
+    np.testing.assert_allclose(li, -math.log(2.0), rtol=1e-6)
+    assert lo[0] == -np.inf  # out of support
+
+
+def test_normal_entropy_logprob_kl():
+    n1 = Normal(0.5, 2.0)
+    n2 = Normal(-1.0, 1.0)
+    x = 1.3
+    ent = n1.entropy()
+    lp = n1.log_prob(layers.assign(np.array([x], np.float32)))
+    kl = n1.kl_divergence(n2)
+    s = n1.sample([4000], seed=3)
+    ev, lv, kv, sv = _run([ent, lp, kl, s])
+    np.testing.assert_allclose(
+        ev, 0.5 + 0.5 * math.log(2 * math.pi) + math.log(2.0), rtol=1e-6)
+    want_lp = -((x - 0.5) ** 2) / (2 * 4.0) - math.log(2.0) \
+        - math.log(math.sqrt(2 * math.pi))
+    np.testing.assert_allclose(lv, want_lp, rtol=1e-5)
+    # closed-form KL(N(0.5,2) || N(-1,1))
+    want_kl = 0.5 * (4.0 + 1.5 ** 2 - 1.0 - math.log(4.0))
+    np.testing.assert_allclose(kv, want_kl, rtol=1e-5)
+    assert abs(sv.mean() - 0.5) < 0.15 and abs(sv.std() - 2.0) < 0.15
+
+
+def test_categorical_entropy_kl_logprob():
+    la = np.array([[1.0, 2.0, 3.0]], np.float32)
+    lb = np.array([[3.0, 1.0, 2.0]], np.float32)
+    a = Categorical(layers.assign(la))
+    b = Categorical(layers.assign(lb))
+    ent, kl = _run([a.entropy(), a.kl_divergence(b)])
+
+    def softmax(z):
+        e = np.exp(z - z.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    pa, pb = softmax(la), softmax(lb)
+    np.testing.assert_allclose(ent.ravel(), -(pa * np.log(pa)).sum(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(kl.ravel(),
+                               (pa * (np.log(pa) - np.log(pb))).sum(),
+                               rtol=1e-5)
+
+    c = Categorical(layers.assign(la))
+    lp, = _run([c.log_prob(layers.assign(np.array([2], np.int64)))])
+    np.testing.assert_allclose(lp.ravel(), np.log(pa[0, 2]), rtol=1e-5)
+
+
+def test_mvn_diag_entropy_kl():
+    a_scale = np.array([[0.4, 0.0], [0.0, 0.5]], np.float32)
+    b_scale = np.array([[0.3, 0.0], [0.0, 0.4]], np.float32)
+    a = MultivariateNormalDiag(layers.assign(np.array([0.3, 0.5], np.float32)),
+                               layers.assign(a_scale))
+    b = MultivariateNormalDiag(layers.assign(np.array([0.2, 0.4], np.float32)),
+                               layers.assign(b_scale))
+    ent_a, ent_b, kl = _run([a.entropy(), b.entropy(), a.kl_divergence(b)])
+    # Golden values from the reference docstring
+    # (ref layers/distributions.py:494 example).
+    np.testing.assert_allclose(ent_a.ravel(), [2.033158], rtol=1e-4)
+    np.testing.assert_allclose(ent_b.ravel(), [1.7777451], rtol=1e-4)
+    np.testing.assert_allclose(kl.ravel(), [0.06542051], rtol=1e-3)
+
+
+def test_nets_simple_img_conv_pool_and_group():
+    img = layers.data("img", shape=[1, 28, 28], dtype="float32")
+    out1 = nets.simple_img_conv_pool(img, num_filters=4, filter_size=5,
+                                     pool_size=2, pool_stride=2, act="relu")
+    out2 = nets.img_conv_group(img, conv_num_filter=[4, 4], pool_size=2,
+                               conv_act="relu", conv_with_batchnorm=True,
+                               pool_stride=2)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    x = np.random.RandomState(0).randn(2, 1, 28, 28).astype(np.float32)
+    o1, o2 = exe.run(fluid.default_main_program(), feed={"img": x},
+                     fetch_list=[out1, out2])
+    assert o1.shape == (2, 4, 12, 12)
+    assert o2.shape == (2, 4, 14, 14)
+    assert np.asarray(o1).min() >= 0.0  # relu'd
+
+
+def test_nets_glu_and_sequence_conv_pool():
+    x = layers.data("x", shape=[6], dtype="float32")
+    g = nets.glu(x, dim=-1)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xv = np.random.RandomState(1).randn(3, 6).astype(np.float32)
+    gv, = exe.run(fluid.default_main_program(), feed={"x": xv},
+                  fetch_list=[g])
+    a, b = xv[:, :3], xv[:, 3:]
+    np.testing.assert_allclose(np.asarray(gv), a / (1 + np.exp(-b)) * 1.0,
+                               rtol=2e-5, atol=2e-6)
+
+    import paddle_tpu.core.framework as fw
+    main2, startup2 = fw.Program(), fw.Program()
+    with fw.program_guard(main2, startup2):
+        seq = layers.data("seq", shape=[5, 8], dtype="float32")
+        out = nets.sequence_conv_pool(seq, num_filters=6, filter_size=3,
+                                      act="tanh", pool_type="max")
+        exe2 = fluid.Executor()
+        exe2.run(startup2)
+        sv = np.random.RandomState(2).randn(2, 5, 8).astype(np.float32)
+        ov, = exe2.run(main2, feed={"seq": sv}, fetch_list=[out])
+    assert np.asarray(ov).shape == (2, 6)

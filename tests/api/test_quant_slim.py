@@ -62,7 +62,11 @@ def test_qat_program_inserts_fake_quant_and_trains():
     n_ops_before = len(main.global_block().ops)
     quant.quantize_program(main, startup)
     types = [op.type for op in main.global_block().ops]
-    assert "fake_channel_wise_quantize_dequantize_abs_max" in types
+    # mul/matmul weights get PER-TENSOR abs_max (reference
+    # QuantizationTransformPass falls back for non-conv ops); channel-wise
+    # is conv-only (covered below).
+    assert "fake_quantize_dequantize_abs_max" in types
+    assert "fake_channel_wise_quantize_dequantize_abs_max" not in types
     assert "fake_quantize_dequantize_moving_average_abs_max" in types
     assert len(types) > n_ops_before
 
@@ -186,3 +190,25 @@ def test_fsp_and_hint_losses_build():
     f, h = exe.run(feed=feed, fetch_list=[floss, hloss])
     np.testing.assert_allclose(float(f), 0.0, atol=1e-6)
     np.testing.assert_allclose(float(h), 0.0, atol=1e-6)
+
+
+def test_qat_conv_uses_channel_wise():
+    img = layers.data("img", shape=[3, 8, 8], dtype="float32")
+    conv = layers.conv2d(img, num_filters=4, filter_size=3)
+    flat = layers.flatten(conv, axis=1)
+    pred = layers.fc(flat, size=1)
+    loss = layers.mean(pred)
+    main = fluid.default_main_program()
+    quant.quantize_program(main, fluid.default_startup_program())
+    block = main.global_block()
+    types = [op.type for op in block.ops]
+    # conv filter -> channel-wise; fc (mul) weight -> per-tensor
+    assert "fake_channel_wise_quantize_dequantize_abs_max" in types
+    assert "fake_quantize_dequantize_abs_max" in types
+    for op in block.ops:
+        if op.type == "fake_channel_wise_quantize_dequantize_abs_max":
+            scale_var = block.vars[op.output("OutScale")[0]]
+            assert list(scale_var.shape) == [4]  # per output channel
+        if op.type == "fake_quantize_dequantize_abs_max":
+            scale_var = block.vars[op.output("OutScale")[0]]
+            assert list(scale_var.shape) == [1]

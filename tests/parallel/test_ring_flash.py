@@ -1,0 +1,89 @@
+"""Ring attention with the Pallas flash inner block (VERDICT r1 #9).
+
+Forces the flash path on the CPU mesh (kernels run under the Pallas
+interpreter) and checks ring == full attention for fwd AND grads, causal
+and not.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.parallel.mesh import make_mesh
+import importlib
+
+ra = importlib.import_module("paddle_tpu.parallel.ring_attention")
+from paddle_tpu.ops.pallas import flash
+
+
+@pytest.fixture(autouse=True)
+def _force_flash(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_FORCE_FLASH", "1")
+    yield
+
+
+def _full_oracle(q, k, v, scale, causal):
+    return flash._xla_ref(q, k, v, scale, causal)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_matches_full(causal):
+    b, h, t, d = 1, 2, 64, 16
+    sp = 4
+    mesh = make_mesh(sp=sp, devices=jax.devices()[:sp])
+    key = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(kk, (b, h, t, d), jnp.float32)
+               for kk in jax.random.split(key, 3))
+    scale = 1.0 / d ** 0.5
+
+    def ring_loss(q, k, v):
+        o = ra.ring_attention_sharded(q, k, v, mesh, causal=causal)
+        return jnp.sum(jnp.sin(o)), o
+
+    def full_loss(q, k, v):
+        o = _full_oracle(q, k, v, scale, causal)
+        return jnp.sum(jnp.sin(o)), o
+
+    (lr, o_ring), g_ring = jax.value_and_grad(
+        ring_loss, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+    (lf, o_full), g_full = jax.value_and_grad(
+        full_loss, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+
+    np.testing.assert_allclose(np.asarray(o_ring), np.asarray(o_full),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(float(lr), float(lf), rtol=1e-5)
+    for a, b_ in zip(g_ring, g_full):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=5e-5, rtol=5e-5)
+
+
+def test_flash_lse_gradient_path():
+    """Differentiating THROUGH the lse output (the ring combine path) must
+    match the oracle: loss uses both out and lse."""
+    b, h, t, d = 1, 2, 32, 8
+    key = jax.random.PRNGKey(1)
+    q, k, v = (jax.random.normal(kk, (b, h, t, d), jnp.float32)
+               for kk in jax.random.split(key, 3))
+    scale = 1.0 / d ** 0.5
+
+    def loss_flash(q, k, v):
+        o, lse = flash.flash_attention_with_lse(q, k, v, scale=scale,
+                                                block_q=16, block_k=16)
+        return jnp.sum(jnp.sin(o)) + jnp.sum(jnp.cos(lse))
+
+    def loss_oracle(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+        lse = jax.scipy.special.logsumexp(s, axis=-1)
+        p = jnp.exp(s - lse[..., None])
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        return jnp.sum(jnp.sin(o)) + jnp.sum(jnp.cos(lse))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    go = jax.grad(loss_oracle, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, go):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=3e-5, rtol=3e-5)
