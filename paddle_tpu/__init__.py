@@ -31,6 +31,10 @@ from . import optimizer
 from .optimizer import clip
 from .optimizer import regularizer
 from . import metrics
+from . import average
+from . import evaluator
+from . import net_drawer
+from . import contrib
 from . import io
 from .io.state import (save_params, save_persistables, save_vars, load_params,
                        load_persistables, load_vars)

@@ -1,0 +1,55 @@
+"""Weighted running average — pure-host bookkeeping.
+
+Parity: `python/paddle/fluid/average.py:40` (WeightedAverage). As in the
+reference, this never touches the Program; it is plain Python over fetched
+numbers, kept for API compatibility (the reference itself points users at
+fluid.metrics).
+"""
+
+import warnings
+
+import numpy as np
+
+__all__ = ["WeightedAverage"]
+
+
+def _is_number(var):
+    return isinstance(var, (int, float)) or (
+        isinstance(var, np.ndarray) and var.shape == (1,))
+
+
+def _is_number_or_matrix(var):
+    return _is_number(var) or isinstance(var, np.ndarray)
+
+
+class WeightedAverage:
+    """avg.add(value, weight); avg.eval() -> sum(v*w)/sum(w)."""
+
+    def __init__(self):
+        warnings.warn(
+            "WeightedAverage is deprecated, please use "
+            "paddle_tpu.metrics instead.", Warning)
+        self.reset()
+
+    def reset(self):
+        self.numerator = None
+        self.denominator = None
+
+    def add(self, value, weight):
+        if not _is_number_or_matrix(value):
+            raise ValueError(
+                "The 'value' must be a number(int, float) or a numpy ndarray.")
+        if not _is_number(weight):
+            raise ValueError("The 'weight' must be a number(int, float).")
+        if self.numerator is None or self.denominator is None:
+            self.numerator = value * weight
+            self.denominator = weight
+        else:
+            self.numerator += value * weight
+            self.denominator += weight
+
+    def eval(self):
+        if self.numerator is None or self.denominator is None:
+            raise ValueError(
+                "There is no data to be averaged in WeightedAverage.")
+        return self.numerator / self.denominator

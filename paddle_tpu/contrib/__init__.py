@@ -1,0 +1,3 @@
+"""contrib — API-compatible extras (parity: python/paddle/fluid/contrib)."""
+
+from . import decoder  # noqa: F401

@@ -1,0 +1,2 @@
+from .beam_search_decoder import (  # noqa: F401
+    InitState, StateCell, TrainingDecoder, BeamSearchDecoder)

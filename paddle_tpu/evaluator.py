@@ -1,0 +1,73 @@
+"""Deprecated Evaluator shims — state lives host-side, math in metrics.
+
+Parity: `python/paddle/fluid/evaluator.py:45` (Evaluator base,
+ChunkEvaluator:127, EditDistance:218, DetectionMAP:299). The reference
+deprecates these in favor of fluid.metrics; here each evaluator wraps the
+corresponding `paddle_tpu.metrics` class, so the accumulation state is a
+host-side metric object rather than scope variables. TPU-native rationale:
+evaluator state updated per-batch on host costs nothing on the XLA step
+path, and the metric math already has numeric tests in metrics.py.
+
+`reset(executor)` / `eval(executor)` keep the reference call signatures;
+the executor argument is accepted and unused (state is host-side).
+"""
+
+import warnings
+
+from . import metrics as _metrics
+
+__all__ = ["ChunkEvaluator", "EditDistance", "DetectionMAP"]
+
+
+class Evaluator:
+    """Warn-and-delegate base (ref evaluator.py:45)."""
+
+    def __init__(self, name=None, **kwargs):
+        warnings.warn(
+            "%s is deprecated, please use paddle_tpu.metrics instead." %
+            self.__class__.__name__, Warning)
+        self.metric = None
+        self.states = []
+        self.helper_name = name
+
+    def reset(self, executor=None, reset_program=None):
+        self.metric.reset()
+
+    def eval(self, executor=None, eval_program=None):
+        return self.metric.eval()
+
+    def update(self, *args, **kwargs):
+        self.metric.update(*args, **kwargs)
+
+
+class ChunkEvaluator(Evaluator):
+    """Chunk F1 over (num_infer, num_label, num_correct) batch counts."""
+
+    def __init__(self, input=None, label=None, chunk_scheme=None,
+                 num_chunk_types=None, excluded_chunk_types=None, name=None):
+        super().__init__(name=name)
+        self.metric = _metrics.ChunkEvaluator(name=name)
+
+
+class EditDistance(Evaluator):
+    """Average edit distance + error-free sequence rate."""
+
+    def __init__(self, input=None, label=None, ignored_tokens=None,
+                 name=None):
+        super().__init__(name=name)
+        self.metric = _metrics.EditDistance(name=name)
+
+
+class DetectionMAP(Evaluator):
+    """Mean average precision over detection batches."""
+
+    def __init__(self, input=None, gt_label=None, gt_box=None,
+                 gt_difficult=None, class_num=None,
+                 background_label=0, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version="integral", name=None):
+        super().__init__(name=name)
+        self.metric = _metrics.DetectionMAP(
+            name=name, overlap_threshold=overlap_threshold)
+
+    def get_map_var(self):
+        return None

@@ -1,0 +1,34 @@
+"""Program → graphviz DOT dump.
+
+Parity: `python/paddle/fluid/net_drawer.py:103` (draw_graph). Reuses the
+DOT emitter in utils/debugger.py (draw_block_graphviz); this module adds
+the reference's two-program entry point and op/var styling knobs.
+"""
+
+import json
+
+from .utils.debugger import draw_block_graphviz
+
+__all__ = ["draw_graph"]
+
+OP_STYLE = {"shape": "oval", "color": "#0F9D58", "style": "filled"}
+VAR_STYLE = {"shape": "box", "color": "#999999"}
+
+
+def draw_node(op):
+    """One DOT node line for an Operator (ref net_drawer.py:62)."""
+    style = ", ".join('%s="%s"' % kv for kv in OP_STYLE.items())
+    return '"%s" [label="%s", %s]' % (op.type, op.type, style)
+
+
+def draw_graph(startup_program, main_program, path=None, **kwargs):
+    """Dump main_program's global block as DOT; startup ops become a
+    comment header (the reference draws both into one canvas)."""
+    header = "// startup ops: %s\n" % json.dumps(
+        [op.type for op in startup_program.global_block().ops])
+    dot = header + draw_block_graphviz(main_program.global_block(),
+                                       path=None)
+    if path:
+        with open(path, "w") as f:
+            f.write(dot)
+    return dot

@@ -232,3 +232,49 @@ def while_loop_op(ctx):
 
     res = jax.lax.while_loop(c, b, tuple(jnp.asarray(x) for x in xs))
     return {"Out": list(res)}
+
+
+@register("contrib_beam_search_decoder")
+def contrib_beam_search_decoder(ctx):
+    """Beam search over a one-step sub-block (contrib.decoder
+    BeamSearchDecoder; ref contrib/decoder/beam_search_decoder.py:523).
+
+    The sub-block maps (prev_ids (B*K,), states...) -> (softmax scores
+    (B*K, V), updated states). Lowered through inference.decoding
+    beam_decode: dense lanes inside ONE lax.scan, reorder-by-parent as a
+    gather — the TPU-legal replacement for the reference's LoD While loop.
+    """
+    import jax.numpy as jnp
+    from ..inference.decoding import beam_decode
+    prog = ctx.program
+    block = prog.blocks[ctx.attr("sub_block")]
+    K = ctx.attr("beam_size")
+    state_names = list(ctx.attr("state_names"))
+    inner_names = list(ctx.attr("state_inner_names"))
+    updated_names = list(ctx.attr("state_updated_names"))
+    prev_ids_name = ctx.attr("prev_ids_name")
+    scores_name = ctx.attr("scores_name")
+
+    init_ids = ctx.in_("InitIds").reshape(-1)
+    init_states = ctx.in_list("InitStates")
+    cache0 = {n: jnp.repeat(s, K, axis=0)
+              for n, s in zip(state_names, init_states)}
+    outer = dict(ctx.env)
+
+    def step_fn(ids_t, cache, t):
+        env2 = dict(outer)
+        env2[prev_ids_name] = ids_t
+        for n, inner in zip(state_names, inner_names):
+            env2[inner] = cache[n]
+        _run_block(block, env2, prog, ctx.is_test)
+        # the sub-block emits normalized probabilities (softmax head);
+        # log turns them into the log-probs beam_decode expects
+        # (log_softmax over already-normalized log-probs is identity)
+        logits = jnp.log(env2[scores_name] + 1e-9)
+        new_cache = {n: env2[u] for n, u in zip(state_names, updated_names)}
+        return logits, new_cache
+
+    ids, scores = beam_decode(
+        step_fn, cache0, init_ids, ctx.attr("max_len"), K,
+        ctx.attr("end_id"), length_penalty=ctx.attr("length_penalty", 0.0))
+    return {"Ids": ids, "Scores": scores}

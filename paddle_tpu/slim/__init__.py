@@ -1,8 +1,11 @@
-"""Slim-lite: pruning masks + distillation losses.
+"""Slim-lite: pruning masks + distillation losses + light-NAS search.
 
-Parity: the reference's contrib/slim (PruneStrategy / distillation
-distill losses). See prune.py and distill.py.
+Parity: the reference's contrib/slim (PruneStrategy, distillation
+losses, nas/light_nas_strategy + searcher/controller). See prune.py,
+distill.py, nas.py.
 """
 
 from .prune import Pruner, sensitivity_prune_ratios  # noqa: F401
 from .distill import (soft_label_loss, l2_hint_loss, fsp_loss)  # noqa: F401
+from .nas import (SearchSpace, EvolutionaryController, SAController,  # noqa: F401
+                  ControllerServer, SearchAgent, LightNASStrategy)

@@ -1,0 +1,304 @@
+"""Light-NAS: search space + simulated-annealing controller + strategy.
+
+Parity: the reference's `contrib/slim/nas/` — SearchSpace
+(search_space.py:19), LightNASStrategy (light_nas_strategy.py:35),
+ControllerServer/SearchAgent (controller_server.py:24, search_agent.py:21) —
+and `contrib/slim/searcher/controller.py:59` (SAController).
+
+TPU-native design: a candidate is a *token vector*; `create_net` builds a
+fresh Program for it and evaluation runs through the whole-program-jit
+Executor, so each candidate is ONE XLA executable on tiny eval shapes.
+The FLOPs constraint is checked symbolically with
+`utils.model_stat.count_flops` on the un-compiled Program, so infeasible
+candidates are rejected before any compile. The controller itself is plain
+host Python (search is control-plane work, not MXU work); the distributed
+search uses the same line-protocol TCP server/agent pair as the reference
+so multiple hosts can pull tokens from one annealing chain.
+"""
+
+import math
+import socket
+import threading
+
+import numpy as np
+
+from ..utils.model_stat import count_flops
+from ..utils.log import get_logger
+
+_logger = get_logger(__name__)
+
+__all__ = [
+    "SearchSpace", "EvolutionaryController", "SAController",
+    "ControllerServer", "SearchAgent", "LightNASStrategy",
+]
+
+
+class SearchSpace:
+    """Abstract search space (ref search_space.py:19).
+
+    Subclasses define the token domain and how tokens become a network.
+    """
+
+    def init_tokens(self):
+        """Initial token vector (list of ints)."""
+        raise NotImplementedError("Abstract method.")
+
+    def range_table(self):
+        """Per-position exclusive upper bounds: tokens[i] in [0, table[i])."""
+        raise NotImplementedError("Abstract method.")
+
+    def create_net(self, tokens):
+        """Build programs for `tokens`.
+
+        Returns (startup_program, train_program, eval_program,
+        train_fetches, eval_fetches) like the reference contract; strategies
+        only require what their eval_fn consumes, so lighter tuples are fine
+        when used with a custom eval_fn.
+        """
+        raise NotImplementedError("Abstract method.")
+
+    def get_model_latency(self, program):
+        """Proxy latency of a candidate: forward FLOPs of its Program.
+
+        The reference queries a latency lookup table; on TPU the symbolic
+        FLOP count is the compile-free proxy (MXU-bound nets are
+        FLOPs-proportional at fixed shapes). Override for a real table.
+        """
+        total, _ = count_flops(program)
+        return total
+
+
+class EvolutionaryController:
+    """Abstract controller (ref controller.py:28)."""
+
+    def update(self, tokens, reward):
+        raise NotImplementedError("Abstract method.")
+
+    def reset(self, range_table, init_tokens, constrain_func=None):
+        raise NotImplementedError("Abstract method.")
+
+    def next_tokens(self):
+        raise NotImplementedError("Abstract method.")
+
+
+class SAController(EvolutionaryController):
+    """Simulated-annealing controller (ref controller.py:59).
+
+    Accepts a worse candidate with probability exp(dR / T), T decaying
+    geometrically per update. Seeded: searches are replayable
+    (utils/determinism story applies to the search loop too).
+    """
+
+    def __init__(self, range_table=None, reduce_rate=0.85,
+                 init_temperature=1024, max_try_number=300, seed=0):
+        self._range_table = range_table
+        self._reduce_rate = reduce_rate
+        self._init_temperature = init_temperature
+        self._max_try_number = max_try_number
+        self._rng = np.random.default_rng(seed)
+        self._constrain_func = None
+        self._tokens = None
+        self._reward = -float("inf")
+        self._best_tokens = None
+        self._max_reward = -float("inf")
+        self._iter = 0
+
+    @property
+    def best_tokens(self):
+        return self._best_tokens
+
+    @property
+    def max_reward(self):
+        return self._max_reward
+
+    def reset(self, range_table, init_tokens, constrain_func=None):
+        self._range_table = list(range_table)
+        self._constrain_func = constrain_func
+        self._tokens = list(init_tokens)
+        self._iter = 0
+
+    def update(self, tokens, reward):
+        self._iter += 1
+        temperature = self._init_temperature * self._reduce_rate ** self._iter
+        dr = reward - self._reward
+        if dr > 0 or self._rng.random() <= math.exp(
+                min(0.0, dr) / max(temperature, 1e-12)):
+            self._reward = reward
+            self._tokens = list(tokens)
+        if reward > self._max_reward:
+            self._max_reward = reward
+            self._best_tokens = list(tokens)
+        _logger.info("SA iter %d: reward=%.6g best=%.6g tokens=%s",
+                     self._iter, reward, self._max_reward, self._best_tokens)
+
+    def _mutate(self, tokens):
+        new_tokens = list(tokens)
+        index = int(self._rng.integers(len(self._range_table)))
+        span = self._range_table[index]
+        if span > 1:
+            new_tokens[index] = int(
+                (new_tokens[index] + self._rng.integers(1, span)) % span)
+        return new_tokens
+
+    def next_tokens(self):
+        new_tokens = self._mutate(self._tokens)
+        if self._constrain_func is None:
+            return new_tokens
+        for _ in range(self._max_try_number):
+            if self._constrain_func(new_tokens):
+                return new_tokens
+            new_tokens = self._mutate(self._tokens)
+        return list(self._tokens)  # no feasible neighbour found
+
+
+class ControllerServer:
+    """TCP server sharing one controller across search workers
+    (ref controller_server.py:24).
+
+    Line protocol, one request per connection:
+      ``next_tokens\\n``            -> ``t0,t1,...\\n``
+      ``update <reward> t0,t1,...`` -> ``ok\\n``
+    """
+
+    def __init__(self, controller, address=("127.0.0.1", 0), key="light-nas"):
+        self._controller = controller
+        self._key = key
+        self._lock = threading.Lock()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(address)
+        self._sock.listen(16)
+        self._closed = False
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    @property
+    def address(self):
+        return self._sock.getsockname()
+
+    def _serve(self):
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            try:
+                line = conn.makefile("r").readline().strip()
+                with self._lock:
+                    if line == "next_tokens":
+                        toks = self._controller.next_tokens()
+                        conn.sendall(
+                            (",".join(map(str, toks)) + "\n").encode())
+                    elif line.startswith("update "):
+                        _, reward, toks = line.split(" ", 2)
+                        self._controller.update(
+                            [int(t) for t in toks.split(",")], float(reward))
+                        conn.sendall(b"ok\n")
+                    else:
+                        conn.sendall(b"err\n")
+            except Exception:
+                pass
+            finally:
+                conn.close()
+
+    def close(self):
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class SearchAgent:
+    """Client for ControllerServer (ref search_agent.py:21)."""
+
+    def __init__(self, server_ip, server_port):
+        self._addr = (server_ip, server_port)
+
+    def _request(self, line):
+        with socket.create_connection(self._addr, timeout=10) as conn:
+            conn.sendall((line + "\n").encode())
+            return conn.makefile("r").readline().strip()
+
+    def next_tokens(self):
+        return [int(t) for t in self._request("next_tokens").split(",")]
+
+    def update(self, tokens, reward):
+        return self._request(
+            "update %s %s" % (reward, ",".join(map(str, tokens)))) == "ok"
+
+
+class LightNASStrategy:
+    """Search driver (ref light_nas_strategy.py:35).
+
+    Repeatedly: draw tokens from the controller (or a remote agent),
+    reject candidates over `target_flops`/`target_latency` symbolically,
+    evaluate the survivor with `eval_fn(tokens, search_space)` -> reward,
+    and anneal. Returns the best (tokens, reward).
+    """
+
+    def __init__(self, search_space, controller=None, eval_fn=None,
+                 target_flops=None, target_latency=None, search_steps=10,
+                 server_ip=None, server_port=0, is_server=False,
+                 key="light-nas"):
+        self._space = search_space
+        self._controller = controller or SAController()
+        self._eval_fn = eval_fn
+        self._target_flops = target_flops
+        self._target_latency = target_latency
+        self._search_steps = search_steps
+        self._server = None
+        self._agent = None
+        if is_server:
+            self._server = ControllerServer(
+                self._controller, ("127.0.0.1", server_port), key).start()
+        if server_ip:
+            if not server_port and self._server is None:
+                raise ValueError("server_port is required when connecting "
+                                 "to a remote controller server")
+            self._agent = SearchAgent(server_ip,
+                                      server_port or self._server.address[1])
+
+    def _feasible(self, tokens):
+        if self._target_flops is None and self._target_latency is None:
+            return True
+        net = self._space.create_net(tokens)
+        train_prog = net[1] if isinstance(net, tuple) else net
+        lat = self._space.get_model_latency(train_prog)
+        if self._target_flops is not None and lat > self._target_flops:
+            return False
+        if self._target_latency is not None and lat > self._target_latency:
+            return False
+        return True
+
+    def search(self):
+        init = self._space.init_tokens()
+        self._controller.reset(self._space.range_table(), init,
+                               constrain_func=self._feasible)
+        history = []
+        tokens = list(init)
+        for _ in range(self._search_steps):
+            reward = self._evaluate(tokens)
+            history.append((list(tokens), reward))
+            if self._agent is not None:
+                self._agent.update(tokens, reward)
+                tokens = self._agent.next_tokens()
+            else:
+                self._controller.update(tokens, reward)
+                tokens = self._controller.next_tokens()
+        best_tokens, best_reward = max(history, key=lambda h: h[1])
+        self.history = history
+        return best_tokens, best_reward
+
+    def _evaluate(self, tokens):
+        if self._eval_fn is not None:
+            return float(self._eval_fn(tokens, self._space))
+        raise ValueError("LightNASStrategy needs an eval_fn "
+                         "(tokens, search_space) -> reward")
+
+    def close(self):
+        if self._server is not None:
+            self._server.close()
