@@ -5,7 +5,8 @@ layers/collective.py — re-designed as SPMD over jax.sharding meshes (see
 SURVEY.md §2.6): dp/fsdp/tp/pp/sp/ep axes, XLA collectives over ICI.
 """
 
-from .mesh import (MeshConfig, get_mesh, set_mesh, make_mesh, mesh_axes,
+from .mesh import (MeshConfig, get_mesh, set_mesh, make_mesh,
+                   make_hybrid_mesh, host_domains, mesh_axes,
                    multihost_initialize)
 from .collective import (allreduce, broadcast, allgather, reducescatter,
                          alltoall, barrier, send_recv)

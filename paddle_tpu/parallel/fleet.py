@@ -1,16 +1,36 @@
 """Fleet distributed-training facade.
 
 Parity: python/paddle/fluid/incubate/fleet/ (base/role_maker.py,
-collective/__init__.py, parameter_server/). fleet.init / distributed_optimizer
-/ worker_num etc. keep their shape; underneath everything is the SPMD mesh.
+base/fleet_base.py, collective/__init__.py). fleet.init /
+distributed_optimizer / worker_num keep their shapes; underneath:
+
+* role makers resolve rank/endpoints from the same PADDLE_* env vars
+  PaddleCloud sets (role_maker.py PaddleCloudRoleMaker), and fleet.init
+  bootstraps jax.distributed from them (coordinator = first endpoint).
+* the cluster becomes ONE device mesh: a DCN-aware hybrid mesh when the
+  job spans hosts (model axes pinned inside each host's ICI domain),
+  a flat mesh otherwise.
+* distributed_optimizer returns a DistributedOptimizer whose minimize
+  applies the DistributedStrategy as program transforms — AMP decoration,
+  megatron shard rules (tp), ZeRO-1 optimizer-state sharding, fsdp — so
+  `exe.run(CompiledProgram(prog).with_mesh(fleet.mesh()))` executes the
+  whole strategy through GSPMD. Gradient sync itself needs no code:
+  sharded state makes XLA insert the collectives (the reference's
+  allreduce DistributedOptimizer re-expressed as layout annotations).
 """
+
+import os
 
 import jax
 
-from .mesh import get_mesh, make_mesh, set_mesh, multihost_initialize
+from .mesh import (get_mesh, make_mesh, make_hybrid_mesh, set_mesh,
+                   multihost_initialize)
 
 
 class RoleMakerBase:
+    endpoints = None
+    current_endpoint = None
+
     def is_worker(self):
         return True
 
@@ -18,7 +38,7 @@ class RoleMakerBase:
         return False
 
     def is_first_worker(self):
-        return jax.process_index() == 0
+        return self.worker_index() == 0
 
     def worker_num(self):
         return jax.process_count()
@@ -28,30 +48,113 @@ class RoleMakerBase:
 
 
 class PaddleCloudRoleMaker(RoleMakerBase):
+    """Rank/endpoints from PaddleCloud's env contract
+    (ref incubate/fleet/base/role_maker.py PaddleCloudRoleMaker):
+    PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ENDPOINTS,
+    PADDLE_CURRENT_ENDPOINT."""
+
     def __init__(self, is_collective=True):
         self._is_collective = is_collective
+        self._id_set = "PADDLE_TRAINER_ID" in os.environ
+        self._id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._num = int(os.environ.get("PADDLE_TRAINERS_NUM", "0")) or None
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self.endpoints = [e for e in eps.split(",") if e] or None
+        self.current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT") \
+            or (self.endpoints[self._id]
+                if self.endpoints and self._id < len(self.endpoints) else None)
+
+    def worker_num(self):
+        return self._num if self._num else jax.process_count()
+
+    def worker_index(self):
+        return self._id if (self._id_set or self._num) else jax.process_index()
 
 
 class UserDefinedRoleMaker(RoleMakerBase):
     def __init__(self, current_id=0, role=None, worker_num=1,
-                 server_endpoints=None):
+                 server_endpoints=None, worker_endpoints=None):
         self._id = current_id
         self._num = worker_num
+        # server_endpoints (legacy pserver addresses) must NOT become the
+        # jax.distributed worker ring — only worker endpoints bootstrap it
+        self.endpoints = worker_endpoints
+        self.server_endpoints = server_endpoints
+        self.current_endpoint = (self.endpoints[current_id]
+                                 if self.endpoints
+                                 and current_id < len(self.endpoints)
+                                 else None)
+
+    def worker_num(self):
+        return self._num
+
+    def worker_index(self):
+        return self._id
 
 
 class DistributedStrategy:
-    """Parity: fleet DistributedStrategy — knobs map onto mesh shape + jit
-    options instead of nccl/pserver config."""
+    """Parity: fleet DistributedStrategy — knobs map onto mesh shape +
+    program transforms instead of nccl/pserver config.
+
+    Degrees are cluster-wide totals. `zero_stage`: 0 = replicated
+    optimizer state, 1 = shard accumulators over dp (ZeRO-1),
+    3 = shard params too (fsdp; `use_fsdp` is the legacy alias).
+    `emulated_hosts` chunks a single-process mesh into fake host domains
+    (testing DCN layouts on the CPU mesh)."""
 
     def __init__(self):
         self.tp_degree = 1
         self.pp_degree = 1
         self.sp_degree = 1
         self.ep_degree = 1
+        self.zero_stage = 0
         self.use_fsdp = False
         self.amp = False
+        self.amp_init_loss_scaling = 2.0 ** 15
         self.recompute = False
         self.gradient_merge_steps = 1
+        self.emulated_hosts = None
+
+
+class DistributedOptimizer:
+    """minimize() = inner minimize + the strategy's program transforms
+    (ref collective/__init__.py CollectiveOptimizer, done as annotations)."""
+
+    def __init__(self, optimizer, fleet_obj):
+        self._inner = optimizer
+        self._fleet = fleet_obj
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        import inspect
+        from .tensor_parallel import apply_shard_rules
+        from .transpiler import shard_optimizer_state, shard_params_fsdp
+        s = self._fleet._strategy or DistributedStrategy()
+        opt = self._inner
+        if s.amp:
+            from .. import amp as amp_mod
+            opt = amp_mod.decorate(
+                opt, init_loss_scaling=s.amp_init_loss_scaling)
+        # wrappers (Lookahead, ModelAverage, ...) take fewer kwargs than
+        # the Optimizer base — forward only what the inner one accepts
+        accepted = inspect.signature(opt.minimize).parameters
+        kwargs = {k: v for k, v in
+                  (("startup_program", startup_program),
+                   ("parameter_list", parameter_list),
+                   ("no_grad_set", no_grad_set))
+                  if k in accepted}
+        result = opt.minimize(loss, **kwargs)
+        program = loss.block.program
+        if s.tp_degree > 1 or s.sp_degree > 1:
+            apply_shard_rules(program)
+        if s.use_fsdp or s.zero_stage >= 3:
+            shard_params_fsdp(program)
+        if s.zero_stage >= 1 or s.use_fsdp:
+            shard_optimizer_state(program)
+        return result
 
 
 class Fleet:
@@ -63,12 +166,33 @@ class Fleet:
     def init(self, role_maker=None, is_collective=True, strategy=None):
         self._role = role_maker or PaddleCloudRoleMaker(is_collective)
         self._strategy = strategy or DistributedStrategy()
-        s = self._strategy
-        mesh = make_mesh(tp=s.tp_degree, pp=s.pp_degree, sp=s.sp_degree,
-                         ep=s.ep_degree)
-        set_mesh(mesh)
+        eps = self._role.endpoints
+        if is_collective and eps and len(eps) > 1:
+            multihost_initialize(endpoints=eps,
+                                 current_endpoint=self._role.current_endpoint)
+        set_mesh(self._build_mesh())
         self._inited = True
         return self
+
+    def _build_mesh(self):
+        s = self._strategy
+        n = len(jax.devices())
+        model = s.tp_degree * s.pp_degree * s.sp_degree * s.ep_degree
+        hosts = (jax.process_count() if jax.process_count() > 1
+                 else s.emulated_hosts)
+        if hosts and hosts > 1 and n % hosts == 0:
+            per_host = n // hosts
+            if model <= per_host and per_host % model == 0:
+                # model axes inside each host's ICI domain, dp over DCN
+                return make_hybrid_mesh(
+                    dp_dcn=hosts, dp=per_host // model, tp=s.tp_degree,
+                    pp=s.pp_degree, sp=s.sp_degree, ep=s.ep_degree,
+                    hosts=s.emulated_hosts)
+        return make_mesh(tp=s.tp_degree, pp=s.pp_degree, sp=s.sp_degree,
+                         ep=s.ep_degree)
+
+    def mesh(self):
+        return get_mesh()
 
     def is_first_worker(self):
         return self._role.is_first_worker() if self._role else True
@@ -86,15 +210,20 @@ class Fleet:
         return False
 
     def barrier_worker(self):
-        pass
+        """Block until every process reaches the barrier (DCN sync)."""
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("paddle_tpu_fleet_barrier")
 
     def distributed_optimizer(self, optimizer, strategy=None):
-        """The returned optimizer is unchanged: SPMD makes grad sync a
-        compiler concern (psum inserted by GSPMD), matching the semantics of
-        fleet's allreduce DistributedOptimizer."""
         if strategy is not None:
             self._strategy = strategy
-        return optimizer
+        return DistributedOptimizer(optimizer, self)
+
+    def compiled_program(self, program):
+        """The program, placed on fleet's mesh — run it with exe.run."""
+        from ..core.compiler import CompiledProgram
+        return CompiledProgram(program).with_mesh(get_mesh())
 
     def init_worker(self):
         pass

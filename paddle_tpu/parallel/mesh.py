@@ -56,6 +56,66 @@ def make_mesh(dp=None, tp=1, pp=1, sp=1, ep=1, devices=None):
     return Mesh(arr, ("dp", "pp", "ep", "sp", "tp"))
 
 
+def make_hybrid_mesh(dp_dcn=None, *, dp=1, tp=1, pp=1, sp=1, ep=1,
+                     pp_dcn=1, devices=None, hosts=None):
+    """DCN-aware mesh: slow axes factor across hosts, fast axes stay
+    inside each host's ICI domain (the scaling-book recipe; parity with
+    the reference's two-level nccl rings — fleet's inter/intra-node
+    hierarchical allreduce, transpiler endpoint lists).
+
+    dp_dcn × pp_dcn spans hosts (DCN); dp/pp/ep/sp/tp span each host's
+    own devices (ICI). Returns the same 5-axis Mesh as make_mesh — the dp
+    axis is dp_dcn*dp with host-major device order, pp is pp_dcn*pp — so
+    shard rules, collectives, and the executor are unchanged; XLA lowers
+    the inter-host segment of a collective onto DCN and the intra-host
+    segment onto ICI automatically from device locality.
+
+    Hosts are discovered from device.process_index. On a single-process
+    mesh (the 8-device CPU test mesh), `hosts=N` emulates N host domains
+    by chunking the device list, so host-locality layouts are testable
+    without multi-host hardware.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    by_proc = {}
+    for d in devices:
+        by_proc.setdefault(getattr(d, "process_index", 0), []).append(d)
+    if len(by_proc) == 1 and hosts and hosts > 1:
+        flat = next(iter(by_proc.values()))
+        if len(flat) % hosts:
+            raise ValueError(f"{len(flat)} devices not divisible into "
+                             f"{hosts} emulated hosts")
+        per = len(flat) // hosts
+        groups = [flat[i * per:(i + 1) * per] for i in range(hosts)]
+    else:
+        groups = [by_proc[k] for k in sorted(by_proc)]
+    n_hosts = len(groups)
+    per_host = len(groups[0])
+    if any(len(g) != per_host for g in groups):
+        raise ValueError("hosts hold unequal device counts")
+    if dp_dcn is None:
+        if n_hosts % pp_dcn:
+            raise ValueError(f"{n_hosts} hosts not divisible by pp_dcn={pp_dcn}")
+        dp_dcn = n_hosts // pp_dcn
+    if dp_dcn * pp_dcn != n_hosts:
+        raise ValueError(f"dp_dcn*pp_dcn={dp_dcn * pp_dcn} != {n_hosts} hosts")
+    if dp * pp * ep * sp * tp != per_host:
+        raise ValueError(f"ici mesh {dp}x{pp}x{ep}x{sp}x{tp} != "
+                         f"{per_host} devices/host")
+    arr = np.array(groups).reshape(dp_dcn, pp_dcn, dp, pp, ep, sp, tp)
+    arr = arr.transpose(0, 2, 1, 3, 4, 5, 6).reshape(
+        dp_dcn * dp, pp_dcn * pp, ep, sp, tp)
+    return Mesh(arr, ("dp", "pp", "ep", "sp", "tp"))
+
+
+def host_domains(mesh, per_host):
+    """Debug/test helper: map each mesh position to its host index,
+    assuming `per_host` devices per host domain (emulated or real)."""
+    def host_of(d):
+        pi = getattr(d, "process_index", 0)
+        return pi if jax.process_count() > 1 else d.id // per_host
+    return np.vectorize(host_of)(mesh.devices)
+
+
 def set_mesh(mesh):
     global _current_mesh
     _current_mesh = mesh
@@ -74,11 +134,27 @@ def mesh_axes(mesh=None):
 
 
 def multihost_initialize(coordinator_address=None, num_processes=None,
-                         process_id=None):
+                         process_id=None, endpoints=None,
+                         current_endpoint=None):
     """Parity: transpiler endpoints / fleet.init on a multi-host pod.
-    Wraps jax.distributed.initialize; a no-op when single-process."""
+    Wraps jax.distributed.initialize; a no-op when single-process.
+
+    Accepts either jax-style (coordinator_address, num_processes,
+    process_id) or fluid-transpiler-style (endpoints list +
+    current_endpoint, as in DistributeTranspilerConfig): the first
+    endpoint is the coordinator, rank is the index of current_endpoint.
+    """
+    if endpoints:
+        if current_endpoint is None:
+            raise ValueError("current_endpoint required with endpoints")
+        coordinator_address = coordinator_address or endpoints[0]
+        num_processes = len(endpoints)
+        process_id = endpoints.index(current_endpoint)
     if num_processes in (None, 1):
         return False
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None and is_init():
+        return True  # re-entrant: fleet.init / retries must not re-bootstrap
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)

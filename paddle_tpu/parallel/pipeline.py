@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 
 def pipeline_step(stage_fn, stacked_params, x_microbatches, axis_name="pp"):
@@ -77,7 +77,7 @@ def pipeline_apply(stage_fn, params_stacked, x, mesh, microbatches,
 
     fn = shard_map(inner, mesh=mesh,
                    in_specs=(pspec, P()), out_specs=P(),
-                   check_rep=False)
+                   check_vma=False)
     ym = fn(params_stacked, xm)
     return ym.reshape((b,) + ym.shape[2:])
 
@@ -197,7 +197,7 @@ def pipeline_1f1b(stage_fn, loss_fn, stacked_params, x_microbatches, aux,
 
     fn = shard_map(inner, mesh=mesh,
                    in_specs=(pspec, P(), P()),
-                   out_specs=(P(), pspec), check_rep=False)
+                   out_specs=(P(), pspec), check_vma=False)
     return fn(stacked_params, x_microbatches, aux)
 
 
@@ -392,7 +392,7 @@ def build_pipelined_forward(program, marker_idx, pipeline_cfg, mesh,
             return total / m
 
         fn = shard_map(inner, mesh=mesh, in_specs=(P(), P(), P()),
-                       out_specs=P(), check_rep=False)
+                       out_specs=P(), check_vma=False)
         return fn(params, rng, feeds_m)
 
     return fwd

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 
 def _online_block(q, k, v, m, l, o, mask, scale):
@@ -171,5 +171,5 @@ def ring_attention_sharded(q, k, v, mesh, causal=False,
     fn = shard_map(
         functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_rep=False)
+        check_vma=False)
     return fn(q, k, v)

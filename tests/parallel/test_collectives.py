@@ -12,7 +12,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from paddle_tpu.parallel import collective
 from paddle_tpu.parallel.mesh import make_mesh
@@ -25,7 +25,7 @@ def mesh1d():
 
 def _smap(fn, mesh, in_spec, out_spec):
     return shard_map(fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
-                     check_rep=False)
+                     check_vma=False)
 
 
 def test_allreduce_ops(mesh1d):

@@ -13,7 +13,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 import paddle_tpu as fluid
 from paddle_tpu import layers
@@ -84,7 +84,7 @@ def test_tp_matmul_pair_matches_dense():
 
     fn = shard_map(local, mesh=mesh,
                    in_specs=(P(), P(None, "tp"), P("tp", None)),
-                   out_specs=P(), check_rep=False)
+                   out_specs=P(), check_vma=False)
     got = np.asarray(fn(x, w1, w2))
     np.testing.assert_allclose(got, ref, rtol=1e-4)
 
@@ -136,7 +136,7 @@ def test_moe_ep_matches_dense():
         in_specs=({"gate_w": P(), "w_up": P("ep"), "w_down": P("ep")},
                   P("ep", None)),
         out_specs=P("ep", None),
-        check_rep=False)
+        check_vma=False)
     ep_out = fn(params, x)
 
     np.testing.assert_allclose(np.asarray(ep_out), np.asarray(dense_out),
