@@ -1,0 +1,131 @@
+// Native host-side multiclass NMS for the inference postprocess path.
+//
+// Parity: paddle/fluid/operators/detection/multiclass_nms_op.cc — the
+// reference runs NMS on the CPU and emits a variable-length (LoD) result.
+// On TPU the in-graph `multiclass_nms` op is the static-shape padded
+// variant (XLA-legal); this native kernel is the true variable-length
+// postprocess for the predictor: detections leave the chip as dense
+// (boxes, scores) and the host prunes them without holding the GIL.
+//
+// C ABI (ctypes): single translation unit, no deps beyond libm.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct Det {
+  float score;
+  int cls;
+  int idx;  // index into the boxes array
+};
+
+inline float iou(const float* a, const float* b, bool normalized) {
+  const float off = normalized ? 0.f : 1.f;
+  const float ix1 = std::max(a[0], b[0]);
+  const float iy1 = std::max(a[1], b[1]);
+  const float ix2 = std::min(a[2], b[2]);
+  const float iy2 = std::min(a[3], b[3]);
+  const float iw = std::max(ix2 - ix1 + off, 0.f);
+  const float ih = std::max(iy2 - iy1 + off, 0.f);
+  const float inter = iw * ih;
+  const float area_a = (a[2] - a[0] + off) * (a[3] - a[1] + off);
+  const float area_b = (b[2] - b[0] + off) * (b[3] - b[1] + off);
+  const float uni = area_a + area_b - inter;
+  return uni <= 0.f ? 0.f : inter / uni;
+}
+
+// Greedy per-class NMS over one image's candidates for class `c`.
+// scores: (C, M) row-major; boxes: (M, 4). Appends survivors to `out`.
+void nms_one_class(const float* boxes, const float* cls_scores, int m,
+                   float score_thresh, float nms_thresh, float eta,
+                   int nms_top_k, bool normalized, int cls,
+                   std::vector<Det>* out) {
+  std::vector<Det> cand;
+  cand.reserve(64);
+  for (int i = 0; i < m; ++i) {
+    if (cls_scores[i] > score_thresh) cand.push_back({cls_scores[i], cls, i});
+  }
+  std::stable_sort(cand.begin(), cand.end(),
+            [](const Det& a, const Det& b) { return a.score > b.score; });
+  if (nms_top_k > -1 && (int)cand.size() > nms_top_k) cand.resize(nms_top_k);
+
+  float adaptive = nms_thresh;
+  std::vector<Det> kept;
+  for (const Det& d : cand) {
+    bool keep = true;
+    for (const Det& k : kept) {
+      if (iou(boxes + 4 * d.idx, boxes + 4 * k.idx, normalized) > adaptive) {
+        keep = false;
+        break;
+      }
+    }
+    if (keep) {
+      kept.push_back(d);
+      if (eta < 1.f && adaptive > 0.5f) adaptive *= eta;  // adaptive NMS
+    }
+  }
+  out->insert(out->end(), kept.begin(), kept.end());
+}
+
+}  // namespace
+
+extern "C" {
+
+// One image. boxes: (M,4) f32, scores: (C,M) f32.
+// out: caller buffer of capacity `out_cap` rows x 6 floats
+// [class, score, x1, y1, x2, y2]. Returns the number of detections kept
+// (post keep_top_k, pre out_cap); writes min(kept, out_cap) rows, so a
+// return > out_cap tells the caller its buffer was too small.
+int pt_multiclass_nms(const float* boxes, const float* scores, int m, int c,
+                      float score_thresh, float nms_thresh, float eta,
+                      int nms_top_k, int keep_top_k, int background_label,
+                      int normalized, float* out, int out_cap) {
+  std::vector<Det> all;
+  for (int cls = 0; cls < c; ++cls) {
+    if (cls == background_label) continue;
+    nms_one_class(boxes, scores + (size_t)cls * m, m, score_thresh,
+                  nms_thresh, eta, nms_top_k, normalized != 0, cls, &all);
+  }
+  std::stable_sort(all.begin(), all.end(),
+            [](const Det& a, const Det& b) { return a.score > b.score; });
+  int kept = (int)all.size();
+  if (keep_top_k > -1 && kept > keep_top_k) kept = keep_top_k;
+  const int n = kept < out_cap ? kept : out_cap;
+  for (int i = 0; i < n; ++i) {
+    const Det& d = all[i];
+    float* row = out + 6 * i;
+    row[0] = (float)d.cls;
+    row[1] = d.score;
+    std::memcpy(row + 2, boxes + 4 * d.idx, 4 * sizeof(float));
+  }
+  return kept;
+}
+
+// Batch driver: boxes (N,M,4), scores (N,C,M). Writes each image's rows
+// contiguously into `out` (capacity out_cap rows total) and the per-image
+// counts into `counts` (N entries) — the LoD offsets are the running sum.
+// Returns total rows, or -1 if `out` was too small.
+int pt_multiclass_nms_batch(const float* boxes, const float* scores, int n,
+                            int m, int c, float score_thresh,
+                            float nms_thresh, float eta, int nms_top_k,
+                            int keep_top_k, int background_label,
+                            int normalized, float* out, int out_cap,
+                            int* counts) {
+  int total = 0;
+  for (int i = 0; i < n; ++i) {
+    int kept = pt_multiclass_nms(
+        boxes + (size_t)i * m * 4, scores + (size_t)i * c * m, m, c,
+        score_thresh, nms_thresh, eta, nms_top_k, keep_top_k,
+        background_label, normalized, out + (size_t)total * 6,
+        out_cap - total);
+    if (kept > out_cap - total) return -1;
+    counts[i] = kept;
+    total += kept;
+  }
+  return total;
+}
+
+}  // extern "C"

@@ -10,6 +10,7 @@ decoding.py.
 
 from .predictor import Predictor, create_predictor, AnalysisConfig
 from .decoding import greedy_decode, beam_decode
+from .postprocess import multiclass_nms_host
 
 __all__ = ["Predictor", "create_predictor", "AnalysisConfig",
-           "greedy_decode", "beam_decode"]
+           "greedy_decode", "beam_decode", "multiclass_nms_host"]

@@ -14,24 +14,14 @@ falls back to ImportError for callers that want to gate on availability.
 import ctypes
 import os
 import struct
-import subprocess
 import threading
 
 import numpy as np
 
-_CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
-_SO = os.path.join(_CSRC, "build", "libprefetch.so")
+from ..utils.native import CSRC_DIR as _CSRC, build_and_load
 
 _lib = None
 _lib_lock = threading.Lock()
-
-
-def _build_so():
-    os.makedirs(os.path.dirname(_SO), exist_ok=True)
-    src = os.path.join(_CSRC, "prefetch.cc")
-    cmd = ["g++", "-O2", "-fPIC", "-shared", "-pthread", "-std=c++17",
-           src, "-o", _SO]
-    subprocess.run(cmd, check=True, capture_output=True)
 
 
 def load_library():
@@ -40,12 +30,7 @@ def load_library():
     with _lib_lock:
         if _lib is not None:
             return _lib
-        src = os.path.join(_CSRC, "prefetch.cc")
-        if not os.path.exists(_SO) or (
-                os.path.exists(src)
-                and os.path.getmtime(src) > os.path.getmtime(_SO)):
-            _build_so()  # (re)build when the source is newer
-        lib = ctypes.CDLL(_SO)
+        lib = build_and_load("prefetch.cc", "libprefetch.so")
         lib.pt_ring_create.restype = ctypes.c_void_p
         lib.pt_ring_create.argtypes = [ctypes.c_size_t, ctypes.c_size_t]
         lib.pt_ring_destroy.argtypes = [ctypes.c_void_p]

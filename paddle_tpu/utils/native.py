@@ -1,0 +1,28 @@
+"""Shared build-on-first-use loader for csrc/ native libraries.
+
+One place for the mkdir + mtime-compare + g++ + CDLL sequence so the
+prefetch ring (reader/native.py) and the NMS kernel
+(inference/postprocess.py) can't drift in build flags.
+"""
+
+import ctypes
+import os
+import subprocess
+
+CSRC_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
+CXXFLAGS = ("-O2", "-fPIC", "-shared", "-pthread", "-std=c++17")
+
+
+def build_and_load(src_name, so_name):
+    """Compile csrc/<src_name> into csrc/build/<so_name> when missing or
+    stale, then dlopen it. Raises on compile failure — callers decide
+    whether to fall back."""
+    src = os.path.join(CSRC_DIR, src_name)
+    so = os.path.join(CSRC_DIR, "build", so_name)
+    if not os.path.exists(so) or (
+            os.path.exists(src)
+            and os.path.getmtime(src) > os.path.getmtime(so)):
+        os.makedirs(os.path.dirname(so), exist_ok=True)
+        subprocess.run(["g++", *CXXFLAGS, src, "-o", so],
+                       check=True, capture_output=True)
+    return ctypes.CDLL(so)
