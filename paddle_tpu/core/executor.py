@@ -252,6 +252,21 @@ class Executor:
                 param_names = [n for n in marker.attr("params") if n in env]
                 base_env = {k: v for k, v in env.items() if k not in param_names}
 
+                # Forward results that stay live past the backward: what
+                # the optimizer section reads, what run() fetches, and the
+                # persistables (e.g. batch-norm running stats written in
+                # the forward). Everything else is returned nowhere, so a
+                # remat policy is free to discard it — without this
+                # pruning the aux dict would pin every intermediate as a
+                # checkpoint output and jax.checkpoint could save nothing.
+                post_reads = set()
+                for op in gb.ops[marker_idx + 1:]:
+                    post_reads.update(op.input_names)
+                # "@RNG@" is an implicit read (OpContext.rng()), never in
+                # input_names — optimizer-section ops like dpsgd need it
+                keep_names = (set(fetch_names) | set(persist_names)
+                              | set(post_reads) | {loss_name, "@RNG@"})
+
                 if pipelined_fwd is not None:
                     feed_keys = set(feeds)
 
@@ -264,7 +279,8 @@ class Executor:
                         env2 = dict(base_env)
                         env2.update(params)
                         env2[loss_name] = loss
-                        return loss, env2
+                        return loss, {k: v for k, v in env2.items()
+                                      if k in keep_names}
                 else:
                     def fwd(params):
                         env2 = dict(base_env)
@@ -272,7 +288,17 @@ class Executor:
                         for op in gb.ops[:marker_idx]:
                             ops_registry.run_op(op, env2, program, is_test)
                         loss = jnp.sum(env2[loss_name])
-                        return loss, env2
+                        return loss, {k: v for k, v in env2.items()
+                                      if k in keep_names}
+
+                rcfg = getattr(program, "_recompute", None)
+                if rcfg is not None:
+                    # Remat: backward rebuilds the forward under the XLA
+                    # policy instead of saving every intermediate
+                    # (optimizer/recompute.py; HBM-for-FLOPs trade).
+                    from ..optimizer.recompute import resolve_policy
+                    fwd = jax.checkpoint(
+                        fwd, policy=resolve_policy(rcfg["policy"]))
 
                 params = {n: env[n] for n in param_names}
                 (loss_val, env), grads = jax.value_and_grad(

@@ -13,6 +13,7 @@ from .optimizers import (Optimizer, SGDOptimizer, MomentumOptimizer,
 from .dgc import DGCMomentumOptimizer
 from .wrappers import (ExponentialMovingAverage, ModelAverage,
                        LookaheadOptimizer)
+from .recompute import RecomputeOptimizer
 from .regularizer import (L1Decay, L2Decay, L1DecayRegularizer,
                           L2DecayRegularizer, WeightDecayRegularizer)
 from . import clip

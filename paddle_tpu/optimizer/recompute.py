@@ -1,0 +1,71 @@
+"""Rematerialization (recompute) — trade FLOPs for HBM.
+
+The reference fluid 1.5 has no recompute optimizer (it arrived in later
+releases as RecomputeOptimizer with manual checkpoint variables); on TPU
+the capability is first-class because HBM, not FLOPs, bounds batch size.
+TPU-native design: instead of naming checkpoint variables and re-emitting
+forward ops (the later-fluid mechanism), the Executor wraps the traced
+forward in `jax.checkpoint` with an XLA remat policy — the compiler picks
+what to save and what to recompute:
+
+    dots      save matmul/conv outputs, recompute elementwise chains
+              (the standard transformer recipe: ~0 extra matmul FLOPs,
+              activations between dots are rebuilt on the fly)
+    nothing   save only inputs; recompute everything in the backward
+    offload   save dots to host memory, stream back in the backward
+
+Usage keeps the later-fluid shape for familiarity:
+
+    opt = fluid.optimizer.RecomputeOptimizer(
+        fluid.optimizer.AdamOptimizer(1e-3), policy="dots")
+    opt.minimize(loss)
+"""
+
+import jax
+
+_POLICIES = {
+    "dots": lambda: jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "nothing": lambda: jax.checkpoint_policies.nothing_saveable,
+    "everything": lambda: jax.checkpoint_policies.everything_saveable,
+    "offload": lambda: jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+        "device", "pinned_host"),
+}
+
+
+def resolve_policy(name):
+    if name not in _POLICIES:
+        raise ValueError(f"unknown remat policy {name!r}; "
+                         f"one of {sorted(_POLICIES)}")
+    return _POLICIES[name]()
+
+
+class RecomputeOptimizer:
+    """Wraps an optimizer; minimize() additionally tags the program for
+    forward rematerialization (consumed by Executor._build)."""
+
+    def __init__(self, optimizer, policy="dots", checkpoints=None):
+        # `checkpoints` (manual checkpoint vars) is accepted for API
+        # familiarity but unused: the policy tells XLA what to save.
+        self._inner = optimizer
+        self._policy = policy
+        resolve_policy(policy)  # validate eagerly
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def _set_checkpoints(self, checkpoints):
+        pass  # later-fluid API shape; policy-driven here
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        import inspect
+        # wrappers (Lookahead, ModelAverage) accept fewer kwargs
+        accepted = inspect.signature(self._inner.minimize).parameters
+        kwargs = {k: v for k, v in
+                  (("startup_program", startup_program),
+                   ("parameter_list", parameter_list),
+                   ("no_grad_set", no_grad_set))
+                  if k in accepted}
+        result = self._inner.minimize(loss, **kwargs)
+        loss.block.program._recompute = {"policy": self._policy}
+        return result

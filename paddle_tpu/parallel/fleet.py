@@ -148,6 +148,10 @@ class DistributedOptimizer:
                   if k in accepted}
         result = opt.minimize(loss, **kwargs)
         program = loss.block.program
+        if s.recompute:
+            program._recompute = {
+                "policy": s.recompute if isinstance(s.recompute, str)
+                else "dots"}
         if s.tp_degree > 1 or s.sp_degree > 1:
             apply_shard_rules(program)
         if s.use_fsdp or s.zero_stage >= 3:
