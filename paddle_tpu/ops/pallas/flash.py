@@ -27,6 +27,15 @@ from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
 
+# Per-row scalars (logsumexp, delta) are stored lane-padded as
+# (..., T, LSE_LANES) instead of (..., T): TPU Pallas requires a block's
+# last two dims to be (8k, 128m) or equal to the array dims, so a (1, bq)
+# block of a 2-D array cannot lower. 8 here lowers via the
+# block-dim-equals-array-dim escape hatch (the trailing dim is whole),
+# NOT an 8-lane hardware rule — any value whose dim is never blocked
+# works; the jax.experimental reference kernel uses 128.
+LSE_LANES = 8
+
 # Incremented each time flash_attention is TRACED — bench.py asserts the
 # flash path actually engaged for the headline model (VERDICT r1 weak #7).
 TRACE_COUNT = 0
@@ -116,7 +125,8 @@ def _fwd_kernel(*refs, scale, causal, block_k, q_len, kv_len,
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))          # (block_q, 1)
+    lse_ref[0] = jnp.broadcast_to(lse, (block_q, LSE_LANES))
 
 
 def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k):
@@ -162,13 +172,15 @@ def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k):
         grid=grid,
         in_specs=in_specs,
         out_specs=[pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
-                   pl.BlockSpec((1, bq), lambda bh, i: (bh, i))],
+                   pl.BlockSpec((1, bq, LSE_LANES),
+                                lambda bh, i: (bh, i, 0))],
         out_shape=[jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
-                   jax.ShapeDtypeStruct((b * h, tq_p), jnp.float32)],
+                   jax.ShapeDtypeStruct((b * h, tq_p, LSE_LANES),
+                                        jnp.float32)],
         interpret=_interpret(),
     )(*operands)
     out = out[:, :tq].reshape(b, h, tq, d)
-    lse = lse[:, :tq].reshape(b, h, tq)
+    lse = lse[:, :tq, 0].reshape(b, h, tq)
     return out, lse
 
 
@@ -185,8 +197,8 @@ def _dq_kernel(*refs, scale, causal, block_k, q_len, kv_len,
         b_ref = None
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]
-    dlt = dlt_ref[0][:, None]
+    lse = lse_ref[0][:, 0:1]
+    dlt = dlt_ref[0][:, 0:1]
     block_q, d = q.shape
     q0 = pl.program_id(1) * block_q
     num_kb = pl.cdiv(kv_len, block_k)
@@ -231,8 +243,8 @@ def _dkv_kernel(*refs, scale, causal, block_q, q_len, kv_len,
         q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
         do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(
             jnp.float32)
-        lse_blk = lse_ref[0, pl.ds(qb * block_q, block_q)][:, None]
-        dlt_blk = dlt_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        lse_blk = lse_ref[0, pl.ds(qb * block_q, block_q), 0:1]
+        dlt_blk = dlt_ref[0, pl.ds(qb * block_q, block_q), 0:1]
         s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * scale
         if b_ref is not None:
             if bias_per_q:
@@ -273,8 +285,12 @@ def _flash_bwd(q, k, v, bias, lse, out, do, scale, causal, block_q, block_k,
     do_p = _pad_to(do, 2, bq).reshape(b * h, -1, d)
     k_p = _pad_to(k, 2, bk).reshape(b * h, -1, d)
     v_p = _pad_to(v, 2, bk).reshape(b * h, -1, d)
-    lse_p = _pad_to(lse.reshape(b * h, tq), 1, bq)
-    dlt_p = _pad_to(delta.reshape(b * h, tq), 1, bq)
+    def lane_pad(x):  # (b*h, tq) -> (b*h, tq_padded, LSE_LANES)
+        x = _pad_to(x, 1, bq)
+        return jnp.broadcast_to(x[..., None], x.shape + (LSE_LANES,))
+
+    lse_p = lane_pad(lse.reshape(b * h, tq))
+    dlt_p = lane_pad(delta.reshape(b * h, tq))
     tq_p, tk_p = q_p.shape[1], k_p.shape[1]
 
     has_bias = bias is not None
@@ -304,8 +320,8 @@ def _flash_bwd(q, k, v, bias, lse, out, do, scale, causal, block_q, block_k,
                 (1, 1, tk_p), lambda bh, i, f=bidx: (f(bh), 0, 0)))
         operands.append(bias3)
     in_specs += [
-        pl.BlockSpec((1, bq), lambda bh, i: (bh, i)),
-        pl.BlockSpec((1, bq), lambda bh, i: (bh, i)),
+        pl.BlockSpec((1, bq, LSE_LANES), lambda bh, i: (bh, i, 0)),
+        pl.BlockSpec((1, bq, LSE_LANES), lambda bh, i: (bh, i, 0)),
         pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
     ]
     operands += [lse_p, dlt_p, do_p]
@@ -336,8 +352,8 @@ def _flash_bwd(q, k, v, bias, lse, out, do, scale, causal, block_q, block_k,
                 (1, 1, bk), lambda bh, j, f=bidx: (f(bh), 0, j)))
         operands.append(bias3)
     in_specs += [
-        pl.BlockSpec((1, tq_p), lambda bh, j: (bh, 0)),
-        pl.BlockSpec((1, tq_p), lambda bh, j: (bh, 0)),
+        pl.BlockSpec((1, tq_p, LSE_LANES), lambda bh, j: (bh, 0, 0)),
+        pl.BlockSpec((1, tq_p, LSE_LANES), lambda bh, j: (bh, 0, 0)),
         pl.BlockSpec((1, tq_p, d), lambda bh, j: (bh, 0, 0)),
     ]
     operands += [lse_p, dlt_p, do_p]
