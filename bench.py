@@ -150,10 +150,16 @@ def bench_one(batch, seq_len, n_steps):
     from paddle_tpu.ops.pallas import flash
 
     trace0 = flash.TRACE_COUNT
+    t_build0 = time.perf_counter()
     step, tokens_per_step, step_flops = build_step(batch, seq_len)
+    t_build = time.perf_counter() - t_build0
     # warmup: first call compiles (~20-40s on TPU), second confirms cache
+    t_c0 = time.perf_counter()
     step()
+    t_compile = time.perf_counter() - t_c0
     step()
+    print(f"bench: batch={batch} build {t_build:.1f}s "
+          f"compile+first-step {t_compile:.1f}s", file=sys.stderr)
     flash_engaged = flash.TRACE_COUNT > trace0
 
     t0 = time.perf_counter()

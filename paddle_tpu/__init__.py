@@ -13,7 +13,8 @@ from .core import (framework, unique_name)
 from .core.framework import (Program, Variable, Parameter, program_guard,
                              name_scope, default_main_program,
                              default_startup_program, in_dygraph_mode)
-from .core.place import (CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace,
+from .core.place import (cuda_pinned_places,
+                         CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace,
                          cpu_places, cuda_places, tpu_places,
                          is_compiled_with_cuda, is_compiled_with_tpu)
 from .core.executor import Executor, Scope, global_scope, scope_guard

@@ -80,3 +80,11 @@ cuda_places = tpu_places
 
 def cpu_places(device_count=1):
     return [CPUPlace() for _ in range(device_count)]
+
+
+def cuda_pinned_places(device_count=None):
+    """Parity: fluid.cuda_pinned_places. Pinned host staging is managed by
+    the runtime (the C++ prefetch ring + XLA's transfer manager), so these
+    are plain host places."""
+    n = device_count if device_count else 1
+    return [CUDAPinnedPlace() for _ in range(n)]

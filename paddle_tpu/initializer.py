@@ -209,3 +209,20 @@ def _global_weight_initializer():
 
 def _global_bias_initializer():
     return ConstantInitializer(0.0)
+
+
+def force_init_on_cpu():
+    """Parity: fluid.initializer.force_init_on_cpu. XLA owns placement —
+    initializers run inside the startup executable on the target device;
+    there is no host-pinning concern, so this is always False."""
+    return False
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def init_on_cpu():
+    """Parity shim: fluid.initializer.init_on_cpu — a no-op context; see
+    force_init_on_cpu."""
+    yield

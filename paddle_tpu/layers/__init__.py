@@ -5,9 +5,10 @@ so `layers.fc(...)`, `layers.data(...)` etc. work like the reference.
 """
 
 from .io import (data, fluid_data, py_reader, create_py_reader_by_data,
-                 double_buffer, read_file, PyReader)
+                 double_buffer, read_file, load, PyReader)
 from .nn import *          # noqa: F401,F403
-from .tensor import (create_tensor, create_parameter, create_global_var,
+from .tensor import (tensor_array_to_tensor,
+                     create_tensor, create_parameter, create_global_var,
                      fill_constant, fill_constant_batch_size_like, assign,
                      zeros, ones, zeros_like, ones_like, sums, linspace,
                      range, eye, diag, reverse, has_inf, has_nan, isfinite,
@@ -34,13 +35,16 @@ from .sequence import (sequence_mask, sequence_pad, sequence_unpad,
                        sequence_reverse, sequence_conv, sequence_concat,
                        sequence_slice, sequence_enumerate, sequence_reshape)
 from . import control_flow
-from .control_flow import (While, Switch, IfElse, StaticRNN, cond, case,
+from .control_flow import (Print, DynamicRNN,
+                           reorder_lod_tensor_by_rank,
+                           While, Switch, IfElse, StaticRNN, cond, case,
                            switch_case, increment, array_write, array_read,
                            array_length, create_array, less_than, less_equal,
                            greater_than, greater_equal, equal, not_equal,
                            is_empty, autoincreased_step_counter, while_loop)
 from . import rnn
-from .rnn import (dynamic_lstm, dynamic_gru, lstm, gru, lstm_unit, gru_unit)
+from .rnn import (dynamic_lstm, dynamic_lstmp, dynamic_gru, lstm, gru,
+                  lstm_unit, gru_unit)
 from . import attention
 from .attention import (scaled_dot_product_attention, multi_head_attention,
                         add_position_encoding)
@@ -54,7 +58,10 @@ from .detection import (prior_box, density_prior_box, box_coder,
                         bipartite_match, target_assign, box_clip,
                         polygon_box_transform, retinanet_detection_output,
                         sigmoid_focal_loss, distribute_fpn_proposals,
-                        collect_fpn_proposals)
+                        collect_fpn_proposals, generate_proposals,
+                        rpn_target_assign, retinanet_target_assign,
+                        generate_proposal_labels, box_decoder_and_assign,
+                        multiclass_nms2)
 from .nn import topk as top_k  # fluid exposes both spellings
 from . import distributions
 from .math_op_patch import monkey_patch_variable

@@ -500,3 +500,126 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None):
                      {"Out": outs}, {"cond_fn": key_c, "body_fn": key_b,
                                      "n_vars": n})
     return outs
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Parity: fluid.layers.Print — logs the tensor from inside the jitted
+    step (jax.debug.print host tap; the step remains one XLA executable).
+    Returns the input unchanged so it composes like the reference op."""
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op("print", {"X": input}, {"Out": out},
+                     {"message": message or "",
+                      "print_tensor_name": print_tensor_name,
+                      "print_tensor_shape": print_tensor_shape,
+                      "print_tensor_value": True})
+    return out
+
+
+class DynamicRNN:
+    """Parity: fluid.layers.DynamicRNN (ref layers/control_flow.py).
+
+    The reference walks LoD sequences with a shrinking batch (sorted by
+    length); TPU-native this is the padded-batch form of the same
+    contract (SURVEY.md design decision 4): step inputs are (B, T, ...)
+    batch-major plus an explicit `length` tensor, the whole loop lowers
+    to ONE lax.scan via StaticRNN, and memory updates freeze once a row's
+    length is passed — exactly the LoD semantics, at fixed shapes.
+    Outputs come back (B, T, ...) zero-padded past each row's length.
+    """
+
+    def __init__(self, name=None):
+        self._rnn = StaticRNN(name=name or "dynamic_rnn")
+        self._lengths = None
+        self._mask_inner = None
+        self._maxlen = None
+        self._outputs = []
+
+    @contextlib.contextmanager
+    def block(self):
+        with self._rnn.step():
+            yield
+
+    @contextlib.contextmanager
+    def _in_parent_block(self):
+        # prep ops (transpose, mask) belong OUTSIDE the scan body — same
+        # block-switch trick as StaticRNN.memory's init constants
+        program = self._rnn.helper.main_program
+        saved = program.current_block_idx
+        program.current_block_idx = self._rnn._parent.idx
+        try:
+            yield
+        finally:
+            program.current_block_idx = saved
+
+    def step_input(self, x, level=0, length=None):
+        """x: (B, T, ...); `length` (B,) must accompany the first step
+        input (the padded replacement for LoD lod levels)."""
+        from . import nn as nn_layers
+        from . import sequence as seq_layers
+        with self._in_parent_block():
+            perm = [1, 0] + list(range(2, len(x.shape)))
+            xt = nn_layers.transpose(x, perm=perm)  # (T, B, ...)
+            mt = None
+            if length is not None and self._mask_inner is None:
+                self._lengths = length
+                self._maxlen = x.shape[1]
+                m = seq_layers.sequence_mask(length, maxlen=self._maxlen,
+                                             dtype="float32")   # (B, T)
+                mt = nn_layers.transpose(m, perm=[1, 0])         # (T, B)
+        inner = self._rnn.step_input(xt)
+        if mt is not None:
+            self._mask_inner = self._rnn.step_input(mt)
+        return inner
+
+    def static_input(self, x):
+        return x
+
+    def memory(self, init=None, shape=None, value=0.0, need_reorder=False,
+               dtype="float32", batch_ref=None):
+        return self._rnn.memory(init=init, shape=shape, value=value,
+                                dtype=dtype, batch_ref=batch_ref)
+
+    def update_memory(self, ex_mem, new_mem):
+        from . import nn as nn_layers
+        from .. import layers as L
+        if self._mask_inner is not None:
+            # new = ex + m * (new - ex): frozen once the row's length passes
+            m = nn_layers.unsqueeze(self._mask_inner, axes=[-1])  # (B, 1)
+            diff = L.elementwise_sub(new_mem, ex_mem)
+            new_mem = L.elementwise_add(ex_mem, L.elementwise_mul(diff, m))
+        self._rnn.update_memory(ex_mem, new_mem)
+
+    def output(self, *outputs):
+        self._outputs.extend(outputs)
+        self._rnn.output(*outputs)
+
+    def __call__(self):
+        from . import nn as nn_layers
+        from . import sequence as seq_layers
+        from .. import layers as L
+        outs = self._rnn()
+        outs = outs if isinstance(outs, list) else [outs]
+        fixed = []
+        for o in outs[:len(self._outputs)]:
+            perm = [1, 0] + list(range(2, len(o.shape)))
+            ob = nn_layers.transpose(o, perm=perm)  # (B, T, ...)
+            if self._lengths is not None:
+                m = seq_layers.sequence_mask(
+                    self._lengths, maxlen=self._maxlen, dtype="float32")
+                for _ in range(len(ob.shape) - 2):
+                    m = nn_layers.unsqueeze(m, axes=[-1])
+                ob = L.elementwise_mul(ob, m)
+            fixed.append(ob)
+        return fixed[0] if len(fixed) == 1 else fixed
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """Parity shim: fluid.layers.reorder_lod_tensor_by_rank. The
+    length-sorted shrinking-batch execution it supported does not exist
+    here (padded batches + masks run every row in lockstep), so no
+    reorder is ever needed; returns x unchanged."""
+    return x

@@ -9,7 +9,10 @@ LoD outputs.
 
 from ..core.layer_helper import LayerHelper
 
-__all__ = ["prior_box", "density_prior_box", "box_coder", "iou_similarity",
+__all__ = ["generate_proposals", "rpn_target_assign",
+           "retinanet_target_assign", "generate_proposal_labels",
+           "box_decoder_and_assign", "multiclass_nms2",
+           "prior_box", "density_prior_box", "box_coder", "iou_similarity",
            "multiclass_nms", "yolo_box", "roi_pool", "roi_align",
            "psroi_pool", "ssd_loss", "multi_box_head", "detection_output"]
 
@@ -374,4 +377,164 @@ def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
                      {"MultiLevelRois": list(multi_rois),
                       "MultiLevelScores": list(multi_scores)},
                      {"FpnRois": out}, {"post_nms_topN": post_nms_top_n})
+    return out
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None):
+    """Parity: fluid.layers.generate_proposals. Static outputs:
+    rois (N, post_nms_top_n, 4) padded with -1 rows + probs."""
+    helper = LayerHelper("generate_proposals", name=name)
+    n = scores.shape[0]
+    rois = helper.create_variable_for_type_inference(
+        "float32", (n, post_nms_top_n, 4))
+    probs = helper.create_variable_for_type_inference(
+        "float32", (n, post_nms_top_n, 1))
+    helper.append_op("generate_proposals",
+                     {"Scores": scores, "BboxDeltas": bbox_deltas,
+                      "ImInfo": im_info, "Anchors": anchors,
+                      "Variances": variances},
+                     {"RpnRois": rois, "RpnRoiProbs": probs},
+                     {"pre_nms_topN": pre_nms_top_n,
+                      "post_nms_topN": post_nms_top_n,
+                      "nms_thresh": nms_thresh, "min_size": min_size})
+    return rois, probs
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """Parity: fluid.layers.rpn_target_assign. Static outputs per image:
+    rpn_batch_size_per_im sampled rows; padding rows carry zero weight
+    (the LoD-free replacement for the reference's index outputs)."""
+    helper = LayerHelper("rpn_target_assign")
+    n = gt_boxes.shape[0]
+    r = rpn_batch_size_per_im
+    c = cls_logits.shape[-1]
+    sp = helper.create_variable_for_type_inference("float32", (n, r, c))
+    lp = helper.create_variable_for_type_inference("float32", (n, r, 4))
+    tl = helper.create_variable_for_type_inference("int32", (n, r, 1))
+    tb = helper.create_variable_for_type_inference("float32", (n, r, 4))
+    iw = helper.create_variable_for_type_inference("float32", (n, r, 4))
+    sw = helper.create_variable_for_type_inference("float32", (n, r, 1))
+    helper.append_op("rpn_target_assign",
+                     {"BboxPred": bbox_pred, "ClsLogits": cls_logits,
+                      "Anchor": anchor_box, "GtBoxes": gt_boxes},
+                     {"PredictedScores": sp, "PredictedLocation": lp,
+                      "TargetLabel": tl, "TargetBBox": tb,
+                      "BBoxInsideWeight": iw, "ScoreWeight": sw},
+                     {"rpn_batch_size_per_im": rpn_batch_size_per_im,
+                      "rpn_fg_fraction": rpn_fg_fraction,
+                      "rpn_positive_overlap": rpn_positive_overlap,
+                      "rpn_negative_overlap": rpn_negative_overlap})
+    # extension over the reference 5-tuple: `sw` masks padded sample rows
+    # (weight 0) — the LoD-free replacement for shrinking index outputs;
+    # weight the objectness CE with it.
+    return sp, lp, tl, tb, iw, sw
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels=None, is_crowd=None,
+                            im_info=None, num_classes=1,
+                            positive_overlap=0.5, negative_overlap=0.4):
+    """Parity: fluid.layers.retinanet_target_assign — every anchor is
+    labeled (focal loss consumes all); weights carry the fg/valid masks."""
+    helper = LayerHelper("retinanet_target_assign")
+    n = gt_boxes.shape[0]
+    a = anchor_box.shape[0]
+    c = cls_logits.shape[-1]
+    sp = helper.create_variable_for_type_inference("float32", (n, a, c))
+    lp = helper.create_variable_for_type_inference("float32", (n, a, 4))
+    tl = helper.create_variable_for_type_inference("int32", (n, a, 1))
+    tb = helper.create_variable_for_type_inference("float32", (n, a, 4))
+    iw = helper.create_variable_for_type_inference("float32", (n, a, 4))
+    sw = helper.create_variable_for_type_inference("float32", (n, a, 1))
+    inputs = {"BboxPred": bbox_pred, "ClsLogits": cls_logits,
+              "Anchor": anchor_box, "GtBoxes": gt_boxes}
+    if gt_labels is not None:
+        inputs["GtLabels"] = gt_labels
+    helper.append_op("retinanet_target_assign", inputs,
+                     {"PredictedScores": sp, "PredictedLocation": lp,
+                      "TargetLabel": tl, "TargetBBox": tb,
+                      "BBoxInsideWeight": iw, "ScoreWeight": sw},
+                     {"retinanet": True,
+                      "rpn_positive_overlap": positive_overlap,
+                      "rpn_negative_overlap": negative_overlap})
+    return sp, lp, tl, tb, iw, sw
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd=None,
+                             gt_boxes=None, im_info=None,
+                             batch_size_per_im=256, fg_fraction=0.25,
+                             fg_thresh=0.5, bg_thresh_hi=0.5,
+                             bg_thresh_lo=0.0, bbox_reg_weights=None,
+                             class_nums=81, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False):
+    """Parity: fluid.layers.generate_proposal_labels. Static outputs
+    (N, batch_size_per_im, ...); label -1 marks padding rows."""
+    helper = LayerHelper("generate_proposal_labels")
+    n = rpn_rois.shape[0]
+    r = batch_size_per_im
+    rois = helper.create_variable_for_type_inference("float32", (n, r, 4))
+    labels = helper.create_variable_for_type_inference("int32", (n, r, 1))
+    tgts = helper.create_variable_for_type_inference(
+        "float32", (n, r, 4 * class_nums))
+    iw = helper.create_variable_for_type_inference(
+        "float32", (n, r, 4 * class_nums))
+    ow = helper.create_variable_for_type_inference(
+        "float32", (n, r, 4 * class_nums))
+    helper.append_op("generate_proposal_labels",
+                     {"RpnRois": rpn_rois, "GtClasses": gt_classes,
+                      "GtBoxes": gt_boxes},
+                     {"Rois": rois, "LabelsInt32": labels,
+                      "BboxTargets": tgts, "BboxInsideWeights": iw,
+                      "BboxOutsideWeights": ow},
+                     {"batch_size_per_im": batch_size_per_im,
+                      "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
+                      "bg_thresh_hi": bg_thresh_hi,
+                      "bg_thresh_lo": bg_thresh_lo,
+                      "bbox_reg_weights": list(bbox_reg_weights)
+                      if bbox_reg_weights else None,
+                      "class_nums": class_nums})
+    return rois, labels, tgts, iw, ow
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip=None, name=None):
+    """Parity: fluid.layers.box_decoder_and_assign."""
+    helper = LayerHelper("box_decoder_and_assign", name=name)
+    r, c4 = target_box.shape
+    decoded = helper.create_variable_for_type_inference("float32", (r, c4))
+    assigned = helper.create_variable_for_type_inference("float32", (r, 4))
+    inputs = {"PriorBox": prior_box, "TargetBox": target_box,
+              "BoxScore": box_score}
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = prior_box_var
+    helper.append_op("box_decoder_and_assign", inputs,
+                     {"DecodeBox": decoded, "OutputAssignBox": assigned},
+                     {"box_clip": box_clip})
+    return decoded, assigned
+
+
+def multiclass_nms2(bboxes, scores, score_threshold=0.01, nms_top_k=64,
+                    keep_top_k=100, nms_threshold=0.3, normalized=True,
+                    nms_eta=1.0, background_label=0, return_index=False,
+                    name=None):
+    """Parity: fluid.layers.multiclass_nms2 — multiclass_nms plus the
+    kept-row index channel."""
+    helper = LayerHelper("multiclass_nms2", name=name)
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    index = helper.create_variable_for_type_inference("int32")
+    helper.append_op("multiclass_nms2",
+                     {"BBoxes": bboxes, "Scores": scores},
+                     {"Out": out, "Index": index},
+                     {"score_threshold": score_threshold,
+                      "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+                      "nms_threshold": nms_threshold,
+                      "background_label": background_label})
+    if return_index:
+        return out, index
     return out

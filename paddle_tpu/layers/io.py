@@ -96,3 +96,19 @@ def read_file(reader):
     """Parity: fluid.layers.read_file — with PyReader feeding feed dicts,
     the feed slots ARE the read results."""
     return reader.feed_list
+
+
+def load(out, file_path, load_as_fp16=None):
+    """Parity: fluid.layers.load — load a save_op-produced file into `out`.
+
+    The reference appends a C++ load op; here the file (npy/npz single
+    array) is read host-side at build time and assigned, which keeps the
+    executor's step a pure device program."""
+    import numpy as np
+    from .tensor import assign
+    arr = np.load(file_path, allow_pickle=False)
+    if hasattr(arr, "files"):               # npz: single entry
+        arr = arr[arr.files[0]]
+    if load_as_fp16:
+        arr = arr.astype(np.float16)
+    return assign(arr, output=out)

@@ -1248,3 +1248,314 @@ def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True, out_val_if_empty=0):
 def shuffle_channel(x, group, name=None):
     """Parity: fluid.layers.shuffle_channel (ShuffleNet)."""
     return _simple_layer("shuffle_channel", {"X": x}, {"group": group})
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    """Parity: fluid.layers.adaptive_pool3d (NCDHW)."""
+    helper = LayerHelper("adaptive_pool3d", name=name)
+    ps = list(pool_size) if isinstance(pool_size, (list, tuple)) \
+        else [pool_size] * 3
+    out = helper.create_variable_for_type_inference(
+        input.dtype, tuple(input.shape[:2]) + tuple(ps))
+    helper.append_op("adaptive_pool3d", {"X": input}, {"Out": out},
+                     {"pool_size": ps, "pooling_type": pool_type})
+    return out
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     align_corners=True, align_mode=1):
+    """Parity: fluid.layers.resize_trilinear (NCDHW)."""
+    helper = LayerHelper("trilinear_interp", name=name)
+    if out_shape is not None:
+        od, oh, ow = (int(s) for s in out_shape)
+    else:
+        od, oh, ow = (int(s * scale) for s in input.shape[2:])
+    out = helper.create_variable_for_type_inference(
+        input.dtype, tuple(input.shape[:2]) + (od, oh, ow))
+    helper.append_op("trilinear_interp", {"X": input}, {"Out": out},
+                     {"out_d": od, "out_h": oh, "out_w": ow})
+    return out
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """Parity: fluid.layers.image_resize_short — resize so the SHORT side
+    equals out_short_len, keeping aspect ratio."""
+    h, w = input.shape[2], input.shape[3]
+    short = min(h, w)
+    oh = int(round(h * out_short_len / float(short)))
+    ow = int(round(w * out_short_len / float(short)))
+    if resample.upper() == "NEAREST":
+        return resize_nearest(input, out_shape=[oh, ow])
+    return resize_bilinear(input, out_shape=[oh, ow])
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """Parity: fluid.layers.unfold (im2col): (N,C,H,W) -> (N, C*kh*kw, L).
+    paddings may be 1, 2, or 4 ints ([top, left, bottom, right])."""
+    helper = LayerHelper("unfold", name=name)
+    ks = _pair(kernel_sizes)
+    st = _pair(strides)
+    pd = list(paddings) if isinstance(paddings, (list, tuple)) \
+        else [paddings, paddings]
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    dl = _pair(dilations)
+    n, c, h, w = x.shape
+    oh = (h + pd[0] + pd[2] - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
+    ow = (w + pd[1] + pd[3] - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
+    out = helper.create_variable_for_type_inference(
+        x.dtype, (n, c * ks[0] * ks[1], oh * ow))
+    helper.append_op("unfold", {"X": x}, {"Y": out},
+                     {"kernel_sizes": ks, "strides": st, "paddings": pd,
+                      "dilations": dl})
+    return out
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """Parity: fluid.layers.bilinear_tensor_product:
+    out[:, i] = x . W_i . y^T + b (one MXU einsum)."""
+    helper = LayerHelper("bilinear_tensor_product", name=name,
+                         param_attr=param_attr, bias_attr=bias_attr, act=act)
+    dx, dy = x.shape[-1], y.shape[-1]
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[size, dx, dy], dtype=x.dtype)
+    inputs = {"X": x, "Y": y, "Weight": w}
+    b = helper.create_parameter(attr=helper.bias_attr, shape=[size],
+                                dtype=x.dtype, is_bias=True)
+    if b is not None:
+        inputs["Bias"] = b
+    out = helper.create_variable_for_type_inference(
+        x.dtype, (x.shape[0], size))
+    helper.append_op("bilinear_tensor_product", inputs, {"Out": out}, {})
+    return helper.append_activation(out)
+
+
+def merge_selected_rows(x, name=None):
+    """Parity shim: SelectedRows is re-designed away (dense grads via XLA
+    scatter-add), so merging duplicate rows is the identity on device."""
+    helper = LayerHelper("merge_selected_rows", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op("merge_selected_rows", {"X": x}, {"Out": out}, {})
+    return out
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    helper = LayerHelper("get_tensor_from_selected_rows", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op("get_tensor_from_selected_rows", {"X": x},
+                     {"Out": out}, {})
+    return out
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """Parity: fluid.layers.lod_reset. LoD lives host-side here
+    (core/lod.py); on device this is the identity, the new lod is carried
+    as metadata for layer-level consumers."""
+    helper = LayerHelper("lod_reset")
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op("lod_reset", {"X": x} | ({"Y": y} if y is not None
+                                              else {}), {"Out": out},
+                     {"target_lod": list(target_lod) if target_lod else []})
+    out._lod_source = y if y is not None else target_lod
+    return out
+
+
+def lod_append(x, level):
+    helper = LayerHelper("lod_append")
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    inputs = {"X": x}
+    if not isinstance(level, (list, tuple)):
+        inputs["Y"] = level
+    helper.append_op("lod_append", inputs, {"Out": out}, {})
+    return out
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """Parity: fluid.layers.linear_chain_crf (ref layers/nn.py:1409).
+    Padded form: input (B, T, num_tags), label (B, T[, 1]), length (B,).
+    Returns the per-sequence negative log-likelihood (B, 1) — the cost
+    the reference feeds to the optimizer. The transition parameter is
+    [num_tags + 2, num_tags]: rows 0/1 are start/end scores."""
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr)
+    num_tags = input.shape[-1]
+    transition = helper.create_parameter(
+        attr=helper.param_attr, shape=[num_tags + 2, num_tags],
+        dtype="float32")
+    b = input.shape[0]
+    ll = helper.create_variable_for_type_inference("float32", (b, 1))
+    alpha = helper.create_variable_for_type_inference("float32", input.shape)
+    em_exps = helper.create_variable_for_type_inference("float32",
+                                                        input.shape)
+    tr_exps = helper.create_variable_for_type_inference(
+        "float32", (num_tags + 2, num_tags))
+    inputs = {"Emission": input, "Transition": transition, "Label": label}
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op("linear_chain_crf", inputs,
+                     {"LogLikelihood": ll, "Alpha": alpha,
+                      "EmissionExps": em_exps, "TransitionExps": tr_exps},
+                     {})
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    """Parity: fluid.layers.crf_decoding — Viterbi path (B, T) int64
+    (or per-position error flags when label is given). Pass the SAME
+    param_attr name used by linear_chain_crf so decoding reads the
+    trained transition."""
+    helper = LayerHelper("crf_decoding", param_attr=param_attr)
+    num_tags = input.shape[-1]
+    transition = helper.create_parameter(
+        attr=helper.param_attr, shape=[num_tags + 2, num_tags],
+        dtype="float32")
+    out = helper.create_variable_for_type_inference(
+        "int64", tuple(input.shape[:2]))
+    inputs = {"Emission": input, "Transition": transition}
+    if label is not None:
+        inputs["Label"] = label
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op("crf_decoding", inputs, {"ViterbiPath": out}, {})
+    return out
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            input_length=None, label_length=None):
+    """Parity: fluid.layers.warpctc. Padded form: input (B, T, C) raw
+    logits, label (B, L) padded. Returns per-sequence CTC loss (B, 1).
+    (The reference's LoD form maps to the length args here —
+    design decision 4.)"""
+    helper = LayerHelper("warpctc")
+    b = input.shape[0]
+    loss = helper.create_variable_for_type_inference("float32", (b, 1))
+    inputs = {"Logits": input, "Label": label}
+    if input_length is not None:
+        inputs["LogitsLength"] = input_length
+    if label_length is not None:
+        inputs["LabelLength"] = label_length
+    helper.append_op("warpctc", inputs, {"Loss": loss},
+                     {"blank": blank, "norm_by_times": norm_by_times})
+    return loss
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, name=None):
+    """Parity: fluid.layers.ctc_greedy_decoder — argmax, merge repeats,
+    drop blanks. Returns (decoded (B, T) int64 padded with -1,
+    lengths (B, 1)) — the padded replacement for the LoD output."""
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    b, t = input.shape[0], input.shape[1]
+    out = helper.create_variable_for_type_inference("int64", (b, t))
+    out_len = helper.create_variable_for_type_inference("int64", (b, 1))
+    inputs = {"Input": input}
+    if input_length is not None:
+        inputs["InputLength"] = input_length
+    helper.append_op("ctc_greedy_decoder", inputs,
+                     {"Output": out, "OutputLength": out_len},
+                     {"blank": blank})
+    return out, out_len
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Parity: fluid.layers.nce (ref layers/nn.py:5955). Returns the
+    per-row NCE cost (B, 1). is_sparse is moot on TPU (dense
+    scatter-add grads)."""
+    import numpy as np
+    helper = LayerHelper("nce", param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[num_total_classes, dim],
+                                dtype=input.dtype)
+    b = helper.create_parameter(attr=helper.bias_attr,
+                                shape=[num_total_classes], dtype=input.dtype,
+                                is_bias=True)
+    batch = input.shape[0]
+    num_true = label.shape[1] if len(label.shape) > 1 else 1
+    cost = helper.create_variable_for_type_inference("float32", (batch, 1))
+    s_logits = helper.create_variable_for_type_inference(
+        "float32", (batch, num_true + num_neg_samples))
+    s_labels = helper.create_variable_for_type_inference(
+        "int64", (batch, num_true + num_neg_samples))
+    inputs = {"Input": input, "Label": label, "Weight": w}
+    if b is not None:
+        inputs["Bias"] = b
+    if custom_dist is not None:
+        from . import tensor as tensor_layers
+        probs = tensor_layers.assign(
+            np.asarray(custom_dist, np.float32))
+        inputs["CustomDistProbs"] = probs
+        sampler = "custom_dist"
+    helper.append_op("nce", inputs,
+                     {"Cost": cost, "SampleLogits": s_logits,
+                      "SampleLabels": s_labels},
+                     {"num_total_classes": num_total_classes,
+                      "num_neg_samples": num_neg_samples,
+                      "sampler": sampler, "op_seed": seed})
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    """Parity: fluid.layers.hsigmoid (ref layers/nn.py:6169) — default
+    complete-binary-tree form. Custom trees (path_table/path_code) are
+    not supported; the default SimpleCode tree covers the book usage."""
+    if is_custom or path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "hsigmoid: custom trees are not supported; use the default "
+            "complete binary tree")
+    helper = LayerHelper("hsigmoid", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[num_classes - 1, dim],
+                                dtype=input.dtype)
+    b = helper.create_parameter(attr=helper.bias_attr,
+                                shape=[num_classes - 1], dtype=input.dtype,
+                                is_bias=True)
+    batch = input.shape[0]
+    max_depth = max(int(num_classes - 1).bit_length(), 1)
+    out = helper.create_variable_for_type_inference("float32", (batch, 1))
+    pre = helper.create_variable_for_type_inference("float32",
+                                                    (batch, max_depth))
+    inputs = {"X": input, "W": w, "Label": label}
+    if b is not None:
+        inputs["Bias"] = b
+    helper.append_op("hierarchical_sigmoid", inputs,
+                     {"Out": out, "PreOut": pre},
+                     {"num_classes": num_classes})
+    return out
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1, remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    """Parity: fluid.layers.sampled_softmax_with_cross_entropy
+    (ref layers/nn.py:6748): softmax CE over {true + log-uniform sampled}
+    classes with logQ correction. Returns loss (B, 1)."""
+    if use_customized_samples:
+        raise NotImplementedError(
+            "sampled_softmax_with_cross_entropy: customized samples are "
+            "not supported; the op draws its own log-uniform samples")
+    helper = LayerHelper("sampled_softmax_with_cross_entropy")
+    batch = logits.shape[0]
+    loss = helper.create_variable_for_type_inference("float32", (batch, 1))
+    samples = helper.create_variable_for_type_inference(
+        "int64", (batch, num_true + num_samples))
+    s_logits = helper.create_variable_for_type_inference(
+        "float32", (batch, num_true + num_samples))
+    helper.append_op("sample_logits", {"Logits": logits, "Labels": label},
+                     {"Loss": loss, "Samples": samples,
+                      "SampledLogits": s_logits},
+                     {"num_samples": num_samples,
+                      "remove_accidental_hits": remove_accidental_hits,
+                      "use_customized_samples": use_customized_samples,
+                      "op_seed": seed})
+    return loss

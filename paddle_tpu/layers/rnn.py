@@ -193,3 +193,52 @@ def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
     helper.append_op("gru_unit", inputs,
                      {"Hidden": h, "Gate": gate, "ResetHiddenPrev": rhp}, {})
     return h, rhp, gate
+
+
+def dynamic_lstmp(input, size, proj_size, length=None, h_0=None, c_0=None,
+                  param_attr=None, bias_attr=None, use_peepholes=True,
+                  is_reverse=False, gate_activation="sigmoid",
+                  cell_activation="tanh", candidate_activation="tanh",
+                  proj_activation="tanh", dtype="float32", name=None):
+    """Parity: fluid.layers.dynamic_lstmp — LSTM with recurrent
+    projection. size = 4 * hidden. Returns (projection (B, T, P),
+    cell (B, T, H))."""
+    helper = LayerHelper("dynamic_lstmp", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    hidden = size // 4
+    d = input.shape[-1]
+    w_x = helper.create_parameter(_suffixed(helper.param_attr, "wx"),
+                                  [d, 4 * hidden], dtype)
+    w_h = helper.create_parameter(_suffixed(helper.param_attr, "wh"),
+                                  [proj_size, 4 * hidden], dtype)
+    w_p = helper.create_parameter(_suffixed(helper.param_attr, "wp"),
+                                  [hidden, proj_size], dtype)
+    bias_len = 7 * hidden if use_peepholes else 4 * hidden
+    bias = helper.create_parameter(helper.bias_attr, [bias_len], dtype,
+                                   is_bias=True)
+    proj = helper.create_variable_for_type_inference(
+        dtype, tuple(input.shape[:2]) + (proj_size,))
+    cell = helper.create_variable_for_type_inference(
+        dtype, tuple(input.shape[:2]) + (hidden,))
+    r_last = helper.create_variable_for_type_inference(
+        dtype, (input.shape[0], proj_size))
+    c_last = helper.create_variable_for_type_inference(
+        dtype, (input.shape[0], hidden))
+    inputs = {"Input": input, "WeightX": w_x, "WeightH": w_h,
+              "ProjWeight": w_p, "Bias": bias}
+    if length is not None:
+        inputs["Length"] = length
+    if h_0 is not None:
+        inputs["H0"] = h_0
+    if c_0 is not None:
+        inputs["C0"] = c_0
+    helper.append_op("lstmp", inputs,
+                     {"Projection": proj, "Cell": cell, "LastH": r_last,
+                      "LastC": c_last},
+                     {"use_peepholes": use_peepholes,
+                      "is_reverse": is_reverse,
+                      "gate_activation": gate_activation,
+                      "cell_activation": cell_activation,
+                      "candidate_activation": candidate_activation,
+                      "proj_activation": proj_activation})
+    return proj, cell

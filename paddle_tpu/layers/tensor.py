@@ -236,3 +236,17 @@ def pad_constant_like(x, y, pad_value=0.0, name=None):
     helper.append_op("pad_constant_like", {"X": x, "Y": y}, {"Out": out},
                      {"pad_value": float(pad_value)})
     return out
+
+
+def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False):
+    """Parity: fluid.layers.tensor_array_to_tensor — concat (or stack) a
+    TensorArray along `axis`. Returns (out, index) like the reference,
+    where index holds each entry's size along `axis`."""
+    helper = LayerHelper("tensor_array_to_tensor", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    index = helper.create_variable_for_type_inference("int32")
+    helper.append_op("tensor_array_to_tensor", {"X": input},
+                     {"Out": out}, {"axis": axis, "use_stack": use_stack})
+    helper.append_op("tensor_array_sizes", {"X": input}, {"Out": index},
+                     {"axis": axis})
+    return out, index

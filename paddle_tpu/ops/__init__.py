@@ -14,6 +14,13 @@ import jax
 _REGISTRY = {}
 
 
+class TensorArray(list):
+    """The value of a LoDTensorArray var during tracing: a python list of
+    arrays with static length. A dedicated type so run_op can tell an
+    array VALUE (stored whole under one output name) apart from a
+    multi-output list (zipped across output names)."""
+
+
 def register(*names):
     def deco(fn):
         for n in names:
@@ -94,7 +101,8 @@ def run_op(op, env, program, is_test=False):
     if outs:
         for slot, vals in outs.items():
             names = op.output(slot)
-            if not isinstance(vals, (list, tuple)):
+            if not isinstance(vals, (list, tuple)) or \
+                    isinstance(vals, TensorArray):
                 vals = [vals]
             for name, val in zip(names, vals):
                 env[name] = val
@@ -117,3 +125,7 @@ from . import rnn_ops         # noqa: E402,F401
 from . import attention_ops   # noqa: E402,F401
 from . import beam_search_ops  # noqa: E402,F401
 from . import quant_ops       # noqa: E402,F401
+from . import crf_ops         # noqa: E402,F401
+from . import ctc_ops         # noqa: E402,F401
+from . import sampling_ops    # noqa: E402,F401
+from . import rcnn_ops        # noqa: E402,F401

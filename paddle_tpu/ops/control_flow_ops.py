@@ -37,18 +37,25 @@ def is_empty(ctx):
 
 @register("create_array")
 def create_array(ctx):
-    return {"Out": []}
+    from . import TensorArray
+    return {"Out": TensorArray()}
 
 
 @register("array_write")
 def array_write(ctx):
-    arr = list(ctx.in_("Array")) if ctx.has_in("Array") else []
+    from . import TensorArray
+    arr = TensorArray(ctx.in_("Array")) if ctx.has_in("Array") \
+        else TensorArray()
     i = int(ctx.attr("static_index", len(arr)))
     x = ctx.in_("X")
     if i == len(arr):
         arr.append(x)
-    else:
+    elif i < len(arr):
         arr[i] = x
+    else:
+        raise ValueError(
+            f"array_write index {i} skips entries (len={len(arr)}) — "
+            f"TensorArray writes must be dense during tracing")
     return {"Out": arr}
 
 
@@ -278,3 +285,31 @@ def contrib_beam_search_decoder(ctx):
         step_fn, cache0, init_ids, ctx.attr("max_len"), K,
         ctx.attr("end_id"), length_penalty=ctx.attr("length_penalty", 0.0))
     return {"Ids": ids, "Scores": scores}
+
+
+@register("print")
+def print_op(ctx):
+    """Parity: print_op (fluid.layers.Print) — host-side tensor logging
+    from inside the jitted step via jax.debug.print (tap, not transfer:
+    the step stays one XLA executable)."""
+    x = ctx.in_("X")
+    msg = ctx.attr("message", "") or ""
+    parts = []
+    if ctx.attr("print_tensor_name", True):
+        parts.append(ctx.op.input("X")[0])
+    fmt = msg + " ".join(parts)
+    if ctx.attr("print_tensor_shape", True):
+        fmt += f" shape={tuple(x.shape)}"
+    if ctx.attr("print_tensor_value", True):
+        fmt += " value={x}"
+        jax.debug.print(fmt, x=x)
+    else:
+        jax.debug.print(fmt)
+    return {"Out": x}
+
+
+@register("tensor_array_sizes")
+def tensor_array_sizes(ctx):
+    axis = ctx.attr("axis", 0)
+    return {"Out": jnp.asarray([x.shape[axis] for x in ctx.in_("X")],
+                               jnp.int32)}

@@ -1,0 +1,135 @@
+"""Linear-chain CRF: log-likelihood + Viterbi decoding.
+
+Parity: paddle/fluid/operators/linear_chain_crf_op.* and crf_decoding_op.*
+(layer API: python/paddle/fluid/layers/nn.py linear_chain_crf:1409,
+crf_decoding). The reference walks LoD sequences sequentially on the CPU;
+TPU-native both passes are batched `lax.scan`s over the padded time axis
+with per-sequence length masks (SURVEY.md design decision 4), so the
+whole batch advances one timestep per scan step on the VPU.
+
+Transition parameter layout matches the reference exactly:
+    transition[0]  = start scores   (alpha_0 contribution)
+    transition[1]  = end scores     (added at each sequence's last step)
+    transition[2:] = (N, N) matrix, [i, j] = score of tag i -> tag j.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import register
+
+
+def _split_transition(w):
+    return w[0], w[1], w[2:]          # start (N,), end (N,), trans (N, N)
+
+
+def _crf_inputs(ctx):
+    em = ctx.in_("Emission").astype(jnp.float32)     # (B, T, N)
+    w = ctx.in_("Transition").astype(jnp.float32)    # (N + 2, N)
+    length = ctx.in_("Length")
+    if length is None:
+        length = jnp.full((em.shape[0],), em.shape[1], jnp.int32)
+    return em, w, length.reshape(-1).astype(jnp.int32)
+
+
+@register("linear_chain_crf")
+def linear_chain_crf(ctx):
+    """Returns the negative log-likelihood per sequence (the cost the
+    reference's crf layer feeds to the optimizer), plus the alpha table
+    for parity with the reference's output set."""
+    em, w, length = _crf_inputs(ctx)
+    label = ctx.in_("Label")
+    if label.ndim == 3:
+        label = label[..., 0]
+    label = label.astype(jnp.int32)                   # (B, T)
+    b, t, n = em.shape
+    start, end, trans = _split_transition(w)
+
+    # ---- gold path score -------------------------------------------------
+    t_idx = jnp.arange(t)
+    valid = t_idx[None, :] < length[:, None]          # (B, T)
+    em_score = jnp.where(
+        valid, jnp.take_along_axis(em, label[..., None], -1)[..., 0], 0.0
+    ).sum(-1)
+    pair_valid = valid[:, 1:]                          # step t-1 -> t exists
+    pair = trans[label[:, :-1], label[:, 1:]]          # (B, T-1)
+    trans_score = jnp.where(pair_valid, pair, 0.0).sum(-1)
+    last = jnp.clip(length - 1, 0, t - 1)
+    last_tag = jnp.take_along_axis(label, last[:, None], 1)[:, 0]
+    score = em_score + trans_score + start[label[:, 0]] + end[last_tag]
+
+    # ---- partition function (forward algorithm) ---------------------------
+    def step(alpha, xs):
+        e_t, active = xs                               # (B, N), (B,)
+        nxt = jax.nn.logsumexp(
+            alpha[:, :, None] + trans[None], axis=1) + e_t
+        alpha = jnp.where(active[:, None], nxt, alpha)
+        return alpha, alpha
+
+    alpha0 = start[None] + em[:, 0]                    # (B, N)
+    active = (t_idx[None, 1:] < length[:, None]).T     # (T-1, B)
+    alpha_last, alphas = jax.lax.scan(
+        step, alpha0, (jnp.moveaxis(em[:, 1:], 1, 0), active))
+    log_z = jax.nn.logsumexp(alpha_last + end[None], axis=-1)
+
+    nll = (log_z - score)[:, None]                     # (B, 1)
+    alpha_full = jnp.concatenate([alpha0[:, None], jnp.moveaxis(alphas, 0, 1)],
+                                 axis=1)
+    return {"LogLikelihood": nll,
+            "Alpha": alpha_full,
+            "EmissionExps": jnp.exp(em),
+            "TransitionExps": jnp.exp(w)}
+
+
+@register("crf_decoding")
+def crf_decoding(ctx):
+    """Viterbi path (B, T) int64; positions past each length are 0.
+    With a Label input, returns per-position mismatch mask instead
+    (the reference's evaluation mode)."""
+    em, w, length = _crf_inputs(ctx)
+    b, t, n = em.shape
+    start, end, trans = _split_transition(w)
+    t_idx = jnp.arange(t)
+
+    def fwd(carry, xs):
+        delta = carry                                   # (B, N)
+        e_t, active = xs
+        cand = delta[:, :, None] + trans[None]          # (B, N, N)
+        best_prev = jnp.argmax(cand, axis=1)            # (B, N)
+        nxt = jnp.max(cand, axis=1) + e_t
+        delta = jnp.where(active[:, None], nxt, delta)
+        return delta, best_prev
+
+    delta0 = start[None] + em[:, 0]
+    active = (t_idx[None, 1:] < length[:, None]).T
+    delta_last, back = jax.lax.scan(
+        fwd, delta0, (jnp.moveaxis(em[:, 1:], 1, 0), active))
+    # end scores apply at each sequence's own last position: since padded
+    # steps freeze delta, delta_last IS each sequence's final delta.
+    last_tag = jnp.argmax(delta_last + end[None], axis=-1)  # (B,)
+
+    def bwd(tag, xs):
+        bp, step_t = xs                                  # (B, N), scalar
+        prev = jnp.take_along_axis(bp, tag[:, None], 1)[:, 0]
+        # only backtrack through steps that were active for this sequence
+        tag_out = jnp.where(step_t < length, prev, tag)
+        return tag_out, tag_out
+
+    # walk t-1 ... 1; emit the tag at each earlier position
+    steps = jnp.arange(1, t)
+    _, rev_tags = jax.lax.scan(bwd, last_tag, (back, steps), reverse=True)
+    path = jnp.concatenate([jnp.moveaxis(rev_tags, 0, 1),
+                            last_tag[:, None]], axis=1)  # (B, T)
+    # positions beyond length emit 0 (the reference's LoD output simply
+    # ends; padded form zero-fills)
+    path = jnp.where(t_idx[None] < length[:, None], path, 0)
+    path = path.astype(jnp.int64)
+
+    label = ctx.in_("Label")
+    if label is not None:
+        if label.ndim == 3:
+            label = label[..., 0]
+        err = (path != label.astype(path.dtype)) & \
+            (t_idx[None] < length[:, None])
+        return {"ViterbiPath": err.astype(jnp.int64)}
+    return {"ViterbiPath": path}

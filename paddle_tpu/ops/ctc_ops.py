@@ -1,0 +1,134 @@
+"""CTC: loss (warpctc parity) + greedy decoding.
+
+Parity: paddle/fluid/operators/warpctc_op.* (which wraps the warp-ctc
+CUDA library) and ctc_align_op (ctc_greedy_decoder). TPU-native: the
+alpha recursion runs in log space as ONE `lax.scan` over the padded time
+axis for the whole batch — the extended-label lattice (2L+1 states) is a
+static-shape tensor, per-sequence lengths are masks, and the backward
+comes for free from jax.grad through the scan (no hand-written beta
+pass, XLA differentiates the recursion).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import register
+
+NEG_INF = -1e30
+
+
+def _log_add(a, b):
+    # inputs are clamped BEFORE the math (double-where) so the untaken
+    # branch stays finite — otherwise d log(0+0) = inf * 0 = NaN leaks
+    # through the outer where in reverse mode
+    both = jnp.maximum(a, b) <= NEG_INF / 2
+    a2 = jnp.where(both, 0.0, a)
+    b2 = jnp.where(both, 0.0, b)
+    m = jnp.maximum(a2, b2)
+    out = m + jnp.log(jnp.exp(a2 - m) + jnp.exp(b2 - m))
+    return jnp.where(both, NEG_INF, out)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0):
+    """log_probs (B, T, C) log-softmaxed; labels (B, L) padded.
+    Returns per-sequence negative log-likelihood (B,)."""
+    b, t, c = log_probs.shape
+    l = labels.shape[1]
+    s = 2 * l + 1
+
+    # extended label sequence: blank, y1, blank, y2, ..., blank
+    ext = jnp.full((b, s), blank, labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)                    # (B, S)
+    # allowed skip: ext[i] != ext[i-2] (distinct consecutive labels)
+    skip_ok = jnp.concatenate(
+        [jnp.zeros((b, 2), bool), ext[:, 2:] != ext[:, :-2]], axis=1)
+    valid_state = jnp.arange(s)[None] < (2 * label_lengths + 1)[:, None]
+
+    def emit(lp_t):                                       # (B, C) -> (B, S)
+        return jnp.take_along_axis(lp_t, ext, axis=1)
+
+    alpha0 = jnp.full((b, s), NEG_INF)
+    alpha0 = alpha0.at[:, 0].set(log_probs[:, 0, blank])
+    first_lab = jnp.take_along_axis(log_probs[:, 0], ext[:, 1:2], 1)[:, 0]
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(label_lengths > 0, first_lab, NEG_INF))
+    alpha0 = jnp.where(valid_state, alpha0, NEG_INF)
+
+    def step(alpha, xs):
+        lp_t, t_i = xs                                    # (B, C), scalar
+        prev1 = jnp.concatenate(
+            [jnp.full((b, 1), NEG_INF), alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate(
+            [jnp.full((b, 2), NEG_INF), alpha[:, :-2]], axis=1)
+        a = _log_add(alpha, prev1)
+        a = jnp.where(skip_ok, _log_add(a, prev2), a)
+        a = a + emit(lp_t)
+        a = jnp.where(valid_state, a, NEG_INF)
+        active = (t_i < input_lengths)[:, None]
+        return jnp.where(active, a, alpha), None
+
+    alpha, _ = jax.lax.scan(
+        step, alpha0,
+        (jnp.moveaxis(log_probs[:, 1:], 1, 0), jnp.arange(1, t)))
+
+    # final states: last blank (2L) and last label (2L-1)
+    last_blank = jnp.take_along_axis(alpha, (2 * label_lengths)[:, None],
+                                     1)[:, 0]
+    idx = jnp.clip(2 * label_lengths - 1, 0, s - 1)
+    last_lab = jnp.where(label_lengths > 0,
+                         jnp.take_along_axis(alpha, idx[:, None], 1)[:, 0],
+                         NEG_INF)
+    return -_log_add(last_blank, last_lab)
+
+
+@register("warpctc")
+def warpctc(ctx):
+    """Logits (B, T, C) unnormalized (the op applies log-softmax, matching
+    warp-ctc's contract); Label (B, L) padded. Loss (B, 1)."""
+    logits = ctx.in_("Logits").astype(jnp.float32)
+    labels = ctx.in_("Label")
+    if labels.ndim == 3:
+        labels = labels[..., 0]
+    b, t, c = logits.shape
+    in_len = ctx.in_("LogitsLength")
+    in_len = (jnp.full((b,), t, jnp.int32) if in_len is None
+              else in_len.reshape(-1).astype(jnp.int32))
+    lab_len = ctx.in_("LabelLength")
+    lab_len = (jnp.full((b,), labels.shape[1], jnp.int32) if lab_len is None
+               else lab_len.reshape(-1).astype(jnp.int32))
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    loss = ctc_loss(lp, labels.astype(jnp.int32), in_len, lab_len,
+                    blank=ctx.attr("blank", 0))
+    if ctx.attr("norm_by_times", False):
+        loss = loss / jnp.maximum(in_len.astype(jnp.float32), 1.0)
+    return {"Loss": loss[:, None]}
+
+
+@register("ctc_align", "ctc_greedy_decoder")
+def ctc_align(ctx):
+    """Greedy decode: argmax per step, merge repeats, drop blanks.
+    Static-shape output (B, T) padded with -1 + per-sequence lengths —
+    the padded replacement for the reference's LoD output."""
+    x = ctx.in_("Input")                                  # (B, T, C) probs
+    blank = ctx.attr("blank", 0)
+    b, t, c = x.shape
+    ids = jnp.argmax(x, axis=-1)                          # (B, T)
+    in_len = ctx.in_("InputLength")
+    in_len = (jnp.full((b,), t, jnp.int32) if in_len is None
+              else in_len.reshape(-1).astype(jnp.int32))
+    step_valid = jnp.arange(t)[None] < in_len[:, None]
+    prev = jnp.concatenate([jnp.full((b, 1), -1, ids.dtype), ids[:, :-1]],
+                           axis=1)
+    keep = (ids != blank) & (ids != prev) & step_valid    # (B, T)
+
+    # stable left-compaction: position = rank among kept entries
+    pos = jnp.cumsum(keep, axis=1) - 1                    # (B, T)
+    out = jnp.full((b, t), -1, jnp.int64)
+    rows = jnp.repeat(jnp.arange(b)[:, None], t, 1)
+    out = out.at[rows, jnp.where(keep, pos, t - 1)].set(
+        jnp.where(keep, ids, -1).astype(jnp.int64), mode="drop")
+    # a kept id writing to its rank; discarded ones write -1 at t-1 — but
+    # that slot may hold a real value, so re-mask by count instead
+    count = keep.sum(axis=1)
+    out = jnp.where(jnp.arange(t)[None] < count[:, None], out, -1)
+    return {"Output": out, "OutputLength": count[:, None].astype(jnp.int64)}

@@ -245,13 +245,11 @@ def density_prior_box(ctx):
     return {"Boxes": priors, "Variances": var}
 
 
-def _nms_single(boxes, scores, score_thresh, nms_thresh, top_k):
-    """Static-shape class-wise NMS core: returns (keep_mask, order) for one
-    class. Runs as regular XLA ops (sort + O(K^2) IoU suppress over the
-    top_k candidates) — no host round-trip, TPU-friendly."""
-    k = min(top_k, scores.shape[0])
-    top_scores, order = jax.lax.top_k(scores, k)
-    cand = boxes[order]  # (K, 4)
+def _suppress_sorted(cand, top_scores, score_thresh, nms_thresh):
+    """Greedy IoU suppression over score-DESCENDING candidates (K, 4).
+    Returns the keep mask. O(K^2) IoU + a fori_loop sweep — regular XLA
+    ops, no host round-trip."""
+    k = cand.shape[0]
     x1, y1, x2, y2 = cand[:, 0], cand[:, 1], cand[:, 2], cand[:, 3]
     area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
     ix1 = jnp.maximum(x1[:, None], x1[None, :])
@@ -266,7 +264,16 @@ def _nms_single(boxes, scores, score_thresh, nms_thresh, top_k):
         return keep & ~sup
 
     keep = top_scores > score_thresh
-    keep = jax.lax.fori_loop(0, k, body, keep)
+    return jax.lax.fori_loop(0, k, body, keep)
+
+
+def _nms_single(boxes, scores, score_thresh, nms_thresh, top_k):
+    """Static-shape class-wise NMS core: returns (keep_mask, order,
+    sorted_scores) over the top_k candidates."""
+    k = min(top_k, scores.shape[0])
+    top_scores, order = jax.lax.top_k(scores, k)
+    cand = boxes[order]  # (K, 4)
+    keep = _suppress_sorted(cand, top_scores, score_thresh, nms_thresh)
     return keep, order, top_scores
 
 

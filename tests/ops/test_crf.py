@@ -1,0 +1,116 @@
+"""Linear-chain CRF tests: NLL vs brute-force enumeration, Viterbi vs
+brute-force argmax, variable lengths, and end-to-end training."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core import framework
+
+
+def _brute(em, w, lengths):
+    """Enumerate all tag sequences: returns (logZ, best_path, best_score)
+    per batch row. em (B,T,N), w (N+2,N)."""
+    start, end, trans = w[0], w[1], w[2:]
+    B, T, N = em.shape
+    logzs, paths, scores_best = [], [], []
+    for b in range(B):
+        L = lengths[b]
+        best, best_p = -np.inf, None
+        total = []
+        for tags in itertools.product(range(N), repeat=L):
+            s = start[tags[0]] + end[tags[L - 1]]
+            s += sum(em[b, t, tags[t]] for t in range(L))
+            s += sum(trans[tags[t - 1], tags[t]] for t in range(1, L))
+            total.append(s)
+            if s > best:
+                best, best_p = s, tags
+        m = np.max(total)
+        logzs.append(m + np.log(np.sum(np.exp(np.array(total) - m))))
+        paths.append(list(best_p) + [0] * (T - L))
+        scores_best.append(best)
+    return np.array(logzs), np.array(paths), np.array(scores_best)
+
+
+def _build_and_run(em, labels, lengths, fetch_decode=True):
+    B, T, N = em.shape
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        ev = fluid.data(name="em", shape=[B, T, N], dtype="float32")
+        lv = fluid.data(name="lb", shape=[B, T], dtype="int64")
+        lnv = fluid.data(name="ln", shape=[B], dtype="int64")
+        nll = layers.linear_chain_crf(
+            ev, lv, param_attr=fluid.ParamAttr(name="crf_w"), length=lnv)
+        path = layers.crf_decoding(
+            ev, param_attr=fluid.ParamAttr(name="crf_w"), length=lnv)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        w = np.random.default_rng(7).standard_normal(
+            (N + 2, N)).astype(np.float32)
+        fluid.global_scope().set("crf_w", w)
+        out = exe.run(main, feed={"em": em, "lb": labels, "ln": lengths},
+                      fetch_list=[nll, path])
+    return w, np.asarray(out[0]), np.asarray(out[1])
+
+
+def test_crf_nll_and_viterbi_match_brute_force():
+    rng = np.random.default_rng(0)
+    B, T, N = 3, 5, 4
+    em = rng.standard_normal((B, T, N)).astype(np.float32)
+    labels = rng.integers(0, N, (B, T)).astype(np.int64)
+    lengths = np.array([5, 3, 4], np.int64)
+
+    w, nll, path = _build_and_run(em, labels, lengths)
+    logz, best_path, _ = _brute(em, w, lengths)
+
+    # gold score for the fed labels
+    start, end, trans = w[0], w[1], w[2:]
+    for b in range(B):
+        L = lengths[b]
+        tags = labels[b, :L]
+        s = start[tags[0]] + end[tags[-1]]
+        s += em[b, np.arange(L), tags].sum()
+        s += trans[tags[:-1], tags[1:]].sum()
+        np.testing.assert_allclose(nll[b, 0], logz[b] - s,
+                                   rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(path, best_path)
+
+
+def test_crf_trains_to_memorize_tags():
+    rng = np.random.default_rng(1)
+    B, T, N = 8, 6, 3
+    x = rng.standard_normal((B, T, 5)).astype(np.float32)
+    labels = rng.integers(0, N, (B, T)).astype(np.int64)
+    lengths = np.full((B,), T, np.int64)
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        xv = fluid.data(name="x", shape=[B, T, 5], dtype="float32")
+        lv = fluid.data(name="lb", shape=[B, T], dtype="int64")
+        lnv = fluid.data(name="ln", shape=[B], dtype="int64")
+        h = layers.fc(xv, size=64, act="relu", num_flatten_dims=2)
+        em = layers.fc(h, size=N, num_flatten_dims=2)
+        nll = layers.linear_chain_crf(
+            em, lv, param_attr=fluid.ParamAttr(name="crf_w2"), length=lnv)
+        loss = layers.mean(nll)
+        fluid.optimizer.AdamOptimizer(learning_rate=0.1).minimize(loss)
+        path = layers.crf_decoding(
+            em, param_attr=fluid.ParamAttr(name="crf_w2"), length=lnv)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        feed = {"x": x, "lb": labels, "ln": lengths}
+        first = None
+        for i in range(150):
+            out = exe.run(main, feed=feed, fetch_list=[loss])
+            if first is None:
+                first = float(np.asarray(out[0]).reshape(()))
+        final = float(np.asarray(out[0]).reshape(()))
+        assert final < first * 0.2, (first, final)
+        decoded = np.asarray(exe.run(main, feed=feed,
+                                     fetch_list=[path])[0])
+    assert (decoded == labels).mean() > 0.95

@@ -1,0 +1,104 @@
+"""CTC tests: loss vs torch.nn.functional.ctc_loss (fwd + grad),
+greedy decoder vs a python oracle, training smoke."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core import framework
+
+
+def _torch_ctc(logits, labels, in_len, lab_len, blank=0):
+    lp = torch.from_numpy(logits).log_softmax(-1).transpose(0, 1)  # (T,B,C)
+    lp.requires_grad_(False)
+    return torch.nn.functional.ctc_loss(
+        lp, torch.from_numpy(labels), torch.from_numpy(in_len),
+        torch.from_numpy(lab_len), blank=blank, reduction="none",
+        zero_infinity=False).numpy()
+
+
+def test_warpctc_matches_torch():
+    rng = np.random.default_rng(0)
+    B, T, C, L = 4, 12, 6, 5
+    logits = rng.standard_normal((B, T, C)).astype(np.float32)
+    labels = rng.integers(1, C, (B, L)).astype(np.int32)  # avoid blank=0
+    in_len = np.array([12, 10, 12, 8], np.int64)
+    lab_len = np.array([5, 3, 4, 2], np.int64)
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        xv = fluid.data(name="x", shape=[B, T, C], dtype="float32")
+        lv = fluid.data(name="l", shape=[B, L], dtype="int32")
+        ilv = fluid.data(name="il", shape=[B], dtype="int64")
+        llv = fluid.data(name="ll", shape=[B], dtype="int64")
+        loss = layers.warpctc(xv, lv, input_length=ilv, label_length=llv)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        got = np.asarray(exe.run(
+            main, feed={"x": logits, "l": labels, "il": in_len,
+                        "ll": lab_len}, fetch_list=[loss])[0]).reshape(-1)
+    ref = _torch_ctc(logits, labels, in_len, lab_len)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_warpctc_grad_matches_torch():
+    rng = np.random.default_rng(1)
+    B, T, C, L = 2, 8, 5, 3
+    logits = rng.standard_normal((B, T, C)).astype(np.float32)
+    labels = rng.integers(1, C, (B, L)).astype(np.int32)
+    in_len = np.array([8, 6], np.int64)
+    lab_len = np.array([3, 2], np.int64)
+
+    # torch grad
+    lt = torch.from_numpy(logits).clone().requires_grad_(True)
+    lp = lt.log_softmax(-1).transpose(0, 1)
+    tl = torch.nn.functional.ctc_loss(
+        lp, torch.from_numpy(labels), torch.from_numpy(in_len),
+        torch.from_numpy(lab_len), blank=0, reduction="sum")
+    tl.backward()
+    ref_grad = lt.grad.numpy()
+
+    # ours via the framework backward
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        xv = fluid.data(name="x", shape=[B, T, C], dtype="float32")
+        xv.stop_gradient = False
+        lv = fluid.data(name="l", shape=[B, L], dtype="int32")
+        ilv = fluid.data(name="il", shape=[B], dtype="int64")
+        llv = fluid.data(name="ll", shape=[B], dtype="int64")
+        loss = layers.reduce_sum(layers.warpctc(
+            xv, lv, input_length=ilv, label_length=llv))
+        grads = fluid.gradients([loss], [xv])
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        got = np.asarray(exe.run(
+            main, feed={"x": logits, "l": labels, "il": in_len,
+                        "ll": lab_len}, fetch_list=[grads[0]])[0])
+    np.testing.assert_allclose(got, ref_grad, rtol=2e-3, atol=2e-3)
+
+
+def test_ctc_greedy_decoder():
+    # probs crafted so argmax path is [1, 1, 0, 2, 2, 0, 1] -> [1, 2, 1]
+    path = [1, 1, 0, 2, 2, 0, 1]
+    C = 4
+    probs = np.full((1, len(path), C), 0.1, np.float32)
+    for t, c in enumerate(path):
+        probs[0, t, c] = 0.9
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        xv = fluid.data(name="x", shape=[1, len(path), C], dtype="float32")
+        out, out_len = layers.ctc_greedy_decoder(xv, blank=0)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        o, n = exe.run(main, feed={"x": probs}, fetch_list=[out, out_len])
+    o, n = np.asarray(o), np.asarray(n)
+    assert n[0, 0] == 3
+    np.testing.assert_array_equal(o[0, :3], [1, 2, 1])
+    assert (o[0, 3:] == -1).all()

@@ -1,0 +1,204 @@
+"""RPN / Faster-RCNN op tests: proposal decode+NMS sanity, target-assign
+IoU rules, label sampling balance, decode-and-assign numerics."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core import framework
+
+
+def _run(build, feed):
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        outs = build()
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        return [np.asarray(o) for o in
+                exe.run(main, feed=feed, fetch_list=list(outs))]
+
+
+def test_generate_proposals_basic():
+    rng = np.random.default_rng(0)
+    N, A, H, W = 1, 3, 4, 4
+    scores = rng.uniform(0.1, 1, (N, A, H, W)).astype(np.float32)
+    deltas = (rng.standard_normal((N, 4 * A, H, W)) * 0.1).astype(np.float32)
+    base = rng.uniform(0, 40, (H, W, A, 2)).astype(np.float32)
+    anchors = np.concatenate([base, base + 16], axis=-1)
+    variances = np.ones_like(anchors)
+    im_info = np.array([[64, 64, 1.0]], np.float32)
+
+    def build():
+        sv = fluid.data(name="s", shape=[N, A, H, W], dtype="float32")
+        dv = fluid.data(name="d", shape=[N, 4 * A, H, W], dtype="float32")
+        iv = fluid.data(name="i", shape=[N, 3], dtype="float32")
+        av = fluid.data(name="a", shape=[H, W, A, 4], dtype="float32")
+        vv = fluid.data(name="v", shape=[H, W, A, 4], dtype="float32")
+        rois, probs = layers.generate_proposals(
+            sv, dv, iv, av, vv, pre_nms_top_n=40, post_nms_top_n=10,
+            nms_thresh=0.6, min_size=1.0)
+        return rois, probs
+
+    rois, probs = _run(build, {"s": scores, "d": deltas, "i": im_info,
+                               "a": anchors, "v": variances})
+    assert rois.shape == (1, 10, 4)
+    valid = rois[0, :, 0] >= 0
+    assert valid.any()
+    vr = rois[0][valid]
+    # inside image, well-formed
+    assert (vr[:, 0] <= vr[:, 2]).all() and (vr[:, 1] <= vr[:, 3]).all()
+    assert (vr >= -1e-3).all() and (vr[:, 2] < 64).all()
+    # probs best-first
+    p = probs[0, valid, 0]
+    assert (np.diff(p) <= 1e-6).all()
+
+
+def test_rpn_target_assign_iou_rule():
+    # 2 gt boxes, anchors crafted: a0 overlaps gt0 strongly, a1 nothing,
+    # a2 overlaps gt1 strongly
+    anchors = np.array([[0, 0, 10, 10], [40, 40, 50, 50], [18, 18, 30, 30]],
+                       np.float32)
+    gt = np.array([[[0, 0, 10, 10], [20, 20, 30, 30]]], np.float32)
+    bbox_pred = np.zeros((1, 3, 4), np.float32)
+    cls_logits = np.zeros((1, 3, 1), np.float32)
+
+    def build():
+        av = fluid.data(name="a", shape=[3, 4], dtype="float32")
+        gv = fluid.data(name="g", shape=[1, 2, 4], dtype="float32")
+        bv = fluid.data(name="b", shape=[1, 3, 4], dtype="float32")
+        cv = fluid.data(name="c", shape=[1, 3, 1], dtype="float32")
+        sp, lp, tl, tb, iw, sw = layers.rpn_target_assign(
+            bv, cv, av, None, gv, rpn_batch_size_per_im=4,
+            rpn_fg_fraction=0.5)
+        return tl, iw, sw
+
+    tl, iw, sw = _run(build, {"a": anchors, "g": gt,
+                              "b": bbox_pred, "c": cls_logits})
+    # 2 fg slots: both real positives found (anchors 0 and 2)
+    assert tl.shape == (1, 4, 1)
+    assert (tl[0, :2, 0] == 1).all()
+    assert iw[0, :2].sum() == 8.0  # both fg rows carry weight on 4 coords
+    # every sampled row is real here (1 neg anchor fills 1 of 2 bg slots)
+    assert sw[0, :2, 0].sum() == 2.0
+
+
+def test_retinanet_target_assign_labels_every_anchor():
+    anchors = np.array([[0, 0, 10, 10], [40, 40, 50, 50]], np.float32)
+    gt = np.array([[[0, 0, 10, 10]]], np.float32)
+    bbox_pred = np.zeros((1, 2, 4), np.float32)
+    cls_logits = np.zeros((1, 2, 1), np.float32)
+
+    def build():
+        av = fluid.data(name="a", shape=[2, 4], dtype="float32")
+        gv = fluid.data(name="g", shape=[1, 1, 4], dtype="float32")
+        bv = fluid.data(name="b", shape=[1, 2, 4], dtype="float32")
+        cv = fluid.data(name="c", shape=[1, 2, 1], dtype="float32")
+        outs = layers.retinanet_target_assign(bv, cv, av, None, gv)
+        return outs[2], outs[5]          # labels, score weight
+
+    tl, sw = _run(build, {"a": anchors, "g": gt,
+                          "b": bbox_pred, "c": cls_logits})
+    np.testing.assert_array_equal(tl[0, :, 0], [1, 0])
+    assert (sw[0, :, 0] == 1).all()      # both anchors contribute to CE
+
+
+def test_generate_proposal_labels_sampling():
+    rng = np.random.default_rng(1)
+    N, R, G, C = 1, 20, 2, 5
+    gt = np.array([[[0, 0, 20, 20], [40, 40, 60, 60]]], np.float32)
+    gt_cls = np.array([[1, 3]], np.int64)
+    # rois: half near gt0, half far away
+    near = gt[0, 0] + rng.uniform(-2, 2, (R // 2, 4)).astype(np.float32)
+    far = np.abs(rng.uniform(70, 90, (R // 2, 4))).astype(np.float32)
+    far[:, 2:] = far[:, :2] + 8
+    rois = np.concatenate([near, far])[None]
+
+    def build():
+        rv = fluid.data(name="r", shape=[N, R, 4], dtype="float32")
+        cv = fluid.data(name="c", shape=[N, G], dtype="int64")
+        gv = fluid.data(name="g", shape=[N, G, 4], dtype="float32")
+        out = layers.generate_proposal_labels(
+            rv, cv, gt_boxes=gv, batch_size_per_im=8, fg_fraction=0.25,
+            fg_thresh=0.5, class_nums=C)
+        return out[0], out[1], out[2], out[3]
+
+    srois, labels, tgts, iw = _run(build, {"r": rois, "c": gt_cls, "g": gt})
+    assert srois.shape == (1, 8, 4) and labels.shape == (1, 8, 1)
+    lab = labels[0, :, 0]
+    # fg slots (first 2 = 8*0.25) carry real gt classes
+    assert set(lab[:2]) <= {1, 3}
+    # bg slots are 0 or padding -1
+    assert set(lab[2:]) <= {0, -1}
+    # inside weights only on the fg rows' own class columns
+    for i in range(2):
+        cls = lab[i]
+        cols = iw[0, i].reshape(C, 4)
+        assert cols[cls].sum() == 4.0
+        assert cols.sum() == 4.0
+
+
+def test_box_decoder_and_assign():
+    prior = np.array([[0, 0, 10, 10]], np.float32)
+    pvar = np.array([1.0, 1.0, 1.0, 1.0], np.float32)
+    # class 0 delta zero; class 1 shifts right by one anchor width
+    deltas = np.array([[0, 0, 0, 0, 1.0, 0, 0, 0]], np.float32)
+    scores = np.array([[0.2, 0.8]], np.float32)
+
+    def build():
+        pv = fluid.data(name="p", shape=[1, 4], dtype="float32")
+        vv = fluid.data(name="v", shape=[4], dtype="float32")
+        dv = fluid.data(name="d", shape=[1, 8], dtype="float32")
+        sv = fluid.data(name="s", shape=[1, 2], dtype="float32")
+        return layers.box_decoder_and_assign(pv, vv, dv, sv)
+
+    decoded, assigned = _run(build, {"p": prior, "v": pvar,
+                                     "d": deltas, "s": scores})
+    # class-0 decode returns the prior itself
+    np.testing.assert_allclose(decoded[0, :4], prior[0], atol=1e-5)
+    # best class is 1 -> assigned box is the shifted decode
+    np.testing.assert_allclose(assigned[0], decoded[0, 4:], atol=1e-5)
+    assert assigned[0, 0] > prior[0, 0] + 5  # shifted right by ~11
+
+
+def test_multiclass_nms2_index_channel():
+    rng = np.random.default_rng(2)
+    boxes = rng.uniform(0, 50, (1, 8, 2)).astype(np.float32)
+    boxes = np.concatenate([boxes, boxes + 10], -1)
+    scores = rng.uniform(0, 1, (1, 3, 8)).astype(np.float32)
+
+    def build():
+        bv = fluid.data(name="b", shape=[1, 8, 4], dtype="float32")
+        sv = fluid.data(name="s", shape=[1, 3, 8], dtype="float32")
+        return layers.multiclass_nms2(bv, sv, score_threshold=0.3,
+                                      keep_top_k=6, return_index=True)
+
+    out, index = _run(build, {"b": boxes, "s": scores})
+    valid = out[0, :, 0] >= 0
+    assert (index[0, valid, 0] >= 0).all()
+    assert (index[0, ~valid, 0] == -1).all()
+
+
+def test_retinanet_target_assign_multiclass_labels():
+    anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                        [40, 40, 50, 50]], np.float32)
+    gt = np.array([[[0, 0, 10, 10], [20, 20, 30, 30]]], np.float32)
+    gt_labels = np.array([[7, 3]], np.int64)
+    bbox_pred = np.zeros((1, 3, 4), np.float32)
+    cls_logits = np.zeros((1, 3, 1), np.float32)
+
+    def build():
+        av = fluid.data(name="a", shape=[3, 4], dtype="float32")
+        gv = fluid.data(name="g", shape=[1, 2, 4], dtype="float32")
+        glv = fluid.data(name="gl", shape=[1, 2], dtype="int64")
+        bv = fluid.data(name="b", shape=[1, 3, 4], dtype="float32")
+        cv = fluid.data(name="c", shape=[1, 3, 1], dtype="float32")
+        outs = layers.retinanet_target_assign(bv, cv, av, None, gv,
+                                              gt_labels=glv)
+        return (outs[2],)
+
+    tl, = _run(build, {"a": anchors, "g": gt, "gl": gt_labels,
+                       "b": bbox_pred, "c": cls_logits})
+    # positives carry their own gt class, background stays 0
+    np.testing.assert_array_equal(tl[0, :, 0], [7, 3, 0])

@@ -1,0 +1,134 @@
+"""NCE / hsigmoid / sampled softmax / dynamic_lstmp tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core import framework
+
+
+def _train(build, feed, steps=40, lr=0.1):
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        loss = build()
+        fluid.optimizer.AdamOptimizer(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor()
+    losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(steps):
+            out = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(out[0]).reshape(())))
+    return losses
+
+
+def test_nce_trains_down():
+    rng = np.random.default_rng(0)
+    B, D, C = 16, 8, 50
+    x = rng.standard_normal((B, D)).astype(np.float32)
+    y = rng.integers(0, C, (B, 1)).astype(np.int64)
+
+    def build():
+        xv = fluid.data(name="x", shape=[B, D], dtype="float32")
+        yv = fluid.data(name="y", shape=[B, 1], dtype="int64")
+        return layers.mean(layers.nce(xv, yv, num_total_classes=C,
+                                      num_neg_samples=8))
+
+    losses = _train(build, {"x": x, "y": y})
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+def test_nce_log_uniform_sampler_runs():
+    rng = np.random.default_rng(1)
+    B, D, C = 8, 4, 30
+    x = rng.standard_normal((B, D)).astype(np.float32)
+    y = rng.integers(0, C, (B, 1)).astype(np.int64)
+
+    def build():
+        xv = fluid.data(name="x", shape=[B, D], dtype="float32")
+        yv = fluid.data(name="y", shape=[B, 1], dtype="int64")
+        return layers.mean(layers.nce(xv, yv, num_total_classes=C,
+                                      num_neg_samples=5,
+                                      sampler="log_uniform"))
+
+    losses = _train(build, {"x": x, "y": y}, steps=5)
+    assert np.isfinite(losses).all()
+
+
+def test_hsigmoid_trains_and_beats_chance():
+    rng = np.random.default_rng(2)
+    B, D, C = 32, 16, 10
+    x = rng.standard_normal((B, D)).astype(np.float32)
+    y = rng.integers(0, C, (B, 1)).astype(np.int64)
+
+    def build():
+        xv = fluid.data(name="x", shape=[B, D], dtype="float32")
+        yv = fluid.data(name="y", shape=[B, 1], dtype="int64")
+        return layers.mean(layers.hsigmoid(xv, yv, num_classes=C))
+
+    losses = _train(build, {"x": x, "y": y}, steps=120)
+    # -log P(correct path) falls well below the chance level log2(C) bits
+    assert losses[-1] < 0.3 * losses[0], (losses[0], losses[-1])
+
+
+def test_hsigmoid_custom_tree_rejected():
+    with pytest.raises(NotImplementedError):
+        main, startup = framework.Program(), framework.Program()
+        with framework.program_guard(main, startup):
+            xv = fluid.data(name="x", shape=[4, 4], dtype="float32")
+            yv = fluid.data(name="y", shape=[4, 1], dtype="int64")
+            layers.hsigmoid(xv, yv, num_classes=6, is_custom=True)
+
+
+def test_sampled_softmax_approximates_full():
+    rng = np.random.default_rng(3)
+    B, C = 8, 200
+    logits = rng.standard_normal((B, C)).astype(np.float32) * 0.1
+    y = rng.integers(0, C, (B, 1)).astype(np.int64)
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        lv = fluid.data(name="lg", shape=[B, C], dtype="float32")
+        yv = fluid.data(name="y", shape=[B, 1], dtype="int64")
+        s_loss = layers.sampled_softmax_with_cross_entropy(
+            lv, yv, num_samples=150)
+        full = layers.mean(layers.softmax_with_cross_entropy(lv, yv))
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        s, f = exe.run(main, feed={"lg": logits, "y": y},
+                       fetch_list=[s_loss, full])
+    # with near-uniform logits and many samples the estimate lands near
+    # the full softmax CE (both ~= log C here)
+    assert abs(float(np.asarray(s).mean()) - float(np.asarray(f))) < 1.0
+
+
+def test_dynamic_lstmp_shapes_and_training():
+    rng = np.random.default_rng(4)
+    B, T, D, H, P = 4, 6, 5, 8, 3
+    x = rng.standard_normal((B, T, D)).astype(np.float32)
+    tgt = rng.standard_normal((B, P)).astype(np.float32)
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        xv = fluid.data(name="x", shape=[B, T, D], dtype="float32")
+        yv = fluid.data(name="y", shape=[B, P], dtype="float32")
+        proj, cell = layers.dynamic_lstmp(xv, size=4 * H, proj_size=P)
+        loss = layers.mean(layers.square_error_cost(
+            layers.reduce_mean(proj, dim=1), yv))
+        fluid.optimizer.AdamOptimizer(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        first = last = None
+        for i in range(40):
+            p, c, l = exe.run(main, feed={"x": x, "y": tgt},
+                              fetch_list=[proj, cell, loss])
+            if first is None:
+                first = float(np.asarray(l).reshape(()))
+        last = float(np.asarray(l).reshape(()))
+    assert np.asarray(p).shape == (B, T, P)
+    assert np.asarray(c).shape == (B, T, H)
+    assert last < first * 0.5
