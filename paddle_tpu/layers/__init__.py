@@ -28,7 +28,8 @@ from .learning_rate_scheduler import (noam_decay, exponential_decay,
                                       polynomial_decay, piecewise_decay,
                                       cosine_decay, linear_lr_warmup)
 from . import sequence
-from .sequence import (sequence_mask, sequence_pad, sequence_unpad,
+from .sequence import (sequence_scatter, sequence_topk_avg_pooling,
+                       sequence_mask, sequence_pad, sequence_unpad,
                        sequence_pool, sequence_first_step,
                        sequence_last_step, sequence_softmax,
                        sequence_expand, sequence_expand_as,
@@ -61,7 +62,8 @@ from .detection import (prior_box, density_prior_box, box_coder,
                         collect_fpn_proposals, generate_proposals,
                         rpn_target_assign, retinanet_target_assign,
                         generate_proposal_labels, box_decoder_and_assign,
-                        multiclass_nms2)
+                        multiclass_nms2, roi_perspective_transform,
+                        generate_mask_labels)
 from .nn import topk as top_k  # fluid exposes both spellings
 from . import distributions
 from .math_op_patch import monkey_patch_variable

@@ -510,13 +510,17 @@ def Print(input, first_n=-1, message=None, summarize=-1,
     step (jax.debug.print host tap; the step remains one XLA executable).
     Returns the input unchanged so it composes like the reference op."""
     helper = LayerHelper("print")
+    # the sink var is persistable so the executor's dead-code slicer keeps
+    # the op even when nothing consumes Print's return value (the common
+    # side-effect-only usage)
     out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    out.persistable = True
     helper.append_op("print", {"X": input}, {"Out": out},
                      {"message": message or "",
                       "print_tensor_name": print_tensor_name,
                       "print_tensor_shape": print_tensor_shape,
                       "print_tensor_value": True})
-    return out
+    return input
 
 
 class DynamicRNN:

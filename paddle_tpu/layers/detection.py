@@ -9,7 +9,8 @@ LoD outputs.
 
 from ..core.layer_helper import LayerHelper
 
-__all__ = ["generate_proposals", "rpn_target_assign",
+__all__ = ["roi_perspective_transform", "generate_mask_labels",
+           "generate_proposals", "rpn_target_assign",
            "retinanet_target_assign", "generate_proposal_labels",
            "box_decoder_and_assign", "multiclass_nms2",
            "prior_box", "density_prior_box", "box_coder", "iou_similarity",
@@ -538,3 +539,48 @@ def multiclass_nms2(bboxes, scores, score_threshold=0.01, nms_top_k=64,
     if return_index:
         return out, index
     return out
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0):
+    """Parity: fluid.layers.roi_perspective_transform. rois (N, R, 8)
+    quadrilaterals; returns (N, R, C, th, tw)."""
+    helper = LayerHelper("roi_perspective_transform")
+    n, c = input.shape[0], input.shape[1]
+    r = rois.shape[1]
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (n, r, c, transformed_height, transformed_width))
+    helper.append_op("roi_perspective_transform",
+                     {"X": input, "ROIs": rois}, {"Out": out},
+                     {"transformed_height": transformed_height,
+                      "transformed_width": transformed_width,
+                      "spatial_scale": spatial_scale})
+    return out
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution,
+                         poly_lengths=None):
+    """Parity: fluid.layers.generate_mask_labels (Mask R-CNN targets).
+    Padded form: gt_segms (N, G, P, 2) one polygon per instance +
+    poly_lengths (N, G). MaskInt32 is (N, R, num_classes*res*res) with
+    -1 = ignore."""
+    helper = LayerHelper("generate_mask_labels")
+    n, r = rois.shape[0], rois.shape[1]
+    mask_rois = helper.create_variable_for_type_inference(
+        "float32", (n, r, 4))
+    has_mask = helper.create_variable_for_type_inference("int32", (n, r, 1))
+    masks = helper.create_variable_for_type_inference(
+        "int32", (n, r, num_classes * resolution * resolution))
+    inputs = {"ImInfo": im_info, "GtClasses": gt_classes,
+              "GtSegms": gt_segms, "Rois": rois,
+              "LabelsInt32": labels_int32}
+    if is_crowd is not None:
+        inputs["IsCrowd"] = is_crowd
+    if poly_lengths is not None:
+        inputs["PolyLengths"] = poly_lengths
+    helper.append_op("generate_mask_labels", inputs,
+                     {"MaskRois": mask_rois, "RoiHasMaskInt32": has_mask,
+                      "MaskInt32": masks},
+                     {"num_classes": num_classes, "resolution": resolution})
+    return mask_rois, has_mask, masks

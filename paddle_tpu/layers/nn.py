@@ -1559,3 +1559,85 @@ def sampled_softmax_with_cross_entropy(logits, label, num_samples,
                       "use_customized_samples": use_customized_samples,
                       "op_seed": seed})
     return loss
+
+
+def match_matrix_tensor(x, y, channel_num, act=None, param_attr=None,
+                        dtype="float32", name=None,
+                        x_length=None, y_length=None):
+    """Parity: fluid.layers.match_matrix_tensor — bilinear match matrix
+    out[b, c, i, j] = x_bi . W_c . y_bj. Padded form: x (B, Lx, D),
+    y (B, Ly, D) (+ optional lengths). Returns (out (B, C, Lx, Ly), tmp)."""
+    helper = LayerHelper("match_matrix_tensor", param_attr=param_attr,
+                         act=act, name=name)
+    d = x.shape[-1]
+    dy = y.shape[-1]
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[d, channel_num, dy], dtype=dtype)
+    b, lx = x.shape[0], x.shape[1]
+    ly = y.shape[1]
+    out = helper.create_variable_for_type_inference(
+        dtype, (b, channel_num, lx, ly))
+    tmp = helper.create_variable_for_type_inference(
+        dtype, (b, lx, channel_num, dy))
+    inputs = {"X": x, "Y": y, "W": w}
+    if x_length is not None:
+        inputs["XLength"] = x_length
+    if y_length is not None:
+        inputs["YLength"] = y_length
+    helper.append_op("match_matrix_tensor", inputs,
+                     {"Out": out, "Tmp": tmp}, {})
+    return helper.append_activation(out), tmp
+
+
+def var_conv_2d(input, row, col, input_channel, output_channel, filter_size,
+                stride=1, param_attr=None, act=None, dtype="float32",
+                name=None):
+    """Parity: fluid.layers.var_conv_2d — conv over per-row variable-size
+    images; padded form masks outputs beyond each row's (row, col) valid
+    extent."""
+    helper = LayerHelper("var_conv_2d", param_attr=param_attr, act=act,
+                         name=name)
+    ks = _pair(filter_size)
+    st = _pair(stride)
+    w = helper.create_parameter(
+        attr=helper.param_attr,
+        shape=[output_channel, input_channel, ks[0], ks[1]], dtype=dtype)
+    b, _, h, wd = input.shape
+    out = helper.create_variable_for_type_inference(
+        dtype, (b, output_channel,
+                (h + st[0] - 1) // st[0], (wd + st[1] - 1) // st[1]))
+    inputs = {"X": input, "W": w}
+    if row is not None:
+        inputs["Row"] = row
+    if col is not None:
+        inputs["Col"] = col
+    helper.append_op("var_conv_2d", inputs, {"Out": out},
+                     {"strides": list(st)})
+    return helper.append_activation(out)
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    """Parity: fluid.layers.tree_conv (TBCNN). nodes_vector (B, N, D),
+    edge_set (B, E, 2) (parent, child) int pairs padded with -1.
+    Returns (B, N, output_size, num_filters)."""
+    helper = LayerHelper("tree_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    d = nodes_vector.shape[-1]
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[d, 3, output_size, num_filters],
+                                dtype=nodes_vector.dtype)
+    bias = helper.create_parameter(attr=helper.bias_attr,
+                                   shape=[output_size, num_filters],
+                                   dtype=nodes_vector.dtype, is_bias=True)
+    b, n = nodes_vector.shape[0], nodes_vector.shape[1]
+    out = helper.create_variable_for_type_inference(
+        nodes_vector.dtype, (b, n, output_size, num_filters))
+    inputs = {"NodesVector": nodes_vector, "EdgeSet": edge_set,
+              "Filter": w}
+    if bias is not None:
+        inputs["Bias"] = bias
+    helper.append_op("tree_conv", inputs, {"Out": out},
+                     {"max_depth": max_depth})
+    return helper.append_activation(out)

@@ -185,3 +185,34 @@ def sequence_reshape(input, new_dim, name=None):
     helper.append_op("sequence_reshape", {"X": input}, {"Out": out},
                      {"new_dim": new_dim})
     return out
+
+
+def sequence_scatter(input, index, updates, length=None, name=None):
+    """Parity: fluid.layers.sequence_scatter. Padded form: input (B, D),
+    index (B, L), updates (B, L) + optional per-row length."""
+    helper = LayerHelper("sequence_scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    inputs = {"X": input, "Ids": index, "Updates": updates}
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op("sequence_scatter", inputs, {"Out": out}, {})
+    return out
+
+
+def sequence_topk_avg_pooling(input, row=None, col=None, topks=(1,),
+                              channel_num=1, name=None):
+    """Parity: fluid.layers.sequence_topk_avg_pooling. Padded form:
+    input (B, C, L1, L2) + optional row/col valid lengths. Returns
+    (B, L1, C * len(topks))."""
+    helper = LayerHelper("sequence_topk_avg_pooling", name=name)
+    b, c, l1, _ = input.shape
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (b, l1, c * len(topks)))
+    inputs = {"X": input}
+    if row is not None:
+        inputs["Row"] = row
+    if col is not None:
+        inputs["Col"] = col
+    helper.append_op("sequence_topk_avg_pooling", inputs, {"Out": out},
+                     {"topks": list(topks), "channel_num": channel_num})
+    return out

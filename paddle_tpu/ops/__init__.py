@@ -129,3 +129,4 @@ from . import crf_ops         # noqa: E402,F401
 from . import ctc_ops         # noqa: E402,F401
 from . import sampling_ops    # noqa: E402,F401
 from . import rcnn_ops        # noqa: E402,F401
+from . import match_ops       # noqa: E402,F401

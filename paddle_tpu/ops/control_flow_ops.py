@@ -297,14 +297,16 @@ def print_op(ctx):
     parts = []
     if ctx.attr("print_tensor_name", True):
         parts.append(ctx.op.input("X")[0])
-    fmt = msg + " ".join(parts)
+    prefix = msg + " ".join(parts)
     if ctx.attr("print_tensor_shape", True):
-        fmt += f" shape={tuple(x.shape)}"
+        prefix += f" shape={tuple(x.shape)}"
+    # jax.debug.callback with plain-python formatting: user text is never
+    # parsed as a format string (jax.debug.print chokes on braces)
     if ctx.attr("print_tensor_value", True):
-        fmt += " value={x}"
-        jax.debug.print(fmt, x=x)
+        jax.debug.callback(
+            lambda v, p=prefix: print(p, "value=", v), x)
     else:
-        jax.debug.print(fmt)
+        jax.debug.callback(lambda p=prefix: print(p))
     return {"Out": x}
 
 

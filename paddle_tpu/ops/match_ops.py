@@ -1,0 +1,155 @@
+"""Text-matching / tree ops: match_matrix_tensor, var_conv_2d,
+sequence_scatter, sequence_topk_avg_pooling, tree_conv.
+
+Parity: paddle/fluid/operators/match_matrix_tensor_op.*, var_conv_2d_op.*,
+sequence_scatter_op.*, sequence_topk_avg_pooling_op.*, tree_conv_op.*
+(layer API python/paddle/fluid/layers/nn.py). These are the MatchPyramid /
+TBCNN family the reference runs over LoD tensors with per-sequence CPU
+loops; TPU-native every op is batched static-shape with length masks
+(SURVEY.md design decision 4) and the bilinear/conv cores ride the MXU.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import register
+
+
+@register("match_matrix_tensor")
+def match_matrix_tensor(ctx):
+    """X (B, Lx, D), Y (B, Ly, D), W (D, C, D):
+    Out[b, c, i, j] = x_bi . W_c . y_bj — one einsum, C MXU matmuls."""
+    x = ctx.in_("X").astype(jnp.float32)
+    y = ctx.in_("Y").astype(jnp.float32)
+    w = ctx.in_("W").astype(jnp.float32)
+    out = jnp.einsum("bid,dce,bje->bcij", x, w, y)
+    x_len = ctx.in_("XLength")
+    y_len = ctx.in_("YLength")
+    if x_len is not None:
+        mask = jnp.arange(x.shape[1])[None] < x_len.reshape(-1, 1)
+        out = out * mask[:, None, :, None]
+    if y_len is not None:
+        mask = jnp.arange(y.shape[1])[None] < y_len.reshape(-1, 1)
+        out = out * mask[:, None, None, :]
+    return {"Out": out, "Tmp": jnp.einsum("bid,dce->bice", x, w)}
+
+
+@register("var_conv_2d")
+def var_conv_2d(ctx):
+    """Per-row variable-size conv: a regular XLA conv over the padded
+    (B, C, H, W) batch with outputs zeroed beyond each row's valid
+    region — what the reference's per-sample CPU im2col computes, at
+    fixed shapes."""
+    x = ctx.in_("X")                                 # (B, C, H, W)
+    w = ctx.in_("W")                                 # (Cout, Cin, kh, kw)
+    stride = ctx.attr("strides", [1, 1])
+    row = ctx.in_("Row")                             # (B,) valid heights
+    col = ctx.in_("Col")                             # (B,) valid widths
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=tuple(stride), padding="SAME",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    b, c, h, wd = out.shape
+    if row is not None:
+        oh = (row.reshape(-1) + stride[0] - 1) // stride[0]
+        out = out * (jnp.arange(h)[None] < oh[:, None])[:, None, :, None]
+    if col is not None:
+        ow = (col.reshape(-1) + stride[1] - 1) // stride[1]
+        out = out * (jnp.arange(wd)[None] < ow[:, None])[:, None, None, :]
+    return {"Out": out}
+
+
+@register("sequence_scatter")
+def sequence_scatter(ctx):
+    """X (B, D); per row b, X[b, ids[b, k]] += updates[b, k] for the
+    row's valid k (length mask) — the padded form of the reference's
+    LoD-walk scatter."""
+    x = ctx.in_("X")
+    ids = ctx.in_("Ids").astype(jnp.int32)           # (B, L)
+    upd = ctx.in_("Updates")                         # (B, L)
+    if ids.ndim == 3:
+        ids = ids[..., 0]
+    lengths = ctx.in_("Length")
+    if lengths is not None:
+        valid = jnp.arange(ids.shape[1])[None] < lengths.reshape(-1, 1)
+        upd = jnp.where(valid, upd, 0.0)
+    b = x.shape[0]
+    rows = jnp.repeat(jnp.arange(b)[:, None], ids.shape[1], 1)
+    return {"Out": x.at[rows, ids].add(upd)}
+
+
+@register("sequence_topk_avg_pooling")
+def sequence_topk_avg_pooling(ctx):
+    """X (B, C, L1, L2): for each (b, c, i) the mean of the top-k valid
+    entries over j, for every k in `topks`. Out (B, L1, C * len(topks)),
+    zero past the row length — the padded form of the reference's
+    per-sequence output."""
+    x = ctx.in_("X").astype(jnp.float32)
+    topks = list(ctx.attr("topks"))
+    b, c, l1, l2 = x.shape
+    row = ctx.in_("Row")                             # (B,) valid i
+    col = ctx.in_("Col")                             # (B,) valid j
+    if col is not None:
+        jmask = jnp.arange(l2)[None] < col.reshape(-1, 1)   # (B, L2)
+        x = jnp.where(jmask[:, None, None, :], x, -jnp.inf)
+    kmax = min(max(topks), l2)
+    top = jax.lax.top_k(x, kmax)[0]                  # (B, C, L1, kmax)
+    top = jnp.where(jnp.isfinite(top), top, 0.0)
+    csum = jnp.cumsum(top, axis=-1)                  # prefix sums
+    outs = []
+    for k in topks:
+        kk = min(k, kmax)
+        # average over min(k, valid_count) entries (reference divides by k)
+        outs.append(csum[..., kk - 1] / float(k))    # (B, C, L1)
+    out = jnp.stack(outs, axis=-1)                   # (B, C, L1, K)
+    out = out.transpose(0, 2, 1, 3).reshape(b, l1, c * len(topks))
+    if row is not None:
+        imask = jnp.arange(l1)[None] < row.reshape(-1, 1)
+        out = out * imask[:, :, None]
+    return {"Out": out}
+
+
+@register("tree_conv")
+def tree_conv(ctx):
+    """TBCNN tree convolution (continuous binary tree, Mou et al. — the
+    design the reference's tree_conv_op implements). NodesVector
+    (B, N, D); EdgeSet (B, E, 2) (parent, child) pairs, -1 padded;
+    Filter (D, 3, H, F). The window is each node + its direct children;
+    weights mix W_top for the parent and a left/right-interpolated pair
+    for children by position. Out (B, N, H, F)."""
+    nodes = ctx.in_("NodesVector").astype(jnp.float32)   # (B, N, D)
+    edges = ctx.in_("EdgeSet").astype(jnp.int32)         # (B, E, 2)
+    filt = ctx.in_("Filter").astype(jnp.float32)         # (D, 3, H, F)
+    b, n, d = nodes.shape
+    w_top, w_left, w_right = filt[:, 0], filt[:, 1], filt[:, 2]  # (D, H, F)
+
+    def per_sample(nv, ed):
+        parent, child = ed[:, 0], ed[:, 1]               # (E,)
+        valid = (parent >= 0) & (child >= 0)
+        p = jnp.where(valid, parent, 0)
+        ch = jnp.where(valid, child, 0)
+        vf = valid.astype(jnp.float32)
+        # child position among its siblings: rank by edge order
+        ones = jnp.where(valid, 1.0, 0.0)
+        # cumulative count of previous children of the same parent
+        same = (p[:, None] == p[None, :]) & (jnp.arange(len(p))[None, :]
+                                             < jnp.arange(len(p))[:, None])
+        pos = (same * ones[None, :]).sum(-1)             # (E,)
+        cnt = jax.ops.segment_sum(ones, p, num_segments=n)[p]  # siblings
+        denom = jnp.maximum(cnt - 1.0, 1.0)
+        eta_r = jnp.where(cnt > 1, pos / denom, 0.5)
+        eta_l = 1.0 - eta_r
+        cx = nv[ch]                                       # (E, D)
+        contrib = (jnp.einsum("ed,dhf->ehf", cx * (eta_l * vf)[:, None],
+                              w_left)
+                   + jnp.einsum("ed,dhf->ehf", cx * (eta_r * vf)[:, None],
+                                w_right))
+        agg = jax.ops.segment_sum(contrib, p, num_segments=n)  # (N, H, F)
+        self_term = jnp.einsum("nd,dhf->nhf", nv, w_top)
+        return self_term + agg
+
+    out = jax.vmap(per_sample)(nodes, edges)
+    bias = ctx.in_("Bias")
+    if bias is not None:
+        out = out + bias.reshape(1, 1, *bias.shape[-2:]) \
+            if bias.ndim >= 2 else out + bias.reshape(1, 1, -1, 1)
+    return {"Out": out}

@@ -304,3 +304,142 @@ def multiclass_nms2(ctx):
     valid = out[:, :, 0] >= 0
     rank = jnp.where(valid, jnp.arange(k)[None, :], -1)
     return {"Out": out, "Index": rank[..., None].astype(jnp.int32)}
+
+
+@register("roi_perspective_transform")
+def roi_perspective_transform(ctx):
+    """Parity: detection/roi_perspective_transform_op. X (N, C, H, W);
+    ROIs (N, R, 8) quadrilaterals (x1 y1 ... x4 y4, clockwise from
+    top-left). Each quad is warped to (transformed_h, transformed_w) by
+    the quad->rect homography; sampling is bilinear. All R transforms
+    solve as one batched 8x8 linear system + one gather — no per-roi
+    host loop."""
+    x = ctx.in_("X").astype(jnp.float32)
+    rois = ctx.in_("ROIs").astype(jnp.float32)        # (N, R, 8)
+    th = ctx.attr("transformed_height")
+    tw = ctx.attr("transformed_width")
+    scale = ctx.attr("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+
+    def solve_h(quad):
+        """Homography mapping output rect corners -> quad corners."""
+        src = jnp.array([[0.0, 0.0], [tw - 1.0, 0.0],
+                         [tw - 1.0, th - 1.0], [0.0, th - 1.0]])
+        dst = quad.reshape(4, 2) * scale
+        rows = []
+        for i in range(4):
+            sx, sy = src[i, 0], src[i, 1]
+            dx, dy = dst[i, 0], dst[i, 1]
+            rows.append(jnp.stack([sx, sy, jnp.float32(1), 0, 0, 0,
+                                   -dx * sx, -dx * sy]))
+            rows.append(jnp.stack([0, 0, 0, sx, sy, jnp.float32(1),
+                                   -dy * sx, -dy * sy]))
+        a = jnp.stack(rows)                            # (8, 8)
+        bvec = jnp.stack([dst[0, 0], dst[0, 1], dst[1, 0], dst[1, 1],
+                          dst[2, 0], dst[2, 1], dst[3, 0], dst[3, 1]])
+        hvec = jnp.linalg.solve(a + 1e-8 * jnp.eye(8), bvec)
+        return jnp.concatenate([hvec, jnp.ones(1)]).reshape(3, 3)
+
+    ys, xs = jnp.meshgrid(jnp.arange(th, dtype=jnp.float32),
+                          jnp.arange(tw, dtype=jnp.float32), indexing="ij")
+    grid = jnp.stack([xs.ravel(), ys.ravel(), jnp.ones(th * tw)])  # (3, P)
+
+    def per_roi(img, quad):
+        hm = solve_h(quad)
+        pts = hm @ grid                                # (3, P)
+        px = pts[0] / jnp.maximum(jnp.abs(pts[2]), 1e-8) * jnp.sign(pts[2])
+        py = pts[1] / jnp.maximum(jnp.abs(pts[2]), 1e-8) * jnp.sign(pts[2])
+        x0 = jnp.floor(px)
+        y0 = jnp.floor(py)
+        fx, fy = px - x0, py - y0
+        x0i = jnp.clip(x0.astype(jnp.int32), 0, w - 1)
+        y0i = jnp.clip(y0.astype(jnp.int32), 0, h - 1)
+        x1i = jnp.clip(x0i + 1, 0, w - 1)
+        y1i = jnp.clip(y0i + 1, 0, h - 1)
+        v = (img[:, y0i, x0i] * (1 - fx) * (1 - fy)
+             + img[:, y0i, x1i] * fx * (1 - fy)
+             + img[:, y1i, x0i] * (1 - fx) * fy
+             + img[:, y1i, x1i] * fx * fy)             # (C, P)
+        inb = ((px >= 0) & (px <= w - 1) & (py >= 0) & (py <= h - 1))
+        v = v * inb[None]
+        return v.reshape(c, th, tw)
+
+    out = jax.vmap(lambda img, qs: jax.vmap(
+        lambda q: per_roi(img, q))(qs))(x, rois)       # (N, R, C, th, tw)
+    return {"Out": out}
+
+
+@register("generate_mask_labels")
+def generate_mask_labels(ctx):
+    """Mask R-CNN mask targets (parity: detection/generate_mask_labels_op).
+
+    Padded design: GtSegms (N, G, P, 2) holds ONE polygon per instance
+    (P points, tail padded; PolyLengths (N, G) gives the valid count) —
+    the reference's 3-level LoD polygon lists collapse to this. For every
+    fg roi the matched instance's polygon is rasterized onto the roi's
+    resolution x resolution grid by even-odd ray casting — pure vector
+    math, no host round-trip. MaskInt32 holds {0,1} in the roi's class
+    slice and -1 (ignore) elsewhere, the masked-sigmoid-loss convention.
+    """
+    im_info = ctx.in_("ImInfo")
+    gt_classes = ctx.in_("GtClasses")               # (N, G)
+    segms = ctx.in_("GtSegms").astype(jnp.float32)  # (N, G, P, 2)
+    plen = ctx.in_("PolyLengths")                   # (N, G)
+    rois = ctx.in_("Rois")                          # (N, R, 4)
+    labels = ctx.in_("LabelsInt32")                 # (N, R, 1)
+    if labels.ndim == 3:
+        labels = labels[..., 0]
+    num_classes = ctx.attr("num_classes", 81)
+    res = ctx.attr("resolution", 14)
+    n, g, p, _ = segms.shape
+
+    if plen is None:
+        plen = jnp.full((n, g), p, jnp.int32)
+
+    def raster(poly, m, roi):
+        """poly (P, 2), m = valid point count, roi (4,) -> (res, res)."""
+        x0, y0, x1, y1 = roi[0], roi[1], roi[2], roi[3]
+        xs = x0 + (jnp.arange(res) + 0.5) / res * jnp.maximum(x1 - x0, 1e-3)
+        ys = y0 + (jnp.arange(res) + 0.5) / res * jnp.maximum(y1 - y0, 1e-3)
+        px = jnp.broadcast_to(xs[None, :], (res, res)).ravel()
+        py = jnp.broadcast_to(ys[:, None], (res, res)).ravel()
+        idx = jnp.arange(p)
+        nxt = jnp.where(idx + 1 < m, idx + 1, 0)
+        ax, ay = poly[:, 0], poly[:, 1]
+        bx, by = poly[nxt, 0], poly[nxt, 1]
+        evalid = (idx < m)[:, None]
+        cond = (ay[:, None] > py[None]) != (by[:, None] > py[None])
+        t = (py[None] - ay[:, None]) / jnp.where(
+            jnp.abs(by - ay)[:, None] < 1e-12, 1e-12, (by - ay)[:, None])
+        xint = ax[:, None] + t * (bx - ax)[:, None]
+        cross = cond & (px[None] < xint) & evalid
+        inside = (cross.sum(0) % 2).astype(jnp.int32)
+        return inside.reshape(res, res)
+
+    def per_image(info_i, gtc_i, seg_i, plen_i, rois_i, lab_i):
+        # rois live in the resized-image space; gt polygons in the
+        # original space — divide by im_scale first (ref op behavior)
+        rois_i = rois_i / jnp.maximum(info_i[2], 1e-8)
+        gt_boxes = jnp.stack([seg_i[..., 0].min(-1), seg_i[..., 1].min(-1),
+                              seg_i[..., 0].max(-1), seg_i[..., 1].max(-1)],
+                             axis=-1)                # (G, 4) polygon bbox
+        iou = _iou_matrix(rois_i, gt_boxes)          # (R, G)
+        same_cls = lab_i[:, None] == gtc_i[None, :].astype(lab_i.dtype)
+        iou = jnp.where(same_cls, iou, -1.0)
+        best = iou.argmax(axis=1)                    # (R,)
+        has_mask = (lab_i > 0) & (iou.max(axis=1) > 0)
+
+        def one(r):
+            mask = raster(seg_i[best[r]], plen_i[best[r]], rois_i[r])
+            cls = jnp.clip(lab_i[r], 0, num_classes - 1)
+            full = jnp.full((num_classes, res * res), -1, jnp.int32)
+            full = full.at[cls].set(mask.ravel())
+            return jnp.where(has_mask[r], full.reshape(-1), -1)
+
+        masks = jax.vmap(one)(jnp.arange(rois_i.shape[0]))
+        return rois_i, has_mask.astype(jnp.int32)[:, None], masks
+
+    mask_rois, has_mask, masks = jax.vmap(per_image)(
+        im_info, gt_classes, segms, plen, rois, labels)
+    return {"MaskRois": mask_rois, "RoiHasMaskInt32": has_mask,
+            "MaskInt32": masks}

@@ -223,11 +223,15 @@ def lstmp(ctx):
     cand_act = acts[ctx.attr("candidate_activation", "tanh")]
     proj_act = acts[ctx.attr("proj_activation", "tanh")]
 
+    reverse = bool(ctx.attr("is_reverse", False))
     xw = x @ w_x
     if bias is not None:
         xw = xw + bias
     xs = jnp.swapaxes(xw, 0, 1)
     steps = jnp.arange(t)
+    if reverse:
+        xs = xs[::-1]
+        steps = steps[::-1]
 
     def body(carry, inp):
         r_prev, c_prev = carry
@@ -251,6 +255,9 @@ def lstmp(ctx):
         return (r_new, c_new), (r_new, c_new)
 
     (r_last, c_last), (rs, cs) = jax.lax.scan(body, (r0, c0), (xs, steps))
-    return {"Projection": jnp.swapaxes(rs, 0, 1),
-            "Cell": jnp.swapaxes(cs, 0, 1),
+    rs = jnp.swapaxes(rs, 0, 1)
+    cs = jnp.swapaxes(cs, 0, 1)
+    if reverse:
+        rs, cs = rs[:, ::-1], cs[:, ::-1]
+    return {"Projection": rs, "Cell": cs,
             "LastH": r_last, "LastC": c_last}
