@@ -89,9 +89,15 @@ def _as_fetch_name(f):
     return str(f)
 
 
+# ops kept for their host-visible side effects even when nothing consumes
+# their outputs (fluid's Print/assert family)
+SIDE_EFFECT_OPS = {"print"}
+
+
 def _slice_ops(block, fetch_names):
-    """Backward slice of a block's op list: ops needed for fetches or that
-    write persistable vars (stat/counter updates keep running)."""
+    """Backward slice of a block's op list: ops needed for fetches, ops
+    that write persistable vars (stat/counter updates keep running), and
+    side-effect roots (print)."""
     needed = set(fetch_names)
     keep = []
     for op in reversed(block.ops):
@@ -99,7 +105,8 @@ def _slice_ops(block, fetch_names):
         writes_persistable = any(
             (n in block.vars and block.vars[n].persistable)
             for n in out_names)
-        if writes_persistable or (out_names & needed):
+        if writes_persistable or (out_names & needed) \
+                or op.type in SIDE_EFFECT_OPS:
             keep.append(op)
             needed |= set(op.input_names)
     return list(reversed(keep))

@@ -510,11 +510,10 @@ def Print(input, first_n=-1, message=None, summarize=-1,
     step (jax.debug.print host tap; the step remains one XLA executable).
     Returns the input unchanged so it composes like the reference op."""
     helper = LayerHelper("print")
-    # the sink var is persistable so the executor's dead-code slicer keeps
-    # the op even when nothing consumes Print's return value (the common
-    # side-effect-only usage)
+    # the executor's dead-code slicer treats print as a side-effect root
+    # (executor.SIDE_EFFECT_OPS), so the common return-value-dropped usage
+    # still logs without making the sink var scope state
     out = helper.create_variable_for_type_inference(input.dtype, input.shape)
-    out.persistable = True
     helper.append_op("print", {"X": input}, {"Out": out},
                      {"message": message or "",
                       "print_tensor_name": print_tensor_name,

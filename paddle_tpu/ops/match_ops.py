@@ -116,6 +116,10 @@ def tree_conv(ctx):
     Filter (D, 3, H, F). The window is each node + its direct children;
     weights mix W_top for the parent and a left/right-interpolated pair
     for children by position. Out (B, N, H, F)."""
+    if ctx.attr("max_depth", 2) != 2:
+        raise NotImplementedError(
+            "tree_conv: only max_depth=2 (node + direct children) is "
+            "implemented; deeper windows need multi-hop aggregation")
     nodes = ctx.in_("NodesVector").astype(jnp.float32)   # (B, N, D)
     edges = ctx.in_("EdgeSet").astype(jnp.int32)         # (B, E, 2)
     filt = ctx.in_("Filter").astype(jnp.float32)         # (D, 3, H, F)

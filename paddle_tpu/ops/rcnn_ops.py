@@ -416,16 +416,26 @@ def generate_mask_labels(ctx):
         inside = (cross.sum(0) % 2).astype(jnp.int32)
         return inside.reshape(res, res)
 
-    def per_image(info_i, gtc_i, seg_i, plen_i, rois_i, lab_i):
+    def per_image(info_i, gtc_i, crowd_i, seg_i, plen_i, rois_i, lab_i):
         # rois live in the resized-image space; gt polygons in the
         # original space — divide by im_scale first (ref op behavior)
         rois_i = rois_i / jnp.maximum(info_i[2], 1e-8)
-        gt_boxes = jnp.stack([seg_i[..., 0].min(-1), seg_i[..., 1].min(-1),
-                              seg_i[..., 0].max(-1), seg_i[..., 1].max(-1)],
-                             axis=-1)                # (G, 4) polygon bbox
+        # polygon bbox over VALID points only (the padded tail sits at 0,0
+        # and would otherwise drag every bbox to the origin)
+        pvalid = jnp.arange(p)[None, :] < plen_i[:, None]       # (G, P)
+        xs_ = jnp.where(pvalid, seg_i[..., 0], jnp.inf)
+        ys_ = jnp.where(pvalid, seg_i[..., 1], jnp.inf)
+        xe_ = jnp.where(pvalid, seg_i[..., 0], -jnp.inf)
+        ye_ = jnp.where(pvalid, seg_i[..., 1], -jnp.inf)
+        gt_boxes = jnp.stack([xs_.min(-1), ys_.min(-1),
+                              xe_.max(-1), ye_.max(-1)], axis=-1)
         iou = _iou_matrix(rois_i, gt_boxes)          # (R, G)
         same_cls = lab_i[:, None] == gtc_i[None, :].astype(lab_i.dtype)
-        iou = jnp.where(same_cls, iou, -1.0)
+        ok_gt = same_cls & (plen_i[None, :] >= 3)
+        if crowd_i is not None:
+            # crowd instances never supervise masks (ref op behavior)
+            ok_gt &= (crowd_i[None, :] == 0)
+        iou = jnp.where(ok_gt, iou, -1.0)
         best = iou.argmax(axis=1)                    # (R,)
         has_mask = (lab_i > 0) & (iou.max(axis=1) > 0)
 
@@ -439,7 +449,12 @@ def generate_mask_labels(ctx):
         masks = jax.vmap(one)(jnp.arange(rois_i.shape[0]))
         return rois_i, has_mask.astype(jnp.int32)[:, None], masks
 
+    is_crowd = ctx.in_("IsCrowd")
+    if is_crowd is not None and is_crowd.ndim == 3:
+        is_crowd = is_crowd[..., 0]
+    if is_crowd is None:
+        is_crowd = jnp.zeros((n, g), jnp.int32)
     mask_rois, has_mask, masks = jax.vmap(per_image)(
-        im_info, gt_classes, segms, plen, rois, labels)
+        im_info, gt_classes, is_crowd, segms, plen, rois, labels)
     return {"MaskRois": mask_rois, "RoiHasMaskInt32": has_mask,
             "MaskInt32": masks}
