@@ -108,7 +108,9 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
 def roi_pool(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0,
              name=None):
     helper = LayerHelper("roi_pool", name=name)
-    out = helper.create_variable_for_type_inference(input.dtype)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (rois.shape[0], input.shape[1],
+                      pooled_height, pooled_width))
     helper.append_op("roi_pool", {"X": input, "ROIs": rois}, {"Out": out},
                      {"pooled_height": pooled_height,
                       "pooled_width": pooled_width,
@@ -119,7 +121,9 @@ def roi_pool(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0,
 def roi_align(input, rois, pooled_height=1, pooled_width=1,
               spatial_scale=1.0, sampling_ratio=-1, name=None):
     helper = LayerHelper("roi_align", name=name)
-    out = helper.create_variable_for_type_inference(input.dtype)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (rois.shape[0], input.shape[1],
+                      pooled_height, pooled_width))
     helper.append_op("roi_align", {"X": input, "ROIs": rois}, {"Out": out},
                      {"pooled_height": pooled_height,
                       "pooled_width": pooled_width,

@@ -9,6 +9,7 @@ the callers (tests, bench.py) — the framework compiles the whole step to one
 XLA executable either way.
 """
 
+from . import fit_a_line
 from . import mnist
 from . import resnet
 from . import vgg
@@ -20,3 +21,4 @@ from . import bert
 from . import deepfm
 from . import gan
 from . import detection_demo
+from . import label_semantic_roles

@@ -65,8 +65,11 @@ def _roi_grid(x, rois, pooled_h, pooled_w, spatial_scale, sampling=2, align=True
 def roi_align(ctx):
     x = ctx.in_("X")
     rois = ctx.in_("ROIs")
-    out = _roi_grid(x, rois, ctx.attr("pooled_height", 1), ctx.attr("pooled_width", 1),
-                    ctx.attr("spatial_scale", 1.0), ctx.attr("sampling_ratio", 2) or 2)
+    sr = ctx.attr("sampling_ratio", 2)
+    sr = 2 if sr is None or sr <= 0 else sr   # fluid's -1 = "auto"
+    out = _roi_grid(x, rois, ctx.attr("pooled_height", 1),
+                    ctx.attr("pooled_width", 1),
+                    ctx.attr("spatial_scale", 1.0), sr)
     return {"Out": out}
 
 

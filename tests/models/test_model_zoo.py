@@ -262,3 +262,64 @@ def test_ssd_builds_and_steps():
 
     losses = _train(feed, loss, steps=2, lr=1e-4)
     assert np.isfinite(losses).all()
+
+
+def test_fit_a_line_converges():
+    from paddle_tpu.models import fit_a_line
+    np.random.seed(7)
+    w_true = np.random.randn(13, 1).astype(np.float32)
+    xs = np.random.randn(64, 13).astype(np.float32)
+    ys = xs @ w_true + 0.01 * np.random.randn(64, 1).astype(np.float32)
+    _x, _y, _pred, loss = fit_a_line.build_train_net()
+    losses = _train(lambda i: {"x": xs, "y": ys}, loss, steps=60, lr=0.05,
+                    opt=fluid.optimizer.SGDOptimizer(learning_rate=0.05))
+    assert losses[-1] < 0.05, losses[-1]
+
+
+def test_label_semantic_roles_trains_and_decodes():
+    from paddle_tpu.models import label_semantic_roles as srl
+    rng = np.random.default_rng(9)
+    B, T = 4, 8
+    feed = {name: rng.integers(
+        0, 40, (B, T)).astype(np.int64) for name in srl.FEATURE_NAMES}
+    feed["predicate"] %= srl.PRED_DICT_LEN
+    feed["mark"] %= srl.MARK_DICT_LEN
+    feed["target"] = rng.integers(0, srl.LABEL_DICT_LEN, (B, T)).astype(np.int64)
+    feed["length"] = np.array([8, 6, 8, 5], np.int64)
+
+    feats, target, length, cost, decode = srl.build_train_net(B, T,
+                                                              hidden_dim=32)
+    opt = fluid.optimizer.AdamOptimizer(learning_rate=0.02)
+    opt.minimize(cost)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for _ in range(40):
+        out = exe.run(feed=feed, fetch_list=[cost])
+        losses.append(float(np.asarray(out[0]).reshape(())))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    dec = np.asarray(exe.run(feed=feed, fetch_list=[decode])[0])
+    assert dec.shape == (B, T)
+    mask = np.arange(T)[None] < feed["length"][:, None]
+    acc = (dec == feed["target"])[mask].mean()
+    assert acc > 0.5, acc  # memorizing a tiny batch
+
+
+def test_faster_rcnn_pipeline_trains():
+    rng = np.random.default_rng(11)
+    B, S, G = 2, 64, 4
+    img = rng.standard_normal((B, 3, S, S)).astype(np.float32)
+    base = rng.uniform(4, 30, (B, G, 2)).astype(np.float32)
+    gt_box = np.concatenate([base, base + rng.uniform(10, 24, (B, G, 2))
+                             .astype(np.float32)], -1)
+    gt_label = rng.integers(1, 5, (B, G)).astype(np.int64)
+    im_info = np.tile(np.array([S, S, 1.0], np.float32), (B, 1))
+
+    _i, _b, _l, _ii, loss = detection_demo.build_faster_rcnn_train(
+        num_classes=5, image_size=S, max_gt=G)
+    feed = {"img": img, "gt_box": gt_box, "gt_label": gt_label,
+            "im_info": im_info}
+    losses = _train(lambda i: feed, loss, steps=6, lr=1e-3)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
