@@ -323,3 +323,28 @@ def test_faster_rcnn_pipeline_trains():
     losses = _train(lambda i: feed, loss, steps=6, lr=1e-3)
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses
+
+
+def test_mnist_convergence_97pct():
+    """SURVEY.md §4: MNIST >=97% within an epoch-equivalent. The synthetic
+    dataset is learnable by construction; full-dataset accuracy after a
+    short training run must clear the reference's book-test bar."""
+    import paddle_tpu.dataset as dataset
+    import paddle_tpu.reader as reader
+    np.random.seed(3)
+    _img, _lbl, pred, loss, acc = mnist.build_train_net("conv")
+    opt = fluid.optimizer.AdamOptimizer(learning_rate=2e-3)
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(["img", "label"])
+    for epoch in range(2):
+        for batch in reader.batch(dataset.mnist.train(), 64)():
+            exe.run(feed=feeder.feed(batch), fetch_list=[loss])
+    accs, ns = [], []
+    for batch in reader.batch(dataset.mnist.test(), 64)():
+        out = exe.run(feed=feeder.feed(batch), fetch_list=[acc])
+        accs.append(float(np.asarray(out[0]).reshape(-1)[0]))
+        ns.append(len(batch))
+    overall = float(np.average(accs, weights=ns))
+    assert overall >= 0.97, overall
