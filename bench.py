@@ -18,6 +18,7 @@ via the framework Executor; matmul path is bf16 (amp cast_model_to_bf16),
 params/accum fp32.
 
 Env knobs: BENCH_SEQ_LEN, BENCH_BATCHES ("8,16,32"), BENCH_STEPS,
+BENCH_RECOMPUTE (remat policy: dots|nothing|offload),
 BENCH_TINY=1 (bert_tiny config for off-TPU smoke tests), BENCH_PEAK_TFLOPS
 (override the per-chip peak), BENCH_DEVICE_TIMEOUT, BENCH_INIT_RETRIES.
 """
@@ -127,6 +128,11 @@ def build_step(batch, seq_len):
         feeds, total_loss, _mlm, _acc = bert.build_pretrain_net(
             cfg, seq_len=seq_len)
         opt = fluid.optimizer.AdamOptimizer(learning_rate=1e-4)
+        # BENCH_RECOMPUTE=dots|nothing|offload: remat to fit bigger
+        # batches (the usual MFU lever once HBM binds)
+        rc = os.environ.get("BENCH_RECOMPUTE")
+        if rc:
+            opt = fluid.optimizer.RecomputeOptimizer(opt, policy=rc)
         opt.minimize(total_loss)
     # forward model FLOPs for this batch; training step ~ 3x (fwd + 2x bwd)
     fwd_flops, _per_op = model_stat.count_flops(main, batch_size=batch)

@@ -1,0 +1,50 @@
+"""Program JSON round-trip for the round-2 op families (SURVEY.md §2.7).
+
+save_inference_model serializes the Program as JSON; every newly added op
+must survive to_json -> from_json -> execution with identical structure.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core import framework
+
+
+def test_new_ops_survive_json_roundtrip_and_execute():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        em = fluid.data(name="em", shape=[2, 5, 4], dtype="float32")
+        ln = fluid.data(name="ln", shape=[2], dtype="int64")
+        path = layers.crf_decoding(
+            em, param_attr=fluid.ParamAttr(name="crf_w"), length=ln)
+        x = fluid.data(name="x", shape=[2, 6, 3], dtype="float32")
+        lab = fluid.data(name="lab", shape=[2, 2], dtype="int32")
+        ctc = layers.warpctc(x, lab)
+        img = fluid.data(name="img", shape=[1, 2, 8, 8], dtype="float32")
+        rois = fluid.data(name="r", shape=[1, 2, 8], dtype="float32")
+        warped = layers.roi_perspective_transform(img, rois, 4, 4)
+
+    main2 = framework.Program.from_json(main.to_json())
+    assert [op.type for op in main2.global_block().ops] == \
+        [op.type for op in main.global_block().ops]
+
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.global_scope().set(
+            "crf_w", np.random.default_rng(0).standard_normal(
+                (6, 4)).astype(np.float32))
+        rng = np.random.default_rng(1)
+        feed = {"em": rng.standard_normal((2, 5, 4)).astype(np.float32),
+                "ln": np.array([5, 3], np.int64),
+                "x": rng.standard_normal((2, 6, 3)).astype(np.float32),
+                "lab": rng.integers(1, 3, (2, 2)).astype(np.int32),
+                "img": rng.standard_normal((1, 2, 8, 8)).astype(np.float32),
+                "r": (rng.random((1, 2, 8)) * 6).astype(np.float32)}
+        o1 = exe.run(main, feed=feed, fetch_list=[path, ctc, warped])
+        o2 = exe.run(main2, feed=feed, fetch_list=[path, ctc, warped])
+    for a, b in zip(o1, o2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)

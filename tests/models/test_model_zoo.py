@@ -333,6 +333,9 @@ def test_mnist_convergence_97pct():
     import paddle_tpu.reader as reader
     np.random.seed(3)
     _img, _lbl, pred, loss, acc = mnist.build_train_net("conv")
+    # eval must NOT touch the training program: the backward marker makes
+    # exe.run execute the optimizer too, which would train on test batches
+    test_prog = fluid.default_main_program().clone(for_test=True)
     opt = fluid.optimizer.AdamOptimizer(learning_rate=2e-3)
     opt.minimize(loss)
     exe = fluid.Executor()
@@ -343,7 +346,7 @@ def test_mnist_convergence_97pct():
             exe.run(feed=feeder.feed(batch), fetch_list=[loss])
     accs, ns = [], []
     for batch in reader.batch(dataset.mnist.test(), 64)():
-        out = exe.run(feed=feeder.feed(batch), fetch_list=[acc])
+        out = exe.run(test_prog, feed=feeder.feed(batch), fetch_list=[acc])
         accs.append(float(np.asarray(out[0]).reshape(-1)[0]))
         ns.append(len(batch))
     overall = float(np.average(accs, weights=ns))
