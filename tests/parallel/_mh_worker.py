@@ -1,0 +1,73 @@
+"""Worker for the real multi-process multi-host test (spawned by
+test_multihost_process.py). Each process owns 4 virtual CPU devices and
+joins a 2-process jax.distributed cluster over localhost — the closest
+this environment gets to a 2-host DCN pod.
+
+Validates through the PUBLIC fleet path: PaddleCloud env vars -> fleet.init
+(bootstraps jax.distributed from the endpoint list) -> hybrid mesh grouped
+by real process_index -> a cross-host psum over the dp axis.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def main():
+    rank, port = int(sys.argv[1]), sys.argv[2]
+    # PaddleCloud contract: fleet.init reads these
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = "2"
+    os.environ["PADDLE_TRAINER_ENDPOINTS"] = \
+        f"127.0.0.1:{port},127.0.0.1:{int(port) + 1}"
+    os.environ["PADDLE_CURRENT_ENDPOINT"] = \
+        f"127.0.0.1:{int(port) + rank}"
+
+    from paddle_tpu.parallel import fleet as fleet_mod
+    from paddle_tpu.parallel import mesh as mesh_mod
+
+    flt = fleet_mod.Fleet()
+    s = fleet_mod.DistributedStrategy()
+    s.tp_degree = 2
+    flt.init(strategy=s)
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert flt.worker_num() == 2 and flt.worker_index() == rank
+    m = mesh_mod.get_mesh()
+    shape = dict(zip(m.axis_names, m.devices.shape))
+    assert shape["tp"] == 2 and shape["dp"] == 4, shape
+    # model axis must be host-local: each tp pair lives on one process
+    for idx in np.ndindex(m.devices.shape[:-1]):
+        pair = m.devices[idx]
+        pids = {d.process_index for d in pair.ravel()}
+        assert len(pids) == 1, f"tp group spans processes: {pids}"
+
+    # cross-host collective: psum over dp (spans both processes)
+    @jax.jit
+    def f():
+        def blk():
+            return jax.lax.psum(
+                jnp.float32(jax.lax.axis_index("dp") + 1), "dp")
+        return jax.shard_map(blk, mesh=m, in_specs=(), out_specs=P())()
+
+    total = float(np.asarray(jax.device_get(f())).reshape(-1)[0])
+    assert total == 1 + 2 + 3 + 4, total
+
+    flt.barrier_worker()
+    print(f"MH_OK rank={rank} total={total}")
+
+
+if __name__ == "__main__":
+    main()
