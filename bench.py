@@ -17,7 +17,8 @@ The whole train step (fwd + grad + adam) runs as ONE donated XLA executable
 via the framework Executor; matmul path is bf16 (amp cast_model_to_bf16),
 params/accum fp32.
 
-Env knobs: BENCH_SEQ_LEN, BENCH_BATCHES ("8,16,32"), BENCH_STEPS,
+Env knobs: BENCH_MODEL (bert|resnet — secondary images/sec metric),
+BENCH_SEQ_LEN, BENCH_BATCHES ("8,16,32"), BENCH_STEPS,
 BENCH_RECOMPUTE (remat policy: dots|nothing|offload),
 BENCH_TINY=1 (bert_tiny config for off-TPU smoke tests), BENCH_PEAK_TFLOPS
 (override the per-chip peak), BENCH_DEVICE_TIMEOUT, BENCH_INIT_RETRIES.
@@ -29,6 +30,8 @@ import sys
 import time
 
 V100_BERT_BASE_TOKENS_PER_SEC = 2800.0
+# reference-era published V100 fp32 ResNet-50 training throughput/card
+V100_RESNET50_IMAGES_PER_SEC = 360.0
 
 # bf16 peak TFLOP/s per chip by device_kind substring (public specs).
 PEAK_TFLOPS = [
@@ -109,31 +112,27 @@ def _device_watchdog():
     os._exit(2)
 
 
-def build_step(batch, seq_len):
-    import numpy as np
+def _compile_train_step(build_net, make_feed, make_opt, batch,
+                        units_per_step):
+    """Shared bench scaffold: build program + optimizer (with the
+    BENCH_RECOMPUTE wrap), count FLOPs, cast bf16, init, and return
+    (step_fn, units_per_step, train_flops_per_step)."""
     import paddle_tpu as fluid
     from paddle_tpu.core import framework
     from paddle_tpu.core.executor import Scope, scope_guard
-    from paddle_tpu.models import bert
     from paddle_tpu.utils import model_stat
     from paddle_tpu import amp
 
-    if os.environ.get("BENCH_TINY") == "1":
-        cfg = bert.bert_tiny()
-        seq_len = min(seq_len, cfg.max_position_embeddings)
-    else:
-        cfg = bert.BertConfig(max_position_embeddings=seq_len)
     main, startup = framework.Program(), framework.Program()
     with framework.program_guard(main, startup):
-        feeds, total_loss, _mlm, _acc = bert.build_pretrain_net(
-            cfg, seq_len=seq_len)
-        opt = fluid.optimizer.AdamOptimizer(learning_rate=1e-4)
+        loss = build_net()
+        opt = make_opt()
         # BENCH_RECOMPUTE=dots|nothing|offload: remat to fit bigger
         # batches (the usual MFU lever once HBM binds)
         rc = os.environ.get("BENCH_RECOMPUTE")
         if rc:
             opt = fluid.optimizer.RecomputeOptimizer(opt, policy=rc)
-        opt.minimize(total_loss)
+        opt.minimize(loss)
     # forward model FLOPs for this batch; training step ~ 3x (fwd + 2x bwd)
     fwd_flops, _per_op = model_stat.count_flops(main, batch_size=batch)
     amp.cast_model_to_bf16(main)
@@ -142,13 +141,67 @@ def build_step(batch, seq_len):
     exe = fluid.Executor(fluid.TPUPlace(0))
     with scope_guard(scope):
         exe.run(startup)
-    feed = bert.make_pretrain_feed(cfg, seq_len, batch, dtype=np.int32)
+    feed = make_feed()
 
     def step():
         with scope_guard(scope):
-            return exe.run(main, feed=feed, fetch_list=[total_loss])
+            return exe.run(main, feed=feed, fetch_list=[loss])
 
-    return step, batch * seq_len, 3 * fwd_flops
+    return step, units_per_step, 3 * fwd_flops
+
+
+def build_resnet_step(batch, image_size=224):
+    """Secondary benchmark (SURVEY.md §6): ResNet-50 images/sec/chip."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet
+
+    tiny = os.environ.get("BENCH_TINY") == "1"
+    depth = 18 if tiny else 50
+    if tiny:
+        image_size = min(image_size, 64)
+    rng = np.random.default_rng(0)
+
+    def build_net():
+        _i, _l, _p, loss, _a1, _a5 = resnet.build_train_net(
+            depth=depth, image_shape=(3, image_size, image_size))
+        return loss
+
+    def make_feed():
+        return {"img": rng.standard_normal(
+            (batch, 3, image_size, image_size)).astype(np.float32),
+            "label": rng.integers(0, 1000, (batch, 1)).astype(np.int64)}
+
+    return _compile_train_step(
+        build_net, make_feed,
+        lambda: fluid.optimizer.MomentumOptimizer(learning_rate=0.1,
+                                                  momentum=0.9),
+        batch, units_per_step=batch)   # units = images
+
+
+def build_step(batch, seq_len):
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.models import bert
+
+    if os.environ.get("BENCH_MODEL", "bert") == "resnet":
+        return build_resnet_step(batch)
+    if os.environ.get("BENCH_TINY") == "1":
+        cfg = bert.bert_tiny()
+        seq_len = min(seq_len, cfg.max_position_embeddings)
+    else:
+        cfg = bert.BertConfig(max_position_embeddings=seq_len)
+
+    def build_net():
+        feeds, total_loss, _mlm, _acc = bert.build_pretrain_net(
+            cfg, seq_len=seq_len)
+        return total_loss
+
+    return _compile_train_step(
+        build_net,
+        lambda: bert.make_pretrain_feed(cfg, seq_len, batch, dtype=np.int32),
+        lambda: fluid.optimizer.AdamOptimizer(learning_rate=1e-4),
+        batch, units_per_step=batch * seq_len)   # units = tokens
 
 
 def bench_one(batch, seq_len, n_steps):
@@ -198,26 +251,39 @@ def _emit(sweep, seq_len, kind, peak):
             return
         _EMITTED = True
     best = max(sweep, key=lambda r: r["tokens_per_sec"])
-    if not best["flash_engaged"]:
-        print("bench: WARNING — Pallas flash attention did NOT engage; "
-              "the number below rides the O(T^2) XLA fallback",
-              file=sys.stderr)
-    print(json.dumps({
-        "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+    model = os.environ.get("BENCH_MODEL", "bert")
+    if model == "resnet":
+        metric = "resnet50_train_images_per_sec_per_chip"
+        unit = "images/s/chip"
+        baseline = V100_RESNET50_IMAGES_PER_SEC
+    else:
+        metric = "bert_base_pretrain_tokens_per_sec_per_chip"
+        unit = "tokens/s/chip"
+        baseline = V100_BERT_BASE_TOKENS_PER_SEC
+        if not best["flash_engaged"]:
+            print("bench: WARNING — Pallas flash attention did NOT "
+                  "engage; the number below rides the O(T^2) XLA "
+                  "fallback", file=sys.stderr)
+    result = {
+        "metric": metric,
         "value": round(best["tokens_per_sec"], 2),
-        "unit": "tokens/s/chip",
-        "vs_baseline": round(
-            best["tokens_per_sec"] / V100_BERT_BASE_TOKENS_PER_SEC, 3),
+        "unit": unit,
+        "vs_baseline": round(best["tokens_per_sec"] / baseline, 3),
         "mfu": round(best["mfu"], 4),
         "batch": best["batch"],
-        "seq_len": seq_len,
         "device_kind": kind,
         "peak_tflops": peak / 1e12,
-        "flash_engaged": best["flash_engaged"],
         "sweep": [{"batch": r["batch"],
                    "tokens_per_sec": round(r["tokens_per_sec"], 2),
                    "mfu": round(r["mfu"], 4)} for r in sweep],
-    }), flush=True)
+    }
+    if model == "resnet":
+        result["image_size"] = 64 if os.environ.get("BENCH_TINY") == "1" \
+            else 224
+    else:
+        result["seq_len"] = seq_len
+        result["flash_engaged"] = best["flash_engaged"]
+    print(json.dumps(result), flush=True)
 
 
 def main():

@@ -28,12 +28,15 @@ def conv2d(ctx):
     dilations = _pair(ctx.attr("dilations", [1, 1]))
     groups = ctx.attr("groups", 1) or 1
     dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    # No preferred_element_type: the TPU MXU accumulates bf16 convs in f32
+    # regardless, and a widened output breaks the conv TRANSPOSE rule
+    # under AMP (the f32 cotangent meets the bf16 filter — lax.conv
+    # requires identical dtypes, unlike dot_general).
     out = lax.conv_general_dilated(
         x, w, window_strides=strides,
         padding=[(pads[0], pads[0]), (pads[1], pads[1])],
         rhs_dilation=dilations, dimension_numbers=dn,
-        feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+        feature_group_count=groups)
     out = out.astype(x.dtype)
     if ctx.has_in("Bias"):
         out = out + ctx.in_("Bias").reshape(1, -1, 1, 1)
