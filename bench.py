@@ -112,11 +112,10 @@ def _device_watchdog():
     os._exit(2)
 
 
-def _compile_train_step(build_net, make_feed, make_opt, batch,
-                        units_per_step):
+def _compile_train_step(build_net, make_feed, make_opt, batch):
     """Shared bench scaffold: build program + optimizer (with the
     BENCH_RECOMPUTE wrap), count FLOPs, cast bf16, init, and return
-    (step_fn, units_per_step, train_flops_per_step)."""
+    (step_fn, train_flops_per_step)."""
     import paddle_tpu as fluid
     from paddle_tpu.core import framework
     from paddle_tpu.core.executor import Scope, scope_guard
@@ -147,7 +146,7 @@ def _compile_train_step(build_net, make_feed, make_opt, batch,
         with scope_guard(scope):
             return exe.run(main, feed=feed, fetch_list=[loss])
 
-    return step, units_per_step, 3 * fwd_flops
+    return step, 3 * fwd_flops
 
 
 def build_resnet_step(batch, image_size=224):
@@ -172,11 +171,12 @@ def build_resnet_step(batch, image_size=224):
             (batch, 3, image_size, image_size)).astype(np.float32),
             "label": rng.integers(0, 1000, (batch, 1)).astype(np.int64)}
 
-    return _compile_train_step(
+    RUN_INFO.update(image_size=image_size, depth=depth)
+    step, flops = _compile_train_step(
         build_net, make_feed,
         lambda: fluid.optimizer.MomentumOptimizer(learning_rate=0.1,
-                                                  momentum=0.9),
-        batch, units_per_step=batch)   # units = images
+                                                  momentum=0.9), batch)
+    return step, batch, flops          # units = images
 
 
 def build_step(batch, seq_len):
@@ -197,11 +197,11 @@ def build_step(batch, seq_len):
             cfg, seq_len=seq_len)
         return total_loss
 
-    return _compile_train_step(
+    step, flops = _compile_train_step(
         build_net,
         lambda: bert.make_pretrain_feed(cfg, seq_len, batch, dtype=np.int32),
-        lambda: fluid.optimizer.AdamOptimizer(learning_rate=1e-4),
-        batch, units_per_step=batch * seq_len)   # units = tokens
+        lambda: fluid.optimizer.AdamOptimizer(learning_rate=1e-4), batch)
+    return step, batch * seq_len, flops          # units = tokens
 
 
 def bench_one(batch, seq_len, n_steps):
@@ -237,6 +237,7 @@ def bench_one(batch, seq_len, n_steps):
 
 
 _SWEEP = []          # completed batch results (the hard watchdog reads it)
+RUN_INFO = {}        # facts recorded by the build fns (image_size, depth)
 _EMITTED = False
 import threading as _threading
 _EMIT_LOCK = _threading.Lock()
@@ -252,13 +253,19 @@ def _emit(sweep, seq_len, kind, peak):
         _EMITTED = True
     best = max(sweep, key=lambda r: r["tokens_per_sec"])
     model = os.environ.get("BENCH_MODEL", "bert")
+    tiny = os.environ.get("BENCH_TINY") == "1"
     if model == "resnet":
-        metric = "resnet50_train_images_per_sec_per_chip"
+        # under BENCH_TINY the run is ResNet-18 — name what actually ran
+        arch = f"resnet{RUN_INFO.get('depth', 50)}"
+        metric = f"{arch}_train_images_per_sec_per_chip"
         unit = "images/s/chip"
+        rate_key = "images_per_sec"
         baseline = V100_RESNET50_IMAGES_PER_SEC
     else:
-        metric = "bert_base_pretrain_tokens_per_sec_per_chip"
+        metric = ("bert_tiny" if tiny else
+                  "bert_base") + "_pretrain_tokens_per_sec_per_chip"
         unit = "tokens/s/chip"
+        rate_key = "tokens_per_sec"
         baseline = V100_BERT_BASE_TOKENS_PER_SEC
         if not best["flash_engaged"]:
             print("bench: WARNING — Pallas flash attention did NOT "
@@ -268,18 +275,20 @@ def _emit(sweep, seq_len, kind, peak):
         "metric": metric,
         "value": round(best["tokens_per_sec"], 2),
         "unit": unit,
+        # the ratio is only apples-to-apples for the full configs
         "vs_baseline": round(best["tokens_per_sec"] / baseline, 3),
         "mfu": round(best["mfu"], 4),
         "batch": best["batch"],
         "device_kind": kind,
         "peak_tflops": peak / 1e12,
         "sweep": [{"batch": r["batch"],
-                   "tokens_per_sec": round(r["tokens_per_sec"], 2),
+                   rate_key: round(r["tokens_per_sec"], 2),
                    "mfu": round(r["mfu"], 4)} for r in sweep],
     }
+    if tiny:
+        result["tiny"] = True
     if model == "resnet":
-        result["image_size"] = 64 if os.environ.get("BENCH_TINY") == "1" \
-            else 224
+        result["image_size"] = RUN_INFO.get("image_size")
     else:
         result["seq_len"] = seq_len
         result["flash_engaged"] = best["flash_engaged"]

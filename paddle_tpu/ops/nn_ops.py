@@ -37,7 +37,6 @@ def conv2d(ctx):
         padding=[(pads[0], pads[0]), (pads[1], pads[1])],
         rhs_dilation=dilations, dimension_numbers=dn,
         feature_group_count=groups)
-    out = out.astype(x.dtype)
     if ctx.has_in("Bias"):
         out = out + ctx.in_("Bias").reshape(1, -1, 1, 1)
     return {"Output": out, "Out": out}

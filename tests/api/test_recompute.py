@@ -99,3 +99,34 @@ def test_fleet_strategy_recompute_flag():
         flt.distributed_optimizer(
             fluid.optimizer.SGDOptimizer(learning_rate=0.1)).minimize(loss)
     assert main._recompute == {"policy": "dots"}
+
+
+def test_bf16_amp_conv_model_trains():
+    """Regression: conv models must train under cast_model_to_bf16 (the
+    conv transpose rule used to see mixed f32/bf16 dtypes and abort)."""
+    from paddle_tpu import amp
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((4, 3, 16, 16)).astype(np.float32)
+    y = rng.integers(0, 5, (4, 1)).astype(np.int64)
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        xv = fluid.data(name="x", shape=[4, 3, 16, 16], dtype="float32")
+        yv = fluid.data(name="y", shape=[4, 1], dtype="int64")
+        h = layers.conv2d(xv, num_filters=8, filter_size=3, padding=1,
+                          act="relu")
+        h = layers.pool2d(h, pool_size=2, pool_stride=2)
+        logits = layers.fc(layers.reshape(h, shape=[4, -1]), size=5)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, yv))
+        fluid.optimizer.MomentumOptimizer(
+            learning_rate=0.05, momentum=0.9).minimize(loss)
+    amp.cast_model_to_bf16(main)
+    exe = fluid.Executor()
+    with scope_guard(Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(12):
+            out, = exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])
+            losses.append(float(np.asarray(out).reshape(-1)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
