@@ -275,8 +275,10 @@ def _emit(sweep, seq_len, kind, peak):
         "metric": metric,
         "value": round(best["tokens_per_sec"], 2),
         "unit": unit,
-        # the ratio is only apples-to-apples for the full configs
-        "vs_baseline": round(best["tokens_per_sec"] / baseline, 3),
+        # the ratio is only meaningful for the full configs; tiny smoke
+        # runs emit null rather than a nonsense multiple
+        "vs_baseline": (None if tiny else
+                        round(best["tokens_per_sec"] / baseline, 3)),
         "mfu": round(best["mfu"], 4),
         "batch": best["batch"],
         "device_kind": kind,

@@ -129,18 +129,34 @@ def _fwd_kernel(*refs, scale, causal, block_k, q_len, kv_len,
     lse_ref[0] = jnp.broadcast_to(lse, (block_q, LSE_LANES))
 
 
-def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k):
+def _prep_qkv_bias(q, k, v, bias, block_q, block_k):
+    """Shared pre-processing for every flash kernel: pad the time axes to
+    the block sizes, collapse (B, H) into one grid axis, and canonicalize
+    the bias with its grid index fn. Returns
+    (q3, k3, v3, bias3, bidx, per_q, bq, bk)."""
     b, h, tq, d = q.shape
     tk = k.shape[2]
     bq = min(block_q, max(tq, 1))
     bk = min(block_k, max(tk, 1))
-    q_p = _pad_to(q, 2, bq)
-    k_p = _pad_to(k, 2, bk)
-    v_p = _pad_to(v, 2, bk)
-    tq_p, tk_p = q_p.shape[2], k_p.shape[2]
-    q3 = q_p.reshape(b * h, tq_p, d)
-    k3 = k_p.reshape(b * h, tk_p, d)
-    v3 = v_p.reshape(b * h, tk_p, d)
+    q3 = _pad_to(q, 2, bq).reshape(b * h, -1, d)
+    k3 = _pad_to(k, 2, bk).reshape(b * h, -1, d)
+    v3 = _pad_to(v, 2, bk).reshape(b * h, -1, d)
+    per_q, bias3, bidx = False, None, None
+    if bias is not None:
+        bb, hb, tqb, _ = bias.shape
+        per_q = tqb > 1
+        bias3 = _pad_to(_pad_to(bias, 3, bk), 2, bq if per_q else 1)
+        bias3 = bias3.reshape(bb * hb, bias3.shape[2], k3.shape[1])
+        bidx = _bias_index_fn(bb, hb, h)
+    return q3, k3, v3, bias3, bidx, per_q, bq, bk
+
+
+def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    q3, k3, v3, bias3, bidx, per_q, bq, bk = _prep_qkv_bias(
+        q, k, v, bias, block_q, block_k)
+    tq_p, tk_p = q3.shape[1], k3.shape[1]
     grid = (b * h, tq_p // bq)
 
     in_specs = [
@@ -150,13 +166,7 @@ def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k):
     ]
     operands = [q3, k3, v3]
     has_bias = bias is not None
-    per_q = False
     if has_bias:
-        bb, hb, tqb, _ = bias.shape
-        per_q = tqb > 1
-        bias3 = _pad_to(_pad_to(bias, 3, bk), 2, bq if per_q else 1)
-        bias3 = bias3.reshape(bb * hb, bias3.shape[2], tk_p)
-        bidx = _bias_index_fn(bb, hb, h)
         if per_q:
             in_specs.append(pl.BlockSpec(
                 (1, bq, tk_p), lambda bh, i, f=bidx: (f(bh), i, 0)))
@@ -182,6 +192,130 @@ def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k):
     out = out[:, :tq].reshape(b, h, tq, d)
     lse = lse[:, :tq, 0].reshape(b, h, tq)
     return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Long-context forward: K/V blocked through the GRID, not VMEM-resident
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel_kgrid(*refs, scale, causal, q_len, kv_len, num_kb,
+                      has_bias, bias_per_q):
+    """One (bh, q_block, k_block) grid step. The TPU grid runs the
+    innermost dimension sequentially on a core, so the online-softmax
+    state lives in VMEM scratch across k steps — K/V stream through
+    block-sized windows instead of residing whole in VMEM, lifting the
+    sequence-length ceiling from VMEM capacity to HBM."""
+    if has_bias:
+        (q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
+         acc_ref, m_ref, l_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+        b_ref = None
+    kb = pl.program_id(2)
+    q = q_ref[0].astype(jnp.float32) * scale
+    block_q, d = q.shape
+    q0 = pl.program_id(1) * block_q
+    k_blk = k_ref[0].astype(jnp.float32)              # (block_k, d)
+    v_blk = v_ref[0].astype(jnp.float32)
+    block_k = k_blk.shape[0]
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+    if b_ref is not None:
+        bblk = b_ref[0] if bias_per_q else b_ref[0, 0:1]
+        s = s + bblk.astype(jnp.float32)
+    s = _mask(s, q0, block_q, kb, block_k, q_len, kv_len, causal)
+
+    m_prev = m_ref[:, 0:1]
+    l_prev = l_ref[:, 0:1]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = alpha * l_prev + p.sum(axis=-1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
+        p, v_blk, preferred_element_type=jnp.float32)
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(kb == num_kb - 1)
+    def _flush():
+        l = l_ref[:, 0:1]
+        o_ref[0] = (acc_ref[...] /
+                    jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse = m_ref[:, 0:1] + jnp.log(jnp.maximum(l, 1e-30))
+        lse_ref[0] = jnp.broadcast_to(lse, (block_q, LSE_LANES))
+
+
+def _flash_fwd_kgrid(q, k, v, bias, scale, causal, block_q, block_k):
+    """Forward with K/V streamed by the grid. Same contract as
+    _flash_fwd; selected for long contexts (see flash_attention_with_lse)
+    or forced with PT_FLASH_KGRID=1."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    q3, k3, v3, bias3, bidx, per_q, bq, bk = _prep_qkv_bias(
+        q, k, v, bias, block_q, block_k)
+    tq_p, tk_p = q3.shape[1], k3.shape[1]
+    num_kb = tk_p // bk
+    grid = (b * h, tq_p // bq, num_kb)
+
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+    ]
+    operands = [q3, k3, v3]
+    has_bias = bias is not None
+    if has_bias:
+        if per_q:
+            in_specs.append(pl.BlockSpec(
+                (1, bq, bk), lambda bh, i, j, f=bidx: (f(bh), i, j)))
+        else:
+            in_specs.append(pl.BlockSpec(
+                (1, 1, bk), lambda bh, i, j, f=bidx: (f(bh), 0, j)))
+        operands.append(bias3)
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel_kgrid, scale=scale, causal=causal,
+                          q_len=tq, kv_len=tk, num_kb=num_kb,
+                          has_bias=has_bias, bias_per_q=per_q),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+                   pl.BlockSpec((1, bq, LSE_LANES),
+                                lambda bh, i, j: (bh, i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
+                   jax.ShapeDtypeStruct((b * h, tq_p, LSE_LANES),
+                                        jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32),
+                        pltpu.VMEM((bq, LSE_LANES), jnp.float32),
+                        pltpu.VMEM((bq, LSE_LANES), jnp.float32)],
+        interpret=_interpret(),
+    )(*operands)
+    out = out[:, :tq].reshape(b, h, tq, d)
+    lse = lse[:, :tq, 0].reshape(b, h, tq)
+    return out, lse
+
+
+# VMEM budget above which the full-KV forward would not fit: stream K/V
+# through the grid instead. ~2 arrays * T * D * 4B; 4MB is conservative
+# against ~16MB usable VMEM.
+_KV_VMEM_BYTES_LIMIT = 4 * 1024 * 1024
+
+
+def _use_kgrid(tk_p, d):
+    import os
+    if os.environ.get("PT_FLASH_KGRID") == "1":
+        return True
+    if os.environ.get("PT_FLASH_KGRID") == "0":
+        return False
+    return 2 * tk_p * d * 4 > _KV_VMEM_BYTES_LIMIT
 
 
 # ---------------------------------------------------------------------------
@@ -267,6 +401,194 @@ def _dkv_kernel(*refs, scale, causal, block_q, q_len, kv_len,
     dk_acc, dv_acc = jax.lax.fori_loop(0, num_qb, body, (z, z))
     dk_ref[0] = (dk_acc * scale).astype(dk_ref.dtype)
     dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+
+
+def _dq_kernel_kgrid(*refs, scale, causal, q_len, kv_len, num_kb,
+                     has_bias, bias_per_q):
+    """dQ with K/V streamed by the grid: grid (bh, q_block, k_block),
+    the dq accumulator carried in VMEM scratch across k steps."""
+    if has_bias:
+        q_ref, k_ref, v_ref, b_ref, lse_ref, dlt_ref, do_ref, dq_ref, \
+            acc_ref = refs
+    else:
+        q_ref, k_ref, v_ref, lse_ref, dlt_ref, do_ref, dq_ref, \
+            acc_ref = refs
+        b_ref = None
+    kb = pl.program_id(2)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, 0:1]
+    dlt = dlt_ref[0][:, 0:1]
+    block_q, d = q.shape
+    q0 = pl.program_id(1) * block_q
+    k_blk = k_ref[0].astype(jnp.float32)
+    v_blk = v_ref[0].astype(jnp.float32)
+    block_k = k_blk.shape[0]
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+    if b_ref is not None:
+        bblk = b_ref[0] if bias_per_q else b_ref[0, 0:1]
+        s = s + bblk.astype(jnp.float32)
+    s = _mask(s, q0, block_q, kb, block_k, q_len, kv_len, causal)
+    p = jnp.exp(s - lse)
+    dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+    ds = p * (dp - dlt)
+    acc_ref[...] += jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+    @pl.when(kb == num_kb - 1)
+    def _flush():
+        dq_ref[0] = (acc_ref[...] * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel_kgrid(*refs, scale, causal, q_len, kv_len, num_qb,
+                      has_bias, bias_per_q):
+    """dK/dV with Q/dO streamed by the grid: grid (bh, k_block, q_block),
+    dk/dv accumulators carried in VMEM scratch across q steps."""
+    if has_bias:
+        q_ref, k_ref, v_ref, b_ref, lse_ref, dlt_ref, do_ref, \
+            dk_ref, dv_ref, dk_acc, dv_acc = refs
+    else:
+        q_ref, k_ref, v_ref, lse_ref, dlt_ref, do_ref, \
+            dk_ref, dv_ref, dk_acc, dv_acc = refs
+        b_ref = None
+    kb = pl.program_id(1)
+    qb = pl.program_id(2)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    block_k, d = k.shape
+    q_blk = q_ref[0].astype(jnp.float32)
+    do_blk = do_ref[0].astype(jnp.float32)
+    lse_blk = lse_ref[0][:, 0:1]
+    dlt_blk = dlt_ref[0][:, 0:1]
+    block_q = q_blk.shape[0]
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * scale
+    if b_ref is not None:
+        bblk = b_ref[0] if bias_per_q else b_ref[0, 0:1]
+        s = s + bblk.astype(jnp.float32)
+    s = _mask(s, qb * block_q, block_q, kb, block_k, q_len, kv_len, causal)
+    p = jnp.exp(s - lse_blk)
+    dv_acc[...] += jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
+    dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
+    ds = p * (dp - dlt_blk)
+    dk_acc[...] += jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
+
+    @pl.when(qb == num_qb - 1)
+    def _flush():
+        dk_ref[0] = (dk_acc[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_kgrid(q, k, v, bias, lse, out, do, scale, causal, block_q,
+                     block_k, dlse=None):
+    """Backward with the SAME VMEM discipline as _flash_fwd_kgrid —
+    everything streams through block-sized grid windows, so long-context
+    TRAINING fits too, not just the forward."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
+
+    q3, k3, v3, bias3, bidx, per_q, bq, bk = _prep_qkv_bias(
+        q, k, v, bias, block_q, block_k)
+    do3 = _pad_to(do, 2, bq).reshape(b * h, -1, d)
+    tq_p, tk_p = q3.shape[1], k3.shape[1]
+    num_qb, num_kb = tq_p // bq, tk_p // bk
+
+    def lane_pad(x):
+        x = _pad_to(x, 1, bq)
+        return jnp.broadcast_to(x[..., None], x.shape + (LSE_LANES,))
+
+    lse_p = lane_pad(lse.reshape(b * h, tq))
+    dlt_p = lane_pad(delta.reshape(b * h, tq))
+    has_bias = bias is not None
+
+    # -- dQ: grid (bh, qb, kb) ------------------------------------------
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+    ]
+    operands = [q3, k3, v3]
+    if has_bias:
+        if per_q:
+            in_specs.append(pl.BlockSpec(
+                (1, bq, bk), lambda bh, i, j, f=bidx: (f(bh), i, j)))
+        else:
+            in_specs.append(pl.BlockSpec(
+                (1, 1, bk), lambda bh, i, j, f=bidx: (f(bh), 0, j)))
+        operands.append(bias3)
+    in_specs += [
+        pl.BlockSpec((1, bq, LSE_LANES), lambda bh, i, j: (bh, i, 0)),
+        pl.BlockSpec((1, bq, LSE_LANES), lambda bh, i, j: (bh, i, 0)),
+        pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+    ]
+    operands += [lse_p, dlt_p, do3]
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel_kgrid, scale=scale, causal=causal,
+                          q_len=tq, kv_len=tk, num_kb=num_kb,
+                          has_bias=has_bias, bias_per_q=per_q),
+        grid=(b * h, num_qb, num_kb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=_interpret(),
+    )(*operands)
+
+    # -- dK/dV: grid (bh, kb, qb) ---------------------------------------
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+    ]
+    operands = [q3, k3, v3]
+    if has_bias:
+        if per_q:
+            in_specs.append(pl.BlockSpec(
+                (1, bq, bk), lambda bh, j, i, f=bidx: (f(bh), i, j)))
+        else:
+            in_specs.append(pl.BlockSpec(
+                (1, 1, bk), lambda bh, j, i, f=bidx: (f(bh), 0, j)))
+        operands.append(bias3)
+    in_specs += [
+        pl.BlockSpec((1, bq, LSE_LANES), lambda bh, j, i: (bh, i, 0)),
+        pl.BlockSpec((1, bq, LSE_LANES), lambda bh, j, i: (bh, i, 0)),
+        pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),
+    ]
+    operands += [lse_p, dlt_p, do3]
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel_kgrid, scale=scale, causal=causal,
+                          q_len=tq, kv_len=tk, num_qb=num_qb,
+                          has_bias=has_bias, bias_per_q=per_q),
+        grid=(b * h, num_kb, num_qb),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+                   pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0))],
+        out_shape=[jax.ShapeDtypeStruct((b * h, tk_p, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, tk_p, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=_interpret(),
+    )(*operands)
+
+    dq = dq[:, :tq].reshape(b, h, tq, d)
+    dk = dk[:, :tk].reshape(b, h, tk, d)
+    dv = dv[:, :tk].reshape(b, h, tk, d)
+    return dq, dk, dv, delta
 
 
 def _flash_bwd(q, k, v, bias, lse, out, do, scale, causal, block_q, block_k,
@@ -402,24 +724,43 @@ def _dbias_xla(q, k, v, bias, lse, do, delta, scale, causal):
 # custom_vjp plumbing + public API
 # ---------------------------------------------------------------------------
 
+def _padded_len(n, block):
+    blk = min(block, max(n, 1))
+    return n + (-n) % blk
+
+
+def _fwd_dispatch(q, k, v, bias, scale, causal, block_q, block_k):
+    # long contexts stream K/V through the grid (full-KV VMEM residency
+    # is the ceiling of the default kernel); short ones keep the
+    # hardware-proven path
+    if _use_kgrid(_padded_len(k.shape[2], block_k), q.shape[-1]):
+        return _flash_fwd_kgrid(q, k, v, bias, scale, causal, block_q,
+                                block_k)
+    return _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def _flash(q, k, v, bias, scale, causal, block_q, block_k):
     """Differentiable (out, lse). The lse output is what makes the ring-
     attention online combine differentiable: its cotangent folds into the
     backward's delta term (ds = p*(dp - delta + dlse))."""
-    return _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k)
+    return _fwd_dispatch(q, k, v, bias, scale, causal, block_q, block_k)
 
 
 def _flash_vjp_fwd(q, k, v, bias, scale, causal, block_q, block_k):
-    out, lse = _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k)
+    out, lse = _fwd_dispatch(q, k, v, bias, scale, causal, block_q,
+                             block_k)
     return (out, lse), (q, k, v, bias, lse, out)
 
 
 def _flash_vjp_bwd(scale, causal, block_q, block_k, res, g):
     q, k, v, bias, lse, out = res
     do, dlse = g
-    dq, dk, dv, delta = _flash_bwd(q, k, v, bias, lse, out, do, scale,
-                                   causal, block_q, block_k, dlse=dlse)
+    bwd = (_flash_bwd_kgrid
+           if _use_kgrid(_padded_len(k.shape[2], block_k), q.shape[-1])
+           else _flash_bwd)
+    dq, dk, dv, delta = bwd(q, k, v, bias, lse, out, do, scale,
+                            causal, block_q, block_k, dlse=dlse)
     if bias is None:
         return dq, dk, dv, None
     db = _dbias_xla(q, k, v, bias, lse, do, delta, scale, causal)

@@ -170,3 +170,70 @@ def test_bert_train_step_has_no_quadratic_tensor():
         assert not bad, f"quadratic attention tensor(s) in HLO: {bad}"
     finally:
         os.environ.pop("PADDLE_TPU_FORCE_FLASH", None)
+
+
+# ---------------------------------------------------------------- kgrid
+def test_kgrid_forward_matches_default(monkeypatch):
+    """The K-streaming grid forward must equal the full-KV kernel and the
+    XLA oracle (fwd + lse), incl. causal, bias, and ragged tails."""
+    from paddle_tpu.ops.pallas import flash
+    rng = np.random.default_rng(0)
+    B, H, D = 2, 2, 16
+    for tq, tk, causal, bias_kind in [(128, 128, False, None),
+                                      (96, 160, True, None),
+                                      (128, 256, False, "padding"),
+                                      (96, 128, True, "per_q")]:
+        q = jnp.asarray(rng.standard_normal((B, H, tq, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, H, tk, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, H, tk, D)), jnp.float32)
+        bias = None
+        if bias_kind == "padding":
+            bias = jnp.asarray(
+                rng.standard_normal((B, 1, 1, tk)) * 2, jnp.float32)
+        elif bias_kind == "per_q":
+            # full relative-position bias: exercises the (bq, bk) tiling
+            bias = jnp.asarray(
+                rng.standard_normal((B, H, tq, tk)), jnp.float32)
+        monkeypatch.setenv("PT_FLASH_KGRID", "0")
+        o_ref, lse_ref = flash.flash_attention_with_lse(
+            q, k, v, bias=bias, causal=causal, block_q=64, block_k=64)
+        monkeypatch.setenv("PT_FLASH_KGRID", "1")
+        o_kg, lse_kg = flash.flash_attention_with_lse(
+            q, k, v, bias=bias, causal=causal, block_q=64, block_k=64)
+        np.testing.assert_allclose(np.asarray(o_kg), np.asarray(o_ref),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(lse_kg), np.asarray(lse_ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_kgrid_gradients_flow(monkeypatch):
+    """Backward through the kgrid forward rides the same custom_vjp
+    kernels; grads must match the default path."""
+    from paddle_tpu.ops.pallas import flash
+    rng = np.random.default_rng(1)
+    B, H, T, D = 1, 2, 128, 8
+    q = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.float32)
+
+    bias = jnp.asarray(rng.standard_normal((B, H, T, T)), jnp.float32)
+
+    def loss(q, k, v, b):
+        return flash.flash_attention(q, k, v, bias=b, causal=True,
+                                     block_q=64, block_k=64).sum()
+
+    monkeypatch.setenv("PT_FLASH_KGRID", "0")
+    g_ref = jax.grad(loss, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    monkeypatch.setenv("PT_FLASH_KGRID", "1")
+    g_kg = jax.grad(loss, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    for a, b in zip(g_kg, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_kgrid_auto_selected_for_long_context(monkeypatch):
+    from paddle_tpu.ops.pallas import flash
+    monkeypatch.delenv("PT_FLASH_KGRID", raising=False)
+    # 2 * T * D * 4 bytes over the 4MB limit -> kgrid
+    assert flash._use_kgrid(tk_p=16384, d=64)
+    assert not flash._use_kgrid(tk_p=2048, d=64)
