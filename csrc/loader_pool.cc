@@ -1,0 +1,250 @@
+// Native data-loader worker pool: N C++ threads assemble framed batches
+// from registered host arrays and push them into the prefetch ring —
+// gather, stack, and frame all happen off the GIL.
+//
+// Parity: the reference's multi-threaded C++ reader stack
+// (paddle/fluid/operators/reader/create_custom_reader_op.cc, the
+// MultiFileReader / open_files thread pool, buffered_reader.cc): batch
+// assembly is native work overlapped with device compute. TPU-native
+// framing: the pool writes the same flat batch format reader/native.py's
+// serialize_batch emits, so the consumer side (deserialize_batch -> feed)
+// is unchanged whether batches come from Python producers or this pool.
+//
+// Decoupling: this .so never links against libprefetch.so — the Python
+// wrapper hands in the ring handle plus the addresses of pt_ring_push /
+// pt_ring_close as plain function pointers, so the two libraries stay
+// independently buildable (flat C ABI for ctypes; no pybind11 in image).
+//
+// Scheduling: a global atomic batch counter hands out batch ids; workers
+// recompute the per-epoch shuffle permutation deterministically from
+// (seed, epoch) with std::mt19937_64, so any worker can build any batch.
+// `ordered` mode serializes pushes by batch-id ticket (deterministic
+// consumer order even with many workers); unordered trades order for a
+// little less tail latency. The last worker out closes the ring so the
+// consumer sees EOF without any Python-side join thread.
+//
+// Build: g++ -O2 -shared -fPIC -pthread -std=c++17 loader_pool.cc -o
+// build/libloaderpool.so (reader/native.py builds on first use).
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <numeric>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+typedef int (*PushFn)(void*, const void*, size_t);
+typedef void (*CloseFn)(void*);
+
+struct Source {
+  std::string key;
+  std::string dtype;                 // numpy dtype string, e.g. "float32"
+  const uint8_t* data = nullptr;     // caller-owned, rows * sample_bytes
+  std::vector<int64_t> sample_dims;  // per-sample shape (excludes batch dim)
+  int64_t sample_bytes = 0;
+};
+
+struct Pool {
+  void* ring = nullptr;
+  PushFn push = nullptr;
+  CloseFn close = nullptr;
+  int n_workers = 1;
+  std::vector<Source> sources;
+  int64_t rows = 0;
+
+  // run config (set by start)
+  int64_t batch = 1;
+  int64_t epochs = 1;
+  uint64_t seed = 0;
+  bool shuffle = false;
+  bool drop_last = false;
+  bool ordered = true;
+  int64_t per_epoch = 0;
+  int64_t total_batches = 0;
+
+  std::atomic<int64_t> next_batch{0};
+  std::atomic<int> active{0};
+  std::atomic<bool> stop{false};
+
+  // ordered-push ticketing
+  std::mutex ticket_mu;
+  std::condition_variable ticket_cv;
+  int64_t next_push = 0;
+
+  std::vector<std::thread> threads;
+};
+
+void append(std::vector<uint8_t>& buf, const void* p, size_t n) {
+  const uint8_t* b = static_cast<const uint8_t*>(p);
+  buf.insert(buf.end(), b, b + n);
+}
+
+// Frame one batch of `idx` rows in the serialize_batch layout:
+// [n:u32] then per source [klen:u16][key][dlen:u8][dtype][ndim:u8]
+// [dims:i64*ndim][raw rows].
+void build_batch(const Pool& p, const std::vector<int64_t>& idx,
+                 std::vector<uint8_t>& buf) {
+  buf.clear();
+  uint32_t n = static_cast<uint32_t>(p.sources.size());
+  append(buf, &n, 4);
+  for (const Source& s : p.sources) {
+    uint16_t klen = static_cast<uint16_t>(s.key.size());
+    append(buf, &klen, 2);
+    append(buf, s.key.data(), klen);
+    uint8_t dlen = static_cast<uint8_t>(s.dtype.size());
+    append(buf, &dlen, 1);
+    append(buf, s.dtype.data(), dlen);
+    uint8_t ndim = static_cast<uint8_t>(1 + s.sample_dims.size());
+    append(buf, &ndim, 1);
+    int64_t bsz = static_cast<int64_t>(idx.size());
+    append(buf, &bsz, 8);
+    for (int64_t d : s.sample_dims) append(buf, &d, 8);
+    size_t off = buf.size();
+    buf.resize(off + idx.size() * s.sample_bytes);
+    uint8_t* out = buf.data() + off;
+    for (size_t i = 0; i < idx.size(); ++i) {
+      std::memcpy(out + i * s.sample_bytes,
+                  s.data + idx[i] * s.sample_bytes, s.sample_bytes);
+    }
+  }
+}
+
+void worker(Pool* p) {
+  std::vector<uint8_t> buf;
+  // cached (epoch, permutation) — recomputed deterministically on miss
+  int64_t cached_epoch = -1;
+  std::vector<int64_t> perm;
+  while (!p->stop.load(std::memory_order_relaxed)) {
+    int64_t b = p->next_batch.fetch_add(1, std::memory_order_relaxed);
+    if (b >= p->total_batches) break;
+    int64_t epoch = b / p->per_epoch;
+    int64_t i = b % p->per_epoch;
+    if (p->shuffle) {
+      if (epoch != cached_epoch) {
+        perm.resize(p->rows);
+        std::iota(perm.begin(), perm.end(), 0);
+        std::mt19937_64 rng(p->seed + static_cast<uint64_t>(epoch));
+        std::shuffle(perm.begin(), perm.end(), rng);
+        cached_epoch = epoch;
+      }
+    }
+    int64_t lo = i * p->batch;
+    int64_t hi = std::min(p->rows, lo + p->batch);
+    std::vector<int64_t> idx;
+    idx.reserve(hi - lo);
+    for (int64_t j = lo; j < hi; ++j)
+      idx.push_back(p->shuffle ? perm[j] : j);
+    build_batch(*p, idx, buf);
+
+    if (p->ordered) {
+      std::unique_lock<std::mutex> lk(p->ticket_mu);
+      p->ticket_cv.wait(lk, [&] {
+        return p->next_push == b || p->stop.load(std::memory_order_relaxed);
+      });
+      if (p->stop.load(std::memory_order_relaxed)) break;
+      // push while holding the ticket: ring backpressure serializes here,
+      // which is exactly what "deterministic consumer order" requires
+      int rc = p->push(p->ring, buf.data(), buf.size());
+      ++p->next_push;
+      p->ticket_cv.notify_all();
+      if (rc != 0) break;  // ring closed under us
+    } else {
+      if (p->push(p->ring, buf.data(), buf.size()) != 0) break;
+    }
+  }
+  if (p->active.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+    // last worker out: EOF the ring so the consumer drains then stops
+    p->close(p->ring);
+  }
+  // wake ordered waiters stuck on a ticket that will never come
+  p->ticket_cv.notify_all();
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pl_pool_create(void* ring, void* push_fn, void* close_fn,
+                     int n_workers) {
+  Pool* p = new Pool();
+  p->ring = ring;
+  p->push = reinterpret_cast<PushFn>(push_fn);
+  p->close = reinterpret_cast<CloseFn>(close_fn);
+  p->n_workers = n_workers < 1 ? 1 : n_workers;
+  return p;
+}
+
+// Register a caller-owned contiguous array of `rows` samples. The pointer
+// must stay valid until pl_pool_destroy (the Python wrapper keeps a ref).
+int pl_pool_add_source(void* pp, const char* key, const char* dtype,
+                       const void* data, int64_t rows,
+                       const int64_t* sample_dims, int32_t sample_ndim,
+                       int64_t sample_bytes) {
+  Pool* p = static_cast<Pool*>(pp);
+  if (!p->threads.empty()) return -1;  // already started
+  if (p->sources.empty()) {
+    p->rows = rows;
+  } else if (rows != p->rows) {
+    return -2;  // all sources must agree on dataset length
+  }
+  Source s;
+  s.key = key ? key : "";
+  s.dtype = dtype;
+  s.data = static_cast<const uint8_t*>(data);
+  s.sample_dims.assign(sample_dims, sample_dims + sample_ndim);
+  s.sample_bytes = sample_bytes;
+  p->sources.push_back(std::move(s));
+  return 0;
+}
+
+// Launch the workers. Returns total batch count, or -1 on bad config.
+int64_t pl_pool_start(void* pp, int64_t batch, int64_t epochs, uint64_t seed,
+                      int shuffle, int drop_last, int ordered) {
+  Pool* p = static_cast<Pool*>(pp);
+  if (!p->threads.empty() || p->sources.empty() || batch < 1 || epochs < 1)
+    return -1;
+  p->batch = batch;
+  p->epochs = epochs;
+  p->seed = seed;
+  p->shuffle = shuffle != 0;
+  p->drop_last = drop_last != 0;
+  p->ordered = ordered != 0;
+  p->per_epoch = drop_last ? p->rows / batch
+                           : (p->rows + batch - 1) / batch;
+  if (p->per_epoch == 0) {
+    p->close(p->ring);  // dataset smaller than one (drop_last) batch: EOF
+    return 0;
+  }
+  p->total_batches = p->per_epoch * epochs;
+  p->active.store(p->n_workers);
+  for (int i = 0; i < p->n_workers; ++i)
+    p->threads.emplace_back(worker, p);
+  return p->total_batches;
+}
+
+// Block until every worker exits (the ring is closed by the last one).
+void pl_pool_join(void* pp) {
+  Pool* p = static_cast<Pool*>(pp);
+  for (std::thread& t : p->threads)
+    if (t.joinable()) t.join();
+}
+
+// Abort + free. Closes the ring (unblocking pushers), joins, deletes.
+void pl_pool_destroy(void* pp) {
+  Pool* p = static_cast<Pool*>(pp);
+  p->stop.store(true);
+  if (p->close && p->ring) p->close(p->ring);
+  p->ticket_cv.notify_all();
+  for (std::thread& t : p->threads)
+    if (t.joinable()) t.join();
+  delete p;
+}
+
+}  // extern "C"

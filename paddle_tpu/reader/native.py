@@ -148,6 +148,125 @@ class NativeRing:
             pass
 
 
+_pool_lib = None
+
+
+def load_pool_library():
+    """Load (building if needed) the native loader-pool library."""
+    global _pool_lib
+    with _lib_lock:
+        if _pool_lib is not None:
+            return _pool_lib
+        lib = build_and_load("loader_pool.cc", "libloaderpool.so")
+        lib.pl_pool_create.restype = ctypes.c_void_p
+        lib.pl_pool_create.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                       ctypes.c_void_p, ctypes.c_int]
+        lib.pl_pool_add_source.restype = ctypes.c_int
+        lib.pl_pool_add_source.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32, ctypes.c_int64]
+        lib.pl_pool_start.restype = ctypes.c_int64
+        lib.pl_pool_start.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_uint64, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+        lib.pl_pool_join.argtypes = [ctypes.c_void_p]
+        lib.pl_pool_destroy.argtypes = [ctypes.c_void_p]
+        _pool_lib = lib
+        return _pool_lib
+
+
+class NativeLoaderPool:
+    """Multi-worker C++ batch assembler over in-memory arrays.
+
+    N native threads gather rows (deterministic per-epoch shuffle), stack
+    them into framed batches (the serialize_batch format) and push into the
+    prefetch ring — the whole inner loop runs off the GIL. Parity: the
+    reference's open_files / MultiFileReader thread pool feeding
+    buffered_reader (csrc/loader_pool.cc has the map).
+
+    arrays: dict name->ndarray (feed-dict batches) or list/tuple of
+    ndarrays (positional batches); all must share dim 0 (dataset length).
+    `ordered=True` guarantees the consumer sees batches in batch-id order
+    even with many workers, so a seeded run is fully deterministic.
+    """
+
+    def __init__(self, arrays, batch_size, epochs=1, shuffle_seed=None,
+                 drop_last=False, ordered=True, n_workers=2, slots=8):
+        self._ringlib = load_library()
+        self._lib = load_pool_library()
+        if isinstance(arrays, dict):
+            items = list(arrays.items())
+        else:
+            items = [("", a) for a in arrays]
+        # keep contiguous refs alive for the pool's lifetime (C++ reads
+        # the raw pointers until destroy)
+        self._arrays = [(k, np.ascontiguousarray(v)) for k, v in items]
+        rows = {a.shape[0] for _, a in self._arrays}
+        if len(rows) != 1:
+            raise ValueError(f"sources disagree on dataset length: {rows}")
+        n = rows.pop()
+        batch_bytes = sum(
+            int(np.prod(a.shape[1:], dtype=np.int64)) * a.dtype.itemsize
+            for _, a in self._arrays) * batch_size
+        header = 4 + sum(3 + len(k.encode()) + len(str(a.dtype)) +
+                         8 * a.ndim for k, a in self._arrays)
+        self._ring = NativeRing(slots=slots,
+                                slot_bytes=batch_bytes + header)
+        self._ptr = self._lib.pl_pool_create(
+            self._ring._ptr,
+            ctypes.cast(self._ringlib.pt_ring_push, ctypes.c_void_p),
+            ctypes.cast(self._ringlib.pt_ring_close, ctypes.c_void_p),
+            int(n_workers))
+        for k, a in self._arrays:
+            dims = (ctypes.c_int64 * max(1, a.ndim - 1))(*a.shape[1:])
+            rc = self._lib.pl_pool_add_source(
+                self._ptr, k.encode(), str(a.dtype).encode(),
+                a.ctypes.data_as(ctypes.c_void_p), n, dims, a.ndim - 1,
+                int(np.prod(a.shape[1:], dtype=np.int64)) * a.dtype.itemsize)
+            if rc != 0:
+                raise RuntimeError(f"pl_pool_add_source failed rc={rc}")
+        self.total_batches = self._lib.pl_pool_start(
+            self._ptr, batch_size, epochs,
+            0 if shuffle_seed is None else int(shuffle_seed),
+            0 if shuffle_seed is None else 1,
+            1 if drop_last else 0, 1 if ordered else 0)
+        if self.total_batches < 0:
+            raise RuntimeError("pl_pool_start rejected the config")
+
+    def __iter__(self):
+        while True:
+            raw = self._ring.pop()
+            if raw is None:
+                return
+            yield deserialize_batch(raw)
+
+    def close(self):
+        if getattr(self, "_ptr", None):
+            self._lib.pl_pool_destroy(self._ptr)
+            self._ptr = None
+
+    def __del__(self):
+        try:
+            self.close()  # joins workers, so the ring outlives every push
+        except Exception:
+            pass
+
+
+def pool_reader(arrays, batch_size, **kw):
+    """Reader-decorator facade over NativeLoaderPool (same call shape as
+    paddle.batch(paddle.reader.shuffle(...)) chains, but native)."""
+
+    def reader_fn():
+        pool = NativeLoaderPool(arrays, batch_size, **kw)
+        try:
+            yield from pool
+        finally:
+            pool.close()
+
+    return reader_fn
+
+
 def native_buffered(reader, size=8):
     """Decorator parity with reader.buffered(), but the buffer is the C++
     ring: the producer thread serializes+pushes while the consumer pops.
