@@ -163,7 +163,12 @@ void worker(Pool* p) {
     // last worker out: EOF the ring so the consumer drains then stops
     p->close(p->ring);
   }
-  // wake ordered waiters stuck on a ticket that will never come
+  // wake ordered waiters stuck on a ticket that will never come; the lock
+  // serializes with a waiter between its predicate check and parking, or
+  // the notify could land in that window and be lost
+  {
+    std::lock_guard<std::mutex> lk(p->ticket_mu);
+  }
   p->ticket_cv.notify_all();
 }
 
@@ -241,6 +246,10 @@ void pl_pool_destroy(void* pp) {
   Pool* p = static_cast<Pool*>(pp);
   p->stop.store(true);
   if (p->close && p->ring) p->close(p->ring);
+  {
+    // serialize with waiters' predicate-check-to-park window (lost-wakeup)
+    std::lock_guard<std::mutex> lk(p->ticket_mu);
+  }
   p->ticket_cv.notify_all();
   for (std::thread& t : p->threads)
     if (t.joinable()) t.join();

@@ -18,6 +18,7 @@ from . import recommender
 from . import lstm_text
 from . import transformer
 from . import bert
+from . import ernie
 from . import deepfm
 from . import gan
 from . import detection_demo

@@ -58,6 +58,13 @@ def _pad_to(x, axis, mult):
     return jnp.pad(x, widths)
 
 
+def _lane_pad(x, bq):
+    """(b*h, tq) -> (b*h, tq_padded, LSE_LANES): pad the q axis to the
+    block size and broadcast across the lane dim (TPU wants >=2D tiles)."""
+    x = _pad_to(x, 1, bq)
+    return jnp.broadcast_to(x[..., None], x.shape + (LSE_LANES,))
+
+
 def _bias_index_fn(bb, hb, h):
     """Index map over the collapsed (bb*hb) bias batch dim for grid index
     bh in [0, b*h)."""
@@ -508,12 +515,8 @@ def _flash_bwd_kgrid(q, k, v, bias, lse, out, do, scale, causal, block_q,
     tq_p, tk_p = q3.shape[1], k3.shape[1]
     num_qb, num_kb = tq_p // bq, tk_p // bk
 
-    def lane_pad(x):
-        x = _pad_to(x, 1, bq)
-        return jnp.broadcast_to(x[..., None], x.shape + (LSE_LANES,))
-
-    lse_p = lane_pad(lse.reshape(b * h, tq))
-    dlt_p = lane_pad(delta.reshape(b * h, tq))
+    lse_p = _lane_pad(lse.reshape(b * h, tq), bq)
+    dlt_p = _lane_pad(delta.reshape(b * h, tq), bq)
     has_bias = bias is not None
 
     # -- dQ: grid (bh, qb, kb) ------------------------------------------
@@ -595,36 +598,19 @@ def _flash_bwd(q, k, v, bias, lse, out, do, scale, causal, block_q, block_k,
                dlse=None):
     b, h, tq, d = q.shape
     tk = k.shape[2]
-    bq = min(block_q, max(tq, 1))
-    bk = min(block_k, max(tk, 1))
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     if dlse is not None:
         # lse cotangent: d lse / d s = softmax = p, so it enters every
         # kernel exactly as ds = p*(dp - (delta - dlse)).
         delta = delta - dlse.astype(jnp.float32)
 
-    q_p = _pad_to(q, 2, bq).reshape(b * h, -1, d)
+    q_p, k_p, v_p, bias3, bidx, per_q, bq, bk = _prep_qkv_bias(
+        q, k, v, bias, block_q, block_k)
     do_p = _pad_to(do, 2, bq).reshape(b * h, -1, d)
-    k_p = _pad_to(k, 2, bk).reshape(b * h, -1, d)
-    v_p = _pad_to(v, 2, bk).reshape(b * h, -1, d)
-    def lane_pad(x):  # (b*h, tq) -> (b*h, tq_padded, LSE_LANES)
-        x = _pad_to(x, 1, bq)
-        return jnp.broadcast_to(x[..., None], x.shape + (LSE_LANES,))
-
-    lse_p = lane_pad(lse.reshape(b * h, tq))
-    dlt_p = lane_pad(delta.reshape(b * h, tq))
+    lse_p = _lane_pad(lse.reshape(b * h, tq), bq)
+    dlt_p = _lane_pad(delta.reshape(b * h, tq), bq)
     tq_p, tk_p = q_p.shape[1], k_p.shape[1]
-
     has_bias = bias is not None
-    per_q = False
-    bias3 = None
-    bidx = None
-    if has_bias:
-        bb, hb, tqb, _ = bias.shape
-        per_q = tqb > 1
-        bias3 = _pad_to(_pad_to(bias, 3, bk), 2, bq if per_q else 1)
-        bias3 = bias3.reshape(bb * hb, bias3.shape[2], tk_p)
-        bidx = _bias_index_fn(bb, hb, h)
 
     # -- dQ: grid over q blocks, loop over k blocks.
     in_specs = [
