@@ -156,6 +156,7 @@ def _compile_train_step(build_net, make_feed, make_opt, batch):
         with scope_guard(scope):
             return exe.run(main, feed=feed, fetch_list=[loss])
 
+    step.executor = exe
     return step, 3 * fwd_flops
 
 
@@ -245,10 +246,25 @@ def bench_one(batch, seq_len, n_steps):
     # out is numpy (return_numpy) so the step is host-synchronized
     dt = time.perf_counter() - t0
     assert np.isfinite(out[0]).all(), "loss went non-finite during bench"
+    # cross-check the analytic FLOPs/step against XLA's own cost model;
+    # a big gap means the MFU denominator (and so MFU itself) is suspect
+    xla_flops = None
+    try:
+        exe = getattr(step, "executor", None)
+        if exe is not None:
+            xla_flops = float(exe.last_cost_analysis().get("flops", 0)) or None
+    except Exception as e:
+        print(f"bench: cost_analysis unavailable: {e}", file=sys.stderr)
+    if xla_flops:
+        ratio = step_flops / xla_flops
+        print(f"bench: flops cross-check analytic/xla = {ratio:.2f} "
+              f"(analytic {step_flops:.3e}, xla {xla_flops:.3e})",
+              file=sys.stderr)
     return {
         "batch": batch,
         "tokens_per_sec": tokens_per_step * n_steps / dt,
         "model_flops_per_sec": step_flops * n_steps / dt,
+        "xla_flops_per_step": xla_flops,
         "flash_engaged": bool(flash_engaged),
     }
 
@@ -299,6 +315,9 @@ def _emit(sweep, seq_len, kind, peak):
         "vs_baseline": (None if tiny else
                         round(best["tokens_per_sec"] / baseline, 3)),
         "mfu": round(best["mfu"], 4),
+        # XLA's own FLOPs count for one step (None if unavailable): lets a
+        # reader audit the analytic MFU denominator against the compiler's
+        "xla_flops_per_step": best.get("xla_flops_per_step"),
         "batch": best["batch"],
         "device_kind": kind,
         "peak_tflops": peak / 1e12,

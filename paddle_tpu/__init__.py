@@ -48,6 +48,7 @@ from . import profiler
 from . import amp
 from . import models
 from . import utils
+from .utils import install_check   # fluid.install_check.run_check() parity
 from . import inference
 
 # fluid-compat: `fluid.data` in 2.x has no implicit batch dim. Keep both:

@@ -1,3 +1,4 @@
 """contrib — API-compatible extras (parity: python/paddle/fluid/contrib)."""
 
 from . import decoder  # noqa: F401
+from . import mixed_precision  # noqa: F401

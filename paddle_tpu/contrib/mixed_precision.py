@@ -1,0 +1,10 @@
+"""fluid.contrib.mixed_precision parity path — re-exports the AMP API.
+
+Parity: python/paddle/fluid/contrib/mixed_precision/__init__.py (decorate,
+AutoMixedPrecisionLists). The implementation lives in paddle_tpu.amp
+(in-graph dynamic loss scaling, bf16-first policy); this module keeps the
+reference import path working unchanged.
+"""
+
+from ..amp import (decorate, CustomOpLists,  # noqa: F401
+                   AutoMixedPrecisionLists)

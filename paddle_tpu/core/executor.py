@@ -134,14 +134,40 @@ class Executor:
     def close(self):
         self._cache.clear()
         self._last_call = None
+        self._compiled_memo = {}
+
+    def _last_compiled(self):
+        """AOT-compiled object for the most recent step, memoized per
+        cached step_fn — lower().compile() would otherwise re-trace and
+        re-pay the full XLA compile (~20-40s for the big models) on every
+        introspection call."""
+        if self._last_call is None:
+            raise RuntimeError("no program has been run yet")
+        step_fn, args = self._last_call
+        memo = getattr(self, "_compiled_memo", None)
+        if memo is None:
+            memo = self._compiled_memo = {}
+        compiled = memo.get(id(step_fn))
+        if compiled is None:
+            compiled = memo[id(step_fn)] = step_fn.lower(*args).compile()
+        return compiled
 
     def last_compiled_text(self):
         """Optimized HLO of the most recent step executable (post-XLA-opt;
         what actually ran). Used by bench.py's self-audit and kernel tests."""
-        if self._last_call is None:
-            raise RuntimeError("no program has been run yet")
-        step_fn, args = self._last_call
-        return step_fn.lower(*args).compile().as_text()
+        return self._last_compiled().as_text()
+
+    def last_cost_analysis(self):
+        """XLA's own cost model for the most recent step executable:
+        {'flops': ..., 'bytes accessed': ..., ...} (keys as XLA names
+        them; flops is the compiler's count for ONE step). Used by
+        bench.py to cross-check the analytic FLOPs/step number — a big
+        mismatch means the MFU denominator is lying."""
+        costs = self._last_compiled().cost_analysis()
+        # older jax returns a one-element list of dicts
+        if isinstance(costs, (list, tuple)):
+            costs = costs[0] if costs else {}
+        return dict(costs or {})
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             feed_var_name="feed", fetch_var_name="fetch", return_numpy=True,
