@@ -15,3 +15,6 @@ from . import wmt16
 from . import flowers
 from . import conll05
 from . import sentiment
+from . import image
+from . import mq2007
+from . import voc2012

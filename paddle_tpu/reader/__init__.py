@@ -1,4 +1,5 @@
 from .decorator import (batch, shuffle, buffered, cache, chain, compose,
                         map_readers, firstn, xmap_readers,
-                        multiprocess_reader)
+                        multiprocess_reader, ComposeNotAligned, Fake,
+                        PipeReader)
 from .dataloader import DataLoader

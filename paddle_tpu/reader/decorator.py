@@ -1,7 +1,8 @@
 """Reader decorators.
 
 Parity: python/paddle/reader/decorator.py (batch, shuffle, buffered, cache,
-chain, compose, map_readers, firstn, xmap_readers).
+chain, compose, map_readers, firstn, xmap_readers, multiprocess_reader,
+Fake, PipeReader, ComposeNotAligned).
 """
 
 import itertools
@@ -81,12 +82,24 @@ def chain(*readers):
     return chain_reader
 
 
+class ComposeNotAligned(ValueError):
+    """Raised when composed readers yield different lengths
+    (parity: paddle.reader.ComposeNotAligned)."""
+
+
 def compose(*readers, check_alignment=True):
     def compose_reader():
         its = [r() for r in readers]
-        for items in zip(*its):
+        sentinel = object()
+        for items in itertools.zip_longest(*its, fillvalue=sentinel):
+            # identity checks only: `in`/== would invoke ndarray.__eq__
+            if check_alignment and any(it is sentinel for it in items):
+                raise ComposeNotAligned(
+                    "composed readers have different lengths")
             out = ()
             for item in items:
+                if item is sentinel:
+                    continue
                 out += item if isinstance(item, tuple) else (item,)
             yield out
     return compose_reader
@@ -124,3 +137,61 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
 
 def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
     return chain(*readers)
+
+
+class Fake:
+    """Caches the first sample and replays it forever-per-call (parity:
+    paddle.reader.Fake — pipeline debugging with constant data)."""
+
+    def __init__(self):
+        self.data = None
+        self.yield_num = 0
+
+    def __call__(self, reader, max_num):
+        def fake_reader():
+            if self.data is None:
+                self.data = next(reader())
+            # reset on entry, not exhaustion — an abandoned generator must
+            # not shorten the next call's stream
+            self.yield_num = 0
+            while self.yield_num < max_num:
+                self.yield_num += 1
+                yield self.data
+        return fake_reader
+
+
+class PipeReader:
+    """Stream lines from a shell command's stdout (parity:
+    paddle.reader.PipeReader — e.g. `cat x.gz | gzip -d`). TPU note: the
+    subprocess replaces the reference's hadoop/streaming use; batches are
+    buffered bytes split on newlines."""
+
+    def __init__(self, command, bufsize=8192, file_type="plain"):
+        import subprocess
+        self.process = subprocess.Popen(
+            command.split(" "), bufsize=bufsize, stdout=subprocess.PIPE)
+        self.bufsize = bufsize
+        if file_type == "gzip":
+            import zlib
+            self.dec = zlib.decompressobj(32 + zlib.MAX_WBITS)
+        else:
+            self.dec = None
+
+    def get_line(self, cut_lines=True, line_break="\n"):
+        remained = b""
+        while True:
+            buf = self.process.stdout.read(self.bufsize)
+            if not buf:
+                break
+            if self.dec is not None:
+                buf = self.dec.decompress(buf)
+            if not cut_lines:
+                yield buf
+                continue
+            buf = remained + buf
+            lines = buf.split(line_break.encode())
+            remained = lines.pop()
+            for line in lines:
+                yield line.decode(errors="replace")
+        if remained:
+            yield remained.decode(errors="replace")

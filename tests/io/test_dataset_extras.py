@@ -1,0 +1,164 @@
+"""image / mq2007 / voc2012 dataset modules (reference dataset/ parity).
+
+Same pattern as test_dataset_decoding: build format-valid real files in a
+temp DATA_HOME and check reference-semantics decoding, then the synthetic
+fallback without files.
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def data_home(tmp_path, monkeypatch):
+    import paddle_tpu.dataset.common as common
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
+    yield tmp_path
+
+
+# ---------------------------------------------------------------- image --
+
+def _png_bytes(arr):
+    import io
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def test_image_transforms_roundtrip(tmp_path):
+    from paddle_tpu.dataset import image
+
+    arr = np.arange(40 * 60 * 3, dtype=np.uint8).reshape(40, 60, 3) % 255
+    p = tmp_path / "img.png"
+    p.write_bytes(_png_bytes(arr))
+    im = image.load_image(str(p))
+    np.testing.assert_array_equal(im, arr)            # png is lossless
+
+    short = image.resize_short(im, 20)
+    assert min(short.shape[:2]) == 20
+    assert short.shape[1] == 30                       # aspect kept (40x60)
+
+    crop = image.center_crop(short, 16)
+    assert crop.shape[:2] == (16, 16)
+    rc = image.random_crop(short, 16)
+    assert rc.shape[:2] == (16, 16)
+
+    flipped = image.left_right_flip(im)
+    np.testing.assert_array_equal(flipped, im[:, ::-1])
+
+    chw = image.simple_transform(im, 24, 16, is_train=False,
+                                 mean=[1.0, 2.0, 3.0])
+    assert chw.shape == (3, 16, 16) and chw.dtype == np.float32
+
+    full = image.load_and_transform(str(p), 24, 16, is_train=True)
+    assert full.shape == (3, 16, 16)
+
+
+def test_image_grayscale():
+    from paddle_tpu.dataset import image
+
+    rgb = np.zeros((10, 10, 3), np.uint8)
+    rgb[:, :, 0] = 200
+    g = image.load_image_bytes(_png_bytes(rgb), is_color=False)
+    assert g.shape == (10, 10, 1)
+    assert 40 < int(g.mean()) < 90          # luma of pure red ~ 0.299*200
+
+
+# --------------------------------------------------------------- mq2007 --
+
+LETOR_TEXT = """2 qid:10 1:0.5 2:0.25 3:0.1 #docid=A
+0 qid:10 1:0.1 2:0.9 3:0.3 #docid=B
+1 qid:10 1:0.4 2:0.4 3:0.2 #docid=C
+1 qid:20 1:0.9 2:0.0 3:0.5 #docid=D
+0 qid:20 1:0.2 2:0.1 #docid=E
+"""
+
+
+def test_mq2007_letor_parsing(data_home):
+    (data_home / "mq2007" / "Fold1").mkdir(parents=True)
+    (data_home / "mq2007" / "Fold1" / "train.txt").write_text(LETOR_TEXT)
+    from paddle_tpu.dataset import mq2007
+
+    lists = mq2007.load_from_text(
+        str(data_home / "mq2007" / "Fold1" / "train.txt"))
+    assert [ql.query_id for ql in lists] == [10, 20]
+    assert len(lists[0]) == 3 and len(lists[1]) == 2
+    q = lists[0][0]
+    assert q.relevance_score == 2
+    # fixed 46-dim vectors: stated features first, the rest fill_missing
+    assert len(q.feature_vector) == mq2007.FEATURE_DIM
+    assert q.feature_vector[:3] == [0.5, 0.25, 0.1]
+    assert set(q.feature_vector[3:]) == {-1}
+    assert "docid=A" in q.description
+    # sparse row E fills missing TRAILING features too (never ragged)
+    e = lists[1][1].feature_vector
+    assert len(e) == mq2007.FEATURE_DIM and e[:3] == [0.2, 0.1, -1]
+
+    # pairwise: only cross-relevance pairs, higher first
+    pairs = list(mq2007.train("pairwise")())
+    assert len(pairs) > 0
+    one, hi, lo = pairs[0]
+    assert one == [1.0]
+    # pointwise and listwise shapes
+    rel, feat = next(iter(mq2007.train("pointwise")()))
+    assert feat.ndim == 1
+    rels, feats = next(iter(mq2007.train("listwise")()))
+    assert feats.shape[0] == len(rels)
+
+
+def test_mq2007_synthetic_fallback(data_home):
+    from paddle_tpu.dataset import mq2007
+    rel, feat = next(iter(mq2007.test("pointwise")()))
+    assert feat.shape == (mq2007.FEATURE_DIM,)
+    assert rel in (0, 1, 2)
+    with pytest.raises(ValueError):
+        mq2007.train("bogus")
+    with pytest.raises(RuntimeError):
+        mq2007.fetch()
+
+
+# -------------------------------------------------------------- voc2012 --
+
+def test_voc2012_synthetic(data_home):
+    from paddle_tpu.dataset import voc2012
+    img, lbl = next(iter(voc2012.train()()))
+    assert img.shape[0] == 3 and img.dtype == np.uint8
+    assert lbl.shape == img.shape[1:] and lbl.dtype == np.uint8
+    assert lbl.max() >= 1 and lbl.max() < voc2012.N_CLASSES
+    # the mask marks exactly the colored rectangle
+    assert (lbl > 0).sum() > 0
+
+
+def test_voc2012_real_tar_decoding(data_home):
+    import tarfile
+    from paddle_tpu.dataset import voc2012
+
+    img = (np.random.RandomState(0).rand(24, 24, 3) * 255).astype(np.uint8)
+    lbl = np.zeros((24, 24), np.uint8)
+    lbl[4:12, 4:12] = 7
+    tar_path = data_home / voc2012.VOC_TAR
+
+    import io
+    from PIL import Image
+
+    def _add(tf, name, data):
+        info = tarfile.TarInfo(name)
+        info.size = len(data)
+        tf.addfile(info, io.BytesIO(data))
+
+    def _enc(arr, fmt):
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format=fmt)
+        return buf.getvalue()
+
+    with tarfile.open(tar_path, "w") as tf:
+        _add(tf, voc2012._SETS_DIR + "trainval.txt", b"2007_000001\n")
+        _add(tf, voc2012._IMG_DIR + "2007_000001.jpg", _enc(img, "JPEG"))
+        _add(tf, voc2012._LBL_DIR + "2007_000001.png", _enc(lbl, "PNG"))
+
+    out = list(voc2012.train()())
+    assert len(out) == 1
+    got_img, got_lbl = out[0]
+    assert got_img.shape == (3, 24, 24)
+    np.testing.assert_array_equal(got_lbl, lbl)       # png mask lossless

@@ -1,0 +1,67 @@
+"""Reader decorator parity tests (paddle.reader surface).
+
+Covers the decorators added for full parity: compose alignment error,
+Fake replay, PipeReader subprocess streaming (plain + gzip).
+"""
+
+import gzip
+
+import numpy as np
+import pytest
+
+from paddle_tpu import reader
+
+
+def _r(items):
+    def rd():
+        yield from items
+    return rd
+
+
+def test_compose_aligned_and_not():
+    c = reader.compose(_r([1, 2]), _r([(10, 11), (20, 21)]))
+    assert list(c()) == [(1, 10, 11), (2, 20, 21)]
+    bad = reader.compose(_r([1, 2, 3]), _r([1]))
+    with pytest.raises(reader.ComposeNotAligned):
+        list(bad())
+    ok = reader.compose(_r([1, 2, 3]), _r([1]), check_alignment=False)
+    assert len(list(ok())) == 3
+
+
+def test_fake_replays_first_sample():
+    fake = reader.Fake()
+    src = _r([("a", 1), ("b", 2)])
+    out = list(fake(src, max_num=4)())
+    assert out == [("a", 1)] * 4
+    # a second call replays again (yield_num reset)
+    assert list(fake(src, max_num=2)()) == [("a", 1)] * 2
+
+
+def test_fake_abandoned_generator_does_not_shorten_next():
+    fake = reader.Fake()
+    g = fake(_r(["x", "y"]), max_num=5)()
+    next(g), next(g)            # consume 2, abandon
+    assert len(list(fake(_r(["x"]), max_num=5)())) == 5
+
+
+def test_compose_handles_numpy_samples():
+    a = _r([np.arange(4), np.arange(4) + 1])
+    b = _r([np.zeros(3), np.ones(3)])
+    out = list(reader.compose(a, b)())
+    assert len(out) == 2 and len(out[0]) == 2
+    np.testing.assert_array_equal(out[1][0], np.arange(4) + 1)
+
+
+def test_pipereader_plain(tmp_path):
+    p = tmp_path / "lines.txt"
+    p.write_text("alpha\nbeta\ngamma\n")
+    pr = reader.PipeReader(f"cat {p}")
+    assert list(pr.get_line()) == ["alpha", "beta", "gamma"]
+
+
+def test_pipereader_gzip(tmp_path):
+    p = tmp_path / "lines.gz"
+    with gzip.open(p, "wb") as f:
+        f.write(b"one\ntwo\n")
+    pr = reader.PipeReader(f"cat {p}", file_type="gzip")
+    assert list(pr.get_line()) == ["one", "two"]
