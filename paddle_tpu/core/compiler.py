@@ -112,16 +112,29 @@ class ParallelExecutor:
 
 
 def _shard_feeds_spec(feeds, mesh):
-    """Leading-axis batch sharding for every feed; scalars replicated."""
+    """Batch axis over 'dp'; the time axis (dim 1) additionally over 'sp'
+    when it divides and is plausibly a sequence (>=32 — keeps small aux
+    feeds like masked-position indices replicated). Sharding is layout
+    only, never semantics, so the heuristic can't change numerics."""
     specs = {}
     dp = mesh.shape.get("dp", 1) if "dp" in mesh.axis_names else 1
+    sp = mesh.shape.get("sp", 1) if "sp" in mesh.axis_names else 1
     for k, v in feeds.items():
+        axes = []
         if dp > 1 and hasattr(v, "ndim") and v.ndim >= 1 \
                 and v.shape[0] % dp == 0:
-            specs[k] = NamedSharding(mesh, P("dp", *([None] * (v.ndim - 1))))
+            axes.append("dp")
+        elif hasattr(v, "ndim") and v.ndim >= 1:
+            axes.append(None)
+        if axes and sp > 1 and v.ndim >= 2 and v.shape[1] >= 32 \
+                and v.shape[1] % sp == 0:
+            axes.append("sp")
+        if axes and hasattr(v, "ndim"):
+            axes += [None] * (v.ndim - len(axes))
+            specs[k] = NamedSharding(mesh, P(*axes))
         else:
             specs[k] = NamedSharding(mesh, P())
-        # note: uneven batches fall back to replication (still correct)
+        # note: uneven axes fall back to replication (still correct)
     return specs
 
 

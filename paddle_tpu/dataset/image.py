@@ -39,13 +39,23 @@ def load_image(path, is_color=True):
 
 
 def _resize(im, h, w):
-    """Bilinear resize via PIL (codec-quality), numpy in/out."""
+    """Bilinear resize via PIL (codec-quality), numpy in/out. Preserves
+    dtype: float images resize per-channel in PIL 'F' mode (no value
+    truncation), uint8 goes through the native path."""
+    Image = _pil()
     squeeze = im.ndim == 3 and im.shape[2] == 1
     src = im[:, :, 0] if squeeze else im
     dtype = src.dtype
-    img = _pil().fromarray(src.astype(np.uint8) if dtype != np.uint8 else src)
-    img = img.resize((w, h))
-    out = np.asarray(img)
+    if dtype == np.uint8:
+        out = np.asarray(Image.fromarray(src).resize((w, h)))
+    else:
+        chans = src[..., None] if src.ndim == 2 else src
+        planes = [np.asarray(Image.fromarray(
+            chans[:, :, c].astype(np.float32), mode="F").resize((w, h)))
+            for c in range(chans.shape[2])]
+        out = np.stack(planes, axis=-1)
+        if src.ndim == 2:
+            out = out[:, :, 0]
     if squeeze:
         out = out[:, :, None]
     return out.astype(dtype)
@@ -111,6 +121,14 @@ def load_and_transform(filename, resize_size, crop_size, is_train,
                             crop_size, is_train, is_color, mean)
 
 
+def _obj_array(bufs):
+    """1-D object array of per-image byte buffers — np.array(..., object)
+    would go 2-D whenever the buffers happen to share a length."""
+    arr = np.empty(len(bufs), dtype=object)
+    arr[:] = bufs
+    return arr
+
+
 def batch_images_from_tar(data_file, dataset_name, img2label,
                           num_per_batch=1024):
     """Pre-decode a tar of images into .npz batch files + a meta listing
@@ -131,14 +149,14 @@ def batch_images_from_tar(data_file, dataset_name, img2label,
             labels.append(img2label[member.name])
             if len(data) == num_per_batch:
                 fname = os.path.join(out_path, f"batch_{n}.npz")
-                np.savez(fname, data=np.array(data, dtype=object),
+                np.savez(fname, data=_obj_array(data),
                          label=np.asarray(labels))
                 names.append(fname)
                 data, labels = [], []
                 n += 1
         if data:
             fname = os.path.join(out_path, f"batch_{n}.npz")
-            np.savez(fname, data=np.array(data, dtype=object),
+            np.savez(fname, data=_obj_array(data),
                      label=np.asarray(labels))
             names.append(fname)
     with open(meta, "w") as f:

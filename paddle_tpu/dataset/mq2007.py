@@ -21,7 +21,8 @@ class Query:
                  description=""):
         self.query_id = query_id
         self.relevance_score = relevance_score
-        self.feature_vector = feature_vector or []
+        # no `or []`: truthiness on a numpy feature array raises
+        self.feature_vector = [] if feature_vector is None else feature_vector
         self.description = description
 
     def __str__(self):

@@ -52,8 +52,9 @@ def _synthetic_reader_creator(num, seed, size=64):
             lbl = np.zeros((size, size), np.uint8)
             h, w = rng.randint(size // 4, size // 2, 2)
             y, x = rng.randint(0, size - h), rng.randint(0, size - w)
-            img[y:y + h, x:x + w] = colors[cls] + \
-                rng.randint(-16, 16, (h, w, 3))
+            img[y:y + h, x:x + w] = np.clip(
+                colors[cls].astype(np.int32) +
+                rng.randint(-16, 16, (h, w, 3)), 0, 255).astype(np.uint8)
             lbl[y:y + h, x:x + w] = cls
             yield img.transpose(2, 0, 1), lbl
 

@@ -50,8 +50,43 @@ def _xla_attention(q, k, v, bias=None, scale=None, causal=False):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
+def _active_sp_mesh(q, k, bias):
+    """The executor-activated mesh, when sequence parallelism applies:
+    mesh has an 'sp' axis > 1, BOTH time axes divide it (cross-attention
+    has Tq != Tk), and the bias (if any) is a 4-D key-side bias — the
+    shapes ring attention can decompose. Anything else falls back to the
+    dense paths, never crashes."""
+    if os.environ.get("PADDLE_TPU_DISABLE_RING") == "1":
+        return None
+    try:
+        from jax._src import mesh as mesh_lib
+        mesh = mesh_lib.thread_resources.env.physical_mesh
+    except Exception:  # pragma: no cover - jax internals moved
+        return None
+    if mesh.empty or "sp" not in mesh.axis_names:
+        return None
+    sp = mesh.shape["sp"]
+    if sp <= 1 or q.shape[2] % sp != 0 or k.shape[2] % sp != 0:
+        return None
+    if bias is not None and (bias.ndim != 4 or bias.shape[2] != 1
+                             or bias.shape[3] != k.shape[2]):
+        return None                      # per-query / odd-rank bias
+    for name, dim in (("dp", q.shape[0]), ("tp", q.shape[1])):
+        if name in mesh.axis_names and dim % mesh.shape[name] != 0:
+            return None
+    return mesh
+
+
 def dot_product_attention(q, k, v, bias=None, scale=None, causal=False):
-    """Dispatch: Pallas flash kernel on TPU, XLA composition elsewhere."""
+    """Dispatch: ring attention over 'sp' when the Executor activated a
+    sequence-parallel mesh (the framework path to long context — K/V and
+    the key-side bias rotate over ICI, O(T/sp) memory per chip); else the
+    Pallas flash kernel on TPU; else the XLA composition."""
+    sp_mesh = _active_sp_mesh(q, k, bias)
+    if sp_mesh is not None:
+        from ..parallel.ring_attention import ring_attention_sharded
+        return ring_attention_sharded(q, k, v, sp_mesh, causal=causal,
+                                      scale=scale, bias=bias)
     if _use_pallas():
         try:
             from .pallas.flash import flash_attention

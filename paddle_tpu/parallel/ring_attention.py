@@ -21,9 +21,11 @@ from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
 
-def _online_block(q, k, v, m, l, o, mask, scale):
+def _online_block(q, k, v, m, l, o, mask, scale, bias=None):
     """One flash-attention block update. q:(...,Tq,d) k,v:(...,Tk,d)."""
     s = jnp.einsum("...qd,...kd->...qk", q, k) * scale
+    if bias is not None:
+        s = s + bias                      # additive (padding) bias
     if mask is not None:
         s = jnp.where(mask, s, -jnp.inf)
     m_blk = jnp.max(s, axis=-1)
@@ -86,20 +88,23 @@ def _use_flash_inner():
         return False
 
 
-def _ring_step_flash(q, kk, vv, kv_owner, idx, causal, scale):
+def _ring_step_flash(q, kk, vv, kv_owner, idx, causal, scale, bias=None):
     """One ring step through the fused Pallas kernel: returns the chunk's
     normalized output + logsumexp for the cross-step online combine. The
     causal structure is block-level (past owner: full; self: in-chunk
     causal; future owner: skip) so no (T_local, T_local) mask tensor is
-    ever materialized in HBM."""
+    ever materialized in HBM. `bias` is this step's key-side (padding)
+    bias chunk (B, hb, 1, T_local), rotated by the caller with kk/vv."""
     from ..ops.pallas.flash import flash_attention_with_lse
     b, h, t_local, _ = q.shape
 
     def full(_):
-        return flash_attention_with_lse(q, kk, vv, scale=scale, causal=False)
+        return flash_attention_with_lse(q, kk, vv, bias=bias, scale=scale,
+                                        causal=False)
 
     def diag(_):
-        return flash_attention_with_lse(q, kk, vv, scale=scale, causal=True)
+        return flash_attention_with_lse(q, kk, vv, bias=bias, scale=scale,
+                                        causal=True)
 
     def skip(_):
         return (jnp.zeros_like(q),
@@ -112,25 +117,33 @@ def _ring_step_flash(q, kk, vv, kv_owner, idx, causal, scale):
                     None)
 
 
-def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None):
+def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None,
+                   bias=None):
     """Ring attention over a sequence-sharded axis. Call INSIDE shard_map:
     q,k,v are the local shards (B, H, T_local, d); the sequence axis is
     sharded over `axis_name`. K/V rotate around the ring; per-step partial
     softmax is merged online. On TPU (or PADDLE_TPU_FORCE_FLASH=1) the
-    local block runs the fused Pallas flash kernel (SURVEY §7 R2 item)."""
+    local block runs the fused Pallas flash kernel (SURVEY §7 R2 item).
+
+    `bias`: optional KEY-side additive bias (padding mask) local chunk
+    (B, 1|H, 1, T_local), sharded over the key-time axis like k/v; it
+    rotates around the ring with them. Per-query biases (Tq > 1) are not
+    ring-decomposable here — callers fall back to dense attention."""
     sp = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     b, h, t_local, d = q.shape
     scale = scale if scale is not None else d ** -0.5
     q_pos = idx * t_local + jnp.arange(t_local)
     use_flash = _use_flash_inner()
+    has_bias = bias is not None
 
     def body(i, carry):
-        m, l, o, kk, vv = carry
+        m, l, o, kk, vv, bb = carry
         kv_owner = (idx - i) % sp  # whose shard we hold at step i
+        bias_i = bb if has_bias else None
         if use_flash:
             o_s, lse_s = _ring_step_flash(q, kk, vv, kv_owner, idx, causal,
-                                          scale)
+                                          scale, bias=bias_i)
             # combine normalized chunk outputs via lse weights
             m_new = jnp.maximum(m, lse_s)
             safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
@@ -147,29 +160,55 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None):
                 mask = jnp.broadcast_to(mask, (b, h, t_local, t_local))
             else:
                 mask = None
-            m, l, o = _online_block(q, kk, vv, m, l, o, mask, scale)
+            m, l, o = _online_block(q, kk, vv, m, l, o, mask, scale,
+                                    bias=bias_i)
         perm = [(j, (j + 1) % sp) for j in range(sp)]
         kk = lax.ppermute(kk, axis_name, perm)
         vv = lax.ppermute(vv, axis_name, perm)
-        return (m, l, o, kk, vv)
+        if has_bias:
+            bb = lax.ppermute(bb, axis_name, perm)
+        return (m, l, o, kk, vv, bb)
 
     acc_dtype = jnp.float32 if use_flash else q.dtype
     init = (jnp.full((b, h, t_local), -jnp.inf, acc_dtype),
             jnp.zeros((b, h, t_local), acc_dtype),
             jnp.zeros((b, h, t_local, d), acc_dtype),
-            k, v)
-    m, l, o, _, _ = lax.fori_loop(0, sp, body, init)
+            k, v,
+            bias if has_bias else jnp.zeros((), q.dtype))
+    m, l, o, _, _, _ = lax.fori_loop(0, sp, body, init)
     return (o / jnp.maximum(l, 1e-20)[..., None]).astype(q.dtype)
 
 
-def ring_attention_sharded(q, k, v, mesh, causal=False,
-                           batch_axis="dp", seq_axis="sp", head_axis="tp"):
+def ring_attention_sharded(q, k, v, mesh, causal=False, scale=None,
+                           bias=None, batch_axis="dp", seq_axis="sp",
+                           head_axis="tp"):
     """shard_map wrapper: q,k,v are global (B, H, T, d) arrays; returns the
-    globally-correct attention output with T sharded over `seq_axis`."""
-    spec = P(batch_axis, head_axis, seq_axis, None)
+    globally-correct attention output with T sharded over `seq_axis`.
+    Axis names absent from `mesh` are dropped from the specs, so the same
+    call works on sp-only, dp+sp, or full hybrid meshes. `bias` must be a
+    key-side (B, 1|H, 1, Tk) padding bias (rotates with K/V)."""
+    def ax(name):
+        return name if name in mesh.axis_names else None
 
-    fn = shard_map(
-        functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
-    return fn(q, k, v)
+    spec = P(ax(batch_axis), ax(head_axis), ax(seq_axis), None)
+    in_specs = [spec, spec, spec]
+    args = [q, k, v]
+    if bias is not None:
+        if bias.shape[2] != 1:
+            raise ValueError(
+                "ring attention takes a key-side bias (B, 1|H, 1, Tk); "
+                f"got Tq={bias.shape[2]}")
+        in_specs.append(P(ax(batch_axis),
+                          ax(head_axis) if bias.shape[1] != 1 else None,
+                          None, ax(seq_axis)))
+        args.append(bias)
+
+    def local(*a):
+        qq, kk, vv = a[:3]
+        bb = a[3] if len(a) > 3 else None
+        return ring_attention(qq, kk, vv, axis_name=seq_axis, causal=causal,
+                              scale=scale, bias=bb)
+
+    fn = shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
+                   out_specs=spec, check_vma=False)
+    return fn(*args)

@@ -90,16 +90,18 @@ class ComposeNotAligned(ValueError):
 def compose(*readers, check_alignment=True):
     def compose_reader():
         its = [r() for r in readers]
-        sentinel = object()
-        for items in itertools.zip_longest(*its, fillvalue=sentinel):
+        if check_alignment:
+            sentinel = object()
+            zipped = itertools.zip_longest(*its, fillvalue=sentinel)
+        else:
+            zipped = zip(*its)      # reference semantics: stop at shortest
+        for items in zipped:
             # identity checks only: `in`/== would invoke ndarray.__eq__
             if check_alignment and any(it is sentinel for it in items):
                 raise ComposeNotAligned(
                     "composed readers have different lengths")
             out = ()
             for item in items:
-                if item is sentinel:
-                    continue
                 out += item if isinstance(item, tuple) else (item,)
             yield out
     return compose_reader

@@ -55,6 +55,45 @@ def test_image_transforms_roundtrip(tmp_path):
     assert full.shape == (3, 16, 16)
 
 
+def test_image_resize_preserves_float_values():
+    from paddle_tpu.dataset import image
+
+    im = np.linspace(0.0, 1.0, 16 * 24 * 3, dtype=np.float32)
+    im = im.reshape(16, 24, 3)
+    out = image.resize_short(im, 8)
+    assert out.dtype == np.float32
+    # float [0,1] data must not truncate to zeros
+    assert 0.3 < float(out.mean()) < 0.7
+    np.testing.assert_allclose(out.mean(), im.mean(), atol=0.05)
+
+
+def test_batch_images_from_tar_equal_length_buffers(tmp_path):
+    import tarfile
+    from paddle_tpu.dataset import image
+
+    # two encoded "images" with EQUAL byte length (the np.array(object)
+    # 2-D trap) + a 1-element final batch
+    tar_path = tmp_path / "imgs.tar"
+    with tarfile.open(tar_path, "w") as tf:
+        for name, payload in [("a.jpg", b"12345678"), ("b.jpg", b"abcdefgh"),
+                              ("c.jpg", b"x")]:
+            info = tarfile.TarInfo(name)
+            info.size = len(payload)
+            import io as _io
+            tf.addfile(info, _io.BytesIO(payload))
+    meta = image.batch_images_from_tar(
+        str(tar_path), "t", {"a.jpg": 0, "b.jpg": 1, "c.jpg": 2},
+        num_per_batch=2)
+    batches = open(meta).read().splitlines()
+    assert len(batches) == 2
+    first = np.load(batches[0], allow_pickle=True)
+    data = first["data"]
+    assert data.shape == (2,) and data.dtype == object
+    assert bytes(data[0]) == b"12345678"
+    last = np.load(batches[1], allow_pickle=True)
+    assert last["data"].shape == (1,)
+
+
 def test_image_grayscale():
     from paddle_tpu.dataset import image
 

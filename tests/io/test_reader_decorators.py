@@ -25,7 +25,7 @@ def test_compose_aligned_and_not():
     with pytest.raises(reader.ComposeNotAligned):
         list(bad())
     ok = reader.compose(_r([1, 2, 3]), _r([1]), check_alignment=False)
-    assert len(list(ok())) == 3
+    assert list(ok()) == [(1, 1)]      # zips to the shortest, like paddle
 
 
 def test_fake_replays_first_sample():
@@ -42,6 +42,13 @@ def test_fake_abandoned_generator_does_not_shorten_next():
     g = fake(_r(["x", "y"]), max_num=5)()
     next(g), next(g)            # consume 2, abandon
     assert len(list(fake(_r(["x"]), max_num=5)())) == 5
+
+
+def test_compose_unaligned_stops_at_shortest():
+    # reference semantics: check_alignment=False zips to the SHORTEST
+    out = list(reader.compose(_r([(1, 2), (3, 4), (5, 6)]), _r([9]),
+                              check_alignment=False)())
+    assert out == [(1, 2, 9)]
 
 
 def test_compose_handles_numpy_samples():
