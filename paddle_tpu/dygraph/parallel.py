@@ -1,13 +1,21 @@
 """Dygraph data parallelism.
 
 Parity: python/paddle/fluid/dygraph/parallel.py (DataParallel over NCCL).
-TPU-native: gradient all-reduce happens via jax.lax.psum when running under
-a mapped axis; on a single process it averages over the local batch exactly
-like the reference's single-card path (no-op scale).
+TPU-native: instead of wrapping the eager loop in a collective runtime,
+DataParallel places every input batch SHARDED over a 'dp' device mesh
+(leading axis split). JAX's computation-follows-sharding then runs each
+eager op distributed, and when the tape replays under jax.grad the
+parameter gradients are all-reduced by GSPMD automatically (params are
+replicated, so their cotangents get a psum inserted) — the reference's
+scale_loss / apply_collective_grads pair survives as API but the sync it
+did by hand is already in the compiled backward.
 """
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 class ParallelEnv:
@@ -25,34 +33,63 @@ def prepare_context(strategy=None):
 
 
 class DataParallel:
-    """Wraps a dygraph Layer; scale_loss/apply_collective_grads mirror the
-    reference API. Under a shard_map/pmap axis 'dp' the grad sync is a psum;
-    single-device it's identity."""
+    """Wraps a dygraph Layer. Calls shard input batches across the local
+    devices (leading axis over 'dp'); gradient sync is GSPMD's job during
+    the tape's backward jit, so scale_loss/apply_collective_grads are
+    kept for API parity but are identity on the loss/grads."""
 
-    def __init__(self, layers, strategy=None):
+    def __init__(self, layers, strategy=None, devices=None):
+        from ..parallel.mesh import make_mesh
         self._layers = layers
         self._strategy = strategy or ParallelEnv()
+        # multi-process: shard over THIS process's devices only (host
+        # arrays can't device_put onto non-addressable devices); the
+        # cross-process grad sync happens in apply_collective_grads.
+        if devices is None:
+            devices = (jax.local_devices() if jax.process_count() > 1
+                       else jax.devices())
+        devs = list(devices)
+        self._mesh = make_mesh(dp=len(devs), devices=devs)
+        self._ndev = len(devs)
+
+    def _shard(self, value):
+        """device_put a batch-leading array over the dp mesh (replicate
+        anything that doesn't divide)."""
+        from .base import EagerVariable, to_variable
+        if isinstance(value, EagerVariable):
+            arr = value.value
+            spec = P("dp") if (arr.ndim >= 1 and self._ndev > 1
+                               and arr.shape[0] % self._ndev == 0) else P()
+            value.value = jax.device_put(
+                arr, NamedSharding(self._mesh, spec))
+            return value
+        if isinstance(value, (np.ndarray, jnp.ndarray)):
+            return self._shard(to_variable(np.asarray(value)))
+        return value
 
     def __call__(self, *args, **kwargs):
+        args = tuple(self._shard(a) for a in args)
+        kwargs = {k: self._shard(v) for k, v in kwargs.items()}
         return self._layers(*args, **kwargs)
 
     def __getattr__(self, name):
         return getattr(self.__dict__["_layers"], name)
 
     def scale_loss(self, loss):
-        n = getattr(self._strategy, "nranks", 1)
-        if n <= 1:
-            return loss
-        from .functional import scale_op
-        return scale_op(loss, scale=1.0 / n)
+        # the loss is already the GLOBAL batch mean (the batch was sharded,
+        # not replicated), so no 1/nranks rescale is needed — identity.
+        return loss
 
     def apply_collective_grads(self):
-        n = getattr(self._strategy, "nranks", 1)
-        if n <= 1:
+        # Single process: the grad psum happened inside the backward jit
+        # (params replicated -> GSPMD reduces their cotangents).
+        # Multi-process: each rank saw only its local batch — average the
+        # per-rank grads across processes (the reference's NCCL all-reduce,
+        # here a gather+mean over the jax.distributed cluster).
+        if jax.process_count() <= 1:
             return
+        from jax.experimental import multihost_utils
         for p in self._layers.parameters():
-            if p.grad is not None:
-                try:
-                    p._grad = jax.lax.psum(p._grad, "dp")
-                except NameError:
-                    pass  # no mapped axis: single-program execution
+            if getattr(p, "_grad", None) is not None:
+                gathered = multihost_utils.process_allgather(p._grad)
+                p._grad = jnp.mean(gathered, axis=0)

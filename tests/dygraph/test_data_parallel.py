@@ -1,0 +1,88 @@
+"""Dygraph DataParallel: sharded-input data parallelism on the CPU mesh.
+
+Parity model: the reference's test_imperative_parallel — here the grad
+sync is GSPMD's (params replicated, batch sharded), so the checks are:
+inputs really shard over 'dp', numerics match plain dygraph, and the
+scale_loss/apply_collective_grads API is callable.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import NamedSharding
+
+import paddle_tpu as fluid
+from paddle_tpu import dygraph
+from paddle_tpu.dygraph import nn as dnn, functional as F
+from paddle_tpu.dygraph.parallel import DataParallel, ParallelEnv
+
+
+def test_data_parallel_matches_single_device():
+    """Same params, same batch: the wrapped step's loss AND updated
+    weights must equal the plain dygraph step's (true numerics parity)."""
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(0)
+    xs = rs.rand(16, 4).astype(np.float32)
+    ys = xs @ rs.rand(4, 1).astype(np.float32)
+    w_init = rs.rand(4, 1).astype(np.float32)
+    b_init = np.zeros((1,), np.float32)
+
+    def one_step(wrap):
+        with dygraph.guard():
+            fc = dnn.Linear(4, 1)
+            fc.parameters()[0].value = jnp.asarray(w_init)
+            fc.parameters()[1].value = jnp.asarray(b_init)
+            net = DataParallel(fc) if wrap else fc
+            opt = fluid.optimizer.SGDOptimizer(learning_rate=0.1)
+            pred = net(dygraph.to_variable(xs))
+            diff = pred - dygraph.to_variable(ys)
+            loss = F.mean(diff * diff)
+            if wrap:
+                loss = net.scale_loss(loss)
+            loss.backward()
+            if wrap:
+                net.apply_collective_grads()
+            opt.minimize(loss)
+            w1 = np.asarray(fc.parameters()[0].numpy())
+        return w1, float(loss.numpy())
+
+    w1_plain, loss_plain = one_step(False)
+    w1_dp, loss_dp = one_step(True)
+    np.testing.assert_allclose(loss_dp, loss_plain, rtol=1e-5)
+    np.testing.assert_allclose(w1_dp, w1_plain, rtol=1e-5, atol=1e-7)
+
+
+def test_data_parallel_shards_inputs():
+    with dygraph.guard():
+        fc = dnn.Linear(4, 2)
+        net = DataParallel(fc)
+        x = dygraph.to_variable(np.ones((8, 4), np.float32))
+        out = net(x)
+        # the wrapped call sharded the input batch over 'dp'
+        sh = x.value.sharding
+        assert isinstance(sh, NamedSharding)
+        if len(jax.devices()) > 1:     # conftest forces the 8-dev CPU mesh
+            assert len(sh.spec) >= 1 and sh.spec[0] == "dp"
+        assert out.shape == (8, 2)
+
+
+def test_data_parallel_replicates_odd_batches():
+    with dygraph.guard():
+        net = DataParallel(dnn.Linear(4, 2))
+        x = dygraph.to_variable(np.ones((7, 4), np.float32))  # 7 % 8 != 0
+        out = net(x)                     # replicated, still correct
+        assert out.shape == (7, 2)
+        np.testing.assert_allclose(np.asarray(out.numpy())[0],
+                                   np.asarray(out.numpy())[6])
+
+
+def test_parallel_env_and_getattr_passthrough():
+    env = ParallelEnv()
+    assert env.nranks == len(jax.devices())
+    with dygraph.guard():
+        fc = dnn.Linear(4, 2)
+        net = DataParallel(fc)
+        assert net.parameters() is not None
+        assert len(net.parameters()) == len(fc.parameters())
