@@ -89,7 +89,12 @@ class DataParallel:
         if jax.process_count() <= 1:
             return
         from jax.experimental import multihost_utils
-        for p in self._layers.parameters():
-            if getattr(p, "_grad", None) is not None:
-                gathered = multihost_utils.process_allgather(p._grad)
-                p._grad = jnp.mean(gathered, axis=0)
+        params = [p for p in self._layers.parameters()
+                  if getattr(p, "_grad", None) is not None]
+        if not params:
+            return
+        # ONE collective over the whole grad pytree, not one per param
+        gathered = multihost_utils.process_allgather(
+            [p._grad for p in params])
+        for p, g in zip(params, gathered):
+            p._grad = jnp.mean(g, axis=0)

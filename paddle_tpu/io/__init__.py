@@ -6,3 +6,5 @@ from .inference_io import save_inference_model, load_inference_model
 from .checkpoint import (save_checkpoint, load_checkpoint,
                          save_checkpoint_async, save_checkpoint_sharded,
                          load_checkpoint_sharded, CheckpointHandle)
+from .fluid_format import (load_fluid_vars, save_fluid_vars,
+                           load_fluid_persistables)

@@ -1,0 +1,181 @@
+"""Reference-binary checkpoint interop (io/fluid_format.py).
+
+The byte layout is pinned by lod_tensor.cc SerializeToStream /
+tensor_util.cc TensorToStream: a hand-built reference-format fixture must
+decode exactly, our writer must round-trip through our reader, and
+load_fluid_persistables must hydrate a real program scope.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import fluid_format as ff
+
+
+def _reference_bytes(arr, lod=(), packed_dims=False):
+    """Build the byte stream exactly as the reference C++ writes it."""
+    out = bytearray()
+    out += struct.pack("<I", 0)                     # lod version
+    out += struct.pack("<Q", len(lod))
+    for level in lod:
+        lv = np.asarray(level, np.uint64)
+        out += struct.pack("<Q", lv.nbytes) + lv.tobytes()
+    out += struct.pack("<I", 0)                     # tensor version
+    desc = bytearray()
+    enum = {np.dtype(np.float32): 5, np.dtype(np.int64): 3,
+            np.dtype(np.float16): 4}[arr.dtype]
+    desc += bytes([0x08, enum])                     # field 1 varint
+    if packed_dims:
+        dims = bytearray()
+        for d in arr.shape:
+            while True:
+                b = d & 0x7F
+                d >>= 7
+                dims.append(b | 0x80 if d else b)
+                if not d:
+                    break
+        desc += bytes([0x12, len(dims)]) + bytes(dims)
+    else:
+        for d in arr.shape:
+            desc += bytes([0x10])
+            while True:
+                b = d & 0x7F
+                d >>= 7
+                desc.append(b | 0x80 if d else b)
+                if not d:
+                    break
+    out += struct.pack("<i", len(desc)) + bytes(desc)
+    out += np.ascontiguousarray(arr).tobytes()
+    return bytes(out)
+
+
+def test_decodes_reference_layout_fp32_and_int64(tmp_path):
+    import io as _io
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    got, lod = ff.read_lod_tensor(_io.BytesIO(_reference_bytes(a)))
+    np.testing.assert_array_equal(got, a)
+    assert lod == []
+
+    b = np.array([[1], [2], [300]], np.int64)
+    got, _ = ff.read_lod_tensor(_io.BytesIO(_reference_bytes(b)))
+    np.testing.assert_array_equal(got, b)
+    assert got.dtype == np.int64
+
+
+def test_decodes_lod_and_packed_dims():
+    import io as _io
+    a = np.zeros((5, 2), np.float32)
+    raw = _reference_bytes(a, lod=[[0, 2, 5]], packed_dims=True)
+    got, lod = ff.read_lod_tensor(_io.BytesIO(raw))
+    assert got.shape == (5, 2)
+    assert lod == [[0, 2, 5]]
+
+
+def test_writer_reader_roundtrip_all_dtypes(tmp_path):
+    import io as _io
+    for dtype in [np.float32, np.float64, np.float16, np.int64, np.int32,
+                  np.int16, np.int8, np.uint8, np.bool_]:
+        a = (np.arange(24) % 2).astype(dtype).reshape(2, 3, 4)
+        buf = _io.BytesIO()
+        ff.write_lod_tensor(buf, a, lod=[[0, 1, 2]])
+        buf.seek(0)
+        got, lod = ff.read_lod_tensor(buf)
+        np.testing.assert_array_equal(got, a)
+        assert got.dtype == a.dtype and lod == [[0, 1, 2]]
+
+
+def test_per_var_dir_and_combined_file(tmp_path):
+    vars_ = {"w": np.random.RandomState(0).rand(4, 2).astype(np.float32),
+             "b": np.zeros((2,), np.float32)}
+    ff.save_fluid_vars(str(tmp_path / "pervar"), vars_)
+    got = ff.load_fluid_vars(str(tmp_path / "pervar"))
+    assert set(got) == {"w", "b"}
+    np.testing.assert_array_equal(got["w"], vars_["w"])
+
+    ff.save_fluid_vars(str(tmp_path / "comb"), vars_, filename="all",
+                       var_order=["w", "b"])
+    got = ff.load_fluid_vars(str(tmp_path / "comb"), var_names=["w", "b"],
+                             filename="all")
+    np.testing.assert_array_equal(got["b"], vars_["b"])
+    with pytest.raises(ValueError):
+        ff.load_fluid_vars(str(tmp_path / "comb"), var_names=["w"],
+                           filename="all")          # trailing bytes
+
+
+def test_load_fluid_persistables_into_program(tmp_path):
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.fc(x, size=3, param_attr=fluid.ParamAttr(name="fc_w"),
+                      bias_attr=fluid.ParamAttr(name="fc_b"))
+    w = np.random.RandomState(1).rand(4, 3).astype(np.float32)
+    b = np.random.RandomState(2).rand(3).astype(np.float32)
+    ff.save_fluid_vars(str(tmp_path), {"fc_w": w, "fc_b": b})
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor().run(startup)
+        n, missing = ff.load_fluid_persistables(str(tmp_path),
+                                                main_program=main)
+        assert n == 2 and missing == []
+        np.testing.assert_allclose(np.asarray(scope.get("fc_w")), w,
+                                   rtol=1e-6)
+        out = fluid.Executor().run(main, feed={
+            "x": np.ones((2, 4), np.float32)}, fetch_list=[y])
+        np.testing.assert_allclose(out[0], np.ones((2, 4)) @ w + b,
+                                   rtol=1e-5)
+
+
+def test_corrupt_file_skipped_in_scan_raised_when_explicit(tmp_path):
+    ok = np.ones((2, 2), np.float32)
+    ff.save_fluid_vars(str(tmp_path), {"good": ok})
+    # corrupt: valid headers, desc_size=1, truncated mid-varint (0x80)
+    (tmp_path / "bad").write_bytes(
+        struct.pack("<I", 0) + struct.pack("<Q", 0) + struct.pack("<I", 0) +
+        struct.pack("<i", 1) + b"\x80")
+    got = ff.load_fluid_vars(str(tmp_path))          # scan: skips 'bad'
+    assert set(got) == {"good"}
+    with pytest.raises((ValueError, IndexError)):
+        ff.load_fluid_vars(str(tmp_path), var_names=["bad"])
+    with pytest.raises(FileNotFoundError):
+        ff.load_fluid_vars(str(tmp_path), var_names=["nope"])
+
+
+def test_minus_one_dims_accept_any_extent(tmp_path):
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        v = main.global_block().create_var(name="dyn", shape=[-1, 3],
+                                           dtype="float32",
+                                           persistable=True)
+    ff.save_fluid_vars(str(tmp_path), {"dyn": np.zeros((7, 3), np.float32)})
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        n, missing = ff.load_fluid_persistables(str(tmp_path),
+                                                main_program=main)
+    assert n == 1 and missing == []
+
+
+def test_shape_mismatch_raises(tmp_path):
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        layers.fc(x, size=3, param_attr=fluid.ParamAttr(name="w2"))
+    ff.save_fluid_vars(str(tmp_path), {"w2": np.zeros((5, 3), np.float32)})
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor().run(startup)
+        with pytest.raises(ValueError, match="shape mismatch"):
+            ff.load_fluid_persistables(str(tmp_path), main_program=main)
