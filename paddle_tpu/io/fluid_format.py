@@ -18,7 +18,6 @@ data_type enum, field 2 repeated int64 dims (unpacked tags 0x10; packed
 format is fixed by the reference's wire compatibility, not its code.
 """
 
-import io as _io
 import os
 import struct
 
@@ -53,8 +52,13 @@ def _write_varint(out, value):
             return
 
 
-def _parse_tensor_desc(buf):
-    """(dtype, dims) from a VarType.TensorDesc proto blob."""
+def _signed64(v):
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def parse_tensor_desc_wire(buf):
+    """(data_type enum, signed dims) from a VarType.TensorDesc blob —
+    the ONE wire parser shared by fluid_format and fluid_proto."""
     off, dtype_enum, dims = 0, None, []
     while off < len(buf):
         tag, off = _read_varint(buf, off)
@@ -63,13 +67,13 @@ def _parse_tensor_desc(buf):
             dtype_enum, off = _read_varint(buf, off)
         elif field == 2 and wire == 0:        # dims, unpacked
             d, off = _read_varint(buf, off)
-            dims.append(d)
+            dims.append(_signed64(d))
         elif field == 2 and wire == 2:        # dims, packed
             ln, off = _read_varint(buf, off)
             end = off + ln
             while off < end:
                 d, off = _read_varint(buf, off)
-                dims.append(d)
+                dims.append(_signed64(d))
         elif wire == 0:
             _, off = _read_varint(buf, off)
         elif wire == 2:
@@ -77,10 +81,14 @@ def _parse_tensor_desc(buf):
             off += ln
         else:
             raise ValueError(f"unsupported wire type {wire} in TensorDesc")
+    return dtype_enum, dims
+
+
+def _parse_tensor_desc(buf):
+    """(np dtype, dims) from a VarType.TensorDesc proto blob."""
+    dtype_enum, dims = parse_tensor_desc_wire(buf)
     if dtype_enum not in _DTYPE_BY_ENUM:
         raise ValueError(f"unsupported fluid data_type enum {dtype_enum}")
-    # dims are non-negative in saved tensors; decode as signed just in case
-    dims = [d - (1 << 64) if d >= (1 << 63) else d for d in dims]
     return np.dtype(_DTYPE_BY_ENUM[dtype_enum]), dims
 
 
@@ -179,7 +187,11 @@ def save_fluid_vars(dirname, vars_dict, filename=None, var_order=None):
     the original PaddlePaddle — migration works in both directions)."""
     os.makedirs(dirname, exist_ok=True)
     if filename is not None:
-        order = var_order if var_order is not None else sorted(vars_dict)
+        # default to INSERTION order (callers build vars_dict in program
+        # declaration order — what the reference's save_combine writes and
+        # what load_fluid_persistables reads back); sorting here would
+        # silently permute same-shaped tensors on the round trip
+        order = var_order if var_order is not None else list(vars_dict)
         with open(os.path.join(dirname, filename), "wb") as f:
             for name in order:
                 write_lod_tensor(f, np.asarray(vars_dict[name]))
@@ -213,12 +225,17 @@ def load_fluid_persistables(dirname, main_program=None, filename=None,
             continue
         arr = loaded[v.name]
         want = tuple(int(d) for d in v.shape)
-        ok = len(arr.shape) == len(want) and all(
-            w == -1 or int(a) == w for a, w in zip(arr.shape, want))
-        if want and not ok:
+        if want:
+            ok = len(arr.shape) == len(want) and all(
+                w == -1 or int(a) == w for a, w in zip(arr.shape, want))
+        else:
+            # scalar-declared var: accept () or a single element, reject
+            # real tensors (silently storing one breaks far from here)
+            ok = arr.ndim == 0 or arr.size == 1
+        if not ok:
             raise ValueError(
                 f"shape mismatch for '{v.name}': checkpoint "
-                f"{tuple(arr.shape)} vs program {want}")
+                f"{tuple(arr.shape)} vs program {want or '()'}")
         scope.set(v.name, jnp.asarray(arr))
         set_count += 1
     return set_count, missing

@@ -132,6 +132,33 @@ def test_load_fluid_persistables_into_program(tmp_path):
                                    rtol=1e-5)
 
 
+def test_combined_default_order_is_insertion_not_sorted(tmp_path):
+    # two same-shaped tensors named so sorted order != insertion order:
+    # the round trip must NOT silently swap them
+    wb = np.full((2, 2), 1.0, np.float32)
+    wa = np.full((2, 2), 2.0, np.float32)
+    ff.save_fluid_vars(str(tmp_path), {"w_b": wb, "w_a": wa},
+                       filename="all")
+    got = ff.load_fluid_vars(str(tmp_path), var_names=["w_b", "w_a"],
+                             filename="all")
+    np.testing.assert_array_equal(got["w_b"], wb)
+    np.testing.assert_array_equal(got["w_a"], wa)
+
+
+def test_scalar_var_rejects_tensor_checkpoint(tmp_path):
+    import paddle_tpu as fluid
+
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        main.global_block().create_var(name="step", shape=[],
+                                       dtype="float32", persistable=True)
+    ff.save_fluid_vars(str(tmp_path), {"step": np.zeros((4, 3), np.float32)})
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with pytest.raises(ValueError, match="shape mismatch"):
+            ff.load_fluid_persistables(str(tmp_path), main_program=main)
+
+
 def test_corrupt_file_skipped_in_scan_raised_when_explicit(tmp_path):
     ok = np.ones((2, 2), np.float32)
     ff.save_fluid_vars(str(tmp_path), {"good": ok})
