@@ -783,8 +783,28 @@ def _canonical_bias(bias, b, h, tq, tk):
     return bias
 
 
+def default_blocks():
+    """(block_q, block_k) defaults, overridable without code edits via
+    PADDLE_TPU_FLASH_BLOCK_Q / _K — the hardware-tuning knob
+    (tools/tune_flash.py sweeps them on a real chip). A bad value fails
+    HERE naming the variable — raising mid-kernel would silently drop
+    attention to the O(T^2) fallback (the r1 weak-#7 failure mode)."""
+    import os
+    out = []
+    for name in ("PADDLE_TPU_FLASH_BLOCK_Q", "PADDLE_TPU_FLASH_BLOCK_K"):
+        raw = os.environ.get(name, "128")
+        try:
+            v = int(raw)
+        except ValueError:
+            raise ValueError(f"{name}={raw!r} is not an integer")
+        if v < 1:
+            raise ValueError(f"{name}={v} must be a positive block size")
+        out.append(v)
+    return tuple(out)
+
+
 def flash_attention(q, k, v, bias=None, scale=None, causal=False,
-                    block_q=128, block_k=128):
+                    block_q=None, block_k=None):
     """Fused blockwise attention. q/k/v: (B, H, T, D); bias broadcastable to
     (B, H, Tq, Tk) is applied inside the kernel (additive, pre-softmax)."""
     return flash_attention_with_lse(q, k, v, bias=bias, scale=scale,
@@ -793,10 +813,13 @@ def flash_attention(q, k, v, bias=None, scale=None, causal=False,
 
 
 def flash_attention_with_lse(q, k, v, bias=None, scale=None, causal=False,
-                             block_q=128, block_k=128):
+                             block_q=None, block_k=None):
     """Variant returning (out, logsumexp (B,H,Tq) fp32) — the building block
     for ring attention's cross-device online combine. Fully differentiable
     (the lse cotangent rides the same Pallas backward kernels)."""
+    dq, dk = default_blocks()
+    block_q = dq if block_q is None else block_q
+    block_k = dk if block_k is None else block_k
     global TRACE_COUNT
     TRACE_COUNT += 1
     d = q.shape[-1]

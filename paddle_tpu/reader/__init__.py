@@ -2,4 +2,4 @@ from .decorator import (batch, shuffle, buffered, cache, chain, compose,
                         map_readers, firstn, xmap_readers,
                         multiprocess_reader, ComposeNotAligned, Fake,
                         PipeReader)
-from .dataloader import DataLoader
+from .dataloader import DataLoader, device_prefetch

@@ -59,6 +59,33 @@ def test_compose_handles_numpy_samples():
     np.testing.assert_array_equal(out[1][0], np.arange(4) + 1)
 
 
+def test_device_prefetch_dict_and_list():
+    import jax
+
+    batches = [{"x": np.full((4, 2), i, np.float32)} for i in range(5)]
+    got = list(reader.device_prefetch(iter(batches), depth=2))
+    assert len(got) == 5
+    for i, b in enumerate(got):
+        assert isinstance(b["x"], jax.Array)
+        np.testing.assert_array_equal(np.asarray(b["x"]), batches[i]["x"])
+
+    lists = [[np.ones(3), np.zeros(2)] for _ in range(3)]
+    got = list(reader.device_prefetch(lists, depth=4))   # depth > len
+    assert len(got) == 3 and isinstance(got[0], list)
+
+
+def test_device_prefetch_with_sharding():
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    sh = NamedSharding(mesh, P("dp"))
+    n = len(jax.devices())
+    batches = [np.arange(n * 2, dtype=np.float32) for _ in range(3)]
+    got = list(reader.device_prefetch(iter(batches), sharding=sh))
+    assert got[0].sharding == sh
+
+
 def test_pipereader_plain(tmp_path):
     p = tmp_path / "lines.txt"
     p.write_text("alpha\nbeta\ngamma\n")

@@ -1,0 +1,92 @@
+"""Flash-attention block-size tuner: sweep (block_q, block_k) on the
+current backend and print the fastest config.
+
+Run on a real TPU when the tunnel is up:
+
+    python tools/tune_flash.py --seq 512 --batch 8 --heads 12 --dim 64
+
+Then export the winner for bench/training runs:
+
+    export PADDLE_TPU_FLASH_BLOCK_Q=... PADDLE_TPU_FLASH_BLOCK_K=...
+
+(ops/pallas/flash.py default_blocks() reads those knobs.)
+"""
+
+import argparse
+import itertools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--blocks", default="128,256,512",
+                    help="comma list swept for BOTH block_q and block_k")
+    ap.add_argument("--backward", action="store_true",
+                    help="time fwd+bwd instead of fwd only")
+    ap.add_argument("--dtype", default="bfloat16",
+                    help="bfloat16 on TPU; float32 for CPU smoke runs "
+                         "(bf16 through the interpreter is glacial)")
+    args = ap.parse_args()
+
+    import jax
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # a force-registered TPU plugin (axon) overrides the env var
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas import flash
+
+    dtype = jnp.dtype(args.dtype)
+    key = jax.random.PRNGKey(0)
+    shape = (args.batch, args.heads, args.seq, args.dim)
+    q = jax.random.normal(key, shape, dtype)
+    k = jax.random.normal(jax.random.fold_in(key, 1), shape, dtype)
+    v = jax.random.normal(jax.random.fold_in(key, 2), shape, dtype)
+
+    blocks = [int(b) for b in args.blocks.split(",")]
+    results = []
+    for bq, bk in itertools.product(blocks, blocks):
+        if bq > args.seq or bk > args.seq:
+            continue
+        if args.backward:
+            def loss(q, k, v, bq=bq, bk=bk):
+                return flash.flash_attention(
+                    q, k, v, causal=True, block_q=bq, block_k=bk
+                ).astype(jnp.float32).sum()
+            fn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        else:
+            fn = jax.jit(lambda q, k, v, bq=bq, bk=bk: flash.flash_attention(
+                q, k, v, causal=True, block_q=bq, block_k=bk))
+        try:
+            out = fn(q, k, v)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                out = fn(q, k, v)
+            jax.block_until_ready(out)
+            dt = (time.perf_counter() - t0) / args.steps
+        except Exception as e:
+            print(f"bq={bq:4d} bk={bk:4d}  FAILED: {e}", file=sys.stderr)
+            continue
+        results.append((dt, bq, bk))
+        print(f"bq={bq:4d} bk={bk:4d}  {dt * 1e3:8.3f} ms/step")
+
+    if not results:
+        print("no config ran", file=sys.stderr)
+        return 1
+    dt, bq, bk = min(results)
+    print(f"\nbest: PADDLE_TPU_FLASH_BLOCK_Q={bq} "
+          f"PADDLE_TPU_FLASH_BLOCK_K={bk}  ({dt * 1e3:.3f} ms/step)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
