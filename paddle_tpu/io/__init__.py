@@ -8,4 +8,6 @@ from .checkpoint import (save_checkpoint, load_checkpoint,
                          load_checkpoint_sharded, CheckpointHandle)
 from .fluid_format import (load_fluid_vars, save_fluid_vars,
                            load_fluid_persistables)
-from .fluid_proto import parse_program_desc, load_fluid_inference_model
+from .fluid_proto import (parse_program_desc, encode_program_desc,
+                          load_fluid_inference_model,
+                          save_fluid_inference_model)

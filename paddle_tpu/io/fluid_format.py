@@ -42,6 +42,8 @@ def _read_varint(buf, off):
 
 
 def _write_varint(out, value):
+    if value < 0:                    # proto2: two's-complement 64-bit
+        value += 1 << 64
     while True:
         b = value & 0x7F
         value >>= 7

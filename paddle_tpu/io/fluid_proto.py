@@ -245,6 +245,243 @@ def _strip_feed_fetch(program):
     return ([n for _, n in sorted(feeds)], [n for _, n in sorted(fetches)])
 
 
+# ---------------------------------------------------------------------------
+# Encoding: our Program -> reference ProgramDesc bytes (the reverse path,
+# so paddle_tpu-trained models serve on the original PaddlePaddle).
+# ---------------------------------------------------------------------------
+
+# the ONE wire writer / dtype map, shared with fluid_format
+from .fluid_format import _write_varint as _wvint, _ENUM_BY_DTYPE
+
+
+def _wtag(out, field, wire):
+    _wvint(out, (field << 3) | wire)
+
+
+def _wbytes(out, field, payload):
+    _wtag(out, field, 2)
+    _wvint(out, len(payload))
+    out.extend(payload)
+
+
+def _wstr(out, field, s):
+    _wbytes(out, field, s.encode())
+
+
+def _wvarint_field(out, field, v):
+    _wtag(out, field, 0)
+    _wvint(out, v)
+
+
+def _encode_attr(name, value):
+    """OpDesc.Attr bytes for a python attr value; None for unencodable."""
+    out = bytearray()
+    _wstr(out, 1, name)
+    if name == "sub_block" and isinstance(value, int):
+        # control-flow block reference: framework.proto AttrType BLOCK,
+        # not INT — While/cond would fail the reference's type check
+        _wvarint_field(out, 2, 8)
+        _wvarint_field(out, 12, value)
+    elif name in ("sub_blocks", "blocks") and isinstance(
+            value, (list, tuple)) and all(
+            isinstance(v, int) and not isinstance(v, bool) for v in value):
+        _wvarint_field(out, 2, 10)                    # BLOCKS
+        for v in value:
+            _wvarint_field(out, 14, v)
+    elif isinstance(value, bool):                     # before int!
+        _wvarint_field(out, 2, 6)
+        _wvarint_field(out, 10, int(value))
+    elif isinstance(value, int):
+        if -(1 << 31) <= value < (1 << 31):
+            _wvarint_field(out, 2, 0)
+            _wvarint_field(out, 3, value)
+        else:
+            _wvarint_field(out, 2, 9)                 # LONG
+            _wvarint_field(out, 13, value)
+    elif isinstance(value, float):
+        _wvarint_field(out, 2, 1)
+        _wtag(out, 4, 5)
+        out.extend(struct.pack("<f", value))
+    elif isinstance(value, str):
+        _wvarint_field(out, 2, 2)
+        _wstr(out, 5, value)
+    elif isinstance(value, (list, tuple)):
+        vals = list(value)
+        if all(isinstance(v, bool) for v in vals) and vals:
+            _wvarint_field(out, 2, 7)
+            for v in vals:
+                _wvarint_field(out, 11, int(v))
+        elif all(isinstance(v, int) for v in vals):
+            if all(-(1 << 31) <= v < (1 << 31) for v in vals):
+                _wvarint_field(out, 2, 3)             # INTS
+                for v in vals:
+                    _wvarint_field(out, 6, v)
+            else:
+                _wvarint_field(out, 2, 11)            # LONGS
+                for v in vals:
+                    _wvarint_field(out, 15, v)
+        elif all(isinstance(v, (int, float)) for v in vals):
+            # mixed int/float (e.g. aspect_ratios=[1, 2, 0.5]): FLOATS —
+            # dropping the attr would silently serve with op defaults
+            _wvarint_field(out, 2, 4)
+            for v in vals:
+                _wtag(out, 7, 5)
+                out.extend(struct.pack("<f", float(v)))
+        elif all(isinstance(v, str) for v in vals):
+            _wvarint_field(out, 2, 5)
+            for v in vals:
+                _wstr(out, 8, v)
+        else:
+            return None
+    else:
+        return None
+    return bytes(out)
+
+
+def _encode_op(op_type, inputs, outputs, attrs):
+    out = bytearray()
+    for slot, args in inputs.items():
+        var = bytearray()
+        _wstr(var, 1, slot)
+        for a in args:
+            _wstr(var, 2, a)
+        _wbytes(out, 1, var)
+    for slot, args in outputs.items():
+        var = bytearray()
+        _wstr(var, 1, slot)
+        for a in args:
+            _wstr(var, 2, a)
+        _wbytes(out, 2, var)
+    _wstr(out, 3, op_type)
+    for name, value in attrs.items():
+        enc = _encode_attr(name, value)
+        if enc is not None:
+            _wbytes(out, 4, enc)
+        else:
+            import warnings
+            warnings.warn(
+                f"attr '{name}' of op '{op_type}' has unencodable type "
+                f"{type(value).__name__}; omitted from the exported "
+                "ProgramDesc", RuntimeWarning, stacklevel=2)
+    return bytes(out)
+
+
+def _encode_var(name, dtype, dims, persistable, kind=7):
+    td = bytearray()
+    _wvarint_field(td, 1, _ENUM_BY_DTYPE.get(np.dtype(dtype), 5))
+    for d in dims:
+        _wvarint_field(td, 2, int(d))
+    lod = bytearray()
+    _wbytes(lod, 1, td)
+    vtype = bytearray()
+    _wvarint_field(vtype, 1, kind)
+    if kind == 7:
+        _wbytes(vtype, 3, lod)
+    out = bytearray()
+    _wstr(out, 1, name)
+    _wbytes(out, 2, vtype)
+    if persistable:
+        _wvarint_field(out, 3, 1)
+    return bytes(out)
+
+
+def _slice_for_inference(program, fetch_names):
+    """Backward-slice the global block to ops the fetches need, WITHOUT
+    Program._prune's keep-persistable-writers rule (that rule preserves
+    optimizer/stat updates — training semantics; an inference export must
+    shed them, matching the reference's save_inference_model pruning)."""
+    gb = program.global_block()
+    need = set(fetch_names)
+    keep = []
+    for op in reversed(gb.ops):
+        if set(op.output_names) & need:
+            keep.append(op)
+            need |= set(op.input_names)
+    gb.ops = list(reversed(keep))
+    program._bump_version()
+    return program
+
+
+def encode_program_desc(program, feed_names=(), fetch_names=(),
+                        only_vars=None):
+    """paddle_tpu Program -> reference ProgramDesc bytes, with the feed/
+    fetch plumbing the reference's load_inference_model expects.
+    `only_vars`: restrict global-block var descs to these names (the
+    inference exporter passes the referenced-var set so grad/optimizer
+    vars stay out of __model__)."""
+    out = bytearray()
+    for blk in program.blocks:
+        b = bytearray()
+        _wvarint_field(b, 1, blk.idx)
+        _wvarint_field(b, 2, blk.parent_idx)
+        if blk.idx == 0:
+            _wbytes(b, 3, _encode_var("feed", "float32", [], True, kind=9))
+            _wbytes(b, 3, _encode_var("fetch", "float32", [], True, kind=10))
+        for v in blk.vars.values():
+            if blk.idx == 0 and only_vars is not None \
+                    and v.name not in only_vars:
+                continue
+            _wbytes(b, 3, _encode_var(
+                v.name, getattr(v, "dtype", "float32") or "float32",
+                list(getattr(v, "shape", None) or []),
+                bool(getattr(v, "persistable", False))))
+        if blk.idx == 0:
+            for col, name in enumerate(feed_names):
+                _wbytes(b, 4, _encode_op(
+                    "feed", {"X": ["feed"]}, {"Out": [name]}, {"col": col}))
+        for op in blk.ops:
+            _wbytes(b, 4, _encode_op(op.type, op.inputs, op.outputs,
+                                     op.attrs))
+        if blk.idx == 0:
+            for col, name in enumerate(fetch_names):
+                _wbytes(b, 4, _encode_op(
+                    "fetch", {"X": [name]}, {"Out": ["fetch"]},
+                    {"col": col}))
+        _wbytes(out, 1, b)
+    return bytes(out)
+
+
+def save_fluid_inference_model(dirname, feed_names, fetch_vars, executor,
+                               main_program=None, model_filename=None,
+                               params_filename=None, scope=None):
+    """Export in the REFERENCE's save_inference_model layout (`__model__`
+    ProgramDesc + weights), so paddle_tpu-trained models serve on the
+    original PaddlePaddle. Contract mirrors our save_inference_model."""
+    from ..core import framework
+    from ..core.executor import global_scope
+    from .fluid_format import save_fluid_vars
+
+    program = main_program or framework.default_main_program()
+    scope = scope or global_scope()
+    fetch_names = [v.name if hasattr(v, "name") else v for v in fetch_vars]
+    pruned = _slice_for_inference(program.clone(for_test=True), fetch_names)
+    gb = pruned.global_block()
+    referenced = set(feed_names) | set(fetch_names)
+    for op in gb.ops:
+        referenced |= set(op.input_names) | set(op.output_names)
+    raw = encode_program_desc(pruned, feed_names, fetch_names,
+                              only_vars=referenced)
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, model_filename or "__model__"),
+              "wb") as f:
+        f.write(raw)
+    persist, missing = {}, []
+    for v in gb.vars.values():
+        if v.persistable and v.name in referenced \
+                and v.name not in ("feed", "fetch"):
+            val = scope.get(v.name)
+            if val is None:
+                missing.append(v.name)
+            else:
+                persist[v.name] = np.asarray(val)
+    if missing:
+        raise ValueError(
+            f"persistables have no value in the scope (did startup run "
+            f"here?): {missing}")
+    save_fluid_vars(dirname, persist, filename=params_filename)
+    return list(persist)
+
+
 def load_fluid_inference_model(dirname, executor=None, model_filename=None,
                                params_filename=None, scope=None):
     """Load a model exported by the REFERENCE's save_inference_model.

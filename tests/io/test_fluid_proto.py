@@ -172,6 +172,119 @@ def test_missing_params_raise(tmp_path):
             fp.load_fluid_inference_model(str(tmp_path), fluid.Executor())
 
 
+def test_export_roundtrip_through_reference_format(tmp_path):
+    """OUR trained program -> reference __model__ + params -> load back
+    through the reference-format loader -> identical outputs."""
+    from paddle_tpu import layers
+    from paddle_tpu.io import fluid_proto as fpp
+
+    rs = np.random.RandomState(3)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[6], dtype="float32")
+        h = layers.fc(x, size=4, act="relu",
+                      param_attr=fluid.ParamAttr(name="w1"),
+                      bias_attr=fluid.ParamAttr(name="b1"))
+        out_v = layers.fc(h, size=2, param_attr=fluid.ParamAttr(name="w2"),
+                          bias_attr=fluid.ParamAttr(name="b2"))
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    xs = rs.rand(5, 6).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        want, = exe.run(main.clone(for_test=True), feed={"x": xs},
+                        fetch_list=[out_v])
+        names = fpp.save_fluid_inference_model(
+            str(tmp_path), ["x"], [out_v], exe, main_program=main)
+    assert set(names) == {"w1", "b1", "w2", "b2"}
+
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog, feeds, fetches = fpp.load_fluid_inference_model(
+            str(tmp_path), exe)
+        assert feeds == ["x"]
+        got, = exe.run(prog, feed={"x": xs}, fetch_list=fetches)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_export_excludes_optimizer_state(tmp_path):
+    """An Adam-trained program must export ONLY the serving params — no
+    moments/beta-pow/lr vars in the payload, none declared in __model__."""
+    from paddle_tpu import layers
+    from paddle_tpu.io import fluid_proto as fpp
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1, param_attr=fluid.ParamAttr(name="w"),
+                         bias_attr=fluid.ParamAttr(name="b"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={"x": np.ones((2, 4), np.float32),
+                            "y": np.ones((2, 1), np.float32)},
+                fetch_list=[loss])
+        names = fpp.save_fluid_inference_model(
+            str(tmp_path), ["x"], [pred], exe, main_program=main)
+    assert set(names) == {"w", "b"}          # no adam moments / lr
+
+    prog = fpp.parse_program_desc((tmp_path / "__model__").read_bytes())
+    gb = prog.global_block()
+    assert not [n for n in gb.vars if "moment" in n or "beta" in n
+                or "learning_rate" in n or "@GRAD" in n]
+
+
+def test_export_missing_scope_value_raises(tmp_path):
+    from paddle_tpu import layers
+    from paddle_tpu.io import fluid_proto as fpp
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        pred = layers.fc(x, size=1, param_attr=fluid.ParamAttr(name="w3"))
+    scope = fluid.Scope()                    # startup never ran here
+    with fluid.scope_guard(scope):
+        with pytest.raises(ValueError, match="no value in the scope"):
+            fpp.save_fluid_inference_model(
+                str(tmp_path), ["x"], [pred], fluid.Executor(),
+                main_program=main)
+
+
+def test_encode_sub_block_and_mixed_list_attrs():
+    from paddle_tpu.io import fluid_proto as fpp
+
+    op = fpp._encode_op("while", {"X": ["a"]}, {"Out": ["o"]},
+                        {"sub_block": 3, "ratios": [1, 2, 0.5]})
+    op_type, _ins, _outs, got = fpp._parse_op(op)
+    assert got["sub_block"] == 3             # decoded via BLOCK slot
+    assert got["ratios"] == pytest.approx([1.0, 2.0, 0.5])  # FLOATS
+    with pytest.warns(RuntimeWarning, match="unencodable"):
+        fpp._encode_op("x", {}, {}, {"cb": lambda: None})
+
+
+def test_encode_attr_types_roundtrip():
+    from paddle_tpu.io import fluid_proto as fpp
+
+    attrs = {"i": 7, "neg": -3, "big": 1 << 40, "f": 0.5, "s": "hi",
+             "flag": True, "ints": [1, -2], "floats": [1.0, 2.5],
+             "strs": ["a", "b"], "longs": [1 << 40, 2]}
+    op = fpp._encode_op("dummy", {"X": ["a"]}, {"Out": ["o"]}, attrs)
+    op_type, ins, outs, got = fpp._parse_op(op)
+    assert op_type == "dummy"
+    assert got["i"] == 7 and got["neg"] == -3 and got["big"] == 1 << 40
+    assert got["f"] == pytest.approx(0.5) and got["s"] == "hi"
+    assert got["flag"] is True
+    assert got["ints"] == [1, -2] and got["strs"] == ["a", "b"]
+    assert got["longs"] == [1 << 40, 2]
+    assert got["floats"] == pytest.approx([1.0, 2.5])
+
+
 def test_attr_negative_and_packed_decoding():
     # negative int attr (axis=-1) must decode signed, packed ints too
     op = _op("concat", [("X", ["a", "b"])], [("Out", ["o"])],
