@@ -38,14 +38,23 @@ class AnalysisConfig:
 
 class Predictor:
     def __init__(self, config):
+        import os
         self.config = config
         self.scope = Scope()
         self._exe = Executor()
         with scope_guard(self.scope):
-            (self.program, self.feed_names,
-             self.fetch_vars) = load_inference_model(config.model_dir,
-                                                     self._exe)
-        self.fetch_names = [v.name for v in self.fetch_vars]
+            if os.path.exists(os.path.join(config.model_dir, "__model__")):
+                # a REFERENCE export dir (save_inference_model's ProgramDesc
+                # protobuf + weights): serve it directly (io/fluid_proto.py)
+                from ..io.fluid_proto import load_fluid_inference_model
+                (self.program, self.feed_names,
+                 self.fetch_names) = load_fluid_inference_model(
+                    config.model_dir, self._exe)
+            else:
+                (self.program, self.feed_names,
+                 fetch_vars) = load_inference_model(config.model_dir,
+                                                    self._exe)
+                self.fetch_names = [v.name for v in fetch_vars]
         if config.use_bf16:
             self._cast_params_bf16()
 

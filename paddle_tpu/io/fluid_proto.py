@@ -307,7 +307,12 @@ def _encode_attr(name, value):
         _wstr(out, 5, value)
     elif isinstance(value, (list, tuple)):
         vals = list(value)
-        if all(isinstance(v, bool) for v in vals) and vals:
+        if not vals:
+            # an empty list carries no element-type evidence; emitting a
+            # guessed AttrType would fail the reference's C++ type check —
+            # omit it (op defaults apply) via the caller's warning path
+            return None
+        if all(isinstance(v, bool) for v in vals):
             _wvarint_field(out, 2, 7)
             for v in vals:
                 _wvarint_field(out, 11, int(v))
@@ -368,7 +373,14 @@ def _encode_op(op_type, inputs, outputs, attrs):
 
 def _encode_var(name, dtype, dims, persistable, kind=7):
     td = bytearray()
-    _wvarint_field(td, 1, _ENUM_BY_DTYPE.get(np.dtype(dtype), 5))
+    np_dtype = np.dtype("float32" if dtype in (None, "") else dtype)
+    if np_dtype not in _ENUM_BY_DTYPE:
+        # e.g. bfloat16: the fluid 1.5 format has no enum for it; writing
+        # FP32 silently would make the reference execute wrong numerics
+        raise ValueError(
+            f"var '{name}' dtype {dtype} has no fluid enum; cast the "
+            "program to a supported dtype before exporting")
+    _wvarint_field(td, 1, _ENUM_BY_DTYPE[np_dtype])
     for d in dims:
         _wvarint_field(td, 2, int(d))
     lod = bytearray()
@@ -459,12 +471,9 @@ def save_fluid_inference_model(dirname, feed_names, fetch_vars, executor,
     referenced = set(feed_names) | set(fetch_names)
     for op in gb.ops:
         referenced |= set(op.input_names) | set(op.output_names)
-    raw = encode_program_desc(pruned, feed_names, fetch_names,
-                              only_vars=referenced)
-    os.makedirs(dirname, exist_ok=True)
-    with open(os.path.join(dirname, model_filename or "__model__"),
-              "wb") as f:
-        f.write(raw)
+    # validate EVERYTHING (params present, dtypes encodable) before any
+    # write — a partial export (dangling __model__) would flip Predictor's
+    # format dispatch for the whole dir
     persist, missing = {}, []
     for v in gb.vars.values():
         if v.persistable and v.name in referenced \
@@ -473,11 +482,22 @@ def save_fluid_inference_model(dirname, feed_names, fetch_vars, executor,
             if val is None:
                 missing.append(v.name)
             else:
-                persist[v.name] = np.asarray(val)
+                arr = np.asarray(val)
+                if arr.dtype not in _ENUM_BY_DTYPE:
+                    raise ValueError(
+                        f"param '{v.name}' dtype {arr.dtype} has no fluid "
+                        "enum; cast (e.g. bf16 -> fp32) before exporting")
+                persist[v.name] = arr
     if missing:
         raise ValueError(
             f"persistables have no value in the scope (did startup run "
             f"here?): {missing}")
+    raw = encode_program_desc(pruned, feed_names, fetch_names,
+                              only_vars=referenced)
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, model_filename or "__model__"),
+              "wb") as f:
+        f.write(raw)
     save_fluid_vars(dirname, persist, filename=params_filename)
     return list(persist)
 

@@ -69,6 +69,33 @@ def test_predictor_bf16_close_to_fp32(tmp_path):
     np.testing.assert_allclose(out, ref_out, rtol=3e-2, atol=3e-2)
 
 
+def test_predictor_serves_reference_export_dir(tmp_path):
+    """A dir in the REFERENCE layout (__model__ protobuf + weights) feeds
+    the same Predictor pipeline (AOT cache, buckets, bf16)."""
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[-1, 8], dtype="float32")
+        h = layers.fc(x, size=16, act="relu")
+        pred = layers.fc(h, size=3, act="softmax")
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_fluid_inference_model(
+            str(tmp_path / "ref"), ["x"], [pred], exe, main_program=main)
+        ref_in = np.random.default_rng(1).standard_normal(
+            (4, 8)).astype(np.float32)
+        ref_out = np.asarray(exe.run(main.clone(for_test=True),
+                                     feed={"x": ref_in},
+                                     fetch_list=[pred])[0])
+
+    predictor = inference.create_predictor(str(tmp_path / "ref"))
+    assert predictor.get_input_names() == ["x"]
+    out = predictor.run({"x": ref_in})
+    np.testing.assert_allclose(np.asarray(out[0]), ref_out,
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_kv_cache_greedy_matches_full_recompute():
     """A tiny attention LM step driven through init/update_kv_cache +
     greedy_decode must reproduce the naive 'recompute everything each

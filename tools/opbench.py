@@ -1,0 +1,130 @@
+"""Op-level microbenchmarks: time the hot kernels on the current backend
+and print achieved TFLOP/s (and % of peak when known).
+
+Run on a real TPU:
+
+    python tools/opbench.py                 # all suites
+    python tools/opbench.py --ops matmul,flash --dtype bfloat16
+
+Suites: matmul (MXU), conv (ResNet shapes), flash (Pallas attention),
+layernorm+softmax (VPU/fusion), embedding (gather). The numbers bound
+what bench.py's end-to-end MFU can reach — if matmul sits at 60% of peak
+and the model bench at 20%, the gap is scheduling/input, not kernels.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+PEAKS = {"v2": 45e12, "v3": 123e12, "v4": 275e12, "v5 lite": 197e12,
+         "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
+
+
+def _peak(kind):
+    kind = kind.lower()
+    best = None
+    for sub, p in PEAKS.items():
+        if sub in kind:
+            best = p
+    return best
+
+
+def _time(fn, *args, steps=20):
+    import jax
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", default="matmul,conv,flash,norm,embedding")
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--steps", type=int, default=20)
+    args = ap.parse_args()
+
+    import jax
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(args.dtype)
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", str(dev))
+    peak = _peak(kind)
+    print(f"device: {kind}  dtype: {dtype}  "
+          f"peak: {peak / 1e12 if peak else '?'} TFLOP/s")
+    key = jax.random.PRNGKey(0)
+
+    def report(name, seconds, flops):
+        tf = flops / seconds / 1e12
+        pct = f"{flops / seconds / peak:6.1%}" if peak else "   n/a"
+        print(f"{name:<28} {seconds * 1e3:9.3f} ms  {tf:8.2f} TF/s  {pct}")
+
+    suites = set(args.ops.split(","))
+
+    if "matmul" in suites:
+        for m, n, k in [(1024, 1024, 1024), (4096, 4096, 4096),
+                        (8192, 8192, 8192)]:
+            a = jax.random.normal(key, (m, k), dtype)
+            b = jax.random.normal(key, (k, n), dtype)
+            f = jax.jit(lambda a, b: a @ b)
+            dt = _time(f, a, b, steps=args.steps)
+            report(f"matmul {m}x{k}x{n}", dt, 2 * m * n * k)
+
+    if "conv" in suites:
+        from jax import lax
+        for b, c_in, c_out, hw, khw, stride in [
+                (32, 3, 64, 224, 7, 2), (32, 256, 256, 14, 3, 1)]:
+            x = jax.random.normal(key, (b, c_in, hw, hw), dtype)
+            w = jax.random.normal(key, (c_out, c_in, khw, khw), dtype)
+            f = jax.jit(lambda x, w: lax.conv_general_dilated(
+                x, w, (stride, stride), "SAME"))
+            dt = _time(f, x, w, steps=args.steps)
+            out_hw = hw // stride
+            flops = 2 * b * c_out * out_hw * out_hw * c_in * khw * khw
+            report(f"conv {c_in}->{c_out} {hw}px k{khw}", dt, flops)
+
+    if "flash" in suites:
+        from paddle_tpu.ops.pallas import flash
+        for b, h, t, d in [(8, 12, 512, 64), (1, 12, 4096, 64)]:
+            q = jax.random.normal(key, (b, h, t, d), dtype)
+            f = jax.jit(lambda q: flash.flash_attention(q, q, q,
+                                                        causal=True))
+            try:
+                dt = _time(f, q, steps=max(2, args.steps // 2))
+            except Exception as e:
+                print(f"flash b{b} t{t}: FAILED {e}", file=sys.stderr)
+                continue
+            flops = 2 * 2 * b * h * t * t * d // 2   # causal half
+            report(f"flash b{b} h{h} t{t}", dt, flops)
+
+    if "norm" in suites:
+        x = jax.random.normal(key, (8192, 1024), jnp.float32)
+        f = jax.jit(lambda x: jax.nn.softmax(
+            (x - x.mean(-1, keepdims=True)) / (x.std(-1, keepdims=True)
+                                               + 1e-5)))
+        dt = _time(f, x, steps=args.steps)
+        report("layernorm+softmax 8192x1024", dt, 10 * x.size)
+
+    if "embedding" in suites:
+        tbl = jax.random.normal(key, (50_000, 768), dtype)
+        ids = jax.random.randint(key, (8 * 512,), 0, 50_000)
+        f = jax.jit(lambda tbl, ids: tbl[ids])
+        dt = _time(f, tbl, ids, steps=args.steps)
+        gb = (ids.size * 768 * tbl.dtype.itemsize) / 2**30
+        print(f"{'embedding gather 4096x768':<28} {dt * 1e3:9.3f} ms  "
+              f"{gb / dt:8.2f} GB/s")
+
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
