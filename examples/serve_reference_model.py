@@ -1,0 +1,58 @@
+"""Serve a model exported by the ORIGINAL PaddlePaddle on TPU.
+
+Point it at a save_inference_model dir (the `__model__` + weights
+layout). Without an argument it builds a demo export first, so the
+script runs self-contained:
+
+    python examples/serve_reference_model.py [/path/to/export_dir]
+"""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax                                              # noqa: E402
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as fluid                              # noqa: E402
+from paddle_tpu import layers, inference                # noqa: E402
+from paddle_tpu.core import framework                   # noqa: E402
+
+
+def _build_demo_export():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[-1, 16], dtype="float32")
+        h = layers.fc(x, size=64, act="relu")
+        pred = layers.fc(h, size=4, act="softmax")
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    d = tempfile.mkdtemp(prefix="fluid_export_")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_fluid_inference_model(d, ["x"], [pred], exe,
+                                            main_program=main)
+    print(f"demo reference-format export written to {d}")
+    return d
+
+
+def main():
+    model_dir = sys.argv[1] if len(sys.argv) > 1 else _build_demo_export()
+    cfg = inference.AnalysisConfig(model_dir)
+    predictor = inference.create_predictor(cfg)
+    feed_name = predictor.get_input_names()[0]
+    x = np.random.default_rng(0).standard_normal((8, 16)).astype(np.float32)
+    out = predictor.run({feed_name: x})
+    print(f"served {feed_name} {x.shape} -> "
+          f"{[np.asarray(o).shape for o in out]}")
+    print(np.asarray(out[0])[:2])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

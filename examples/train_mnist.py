@@ -1,0 +1,65 @@
+"""Recognize digits (book ch.2): static-graph training with the C++
+loader pool feeding batches.
+
+    python examples/train_mnist.py
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax                                              # noqa: E402
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as fluid                              # noqa: E402
+from paddle_tpu import layers                           # noqa: E402
+
+
+def main():
+    img = layers.data("img", shape=[784], dtype="float32")
+    label = layers.data("label", shape=[1], dtype="int64")
+    h = layers.fc(img, size=200, act="relu")
+    logits = layers.fc(h, size=10)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    acc = layers.accuracy(layers.softmax(logits), label)
+    test_prog = fluid.default_main_program().clone(for_test=True)
+    fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(fluid.default_startup_program())
+
+    import paddle_tpu.dataset as dataset
+    from paddle_tpu.reader import native
+
+    samples = list(dataset.mnist.train()())
+    xs = np.stack([s[0] for s in samples]).astype(np.float32)
+    ys = np.array([s[1] for s in samples], np.int64).reshape(-1, 1)
+
+    if native.available():          # C++ multi-worker loader pool
+        batches = native.NativeLoaderPool(
+            {"img": xs.reshape(len(xs), 784), "label": ys}, batch_size=64,
+            epochs=1, shuffle_seed=0, drop_last=True, n_workers=4)
+    else:
+        batches = ({"img": xs[i:i + 64].reshape(-1, 784),
+                    "label": ys[i:i + 64]}
+                   for i in range(0, len(xs) - 64, 64))
+
+    for step, batch in enumerate(batches):
+        l, a = exe.run(feed=batch, fetch_list=[loss, acc])
+        if step % 20 == 0:
+            print(f"step {step:4d}  loss {np.asarray(l).item():.4f}  "
+                  f"acc {np.asarray(a).item():.3f}")
+
+    l, a = exe.run(test_prog,
+                   feed={"img": xs[:512].reshape(-1, 784),
+                         "label": ys[:512]}, fetch_list=[loss, acc])
+    print(f"eval  loss {np.asarray(l).item():.4f}  acc {np.asarray(a).item():.3f}")
+    return 0 if np.asarray(a).item() > 0.9 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

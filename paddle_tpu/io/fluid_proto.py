@@ -364,10 +364,14 @@ def _encode_op(op_type, inputs, outputs, attrs):
             _wbytes(out, 4, enc)
         else:
             import warnings
+            if isinstance(value, (list, tuple)) and not value:
+                reason = ("is an empty list (no element-type evidence; "
+                          "the op default applies on the reference)")
+            else:
+                reason = f"has unencodable type {type(value).__name__}"
             warnings.warn(
-                f"attr '{name}' of op '{op_type}' has unencodable type "
-                f"{type(value).__name__}; omitted from the exported "
-                "ProgramDesc", RuntimeWarning, stacklevel=2)
+                f"attr '{name}' of op '{op_type}' {reason}; omitted from "
+                "the exported ProgramDesc", RuntimeWarning, stacklevel=2)
     return bytes(out)
 
 

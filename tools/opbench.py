@@ -19,17 +19,17 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-PEAKS = {"v2": 45e12, "v3": 123e12, "v4": 275e12, "v5 lite": 197e12,
-         "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
+# chip peak table + env override shared with the end-to-end bench
+from bench import PEAK_TFLOPS, _peak_flops  # noqa: E402
 
 
 def _peak(kind):
-    kind = kind.lower()
-    best = None
-    for sub, p in PEAKS.items():
-        if sub in kind:
-            best = p
-    return best
+    if "cpu" in kind.lower():
+        return None                  # no meaningful MXU peak to compare
+    try:
+        return _peak_flops(kind)     # honors BENCH_PEAK_TFLOPS
+    except Exception:
+        return None
 
 
 def _time(fn, *args, steps=20):
@@ -60,7 +60,8 @@ def main():
     kind = getattr(dev, "device_kind", str(dev))
     peak = _peak(kind)
     print(f"device: {kind}  dtype: {dtype}  "
-          f"peak: {peak / 1e12 if peak else '?'} TFLOP/s")
+          f"peak: {peak / 1e12 if peak else '?'} TFLOP/s (bf16 table — "
+          f"the % column is only meaningful for --dtype bfloat16)")
     key = jax.random.PRNGKey(0)
 
     def report(name, seconds, flops):
