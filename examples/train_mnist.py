@@ -46,7 +46,7 @@ def main():
     else:
         batches = ({"img": xs[i:i + 64].reshape(-1, 784),
                     "label": ys[i:i + 64]}
-                   for i in range(0, len(xs) - 64, 64))
+                   for i in range(0, len(xs) - 63, 64))
 
     for step, batch in enumerate(batches):
         l, a = exe.run(feed=batch, fetch_list=[loss, acc])

@@ -9,6 +9,7 @@ decoding.py.
 """
 
 from .predictor import Predictor, create_predictor, AnalysisConfig
+from .aot import save_aot_model, load_aot_model, AotModel
 from .decoding import greedy_decode, beam_decode
 from .postprocess import multiclass_nms_host
 

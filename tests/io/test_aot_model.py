@@ -1,0 +1,92 @@
+"""AOT serving artifacts (inference/aot.py): export -> serialize ->
+deserialize in a param-free context -> identical outputs.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, inference
+from paddle_tpu.core import framework
+
+
+def _net():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[-1, 8], dtype="float32")
+        h = layers.fc(x, size=16, act="relu")
+        pred = layers.fc(h, size=3, act="softmax")
+    return main, startup, pred
+
+
+def test_aot_roundtrip_matches_live_program(tmp_path):
+    main, startup, pred = _net()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rs = np.random.RandomState(0)
+    x1 = rs.rand(1, 8).astype(np.float32)
+    x8 = rs.rand(8, 8).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        infer = main.clone(for_test=True)
+        want1 = np.asarray(exe.run(infer, feed={"x": x1},
+                                   fetch_list=[pred])[0])
+        want8 = np.asarray(exe.run(infer, feed={"x": x8},
+                                   fetch_list=[pred])[0])
+        files = inference.save_aot_model(
+            str(tmp_path), infer, ["x"], [pred],
+            example_batches=(1, 8), scope=scope)
+    assert len(files) == 2
+
+    # load with NO scope/program anywhere in sight — the artifact is
+    # self-contained (params baked in as constants)
+    model = inference.load_aot_model(str(tmp_path))
+    assert model.batch_sizes() == [1, 8]
+    np.testing.assert_allclose(model.run({"x": x1})[0], want1, rtol=1e-5)
+    np.testing.assert_allclose(model({"x": x8})[0], want8, rtol=1e-5)
+
+
+def test_aot_unknown_batch_raises(tmp_path):
+    main, startup, pred = _net()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        inference.save_aot_model(str(tmp_path), main.clone(for_test=True),
+                                 ["x"], [pred], example_batches=(4,),
+                                 scope=scope)
+    model = inference.load_aot_model(str(tmp_path))
+    with pytest.raises(ValueError, match="no compiled signature"):
+        model.run({"x": np.zeros((5, 8), np.float32)})
+
+
+def test_aot_static_batch_feed(tmp_path):
+    """fluid.data with a static leading batch: the declared batch is THE
+    signature; other buckets raise instead of exporting wrong-rank."""
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[4, 8], dtype="float32")
+        pred = layers.fc(x, size=2)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        infer = main.clone(for_test=True)
+        with pytest.raises(ValueError, match="static batch"):
+            inference.save_aot_model(str(tmp_path), infer, ["x"], [pred],
+                                     example_batches=(8,), scope=scope)
+        inference.save_aot_model(str(tmp_path), infer, ["x"], [pred],
+                                 example_batches=(4,), scope=scope)
+    model = inference.load_aot_model(str(tmp_path))
+    out = model.run({"x": np.ones((4, 8), np.float32)})
+    assert np.asarray(out[0]).shape == (4, 2)
+
+
+def test_aot_missing_param_raises(tmp_path):
+    main, _startup, pred = _net()
+    scope = fluid.Scope()                    # startup never ran
+    with fluid.scope_guard(scope):
+        with pytest.raises(ValueError, match="no value in scope"):
+            inference.save_aot_model(str(tmp_path),
+                                     main.clone(for_test=True),
+                                     ["x"], [pred], scope=scope)
