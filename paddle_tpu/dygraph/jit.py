@@ -56,12 +56,45 @@ class TracedLayer:
     def __init__(self, layer):
         self._layer = layer
         self._call = to_static(layer)
+        self._example_args = None
 
     @staticmethod
     def trace(layer, inputs):
         tl = TracedLayer(layer)
         outs = tl(*inputs)
+        tl._example_args = [
+            a.value if isinstance(a, EagerVariable) else jnp.asarray(a)
+            for a in inputs]
         return outs, tl
 
     def __call__(self, *args):
         return self._call(*args)
+
+    def save_inference_model(self, dirname, feed=None, fetch=None):
+        """Export the traced layer as a self-contained AOT serving
+        artifact (parity: reference TracedLayer.save_inference_model,
+        which wrote a ProgramDesc for the inference engine; here the
+        artifact is the serialized compiled graph — load with
+        paddle_tpu.inference.load_aot_model). Signature = the traced
+        input shapes."""
+        if self._example_args is None:
+            raise RuntimeError("trace the layer first: "
+                               "TracedLayer.trace(layer, inputs)")
+        if feed is not None or fetch is not None:
+            raise NotImplementedError(
+                "feed/fetch index selection is not supported; the artifact "
+                "exports all traced inputs and the layer's output")
+        from ..inference.aot import save_aot_callable
+
+        names = [f"x{i}" for i in range(len(self._example_args))]
+        # the functionalized fn is (param_vals, *args) -> out; close over
+        # the current param values so they bake into the artifact
+        params_vals = [p.value for p in self._call._params]
+        inner = self._call._jitted
+
+        def fn(feeds):
+            return [inner(params_vals, *[feeds[n] for n in names])]
+
+        example = dict(zip(names, self._example_args))
+        return save_aot_callable(dirname, fn, example,
+                                 fetch_names=["out0"])

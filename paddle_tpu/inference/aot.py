@@ -55,6 +55,10 @@ def _feed_specs(program, feed_names, batch):
             raise ValueError(
                 f"feed '{name}' declares a static batch {dims[0]}; "
                 f"example_batches must be ({dims[0]},), got {batch}")
+        if any(d < 0 for d in dims[1:]):
+            raise ValueError(
+                f"feed '{name}' has a dynamic non-batch dim {dims}; AOT "
+                "export is shape-specialized — declare static shapes")
         specs[name] = jax.ShapeDtypeStruct(tuple(dims),
                                            jnp.dtype(v.dtype))
     return specs
@@ -79,23 +83,45 @@ def save_aot_model(dirname, program, feed_names, fetch_names,
             state[v.name] = jnp.asarray(val)
 
     fn = _infer_fn(program, feed_names, fetch_names, state)
+    specs_by_batch = {int(b): _feed_specs(program, feed_names, b)
+                      for b in example_batches}
+    return _write_artifacts(dirname, fn, specs_by_batch, feed_names,
+                            fetch_names)
+
+
+def _write_artifacts(dirname, fn, specs_by_batch, feed_names, fetch_names):
+    """Serialize fn once per signature + the shared aot_meta.json (the ONE
+    place that knows the artifact layout load_aot_model reads)."""
     os.makedirs(dirname, exist_ok=True)
     sigs = []
-    for batch in example_batches:
-        specs = _feed_specs(program, feed_names, batch)
+    for batch, specs in specs_by_batch.items():
         exp = jax.export.export(jax.jit(fn))(specs)
         fname = f"sig_b{batch}.jaxexp"
         with open(os.path.join(dirname, fname), "wb") as f:
             f.write(exp.serialize())
-        sigs.append({"batch": int(batch), "file": fname,
+        sigs.append({"batch": batch, "file": fname,
                      "shapes": {k: list(s.shape) for k, s in specs.items()},
                      "dtypes": {k: str(np.dtype(s.dtype))
                                 for k, s in specs.items()}})
-    meta = {"feed_names": list(feed_names), "fetch_names": fetch_names,
-            "signatures": sigs}
+    meta = {"feed_names": list(feed_names),
+            "fetch_names": list(fetch_names), "signatures": sigs}
     with open(os.path.join(dirname, "aot_meta.json"), "w") as f:
         json.dump(meta, f, indent=1)
     return [s["file"] for s in sigs]
+
+
+def save_aot_callable(dirname, fn, example_feeds, fetch_names=("out",)):
+    """Export an arbitrary jittable fn(feeds dict) -> [outputs] as an AOT
+    artifact (one signature: the example shapes). Backs dygraph
+    TracedLayer.save_inference_model."""
+    specs = {}
+    for k, v in example_feeds.items():
+        arr = jnp.asarray(v)
+        specs[k] = jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+    first = next(iter(specs.values()))
+    batch = int(first.shape[0]) if first.shape else 1
+    return _write_artifacts(dirname, fn, {batch: specs}, list(specs),
+                            fetch_names)
 
 
 class AotModel:

@@ -82,6 +82,26 @@ def test_aot_static_batch_feed(tmp_path):
     assert np.asarray(out[0]).shape == (4, 2)
 
 
+def test_traced_layer_save_inference_model(tmp_path):
+    """Dygraph TracedLayer -> AOT artifact -> fresh-context serving."""
+    from paddle_tpu import dygraph
+    from paddle_tpu.dygraph import nn as dnn
+    from paddle_tpu.dygraph.jit import TracedLayer
+
+    x = np.random.RandomState(0).rand(4, 6).astype(np.float32)
+    with dygraph.guard():
+        fc = dnn.Linear(6, 3)
+        out, traced = TracedLayer.trace(fc, [dygraph.to_variable(x)])
+        want = np.asarray(out)
+        traced.save_inference_model(str(tmp_path))
+
+    model = inference.load_aot_model(str(tmp_path))
+    got = model.run({"x0": x})
+    np.testing.assert_allclose(got[0], want, rtol=1e-5)
+    with pytest.raises(RuntimeError, match="trace the layer"):
+        TracedLayer(dnn.Linear(2, 2)).save_inference_model(str(tmp_path))
+
+
 def test_aot_missing_param_raises(tmp_path):
     main, _startup, pred = _net()
     scope = fluid.Scope()                    # startup never ran

@@ -24,6 +24,9 @@ from bench import PEAK_TFLOPS  # noqa: E402
 
 
 def _peak(kind):
+    if "cpu" in kind.lower():
+        return None      # no meaningful MXU peak, even with the env var
+                         # still exported from an earlier TPU session
     env = os.environ.get("BENCH_PEAK_TFLOPS")
     if env:
         return float(env) * 1e12     # malformed value raises, by design
