@@ -19,21 +19,27 @@ LR_COUNTER_NAME = "@LR_DECAY_COUNTER@"
 
 
 def _decay_step_counter(begin=0):
+    """Float mirror of the shared @LR_DECAY_COUNTER@ (reference
+    learning_rate_scheduler.py:45 — delegate to autoincreased_step_counter
+    so the init-to-begin-minus-step rule lives in ONE place; the first
+    executed step observes `begin`).
+
+    NOTE reference-parity caveat: the counter is shared per program, so
+    `begin` only takes effect for the FIRST scheduler built — mixing
+    noam_decay (begin=1) with another schedule (begin=0) in one program
+    follows whichever was built first, exactly like fluid."""
+    from .control_flow import autoincreased_step_counter
+
     helper = LayerHelper("global_step_counter")
     gb = default_main_program().global_block()
     if LR_COUNTER_NAME in gb.vars:
-        counter = gb.vars[LR_COUNTER_NAME]
-        # already incremented this program; reuse
         return gb.vars[LR_COUNTER_NAME + ".float"]
-    counter = helper.create_or_get_global_variable(
-        LR_COUNTER_NAME, shape=(), dtype="float32", persistable=True)
-    counter.stop_gradient = True
-    init_mod.ConstantInitializer(float(begin))(counter)
-    helper.append_op("increment", {"X": counter}, {"Out": counter},
-                     {"step": 1.0})
+    counter = autoincreased_step_counter(LR_COUNTER_NAME, begin=begin,
+                                         step=1)
     fcounter = helper.create_or_get_global_variable(
         LR_COUNTER_NAME + ".float", shape=(), dtype="float32")
-    helper.append_op("assign", {"X": counter}, {"Out": fcounter})
+    helper.append_op("cast", {"X": counter}, {"Out": fcounter},
+                     {"out_dtype": "float32"})
     fcounter.stop_gradient = True
     return fcounter
 
