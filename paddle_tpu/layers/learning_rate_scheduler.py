@@ -9,7 +9,6 @@ import math
 
 from ..core.framework import default_main_program
 from ..core.layer_helper import LayerHelper
-from .. import initializer as init_mod
 from . import tensor
 from . import nn
 from . import ops as ops_layers
