@@ -155,6 +155,64 @@ def test_smooth_l1_formula():
                                per.sum(-1), rtol=1e-5)
 
 
+def test_xavier_msra_conv_fan_math():
+    """initializer.py _compute_fans: conv fans include the receptive
+    field — Xavier-uniform limit sqrt(6/(fan_in+fan_out)), MSRA-uniform
+    sqrt(6/fan_in); checked via the realized value bounds + variance."""
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        layers.create_parameter(
+            [64, 32, 3, 3], "float32",
+            attr=fluid.ParamAttr(name="xv",
+                                 initializer=fluid.initializer.Xavier()))
+        layers.create_parameter(
+            [64, 32, 3, 3], "float32",
+            attr=fluid.ParamAttr(name="ms",
+                                 initializer=fluid.initializer.MSRA()))
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        xv = np.asarray(scope.get("xv"))
+        ms = np.asarray(scope.get("ms"))
+    fan_in, fan_out = 32 * 9, 64 * 9
+    lim_xv = np.sqrt(6.0 / (fan_in + fan_out))
+    lim_ms = np.sqrt(6.0 / fan_in)
+    for arr, lim in [(xv, lim_xv), (ms, lim_ms)]:
+        assert arr.min() >= -lim - 1e-6 and arr.max() <= lim + 1e-6
+        # near-full coverage of the range, uniform variance lim^2/3
+        assert arr.max() > lim * 0.98 and arr.min() < -lim * 0.98
+        np.testing.assert_allclose(arr.std(), lim / np.sqrt(3.0), rtol=0.02)
+
+
+def test_auc_matches_rank_statistic():
+    """auc_op: bucketized trapezoid AUC; with well-separated scores it
+    equals the exact Mann-Whitney rank statistic."""
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        p = layers.data("p", shape=[2], dtype="float32")
+        l = layers.data("l", shape=[1], dtype="int64")
+        auc_val, batch_auc, _states = layers.auc(p, l,
+                                                 num_thresholds=4095)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    pos = np.array([0.9, 0.8, 0.6, 0.35], np.float32)   # labels 1
+    neg = np.array([0.7, 0.4, 0.3, 0.1], np.float32)    # labels 0
+    probs1 = np.concatenate([pos, neg])
+    probs = np.stack([1 - probs1, probs1], axis=1)
+    labels = np.array([[1]] * 4 + [[0]] * 4, np.int64)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        got, = exe.run(main, feed={"p": probs, "l": labels},
+                       fetch_list=[auc_val])
+    # exact AUC: fraction of (pos, neg) pairs ranked correctly
+    correct = sum(1.0 if pp > nn else 0.5 if pp == nn else 0.0
+                  for pp in pos for nn in neg)
+    want = correct / (len(pos) * len(neg))
+    np.testing.assert_allclose(np.asarray(got).reshape(-1)[0], want,
+                               rtol=5e-3)
+
+
 def test_accuracy_top_k():
     """accuracy_op: fraction of rows whose top-k contains the label."""
     main, startup = framework.Program(), framework.Program()
