@@ -185,6 +185,60 @@ def test_xavier_msra_conv_fan_math():
         np.testing.assert_allclose(arr.std(), lim / np.sqrt(3.0), rtol=0.02)
 
 
+def test_embedding_padding_idx_zero_output_and_frozen_row():
+    """lookup_table_op: padding_idx rows read as zeros AND receive no
+    gradient (the row never trains)."""
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        ids = layers.data("ids", shape=[3], dtype="int64")
+        emb = layers.embedding(ids, size=[10, 4], padding_idx=2,
+                               param_attr=fluid.ParamAttr(name="tbl"))
+        loss = layers.reduce_sum(emb)
+        fluid.optimizer.SGDOptimizer(1.0).minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        t0 = np.asarray(scope.get("tbl")).copy()
+        out, = exe.run(main, feed={"ids": np.array([[2, 1, 2]], np.int64)},
+                       fetch_list=[emb])
+        t1 = np.asarray(scope.get("tbl"))
+    assert np.allclose(np.asarray(out)[0, 0], 0)
+    np.testing.assert_array_equal(t1[2], t0[2])     # frozen
+    assert not np.allclose(t1[1], t0[1])            # trained
+
+
+def test_dropout_default_is_downgrade_in_infer():
+    """dropout_op (fluid 1.5 default downgrade_in_infer): TRAIN keeps
+    surviving values unscaled; INFER multiplies by (1-p). upscale_in_train
+    is the inverse pair."""
+    def run(impl):
+        main, startup = framework.Program(), framework.Program()
+        with framework.program_guard(main, startup):
+            x = layers.data("x", shape=[2000], dtype="float32")
+            kw = {} if impl is None else {"dropout_implementation": impl}
+            out = layers.dropout(x, dropout_prob=0.5, **kw)
+        test_prog = main.clone(for_test=True)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        xs = np.ones((4, 2000), np.float32)
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            tr, = exe.run(main, feed={"x": xs}, fetch_list=[out])
+            te, = exe.run(test_prog, feed={"x": xs}, fetch_list=[out])
+        return np.asarray(tr), np.asarray(te)
+
+    for impl in (None, "downgrade_in_infer"):
+        tr, te = run(impl)
+        nz = tr[tr != 0]
+        np.testing.assert_allclose(nz, 1.0)         # train: no upscale
+        np.testing.assert_allclose(te, 0.5)         # infer: x * (1-p)
+        assert 0.4 < len(nz) / tr.size < 0.6
+    tr, te = run("upscale_in_train")
+    np.testing.assert_allclose(tr[tr != 0], 2.0)    # train: x / (1-p)
+    np.testing.assert_allclose(te, 1.0)             # infer: identity
+
+
 def test_auc_matches_rank_statistic():
     """auc_op: bucketized trapezoid AUC; with well-separated scores it
     equals the exact Mann-Whitney rank statistic."""
