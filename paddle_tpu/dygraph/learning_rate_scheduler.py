@@ -1,22 +1,33 @@
 """Dygraph LR schedulers.
 
 Parity: python/paddle/fluid/dygraph/learning_rate_scheduler.py. Host-side
-python objects: `scheduler()` returns the current LR and `step()` advances.
+python objects with the reference contract: `scheduler()` returns the LR
+at the current step and AUTO-ADVANCES (the optimizer calls it once per
+minimize); `step()` only computes the current LR, never advances.
 """
 
 import math
 
 
 class LearningRateDecay:
+    """Reference contract (dygraph/learning_rate_scheduler.py
+    LearningRateDecay.__call__): each CALL returns the lr at the current
+    step_num and then auto-advances — the optimizer calls the object once
+    per minimize, so schedules progress without any manual step()."""
+
     def __init__(self, begin=0, step=1):
         self.step_num = begin
         self.step_size = step
 
     def step(self):
-        self.step_num += self.step_size
+        """Compute the lr at the current step (reference naming; the
+        auto-increment lives in __call__)."""
+        return self.get_lr()
 
     def __call__(self):
-        return self.get_lr()
+        lr = self.step()
+        self.step_num += self.step_size
+        return lr
 
     def get_lr(self):
         raise NotImplementedError

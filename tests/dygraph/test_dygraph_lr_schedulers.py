@@ -1,0 +1,54 @@
+"""Dygraph LR scheduler parity: each optimizer call advances the
+schedule automatically (reference LearningRateDecay.__call__ increments
+after computing — no manual step() in user code).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import dygraph
+from paddle_tpu.dygraph import nn as dnn, functional as F
+from paddle_tpu.dygraph import learning_rate_scheduler as lrs
+
+
+def test_call_auto_advances():
+    d = lrs.ExponentialDecay(learning_rate=0.5, decay_steps=3,
+                             decay_rate=0.7)
+    got = [d() for _ in range(4)]
+    want = [0.5 * 0.7 ** (s / 3.0) for s in range(4)]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_noam_never_sees_step_zero():
+    d = lrs.NoamDecay(d_model=64, warmup_steps=4)
+    got = [d() for _ in range(3)]
+    want = [64 ** -0.5 * min(s ** -0.5, s * 4 ** -1.5) for s in (1, 2, 3)]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_optimizer_consumes_schedule_per_minimize():
+    """Two minimize calls at lr [0.5, 0.35]: the realized SGD updates must
+    use the ADVANCING schedule, not a constant first value."""
+    xs = np.ones((4, 2), np.float32)
+    ys = np.zeros((4, 1), np.float32)
+    with dygraph.guard():
+        fc = dnn.Linear(2, 1)
+        sched = lrs.ExponentialDecay(learning_rate=0.5, decay_steps=1,
+                                     decay_rate=0.7)
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=sched)
+        ws = [np.asarray(fc.parameters()[0].numpy()).copy()]
+        grads = []
+        for _ in range(2):
+            pred = fc(dygraph.to_variable(xs))
+            diff = pred - dygraph.to_variable(ys)
+            loss = F.mean(diff * diff)
+            loss.backward()
+            grads.append(np.asarray(fc.parameters()[0].gradient()).copy())
+            opt.minimize(loss)
+            fc.clear_gradients()
+            ws.append(np.asarray(fc.parameters()[0].numpy()).copy())
+    lr0 = (ws[0] - ws[1]) / grads[0]
+    lr1 = (ws[1] - ws[2]) / grads[1]
+    np.testing.assert_allclose(lr0, 0.5, rtol=1e-4)
+    np.testing.assert_allclose(lr1, 0.5 * 0.7, rtol=1e-4)
