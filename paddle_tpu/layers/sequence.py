@@ -112,14 +112,25 @@ def sequence_unpad(x, length, name=None):
     return _seq_op("sequence_unpad", x, length, out_shape=tuple(x.shape))
 
 
-def sequence_expand(x, y, ref_level=-1, static_repeat=0, name=None):
-    """Parity: fluid.layers.sequence_expand. Static variant: each row of x
-    repeats `static_repeat` times (or y's per-row count at trace time)."""
+def sequence_expand(x, y, ref_level=-1, static_repeat=0, y_length=None,
+                    name=None):
+    """Parity: fluid.layers.sequence_expand — repeat each sequence of x
+    per y's lod at ref_level. Padded-domain contract (this framework's
+    LoD model): y supplies the STATIC output row count; the ragged
+    per-sequence counts ride in `y_length` (a (B,) int var, e.g. a
+    lengths feed) and steer a fixed-shape gather. `static_repeat` is the
+    uniform fast path; with neither, rows expand uniformly to y's size."""
     helper = LayerHelper("sequence_expand", name=name)
-    n = x.shape[0] * static_repeat if static_repeat else x.shape[0]
+    if static_repeat:
+        n = x.shape[0] * static_repeat if x.shape[0] != -1 else -1
+    else:
+        n = y.shape[0]
     out = helper.create_variable_for_type_inference(
         x.dtype, (n,) + tuple(x.shape[1:]))
-    helper.append_op("sequence_expand", {"X": x, "YLength": y}, {"Out": out},
+    inputs = {"X": x, "Y": y}
+    if y_length is not None:
+        inputs["YLength"] = y_length
+    helper.append_op("sequence_expand", inputs, {"Out": out},
                      {"ref_level": ref_level, "static_repeat": static_repeat})
     return out
 

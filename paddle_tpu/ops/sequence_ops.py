@@ -77,14 +77,41 @@ def sequence_reverse(ctx):
 
 @register("sequence_expand")
 def sequence_expand(ctx):
-    x = ctx.in_("X")      # (B, ...) one row per sequence
-    y_len = ctx.in_("YLength")  # (B,) times to repeat each row
-    # Static-shape variant: ref_level expansion with uniform repeat counts.
+    """Repeat x's rows per y's sequence lengths (reference:
+    sequence_expand_op). TPU-static form: the OUTPUT row count is Y's
+    static row count N; the ragged repeat counts (YLength values) only
+    steer a gather index (searchsorted over their cumsum), so ragged
+    expansion runs under jit with fixed shapes."""
+    x = ctx.in_("X")            # (B, ...) one row per sequence
     reps = int(ctx.attr("static_repeat", 0))
     if reps:
         return {"Out": jnp.repeat(x, reps, axis=0)}
-    # Fallback: mask-weighted gather (requires uniform lengths at trace time)
-    return {"Out": jnp.repeat(x, int(y_len[0]), axis=0)}
+    y = ctx.in_("Y")            # (N, ...): N = total expanded rows
+    y_len = ctx.in_("YLength")  # (B,) per-sequence repeat counts
+    if y is None and y_len is None:
+        raise ValueError("sequence_expand needs Y (for the static output "
+                         "size) or static_repeat")
+    n = y.shape[0] if y is not None else None
+    if y_len is None:
+        # no lengths: uniform expansion N // B
+        if n % x.shape[0]:
+            raise ValueError(
+                f"uniform sequence_expand: Y rows {n} not divisible by X "
+                f"rows {x.shape[0]}; pass y_length for ragged expansion")
+        return {"Out": jnp.repeat(x, n // x.shape[0], axis=0)}
+    starts = jnp.cumsum(y_len.astype(jnp.int32))
+    if n is None:
+        raise ValueError("ragged sequence_expand needs Y for the static "
+                         "output row count")
+    # row j of the output copies x[i] where j falls in segment i
+    pos = jnp.arange(n, dtype=jnp.int32)
+    idx = jnp.searchsorted(starts, pos, side="right")
+    idx = jnp.clip(idx, 0, x.shape[0] - 1)
+    out = jnp.take(x, idx, axis=0)
+    # rows past sum(y_length) are PADDING: zero them (file convention),
+    # or the backward accumulates phantom grad into x's last row
+    valid = (pos < starts[-1]).reshape((n,) + (1,) * (x.ndim - 1))
+    return {"Out": jnp.where(valid, out, jnp.zeros((), out.dtype))}
 
 
 @register("sequence_pad")

@@ -286,3 +286,93 @@ def test_accuracy_top_k():
                        fetch_list=[acc])
     np.testing.assert_allclose(np.asarray(got).reshape(-1)[0], 2.0 / 3.0,
                                rtol=1e-6)
+
+
+def test_sequence_expand_ragged_counts():
+    """sequence_expand_op: out row j copies x[i] where j falls in y's
+    i-th lod segment — ragged counts via a lengths feed, static shapes."""
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        p = layers.create_parameter(
+            [2, 1], "float32",
+            attr=fluid.ParamAttr(
+                name="sx", initializer=fluid.initializer.NumpyArrayInitializer(
+                    np.array([[1.0], [2.0]], np.float32))))
+        y = fluid.data(name="y", shape=[5, 1], dtype="float32")
+        ylen = fluid.data(name="ylen", shape=[2], dtype="int32")
+        out = layers.sequence_expand(p, y, y_length=ylen)
+        loss = layers.reduce_sum(out)
+        fluid.append_backward(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        got, gx = exe.run(main, feed={
+            "y": np.zeros((5, 1), np.float32),
+            "ylen": np.array([2, 3], np.int32)},
+            fetch_list=[out, "sx@GRAD"])
+    np.testing.assert_allclose(np.asarray(got).reshape(-1),
+                               [1, 1, 2, 2, 2])
+    # grad accumulates per copy: d sum / d x = [2, 3]
+    np.testing.assert_allclose(np.asarray(gx).reshape(-1), [2, 3])
+
+
+def test_sequence_expand_uniform_and_static():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[2, 1], dtype="float32")
+        y = fluid.data(name="y", shape=[6, 1], dtype="float32")
+        out_u = layers.sequence_expand(x, y)              # uniform 6//2
+        out_s = layers.sequence_expand(x, y, static_repeat=2)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        u, s = exe.run(main, feed={
+            "x": np.array([[1.0], [2.0]], np.float32),
+            "y": np.zeros((6, 1), np.float32)}, fetch_list=[out_u, out_s])
+    np.testing.assert_allclose(np.asarray(u).reshape(-1),
+                               [1, 1, 1, 2, 2, 2])
+    np.testing.assert_allclose(np.asarray(s).reshape(-1), [1, 1, 2, 2])
+
+
+def test_sequence_expand_pads_tail_and_rejects_nondivisible():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        p = layers.create_parameter(
+            [2, 1], "float32",
+            attr=fluid.ParamAttr(
+                name="sx2",
+                initializer=fluid.initializer.NumpyArrayInitializer(
+                    np.array([[1.0], [2.0]], np.float32))))
+        y = fluid.data(name="y", shape=[5, 1], dtype="float32")
+        ylen = fluid.data(name="ylen", shape=[2], dtype="int32")
+        out = layers.sequence_expand(p, y, y_length=ylen)
+        loss = layers.reduce_sum(out)
+        fluid.append_backward(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        got, gx = exe.run(main, feed={
+            "y": np.zeros((5, 1), np.float32),
+            "ylen": np.array([2, 1], np.int32)},     # sum=3 < 5: 2 pad rows
+            fetch_list=[out, "sx2@GRAD"])
+    np.testing.assert_allclose(np.asarray(got).reshape(-1),
+                               [1, 1, 2, 0, 0])      # tail masked
+    np.testing.assert_allclose(np.asarray(gx).reshape(-1), [2, 1])
+
+    # uniform path with non-divisible Y rows: loud error, not silent drop
+    main2, startup2 = framework.Program(), framework.Program()
+    with framework.program_guard(main2, startup2):
+        x2 = fluid.data(name="x2", shape=[2, 1], dtype="float32")
+        y2 = fluid.data(name="y2", shape=[5, 1], dtype="float32")
+        out2 = layers.sequence_expand(x2, y2)
+    exe2 = fluid.Executor()
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe2.run(startup2)
+        with pytest.raises(Exception, match="not divisible"):
+            exe2.run(main2, feed={"x2": np.ones((2, 1), np.float32),
+                                  "y2": np.zeros((5, 1), np.float32)},
+                     fetch_list=[out2])
