@@ -376,3 +376,57 @@ def test_sequence_expand_pads_tail_and_rejects_nondivisible():
             exe2.run(main2, feed={"x2": np.ones((2, 1), np.float32),
                                   "y2": np.zeros((5, 1), np.float32)},
                      fetch_list=[out2])
+
+
+def test_loss_op_formulas():
+    """Reference kernel formulas: hard_sigmoid clip(0.2x+0.5)
+    (hard_sigmoid_op.h HardSigmoidFunctor), log_loss eps=1e-4 BCE
+    (log_loss_op.h), huber 0.5r^2 / delta(|r|-delta/2) (huber_loss_op.h
+    HuberLossForward), margin_rank_loss max(0, -label*(left-right)+margin)
+    (margin_rank_loss_op.h ReLU(margin - label*(left-right)))."""
+    def run(build, feeds):
+        main, startup = framework.Program(), framework.Program()
+        with framework.program_guard(main, startup):
+            out = build()
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            got, = exe.run(main, feed=feeds, fetch_list=[out])
+        return np.asarray(got)
+
+    x = np.array([[-3.0, -1.0, 0.0, 1.0, 3.0]], np.float32)
+    got = run(lambda: layers.hard_sigmoid(
+        layers.data("x", shape=[5], dtype="float32")), {"x": x})
+    np.testing.assert_allclose(got, np.clip(0.2 * x + 0.5, 0, 1),
+                               rtol=1e-6)
+
+    p = np.array([[0.2], [0.9]], np.float32)
+    l = np.array([[0.0], [1.0]], np.float32)
+    got = run(lambda: layers.log_loss(
+        layers.data("p", shape=[1], dtype="float32"),
+        layers.data("l", shape=[1], dtype="float32")), {"p": p, "l": l})
+    eps = 1e-4
+    want = -l * np.log(p + eps) - (1 - l) * np.log(1 - p + eps)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    xx = np.array([[0.5], [3.0]], np.float32)
+    yy = np.zeros((2, 1), np.float32)
+    got = run(lambda: layers.huber_loss(
+        layers.data("hx", shape=[1], dtype="float32"),
+        layers.data("hy", shape=[1], dtype="float32"), delta=1.0),
+        {"hx": xx, "hy": yy})
+    r = np.abs(xx - yy)
+    np.testing.assert_allclose(
+        got, np.where(r <= 1.0, 0.5 * r * r, r - 0.5), rtol=1e-5)
+
+    lab = np.array([[1.0], [-1.0]], np.float32)
+    left = np.array([[0.8], [0.3]], np.float32)
+    right = np.array([[0.5], [0.6]], np.float32)
+    got = run(lambda: layers.margin_rank_loss(
+        layers.data("lab", shape=[1], dtype="float32"),
+        layers.data("left", shape=[1], dtype="float32"),
+        layers.data("right", shape=[1], dtype="float32"), margin=0.1),
+        {"lab": lab, "left": left, "right": right})
+    np.testing.assert_allclose(
+        got, np.maximum(0.0, -lab * (left - right) + 0.1), rtol=1e-5)
