@@ -430,3 +430,46 @@ def test_loss_op_formulas():
         {"lab": lab, "left": left, "right": right})
     np.testing.assert_allclose(
         got, np.maximum(0.0, -lab * (left - right) + 0.1), rtol=1e-5)
+
+
+def test_threshold_activation_formulas():
+    """Reference activation kernels (activation_op.h): hard_shrink
+    (x if |x|>t else 0, t=0.5), softshrink (x-/+lambda outside, 0 inside),
+    thresholded_relu (x if x>1 else 0), relu6 clip(x,0,6), selu
+    (scale*(x | alpha*(e^x-1)) with the Klambauer constants), swish
+    x*sigmoid(beta x)."""
+    def run(build, feeds):
+        main, startup = framework.Program(), framework.Program()
+        with framework.program_guard(main, startup):
+            out = build()
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            got, = exe.run(main, feed=feeds, fetch_list=[out])
+        return np.asarray(got)
+
+    x = np.array([[-2.0, -0.3, 0.0, 0.3, 2.0]], np.float32)
+    got = run(lambda: layers.hard_shrink(
+        layers.data("x", shape=[5], dtype="float32")), {"x": x})
+    np.testing.assert_allclose(got, np.where(np.abs(x) > 0.5, x, 0.0))
+    got = run(lambda: layers.softshrink(
+        layers.data("x", shape=[5], dtype="float32")), {"x": x})
+    np.testing.assert_allclose(
+        got, np.where(x > 0.5, x - 0.5, np.where(x < -0.5, x + 0.5, 0.0)))
+    got = run(lambda: layers.thresholded_relu(
+        layers.data("x", shape=[5], dtype="float32")), {"x": x})
+    np.testing.assert_allclose(got, np.where(x > 1.0, x, 0.0))
+
+    x2 = np.array([[-1.0, 3.0, 8.0]], np.float32)
+    got = run(lambda: layers.relu6(
+        layers.data("x2", shape=[3], dtype="float32")), {"x2": x2})
+    np.testing.assert_allclose(got, np.clip(x2, 0, 6))
+    got = run(lambda: layers.selu(
+        layers.data("x2", shape=[3], dtype="float32")), {"x2": x2})
+    sc, al = 1.0507009873554805, 1.6732632423543772
+    np.testing.assert_allclose(
+        got, sc * np.where(x2 > 0, x2, al * (np.exp(x2) - 1)), rtol=1e-5)
+    got = run(lambda: layers.swish(
+        layers.data("x2", shape=[3], dtype="float32")), {"x2": x2})
+    np.testing.assert_allclose(got, x2 / (1 + np.exp(-x2)), rtol=1e-5)
