@@ -23,7 +23,9 @@ feed | resnet — secondary images/sec metric),
 BENCH_SEQ_LEN, BENCH_BATCHES ("8,16,32"), BENCH_STEPS,
 BENCH_RECOMPUTE (remat policy: dots|nothing|offload),
 BENCH_TINY=1 (bert_tiny config for off-TPU smoke tests), BENCH_PEAK_TFLOPS
-(override the per-chip peak), BENCH_DEVICE_TIMEOUT, BENCH_INIT_RETRIES.
+(override the per-chip peak), BENCH_DEVICE_TIMEOUT, BENCH_INIT_RETRIES,
+BENCH_DUMP_HLO=<path> (archive the best batch's optimized HLO),
+BENCH_HBM_FRACTION (pre-flight prune threshold, default 0.92).
 """
 
 import json
@@ -365,7 +367,16 @@ def bench_one(batch, seq_len, n_steps):
             mem_gb = round(stats["peak_bytes_in_use"] / 2**30, 3)
     except Exception:
         pass
+    hlo_text = None
+    if os.environ.get("BENCH_DUMP_HLO"):
+        try:
+            # cheap: _last_compiled() is already memoized by the
+            # cost-analysis call above
+            hlo_text = step.executor.last_compiled_text()
+        except Exception as e:
+            print(f"bench: HLO dump unavailable: {e}", file=sys.stderr)
     return {
+        "hlo_text": hlo_text,
         "batch": batch,
         "tokens_per_sec": tokens_per_step * n_steps / dt,
         "model_flops_per_sec": step_flops * n_steps / dt,
@@ -373,6 +384,42 @@ def bench_one(batch, seq_len, n_steps):
         "peak_mem_gb_process": mem_gb,
         "flash_engaged": bool(flash_engaged),
     }
+
+
+def _hbm_limit_bytes():
+    """Device memory capacity per XLA's allocator (None off-TPU)."""
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats() or {}
+        return stats.get("bytes_limit")
+    except Exception:
+        return None
+
+
+def _project_peak_bytes(points, batch):
+    """HBM pre-flight projection for a batch LARGER than any run so far.
+
+    The allocator peak is process-lifetime monotonic, so only the
+    strictly-increasing (batch, peak) subsequence carries information:
+    with two such points the activation slope is (p2-p1)/(b2-b1) on top
+    of the fixed params+opt-state floor; with one point no linear split
+    is possible and the caller falls back to the "HBM already nearly
+    full" check. Returns None when no projection is justified."""
+    pts = []
+    for b, p in points:
+        if p and (not pts or (b > pts[-1][0] and p > pts[-1][1])):
+            pts.append((b, p))
+    if len(pts) < 2:
+        return None
+    (b1, p1), (b2, p2) = pts[-2], pts[-1]
+    slope = (p2 - p1) / (b2 - b1)
+    return p2 + max(slope, 0.0) * (batch - b2)
+
+
+def _looks_like_oom(err):
+    s = repr(err).lower()
+    return ("resource_exhausted" in s or "out of memory" in s
+            or "oom" in s or "exceeds the memory" in s)
 
 
 _SWEEP = []          # completed batch results (the hard watchdog reads it)
@@ -444,6 +491,17 @@ def _emit(sweep, seq_len, kind, peak):
                    rate_key: round(r["tokens_per_sec"], 2),
                    "mfu": round(r["mfu"], 4)} for r in sweep],
     }
+    hlo_path = os.environ.get("BENCH_DUMP_HLO")
+    if hlo_path and best.get("hlo_text"):
+        try:
+            d = os.path.dirname(hlo_path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            with open(hlo_path, "w") as f:
+                f.write(best["hlo_text"])
+            result["hlo_path"] = hlo_path
+        except OSError as e:
+            print(f"bench: HLO dump write failed: {e}", file=sys.stderr)
     if tiny:
         result["tiny"] = True
     if model == "resnet":
@@ -485,18 +543,57 @@ def main():
     hard_timer.daemon = True
     hard_timer.start()
 
+    hbm_limit = _hbm_limit_bytes()
+    hbm_frac = float(os.environ.get("BENCH_HBM_FRACTION", 0.92))
+    mem_points = []        # (batch, peak_bytes) of successful runs
+    max_ok = 0             # largest batch that ran (any smaller one fits)
+    oom_floor = None       # smallest batch that OOMed (larger can't fit)
+    peak_poisoned = False  # an OOM pins the lifetime peak near the limit,
+    #                        making later memory_stats reads meaningless
+
     t_start = time.perf_counter()
     for batch in batches:
+        if oom_floor is not None and batch >= oom_floor:
+            print(f"bench: pre-flight prune batch={batch}: batch "
+                  f"{oom_floor} already OOMed", file=sys.stderr)
+            continue
+        if hbm_limit and batch > max_ok and mem_points:
+            proj = _project_peak_bytes(mem_points, batch)
+            last_peak = mem_points[-1][1]
+            if proj is not None and proj > hbm_frac * hbm_limit:
+                print(f"bench: pre-flight prune batch={batch}: projected "
+                      f"peak {proj / 2**30:.1f}GiB > {hbm_frac:.0%} of "
+                      f"{hbm_limit / 2**30:.1f}GiB HBM", file=sys.stderr)
+                continue
+            if proj is None and last_peak > hbm_frac * hbm_limit:
+                print(f"bench: pre-flight prune batch={batch}: HBM already "
+                      f"{last_peak / hbm_limit:.0%} full at batch "
+                      f"{mem_points[-1][0]}", file=sys.stderr)
+                continue
         try:
             r = bench_one(batch, seq_len, n_steps)
         except Exception as e:
             print(f"bench: batch {batch} failed: {e}", file=sys.stderr)
+            if _looks_like_oom(e):
+                oom_floor = batch if oom_floor is None else min(oom_floor,
+                                                                batch)
+                peak_poisoned = True
             continue
+        max_ok = max(max_ok, batch)
+        if r.get("peak_mem_gb_process") and not peak_poisoned:
+            mem_points.append((batch, r["peak_mem_gb_process"] * 2**30))
         r["mfu"] = r["model_flops_per_sec"] / peak
         print(f"bench: batch={batch} {r['tokens_per_sec']:.1f} tok/s "
               f"mfu={r['mfu']:.3f} flash={r['flash_engaged']}",
               file=sys.stderr)
         _SWEEP.append(r)
+        if len(_SWEEP) > 1:
+            # the optimized HLO text is tens of MB for the full models;
+            # keep only the best-so-far batch's copy
+            best_so_far = max(_SWEEP, key=lambda x: x["tokens_per_sec"])
+            for x in _SWEEP:
+                if x is not best_so_far:
+                    x["hlo_text"] = None
         elapsed = time.perf_counter() - t_start
         if elapsed > budget and batch != batches[-1]:
             print(f"bench: time budget {budget:.0f}s exhausted after "

@@ -1,0 +1,39 @@
+"""Hardware test tier (`-m tpu`): runs ONLY when the real TPU tunnel is
+live. Kept OUT of `tests/` because that tree's conftest force-pins the
+cpu platform; this one wants the axon TPU backend.
+
+Safety: the axon tunnel wedges for ~an hour if device init hangs or two
+processes init it concurrently, so before letting pytest's in-process
+jax touch the backend we probe device init in a SUBPROCESS under a hard
+timeout. A dead tunnel skips the tier instead of hanging it.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+from tpu_probe import probe  # noqa: E402  (shared wedge-safe probe)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "tpu: requires the real TPU chip (axon tunnel)")
+    # The f32 oracle comparisons assume exact-f32 matmuls; without this
+    # pin the TPU default runs einsums as bf16 MXU passes (~1e-3 error),
+    # blowing the 2e-5/5e-4 tolerances. bf16 *production* precision is
+    # bench.py's concern, not this tier's.
+    import jax
+    jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def pytest_collection_modifyitems(config, items):
+    if not items:
+        return
+    if probe() is None:
+        skip = pytest.mark.skip(reason="TPU tunnel unavailable/wedged "
+                                       "(subprocess probe failed)")
+        for item in items:
+            item.add_marker(skip)

@@ -1,0 +1,118 @@
+"""Flash-vs-oracle on REAL TPU hardware (the compiled Mosaic kernel, not
+the CPU Pallas interpreter that `tests/ops/test_flash_attention.py`
+exercises). Writes a committed evidence artifact to
+`perf/flash_oracle_tpu.json` — VERDICT r2 asked for reproducible
+hardware proof after the round-2 run's logs were lost with the session.
+
+Tolerances are bf16-aware: the production kernel runs bf16 inputs with
+f32 accumulation; the oracle is computed in f32 and compared against a
+bf16-rounded reference error bound.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+PERF = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "perf")
+
+_RESULTS = []
+
+
+def _record(name, max_err, tol, shapes):
+    _RESULTS.append({"case": name, "max_abs_err": float(max_err),
+                     "tol": float(tol), "shapes": shapes,
+                     "passed": bool(max_err <= tol)})
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _evidence_file():
+    yield
+    if not _RESULTS:
+        return
+    os.makedirs(PERF, exist_ok=True)
+    import jax
+    dev = jax.devices()[0]
+    with open(os.path.join(PERF, "flash_oracle_tpu.json"), "w") as f:
+        json.dump({
+            "timestamp": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "device_kind": getattr(dev, "device_kind", str(dev)),
+            "platform": dev.platform,
+            "cases": _RESULTS,
+        }, f, indent=1)
+
+
+def _rand(shape, seed, dtype):
+    import jax
+    import jax.numpy as jnp
+    return jax.random.normal(jax.random.PRNGKey(seed), shape,
+                             jnp.float32).astype(dtype)
+
+
+@pytest.mark.parametrize("dtype_name,tol", [("float32", 2e-5),
+                                            ("bfloat16", 2e-2)])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_fwd_tpu(dtype_name, tol, causal):
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas import flash
+
+    dtype = jnp.dtype(dtype_name)
+    b, h, t, d = 2, 4, 512, 64
+    q, k, v = (_rand((b, h, t, d), s, dtype) for s in (0, 1, 2))
+    scale = 1.0 / d ** 0.5
+    got = flash.flash_attention(q, k, v, scale=scale, causal=causal)
+    want = flash._xla_ref(q.astype(jnp.float32), k.astype(jnp.float32),
+                          v.astype(jnp.float32), scale, causal)
+    err = np.max(np.abs(np.asarray(got, np.float32) - np.asarray(want)))
+    _record(f"fwd_{dtype_name}_causal={causal}", err, tol,
+            {"b": b, "h": h, "t": t, "d": d})
+    assert err <= tol, f"max_abs_err {err} > {tol}"
+
+
+@pytest.mark.parametrize("bias_kind", ["none", "key_mask"])
+def test_flash_bwd_tpu(bias_kind):
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas import flash
+
+    b, h, t, d = 2, 4, 256, 64
+    q, k, v = (_rand((b, h, t, d), s, jnp.float32) for s in (0, 1, 2))
+    scale = 1.0 / d ** 0.5
+    bias = None
+    if bias_kind == "key_mask":
+        m = np.zeros((b, 1, 1, t), np.float32)
+        m[0, :, :, t // 2:] = -1e9
+        bias = jnp.asarray(m)
+
+    def floss(q, k, v):
+        o = flash.flash_attention(q, k, v, bias=bias, scale=scale)
+        return jnp.sum(jnp.sin(o))
+
+    def oloss(q, k, v):
+        o = flash._xla_ref(q, k, v, scale, False, bias=bias)
+        return jnp.sum(jnp.sin(o))
+
+    gf = jax.grad(floss, argnums=(0, 1, 2))(q, k, v)
+    go = jax.grad(oloss, argnums=(0, 1, 2))(q, k, v)
+    err = max(float(np.max(np.abs(np.asarray(a) - np.asarray(b_))))
+              for a, b_ in zip(gf, go))
+    tol = 5e-4
+    _record(f"bwd_f32_bias={bias_kind}", err, tol,
+            {"b": b, "h": h, "t": t, "d": d})
+    assert err <= tol, f"max grad err {err} > {tol}"
+
+
+def test_flash_actually_compiled_not_interpreted():
+    """On a real TPU the kernel must take the compiled Mosaic path, not
+    the interpreter fallback — otherwise the perf story is fiction."""
+    import jax
+    from paddle_tpu.ops.pallas import flash
+
+    assert jax.devices()[0].platform.lower() in ("tpu", "axon")
+    assert not flash._interpret(), \
+        "flash kernel fell back to interpret mode on TPU"

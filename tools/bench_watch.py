@@ -1,0 +1,177 @@
+"""Detached bench watcher: probe the TPU tunnel, run the full perf
+suite the moment it opens, archive the evidence into the repo.
+
+Two consecutive rounds lost their hardware window to a wedged axon
+tunnel (BENCH_r01/r02 both `parsed: null`).  This watcher makes the
+window a background concern instead of a foreground gamble:
+
+    python tools/bench_watch.py &          # or: nohup ... &
+
+Every cycle it probes device init **in a subprocess under `timeout`**
+— never in-process, and never two probes at once: the axon plugin
+wedges for ~an hour if two processes initialize the backend
+concurrently, so a single sequential probe/run chain is the only safe
+shape.  Every attempt is appended to `perf/watch_log.txt` (committed:
+if the tunnel never opens, the log itself is the evidence of
+continuous attempts).
+
+On a live tunnel it runs, in order (each its own subprocess, strictly
+sequential):
+  1. tiny smoke bench            -> perf/bench_tiny.json
+  2. ERNIE headline bench        -> perf/bench_ernie.json (+ HLO dump)
+  3. secondaries                 -> perf/bench_{resnet,transformer,deepfm}.json
+  4. flash block-size tuner      -> perf/tune_flash.txt
+  5. TPU test tier (flash-vs-oracle on hardware)
+                                 -> perf/tpu_tier.txt + perf/flash_oracle_tpu.json
+then commits `perf/` and exits.  A partial window (tunnel dies
+mid-suite) still commits whatever landed.
+
+Knobs: WATCH_INTERVAL_S (default 600), WATCH_MAX_CYCLES (default 64),
+WATCH_PROBE_TIMEOUT_S (default 120).  Touch `perf/watch_stop` to make
+the watcher exit cleanly before its cycle budget (do this before
+anything else needs the tunnel — two concurrent axon inits wedge it).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PERF = os.path.join(REPO, "perf")
+LOG = os.path.join(PERF, "watch_log.txt")
+STOP = os.path.join(PERF, "watch_stop")
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from tpu_probe import DEFAULT_TIMEOUT_S as PROBE_TIMEOUT_S  # noqa: E402
+from tpu_probe import probe  # noqa: E402  (shared wedge-safe probe)
+
+INTERVAL_S = int(os.environ.get("WATCH_INTERVAL_S", 600))
+MAX_CYCLES = int(os.environ.get("WATCH_MAX_CYCLES", 64))
+
+
+def log(msg, to_file=True):
+    line = f"{time.strftime('%Y-%m-%d %H:%M:%S')} {msg}"
+    print(f"watch: {line}", file=sys.stderr, flush=True)
+    if not to_file:
+        return
+    os.makedirs(PERF, exist_ok=True)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def run_step(name, cmd, env=None, timeout_s=3600, stdout_path=None):
+    """Run one suite step in a subprocess; archive stdout; never raise."""
+    log(f"step {name}: {' '.join(cmd)}")
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    t0 = time.time()
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=timeout_s, env=full_env, cwd=REPO)
+        rc = out.returncode
+        stdout, stderr = out.stdout, out.stderr
+    except subprocess.TimeoutExpired as e:
+        rc = -1
+        stdout = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) \
+            else (e.stdout or "")
+        stderr = f"timeout after {timeout_s}s"
+    if stdout_path:
+        with open(os.path.join(PERF, stdout_path), "w") as f:
+            f.write(stdout)
+    log(f"step {name}: rc={rc} in {time.time() - t0:.0f}s "
+        f"(stderr tail: {stderr.strip().splitlines()[-1] if stderr.strip() else ''!r})")
+    return rc
+
+
+def run_suite():
+    py = sys.executable
+    bench = os.path.join(REPO, "bench.py")
+    os.makedirs(os.path.join(PERF, "hlo"), exist_ok=True)
+    # 1. tiny smoke first: cheap confirmation the chip does real work
+    #    before burning the window on BERT-base compiles
+    run_step("tiny", [py, bench],
+             env={"BENCH_TINY": "1", "BENCH_BATCHES": "8",
+                  "BENCH_STEPS": "5", "BENCH_HARD_TIMEOUT": "900"},
+             timeout_s=1200, stdout_path="bench_tiny.json")
+    # 2. headline: ERNIE-base, full sweep, HLO of the best batch archived
+    rc = run_step("ernie", [py, bench],
+                  env={"BENCH_DUMP_HLO": os.path.join(PERF, "hlo",
+                                                      "ernie_best.hlo.txt")},
+                  timeout_s=4000, stdout_path="bench_ernie.json")
+    if rc != 0:
+        log("headline failed — continuing with secondaries anyway")
+    # 3. secondaries (SURVEY §6 / BASELINE configs)
+    for model, budget in (("resnet", 2400), ("transformer", 2400),
+                          ("deepfm", 1800)):
+        run_step(model, [py, bench],
+                 env={"BENCH_MODEL": model,
+                      "BENCH_HARD_TIMEOUT": str(budget)},
+                 timeout_s=budget + 600, stdout_path=f"bench_{model}.json")
+    # 4. flash block-size tuner (exports the winner for future runs)
+    run_step("tune_flash",
+             [py, os.path.join(REPO, "tools", "tune_flash.py"),
+              "--backward"],
+             timeout_s=2400, stdout_path="tune_flash.txt")
+    # 5. hardware flash-vs-oracle tier (writes perf/flash_oracle_tpu.json)
+    run_step("tpu_tier",
+             [py, "-m", "pytest", os.path.join(REPO, "tests_tpu"),
+              "-q", "-m", "tpu"],
+             timeout_s=2400, stdout_path="tpu_tier.txt")
+
+
+def commit_perf(msg):
+    """Commit ONLY the perf/ tree (pathspec-limited so unrelated staged
+    work is never swept into the watcher's commit). The commit-event
+    line goes to stderr only — writing it into watch_log.txt would
+    leave the tree perpetually one line dirty."""
+    try:
+        subprocess.run(["git", "add", "perf"], cwd=REPO, check=True,
+                       capture_output=True)
+        diff = subprocess.run(["git", "diff", "--cached", "--quiet",
+                               "--", "perf"], cwd=REPO)
+        if diff.returncode == 0:
+            return
+        subprocess.run(
+            ["git", "commit", "-m", msg, "-m",
+             "No-Verification-Needed: perf artifacts only, no source change",
+             "--", "perf"],
+            cwd=REPO, check=True, capture_output=True)
+        log(f"committed perf artifacts: {msg}", to_file=False)
+    except subprocess.CalledProcessError as e:
+        log(f"git commit failed: {e.stderr if hasattr(e, 'stderr') else e}",
+            to_file=False)
+
+
+def main():
+    os.makedirs(PERF, exist_ok=True)
+    log(f"watcher start (interval {INTERVAL_S}s, max {MAX_CYCLES} cycles, "
+        f"probe timeout {PROBE_TIMEOUT_S}s)")
+    for cycle in range(1, MAX_CYCLES + 1):
+        if os.path.exists(STOP):
+            log("stop file present — exiting")
+            commit_perf("Record bench-watcher tunnel probe log")
+            return 0
+        dev = probe()
+        if dev is None:
+            log(f"cycle {cycle}/{MAX_CYCLES}: tunnel wedged")
+            # commit the attempt log every 6 cycles so a killed session
+            # still leaves evidence in git history
+            if cycle % 6 == 0:
+                commit_perf("Record bench-watcher tunnel probe log")
+            time.sleep(INTERVAL_S)
+            continue
+        log(f"cycle {cycle}: TUNNEL OK ({dev}) — running perf suite")
+        run_suite()
+        commit_perf("Archive TPU bench artifacts from hardware window")
+        log("suite complete — watcher exiting")
+        return 0
+    log("cycle budget exhausted — exiting")
+    commit_perf("Record bench-watcher tunnel probe log")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

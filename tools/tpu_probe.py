@@ -1,0 +1,35 @@
+"""Shared TPU-tunnel probe: device init in a SUBPROCESS under a hard
+timeout. The single source of truth for the wedge-safety rules (the
+axon plugin wedges ~an hour on a hung or concurrent device init, so
+probes must be subprocess-only, sequential, and killable).
+
+Used by tools/bench_watch.py and tests_tpu/conftest.py.
+"""
+
+import os
+import subprocess
+import sys
+
+DEFAULT_TIMEOUT_S = int(os.environ.get("WATCH_PROBE_TIMEOUT_S", 120))
+
+
+def probe(timeout_s=None):
+    """Return a 'platform device_kind n_devices' string when a live TPU
+    backend answers device init within the timeout, else None. The
+    subprocess is killed at the timeout so a wedged init never blocks
+    the caller."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "print(d[0].platform, getattr(d[0], 'device_kind', ''), "
+             "len(d))"],
+            capture_output=True, text=True,
+            timeout=timeout_s or DEFAULT_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        return None
+    tail = (out.stdout.strip().splitlines() or [""])[-1]
+    low = tail.lower()
+    if out.returncode == 0 and ("tpu" in low or "axon" in low):
+        return tail
+    return None
