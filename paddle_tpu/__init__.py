@@ -40,6 +40,8 @@ from . import io
 from .io.state import (save_params, save_persistables, save_vars, load_params,
                        load_persistables, load_vars)
 from .io.inference_io import save_inference_model, load_inference_model
+from .io.dataset import (DatasetFactory, InMemoryDataset, QueueDataset,
+                         FileInstantDataset, BoxPSDataset, DataFeedDesc)
 from . import dataset
 from . import reader
 from . import dygraph

@@ -169,6 +169,71 @@ class Executor:
             costs = costs[0] if costs else {}
         return dict(costs or {})
 
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Consume every sample in `dataset`, one optimizer step per
+        batch. Parity: fluid.Executor.train_from_dataset
+        (executor.py:894). The reference spawns `thread` HogwildWorkers
+        each interpreting the op list against a feed queue; here the
+        whole step is one donated XLA executable, so threads go to the
+        native file PARSER (csrc/dataset_feed.cc) and the host loop just
+        hands static-shape batches to the device."""
+        return self._run_from_dataset(program, dataset, scope, thread,
+                                      debug, fetch_list, fetch_info,
+                                      print_period, is_infer=False)
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Parity: fluid.Executor.infer_from_dataset (executor.py:817).
+        Same loop as train_from_dataset; the program decides whether
+        anything trains (pass a clone(for_test=True) / optimizer-free
+        program, as the reference's examples do — the reference's
+        `_set_infer` flag only gates pserver gradient push, which is
+        design-deleted on TPU)."""
+        return self._run_from_dataset(program, dataset, scope, thread,
+                                      debug, fetch_list, fetch_info,
+                                      print_period, is_infer=True)
+
+    def _run_from_dataset(self, program, dataset, scope, thread, debug,
+                          fetch_list, fetch_info, print_period, is_infer):
+        import time as _time
+        if dataset is None:
+            raise RuntimeError("dataset is need and should be initialized")
+        program = program if program is not None else default_main_program()
+        scope = scope if scope is not None else global_scope()
+        dataset._prepare_to_run()
+        # reference executor.py _prepare_trainer: an explicit thread > 0
+        # overrides dataset.thread_num (the docstring's min() is stale)
+        nthread = thread if thread > 0 else dataset.thread_num
+        names = [f if isinstance(f, str) else f.name
+                 for f in (fetch_list or [])]
+        infos = list(fetch_info) if fetch_info else names
+        step = 0
+        t0 = _time.perf_counter()
+        gb = program.global_block()
+        drop = None        # loop-invariant: batch key sets are identical
+        for feed in dataset._iter_batches(nthread):
+            # drop feed entries the program doesn't declare (e.g. the
+            # auto-emitted <name>_seq_len for programs that don't use it)
+            if drop is None:
+                drop = {k for k in feed if not gb.has_var(k)}
+            if drop:
+                feed = {k: v for k, v in feed.items() if k not in drop}
+            out = self.run(program, feed=feed, fetch_list=fetch_list,
+                           scope=scope)
+            step += 1
+            if names and step % print_period == 0:
+                msgs = [f"{info}: {np.asarray(v).ravel()[:8]}"
+                        for info, v in zip(infos, out)]
+                print(f"step {step}: " + ", ".join(msgs))
+            if debug:
+                dt = (_time.perf_counter() - t0) / step
+                print(f"step {step}: avg {dt * 1e3:.2f} ms/batch")
+        dataset._finish_to_run()
+        return None
+
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             feed_var_name="feed", fetch_var_name="fetch", return_numpy=True,
             use_program_cache=True):

@@ -1,0 +1,415 @@
+"""Dataset ingestion path: DatasetFactory / InMemoryDataset /
+QueueDataset + exe.train_from_dataset / infer_from_dataset.
+
+Parity targets: python/paddle/fluid/dataset.py (:21,:269,:613),
+executor.py:817/:894, data_feed.cc's MultiSlot text format. The
+headline check mirrors VERDICT r2 item 2's done-bar: DeepFM trains
+from generated files via exe.train_from_dataset with numerics matching
+the feed-dict path.
+"""
+
+import gzip
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core import framework
+from paddle_tpu.core.executor import Scope, scope_guard
+from paddle_tpu.io import dataset as ds
+from paddle_tpu.models import deepfm
+
+FIELDS = 5
+NFEAT = 1000
+
+
+def _deepfm_lines(rng, n):
+    """MultiSlot lines for the DeepFM slots (ids, vals, label)."""
+    ids = rng.integers(0, NFEAT, (n, FIELDS))
+    vals = rng.random((n, FIELDS)).round(4)
+    lab = rng.integers(0, 2, (n,))
+    lines = []
+    for i in range(n):
+        toks = ([str(FIELDS)] + [str(x) for x in ids[i]]
+                + [str(FIELDS)] + [f"{x:.4f}" for x in vals[i]]
+                + ["1", str(lab[i])])
+        lines.append(" ".join(toks))
+    return lines, ids, vals.astype(np.float32), lab.astype(np.float32)
+
+
+def _write_files(tmp_path, lines, n_files=2, suffix=""):
+    files = []
+    per = (len(lines) + n_files - 1) // n_files
+    for f in range(n_files):
+        p = str(tmp_path / f"part-{f}{suffix}")
+        chunk = "\n".join(lines[f * per:(f + 1) * per]) + "\n"
+        if suffix == ".gz":
+            with gzip.open(p, "wt") as fh:
+                fh.write(chunk)
+        else:
+            with open(p, "w") as fh:
+                fh.write(chunk)
+        files.append(p)
+    return files
+
+
+def _build_deepfm(seed=7):
+    main, startup = framework.Program(), framework.Program()
+    startup.random_seed = seed
+    main.random_seed = seed
+    with framework.program_guard(main, startup):
+        _i, _v, _l, avg_loss, _p = deepfm.build_train_net(
+            num_features=NFEAT, num_fields=FIELDS, embed_dim=4)
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(avg_loss)
+    return main, startup, avg_loss
+
+
+def test_deepfm_train_from_dataset_matches_feed_dict(tmp_path):
+    rng = np.random.default_rng(0)
+    lines, ids, vals, lab = _deepfm_lines(rng, 32)
+    files = _write_files(tmp_path, lines, n_files=2)
+
+    main, startup, loss = _build_deepfm()
+    gb = main.global_block()
+    use_vars = [gb.var("feat_ids"), gb.var("feat_vals"), gb.var("label")]
+
+    scope = Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    with scope_guard(scope):
+        exe.run(startup)
+    # materialize to host: the Executor donates state buffers, so device
+    # arrays in the scope are invalidated by the first step
+    snapshot = {k: np.asarray(v) for k, v in scope._vars.items()}
+
+    batch = 8
+    d = ds.DatasetFactory().create_dataset("QueueDataset")
+    d.set_batch_size(batch)
+    d.set_use_var(use_vars)
+    d.set_filelist(files)
+    d.set_thread(2)
+    with scope_guard(scope):
+        exe.train_from_dataset(program=main, dataset=d, scope=scope)
+    params_a = {k: np.asarray(v) for k, v in scope._vars.items()}
+
+    # reset params, replay the same batches through plain feed dicts
+    scope._vars.clear()
+    scope._vars.update(snapshot)
+    exe2 = fluid.Executor(fluid.TPUPlace(0))   # fresh step counter -> same rng
+    with scope_guard(scope):
+        for b0 in range(0, 32, batch):
+            sl = slice(b0, b0 + batch)
+            exe2.run(main, feed={
+                "feat_ids": ids[sl].astype(np.int64),
+                "feat_vals": vals[sl],
+                "label": lab[sl].reshape(-1, 1),
+            }, fetch_list=[loss])
+    params_b = {k: np.asarray(v) for k, v in scope._vars.items()}
+
+    assert set(params_a) == set(params_b)
+    for k in params_a:
+        np.testing.assert_allclose(params_a[k], params_b[k],
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=f"param {k} diverged")
+
+
+def test_inmemory_shuffles_and_sizes(tmp_path):
+    rng = np.random.default_rng(1)
+    lines, ids, _, _ = _deepfm_lines(rng, 24)
+    files = _write_files(tmp_path, lines, n_files=3)
+
+    main = framework.Program()
+    with framework.program_guard(main, framework.Program()):
+        use_vars = [layers.data("feat_ids", shape=[FIELDS], dtype="int64"),
+                    layers.data("feat_vals", shape=[FIELDS],
+                                dtype="float32"),
+                    layers.data("label", shape=[1], dtype="float32")]
+
+    d = ds.DatasetFactory().create_dataset("InMemoryDataset")
+    d.set_batch_size(6)
+    d.set_use_var(use_vars)
+    d.set_filelist(files)
+    d.set_thread(4)          # clamped to len(filelist)
+    d.load_into_memory()
+    assert d.thread_num == 3
+    assert d.get_memory_data_size() == 24
+
+    # file-order load: first batch == first 6 generated instances
+    first = next(iter(d._iter_batches()))
+    np.testing.assert_array_equal(first["feat_ids"], ids[:6])
+
+    d.set_shuffle_seed(123)
+    d.local_shuffle()
+    shuf1 = [b["feat_ids"].copy() for b in d._iter_batches()]
+    seen = np.sort(np.concatenate([b.ravel() for b in shuf1]))
+    np.testing.assert_array_equal(seen, np.sort(ids.ravel()))
+
+    # deterministic under the seed
+    d2 = ds.DatasetFactory().create_dataset("InMemoryDataset")
+    d2.set_batch_size(6)
+    d2.set_use_var(use_vars)
+    d2.set_filelist(files)
+    d2.load_into_memory()
+    d2.set_shuffle_seed(123)
+    d2.local_shuffle()
+    shuf2 = [b["feat_ids"].copy() for b in d2._iter_batches()]
+    for a, b in zip(shuf1, shuf2):
+        np.testing.assert_array_equal(a, b)
+
+    d.release_memory()
+    with pytest.raises(RuntimeError):
+        d.get_memory_data_size()
+
+
+def test_global_shuffle_partitions_disjoint(tmp_path):
+    """Hash partition: simulated workers see disjoint instances whose
+    union is the whole dataset (the TPU re-expression of the fleet
+    record redistribution — see io/dataset.py global_shuffle)."""
+    rng = np.random.default_rng(2)
+    lines, ids, _, _ = _deepfm_lines(rng, 30)
+    files = _write_files(tmp_path, lines, n_files=1)
+
+    main = framework.Program()
+    with framework.program_guard(main, framework.Program()):
+        use_vars = [layers.data("feat_ids", shape=[FIELDS], dtype="int64"),
+                    layers.data("feat_vals", shape=[FIELDS],
+                                dtype="float32"),
+                    layers.data("label", shape=[1], dtype="float32")]
+
+    class FakeFleet:
+        def __init__(self, n, i):
+            self._n, self._i = n, i
+
+        def worker_num(self):
+            return self._n
+
+        def worker_index(self):
+            return self._i
+
+        def barrier_worker(self):
+            pass
+
+    rows = []
+    for w in range(3):
+        d = ds.InMemoryDataset()
+        d.set_batch_size(4)
+        d.set_use_var(use_vars)
+        d.set_filelist(files)
+        d.set_shuffle_seed(5)
+        d.load_into_memory()
+        d.global_shuffle(fleet=FakeFleet(3, w))
+        got = [b["feat_ids"] for b in d._iter_batches()]
+        if got:
+            rows.append(np.concatenate(got, axis=0))
+    union = np.concatenate(rows, axis=0)
+    assert union.shape[0] == 30
+    # every original instance appears exactly once across workers
+    key = lambda a: {tuple(r) for r in a}          # noqa: E731
+    assert key(union) == key(ids)
+
+
+def test_sparse_slot_pads_and_emits_seq_len(tmp_path):
+    """lod_level=1 slots: padded values feed the var name, lengths feed
+    <name>_seq_len (SURVEY §1 decision 4's explicit-length form)."""
+    lines = ["3 11 12 13 1 1.0",
+             "1 7 1 0.0",
+             "2 5 6 1 1.0",
+             "4 1 2 3 4 1 0.0"]
+    files = _write_files(tmp_path, lines, n_files=1)
+
+    main = framework.Program()
+    with framework.program_guard(main, framework.Program()):
+        q = layers.data("q", shape=[1], dtype="int64", lod_level=1)
+        y = layers.data("y", shape=[1], dtype="float32")
+
+    d = ds.InMemoryDataset()
+    d.set_batch_size(2)
+    d.set_use_var([q, y])
+    d.set_filelist(files)
+    d.load_into_memory()
+    batches = list(d._iter_batches())
+    assert len(batches) == 2
+    b0, b1 = batches
+    assert b0["q"].shape == (2, 4)          # dataset-wide max len
+    np.testing.assert_array_equal(b0["q"][0], [11, 12, 13, 0])
+    np.testing.assert_array_equal(b0["q_seq_len"].ravel(), [3, 1])
+    np.testing.assert_array_equal(b1["q_seq_len"].ravel(), [2, 4])
+    np.testing.assert_array_equal(b1["y"].ravel(), [1.0, 0.0])
+
+
+def test_pipe_command_decompresses(tmp_path):
+    rng = np.random.default_rng(3)
+    lines, ids, _, _ = _deepfm_lines(rng, 8)
+    files = _write_files(tmp_path, lines, n_files=2, suffix=".gz")
+
+    main = framework.Program()
+    with framework.program_guard(main, framework.Program()):
+        use_vars = [layers.data("feat_ids", shape=[FIELDS], dtype="int64"),
+                    layers.data("feat_vals", shape=[FIELDS],
+                                dtype="float32"),
+                    layers.data("label", shape=[1], dtype="float32")]
+
+    d = ds.InMemoryDataset()
+    d.set_batch_size(4)
+    d.set_use_var(use_vars)
+    d.set_filelist(files)
+    d.set_pipe_command("gzip -dc")
+    d.load_into_memory()
+    got = np.concatenate([b["feat_ids"] for b in d._iter_batches()])
+    np.testing.assert_array_equal(got, ids)
+
+
+def test_queue_dataset_carries_across_files(tmp_path):
+    """Batch boundary straddles a file boundary: 10 instances over two
+    files, batch 4 -> 4+4+2 with no instance lost or reordered."""
+    rng = np.random.default_rng(4)
+    lines, ids, _, _ = _deepfm_lines(rng, 10)
+    files = [_write_files(tmp_path, lines[:7], n_files=1)[0]]
+    p2 = str(tmp_path / "part-b")
+    with open(p2, "w") as fh:
+        fh.write("\n".join(lines[7:]) + "\n")
+    files.append(p2)
+
+    main = framework.Program()
+    with framework.program_guard(main, framework.Program()):
+        use_vars = [layers.data("feat_ids", shape=[FIELDS], dtype="int64"),
+                    layers.data("feat_vals", shape=[FIELDS],
+                                dtype="float32"),
+                    layers.data("label", shape=[1], dtype="float32")]
+
+    d = ds.QueueDataset()
+    d.set_batch_size(4)
+    d.set_use_var(use_vars)
+    d.set_filelist(files)
+    sizes = []
+    got = []
+    for b in d._iter_batches():
+        sizes.append(b["feat_ids"].shape[0])
+        got.append(b["feat_ids"])
+    assert sizes == [4, 4, 2]
+    np.testing.assert_array_equal(np.concatenate(got), ids)
+
+    with pytest.raises(NotImplementedError):
+        d.local_shuffle()
+    with pytest.raises(NotImplementedError):
+        d.global_shuffle()
+
+
+def test_merge_by_lineid(tmp_path):
+    """Instances with the same ins_id merge: listed slots concatenate
+    (deduped), unlisted keep the first instance's values."""
+    lines = ["1 idA 2 1 2 1 1.0",
+             "1 idB 1 9 1 0.0",
+             "1 idA 2 2 3 1 0.5"]
+    files = _write_files(tmp_path, lines, n_files=1)
+
+    main = framework.Program()
+    with framework.program_guard(main, framework.Program()):
+        q = layers.data("q", shape=[1], dtype="int64", lod_level=1)
+        y = layers.data("y", shape=[1], dtype="float32")
+
+    d = ds.InMemoryDataset()
+    d.set_batch_size(2)
+    d.set_use_var([q, y])
+    d.set_filelist(files)
+    d.set_merge_by_lineid([q])
+    d.load_into_memory()
+    d.set_shuffle_seed(0)
+    d.global_shuffle()             # merge runs after shuffle, as upstream
+    assert d.get_memory_data_size() == 2
+    rows = {}
+    for b in d._iter_batches():
+        for r in range(b["q"].shape[0]):
+            n = int(b["q_seq_len"].ravel()[r])
+            rows[frozenset(b["q"][r, :n].tolist())] = float(
+                b["y"].ravel()[r])
+    # idA: q values {1,2} + {2,3} -> dedup {1,2,3}; y keeps one of the
+    # two merged instances' values ("first" follows the shuffle order,
+    # as in the reference's post-shuffle MergeByInsId)
+    assert frozenset({1, 2, 3}) in rows
+    assert rows[frozenset({1, 2, 3})] in (1.0, 0.5)
+    assert rows[frozenset({9})] == 0.0
+
+
+def test_native_and_python_parsers_agree(tmp_path):
+    if ds._load_df_lib() is None:
+        pytest.skip("native dataset_feed lib unavailable")
+    rng = np.random.default_rng(5)
+    lines, *_ = _deepfm_lines(rng, 12)
+    files = _write_files(tmp_path, lines, n_files=2)
+    slots = [{"name": "feat_ids", "type": "uint64", "is_dense": True},
+             {"name": "feat_vals", "type": "float", "is_dense": True},
+             {"name": "label", "type": "float", "is_dense": True}]
+    nat, _ = ds._parse_files_native(slots, files, "cat", False, False, 2)
+    py, _ = ds._parse_files_python(slots, files, "cat", False, False)
+    for (nv, nl), (pv, pl) in zip(nat, py):
+        np.testing.assert_array_equal(nl, pl)
+        np.testing.assert_allclose(nv, pv, rtol=1e-6)
+
+
+def test_bad_data_raises(tmp_path):
+    p = str(tmp_path / "bad.txt")
+    with open(p, "w") as fh:
+        fh.write("0 5 1.0\n")        # zero-count slot: reference enforces >0
+    main = framework.Program()
+    with framework.program_guard(main, framework.Program()):
+        v = layers.data("x", shape=[1], dtype="float32")
+    d = ds.InMemoryDataset()
+    d.set_use_var([v])
+    d.set_filelist([p])
+    with pytest.raises(Exception, match="zero|positive"):
+        d.load_into_memory()
+
+
+def test_datafeed_desc_roundtrip():
+    main = framework.Program()
+    with framework.program_guard(main, framework.Program()):
+        x = layers.data("x", shape=[3], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="int64")
+    d = ds.QueueDataset()
+    d.set_batch_size(16)
+    d.set_use_var([x, y])
+    text = d.desc()
+    assert 'name: "MultiSlotDataFeed"' in text
+    assert "batch_size: 16" in text
+    parsed = ds.DataFeedDesc(text)
+    parsed.set_batch_size(64)
+    assert "batch_size: 64" in parsed.desc()
+    assert 'name: "x"' in parsed.desc() and 'type: "uint64"' in parsed.desc()
+
+
+def test_factory_and_exports():
+    assert isinstance(fluid.DatasetFactory().create_dataset(),
+                      fluid.QueueDataset)
+    assert isinstance(fluid.DatasetFactory().create_dataset(
+        "InMemoryDataset"), fluid.InMemoryDataset)
+    assert isinstance(fluid.DatasetFactory().create_dataset(
+        "BoxPSDataset"), fluid.BoxPSDataset)
+    with pytest.raises(ValueError):
+        fluid.DatasetFactory().create_dataset("NoSuchDataset")
+
+
+def test_infer_from_dataset_runs(tmp_path):
+    rng = np.random.default_rng(6)
+    lines, *_ = _deepfm_lines(rng, 8)
+    files = _write_files(tmp_path, lines, n_files=1)
+
+    main, startup, loss = _build_deepfm()
+    infer_prog = main.clone(for_test=True)
+    gb = main.global_block()
+    d = ds.QueueDataset()
+    d.set_batch_size(4)
+    d.set_use_var([gb.var("feat_ids"), gb.var("feat_vals"),
+                   gb.var("label")])
+    d.set_filelist(files)
+
+    scope = Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    with scope_guard(scope):
+        exe.run(startup)
+        before = {k: np.asarray(v) for k, v in scope._vars.items()}
+        exe.infer_from_dataset(program=infer_prog, dataset=d, scope=scope)
+        after = {k: np.asarray(v) for k, v in scope._vars.items()}
+    for k in before:       # infer program must not touch params
+        np.testing.assert_array_equal(before[k], after[k])
