@@ -419,7 +419,7 @@ def _project_peak_bytes(points, batch):
 def _looks_like_oom(err):
     s = repr(err).lower()
     return ("resource_exhausted" in s or "out of memory" in s
-            or "oom" in s or "exceeds the memory" in s)
+            or "exceeds the memory" in s)
 
 
 _SWEEP = []          # completed batch results (the hard watchdog reads it)

@@ -5,6 +5,12 @@ On a TPU pod slice this uses the real chips; to try it on CPU:
 
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     JAX_PLATFORMS=cpu python examples/distributed_training.py
+
+Multi-process (each process simulating one host of a pod, rendezvous
+over localhost — fleet.init consumes the env the launcher sets):
+
+    JAX_PLATFORMS=cpu python -m paddle_tpu.distributed.launch \
+        --nproc_per_node=2 examples/distributed_training.py
 """
 
 import os
@@ -13,6 +19,17 @@ import sys
 import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+N_RANKS = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu" \
+        and "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    # each process gets its own virtual devices (4 under the launcher's
+    # multi-process mode, 8 standalone)
+    count = 4 if N_RANKS > 1 else 8
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               f" --xla_force_host_platform_device_count"
+                               f"={count}").strip()
 
 import jax                                              # noqa: E402
 if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
@@ -25,9 +42,26 @@ from paddle_tpu.parallel.mesh import make_mesh          # noqa: E402
 
 
 def main():
+    mesh = None
+    if N_RANKS > 1:
+        # launched via paddle_tpu.distributed.launch: join the cluster
+        # through the fleet bootstrap (PaddleCloud env contract), then
+        # train on fleet's DCN-aware hybrid mesh — dp spans the
+        # processes, so every process owns a shard of every step
+        from paddle_tpu.parallel import fleet as fleet_mod
+        flt = fleet_mod.Fleet()
+        flt.init()
+        mesh = flt.mesh()
+        print(f"rank {flt.worker_index()}/{flt.worker_num()} joined "
+              f"({jax.process_count()} processes, "
+              f"{len(jax.devices())} global devices, mesh "
+              f"{dict(zip(mesh.axis_names, mesh.devices.shape))})")
     n = len(jax.devices())
     dp = 2 if n % 2 == 0 else 1
     sp = 2 if n % (dp * 2) == 0 else 1
+    if mesh is not None:
+        dp = mesh.shape.get("dp", 1)
+        sp = mesh.shape.get("sp", 1)
     print(f"{n} devices -> mesh dp={dp} sp={sp}")
 
     cfg = bert.bert_tiny()
@@ -41,8 +75,9 @@ def main():
     exe = fluid.Executor(fluid.TPUPlace(0))
     exe.run(startup)
 
-    mesh = make_mesh(dp=dp, sp=sp,
-                     devices=jax.devices()[:dp * sp])
+    if mesh is None:
+        mesh = make_mesh(dp=dp, sp=sp,
+                         devices=jax.devices()[:dp * sp])
     compiled = fluid.CompiledProgram(main_prog).with_mesh(mesh)
     # with 'sp' active the attention ops dispatch to ring attention
     # automatically (K/V + padding bias rotate over the ring)

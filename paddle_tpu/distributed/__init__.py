@@ -1,0 +1,18 @@
+"""Multi-process launch utilities.
+
+Parity: python/paddle/distributed/ (launch.py, launch_ps.py). The
+launcher sets the PaddleCloud env contract consumed by
+`parallel.fleet.PaddleCloudRoleMaker` / `fleet.init`, which bootstraps
+`jax.distributed` — the TPU-native replacement for the reference's
+NCCL rendezvous over trainer endpoints.
+"""
+
+
+def __getattr__(name):
+    # lazy: `python -m paddle_tpu.distributed.launch` re-executes the
+    # module, and an eager import here would trigger runpy's
+    # found-in-sys.modules warning
+    if name in ("launch", "launch_ps"):
+        import importlib
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(name)

@@ -1,0 +1,149 @@
+"""Multi-process training launcher.
+
+Parity: python/paddle/distributed/launch.py (start_procs:132,
+launch:243). Spawns one training process per local device/rank with the
+PaddleCloud env contract (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+PADDLE_TRAINER_ENDPOINTS / PADDLE_CURRENT_ENDPOINT); inside the script,
+`fleet.init()` reads those vars and bootstraps the jax.distributed
+cluster (coordinator = endpoint 0), then builds the DCN-aware hybrid
+mesh. The reference instead passes the endpoints to a NCCL-id
+broadcast; same contract, TPU rendezvous.
+
+Usage (mirrors the reference):
+
+    python -m paddle_tpu.distributed.launch --nproc_per_node=2 \
+        examples/distributed_training.py --arg1 ...
+
+Multi-node: give every node the same --cluster_node_ips (comma list)
+and its own --node_ip; ranks are node_id * nproc_per_node + local_i.
+
+TPU notes:
+- On a real pod each HOST runs ONE process that owns all its local
+  chips (jax's one-process-per-host model), so nproc_per_node is
+  normally 1 there; >1 is the CPU-mesh/dev workflow where each process
+  simulates a host (set JAX_PLATFORMS=cpu +
+  xla_force_host_platform_device_count in the training script, as
+  examples/distributed_training.py does).
+- --selected_gpus is accepted for reference-CLI compatibility and maps
+  to per-process PADDLE_SELECTED_DEVICES (scripts may consume it; XLA
+  owns real TPU device assignment).
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+from argparse import REMAINDER
+
+
+def _parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        description="paddle_tpu multi-process launcher "
+                    "(parity: paddle.distributed.launch)")
+    parser.add_argument(
+        "--cluster_node_ips", type=str, default="127.0.0.1",
+        help="comma-separated IPs of all nodes")
+    parser.add_argument("--node_ip", type=str, default="127.0.0.1",
+                        help="this node's IP")
+    parser.add_argument(
+        "--use_paddlecloud", action="store_true",
+        help="pick node identity up from the PaddleCloud env "
+             "(PADDLE_TRAINERS / POD_IP / PADDLE_TRAINER_ID)")
+    parser.add_argument("--started_port", type=int, default=6170,
+                        help="first rendezvous port on each node")
+    parser.add_argument("--print_config", type=bool, default=True)
+    parser.add_argument(
+        "--nproc_per_node", type=int, default=None,
+        help="processes per node (default: len(--selected_gpus) or 1)")
+    parser.add_argument(
+        "--selected_gpus", "--selected_devices", dest="selected_gpus",
+        type=str, default=None,
+        help="reference-compat device list; exported per process as "
+             "PADDLE_SELECTED_DEVICES")
+    parser.add_argument("--log_dir", type=str, default=None,
+                        help="write per-process workerlog.N files here")
+    parser.add_argument("training_script", type=str)
+    parser.add_argument("training_script_args", nargs=REMAINDER)
+    return parser.parse_args(argv)
+
+
+def start_procs(args):
+    """Spawn the local worker processes and wait; raises
+    CalledProcessError on the first non-zero exit (reference
+    launch.py:132)."""
+    node_ips = [x.strip() for x in args.cluster_node_ips.split(",")]
+    current_node_ip = args.node_ip
+    node_id = node_ips.index(current_node_ip)
+    if args.use_paddlecloud:
+        # reference launch.py:143: PaddleCloud publishes identity via env
+        trainer_nums = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        if trainer_nums != 1:
+            current_node_ip = os.environ["POD_IP"]
+            node_ips = os.environ["PADDLE_TRAINERS"].split(",")
+            node_id = int(os.environ["PADDLE_TRAINER_ID"])
+
+    selected = ([x.strip() for x in args.selected_gpus.split(",")]
+                if args.selected_gpus else None)
+    nproc = args.nproc_per_node or (len(selected) if selected else 1)
+    num_nodes = len(node_ips)
+    nranks = num_nodes * nproc
+
+    endpoints = ",".join(f"{ip}:{args.started_port + i}"
+                         for ip in node_ips for i in range(nproc))
+    if args.print_config:
+        print(f"trainers_endpoints: {endpoints} , node_id: {node_id} , "
+              f"current_node_ip: {current_node_ip} , num_nodes: "
+              f"{num_nodes} , nranks: {nranks}")
+
+    # plain dicts: copy.copy(os.environ) ALIASES the live environment,
+    # so mutating it would pollute the launcher's own process
+    base_env = dict(os.environ)
+    # proxies break the rendezvous sockets (reference drops them too)
+    base_env.pop("http_proxy", None)
+    base_env.pop("https_proxy", None)
+
+    procs, cmds, log_fns = [], [], []
+    for i in range(nproc):
+        env = dict(base_env)
+        env.update({
+            "PADDLE_TRAINER_ID": str(node_id * nproc + i),
+            "PADDLE_CURRENT_ENDPOINT":
+                f"{current_node_ip}:{args.started_port + i}",
+            "PADDLE_TRAINERS_NUM": str(nranks),
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_LOCAL_RANK": str(i),
+            "PADDLE_NPROC_PER_NODE": str(nproc),
+        })
+        if selected:
+            env["PADDLE_SELECTED_DEVICES"] = selected[i % len(selected)]
+        cmd = [sys.executable, "-u", args.training_script] \
+            + args.training_script_args
+        cmds.append(cmd)
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            fn = open(os.path.join(args.log_dir, f"workerlog.{i}"), "w")
+            log_fns.append(fn)
+            procs.append(subprocess.Popen(cmd, env=env, stdout=fn,
+                                          stderr=fn))
+        else:
+            procs.append(subprocess.Popen(cmd, env=env))
+
+    failures = []
+    for i, proc in enumerate(procs):
+        proc.wait()
+        if i < len(log_fns):
+            log_fns[i].close()
+        if proc.returncode != 0:
+            failures.append((i, proc.returncode))
+    if failures:
+        i, rc = failures[0]
+        raise subprocess.CalledProcessError(returncode=rc, cmd=cmds[i])
+
+
+def launch(argv=None):
+    args = _parse_args(argv)
+    start_procs(args)
+
+
+if __name__ == "__main__":
+    launch()

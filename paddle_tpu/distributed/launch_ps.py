@@ -1,0 +1,24 @@
+"""Parameter-server launcher — documented NON-PORT.
+
+Parity target: python/paddle/distributed/launch_ps.py, which spawns
+pserver + trainer process groups for the async/geo-SGD parameter-server
+mode. TPU pods have no parameter servers: optimizer state shards across
+devices (ZeRO-1/fsdp — see parallel/transpiler.py for the documented
+re-expression of DistributeTranspiler), and all communication rides XLA
+collectives over ICI/DCN. Launch data-parallel workers with
+`python -m paddle_tpu.distributed.launch` instead; MIGRATION.md covers
+converting pserver configs.
+"""
+
+
+def launch(argv=None):
+    raise RuntimeError(
+        "paddle_tpu has no parameter-server mode: TPU training shards "
+        "optimizer state over devices (ZeRO/fsdp) instead of hosting it "
+        "on pservers. Use `python -m paddle_tpu.distributed.launch` with "
+        "fleet's DistributedStrategy (see parallel/transpiler.py and "
+        "MIGRATION.md).")
+
+
+if __name__ == "__main__":
+    launch()

@@ -26,14 +26,19 @@ from jax.sharding import PartitionSpec as P
 
 
 def main():
-    rank, port = int(sys.argv[1]), sys.argv[2]
-    # PaddleCloud contract: fleet.init reads these
-    os.environ["PADDLE_TRAINER_ID"] = str(rank)
-    os.environ["PADDLE_TRAINERS_NUM"] = "2"
-    os.environ["PADDLE_TRAINER_ENDPOINTS"] = \
-        f"127.0.0.1:{port},127.0.0.1:{int(port) + 1}"
-    os.environ["PADDLE_CURRENT_ENDPOINT"] = \
-        f"127.0.0.1:{int(port) + rank}"
+    if len(sys.argv) > 2:
+        # legacy direct-spawn mode: rank + port from argv
+        rank, port = int(sys.argv[1]), sys.argv[2]
+        os.environ["PADDLE_TRAINER_ID"] = str(rank)
+        os.environ["PADDLE_TRAINERS_NUM"] = "2"
+        os.environ["PADDLE_TRAINER_ENDPOINTS"] = \
+            f"127.0.0.1:{port},127.0.0.1:{int(port) + 1}"
+        os.environ["PADDLE_CURRENT_ENDPOINT"] = \
+            f"127.0.0.1:{int(port) + rank}"
+    else:
+        # normal mode: paddle_tpu.distributed.launch already exported
+        # the PaddleCloud contract
+        rank = int(os.environ["PADDLE_TRAINER_ID"])
 
     from paddle_tpu.parallel import fleet as fleet_mod
     from paddle_tpu.parallel import mesh as mesh_mod
@@ -65,8 +70,41 @@ def main():
     total = float(np.asarray(jax.device_get(f())).reshape(-1)[0])
     assert total == 1 + 2 + 3 + 4, total
 
+    # --- dataset global_shuffle across REAL processes: each rank loads
+    # a DIFFERENT file; after global_shuffle the union of shards must be
+    # exactly the full dataset (the DCN redistribution path)
+    import tempfile
+    from paddle_tpu.core import framework
+    from paddle_tpu import layers
+    from paddle_tpu.io import dataset as ds
+
+    with framework.program_guard(framework.Program(), framework.Program()):
+        xvar = layers.data("x", shape=[1], dtype="int64")
+    tmp = os.path.join(tempfile.gettempdir(), f"mh_ds_rank{rank}.txt")
+    base = rank * 4
+    with open(tmp, "w") as fh:
+        for v in range(base, base + 4):
+            fh.write(f"1 {v}\n")
+    d = ds.InMemoryDataset()
+    d.set_batch_size(2)
+    d.set_use_var([xvar])
+    d.set_filelist([tmp])
+    d.set_shuffle_seed(11)
+    d.load_into_memory()
+    d.global_shuffle(fleet=flt)
+    mine = sorted(int(b["x"][r, 0]) for b in d._iter_batches()
+                  for r in range(b["x"].shape[0]))
+    from jax.experimental import multihost_utils
+    counts = np.asarray(multihost_utils.process_allgather(
+        np.asarray([len(mine)], np.int32))).reshape(-1)
+    padded = np.full((8,), -1, np.int32)
+    padded[:len(mine)] = mine
+    allv = np.asarray(multihost_utils.process_allgather(padded))
+    union = sorted(int(v) for r in range(2) for v in allv[r, :counts[r]])
+    assert union == list(range(8)), f"global_shuffle lost data: {union}"
+
     flt.barrier_worker()
-    print(f"MH_OK rank={rank} total={total}")
+    print(f"MH_OK rank={rank} total={total} shard={len(mine)}")
 
 
 if __name__ == "__main__":

@@ -21,24 +21,38 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def test_two_process_fleet_cluster():
+def test_two_process_fleet_cluster(tmp_path):
+    """The 2-process cluster now bootstraps through the user-facing
+    launcher (paddle_tpu.distributed.launch — parity: reference
+    launch.py:132 start_procs), which exports the PaddleCloud env the
+    workers' fleet.init consumes."""
     port = _free_port()
     worker = os.path.join(os.path.dirname(__file__), "_mh_worker.py")
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("PADDLE_", "XLA_", "JAX_"))}
-    procs = [subprocess.Popen(
-        [sys.executable, worker, str(rank), str(port)],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
-        for rank in range(2)]
-    outs = []
+    log_dir = str(tmp_path / "logs")
+    # own session group: on timeout, killpg reaps the launcher AND its
+    # worker grandchildren (a plain kill would orphan workers holding
+    # the rendezvous port)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node=2", f"--started_port={port}",
+         f"--log_dir={log_dir}", worker],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        start_new_session=True,
+        cwd=os.path.join(os.path.dirname(__file__), "..", ".."))
     try:
-        for p in procs:
-            out, _ = p.communicate(timeout=150)
-            outs.append(out.decode())
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for rank, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"rank {rank} failed:\n{out[-2000:]}"
-        assert f"MH_OK rank={rank} total=10.0" in out, out[-2000:]
+        stdout, _ = proc.communicate(timeout=300)
+    except subprocess.TimeoutExpired:
+        os.killpg(os.getpgid(proc.pid), 9)
+        stdout, _ = proc.communicate()
+    logs = []
+    for rank in range(2):
+        p = os.path.join(log_dir, f"workerlog.{rank}")
+        logs.append(open(p).read() if os.path.exists(p) else "<missing>")
+    assert proc.returncode == 0, \
+        f"launcher failed:\n{stdout.decode()[-500:]}\n" \
+        f"w0:\n{logs[0][-1500:]}\nw1:\n{logs[1][-1500:]}"
+    for rank in range(2):
+        assert f"MH_OK rank={rank} total=10.0" in logs[rank], \
+            logs[rank][-2000:]
