@@ -417,9 +417,13 @@ def _project_peak_bytes(points, batch):
 
 
 def _looks_like_oom(err):
+    import re
     s = repr(err).lower()
+    # word-bounded "oom" catches XLA's "OOM when allocating ..." without
+    # tripping on identifiers like "bloom" in tracebacks
     return ("resource_exhausted" in s or "out of memory" in s
-            or "exceeds the memory" in s)
+            or "exceeds the memory" in s
+            or re.search(r"\boom\b", s) is not None)
 
 
 _SWEEP = []          # completed batch results (the hard watchdog reads it)

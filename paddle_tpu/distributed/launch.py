@@ -85,6 +85,10 @@ def start_procs(args):
     selected = ([x.strip() for x in args.selected_gpus.split(",")]
                 if args.selected_gpus else None)
     nproc = args.nproc_per_node or (len(selected) if selected else 1)
+    if selected and nproc > len(selected):
+        raise ValueError(
+            f"--nproc_per_node={nproc} exceeds the {len(selected)} "
+            f"devices in --selected_gpus (a rank per device, no sharing)")
     num_nodes = len(node_ips)
     nranks = num_nodes * nproc
 
@@ -128,15 +132,39 @@ def start_procs(args):
         else:
             procs.append(subprocess.Popen(cmd, env=env))
 
-    failures = []
-    for i, proc in enumerate(procs):
-        proc.wait()
-        if i < len(log_fns):
-            log_fns[i].close()
-        if proc.returncode != 0:
-            failures.append((i, proc.returncode))
-    if failures:
-        i, rc = failures[0]
+    # poll ALL ranks: one dead rank leaves peers blocked inside an XLA
+    # collective forever, so on first failure terminate the survivors
+    # instead of waiting on them in index order
+    import time
+    first_fail = None
+    try:
+        while any(p.poll() is None for p in procs):
+            for i, p in enumerate(procs):
+                if p.poll() is not None and p.returncode != 0:
+                    first_fail = (i, p.returncode)
+                    break
+            if first_fail:
+                break
+            time.sleep(0.2)
+    finally:
+        if first_fail:
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        for fn in log_fns:
+            fn.close()
+    if first_fail is None:
+        for i, p in enumerate(procs):
+            if p.returncode != 0:
+                first_fail = (i, p.returncode)
+                break
+    if first_fail:
+        i, rc = first_fail
         raise subprocess.CalledProcessError(returncode=rc, cmd=cmds[i])
 
 

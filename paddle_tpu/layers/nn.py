@@ -278,15 +278,37 @@ def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
     return out
 
 
+def _adaptive_pool(op_type, input, psize, pool_type, require_index, name):
+    """Shared adaptive_pool2d/3d body: validation per the reference
+    contract (ref nn.py:3140-3148) + optional argmax Mask output."""
+    if pool_type not in ("max", "avg"):
+        raise ValueError(
+            "Unknown pool_type: '%s'. It can only be 'max' or 'avg'."
+            % str(pool_type))
+    if pool_type == "avg" and require_index:
+        raise ValueError(
+            "invalid setting 'require_index' true when 'pool_type' is "
+            "'avg'.")
+    helper = LayerHelper(op_type, name=name)
+    shape = tuple(input.shape[:2]) + tuple(psize)
+    out = helper.create_variable_for_type_inference(input.dtype, shape)
+    outs = {"Out": out}
+    if require_index:
+        mask = helper.create_variable_for_type_inference("int32", shape)
+        outs["Mask"] = mask
+    helper.append_op(op_type, {"X": input}, outs,
+                     {"pool_size": list(psize), "pooling_type": pool_type,
+                      "require_index": require_index})
+    return (out, outs["Mask"]) if require_index else out
+
+
 def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
                     name=None):
-    helper = LayerHelper("adaptive_pool2d", name=name)
-    psize = _pair(pool_size)
-    out_shape = tuple(input.shape[:2]) + tuple(psize)
-    out = helper.create_variable_for_type_inference(input.dtype, out_shape)
-    helper.append_op("adaptive_pool2d", {"X": input}, {"Out": out},
-                     {"pool_size": psize, "pooling_type": pool_type})
-    return out
+    """Parity: fluid.layers.adaptive_pool2d (ref nn.py:3069): floor/ceil
+    windows; require_index additionally returns the argmax Mask (flat
+    index into the input plane) and is invalid for avg pooling."""
+    return _adaptive_pool("adaptive_pool2d", input, _pair(pool_size),
+                          pool_type, require_index, name)
 
 
 def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
@@ -1162,11 +1184,21 @@ def fsp_matrix(x, y):
 
 def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
                 out_stride=1, name=None):
-    return _simple_layer("im2sequence", {"X": input},
-                         {"kernels": _pair(filter_size),
-                          "strides": _pair(stride),
-                          "paddings": _pair(padding, 4)},
-                         helper_name="im2sequence")
+    """Parity: fluid.layers.im2sequence (ref nn.py:6375). Out rows are
+    image windows ((N*oh*ow, C*kh*kw)); the uniform per-image step count
+    rides the op's Length output (static shapes make the LoD uniform)."""
+    helper = LayerHelper("im2sequence", name=name)
+    ins = {"X": input}
+    if input_image_size is not None:
+        ins["Y"] = input_image_size        # op raises: dynamic windows
+    out = helper.create_variable_for_type_inference(input.dtype)
+    length = helper.create_variable_for_type_inference("int32")
+    helper.append_op("im2sequence", ins, {"Out": out, "Length": length},
+                     {"kernels": _pair(filter_size),
+                      "strides": _pair(stride),
+                      "paddings": _pair(padding, 4),
+                      "out_stride": _pair(out_stride)})
+    return out
 
 
 def deformable_conv(input, offset, mask, num_filters, filter_size, stride=1,
@@ -1252,15 +1284,12 @@ def shuffle_channel(x, group, name=None):
 
 def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
                     name=None):
-    """Parity: fluid.layers.adaptive_pool3d (NCDHW)."""
-    helper = LayerHelper("adaptive_pool3d", name=name)
+    """Parity: fluid.layers.adaptive_pool3d (NCDHW); require_index as in
+    adaptive_pool2d (invalid with avg, returns (out, mask))."""
     ps = list(pool_size) if isinstance(pool_size, (list, tuple)) \
         else [pool_size] * 3
-    out = helper.create_variable_for_type_inference(
-        input.dtype, tuple(input.shape[:2]) + tuple(ps))
-    helper.append_op("adaptive_pool3d", {"X": input}, {"Out": out},
-                     {"pool_size": ps, "pooling_type": pool_type})
-    return out
+    return _adaptive_pool("adaptive_pool3d", input, ps, pool_type,
+                          require_index, name)
 
 
 def resize_trilinear(input, out_shape=None, scale=None, name=None,

@@ -57,28 +57,50 @@ def conv3d(ctx):
     return {"Output": out, "Out": out}
 
 
+def _conv_transpose_nd(x, w, strides, pads, dilations, groups, nd):
+    """Transposed conv, any groups. Fluid filter layout is
+    (C_in, C_out/g, *k) — the forward-conv kernel of the op this
+    transposes. The explicit padding of the dilated conv is (k-1)*d - p
+    per side, which yields out = (in-1)*s - 2p + (k-1)*d + 1 (the
+    reference conv_transpose_op.cc formula).
+
+    groups == 1 rides lax.conv_transpose(transpose_kernel=True); for
+    groups > 1 (which conv_transpose doesn't support) we emit the
+    gradient-of-conv directly: swap O/I inside each group, flip spatial,
+    and run conv_general_dilated with lhs_dilation = strides and
+    feature_group_count = groups — the same XLA HLO the autodiff of a
+    grouped forward conv produces."""
+    spatial_names = "DHW"[3 - nd:]
+    dn_str = ("NC" + spatial_names, "OI" + spatial_names,
+              "NC" + spatial_names)
+    tpads = [dilations[i] * (w.shape[2 + i] - 1) - pads[i]
+             for i in range(nd)]
+    if groups == 1:
+        dn = lax.conv_dimension_numbers(x.shape, w.shape, dn_str)
+        return lax.conv_transpose(
+            x, w, strides=strides, padding=[(p, p) for p in tpads],
+            rhs_dilation=dilations, dimension_numbers=dn,
+            transpose_kernel=True)
+    cin, coutg = w.shape[0], w.shape[1]
+    k = w.shape[2:]
+    wk = w.reshape((groups, cin // groups, coutg) + k)
+    wk = jnp.swapaxes(wk, 1, 2).reshape((groups * coutg, cin // groups) + k)
+    wk = jnp.flip(wk, axis=tuple(range(2, 2 + nd)))
+    dn = lax.conv_dimension_numbers(x.shape, wk.shape, dn_str)
+    return lax.conv_general_dilated(
+        x, wk, window_strides=(1,) * nd, padding=[(p, p) for p in tpads],
+        lhs_dilation=strides, rhs_dilation=dilations,
+        dimension_numbers=dn, feature_group_count=groups)
+
+
 @register("conv2d_transpose")
 def conv2d_transpose(ctx):
     x, w = ctx.in_("Input"), ctx.in_("Filter")  # w: [C_in, C_out/g, kH, kW]
-    strides = _pair(ctx.attr("strides", [1, 1]))
-    pads = _pair(ctx.attr("paddings", [0, 0]))
-    dilations = _pair(ctx.attr("dilations", [1, 1]))
-    groups = ctx.attr("groups", 1) or 1
-    # Fluid filter layout is (C_in, C_out/g, kH, kW) — the forward-conv
-    # kernel of the op this transposes, i.e. OIHW with O == lhs features.
-    # transpose_kernel=True makes conv_transpose swap O/I and flip spatial,
-    # exactly the gradient-of-conv semantics the reference kernel implements.
-    # The explicit padding of the dilated conv is (k-1)*d - p per side, which
-    # yields out = (in-1)*s - 2p + (k-1)*d + 1 (the reference's formula).
-    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
-    tpads = [dilations[i] * (w.shape[2 + i] - 1) - pads[i] for i in range(2)]
-    out = lax.conv_transpose(
-        x, w, strides=strides,
-        padding=[(tpads[0], tpads[0]), (tpads[1], tpads[1])],
-        rhs_dilation=dilations, dimension_numbers=dn,
-        transpose_kernel=True)
-    if groups != 1:
-        raise NotImplementedError("grouped conv2d_transpose")
+    out = _conv_transpose_nd(
+        x, w, _pair(ctx.attr("strides", [1, 1])),
+        _pair(ctx.attr("paddings", [0, 0])),
+        _pair(ctx.attr("dilations", [1, 1])),
+        ctx.attr("groups", 1) or 1, nd=2)
     if ctx.has_in("Bias"):
         out = out + ctx.in_("Bias").reshape(1, -1, 1, 1)
     return {"Output": out, "Out": out}
@@ -134,17 +156,60 @@ def pool3d(ctx):
     return {"Out": out}
 
 
+def _adaptive_bounds(n_in, n_out):
+    """floor/ceil window bounds of the reference adaptive pooling
+    (nn.py:3082: hstart=floor(i*H/out), hend=ceil((i+1)*H/out)). Static
+    Python ints — every window slice below is a static XLA slice."""
+    return [(i * n_in // n_out, -((-(i + 1) * n_in) // n_out))
+            for i in range(n_out)]
+
+
+def _adaptive_pool2d_vals(x, oh, ow, pool_type, want_index):
+    n, c, h, w = x.shape
+    if h % oh == 0 and w % ow == 0 and not want_index:
+        # uniform windows: one reshape-reduce (the MXU-friendly path)
+        kh, kw = h // oh, w // ow
+        v = x.reshape(n, c, oh, kh, ow, kw)
+        return (v.max(axis=(3, 5)) if pool_type == "max"
+                else v.mean(axis=(3, 5))), None
+    rows_out, rows_idx = [], []
+    for hs, he in _adaptive_bounds(h, oh):
+        cols_out, cols_idx = [], []
+        for ws, we in _adaptive_bounds(w, ow):
+            win = x[:, :, hs:he, ws:we]
+            if pool_type == "avg":
+                cols_out.append(win.mean(axis=(2, 3)))
+                continue
+            flat = win.reshape(n, c, -1)
+            cols_out.append(flat.max(axis=-1))
+            if want_index:
+                am = jnp.argmax(flat, axis=-1)
+                ww = we - ws
+                # reference mask: flat index into the input H*W plane
+                cols_idx.append((hs + am // ww) * w + (ws + am % ww))
+        rows_out.append(jnp.stack(cols_out, axis=-1))
+        if cols_idx:
+            rows_idx.append(jnp.stack(cols_idx, axis=-1))
+    out = jnp.stack(rows_out, axis=-2)
+    idx = jnp.stack(rows_idx, axis=-2) if rows_idx else None
+    return out, idx
+
+
 @register("adaptive_pool2d")
 def adaptive_pool2d(ctx):
+    """Parity: pool2d(adaptive=True) / max_pool2d_with_index(adaptive).
+    Non-divisible sizes use the reference's floor/ceil (possibly
+    overlapping) windows; require_index returns the argmax position as
+    a flat index into the input plane (ref pool_with_index_op)."""
     x = ctx.in_("X")
     oh, ow = _pair(ctx.attr("pool_size"))
-    n, c, h, w = x.shape
-    # TPU-friendly: require divisibility (reference kernels special-case too)
-    kh, kw = h // oh, w // ow
-    x = x.reshape(n, c, oh, kh, ow, kw)
-    if ctx.attr("pooling_type", "avg") == "max":
-        return {"Out": x.max(axis=(3, 5))}
-    return {"Out": x.mean(axis=(3, 5))}
+    ptype = ctx.attr("pooling_type", "avg")
+    want_index = bool(ctx.attr("require_index", False))
+    out, idx = _adaptive_pool2d_vals(x, oh, ow, ptype, want_index)
+    res = {"Out": out}
+    if idx is not None:
+        res["Mask"] = idx.astype(jnp.int32)
+    return res
 
 
 @register("batch_norm")
@@ -376,9 +441,41 @@ def grid_sampler(ctx):
     return {"Output": jnp.moveaxis(out, -1, 1)}
 
 
-@register("pad_hwc", "im2sequence")
+@register("im2sequence")
 def im2sequence(ctx):
-    raise NotImplementedError("im2sequence: use unfold")
+    """Parity: im2sequence_op.h Im2SequenceKernel — scan the image with
+    a filter and emit one sequence step per window position, each step
+    being the (C, kh, kw)-flattened patch. Output rows are
+    batch-major/row-major windows: shape (N * oh * ow, C*kh*kw); with
+    every image the same static size the LoD is uniform (oh*ow steps
+    per image), emitted as the companion Length output. out_size =
+    (img + p0 + p1 - filter)/stride + 1 (im2sequence_op.h:30).
+
+    The reference's input_image_size batch-inference mode implies
+    per-sample dynamic window counts — incompatible with static XLA
+    shapes (SURVEY §1 decision 4); it raises with a pad+mask pointer."""
+    if ctx.has_in("Y"):
+        raise NotImplementedError(
+            "im2sequence(input_image_size=...) needs per-sample dynamic "
+            "window counts; pad images to one static size instead "
+            "(SURVEY §1 decision 4)")
+    x = ctx.in_("X")  # NCHW
+    n, c, h, w = x.shape
+    k = _pair(ctx.attr("kernels"))
+    s = _pair(ctx.attr("strides", [1, 1]))
+    p = ctx.attr("paddings", [0, 0, 0, 0])
+    # paddings = (up, left, down, right)
+    ph = (p[0], p[2] if len(p) > 2 else p[0])
+    pw = (p[1], p[3] if len(p) > 3 else p[1])
+    dn = lax.conv_dimension_numbers(x.shape, (1, c) + tuple(k),
+                                    ("NCHW", "OIHW", "NCHW"))
+    patches = lax.conv_general_dilated_patches(
+        x, k, s, [ph, pw], dimension_numbers=dn)  # (N, C*kh*kw, oh, ow)
+    steps = patches.shape[2] * patches.shape[3]
+    out = patches.reshape(n, c * k[0] * k[1], steps)
+    out = jnp.swapaxes(out, 1, 2).reshape(n * steps, c * k[0] * k[1])
+    return {"Out": out,
+            "Length": jnp.full((n,), steps, jnp.int32)}
 
 
 @register("unfold")
@@ -402,17 +499,11 @@ def conv3d_transpose(ctx):
     """Filter layout (C_in, C_out/g, kD, kH, kW) — same gradient-of-conv
     semantics as conv2d_transpose above (reference: conv_transpose_op.cc)."""
     x, w = ctx.in_("Input"), ctx.in_("Filter")
-    strides = _pair(ctx.attr("strides", [1, 1, 1]), 3)
-    pads = _pair(ctx.attr("paddings", [0, 0, 0]), 3)
-    dilations = _pair(ctx.attr("dilations", [1, 1, 1]), 3)
-    if (ctx.attr("groups", 1) or 1) != 1:
-        raise NotImplementedError("grouped conv3d_transpose")
-    dn = lax.conv_dimension_numbers(x.shape, w.shape,
-                                    ("NCDHW", "OIDHW", "NCDHW"))
-    tpads = [dilations[i] * (w.shape[2 + i] - 1) - pads[i] for i in range(3)]
-    out = lax.conv_transpose(
-        x, w, strides=strides, padding=[(p, p) for p in tpads],
-        rhs_dilation=dilations, dimension_numbers=dn, transpose_kernel=True)
+    out = _conv_transpose_nd(
+        x, w, _pair(ctx.attr("strides", [1, 1, 1]), 3),
+        _pair(ctx.attr("paddings", [0, 0, 0]), 3),
+        _pair(ctx.attr("dilations", [1, 1, 1]), 3),
+        ctx.attr("groups", 1) or 1, nd=3)
     if ctx.has_in("Bias"):
         out = out + ctx.in_("Bias").reshape(1, -1, 1, 1, 1)
     return {"Output": out, "Out": out}
@@ -525,16 +616,108 @@ def deformable_conv(ctx):
 
 @register("adaptive_pool3d")
 def adaptive_pool3d(ctx):
-    """Parity: adaptive_pool3d_op (NCDHW). Divisibility required, same as
-    the 2-D variant — the reference kernels special-case this path too."""
+    """Parity: pool3d(adaptive=True) / max_pool3d_with_index (NCDHW);
+    floor/ceil windows, optional argmax Mask as flat index into the
+    input D*H*W volume."""
     x = ctx.in_("X")
     od, oh, ow = ctx.attr("pool_size")
+    ptype = ctx.attr("pooling_type", "avg")
+    want_index = bool(ctx.attr("require_index", False))
     n, c, d, h, w = x.shape
-    kd, kh, kw = d // od, h // oh, w // ow
-    x = x.reshape(n, c, od, kd, oh, kh, ow, kw)
-    if ctx.attr("pooling_type", "avg") == "max":
-        return {"Out": x.max(axis=(3, 5, 7))}
-    return {"Out": x.mean(axis=(3, 5, 7))}
+    if d % od == 0 and h % oh == 0 and w % ow == 0 and not want_index:
+        kd, kh, kw = d // od, h // oh, w // ow
+        v = x.reshape(n, c, od, kd, oh, kh, ow, kw)
+        return {"Out": (v.max(axis=(3, 5, 7)) if ptype == "max"
+                        else v.mean(axis=(3, 5, 7)))}
+    outs, idxs = [], []
+    for ds_, de in _adaptive_bounds(d, od):
+        for hs, he in _adaptive_bounds(h, oh):
+            for ws, we in _adaptive_bounds(w, ow):
+                win = x[:, :, ds_:de, hs:he, ws:we]
+                if ptype == "avg":
+                    outs.append(win.mean(axis=(2, 3, 4)))
+                    continue
+                flat = win.reshape(n, c, -1)
+                outs.append(flat.max(axis=-1))
+                if want_index:
+                    am = jnp.argmax(flat, axis=-1)
+                    wh, ww = he - hs, we - ws
+                    ld = am // (wh * ww)
+                    lh = (am // ww) % wh
+                    lw = am % ww
+                    idxs.append((ds_ + ld) * h * w + (hs + lh) * w
+                                + (ws + lw))
+    out = jnp.stack(outs, axis=-1).reshape(n, c, od, oh, ow)
+    res = {"Out": out}
+    if idxs:
+        res["Mask"] = jnp.stack(idxs, axis=-1).reshape(
+            n, c, od, oh, ow).astype(jnp.int32)
+    return res
+
+
+@register("max_pool2d_with_index")
+def max_pool2d_with_index(ctx):
+    """Parity: pool_with_index_op — max pooling that also returns the
+    argmax as a flat index into the (unpadded) input plane; the
+    input half of the max_pool/unpool pair. adaptive=True delegates to
+    the adaptive windows above (that is how fluid.layers.adaptive_pool2d
+    lowers max pooling, ref nn.py:3152)."""
+    x = ctx.in_("X")
+    n, c, h, w = x.shape
+    ksize = _pair(ctx.attr("ksize"))
+    if ctx.attr("adaptive", False):
+        out, idx = _adaptive_pool2d_vals(x, ksize[0], ksize[1], "max", True)
+        return {"Out": out, "Mask": idx.astype(jnp.int32)}
+    strides = _pair(ctx.attr("strides", ksize))
+    pads = _pair(ctx.attr("paddings", [0, 0]))
+    if ctx.attr("global_pooling", False):
+        ksize, strides, pads = (h, w), (h, w), (0, 0)
+    kh, kw = ksize
+    # large finite negative, NOT -inf: the patches extraction is a conv
+    # with a 0/1 kernel and 0 * -inf would poison windows with NaN
+    neg = jnp.asarray(jnp.finfo(x.dtype).min / 2, x.dtype)
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pads[0], pads[0]),
+                     (pads[1], pads[1])), constant_values=neg)
+    dn = lax.conv_dimension_numbers(xp.shape, (1, c) + tuple(ksize),
+                                    ("NCHW", "OIHW", "NCHW"))
+    pv = lax.conv_general_dilated_patches(
+        xp, ksize, strides, "VALID", dimension_numbers=dn)
+    oh_, ow_ = pv.shape[2], pv.shape[3]
+    pv = pv.reshape(n, c, kh * kw, oh_, ow_)
+    am = jnp.argmax(pv, axis=2)
+    out = jnp.max(pv, axis=2)
+    # integer index math (a float index map would corrupt planes with
+    # h*w > 2^24): window origin + argmax offset, in input coordinates
+    oi = jnp.arange(oh_, dtype=jnp.int32)[:, None] * strides[0] - pads[0]
+    oj = jnp.arange(ow_, dtype=jnp.int32)[None, :] * strides[1] - pads[1]
+    gh = oi[None, None] + (am // kw).astype(jnp.int32)
+    gw = oj[None, None] + (am % kw).astype(jnp.int32)
+    return {"Out": out, "Mask": gh * w + gw}
+
+
+@register("unpool")
+def unpool(ctx):
+    """Parity: unpool_op (max unpooling): scatter pooled values back to
+    the argmax positions recorded by max_pool2d_with_index; everything
+    else is zero. Output spatial size = (in-1)*stride - 2*pad + ksize
+    (or the explicit output_size attr)."""
+    x, idx = ctx.in_("X"), ctx.in_("Indices")
+    n, c, ph, pw = x.shape
+    ksize = _pair(ctx.attr("ksize"))
+    strides = _pair(ctx.attr("strides", ksize))
+    pads = _pair(ctx.attr("paddings", [0, 0]))
+    osize = ctx.attr("output_size", None)
+    if osize:
+        oh_, ow_ = osize[-2], osize[-1]
+    else:
+        oh_ = (ph - 1) * strides[0] - 2 * pads[0] + ksize[0]
+        ow_ = (pw - 1) * strides[1] - 2 * pads[1] + ksize[1]
+    flat = jnp.zeros((n, c, oh_ * ow_), x.dtype)
+    ni = jnp.arange(n)[:, None, None]
+    ci = jnp.arange(c)[None, :, None]
+    flat = flat.at[ni, ci, idx.reshape(n, c, -1).astype(jnp.int32)].set(
+        x.reshape(n, c, -1))
+    return {"Out": flat.reshape(n, c, oh_, ow_)}
 
 
 @register("bilinear_tensor_product")

@@ -1,0 +1,178 @@
+"""Parity tests for the pooling-index family and grouped transposed
+convs (VERDICT r2 item 4): adaptive_pool2d/3d require_index,
+max_pool2d_with_index + unpool, grouped conv2d/conv3d_transpose,
+im2sequence. Goldens come from torch-cpu (same argmax/window
+conventions as the reference kernels) and from the reference
+im2sequence docstring example (ref nn.py:6474)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+import torch
+import torch.nn.functional as F
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+RS = np.random.RandomState(7)
+
+
+def _run(outs, feeds, scope_sets=None):
+    outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    for k, v in (scope_sets or {}).items():
+        fluid.global_scope().set(k, jnp.asarray(v))
+    return exe.run(feed=feeds, fetch_list=list(outs))
+
+
+@pytest.mark.parametrize("hw,osize", [((8, 8), (2, 2)), ((7, 5), (3, 2)),
+                                      ((6, 9), (4, 4))])
+def test_adaptive_max_pool2d_with_index(hw, osize):
+    x = RS.randn(2, 3, *hw).astype(np.float32)
+    xv = layers.data("x", shape=[3, *hw], dtype="float32")
+    out, mask = layers.adaptive_pool2d(xv, list(osize), pool_type="max",
+                                       require_index=True)
+    got, gm = _run([out, mask], {"x": x})
+    want, wm = F.adaptive_max_pool2d(torch.from_numpy(x), osize,
+                                     return_indices=True)
+    np.testing.assert_allclose(got, want.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(gm, wm.numpy())
+
+
+@pytest.mark.parametrize("hw,osize", [((7, 5), (3, 2)), ((10, 10), (3, 3))])
+def test_adaptive_avg_pool2d_nondivisible(hw, osize):
+    x = RS.randn(2, 3, *hw).astype(np.float32)
+    xv = layers.data("x", shape=[3, *hw], dtype="float32")
+    out = layers.adaptive_pool2d(xv, list(osize), pool_type="avg")
+    got, = _run(out, {"x": x})
+    want = F.adaptive_avg_pool2d(torch.from_numpy(x), osize)
+    np.testing.assert_allclose(got, want.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_adaptive_pool2d_avg_with_index_raises():
+    xv = layers.data("x", shape=[3, 8, 8], dtype="float32")
+    with pytest.raises(ValueError, match="require_index"):
+        layers.adaptive_pool2d(xv, 2, pool_type="avg", require_index=True)
+    with pytest.raises(ValueError, match="pool_type"):
+        layers.adaptive_pool2d(xv, 2, pool_type="mean")
+
+
+def test_adaptive_max_pool3d_with_index():
+    x = RS.randn(2, 2, 5, 7, 6).astype(np.float32)
+    xv = layers.data("x", shape=[2, 5, 7, 6], dtype="float32")
+    out, mask = layers.adaptive_pool3d(xv, [2, 3, 2], pool_type="max",
+                                       require_index=True)
+    got, gm = _run([out, mask], {"x": x})
+    want, wm = F.adaptive_max_pool3d(torch.from_numpy(x), (2, 3, 2),
+                                     return_indices=True)
+    np.testing.assert_allclose(got, want.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(gm, wm.numpy())
+
+
+@pytest.mark.parametrize("k,s,p", [(2, 2, 0), (3, 2, 1), (3, 1, 1)])
+def test_max_pool2d_with_index_and_unpool_roundtrip(k, s, p):
+    """max_pool2d_with_index matches torch (values + flat indices), and
+    unpool scatters back exactly like torch.max_unpool2d."""
+    from paddle_tpu.core.layer_helper import LayerHelper
+
+    x = RS.randn(2, 3, 8, 8).astype(np.float32)
+    xv = layers.data("x", shape=[3, 8, 8], dtype="float32")
+    helper = LayerHelper("max_pool2d_with_index")
+    out = helper.create_variable_for_type_inference("float32")
+    mask = helper.create_variable_for_type_inference("int32")
+    helper.append_op("max_pool2d_with_index", {"X": xv},
+                     {"Out": out, "Mask": mask},
+                     {"ksize": [k, k], "strides": [s, s],
+                      "paddings": [p, p]})
+    unp = helper.create_variable_for_type_inference("float32")
+    helper.append_op("unpool", {"X": out, "Indices": mask}, {"Out": unp},
+                     {"ksize": [k, k], "strides": [s, s],
+                      "paddings": [p, p], "output_size": [8, 8]})
+    got, gm, gu = _run([out, mask, unp], {"x": x})
+
+    t = torch.from_numpy(x)
+    want, wm = F.max_pool2d(t, k, stride=s, padding=p, return_indices=True)
+    np.testing.assert_allclose(got, want.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(gm, wm.numpy())
+    wu = F.max_unpool2d(want, wm, k, stride=s, padding=p,
+                        output_size=(8, 8))
+    np.testing.assert_allclose(gu, wu.numpy(), rtol=1e-6)
+
+
+@pytest.mark.parametrize("groups,stride,pad,dil", [
+    (2, 1, 0, 1), (3, 2, 1, 1), (2, 2, 1, 2)])
+def test_grouped_conv2d_transpose(groups, stride, pad, dil):
+    cin, coutg, kk = 6, 2, 3
+    x = RS.randn(2, cin, 7, 7).astype(np.float32)
+    w = RS.randn(cin, coutg, kk, kk).astype(np.float32)
+    xv = layers.data("x", shape=[cin, 7, 7], dtype="float32")
+    out = layers.conv2d_transpose(
+        xv, num_filters=coutg * groups, filter_size=kk, stride=stride,
+        padding=pad, dilation=dil, groups=groups, bias_attr=False,
+        param_attr=fluid.ParamAttr(name="wt"))
+    got, = _run(out, {"x": x}, scope_sets={"wt": w})
+    want = F.conv_transpose2d(torch.from_numpy(x), torch.from_numpy(w),
+                              stride=stride, padding=pad, dilation=dil,
+                              groups=groups)
+    np.testing.assert_allclose(got, want.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_grouped_conv3d_transpose():
+    cin, coutg, kk, g = 4, 3, 2, 2
+    x = RS.randn(1, cin, 4, 5, 4).astype(np.float32)
+    w = RS.randn(cin, coutg, kk, kk, kk).astype(np.float32)
+    xv = layers.data("x", shape=[cin, 4, 5, 4], dtype="float32")
+    out = layers.conv3d_transpose(
+        xv, num_filters=coutg * g, filter_size=kk, stride=2, padding=1,
+        groups=g, bias_attr=False, param_attr=fluid.ParamAttr(name="w3"))
+    got, = _run(out, {"x": x}, scope_sets={"w3": w})
+    want = F.conv_transpose3d(torch.from_numpy(x), torch.from_numpy(w),
+                              stride=2, padding=1, groups=g)
+    np.testing.assert_allclose(got, want.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_im2sequence_reference_example():
+    """The exact worked example from the reference docstring
+    (ref nn.py:6440-6478): 2x2x3x3 input, 2x2 filter, stride 1."""
+    x = np.array(
+        [[[[6., 2., 1.], [8., 3., 5.], [0., 2., 6.]],
+          [[2., 4., 4.], [6., 3., 0.], [6., 4., 7.]]],
+         [[[6., 7., 1.], [5., 7., 9.], [2., 4., 8.]],
+          [[1., 2., 1.], [1., 3., 5.], [9., 0., 8.]]]], np.float32)
+    xv = layers.data("x", shape=[2, 3, 3], dtype="float32")
+    out = layers.im2sequence(xv, filter_size=[2, 2], stride=[1, 1],
+                             padding=[0, 0, 0, 0])
+    got, = _run(out, {"x": x})
+    want = np.array(
+        [[6., 2., 8., 3., 2., 4., 6., 3.],
+         [2., 1., 3., 5., 4., 4., 3., 0.],
+         [8., 3., 0., 2., 6., 3., 6., 4.],
+         [3., 5., 2., 6., 3., 0., 4., 7.],
+         [6., 7., 5., 7., 1., 2., 1., 3.],
+         [7., 1., 7., 9., 2., 1., 3., 5.],
+         [5., 7., 2., 4., 1., 3., 9., 0.],
+         [7., 9., 4., 8., 3., 5., 0., 8.]], np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_im2sequence_padded_strided():
+    """Non-trivial stride/padding vs a numpy sliding-window golden."""
+    n, c, h, w = 2, 3, 5, 6
+    k, s, p = (2, 3), (2, 2), (1, 0, 1, 0)
+    x = RS.randn(n, c, h, w).astype(np.float32)
+    xv = layers.data("x", shape=[c, h, w], dtype="float32")
+    out = layers.im2sequence(xv, filter_size=list(k), stride=list(s),
+                             padding=list(p))
+    got, = _run(out, {"x": x})
+    xp = np.pad(x, ((0, 0), (0, 0), (p[0], p[2]), (p[1], p[3])))
+    oh = (xp.shape[2] - k[0]) // s[0] + 1
+    ow = (xp.shape[3] - k[1]) // s[1] + 1
+    rows = []
+    for b in range(n):
+        for i in range(oh):
+            for j in range(ow):
+                rows.append(xp[b, :, i * s[0]:i * s[0] + k[0],
+                               j * s[1]:j * s[1] + k[1]].ravel())
+    np.testing.assert_allclose(got, np.stack(rows), rtol=1e-6)
