@@ -134,28 +134,38 @@ class Executor:
     def close(self):
         self._cache.clear()
         self._last_call = None
-        self._compiled_memo = {}
+        self._compiled_pair = None
 
     def _last_compiled(self):
-        """AOT-compiled object for the most recent step, memoized per
-        cached step_fn — lower().compile() would otherwise re-trace and
-        re-pay the full XLA compile (~20-40s for the big models) on every
-        introspection call."""
+        """AOT-compiled object for the most recent step, memoized for
+        the CURRENT step_fn only — lower().compile() would otherwise
+        re-pay the full XLA compile (~20-40s for the big models) on
+        every introspection call, and keeping more than one executable
+        leaks them across programs. Identity-compared against the live
+        step_fn (an id() key could alias a recycled address)."""
         if self._last_call is None:
             raise RuntimeError("no program has been run yet")
         step_fn, args = self._last_call
-        memo = getattr(self, "_compiled_memo", None)
-        if memo is None:
-            memo = self._compiled_memo = {}
-        compiled = memo.get(id(step_fn))
-        if compiled is None:
-            compiled = memo[id(step_fn)] = step_fn.lower(*args).compile()
-        return compiled
+        pair = getattr(self, "_compiled_pair", None)
+        if pair is None or pair[0] is not step_fn:
+            self._compiled_pair = (step_fn, step_fn.lower(*args).compile())
+        return self._compiled_pair[1]
 
     def last_compiled_text(self):
         """Optimized HLO of the most recent step executable (post-XLA-opt;
         what actually ran). Used by bench.py's self-audit and kernel tests."""
         return self._last_compiled().as_text()
+
+    def last_lowered_text(self):
+        """StableHLO of the most recent step BEFORE backend optimization.
+        Backend-independent: bf16 dot operand types and remat's duplicated
+        computation are still visible here, where the CPU backend's
+        legalization (bf16->f32 upcast) and CSE would erase them from the
+        optimized text. Used by tests/perf/ HLO audits."""
+        if self._last_call is None:
+            raise RuntimeError("no program has been run yet")
+        step_fn, args = self._last_call
+        return step_fn.lower(*args).as_text()
 
     def last_cost_analysis(self):
         """XLA's own cost model for the most recent step executable:

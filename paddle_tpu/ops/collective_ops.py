@@ -107,3 +107,17 @@ def alltoall(ctx):
 def c_sync(ctx):
     # XLA schedules compute/comm overlap itself; sync is a no-op by design.
     return {"Out": ctx.in_("X")}
+
+
+@register("moe")
+def moe(ctx):
+    """Framework-level Mixture-of-Experts FFN (expert parallelism over
+    the mesh 'ep' axis via all_to_all dispatch; dense all-experts-local
+    fallback off-mesh). The TPU re-expression of the reference's
+    conditional-compute scale story — see parallel/moe.py moe_apply."""
+    from ..parallel.moe import moe_apply
+
+    out, aux = moe_apply(
+        ctx.in_("X"), ctx.in_("GateW"), ctx.in_("WUp"), ctx.in_("WDown"),
+        capacity_factor=ctx.attr("capacity_factor", 1.25))
+    return {"Out": out, "AuxLoss": aux.reshape(1)}

@@ -53,6 +53,60 @@ def expert_parallel_combine(y, dispatch_info, gate, num_experts, capacity,
     return picked * (gate * ok)[:, None]
 
 
+def _active_ep_mesh(tokens, num_experts):
+    """The executor-activated mesh, when expert parallelism applies:
+    an 'ep' axis > 1 that divides both the token count and the expert
+    count. Anything else returns None (dense fallback, never crashes).
+    Mirrors ops/attention_ops._active_sp_mesh."""
+    try:
+        from jax._src import mesh as mesh_lib
+        mesh = mesh_lib.thread_resources.env.physical_mesh
+    except Exception:  # pragma: no cover - jax internals moved
+        return None
+    if mesh.empty or "ep" not in mesh.axis_names:
+        return None
+    ep = mesh.shape["ep"]
+    if ep <= 1 or tokens % ep != 0 or num_experts % ep != 0:
+        return None
+    return mesh
+
+
+def moe_apply(x, gate_w, w_up, w_down, capacity_factor=1.25,
+              axis_name="ep"):
+    """Framework entry for MoE: dispatch over the Executor-activated
+    'ep' mesh axis (all_to_all expert parallelism) or run all experts
+    densely when no ep axis is active. x: (..., d); expert weights
+    w_up (e, d, f) / w_down (e, f, d); returns (same-shape out, scalar
+    load-balance aux loss). This is what the "moe" op lowers to — the
+    Program-level path the ops/tests/dryrun drive through exe.run."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    x2 = x.reshape(-1, d)
+    e, _, f = w_up.shape
+    layer = MoELayer(d_model=d, d_ff=f, num_experts=e,
+                     capacity_factor=capacity_factor, axis_name=axis_name)
+    params = {"gate_w": gate_w, "w_up": w_up, "w_down": w_down}
+    mesh = _active_ep_mesh(x2.shape[0], e)
+    if mesh is None:
+        out, aux = layer(params, x2)     # dense: every expert local
+    else:
+        def local(params, t):
+            o, a = layer(params, t)
+            return o, a[None]            # scalar -> (1,) so 'ep' shards it
+
+        fn = shard_map(
+            local, mesh=mesh,
+            in_specs=({"gate_w": P(), "w_up": P(axis_name),
+                       "w_down": P(axis_name)}, P(axis_name, None)),
+            out_specs=(P(axis_name, None), P(axis_name)), check_vma=False)
+        out, aux = fn(params, x2)
+        aux = jnp.mean(aux)
+    return out.reshape(orig_shape), aux
+
+
 class MoELayer:
     """Functional MoE FFN block: params is a dict of stacked expert weights
     (local experts on this ep shard)."""

@@ -1,0 +1,74 @@
+"""MoE through the framework path (VERDICT r2 item 6): the `moe` layer
++ op lower through Program -> Executor, dispatch over the 'ep' mesh
+axis via all_to_all, and match the dense single-device numerics when
+capacity is ample (no token drops)."""
+
+import numpy as np
+
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core import framework
+from paddle_tpu.core.executor import Scope, scope_guard
+from paddle_tpu.parallel.mesh import make_mesh
+
+
+def _moe_program(d=8, d_ff=16, experts=4, cf=8.0):
+    x = layers.data("x", shape=[16, d], dtype="float32")
+    out, aux = layers.moe(x, d_ff=d_ff, num_experts=experts,
+                          capacity_factor=cf,
+                          param_attr=fluid.ParamAttr(name="moe"))
+    loss = layers.mean(layers.reduce_sum(layers.square(out), dim=-1)) \
+        + layers.reduce_sum(aux) * 0.01
+    return x, out, aux, loss
+
+
+def _feed(batch=2, t=16, d=8, seed=0):
+    rs = np.random.RandomState(seed)
+    return {"x": rs.randn(batch, t, d).astype(np.float32)}
+
+
+def _run(ep_mesh, steps=3):
+    main, startup = framework.Program(), framework.Program()
+    startup.random_seed = 11
+    with framework.program_guard(main, startup):
+        x, out, aux, loss = _moe_program()
+        fluid.optimizer.SGDOptimizer(learning_rate=0.05).minimize(loss)
+    scope = Scope()
+    losses = []
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        prog = main
+        if ep_mesh:
+            mesh = make_mesh(ep=2, devices=jax.devices()[:2])
+            prog = fluid.CompiledProgram(main).with_mesh(mesh)
+        for _ in range(steps):
+            lv, av = exe.run(prog, feed=_feed(), fetch_list=[loss, aux])
+            losses.append(float(np.asarray(lv).ravel()[0]))
+            assert np.isfinite(np.asarray(av)).all()
+        wup = np.asarray(scope.get("moe_w_up"))
+    return losses, wup
+
+
+def test_moe_ep_matches_dense():
+    """ep=2 all_to_all path == dense all-experts numerics (capacity is
+    ample so no tokens drop; gating is deterministic in x)."""
+    dense_losses, dense_w = _run(ep_mesh=False, steps=5)
+    ep_losses, ep_w = _run(ep_mesh=True, steps=5)
+    # top-1 gating flips make the loss non-monotone step to step; the
+    # trend check is that SOME step improved on the start
+    assert min(dense_losses) < dense_losses[0]
+    np.testing.assert_allclose(dense_losses, ep_losses, rtol=5e-4,
+                               atol=1e-6)
+    np.testing.assert_allclose(dense_w, ep_w, rtol=5e-4, atol=1e-6)
+
+
+def test_moe_expert_weights_carry_ep_dist_attr():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        _moe_program()
+    gb = main.global_block()
+    assert tuple(gb.var("moe_w_up").dist_attr)[0] == "ep"
+    assert tuple(gb.var("moe_w_down").dist_attr)[0] == "ep"
