@@ -63,7 +63,7 @@ from .detection import (prior_box, density_prior_box, box_coder,
                         rpn_target_assign, retinanet_target_assign,
                         generate_proposal_labels, box_decoder_and_assign,
                         multiclass_nms2, roi_perspective_transform,
-                        generate_mask_labels)
+                        generate_mask_labels, detection_map)
 from .nn import topk as top_k  # fluid exposes both spellings
 from . import distributions
 from .math_op_patch import monkey_patch_variable

@@ -15,7 +15,8 @@ __all__ = ["roi_perspective_transform", "generate_mask_labels",
            "box_decoder_and_assign", "multiclass_nms2",
            "prior_box", "density_prior_box", "box_coder", "iou_similarity",
            "multiclass_nms", "yolo_box", "roi_pool", "roi_align",
-           "psroi_pool", "ssd_loss", "multi_box_head", "detection_output"]
+           "psroi_pool", "ssd_loss", "multi_box_head", "detection_output",
+           "detection_map"]
 
 
 def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.0],
@@ -588,3 +589,30 @@ def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
                       "MaskInt32": masks},
                      {"num_classes": num_classes, "resolution": resolution})
     return mask_rois, has_mask, masks
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.3, evaluate_difficult=True,
+                  has_state=None, input_states=None, out_states=None,
+                  ap_version="integral"):
+    """Parity: fluid.layers.detection_map (ref detection.py:1002).
+    Current-batch VOC mAP via the host-callback detection_map op;
+    multi-batch streaming accumulation (the reference's in-graph
+    PosCount/TruePos/FalsePos states) lives host-side in
+    metrics.DetectionMAP — pass detections there for eval loops."""
+    if input_states is not None or out_states is not None:
+        raise NotImplementedError(
+            "detection_map in-graph accumulator states are not ported: "
+            "stream batches through metrics.DetectionMAP instead "
+            "(host-side accumulate, same VOC math)")
+    helper = LayerHelper("detection_map")
+    out = helper.create_variable_for_type_inference("float32", (1,))
+    helper.append_op("detection_map",
+                     {"DetectRes": detect_res, "Label": label},
+                     {"MAP": out},
+                     {"overlap_threshold": overlap_threshold,
+                      "ap_version": ap_version,
+                      "class_num": class_num,
+                      "background_label": background_label,
+                      "evaluate_difficult": evaluate_difficult})
+    return out

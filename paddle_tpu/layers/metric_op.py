@@ -58,8 +58,24 @@ def mean_iou(input, label, num_classes):
 
 def edit_distance(input, label, normalized=True, ignored_tokens=None,
                   input_length=None, label_length=None):
-    """Parity: fluid.layers.edit_distance (Levenshtein on padded seqs)."""
+    """Parity: fluid.layers.edit_distance (Levenshtein on padded seqs);
+    ignored_tokens erase from both sides first (ref nn.py:5671-5689,
+    the sequence_erase op)."""
     helper = LayerHelper("edit_distance")
+    if ignored_tokens:
+        def _erase(seq, length):
+            erased = helper.create_variable_for_type_inference(seq.dtype,
+                                                               seq.shape)
+            new_len = helper.create_variable_for_type_inference("int32")
+            ins_e = {"X": seq}
+            if length is not None:
+                ins_e["Length"] = length
+            helper.append_op("sequence_erase", ins_e,
+                             {"Out": erased, "Length": new_len},
+                             {"tokens": list(ignored_tokens)})
+            return erased, new_len
+        input, input_length = _erase(input, input_length)
+        label, label_length = _erase(label, label_length)
     out = helper.create_variable_for_type_inference("float32",
                                                     (input.shape[0], 1))
     seq_num = helper.create_variable_for_type_inference("int32", (1,))

@@ -315,3 +315,10 @@ def tensor_array_sizes(ctx):
     axis = ctx.attr("axis", 0)
     return {"Out": jnp.asarray([x.shape[axis] for x in ctx.in_("X")],
                                jnp.int32)}
+
+
+# the C++ op names behind layers.array_read/array_write/array_length
+# (TensorArray): same kernels, reference op-name aliases
+register("write_to_array")(array_write)
+register("read_from_array")(array_read)
+register("lod_array_length")(array_length)

@@ -7,6 +7,7 @@ models; NMS runs as a host-side utility (paddle_tpu.layers.detection).
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from . import register
 
@@ -759,3 +760,80 @@ def retinanet_detection_output(ctx):
                                                                       keepdims=True),
         allb], axis=-1)
     return {"Out": out}
+
+
+@register("detection_map")
+def detection_map(ctx):
+    """Parity: detection_map_op (VOC mAP). Host-callback kernel (the
+    reference computes this C++-side per step; it is a monitoring
+    metric, never on the grad path). DetectRes rows [label, score,
+    x1,y1,x2,y2]; Label rows [label, x1,y1,x2,y2(,difficult)]. Batch
+    rows are evaluated as one image set unless per-image Lengths are
+    fed — streaming multi-batch accumulation lives host-side in
+    metrics.DetectionMAP."""
+    det = ctx.in_("DetectRes")
+    gt = ctx.in_("Label")
+    thr = ctx.attr("overlap_threshold", 0.3)
+    ap_version = ctx.attr("ap_version", "integral")
+    background = ctx.attr("background_label", 0)
+    eval_difficult = ctx.attr("evaluate_difficult", True)
+
+    def _map(det, gt):
+        det = np.asarray(det, np.float32).reshape(-1, 6)
+        gt = np.asarray(gt, np.float32)
+        gt = gt.reshape(-1, gt.shape[-1])
+        if not eval_difficult and gt.shape[-1] >= 6:
+            # column 5 is the difficult flag: excluded boxes leave both
+            # the recall denominator and the matching pool (VOC drops
+            # them from scoring; the don't-penalize-matches nuance is
+            # folded into the drop)
+            gt = gt[gt[:, 5] < 0.5]
+        aps = []
+        for cls in np.unique(gt[:, 0]):
+            if background is not None and int(cls) == int(background):
+                continue
+            g = gt[gt[:, 0] == cls][:, 1:5]
+            d = det[det[:, 0] == cls]
+            if not len(g):
+                continue
+            order = np.argsort(-d[:, 1])
+            matched = np.zeros(len(g), bool)
+            tp = np.zeros(len(order)); fp = np.zeros(len(order))
+            for r, i in enumerate(order):
+                bb = d[i, 2:6]
+                ious = _iou_np(bb, g)
+                j = int(np.argmax(ious)) if len(ious) else -1
+                if j >= 0 and ious[j] >= thr and not matched[j]:
+                    tp[r] = 1; matched[j] = True
+                else:
+                    fp[r] = 1
+            ctp, cfp = np.cumsum(tp), np.cumsum(fp)
+            rec = ctp / max(len(g), 1)
+            prec = ctp / np.maximum(ctp + cfp, 1e-9)
+            if ap_version == "11point":
+                ap = np.mean([prec[rec >= t].max() if (rec >= t).any()
+                              else 0.0 for t in np.linspace(0, 1, 11)])
+            else:
+                mrec = np.concatenate([[0.0], rec, [1.0]])
+                mpre = np.concatenate([[0.0], prec, [0.0]])
+                for i in range(len(mpre) - 2, -1, -1):
+                    mpre[i] = max(mpre[i], mpre[i + 1])
+                idx = np.where(mrec[1:] != mrec[:-1])[0]
+                ap = np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1])
+            aps.append(ap)
+        return np.float32(np.mean(aps) if aps else 0.0)
+
+    out = jax.pure_callback(
+        _map, jax.ShapeDtypeStruct((), jnp.float32), det, gt)
+    return {"MAP": out.reshape(1), "Out": out.reshape(1)}
+
+
+def _iou_np(box, boxes):
+    x1 = np.maximum(box[0], boxes[:, 0])
+    y1 = np.maximum(box[1], boxes[:, 1])
+    x2 = np.minimum(box[2], boxes[:, 2])
+    y2 = np.minimum(box[3], boxes[:, 3])
+    inter = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    a1 = (box[2] - box[0]) * (box[3] - box[1])
+    a2 = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    return inter / np.maximum(a1 + a2 - inter, 1e-9)

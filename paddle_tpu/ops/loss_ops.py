@@ -244,3 +244,25 @@ def sigmoid_focal_loss(ctx):
     if fg_num is not None:
         loss = loss / jnp.maximum(fg_num.astype(x.dtype).reshape(()), 1.0)
     return {"Out": loss}
+
+
+@register("hinge_loss")
+def hinge_loss(ctx):
+    """Parity: hinge_loss_op.h: loss = max(0, 1 - logits * (2*label-1))
+    with {0,1} labels."""
+    x = ctx.in_("Logits")
+    y = ctx.in_("Labels").astype(x.dtype)
+    return {"Loss": jnp.maximum(1.0 - x * (2.0 * y - 1.0), 0.0)}
+
+
+@register("modified_huber_loss")
+def modified_huber_loss(ctx):
+    """Parity: modified_huber_loss_op.h: z = x*(2y-1); loss = -4z for
+    z < -1, (1-z)^2 for z < 1, else 0. IntermediateVal carries z (the
+    reference grad kernel reads it; ours exists for fetch parity)."""
+    x = ctx.in_("X")
+    y = ctx.in_("Y").astype(x.dtype)
+    z = x * (2.0 * y - 1.0)
+    loss = jnp.where(z < -1.0, -4.0 * z,
+                     jnp.where(z < 1.0, (1.0 - z) ** 2, 0.0))
+    return {"Out": loss, "IntermediateVal": z}

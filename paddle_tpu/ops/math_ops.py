@@ -220,3 +220,46 @@ def dot(ctx):
 def increment(ctx):
     x = ctx.in_("X")
     return {"Out": x + jnp.asarray(ctx.attr("step", 1.0), x.dtype)}
+
+
+@register("minus")
+def minus(ctx):
+    """Parity: minus_op (X - Y; the old fluid.layers.elementwise pair)."""
+    return {"Out": ctx.in_("X") - ctx.in_("Y")}
+
+
+@register("l1_norm")
+def l1_norm(ctx):
+    """Parity: l1_norm_op: Out = sum(|X|) (scalar)."""
+    return {"Out": jnp.sum(jnp.abs(ctx.in_("X")))}
+
+
+@register("squared_l2_norm")
+def squared_l2_norm(ctx):
+    """Parity: squared_l2_norm_op: Out = sum(X^2) (scalar; the kernel
+    behind GradientClipByGlobalNorm in the reference)."""
+    x = ctx.in_("X")
+    return {"Out": jnp.sum(x * x)}
+
+
+@register("fill")
+def fill(ctx):
+    """Parity: fill_op: materialize an explicit value list with a static
+    shape (the reference uses it for small constant tables)."""
+    from .tensor_ops import _np_dtype
+    import numpy as np
+    shape = ctx.attr("shape")
+    value = ctx.attr("value", [0.0])
+    dtype = _np_dtype(ctx.attr("dtype", "float32"))
+    return {"Out": jnp.asarray(np.asarray(value, dtype).reshape(shape))}
+
+
+@register("conv_shift")
+def conv_shift(ctx):
+    """Parity: conv_shift_op (NTM-style circular correlation):
+    out[b, i] = sum_j x[b, (i + j - N//2) mod M] * y[b, j]."""
+    x, y = ctx.in_("X"), ctx.in_("Y")
+    m, n = x.shape[1], y.shape[1]
+    rolled = jnp.stack([jnp.roll(x, shift=-(j - n // 2), axis=1)
+                        for j in range(n)], axis=1)   # (B, N, M)
+    return {"Out": jnp.einsum("bnm,bn->bm", rolled, y)}

@@ -196,3 +196,31 @@ def filter_by_instag(ctx):
     idx = jnp.where(hit, jnp.arange(ins.shape[0]), -1)
     return {"Out": out, "LossWeight": hit.astype(jnp.float32)[:, None],
             "IndexMap": jnp.stack([idx, idx], -1)}
+
+
+@register("positive_negative_pair")
+def positive_negative_pair(ctx):
+    """Parity: positive_negative_pair_op (ranking eval, e.g. mq2007):
+    among item pairs sharing a QueryID, count pairs whose score order
+    agrees (positive), disagrees (negative), or ties (neutral) with the
+    label order; accumulates into the Accumulate* states when given."""
+    score = ctx.in_("Score").reshape(-1)
+    label = ctx.in_("Label").reshape(-1).astype(score.dtype)
+    qid = ctx.in_("QueryID").reshape(-1)
+    n = score.shape[0]
+    same_q = qid[:, None] == qid[None, :]
+    upper = jnp.triu(jnp.ones((n, n), jnp.bool_), k=1)
+    pair = same_q & upper & (label[:, None] != label[None, :])
+    s_diff = score[:, None] - score[None, :]
+    l_diff = label[:, None] - label[None, :]
+    agree = jnp.sign(s_diff) == jnp.sign(l_diff)
+    tie = s_diff == 0.0
+    pos = jnp.sum(pair & agree & ~tie).astype(jnp.float32)
+    neu = jnp.sum(pair & tie).astype(jnp.float32)
+    neg = jnp.sum(pair & ~agree & ~tie).astype(jnp.float32)
+    if ctx.has_in("AccumulatePositivePair"):
+        pos = pos + ctx.in_("AccumulatePositivePair").reshape(())
+        neg = neg + ctx.in_("AccumulateNegativePair").reshape(())
+        neu = neu + ctx.in_("AccumulateNeutralPair").reshape(())
+    return {"PositivePair": pos.reshape(1), "NegativePair": neg.reshape(1),
+            "NeutralPair": neu.reshape(1)}

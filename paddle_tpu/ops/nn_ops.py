@@ -614,21 +614,15 @@ def deformable_conv(ctx):
     return {"Output": out, "Out": out}
 
 
-@register("adaptive_pool3d")
-def adaptive_pool3d(ctx):
-    """Parity: pool3d(adaptive=True) / max_pool3d_with_index (NCDHW);
-    floor/ceil windows, optional argmax Mask as flat index into the
-    input D*H*W volume."""
-    x = ctx.in_("X")
-    od, oh, ow = ctx.attr("pool_size")
-    ptype = ctx.attr("pooling_type", "avg")
-    want_index = bool(ctx.attr("require_index", False))
+def _adaptive_pool3d_vals(x, od, oh, ow, ptype, want_index):
+    """3-D analogue of _adaptive_pool2d_vals: floor/ceil windows with
+    static slices; optional argmax index into the D*H*W volume."""
     n, c, d, h, w = x.shape
     if d % od == 0 and h % oh == 0 and w % ow == 0 and not want_index:
         kd, kh, kw = d // od, h // oh, w // ow
         v = x.reshape(n, c, od, kd, oh, kh, ow, kw)
-        return {"Out": (v.max(axis=(3, 5, 7)) if ptype == "max"
-                        else v.mean(axis=(3, 5, 7)))}
+        return (v.max(axis=(3, 5, 7)) if ptype == "max"
+                else v.mean(axis=(3, 5, 7))), None
     outs, idxs = [], []
     for ds_, de in _adaptive_bounds(d, od):
         for hs, he in _adaptive_bounds(h, oh):
@@ -648,10 +642,24 @@ def adaptive_pool3d(ctx):
                     idxs.append((ds_ + ld) * h * w + (hs + lh) * w
                                 + (ws + lw))
     out = jnp.stack(outs, axis=-1).reshape(n, c, od, oh, ow)
+    idx = jnp.stack(idxs, axis=-1).reshape(n, c, od, oh, ow) \
+        if idxs else None
+    return out, idx
+
+
+@register("adaptive_pool3d")
+def adaptive_pool3d(ctx):
+    """Parity: pool3d(adaptive=True) / max_pool3d_with_index (NCDHW);
+    floor/ceil windows, optional argmax Mask as flat index into the
+    input D*H*W volume."""
+    x = ctx.in_("X")
+    od, oh, ow = ctx.attr("pool_size")
+    out, idx = _adaptive_pool3d_vals(
+        x, od, oh, ow, ctx.attr("pooling_type", "avg"),
+        bool(ctx.attr("require_index", False)))
     res = {"Out": out}
-    if idxs:
-        res["Mask"] = jnp.stack(idxs, axis=-1).reshape(
-            n, c, od, oh, ow).astype(jnp.int32)
+    if idx is not None:
+        res["Mask"] = idx.astype(jnp.int32)
     return res
 
 
@@ -731,3 +739,76 @@ def bilinear_tensor_product(ctx):
     if b is not None:
         out = out + b.reshape(1, -1)
     return {"Out": out}
+
+
+@register("max_pool3d_with_index")
+def max_pool3d_with_index(ctx):
+    """Parity: pool_with_index_op 3-D (NCDHW): max pool + argmax as a
+    flat index into the input D*H*W volume (same window-origin integer
+    math as the 2-D kernel above)."""
+    x = ctx.in_("X")
+    n, c, d, h, w = x.shape
+    ksize = _pair(ctx.attr("ksize"), 3)
+    if ctx.attr("adaptive", False):
+        # fluid.layers.adaptive_pool3d(max) lowers here with adaptive=True
+        out, idx = _adaptive_pool3d_vals(x, ksize[0], ksize[1], ksize[2],
+                                         "max", True)
+        return {"Out": out, "Mask": idx.astype(jnp.int32)}
+    strides = _pair(ctx.attr("strides", ksize), 3)
+    pads = _pair(ctx.attr("paddings", [0, 0, 0]), 3)
+    if ctx.attr("global_pooling", False):
+        ksize, strides, pads = (d, h, w), (d, h, w), (0, 0, 0)
+    kd, kh, kw = ksize
+    neg = jnp.asarray(jnp.finfo(x.dtype).min / 2, x.dtype)
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pads[0], pads[0]),
+                     (pads[1], pads[1]), (pads[2], pads[2])),
+                 constant_values=neg)
+    dn = lax.conv_dimension_numbers(xp.shape, (1, c) + tuple(ksize),
+                                    ("NCDHW", "OIDHW", "NCDHW"))
+    pv = lax.conv_general_dilated_patches(
+        xp, ksize, strides, "VALID", dimension_numbers=dn)
+    od_, oh_, ow_ = pv.shape[2:]
+    pv = pv.reshape(n, c, kd * kh * kw, od_, oh_, ow_)
+    am = jnp.argmax(pv, axis=2)
+    out = jnp.max(pv, axis=2)
+    oi = (jnp.arange(od_, dtype=jnp.int32) * strides[0] - pads[0])
+    oj = (jnp.arange(oh_, dtype=jnp.int32) * strides[1] - pads[1])
+    ok_ = (jnp.arange(ow_, dtype=jnp.int32) * strides[2] - pads[2])
+    ld = (am // (kh * kw)).astype(jnp.int32)
+    lh = ((am // kw) % kh).astype(jnp.int32)
+    lw = (am % kw).astype(jnp.int32)
+    gd = oi[:, None, None] + ld
+    gh = oj[None, :, None] + lh
+    gw = ok_[None, None, :] + lw
+    return {"Out": out, "Mask": (gd * h + gh) * w + gw}
+
+
+# depthwise transposed conv is the grouped path with groups == C_in
+register("depthwise_conv2d_transpose")(conv2d_transpose)
+
+
+@register("sync_batch_norm")
+def sync_batch_norm(ctx):
+    """Parity: sync_batch_norm_op (cross-device batch statistics).
+    Under GSPMD the plain batch_norm's jnp.mean over the dp-sharded
+    batch axis IS the global mean — XLA inserts the cross-replica
+    reduction automatically — so the sync variant is the same kernel
+    by construction (tested in tests/parallel/test_dist_attr_executor)."""
+    return batch_norm(ctx)
+
+
+@register("spp")
+def spp(ctx):
+    """Parity: spp_op (spatial pyramid pooling): levels i=0..H-1 pool
+    adaptively into 2^i x 2^i bins; flattened bins concat to
+    (N, C * sum(4^i)). Built on the adaptive windows above."""
+    x = ctx.in_("X")
+    levels = int(ctx.attr("pyramid_height", 1))
+    ptype = ctx.attr("pooling_type", "max")
+    n, c = x.shape[:2]
+    outs = []
+    for i in range(levels):
+        bins = 2 ** i
+        o, _ = _adaptive_pool2d_vals(x, bins, bins, ptype, False)
+        outs.append(o.reshape(n, c * bins * bins))
+    return {"Out": jnp.concatenate(outs, axis=1)}

@@ -204,3 +204,44 @@ def dpsgd(ctx):
     g = g * jnp.minimum(1.0, clip / jnp.maximum(gn, 1e-12))
     g = g + sigma * jax.random.normal(ctx.rng(), g.shape, g.dtype)
     return {"ParamOut": (p - _lr(ctx) * g).astype(p.dtype)}
+
+
+@register("proximal_gd")
+def proximal_gd(ctx):
+    """Parity: proximal_gd_op: prox step on z = p - lr*g:
+    p' = sign(z) * max(|z| - lr*l1, 0) / (1 + lr*l2)."""
+    p, g = ctx.in_("Param"), ctx.in_("Grad")
+    lr = _lr(ctx)
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    z = p - lr * g
+    p_new = jnp.sign(z) * jnp.maximum(jnp.abs(z) - lr * l1, 0.0) \
+        / (1.0 + lr * l2)
+    return {"ParamOut": p_new.astype(p.dtype)}
+
+
+@register("proximal_adagrad")
+def proximal_adagrad(ctx):
+    """Parity: proximal_adagrad_op: adagrad-scaled lr, then the same
+    soft-threshold prox as proximal_gd."""
+    p, g = ctx.in_("Param"), ctx.in_("Grad")
+    m = ctx.in_("Moment")
+    lr = _lr(ctx)
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    m_new = m + g * g
+    eff = lr / jnp.sqrt(m_new + 1e-10)
+    z = p - eff * g
+    p_new = jnp.sign(z) * jnp.maximum(jnp.abs(z) - eff * l1, 0.0) \
+        / (1.0 + eff * l2)
+    return {"ParamOut": p_new.astype(p.dtype), "MomentOut": m_new}
+
+
+@register("decoupled_weight_decay")
+def decoupled_weight_decay(ctx):
+    """Parity: contrib extend_with_decoupled_weight_decay (AdamW-style):
+    after the base optimizer update, ParamOut = Param - coeff * the
+    PRE-update parameter snapshot (Loshchilov & Hutter 2017)."""
+    p, pre = ctx.in_("Param"), ctx.in_("PrevParam")
+    coeff = ctx.attr("coeff", 0.0)
+    return {"ParamOut": (p - coeff * pre).astype(p.dtype)}

@@ -98,3 +98,70 @@ def fake_quantize_moving_average_abs_max(ctx):
         scale = rate * in_scale + (1.0 - rate) * cur
     out = quant_dequant(x, jnp.reshape(scale, ()), bits)
     return {"Out": out, "OutScale": scale}
+
+
+@register("fake_dequantize_max_abs")
+def fake_dequantize_max_abs(ctx):
+    """Parity: fake_dequantize_op: Out = X * Scale / max_range (int8
+    tensor back to float with a recorded abs-max scale)."""
+    x = ctx.in_("X").astype(jnp.float32)
+    scale = ctx.in_("Scale").reshape(())
+    max_range = float(ctx.attr("max_range", 127.0))
+    return {"Out": x * scale / max_range}
+
+
+@register("fake_channel_wise_dequantize_max_abs")
+def fake_channel_wise_dequantize_max_abs(ctx):
+    """Parity: channel-wise variant — Scales[0] is per-channel; the
+    optional second scale (activation) divides out like the reference's
+    two-level max_range."""
+    x = ctx.in_("X").astype(jnp.float32)
+    scales = ctx.in_("Scales")
+    if isinstance(scales, (list, tuple)):
+        ch, rest = scales[0], scales[1:]
+    else:
+        ch, rest = scales, ()
+    bits = ctx.attr("quant_bits", [8])
+    axis = ctx.attr("quant_axis", 0)
+    qmax = float(2 ** (int(bits[0]) - 1) - 1)
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    out = x * ch.reshape(shape) / qmax
+    for i, s in enumerate(rest):
+        b = int(bits[i + 1]) if i + 1 < len(bits) else 8
+        out = out * s.reshape(()) / float(2 ** (b - 1) - 1)
+    return {"Out": out}
+
+
+@register("fake_quantize_range_abs_max")
+def fake_quantize_range_abs_max(ctx):
+    """Parity: range_abs_max QAT activation quant — the reference keeps
+    a window_size ring of batch abs-maxes and uses its max; that state
+    is design-reduced to an EMA (same role: a smoothed activation scale
+    that FORGETS old outliers, unlike a monotone running max), matching
+    fake_quantize_moving_average_abs_max above."""
+    x = ctx.in_("X")
+    bits = ctx.attr("bit_length", 8)
+    rate = ctx.attr("moving_rate", 0.9)
+    in_scale = ctx.in_("InScale").reshape(())
+    if ctx.is_test:
+        scale = in_scale
+    else:
+        cur = abs_max(x)
+        # first step (scale state still 0) adopts the batch max outright
+        scale = jnp.where(in_scale > 0,
+                          rate * in_scale + (1.0 - rate) * cur, cur)
+    return {"Out": quant_dequant(x, scale, bits), "OutScale": scale}
+
+
+@register("moving_average_abs_max_scale")
+def moving_average_abs_max_scale(ctx):
+    """Parity: scale observer — passthrough output, EMA abs-max scale
+    state (used by PTQ calibration passes)."""
+    x = ctx.in_("X")
+    rate = ctx.attr("moving_rate", 0.9)
+    in_scale = ctx.in_("InScale").reshape(()) if ctx.has_in("InScale") \
+        else jnp.float32(0.0)
+    scale = in_scale if ctx.is_test else \
+        rate * in_scale + (1.0 - rate) * abs_max(x)
+    return {"Out": x, "OutScale": scale}

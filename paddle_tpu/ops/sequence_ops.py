@@ -199,3 +199,28 @@ def row_conv(ctx):
         valid = ((jnp.arange(t) + i) < t)[None, :, None]
         out = out + shifted * valid * w[i][None, None, :]
     return {"Out": out}
+
+
+@register("sequence_erase")
+def sequence_erase(ctx):
+    """Parity: sequence_erase_op — drop every occurrence of the given
+    tokens, compacting each sequence. Static-shape form (SURVEY §1
+    decision 4): X is (B, T) padded with per-row Length; survivors
+    stable-compact to the left via an argsort on (dropped, position),
+    the zero tail pads, and the new lengths ride the Length output."""
+    x = ctx.in_("X")                       # (B, T) int tokens, padded
+    lengths = ctx.in_("Length").reshape(-1) if ctx.has_in("Length") \
+        else jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    tokens = ctx.attr("tokens", [])
+    t = x.shape[1]
+    valid = _mask(lengths, t, jnp.bool_)
+    keep = valid
+    for tok in tokens:
+        keep = keep & (x != tok)
+    # stable partition: survivors (rank 0) before dropped (rank 1)
+    order = jnp.argsort(jnp.where(keep, 0, 1)
+                        * (t + 1) + jnp.arange(t)[None, :], axis=1)
+    compacted = jnp.take_along_axis(x, order, axis=1)
+    new_len = keep.sum(axis=1).astype(jnp.int32)
+    out = compacted * _mask(new_len, t, compacted.dtype)
+    return {"Out": out, "Length": new_len}

@@ -325,7 +325,7 @@ def diag(ctx):
     return {"Out": jnp.diag(ctx.in_("Diagonal"))}
 
 
-@register("zeros_like", "fill_zeros_like")
+@register("zeros_like", "fill_zeros_like", "fill_zeros_like2")
 def zeros_like(ctx):
     return {"Out": jnp.zeros_like(ctx.in_("X"))}
 

@@ -1,0 +1,223 @@
+"""Numeric tests for the ops implemented while closing the op audit
+(tools/op_audit.py): hinge/modified-huber losses, l1/squared-l2 norms,
+minus, fill, conv_shift, sequence_erase (+ edit_distance ignored_tokens),
+max_pool3d_with_index, spp, proximal optim rules, positive_negative_pair,
+fake dequantize, detection_map."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+import torch
+import torch.nn.functional as F
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.layer_helper import LayerHelper
+
+RS = np.random.RandomState(11)
+
+
+def _run(outs, feeds, scope_sets=None):
+    outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    for k, v in (scope_sets or {}).items():
+        fluid.global_scope().set(k, jnp.asarray(v))
+    return exe.run(feed=feeds, fetch_list=list(outs))
+
+
+def _op(op_type, ins, outs_spec, attrs):
+    helper = LayerHelper(op_type)
+    outs = {}
+    for slot, dtype in outs_spec.items():
+        outs[slot] = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(op_type, ins, outs, attrs)
+    return outs
+
+
+def test_hinge_and_modified_huber_loss():
+    x = RS.randn(12, 1).astype(np.float32)
+    y = RS.randint(0, 2, (12, 1)).astype(np.float32)
+    xv = layers.data("x", shape=[1], dtype="float32")
+    yv = layers.data("y", shape=[1], dtype="float32")
+    h = _op("hinge_loss", {"Logits": xv, "Labels": yv},
+            {"Loss": "float32"}, {})["Loss"]
+    m = _op("modified_huber_loss", {"X": xv, "Y": yv},
+            {"Out": "float32", "IntermediateVal": "float32"}, {})["Out"]
+    gh, gm = _run([h, m], {"x": x, "y": y})
+    np.testing.assert_allclose(
+        gh, np.maximum(1 - x * (2 * y - 1), 0), rtol=1e-6)
+    z = x * (2 * y - 1)
+    want = np.where(z < -1, -4 * z, np.where(z < 1, (1 - z) ** 2, 0))
+    np.testing.assert_allclose(gm, want, rtol=1e-5, atol=1e-6)
+
+
+def test_norms_minus_fill():
+    x = RS.randn(3, 4).astype(np.float32)
+    y = RS.randn(3, 4).astype(np.float32)
+    xv = layers.data("x", shape=[4], dtype="float32")
+    yv = layers.data("y", shape=[4], dtype="float32")
+    l1 = _op("l1_norm", {"X": xv}, {"Out": "float32"}, {})["Out"]
+    l2 = _op("squared_l2_norm", {"X": xv}, {"Out": "float32"}, {})["Out"]
+    mi = _op("minus", {"X": xv, "Y": yv}, {"Out": "float32"}, {})["Out"]
+    fl = _op("fill", {}, {"Out": "float32"},
+             {"shape": [2, 2], "value": [1.0, 2.0, 3.0, 4.0],
+              "dtype": "float32"})["Out"]
+    g1, g2, gm, gf = _run([l1, l2, mi, fl], {"x": x, "y": y})
+    np.testing.assert_allclose(g1, np.abs(x).sum(), rtol=1e-6)
+    np.testing.assert_allclose(g2, (x * x).sum(), rtol=1e-6)
+    np.testing.assert_allclose(gm, x - y, rtol=1e-6)
+    np.testing.assert_allclose(gf, [[1, 2], [3, 4]])
+
+
+def test_conv_shift_circular():
+    b, m, n = 2, 7, 3
+    x = RS.randn(b, m).astype(np.float32)
+    y = RS.randn(b, n).astype(np.float32)
+    xv = layers.data("x", shape=[m], dtype="float32")
+    yv = layers.data("y", shape=[n], dtype="float32")
+    out = _op("conv_shift", {"X": xv, "Y": yv}, {"Out": "float32"},
+              {})["Out"]
+    got, = _run(out, {"x": x, "y": y})
+    want = np.zeros((b, m), np.float32)
+    for bb in range(b):
+        for i in range(m):
+            for j in range(n):
+                want[bb, i] += x[bb, (i + j - n // 2) % m] * y[bb, j]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_sequence_erase_and_edit_distance_ignored_tokens():
+    x = np.array([[1, 5, 2, 5, 3, 0], [5, 5, 4, 0, 0, 0]], np.int64)
+    lens = np.array([[5], [3]], np.int32)
+    xv = layers.data("x", shape=[6], dtype="int64")
+    lv = layers.data("len", shape=[1], dtype="int32")
+    res = _op("sequence_erase", {"X": xv, "Length": lv},
+              {"Out": "int64", "Length": "int32"}, {"tokens": [5]})
+    got, glen = _run([res["Out"], res["Length"]],
+                     {"x": x, "len": lens})
+    np.testing.assert_array_equal(got, [[1, 2, 3, 0, 0, 0],
+                                        [4, 0, 0, 0, 0, 0]])
+    np.testing.assert_array_equal(glen.ravel(), [3, 1])
+
+    # through edit_distance: erasing token 5 makes hyp == ref
+    hyp = np.array([[1, 5, 2, 3]], np.int64)
+    ref = np.array([[1, 2, 3, 0]], np.int64)
+    hv = layers.data("h", shape=[4], dtype="int64")
+    rv = layers.data("r", shape=[4], dtype="int64")
+    hl = layers.data("hl", shape=[1], dtype="int32")
+    rl = layers.data("rl", shape=[1], dtype="int32")
+    dist, _ = layers.edit_distance(hv, rv, normalized=False,
+                                   ignored_tokens=[5], input_length=hl,
+                                   label_length=rl)
+    gd, = _run(dist, {"h": hyp, "r": ref,
+                      "hl": np.array([[4]], np.int32),
+                      "rl": np.array([[3]], np.int32)})
+    assert float(np.asarray(gd).ravel()[0]) == 0.0
+
+
+def test_max_pool3d_with_index_matches_torch():
+    x = RS.randn(2, 2, 6, 6, 6).astype(np.float32)
+    xv = layers.data("x", shape=[2, 6, 6, 6], dtype="float32")
+    res = _op("max_pool3d_with_index", {"X": xv},
+              {"Out": "float32", "Mask": "int32"},
+              {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+               "paddings": [0, 0, 0]})
+    got, gm = _run([res["Out"], res["Mask"]], {"x": x})
+    want, wm = F.max_pool3d(torch.from_numpy(x), 2, stride=2,
+                            return_indices=True)
+    np.testing.assert_allclose(got, want.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(gm, wm.numpy())
+
+
+def test_spp_matches_composed_adaptive_pools():
+    x = RS.randn(2, 3, 8, 8).astype(np.float32)
+    xv = layers.data("x", shape=[3, 8, 8], dtype="float32")
+    out = _op("spp", {"X": xv}, {"Out": "float32"},
+              {"pyramid_height": 3, "pooling_type": "max"})["Out"]
+    got, = _run(out, {"x": x})
+    t = torch.from_numpy(x)
+    parts = [F.adaptive_max_pool2d(t, 2 ** i).reshape(2, -1)
+             for i in range(3)]
+    want = torch.cat(parts, dim=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_proximal_rules():
+    from paddle_tpu.ops import get as get_op   # noqa: F401
+    import jax
+    from paddle_tpu import ops as opreg
+
+    p = RS.randn(5).astype(np.float32)
+    g = RS.randn(5).astype(np.float32)
+    lr, l1, l2 = 0.1, 0.05, 0.02
+
+    class Ctx:
+        is_test = False
+
+        def in_(self, n):
+            return {"Param": jnp.asarray(p), "Grad": jnp.asarray(g),
+                    "Moment": jnp.zeros(5),
+                    "LearningRate": jnp.float32(lr)}[n]
+
+        def attr(self, n, d=None):
+            return {"l1": l1, "l2": l2}.get(n, d)
+
+        def has_in(self, n):
+            return True
+    out = opreg._REGISTRY["proximal_gd"](Ctx())
+    z = p - lr * g
+    want = np.sign(z) * np.maximum(np.abs(z) - lr * l1, 0) / (1 + lr * l2)
+    np.testing.assert_allclose(out["ParamOut"], want, rtol=1e-5)
+
+    out = opreg._REGISTRY["proximal_adagrad"](Ctx())
+    m = g * g
+    eff = lr / np.sqrt(m + 1e-10)
+    z = p - eff * g
+    want = np.sign(z) * np.maximum(np.abs(z) - eff * l1, 0) / (1 + eff * l2)
+    np.testing.assert_allclose(out["ParamOut"], want, rtol=1e-4)
+
+
+def test_positive_negative_pair():
+    score = np.array([3.0, 1.0, 2.0, 5.0, 4.0], np.float32)
+    label = np.array([2.0, 1.0, 1.0, 1.0, 2.0], np.float32)
+    qid = np.array([0, 0, 0, 1, 1], np.int64)
+    sv = layers.data("s", shape=[1], dtype="float32")
+    lv = layers.data("l", shape=[1], dtype="float32")
+    qv = layers.data("q", shape=[1], dtype="int64")
+    res = _op("positive_negative_pair",
+              {"Score": sv, "Label": lv, "QueryID": qv},
+              {"PositivePair": "float32", "NegativePair": "float32",
+               "NeutralPair": "float32"}, {})
+    gp, gn, gu = _run([res["PositivePair"], res["NegativePair"],
+                       res["NeutralPair"]],
+                      {"s": score.reshape(-1, 1),
+                       "l": label.reshape(-1, 1),
+                       "q": qid.reshape(-1, 1)})
+    # q0: label pairs (0,1),(0,2) -> scores agree both; q1: (3,4) label
+    # says 4>3 but score says 3>4 -> negative
+    assert float(gp) == 2.0 and float(gn) == 1.0 and float(gu) == 0.0
+
+
+def test_fake_dequantize_max_abs():
+    x = (RS.randn(4, 4) * 100).astype(np.float32)
+    xv = layers.data("x", shape=[4], dtype="float32")
+    sv = layers.data("s", shape=[1], dtype="float32")
+    out = _op("fake_dequantize_max_abs", {"X": xv, "Scale": sv},
+              {"Out": "float32"}, {"max_range": 127.0})["Out"]
+    got, = _run(out, {"x": x, "s": np.array([0.5], np.float32)})
+    np.testing.assert_allclose(got, x * 0.5 / 127.0, rtol=1e-6)
+
+
+def test_detection_map_layer():
+    # 2 classes; class 1: det matches gt (AP 1); class 2: det misses
+    det = np.array([[1, 0.9, 0, 0, 10, 10],
+                    [2, 0.8, 50, 50, 60, 60]], np.float32)
+    gt = np.array([[1, 0, 0, 10, 10],
+                   [2, 80, 80, 90, 90]], np.float32)
+    dv = layers.data("d", shape=[6], dtype="float32")
+    gv = layers.data("g", shape=[5], dtype="float32")
+    m = layers.detection_map(dv, gv, class_num=3, overlap_threshold=0.5)
+    got, = _run(m, {"d": det, "g": gt})
+    np.testing.assert_allclose(np.asarray(got).ravel()[0], 0.5, atol=1e-6)
