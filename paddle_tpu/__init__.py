@@ -36,6 +36,8 @@ from . import average
 from . import evaluator
 from . import net_drawer
 from . import contrib
+from . import communicator
+from .communicator import Communicator
 from . import io
 from .io.state import (save_params, save_persistables, save_vars, load_params,
                        load_persistables, load_vars)

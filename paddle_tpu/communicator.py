@@ -1,0 +1,39 @@
+"""Parity shim: python/paddle/fluid/communicator.py:22 — documented
+NON-PORT of the async/geo-SGD parameter-server communicator.
+
+The reference Communicator is a C++ background thread pool that pushes
+gradients to / pulls parameters from pservers asynchronously (the
+DistributeTranspiler async mode). TPU training has no pservers:
+optimizer state shards across devices (ZeRO-1/fsdp — see
+parallel/transpiler.py for the documented re-expression) and gradient
+exchange is a compiled XLA collective inside the training step, which
+is both synchronous AND overlapped by XLA's scheduler — the latency
+hiding async-SGD buys on a CPU cluster comes for free on ICI, without
+the staleness. MIGRATION.md covers converting async-mode configs.
+
+The class is import-compatible: constructing it works (so transpiled
+code paths survive), start()/stop() are no-ops with a warning.
+"""
+
+import warnings
+
+__all__ = ["Communicator"]
+
+
+class Communicator:
+    def __init__(self, program=None):
+        self._running = False
+        warnings.warn(
+            "Communicator is a no-op on TPU: gradients ride XLA "
+            "collectives inside the jitted step (no async pserver "
+            "push/pull). See parallel/transpiler.py and MIGRATION.md.",
+            stacklevel=2)
+
+    def start(self):
+        self._running = True
+
+    def stop(self):
+        self._running = False
+
+    def is_running(self):
+        return self._running

@@ -2,3 +2,8 @@
 
 from . import decoder  # noqa: F401
 from . import mixed_precision  # noqa: F401
+from . import extend_optimizer  # noqa: F401
+from .extend_optimizer import extend_with_decoupled_weight_decay  # noqa: F401
+from .op_frequence import op_freq_statistic  # noqa: F401
+from . import inferencer  # noqa: F401
+from . import trainer  # noqa: F401

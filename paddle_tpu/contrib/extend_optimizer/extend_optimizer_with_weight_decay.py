@@ -1,0 +1,44 @@
+"""Parity: python/paddle/fluid/contrib/extend_optimizer/
+extend_optimizer_with_weight_decay.py:102 — a class decorator giving
+any optimizer decoupled (AdamW-style) weight decay: the decay applies
+to the PRE-update parameter value, outside the adaptive rescaling.
+
+TPU-native mechanics: a snapshot assign before the optimizer ops and a
+`decoupled_weight_decay` op after them — all inside the same jitted
+step, so XLA fuses the whole update chain."""
+
+from ...optimizer.optimizers import Optimizer
+
+__all__ = ["extend_with_decoupled_weight_decay"]
+
+
+def extend_with_decoupled_weight_decay(base_optimizer):
+    if not (isinstance(base_optimizer, type)
+            and issubclass(base_optimizer, Optimizer)):
+        raise TypeError(
+            "input 'base_optimizer' should be an Optimizer subclass")
+
+    class OptimizerWithDecoupledWeightDecay(base_optimizer):
+        """base_optimizer + decoupled decay (first ctor arg, like the
+        reference: OptimizerWithDecoupledWeightDecay(coeff, ...)."""
+
+        def __init__(self, weight_decay, *args, **kwargs):
+            super().__init__(*args, **kwargs)
+            self._decoupled_coeff = float(weight_decay)
+
+        def apply_gradients(self, params_grads):
+            from ... import layers
+            block = params_grads[0][0].block.program.global_block()
+            # snapshot BEFORE the base update ops run
+            snaps = [(p, layers.assign(p)) for p, _ in params_grads]
+            ops = super().apply_gradients(params_grads)
+            if self._decoupled_coeff:
+                for p, snap in snaps:
+                    ops.append(block.append_op(
+                        "decoupled_weight_decay",
+                        {"Param": p, "PrevParam": snap},
+                        {"ParamOut": p},
+                        {"coeff": self._decoupled_coeff}))
+            return ops
+
+    return OptimizerWithDecoupledWeightDecay

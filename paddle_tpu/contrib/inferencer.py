@@ -1,0 +1,41 @@
+"""Parity: python/paddle/fluid/contrib/inferencer.py (deprecated in
+the reference in favor of fluid.Executor + load_inference_model; kept
+import-compatible and functional here)."""
+
+import warnings
+
+from ..core import framework
+from ..core.executor import Executor, Scope, scope_guard
+from ..core.place import TPUPlace
+from ..io.state import load_params
+
+__all__ = ["Inferencer"]
+
+
+class Inferencer:
+    """Build the net from infer_func, load params, serve .infer()."""
+
+    def __init__(self, infer_func, param_path, place=None, parallel=False):
+        warnings.warn(
+            "fluid.contrib.inferencer.Inferencer is deprecated (as in "
+            "the reference); use fluid.Executor with "
+            "load_inference_model / inference.Predictor.", stacklevel=2)
+        self.param_path = param_path
+        self.scope = Scope()
+        self.place = place if place is not None else TPUPlace(0)
+        self.inference_program = framework.Program()
+        startup = framework.Program()
+        with framework.program_guard(self.inference_program, startup):
+            self.predict_var = infer_func()
+        self.exe = Executor(self.place)
+        with scope_guard(self.scope):
+            self.exe.run(startup)
+            load_params(self.exe, param_path,
+                        main_program=self.inference_program)
+        self.inference_program = self.inference_program.clone(for_test=True)
+
+    def infer(self, inputs, return_numpy=True):
+        with scope_guard(self.scope):
+            return self.exe.run(self.inference_program, feed=inputs,
+                                fetch_list=[self.predict_var],
+                                return_numpy=return_numpy)

@@ -1,0 +1,34 @@
+"""Parity: python/paddle/fluid/contrib/op_frequence.py — op-frequency
+statistics over a Program (single ops and adjacent producer->consumer
+pairs), a profiling aid for spotting fusion candidates."""
+
+from collections import OrderedDict
+
+from ..core.framework import Program
+
+__all__ = ["op_freq_statistic"]
+
+
+def op_freq_statistic(program):
+    """Returns (uni_op_freq, adj_2_op_freq): single-op counts and
+    adjacent-op-pair counts ("a->b" keys), both sorted descending, as
+    in the reference (contrib/op_frequence.py:23)."""
+    if not isinstance(program, Program):
+        raise TypeError("The input type should be Program. "
+                        "But you passed in %s" % (type(program)))
+    uni = OrderedDict()
+    adj = OrderedDict()
+    producer = {}       # var name -> op type that wrote it
+    params = {p.name for p in program.global_block().all_parameters()}
+    for op in program.global_block().ops:
+        uni[op.type] = uni.get(op.type, 0) + 1
+        for name in op.input_names:
+            prev = producer.get(name)
+            if prev is not None and name not in params:
+                key = prev + "->" + op.type
+                adj[key] = adj.get(key, 0) + 1
+        for name in op.output_names:
+            producer[name] = op.type
+    uni = sorted(uni.items(), key=lambda kv: -kv[1])
+    adj = sorted(adj.items(), key=lambda kv: -kv[1])
+    return uni, adj

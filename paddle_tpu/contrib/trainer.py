@@ -1,0 +1,110 @@
+"""Parity: python/paddle/fluid/contrib/trainer.py (the pre-Executor
+high-level Trainer, deprecated in the reference; kept import-compatible
+and minimally functional: event-driven epoch/step loop, test(), save).
+"""
+
+import warnings
+
+import numpy as np
+
+from ..core import framework
+from ..core.data_feeder import DataFeeder
+from ..core.executor import Executor, Scope, scope_guard
+from ..core.place import TPUPlace
+from ..io.state import save_params, load_params
+
+__all__ = ["BeginEpochEvent", "EndEpochEvent", "BeginStepEvent",
+           "EndStepEvent", "Trainer"]
+
+
+class BeginEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class Trainer:
+    """train_func returns the loss var (optionally [loss, ...metrics]);
+    optimizer_func returns an optimizer. The event_handler receives the
+    Begin/End Epoch/Step events of the reference protocol."""
+
+    def __init__(self, train_func, optimizer_func, param_path=None,
+                 place=None, parallel=False, checkpoint_config=None):
+        warnings.warn(
+            "fluid.contrib.trainer.Trainer is deprecated (as in the "
+            "reference); use fluid.Executor with exe.run or "
+            "exe.train_from_dataset.", stacklevel=2)
+        self.place = place if place is not None else TPUPlace(0)
+        self.scope = Scope()
+        self.train_program = framework.Program()
+        self.startup_program = framework.Program()
+        with framework.program_guard(self.train_program,
+                                     self.startup_program):
+            out = train_func()
+            self.train_outs = list(out) if isinstance(out, (list, tuple)) \
+                else [out]
+            optimizer_func().minimize(self.train_outs[0])
+        self.test_program = self.train_program.clone(for_test=True)
+        self.exe = Executor(self.place)
+        with scope_guard(self.scope):
+            self.exe.run(self.startup_program)
+            if param_path:
+                load_params(self.exe, param_path,
+                            main_program=self.train_program)
+
+    def train(self, num_epochs, event_handler, reader=None,
+              feed_order=None):
+        feeder = DataFeeder(feed_order, program=self.train_program)
+        with scope_guard(self.scope):
+            for epoch in range(num_epochs):
+                event_handler(BeginEpochEvent(epoch))
+                for step, batch in enumerate(reader()):
+                    ev = BeginStepEvent(epoch, step)
+                    event_handler(ev)
+                    fetches = self.train_outs if ev.fetch_metrics else []
+                    out = self.exe.run(self.train_program,
+                                       feed=feeder.feed(batch),
+                                       fetch_list=fetches)
+                    event_handler(EndStepEvent(epoch, step, out))
+                event_handler(EndEpochEvent(epoch))
+
+    def test(self, reader, feed_order):
+        feeder = DataFeeder(feed_order, program=self.test_program)
+        totals = None
+        n = 0
+        with scope_guard(self.scope):
+            for batch in reader():
+                out = self.exe.run(self.test_program,
+                                   feed=feeder.feed(batch),
+                                   fetch_list=self.train_outs)
+                vals = [float(np.asarray(v).mean()) for v in out]
+                totals = vals if totals is None else \
+                    [a + b for a, b in zip(totals, vals)]
+                n += 1
+        return [t / max(n, 1) for t in (totals or [])]
+
+    def save_params(self, param_path):
+        with scope_guard(self.scope):
+            save_params(self.exe, param_path,
+                        main_program=self.train_program)
+
+    def stop(self):
+        pass

@@ -128,7 +128,13 @@ def gen_point(querylist):
 
 def gen_pair(querylist, partial_order="full"):
     """Pairwise: -> (1, higher_features, lower_features) for every pair
-    with different relevance (the reference's full partial order)."""
+    with different relevance (the reference's full partial order).
+    Only the 'full' order is implemented; the reference's 'neighbour'
+    variant raises instead of silently returning full-order pairs."""
+    if partial_order != "full":
+        raise NotImplementedError(
+            f"mq2007.gen_pair partial_order={partial_order!r}: only "
+            f"'full' is implemented (reference also offers 'neighbour')")
     docs = sorted(querylist, key=lambda q: -q.relevance_score)
     for i, hi in enumerate(docs):
         for lo in docs[i + 1:]:

@@ -195,5 +195,18 @@ class PipeReader:
             remained = lines.pop()
             for line in lines:
                 yield line.decode(errors="replace")
+        if self.dec is not None:
+            # drain the decompressor's internal tail: without flush()
+            # bytes buffered past the last read are silently dropped
+            # (latent bug in the reference's PipeReader, fixed here)
+            tail = self.dec.flush()
+            if tail:
+                if not cut_lines:
+                    yield tail
+                else:
+                    lines = (remained + tail).split(line_break.encode())
+                    remained = lines.pop()
+                    for line in lines:
+                        yield line.decode(errors="replace")
         if remained:
             yield remained.decode(errors="replace")

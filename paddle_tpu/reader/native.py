@@ -209,7 +209,10 @@ class NativeLoaderPool:
         batch_bytes = sum(
             int(np.prod(a.shape[1:], dtype=np.int64)) * a.dtype.itemsize
             for _, a in self._arrays) * batch_size
-        header = 4 + sum(3 + len(k.encode()) + len(str(a.dtype)) +
+        # 4 fixed bytes per source frame header: u16 klen + u8 dlen +
+        # u8 ndim (loader_pool.cc write_frame) — matches the C++ layout
+        # so the ring slot never reallocs
+        header = 4 + sum(4 + len(k.encode()) + len(str(a.dtype)) +
                          8 * a.ndim for k, a in self._arrays)
         self._ring = NativeRing(slots=slots,
                                 slot_bytes=batch_bytes + header)

@@ -158,3 +158,127 @@ def test_training_decoder_then_beam_search_recovers_sequence():
         # the top beam of every batch row replays the memorized sequence
         np.testing.assert_array_equal(ids[:, 0, :],
                                       np.tile(TARGET, (B, 1)))
+
+
+def test_communicator_shim():
+    import warnings as w
+    import paddle_tpu as fluid
+    with w.catch_warnings(record=True) as rec:
+        w.simplefilter("always")
+        c = fluid.Communicator()
+        assert any("no-op on TPU" in str(r.message) for r in rec)
+    c.start()
+    assert c.is_running()
+    c.stop()
+    assert not c.is_running()
+
+
+def test_op_freq_statistic():
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.core import framework
+
+    main = framework.Program()
+    with framework.program_guard(main, framework.Program()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        h = layers.relu(x)
+        h = layers.relu(h)
+        _ = layers.scale(h, scale=2.0)
+    uni, adj = fluid.contrib.op_freq_statistic(main)
+    uni = dict(uni)
+    assert uni["relu"] == 2 and uni["scale"] == 1
+    assert dict(adj).get("relu->relu") == 1
+    import pytest
+    with pytest.raises(TypeError):
+        fluid.contrib.op_freq_statistic("not a program")
+
+
+def test_extend_with_decoupled_weight_decay_matches_manual():
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.core import framework
+    from paddle_tpu.core.executor import Scope, scope_guard
+
+    coeff, lr = 0.1, 0.5
+
+    def build(use_decay):
+        main, startup = framework.Program(), framework.Program()
+        startup.random_seed = 3
+        with framework.program_guard(main, startup):
+            x = layers.data("x", shape=[4], dtype="float32")
+            y = layers.fc(x, size=1, bias_attr=False,
+                          param_attr=fluid.ParamAttr(name="w"))
+            loss = layers.mean(y)
+            if use_decay:
+                cls = fluid.contrib.extend_with_decoupled_weight_decay(
+                    fluid.optimizer.SGDOptimizer)
+                cls(coeff, learning_rate=lr).minimize(loss)
+            else:
+                fluid.optimizer.SGDOptimizer(learning_rate=lr).minimize(
+                    loss)
+        scope = Scope()
+        exe = fluid.Executor()
+        feed = {"x": np.ones((2, 4), np.float32)}
+        with scope_guard(scope):
+            exe.run(startup)
+            w0 = np.asarray(scope.get("w")).copy()
+            exe.run(main, feed=feed, fetch_list=[loss])
+            w1 = np.asarray(scope.get("w"))
+        return w0, w1
+
+    w0, w_plain = build(False)
+    w0b, w_decay = build(True)
+    np.testing.assert_allclose(w0, w0b, rtol=1e-6)
+    # decoupled decay: w_decay = w_plain - coeff * w0
+    np.testing.assert_allclose(w_decay, w_plain - coeff * w0,
+                               rtol=1e-5, atol=1e-7)
+
+    import pytest
+    with pytest.raises(TypeError):
+        fluid.contrib.extend_with_decoupled_weight_decay(object)
+
+
+def test_trainer_inferencer_shims(tmp_path):
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.contrib.trainer import Trainer, EndStepEvent
+    from paddle_tpu.contrib.inferencer import Inferencer
+
+    def train_net():
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1, param_attr=fluid.ParamAttr(name="tw"))
+        return layers.mean(layers.square_error_cost(pred, y))
+
+    rs = np.random.RandomState(0)
+    xs = rs.randn(64, 4).astype(np.float32)
+    ys = (xs @ np.array([1., -2., 3., 0.5], np.float32)).reshape(-1, 1)
+
+    def reader():
+        for i in range(0, 64, 16):
+            yield list(zip(xs[i:i + 16], ys[i:i + 16]))
+
+    losses = []
+
+    def handler(ev):
+        if isinstance(ev, EndStepEvent):
+            losses.append(float(np.asarray(ev.metrics[0]).ravel()[0]))
+
+    t = Trainer(train_net, lambda: fluid.optimizer.AdamOptimizer(0.1))
+    t.train(num_epochs=8, event_handler=handler, reader=reader,
+            feed_order=["x", "y"])
+    assert losses[-1] < losses[0] * 0.5
+    test_loss = t.test(reader, feed_order=["x", "y"])
+    assert test_loss[0] < losses[0]
+    pdir = str(tmp_path / "params")
+    t.save_params(pdir)
+
+    def infer_net():
+        x = layers.data("x", shape=[4], dtype="float32")
+        return layers.fc(x, size=1, param_attr=fluid.ParamAttr(name="tw"))
+
+    inf = Inferencer(infer_net, pdir)
+    out, = inf.infer({"x": xs[:4]})
+    assert np.asarray(out).shape == (4, 1)
