@@ -326,9 +326,10 @@ class BilinearTensorProduct(Layer):
 class GRUUnit(Layer):
     def __init__(self, size, param_attr=None, bias_attr=None,
                  activation="tanh", gate_activation="sigmoid",
-                 dtype="float32", name_scope=None):
+                 origin_mode=False, dtype="float32", name_scope=None):
         super().__init__(name_scope)
         self._hidden = size // 3
+        self._origin_mode = origin_mode
         d = self._hidden
         self.weight = self.create_parameter([d, 3 * d], dtype, param_attr)
         self.bias = self.create_parameter([1, 3 * d], dtype, bias_attr,
@@ -336,8 +337,11 @@ class GRUUnit(Layer):
 
     def forward(self, input, hidden):
         d = self._hidden
+        origin_mode = self._origin_mode
 
-        # GRU math (fluid gru_unit): input already = x @ W_in + b_in (3d)
+        # GRU math (fluid gru_unit): input already = x @ W_in + b_in (3d).
+        # origin_mode=False (the fluid default) blends h = (1-u)h + u*c
+        # (gru_kernel.h gru_finalOutput); True is the original paper.
         def gru(x, h, w, b):
             xu, xr, xc = jnp.split(x + b.reshape(-1), 3, axis=-1)
             hu = h @ w[:, :d]
@@ -345,7 +349,10 @@ class GRUUnit(Layer):
             u = jax.nn.sigmoid(xu + hu)
             r = jax.nn.sigmoid(xr + hr)
             c = jnp.tanh(xc + (r * h) @ w[:, 2 * d:])
-            new_h = u * h + (1 - u) * c
+            if origin_mode:
+                new_h = u * h + (1 - u) * c
+            else:
+                new_h = (1 - u) * h + u * c
             return new_h
 
         from .base import current_tape, _grad_enabled

@@ -935,17 +935,21 @@ def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
 
 def resize_bilinear(input, out_shape=None, scale=None, name=None,
                     align_corners=True, align_mode=1):
-    return _resize(input, out_shape, scale, "bilinear_interp", name)
+    return _resize(input, out_shape, scale, "bilinear_interp", name,
+                   align_corners=align_corners, align_mode=align_mode)
 
 
 def resize_nearest(input, out_shape=None, scale=None, name=None,
                    align_corners=True):
-    return _resize(input, out_shape, scale, "nearest_interp", name)
+    return _resize(input, out_shape, scale, "nearest_interp", name,
+                   align_corners=align_corners)
 
 
-def _resize(input, out_shape, scale, op_type, name):
+def _resize(input, out_shape, scale, op_type, name, align_corners=True,
+            align_mode=1):
     helper = LayerHelper(op_type, name=name)
-    attrs = {}
+    # the reference DEFAULTS to align_corners=True (nn.py:7861)
+    attrs = {"align_corners": align_corners, "align_mode": align_mode}
     if out_shape is not None:
         attrs["out_h"], attrs["out_w"] = int(out_shape[0]), int(out_shape[1])
         shape = tuple(input.shape[:2]) + (attrs["out_h"], attrs["out_w"])
@@ -958,7 +962,28 @@ def _resize(input, out_shape, scale, op_type, name):
     return out
 
 
-image_resize = resize_bilinear
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR", actual_shape=None,
+                 align_corners=True, align_mode=1):
+    """Parity: fluid.layers.image_resize (ref nn.py:7852): dispatch on
+    resample; actual_shape (a runtime shape tensor) is unsupported —
+    static shapes, pass out_shape."""
+    if actual_shape is not None:
+        raise NotImplementedError(
+            "image_resize(actual_shape=...) needs runtime output shapes; "
+            "pass a static out_shape (SURVEY §1 decision 4)")
+    resample = resample.upper()
+    if resample == "BILINEAR":
+        return resize_bilinear(input, out_shape, scale, name,
+                               align_corners, align_mode)
+    if resample == "NEAREST":
+        return resize_nearest(input, out_shape, scale, name, align_corners)
+    if resample == "TRILINEAR":
+        return resize_trilinear(input, out_shape, scale, name,
+                                align_corners, align_mode)
+    raise ValueError(
+        "The 'resample' of image_resize can only be 'BILINEAR', "
+        "'TRILINEAR' or 'NEAREST' currently.")
 
 
 def pixel_shuffle(x, upscale_factor):
@@ -1360,7 +1385,9 @@ def resize_trilinear(input, out_shape=None, scale=None, name=None,
     out = helper.create_variable_for_type_inference(
         input.dtype, tuple(input.shape[:2]) + (od, oh, ow))
     helper.append_op("trilinear_interp", {"X": input}, {"Out": out},
-                     {"out_d": od, "out_h": oh, "out_w": ow})
+                     {"out_d": od, "out_h": oh, "out_w": ow,
+                      "align_corners": align_corners,
+                      "align_mode": align_mode})
     return out
 
 

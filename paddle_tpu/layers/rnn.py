@@ -140,7 +140,8 @@ def dynamic_gru(input, size, length=None, h_0=None, param_attr=None,
     if h_0 is not None:
         inputs["H0"] = h_0
     helper.append_op("gru", inputs, {"Hidden": hs, "LastH": h_last},
-                     {"is_reverse": is_reverse})
+                     {"is_reverse": is_reverse,
+                      "origin_mode": origin_mode})
     return hs
 
 
@@ -173,7 +174,12 @@ def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
              origin_mode=False, name=None):
     """Parity: fluid.layers.gru_unit — one step. input (B, 3H) is the
     pre-projected x (fluid convention: caller fc's x to 3H); size = 3 * H.
-    Returns (hidden, reset_hidden_prev, gate)."""
+    Returns (hidden, reset_hidden_prev, gate).
+
+    Documented divergence: the reference ACCEPTS origin_mode here but
+    silently drops it (only dynamic_gru forwards it, nn.py:1260); we
+    honor the flag — passing True gives the original-paper blend
+    instead of reproducing the reference's silent-drop quirk."""
     helper = LayerHelper("gru_unit", param_attr=param_attr,
                          bias_attr=bias_attr, name=name)
     h_size = size // 3
@@ -191,7 +197,8 @@ def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
     if bias is not None:
         inputs["Bias"] = bias
     helper.append_op("gru_unit", inputs,
-                     {"Hidden": h, "Gate": gate, "ResetHiddenPrev": rhp}, {})
+                     {"Hidden": h, "Gate": gate, "ResetHiddenPrev": rhp},
+                     {"origin_mode": origin_mode})
     return h, rhp, gate
 
 

@@ -364,33 +364,90 @@ def dropout(ctx):
     return {"Out": out.astype(x.dtype), "Mask": mask.astype(x.dtype)}
 
 
-def _resize(ctx, method):
-    x = ctx.in_("X")  # NCHW
-    out_h = ctx.attr("out_h", -1)
-    out_w = ctx.attr("out_w", -1)
+def _interp_src(out_size, in_size, align_corners, align_mode):
+    """Source coordinates per the reference interpolate kernels
+    (bilinear_interp_op.h): align_corners -> ratio (in-1)/(out-1);
+    else ratio in/out with align_mode 0 = half-pixel centers
+    ((d+0.5)*r - 0.5, the torch/TF convention) and align_mode 1 = the
+    fluid legacy d*r. The reference DEFAULT is align_corners=True —
+    silently computing half-pixel here would shift every upsample."""
+    d = jnp.arange(out_size, dtype=jnp.float32)
+    if out_size <= 1:
+        # reference guard (interpolate_op.h): ratio is only computed
+        # for out > 1, so a size-1 output samples pixel 0 in EVERY mode
+        return jnp.zeros((out_size,), jnp.float32)
+    if align_corners:
+        src = d * ((in_size - 1) / (out_size - 1))
+    else:
+        ratio = in_size / out_size
+        src = (d + 0.5) * ratio - 0.5 if align_mode == 0 else d * ratio
+    return jnp.clip(src, 0.0, in_size - 1)
+
+
+def _lerp_axis(x, axis, out_size, align_corners, align_mode):
+    """1-D linear interpolation along `axis` (separable resize)."""
+    in_size = x.shape[axis]
+    src = _interp_src(out_size, in_size, align_corners, align_mode)
+    i0 = jnp.floor(src).astype(jnp.int32)
+    i1 = jnp.minimum(i0 + 1, in_size - 1)
+    frac = src - i0
+    a = jnp.take(x, i0, axis=axis)
+    b = jnp.take(x, i1, axis=axis)
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    return a + (b - a) * frac.reshape(shape).astype(x.dtype)
+
+
+def _resize_sizes(ctx, x, nd):
+    names = ["out_d", "out_h", "out_w"][3 - nd:]
+    sizes = [ctx.attr(nm, -1) for nm in names]
     scale = ctx.attr("scale", 0.0)
-    n, c, h, w = x.shape
     if scale and scale > 0:
-        out_h, out_w = int(h * scale), int(w * scale)
-    return {"Out": jax.image.resize(x, (n, c, out_h, out_w), method=method)}
+        sizes = [int(s * scale) for s in x.shape[2:]]
+    return sizes
 
 
 @register("bilinear_interp")
 def bilinear_interp(ctx):
-    return _resize(ctx, "bilinear")
+    x = ctx.in_("X")  # NCHW
+    oh, ow = _resize_sizes(ctx, x, 2)
+    ac = bool(ctx.attr("align_corners", True))
+    am = ctx.attr("align_mode", 1)
+    out = _lerp_axis(x, 2, oh, ac, am)
+    out = _lerp_axis(out, 3, ow, ac, am)
+    return {"Out": out}
 
 
 @register("nearest_interp")
 def nearest_interp(ctx):
-    return _resize(ctx, "nearest")
+    """Parity: nearest_interp_op — align_corners rounds
+    (int(ratio*d + 0.5) with ratio (in-1)/(out-1)); else floor(d*in/out)."""
+    x = ctx.in_("X")
+    oh, ow = _resize_sizes(ctx, x, 2)
+    ac = bool(ctx.attr("align_corners", True))
+    out = x
+    for axis, osize in ((2, oh), (3, ow)):
+        in_size = out.shape[axis]
+        # one source of truth for the coordinate conventions:
+        # align_corners rounds the corner-aligned src, else floors the
+        # legacy (align_mode=1) src
+        src = _interp_src(osize, in_size, ac, 1)
+        idx = jnp.floor(src + 0.5) if ac else jnp.floor(src)
+        idx = jnp.clip(idx, 0, in_size - 1).astype(jnp.int32)
+        out = jnp.take(out, idx, axis=axis)
+    return {"Out": out}
 
 
 @register("trilinear_interp")
 def trilinear_interp(ctx):
     x = ctx.in_("X")  # NCDHW
-    n, c = x.shape[:2]
-    shape = (n, c, ctx.attr("out_d"), ctx.attr("out_h"), ctx.attr("out_w"))
-    return {"Out": jax.image.resize(x, shape, method="trilinear")}
+    od, oh, ow = _resize_sizes(ctx, x, 3)
+    ac = bool(ctx.attr("align_corners", True))
+    am = ctx.attr("align_mode", 1)
+    out = _lerp_axis(x, 2, od, ac, am)
+    out = _lerp_axis(out, 3, oh, ac, am)
+    out = _lerp_axis(out, 4, ow, ac, am)
+    return {"Out": out}
 
 
 @register("affine_channel")

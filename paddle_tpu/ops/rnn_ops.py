@@ -110,6 +110,10 @@ def gru(ctx):
     """Full-sequence GRU. Gate order follows gru_op.h: update u, reset r,
     candidate c; candidate uses (r * h_prev) @ W_c like the reference.
 
+    origin_mode selects the output blend (gru_kernel.h gru_finalOutput):
+    False (the fluid DEFAULT) -> h = (1-u)*h_prev + u*c;
+    True (the original GRU paper) -> h = u*h_prev + (1-u)*c.
+
     Inputs: Input (B,T,D), WeightX (D,3H), WeightH (H,3H), Bias (3H,),
     optional H0 (B,H), Length (B,).
     """
@@ -136,12 +140,15 @@ def gru(ctx):
     if reverse:
         steps = steps[::-1]
 
+    origin_mode = bool(ctx.attr("origin_mode", False))
+
     def body(h_prev, inp):
         x_t, step = inp
         ur = jax.nn.sigmoid(x_t[:, : 2 * h] + h_prev @ w_h_gates)
         u, r = ur[:, :h], ur[:, h:]
         c = jnp.tanh(x_t[:, 2 * h:] + (r * h_prev) @ w_h_cand)
-        h_new = u * h_prev + (1 - u) * c
+        h_new = (u * h_prev + (1 - u) * c) if origin_mode \
+            else ((1 - u) * h_prev + u * c)
         if lengths is not None:
             m = _len_mask(lengths, step, h_new.dtype)
             h_new = m * h_new + (1 - m) * h_prev
@@ -184,7 +191,11 @@ def gru_unit(ctx):
     ur = jax.nn.sigmoid(x[:, : 2 * h] + h_prev @ w[:, : 2 * h])
     u, r = ur[:, :h], ur[:, h:]
     c = jnp.tanh(x[:, 2 * h:] + (r * h_prev) @ w[:, 2 * h:])
-    h_new = u * h_prev + (1 - u) * c
+    # origin_mode=False is the fluid default (gru_finalOutput)
+    if bool(ctx.attr("origin_mode", False)):
+        h_new = u * h_prev + (1 - u) * c
+    else:
+        h_new = (1 - u) * h_prev + u * c
     return {"Hidden": h_new, "Gate": jnp.concatenate([ur, c], -1),
             "ResetHiddenPrev": r * h_prev}
 

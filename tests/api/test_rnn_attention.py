@@ -68,7 +68,10 @@ def test_gru_matches_numpy():
         ur = _sigmoid(xw[:, :2 * h] + h_prev @ w_h[:, :2 * h])
         u, r = ur[:, :h], ur[:, h:]
         c = np.tanh(xw[:, 2 * h:] + (r * h_prev) @ w_h[:, 2 * h:])
-        h_prev = u * h_prev + (1 - u) * c
+        # fluid default origin_mode=False: h = (1-u)*h_prev + u*c
+        # (this golden previously encoded the origin_mode=True paper
+        # blend — the exact bug test_semantic_parity2 caught)
+        h_prev = (1 - u) * h_prev + u * c
         want.append(h_prev.copy())
     np.testing.assert_allclose(got, np.stack(want, 1), rtol=2e-5, atol=2e-5)
 
