@@ -222,15 +222,55 @@ class Executor:
         infos = list(fetch_info) if fetch_info else names
         step = 0
         t0 = _time.perf_counter()
-        gb = program.global_block()
+        base_prog = getattr(program, "program", program)  # CompiledProgram
+        gb = base_prog.global_block()
         drop = None        # loop-invariant: batch key sets are identical
-        for feed in dataset._iter_batches(nthread):
-            # drop feed entries the program doesn't declare (e.g. the
-            # auto-emitted <name>_seq_len for programs that don't use it)
-            if drop is None:
-                drop = {k for k in feed if not gb.has_var(k)}
-            if drop:
-                feed = {k: v for k, v in feed.items() if k not in drop}
+
+        def batches():
+            nonlocal drop
+            for feed in dataset._iter_batches(nthread):
+                # drop feed entries the program doesn't declare (e.g. the
+                # auto-emitted <name>_seq_len when the program skips it)
+                if drop is None:
+                    drop = {k for k in feed if not gb.has_var(k)}
+                if drop:
+                    feed = {k: v for k, v in feed.items()
+                            if k not in drop}
+                yield feed
+
+        it = batches()
+        # overlap host->device transfer with device compute; on the
+        # mesh path each batch is placed straight into its sharded
+        # layout (specs recomputed only when the batch shapes change,
+        # i.e. once plus possibly the tail batch)
+        from ..reader.dataloader import device_prefetch
+        mesh = getattr(program, "mesh", None)
+        if mesh is None:
+            it = device_prefetch(it, depth=2)
+        else:
+            from .compiler import _shard_feeds_spec
+
+            def placed(src):
+                import collections
+                buf = collections.deque()
+                shapes, specs = None, None
+                for feed in src:
+                    cur = {k: getattr(v, "shape", ()) for k, v in
+                           feed.items()}
+                    if cur != shapes:
+                        shapes = cur
+                        specs = _shard_feeds_spec(
+                            {k: jnp.asarray(v) for k, v in feed.items()},
+                            mesh)
+                    buf.append({k: jax.device_put(v, specs[k])
+                                for k, v in feed.items()})
+                    if len(buf) >= 2:
+                        yield buf.popleft()
+                while buf:
+                    yield buf.popleft()
+
+            it = placed(it)
+        for feed in it:
             out = self.run(program, feed=feed, fetch_list=fetch_list,
                            scope=scope)
             step += 1

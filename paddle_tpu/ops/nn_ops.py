@@ -385,7 +385,12 @@ def _interp_src(out_size, in_size, align_corners, align_mode):
 
 
 def _lerp_axis(x, axis, out_size, align_corners, align_mode):
-    """1-D linear interpolation along `axis` (separable resize)."""
+    """1-D linear interpolation along `axis` (separable resize).
+    Integer inputs interpolate in f32 (casting the FRACTION to an int
+    dtype would truncate it to 0 and silently degrade to
+    floor-nearest); the caller casts the final result back."""
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        x = x.astype(jnp.float32)
     in_size = x.shape[axis]
     src = _interp_src(out_size, in_size, align_corners, align_mode)
     i0 = jnp.floor(src).astype(jnp.int32)
@@ -396,6 +401,14 @@ def _lerp_axis(x, axis, out_size, align_corners, align_mode):
     shape = [1] * x.ndim
     shape[axis] = -1
     return a + (b - a) * frac.reshape(shape).astype(x.dtype)
+
+
+def _cast_like(out, ref_dtype):
+    if out.dtype == ref_dtype:
+        return out
+    if not jnp.issubdtype(ref_dtype, jnp.floating):
+        out = jnp.round(out)
+    return out.astype(ref_dtype)
 
 
 def _resize_sizes(ctx, x, nd):
@@ -415,7 +428,7 @@ def bilinear_interp(ctx):
     am = ctx.attr("align_mode", 1)
     out = _lerp_axis(x, 2, oh, ac, am)
     out = _lerp_axis(out, 3, ow, ac, am)
-    return {"Out": out}
+    return {"Out": _cast_like(out, x.dtype)}
 
 
 @register("nearest_interp")
@@ -447,7 +460,7 @@ def trilinear_interp(ctx):
     out = _lerp_axis(x, 2, od, ac, am)
     out = _lerp_axis(out, 3, oh, ac, am)
     out = _lerp_axis(out, 4, ow, ac, am)
-    return {"Out": out}
+    return {"Out": _cast_like(out, x.dtype)}
 
 
 @register("affine_channel")

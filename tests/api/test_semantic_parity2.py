@@ -317,3 +317,18 @@ def test_resize_size_one_output_samples_pixel_zero():
     g0, g1 = _run(outs, {"x": x})
     assert float(np.asarray(g0).ravel()[0]) == 0.0
     assert float(np.asarray(g1).ravel()[0]) == 0.0
+
+
+def test_resize_bilinear_integer_input_interpolates():
+    """Integer images must interpolate in float and round back, not
+    silently degrade to floor-nearest (frac truncation)."""
+    x = (np.arange(16, dtype=np.int32) * 4).reshape(1, 1, 4, 4)
+    xv = layers.data("x", shape=[1, 4, 4], dtype="int32")
+    out = layers.resize_bilinear(xv, out_shape=(7, 7),
+                                 align_corners=True)
+    got, = _run(out, {"x": x})
+    want = np.round(F.interpolate(torch.from_numpy(x).float(),
+                                  size=(7, 7), mode="bilinear",
+                                  align_corners=True).numpy())
+    assert got.dtype == np.int32
+    np.testing.assert_array_equal(got, want.astype(np.int32))

@@ -126,3 +126,36 @@ def test_remat_policies_change_saved_intermediates():
     assert dots_nothing > dots_none, (
         f"policy=nothing ({dots_nothing} dots) should exceed the "
         f"no-remat baseline ({dots_none})")
+
+
+def test_sp_step_emits_ring_collective_permute():
+    """(d) sequence parallelism must actually ride the ring: a dp x sp
+    BERT step's compiled HLO carries collective-permute ops (the K/V
+    rotation). If the auto-dispatch to ring attention silently stops
+    engaging, attention falls back to full T^2 per chip and the HLO
+    loses the permutes — this trips before a hardware window would."""
+    import jax
+    from paddle_tpu.models import bert
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    cfg = bert.bert_tiny()
+    seq_len, batch = 64, 4
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        _feeds, total_loss, _mlm, _acc = bert.build_pretrain_net(
+            cfg, seq_len=seq_len)
+        fluid.optimizer.AdamOptimizer(1e-4).minimize(total_loss)
+    scope = Scope()
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        mesh = make_mesh(dp=2, sp=2, devices=jax.devices()[:4])
+        compiled = fluid.CompiledProgram(main).with_mesh(mesh)
+        feed = bert.make_pretrain_feed(cfg, seq_len, batch)
+        exe.run(compiled, feed=feed, fetch_list=[total_loss])
+    txt = exe.last_compiled_text()
+    n_cp = len(re.findall(r"\bcollective-permute(?:-start)?\(", txt))
+    assert n_cp > 0, (
+        "no collective-permute in the dp x sp step — ring attention "
+        "did not engage (sequence parallelism is running the dense "
+        "O(T^2) fallback)")
