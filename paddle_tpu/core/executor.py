@@ -240,36 +240,30 @@ class Executor:
 
         it = batches()
         # overlap host->device transfer with device compute; on the
-        # mesh path each batch is placed straight into its sharded
-        # layout (specs recomputed only when the batch shapes change,
-        # i.e. once plus possibly the tail batch)
+        # data-parallel path each batch is placed straight into its
+        # sharded mesh layout (specs memoized per batch-shape set: one
+        # entry, plus possibly the tail batch). Gate on _data_parallel,
+        # NOT the mesh property — reading CompiledProgram.mesh lazily
+        # CREATES a dp mesh, which would shard inputs for a program
+        # that run() then executes single-device.
         from ..reader.dataloader import device_prefetch
-        mesh = getattr(program, "mesh", None)
-        if mesh is None:
-            it = device_prefetch(it, depth=2)
-        else:
+        if getattr(program, "_data_parallel", False):
             from .compiler import _shard_feeds_spec
+            mesh = program.mesh
+            spec_memo = {}
 
-            def placed(src):
-                import collections
-                buf = collections.deque()
-                shapes, specs = None, None
-                for feed in src:
-                    cur = {k: getattr(v, "shape", ()) for k, v in
-                           feed.items()}
-                    if cur != shapes:
-                        shapes = cur
-                        specs = _shard_feeds_spec(
-                            {k: jnp.asarray(v) for k, v in feed.items()},
-                            mesh)
-                    buf.append({k: jax.device_put(v, specs[k])
-                                for k, v in feed.items()})
-                    if len(buf) >= 2:
-                        yield buf.popleft()
-                while buf:
-                    yield buf.popleft()
+            def sharding_for(feed):
+                key = tuple(sorted((k, getattr(v, "shape", ()))
+                                   for k, v in feed.items()))
+                if key not in spec_memo:
+                    # _shard_feeds_spec reads only .shape/.ndim — numpy
+                    # arrays go in directly, no device round-trip
+                    spec_memo[key] = _shard_feeds_spec(feed, mesh)
+                return spec_memo[key]
 
-            it = placed(it)
+            it = device_prefetch(it, depth=2, sharding_fn=sharding_for)
+        else:
+            it = device_prefetch(it, depth=2)
         for feed in it:
             out = self.run(program, feed=feed, fetch_list=fetch_list,
                            scope=scope)

@@ -61,11 +61,16 @@ def box_coder(prior_box, prior_box_var, target_box,
     helper = LayerHelper("box_coder", name=name)
     out = helper.create_variable_for_type_inference(target_box.dtype)
     inputs = {"PriorBox": prior_box, "TargetBox": target_box}
-    if prior_box_var is not None and not isinstance(prior_box_var, (list, tuple)):
-        inputs["PriorBoxVar"] = prior_box_var
-    helper.append_op("box_coder", inputs, {"Out": out},
-                     {"code_type": code_type.lower(),
-                      "box_normalized": box_normalized, "axis": axis})
+    attrs = {"code_type": code_type.lower(),
+             "box_normalized": box_normalized, "axis": axis}
+    if prior_box_var is not None:
+        if isinstance(prior_box_var, (list, tuple)):
+            # reference contract: a python list rides the variance attr
+            attrs["variance"] = [float(v) for v in prior_box_var]
+        else:
+            inputs["PriorBoxVar"] = prior_box_var
+    # reference output slot name (box_coder_op.cc): OutputBox
+    helper.append_op("box_coder", inputs, {"OutputBox": out}, attrs)
     return out
 
 

@@ -104,29 +104,72 @@ def psroi_pool(ctx):
 
 @register("box_coder")
 def box_coder(ctx):
+    """Parity: box_coder_op.h. Encode: offsets (t-p)/pw scaled by
+    1/variance; decode: cx = var*d*pw + pcx, w = pw*exp(var*d).
+    box_normalized=False adds the reference's +1 to widths (corner
+    pixels inclusive) and subtracts 1 back on decoded corners.
+    PriorBoxVar input or the `variance` attr list supplies per-coord
+    variances (both optional); decode `axis` picks which dim of the
+    (N, M, 4) target the priors broadcast along."""
     prior = ctx.in_("PriorBox")      # (M, 4)
     target = ctx.in_("TargetBox")
     code_type = ctx.attr("code_type", "encode_center_size")
-    pw = prior[:, 2] - prior[:, 0]
-    ph = prior[:, 3] - prior[:, 1]
+    normalized = bool(ctx.attr("box_normalized", True))
+    axis = ctx.attr("axis", 0)
+    one = 0.0 if normalized else 1.0
+    var = None
+    if ctx.has_in("PriorBoxVar"):
+        var = ctx.in_("PriorBoxVar")                 # (M, 4)
+    else:
+        vattr = ctx.attr("variance", None)
+        if vattr:
+            var = jnp.asarray(vattr, prior.dtype).reshape(1, 4)
+    pw = prior[:, 2] - prior[:, 0] + one
+    ph = prior[:, 3] - prior[:, 1] + one
     pcx = prior[:, 0] + 0.5 * pw
     pcy = prior[:, 1] + 0.5 * ph
     if code_type == "encode_center_size":
-        tw = target[:, 2] - target[:, 0]
-        th = target[:, 3] - target[:, 1]
+        tw = target[:, 2] - target[:, 0] + one
+        th = target[:, 3] - target[:, 1] + one
         tcx = target[:, 0] + 0.5 * tw
         tcy = target[:, 1] + 0.5 * th
-        out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
-                         jnp.log(tw / pw), jnp.log(th / ph)], axis=-1)
-    else:
-        d = target  # (N, M, 4) or (M, 4)
-        if d.ndim == 2:
-            d = d[:, None, :]
-        cx = pcx + d[..., 0] * pw
-        cy = pcy + d[..., 1] * ph
-        w = pw * jnp.exp(d[..., 2])
-        h = ph * jnp.exp(d[..., 3])
-        out = jnp.stack([cx - 0.5 * w, cy - 0.5 * h, cx + 0.5 * w, cy + 0.5 * h], axis=-1)
+        # (N, M, 4): every target against every prior (reference
+        # EncodeCenterSize loops n x m)
+        out = jnp.stack(
+            [(tcx[:, None] - pcx[None, :]) / pw[None, :],
+             (tcy[:, None] - pcy[None, :]) / ph[None, :],
+             jnp.log(jnp.abs(tw[:, None] / pw[None, :])),
+             jnp.log(jnp.abs(th[:, None] / ph[None, :]))], axis=-1)
+        if var is not None:
+            out = out / var[None, :, :]
+        return {"OutputBox": out}
+    # decode_center_size
+    d = target  # (N, M, 4) or (M, 4)
+    if d.ndim == 2:
+        d = d[:, None, :]
+    shape = [1, 1, 4]
+    # reference DecodeCenterSize: axis==0 indexes priors by the COLUMN
+    # (priors vary along target dim 1, broadcast over dim 0); axis==1
+    # indexes by the row
+    pshape = [1, 1]
+    pshape[1 - axis] = -1
+    pw_b = pw.reshape(pshape)
+    ph_b = ph.reshape(pshape)
+    pcx_b = pcx.reshape(pshape)
+    pcy_b = pcy.reshape(pshape)
+    if var is not None:
+        if var.shape[0] == 1:
+            v = var.reshape(shape)
+        else:
+            vshape = pshape + [4]
+            v = var.reshape(vshape)
+        d = d * v
+    cx = pcx_b + d[..., 0] * pw_b
+    cy = pcy_b + d[..., 1] * ph_b
+    w = pw_b * jnp.exp(d[..., 2])
+    h = ph_b * jnp.exp(d[..., 3])
+    out = jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                     cx + 0.5 * w - one, cy + 0.5 * h - one], axis=-1)
     return {"OutputBox": out}
 
 

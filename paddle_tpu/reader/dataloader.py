@@ -55,7 +55,7 @@ class DataLoader:
         return iter(_Prefetcher(self._batch_reader, self.capacity))
 
 
-def device_prefetch(batch_iter, depth=2, sharding=None):
+def device_prefetch(batch_iter, depth=2, sharding=None, sharding_fn=None):
     """Overlap host->device transfer with device compute: while step N
     runs, batch N+1 is already being device_put in the background.
 
@@ -78,8 +78,11 @@ def device_prefetch(batch_iter, depth=2, sharding=None):
         try:
             for batch in it:
                 # device_put maps over pytrees (dict/list/tuple/nested)
-                # itself; async dispatch returns at once
-                buf.append(jax.device_put(batch, sharding))
+                # itself; async dispatch returns at once. sharding_fn
+                # (when given) picks per-batch placement — the mesh
+                # training path computes specs from batch shapes
+                place = sharding_fn(batch) if sharding_fn else sharding
+                buf.append(jax.device_put(batch, place))
                 if len(buf) >= depth:
                     yield buf.popleft()
             while buf:

@@ -1,0 +1,165 @@
+"""Optimizer update-rule parity: run each optimizer through
+minimize()+exe.run on a program with a KNOWN gradient (loss =
+sum(w * feed) so dL/dw = feed) and replay the reference kernel
+formulas in numpy over several steps. Locks accumulator threading,
+beta-pow state, and epsilon placement (fluid's adam epsilon sits
+OUTSIDE the bias-correction rescale — torch's sits inside — so torch
+cannot be the golden here; paddle/fluid/operators/optimizers/*.h are).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core import framework
+from paddle_tpu.core.executor import Scope, scope_guard
+
+RS = np.random.RandomState(5)
+D = 4
+LR = 0.1
+
+
+def _run_optimizer(make_opt, steps=3, seed=9):
+    main, startup = framework.Program(), framework.Program()
+    startup.random_seed = seed
+    with framework.program_guard(main, startup):
+        x = layers.data("x", shape=[D], dtype="float32")
+        wv = layers.create_parameter([D], "float32", name="w",
+                                     default_initializer=fluid.initializer
+                                     .NormalInitializer(0.0, 1.0))
+        loss = layers.reduce_sum(layers.elementwise_mul(wv, x))
+        make_opt().minimize(loss)
+    scope = Scope()
+    exe = fluid.Executor()
+    grads = [RS.randn(D).astype(np.float32) for _ in range(steps)]
+    with scope_guard(scope):
+        exe.run(startup)
+        w0 = np.asarray(scope.get("w")).copy()
+        for g in grads:
+            exe.run(main, feed={"x": g.reshape(1, D)}, fetch_list=[loss])
+        w_final = np.asarray(scope.get("w"))
+    return w0, grads, w_final
+
+
+def test_adam_reference_formula():
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    w0, grads, got = _run_optimizer(
+        lambda: fluid.optimizer.AdamOptimizer(LR, beta1=b1, beta2=b2,
+                                              epsilon=eps))
+    w = w0.copy()
+    m = np.zeros(D); v = np.zeros(D); b1p = b2p = 1.0
+    for g in grads:
+        b1p *= b1; b2p *= b2
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        lr_t = LR * np.sqrt(1 - b2p) / (1 - b1p)
+        w = w - lr_t * m / (np.sqrt(v) + eps)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_adamax_reference_formula():
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    w0, grads, got = _run_optimizer(
+        lambda: fluid.optimizer.AdamaxOptimizer(LR, beta1=b1, beta2=b2,
+                                                epsilon=eps))
+    w = w0.copy()
+    m = np.zeros(D); inf = np.zeros(D); b1p = 1.0
+    for g in grads:
+        b1p *= b1
+        m = b1 * m + (1 - b1) * g
+        inf = np.maximum(b2 * inf, np.abs(g))
+        w = w - (LR / (1 - b1p)) * m / (inf + eps)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_adagrad_reference_formula():
+    eps = 1e-6
+    w0, grads, got = _run_optimizer(
+        lambda: fluid.optimizer.AdagradOptimizer(LR, epsilon=eps))
+    w = w0.copy(); acc = np.zeros(D)
+    for g in grads:
+        acc = acc + g * g
+        w = w - LR * g / (np.sqrt(acc) + eps)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_decayed_adagrad_reference_formula():
+    decay, eps = 0.95, 1e-6
+    w0, grads, got = _run_optimizer(
+        lambda: fluid.optimizer.DecayedAdagradOptimizer(
+            LR, decay=decay, epsilon=eps))
+    w = w0.copy(); acc = np.zeros(D)
+    for g in grads:
+        acc = decay * acc + (1 - decay) * g * g
+        w = w - LR * g / (np.sqrt(acc) + eps)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_adadelta_reference_formula():
+    rho, eps = 0.95, 1e-6
+    w0, grads, got = _run_optimizer(
+        lambda: fluid.optimizer.AdadeltaOptimizer(
+            LR, epsilon=eps, rho=rho))
+    w = w0.copy(); ag = np.zeros(D); au = np.zeros(D)
+    for g in grads:
+        ag = rho * ag + (1 - rho) * g * g
+        upd = -np.sqrt((au + eps) / (ag + eps)) * g
+        au = rho * au + (1 - rho) * upd * upd
+        w = w + upd
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("centered", [False, True])
+def test_rmsprop_reference_formula(centered):
+    rho, eps, mom = 0.95, 1e-6, 0.9
+    w0, grads, got = _run_optimizer(
+        lambda: fluid.optimizer.RMSPropOptimizer(
+            LR, rho=rho, epsilon=eps, momentum=mom, centered=centered))
+    w = w0.copy(); ms = np.zeros(D); mo = np.zeros(D); mg = np.zeros(D)
+    for g in grads:
+        ms = rho * ms + (1 - rho) * g * g
+        if centered:
+            mg = rho * mg + (1 - rho) * g
+            mo = mom * mo + LR * g / np.sqrt(ms - mg * mg + eps)
+        else:
+            mo = mom * mo + LR * g / np.sqrt(ms + eps)
+        w = w - mo
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_ftrl_reference_formula():
+    l1, l2, lrp = 0.1, 0.05, -0.5
+    w0, grads, got = _run_optimizer(
+        lambda: fluid.optimizer.FtrlOptimizer(LR, l1=l1, l2=l2,
+                                              lr_power=lrp))
+    w = w0.copy(); sq = np.zeros(D); lin = np.zeros(D)
+    for g in grads:
+        new_sq = sq + g * g
+        sigma = (new_sq ** -lrp - sq ** -lrp) / LR
+        lin = lin + g - sigma * w
+        pre = np.clip(lin, -l1, l1) - lin
+        denom = new_sq ** -lrp / LR + 2 * l2
+        w = pre / denom
+        sq = new_sq
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_lamb_reference_formula():
+    b1, b2, eps, wd = 0.9, 0.999, 1e-6, 0.01
+    w0, grads, got = _run_optimizer(
+        lambda: fluid.optimizer.LambOptimizer(
+            LR, lamb_weight_decay=wd, beta1=b1, beta2=b2, epsilon=eps))
+    w = w0.copy()
+    m = np.zeros(D); v = np.zeros(D); b1p = b2p = 1.0
+    for g in grads:
+        b1p *= b1; b2p *= b2
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        m_hat = m / (1 - b1p)
+        v_hat = v / (1 - b2p)
+        r = m_hat / (np.sqrt(v_hat) + eps) + wd * w
+        pn, rn = np.linalg.norm(w), np.linalg.norm(r)
+        trust = pn / rn if pn > 0 and rn > 0 else 1.0
+        w = w - LR * trust * r
+    np.testing.assert_allclose(got, w, rtol=1e-4, atol=1e-5)

@@ -332,3 +332,95 @@ def test_resize_bilinear_integer_input_interpolates():
                                   align_corners=True).numpy())
     assert got.dtype == np.int32
     np.testing.assert_array_equal(got, want.astype(np.int32))
+
+
+def _box_coder_run(prior, target, var, code_type, normalized=True,
+                   axis=0):
+    from paddle_tpu.layers import detection as det
+    pv = layers.data("p", shape=[4], dtype="float32")
+    tv = layers.data("t", shape=list(target.shape[1:]), dtype="float32")
+    feeds = {"p": prior, "t": target}
+    var_in = var
+    if isinstance(var, np.ndarray):
+        var_in = layers.data("pvar", shape=[4], dtype="float32")
+        feeds["pvar"] = var
+    out = det.box_coder(pv, var_in, tv, code_type=code_type,
+                        box_normalized=normalized, axis=axis)
+    got, = _run(out, feeds)
+    return np.asarray(got)
+
+
+def test_box_coder_encode_with_variance():
+    """Reference box_coder_op.h EncodeCenterSize: all-pairs (N, M, 4)
+    offsets scaled by 1/variance (this op previously paired row-to-row
+    and dropped variance entirely — untestable because the layer bound
+    the wrong output slot and could never execute)."""
+    prior = np.array([[0., 0., 4., 4.], [2., 2., 8., 10.]], np.float32)
+    var = [0.1, 0.1, 0.2, 0.2]
+    target = np.array([[1., 1., 3., 3.], [0., 0., 8., 8.]], np.float32)
+    got = _box_coder_run(prior, target, var, "encode_center_size")
+    want = np.zeros((2, 2, 4), np.float32)
+    for n in range(2):
+        for m in range(2):
+            pw, ph = prior[m, 2] - prior[m, 0], prior[m, 3] - prior[m, 1]
+            pcx, pcy = prior[m, 0] + pw / 2, prior[m, 1] + ph / 2
+            tw, th = target[n, 2] - target[n, 0], target[n, 3] - target[n, 1]
+            tcx, tcy = target[n, 0] + tw / 2, target[n, 1] + th / 2
+            want[n, m] = [(tcx - pcx) / pw / var[0],
+                          (tcy - pcy) / ph / var[1],
+                          np.log(tw / pw) / var[2],
+                          np.log(th / ph) / var[3]]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_box_coder_decode_roundtrip():
+    """decode(encode(x)) == x (same priors/variance, matched pairs)."""
+    rng = np.random.RandomState(3)
+    prior = np.abs(rng.rand(3, 4).astype(np.float32))
+    prior[:, 2:] = prior[:, :2] + 1.0 + rng.rand(3, 2).astype(np.float32)
+    boxes = np.abs(rng.rand(3, 4).astype(np.float32))
+    boxes[:, 2:] = boxes[:, :2] + 0.5 + rng.rand(3, 2).astype(np.float32)
+    var = np.array([[0.1, 0.1, 0.2, 0.2]] * 3, np.float32)
+    enc = _box_coder_run(prior, boxes, var, "encode_center_size")
+    matched = np.stack([enc[i, i] for i in range(3)])[None]  # (1, 3, 4)
+    dec = _box_coder_run(prior, matched.reshape(1, 3, 4), var,
+                         "decode_center_size")
+    np.testing.assert_allclose(dec.reshape(3, 4), boxes, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_box_coder_unnormalized_plus_one():
+    """box_normalized=False: widths are inclusive (+1), decoded corners
+    subtract it back (reference pixel-coordinate mode)."""
+    prior = np.array([[0., 0., 3., 3.]], np.float32)   # 4x4 px box
+    target = np.array([[0., 0., 3., 3.]], np.float32)
+    enc = _box_coder_run(prior, target, None, "encode_center_size",
+                         normalized=False)
+    np.testing.assert_allclose(enc.reshape(4), [0, 0, 0, 0], atol=1e-6)
+    dec = _box_coder_run(prior, np.zeros((1, 1, 4), np.float32), None,
+                         "decode_center_size", normalized=False)
+    np.testing.assert_allclose(dec.reshape(4), prior[0], atol=1e-5)
+
+
+def test_detection_output_executes_end_to_end():
+    """detection_output = box_coder decode + softmax + NMS; this path
+    was dead before the box_coder output-slot fix."""
+    rng = np.random.RandomState(4)
+    m, c = 6, 3
+    loc = rng.randn(1, m, 4).astype(np.float32) * 0.1
+    scores = rng.randn(1, m, c).astype(np.float32)
+    prior = np.abs(rng.rand(m, 4).astype(np.float32))
+    prior[:, 2:] = prior[:, :2] + 0.5
+    pvar = np.full((m, 4), 0.1, np.float32)
+
+    from paddle_tpu.layers import detection as det
+    lv = layers.data("loc", shape=[m, 4], dtype="float32")
+    sv = layers.data("sc", shape=[m, c], dtype="float32")
+    pv = layers.data("pr", shape=[4], dtype="float32")
+    vv = layers.data("pv", shape=[4], dtype="float32")
+    out = det.detection_output(lv, sv, pv, vv, score_threshold=0.0,
+                               nms_threshold=0.5)
+    got, = _run(out, {"loc": loc, "sc": scores, "pr": prior, "pv": pvar})
+    got = np.asarray(got)
+    assert got.ndim >= 2 and got.shape[-1] == 6   # [label score x1 y1 x2 y2]
+    assert np.isfinite(got).all()
