@@ -144,9 +144,13 @@ def box_coder(ctx):
             out = out / var[None, :, :]
         return {"OutputBox": out}
     # decode_center_size
-    d = target  # (N, M, 4) or (M, 4)
-    if d.ndim == 2:
-        d = d[:, None, :]
+    d = target  # (N, M, 4); a 2D (M, 4) target row-matches its priors
+    squeeze_2d = d.ndim == 2
+    if squeeze_2d:
+        # local extension (the reference only takes rank-3 here): one
+        # offset per prior -> (1, M, 4) so axis=0 pairs row i <-> prior i
+        d = d[None, :, :]
+        axis = 0
     shape = [1, 1, 4]
     # reference DecodeCenterSize: axis==0 indexes priors by the COLUMN
     # (priors vary along target dim 1, broadcast over dim 0); axis==1
@@ -170,6 +174,8 @@ def box_coder(ctx):
     h = ph_b * jnp.exp(d[..., 3])
     out = jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
                      cx + 0.5 * w - one, cy + 0.5 * h - one], axis=-1)
+    if squeeze_2d:
+        out = out[0]
     return {"OutputBox": out}
 
 

@@ -302,13 +302,32 @@ def instance_norm(ctx):
 
 @register("data_norm")
 def data_norm(ctx):
+    """Parity: data_norm_op (CTR feature normalization by running
+    batch summaries). The reference accretes the summaries through
+    PSEUDO-GRADIENTS (data_norm_op.cc grad kernel: d_size=N,
+    d_sum=sum(x), d_sqsum=sum((x-mean)^2)+N*eps) that fleet's pserver
+    applies with a decay; the TPU re-expression folds that update into
+    the forward (functional in-place, like batch_norm running stats):
+    stat' = decay * stat + batch_contribution, skipped in test mode."""
     x = ctx.in_("X")
     bsize = ctx.in_("BatchSize")
     bsum = ctx.in_("BatchSum")
     bsqsum = ctx.in_("BatchSquareSum")
+    eps = ctx.attr("epsilon", 1e-4)
     mean = bsum / bsize
-    scale = lax.rsqrt(bsqsum / bsize - mean * mean + 1e-4)
-    return {"Y": (x - mean) * scale, "Means": mean, "Scales": scale}
+    # reference forward (data_norm_op.cc:36): scales = sqrt(size/sqsum)
+    # — b_square_sum already accumulates CENTERED squares (+ N*eps), so
+    # subtracting mean^2 here would double-center and can go negative
+    scale = jnp.sqrt(bsize / bsqsum)
+    out = {"Y": (x - mean) * scale, "Means": mean, "Scales": scale}
+    if not ctx.is_test:
+        decay = ctx.attr("summary_decay_rate", 0.9999999)
+        n = x.shape[0]
+        out["BatchSizeOut"] = decay * bsize + n
+        out["BatchSumOut"] = decay * bsum + jnp.sum(x, axis=0)
+        out["BatchSquareSumOut"] = decay * bsqsum + jnp.sum(
+            (x - mean) ** 2, axis=0) + n * eps
+    return out
 
 
 @register("spectral_norm")

@@ -424,3 +424,45 @@ def test_detection_output_executes_end_to_end():
     got = np.asarray(got)
     assert got.ndim >= 2 and got.shape[-1] == 6   # [label score x1 y1 x2 y2]
     assert np.isfinite(got).all()
+
+
+def test_data_norm_updates_running_summaries():
+    """data_norm's batch summaries must ACCRETE during training (the
+    layer declared the *Out slots but the kernel never produced them,
+    so the stats stayed frozen at init forever — found by the
+    slot-mismatch audit that also caught box_coder)."""
+    from paddle_tpu.core import framework
+    from paddle_tpu.core.executor import Scope, scope_guard
+
+    rng = np.random.RandomState(6)
+    x = (rng.randn(32, 3) * 2.0 + 5.0).astype(np.float32)
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        xv = layers.data("x", shape=[3], dtype="float32")
+        out = layers.data_norm(xv, name="dn")
+    scope = Scope()
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        names = [v.name for v in main.list_vars()
+                 if v.persistable and "batch_size" in v.name]
+        size0 = np.asarray(scope.get(names[0])).copy()
+        for _ in range(3):
+            exe.run(main, feed={"x": x}, fetch_list=[out])
+        size1 = np.asarray(scope.get(names[0]))
+        # init is 1e4; each step adds decay-weighted 32
+        assert (size1 > size0).all(), "summaries froze (slot mismatch)"
+        # and mean estimate moves toward the true feature mean
+        sum_name = [v.name for v in main.list_vars()
+                    if v.persistable and "batch_sum" in v.name
+                    and "square" not in v.name][0]
+        mean_est = (np.asarray(scope.get(sum_name)) / size1)
+        # features have true mean 5; even after 3 batches (96 samples
+        # vs the 1e4-count init prior) the estimate must be strictly
+        # positive — a frozen bsum would give exactly 0 here
+        assert (mean_est > 0.01).all(), mean_est
+        # in test mode the stats stay put
+        tprog = main.clone(for_test=True)
+        exe.run(tprog, feed={"x": x}, fetch_list=[])
+        np.testing.assert_array_equal(np.asarray(scope.get(names[0])),
+                                      size1)

@@ -1,0 +1,115 @@
+"""Static layer<->kernel slot audit. Caught two real silent-failure
+bugs (box_coder bound output slot 'Out' while the kernel returned
+'OutputBox' — the op could never execute; data_norm declared summary
+*Out slots the kernel never produced — running stats froze at init).
+This test re-runs both scans so new mismatches can't land silently.
+
+Heuristic regexes, so known-benign indirections sit in allowlists:
+- optimizer ops read LearningRate through the _lr(ctx) helper;
+- accuracy's 'Out' input is unused by the reference kernel too
+  (Indices/Label carry the data);
+- beam_search takes full-vocab Scores by design (docstring'd
+  re-design: tokens derive from top-k inside the op, Ids kept for
+  ProgramDesc parity);
+- cond_pair / contrib_beam_search_decoder thread control-flow state
+  the kernels read via in_list or closures.
+"""
+
+import collections
+import os
+import re
+
+PKG = os.path.join(os.path.dirname(__file__), "..", "..", "paddle_tpu")
+
+INPUT_ALLOW = {
+    ("sgd", "LearningRate"), ("momentum", "LearningRate"),
+    ("lars_momentum", "LearningRate"), ("adagrad", "LearningRate"),
+    ("decayed_adagrad", "LearningRate"), ("adam", "LearningRate"),
+    ("adamax", "LearningRate"), ("ftrl", "LearningRate"),
+    ("lamb", "LearningRate"), ("accuracy", "Out"),
+    ("beam_search", "Ids"), ("cond_pair", "X"),
+    ("contrib_beam_search_decoder", "Free"),
+    ("contrib_beam_search_decoder", "InitScores"),
+}
+OUTPUT_ALLOW = set()
+
+
+def _kernel_slots():
+    reads = collections.defaultdict(set)
+    rets = collections.defaultdict(set)
+    ops_dir = os.path.join(PKG, "ops")
+    for f in os.listdir(ops_dir):
+        if not f.endswith(".py"):
+            continue
+        src = open(os.path.join(ops_dir, f)).read()
+        for b in re.split(r"@register\(", src)[1:]:
+            names = re.findall(r'"([a-z0-9_]+)"', b.split(")")[0])
+            reads_here = set(re.findall(
+                r'(?:ctx\.in_|ctx\.in_list|ctx\.has_in)\(\s*'
+                r'"([A-Za-z0-9_@]+)"', b))
+            ret_here = set()
+            for r in re.findall(r'return\s*\{([^}]*)\}', b, re.S):
+                ret_here |= set(re.findall(r'"([A-Za-z0-9_@]+)":', r))
+            # kernels that build the result incrementally:
+            #   out = {"Y": ...}; out["Mask"] = ...; return out
+            for r in re.findall(r'(?:res|out|outs)\s*=\s*\{([^}]*)\}',
+                                b, re.S):
+                ret_here |= set(re.findall(r'"([A-Za-z0-9_@]+)":', r))
+            ret_here |= set(re.findall(r'(?:res|out|outs)\['
+                                       r'"([A-Za-z0-9_@]+)"\]', b))
+            for n in names:
+                reads[n] |= reads_here
+                rets[n] |= ret_here
+    return reads, rets
+
+
+def _layer_calls():
+    calls = []
+    pat = re.compile(
+        r'append_op\(\s*["\']([a-z0-9_]+)["\']\s*,\s*'
+        r'(\{[^{}]*(?:\{[^{}]*\}[^{}]*)*\})\s*,\s*'
+        r'(\{[^{}]*(?:\{[^{}]*\}[^{}]*)*\})', re.S)
+    for root, dirs, files in os.walk(PKG):
+        if "ops" in root.split(os.sep):
+            continue
+        for f in files:
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(root, f)
+            src = open(path).read()
+            for m in pat.finditer(src):
+                ins = set(re.findall(r'["\']([A-Za-z0-9_@]+)["\']\s*:',
+                                     m.group(2)))
+                outs = set(re.findall(r'["\']([A-Za-z0-9_@]+)["\']\s*:',
+                                      m.group(3)))
+                line = src[:m.start()].count("\n") + 1
+                calls.append((path, line, m.group(1), ins, outs))
+    return calls
+
+
+def test_no_unread_input_slots():
+    reads, _ = _kernel_slots()
+    bad = []
+    for path, line, op, ins, _outs in _layer_calls():
+        if op not in reads or not reads[op]:
+            continue
+        for slot in ins - reads[op]:
+            if (op, slot) not in INPUT_ALLOW:
+                bad.append(f"{path}:{line} op '{op}' input '{slot}' is "
+                           f"never read by the kernel")
+    assert not bad, "\n".join(bad)
+
+
+def test_no_unbound_output_slots():
+    _, rets = _kernel_slots()
+    bad = []
+    for path, line, op, _ins, outs in _layer_calls():
+        if op not in rets or not rets[op]:
+            continue
+        for slot in outs - rets[op]:
+            if (op, slot) not in OUTPUT_ALLOW:
+                bad.append(f"{path}:{line} op '{op}' output '{slot}' is "
+                           f"never produced by the kernel (the var "
+                           f"stays unbound -> silent box_coder-class "
+                           f"bug)")
+    assert not bad, "\n".join(bad)
