@@ -15,6 +15,11 @@ equivalent property holds on TPU:
     save-nothing policy recomputes forward dots in the backward pass,
     so its HLO carries strictly more dot ops than the checkpoint-dots
     policy at equal numerics.
+(d) a dp x sp step carries collective-permute ops — the ring-attention
+    K/V rotation; losing them means the sp auto-dispatch regressed to
+    the dense O(T^2) fallback.
+(e) conv analogue of (b): no f32 convolution operands after the bf16
+    cast (ResNet-class models halve their MXU rate otherwise).
 """
 
 import re
@@ -159,3 +164,42 @@ def test_sp_step_emits_ring_collective_permute():
         "no collective-permute in the dp x sp step — ring attention "
         "did not engage (sequence parallelism is running the dense "
         "O(T^2) fallback)")
+
+
+def test_bf16_cast_leaves_no_f32_convs():
+    """(e) conv path analogue of (b): after cast_model_to_bf16 a conv
+    net's lowered step must carry no f32 convolution operands — ResNet
+    MFU halves if convs miss the bf16 MXU path."""
+    from paddle_tpu import amp
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = layers.data("img", shape=[3, 16, 16], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        h = layers.conv2d(x, num_filters=8, filter_size=3, padding=1,
+                          act="relu")
+        h = layers.conv2d(h, num_filters=8, filter_size=3, padding=1,
+                          act="relu")
+        h = layers.pool2d(h, pool_size=16, pool_type="avg",
+                          global_pooling=True)
+        logits = layers.fc(layers.flatten(h), size=10)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits,
+                                                             label))
+        fluid.optimizer.MomentumOptimizer(0.1, 0.9).minimize(loss)
+    amp.cast_model_to_bf16(main)
+    scope = Scope()
+    exe = fluid.Executor()
+    rng = np.random.default_rng(0)
+    with scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={
+            "img": rng.standard_normal((4, 3, 16, 16)).astype(np.float32),
+            "label": rng.integers(0, 10, (4, 1)).astype(np.int64)},
+            fetch_list=[loss])
+    txt = exe.last_lowered_text()
+    convs = re.findall(r"stablehlo\.convolution.*", txt)
+    assert convs, "no convolutions in the audit net"
+    f32 = [c for c in convs if "xf32>" in c]
+    assert not f32, (
+        f"{len(f32)} of {len(convs)} convs touch f32 operands after "
+        f"cast_model_to_bf16: {f32[:2]}")
