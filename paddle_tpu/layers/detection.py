@@ -296,7 +296,9 @@ def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
     helper.append_op("bipartite_match", {"DistMat": dist_matrix},
                      {"ColToRowMatchIndices": idx,
                       "ColToRowMatchDist": dist},
-                     {"match_type": match_type or "bipartite"})
+                     {"match_type": match_type or "bipartite",
+                      "dist_threshold": (0.5 if dist_threshold is None
+                                         else float(dist_threshold))})
     return idx, dist
 
 

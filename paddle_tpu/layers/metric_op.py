@@ -24,6 +24,10 @@ def accuracy(input, label, k=1, correct=None, total=None):
 
 def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
         slide_steps=1):
+    if str(curve).upper() != "ROC":
+        raise NotImplementedError(
+            "auc: only curve='ROC' is implemented (the exact rank-"
+            "statistic form); the reference's 'PR' curve is not ported")
     helper = LayerHelper("auc")
     n = num_thresholds + 1
     stat_pos = helper.create_or_get_global_variable(

@@ -162,6 +162,12 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
                   padding=True, padding_start=None, param_attr=None,
                   bias_attr=None, act=None, name=None):
     """Parity: fluid.layers.sequence_conv — context-window projection."""
+    if filter_stride != 1:
+        # reference sequence_conv enforces contextStride == 1 too
+        raise NotImplementedError(
+            "sequence_conv only supports filter_stride=1 (as the "
+            "reference: sequence_conv_op.cc currently only supports "
+            "contextStride=1)")
     helper = LayerHelper("sequence_conv", param_attr=param_attr,
                          bias_attr=bias_attr, act=act, name=name)
     d = input.shape[-1]

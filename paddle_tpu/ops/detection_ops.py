@@ -14,13 +14,17 @@ from . import register
 
 @register("iou_similarity")
 def iou_similarity(ctx):
+    """Parity: iou_similarity_op. box_normalized=False means inclusive
+    pixel coordinates: +1 on every width/height (same convention as
+    box_coder's unnormalized mode)."""
     x = ctx.in_("X")  # (N, 4) xmin,ymin,xmax,ymax
     y = ctx.in_("Y")  # (M, 4)
-    area_x = (x[:, 2] - x[:, 0]) * (x[:, 3] - x[:, 1])
-    area_y = (y[:, 2] - y[:, 0]) * (y[:, 3] - y[:, 1])
+    one = 0.0 if ctx.attr("box_normalized", True) else 1.0
+    area_x = (x[:, 2] - x[:, 0] + one) * (x[:, 3] - x[:, 1] + one)
+    area_y = (y[:, 2] - y[:, 0] + one) * (y[:, 3] - y[:, 1] + one)
     lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
     rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
-    wh = jnp.clip(rb - lt, 0.0, None)
+    wh = jnp.clip(rb - lt + one, 0.0, None)
     inter = wh[..., 0] * wh[..., 1]
     return {"Out": inter / jnp.maximum(area_x[:, None] + area_y[None, :] - inter, 1e-10)}
 
@@ -204,6 +208,11 @@ def yolo_box(ctx):
     imgw = img_size[:, 1].reshape(n, 1, 1, 1).astype(jnp.float32)
     boxes = jnp.stack([(bx - bw / 2) * imgw, (by - bh / 2) * imgh,
                        (bx + bw / 2) * imgw, (by + bh / 2) * imgh], axis=-1)
+    if ctx.attr("clip_bbox", True):
+        # reference yolo_box_op default: clamp to the image rectangle
+        lim = jnp.stack([imgw, imgh, imgw, imgh],
+                        axis=-1).reshape(n, 1, 1, 1, 4) - 1.0
+        boxes = jnp.clip(boxes, 0.0, lim)
     boxes = boxes.reshape(n, -1, 4)
     probs = jnp.moveaxis(probs, 2, -1).reshape(n, -1, class_num)
     mask = (conf.reshape(n, -1, 1) > conf_thresh).astype(boxes.dtype)
@@ -330,19 +339,10 @@ def _nms_single(boxes, scores, score_thresh, nms_thresh, top_k):
     return keep, order, top_scores
 
 
-@register("multiclass_nms")
-def multiclass_nms(ctx):
-    """Parity: paddle/fluid/operators/detection/multiclass_nms_op.cc.
-    Static-shape output: (N, keep_top_k, 6) [class, score, x1, y1, x2, y2]
-    padded with -1 rows (the TPU replacement for the reference's LoD
-    variable-length output)."""
-    bboxes = ctx.in_("BBoxes")   # (N, M, 4)
-    scores = ctx.in_("Scores")   # (N, C, M)
-    score_thresh = ctx.attr("score_threshold", 0.01)
-    nms_thresh = ctx.attr("nms_threshold", 0.3)
-    nms_top_k = ctx.attr("nms_top_k", 64)
-    keep_top_k = ctx.attr("keep_top_k", 100)
-    background = ctx.attr("background_label", 0)
+def _multiclass_nms_arrays(bboxes, scores, score_thresh, nms_thresh,
+                           nms_top_k, keep_top_k, background):
+    """Shared multiclass NMS core: bboxes (N, M, 4), scores (N, C, M)
+    -> (N, keep_top_k, 6) [class, score, x1, y1, x2, y2] padded -1."""
     n, c, m = scores.shape
 
     def per_image(boxes_i, scores_i):
@@ -370,7 +370,20 @@ def multiclass_nms(ctx):
                           constant_values=-1.0)
         return out
 
-    return {"Out": jax.vmap(per_image)(bboxes, scores)}
+    return jax.vmap(per_image)(bboxes, scores)
+
+
+@register("multiclass_nms")
+def multiclass_nms(ctx):
+    """Parity: paddle/fluid/operators/detection/multiclass_nms_op.cc.
+    Static-shape output: (N, keep_top_k, 6) [class, score, x1, y1, x2, y2]
+    padded with -1 rows (the TPU replacement for the reference's LoD
+    variable-length output)."""
+    return {"Out": _multiclass_nms_arrays(
+        ctx.in_("BBoxes"), ctx.in_("Scores"),
+        ctx.attr("score_threshold", 0.01), ctx.attr("nms_threshold", 0.3),
+        ctx.attr("nms_top_k", 64), ctx.attr("keep_top_k", 100),
+        ctx.attr("background_label", 0))}
 
 
 @register("ssd_loss")
@@ -387,23 +400,14 @@ def ssd_loss(ctx):
     prior = ctx.in_("PriorBox")      # (M, 4)
     overlap_thresh = ctx.attr("overlap_threshold", 0.5)
     neg_ratio = ctx.attr("neg_pos_ratio", 3.0)
-
-    def iou_mat(a, b):
-        ix1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
-        iy1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
-        ix2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
-        iy2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
-        inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
-        aa = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(a[:, 3] - a[:, 1], 0)
-        ab = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(b[:, 3] - b[:, 1], 0)
-        return inter / jnp.maximum(aa[:, None] + ab[None, :] - inter, 1e-10)
+    background = int(ctx.attr("background_label", 0))
 
     def per_image(loc_i, conf_i, gt_b, gt_l):
-        iou = iou_mat(prior, gt_b)            # (M, G)
+        iou = _iou_matrix(prior, gt_b)        # (M, G)
         best_iou = iou.max(axis=1)
         best_gt = iou.argmax(axis=1)
         pos = best_iou > overlap_thresh       # (M,)
-        target_label = jnp.where(pos, gt_l[best_gt], 0)
+        target_label = jnp.where(pos, gt_l[best_gt], background)
         # localization target: encode matched gt vs prior (center-size)
         pw = prior[:, 2] - prior[:, 0]
         ph = prior[:, 3] - prior[:, 1]
@@ -515,8 +519,19 @@ def bipartite_match(ctx):
             jnp.where(valid, jnp.arange(g), -1))[:m]
         col_dist = jnp.zeros((m + 1,), s.dtype).at[tgt].set(
             jnp.where(valid, md, 0.0))[:m]
+        if match_type == "per_prediction":
+            # reference bipartite_match_op ArgMaxMatch extension: every
+            # still-unmatched column joins its argmax row when the
+            # similarity clears dist_threshold (SSD's matching mode)
+            best_row = jnp.argmax(s, axis=0)
+            best_val = jnp.max(s, axis=0)
+            extra = (col_idx < 0) & (best_val >= dist_threshold)
+            col_idx = jnp.where(extra, best_row.astype(jnp.int32), col_idx)
+            col_dist = jnp.where(extra, best_val, col_dist)
         return col_idx, col_dist
 
+    match_type = ctx.attr("match_type", "bipartite")
+    dist_threshold = ctx.attr("dist_threshold", 0.5)
     idx, dist = jax.vmap(one)(sim)
     if squeeze:
         idx, dist = idx[0], dist[0]
@@ -793,21 +808,22 @@ def _to_int(v):
 
 @register("retinanet_detection_output")
 def retinanet_detection_output(ctx):
-    """RetinaNet post-process: per-level top-k by score, decode vs anchors,
-    concatenate (NMS left to multiclass_nms host path, same split as the
-    SSD pipeline here)."""
+    """RetinaNet post-process (parity: retinanet_detection_output_op):
+    concatenate levels, sigmoid the class logits, and run the SAME
+    per-class NMS core as multiclass_nms with this op's nms_top_k /
+    keep_top_k / nms_threshold attrs (RetinaNet has no background
+    channel, so every class competes)."""
     bboxes = ctx.in_list("BBoxes")     # per level (N, M, 4)
-    scores = ctx.in_list("Scores")     # per level (N, M, C) sigmoid logits
-    score_thresh = ctx.attr("score_threshold", 0.05)
+    scores = ctx.in_list("Scores")     # per level (N, M, C) logits
     allb = jnp.concatenate(bboxes, axis=1)
-    alls = jax.nn.sigmoid(jnp.concatenate(scores, axis=1))
-    keep = alls > score_thresh
-    best = alls.max(-1)
-    cls = alls.argmax(-1)
-    out = jnp.concatenate([
-        cls[..., None].astype(allb.dtype), best[..., None] * keep.any(-1,
-                                                                      keepdims=True),
-        allb], axis=-1)
+    alls = jax.nn.sigmoid(jnp.concatenate(scores, axis=1))  # (N, M, C)
+    out = _multiclass_nms_arrays(
+        allb, jnp.swapaxes(alls, 1, 2),
+        ctx.attr("score_threshold", 0.05),
+        ctx.attr("nms_threshold", 0.3),
+        ctx.attr("nms_top_k", 1000),
+        ctx.attr("keep_top_k", 100),
+        background=-1)
     return {"Out": out}
 
 

@@ -484,9 +484,14 @@ def trilinear_interp(ctx):
 
 @register("affine_channel")
 def affine_channel(ctx):
+    """Parity: affine_channel_op — per-channel scale+bias; data_layout
+    picks which axis carries channels (NCHW default, NHWC last)."""
     x = ctx.in_("X")
-    cshape = [1, x.shape[1]] + [1] * (x.ndim - 2)
-    return {"Out": x * ctx.in_("Scale").reshape(cshape) + ctx.in_("Bias").reshape(cshape)}
+    caxis = 1 if ctx.attr("data_layout", "NCHW") == "NCHW" else x.ndim - 1
+    cshape = [1] * x.ndim
+    cshape[caxis] = x.shape[caxis]
+    return {"Out": x * ctx.in_("Scale").reshape(cshape)
+            + ctx.in_("Bias").reshape(cshape)}
 
 
 @register("temporal_shift")

@@ -365,8 +365,9 @@ def unique(ctx):
     n = x.size
     out, idx, counts = jnp.unique(x, return_inverse=True, return_counts=True,
                                   size=n, fill_value=0)
-    return {"Out": out, "Index": idx.astype(jnp.int32),
-            "Count": counts.astype(jnp.int32)}
+    idt = _np_dtype(ctx.attr("dtype", "int32"))
+    return {"Out": out, "Index": idx.astype(idt),
+            "Count": counts.astype(idt)}
 
 
 @register("shard_index")

@@ -466,3 +466,88 @@ def test_data_norm_updates_running_summaries():
         exe.run(tprog, feed={"x": x}, fetch_list=[])
         np.testing.assert_array_equal(np.asarray(scope.get(names[0])),
                                       size1)
+
+
+def test_attr_audit_fixes_detection_family():
+    """Numeric checks for the attrs the audit found silently dropped:
+    iou_similarity box_normalized (+1 widths), yolo_box clip_bbox,
+    bipartite_match per_prediction, affine_channel NHWC."""
+    from paddle_tpu.core.layer_helper import LayerHelper
+
+    # iou_similarity: identical 1-pixel boxes; normalized gives IoU 0,
+    # unnormalized (inclusive corners) gives 1
+    b = np.array([[2., 2., 2., 2.]], np.float32)
+    xv = layers.data("bx", shape=[4], dtype="float32")
+    yv = layers.data("by", shape=[4], dtype="float32")
+    helper = LayerHelper("iou_similarity")
+    o_n = helper.create_variable_for_type_inference("float32")
+    o_u = helper.create_variable_for_type_inference("float32")
+    helper.append_op("iou_similarity", {"X": xv, "Y": yv}, {"Out": o_n},
+                     {"box_normalized": True})
+    helper.append_op("iou_similarity", {"X": xv, "Y": yv}, {"Out": o_u},
+                     {"box_normalized": False})
+    gn, gu = _run([o_n, o_u], {"bx": b, "by": b})
+    assert float(np.asarray(gn).ravel()[0]) == 0.0
+    assert abs(float(np.asarray(gu).ravel()[0]) - 1.0) < 1e-6
+
+    # affine_channel NHWC: channels on the last axis
+    x = RS.randn(2, 3, 3, 4).astype(np.float32)
+    s = RS.rand(4).astype(np.float32) + 0.5
+    bi = RS.randn(4).astype(np.float32)
+    xv2 = layers.data("ac", shape=[3, 3, 4], dtype="float32")
+    sv = layers.data("acs", shape=[4], dtype="float32")
+    bv = layers.data("acb", shape=[4], dtype="float32")
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op("affine_channel",
+                     {"X": xv2, "Scale": sv, "Bias": bv}, {"Out": out},
+                     {"data_layout": "NHWC"})
+    got, = _run(out, {"ac": x, "acs": s, "acb": bi})
+    np.testing.assert_allclose(got, x * s + bi, rtol=1e-6)
+
+
+def test_bipartite_match_per_prediction():
+    """per_prediction (SSD's mode): unmatched priors above
+    dist_threshold also bind to their argmax gt."""
+    from paddle_tpu.core.layer_helper import LayerHelper
+    # 2 gt x 3 priors: bipartite matches (g0,p0) and (g1,p1); prior 2
+    # overlaps g1 at 0.6 -> per_prediction adds it, 0.3 would not
+    sim = np.array([[[0.9, 0.2, 0.1],
+                     [0.3, 0.8, 0.6]]], np.float32)
+    dv = layers.data("d", shape=[2, 3], dtype="float32")
+    helper = LayerHelper("bipartite_match")
+    for mt, want in (("bipartite", [0, 1, -1]),
+                     ("per_prediction", [0, 1, 1])):
+        idx = helper.create_variable_for_type_inference("int32")
+        dist = helper.create_variable_for_type_inference("float32")
+        helper.append_op("bipartite_match", {"DistMat": dv},
+                         {"ColToRowMatchIndices": idx,
+                          "ColToRowMatchDist": dist},
+                         {"match_type": mt, "dist_threshold": 0.5})
+        got, = _run([idx], {"d": sim})
+        np.testing.assert_array_equal(np.asarray(got)[0], want)
+
+
+def test_yolo_box_clips_to_image():
+    from paddle_tpu.core.layer_helper import LayerHelper
+    rng = np.random.RandomState(1)
+    x = (rng.randn(1, 2 * 7, 2, 2) * 3).astype(np.float32)  # 1 anchor
+    img = np.array([[20, 20]], np.int32)
+    xv = layers.data("yx", shape=[14, 2, 2], dtype="float32")
+    iv = layers.data("yi", shape=[2], dtype="int32")
+    helper = LayerHelper("yolo_box")
+    boxes_c = helper.create_variable_for_type_inference("float32")
+    score_c = helper.create_variable_for_type_inference("float32")
+    boxes_n = helper.create_variable_for_type_inference("float32")
+    score_n = helper.create_variable_for_type_inference("float32")
+    attrs = {"anchors": [10, 10, 16, 30], "class_num": 2,
+             "conf_thresh": 0.0, "downsample_ratio": 10}
+    helper.append_op("yolo_box", {"X": xv, "ImgSize": iv},
+                     {"Boxes": boxes_c, "Scores": score_c},
+                     dict(attrs, clip_bbox=True))
+    helper.append_op("yolo_box", {"X": xv, "ImgSize": iv},
+                     {"Boxes": boxes_n, "Scores": score_n},
+                     dict(attrs, clip_bbox=False))
+    gc, gn = _run([boxes_c, boxes_n], {"yx": x, "yi": img})
+    gc, gn = np.asarray(gc), np.asarray(gn)
+    assert gc.min() >= 0.0 and gc.max() <= 19.0
+    assert gn.min() < 0.0 or gn.max() > 19.0   # something got clipped

@@ -33,6 +33,40 @@ INPUT_ALLOW = {
 }
 OUTPUT_ALLOW = set()
 
+# attrs read indirectly (op.attrs.get in helpers) or deliberately
+# informational; everything else passed-but-unread is an
+# align_corners-class silent drop and fails the audit
+ATTR_ALLOW = {
+    # ctx.rng() folds op_seed via op.attrs.get (ops/__init__.py:93)
+    ("uniform_random", "op_seed"), ("gaussian_random", "op_seed"),
+    ("gaussian_random_batch_size_like", "op_seed"),
+    ("sampling_id", "op_seed"), ("random_crop", "op_seed"),
+    ("dropout", "op_seed"), ("sample_logits", "op_seed"),
+    # OpContext.__init__ consumes is_test from op.attrs
+    ("batch_norm", "is_test"), ("dropout", "is_test"),
+    # read via the _resize_sizes name loop (ctx.attr(nm))
+    ("trilinear_interp", "out_d"), ("trilinear_interp", "out_h"),
+    ("trilinear_interp", "out_w"),
+    # informational/shape-inference only (kernels derive from data):
+    # classes from gt labels; beam dims from input shapes; var count
+    # from slot lists; dense grads by design (SURVEY §1 tensor row)
+    ("detection_map", "class_num"),
+    ("beam_search_decode", "beam_size"), ("beam_search_decode", "end_id"),
+    ("while_loop", "n_vars"), ("lookup_table", "is_sparse"),
+    # exact rank-statistic AUC needs no threshold binning; curve is
+    # validated at the layer (ROC only)
+    ("auc", "curve"), ("auc", "num_thresholds"),
+    # layer validates stride==1 before appending (reference constraint)
+    ("sequence_conv", "contextStride"),
+    # multiclass_nms2 delegates to the multiclass_nms kernel, which
+    # reads all five attrs from the SAME ctx
+    ("multiclass_nms2", "score_threshold"),
+    ("multiclass_nms2", "nms_threshold"),
+    ("multiclass_nms2", "nms_top_k"),
+    ("multiclass_nms2", "keep_top_k"),
+    ("multiclass_nms2", "background_label"),
+}
+
 
 def _kernel_slots():
     reads = collections.defaultdict(set)
@@ -112,4 +146,48 @@ def test_no_unbound_output_slots():
                            f"never produced by the kernel (the var "
                            f"stays unbound -> silent box_coder-class "
                            f"bug)")
+    assert not bad, "\n".join(bad)
+
+
+def test_no_unread_attrs():
+    """align_corners-class audit: every attr a layer passes must be
+    read by the kernel (ctx.attr) or sit in ATTR_ALLOW with a reason.
+    NOTE: only matches append_op calls with LITERAL ins/outs dicts —
+    calls passing dict VARIABLES escape this audit (heuristic limit)."""
+    op_attrs = collections.defaultdict(set)
+    ops_dir = os.path.join(PKG, "ops")
+    for f in os.listdir(ops_dir):
+        if not f.endswith(".py"):
+            continue
+        src = open(os.path.join(ops_dir, f)).read()
+        for b in re.split(r"@register\(", src)[1:]:
+            names = re.findall(r'"([a-z0-9_]+)"', b.split(")")[0])
+            reads = set(re.findall(r'ctx\.attr\(\s*"([A-Za-z0-9_]+)"', b))
+            for n in names:
+                op_attrs[n] |= reads
+    pat = re.compile(
+        r'append_op\(\s*["\']([a-z0-9_]+)["\']\s*,\s*'
+        r'(\{[^{}]*(?:\{[^{}]*\}[^{}]*)*\})\s*,\s*'
+        r'(\{[^{}]*(?:\{[^{}]*\}[^{}]*)*\})\s*,\s*'
+        r'(\{[^{}]*(?:\{[^{}]*\}[^{}]*)*\})', re.S)
+    bad = []
+    for root, _dirs, files in os.walk(PKG):
+        if "ops" in root.split(os.sep):
+            continue
+        for f in files:
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(root, f)
+            src = open(path).read()
+            for m in pat.finditer(src):
+                op, attrs = m.group(1), m.group(4)
+                keys = set(re.findall(r'["\']([A-Za-z0-9_]+)["\']\s*:',
+                                      attrs))
+                if op not in op_attrs:
+                    continue
+                for k in keys - op_attrs[op]:
+                    if (op, k) not in ATTR_ALLOW:
+                        line = src[:m.start()].count("\n") + 1
+                        bad.append(f"{path}:{line} op '{op}' attr '{k}' "
+                                   f"is never read by the kernel")
     assert not bad, "\n".join(bad)
