@@ -40,7 +40,7 @@ class OptimizerWithMixedPrecision:
 
     def __init__(self, optimizer, amp_lists, init_loss_scaling,
                  use_dynamic_loss_scaling, incr_every_n_steps=1000,
-                 decr_every_n_nan_or_inf=2, incr_ratio=2.0, decr_ratio=0.5):
+                 decr_every_n_nan_or_inf=2, incr_ratio=2.0, decr_ratio=0.8):
         self._optimizer = optimizer
         self._amp_lists = amp_lists
         self._init_loss_scaling = init_loss_scaling
@@ -152,11 +152,13 @@ class OptimizerWithMixedPrecision:
                         {"Out": good}, {"axis": -1})
 
 
-def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
+def decorate(optimizer, amp_lists=None, init_loss_scaling=1.0,
              incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
-             incr_ratio=2.0, decr_ratio=0.5,
-             use_dynamic_loss_scaling=True):
-    """Parity: fluid.contrib.mixed_precision.decorate."""
+             incr_ratio=2.0, decr_ratio=0.8,
+             use_dynamic_loss_scaling=False):
+    """Parity: fluid.contrib.mixed_precision.decorate — defaults match
+    the 1.5 reference (decorator.py:205): STATIC loss scale 1.0 unless
+    use_dynamic_loss_scaling is opted in, decr_ratio 0.8."""
     if amp_lists is None:
         amp_lists = AutoMixedPrecisionLists()
     return OptimizerWithMixedPrecision(

@@ -78,7 +78,11 @@ def tpu_places(device_ids=None):
 cuda_places = tpu_places
 
 
-def cpu_places(device_count=1):
+def cpu_places(device_count=None):
+    """Parity: fluid.cpu_places — None reads CPU_NUM env (default 1)."""
+    import os
+    if device_count is None:
+        device_count = int(os.environ.get("CPU_NUM", 1))
     return [CPUPlace() for _ in range(device_count)]
 
 

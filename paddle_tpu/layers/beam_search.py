@@ -14,10 +14,12 @@ __all__ = ["beam_search", "beam_search_decode"]
 
 
 def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
-                level=0, is_accumulated=True, return_parent_idx=True,
-                name=None):
-    """One expansion step. scores: (B*K, V) probabilities. Returns
-    (selected_ids (B*K, 1), selected_scores (B*K, 1), parent_idx (B*K,))."""
+                level=0, is_accumulated=True, name=None,
+                return_parent_idx=False):
+    """One expansion step (reference arg ORDER: name before
+    return_parent_idx, nn.py:4555). scores: (B*K, V) probabilities.
+    Returns (selected_ids (B*K, 1), selected_scores (B*K, 1)) — plus
+    parent_idx (B*K,) when return_parent_idx=True."""
     helper = LayerHelper("beam_search", name=name)
     sel_ids = helper.create_variable_for_type_inference(
         "int64", (scores.shape[0], 1))

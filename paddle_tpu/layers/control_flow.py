@@ -502,7 +502,7 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None):
     return outs
 
 
-def Print(input, first_n=-1, message=None, summarize=-1,
+def Print(input, first_n=-1, message=None, summarize=20,
           print_tensor_name=True, print_tensor_type=True,
           print_tensor_shape=True, print_tensor_lod=True,
           print_phase="both"):

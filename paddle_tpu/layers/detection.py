@@ -246,7 +246,7 @@ def detection_output(loc, scores, prior_box, prior_box_var,
 
 def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
                 ignore_thresh, downsample_ratio, gt_score=None,
-                use_label_smooth=False, name=None):
+                use_label_smooth=True, name=None):
     """Parity: fluid.layers.yolov3_loss."""
     from ..core.layer_helper import LayerHelper
     helper = LayerHelper("yolov3_loss", name=name)
@@ -260,7 +260,8 @@ def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
                       "anchor_mask": list(anchor_mask),
                       "class_num": class_num,
                       "ignore_thresh": ignore_thresh,
-                      "downsample_ratio": downsample_ratio})
+                      "downsample_ratio": downsample_ratio,
+                      "use_label_smooth": use_label_smooth})
     return loss
 
 
@@ -303,7 +304,7 @@ def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
 
 
 def target_assign(input, matched_indices, negative_indices=None,
-                  mismatch_value=0, name=None):
+                  mismatch_value=None, name=None):
     """Parity: fluid.layers.target_assign."""
     from ..core.layer_helper import LayerHelper
     helper = LayerHelper("target_assign", name=name)
@@ -482,12 +483,19 @@ def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
 def generate_proposal_labels(rpn_rois, gt_classes, is_crowd=None,
                              gt_boxes=None, im_info=None,
                              batch_size_per_im=256, fg_fraction=0.25,
-                             fg_thresh=0.5, bg_thresh_hi=0.5,
-                             bg_thresh_lo=0.0, bbox_reg_weights=None,
-                             class_nums=81, use_random=True,
+                             fg_thresh=0.25, bg_thresh_hi=0.5,
+                             bg_thresh_lo=0.0,
+                             bbox_reg_weights=[0.1, 0.1, 0.2, 0.2],
+                             class_nums=None, use_random=True,
                              is_cls_agnostic=False, is_cascade_rcnn=False):
     """Parity: fluid.layers.generate_proposal_labels. Static outputs
-    (N, batch_size_per_im, ...); label -1 marks padding rows."""
+    (N, batch_size_per_im, ...); label -1 marks padding rows.
+    Reference-default knobs: fg_thresh 0.25, bbox_reg_weights
+    [0.1, 0.1, 0.2, 0.2]; class_nums has no default (required)."""
+    if class_nums is None:
+        raise ValueError(
+            "generate_proposal_labels: class_nums is required (the "
+            "reference default of None fails the same way)")
     helper = LayerHelper("generate_proposal_labels")
     n = rpn_rois.shape[0]
     r = batch_size_per_im

@@ -589,7 +589,7 @@ def sum(x, name=None):
     return out
 
 
-def cumsum(x, axis=None, exclusive=False, reverse=False, name=None):
+def cumsum(x, axis=None, exclusive=None, reverse=None, name=None):
     helper = LayerHelper("cumsum", name=name)
     out = helper.create_variable_for_type_inference(x.dtype, x.shape)
     attrs = {"exclusive": exclusive, "reverse": reverse}
@@ -1284,8 +1284,8 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None
 
 
 def deformable_conv(input, offset, mask, num_filters, filter_size, stride=1,
-                    padding=0, dilation=1, groups=None, deformable_groups=1,
-                    im2col_step=1, param_attr=None, bias_attr=None,
+                    padding=0, dilation=1, groups=None, deformable_groups=None,
+                    im2col_step=None, param_attr=None, bias_attr=None,
                     modulated=True, name=None):
     """Parity: fluid.layers.deformable_conv (v1/v2)."""
     helper = LayerHelper("deformable_conv", param_attr=param_attr,
@@ -1314,7 +1314,7 @@ def deformable_conv(input, offset, mask, num_filters, filter_size, stride=1,
 
 
 def deformable_roi_pooling(input, rois, trans, no_trans=False,
-                           spatial_scale=1.0, group_size=[1],
+                           spatial_scale=1.0, group_size=[1, 1],
                            pooled_height=1, pooled_width=1, part_size=None,
                            sample_per_part=1, trans_std=0.1,
                            position_sensitive=False, name=None):

@@ -121,6 +121,6 @@ def random_crop(x, shape, seed=None):
     return out
 
 
-def cumsum(x, axis=None, exclusive=False, reverse=False):
+def cumsum(x, axis=None, exclusive=None, reverse=None):
     from .nn import cumsum as _cumsum
     return _cumsum(x, axis, exclusive, reverse)

@@ -545,6 +545,8 @@ def target_assign(ctx):
     x = ctx.in_("X")                   # (B, G, K) gt attributes
     match = ctx.in_("MatchIndices")    # (B, M) gt row per prior, -1 none
     mismatch_value = ctx.attr("mismatch_value", 0)
+    if mismatch_value is None:     # reference default: fill with 0
+        mismatch_value = 0
     safe = jnp.maximum(match, 0)
     g = jnp.take_along_axis(x, safe[..., None], axis=1)
     neg = match < 0
@@ -597,11 +599,12 @@ def yolov3_loss(ctx):
     num_classes = ctx.attr("class_num")
     ignore_thresh = ctx.attr("ignore_thresh", 0.7)
     downsample = ctx.attr("downsample_ratio", 32)
-    if ctx.in_("GTScore") is not None or ctx.attr("use_label_smooth", False):
+    use_label_smooth = bool(ctx.attr("use_label_smooth", True))
+    if ctx.in_("GTScore") is not None:
         import warnings
         warnings.warn(
-            "yolov3_loss: gt_score / use_label_smooth are not supported "
-            "and will be ignored", RuntimeWarning, stacklevel=2)
+            "yolov3_loss: gt_score weighting is not supported and will "
+            "be ignored", RuntimeWarning, stacklevel=2)
     n, _, h, w = x.shape
     na = len(mask)
     all_anchors = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
@@ -699,6 +702,11 @@ def yolov3_loss(ctx):
     obj_loss = bce_obj.reshape(n, -1).sum(-1)
 
     tcls = jax.nn.one_hot(gt_label, num_classes)
+    if use_label_smooth:
+        # reference yolov3_loss_op.h:285: sw = min(1/C, 1/40);
+        # positives 1-sw, negatives sw (the fluid DEFAULT is smoothing ON)
+        sw = min(1.0 / num_classes, 1.0 / 40.0)
+        tcls = tcls * (1.0 - sw) + (1.0 - tcls) * sw
     pcls_flat = pcls.transpose(0, 1, 3, 4, 2).reshape(n, na * h * w,
                                                       num_classes)
     pcls_g = jnp.take_along_axis(pcls_flat, idx[..., None], axis=1)

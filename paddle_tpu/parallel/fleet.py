@@ -137,7 +137,8 @@ class DistributedOptimizer:
         if s.amp:
             from .. import amp as amp_mod
             opt = amp_mod.decorate(
-                opt, init_loss_scaling=s.amp_init_loss_scaling)
+                opt, init_loss_scaling=s.amp_init_loss_scaling,
+                use_dynamic_loss_scaling=True)   # fleet AMP: dynamic
         # wrappers (Lookahead, ModelAverage, ...) take fewer kwargs than
         # the Optimizer base — forward only what the inner one accepts
         accepted = inspect.signature(opt.minimize).parameters

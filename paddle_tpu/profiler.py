@@ -29,7 +29,7 @@ def start_profiler(state="All", tracer_option="Default",
     _timings.clear()
 
 
-def stop_profiler(sorted_key="total", profile_path=None):
+def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
     global _active
     if _active:
         jax.profiler.stop_trace()
@@ -47,7 +47,7 @@ def reset_profiler():
 
 
 @contextlib.contextmanager
-def profiler(state="All", sorted_key="total", profile_path=None):
+def profiler(state="All", sorted_key=None, profile_path='/tmp/profile'):
     start_profiler(state)
     try:
         yield

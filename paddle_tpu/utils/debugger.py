@@ -43,9 +43,10 @@ def print_program(program=None, file=None):
     print(program_to_code(program or default_main_program()), file=file)
 
 
-def draw_block_graphviz(block, path=None, highlights=None):
-    """Emit a DOT graph of a block's dataflow (fluid draw_block_graphviz).
-    Returns the DOT source; writes it to `path` if given."""
+def draw_block_graphviz(block, path="./temp.dot", highlights=None):
+    """Emit a DOT graph of a block's dataflow (fluid draw_block_graphviz,
+    same './temp.dot' default). Returns the DOT source; writes it to
+    `path` when given (None skips the write)."""
     highlights = set(highlights or ())
     lines = ["digraph G {", "  rankdir=TB;"]
     for i, op in enumerate(block.ops):
