@@ -23,3 +23,4 @@ from . import deepfm
 from . import gan
 from . import detection_demo
 from . import label_semantic_roles
+from . import mobilenet
