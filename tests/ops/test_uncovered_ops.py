@@ -1,0 +1,221 @@
+"""Numeric coverage for registered ops that no test had ever named
+(found by a registry-vs-test-text diff, 148 uncovered). Each golden is
+a hand-derived reference formula or torch equivalent — the same sweep
+pattern that has caught 7 real bugs across rounds 2-3."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+import torch
+import torch.nn.functional as F
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+RS = np.random.RandomState(33)
+
+
+def _run(outs, feeds, scope_sets=None):
+    outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    for k, v in (scope_sets or {}).items():
+        fluid.global_scope().set(k, jnp.asarray(v))
+    return exe.run(feed=feeds, fetch_list=list(outs))
+
+
+def _x(shape=(3, 5)):
+    return RS.randn(*shape).astype(np.float32)
+
+
+ACTIVATIONS = [
+    # (layer_call, numpy golden) — formulas from the reference op docs
+    ("mish", lambda v: layers.mish(v),
+     lambda x: x * np.tanh(np.log1p(np.exp(-np.abs(x)))
+                           + np.maximum(x, 0))),
+    ("hard_swish", lambda v: layers.hard_swish(v),
+     lambda x: x * np.clip(x + 3, 0, 6) / 6),
+    ("softsign", lambda v: layers.softsign(v),
+     lambda x: x / (1 + np.abs(x))),
+    ("tanh_shrink", lambda v: layers.tanh_shrink(v),
+     lambda x: x - np.tanh(x)),
+    ("logsigmoid", lambda v: layers.logsigmoid(v),
+     lambda x: -np.log1p(np.exp(-np.abs(x))) + np.minimum(x, 0)),
+    ("stanh", lambda v: layers.stanh(v, scale_a=0.67, scale_b=1.7159),
+     lambda x: 1.7159 * np.tanh(0.67 * x)),
+    ("soft_relu", lambda v: layers.soft_relu(v, threshold=4.0),
+     lambda x: np.log1p(np.exp(np.clip(x, -4.0, 4.0)))),
+    ("brelu", lambda v: layers.brelu(v, t_min=-1.0, t_max=2.0),
+     lambda x: np.clip(x, -1.0, 2.0)),
+    ("reciprocal", lambda v: layers.reciprocal(v),
+     lambda x: 1.0 / x),
+    ("rsqrt", lambda v: layers.rsqrt(v),
+     lambda x: 1.0 / np.sqrt(x)),
+]
+
+
+@pytest.mark.parametrize("name,call,golden", ACTIVATIONS,
+                         ids=[a[0] for a in ACTIVATIONS])
+def test_activation_formulas(name, call, golden):
+    x = _x()
+    if name in ("reciprocal", "rsqrt"):
+        x = np.abs(x) + 0.5
+    xv = layers.data("x", shape=[5], dtype="float32")
+    got, = _run(call(xv), {"x": x})
+    np.testing.assert_allclose(got, golden(x), rtol=2e-5, atol=1e-6)
+
+
+def test_elementwise_family_matches_numpy():
+    a = _x((4, 6)) + 3.0
+    b = np.abs(_x((4, 6))) + 0.5
+    av = layers.data("a", shape=[6], dtype="float32")
+    bv = layers.data("b", shape=[6], dtype="float32")
+    outs = [layers.elementwise_div(av, bv),
+            layers.elementwise_sub(av, bv),
+            layers.elementwise_max(av, bv),
+            layers.elementwise_min(av, bv),
+            layers.elementwise_pow(av, bv),
+            layers.elementwise_mod(av, bv),
+            layers.elementwise_floordiv(av, bv)]
+    got = _run(outs, {"a": a, "b": b})
+    want = [a / b, a - b, np.maximum(a, b), np.minimum(a, b),
+            np.power(a, b), np.mod(a, b), np.floor_divide(a, b)]
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), w, rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_comparison_and_logical_ops():
+    a = RS.randint(0, 3, (8,)).astype(np.float32)
+    b = RS.randint(0, 3, (8,)).astype(np.float32)
+    av = layers.data("a", shape=[8], dtype="float32",
+                     append_batch_size=False)
+    bv = layers.data("b", shape=[8], dtype="float32",
+                     append_batch_size=False)
+    gt = layers.greater_than(av, bv)
+    ge = layers.greater_equal(av, bv)
+    le = layers.less_equal(av, bv)
+    ne = layers.not_equal(av, bv)
+    lx = layers.logical_xor(gt, ge)
+    ln = layers.logical_not(gt)
+    got = _run([gt, ge, le, ne, lx, ln], {"a": a, "b": b})
+    want = [a > b, a >= b, a <= b, a != b,
+            (a > b) ^ (a >= b), ~(a > b)]
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g).astype(bool), w)
+
+
+def test_pixel_shuffle_matches_torch():
+    x = _x((2, 8, 3, 3))
+    xv = layers.data("x", shape=[8, 3, 3], dtype="float32")
+    got, = _run(layers.pixel_shuffle(xv, upscale_factor=2), {"x": x})
+    want = F.pixel_shuffle(torch.from_numpy(x), 2)
+    np.testing.assert_allclose(got, want.numpy(), rtol=1e-6)
+
+
+def test_shuffle_channel():
+    """Reference shuffle_channel_op: (N, g, C/g, H, W) -> transpose the
+    two channel factors."""
+    x = _x((2, 6, 2, 2))
+    xv = layers.data("x", shape=[6, 2, 2], dtype="float32")
+    got, = _run(layers.shuffle_channel(xv, group=3), {"x": x})
+    want = x.reshape(2, 3, 2, 2, 2).transpose(0, 2, 1, 3, 4).reshape(
+        2, 6, 2, 2)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_temporal_shift():
+    """Reference temporal_shift_op: within each segment of T frames,
+    the first C/4 channels shift backward, the next C/4 forward."""
+    n, t, c, h, w = 1, 4, 8, 2, 2
+    x = _x((n * t, c, h, w))
+    xv = layers.data("x", shape=[c, h, w], dtype="float32")
+    got, = _run(layers.temporal_shift(xv, seg_num=t, shift_ratio=0.25),
+                {"x": x})
+    xt = x.reshape(n, t, c, h, w)
+    want = np.zeros_like(xt)
+    c1 = c // 4
+    # reference temporal_shift_op.h:60: first block src = it-1 (shift
+    # RIGHT), second block src = it+1 (shift LEFT)
+    want[:, 1:, :c1] = xt[:, :-1, :c1]
+    want[:, :-1, c1:2 * c1] = xt[:, 1:, c1:2 * c1]
+    want[:, :, 2 * c1:] = xt[:, :, 2 * c1:]
+    np.testing.assert_allclose(got, want.reshape(n * t, c, h, w),
+                               rtol=1e-6)
+
+
+def test_pad_constant_like():
+    big = np.zeros((3, 5), np.float32)
+    small = _x((2, 3))
+    bv = layers.data("b", shape=[3, 5], dtype="float32",
+                     append_batch_size=False)
+    sv = layers.data("s", shape=[2, 3], dtype="float32",
+                     append_batch_size=False)
+    got, = _run(layers.pad_constant_like(bv, sv, pad_value=7.0),
+                {"b": big, "s": small})
+    want = np.full((3, 5), 7.0, np.float32)
+    want[:2, :3] = small
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_cos_sim_formula():
+    a = _x((4, 6))
+    b = _x((4, 6))
+    av = layers.data("a", shape=[6], dtype="float32")
+    bv = layers.data("b", shape=[6], dtype="float32")
+    got, = _run(layers.cos_sim(av, bv), {"a": a, "b": b})
+    want = (a * b).sum(-1) / (np.linalg.norm(a, axis=-1)
+                              * np.linalg.norm(b, axis=-1))
+    np.testing.assert_allclose(np.asarray(got).ravel(), want, rtol=1e-5)
+
+
+def test_maxout_and_prelu():
+    x = _x((2, 6, 3, 3))
+    xv = layers.data("x", shape=[6, 3, 3], dtype="float32")
+    mo = layers.maxout(xv, groups=2)
+    pr = layers.prelu(xv, mode="channel",
+                      param_attr=fluid.ParamAttr(name="prelu_a"))
+    alpha = (RS.rand(6).astype(np.float32) * 0.5).reshape(6)
+    got_mo, got_pr = _run([mo, pr], {"x": x},
+                          scope_sets={"prelu_a": alpha})
+    # reference maxouting.cc: output channel c maxes over the
+    # CONSECUTIVE input channels [c*groups, (c+1)*groups)
+    want_mo = x.reshape(2, 3, 2, 3, 3).max(axis=2)
+    np.testing.assert_allclose(got_mo, want_mo, rtol=1e-6)
+    want_pr = np.where(x > 0, x, x * alpha.reshape(1, 6, 1, 1))
+    np.testing.assert_allclose(got_pr, want_pr, rtol=1e-5)
+
+
+def test_sequence_pool_softmax_reverse_with_lengths():
+    x = _x((2, 4, 3))
+    lens = np.array([3, 2], np.int32)
+    xv = layers.data("x", shape=[4, 3], dtype="float32")
+    lv = layers.data("len", shape=[], dtype="int32")
+    sp = layers.sequence_pool(xv, "average", length=lv)
+    srev = layers.sequence_reverse(xv, length=lv)
+    x1 = _x((2, 4))
+    x1v = layers.data("x1", shape=[4], dtype="float32")
+    ssm = layers.sequence_softmax(x1v, length=lv)
+    got_p, got_r, got_s = _run([sp, srev, ssm],
+                               {"x": x, "len": lens, "x1": x1})
+    for b, L in enumerate(lens):
+        np.testing.assert_allclose(np.asarray(got_p)[b],
+                                   x[b, :L].mean(0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(got_r)[b, :L],
+                                   x[b, :L][::-1], rtol=1e-6)
+        e = np.exp(x1[b, :L] - x1[b, :L].max())
+        np.testing.assert_allclose(np.asarray(got_s)[b, :L],
+                                   e / e.sum(), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(got_s)[b, L:], 0.0,
+                                   atol=1e-6)
+
+
+def test_mse_loss_matches_numpy():
+    a = _x((4, 3))
+    b = _x((4, 3))
+    av = layers.data("a", shape=[3], dtype="float32")
+    bv = layers.data("b", shape=[3], dtype="float32")
+    got, = _run(layers.mse_loss(av, bv), {"a": a, "b": b})
+    np.testing.assert_allclose(np.asarray(got).ravel()[0],
+                               ((a - b) ** 2).mean(), rtol=1e-5)
