@@ -1,0 +1,17 @@
+"""Shared helpers for the ops test tier."""
+
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+
+
+def run_fetch(outs, feeds, scope_sets=None):
+    """Build-and-run the default program: startup, optional scope
+    presets, then one exe.run fetching `outs` (the tier-wide idiom —
+    one copy instead of one per file)."""
+    outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    for k, v in (scope_sets or {}).items():
+        fluid.global_scope().set(k, jnp.asarray(v))
+    return exe.run(feed=feeds, fetch_list=list(outs))

@@ -13,13 +13,7 @@ from paddle_tpu.core import framework
 RS = np.random.RandomState(3)
 
 
-def _run(outs, feeds, scope_sets=None):
-    outs = outs if isinstance(outs, (list, tuple)) else [outs]
-    exe = fluid.Executor()
-    exe.run(fluid.default_startup_program())
-    for k, v in (scope_sets or {}).items():
-        fluid.global_scope().set(k, jnp.asarray(v))
-    return exe.run(feed=feeds, fetch_list=list(outs))
+from op_test_utils import run_fetch as _run  # noqa: E402  (shared tier helper)
 
 
 def test_scatter_nd():

@@ -16,13 +16,15 @@ from paddle_tpu import layers
 RS = np.random.RandomState(33)
 
 
-def _run(outs, feeds, scope_sets=None):
-    outs = outs if isinstance(outs, (list, tuple)) else [outs]
-    exe = fluid.Executor()
-    exe.run(fluid.default_startup_program())
-    for k, v in (scope_sets or {}).items():
-        fluid.global_scope().set(k, jnp.asarray(v))
-    return exe.run(feed=feeds, fetch_list=list(outs))
+@pytest.fixture(autouse=True)
+def _reseed():
+    # fresh stream per test: inputs don't depend on which tests ran
+    # before, so an isolated -k repro sees the same data as a full run
+    global RS
+    RS = np.random.RandomState(33)
+
+
+from op_test_utils import run_fetch as _run  # noqa: E402  (shared tier helper)
 
 
 def _x(shape=(3, 5)):
