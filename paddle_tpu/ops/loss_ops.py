@@ -65,7 +65,7 @@ def sigmoid_cross_entropy_with_logits(ctx):
     return {"Out": loss}
 
 
-@register("square_error_cost", "squared_l2_distance")
+@register("square_error_cost")
 def square_error_cost(ctx):
     x, y = ctx.in_("X"), ctx.in_("Y")
     d = x - y
@@ -109,12 +109,17 @@ def log_loss(ctx):
 
 @register("bpr_loss")
 def bpr_loss(ctx):
+    """Parity: bpr_loss_op.h:69 — the positive class is EXCLUDED from
+    the negatives and the mean divides by (C - 1), not C."""
     x = ctx.in_("X")  # (N, C) scores
     label = _squeeze_label(ctx.in_("Label")).astype(jnp.int32)
+    c = x.shape[1]
     pos = jnp.take_along_axis(x, label[:, None], axis=1)
     diff = -(x - pos)
-    loss = jnp.mean(jnp.log1p(jnp.exp(-jnp.abs(diff))) + jnp.maximum(-diff, 0),
-                    axis=1, keepdims=True)
+    per = jnp.log1p(jnp.exp(-jnp.abs(diff))) + jnp.maximum(-diff, 0)
+    not_pos = (jnp.arange(c)[None, :] != label[:, None])
+    loss = jnp.sum(jnp.where(not_pos, per, 0.0), axis=1,
+                   keepdims=True) / (c - 1)
     return {"Y": loss}
 
 
@@ -266,3 +271,16 @@ def modified_huber_loss(ctx):
     loss = jnp.where(z < -1.0, -4.0 * z,
                      jnp.where(z < 1.0, (1.0 - z) ** 2, 0.0))
     return {"Out": loss, "IntermediateVal": z}
+
+
+@register("squared_l2_distance")
+def squared_l2_distance(ctx):
+    """Parity: squared_l2_distance_op — per-ROW sum of squared diffs
+    ((N, 1) distances) plus the sub_result the grad kernel reads; NOT
+    the elementwise square_error_cost it was previously aliased to."""
+    x, y = ctx.in_("X"), ctx.in_("Y")
+    # reference flattens to (N, -1): ALL trailing dims sum into one
+    # distance per row
+    sub = (x - y).reshape(x.shape[0], -1)
+    return {"Out": jnp.sum(sub * sub, axis=1, keepdims=True),
+            "sub_result": sub}
