@@ -160,9 +160,18 @@ def margin_rank_loss(ctx):
 
 @register("dice_loss")
 def dice_loss(ctx):
+    """Parity: fluid.layers.dice_loss — integer labels ONE-HOT to the
+    class dim before the overlap (the reference contract: input
+    (N, ..., C) probabilities, label (N, ..., 1) int)."""
     x = ctx.in_("X")
-    label = ctx.in_("Label").astype(x.dtype)
+    label = ctx.in_("Label")
     eps = ctx.attr("epsilon", 1e-5)
+    if jnp.issubdtype(label.dtype, jnp.integer):
+        # reference contract: int labels one-hot to x's class dim
+        # (dtype-dispatched — shape equality would misfire at C == 1)
+        label = jax.nn.one_hot(_squeeze_label(label).astype(jnp.int32),
+                               x.shape[-1], dtype=x.dtype)
+    label = label.astype(x.dtype)
     reduce_dims = tuple(range(1, x.ndim))
     inter = 2.0 * jnp.sum(x * label, axis=reduce_dims)
     union = jnp.sum(x, axis=reduce_dims) + jnp.sum(label, axis=reduce_dims)
@@ -179,8 +188,9 @@ def npair_loss(ctx):
     same = (labels[:, None] == labels[None, :]).astype(sim.dtype)
     same = same / jnp.maximum(same.sum(axis=1, keepdims=True), 1.0)
     xent = -jnp.mean(jnp.sum(same * jax.nn.log_softmax(sim, axis=1), axis=1))
-    reg = l2_reg * (jnp.mean(jnp.sum(anchor * anchor, axis=1)) +
-                    jnp.mean(jnp.sum(positive * positive, axis=1))) / 2
+    # reference npair_loss (nn.py:12652): Beta = 0.25, not 0.5
+    reg = l2_reg * 0.25 * (jnp.mean(jnp.sum(anchor * anchor, axis=1)) +
+                           jnp.mean(jnp.sum(positive * positive, axis=1)))
     return {"Out": xent + reg}
 
 

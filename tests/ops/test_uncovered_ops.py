@@ -318,3 +318,43 @@ def test_squared_l2_distance():
     np.testing.assert_allclose(np.asarray(got).ravel(),
                                (flat ** 2).sum(-1), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(gsub), flat, rtol=1e-6)
+
+
+def test_npair_loss_reference_formula():
+    """Reference nn.py:12652: celoss + Beta(=0.25)*l2_reg*l2loss."""
+    n, d = 4, 6
+    anchor = _x((n, d))
+    positive = _x((n, d))
+    labels = np.array([0, 1, 0, 2], np.int64)
+    av = layers.data("an", shape=[d], dtype="float32")
+    pv = layers.data("po", shape=[d], dtype="float32")
+    lv = layers.data("lb", shape=[], dtype="int64")
+    got, = _run(layers.npair_loss(av, pv, lv, l2_reg=0.01),
+                {"an": anchor, "po": positive, "lb": labels})
+    sim = anchor @ positive.T
+    lab = (labels[:, None] == labels[None, :]).astype(np.float32)
+    lab = lab / lab.sum(1, keepdims=True)
+    lsm = sim - sim.max(1, keepdims=True)
+    lsm = lsm - np.log(np.exp(lsm).sum(1, keepdims=True))
+    ce = -(lab * lsm).sum(1).mean()
+    l2 = 0.25 * 0.01 * ((anchor ** 2).sum(1).mean()
+                        + (positive ** 2).sum(1).mean())
+    np.testing.assert_allclose(np.asarray(got).ravel()[0], ce + l2,
+                               rtol=1e-5)
+
+
+def test_dice_loss_one_hots_integer_labels():
+    n, c = 3, 4
+    probs = np.abs(_x((n, c)))
+    probs = probs / probs.sum(-1, keepdims=True)
+    label = np.array([[1], [0], [3]], np.int64)
+    pv = layers.data("pr", shape=[c], dtype="float32")
+    lv = layers.data("lab", shape=[1], dtype="int64")
+    got, = _run(layers.dice_loss(pv, lv, epsilon=1e-5),
+                {"pr": probs, "lab": label})
+    onehot = np.eye(c, dtype=np.float32)[label.ravel()]
+    inter = 2 * (probs * onehot).sum(1)
+    union = probs.sum(1) + onehot.sum(1)
+    want = 1 - (inter / (union + 1e-5)).mean()
+    np.testing.assert_allclose(np.asarray(got).ravel()[0], want,
+                               rtol=1e-5)
