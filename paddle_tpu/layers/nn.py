@@ -1221,16 +1221,28 @@ def crop(x, shape=None, offsets=None, name=None):
     """Parity: fluid.layers.crop / crop_tensor. `shape` must be a static
     list on TPU (XLA needs static slice sizes); `offsets` may be a tensor
     (dynamic_slice starts)."""
-    if hasattr(shape, "dtype"):
+    if hasattr(shape, "dtype") or any(hasattr(s_, "dtype")
+                                      for s_ in (shape or [])):
         raise TypeError(
-            "crop_tensor: tensor-valued `shape` is dynamic-shape; pass a "
-            "python list of ints (use -1 to keep a dim)")
+            "crop_tensor: tensor-valued `shape` (or shape element) is "
+            "dynamic-shape; pass a python list of ints (use -1 to keep "
+            "a dim)")
     ins = {"X": x}
     attrs = {"shape": list(shape), "offsets": offsets}
     if hasattr(offsets, "dtype"):
         ins["Offsets"] = offsets
         attrs["offsets"] = None
-    return _simple_layer("crop_tensor", ins, attrs, helper_name="crop")
+    # static out shape for downstream shape inference (fc sizes etc.):
+    # -1/0 entries mean "rest of the dim from the offset"
+    off_list = offsets if isinstance(offsets, (list, tuple)) \
+        else [0] * len(shape)
+    out_shape = tuple(
+        int(s) if int(s) > 0
+        else (int(x.shape[i]) - int(off_list[i])
+              if int(x.shape[i]) >= 0 else -1)     # unknown dims stay -1
+        for i, s in enumerate(shape))
+    return _simple_layer("crop_tensor", ins, attrs, helper_name="crop",
+                         shape=out_shape)
 
 
 crop_tensor = crop

@@ -8,6 +8,7 @@ constants so XLA can tile/fuse freely.
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 import numpy as np
 
 from . import register

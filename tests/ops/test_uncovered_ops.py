@@ -358,3 +358,72 @@ def test_dice_loss_one_hots_integer_labels():
     want = 1 - (inter / (union + 1e-5)).mean()
     np.testing.assert_allclose(np.asarray(got).ravel()[0], want,
                                rtol=1e-5)
+
+
+def test_mean_iou_confusion_matrix():
+    pred = np.array([0, 0, 1, 1, 2, 2, 2, 1], np.int64)
+    lab = np.array([0, 1, 1, 1, 2, 0, 2, 2], np.int64)
+    pv = layers.data("pr", shape=[8], dtype="int64",
+                     append_batch_size=False)
+    lv = layers.data("lb", shape=[8], dtype="int64",
+                     append_batch_size=False)
+    miou, wrong, correct = layers.mean_iou(pv, lv, num_classes=3)
+    gm, gw, gc = _run([miou, wrong, correct], {"pr": pred, "lb": lab})
+    n = 3
+    cm = np.zeros((n, n))
+    for p, l in zip(pred, lab):
+        cm[l, p] += 1
+    inter = np.diag(cm)
+    union = cm.sum(0) + cm.sum(1) - inter
+    want = (inter[union > 0] / union[union > 0]).mean()
+    np.testing.assert_allclose(np.asarray(gm).ravel()[0], want, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gc), inter)
+    np.testing.assert_allclose(np.asarray(gw), cm.sum(1) - inter)
+
+
+def test_arg_min_max_axis():
+    x = _x((3, 5))
+    xv = layers.data("x", shape=[3, 5], dtype="float32",
+                     append_batch_size=False)
+    am0 = layers.argmax(xv, axis=0)
+    am1 = layers.argmax(xv, axis=1)
+    an1 = layers.argmin(xv, axis=1)
+    g0, g1, gn = _run([am0, am1, an1], {"x": x})
+    np.testing.assert_array_equal(g0, x.argmax(0))
+    np.testing.assert_array_equal(g1, x.argmax(1))
+    np.testing.assert_array_equal(gn, x.argmin(1))
+
+
+def test_crop_tensor_static_offsets():
+    x = _x((5, 6))
+    xv = layers.data("x", shape=[5, 6], dtype="float32",
+                     append_batch_size=False)
+    out = layers.crop_tensor(xv, shape=[2, 3], offsets=[1, 2])
+    got, = _run(out, {"x": x})
+    np.testing.assert_allclose(got, x[1:3, 2:5], rtol=1e-6)
+
+
+def test_one_hot_variants():
+    idx = np.array([[1], [0], [3]], np.int64)
+    iv = layers.data("i", shape=[1], dtype="int64")
+    oh = layers.one_hot(iv, depth=4)
+    got, = _run(oh, {"i": idx})
+    np.testing.assert_array_equal(np.asarray(got).reshape(3, 4),
+                                  np.eye(4)[idx.ravel()])
+
+
+def test_ctc_align_greedy_decode():
+    """ctc_align / ctc_greedy_decoder: merge repeats then drop blanks."""
+    from paddle_tpu.core.layer_helper import LayerHelper
+    # the op takes (B, T, C) probabilities (greedy argmax inside);
+    # exercised through the PUBLIC wrapper
+    toks = np.array([[1, 1, 0, 2, 2, 0, 3],
+                     [0, 4, 4, 4, 0, 0, 0]], np.int32)
+    probs = np.eye(5, dtype=np.float32)[toks]          # (B, T, 5)
+    tv = layers.data("t", shape=[7, 5], dtype="float32")
+    out, ln = layers.ctc_greedy_decoder(tv, blank=0)
+    got, gl = _run([out, ln], {"t": probs})
+    got = np.asarray(got)
+    gl = np.asarray(gl).ravel()
+    assert list(got[0][:gl[0]]) == [1, 2, 3]
+    assert list(got[1][:gl[1]]) == [4]
