@@ -1221,8 +1221,11 @@ def crop(x, shape=None, offsets=None, name=None):
     """Parity: fluid.layers.crop / crop_tensor. `shape` must be a static
     list on TPU (XLA needs static slice sizes); `offsets` may be a tensor
     (dynamic_slice starts)."""
-    if hasattr(shape, "dtype") or any(hasattr(s_, "dtype")
+    from ..core.framework import Variable as _Var
+    if isinstance(shape, _Var) or any(isinstance(s_, _Var)
                                       for s_ in (shape or [])):
+        # Variable check (not hasattr-dtype: numpy scalars have .dtype
+        # and are perfectly static)
         raise TypeError(
             "crop_tensor: tensor-valued `shape` (or shape element) is "
             "dynamic-shape; pass a python list of ints (use -1 to keep "
@@ -1234,8 +1237,15 @@ def crop(x, shape=None, offsets=None, name=None):
         attrs["offsets"] = None
     # static out shape for downstream shape inference (fc sizes etc.):
     # -1/0 entries mean "rest of the dim from the offset"
-    off_list = offsets if isinstance(offsets, (list, tuple)) \
-        else [0] * len(shape)
+    dynamic_offsets = not isinstance(offsets, (list, tuple)) \
+        or any(isinstance(o, _Var) for o in offsets)
+    if dynamic_offsets and any(int(s) <= 0 for s in shape):
+        # the kernel rejects -1/0 sizes with runtime offsets; raise the
+        # same contract here instead of publishing a bogus static shape
+        raise NotImplementedError(
+            "crop_tensor: -1/0 shape entries need static offsets "
+            "(runtime offsets can't give a static slice size)")
+    off_list = offsets if not dynamic_offsets else [0] * len(shape)
     out_shape = tuple(
         int(s) if int(s) > 0
         else (int(x.shape[i]) - int(off_list[i])

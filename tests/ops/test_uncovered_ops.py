@@ -413,10 +413,8 @@ def test_one_hot_variants():
 
 
 def test_ctc_align_greedy_decode():
-    """ctc_align / ctc_greedy_decoder: merge repeats then drop blanks."""
-    from paddle_tpu.core.layer_helper import LayerHelper
-    # the op takes (B, T, C) probabilities (greedy argmax inside);
-    # exercised through the PUBLIC wrapper
+    """ctc_align / ctc_greedy_decoder ((B, T, C) probabilities through
+    the public wrapper): merge repeats then drop blanks."""
     toks = np.array([[1, 1, 0, 2, 2, 0, 3],
                      [0, 4, 4, 4, 0, 0, 0]], np.int32)
     probs = np.eye(5, dtype=np.float32)[toks]          # (B, T, 5)
@@ -427,3 +425,83 @@ def test_ctc_align_greedy_decode():
     gl = np.asarray(gl).ravel()
     assert list(got[0][:gl[0]]) == [1, 2, 3]
     assert list(got[1][:gl[1]]) == [4]
+
+
+def test_sequence_family_batch4():
+    """sequence_concat/slice/enumerate/reshape/unpad formulas."""
+    x = _x((2, 4, 3))
+    y = _x((2, 2, 3))
+    lens = np.array([3, 2], np.int32)
+    xv = layers.data("x", shape=[4, 3], dtype="float32")
+    yv = layers.data("y2", shape=[2, 3], dtype="float32")
+    lv = layers.data("len", shape=[], dtype="int32")
+    cat = layers.sequence_concat([xv, yv])
+    sl = layers.sequence_slice(xv, offset=1, length=2)
+    unp = layers.sequence_unpad(xv, length=lv)
+    gc_, gs, gu = _run([cat, sl, unp], {"x": x, "y2": y, "len": lens})
+    np.testing.assert_allclose(gc_, np.concatenate([x, y], axis=1),
+                               rtol=1e-6)
+    np.testing.assert_allclose(gs, x[:, 1:3], rtol=1e-6)
+    want_unp = x.copy()
+    want_unp[0, 3:] = 0
+    want_unp[1, 2:] = 0
+    np.testing.assert_allclose(gu, want_unp, rtol=1e-6)
+
+    ids = np.array([[1, 2, 3, 4]], np.int64)
+    iv = layers.data("ids", shape=[4], dtype="int64")
+    en = layers.sequence_enumerate(iv, win_size=2, pad_value=0)
+    ge, = _run(en, {"ids": ids})
+    np.testing.assert_array_equal(
+        np.asarray(ge)[0], [[1, 2], [2, 3], [3, 4], [4, 0]])
+
+    rs = layers.sequence_reshape(xv, new_dim=6)
+    gr, = _run(rs, {"x": x})
+    np.testing.assert_allclose(gr, x.reshape(2, 2, 6), rtol=1e-6)
+
+
+def test_chunk_eval_iob_counts():
+    """IOB chunking: B-type = 2*type, I-type = 2*type+1 (op docstring);
+    one exact match, one predicted-only, one label-only span."""
+    # label:  [B0 I0 O  B1]   pred: [B0 I0 B1 O]
+    # O tag = num_chunk_types*2 (outside)
+    lab = np.array([[0, 1, 4, 2]], np.int64)
+    inf = np.array([[0, 1, 2, 4]], np.int64)
+    lv = layers.data("lab", shape=[4], dtype="int64")
+    iv = layers.data("inf", shape=[4], dtype="int64")
+    p, r, f1, n_inf, n_lab, n_cor = layers.chunk_eval(
+        iv, lv, chunk_scheme="IOB", num_chunk_types=2)
+    gp, gr_, gf, gi, gl, gcor = _run([p, r, f1, n_inf, n_lab, n_cor],
+                                     {"lab": lab, "inf": inf})
+    assert int(np.asarray(gi).ravel()[0]) == 2     # predicted chunks
+    assert int(np.asarray(gl).ravel()[0]) == 2     # label chunks
+    assert int(np.asarray(gcor).ravel()[0]) == 1   # the B0-I0 span
+    np.testing.assert_allclose(np.asarray(gp).ravel()[0], 0.5, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(gr_).ravel()[0], 0.5, rtol=1e-6)
+
+
+def test_random_ops_statistics():
+    """bernoulli/multinomial/truncated_gaussian/uniform_batch_size_like:
+    shape + first/second-moment smoke (seeded via the op rng)."""
+    from paddle_tpu.core.layer_helper import LayerHelper
+    helper = LayerHelper("rand")
+    xv = layers.data("x", shape=[8], dtype="float32")
+    probs = layers.data("pb", shape=[4], dtype="float32")
+
+    b = helper.create_variable_for_type_inference("float32")
+    helper.append_op("bernoulli", {"X": probs}, {"Out": b}, {})
+    tg = helper.create_variable_for_type_inference("float32")
+    helper.append_op("truncated_gaussian_random", {}, {"Out": tg},
+                     {"shape": [2000], "mean": 0.0, "std": 1.0})
+    ub = helper.create_variable_for_type_inference("float32")
+    helper.append_op("uniform_random_batch_size_like", {"Input": xv},
+                     {"Out": ub}, {"shape": [0, 16], "min": -1.0,
+                                   "max": 1.0})
+    pb = np.full((3, 4), 0.5, np.float32)
+    xs = np.zeros((5, 8), np.float32)
+    gb, gt, gu = _run([b, tg, ub], {"pb": pb, "x": xs})
+    gb = np.asarray(gb)
+    assert set(np.unique(gb)).issubset({0.0, 1.0})
+    gt = np.asarray(gt)
+    assert abs(float(gt.mean())) < 0.15 and float(np.abs(gt).max()) <= 2.01
+    gu = np.asarray(gu)
+    assert gu.shape == (5, 16) and gu.min() >= -1.0 and gu.max() <= 1.0
