@@ -54,6 +54,9 @@ def main():
     ap.add_argument("--ops", default="matmul,conv,flash,norm,embedding")
     ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--tiny", action="store_true",
+                    help="small shapes: CI/CPU smoke of every suite "
+                         "(full shapes would grind for minutes off-TPU)")
     args = ap.parse_args()
 
     import jax
@@ -78,8 +81,9 @@ def main():
     suites = set(args.ops.split(","))
 
     if "matmul" in suites:
-        for m, n, k in [(1024, 1024, 1024), (4096, 4096, 4096),
-                        (8192, 8192, 8192)]:
+        mm_shapes = [(128, 128, 128)] if args.tiny else \
+            [(1024, 1024, 1024), (4096, 4096, 4096), (8192, 8192, 8192)]
+        for m, n, k in mm_shapes:
             a = jax.random.normal(key, (m, k), dtype)
             b = jax.random.normal(key, (k, n), dtype)
             f = jax.jit(lambda a, b: a @ b)
@@ -88,8 +92,9 @@ def main():
 
     if "conv" in suites:
         from jax import lax
-        for b, c_in, c_out, hw, khw, stride in [
-                (32, 3, 64, 224, 7, 2), (32, 256, 256, 14, 3, 1)]:
+        conv_shapes = [(2, 3, 8, 32, 3, 1)] if args.tiny else [
+            (32, 3, 64, 224, 7, 2), (32, 256, 256, 14, 3, 1)]
+        for b, c_in, c_out, hw, khw, stride in conv_shapes:
             x = jax.random.normal(key, (b, c_in, hw, hw), dtype)
             w = jax.random.normal(key, (c_out, c_in, khw, khw), dtype)
             f = jax.jit(lambda x, w: lax.conv_general_dilated(
@@ -101,7 +106,9 @@ def main():
 
     if "flash" in suites:
         from paddle_tpu.ops.pallas import flash
-        for b, h, t, d in [(8, 12, 512, 64), (1, 12, 4096, 64)]:
+        fl_shapes = [(1, 2, 64, 16)] if args.tiny else \
+            [(8, 12, 512, 64), (1, 12, 4096, 64)]
+        for b, h, t, d in fl_shapes:
             q = jax.random.normal(key, (b, h, t, d), dtype)
             f = jax.jit(lambda q: flash.flash_attention(q, q, q,
                                                         causal=True))
@@ -114,20 +121,23 @@ def main():
             report(f"flash b{b} h{h} t{t}", dt, flops)
 
     if "norm" in suites:
-        x = jax.random.normal(key, (8192, 1024), jnp.float32)
+        nrm = (256, 64) if args.tiny else (8192, 1024)
+        x = jax.random.normal(key, nrm, jnp.float32)
         f = jax.jit(lambda x: jax.nn.softmax(
             (x - x.mean(-1, keepdims=True)) / (x.std(-1, keepdims=True)
                                                + 1e-5)))
         dt = _time(f, x, steps=args.steps)
-        report("layernorm+softmax 8192x1024", dt, 10 * x.size)
+        report(f"layernorm+softmax {nrm[0]}x{nrm[1]}", dt, 10 * x.size)
 
     if "embedding" in suites:
-        tbl = jax.random.normal(key, (50_000, 768), dtype)
-        ids = jax.random.randint(key, (8 * 512,), 0, 50_000)
+        tn, td = (1000, 64) if args.tiny else (50_000, 768)
+        tbl = jax.random.normal(key, (tn, td), dtype)
+        ids = jax.random.randint(key, (64,) if args.tiny else (8 * 512,),
+                                 0, tn)
         f = jax.jit(lambda tbl, ids: tbl[ids])
         dt = _time(f, tbl, ids, steps=args.steps)
-        gb = (ids.size * 768 * tbl.dtype.itemsize) / 2**30
-        print(f"{'embedding gather 4096x768':<28} {dt * 1e3:9.3f} ms  "
+        gb = (ids.size * td * tbl.dtype.itemsize) / 2**30
+        print(f"{f'embedding gather {ids.size}x{td}':<28} {dt * 1e3:9.3f} ms  "
               f"{gb / dt:8.2f} GB/s")
 
     return 0
