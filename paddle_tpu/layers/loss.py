@@ -137,6 +137,10 @@ def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
 
 def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
                                  soft_max_lower_bound=-15.0):
+    """Parity: fluid.layers.teacher_student_sigmoid_loss. The soft_max
+    bounds shape only the reference's HAND-WRITTEN gradient clamp; here
+    autodiff differentiates the exact forward, so they are accepted for
+    signature parity but have no effect (documented deviation)."""
     helper = LayerHelper("teacher_student_sigmoid_loss")
     out = helper.create_variable_for_type_inference(
         input.dtype, (input.shape[0], 1))

@@ -215,14 +215,27 @@ def center_loss(ctx):
 
 @register("teacher_student_sigmoid_loss")
 def teacher_student_sigmoid_loss(ctx):
+    """Parity: teacher_student_sigmoid_loss_op.h:43 — the label ENCODES
+    click + optional teacher score q:
+      label = -2: clk 0, no teacher   -> BCE(x, 0)
+      label = -1: clk 1, no teacher   -> BCE(x, 1)
+      label = q in [0,1): clk 0 + q   -> BCE(x, 0) + BCE(x, q)
+      label = 1+q:        clk 1 + q   -> BCE(x, 1) + BCE(x, q)
+    (the soft_max bounds shape only the reference's hand-written grad;
+    autodiff of this exact forward is the TPU equivalent)."""
     x = ctx.in_("X").reshape(-1)
-    label = ctx.in_("Label").reshape(-1)
-    soft_max_up = ctx.attr("soft_max_up_bound", 15.0)
-    soft_max_lo = ctx.attr("soft_max_lower_bound", -15.0)
-    z = jnp.clip(x, soft_max_lo, soft_max_up)
-    teacher = (label > 0).astype(x.dtype)
-    sig = jnp.log1p(jnp.exp(-jnp.abs(z))) + jnp.maximum(z, 0) - z * teacher
-    return {"Y": sig.reshape(-1, 1)}
+    label = ctx.in_("Label").reshape(-1).astype(x.dtype)
+    softplus = jax.nn.softplus(x)
+    bce0 = softplus                       # target 0
+    bce1 = softplus - x                   # target 1
+    q_clk0 = softplus - x * label         # teacher q = label
+    q_clk1 = softplus - x * (label - 1.0)  # teacher q = label - 1
+    y = jnp.where(
+        label < -1.0, bce0,
+        jnp.where(label < 0.0, bce1,
+                  jnp.where(label < 1.0, bce0 + q_clk0,
+                            bce1 + q_clk1)))
+    return {"Y": y.reshape(-1, 1)}
 
 
 @register("cos_sim")

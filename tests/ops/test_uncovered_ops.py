@@ -505,3 +505,51 @@ def test_random_ops_statistics():
     assert abs(float(gt.mean())) < 0.15 and float(np.abs(gt).max()) <= 2.01
     gu = np.asarray(gu)
     assert gu.shape == (5, 16) and gu.min() >= -1.0 and gu.max() <= 1.0
+
+
+def test_teacher_student_sigmoid_loss_branches():
+    """All four label encodings of teacher_student_sigmoid_loss_op.h:43
+    (clk only / clk+teacher-q), exact branch formulas."""
+    from paddle_tpu.core.layer_helper import LayerHelper
+    x = np.array([0.5, -1.2, 2.0, -0.3], np.float32)
+    # labels: -2 (clk0), -1 (clk1), 0.3 (clk0 + q=.3), 1.7 (clk1 + q=.7)
+    lab = np.array([-2.0, -1.0, 0.3, 1.7], np.float32)
+    xv = layers.data("x", shape=[1], dtype="float32")
+    lv = layers.data("l", shape=[1], dtype="float32")
+    helper = LayerHelper("teacher_student_sigmoid_loss")
+    y = helper.create_variable_for_type_inference("float32")
+    helper.append_op("teacher_student_sigmoid_loss",
+                     {"X": xv, "Label": lv}, {"Y": y}, {})
+    got, = _run(y, {"x": x.reshape(-1, 1), "l": lab.reshape(-1, 1)})
+
+    def sp(v):
+        return np.maximum(v, 0) + np.log1p(np.exp(-np.abs(v)))
+
+    want = np.array([
+        sp(x[0]),
+        sp(x[1]) - x[1],
+        sp(x[2]) + sp(x[2]) - x[2] * 0.3,
+        sp(x[3]) - x[3] + sp(x[3]) - x[3] * 0.7], np.float32)
+    np.testing.assert_allclose(np.asarray(got).ravel(), want, rtol=1e-5)
+
+
+def test_cvm_log_normalization():
+    """continuous_value_model (cvm_op): leading show/click become
+    log(show+1) and log(click+1)-log(show+1); use_cvm=False strips."""
+    from paddle_tpu.core.layer_helper import LayerHelper
+    x = np.array([[10.0, 2.0, 0.5, -0.5],
+                  [100.0, 30.0, 1.0, 2.0]], np.float32)
+    xv = layers.data("x", shape=[4], dtype="float32")
+    helper = LayerHelper("continuous_value_model")
+    keep = helper.create_variable_for_type_inference("float32")
+    strip = helper.create_variable_for_type_inference("float32")
+    helper.append_op("continuous_value_model", {"X": xv}, {"Y": keep},
+                     {"use_cvm": True})
+    helper.append_op("continuous_value_model", {"X": xv}, {"Y": strip},
+                     {"use_cvm": False})
+    gk, gs = _run([keep, strip], {"x": x})
+    show = np.log(x[:, :1] + 1)
+    ctr = np.log(x[:, 1:2] + 1) - show
+    np.testing.assert_allclose(
+        gk, np.concatenate([show, ctr, x[:, 2:]], 1), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gs), x[:, 2:], rtol=1e-6)
