@@ -65,6 +65,13 @@ ATTR_ALLOW = {
     ("multiclass_nms2", "nms_top_k"),
     ("multiclass_nms2", "keep_top_k"),
     ("multiclass_nms2", "background_label"),
+    # the reference FORWARD ignores the soft_max bounds
+    # (teacher_student_sigmoid_loss_op.h:43-63 computes the loss
+    # unclamped); only the hand-written GRAD clamps with them, and
+    # autodiff replaces that grad here (ops/loss_ops.py documents the
+    # decision).  The layer still accepts/forwards them for API parity.
+    ("teacher_student_sigmoid_loss", "soft_max_lower_bound"),
+    ("teacher_student_sigmoid_loss", "soft_max_up_bound"),
 }
 
 
