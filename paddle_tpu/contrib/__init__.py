@@ -1,6 +1,7 @@
 """contrib — API-compatible extras (parity: python/paddle/fluid/contrib)."""
 
 from . import decoder  # noqa: F401
+from . import layers  # noqa: F401
 from . import mixed_precision  # noqa: F401
 from . import extend_optimizer  # noqa: F401
 from .extend_optimizer import extend_with_decoupled_weight_decay  # noqa: F401

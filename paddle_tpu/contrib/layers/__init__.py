@@ -1,0 +1,18 @@
+"""contrib.layers — basic RNN builders, CTR metric bundle, fused elemwise.
+
+Parity: python/paddle/fluid/contrib/layers/__init__.py:24-27 (the union of
+nn, rnn_impl and metric_op ``__all__``).
+"""
+
+from . import nn  # noqa: F401
+from . import rnn_impl  # noqa: F401
+from . import metric_op  # noqa: F401
+
+from .nn import *  # noqa: F401,F403
+from .rnn_impl import *  # noqa: F401,F403
+from .metric_op import *  # noqa: F401,F403
+
+__all__ = []
+__all__ += nn.__all__
+__all__ += rnn_impl.__all__
+__all__ += metric_op.__all__

@@ -1,0 +1,67 @@
+"""CTR metric bundle.
+
+Parity: python/paddle/fluid/contrib/layers/metric_op.py:30-189
+(``ctr_metric_bundle``).
+"""
+
+from ...core.layer_helper import LayerHelper
+from ... import initializer as init_mod
+from ... import layers
+
+__all__ = ["ctr_metric_bundle"]
+
+
+def ctr_metric_bundle(input, label):
+    """Accumulating CTR metrics: returns the six running sums
+    (local_sqrerr, local_abserr, local_prob, local_q, local_pos_num,
+    local_ins_num) the reference keeps in persistable scope vars
+    (metric_op.py:69-81); the caller divides by instance number (and
+    all-reduces first when distributed) to get RMSE/MAE/predicted_ctr/q.
+
+    The reference builds this from 14 chained ops on temporaries; here each
+    batch statistic is one fused reduction and the accumulate is an in-place
+    elementwise_add into the persistable var (the auc-op pattern) — XLA
+    fuses the whole bundle into a couple of kernels.
+    """
+    assert tuple(input.shape) == tuple(label.shape), \
+        "ctr_metric_bundle: input and label must share a shape " \
+        f"(got {input.shape} vs {label.shape})"
+    helper = LayerHelper("ctr_metric_bundle")
+
+    locals_ = []
+    for nm in ("sqrerr", "abserr", "prob", "q", "pos_num", "ins_num"):
+        v = helper.create_or_get_global_variable(
+            helper.name + "." + nm, shape=(1,), dtype="float32",
+            persistable=True)
+        v.stop_gradient = True
+        init_mod.ConstantInitializer(0.0)(v)
+        locals_.append(v)
+    (local_sqrerr, local_abserr, local_prob, local_q, local_pos_num,
+     local_ins_num) = locals_
+
+    label_f = layers.cast(label, "float32")
+    diff = layers.elementwise_sub(input, label_f)
+
+    def _acc(batch_val, local_var):
+        helper.append_op("elementwise_add",
+                         {"X": batch_val, "Y": local_var},
+                         {"Out": local_var})
+
+    batch_sqrerr = helper.create_variable_for_type_inference("float32", (1,))
+    helper.append_op("squared_l2_norm", {"X": diff}, {"Out": batch_sqrerr})
+    _acc(batch_sqrerr, local_sqrerr)
+
+    batch_abserr = helper.create_variable_for_type_inference("float32", (1,))
+    helper.append_op("l1_norm", {"X": diff}, {"Out": batch_abserr})
+    _acc(batch_abserr, local_abserr)
+
+    _acc(layers.reduce_sum(input), local_prob)
+    _acc(layers.reduce_sum(layers.sigmoid(input)), local_q)
+    _acc(layers.reduce_sum(label_f), local_pos_num)
+
+    ones = layers.fill_constant_batch_size_like(
+        input=label, shape=[-1, 1], dtype="float32", value=1.0)
+    _acc(layers.reduce_sum(ones), local_ins_num)
+
+    return (local_sqrerr, local_abserr, local_prob, local_q, local_pos_num,
+            local_ins_num)

@@ -1,0 +1,50 @@
+"""Fused elementwise + activation.
+
+Parity: python/paddle/fluid/contrib/layers/nn.py:29-90
+(``fused_elemwise_activation``).
+"""
+
+from ... import layers
+
+__all__ = ["fused_elemwise_activation"]
+
+_BINARY = {"elementwise_add", "elementwise_mul"}
+_UNARY = {"scale", "relu", "tanh", "sigmoid"}
+
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=True):
+    """out = Unary(Binary(x, y)) or Binary(x, Unary(y)), per functor order
+    (ref nn.py:59-63: ['elementwise_add', 'relu'] -> add(x, relu(y));
+    ['relu', 'elementwise_add'] -> relu(add(x, y))).
+
+    The reference needs a dedicated fused CUDA op to avoid a memory
+    round-trip; on TPU this is a plain composition — XLA fuses the
+    elementwise chain into the surrounding kernel unconditionally, so
+    ``save_intermediate_out`` (a grad-memory knob for the CUDA kernel) is
+    accepted for signature parity and has no effect.
+    """
+    if isinstance(functor_list, str):
+        functor_list = functor_list.split(",")
+    if not isinstance(functor_list, (list, tuple)) or len(functor_list) != 2:
+        raise ValueError("functor_list should be a list of str of length 2, "
+                         f"got {functor_list!r}")
+    functor_list = [f.strip() for f in functor_list]
+    names = set(functor_list)
+    if not (names & _BINARY) or not (names & _UNARY):
+        raise ValueError(
+            "functor_list needs one binary functor from "
+            f"{sorted(_BINARY)} and one unary from {sorted(_UNARY)}, "
+            f"got {functor_list}")
+
+    def unary(v, nm):
+        if nm == "scale":
+            return layers.scale(v, scale=scale)
+        return getattr(layers, nm)(v)
+
+    def binary(a, b, nm):
+        return getattr(layers, nm)(a, b, axis=axis)
+
+    if functor_list[0] in _BINARY:
+        return binary(x, unary(y, functor_list[1]), functor_list[0])
+    return unary(binary(x, y, functor_list[1]), functor_list[0])

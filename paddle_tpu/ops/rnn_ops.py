@@ -272,3 +272,123 @@ def lstmp(ctx):
         rs, cs = rs[:, ::-1], cs[:, ::-1]
     return {"Projection": rs, "Cell": cs,
             "LastH": r_last, "LastC": c_last}
+
+
+_BASIC_ACTS = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+               "relu": jax.nn.relu, "identity": lambda v: v}
+
+
+@register("basic_gru")
+def basic_gru(ctx):
+    """One direction x one layer of contrib basic_gru, full sequence.
+
+    Parity: python/paddle/fluid/contrib/layers/rnn_impl.py:22-137 (the
+    BasicGRUUnit recurrence the reference unrolls via StaticRNN); here the
+    whole layer is ONE lax.scan with the input projections hoisted out onto
+    a single (B*T, D)x(D, 3H) MXU matmul.
+
+    Weight layout matches the unit: GateW (D+H, 2H) producing (r, u) in that
+    split order (rnn_impl.py:125 ``r, u = split(gate_input, 2)``), CandW
+    (D+H, H). The candidate uses ``(r * h_prev) @ CandW_h`` — the unit's
+    DOCUMENTED math (rnn_impl.py:33 ``m_t = actNode(W_cx x + W_ch dot(r, h)
+    + b)``). NOTE the reference 1.5 *code* computes r_hidden and then never
+    uses it (rnn_impl.py:127-131 feeds pre_hidden, not r_hidden, to the
+    candidate matmul — the reset gate is dead there, fixed in later Paddle);
+    we implement the documented equations.
+
+    Blend is u*h + (1-u)*c (rnn_impl.py:135 — the original-paper form, NOT
+    the gru_op default blend).
+
+    Inputs: Input (B,T,D), GateW (D+H,2H), GateB (2H,), CandW (D+H,H),
+    CandB (H,), optional H0 (B,H), optional Length (B,).
+    Outputs: Hidden (B,T,H), LastH (B,H).
+    """
+    x = ctx.in_("Input")
+    gate_w = ctx.in_("GateW")
+    gate_b = ctx.in_("GateB")
+    cand_w = ctx.in_("CandW")
+    cand_b = ctx.in_("CandB")
+    lengths = ctx.in_("Length")
+    d = x.shape[-1]
+    h = cand_w.shape[-1]
+    b, t = x.shape[0], x.shape[1]
+    h0 = ctx.in_("H0")
+    if h0 is None:
+        h0 = jnp.zeros((b, h), x.dtype)
+    act_g = _BASIC_ACTS[ctx.attr("gate_activation", "sigmoid")]
+    act_c = _BASIC_ACTS[ctx.attr("activation", "tanh")]
+    # hoist: input half of both projections out of the scan
+    xg = x @ gate_w[:d] + gate_b          # (B, T, 2H)
+    xc = x @ cand_w[:d] + cand_b          # (B, T, H)
+    wh_g = gate_w[d:]                     # (H, 2H)
+    wh_c = cand_w[d:]                     # (H, H)
+    xs_g = jnp.swapaxes(xg, 0, 1)
+    xs_c = jnp.swapaxes(xc, 0, 1)
+    steps = jnp.arange(t)
+    reverse = bool(ctx.attr("is_reverse", False))
+    if reverse:
+        xs_g, xs_c, steps = xs_g[::-1], xs_c[::-1], steps[::-1]
+
+    def body(h_prev, inp):
+        g_t, c_t, step = inp
+        gates = act_g(g_t + h_prev @ wh_g)
+        r, u = gates[:, :h], gates[:, h:]
+        c = act_c(c_t + (r * h_prev) @ wh_c)
+        h_new = u * h_prev + (1 - u) * c
+        if lengths is not None:
+            m = _len_mask(lengths, step, h_new.dtype)
+            h_new = m * h_new + (1 - m) * h_prev
+        return h_new, h_new
+
+    h_last, hs = jax.lax.scan(body, h0, (xs_g, xs_c, steps))
+    hs = jnp.swapaxes(hs, 0, 1)
+    if reverse:
+        hs = hs[:, ::-1]
+    return {"Hidden": hs, "LastH": h_last}
+
+
+@register("basic_lstm")
+def basic_lstm(ctx):
+    """One direction x one layer of contrib basic_lstm, full sequence.
+
+    Parity: python/paddle/fluid/contrib/layers/rnn_impl.py:622-764
+    (BasicLSTMUnit: single fused Weight (D+H, 4H), gate split order
+    (i, j, f, o) at rnn_impl.py:736, forget_bias added to f) unrolled by
+    StaticRNN at rnn_impl.py:515-612; here one lax.scan, input projection
+    hoisted.
+
+    Inputs: Input (B,T,D), Weight (D+H,4H), Bias (4H,), optional H0/C0
+    (B,H), optional Length (B,). Outputs: Hidden (B,T,H), LastH, LastC.
+
+    Implementation: the recurrence IS the fluid lstm one, so this reuses
+    _lstm_scan after a one-time block permutation of the 4H columns from
+    contrib order (i, j, f, o) to fluid order (i, f, c, o) and folding
+    forget_bias into the f bias slice — XLA constant-folds both.
+    """
+    x = ctx.in_("Input")
+    w = ctx.in_("Weight")
+    bias = ctx.in_("Bias")
+    lengths = ctx.in_("Length")
+    d = x.shape[-1]
+    h = w.shape[-1] // 4
+    b = x.shape[0]
+    h0 = ctx.in_("H0")
+    c0 = ctx.in_("C0")
+    if h0 is None:
+        h0 = jnp.zeros((b, h), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((b, h), x.dtype)
+    act_g = _BASIC_ACTS[ctx.attr("gate_activation", "sigmoid")]
+    act_c = _BASIC_ACTS[ctx.attr("activation", "tanh")]
+    fb = ctx.attr("forget_bias", 1.0)
+
+    def ifco(m):                          # (..., 4H) contrib -> fluid order
+        return jnp.concatenate([m[..., :h], m[..., 2 * h:3 * h],
+                                m[..., h:2 * h], m[..., 3 * h:]], axis=-1)
+
+    bias_p = ifco(bias).at[h:2 * h].add(fb)
+    hs, _, h_last, c_last = _lstm_scan(
+        ifco(x @ w[:d]), h0, c0, ifco(w[d:]), bias_p, lengths,
+        gate_act=act_g, cell_act=act_c, cand_act=act_c,
+        reverse=bool(ctx.attr("is_reverse", False)))
+    return {"Hidden": hs, "LastH": h_last, "LastC": c_last}

@@ -1,0 +1,324 @@
+"""contrib.layers numeric tests vs the reference formulas.
+
+Parity: python/paddle/fluid/contrib/layers/ (rnn_impl.py, metric_op.py,
+nn.py). Goldens implement the DOCUMENTED math (rnn_impl.py:26-33, 640-652);
+see paddle_tpu/contrib/layers/rnn_impl.py for the two reference code quirks
+we deliberately do not reproduce.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib import layers as contrib_layers
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _golden_basic_gru(x, gate_w, gate_b, cand_w, cand_b, h0, lengths=None):
+    b, t, _ = x.shape
+    h = cand_w.shape[1]
+    hp = h0.copy()
+    outs = []
+    for step in range(t):
+        xh = np.concatenate([x[:, step], hp], 1)
+        g = _sigmoid(xh @ gate_w + gate_b)
+        r, u = g[:, :h], g[:, h:]
+        xrh = np.concatenate([x[:, step], r * hp], 1)
+        c = np.tanh(xrh @ cand_w + cand_b)
+        hn = u * hp + (1 - u) * c
+        if lengths is not None:
+            m = (step < lengths).astype("float32")[:, None]
+            hn = m * hn + (1 - m) * hp
+        hp = hn
+        outs.append(hp.copy())
+    return np.stack(outs, 1), hp
+
+
+def _golden_basic_lstm(x, w, bias, h0, c0, forget_bias=1.0, lengths=None):
+    b, t, _ = x.shape
+    h = w.shape[1] // 4
+    hp, cp = h0.copy(), c0.copy()
+    outs = []
+    for step in range(t):
+        g = np.concatenate([x[:, step], hp], 1) @ w + bias
+        i, j, f, o = np.split(g, 4, axis=-1)
+        cn = cp * _sigmoid(f + forget_bias) + _sigmoid(i) * np.tanh(j)
+        hn = np.tanh(cn) * _sigmoid(o)
+        if lengths is not None:
+            m = (step < lengths).astype("float32")[:, None]
+            hn = m * hn + (1 - m) * hp
+            cn = m * cn + (1 - m) * cp
+        hp, cp = hn, cn
+        outs.append(hp.copy())
+    return np.stack(outs, 1), hp, cp
+
+
+def test_basic_gru_matches_golden():
+    np.random.seed(0)
+    b, t, d, h = 3, 5, 4, 6
+    x = np.random.randn(b, t, d).astype("float32")
+    h0 = np.random.randn(1, b, h).astype("float32")
+    lengths = np.array([5, 3, 1], "int32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", [b, t, d], append_batch_size=False)
+        h0v = layers.data("h0", [1, b, h], append_batch_size=False)
+        lv = layers.data("len", [b], dtype="int32", append_batch_size=False)
+        out, last = contrib_layers.basic_gru(
+            xv, h0v, h, sequence_length=lv,
+            param_attr=fluid.ParamAttr(name="gp"),
+            bias_attr=fluid.ParamAttr(name="gb"))
+    exe = fluid.Executor()
+    exe.run(startup)
+    scope = fluid.global_scope()
+    gate_w = np.asarray(scope.get("gp_gate_w_layers_0"))
+    gate_b = np.asarray(scope.get("gb_gate_b_layers_0"))
+    cand_w = np.asarray(scope.get("gp_cand_w_layers_0"))
+    cand_b = np.asarray(scope.get("gb_cand_b_layers_0"))
+    got, got_last = exe.run(main, feed={"x": x, "h0": h0, "len": lengths},
+                            fetch_list=[out, last])
+    want, want_last = _golden_basic_gru(x, gate_w, gate_b, cand_w, cand_b,
+                                        h0[0], lengths)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got_last)[0], want_last,
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_basic_gru_bidirectional_matches_golden():
+    np.random.seed(1)
+    b, t, d, h = 2, 4, 3, 5
+    x = np.random.randn(b, t, d).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", [b, t, d], append_batch_size=False)
+        out, last = contrib_layers.basic_gru(
+            xv, None, h, bidirectional=True,
+            param_attr=fluid.ParamAttr(name="p"),
+            bias_attr=fluid.ParamAttr(name="q"))
+    exe = fluid.Executor()
+    exe.run(startup)
+    scope = fluid.global_scope()
+
+    def p(nm):
+        return np.asarray(fluid.global_scope().get(nm))
+
+    z = np.zeros((b, h), "float32")
+    fw, fw_last = _golden_basic_gru(
+        x, p("p_gate_w_layers_0"), p("q_gate_b_layers_0"),
+        p("p_cand_w_layers_0"), p("q_cand_b_layers_0"), z)
+    bw_rev, bw_last = _golden_basic_gru(
+        x[:, ::-1], p("p_gate_w_reverse_layers_0"),
+        p("q_gate_b_reverse_layers_0"), p("p_cand_w_reverse_layers_0"),
+        p("q_cand_b_reverse_layers_0"), z)
+    got, got_last = exe.run(main, feed={"x": x}, fetch_list=[out, last])
+    want = np.concatenate([fw, bw_rev[:, ::-1]], axis=2)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+    # last_hidden interleaves (layer0_fw, layer0_bw) per the reference's
+    # axis-1 concat + reshape (rnn_impl.py:333-337)
+    got_last = np.asarray(got_last)
+    assert got_last.shape == (2, b, h)
+    np.testing.assert_allclose(got_last[0], fw_last, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(got_last[1], bw_last, rtol=2e-5, atol=2e-5)
+
+
+def test_basic_gru_multilayer_shapes():
+    b, t, d, h, L = 2, 3, 4, 5, 3
+    x = np.random.randn(b, t, d).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", [b, t, d], append_batch_size=False)
+        out, last = contrib_layers.basic_gru(xv, None, h, num_layers=L)
+    exe = fluid.Executor()
+    exe.run(startup)
+    got, got_last = exe.run(main, feed={"x": x}, fetch_list=[out, last])
+    assert np.asarray(got).shape == (b, t, h)
+    assert np.asarray(got_last).shape == (L, b, h)
+
+
+def test_basic_lstm_matches_golden():
+    np.random.seed(2)
+    b, t, d, h = 3, 4, 5, 6
+    x = np.random.randn(b, t, d).astype("float32")
+    h0 = np.random.randn(1, b, h).astype("float32")
+    c0 = np.random.randn(1, b, h).astype("float32")
+    lengths = np.array([4, 2, 3], "int32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", [b, t, d], append_batch_size=False)
+        h0v = layers.data("h0", [1, b, h], append_batch_size=False)
+        c0v = layers.data("c0", [1, b, h], append_batch_size=False)
+        lv = layers.data("len", [b], dtype="int32", append_batch_size=False)
+        out, lh, lc = contrib_layers.basic_lstm(
+            xv, h0v, c0v, h, sequence_length=lv, forget_bias=1.0,
+            param_attr=fluid.ParamAttr(name="lp"),
+            bias_attr=fluid.ParamAttr(name="lb"))
+    exe = fluid.Executor()
+    exe.run(startup)
+    scope = fluid.global_scope()
+    w = np.asarray(scope.get("lp_w_layers_0"))
+    bias = np.asarray(scope.get("lb_b_layers_0"))
+    got, got_lh, got_lc = exe.run(
+        main, feed={"x": x, "h0": h0, "c0": c0, "len": lengths},
+        fetch_list=[out, lh, lc])
+    want, want_lh, want_lc = _golden_basic_lstm(x, w, bias, h0[0], c0[0],
+                                                1.0, lengths)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got_lh)[0], want_lh,
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got_lc)[0], want_lc,
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_basic_lstm_time_major_roundtrip():
+    b, t, d, h = 2, 3, 4, 5
+    x = np.random.randn(t, b, d).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", [t, b, d], append_batch_size=False)
+        out, lh, lc = contrib_layers.basic_lstm(xv, None, None, h,
+                                                batch_first=False)
+    exe = fluid.Executor()
+    exe.run(startup)
+    got = exe.run(main, feed={"x": x}, fetch_list=[out])[0]
+    assert np.asarray(got).shape == (t, b, h)
+
+
+def test_basic_gru_unit_dygraph():
+    np.random.seed(3)
+    b, d, h = 3, 4, 5
+    with fluid.dygraph.guard():
+        unit = contrib_layers.BasicGRUUnit("gru_unit", h)
+        x = fluid.dygraph.to_variable(np.random.randn(b, d)
+                                      .astype("float32"))
+        hp = fluid.dygraph.to_variable(np.random.randn(b, h)
+                                       .astype("float32"))
+        out = unit(x, hp)
+        gw = np.asarray(unit._gate_weight.value)
+        gb = np.asarray(unit._gate_bias.value)
+        cw = np.asarray(unit._candidate_weight.value)
+        cb = np.asarray(unit._candidate_bias.value)
+        xh = np.concatenate([np.asarray(x.value), np.asarray(hp.value)], 1)
+        g = _sigmoid(xh @ gw + gb)
+        r, u = g[:, :h], g[:, h:]
+        xrh = np.concatenate([np.asarray(x.value),
+                              r * np.asarray(hp.value)], 1)
+        c = np.tanh(xrh @ cw + cb)
+        want = u * np.asarray(hp.value) + (1 - u) * c
+        np.testing.assert_allclose(np.asarray(out.value), want,
+                                   rtol=2e-5, atol=2e-5)
+        assert len(unit.parameters()) == 4
+
+
+def test_basic_lstm_unit_dygraph():
+    np.random.seed(4)
+    b, d, h = 2, 3, 4
+    with fluid.dygraph.guard():
+        unit = contrib_layers.BasicLSTMUnit("lstm_unit", h, forget_bias=1.0)
+        x = fluid.dygraph.to_variable(np.random.randn(b, d)
+                                      .astype("float32"))
+        hp = fluid.dygraph.to_variable(np.random.randn(b, h)
+                                       .astype("float32"))
+        cp = fluid.dygraph.to_variable(np.random.randn(b, h)
+                                       .astype("float32"))
+        nh, nc = unit(x, hp, cp)
+        w = np.asarray(unit._weight.value)
+        bias = np.asarray(unit._bias.value)
+        g = np.concatenate([np.asarray(x.value), np.asarray(hp.value)],
+                           1) @ w + bias
+        i, j, f, o = np.split(g, 4, axis=-1)
+        want_c = (np.asarray(cp.value) * _sigmoid(f + 1.0)
+                  + _sigmoid(i) * np.tanh(j))
+        want_h = np.tanh(want_c) * _sigmoid(o)
+        np.testing.assert_allclose(np.asarray(nc.value), want_c,
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(nh.value), want_h,
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_ctr_metric_bundle_accumulates():
+    np.random.seed(5)
+    b = 4
+    preds = [np.random.rand(b, 1).astype("float32") for _ in range(2)]
+    labels = [np.random.randint(0, 2, (b, 1)).astype("float32")
+              for _ in range(2)]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pv = layers.data("p", [b, 1], append_batch_size=False)
+        lv = layers.data("l", [b, 1], append_batch_size=False)
+        outs = contrib_layers.ctr_metric_bundle(pv, lv)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for p, l in zip(preds, labels):
+            vals = exe.run(main, feed={"p": p, "l": l},
+                           fetch_list=list(outs))
+    p_all = np.concatenate(preds)
+    l_all = np.concatenate(labels)
+    sqrerr, abserr, prob, q, pos, ins = [
+        float(np.asarray(v).reshape(-1)[0]) for v in vals]
+    np.testing.assert_allclose(sqrerr, ((p_all - l_all) ** 2).sum(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(abserr, np.abs(p_all - l_all).sum(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(prob, p_all.sum(), rtol=1e-5)
+    np.testing.assert_allclose(q, _sigmoid(p_all).sum(), rtol=1e-5)
+    np.testing.assert_allclose(pos, l_all.sum(), rtol=1e-5)
+    np.testing.assert_allclose(ins, 2 * b, rtol=1e-6)
+
+
+def test_fused_elemwise_activation_both_orders():
+    np.random.seed(6)
+    x = np.random.randn(2, 3).astype("float32")
+    y = np.random.randn(2, 3).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", [2, 3], append_batch_size=False)
+        yv = layers.data("y", [2, 3], append_batch_size=False)
+        a = contrib_layers.fused_elemwise_activation(
+            xv, yv, ["elementwise_add", "relu"])
+        bout = contrib_layers.fused_elemwise_activation(
+            xv, yv, ["relu", "elementwise_add"])
+        c = contrib_layers.fused_elemwise_activation(
+            xv, yv, ["elementwise_mul", "scale"], scale=2.0)
+    exe = fluid.Executor()
+    exe.run(startup)
+    got_a, got_b, got_c = exe.run(main, feed={"x": x, "y": y},
+                                  fetch_list=[a, bout, c])
+    np.testing.assert_allclose(got_a, x + np.maximum(y, 0), rtol=1e-6)
+    np.testing.assert_allclose(got_b, np.maximum(x + y, 0), rtol=1e-6)
+    np.testing.assert_allclose(got_c, x * (2.0 * y), rtol=1e-6)
+
+
+def test_fused_elemwise_activation_validates():
+    import pytest
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", [2, 2], append_batch_size=False)
+        with pytest.raises(ValueError):
+            contrib_layers.fused_elemwise_activation(xv, xv, ["relu"])
+        with pytest.raises(ValueError):
+            contrib_layers.fused_elemwise_activation(xv, xv,
+                                                     ["relu", "tanh"])
+
+
+def test_contrib_layers_all_exports():
+    want = {"BasicGRUUnit", "basic_gru", "BasicLSTMUnit", "basic_lstm",
+            "ctr_metric_bundle", "fused_elemwise_activation"}
+    assert want <= set(contrib_layers.__all__)
+    for nm in want:
+        assert callable(getattr(contrib_layers, nm))
+
+
+def test_rnn_activation_validated_at_build_time():
+    import pytest
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", [2, 3, 4], append_batch_size=False)
+        with pytest.raises(ValueError, match="unsupported activation"):
+            contrib_layers.basic_gru(xv, None, 5, activation=layers.softsign)
+        with pytest.raises(ValueError, match="unsupported activation"):
+            contrib_layers.basic_lstm(xv, None, None, 5,
+                                      gate_activation="softplus")
