@@ -36,6 +36,7 @@ from . import average
 from . import evaluator
 from . import net_drawer
 from . import contrib
+from . import incubate
 from . import communicator
 from .communicator import Communicator
 from . import io

@@ -1,0 +1,7 @@
+"""incubate — experimental user-facing APIs.
+
+Parity: python/paddle/fluid/incubate/ (fleet lives under
+paddle_tpu.parallel.fleet; data_generator here).
+"""
+
+from . import data_generator  # noqa: F401
