@@ -49,6 +49,12 @@ from . import dataset
 from . import reader
 from . import dygraph
 from . import parallel
+# fluid exposes the transpiler surface at top level (ref fluid/__init__.py
+# pulling transpiler.__all__); same names, mesh-first implementations
+from . import transpiler
+from .transpiler import (DistributeTranspiler,
+                         DistributeTranspilerConfig,
+                         memory_optimize, release_memory)
 from . import profiler
 from . import amp
 from . import models

@@ -2,6 +2,13 @@
 
 from . import decoder  # noqa: F401
 from . import layers  # noqa: F401
+from . import reader  # noqa: F401
+from . import utils  # noqa: F401
+from . import quantize  # noqa: F401
+from . import slim  # noqa: F401
+from . import memory_usage_calc  # noqa: F401
+from .memory_usage_calc import memory_usage  # noqa: F401
+from .reader import distributed_batch_reader  # noqa: F401
 from . import mixed_precision  # noqa: F401
 from . import extend_optimizer  # noqa: F401
 from .extend_optimizer import extend_with_decoupled_weight_decay  # noqa: F401

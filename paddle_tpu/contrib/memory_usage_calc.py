@@ -1,0 +1,67 @@
+"""Estimate a program's activation/parameter memory from its desc.
+
+Parity: python/paddle/fluid/contrib/memory_usage_calc.py:46
+(``memory_usage``).
+
+The reference walks OpDesc outputs and sums LoD tensor bytes, scaling
+the (single allowed) -1 dim by batch_size, then pads 5-10% for
+workspace. Same contract here over our JSON program desc — note that
+under whole-program XLA compilation the TRUE footprint is what the
+compiled executable reserves (executor stats / utils.memory report
+that); this estimator remains useful pre-compile for batch-size
+sizing, which is its reference use case.
+"""
+
+from ..core.framework import Program
+
+__all__ = ["memory_usage"]
+
+_DTYPE_SIZE = {"float16": 2, "bfloat16": 2, "float32": 4, "float64": 8,
+               "int8": 1, "uint8": 1, "int16": 2, "int32": 4, "int64": 8,
+               "bool": 1}
+
+
+def memory_usage(program, batch_size):
+    """Returns (min_total, max_total, unit_str) like the reference
+    (memory_usage_calc.py:46-137): sum over every op-output var of
+    prod(shape) * dtype-size, -1 dims scaled by batch_size, 5%%/10%%
+    headroom, unit auto-scaled through KB/MB."""
+    if not isinstance(program, Program):
+        raise TypeError(
+            "Calculating Memory Usage requires Program as its Parameter. "
+            f"But you passed in {type(program)}")
+    if batch_size <= 0:
+        raise ValueError("The batch size need to be positive.")
+
+    total = 0.0
+    seen = {"@EMPTY@"}
+    block = program.global_block()
+    for op in block.ops:
+        for name in op.output_names:
+            if name in seen:
+                continue
+            seen.add(name)
+            var = block.vars.get(name)
+            if var is None or var.shape is None:
+                continue
+            count = 1
+            neg_dims = 0
+            for x in var.shape:
+                if x < 0:
+                    neg_dims += 1
+                    if neg_dims > 1:
+                        raise ValueError(
+                            f"Var {name} has more than one negative dim.")
+                    count *= batch_size * (-x)
+                else:
+                    count *= x
+            total += count * _DTYPE_SIZE.get(str(var.dtype), 4)
+
+    unit = "B"
+    if total > 1024:
+        total /= 1024
+        unit = "KB"
+        if total > 1024:
+            total /= 1024
+            unit = "MB"
+    return total * 1.05, total * 1.1, unit

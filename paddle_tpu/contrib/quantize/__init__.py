@@ -1,0 +1,6 @@
+"""Parity: python/paddle/fluid/contrib/quantize/ — the older
+program-level QAT transpiler, delegating to quant/passes.py."""
+
+from ...quant.passes import QuantizeTranspiler  # noqa: F401
+
+__all__ = ["QuantizeTranspiler"]

@@ -1,0 +1,40 @@
+"""contrib.reader — multi-process reader decorators.
+
+Parity: python/paddle/fluid/contrib/reader/distributed_reader.py:21
+(``distributed_batch_reader``).
+"""
+
+import os
+
+__all__ = ["distributed_batch_reader"]
+
+
+def distributed_batch_reader(batch_reader):
+    """Shard a batch reader across trainers: trainer *i* of *N* keeps the
+    i-th batch of every complete group of N (incomplete tail groups are
+    dropped, matching the reference's buffering loop,
+    distributed_reader.py:43-66). Reads PADDLE_TRAINERS_NUM /
+    PADDLE_TRAINER_ID at decoration time like the reference.
+
+    On TPU this is the HOST-side sharding for per-process input
+    pipelines; in-step data parallelism instead shards one global batch
+    via the mesh (parallel/mesh.py), which is the preferred path.
+    """
+    trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    trainer_id = int(os.getenv("PADDLE_TRAINER_ID", 0))
+    assert trainer_id < trainers_num, (
+        f"trainer_id {trainer_id} must be < PADDLE_TRAINERS_NUM "
+        f"{trainers_num}")
+
+    def decorate_for_multi_process():
+        if trainers_num == 1:
+            yield from batch_reader()
+            return
+        group = []
+        for data in batch_reader():
+            group.append(data)
+            if len(group) == trainers_num:
+                yield group[trainer_id]
+                group = []
+
+    return decorate_for_multi_process

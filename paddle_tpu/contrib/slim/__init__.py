@@ -1,0 +1,7 @@
+"""Parity: python/paddle/fluid/contrib/slim/ — the slim surface lives in
+paddle_tpu.slim (one implementation, this reference import path)."""
+
+from ...slim import *  # noqa: F401,F403
+from ...slim import Compressor  # noqa: F401
+
+__all__ = ["Compressor"]

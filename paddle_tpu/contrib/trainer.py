@@ -8,13 +8,37 @@ import warnings
 import numpy as np
 
 from ..core import framework
+from ..core import unique_name
 from ..core.data_feeder import DataFeeder
 from ..core.executor import Executor, Scope, scope_guard
 from ..core.place import TPUPlace
 from ..io.state import save_params, load_params
 
 __all__ = ["BeginEpochEvent", "EndEpochEvent", "BeginStepEvent",
-           "EndStepEvent", "Trainer"]
+           "EndStepEvent", "Trainer", "CheckpointConfig"]
+
+
+class CheckpointConfig:
+    """Parity: contrib/trainer.py:100 — how often / where the Trainer
+    checkpoints. `load_serial` (e.g. "2.10") makes the Trainer restore
+    that checkpoint at construction instead of starting fresh.
+    pserver_id/lookup_table_name existed for pserver shard checkpoints
+    and stay None here (whole-state saves)."""
+
+    def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
+                 epoch_interval=1, step_interval=10):
+        import os as _os
+        assert epoch_interval >= 1
+        assert step_interval >= 1
+        self.checkpoint_dir = checkpoint_dir or _os.getcwd()
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = epoch_interval
+        self.step_interval = step_interval
+        self.epoch_id = 0
+        self.step_id = 0
+        self.load_serial = None
+        self.pserver_id = None
+        self.lookup_table_name = None
 
 
 class BeginEpochEvent:
@@ -53,11 +77,17 @@ class Trainer:
             "reference); use fluid.Executor with exe.run or "
             "exe.train_from_dataset.", stacklevel=2)
         self.place = place if place is not None else TPUPlace(0)
+        self.checkpoint_cfg = checkpoint_config
+        self._own_checkpoints = []
         self.scope = Scope()
         self.train_program = framework.Program()
         self.startup_program = framework.Program()
-        with framework.program_guard(self.train_program,
-                                     self.startup_program):
+        # fresh name generator: two Trainers built from the same
+        # train_func must produce identical param names, or checkpoint
+        # resume (load_serial) would silently load nothing
+        with unique_name.guard(), \
+                framework.program_guard(self.train_program,
+                                        self.startup_program):
             out = train_func()
             self.train_outs = list(out) if isinstance(out, (list, tuple)) \
                 else [out]
@@ -68,6 +98,13 @@ class Trainer:
             self.exe.run(self.startup_program)
             if param_path:
                 load_params(self.exe, param_path,
+                            main_program=self.train_program)
+            cfg = self.checkpoint_cfg
+            if cfg is not None and cfg.load_serial is not None:
+                import os as _os
+                load_params(self.exe,
+                            _os.path.join(cfg.checkpoint_dir,
+                                          f"checkpoint_{cfg.load_serial}"),
                             main_program=self.train_program)
 
     def train(self, num_epochs, event_handler, reader=None,
@@ -84,7 +121,27 @@ class Trainer:
                                        feed=feeder.feed(batch),
                                        fetch_list=fetches)
                     event_handler(EndStepEvent(epoch, step, out))
+                    self._maybe_checkpoint(epoch, step)
                 event_handler(EndEpochEvent(epoch))
+
+    def _maybe_checkpoint(self, epoch, step):
+        cfg = self.checkpoint_cfg
+        if cfg is None:
+            return
+        if epoch % cfg.epoch_interval or step % cfg.step_interval:
+            return
+        import os as _os
+        serial = f"{epoch}.{step}"
+        path = _os.path.join(cfg.checkpoint_dir, f"checkpoint_{serial}")
+        save_params(self.exe, path, main_program=self.train_program)
+        cfg.epoch_id, cfg.step_id = epoch, step
+        # retention over THIS trainer's saves only — checkpoint_dir
+        # defaults to cwd, which may hold unrelated user directories
+        self._own_checkpoints.append(path)
+        while len(self._own_checkpoints) > cfg.max_num_checkpoints:
+            import shutil
+            shutil.rmtree(self._own_checkpoints.pop(0),
+                          ignore_errors=True)
 
     def test(self, reader, feed_order):
         feeder = DataFeeder(feed_order, program=self.test_program)

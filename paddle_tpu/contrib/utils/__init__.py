@@ -1,0 +1,15 @@
+"""contrib.utils — HDFS shell-out client + lookup-table migration shims.
+
+Parity: python/paddle/fluid/contrib/utils/ (hdfs_utils.py:35,
+lookup_table_utils.py:28).
+"""
+
+from .hdfs_utils import HDFSClient, multi_download, multi_upload  # noqa: F401
+from .lookup_table_utils import (  # noqa: F401
+    convert_dist_to_sparse_program, load_persistables_for_increment,
+    load_persistables_for_inference)
+
+__all__ = ["HDFSClient", "multi_download", "multi_upload",
+           "convert_dist_to_sparse_program",
+           "load_persistables_for_increment",
+           "load_persistables_for_inference"]

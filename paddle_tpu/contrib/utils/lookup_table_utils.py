@@ -1,0 +1,48 @@
+"""Parity shims: python/paddle/fluid/contrib/utils/lookup_table_utils.py:28
+— documented NON-PORT of the distributed-lookup-table loaders.
+
+The reference helpers rewrite a DistributeTranspiler'd program so a
+sharded pserver embedding (`distributed_lookup_table`) can be reloaded
+for incremental training or folded back for inference. TPU training
+never splits the embedding off into pservers: the table is a regular
+parameter sharded over the mesh by GSPMD (annotate it in
+parallel/mesh.py; collectives ride ICI), so checkpoints keep ONE
+logical table and the standard loaders already cover both use cases:
+
+- incremental training -> fluid.io.load_persistables / Checkpointer
+  resume (io/state.py, io/checkpoint.py),
+- inference           -> fluid.io.load_inference_model (io/inference_io.py).
+
+MIGRATION.md covers converting pserver lookup-table configs. These
+raise instead of silently half-working on a program that has no
+pserver ops to rewrite.
+"""
+
+__all__ = ["convert_dist_to_sparse_program",
+           "load_persistables_for_increment",
+           "load_persistables_for_inference"]
+
+_MSG = ("{name} is a pserver distributed-lookup-table helper with no TPU "
+        "analog: embeddings shard over the device mesh as ordinary "
+        "parameters (GSPMD), so use {repl} instead. See "
+        "contrib/utils/lookup_table_utils.py and MIGRATION.md.")
+
+
+def convert_dist_to_sparse_program(program):
+    raise NotImplementedError(_MSG.format(
+        name="convert_dist_to_sparse_program",
+        repl="the untranspiled program directly (no sparse split exists)"))
+
+
+def load_persistables_for_increment(dirname, executor, program,
+                                    lookup_table_var, lookup_table_var_path):
+    raise NotImplementedError(_MSG.format(
+        name="load_persistables_for_increment",
+        repl="fluid.io.load_persistables(executor, dirname, program)"))
+
+
+def load_persistables_for_inference(dirname, executor, program,
+                                    lookup_table_var_name):
+    raise NotImplementedError(_MSG.format(
+        name="load_persistables_for_inference",
+        repl="fluid.io.load_inference_model(dirname, executor)"))

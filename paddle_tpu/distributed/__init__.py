@@ -12,7 +12,7 @@ def __getattr__(name):
     # lazy: `python -m paddle_tpu.distributed.launch` re-executes the
     # module, and an eager import here would trigger runpy's
     # found-in-sys.modules warning
-    if name in ("launch", "launch_ps"):
+    if name in ("launch", "launch_ps", "downpour"):
         import importlib
         return importlib.import_module(f".{name}", __name__)
     raise AttributeError(name)

@@ -1,0 +1,32 @@
+"""Parity shim: python/paddle/fluid/distributed/downpour.py:24
+(``DownpourSGD``) — documented NON-PORT of the Downpour async-SGD
+parameter-server trainer (with it, the whole ``fluid.distributed``
+package: helper.py MPIHelper/FileSystem, node.py Downpour
+Server/Worker protobuf builders, ps_instance.py, ps_pb2.py).
+
+Downpour splits a model into dense params (synced via pserver
+push/pull) and sparse embedding tables (sharded over pservers),
+trading staleness for CPU-cluster throughput. On a TPU pod the same
+scale point is reached synchronously: embeddings shard over the mesh
+(GSPMD), optimizer state shards via ZeRO/fsdp
+(parallel/transpiler.py), and gradient exchange is compiled ICI
+collectives overlapped by XLA's scheduler — no staleness, no separate
+server tier, nothing to configure. Use
+
+    from paddle_tpu.incubate.fleet.collective import fleet
+
+with ``DistributedStrategy`` (zero_stage / use_fsdp) instead;
+MIGRATION.md maps the Downpour knobs.
+"""
+
+__all__ = ["DownpourSGD"]
+
+
+class DownpourSGD:
+    def __init__(self, learning_rate=0.001, window=1):
+        raise NotImplementedError(
+            "DownpourSGD is a pserver async-SGD trainer with no TPU "
+            "analog: shard embeddings/optimizer state over the mesh "
+            "instead (fleet + DistributedStrategy(zero_stage=1 or "
+            "use_fsdp=True)). See distributed/downpour.py and "
+            "MIGRATION.md.")

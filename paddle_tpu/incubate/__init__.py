@@ -5,3 +5,4 @@ paddle_tpu.parallel.fleet; data_generator here).
 """
 
 from . import data_generator  # noqa: F401
+from . import fleet  # noqa: F401

@@ -1,0 +1,11 @@
+"""incubate.fleet — reference import-path mirror onto parallel.fleet.
+
+Parity: python/paddle/fluid/incubate/fleet/. The implementation lives in
+paddle_tpu/parallel/fleet.py (one mesh-first Fleet; collective mode is
+native, pserver modes are documented non-ports).
+"""
+
+from . import base  # noqa: F401
+from . import collective  # noqa: F401
+from . import parameter_server  # noqa: F401
+from . import utils  # noqa: F401

@@ -1,0 +1,7 @@
+"""Parity: python/paddle/fluid/incubate/fleet/base/fleet_base.py —
+re-exports of the mesh-first implementations (parallel/fleet.py)."""
+
+from ....parallel.fleet import (  # noqa: F401
+    DistributedOptimizer, Fleet, Mode)
+
+__all__ = ["Mode", "Fleet", "DistributedOptimizer"]

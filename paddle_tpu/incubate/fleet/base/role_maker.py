@@ -1,0 +1,10 @@
+"""Parity: python/paddle/fluid/incubate/fleet/base/role_maker.py —
+re-exports of the env-driven role makers (parallel/fleet.py)."""
+
+from ....parallel.fleet import (  # noqa: F401
+    MPISymetricRoleMaker, PaddleCloudRoleMaker, Role, RoleMakerBase,
+    UserDefinedCollectiveRoleMaker, UserDefinedRoleMaker)
+
+__all__ = ["Role", "RoleMakerBase", "MPISymetricRoleMaker",
+           "UserDefinedRoleMaker", "UserDefinedCollectiveRoleMaker",
+           "PaddleCloudRoleMaker"]

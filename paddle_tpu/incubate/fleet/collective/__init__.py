@@ -1,0 +1,12 @@
+"""Parity: python/paddle/fluid/incubate/fleet/collective/__init__.py —
+the collective-mode fleet singleton and its optimizer wrappers
+(implementation: parallel/fleet.py; GSPMD inserts the collectives the
+reference's transpiled allreduce ops expressed)."""
+
+from ....parallel.fleet import (  # noqa: F401
+    Collective, CollectiveOpBasedOptimizer, CollectiveOptimizer,
+    DistFCConfig, DistributedStrategy, LambConfig, fleet)
+
+__all__ = ["LambConfig", "DistFCConfig", "Collective",
+           "DistributedStrategy", "CollectiveOpBasedOptimizer",
+           "CollectiveOptimizer", "fleet"]

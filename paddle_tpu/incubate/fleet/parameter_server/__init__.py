@@ -1,0 +1,20 @@
+"""Parity shims: python/paddle/fluid/incubate/fleet/parameter_server/ —
+documented NON-PORT of the parameter-server fleet modes.
+
+Both halves (distribute_transpiler mode and the pslib Downpour runtime)
+exist to spread a sparse/async CPU training job over pserver processes.
+A TPU pod has no pserver tier: parameters (including huge embeddings)
+shard over the device mesh as ordinary arrays, optimizer state shards
+with ZeRO/fsdp (parallel/transpiler.py documents the re-expression),
+and the async push/pull becomes compiled ICI collectives. Use
+
+    from paddle_tpu.incubate.fleet.collective import fleet
+
+with a DistributedStrategy instead; MIGRATION.md maps the pserver
+config knobs. The classes here are import-compatible and raise with
+that guidance when constructed (the launch half lives in
+distributed/launch_ps.py, same contract).
+"""
+
+from . import distribute_transpiler  # noqa: F401
+from . import pslib  # noqa: F401

@@ -1,0 +1,21 @@
+"""Parity shim: incubate/fleet/parameter_server/distribute_transpiler —
+pserver fleet mode; see parameter_server/__init__.py for the non-port
+rationale."""
+
+_MSG = ("{name}: parameter-server fleet mode has no TPU analog — "
+        "optimizer state shards over the mesh instead (ZeRO/fsdp). Use "
+        "paddle_tpu.incubate.fleet.collective.fleet with a "
+        "DistributedStrategy; see parallel/transpiler.py and "
+        "MIGRATION.md.")
+
+__all__ = ["DistributedTranspiler", "TranspilerOptimizer"]
+
+
+class DistributedTranspiler:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_MSG.format(name="DistributedTranspiler"))
+
+
+class TranspilerOptimizer:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_MSG.format(name="TranspilerOptimizer"))

@@ -1,0 +1,30 @@
+"""Parity shim: incubate/fleet/parameter_server/pslib — the Downpour
+pserver runtime (PSLib, DownpourOptimizer, DistributedAdam, the
+Server/Worker node descriptors). Non-port; see
+parameter_server/__init__.py for the rationale and replacement."""
+
+_MSG = ("{name}: the pslib Downpour parameter-server runtime has no TPU "
+        "analog — sparse tables shard over the mesh as ordinary "
+        "parameters and the async push/pull is compiled ICI "
+        "collectives. Use paddle_tpu.incubate.fleet.collective.fleet; "
+        "see parallel/transpiler.py and MIGRATION.md.")
+
+__all__ = ["PSLib", "DownpourOptimizer", "DistributedAdam",
+           "Server", "Worker", "DownpourServer", "DownpourWorker"]
+
+
+def _shim(name):
+    class _Shim:
+        def __init__(self, *a, **k):
+            raise NotImplementedError(_MSG.format(name=name))
+    _Shim.__name__ = _Shim.__qualname__ = name
+    return _Shim
+
+
+PSLib = _shim("PSLib")
+DownpourOptimizer = _shim("DownpourOptimizer")
+DistributedAdam = _shim("DistributedAdam")
+Server = _shim("Server")
+Worker = _shim("Worker")
+DownpourServer = _shim("DownpourServer")
+DownpourWorker = _shim("DownpourWorker")

@@ -1,0 +1,7 @@
+"""Parity: python/paddle/fluid/incubate/fleet/utils/hdfs.py — the same
+shell-out client as contrib.utils (one implementation, two reference
+import paths)."""
+
+from ....contrib.utils.hdfs_utils import HDFSClient  # noqa: F401
+
+__all__ = ["HDFSClient"]

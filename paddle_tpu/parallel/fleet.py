@@ -27,6 +27,22 @@ from .mesh import (get_mesh, make_mesh, make_hybrid_mesh, set_mesh,
                    multihost_initialize)
 
 
+class Mode:
+    """Parity: incubate/fleet/base/fleet_base.py:29. On TPU every mode
+    executes as COLLECTIVE (GSPMD over the mesh); TRANSPILER/PSLIB
+    configs are accepted and re-expressed (parallel/transpiler.py)."""
+    TRANSPILER = 1
+    PSLIB = 2
+    COLLECTIVE = 3
+
+
+class Role:
+    """Parity: incubate/fleet/base/role_maker.py:25. There are no
+    parameter servers on TPU; every process is a WORKER."""
+    WORKER = 1
+    SERVER = 2
+
+
 class RoleMakerBase:
     endpoints = None
     current_endpoint = None
@@ -92,6 +108,64 @@ class UserDefinedRoleMaker(RoleMakerBase):
         return self._id
 
 
+class MPISymetricRoleMaker(RoleMakerBase):
+    """Parity: role_maker.py MPISymetricRoleMaker (all ranks are both
+    worker and 'server' under MPI). The reference needs mpi4py; here
+    rank/size come from the mpirun-provided env (OMPI_COMM_WORLD_* /
+    PMI_*) and the TPU job has no server half, so every rank is a
+    worker — symmetric by construction."""
+
+    def __init__(self):
+        self._id = int(os.environ.get("OMPI_COMM_WORLD_RANK",
+                                      os.environ.get("PMI_RANK", "0")))
+        self._num = int(os.environ.get("OMPI_COMM_WORLD_SIZE",
+                                       os.environ.get("PMI_SIZE", "1")))
+
+    def worker_num(self):
+        return self._num
+
+    def worker_index(self):
+        return self._id
+
+
+class UserDefinedCollectiveRoleMaker(RoleMakerBase):
+    """Parity: role_maker.py UserDefinedCollectiveRoleMaker (collective
+    jobs: workers only, explicit endpoint list)."""
+
+    def __init__(self, current_id=0, worker_endpoints=None):
+        if worker_endpoints is None:
+            raise ValueError("worker_endpoints is required")
+        if not 0 <= current_id < len(worker_endpoints):
+            raise ValueError(
+                f"current_id {current_id} out of range for "
+                f"{len(worker_endpoints)} worker_endpoints")
+        self._id = current_id
+        self.endpoints = list(worker_endpoints)
+        self.current_endpoint = self.endpoints[current_id]
+
+    def worker_num(self):
+        return len(self.endpoints)
+
+    def worker_index(self):
+        return self._id
+
+
+class LambConfig:
+    """Parity: collective/__init__.py:31 (empty marker config selecting
+    LAMB in fleet strategies; pass LambOptimizer directly here)."""
+
+    def __init__(self):
+        pass
+
+
+class DistFCConfig:
+    """Parity: collective/__init__.py:36 (marker config for the
+    distributed-FC softmax; tp-sharded fc covers it here)."""
+
+    def __init__(self):
+        pass
+
+
 class DistributedStrategy:
     """Parity: fleet DistributedStrategy — knobs map onto mesh shape +
     program transforms instead of nccl/pserver config.
@@ -118,11 +192,16 @@ class DistributedStrategy:
 
 class DistributedOptimizer:
     """minimize() = inner minimize + the strategy's program transforms
-    (ref collective/__init__.py CollectiveOptimizer, done as annotations)."""
+    (ref collective/__init__.py CollectiveOptimizer, done as annotations).
 
-    def __init__(self, optimizer, fleet_obj):
+    Constructible both ways the reference allows: via
+    fleet.distributed_optimizer(opt) (fleet_obj carries the strategy) or
+    directly as CollectiveOptimizer(opt, strategy)."""
+
+    def __init__(self, optimizer, fleet_obj=None, strategy=None):
         self._inner = optimizer
         self._fleet = fleet_obj
+        self._strategy = strategy
 
     def __getattr__(self, item):
         return getattr(self._inner, item)
@@ -132,7 +211,9 @@ class DistributedOptimizer:
         import inspect
         from .tensor_parallel import apply_shard_rules
         from .transpiler import shard_optimizer_state, shard_params_fsdp
-        s = self._fleet._strategy or DistributedStrategy()
+        fleet_obj = self._fleet if self._fleet is not None else fleet
+        s = (self._strategy or fleet_obj._strategy
+             or DistributedStrategy())
         opt = self._inner
         if s.amp:
             from .. import amp as amp_mod
@@ -254,4 +335,23 @@ class Fleet:
         return save_persistables(executor, dirname, main_program)
 
 
-fleet = Fleet()
+class Collective(Fleet):
+    """Parity: incubate/fleet/collective/__init__.py:41 — the collective
+    mode IS this framework's native mode; the subclass exists so
+    reference code type-checking `isinstance(fleet, Collective)` works."""
+
+
+class CollectiveOptimizer(DistributedOptimizer):
+    """Parity: collective/__init__.py:139 — reference ctor shape
+    (optimizer, strategy=None); the strategy overrides the global
+    fleet's when given."""
+
+    def __init__(self, optimizer, strategy=None):
+        super().__init__(optimizer, None, strategy)
+
+
+class CollectiveOpBasedOptimizer(CollectiveOptimizer):
+    """Parity: collective/__init__.py:114 — the variant that inserted
+    nccl ops directly; annotations make it identical here."""
+
+fleet = Collective()

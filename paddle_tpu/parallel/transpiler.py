@@ -96,18 +96,34 @@ def _numel(shape):
     return n
 
 
-class HashName:
+class PSDispatcher:
+    """Parity: transpiler/ps_dispatcher.py PSDispatcher — base of the
+    var->pserver placement policies. Kept (with HashName/RoundRobin)
+    because DistributeTranspiler's config surface names them; on TPU the
+    'dispatch' result only labels shards, GSPMD does real placement."""
+
     def __init__(self, pserver_endpoints):
         self.pservers = pserver_endpoints
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+
+class HashName(PSDispatcher):
+    def __init__(self, pserver_endpoints):
+        super().__init__(pserver_endpoints)
 
     def dispatch(self, varlist):
         return [self.pservers[hash(v.name) % len(self.pservers)]
                 for v in varlist]
 
 
-class RoundRobin:
+class RoundRobin(PSDispatcher):
     def __init__(self, pserver_endpoints):
-        self.pservers = pserver_endpoints
+        super().__init__(pserver_endpoints)
         self._i = 0
 
     def dispatch(self, varlist):
@@ -116,3 +132,68 @@ class RoundRobin:
             out.append(self.pservers[self._i % len(self.pservers)])
             self._i += 1
         return out
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=True):
+    """Parity no-op: fluid.transpiler.memory_optimize (ref
+    transpiler/memory_optimization_transpiler.py).
+
+    The reference rewrites the program to reuse var buffers between
+    non-overlapping live ranges. Under whole-program XLA compilation
+    that pass already happens — and better — inside the compiler's
+    buffer assignment (liveness-based reuse + donated inputs via the
+    Executor's donate_argnums), so rewriting the program desc would
+    change nothing downstream. Kept callable so reference training
+    scripts run unmodified; utils/memory.py reports the real footprint.
+    """
+    import warnings
+    warnings.warn(
+        "memory_optimize is a no-op on TPU: XLA buffer assignment "
+        "already reuses buffers (and the Executor donates inputs). "
+        "Use jax.checkpoint via program._recompute for activation "
+        "memory.", stacklevel=2)
+
+
+def release_memory(input_program, skip_opt_set=None):
+    """Parity no-op companion of memory_optimize (same rationale)."""
+    import warnings
+    warnings.warn("release_memory is a no-op on TPU (XLA frees buffers "
+                  "at their last use).", stacklevel=2)
+
+
+class GradAllReduce:
+    """Parity shim: transpiler/collective.py:178 — the ring-allreduce
+    grad-sync transpiler. Data-parallel gradient sync needs NO program
+    rewrite here: sharding params over the mesh 'dp' axis makes XLA
+    insert (and fuse) the all-reduces inside the compiled step
+    (tests/perf/test_hlo_audit.py pins that). Construction works for
+    config compatibility; transpile() raises with the replacement."""
+
+    def __init__(self, nrings=2):
+        self.nrings = nrings
+
+    def transpile(self, startup_program=None, main_program=None,
+                  rank=0, endpoints=None, current_endpoint=None,
+                  wait_port=True):
+        raise NotImplementedError(
+            "GradAllReduce: dp gradient all-reduce compiles from mesh "
+            "shardings — run the program on a mesh with a dp axis "
+            "(fleet.init + exe.run) instead of transpiling. See "
+            "MIGRATION.md.")
+
+
+class LocalSGD:
+    """Parity shim: transpiler/collective.py:269 — K-local-steps-then-
+    average. Its goal (fewer syncs over slow interconnect) maps to
+    DistributedStrategy.gradient_merge_steps (accumulate K steps, one
+    fused sync) on TPU, where ICI makes per-step sync cheap anyway."""
+
+    def __init__(self, nrings=2):
+        self.nrings = nrings
+
+    def transpile(self, *a, **k):
+        raise NotImplementedError(
+            "LocalSGD: use DistributedStrategy.gradient_merge_steps "
+            "(K-step gradient accumulation with one fused sync) — same "
+            "communication saving, no staleness. See MIGRATION.md.")

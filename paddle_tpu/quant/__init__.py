@@ -7,3 +7,7 @@ calibration). See qat.py and ptq.py.
 
 from .qat import quantize_program, QuantizationTransform  # noqa: F401
 from .ptq import calibrate_program, apply_ptq  # noqa: F401
+from .passes import (  # noqa: F401
+    QuantizationTransformPass, QuantizationFreezePass, ConvertToInt8Pass,
+    TransformForMobilePass, ScaleForTrainingPass, ScaleForInferencePass,
+    AddQuantDequantPass, QuantizationStrategy, QuantizeTranspiler)

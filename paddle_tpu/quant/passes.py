@@ -1,0 +1,322 @@
+"""Reference-shaped quantization passes over the QAT/PTQ machinery.
+
+Parity: python/paddle/fluid/contrib/slim/quantization/quantization_pass.py
+(QuantizationTransformPass:?, QuantizationFreezePass, ConvertToInt8Pass,
+TransformForMobilePass, ScaleForTrainingPass, ScaleForInferencePass,
+AddQuantDequantPass), quantization_strategy.py (QuantizationStrategy) and
+contrib/quantize/quantize_transpiler.py:29 (QuantizeTranspiler).
+
+The reference implements each as an IrGraph pass; here they delegate to
+the Program-level transforms in qat.py/ptq.py (one mechanism, the
+reference's API shapes). The two MKLDNN-only passes are documented
+non-ports (CPU inference engine specific)."""
+
+import numpy as np
+
+from .qat import QuantizationTransform
+from . import ptq as _ptq
+
+__all__ = ["QuantizationTransformPass", "QuantizationFreezePass",
+           "ConvertToInt8Pass", "TransformForMobilePass",
+           "ScaleForTrainingPass", "ScaleForInferencePass",
+           "AddQuantDequantPass", "QuantizationStrategy",
+           "QuantizeTranspiler", "MKLDNNPostTrainingQuantStrategy",
+           "TransformForMkldnnPass"]
+
+
+def _program_of(graph):
+    """Accept a Program or a slim GraphWrapper."""
+    return getattr(graph, "program", graph)
+
+
+class QuantizationTransformPass:
+    """Insert trainable fake quant-dequant (QAT). Reference ctor takes
+    (scope, place, bits, quant types...); scope/place are unused here —
+    the transform is pure program rewriting."""
+
+    def __init__(self, scope=None, place=None, weight_bits=8,
+                 activation_bits=8, window_size=10000, moving_rate=0.9,
+                 skip_pattern="skip_quant",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_quantize_type="abs_max", quantizable_op_type=None):
+        kwargs = dict(weight_bits=weight_bits,
+                      activation_bits=activation_bits,
+                      activation_quantize_type=activation_quantize_type,
+                      weight_quantize_type=weight_quantize_type,
+                      moving_rate=moving_rate,
+                      skip_pattern=(skip_pattern,)
+                      if isinstance(skip_pattern, str) else skip_pattern)
+        if quantizable_op_type:
+            kwargs["quantizable_op_types"] = tuple(quantizable_op_type)
+        self._transform = QuantizationTransform(**kwargs)
+        self._scope = scope
+
+    def apply(self, graph, startup_program=None):
+        from ..core.executor import global_scope
+        self._transform.apply(_program_of(graph), startup_program,
+                              scope=self._scope or global_scope())
+        return graph
+
+
+class AddQuantDequantPass(QuantizationTransformPass):
+    """Reference applies quant-dequant to extra (non-matmul) op inputs
+    like elementwise_add/pool for full-int8 deployment; same transform
+    with the wider op set."""
+
+    def __init__(self, scope=None, place=None, moving_rate=0.9,
+                 quant_bits=8, skip_pattern="skip_quant",
+                 quantizable_op_type=("elementwise_add", "pool2d")):
+        super().__init__(scope=scope, place=place,
+                         activation_bits=quant_bits,
+                         moving_rate=moving_rate, skip_pattern=skip_pattern,
+                         quantizable_op_type=quantizable_op_type)
+
+
+class QuantizationFreezePass:
+    """Freeze a QAT-trained program for inference: drop the fake
+    quant-dequant ops, collect the learned scales (activation EMA params
+    from the scope; weight scales recomputed from the weights), and
+    re-install STATIC-scale quant-dequant via the PTQ rewriter.
+
+    On TPU the frozen form keeps fused (dequantized) matmuls — the int8
+    rounding is baked in, compute stays on the bf16 MXU path, which is
+    the fast path on this hardware (ref pass instead emits int8 kernels
+    for CPU/GPU engines)."""
+
+    def __init__(self, scope, place=None, weight_bits=8, activation_bits=8,
+                 weight_quantize_type="abs_max"):
+        self._scope = scope
+        self._weight_bits = weight_bits
+        self._activation_bits = activation_bits
+        self._weight_quantize_type = weight_quantize_type
+
+    def apply(self, graph):
+        program = _program_of(graph)
+        block = program.global_block()
+        scales = {}
+        kept = []
+        for op in block.ops:
+            if op.type.startswith("fake_quantize_dequantize"):
+                src = op.input("X")[0]
+                scale_name = op.output("OutScale")[0]
+                learned = self._scope.get(scale_name)
+                if learned is not None:
+                    scales[src] = float(np.max(np.abs(learned)))
+                else:
+                    w = self._scope.get(src)
+                    if w is not None:
+                        scales[src] = float(np.max(np.abs(w)))
+                continue
+            # consumers were rewired to X.quantized; point them back
+            for slot, names in op.inputs.items():
+                op.inputs[slot] = [n[:-len(".quantized")]
+                                   if n.endswith(".quantized") else n
+                                   for n in names]
+            kept.append(op)
+        block.ops = kept
+        program._bump_version()
+        _ptq.apply_ptq(program, scales,
+                       weight_bits=self._weight_bits,
+                       activation_bits=self._activation_bits)
+        return graph
+
+
+class ConvertToInt8Pass:
+    """Store quantized weights as int8 in the scope (deployment size
+    cut; ref pass rewrites weight storage for mobile). Adds
+    `{name}.int8` and `{name}.int8_scale` scope entries; the program
+    itself still computes via the fused dequant path."""
+
+    def __init__(self, scope, place=None, quantizable_op_type=None):
+        self._scope = scope
+
+    def apply(self, graph):
+        program = _program_of(graph)
+        from ..core.framework import Parameter
+        block = program.global_block()
+        for op in block.ops:
+            if "quantize_dequantize" not in op.type:
+                continue
+            if not op.input("X"):
+                continue
+            name = op.input("X")[0]
+            var = block.vars.get(name)
+            if not isinstance(var, Parameter):
+                continue
+            w = self._scope.get(name)
+            if w is None:
+                continue
+            w = np.asarray(w)
+            scale = float(np.max(np.abs(w))) or 1.0
+            q = np.clip(np.round(w / scale * 127.0), -128, 127)
+            self._scope.set(name + ".int8", q.astype(np.int8))
+            self._scope.set(name + ".int8_scale",
+                            np.asarray([scale], np.float32))
+        return graph
+
+
+class ScaleForTrainingPass:
+    """Attach moving-average out-scale tracking to activations during
+    training (the reference records per-op output scales for later
+    inference). Delegates to the same EMA fake-quant insertion."""
+
+    def __init__(self, scope=None, place=None, moving_rate=0.9):
+        self._pass = QuantizationTransformPass(
+            scope=scope, place=place, moving_rate=moving_rate)
+
+    def apply(self, graph, startup_program=None):
+        return self._pass.apply(graph, startup_program)
+
+
+class ScaleForInferencePass:
+    """Copy the learned out-scales into op attrs for inference
+    (ref: sets `out_threshold` attrs consumed by engines)."""
+
+    def __init__(self, scope=None):
+        self._scope = scope
+
+    def apply(self, graph):
+        program = _program_of(graph)
+        for op in program.global_block().ops:
+            for name in op.output_names:
+                s = self._scope.get(f"{name}.quant_scale") \
+                    if self._scope else None
+                if s is not None:
+                    op._set_attr("out_threshold",
+                                 float(np.max(np.abs(s))))
+        return graph
+
+
+class TransformForMobilePass:
+    """Documented non-port: rewrites quant ops into paddle-mobile's
+    `quantize`/`dequantize` op names for that engine's loader. There is
+    no paddle-mobile engine here — AOT-export the frozen program via
+    inference/aot.py (jax.export) instead."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "TransformForMobilePass targets the paddle-mobile engine; "
+            "export TPU inference programs with inference/aot.py "
+            "(jax.export) instead. See MIGRATION.md.")
+
+
+class MKLDNNPostTrainingQuantStrategy:
+    """Documented non-port: MKLDNN (x86 CPU engine) INT8 calibration.
+    PTQ here is engine-neutral: quant.calibrate_program + apply_ptq."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "MKLDNNPostTrainingQuantStrategy is x86-MKLDNN specific; "
+            "use paddle_tpu.quant.calibrate_program + apply_ptq for "
+            "engine-neutral PTQ. See MIGRATION.md.")
+
+
+class TransformForMkldnnPass:
+    """Documented non-port (same rationale as
+    MKLDNNPostTrainingQuantStrategy)."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "TransformForMkldnnPass is x86-MKLDNN specific; TPU "
+            "programs lower through XLA. See MIGRATION.md.")
+
+
+class QuantizationStrategy:
+    """Parity: slim/quantization/quantization_strategy.py — QAT between
+    start_epoch and end_epoch inside a Compressor pipeline: transform at
+    start, freeze (+ optional int8 weight storage) at end."""
+
+    def __init__(self, start_epoch=0, end_epoch=0, weight_bits=8,
+                 activation_bits=8,
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_quantize_type="abs_max", save_in_nodes=None,
+                 save_out_nodes=None, int8_model_save_path=None,
+                 float_model_save_path=None, mobile_model_save_path=None):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.activation_quantize_type = activation_quantize_type
+        self.weight_quantize_type = weight_quantize_type
+        self.int8_model_save_path = int8_model_save_path
+
+    def on_compression_begin(self, context):
+        pass
+
+    def on_epoch_end(self, context):
+        if context.epoch_id == max(self.end_epoch, self.start_epoch):
+            QuantizationFreezePass(
+                context.scope, weight_bits=self.weight_bits,
+                activation_bits=self.activation_bits,
+                weight_quantize_type=self.weight_quantize_type,
+            ).apply(context.train_graph)
+            if self.int8_model_save_path:
+                ConvertToInt8Pass(context.scope).apply(context.train_graph)
+
+    def on_batch_begin(self, context):
+        pass
+
+    def on_batch_end(self, context):
+        pass
+
+    def on_compression_end(self, context):
+        pass
+
+    def on_epoch_begin(self, context):
+        if context.epoch_id == self.start_epoch:
+            QuantizationTransformPass(
+                scope=context.scope,
+                weight_bits=self.weight_bits,
+                activation_bits=self.activation_bits,
+                activation_quantize_type=self.activation_quantize_type,
+                weight_quantize_type=self.weight_quantize_type,
+            ).apply(context.train_graph)
+
+
+class QuantizeTranspiler:
+    """Parity: contrib/quantize/quantize_transpiler.py:29 — the older
+    program-level QAT API: training_transpile / freeze_program /
+    convert_to_int8, all over the same machinery."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="abs_max",
+                 weight_quantize_type="abs_max", window_size=10000,
+                 moving_rate=0.9):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        # the older transpiler's plain abs_max activations behave like a
+        # fast-moving EMA; one mechanism serves both
+        self.activation_quantize_type = (
+            "moving_average_abs_max"
+            if activation_quantize_type == "abs_max"
+            else activation_quantize_type)
+        self.weight_quantize_type = weight_quantize_type
+        self.moving_rate = moving_rate
+
+    def training_transpile(self, program=None, startup_program=None,
+                           scope=None):
+        from ..core.framework import default_main_program
+        from ..core.executor import global_scope
+        program = program or default_main_program()
+        QuantizationTransform(
+            weight_bits=self.weight_bits,
+            activation_bits=self.activation_bits,
+            activation_quantize_type=self.activation_quantize_type,
+            weight_quantize_type=self.weight_quantize_type,
+            moving_rate=self.moving_rate).apply(
+                program, startup_program, scope=scope or global_scope())
+        return program
+
+    def freeze_program(self, program, place=None, scope=None):
+        from ..core.executor import global_scope
+        QuantizationFreezePass(
+            scope or global_scope(), place,
+            weight_bits=self.weight_bits,
+            activation_bits=self.activation_bits,
+            weight_quantize_type=self.weight_quantize_type).apply(program)
+        return program
+
+    def convert_to_int8(self, program, place=None, scope=None):
+        from ..core.executor import global_scope
+        ConvertToInt8Pass(scope or global_scope(), place).apply(program)
+        return program

@@ -40,11 +40,17 @@ class QuantizationTransform:
         self.skip_pattern = tuple(skip_pattern)
 
     # ------------------------------------------------------------------
-    def apply(self, program, startup_program=None):
+    def apply(self, program, startup_program=None, scope=None):
         """Rewrite `program` in place; returns it. Call AFTER building the
-        forward and BEFORE optimizer.minimize / append_backward."""
+        forward and BEFORE optimizer.minimize / append_backward.
+
+        When `scope` is given, new EMA scale params materialize into it
+        immediately — re-running the startup program after the transform
+        would re-randomize every weight (the reference pass takes
+        scope/place for exactly this reason)."""
         self._startup_block = (startup_program.global_block()
                                if startup_program is not None else None)
+        self._scope = scope
         block = program.global_block()
         quantized = {}   # original var name -> quantized var name
         new_ops = []
@@ -110,6 +116,9 @@ class QuantizationTransform:
                 name=scale_name, shape=[1], dtype="float32", trainable=False)
             # EMA scale starts at 1.0; startup materializes it like any param
             init_mod.ConstantInitializer(1.0)(scale, self._startup_block)
+            if self._scope is not None and self._scope.get(scale_name) is None:
+                import numpy as np
+                self._scope.set(scale_name, np.ones([1], np.float32))
             qop = _make_op(
                 block, "fake_quantize_dequantize_moving_average_abs_max",
                 {"X": [name], "InScale": [scale_name]},

@@ -1,11 +1,25 @@
-"""Slim-lite: pruning masks + distillation losses + light-NAS search.
+"""Slim: pruning + distillation + quantization strategies + light-NAS.
 
-Parity: the reference's contrib/slim (PruneStrategy, distillation
-losses, nas/light_nas_strategy + searcher/controller). See prune.py,
-distill.py, nas.py.
+Parity: the reference's contrib/slim — both the functional core
+(prune.py, distill.py, nas.py) and the reference's class surface
+(core.py Compressor/Strategy/ConfigFactory, graph.py GraphWrapper,
+strategy classes, quantization passes re-exported from quant/).
 """
 
-from .prune import Pruner, sensitivity_prune_ratios  # noqa: F401
-from .distill import (soft_label_loss, l2_hint_loss, fsp_loss)  # noqa: F401
+from .prune import (Pruner, sensitivity_prune_ratios,  # noqa: F401
+                    StructurePruner, PruneStrategy, UniformPruneStrategy,
+                    SensitivePruneStrategy, AutoPruneStrategy)
+from .distill import (soft_label_loss, l2_hint_loss, fsp_loss,  # noqa: F401
+                      merge_programs, L2Distiller, SoftLabelDistiller,
+                      FSPDistiller, DistillationStrategy)
 from .nas import (SearchSpace, EvolutionaryController, SAController,  # noqa: F401
                   ControllerServer, SearchAgent, LightNASStrategy)
+from .core import (Context, Strategy, Compressor,  # noqa: F401
+                   ConfigFactory)
+from .graph import (GraphWrapper, VarWrapper, OpWrapper,  # noqa: F401
+                    SlimGraphExecutor)
+from ..quant.passes import (  # noqa: F401
+    QuantizationTransformPass, QuantizationFreezePass, ConvertToInt8Pass,
+    TransformForMobilePass, ScaleForTrainingPass, ScaleForInferencePass,
+    AddQuantDequantPass, QuantizationStrategy,
+    MKLDNNPostTrainingQuantStrategy, TransformForMkldnnPass)

@@ -78,3 +78,181 @@ class Pruner:
             v = np.asarray(scope.get(n))
             out[n] = float((v == 0).mean())
         return out
+
+
+class StructurePruner:
+    """Whole-filter (structured) pruning decisions.
+
+    Parity: contrib/slim/prune/pruner.py:StructurePruner — picks which
+    output channels of a conv/fc weight to remove by group L1 norm.
+    Here pruned channels are MASKED (zeroed via the persistent-mask
+    mechanism) rather than physically resized: XLA's fused mask multiply
+    is free, while shape-changing surgery would force a recompile per
+    ratio and break alignment-friendly static shapes on the MXU.
+    """
+
+    def __init__(self, pruning_axis=None, criterions=None):
+        self.pruning_axis = pruning_axis or {"*": 0}
+        self.criterions = criterions or {"*": "l1_norm"}
+
+    def cal_pruned_idx(self, name, param, ratio, axis=None):
+        """Indexes of the `ratio` lowest-L1 slices along `axis`."""
+        v = np.asarray(param)
+        if axis is None:
+            axis = self.pruning_axis.get(name, self.pruning_axis.get("*", 0))
+        prune_num = int(round(ratio * v.shape[axis]))
+        reduce_axes = tuple(i for i in range(v.ndim) if i != axis)
+        scores = np.abs(v).sum(axis=reduce_axes)
+        return np.argsort(scores)[:prune_num]
+
+    def structure_mask(self, param, pruned_idx, axis=0):
+        v = np.asarray(param)
+        mask = np.ones_like(v)
+        index = [slice(None)] * v.ndim
+        index[axis] = np.asarray(pruned_idx, dtype=np.int64)
+        mask[tuple(index)] = 0
+        return mask
+
+
+from .core import Strategy  # noqa: E402  (after the mask helpers above)
+
+
+class PruneStrategy(Strategy):
+    """Base pruning strategy (ref prune_strategy.py:PruneStrategy):
+    at start_epoch, install magnitude masks for params matching
+    `pruned_params` at `target_ratio`."""
+
+    def __init__(self, pruner=None, start_epoch=0, end_epoch=0,
+                 target_ratio=0.5, metric_name=None,
+                 pruned_params="conv.*weights"):
+        super().__init__(start_epoch, end_epoch)
+        self.pruner = pruner or Pruner()
+        self.target_ratio = target_ratio
+        self.metric_name = metric_name
+        self.pruned_params = pruned_params
+
+    def _matched_params(self, context):
+        import re
+        pat = re.compile(self.pruned_params)
+        return [p.name() for p in context.train_graph.all_parameters()
+                if pat.match(p.name()) and not p.name().endswith(
+                    ".prune_mask")]
+
+    def _ratios(self, context):
+        return {n: self.target_ratio for n in self._matched_params(context)}
+
+    def _eval_metric(self, context):
+        """Mean of the chosen eval fetch over context.eval_reader (same
+        feed contract as Compressor._eval: dict batches pass through,
+        sequences zip with context.eval_feed_list)."""
+        from ..core.executor import Executor, scope_guard
+        if context.eval_graph is None or context.eval_reader is None:
+            return None
+        exe = Executor(context.place)
+        fetch = self.metric_name or next(
+            iter(context.eval_graph.out_nodes.values()), None)
+        if fetch is None:
+            return None
+        vals = []
+        for data in context.eval_reader():
+            feed = (data if isinstance(data, dict)
+                    else dict(zip(context.eval_feed_list, data)))
+            with scope_guard(context.scope):
+                out = exe.run(context.eval_graph.program, feed=feed,
+                              fetch_list=[fetch])
+            vals.append(float(np.asarray(out[0]).reshape(-1)[0]))
+        return float(np.mean(vals)) if vals else None
+
+    def on_epoch_begin(self, context):
+        if context.epoch_id != self.start_epoch:
+            return
+        ratios = self._ratios(context)
+        if ratios:
+            self.pruner.prune(context.train_graph.program, context.scope,
+                              ratios)
+
+
+class UniformPruneStrategy(PruneStrategy):
+    """Same ratio everywhere (ref prune_strategy.py:UniformPruneStrategy)."""
+
+
+class SensitivePruneStrategy(PruneStrategy):
+    """Sensitivity-weighted ratios (ref SensitivePruneStrategy):
+    params whose masking hurts the eval metric least get pruned more.
+    Sensitivity of p = metric drop when p alone is pruned at
+    `probe_ratio`; ratios scale inversely with normalized sensitivity
+    around target_ratio (capped to [0, 0.9])."""
+
+    def __init__(self, pruner=None, start_epoch=0, end_epoch=0,
+                 target_ratio=0.5, metric_name=None,
+                 pruned_params="conv.*weights", probe_ratio=0.3):
+        super().__init__(pruner, start_epoch, end_epoch, target_ratio,
+                         metric_name, pruned_params)
+        self.probe_ratio = probe_ratio
+
+    def _ratios(self, context):
+        names = self._matched_params(context)
+        base = self._eval_metric(context)
+        if base is None:
+            return {n: self.target_ratio for n in names}
+        sens = {}
+        for n in names:
+            saved = np.asarray(context.scope.get(n)).copy()
+            masked = saved * magnitude_mask(saved, self.probe_ratio)
+            context.scope.set(n, masked)
+            metric = self._eval_metric(context)
+            context.scope.set(n, saved)
+            sens[n] = max(base - (metric if metric is not None else base),
+                          0.0)
+        mean_s = np.mean(list(sens.values())) or 1.0
+        return {n: float(np.clip(
+            self.target_ratio * (2 - sens[n] / mean_s), 0.0, 0.9))
+            for n in names}
+
+
+class AutoPruneStrategy(PruneStrategy):
+    """SA search over per-param ratios (ref auto_prune_strategy.py):
+    candidates are ratio vectors; reward = eval metric after masking at
+    those ratios; best vector wins after `max_try_number` rounds."""
+
+    def __init__(self, pruner=None, start_epoch=0, end_epoch=0,
+                 target_ratio=0.5, metric_name=None,
+                 pruned_params="conv.*weights", max_try_number=10,
+                 seed=0):
+        super().__init__(pruner, start_epoch, end_epoch, target_ratio,
+                         metric_name, pruned_params)
+        self.max_try_number = max_try_number
+        self.seed = seed
+
+    def _ratios(self, context):
+        from .nas import SAController
+        names = self._matched_params(context)
+        if not names:
+            return {}
+        base = self._eval_metric(context)
+        if base is None:
+            return {n: self.target_ratio for n in names}
+        # ratio levels per param: target +/- 40% in 5 steps
+        levels = np.clip(np.linspace(0.6, 1.4, 5) * self.target_ratio,
+                         0.0, 0.9)
+        controller = SAController(seed=self.seed,
+                                  max_try_number=self.max_try_number)
+        mid = len(levels) // 2
+        controller.reset([len(levels)] * len(names), [mid] * len(names))
+        tokens = [mid] * len(names)
+        best, best_reward = None, -np.inf
+        for _ in range(self.max_try_number):
+            ratios = {n: float(levels[t]) for n, t in zip(names, tokens)}
+            saved = {n: np.asarray(context.scope.get(n)).copy()
+                     for n in names}
+            for n, r in ratios.items():
+                context.scope.set(n, saved[n] * magnitude_mask(saved[n], r))
+            metric = self._eval_metric(context)
+            for n in names:
+                context.scope.set(n, saved[n])
+            reward = metric if metric is not None else -np.inf
+            if reward > best_reward:
+                best, best_reward = ratios, reward
+            controller.update(tokens, reward)
+            tokens = controller.next_tokens()
+        return best or {n: self.target_ratio for n in names}

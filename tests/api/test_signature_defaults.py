@@ -21,6 +21,14 @@ DIVERGENCE_ALLOW = {
     # our Trainer/Inferencer are the deprecated contrib shims with a
     # reduced surface; place/parallel args default host-side
     ("infer", "return_numpy"): "shim keeps Executor-style numpy returns",
+    # the reference defaults are the ACCIDENTAL auto-generated var names
+    # of its auc layer's stat buckets ('_generated_var_2/3'); our auc
+    # layer names them stat_pos/stat_neg deliberately, so the FleetUtil
+    # defaults follow the named vars
+    ("get_global_auc", "stat_pos"): "auc stats are named vars here",
+    ("get_global_auc", "stat_neg"): "auc stats are named vars here",
+    ("print_global_auc", "stat_pos"): "auc stats are named vars here",
+    ("print_global_auc", "stat_neg"): "auc stats are named vars here",
 }
 
 

@@ -1,0 +1,235 @@
+"""slim class surface: Compressor pipeline, GraphWrapper, strategies,
+quantization passes (parity: contrib/slim/core, graph, prune strategies,
+distillation, quantization_pass.py, quantize_transpiler.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu import slim
+from paddle_tpu.core import framework
+from paddle_tpu.core.executor import Scope, scope_guard
+
+
+def _mlp_programs(seed=3):
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = seed
+    with framework.program_guard(main, startup):
+        x = layers.data("x", [8, 4], append_batch_size=False)
+        y = layers.data("y", [8, 1], dtype="int64",
+                        append_batch_size=False)
+        h = layers.fc(x, size=16, act="relu",
+                      param_attr=fluid.ParamAttr(name="fc0_weights"))
+        logits = layers.fc(h, size=2,
+                           param_attr=fluid.ParamAttr(name="fc1_weights"))
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+        acc = layers.accuracy(layers.softmax(logits), y)
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.SGDOptimizer(0.5).minimize(loss)
+    return main, startup, test_prog, loss, acc
+
+
+def _data(n=4):
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((n, 8, 4)).astype("float32")
+    ys = rng.integers(0, 2, (n, 8, 1)).astype("int64")
+    return [{"x": xs[i], "y": ys[i]} for i in range(n)]
+
+
+def test_graph_wrapper_traversal():
+    main, _, _, loss, _ = _mlp_programs()
+    g = slim.GraphWrapper(main, out_nodes={"loss": loss.name})
+    params = {p.name() for p in g.all_parameters()}
+    assert {"fc0_weights", "fc1_weights"} <= params
+    assert g.numel_params() >= 4 * 16 + 16 * 2
+    mm = [op for op in g.ops() if op.type() in ("mul", "matmul")][0]
+    nxt = g.next_ops(mm)
+    assert nxt and all(isinstance(o, slim.OpWrapper) for o in nxt)
+    pre = g.pre_ops(nxt[0])
+    assert mm in pre
+    assert g.var("fc0_weights").is_parameter()
+    clone = g.clone(for_test=True)
+    assert clone.program is not main
+
+
+def test_compressor_with_uniform_prune_yaml():
+    main, startup, test_prog, loss, acc = _mlp_programs()
+    scope = Scope()
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+
+    cfg = {
+        "version": 1.0,
+        "pruners": {"pruner_1": {"class": "Pruner"}},
+        "strategies": {
+            "prune_s": {"class": "UniformPruneStrategy",
+                        "pruner": "pruner_1",
+                        "start_epoch": 0,
+                        "target_ratio": 0.5,
+                        "pruned_params": "fc.*weights"},
+        },
+        "compressor": {"epoch": 2, "strategies": ["prune_s"]},
+    }
+    comp = slim.Compressor(
+        None, scope, main, train_reader=lambda: iter(_data()),
+        train_feed_list=["x", "y"], train_fetch_list=[loss],
+        eval_program=test_prog, eval_reader=lambda: iter(_data(2)),
+        eval_feed_list=["x", "y"], eval_fetch_list=[acc])
+    comp.config(cfg)
+    assert comp.epoch == 2 and len(comp.strategies) == 1
+    ctx = comp.run()
+    # masks installed and weights actually half-zeroed
+    for name in ("fc0_weights", "fc1_weights"):
+        w = np.asarray(scope.get(name))
+        frac = (w == 0).mean()
+        assert frac >= 0.45, f"{name} only {frac:.0%} zero"
+        assert scope.get(name + ".prune_mask") is not None
+    assert ctx.eval_results  # eval ran each epoch
+
+
+def test_sensitive_prune_ranks_by_sensitivity():
+    main, startup, test_prog, loss, acc = _mlp_programs()
+    scope = Scope()
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+    s = slim.SensitivePruneStrategy(target_ratio=0.4,
+                                    pruned_params="fc.*weights")
+    ctx = slim.Context(scope=scope,
+                       train_graph=slim.GraphWrapper(main),
+                       eval_graph=slim.GraphWrapper(
+                           test_prog, out_nodes={0: acc.name}),
+                       eval_reader=lambda: iter(_data(2)))
+    ratios = s._ratios(ctx)
+    assert set(ratios) == {"fc0_weights", "fc1_weights"}
+    assert all(0.0 <= r <= 0.9 for r in ratios.values())
+
+
+def test_distillation_strategy_merges_and_trains():
+    # student
+    main, startup, _, loss, _ = _mlp_programs()
+    # teacher: separate program over the SAME data var names
+    t_main, t_startup = framework.Program(), framework.Program()
+    t_main.random_seed = t_startup.random_seed = 11
+    with framework.program_guard(t_main, t_startup):
+        tx = layers.data("x", [8, 4], append_batch_size=False)
+        t_logits = layers.fc(tx, size=2,
+                             param_attr=fluid.ParamAttr(name="t_weights"))
+
+    scope = Scope()
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        exe.run(t_startup)
+
+    # the student program used for distillation carries no optimizer;
+    # the distiller optimizer minimizes task + distill loss
+    s_main, s_startup = framework.Program(), framework.Program()
+    s_main.random_seed = s_startup.random_seed = 3
+    with framework.program_guard(s_main, s_startup):
+        x = layers.data("x", [8, 4], append_batch_size=False)
+        y = layers.data("y", [8, 1], dtype="int64",
+                        append_batch_size=False)
+        h = layers.fc(x, size=16, act="relu")
+        logits = layers.fc(h, size=2)
+        s_loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+    with scope_guard(scope):
+        exe.run(s_startup)
+
+    strategy = slim.DistillationStrategy(
+        distillers=[slim.SoftLabelDistiller(
+            student_feature_map=logits.name,
+            teacher_feature_map="teacher_" + t_logits.name,
+            distillation_loss_weight=0.5)],
+        task_loss=s_loss.name, share_vars=("x",))
+    comp = slim.Compressor(
+        None, scope, s_main, train_reader=lambda: iter(_data()),
+        train_feed_list=["x", "y"], train_fetch_list=[s_loss],
+        teacher_programs=[t_main],
+        distiller_optimizer=fluid.optimizer.SGDOptimizer(0.1),
+        epoch=1, strategies=[strategy])
+    comp.run()
+    merged = comp.train_graph.program
+    names = set(merged.global_block().vars)
+    assert "teacher_t_weights" in names          # teacher merged, renamed
+    assert "x" in names                          # data var shared
+    assert comp.train_graph.out_nodes.get("distill_loss")
+
+
+def test_qat_freeze_and_int8_roundtrip():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = layers.data("x", [4, 6], append_batch_size=False)
+        out = layers.fc(x, size=3,
+                        param_attr=fluid.ParamAttr(name="qw"))
+    scope = Scope()
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+    # keep |x| under the EMA scale's 1.0 init so the first QAT step
+    # doesn't clip (the moving-average scale needs steps to adapt)
+    xs = (np.random.default_rng(1).standard_normal((4, 6))
+          .astype("float32") * 0.3)
+    with scope_guard(scope):
+        base = np.asarray(exe.run(main, feed={"x": xs},
+                                  fetch_list=[out])[0])
+
+    slim.QuantizationTransformPass(scope=scope).apply(main, startup)
+    types = [op.type for op in main.global_block().ops]
+    assert any(t.startswith("fake_quantize_dequantize") for t in types)
+    # NOTE: no startup re-run — the pass materialized the EMA scales
+    # into the scope (re-running startup would re-randomize weights)
+    with scope_guard(scope):
+        qat_out = np.asarray(exe.run(main, feed={"x": xs},
+                                     fetch_list=[out])[0])
+    # int8 rounding error is small but nonzero
+    assert np.abs(qat_out - base).max() < 0.2
+
+    slim.QuantizationFreezePass(scope).apply(main)
+    types = [op.type for op in main.global_block().ops]
+    assert not any("moving_average" in t for t in types)
+    assert any(t == "quantize_dequantize_static_scale" for t in types)
+    with scope_guard(scope):
+        frozen_out = np.asarray(exe.run(main, feed={"x": xs},
+                                        fetch_list=[out])[0])
+    np.testing.assert_allclose(frozen_out, qat_out, atol=0.1)
+
+    slim.ConvertToInt8Pass(scope).apply(main)
+    q = scope.get("qw.int8")
+    assert q is not None and q.dtype == np.int8
+    scale = float(scope.get("qw.int8_scale")[0])
+    w = np.asarray(scope.get("qw"))
+    np.testing.assert_allclose(q.astype(np.float32) * scale / 127.0, w,
+                               atol=scale / 127.0)
+
+
+def test_quantize_transpiler_api():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = layers.data("x", [2, 5], append_batch_size=False)
+        layers.fc(x, size=2)
+    qt = slim.__dict__.get("QuantizeTranspiler") or \
+        __import__("paddle_tpu.quant", fromlist=["QuantizeTranspiler"]
+                   ).QuantizeTranspiler
+    t = qt()
+    t.training_transpile(main, startup)
+    assert any(op.type.startswith("fake_quantize")
+               for op in main.global_block().ops)
+
+
+def test_non_ports_raise_with_guidance():
+    with pytest.raises(NotImplementedError, match="MKLDNN|x86"):
+        slim.MKLDNNPostTrainingQuantStrategy()
+    with pytest.raises(NotImplementedError, match="aot|jax.export"):
+        slim.TransformForMobilePass()
+    import paddle_tpu.transpiler as T
+    with pytest.raises(NotImplementedError, match="mesh|MIGRATION"):
+        T.GradAllReduce().transpile()
+    with pytest.raises(NotImplementedError, match="gradient_merge"):
+        T.LocalSGD().transpile()
+    with pytest.warns(UserWarning, match="no-op"):
+        fluid.memory_optimize(None)
+    with pytest.warns(UserWarning, match="no-op"):
+        fluid.release_memory(None)

@@ -1,0 +1,21 @@
+"""The python-surface disposition audit (docs/surface_audit.md) must
+stay current with the reference tree and the package, and contain zero
+TODOs (VERDICT r3 items 3/5)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..", "..")
+
+
+@pytest.mark.skipif(not os.path.isdir("/root/reference"),
+                    reason="reference tree not present")
+def test_surface_audit_current_and_todo_free():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "surface_audit.py"),
+         "--check"], capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "0 TODO" in out.stdout

@@ -1,0 +1,298 @@
+"""Python-surface disposition audit (VERDICT r3 items 3/5).
+
+Walks the reference's contrib/, incubate/, distributed/ and transpiler/
+python packages, collects every public name (``__all__`` when declared,
+else top-level classes/defs), and dispositions each one:
+
+  ported          — resolves in the mapped paddle_tpu module
+  shim            — import-compatible, raises NotImplementedError with
+                    migration guidance (documented non-port)
+  design-deleted  — no code on purpose, with the reason and replacement
+
+Writes docs/surface_audit.md; exits non-zero if any name is
+undispositioned (TODO), so tests/api/test_surface_audit.py keeps this
+honest the way the op audit is kept honest.
+
+Usage: python tools/surface_audit.py [--check] [--ref /root/reference]
+"""
+
+import argparse
+import ast
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF_DEFAULT = "/root/reference/python/paddle/fluid"
+PACKAGES = ("contrib", "incubate", "distributed", "transpiler")
+SKIP_FILES = ("ps_pb2.py",)
+SKIP_DIRS = ("tests", "details")
+
+# reference module (relative, no .py) -> paddle_tpu module to resolve in.
+# First match by longest prefix.
+MODULE_MAP = {
+    "contrib/layers": "paddle_tpu.contrib.layers",
+    "contrib/decoder": "paddle_tpu.contrib.decoder",
+    "contrib/mixed_precision/fp16_utils": None,   # see DELETED
+    "contrib/mixed_precision": "paddle_tpu.contrib.mixed_precision",
+    "contrib/quantize": "paddle_tpu.contrib.quantize",
+    "contrib/slim": "paddle_tpu.slim",
+    "contrib/reader": "paddle_tpu.contrib.reader",
+    "contrib/utils": "paddle_tpu.contrib.utils",
+    "contrib/extend_optimizer": "paddle_tpu.contrib.extend_optimizer",
+    "contrib/inferencer": "paddle_tpu.contrib.inferencer",
+    "contrib/trainer": "paddle_tpu.contrib.trainer",
+    "contrib/op_frequence": "paddle_tpu.contrib.op_frequence",
+    "contrib/memory_usage_calc": "paddle_tpu.contrib.memory_usage_calc",
+    "contrib/model_stat": "paddle_tpu.utils.model_stat",
+    "contrib": "paddle_tpu.contrib",
+    "incubate/data_generator": "paddle_tpu.incubate.data_generator",
+    "incubate/fleet/base/fleet_base": "paddle_tpu.incubate.fleet.base.fleet_base",
+    "incubate/fleet/base/role_maker": "paddle_tpu.incubate.fleet.base.role_maker",
+    "incubate/fleet/collective": "paddle_tpu.incubate.fleet.collective",
+    "incubate/fleet/parameter_server/distribute_transpiler":
+        "paddle_tpu.incubate.fleet.parameter_server.distribute_transpiler",
+    "incubate/fleet/parameter_server/pslib":
+        "paddle_tpu.incubate.fleet.parameter_server.pslib",
+    "incubate/fleet/utils/hdfs": "paddle_tpu.incubate.fleet.utils.hdfs",
+    "incubate/fleet/utils": "paddle_tpu.incubate.fleet.utils",
+    "distributed/downpour": "paddle_tpu.distributed.downpour",
+    "transpiler": "paddle_tpu.transpiler",
+}
+
+# (module, name) or (module, "*") -> reason. These names have NO code on
+# purpose; the reason names the TPU replacement mechanism.
+DELETED = {
+    ("contrib/mixed_precision/fp16_utils", "*"):
+        "fp16 graph-rewrite helpers (cast insertion, loss-scaling var "
+        "surgery): amp/ decorates the optimizer and casts via policy "
+        "(amp/policy.py cast_model_to_bf16; loss scaling lives in "
+        "amp/decorator.py) — the helper layer has no standalone use "
+        "under whole-program XLA",
+    ("distributed/fleet", "Fleet"):
+        "the pslib (Downpour) Fleet singleton; the collective Fleet "
+        "(incubate/fleet/collective, parallel/fleet.py) is the one "
+        "fleet on TPU — pserver tables shard over the mesh instead "
+        "(see distributed/downpour.py shim)",
+    ("distributed/helper", "FileSystem"):
+        "pslib HDFS config builder for pserver checkpoints; TPU "
+        "checkpoints are whole-state saves (io/checkpoint.py) and HDFS "
+        "access is contrib.utils.HDFSClient",
+    ("distributed/helper", "MPIHelper"):
+        "mpi4py rank/host discovery for pserver jobs; role makers read "
+        "the launcher env instead (parallel/fleet.py "
+        "MPISymetricRoleMaker reads OMPI_*/PMI_*)",
+    ("distributed/node", "*"):
+        "Downpour pserver/worker protobuf descriptors (ps.proto "
+        "builders); no pserver tier exists — the mesh layout "
+        "(parallel/mesh.py) is the cluster description",
+    ("distributed/ps_instance", "PaddlePSInstance"):
+        "pserver/trainer rank bookkeeping over MPI; replaced by "
+        "jax.distributed + role makers (parallel/fleet.py)",
+    ("transpiler/distribute_transpiler", "log"):
+        "module-local logging helper of the pserver transpiler "
+        "implementation, not meaningful API",
+    ("transpiler/distribute_transpiler", "VarBlock"):
+        "pserver var-slice descriptor: params are not split into "
+        "pserver blocks — GSPMD shards arrays by mesh axes "
+        "(parallel/transpiler.py documents the ZeRO re-expression)",
+    ("transpiler/distribute_transpiler", "same_or_split_var"):
+        "pserver var-split naming helper (see VarBlock)",
+    ("transpiler/distribute_transpiler", "slice_variable"):
+        "pserver var-split planner (see VarBlock)",
+}
+
+# names implemented as raising shims (import-compatible, guidance in the
+# error): module -> set of names
+SHIMS = {
+    "incubate/fleet/parameter_server/distribute_transpiler":
+        {"DistributedTranspiler", "TranspilerOptimizer"},
+    "incubate/fleet/parameter_server/pslib":
+        {"PSLib", "DownpourOptimizer", "DistributedAdam", "Server",
+         "Worker", "DownpourServer", "DownpourWorker"},
+    "distributed/downpour": {"DownpourSGD"},
+    "transpiler/collective": {"GradAllReduce", "LocalSGD"},
+    "contrib/slim/quantization/mkldnn_post_training_strategy":
+        {"MKLDNNPostTrainingQuantStrategy"},
+    "contrib/slim/quantization/quantization_mkldnn_pass":
+        {"TransformForMkldnnPass"},
+    "contrib/slim/quantization/quantization_pass":
+        {"TransformForMobilePass"},
+    "contrib/utils/lookup_table_utils":
+        {"convert_dist_to_sparse_program",
+         "load_persistables_for_increment",
+         "load_persistables_for_inference"},
+}
+# where each shim module's names actually live
+SHIM_TARGETS = {
+    "transpiler/collective": "paddle_tpu.transpiler",
+}
+
+
+def _public_names(path):
+    try:
+        tree = ast.parse(open(path).read())
+    except SyntaxError:
+        return []
+    all_names, found = [], False
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            tgt = (node.targets[0] if isinstance(node, ast.Assign)
+                   else node.target)
+            if isinstance(tgt, ast.Name) and tgt.id == "__all__":
+                found = True
+                if isinstance(node.value, (ast.List, ast.Tuple)):
+                    all_names += [e.value for e in node.value.elts
+                                  if isinstance(e, ast.Constant)]
+    if found:
+        return all_names
+    return [n.name for n in tree.body
+            if isinstance(n, (ast.FunctionDef, ast.ClassDef))
+            and not n.name.startswith("_")]
+
+
+def _modules(ref_root):
+    for pkg in PACKAGES:
+        base = os.path.join(ref_root, pkg)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py") or fn in SKIP_FILES \
+                        or fn.startswith("test_"):
+                    continue
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, ref_root)[:-3]  # strip .py
+                if rel.endswith("/__init__"):
+                    rel = rel[:-len("/__init__")]
+                yield rel, full
+
+
+def _target_module(rel):
+    best = None
+    for prefix in MODULE_MAP:
+        if rel == prefix or rel.startswith(prefix + "/"):
+            if best is None or len(prefix) > len(best):
+                best = prefix
+    return MODULE_MAP.get(best) if best else None
+
+
+def _deleted_reason(rel, name):
+    return DELETED.get((rel, name)) or DELETED.get((rel, "*"))
+
+
+def audit(ref_root):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import importlib
+
+    rows = []          # (module, name, status, where/reason)
+    todo = []
+    cache = {}
+
+    def resolve(modname, name):
+        if modname is None:
+            return None
+        if modname not in cache:
+            try:
+                cache[modname] = importlib.import_module(modname)
+            except Exception:
+                cache[modname] = None
+        mod = cache[modname]
+        return mod if mod is not None and hasattr(mod, name) else None
+
+    for rel, path in _modules(ref_root):
+        names = _public_names(path)
+        if not names:
+            continue
+        shim_names = SHIMS.get(rel, set())
+        target = _target_module(rel)
+        for name in names:
+            reason = _deleted_reason(rel, name)
+            if name in shim_names:
+                where = SHIM_TARGETS.get(rel, target)
+                if resolve(where, name):
+                    rows.append((rel, name, "shim", where))
+                else:
+                    todo.append((rel, name, "shim target missing"))
+                continue
+            if reason:
+                rows.append((rel, name, "design-deleted", reason))
+                continue
+            if resolve(target, name):
+                rows.append((rel, name, "ported", target))
+            elif resolve("paddle_tpu.slim", name):
+                rows.append((rel, name, "ported", "paddle_tpu.slim"))
+            else:
+                todo.append((rel, name, f"unresolved (looked in {target})"))
+    return rows, todo
+
+
+def render(rows, todo):
+    counts = {}
+    for _, _, status, _ in rows:
+        counts[status] = counts.get(status, 0) + 1
+    lines = [
+        "# Reference python-surface disposition audit",
+        "",
+        "Generated by `python tools/surface_audit.py` (kept current by "
+        "`tests/api/test_surface_audit.py`). Scope: every public name "
+        "(`__all__`, else top-level classes/defs) in the reference's "
+        "`contrib/`, `incubate/`, `distributed/` and `transpiler/` "
+        "packages — the fate of the main `fluid.*`/`fluid.layers.*` "
+        "surface is op-level audited in `docs/op_audit.md`.",
+        "",
+        f"**{len(rows)} names: {counts.get('ported', 0)} ported, "
+        f"{counts.get('shim', 0)} import-compatible shims (raise with "
+        f"migration guidance), {counts.get('design-deleted', 0)} "
+        f"design-deleted, {len(todo)} TODO.**",
+        "",
+        "Statuses: `ported` — implemented at the listed module; `shim` — "
+        "constructing it raises NotImplementedError naming the TPU "
+        "replacement; `design-deleted` — no code on purpose, reason "
+        "below.",
+        "",
+    ]
+    cur = None
+    for rel, name, status, info in sorted(rows):
+        if rel != cur:
+            lines += [f"## {rel}", "",
+                      "| name | status | where / reason |",
+                      "|---|---|---|"]
+            cur = rel
+        lines.append(f"| `{name}` | {status} | {info} |")
+    lines.append("")
+    if todo:
+        lines += ["## TODO", ""]
+        lines += [f"- `{rel}.{name}`: {why}" for rel, name, why in todo]
+        lines.append("")
+    return "\n".join(lines)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ref", default=REF_DEFAULT)
+    ap.add_argument("--check", action="store_true",
+                    help="fail if docs/surface_audit.md is stale")
+    args = ap.parse_args()
+    rows, todo = audit(args.ref)
+    text = render(rows, todo)
+    out_path = os.path.join(REPO, "docs", "surface_audit.md")
+    if args.check:
+        current = open(out_path).read() if os.path.exists(out_path) else ""
+        if current != text:
+            print("docs/surface_audit.md is stale — rerun "
+                  "python tools/surface_audit.py")
+            return 1
+    else:
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        with open(out_path, "w") as f:
+            f.write(text)
+    print(f"{len(rows)} names dispositioned, {len(todo)} TODO")
+    for rel, name, why in todo:
+        print(f"  TODO {rel}.{name}: {why}")
+    return 1 if todo else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
