@@ -29,6 +29,35 @@ from .framework import (Program, Variable, grad_var_name, BACKWARD_MARKER,
 from .. import ops as ops_registry
 
 
+def _canon_feed(name, value):
+    """int64 policy (MIGRATION.md "Integer dtypes"): device integers are
+    int32. int64 feeds — fluid's contract for ids/labels — are accepted
+    here at the boundary, VALIDATED to fit, and converted explicitly; a
+    value past 2^31 raises instead of silently truncating (the jax
+    default would wrap). float64 narrows to float32 (x64 off)."""
+    if isinstance(value, jax.Array):
+        # already on device (e.g. the compiled path device_put the feed
+        # with its mesh sharding) — converting via numpy would pull it
+        # to host and DESTROY the placement; 64-bit dtypes can't exist
+        # on device with x64 off, so there is nothing to canonicalize
+        return value
+    a = np.asarray(value)
+    if a.dtype == np.int64 or a.dtype == np.uint64:
+        lo, hi = (np.iinfo(np.int32).min, np.iinfo(np.int32).max) \
+            if a.dtype == np.int64 else (0, np.iinfo(np.uint32).max)
+        if a.size and (int(a.max()) > hi or int(a.min()) < lo):
+            raise OverflowError(
+                f"feed '{name}' carries {a.dtype} values outside the "
+                f"32-bit device integer range [{lo}, {hi}] (max seen: "
+                f"{int(a.max())}). Device integers are int32 by policy — "
+                f"re-index ids below 2**31 or split the vocab. See "
+                f"MIGRATION.md 'Integer dtypes'.")
+        a = a.astype(np.int32 if a.dtype == np.int64 else np.uint32)
+    elif a.dtype == np.float64:
+        a = a.astype(np.float32)
+    return jnp.asarray(a)
+
+
 class Scope:
     """Name -> device array store for persistable variables.
 
@@ -286,7 +315,7 @@ class Executor:
         feed = feed or {}
         fetch_names = tuple(_as_fetch_name(f) for f in (fetch_list or []))
 
-        feeds = {k: jnp.asarray(v) for k, v in feed.items()}
+        feeds = {k: _canon_feed(k, v) for k, v in feed.items()}
         feed_sig = tuple(sorted((k, v.shape, str(v.dtype)) for k, v in feeds.items()))
 
         # early, friendly validation (parity: fluid's check_feed_shape_type)

@@ -102,7 +102,14 @@ class EagerVariable:
                  is_leaf=False):
         # value=None creates an empty shell the eager LayerHelper fills in
         # (static-style layer functions pre-create their output vars).
-        self.value = None if value is None else jnp.asarray(value)
+        if value is None:
+            self.value = None
+        else:
+            from ..core.executor import _canon_feed
+            # same int64 policy as the static feed boundary: validate
+            # 64-bit ints fit, convert explicitly (no silent wrap);
+            # _canon_feed passes everything else through unchanged
+            self.value = _canon_feed(name or "eager", value)
         EagerVariable._next_id += 1
         self.id = EagerVariable._next_id
         self.name = name or f"eager_var_{self.id}"

@@ -10,8 +10,29 @@ An op impl has signature ``fn(ctx) -> {output_slot: array-or-list}``.
 """
 
 import jax
+import jax.numpy as jnp
 
 _REGISTRY = {}
+
+# --- int64 policy (VERDICT r3 #7; MIGRATION.md "Integer dtypes") -------
+# Device integers are int32: fluid's int64 ids/labels are accepted at the
+# feed boundary (Executor validates they FIT and converts loudly —
+# core/executor.py _canon_feed), and every kernel that would emit or
+# request int64 emits the canonical device int instead. jax's x64 mode
+# stays off — doubling index widths would halve integer throughput and
+# buy nothing until vocab/ids exceed 2^31 (at which point the feed
+# boundary errors rather than truncates).
+DEVICE_INT = jnp.int32
+
+_CANON_DTYPES = {"int64": "int32", "uint64": "uint32", "float64": "float32"}
+
+
+def canon_dtype(dtype):
+    """Canonicalize a user-requested dtype string per the int64 policy
+    (silently narrowing the REQUEST is fine — values are validated at
+    the feed boundary; jnp would otherwise warn on every trace)."""
+    s = str(dtype)
+    return _CANON_DTYPES.get(s, s)
 
 
 class TensorArray(list):

@@ -12,7 +12,7 @@ host round-trips per step.
 import jax
 import jax.numpy as jnp
 
-from . import register
+from . import register, DEVICE_INT
 
 NEG_INF = -1e9
 
@@ -58,7 +58,7 @@ def beam_search_op(ctx):
     new_scores, parent, tokens, _ = beam_search_step(
         log_probs, beam_scores, finished, k, end_id)
     batch_offset = (jnp.arange(b) * k)[:, None]
-    return {"SelectedIds": tokens.reshape(b * k, 1).astype(jnp.int64),
+    return {"SelectedIds": tokens.reshape(b * k, 1).astype(DEVICE_INT),
             "SelectedScores": new_scores.reshape(b * k, 1),
             "ParentIdx": (parent + batch_offset).reshape(b * k).astype(jnp.int32)}
 

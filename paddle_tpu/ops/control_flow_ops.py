@@ -13,7 +13,7 @@ pieces.
 import jax
 import jax.numpy as jnp
 
-from . import register
+from . import register, DEVICE_INT
 
 
 @register("select", "where_op")
@@ -67,7 +67,7 @@ def array_read(ctx):
 
 @register("array_length")
 def array_length(ctx):
-    return {"Out": jnp.asarray(len(ctx.in_("Array")), jnp.int64)}
+    return {"Out": jnp.asarray(len(ctx.in_("Array")), DEVICE_INT)}
 
 
 @register("tensor_array_to_tensor")

@@ -16,7 +16,7 @@ Transition parameter layout matches the reference exactly:
 import jax
 import jax.numpy as jnp
 
-from . import register
+from . import register, DEVICE_INT
 
 
 def _split_transition(w):
@@ -123,7 +123,7 @@ def crf_decoding(ctx):
     # positions beyond length emit 0 (the reference's LoD output simply
     # ends; padded form zero-fills)
     path = jnp.where(t_idx[None] < length[:, None], path, 0)
-    path = path.astype(jnp.int64)
+    path = path.astype(DEVICE_INT)
 
     label = ctx.in_("Label")
     if label is not None:
@@ -131,5 +131,5 @@ def crf_decoding(ctx):
             label = label[..., 0]
         err = (path != label.astype(path.dtype)) & \
             (t_idx[None] < length[:, None])
-        return {"ViterbiPath": err.astype(jnp.int64)}
+        return {"ViterbiPath": err.astype(DEVICE_INT)}
     return {"ViterbiPath": path}

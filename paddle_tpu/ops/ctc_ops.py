@@ -12,7 +12,7 @@ pass, XLA differentiates the recursion).
 import jax
 import jax.numpy as jnp
 
-from . import register
+from . import register, DEVICE_INT
 
 NEG_INF = -1e30
 
@@ -123,12 +123,12 @@ def ctc_align(ctx):
 
     # stable left-compaction: position = rank among kept entries
     pos = jnp.cumsum(keep, axis=1) - 1                    # (B, T)
-    out = jnp.full((b, t), -1, jnp.int64)
+    out = jnp.full((b, t), -1, DEVICE_INT)
     rows = jnp.repeat(jnp.arange(b)[:, None], t, 1)
     out = out.at[rows, jnp.where(keep, pos, t - 1)].set(
-        jnp.where(keep, ids, -1).astype(jnp.int64), mode="drop")
+        jnp.where(keep, ids, -1).astype(DEVICE_INT), mode="drop")
     # a kept id writing to its rank; discarded ones write -1 at t-1 — but
     # that slot may hold a real value, so re-mask by count instead
     count = keep.sum(axis=1)
     out = jnp.where(jnp.arange(t)[None] < count[:, None], out, -1)
-    return {"Output": out, "OutputLength": count[:, None].astype(jnp.int64)}
+    return {"Output": out, "OutputLength": count[:, None].astype(DEVICE_INT)}

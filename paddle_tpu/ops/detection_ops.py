@@ -9,7 +9,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import register
+from . import register, DEVICE_INT
 
 
 @register("iou_similarity")
@@ -87,7 +87,7 @@ def roi_pool(ctx):
     pw = ctx.attr("pooled_width", 1)
     out = _roi_grid(x, rois, ph, pw, ctx.attr("spatial_scale", 1.0), sampling=2,
                     align=False)
-    return {"Out": out, "Argmax": jnp.zeros(out.shape, jnp.int64)}
+    return {"Out": out, "Argmax": jnp.zeros(out.shape, DEVICE_INT)}
 
 
 @register("psroi_pool")

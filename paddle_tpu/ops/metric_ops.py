@@ -6,7 +6,7 @@ Parity: paddle/fluid/operators/metrics/{accuracy,auc}_op.*
 import jax
 import jax.numpy as jnp
 
-from . import register
+from . import register, DEVICE_INT
 
 
 @register("accuracy")
@@ -15,7 +15,7 @@ def accuracy(ctx):
     label = ctx.in_("Label")
     if label.ndim > 1 and label.shape[-1] == 1:
         label = label.reshape(-1)
-    correct = jnp.any(pred_idx.astype(jnp.int64) == label.astype(jnp.int64)[:, None], axis=1)
+    correct = jnp.any(pred_idx.astype(DEVICE_INT) == label.astype(DEVICE_INT)[:, None], axis=1)
     num_correct = correct.sum().astype(jnp.float32)
     total = jnp.asarray(label.shape[0], jnp.float32)
     return {"Accuracy": (num_correct / total).reshape(1),
@@ -164,9 +164,9 @@ def chunk_eval(ctx):
     return {"Precision": precision.astype(jnp.float32).reshape(1),
             "Recall": recall.astype(jnp.float32).reshape(1),
             "F1-Score": f1.astype(jnp.float32).reshape(1),
-            "NumInferChunks": inf_chunks.astype(jnp.int64).reshape(1),
-            "NumLabelChunks": lab_chunks.astype(jnp.int64).reshape(1),
-            "NumCorrectChunks": correct.astype(jnp.int64).reshape(1)}
+            "NumInferChunks": inf_chunks.astype(DEVICE_INT).reshape(1),
+            "NumLabelChunks": lab_chunks.astype(DEVICE_INT).reshape(1),
+            "NumCorrectChunks": correct.astype(DEVICE_INT).reshape(1)}
 
 
 @register("continuous_value_model")

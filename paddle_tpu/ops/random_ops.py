@@ -9,7 +9,7 @@ reproducible per (seed, step, op) — the TPU answer to cuRAND states.
 import jax
 import jax.numpy as jnp
 
-from . import register
+from . import register, DEVICE_INT
 from .tensor_ops import _np_dtype
 
 
@@ -68,7 +68,7 @@ def randint(ctx):
 def sampling_id(ctx):
     x = ctx.in_("X")  # (N, C) probabilities
     idx = jax.random.categorical(ctx.rng(), jnp.log(jnp.clip(x, 1e-20, None)), axis=-1)
-    return {"Out": idx.astype(jnp.int64)}
+    return {"Out": idx.astype(DEVICE_INT)}
 
 
 @register("random_crop")
@@ -96,7 +96,7 @@ def multinomial(ctx):
     keys = jax.random.split(ctx.rng(), n)
     logits = jnp.log(jnp.clip(x, 1e-20, None))
     samples = jnp.stack([jax.random.categorical(k, logits, axis=-1) for k in keys], -1)
-    return {"Out": samples.astype(jnp.int64)}
+    return {"Out": samples.astype(DEVICE_INT)}
 
 
 @register("bernoulli")

@@ -11,11 +11,16 @@ import jax.numpy as jnp
 from jax import lax
 import numpy as np
 
-from . import register
+from . import register, DEVICE_INT
 from ..core.framework import convert_dtype
 
 
 def _np_dtype(d):
+    # int64 policy: requests for 64-bit types narrow to the device
+    # widths (values were validated at the feed boundary; see
+    # ops/__init__.py DEVICE_INT)
+    from . import canon_dtype
+    d = canon_dtype(d)
     return {"bool": jnp.bool_}.get(d, jnp.dtype(convert_dtype(d)))
 
 
@@ -60,7 +65,7 @@ def rank_op(ctx):
 
 @register("size")
 def size_op(ctx):
-    return {"Out": jnp.asarray(ctx.in_("Input").size, dtype=jnp.int64)}
+    return {"Out": jnp.asarray(ctx.in_("Input").size, dtype=DEVICE_INT)}
 
 
 @register("concat")
@@ -258,17 +263,17 @@ def top_k(ctx):
     x = ctx.in_("X")
     k = ctx.attr("k", 1)
     vals, idx = jax.lax.top_k(x, k)
-    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+    return {"Out": vals, "Indices": idx.astype(DEVICE_INT)}
 
 
 @register("arg_max")
 def arg_max(ctx):
-    return {"Out": jnp.argmax(ctx.in_("X"), axis=ctx.attr("axis", -1)).astype(jnp.int64)}
+    return {"Out": jnp.argmax(ctx.in_("X"), axis=ctx.attr("axis", -1)).astype(DEVICE_INT)}
 
 
 @register("arg_min")
 def arg_min(ctx):
-    return {"Out": jnp.argmin(ctx.in_("X"), axis=ctx.attr("axis", -1)).astype(jnp.int64)}
+    return {"Out": jnp.argmin(ctx.in_("X"), axis=ctx.attr("axis", -1)).astype(DEVICE_INT)}
 
 
 @register("argsort")
@@ -278,7 +283,7 @@ def argsort(ctx):
     descending = ctx.attr("descending", False)
     idx = jnp.argsort(-x if descending else x, axis=axis)
     out = jnp.take_along_axis(x, idx, axis=axis)
-    return {"Out": out, "Indices": idx.astype(jnp.int64)}
+    return {"Out": out, "Indices": idx.astype(DEVICE_INT)}
 
 
 @register("where")
@@ -287,7 +292,7 @@ def where(ctx):
     cond = ctx.in_("Condition")
     n = cond.size
     idx = jnp.nonzero(cond.reshape(-1), size=n, fill_value=-1)[0]
-    return {"Out": idx.reshape(-1, 1).astype(jnp.int64)}
+    return {"Out": idx.reshape(-1, 1).astype(DEVICE_INT)}
 
 
 @register("where_index_select", "select")
