@@ -109,7 +109,10 @@ class _PtbLM(Layer):
         return loss * (1.0 / t)
 
 
-def test_imperative_ptb_lm_trains():
+def test_imperative_ptb_lm_memorizes():
+    """Perplexity gate (VERDICT r3 #6): the dygraph PTB-LM must drive
+    perplexity on a fixed batch below 10% of its initial value (vocab-50
+    random tokens start near ppl~50; memorization pushes ppl toward 1)."""
     rs = np.random.RandomState(1)
     toks = rs.randint(0, 50, (4, 6)).astype(np.int64)
     labs = np.roll(toks, -1, axis=1)
@@ -117,14 +120,15 @@ def test_imperative_ptb_lm_trains():
         lm = _PtbLM()
         opt = fluid.optimizer.AdamOptimizer(learning_rate=0.05)
         losses = []
-        for _ in range(8):
+        for _ in range(30):
             loss = lm(dygraph.to_variable(toks), dygraph.to_variable(labs))
             loss.backward()
             opt.minimize(loss)
             lm.clear_gradients()
             losses.append(float(loss.numpy()))
     assert np.isfinite(losses).all()
-    assert losses[-1] < losses[0] * 0.7
+    ppl0, ppl = np.exp(losses[0]), np.exp(losses[-1])
+    assert ppl < 0.1 * ppl0, (ppl0, ppl)
 
 
 def test_imperative_gan_two_optimizers():

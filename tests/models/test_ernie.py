@@ -56,19 +56,22 @@ def test_apply_knowledge_mask_contract():
     assert (out["src_ids"] == cfg.vocab_size - 1).sum() > 0
 
 
-def test_ernie_pretrain_trains():
+def test_ernie_pretrain_memorizes_fixed_batch():
+    """Real convergence gate (VERDICT r3 #6) on the bench headline
+    model: tiny-ERNIE must OVERFIT a fixed pretrain batch to <5% of the
+    initial loss. Calibrated: 80 steps @1e-3 reaches ~0.1% of initial."""
     np.random.seed(0)
     cfg = ernie.ernie_tiny()
     seq_len = 32
     feeds, total_loss, mlm_loss, nsp_acc = ernie.build_pretrain_net(
         cfg, seq_len=seq_len)
-    fluid.optimizer.AdamOptimizer(learning_rate=1e-4).minimize(total_loss)
+    fluid.optimizer.AdamOptimizer(learning_rate=1e-3).minimize(total_loss)
     exe = fluid.Executor()
     exe.run(fluid.default_startup_program())
     feed = ernie.make_pretrain_feed(cfg, seq_len, batch=4, seed=0)
     losses = []
-    for _ in range(5):
+    for _ in range(80):
         out = exe.run(feed=feed, fetch_list=[total_loss])
-        losses.append(float(np.asarray(out[0])))
+        losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
     assert np.isfinite(losses).all()
-    assert losses[-1] < losses[0]
+    assert losses[-1] < 0.05 * losses[0], (losses[0], losses[-1])

@@ -176,16 +176,20 @@ def _bert_feed(cfg, seq_len, b=4, seed=0):
     return bert.make_pretrain_feed(cfg, seq_len, b, seed=seed)
 
 
-def test_bert_pretrain_trains():
+def test_bert_pretrain_memorizes_fixed_batch():
+    """Real convergence gate (VERDICT r3 #6): tiny-BERT must OVERFIT a
+    fixed pretrain batch to <5% of the initial loss — a 5-step
+    loss-went-down check is coin-flip-adjacent. Calibrated: 80 steps
+    @1e-3 reaches ~0.2% of initial (20x margin)."""
     np.random.seed(0)
     cfg = bert.bert_tiny()
     seq_len = 32
     feeds, total_loss, mlm_loss, nsp_acc = bert.build_pretrain_net(
         cfg, seq_len=seq_len)
-    losses = _train(lambda i: _bert_feed(cfg, seq_len), total_loss, steps=5,
-                    lr=1e-4)
+    losses = _train(lambda i: _bert_feed(cfg, seq_len), total_loss,
+                    steps=80, lr=1e-3)
     assert np.isfinite(losses).all()
-    assert losses[-1] < losses[0]
+    assert losses[-1] < 0.05 * losses[0], (losses[0], losses[-1])
 
 
 def test_bert_classifier_builds():
@@ -320,9 +324,10 @@ def test_faster_rcnn_pipeline_trains():
         num_classes=5, image_size=S, max_gt=G)
     feed = {"img": img, "gt_box": gt_box, "gt_label": gt_label,
             "im_info": im_info}
-    losses = _train(lambda i: feed, loss, steps=6, lr=1e-3)
+    # calibrated: 20 Adam steps on the fixed batch reach ~0.17x initial
+    losses = _train(lambda i: feed, loss, steps=20, lr=1e-3)
     assert np.isfinite(losses).all()
-    assert losses[-1] < losses[0], losses
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
 
 
 def test_mnist_convergence_97pct():
@@ -364,10 +369,11 @@ def test_mobilenet_v1_trains():
     img, label, pred, loss, acc1, acc5 = _
     xs = np.random.randn(16, 3, 32, 32).astype(np.float32)
     ys = np.random.randint(0, 10, (16, 1)).astype(np.int64)
-    losses = _train(lambda i: {"img": xs, "label": ys}, loss, steps=25,
+    # calibrated: 40 Adam steps memorize the batch (~0.0002x initial)
+    losses = _train(lambda i: {"img": xs, "label": ys}, loss, steps=40,
                     lr=3e-3)
     assert np.isfinite(losses).all()
-    assert losses[-1] < losses[0], losses[::6]
+    assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
 
 
 def test_mobilenet_v2_builds_and_steps():
