@@ -1244,7 +1244,8 @@ def crop(x, shape=None, offsets=None, name=None):
         # same contract here instead of publishing a bogus static shape
         raise NotImplementedError(
             "crop_tensor: -1/0 shape entries need static offsets "
-            "(runtime offsets can't give a static slice size)")
+            "(runtime offsets can't give a static slice size) — pass "
+            "explicit sizes in `shape`, or make `offsets` a python list")
     off_list = offsets if not dynamic_offsets else [0] * len(shape)
     out_shape = tuple(
         int(s) if int(s) > 0

@@ -203,3 +203,62 @@ def test_bf16_cast_leaves_no_f32_convs():
     assert not f32, (
         f"{len(f32)} of {len(convs)} convs touch f32 operands after "
         f"cast_model_to_bf16: {f32[:2]}")
+
+
+_DP_STEP_CACHE = {}
+
+
+def _run_dp_step(mesh_kwargs, n_devices):
+    key = tuple(sorted(mesh_kwargs.items()))
+    if key in _DP_STEP_CACHE:
+        return _DP_STEP_CACHE[key]
+    import jax
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        loss = _mlp()
+        fluid.optimizer.MomentumOptimizer(0.1, 0.9).minimize(loss)
+    scope = Scope()
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        mesh = make_mesh(devices=jax.devices()[:n_devices], **mesh_kwargs)
+        compiled = fluid.CompiledProgram(main).with_mesh(mesh)
+        exe.run(compiled, feed=_feed(), fetch_list=[loss])
+    n_params = len(main.global_block().all_parameters())
+    _DP_STEP_CACHE[key] = (exe.last_compiled_text(), n_params)
+    return _DP_STEP_CACHE[key]
+
+
+@pytest.mark.parametrize("mesh_kwargs,n_dev", [({"dp": 8}, 8),
+                                               ({"dp": 2, "sp": 2}, 4)])
+def test_step_has_no_host_transfers(mesh_kwargs, n_dev):
+    """(f) VERDICT r3 #9: a compiled train step must stay ON DEVICE —
+    any infeed/outfeed/send/recv or host memory-space annotation in the
+    optimized HLO means a hidden host round-trip per step (an MFU killer
+    that profiles as idle device time)."""
+    txt, _ = _run_dp_step(mesh_kwargs, n_dev)
+    for marker in ("infeed", "outfeed", " send(", " recv(",
+                   "send-start", "recv-start", "S(5)",
+                   "MoveToHost", "MoveToDevice"):
+        assert marker not in txt, (
+            f"host-transfer marker {marker!r} found in the compiled "
+            f"{mesh_kwargs} step")
+
+
+@pytest.mark.parametrize("mesh_kwargs,n_dev", [({"dp": 8}, 8),
+                                               ({"dp": 2, "sp": 2}, 4)])
+def test_donated_state_is_aliased(mesh_kwargs, n_dev):
+    """(g) VERDICT r3 #9: the Executor donates the train state, and XLA
+    must actually alias those buffers (input_output_alias in the entry
+    header) — silent de-donation doubles peak HBM (params + opt state
+    held twice), the difference between fitting a model and OOM."""
+    txt, n_params = _run_dp_step(mesh_kwargs, n_dev)
+    header = txt.splitlines()[0]
+    m = re.search(r"input_output_alias=\{(.*?)\}, entry", header)
+    assert m, f"no input_output_alias in the {mesh_kwargs} step header"
+    n_alias = len(re.findall(r"\{\d+\}:", m.group(1)))
+    # state = params + optimizer accumulators (momentum: one per param);
+    # at minimum every parameter buffer must alias
+    assert n_alias >= n_params, (
+        f"only {n_alias} aliased buffers for {n_params} params in the "
+        f"{mesh_kwargs} step — donation is not reaching XLA")
