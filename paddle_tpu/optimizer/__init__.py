@@ -17,7 +17,7 @@ DecayedAdagrad = DecayedAdagradOptimizer
 Adadelta = AdadeltaOptimizer
 LarsMomentum = LarsMomentumOptimizer
 from .wrappers import (ExponentialMovingAverage, ModelAverage,
-                       LookaheadOptimizer)
+                       LookaheadOptimizer, GradientMergeOptimizer)
 from .recompute import RecomputeOptimizer
 from .regularizer import (L1Decay, L2Decay, L1DecayRegularizer,
                           L2DecayRegularizer, WeightDecayRegularizer)

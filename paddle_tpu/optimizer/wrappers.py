@@ -124,6 +124,48 @@ class ModelAverage:
         pass
 
 
+def _periodic_flag(helper, block, k, counter_name):
+    """Append a bounded k-periodic gate: a persistable counter stepping
+    (cnt + 1) mod k, and (flag, inv) floats where flag == 1.0 every k-th
+    step. Bounded so a float32 counter can never saturate at 2^24 and
+    silently stop firing on long runs."""
+    cnt = helper.create_global_variable(
+        persistable=True, name=unique_name.generate(counter_name),
+        shape=(), dtype="float32")
+    cnt.stop_gradient = True
+    init_mod.ConstantInitializer(0.0)(cnt)
+    block.append_op("increment", {"X": cnt}, {"Out": cnt}, {"step": 1.0})
+    kconst = helper.create_variable_for_type_inference("float32", ())
+    block.append_op("fill_constant", {}, {"Out": kconst},
+                    {"shape": [], "dtype": "float32", "value": float(k)})
+    block.append_op("elementwise_mod", {"X": cnt, "Y": kconst},
+                    {"Out": cnt}, {"axis": -1})
+    zero = helper.create_variable_for_type_inference("float32", ())
+    block.append_op("fill_constant", {}, {"Out": zero},
+                    {"shape": [], "dtype": "float32", "value": 0.0})
+    flag_b = helper.create_variable_for_type_inference("bool", ())
+    block.append_op("equal", {"X": cnt, "Y": zero}, {"Out": flag_b})
+    flag = helper.create_variable_for_type_inference("float32", ())
+    block.append_op("cast", {"X": flag_b}, {"Out": flag},
+                    {"out_dtype": "float32"})
+    inv = helper.create_variable_for_type_inference("float32", ())
+    block.append_op("scale", {"X": flag}, {"Out": inv},
+                    {"scale": -1.0, "bias": 1.0})
+    return flag, inv
+
+
+def _select(helper, block, flag, inv, new, old, out):
+    """out = flag*new + (1-flag)*old (branch-free periodic select)."""
+    a = helper.create_variable_for_type_inference(new.dtype, new.shape)
+    block.append_op("elementwise_mul", {"X": new, "Y": flag},
+                    {"Out": a}, {"axis": -1})
+    b = helper.create_variable_for_type_inference(new.dtype, new.shape)
+    block.append_op("elementwise_mul", {"X": old, "Y": inv},
+                    {"Out": b}, {"axis": -1})
+    block.append_op("elementwise_add", {"X": a, "Y": b},
+                    {"Out": out}, {"axis": -1})
+
+
 class LookaheadOptimizer:
     """Parity: fluid.optimizer.LookaheadOptimizer (k-step slow/fast sync)."""
 
@@ -138,27 +180,7 @@ class LookaheadOptimizer:
         helper = LayerHelper("lookahead")
         program = loss.block.program
         block = program.global_block()
-        cnt = helper.create_global_variable(
-            persistable=True, name=unique_name.generate("lookahead_step"),
-            shape=(), dtype="float32")
-        cnt.stop_gradient = True
-        init_mod.ConstantInitializer(0.0)(cnt)
-        block.append_op("increment", {"X": cnt}, {"Out": cnt}, {"step": 1.0})
-        # sync = (cnt mod k == 0) as float
-        modk = helper.create_variable_for_type_inference("float32", ())
-        kconst = helper.create_variable_for_type_inference("float32", ())
-        block.append_op("fill_constant", {}, {"Out": kconst},
-                        {"shape": [], "dtype": "float32", "value": float(self.k)})
-        block.append_op("elementwise_mod", {"X": cnt, "Y": kconst},
-                        {"Out": modk}, {"axis": -1})
-        zero = helper.create_variable_for_type_inference("float32", ())
-        block.append_op("fill_constant", {}, {"Out": zero},
-                        {"shape": [], "dtype": "float32", "value": 0.0})
-        sync_b = helper.create_variable_for_type_inference("bool", ())
-        block.append_op("equal", {"X": modk, "Y": zero}, {"Out": sync_b})
-        sync = helper.create_variable_for_type_inference("float32", ())
-        block.append_op("cast", {"X": sync_b}, {"Out": sync},
-                        {"out_dtype": "float32"})
+        sync, inv = _periodic_flag(helper, block, self.k, "lookahead_step")
         for p, _ in params_grads:
             slow = helper.create_global_variable(
                 persistable=True, name=unique_name.generate(p.name + ".slow"),
@@ -175,25 +197,111 @@ class LookaheadOptimizer:
             cand = helper.create_variable_for_type_inference(p.dtype, p.shape)
             block.append_op("elementwise_add", {"X": slow, "Y": step_},
                             {"Out": cand}, {"axis": -1})
-            # blend = sync*cand + (1-sync)*old
-            picked = helper.create_variable_for_type_inference(p.dtype, p.shape)
-            block.append_op("elementwise_mul", {"X": cand, "Y": sync},
-                            {"Out": picked}, {"axis": -1})
-            inv = helper.create_variable_for_type_inference("float32", ())
-            block.append_op("scale", {"X": sync}, {"Out": inv},
-                            {"scale": -1.0, "bias": 1.0})
-            keep_slow = helper.create_variable_for_type_inference(p.dtype, p.shape)
-            block.append_op("elementwise_mul", {"X": slow, "Y": inv},
-                            {"Out": keep_slow}, {"axis": -1})
-            block.append_op("elementwise_add", {"X": picked, "Y": keep_slow},
-                            {"Out": slow}, {"axis": -1})
+            _select(helper, block, sync, inv, cand, slow, slow)
             # fast = sync*slow' + (1-sync)*fast
-            pf = helper.create_variable_for_type_inference(p.dtype, p.shape)
-            block.append_op("elementwise_mul", {"X": slow, "Y": sync},
-                            {"Out": pf}, {"axis": -1})
-            kf = helper.create_variable_for_type_inference(p.dtype, p.shape)
-            block.append_op("elementwise_mul", {"X": p, "Y": inv},
-                            {"Out": kf}, {"axis": -1})
-            block.append_op("elementwise_add", {"X": pf, "Y": kf},
-                            {"Out": p}, {"axis": -1})
+            _select(helper, block, sync, inv, slow, p, p)
         return opt_ops, params_grads
+
+
+class GradientMergeOptimizer:
+    """K-step gradient accumulation with a gated update.
+
+    Parity: fluid.optimizer.GradientMergeOptimizer (the knob
+    DistributedStrategy.gradient_merge_steps routes here; also the
+    documented replacement for the LocalSGD transpiler). Every step adds
+    the fresh gradient into a persistable accumulator; on every k-th
+    step the inner optimizer applies the (averaged) merged gradient and
+    the accumulator resets. Off-steps leave params AND optimizer state
+    (momenta, Adam moments, beta pows) bit-identical: the whole update
+    section is wrapped in snapshot -> update -> select, the same
+    branch-free counter gating Lookahead uses, so the step stays ONE
+    compiled executable with no host round-trip.
+    """
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self.inner_optimizer = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = avg
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ..core.framework import (Operator, default_startup_program,
+                                      program_guard)
+        if self.k_steps <= 1:
+            return self.inner_optimizer.minimize(
+                loss, startup_program, parameter_list, no_grad_set)
+        helper = LayerHelper("gradient_merge")
+        program = loss.block.program
+        block = program.global_block()
+
+        # everything (counter, accumulators, tmp vars AND their startup
+        # initializers) must land in loss's programs, not whatever the
+        # ambient defaults happen to be
+        with program_guard(program,
+                           startup_program or default_startup_program()):
+            params_grads = self.inner_optimizer.backward(
+                loss, startup_program, parameter_list, no_grad_set)
+            apply_f, inv = _periodic_flag(helper, block, self.k_steps,
+                                          "grad_merge_step")
+
+            accs = []
+            for p, g in params_grads:
+                acc = helper.create_global_variable(
+                    persistable=True,
+                    name=unique_name.generate(p.name + ".grad_merge"),
+                    shape=p.shape, dtype=p.dtype)
+                acc.stop_gradient = True
+                init_mod.ConstantInitializer(0.0)(acc)
+                block.append_op("elementwise_add", {"X": acc, "Y": g},
+                                {"Out": acc}, {"axis": -1})
+                # the inner update consumes g := acc * apply (/k when
+                # avg); on off-steps g is 0 and the select below reverts
+                # the state
+                merged = helper.create_variable_for_type_inference(
+                    g.dtype, g.shape)
+                block.append_op("elementwise_mul",
+                                {"X": acc, "Y": apply_f},
+                                {"Out": merged}, {"axis": -1})
+                block.append_op("scale", {"X": merged}, {"Out": g},
+                                {"scale": (1.0 / self.k_steps)
+                                 if self.avg else 1.0})
+                accs.append(acc)
+
+            self.inner_optimizer._create_global_learning_rate(program)
+            pre = len(block.ops)
+            optimize_ops = self.inner_optimizer.apply_gradients(
+                params_grads)
+
+            # every persistable the update section writes gets
+            # snapshot -> select gating (params, momenta, beta pows, ...)
+            written, seen = [], set()
+            for op in block.ops[pre:]:
+                for name in op.output_names:
+                    v = block.vars.get(name)
+                    if v is not None and v.persistable \
+                            and name not in seen:
+                        seen.add(name)
+                        written.append(v)
+            snap_ops, snaps = [], {}
+            for v in written:
+                if not str(v.dtype).startswith(("float", "bfloat")):
+                    raise NotImplementedError(
+                        f"gradient merge cannot gate non-float optimizer "
+                        f"state var {v.name!r} ({v.dtype})")
+                tmp = helper.create_variable_for_type_inference(v.dtype,
+                                                                v.shape)
+                snap_ops.append(Operator(block, "assign", {"X": v},
+                                         {"Out": tmp}))
+                snaps[v.name] = tmp
+            block.ops[pre:pre] = snap_ops
+            for v in written:
+                _select(helper, block, apply_f, inv, v, snaps[v.name], v)
+            # accumulators reset on apply steps
+            for acc in accs:
+                block.append_op("elementwise_mul", {"X": acc, "Y": inv},
+                                {"Out": acc}, {"axis": -1})
+        program._bump_version()
+        return optimize_ops, params_grads

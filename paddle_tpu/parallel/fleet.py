@@ -215,6 +215,15 @@ class DistributedOptimizer:
         s = (self._strategy or fleet_obj._strategy
              or DistributedStrategy())
         opt = self._inner
+        if s.gradient_merge_steps > 1:
+            from ..optimizer.wrappers import GradientMergeOptimizer
+            if s.amp:
+                raise NotImplementedError(
+                    "gradient_merge_steps with strategy.amp is not "
+                    "supported yet: wrap the optimizer with "
+                    "amp.decorate yourself and pass gradient merge as "
+                    "GradientMergeOptimizer(decorated_opt, k)")
+            opt = GradientMergeOptimizer(opt, s.gradient_merge_steps)
         if s.amp:
             from .. import amp as amp_mod
             opt = amp_mod.decorate(

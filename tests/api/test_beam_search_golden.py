@@ -1,0 +1,110 @@
+"""Beam search numeric goldens (parity sweep r4 — the family had only
+shape/wiring coverage).
+
+Parity: beam_search_op.cc / beam_search_decode_op.cc semantics in their
+static-shape re-expression (ops/beam_search_ops.py): finished beams
+freeze (propose only <end> at unchanged score), selection is top-K over
+K*V accumulated log-probs, decode backtracks parent pointers.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core import framework
+from paddle_tpu.core.executor import Scope, scope_guard
+
+
+def _run(build, feed):
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        outs = build()
+    exe = fluid.Executor()
+    with scope_guard(Scope()):
+        exe.run(startup)
+        return [np.asarray(v) for v in
+                exe.run(main, feed=feed, fetch_list=list(outs))]
+
+
+def _step(probs, pre_scores, pre_ids, k, end_id):
+    def build():
+        s = layers.data("s", list(probs.shape), append_batch_size=False)
+        ps = layers.data("ps", list(pre_scores.shape),
+                         append_batch_size=False)
+        pi = layers.data("pi", list(pre_ids.shape), dtype="int64",
+                         append_batch_size=False)
+        ids_sel, scores_sel, parent = layers.beam_search(
+            pi, ps, None, s, beam_size=k, end_id=end_id,
+            return_parent_idx=True)
+        return ids_sel, scores_sel, parent
+
+    return _run(build, {"s": probs, "ps": pre_scores, "pi": pre_ids})
+
+
+def test_beam1_equals_greedy():
+    rng = np.random.RandomState(0)
+    b, v = 3, 7
+    probs = rng.dirichlet(np.ones(v), size=b).astype(np.float32)
+    pre = np.zeros((b, 1), np.float32)
+    pre_ids = np.full((b, 1), -1, np.int64)
+    ids, scores, parent = _step(probs, pre, pre_ids, k=1, end_id=0)
+    np.testing.assert_array_equal(ids.reshape(b), probs.argmax(-1))
+    np.testing.assert_allclose(scores.reshape(b),
+                               np.log(probs.max(-1)), rtol=1e-5)
+
+
+def test_topk_over_all_continuations():
+    """K=2, V=3, hand-computed: selection is top-2 of the 2*3
+    accumulated candidates, parents in FLAT (batch*K+beam) form."""
+    probs = np.array([[[0.7, 0.2, 0.1],
+                       [0.1, 0.1, 0.8]]], np.float32).reshape(2, 3)
+    pre = np.array([[np.log(0.6)], [np.log(0.4)]], np.float32)
+    pre_ids = np.full((2, 1), -1, np.int64)
+    ids, scores, parent = _step(probs, pre, pre_ids, k=2, end_id=9)
+    # candidates: beam0: .6*.7=.42, .12, .06; beam1: .04, .04, .32
+    # top2: .42 (beam0 tok0), .32 (beam1 tok2)
+    np.testing.assert_array_equal(ids.reshape(-1), [0, 2])
+    np.testing.assert_allclose(scores.reshape(-1),
+                               np.log([0.42, 0.32]), rtol=1e-5)
+    np.testing.assert_array_equal(parent.reshape(-1), [0, 1])
+
+
+def test_finished_beam_freezes_score_and_slot():
+    """A beam whose pre_id is <end> proposes exactly one continuation
+    (<end>, score unchanged) — the static-shape form of the reference's
+    LoD prune."""
+    end_id = 2
+    probs = np.array([[0.5, 0.3, 0.2],
+                      [0.9, 0.05, 0.05]], np.float32)
+    pre = np.array([[np.log(0.9)], [np.log(0.8)]], np.float32)
+    pre_ids = np.array([[end_id], [1]], np.int64)   # beam0 finished
+    ids, scores, parent = _step(probs, pre, pre_ids, k=2, end_id=end_id)
+    # beam0 contributes ONLY (end, 0.9); beam1's best is 0.8*0.9=0.72
+    np.testing.assert_allclose(scores.reshape(-1),
+                               np.log([0.9, 0.72]), rtol=1e-5)
+    np.testing.assert_array_equal(ids.reshape(-1), [end_id, 0])
+    np.testing.assert_array_equal(parent.reshape(-1), [0, 1])
+
+
+def test_decode_backtracks_parents():
+    """(T=3, B=1, K=2) with a beam switch at t=2: lane 0's final
+    sequence must follow its parent chain, not its own lane."""
+    ids = np.array([[[5, 6]], [[7, 8]], [[9, 4]]], np.int64)
+    parents = np.array([[[0, 1]], [[0, 1]], [[1, 0]]], np.int64)
+    scores = np.array([[1.0, 0.5]], np.float32)
+
+    def build():
+        i = layers.data("i", [3, 1, 2], dtype="int64",
+                        append_batch_size=False)
+        p = layers.data("p", [3, 1, 2], dtype="int64",
+                        append_batch_size=False)
+        s = layers.data("sc", [1, 2], append_batch_size=False)
+        seq, sc = layers.beam_search_decode(i, p, s, beam_size=2,
+                                            end_id=0)
+        return seq, sc
+
+    seq, sc = _run(build, {"i": ids, "p": parents, "sc": scores})
+    # lane 0 at t=2 came from parent 1: chain 6 -> 8 -> 9
+    np.testing.assert_array_equal(seq[0, 0], [6, 8, 9])
+    # lane 1 at t=2 came from parent 0: chain 5 -> 7 -> 4
+    np.testing.assert_array_equal(seq[0, 1], [5, 7, 4])

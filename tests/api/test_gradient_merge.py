@@ -1,0 +1,202 @@
+"""GradientMergeOptimizer: k accumulation steps == one big-batch step,
+exactly, including stateful optimizer internals (parity:
+fluid.optimizer.GradientMergeOptimizer; the DistributedStrategy
+gradient_merge_steps knob and the LocalSGD shim both route here)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core import framework
+from paddle_tpu.core.executor import Scope, scope_guard
+
+K, B, D = 3, 4, 6
+
+
+def _build(opt_factory, merge, batch=B):
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 11
+    with framework.program_guard(main, startup):
+        x = layers.data("x", [batch, D], append_batch_size=False)
+        y = layers.data("y", [batch, 1], append_batch_size=False)
+        pred = layers.fc(x, size=1, param_attr=fluid.ParamAttr(name="w"),
+                         bias_attr=fluid.ParamAttr(name="b"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt = opt_factory()
+        if merge:
+            opt = fluid.optimizer.GradientMergeOptimizer(opt, K)
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def _data(n_steps):
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((n_steps, B, D)).astype("float32")
+    w = rng.standard_normal((D, 1)).astype("float32")
+    ys = xs @ w + 0.1
+    return xs, ys.astype("float32")
+
+
+@pytest.mark.parametrize("opt_factory", [
+    lambda: fluid.optimizer.AdamOptimizer(1e-2),
+    lambda: fluid.optimizer.MomentumOptimizer(0.1, 0.9),
+    lambda: fluid.optimizer.SGDOptimizer(0.1),
+])
+def test_merge_k_equals_big_batch(opt_factory):
+    """2K sub-batch steps at merge k=K == 2 big-batch (B*K) steps of the
+    unwrapped optimizer — same init seed, identical final params (equal
+    sub-batch sizes make mean-of-means == big-batch mean)."""
+    xs, ys = _data(2 * K)
+
+    main, startup, loss = _build(opt_factory, merge=True)
+    scope = Scope()
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        for i in range(2 * K):
+            exe.run(main, feed={"x": xs[i], "y": ys[i]},
+                    fetch_list=[loss])
+        w_m = np.asarray(scope.get("w")).copy()
+        b_m = np.asarray(scope.get("b")).copy()
+
+    main2, startup2, loss2 = _build(opt_factory, merge=False,
+                                    batch=B * K)
+    scope2 = Scope()
+    exe2 = fluid.Executor()
+    with scope_guard(scope2):
+        exe2.run(startup2)
+        for j in range(2):
+            sl = slice(j * K, (j + 1) * K)
+            exe2.run(main2, feed={"x": xs[sl].reshape(-1, D),
+                                  "y": ys[sl].reshape(-1, 1)},
+                     fetch_list=[loss2])
+        w_b = np.asarray(scope2.get("w"))
+        b_b = np.asarray(scope2.get("b"))
+    np.testing.assert_allclose(w_m, w_b, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(b_m, b_b, rtol=1e-5, atol=1e-7)
+
+
+def _manual_adam_reference(xs, ys, w0, b0, lr=1e-2, beta1=0.9,
+                           beta2=0.999, eps=1e-8):
+    """Big-batch Adam over the concatenated sub-batches."""
+    w, b = w0.copy(), b0.copy()
+    mw = np.zeros_like(w)
+    vw = np.zeros_like(w)
+    mb = np.zeros_like(b)
+    vb = np.zeros_like(b)
+    t = 0
+    for j in range(xs.shape[0] // K):
+        xcat = xs[j * K:(j + 1) * K].reshape(-1, xs.shape[-1])
+        ycat = ys[j * K:(j + 1) * K].reshape(-1, 1)
+        pred = xcat @ w + b
+        diff = pred - ycat
+        n = xcat.shape[0]
+        gw = (2.0 / n) * (xcat.T @ diff)
+        gb = np.full_like(b, (2.0 / n) * diff.sum())
+        t += 1
+        for g, p, m_, v_ in ((gw, "w", mw, vw), (gb, "b", mb, vb)):
+            m_[...] = beta1 * m_ + (1 - beta1) * g
+            v_[...] = beta2 * v_ + (1 - beta2) * g * g
+            mhat = m_ / (1 - beta1 ** t)
+            vhat = v_ / (1 - beta2 ** t)
+            upd = lr * mhat / (np.sqrt(vhat) + eps)
+            if p == "w":
+                w = w - upd
+            else:
+                b = b - upd
+    return w, b
+
+
+def test_merge_adam_matches_manual_big_batch():
+    xs, ys = _data(2 * K)
+    main, startup, loss = _build(lambda: fluid.optimizer.AdamOptimizer(
+        1e-2), merge=True)
+    scope = Scope()
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        w0 = np.asarray(scope.get("w")).copy()
+        b0 = np.asarray(scope.get("b")).copy()
+        losses = []
+        for i in range(2 * K):
+            out = exe.run(main, feed={"x": xs[i], "y": ys[i]},
+                          fetch_list=[loss])
+            losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+        w_m = np.asarray(scope.get("w"))
+        b_m = np.asarray(scope.get("b"))
+    w_ref, b_ref = _manual_adam_reference(xs, ys, w0, b0)
+    np.testing.assert_allclose(w_m, w_ref, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(b_m, b_ref, rtol=1e-4, atol=1e-6)
+
+
+def test_off_steps_leave_params_and_state_untouched():
+    xs, ys = _data(K)
+    main, startup, loss = _build(lambda: fluid.optimizer.AdamOptimizer(
+        1e-2), merge=True)
+    scope = Scope()
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        w0 = np.asarray(scope.get("w")).copy()
+        state_names = [n for n in scope.names()
+                       if "moment" in n or "beta" in n]
+        assert state_names, "Adam state not found in scope"
+        state0 = {n: np.asarray(scope.get(n)).copy() for n in state_names}
+        # steps 1..K-1 are off-steps: nothing moves
+        for i in range(K - 1):
+            exe.run(main, feed={"x": xs[i], "y": ys[i]},
+                    fetch_list=[loss])
+            np.testing.assert_array_equal(np.asarray(scope.get("w")), w0)
+            for n in state_names:
+                np.testing.assert_array_equal(np.asarray(scope.get(n)),
+                                              state0[n])
+        # step K applies: params move
+        exe.run(main, feed={"x": xs[K - 1], "y": ys[K - 1]},
+                fetch_list=[loss])
+        assert not np.array_equal(np.asarray(scope.get("w")), w0)
+
+
+def test_fleet_strategy_routes_gradient_merge():
+    from paddle_tpu.parallel.fleet import (DistributedOptimizer,
+                                           DistributedStrategy, Fleet)
+    s = DistributedStrategy()
+    s.gradient_merge_steps = 2
+    f = Fleet()
+    f._strategy = s
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = layers.data("x", [B, D], append_batch_size=False)
+        y = layers.data("y", [B, 1], append_batch_size=False)
+        loss = layers.mean(layers.square_error_cost(
+            layers.fc(x, size=1), y))
+        DistributedOptimizer(fluid.optimizer.SGDOptimizer(0.1),
+                             f).minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    assert "increment" in types and "elementwise_mod" in types, (
+        "gradient_merge_steps did not wire the merge counter in")
+
+
+def test_minimize_outside_program_guard():
+    """Regression: minimize(loss, startup_program=...) called OUTSIDE a
+    program_guard must create its counter/accumulators in LOSS's
+    programs, not the ambient defaults."""
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = layers.data("x", [B, D], append_batch_size=False)
+        y = layers.data("y", [B, 1], append_batch_size=False)
+        loss = layers.mean(layers.square_error_cost(
+            layers.fc(x, size=1), y))
+    opt = fluid.optimizer.GradientMergeOptimizer(
+        fluid.optimizer.SGDOptimizer(0.1), K)
+    opt.minimize(loss, startup_program=startup)      # no guard active
+    names = set(main.global_block().vars)
+    assert any("grad_merge_step" in n for n in names)
+    xs, ys = _data(1)
+    scope = Scope()
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        out = exe.run(main, feed={"x": xs[0], "y": ys[0]},
+                      fetch_list=[loss])
+    assert np.isfinite(np.asarray(out[0])).all()
