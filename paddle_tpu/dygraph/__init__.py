@@ -7,10 +7,14 @@ from .base import guard, enabled, to_variable, no_grad, enable_dygraph, disable_
 from .layers import Layer, Sequential, LayerList, ParameterList
 from .nn import (Linear, FC, Conv2D, Pool2D, BatchNorm, Embedding, LayerNorm,
                  GroupNorm, PRelu, BilinearTensorProduct, Conv2DTranspose,
-                 SpectralNorm, GRUUnit, NCE, Dropout)
+                 SpectralNorm, GRUUnit, NCE, Dropout,
+                 Conv3D, Conv3DTranspose, TreeConv)
 from .checkpoint import save_dygraph, load_dygraph
 from .jit import to_static, TracedLayer
-from .parallel import DataParallel
+from .parallel import DataParallel, ParallelEnv, Env, prepare_context
+from . import tracer
+from .tracer import (Tracer, BackwardStrategy, start_gperf_profiler,
+                     stop_gperf_profiler)
 from . import learning_rate_scheduler
 from .learning_rate_scheduler import (NoamDecay, ExponentialDecay,
                                       PiecewiseDecay, CosineDecay,

@@ -87,7 +87,10 @@ class Conv2D(Layer):
         fs = filter_size if isinstance(filter_size, (list, tuple)) else (
             filter_size, filter_size)
         groups = groups or 1
-        fan_in = (num_channels // groups) * fs[0] * fs[1]
+        # reference default init counts the FULL num_channels in
+        # filter_elem_num even for grouped convs (dygraph/nn.py
+        # _get_default_param_initializer)
+        fan_in = num_channels * fs[0] * fs[1]
         self.weight = self.create_parameter(
             [num_filters, num_channels // groups, fs[0], fs[1]], dtype,
             param_attr,
@@ -415,3 +418,112 @@ def _pair(v, n=2):
     if isinstance(v, (list, tuple)):
         return list(v)
     return [v] * n
+
+
+class Conv3D(Layer):
+    """Parity: dygraph/nn.py Conv3D (NCDHW)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__()
+        fs = (tuple(filter_size) if isinstance(filter_size, (list, tuple))
+              else (filter_size,) * 3)
+        groups = groups or 1
+        # reference default init counts the FULL num_channels in
+        # filter_elem_num even for grouped convs (nn.py:394)
+        fan_in = num_channels * fs[0] * fs[1] * fs[2]
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // groups, *fs], dtype, param_attr,
+            default_initializer=init_mod.NormalInitializer(
+                0.0, (2.0 / fan_in) ** 0.5))
+        self.bias = self.create_parameter([num_filters], dtype, bias_attr,
+                                          is_bias=True)
+
+        self._attrs = {"strides": list(_pair(stride, 3)),
+                       "paddings": list(_pair(padding, 3)),
+                       "dilations": list(_pair(dilation, 3)),
+                       "groups": groups}
+        self._act = act
+
+    def forward(self, x):
+        out = run_op_eager("conv3d", {"Input": x, "Filter": self.weight},
+                           dict(self._attrs), out_slot="Output")
+        if self.bias is not None:
+            out = run_op_eager(
+                "elementwise_add", {"X": out, "Y": self.bias}, {"axis": 1})
+        return _act(out, self._act)
+
+
+class Conv3DTranspose(Layer):
+    """Parity: dygraph/nn.py Conv3DTranspose (filter (C_in, C_out/g,
+    kD, kH, kW), gradient-of-conv semantics)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__()
+        fs = (tuple(filter_size) if isinstance(filter_size, (list, tuple))
+              else (filter_size,) * 3)
+        groups = groups or 1
+        self.weight = self.create_parameter(
+            [num_channels, num_filters // groups, *fs], dtype, param_attr)
+        self.bias = self.create_parameter([num_filters], dtype, bias_attr,
+                                          is_bias=True)
+
+        self._attrs = {"strides": list(_pair(stride, 3)),
+                       "paddings": list(_pair(padding, 3)),
+                       "dilations": list(_pair(dilation, 3)),
+                       "groups": groups}
+        self._act = act
+
+    def forward(self, x):
+        out = run_op_eager("conv3d_transpose",
+                           {"Input": x, "Filter": self.weight},
+                           dict(self._attrs), out_slot="Output")
+        if self.bias is not None:
+            out = run_op_eager(
+                "elementwise_add", {"X": out, "Y": self.bias}, {"axis": 1})
+        return _act(out, self._act)
+
+
+class TreeConv(Layer):
+    """Parity: dygraph/nn.py TreeConv:2605 (TBCNN over (nodes, edges));
+    reference ctor shape — name_scope first, feature size inferred at
+    first forward, bias [num_filters] only when bias_attr is given."""
+
+    def __init__(self, name_scope, output_size, num_filters=1,
+                 max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+                 name=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._output_size = output_size
+        self._num_filters = num_filters
+        self._max_depth = max_depth
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act = act
+        self._built = False
+
+    def _build_once(self, nodes_vector):
+        feature_size = int(nodes_vector.shape[2])
+        self.W = self.create_parameter(
+            [feature_size, 3, self._output_size, self._num_filters],
+            self._dtype, self._param_attr)
+        self._bias_param = (self.create_parameter(
+            [self._num_filters], self._dtype, self._bias_attr,
+            is_bias=True) if self._bias_attr else None)
+        self._built = True
+
+    def forward(self, nodes_vector, edge_set):
+        if not self._built:
+            self._build_once(nodes_vector)
+        out = run_op_eager(
+            "tree_conv",
+            {"NodesVector": nodes_vector, "EdgeSet": edge_set,
+             "Filter": self.W},
+            {"max_depth": self._max_depth})
+        if self._bias_param is not None:
+            out = run_op_eager("elementwise_add",
+                               {"X": out, "Y": self._bias_param},
+                               {"axis": -1})
+        return _act(out, self._act)

@@ -1,0 +1,63 @@
+"""Dygraph tracer + backward strategy + gperf shims.
+
+Parity: python/paddle/fluid/dygraph/tracer.py (Tracer),
+backward_strategy.py (BackwardStrategy), profiler.py
+(start/stop_gperf_profiler).
+"""
+
+from .base import current_tape
+
+__all__ = ["Tracer", "BackwardStrategy", "start_gperf_profiler",
+           "stop_gperf_profiler"]
+
+
+class Tracer:
+    """Parity: dygraph/tracer.py:Tracer — the object that records eager
+    ops for autodiff. Here the recording IS the Tape (dygraph/base.py);
+    Tracer is a view over the active tape so reference code that flips
+    `tracer._train_mode` or inspects `trace_op` calls keeps working."""
+
+    def __init__(self, block=None):
+        self._train_mode = True
+
+    @property
+    def _tape(self):
+        return current_tape()
+
+    def trace_op(self, type, inputs, outputs, attrs=None, stop_gradient=False):
+        from .base import no_grad
+        from .functional import run_op_into
+        if stop_gradient:
+            with no_grad():
+                run_op_into(type, inputs, attrs or {}, outputs)
+        else:
+            run_op_into(type, inputs, attrs or {}, outputs)
+
+    def train_mode(self):
+        self._train_mode = True
+
+    def eval_mode(self):
+        self._train_mode = False
+
+
+class BackwardStrategy:
+    """Parity: dygraph/backward_strategy.py — `sort_sum_gradient` makes
+    multi-consumer gradient sums deterministic in the reference's
+    C++ engine. jax.grad sums in a fixed traversal order already, so
+    both settings yield identical (deterministic) results; the knob is
+    accepted for API compatibility."""
+
+    def __init__(self):
+        self.sort_sum_gradient = False
+
+
+def start_gperf_profiler():
+    """Parity shim: dygraph gperftools CPU profiler start — maps to the
+    jax profiler trace (utils/profiler), the TPU-native equivalent."""
+    from .. import profiler
+    profiler.start_profiler("All")
+
+
+def stop_gperf_profiler():
+    from .. import profiler
+    profiler.stop_profiler()

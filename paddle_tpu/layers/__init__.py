@@ -22,6 +22,13 @@ from .loss import (cross_entropy, softmax_with_cross_entropy,
                    teacher_student_sigmoid_loss, cos_sim, center_loss)
 from .metric_op import (accuracy, auc, mean_iou, edit_distance,
                         chunk_eval)
+from . import distributions
+from .distributions import (Uniform, Normal, Categorical,
+                            MultivariateNormalDiag)
+from . import layer_function_generator
+from .layer_function_generator import (deprecated, generate_layer_fn,
+                                       generate_activation_fn, autodoc,
+                                       templatedoc)
 from . import learning_rate_scheduler
 from .learning_rate_scheduler import (noam_decay, exponential_decay,
                                       natural_exp_decay, inverse_time_decay,

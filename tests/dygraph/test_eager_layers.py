@@ -113,3 +113,56 @@ def test_tensor_ops_eager():
         assert c.shape == (3, 4)
         s = layers.reduce_sum(c)
         np.testing.assert_allclose(float(s.numpy()), 30.0)
+
+
+def test_conv3d_modules_and_treeconv():
+    """r4 surface closure: dygraph Conv3D / Conv3DTranspose / TreeConv
+    (ref dygraph/nn.py) — shapes, activation, grads."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import dygraph
+    import paddle_tpu.dygraph.functional as F
+
+    with dygraph.guard():
+        x = dygraph.to_variable(
+            np.random.randn(2, 3, 4, 4, 4).astype("float32"))
+        c = dygraph.Conv3D(3, 5, 3, padding=1, act="relu")
+        y = c(x)
+        assert y.shape == (2, 5, 4, 4, 4)
+        assert float(F.mean(y).numpy()) >= 0.0          # relu applied
+        yt = dygraph.Conv3DTranspose(3, 5, 2, stride=2)(x)
+        assert yt.shape == (2, 5, 8, 8, 8)
+        tc = dygraph.TreeConv("tree", output_size=8, num_filters=2,
+                              bias_attr=fluid.ParamAttr(name="tc_b"))
+        nodes = dygraph.to_variable(
+            np.random.randn(2, 6, 4).astype("float32"))
+        edges = dygraph.to_variable(np.zeros((2, 5, 2), np.int32))
+        out = tc(nodes, edges)
+        assert out.shape == (2, 6, 8, 2)
+        loss = F.mean(y)
+        bs = dygraph.BackwardStrategy()
+        bs.sort_sum_gradient = True
+        loss.backward(backward_strategy=bs)
+        assert c.weight._grad is not None
+
+
+def test_tracer_and_generated_layer_fns():
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import dygraph, layers
+
+    t = dygraph.Tracer()
+    t.train_mode(); t.eval_mode()
+    relu = layers.generate_activation_fn("relu")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [2, 3], append_batch_size=False)
+        out = relu(x)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        got = exe.run(main, feed={"x": np.array([[-1., 2., -3.],
+                                                 [4., -5., 6.]],
+                                                np.float32)},
+                      fetch_list=[out])
+    assert (np.asarray(got[0]) >= 0).all()

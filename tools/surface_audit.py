@@ -1,8 +1,10 @@
 """Python-surface disposition audit (VERDICT r3 items 3/5).
 
-Walks the reference's contrib/, incubate/, distributed/ and transpiler/
-python packages, collects every public name (``__all__`` when declared,
-else top-level classes/defs), and dispositions each one:
+Walks the reference's python surface — the contrib/, incubate/,
+distributed/ and transpiler/ packages (``__all__`` when declared, else
+top-level classes/defs) AND the main fluid modules (layers/, dygraph/,
+optimizer, io, ... — ``__all__``-declared names) — and dispositions
+each name:
 
   ported          — resolves in the mapped paddle_tpu module
   shim            — import-compatible, raises NotImplementedError with
@@ -28,6 +30,29 @@ REF_DEFAULT = "/root/reference/python/paddle/fluid"
 PACKAGES = ("contrib", "incubate", "distributed", "transpiler")
 SKIP_FILES = ("ps_pb2.py",)
 SKIP_DIRS = ("tests", "details")
+
+# the MAIN fluid surface: reference module/package -> candidate
+# paddle_tpu modules to resolve each __all__ name in (first hit wins;
+# "paddle_tpu" and "paddle_tpu.layers" are implicit fallbacks)
+MAIN_SURFACE = {
+    "layers": ["paddle_tpu.layers"],
+    "dygraph": ["paddle_tpu.dygraph"],
+    "initializer.py": ["paddle_tpu.initializer"],
+    "optimizer.py": ["paddle_tpu.optimizer"],
+    "metrics.py": ["paddle_tpu.metrics"],
+    "regularizer.py": ["paddle_tpu.optimizer.regularizer"],
+    "clip.py": ["paddle_tpu.optimizer.clip"],
+    "nets.py": ["paddle_tpu.nets"],
+    "backward.py": [], "framework.py": ["paddle_tpu.core.framework"],
+    "executor.py": [], "io.py": ["paddle_tpu.io"],
+    "data_feeder.py": [], "average.py": ["paddle_tpu.average"],
+    "evaluator.py": ["paddle_tpu.evaluator"],
+    "profiler.py": ["paddle_tpu.profiler"],
+    "unique_name.py": ["paddle_tpu.core.unique_name"],
+    "dataset.py": [], "reader.py": ["paddle_tpu.reader"],
+    "parallel_executor.py": [], "param_attr.py": [],
+    "__init__.py": [],
+}
 
 # reference module (relative, no .py) -> paddle_tpu module to resolve in.
 # First match by longest prefix.
@@ -225,7 +250,57 @@ def audit(ref_root):
                 rows.append((rel, name, "ported", "paddle_tpu.slim"))
             else:
                 todo.append((rel, name, f"unresolved (looked in {target})"))
+
+    # the MAIN surface: __all__-declared names only, resolved against
+    # the mapped module(s) + the paddle_tpu/-layers fallbacks
+    for entry, candidates in MAIN_SURFACE.items():
+        p = os.path.join(ref_root, entry)
+        paths = []
+        if os.path.isdir(p):
+            for dp, dns, fns in os.walk(p):
+                dns[:] = [d for d in dns if d not in SKIP_DIRS]
+                paths += [os.path.join(dp, f) for f in sorted(fns)
+                          if f.endswith(".py")]
+        elif os.path.isfile(p):
+            paths = [p]
+        for path in sorted(paths):
+            rel = os.path.relpath(path, ref_root)[:-3]
+            for name in _public_names_all_only(path):
+                reason = _deleted_reason(rel, name)
+                if reason:
+                    rows.append((rel, name, "design-deleted", reason))
+                    continue
+                where = None
+                for cand in list(candidates) + ["paddle_tpu",
+                                                "paddle_tpu.layers",
+                                                "paddle_tpu.dygraph"]:
+                    if resolve(cand, name):
+                        where = cand
+                        break
+                if where:
+                    rows.append((rel, name, "ported", where))
+                else:
+                    todo.append((rel, name, "unresolved (main surface)"))
     return rows, todo
+
+
+def _public_names_all_only(path):
+    """__all__ names only (no class/def fallback): the main surface is
+    fully __all__-declared in the reference."""
+    try:
+        tree = ast.parse(open(path).read())
+    except SyntaxError:
+        return []
+    names = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            tgt = (node.targets[0] if isinstance(node, ast.Assign)
+                   else node.target)
+            if isinstance(tgt, ast.Name) and tgt.id == "__all__":
+                if isinstance(node.value, (ast.List, ast.Tuple)):
+                    names += [e.value for e in node.value.elts
+                              if isinstance(e, ast.Constant)]
+    return names
 
 
 def render(rows, todo):
@@ -236,11 +311,13 @@ def render(rows, todo):
         "# Reference python-surface disposition audit",
         "",
         "Generated by `python tools/surface_audit.py` (kept current by "
-        "`tests/api/test_surface_audit.py`). Scope: every public name "
-        "(`__all__`, else top-level classes/defs) in the reference's "
-        "`contrib/`, `incubate/`, `distributed/` and `transpiler/` "
-        "packages — the fate of the main `fluid.*`/`fluid.layers.*` "
-        "surface is op-level audited in `docs/op_audit.md`.",
+        "`tests/api/test_surface_audit.py`). Scope: the reference's "
+        "FULL python surface — `contrib/`, `incubate/`, `distributed/` "
+        "and `transpiler/` (every public name: `__all__`, else "
+        "top-level classes/defs) plus the main fluid modules "
+        "(`layers/`, `dygraph/`, optimizer, io, ...; their "
+        "`__all__`-declared names). Operator-level fates are separately "
+        "audited in `docs/op_audit.md`.",
         "",
         f"**{len(rows)} names: {counts.get('ported', 0)} ported, "
         f"{counts.get('shim', 0)} import-compatible shims (raise with "
