@@ -165,6 +165,7 @@ class Executor:
     # ------------------------------------------------------------------
     def close(self):
         self._cache.clear()
+        self._meta_cache.clear()
         self._last_call = None
         self._compiled_pair = None
 
@@ -326,7 +327,8 @@ class Executor:
         # ~0.5ms/step on cached small-model steps
         meta_key = (id(program), program.version,
                     tuple(sorted(feed)), fetch_names)
-        persist_names = self._meta_cache.get(meta_key)
+        persist_names = (self._meta_cache.get(meta_key)
+                         if use_program_cache else None)
         if persist_names is None:
             # early, friendly validation (parity: fluid's
             # check_feed_shape_type)
@@ -347,7 +349,8 @@ class Executor:
                             f"program but missing from feed={{...}}")
             persist_names = tuple(sorted(
                 v.name for v in program.list_vars() if v.persistable))
-            self._meta_cache[meta_key] = persist_names
+            if use_program_cache:
+                self._meta_cache[meta_key] = persist_names
         state = {n: scope.get(n) for n in persist_names if scope.get(n) is not None}
         state_sig = tuple(sorted(state))
 

@@ -94,3 +94,81 @@ def synthetic_pair_reader(num, src_vocab, trg_vocab, src_len, trg_len, seed):
             trg = (src[::-1] + 7) % (trg_vocab - 2) + 2
             yield src.astype("int64"), trg.astype("int64"), trg.astype("int64")
     return reader
+
+
+def md5file(fname):
+    """Parity: dataset/common.py:57 — md5 hex digest of a file."""
+    import hashlib
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    """Parity: dataset/common.py:66 — resolve a dataset file path.
+
+    This environment has zero egress, so no bytes are fetched: if the
+    file already sits under DATA_HOME/module_name (user-provided), its
+    path returns (with an md5 warning on mismatch, like the reference's
+    retry would note); otherwise a RuntimeError explains the offline
+    contract and the synthetic fallback every reader has.
+    """
+    import warnings
+    filename = os.path.join(
+        DATA_HOME, module_name,
+        save_name if save_name is not None else url.split("/")[-1])
+    if os.path.exists(filename):
+        if md5sum and md5file(filename) != md5sum:
+            warnings.warn(f"{filename} md5 does not match the reference "
+                          f"checksum; using the file as-is", stacklevel=2)
+        return filename
+    raise RuntimeError(
+        f"download({url!r}): this environment has no network egress. "
+        f"Drop the original file at {filename} to use real data; every "
+        f"paddle_tpu.dataset reader otherwise falls back to a "
+        f"deterministic synthetic with the original shapes/vocabs.")
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=None):
+    """Parity: dataset/common.py:122 — dump a reader into line_count-
+    sized pickle chunks (files open BINARY; the python-2 reference
+    opened text, which py3 pickle cannot use)."""
+    import pickle
+    dumper = dumper or pickle.dump
+    if not callable(dumper):
+        raise TypeError("dumper should be callable.")
+    lines = []
+    indx_f = 0
+    for i, d in enumerate(reader()):
+        lines.append(d)
+        if i >= line_count and i % line_count == 0:
+            with open(suffix % indx_f, "wb") as f:
+                dumper(lines, f)
+                lines = []
+                indx_f += 1
+    if lines:
+        with open(suffix % indx_f, "wb") as f:
+            dumper(lines, f)
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    """Parity: dataset/common.py:160 — read back split() chunks, every
+    trainer_count-th file belonging to this trainer."""
+    import glob
+    import pickle
+    loader = loader or pickle.load
+
+    def reader():
+        if not callable(loader):
+            raise TypeError("loader should be callable.")
+        file_list = sorted(glob.glob(files_pattern))
+        for idx, fn in enumerate(file_list):
+            if idx % trainer_count == trainer_id:
+                with open(fn, "rb") as f:
+                    for line in loader(f):
+                        yield line
+
+    return reader

@@ -26,3 +26,23 @@ def test():
             labels = ((words + pred) % LABEL_DICT_LEN).astype("int64")
             yield (words, *ctx, pred, mark, labels)
     return reader
+
+
+def get_embedding():
+    """Parity: dataset/conll05.py:218 — path to the pretrained word
+    embedding table. Offline: a deterministic synthetic (WORD_DICT_LEN,
+    32) table materializes under DATA_HOME once and its path returns —
+    loaders (np.loadtxt-style text rows, like the reference file) work
+    unchanged."""
+    import os
+    from .common import DATA_HOME, _rng
+    path = os.path.join(DATA_HOME, "conll05st", "emb")
+    if not os.path.exists(path):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        emb = _rng(96).randn(WORD_DICT_LEN, 32).astype("float32")
+        # write-then-rename: a killed or concurrent first call must not
+        # leave a truncated table behind the exists() check
+        tmp = f"{path}.tmp.{os.getpid()}"
+        np.savetxt(tmp, emb, fmt="%.6f")
+        os.replace(tmp, path)
+    return path

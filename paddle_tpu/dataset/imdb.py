@@ -78,3 +78,12 @@ def test(word_idx=None):
         return _real_reader("test", word_idx)
     n = len(word_idx) if word_idx else WORD_DICT_SIZE
     return synthetic_sequence_reader(512, n, 128, 2, seed=73)
+
+
+def build_dict(pattern, cutoff):
+    """Parity: dataset/imdb.py:58 — frequency dict over the corpus with
+    rare words cut off. Offline, the corpus is the synthetic vocab, so
+    this returns the same deterministic word->id map word_dict() serves
+    (cutoff keeps the signature contract; synthetic frequencies are
+    uniform, so nothing falls below it)."""
+    return word_dict()

@@ -60,3 +60,79 @@ def train():
 
 def test():
     return _reader(1024, seed=93)
+
+
+class MovieInfo:
+    """Parity: dataset/movielens.py MovieInfo record."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self):
+        return [self.index,
+                [CATEGORIES_DICT[c] for c in self.categories],
+                [TITLE_DICT[w.lower()] for w in self.title.split()]]
+
+    def __repr__(self):
+        return (f"<MovieInfo id({self.index}), "
+                f"title({self.title}), categories({self.categories})>")
+
+
+class UserInfo:
+    """Parity: dataset/movielens.py UserInfo record."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = AGE_TABLE.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age, self.job_id]
+
+    def __repr__(self):
+        return (f"<UserInfo id({self.index}), gender({'M' if self.is_male else 'F'}), "
+                f"age({AGE_TABLE[self.age]}), job({self.job_id})>")
+
+
+CATEGORIES_DICT = movie_categories()
+TITLE_DICT = get_movie_title_dict()
+
+
+def _meta(seed=95):
+    rng = _rng(seed)
+    movies = {}
+    for mid in range(1, MAX_MOVIE_ID + 1):
+        cats = [f"cat{int(rng.randint(CATEGORIES))}"]
+        title = " ".join(f"t{int(t)}" for t in
+                         rng.randint(0, TITLE_DICT_SIZE, size=3))
+        movies[mid] = MovieInfo(mid, cats, title)
+    users = {}
+    for uid in range(1, MAX_USER_ID + 1):
+        users[uid] = UserInfo(uid, "M" if rng.randint(2) else "F",
+                              AGE_TABLE[int(rng.randint(len(AGE_TABLE)))],
+                              int(rng.randint(MAX_JOB_ID + 1)))
+    return movies, users
+
+
+_META = None
+
+
+def _init_meta():
+    global _META
+    if _META is None:
+        _META = _meta()
+    return _META
+
+
+def movie_info():
+    """Parity: dataset/movielens.py:240 — {movie_id: MovieInfo}
+    (deterministic synthetic metadata matching the id/vocab ranges)."""
+    return _init_meta()[0]
+
+
+def user_info():
+    """Parity: dataset/movielens.py:232 — {user_id: UserInfo}."""
+    return _init_meta()[1]

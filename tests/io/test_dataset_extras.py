@@ -201,3 +201,55 @@ def test_voc2012_real_tar_decoding(data_home):
     got_img, got_lbl = out[0]
     assert got_img.shape == (3, 24, 24)
     np.testing.assert_array_equal(got_lbl, lbl)       # png mask lossless
+
+
+def test_dataset_surface_round4():
+    """r4 closure of the paddle.dataset sibling surface: common file
+    utils, movielens metadata records, wmt dicts, conll05 embedding."""
+    import os
+    import tempfile
+    import numpy as np
+    import pytest
+    import paddle_tpu.dataset as ds
+
+    mi, ui = ds.movielens.movie_info(), ds.movielens.user_info()
+    assert len(mi) == ds.movielens.MAX_MOVIE_ID
+    assert len(ui) == ds.movielens.MAX_USER_ID
+    assert mi[7].value()[0] == 7 and len(ui[3].value()) == 4
+
+    d = ds.wmt16.get_dict("en", 60)
+    assert d["<s>"] == 0 and d["<e>"] == 1 and len(d) == 60
+    rd = ds.wmt16.get_dict("en", 60, reverse=True)
+    assert rd[2] == "<unk>"
+    src, trg = ds.wmt14.get_dict(40)
+    assert src[0] == "<s>" and trg[39].startswith("trg")
+
+    assert len(ds.imdb.build_dict("*", 3)) == ds.imdb.WORD_DICT_SIZE
+
+    emb_path = ds.conll05.get_embedding()
+    emb = np.loadtxt(emb_path)
+    assert emb.shape == (ds.conll05.WORD_DICT_LEN, 32)
+
+    cwd = os.getcwd()
+    with tempfile.TemporaryDirectory() as tmp:
+        os.chdir(tmp)
+        try:
+            ds.common.split(ds.movielens.test(), 300,
+                            suffix="ml-%05d.pickle")
+            files = sorted(os.listdir(tmp))
+            assert len(files) == 4
+            total = sum(1 for _ in ds.common.cluster_files_reader(
+                os.path.join(tmp, "ml-*.pickle"), 1, 0)())
+            assert total == 1024
+            # shard partition: two trainers cover everything exactly once
+            a = sum(1 for _ in ds.common.cluster_files_reader(
+                os.path.join(tmp, "ml-*.pickle"), 2, 0)())
+            b = sum(1 for _ in ds.common.cluster_files_reader(
+                os.path.join(tmp, "ml-*.pickle"), 2, 1)())
+            assert a + b == 1024
+            assert len(ds.common.md5file(files[0])) == 32
+        finally:
+            os.chdir(cwd)
+
+    with pytest.raises(RuntimeError, match="egress"):
+        ds.common.download("http://host/file.tgz", "mod", "md5")

@@ -54,6 +54,14 @@ MAIN_SURFACE = {
     "__init__.py": [],
 }
 
+# sibling packages outside fluid/: reference root (relative to
+# python/paddle) -> candidate paddle_tpu modules; submodule names
+# resolve as attributes of the first candidate
+SIBLING_SURFACE = {
+    "dataset": ["paddle_tpu.dataset"],
+    "reader": ["paddle_tpu.reader"],
+}
+
 # reference module (relative, no .py) -> paddle_tpu module to resolve in.
 # First match by longest prefix.
 MODULE_MAP = {
@@ -281,6 +289,39 @@ def audit(ref_root):
                     rows.append((rel, name, "ported", where))
                 else:
                     todo.append((rel, name, "unresolved (main surface)"))
+
+    # sibling packages (paddle.dataset / paddle.reader)
+    paddle_root = os.path.dirname(ref_root)
+    for pkg, candidates in SIBLING_SURFACE.items():
+        base = os.path.join(paddle_root, pkg)
+        for dp, dns, fns in os.walk(base):
+            dns[:] = [d for d in dns if d not in SKIP_DIRS]
+            for fn in sorted(fns):
+                if not fn.endswith(".py") or fn.startswith("test"):
+                    continue
+                path = os.path.join(dp, fn)
+                rel = pkg + "/" + os.path.relpath(path, base)[:-3]
+                modname = fn[:-3]
+                raw = _public_names_all_only(path)
+                # one reference __all__ entry is malformed
+                # ('test, get_dict' as a single string) — split it
+                names = [n.strip() for entry in raw
+                         for n in entry.split(",")]
+                for name in names:
+                    where = None
+                    for cand in candidates:
+                        if resolve(cand, modname) and hasattr(
+                                getattr(cache[cand], modname), name):
+                            where = f"{cand}.{modname}"
+                            break
+                        if resolve(cand, name):
+                            where = cand
+                            break
+                    if where:
+                        rows.append((rel, name, "ported", where))
+                    else:
+                        todo.append((rel, name,
+                                     "unresolved (sibling surface)"))
     return rows, todo
 
 
