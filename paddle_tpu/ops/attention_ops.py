@@ -164,7 +164,11 @@ def add_position_encoding(ctx):
     b, t, d = x.shape
     half = d // 2
     pos = jnp.arange(t, dtype=jnp.float32)[:, None]
-    div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / half)
+    # reference denominator is (half - 1), not half
+    # (add_position_encoding_op.h:70: pow(10000, k / (half_size - 1)));
+    # half == 1 degenerates to val = position
+    denom = float(max(half - 1, 1))
+    div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / denom)
     enc = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)], axis=-1)
     if enc.shape[-1] < d:  # odd d
         enc = jnp.pad(enc, ((0, 0), (0, d - enc.shape[-1])))

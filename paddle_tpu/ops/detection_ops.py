@@ -29,12 +29,18 @@ def iou_similarity(ctx):
     return {"Out": inter / jnp.maximum(area_x[:, None] + area_y[None, :] - inter, 1e-10)}
 
 
-def _roi_grid(x, rois, pooled_h, pooled_w, spatial_scale, sampling=2, align=True):
-    """Bilinear ROI align core: x NCHW, rois (R,5) [batch_idx,x1,y1,x2,y2]."""
+def _roi_grid(x, rois, pooled_h, pooled_w, spatial_scale, sampling=2,
+              half_pixel=False):
+    """Bilinear ROI align core: x NCHW, rois (R,5) [batch_idx,x1,y1,x2,y2].
+
+    half_pixel=False is the FLUID convention (roi_align_op.h:186-192:
+    corners scale directly, no -0.5 offset, widths clamped to >=1 —
+    torchvision's aligned=False); half_pixel=True is the later
+    Detectron2/paddle-2.x aligned mode, kept for forward compat."""
     n, c, h, w = x.shape
     bidx = rois[:, 0].astype(jnp.int32)
     boxes = rois[:, 1:] * spatial_scale
-    off = 0.5 if align else 0.0
+    off = 0.5 if half_pixel else 0.0
     x1, y1, x2, y2 = boxes[:, 0] - off, boxes[:, 1] - off, boxes[:, 2] - off, boxes[:, 3] - off
     bw = jnp.maximum(x2 - x1, 1.0) / pooled_w
     bh = jnp.maximum(y2 - y1, 1.0) / pooled_h
@@ -85,8 +91,8 @@ def roi_pool(ctx):
     # Max-pool variant approximated with dense sampling + max
     ph = ctx.attr("pooled_height", 1)
     pw = ctx.attr("pooled_width", 1)
-    out = _roi_grid(x, rois, ph, pw, ctx.attr("spatial_scale", 1.0), sampling=2,
-                    align=False)
+    out = _roi_grid(x, rois, ph, pw, ctx.attr("spatial_scale", 1.0),
+                    sampling=2)
     return {"Out": out, "Argmax": jnp.zeros(out.shape, DEVICE_INT)}
 
 
