@@ -637,20 +637,44 @@ def fsp_matrix_op(ctx):
 
 @register("similarity_focus")
 def similarity_focus(ctx):
-    """Per (axis-index) slice: mark the max-position mask across channels
-    (reference: similarity_focus_op) — simplified max-location focus."""
+    """Parity: similarity_focus_op.h:76-105 — for each selected index
+    along `axis`, greedily pick the min(D2, D3) highest-valued cells of
+    that slice such that no two share a row or a column (a greedy
+    bipartite cover in descending value order), and mark the picked
+    positions across the whole axis. TPU-native: the sort-and-scan
+    greedy loop is a lax.scan of masked argmaxes — identical picks
+    (float ties are measure-zero; the reference's unstable sort makes
+    tie order unspecified there too)."""
     x = ctx.in_("X")
     axis = ctx.attr("axis", 1)
     indexes = ctx.attr("indexes", [0])
-    n, c, h, w = x.shape
-    out = jnp.zeros_like(x)
+    xm = jnp.moveaxis(x, axis, 1)                 # (N, A, D2, D3)
+    n, a, d2, d3 = xm.shape
+    k = min(d2, d3)
+
+    def one_slice(sl):                            # (D2, D3) -> 0/1 mask
+        def body(carry, _):
+            used_r, used_c, mask = carry
+            blocked = used_r[:, None] | used_c[None, :]
+            vals = jnp.where(blocked, -jnp.inf, sl)
+            flat = jnp.argmax(vals.reshape(-1))
+            r, c_ = flat // d3, flat % d3
+            used_r = used_r.at[r].set(True)
+            used_c = used_c.at[c_].set(True)
+            mask = mask.at[r, c_].set(1.0)
+            return (used_r, used_c, mask), None
+
+        init = (jnp.zeros(d2, bool), jnp.zeros(d3, bool),
+                jnp.zeros((d2, d3), x.dtype))
+        (_, _, mask), _ = jax.lax.scan(body, init, None, length=k)
+        return mask
+
+    out_m = jnp.zeros_like(xm)
     for idx in indexes:
-        sl = jnp.take(x, idx, axis=axis)          # (N, H, W) if axis=1
-        flat = sl.reshape(n, -1)
-        pos = jnp.argmax(jnp.abs(flat), axis=-1)
-        mask = jax.nn.one_hot(pos, flat.shape[-1]).reshape(sl.shape)
-        out = out + jnp.expand_dims(mask, axis) * jnp.ones_like(x)
-    return {"Out": jnp.minimum(out, 1.0)}
+        masks = jax.vmap(one_slice)(xm[:, idx])   # (N, D2, D3)
+        out_m = out_m + masks[:, None]
+    out = jnp.moveaxis(jnp.minimum(out_m, 1.0), 1, axis)
+    return {"Out": out}
 
 
 @register("deformable_conv", "deformable_conv_v1")

@@ -469,12 +469,23 @@ def crop_tensor(ctx):
 
 @register("hash")
 def hash_op(ctx):
-    """Multiplicative mod-space hashing of int ids (reference: hash_op,
-    used by sparse CTR feature crossing)."""
-    x = ctx.in_("X").astype(jnp.int32)
+    """Mod-space hashing of int ids (reference: hash_op, sparse CTR
+    feature crossing). DOCUMENTED DIVERGENCE: the reference hashes the
+    raw int64 bytes with XXH64 per seed; this kernel uses 32-bit
+    multiplicative hashing (golden-ratio odd constant) — same
+    determinism, same [0, mod_by) bucket contract, different bucket
+    VALUES, so fluid-trained embeddings keyed by reference hash buckets
+    cannot be ported bit-for-bit through this op (retrain or remap
+    buckets). Id width is bounded by the int64 policy (MIGRATION.md
+    'Integer dtypes': device ints are int32, the feed boundary errors
+    past 2^31), so no two REACHABLE ids collide by truncation."""
+    x = ctx.in_("X")
     num_hash = ctx.attr("num_hash", 1)
     mod_by = ctx.attr("mod_by", 100000007)
-    seeds = jnp.arange(1, num_hash + 1, dtype=jnp.uint32) * 0x9E3779B1
+    # the golden-ratio constant must be a uint32 ARRAY scalar: as a bare
+    # python literal it exceeds int32 and jax's weak typing overflows
+    seeds = (jnp.arange(1, num_hash + 1, dtype=jnp.uint32)
+             * jnp.uint32(0x9E3779B1))
     h = (x[..., None].astype(jnp.uint32) * seeds) % jnp.uint32(mod_by)
     return {"Out": h.astype(jnp.int32).reshape(x.shape[:-1] + (num_hash * x.shape[-1],))}
 

@@ -229,3 +229,92 @@ def test_anchor_generator_spot_values():
                                [16.0, 16.0, 16.0, 16.0], rtol=1e-5)
     var = np.asarray(out["Variances"])
     np.testing.assert_allclose(var[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_similarity_focus_matches_reference_loop():
+    """Golden: similarity_focus_op.h:76-105 transcribed (greedy
+    row/column-exclusive cover in descending value order)."""
+    rng = np.random.RandomState(6)
+    x = rng.randn(2, 3, 4, 5).astype("float32")
+    got = np.asarray(_run_kernel("similarity_focus", {"X": x},
+                                 {"axis": 1, "indexes": [0, 2]})["Out"])
+    want = np.zeros_like(x)
+    n, c, h, w = x.shape
+    for i in range(n):
+        for index in (0, 2):
+            cells = sorted(
+                ((x[i, index, j, k], j * w + k)
+                 for j in range(h) for k in range(w)),
+                key=lambda p: -p[0])
+            tag2, tag3 = [False] * h, [False] * w
+            tag_num = 0
+            for _, flat in cells:
+                j, k = flat // w, flat % w
+                if tag2[j] or tag3[k]:
+                    continue
+                tag2[j] = tag3[k] = True
+                tag_num += 1
+                want[i, :, j, k] = 1
+                if tag_num == min(h, w):
+                    break
+    np.testing.assert_allclose(got, want)
+
+
+def test_target_assign_gather_and_weights():
+    x = np.arange(2 * 3 * 2, dtype=np.float32).reshape(2, 3, 2)
+    match = np.array([[1, -1, 2, 0], [-1, 0, -1, 1]], np.int64)
+    out = _run_kernel("target_assign", {"X": x, "MatchIndices": match},
+                      {"mismatch_value": 7.0})
+    got, wt = np.asarray(out["Out"]), np.asarray(out["OutWeight"])
+    assert got.shape == (2, 4, 2)
+    np.testing.assert_allclose(got[0, 0], x[0, 1])
+    np.testing.assert_allclose(got[0, 1], [7.0, 7.0])
+    np.testing.assert_allclose(got[1, 3], x[1, 1])
+    np.testing.assert_allclose(wt.reshape(2, 4),
+                               (match >= 0).astype(np.float32))
+
+
+def test_ctc_align_merges_and_drops():
+    # argmax sequence: [a a blank b b] -> [a b]
+    b, t, c = 1, 5, 4
+    probs = np.zeros((b, t, c), np.float32)
+    for step, cls in enumerate([2, 2, 0, 3, 3]):
+        probs[0, step, cls] = 1.0
+    out = _run_kernel("ctc_align", {"Input": probs}, {"blank": 0})
+    ids = np.asarray(out["Output"])[0]
+    assert list(ids[:2]) == [2, 3] and (ids[2:] == -1).all()
+    assert int(np.asarray(out["OutputLength"]).reshape(-1)[0]) == 2
+
+
+def test_fsp_matrix_formula():
+    rng = np.random.RandomState(7)
+    a = rng.randn(2, 3, 4, 4).astype("float32")
+    b = rng.randn(2, 5, 4, 4).astype("float32")
+    got = np.asarray(_run_kernel("fsp", {"X": a, "Y": b})["Out"])
+    want = np.einsum("nahw,nbhw->nab", a, b) / 16.0
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_hash_contract():
+    x = np.array([[1], [2], [100000]], np.int64)
+    out = np.asarray(_run_kernel("hash", {"X": x},
+                                 {"num_hash": 4, "mod_by": 1000})["Out"])
+    assert out.shape == (3, 4)
+    assert (out >= 0).all() and (out < 1000).all()
+    out2 = np.asarray(_run_kernel("hash", {"X": x},
+                                  {"num_hash": 4, "mod_by": 1000})["Out"])
+    np.testing.assert_array_equal(out, out2)      # deterministic
+    assert len({tuple(r) for r in out}) == 3      # ids separate
+
+
+def test_spectral_norm_power_iteration():
+    rng = np.random.RandomState(8)
+    w = rng.randn(6, 4).astype("float32")
+    u = rng.randn(6).astype("float32")
+    v = rng.randn(4).astype("float32")
+    got = np.asarray(_run_kernel(
+        "spectral_norm", {"Weight": w, "U": u, "V": v},
+        {"dim": 0, "power_iters": 30, "eps": 1e-12})["Out"])
+    # 30 power iterations converge to the true largest singular value
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    np.testing.assert_allclose(got, w / sigma, rtol=1e-4, atol=1e-5)
