@@ -283,9 +283,15 @@ class SpectralNorm(Layer):
         self._attrs = {"dim": dim, "power_iters": power_iters, "eps": eps}
 
     def forward(self, weight):
-        return run_op_eager("spectral_norm",
-                            {"Weight": weight, "U": self._u, "V": self._v},
-                            dict(self._attrs))
+        from .functional import run_op_eager_multi
+        outs = run_op_eager_multi(
+            "spectral_norm",
+            {"Weight": weight, "U": self._u, "V": self._v},
+            dict(self._attrs), ["Out", "UOut", "VOut"])
+        # persist the power-iteration state (reference mutates U/V)
+        self._u.value = outs["UOut"].value
+        self._v.value = outs["VOut"].value
+        return outs["Out"]
 
 
 class PRelu(Layer):

@@ -427,8 +427,10 @@ def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
     u.trainable = False
     v.trainable = False
     out = helper.create_variable_for_type_inference(weight.dtype, weight.shape)
+    # U/V update in place each step (the reference kernel mutates its
+    # U/V inputs), so the power iteration converges across steps
     helper.append_op("spectral_norm", {"Weight": weight, "U": u, "V": v},
-                     {"Out": out},
+                     {"Out": out, "UOut": u, "VOut": v},
                      {"dim": dim, "power_iters": power_iters, "eps": eps})
     return out
 

@@ -349,8 +349,15 @@ def spectral_norm(ctx):
         return (u_, v_)
 
     u2, v2 = lax.fori_loop(0, power_iters, body, (u.reshape(-1), v.reshape(-1)))
+    # the reference updates U/V in place and treats them as constants in
+    # the gradient (buffers, not parameters) — stop_gradient matches
+    # that, and UOut/VOut let the layers persist the iteration state so
+    # power_iters=1 converges ACROSS steps like fluid, instead of
+    # re-estimating from the initial vectors every call
+    u2 = jax.lax.stop_gradient(u2)
+    v2 = jax.lax.stop_gradient(v2)
     sigma = u2 @ wm @ v2
-    return {"Out": w / sigma}
+    return {"Out": w / sigma, "UOut": u2, "VOut": v2}
 
 
 @register("lrn")

@@ -166,3 +166,19 @@ def test_tracer_and_generated_layer_fns():
                                                 np.float32)},
                       fetch_list=[out])
     assert (np.asarray(got[0]) >= 0).all()
+
+
+def test_spectral_norm_module_state_converges():
+    import numpy as np
+    from paddle_tpu import dygraph
+
+    rng = np.random.RandomState(10)
+    w = rng.randn(5, 3).astype("float32")
+    with dygraph.guard():
+        sn = dygraph.SpectralNorm([5, 3], dim=0, power_iters=1)
+        wv = dygraph.to_variable(w)
+        for _ in range(25):
+            out = sn(wv)
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    np.testing.assert_allclose(np.asarray(out.value), w / sigma,
+                               rtol=1e-3, atol=1e-4)

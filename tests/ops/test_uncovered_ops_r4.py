@@ -318,3 +318,29 @@ def test_spectral_norm_power_iteration():
     # 30 power iterations converge to the true largest singular value
     sigma = np.linalg.svd(w, compute_uv=False)[0]
     np.testing.assert_allclose(got, w / sigma, rtol=1e-4, atol=1e-5)
+
+
+def test_spectral_norm_state_persists_across_steps():
+    """The reference mutates U/V in place, so power_iters=1 CONVERGES
+    across calls; the static layer and dygraph module must persist the
+    iteration state (UOut/VOut), not re-estimate from the initial
+    vectors every step."""
+    rng = np.random.RandomState(9)
+    w = rng.randn(6, 4).astype("float32")
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        wv = layers.data("w", [6, 4], append_batch_size=False)
+        out = layers.spectral_norm(wv, dim=0, power_iters=1)
+    exe = fluid.Executor()
+    scope = Scope()
+    with scope_guard(scope):
+        exe.run(startup)
+        outs = [np.asarray(exe.run(main, feed={"w": w},
+                                   fetch_list=[out])[0])
+                for _ in range(25)]
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    # after 25 single-iteration steps the persisted state has converged
+    np.testing.assert_allclose(outs[-1], w / sigma, rtol=1e-3, atol=1e-4)
+    # and the estimate moved between the first and last step
+    assert np.abs(outs[0] - outs[-1]).max() > 0 or np.allclose(
+        outs[0], w / sigma, rtol=1e-3)
