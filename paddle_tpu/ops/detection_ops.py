@@ -735,8 +735,13 @@ def distribute_fpn_proposals(ctx):
     refer_scale = ctx.attr("refer_scale", 224)
     w = rois[:, 2] - rois[:, 0]
     h = rois[:, 3] - rois[:, 1]
-    scale = jnp.sqrt(jnp.maximum(w * h, 1e-12))
-    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-8)) + refer_level
+    # reference area is PIXEL-INCLUSIVE ((w+1)*(h+1), BBoxArea with
+    # normalized=false) and 0 for degenerate boxes
+    # (distribute_fpn_proposals_op.h:85, bbox_util.h:33) — raw w*h
+    # routed boundary boxes one level low
+    area = jnp.where((w < 0) | (h < 0), 0.0, (w + 1.0) * (h + 1.0))
+    scale = jnp.sqrt(area)
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-6)) + refer_level
     lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
     outs, idxs = [], []
     for L in range(min_level, max_level + 1):

@@ -30,6 +30,10 @@ class _Ctx:
         v = self._ins.get(slot, default)
         return v
 
+    def in_list(self, slot):
+        v = self._ins.get(slot, [])
+        return v if isinstance(v, list) else [v]
+
     def has_in(self, slot):
         return slot in self._ins
 
@@ -39,8 +43,15 @@ class _Ctx:
 
 def _run_kernel(op, ins, attrs=None, **kw):
     import jax.numpy as jnp
-    ins = {k: (jnp.asarray(v) if v is not None else None)
-           for k, v in ins.items()}
+
+    def conv(v):
+        if v is None:
+            return None
+        if isinstance(v, (list, tuple)):
+            return [jnp.asarray(e) for e in v]
+        return jnp.asarray(v)
+
+    ins = {k: conv(v) for k, v in ins.items()}
     return _REGISTRY[op](_Ctx(ins, attrs, **kw))
 
 
@@ -344,3 +355,68 @@ def test_spectral_norm_state_persists_across_steps():
     # and the estimate moved between the first and last step
     assert np.abs(outs[0] - outs[-1]).max() > 0 or np.allclose(
         outs[0], w / sigma, rtol=1e-3)
+
+
+def test_distribute_fpn_proposals_levels():
+    """Golden: distribute_fpn_proposals_op.h:85-87 — pixel-inclusive
+    sqrt-area routed by floor(log2(scale/refer + 1e-6)) + refer_level,
+    clamped to [min, max]."""
+    # areas chosen to straddle level boundaries: scale 111.5 (below
+    # 112 = 224/2 boundary), 112.5, 223.5, 224.5, 448.5, plus a huge
+    # and a degenerate box
+    def box(side):
+        return [0.0, 0.0, side - 1.0, side - 1.0]   # inclusive w = side
+
+    rois = np.array([box(111.5), box(112.5), box(223.5), box(224.5),
+                     box(448.5), box(4000.0),
+                     [5.0, 5.0, 2.0, 2.0]], np.float32)
+    out = _run_kernel("distribute_fpn_proposals", {"FpnRois": rois},
+                      {"min_level": 2, "max_level": 5, "refer_level": 4,
+                       "refer_scale": 224})
+    nums = [int(np.asarray(n)[0]) for n in out["MultiLevelRoIsNum"]]
+    want_lvl = []
+    for r in rois:
+        w_, h_ = r[2] - r[0], r[3] - r[1]
+        area = 0.0 if (w_ < 0 or h_ < 0) else (w_ + 1) * (h_ + 1)
+        lvl = int(np.floor(np.log2(np.sqrt(area) / 224 + 1e-6)) + 4)
+        want_lvl.append(min(max(lvl, 2), 5))
+    for L, n in zip(range(2, 6), nums):
+        assert n == want_lvl.count(L), (L, nums, want_lvl)
+    # restore index is a stable sort by level
+    order = np.asarray(out["RestoreIndex"]).reshape(-1)
+    lv = np.asarray(want_lvl)
+    assert (np.diff(lv[order]) >= 0).all()
+
+
+def test_collect_fpn_proposals_topk():
+    r2 = np.array([[0, 0, 10, 10], [1, 1, 5, 5]], np.float32)
+    r3 = np.array([[2, 2, 8, 8]], np.float32)
+    s2 = np.array([0.9, 0.1], np.float32)
+    s3 = np.array([0.5], np.float32)
+    out = _run_kernel("collect_fpn_proposals",
+                      {"MultiLevelRois": [r2, r3],
+                       "MultiLevelScores": [s2, s3]},
+                      {"post_nms_topN": 2})
+    got = np.asarray(out["FpnRois"])
+    np.testing.assert_allclose(got[0], r2[0])      # score 0.9
+    np.testing.assert_allclose(got[1], r3[0])      # score 0.5
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    """Property: zero offsets reduce deformable conv to plain conv."""
+    import jax.numpy as jnp
+    rng = np.random.RandomState(11)
+    x = rng.randn(1, 3, 8, 8).astype("float32")
+    wgt = rng.randn(4, 3, 3, 3).astype("float32")
+    offs = np.zeros((1, 2 * 3 * 3, 8, 8), np.float32)
+    mask = np.ones((1, 3 * 3, 8, 8), np.float32)
+    got = np.asarray(_run_kernel(
+        "deformable_conv",
+        {"Input": x, "Offset": offs, "Mask": mask, "Filter": wgt},
+        {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+         "groups": 1, "deformable_groups": 1, "im2col_step": 1})["Output"])
+    want = np.asarray(_run_kernel(
+        "conv2d", {"Input": x, "Filter": wgt},
+        {"strides": [1, 1], "paddings": [1, 1],
+         "dilations": [1, 1], "groups": 1})["Output"])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
