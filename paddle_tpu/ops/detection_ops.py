@@ -98,18 +98,46 @@ def roi_pool(ctx):
 
 @register("psroi_pool")
 def psroi_pool(ctx):
-    x = ctx.in_("X")
-    rois = ctx.in_("ROIs")
-    out_c = ctx.attr("output_channels")
-    ph = ctx.attr("pooled_height")
-    pw = ctx.attr("pooled_width")
-    pooled = _roi_grid(x, rois, ph, pw, ctx.attr("spatial_scale", 1.0))
-    r = pooled.shape[0]
-    pooled = pooled.reshape(r, out_c, ph, pw, ph, pw)
-    idx_h = jnp.arange(ph)
-    idx_w = jnp.arange(pw)
-    out = pooled[:, :, idx_h[:, None], idx_w[None, :], idx_h[:, None], idx_w[None, :]]
-    return {"Out": out.reshape(r, out_c, ph, pw)}
+    """Parity: psroi_pool_op.h:84-135 — position-sensitive RoI AVERAGE
+    pooling over integer bins: corners round (+1 on the far edge) then
+    scale, widths clamp to >=0.1, bin (i, j) of category c averages
+    input channel (c*ph + i)*pw + j over [floor(start), ceil(end)) cells
+    (empty bins emit 0). TPU-native: the per-bin integer loops become
+    row/column interval masks contracted in one einsum."""
+    x = ctx.in_("X")                    # (N, C, H, W)
+    rois = ctx.in_("ROIs")              # (R, 5) [batch, x1, y1, x2, y2]
+    out_c = _to_int(ctx.attr("output_channels"))
+    ph = _to_int(ctx.attr("pooled_height"))
+    pw = _to_int(ctx.attr("pooled_width"))
+    scale = ctx.attr("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+    bidx = rois[:, 0].astype(jnp.int32)
+    xs = jnp.round(rois[:, 1]) * scale
+    ys = jnp.round(rois[:, 2]) * scale
+    xe = (jnp.round(rois[:, 3]) + 1.0) * scale
+    ye = (jnp.round(rois[:, 4]) + 1.0) * scale
+    rw = jnp.maximum(xe - xs, 0.1)
+    rh = jnp.maximum(ye - ys, 0.1)
+    bh = rh / ph                        # (R,)
+    bw = rw / pw
+
+    def interval_mask(start, bsize, bins, size):
+        # (R, bins, size) 0/1 mask of [floor(i*b+s), ceil((i+1)*b+s))
+        i = jnp.arange(bins, dtype=x.dtype)
+        lo = jnp.clip(jnp.floor(i[None] * bsize[:, None] + start[:, None]),
+                      0, size)
+        hi = jnp.clip(jnp.ceil((i[None] + 1) * bsize[:, None]
+                               + start[:, None]), 0, size)
+        cells = jnp.arange(size, dtype=x.dtype)
+        return ((cells[None, None] >= lo[..., None])
+                & (cells[None, None] < hi[..., None])).astype(x.dtype)
+
+    rmask = interval_mask(ys, bh, ph, h)        # (R, ph, H)
+    cmask = interval_mask(xs, bw, pw, w)        # (R, pw, W)
+    xg = x[bidx].reshape(-1, out_c, ph, pw, h, w)
+    sums = jnp.einsum("rcijhw,rih,rjw->rcij", xg, rmask, cmask)
+    area = jnp.einsum("rih,rjw->rij", rmask, cmask)[:, None]
+    return {"Out": jnp.where(area > 0, sums / jnp.maximum(area, 1.0), 0.0)}
 
 
 @register("box_coder")

@@ -420,3 +420,40 @@ def test_deformable_conv_zero_offset_equals_conv():
         {"strides": [1, 1], "paddings": [1, 1],
          "dilations": [1, 1], "groups": 1})["Output"])
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_psroi_pool_matches_reference_loop():
+    """Golden: psroi_pool_op.h:84-135 transcribed."""
+    rng = np.random.RandomState(12)
+    out_c, ph, pw = 2, 3, 3
+    x = rng.randn(2, out_c * ph * pw, 10, 10).astype("float32")
+    rois = np.array([[0, 1.2, 0.7, 8.6, 9.1],
+                     [1, 0.0, 0.0, 9.0, 9.0],
+                     [0, 3.0, 3.0, 3.4, 3.4]], np.float32)
+    scale = 0.8
+    got = np.asarray(_run_kernel(
+        "psroi_pool", {"X": x, "ROIs": rois},
+        {"output_channels": out_c, "pooled_height": ph,
+         "pooled_width": pw, "spatial_scale": scale})["Out"])
+    H = W = 10
+    want = np.zeros((3, out_c, ph, pw), np.float32)
+    for r in range(3):
+        b = int(rois[r, 0])
+        xs = round(rois[r, 1]) * scale
+        ys = round(rois[r, 2]) * scale
+        xe = (round(rois[r, 3]) + 1.0) * scale
+        ye = (round(rois[r, 4]) + 1.0) * scale
+        rw, rh = max(xe - xs, 0.1), max(ye - ys, 0.1)
+        bh, bw = rh / ph, rw / pw
+        for cch in range(out_c):
+            for i in range(ph):
+                for j in range(pw):
+                    hs = min(max(int(np.floor(i * bh + ys)), 0), H)
+                    he = min(max(int(np.ceil((i + 1) * bh + ys)), 0), H)
+                    ws = min(max(int(np.floor(j * bw + xs)), 0), W)
+                    we = min(max(int(np.ceil((j + 1) * bw + xs)), 0), W)
+                    ch = (cch * ph + i) * pw + j
+                    if he <= hs or we <= ws:
+                        continue
+                    want[r, cch, i, j] = x[b, ch, hs:he, ws:we].mean()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
