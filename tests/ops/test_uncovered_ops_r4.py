@@ -457,3 +457,39 @@ def test_psroi_pool_matches_reference_loop():
                         continue
                     want[r, cch, i, j] = x[b, ch, hs:he, ws:we].mean()
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_spp_levels_and_values():
+    """spp_op: level i adaptively pools 2^i x 2^i bins; concat flattens
+    per level. Level 0 must equal global pooling."""
+    rng = np.random.RandomState(13)
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    out = np.asarray(_run_kernel("spp", {"X": x},
+                                 {"pyramid_height": 2,
+                                  "pooling_type": "max"})["Out"])
+    assert out.shape == (2, 3 * (1 + 4))
+    np.testing.assert_allclose(out[:, :3], x.max(axis=(2, 3)), rtol=1e-6)
+    # level 1, bin (0,0) = max over the top-left quadrant
+    np.testing.assert_allclose(out[:, 3], x[:, 0, :4, :4].max(axis=(1, 2)),
+                               rtol=1e-6)
+
+
+def test_retinanet_detection_output_basic():
+    """Two well-separated boxes, one above threshold per class: sigmoid
+    scoring (no background channel), per-class NMS keeps both."""
+    b1 = np.array([[[0., 0., 10., 10.], [20., 20., 30., 30.]]], np.float32)
+    logits = np.full((1, 2, 3), -6.0, np.float32)
+    logits[0, 0, 1] = 3.0           # box 0 -> class 1 (sigmoid ~0.95)
+    logits[0, 1, 2] = 2.0           # box 1 -> class 2 (~0.88)
+    out = np.asarray(_run_kernel(
+        "retinanet_detection_output",
+        {"BBoxes": [b1], "Scores": [logits]},
+        {"score_threshold": 0.05, "nms_threshold": 0.3,
+         "nms_top_k": 10, "keep_top_k": 5})["Out"])
+    kept = out[out[..., 0] >= 0].reshape(-1, 6)
+    assert kept.shape[0] == 2
+    by_class = {int(r[0]): r for r in kept}
+    assert set(by_class) == {1, 2}
+    np.testing.assert_allclose(by_class[1][1], 1 / (1 + np.exp(-3.0)),
+                               rtol=1e-4)
+    np.testing.assert_allclose(by_class[1][2:], [0, 0, 10, 10], atol=1e-4)

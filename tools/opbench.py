@@ -51,7 +51,7 @@ def _time(fn, *args, steps=20):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--ops", default="matmul,conv,flash,norm,embedding")
+    ap.add_argument("--ops", default="matmul,conv,flash,norm,embedding,rnn")
     ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--tiny", action="store_true",
@@ -119,6 +119,44 @@ def main():
                 continue
             flops = 2 * 2 * b * h * t * t * d // 2   # causal half
             report(f"flash b{b} h{h} t{t}", dt, flops)
+
+    if "rnn" in suites:
+        # the contrib basic_gru/basic_lstm scan kernels: hoisted input
+        # projection (one big MXU matmul) + (H, kH) recurrent matmuls
+        # inside one XLA While — reported as recurrent-matmul TFLOP/s
+        from paddle_tpu.ops import _REGISTRY as _ops
+
+        class _RCtx:
+            def __init__(self, ins, attrs):
+                self._i, self._a = ins, attrs
+                self.is_test = True
+
+            def in_(self, s, d=None):
+                return self._i.get(s, d)
+
+            def has_in(self, s):
+                return s in self._i
+
+            def attr(self, n, d=None):
+                return self._a.get(n, d)
+
+        b, t, d, h = (2, 32, 32, 64) if args.tiny else (32, 512, 512, 1024)
+        x = jax.random.normal(key, (b, t, d), jnp.float32)
+        gw = jax.random.normal(key, (d + h, 2 * h), jnp.float32) * 0.05
+        cw = jax.random.normal(key, (d + h, h), jnp.float32) * 0.05
+        lw = jax.random.normal(key, (d + h, 4 * h), jnp.float32) * 0.05
+        gru = jax.jit(lambda x: _ops["basic_gru"](_RCtx(
+            {"Input": x, "GateW": gw, "GateB": jnp.zeros(2 * h),
+             "CandW": cw, "CandB": jnp.zeros(h)}, {}))["Hidden"])
+        dt = _time(gru, x, steps=args.steps)
+        flops = 2 * b * t * ((d + h) * 3 * h)
+        report(f"basic_gru b{b} t{t} h{h}", dt, flops)
+        lstm = jax.jit(lambda x: _ops["basic_lstm"](_RCtx(
+            {"Input": x, "Weight": lw, "Bias": jnp.zeros(4 * h)},
+            {}))["Hidden"])
+        dt = _time(lstm, x, steps=args.steps)
+        report(f"basic_lstm b{b} t{t} h{h}", dt,
+               2 * b * t * ((d + h) * 4 * h))
 
     if "norm" in suites:
         nrm = (256, 64) if args.tiny else (8192, 1024)
