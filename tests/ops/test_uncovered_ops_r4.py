@@ -493,3 +493,59 @@ def test_retinanet_detection_output_basic():
     np.testing.assert_allclose(by_class[1][1], 1 / (1 + np.exp(-3.0)),
                                rtol=1e-4)
     np.testing.assert_allclose(by_class[1][2:], [0, 0, 10, 10], atol=1e-4)
+
+
+def test_filter_by_instag_masks_rows():
+    ins = np.arange(8, dtype=np.float32).reshape(4, 2)
+    ins_tag = np.array([[1, 0], [2, 3], [9, 0], [3, 0]], np.int64)
+    filt = np.array([1, 3], np.int64)
+    out = _run_kernel("filter_by_instag",
+                      {"Ins": ins, "Ins_tag": ins_tag,
+                       "Filter_tag": filt})
+    got = np.asarray(out["Out"])
+    lw = np.asarray(out["LossWeight"]).reshape(-1)
+    np.testing.assert_allclose(lw, [1, 1, 0, 1])
+    np.testing.assert_allclose(got[2], [0, 0])       # filtered row zeroed
+    np.testing.assert_allclose(got[0], ins[0])
+
+
+def test_ssd_loss_prefers_perfect_predictions():
+    """Property: exact encoded-target localization + confident correct
+    classes must score far below random predictions (the simplified
+    static-shape ssd_loss is documented; this pins its useful-gradient
+    property and the matching/encoding conventions)."""
+    prior = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                      [50, 50, 60, 60], [5, 5, 15, 15]], np.float32)
+    gt_box = np.array([[[0, 0, 10, 10], [20, 20, 30, 30]]], np.float32)
+    gt_label = np.array([[1, 2]], np.int64)
+    M, C = 4, 3
+
+    # exact center-size encoded targets for the two matched priors
+    def encode(g, p):
+        pw, phh = p[2] - p[0], p[3] - p[1]
+        pcx, pcy = (p[0] + p[2]) / 2, (p[1] + p[3]) / 2
+        gw, gh = g[2] - g[0], g[3] - g[1]
+        gcx, gcy = (g[0] + g[2]) / 2, (g[1] + g[3]) / 2
+        return [(gcx - pcx) / pw, (gcy - pcy) / phh,
+                np.log(gw / pw), np.log(gh / phh)]
+
+    loc = np.zeros((1, M, 4), np.float32)
+    loc[0, 0] = encode(gt_box[0, 0], prior[0])
+    loc[0, 1] = encode(gt_box[0, 1], prior[1])
+    conf = np.full((1, M, C), -4.0, np.float32)
+    conf[0, 0, 1] = 6.0     # prior 0 -> class 1 (IoU 1.0 with gt 0)
+    conf[0, 1, 2] = 6.0     # prior 1 -> class 2 (IoU 1.0 with gt 1)
+    conf[0, 2, 0] = 6.0     # prior 2 -> background (no overlap)
+    conf[0, 3, 0] = 6.0     # prior 3: IoU 0.14 < 0.5 -> also background
+
+    good = float(np.asarray(_run_kernel(
+        "ssd_loss", {"Location": loc, "Confidence": conf,
+                     "GtBox": gt_box, "GtLabel": gt_label,
+                     "PriorBox": prior}, {})["Out"]).reshape(-1)[0])
+    rng = np.random.RandomState(14)
+    bad = float(np.asarray(_run_kernel(
+        "ssd_loss", {"Location": rng.randn(1, M, 4).astype("float32"),
+                     "Confidence": rng.randn(1, M, C).astype("float32"),
+                     "GtBox": gt_box, "GtLabel": gt_label,
+                     "PriorBox": prior}, {})["Out"]).reshape(-1)[0])
+    assert good < 0.1 * bad, (good, bad)
