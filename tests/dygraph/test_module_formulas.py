@@ -1,0 +1,74 @@
+"""Dygraph module formula sweep (r4): PRelu / BilinearTensorProduct /
+LayerNorm / GroupNorm / Embedding(padding_idx) vs torch or numpy goldens
+(parity: python/paddle/fluid/dygraph/nn.py)."""
+
+import numpy as np
+import torch
+
+import paddle_tpu as fluid
+from paddle_tpu import dygraph
+from paddle_tpu.dygraph import nn as dnn
+
+
+def test_prelu_modes():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 4, 4).astype("float32")
+    with dygraph.guard():
+        xv = dygraph.to_variable(x)
+        p_all = dnn.PRelu(mode="all")
+        out = np.asarray(p_all(xv).value)
+        np.testing.assert_allclose(out, np.where(x > 0, x, 0.25 * x),
+                                   rtol=1e-6)
+        p_ch = dnn.PRelu(mode="channel", channel=3)
+        a = np.array([0.1, 0.5, 2.0], np.float32)
+        p_ch.weight.value = a
+        out = np.asarray(p_ch(xv).value)
+        want = np.where(x > 0, x, a[None, :, None, None] * x)
+        np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def test_bilinear_tensor_product_matches_torch():
+    rng = np.random.RandomState(1)
+    b, d1, d2, k = 4, 3, 5, 2
+    x = rng.randn(b, d1).astype("float32")
+    y = rng.randn(b, d2).astype("float32")
+    with dygraph.guard():
+        m = dnn.BilinearTensorProduct(d1, d2, k)
+        w = np.asarray(m.weight.value)
+        bias = np.asarray(m.bias.value)
+        out = np.asarray(m(dygraph.to_variable(x),
+                           dygraph.to_variable(y)).value)
+    tb = torch.nn.Bilinear(d1, d2, k)
+    with torch.no_grad():
+        tb.weight.copy_(torch.tensor(w))
+        tb.bias.copy_(torch.tensor(bias))
+    want = tb(torch.tensor(x), torch.tensor(y)).detach().numpy()
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_layernorm_groupnorm_match_torch():
+    rng = np.random.RandomState(2)
+    x = rng.randn(3, 8).astype("float32") * 2
+    with dygraph.guard():
+        ln = dnn.LayerNorm(8)
+        out = np.asarray(ln(dygraph.to_variable(x)).value)
+    want = torch.nn.functional.layer_norm(
+        torch.tensor(x), (8,)).numpy()
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+    xg = rng.randn(2, 6, 4, 4).astype("float32")
+    with dygraph.guard():
+        gn = dnn.GroupNorm(channels=6, groups=3)
+        outg = np.asarray(gn(dygraph.to_variable(xg)).value)
+    wantg = torch.nn.functional.group_norm(torch.tensor(xg), 3).numpy()
+    np.testing.assert_allclose(outg, wantg, rtol=1e-4, atol=1e-4)
+
+
+def test_embedding_padding_idx_zero_row():
+    with dygraph.guard():
+        emb = dnn.Embedding(size=(10, 4), padding_idx=0)
+        ids = dygraph.to_variable(np.array([[0], [3], [0]], np.int64))
+        out = np.asarray(emb(ids).value).reshape(3, 4)
+    np.testing.assert_allclose(out[0], np.zeros(4), atol=1e-7)
+    np.testing.assert_allclose(out[2], np.zeros(4), atol=1e-7)
+    assert np.abs(out[1]).sum() > 0
