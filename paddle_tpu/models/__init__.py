@@ -18,6 +18,7 @@ from . import recommender
 from . import lstm_text
 from . import transformer
 from . import bert
+from . import gpt
 from . import ernie
 from . import deepfm
 from . import gan

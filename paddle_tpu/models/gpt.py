@@ -1,0 +1,188 @@
+"""GPT-style decoder-only language model (zoo extension).
+
+The 1.5 book/models set stops at BERT/ERNIE encoders; this adds the
+decoder-only family the same components support: pre-norm causal
+transformer blocks (`layers.multi_head_attention(causal=True)` rides
+the Pallas flash kernel / ring attention like every attention here),
+weight-tied LM head, and KV-cache generation through
+`inference/decoding.py`.
+
+Train on the static-graph path (one fused XLA step); generate with
+`build_kv_step` + `greedy_decode` on the SAME scope parameters — the
+cached per-token forward is the training math re-expressed for O(1)
+per-step decode, and `tests/models/test_gpt.py` pins the two paths
+token-for-token.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import layers
+from ..core import framework
+from ..core.param_attr import ParamAttr
+
+
+class GPTConfig:
+    vocab_size = 32000
+    hidden_size = 768
+    num_layers = 12
+    num_heads = 12
+    inner_size = 3072
+    max_position = 1024
+    dropout = 0.1
+
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+def gpt_tiny():
+    """4-layer/128-wide config for tests."""
+    return GPTConfig(vocab_size=256, hidden_size=128, num_layers=4,
+                     num_heads=4, inner_size=512, max_position=128,
+                     dropout=0.0)
+
+
+def _block(x, cfg, idx):
+    """Pre-norm GPT-2 block: x + attn(ln(x)); x + ffn(ln(x))."""
+    h = layers.layer_norm(x, begin_norm_axis=2,
+                          param_attr=ParamAttr(name=f"gpt{idx}_ln1_s"),
+                          bias_attr=ParamAttr(name=f"gpt{idx}_ln1_b"))
+    a = layers.multi_head_attention(
+        h, num_heads=cfg.num_heads, d_model=cfg.hidden_size, causal=True,
+        dropout_rate=cfg.dropout,
+        param_attr=ParamAttr(name=f"gpt{idx}_attn"),
+        bias_attr=ParamAttr(name=f"gpt{idx}_attn"))
+    x = layers.elementwise_add(x, a)
+    h = layers.layer_norm(x, begin_norm_axis=2,
+                          param_attr=ParamAttr(name=f"gpt{idx}_ln2_s"),
+                          bias_attr=ParamAttr(name=f"gpt{idx}_ln2_b"))
+    f = layers.fc(h, size=cfg.inner_size, num_flatten_dims=2, act="gelu",
+                  param_attr=ParamAttr(name=f"gpt{idx}_ffn0_w"),
+                  bias_attr=ParamAttr(name=f"gpt{idx}_ffn0_b"))
+    f = layers.fc(f, size=cfg.hidden_size, num_flatten_dims=2,
+                  param_attr=ParamAttr(name=f"gpt{idx}_ffn1_w"),
+                  bias_attr=ParamAttr(name=f"gpt{idx}_ffn1_b"))
+    if cfg.dropout:
+        f = layers.dropout(f, cfg.dropout)
+    return layers.elementwise_add(x, f)
+
+
+def gpt_logits(tokens, cfg, seq_len):
+    """(B, T) int tokens -> (B, T, V) next-token logits (tied head)."""
+    emb = layers.embedding(tokens, size=[cfg.vocab_size, cfg.hidden_size],
+                           param_attr=ParamAttr(name="gpt_word_emb"))
+    pos_table = layers.create_parameter(
+        [cfg.max_position, cfg.hidden_size], "float32",
+        attr=ParamAttr(name="gpt_pos_emb"))
+    pos = layers.slice(pos_table, axes=[0], starts=[0], ends=[seq_len])
+    x = layers.elementwise_add(emb, pos)
+    if cfg.dropout:
+        x = layers.dropout(x, cfg.dropout)
+    for i in range(cfg.num_layers):
+        x = _block(x, cfg, i)
+    x = layers.layer_norm(x, begin_norm_axis=2,
+                          param_attr=ParamAttr(name="gpt_lnf_s"),
+                          bias_attr=ParamAttr(name="gpt_lnf_b"))
+    word_emb = framework.default_main_program().global_block().var(
+        "gpt_word_emb")
+    return layers.matmul(x, word_emb, transpose_y=True)
+
+
+def build_lm_net(cfg=None, seq_len=64):
+    """Causal LM training graph. Feeds: tokens (B, T) int64.
+    Returns (tokens_var, mean_loss, logits)."""
+    cfg = cfg or GPTConfig()
+    tokens = layers.data("tokens", shape=[seq_len], dtype="int64")
+    logits = gpt_logits(tokens, cfg, seq_len)
+    # next-token prediction: positions 0..T-2 predict tokens 1..T-1
+    pred = layers.slice(logits, axes=[1], starts=[0], ends=[seq_len - 1])
+    tgt = layers.slice(tokens, axes=[1], starts=[1], ends=[seq_len])
+    pred2d = layers.reshape(pred, shape=[-1, cfg.vocab_size])
+    tgt2d = layers.reshape(tgt, shape=[-1, 1])
+    loss = layers.mean(layers.softmax_with_cross_entropy(pred2d, tgt2d))
+    return tokens, loss, logits
+
+
+# ---------------------------------------------------------------------------
+# KV-cache generation: the same math per token over scope params
+# ---------------------------------------------------------------------------
+
+def load_params(scope, cfg):
+    """Pull the named parameters into a jax pytree for the cached step."""
+
+    def get(name):
+        v = scope.get(name)
+        if v is None:
+            raise KeyError(
+                f"gpt.load_params: parameter {name!r} not in scope — run "
+                f"the startup program (and train/load) with the same "
+                f"gpt_* ParamAttr names before generating")
+        return jnp.asarray(v)
+
+    p = {"word_emb": get("gpt_word_emb"), "pos_emb": get("gpt_pos_emb"),
+         "lnf_s": get("gpt_lnf_s"), "lnf_b": get("gpt_lnf_b")}
+    for i in range(cfg.num_layers):
+        p[f"l{i}"] = {
+            "ln1_s": get(f"gpt{i}_ln1_s"), "ln1_b": get(f"gpt{i}_ln1_b"),
+            "ln2_s": get(f"gpt{i}_ln2_s"), "ln2_b": get(f"gpt{i}_ln2_b"),
+            "wq": get(f"gpt{i}_attn_q"), "wk": get(f"gpt{i}_attn_k"),
+            "wv": get(f"gpt{i}_attn_v"), "wo": get(f"gpt{i}_attn_o"),
+            "bq": get(f"gpt{i}_attn_q_b"), "bk": get(f"gpt{i}_attn_k_b"),
+            "bv": get(f"gpt{i}_attn_v_b"), "bo": get(f"gpt{i}_attn_o_b"),
+            "f0w": get(f"gpt{i}_ffn0_w"), "f0b": get(f"gpt{i}_ffn0_b"),
+            "f1w": get(f"gpt{i}_ffn1_w"), "f1b": get(f"gpt{i}_ffn1_b"),
+        }
+    return p
+
+
+def _ln(x, s, b, eps=1e-5):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + eps) * s + b
+
+
+def build_kv_step(params, cfg, max_len):
+    """step_fn(ids_t (B,), cache, t) -> (logits (B, V), cache) for
+    inference/decoding.greedy_decode / beam_decode. cache: per layer
+    {"k","v"} of (B, H, max_len, D)."""
+    from ..inference import decoding as dec
+    h_, d = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+
+    def step(ids_t, cache, t):
+        b = ids_t.shape[0]
+        x = params["word_emb"][ids_t] + params["pos_emb"][t]   # (B, M)
+        bias = dec.cache_attention_bias(max_len, t)[0, 0]      # (1, L)
+        for i in range(cfg.num_layers):
+            lp = params[f"l{i}"]
+            hn = _ln(x, lp["ln1_s"], lp["ln1_b"])
+            q = (hn @ lp["wq"] + lp["bq"]).reshape(b, h_, 1, d)
+            k = (hn @ lp["wk"] + lp["bk"]).reshape(b, h_, 1, d)
+            v = (hn @ lp["wv"] + lp["bv"]).reshape(b, h_, 1, d)
+            cache[i] = dec.update_kv_cache(cache[i], k, v, t)
+            s = (jnp.einsum("bhd,bhld->bhl", q[:, :, 0], cache[i]["k"])
+                 / np.sqrt(d)) + bias
+            o = jnp.einsum("bhl,bhld->bhd", jax.nn.softmax(s, -1),
+                           cache[i]["v"]).reshape(b, cfg.hidden_size)
+            x = x + (o @ lp["wo"] + lp["bo"])
+            hn = _ln(x, lp["ln2_s"], lp["ln2_b"])
+            f = jax.nn.gelu(hn @ lp["f0w"] + lp["f0b"], approximate=False)
+            x = x + (f @ lp["f1w"] + lp["f1b"])
+        x = _ln(x, params["lnf_s"], params["lnf_b"])
+        return x @ params["word_emb"].T, cache
+
+    return step
+
+
+def generate(scope, cfg, bos_ids, max_len, eos_id=None):
+    """Greedy KV-cache generation from trained scope params."""
+    from ..inference import decoding as dec
+    params = load_params(scope, cfg)
+    d = cfg.hidden_size // cfg.num_heads
+    cache = dec.init_kv_cache(len(np.asarray(bos_ids)), cfg.num_layers,
+                              cfg.num_heads, max_len, d)
+    step = build_kv_step(params, cfg, max_len)
+    return dec.greedy_decode(step, cache, jnp.asarray(bos_ids), max_len,
+                             eos_id=eos_id)
