@@ -18,7 +18,7 @@ The whole train step (fwd + grad + adam) runs as ONE donated XLA executable
 via the framework Executor; matmul path is bf16 (amp cast_model_to_bf16),
 params/accum fp32.
 
-Env knobs: BENCH_MODEL (ernie [default] | bert — same graph, uniform-random
+Env knobs: BENCH_MODEL (ernie [default] | bert | gpt — encoders share a graph; uniform-random
 feed | resnet — secondary images/sec metric),
 BENCH_SEQ_LEN, BENCH_BATCHES ("8,16,32"), BENCH_STEPS,
 BENCH_RECOMPUTE (remat policy: dots|nothing|offload),
@@ -251,6 +251,36 @@ def build_transformer_step(batch, seq_len):
     return step, batch * max_len, flops          # units = tokens
 
 
+def build_gpt_step(batch, seq_len):
+    """Decoder-only LM (models/gpt.py): causal-attention tokens/sec/chip
+    — the flash-causal training path the encoder benches don't hit."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.models import gpt
+
+    tiny = os.environ.get("BENCH_TINY") == "1"
+    if tiny:
+        cfg = gpt.gpt_tiny()
+        seq_len = min(seq_len, cfg.max_position)
+    else:
+        cfg = gpt.GPTConfig(max_position=max(seq_len, 1024), dropout=0.0)
+    rng = np.random.default_rng(0)
+
+    def build_net():
+        _tok, loss, _logits = gpt.build_lm_net(cfg, seq_len=seq_len)
+        return loss
+
+    def make_feed():
+        return {"tokens": rng.integers(
+            3, cfg.vocab_size, (batch, seq_len)).astype(np.int32)}
+
+    RUN_INFO["seq_len"] = seq_len
+    step, flops = _compile_train_step(
+        build_net, make_feed,
+        lambda: fluid.optimizer.AdamOptimizer(learning_rate=1e-4), batch)
+    return step, batch * seq_len, flops          # units = tokens
+
+
 def build_deepfm_step(batch):
     """BASELINE config #5: DeepFM CTR examples/sec/chip (sparse embedding
     + all-reduce-of-sparse-grads stress)."""
@@ -294,6 +324,8 @@ def build_step(batch, seq_len):
         return build_transformer_step(batch, seq_len)
     if model == "deepfm":
         return build_deepfm_step(batch)
+    if model == "gpt":
+        return build_gpt_step(batch, seq_len)
     # "ernie" (default — BASELINE.json's named headline) and "bert" share
     # the encoder graph; ernie feeds go through the knowledge-masking
     # pipeline (models/ernie.py), bert feeds are uniform random.
@@ -462,6 +494,15 @@ def _emit(sweep, seq_len, kind, peak):
         unit = "examples/s/chip"
         rate_key = "examples_per_sec"
         baseline = None
+    elif model == "gpt":
+        metric = ("gpt_tiny" if tiny else "gpt_base") \
+            + "_lm_train_tokens_per_sec_per_chip"
+        unit = "tokens/s/chip"
+        rate_key = "tokens_per_sec"
+        baseline = None
+        if not best["flash_engaged"]:
+            print("bench: WARNING — Pallas flash attention did NOT "
+                  "engage on the causal LM path", file=sys.stderr)
     else:
         # ernie and bert share the BERT-base-sized graph; name what ran
         arch = "ernie" if model == "ernie" else "bert"

@@ -105,7 +105,7 @@ def run_suite():
         log("headline failed — continuing with secondaries anyway")
     # 3. secondaries (SURVEY §6 / BASELINE configs)
     for model, budget in (("resnet", 2400), ("transformer", 2400),
-                          ("deepfm", 1800)):
+                          ("deepfm", 1800), ("gpt", 2400)):
         run_step(model, [py, bench],
                  env={"BENCH_MODEL": model,
                       "BENCH_HARD_TIMEOUT": str(budget)},
