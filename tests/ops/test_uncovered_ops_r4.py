@@ -549,3 +549,48 @@ def test_ssd_loss_prefers_perfect_predictions():
                      "GtBox": gt_box, "GtLabel": gt_label,
                      "PriorBox": prior}, {})["Out"]).reshape(-1)[0])
     assert good < 0.1 * bad, (good, bad)
+
+
+class _RngCtx(_Ctx):
+    def __init__(self, ins, attrs=None, seed=0, **kw):
+        super().__init__(ins, attrs, **kw)
+        import jax
+        self._key = jax.random.PRNGKey(seed)
+
+    def rng(self):
+        return self._key
+
+
+def test_multinomial_statistics():
+    import jax.numpy as jnp
+    probs = np.array([[0.7, 0.2, 0.1], [0.05, 0.05, 0.9]], np.float32)
+    out = _REGISTRY["multinomial"](_RngCtx(
+        {"X": jnp.asarray(probs)}, {"num_samples": 4000}, seed=3))["Out"]
+    s = np.asarray(out)
+    assert s.shape == (2, 4000)
+    freq0 = np.bincount(s[0], minlength=3) / 4000
+    freq1 = np.bincount(s[1], minlength=3) / 4000
+    np.testing.assert_allclose(freq0, probs[0], atol=0.03)
+    np.testing.assert_allclose(freq1, probs[1], atol=0.03)
+
+
+def test_dpsgd_clips_and_steps():
+    """dpsgd: grad is norm-clipped to `clip`, gaussian noise sigma added,
+    then an SGD step. With sigma=0 and a large grad the update magnitude
+    must equal lr*clip exactly."""
+    import jax.numpy as jnp
+    p = np.zeros(4, np.float32)
+    g = np.array([30.0, 40.0, 0.0, 0.0], np.float32)   # norm 50
+    out = _REGISTRY["dpsgd"](_RngCtx(
+        {"Param": jnp.asarray(p), "Grad": jnp.asarray(g),
+         "LearningRate": jnp.asarray([0.1], np.float32)},
+        {"clip": 10.0, "sigma": 0.0}, seed=1))["ParamOut"]
+    got = np.asarray(out)
+    # clipped grad = g * 10/50 = [6, 8, 0, 0]; update = -lr * that
+    np.testing.assert_allclose(got, [-0.6, -0.8, 0.0, 0.0], rtol=1e-5)
+    # sigma > 0 perturbs deterministically per key
+    out2 = _REGISTRY["dpsgd"](_RngCtx(
+        {"Param": jnp.asarray(p), "Grad": jnp.asarray(g),
+         "LearningRate": jnp.asarray([0.1], np.float32)},
+        {"clip": 10.0, "sigma": 1.0}, seed=1))["ParamOut"]
+    assert not np.allclose(np.asarray(out2), got)
