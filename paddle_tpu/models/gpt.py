@@ -176,13 +176,22 @@ def build_kv_step(params, cfg, max_len):
     return step
 
 
-def generate(scope, cfg, bos_ids, max_len, eos_id=None):
-    """Greedy KV-cache generation from trained scope params."""
+def generate(scope, cfg, bos_ids, max_len, eos_id=None, beam_size=None,
+             length_penalty=0.6):
+    """KV-cache generation from trained scope params: greedy by default,
+    beam search (dense lanes, GNMT length penalty) with beam_size."""
     from ..inference import decoding as dec
     params = load_params(scope, cfg)
     d = cfg.hidden_size // cfg.num_heads
-    cache = dec.init_kv_cache(len(np.asarray(bos_ids)), cfg.num_layers,
-                              cfg.num_heads, max_len, d)
+    b = len(np.asarray(bos_ids))
     step = build_kv_step(params, cfg, max_len)
-    return dec.greedy_decode(step, cache, jnp.asarray(bos_ids), max_len,
-                             eos_id=eos_id)
+    if beam_size is None:
+        cache = dec.init_kv_cache(b, cfg.num_layers, cfg.num_heads,
+                                  max_len, d)
+        return dec.greedy_decode(step, cache, jnp.asarray(bos_ids),
+                                 max_len, eos_id=eos_id)
+    cache = dec.init_kv_cache(b * beam_size, cfg.num_layers,
+                              cfg.num_heads, max_len, d)
+    return dec.beam_decode(step, cache, jnp.asarray(bos_ids), max_len,
+                           beam_size, eos_id if eos_id is not None else -1,
+                           length_penalty=length_penalty)

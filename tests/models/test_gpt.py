@@ -72,3 +72,20 @@ def test_greedy_generation_reproduces_memorized_sequence():
     # bos = toks[0] for 11 steps must regenerate toks[1:]
     ids, _scores = gpt.generate(scope, cfg, toks[:1, 0], max_len=11)
     np.testing.assert_array_equal(np.asarray(ids)[0], toks[0, 1:])
+
+
+def test_beam_generation_top_beam_matches_greedy():
+    """beam_size=2's best lane must reproduce the greedy rollout on an
+    overfit model (probabilities are near-deterministic, so the greedy
+    path dominates every beam)."""
+    cfg, scope, main, _s, toks, losses, _l = _train(
+        steps=120, batch=1, seq_len=12, lr=3e-3, seed=2)
+    assert losses[-1] < 0.02
+    ids_g, _ = gpt.generate(scope, cfg, toks[:1, 0], max_len=11)
+    ids_b, scores = gpt.generate(scope, cfg, toks[:1, 0], max_len=11,
+                                 beam_size=2)
+    assert np.asarray(ids_b).shape == (1, 2, 11)
+    np.testing.assert_array_equal(np.asarray(ids_b)[0, 0],
+                                  np.asarray(ids_g)[0])
+    assert float(np.asarray(scores)[0, 0]) >= float(
+        np.asarray(scores)[0, 1])
