@@ -181,10 +181,9 @@ def gather(ctx):
 def gather_nd(ctx):
     x, idx = ctx.in_("X"), ctx.in_("Index")
     idx = idx.astype(jnp.int32)
-    k = idx.shape[-1]
-    out = x[tuple(jnp.moveaxis(idx, -1, 0))] if k == x.ndim else \
-        x[tuple(jnp.moveaxis(idx, -1, 0))]
-    return {"Out": out}
+    # advanced indexing covers both full (k == ndim -> scalars) and
+    # partial (k < ndim -> trailing slices) gather_nd semantics
+    return {"Out": x[tuple(jnp.moveaxis(idx, -1, 0))]}
 
 
 @register("scatter")

@@ -594,3 +594,43 @@ def test_dpsgd_clips_and_steps():
          "LearningRate": jnp.asarray([0.1], np.float32)},
         {"clip": 10.0, "sigma": 1.0}, seed=1))["ParamOut"]
     assert not np.allclose(np.asarray(out2), got)
+
+
+def test_gather_nd_full_and_partial_index():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    full = np.array([[0, 2, 1], [1, 0, 3]], np.int64)     # -> scalars
+    got = np.asarray(_run_kernel("gather_nd",
+                                 {"X": x, "Index": full})["Out"])
+    np.testing.assert_allclose(got, [x[0, 2, 1], x[1, 0, 3]])
+    part = np.array([[1, 2], [0, 0]], np.int64)           # -> rows of 4
+    got2 = np.asarray(_run_kernel("gather_nd",
+                                  {"X": x, "Index": part})["Out"])
+    np.testing.assert_allclose(got2, np.stack([x[1, 2], x[0, 0]]))
+
+
+def test_scatter_overwrite_and_add():
+    x = np.zeros((4, 2), np.float32)
+    ids = np.array([1, 3], np.int64)
+    upd = np.array([[1., 2.], [3., 4.]], np.float32)
+    got = np.asarray(_run_kernel("scatter", {"X": x, "Ids": ids,
+                                             "Updates": upd},
+                                 {"overwrite": True})["Out"])
+    want = x.copy(); want[1] = upd[0]; want[3] = upd[1]
+    np.testing.assert_allclose(got, want)
+    base = np.ones((4, 2), np.float32)
+    got2 = np.asarray(_run_kernel("scatter", {"X": base, "Ids": ids,
+                                              "Updates": upd},
+                                  {"overwrite": False})["Out"])
+    want2 = base.copy(); want2[1] += upd[0]; want2[3] += upd[1]
+    np.testing.assert_allclose(got2, want2)
+
+
+def test_scatter_nd_add_accumulates_duplicates():
+    x = np.zeros((3, 3), np.float32)
+    idx = np.array([[0, 1], [2, 2], [0, 1]], np.int64)    # dup (0,1)
+    upd = np.array([1.0, 5.0, 2.0], np.float32)
+    got = np.asarray(_run_kernel("scatter_nd_add",
+                                 {"X": x, "Index": idx,
+                                  "Updates": upd})["Out"])
+    want = x.copy(); want[0, 1] = 3.0; want[2, 2] = 5.0
+    np.testing.assert_allclose(got, want)
