@@ -194,11 +194,14 @@ def has_nan(ctx):
 
 @register("l2_normalize", "norm")
 def l2_normalize(ctx):
+    """Parity: norm_op.h:65-71 — epsilon goes INSIDE the sqrt:
+    norm = sqrt(sum(x^2) + eps), y = x / norm (the Norm output carries
+    the eps too; clamping outside diverges for near-zero rows)."""
     x = ctx.in_("X")
     axis = ctx.attr("axis", -1)
     eps = ctx.attr("epsilon", 1e-10)
-    norm = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True))
-    return {"Out": x / jnp.maximum(norm, eps), "Norm": norm}
+    norm = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True) + eps)
+    return {"Out": x / norm, "Norm": norm}
 
 
 @register("bilinear_tensor_product")

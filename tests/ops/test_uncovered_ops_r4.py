@@ -634,3 +634,16 @@ def test_scatter_nd_add_accumulates_duplicates():
                                   "Updates": upd})["Out"])
     want = x.copy(); want[0, 1] = 3.0; want[2, 2] = 5.0
     np.testing.assert_allclose(got, want)
+
+
+def test_l2_normalize_epsilon_inside_sqrt():
+    """Golden: norm_op.h:65-71 — norm = sqrt(sum(x^2) + eps)."""
+    x = np.array([[3.0, 4.0], [0.0, 0.0]], np.float32)
+    eps = 1e-4
+    out = _run_kernel("norm", {"X": x}, {"axis": -1, "epsilon": eps})
+    got, norm = np.asarray(out["Out"]), np.asarray(out["Norm"])
+    want_norm = np.sqrt((x ** 2).sum(-1, keepdims=True) + eps)
+    np.testing.assert_allclose(norm, want_norm, rtol=1e-6)
+    np.testing.assert_allclose(got, x / want_norm, rtol=1e-6)
+    # the zero row divides by sqrt(eps), not by the eps clamp
+    np.testing.assert_allclose(got[1], [0.0, 0.0], atol=1e-7)
