@@ -647,3 +647,32 @@ def test_l2_normalize_epsilon_inside_sqrt():
     np.testing.assert_allclose(got, x / want_norm, rtol=1e-6)
     # the zero row divides by sqrt(eps), not by the eps clamp
     np.testing.assert_allclose(got[1], [0.0, 0.0], atol=1e-7)
+
+
+def test_pool3d_and_conv3d_match_torch():
+    rng = np.random.RandomState(15)
+    x = rng.randn(2, 3, 6, 6, 6).astype("float32")
+    got = np.asarray(_run_kernel("pool3d", {"X": x},
+                                 {"pooling_type": "max", "ksize": [2, 2, 2],
+                                  "strides": [2, 2, 2],
+                                  "paddings": [0, 0, 0]})["Out"])
+    want = torch.nn.functional.max_pool3d(torch.tensor(x), 2, 2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    got_a = np.asarray(_run_kernel("pool3d", {"X": x},
+                                   {"pooling_type": "avg",
+                                    "ksize": [3, 3, 3],
+                                    "strides": [3, 3, 3],
+                                    "paddings": [0, 0, 0]})["Out"])
+    want_a = torch.nn.functional.avg_pool3d(torch.tensor(x), 3, 3).numpy()
+    np.testing.assert_allclose(got_a, want_a, rtol=1e-5)
+
+    w = rng.randn(4, 3, 3, 3, 3).astype("float32")
+    got_c = np.asarray(_run_kernel("conv3d", {"Input": x, "Filter": w},
+                                   {"strides": [1, 1, 1],
+                                    "paddings": [1, 1, 1],
+                                    "dilations": [1, 1, 1],
+                                    "groups": 1})["Output"])
+    want_c = torch.nn.functional.conv3d(torch.tensor(x), torch.tensor(w),
+                                        padding=1).numpy()
+    np.testing.assert_allclose(got_c, want_c, rtol=1e-3, atol=1e-4)
