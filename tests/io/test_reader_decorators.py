@@ -99,3 +99,41 @@ def test_pipereader_gzip(tmp_path):
         f.write(b"one\ntwo\n")
     pr = reader.PipeReader(f"cat {p}", file_type="gzip")
     assert list(pr.get_line()) == ["one", "two"]
+
+
+def test_batch_shuffle_buffered_cache_chain():
+    def rng10():
+        def gen():
+            yield from range(10)
+        return gen
+
+    out = list(reader.batch(rng10(), 3)())
+    assert [len(b) for b in out] == [3, 3, 3, 1]
+    assert [len(b) for b in reader.batch(rng10(), 3, drop_last=True)()] \
+        == [3, 3, 3]
+    assert sorted(reader.shuffle(rng10(), buf_size=4)()) == list(range(10))
+    assert list(reader.buffered(rng10(), 3)()) == list(range(10))
+    c = reader.cache(rng10())
+    assert list(c()) == list(range(10)) and list(c()) == list(range(10))
+    assert list(reader.chain(rng10(), rng10())()) == list(range(10)) * 2
+    assert list(reader.firstn(rng10(), 4)()) == [0, 1, 2, 3]
+    assert list(reader.map_readers(lambda a, b: a * b, rng10(),
+                                   rng10())()) == [i * i for i in range(10)]
+
+
+def test_xmap_and_multiprocess_readers():
+    def rng12():
+        def gen():
+            yield from range(12)
+        return gen
+
+    ordered = list(reader.xmap_readers(lambda x: x * 10, rng12(),
+                                       process_num=3, buffer_size=4,
+                                       order=True)())
+    assert ordered == [i * 10 for i in range(12)]
+    unordered = list(reader.xmap_readers(lambda x: x + 1, rng12(),
+                                         process_num=3, buffer_size=4,
+                                         order=False)())
+    assert sorted(unordered) == [i + 1 for i in range(12)]
+    merged = list(reader.multiprocess_reader([rng12(), rng12()])())
+    assert sorted(merged) == sorted(list(range(12)) * 2)
