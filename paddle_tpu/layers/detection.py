@@ -33,7 +33,8 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.0],
                       "aspect_ratios": list(aspect_ratios),
                       "variances": list(variance), "flip": flip,
                       "clip": clip, "step_w": steps[0], "step_h": steps[1],
-                      "offset": offset})
+                      "offset": offset,
+                      "min_max_aspect_ratios_order": min_max_aspect_ratios_order})
     return boxes, variances
 
 
@@ -51,7 +52,7 @@ def density_prior_box(input, image, densities=None, fixed_sizes=None,
                       "fixed_ratios": list(fixed_ratios or [1.0]),
                       "variances": list(variance), "clip": clip,
                       "step_w": steps[0], "step_h": steps[1],
-                      "offset": offset})
+                      "offset": offset, "flatten_to_2d": flatten_to_2d})
     return boxes, variances
 
 
@@ -179,6 +180,7 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
     feature maps: per-map 3x3 convs predicting loc (4A) + conf (CA), plus
     the matching prior boxes, all flattened and concatenated."""
     from . import nn as nn_layers
+    from ..ops.detection_ops import _expand_aspect_ratios
     if min_sizes is None:
         # reference formula: evenly spaced ratios between min_ratio/max_ratio
         num_layer = len(inputs)
@@ -200,14 +202,11 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
         box, var = prior_box(feat, image, ms, Ms, ar, variance, flip, clip,
                              steps=[step_w[i] if step_w else 0.0,
                                     step_h[i] if step_h else 0.0],
-                             offset=offset)
-        num_priors = 1
-        full_ar = []
-        for a in ar:
-            full_ar.append(a)
-            if flip and a != 1.0:
-                full_ar.append(1.0 / a)
-        num_priors = len(ms) * len(full_ar) + len(ms) * len(Ms)
+                             offset=offset,
+                             min_max_aspect_ratios_order=min_max_aspect_ratios_order)
+        # prior_box_op.h:94-97: priors per cell = expanded ratios (1.0
+        # leads, dedup, flip) x min sizes + ONE sqrt box per max size.
+        num_priors = len(ms) * len(_expand_aspect_ratios(ar, flip)) + len(Ms)
         loc = nn_layers.conv2d(feat, num_priors * 4, kernel_size,
                                padding=pad, stride=stride)
         conf = nn_layers.conv2d(feat, num_priors * num_classes, kernel_size,

@@ -253,8 +253,26 @@ def yolo_box(ctx):
     return {"Boxes": boxes * mask, "Scores": probs * mask}
 
 
+def _expand_aspect_ratios(ars, flip):
+    """Parity: prior_box_op.h:28 ExpandAspectRatios — 1.0 always leads,
+    near-duplicates (eps 1e-6) are dropped, flip appends 1/ar."""
+    out = [1.0]
+    for ar in ars:
+        if any(abs(ar - e) < 1e-6 for e in out):
+            continue
+        out.append(ar)
+        if flip:
+            out.append(1.0 / ar)
+    return out
+
+
 @register("prior_box")
 def prior_box(ctx):
+    """Parity: paddle/fluid/operators/detection/prior_box_op.h:53-170.
+    Per cell, per min_sizes[s]: all expanded aspect ratios then ONE
+    sqrt(min_sizes[s]*max_sizes[s]) square box (paired by index s, not a
+    cross product); min_max_aspect_ratios_order flips that order to
+    min, max, then non-1 ratios."""
     inp = ctx.in_("Input")  # (N, C, H, W) feature map
     image = ctx.in_("Image")
     min_sizes = ctx.attr("min_sizes")
@@ -263,27 +281,39 @@ def prior_box(ctx):
     variances = ctx.attr("variances", [0.1, 0.1, 0.2, 0.2])
     flip = ctx.attr("flip", False)
     clip = ctx.attr("clip", False)
+    mm_order = ctx.attr("min_max_aspect_ratios_order", False)
     step_w = ctx.attr("step_w", 0.0)
     step_h = ctx.attr("step_h", 0.0)
     offset = ctx.attr("offset", 0.5)
     h, w = inp.shape[2], inp.shape[3]
     img_h, img_w = image.shape[2], image.shape[3]
-    sw = step_w or img_w / w
-    sh = step_h or img_h / h
-    full_ars = []
-    for ar in ars:
-        full_ars.append(ar)
-        if flip and ar != 1.0:
-            full_ars.append(1.0 / ar)
+    # prior_box_op.h:85 — EITHER step being zero discards BOTH and falls
+    # back to the image/feature ratio.
+    if step_w == 0 or step_h == 0:
+        sw, sh = img_w / w, img_h / h
+    else:
+        sw, sh = step_w, step_h
+    if max_sizes and len(max_sizes) != len(min_sizes):
+        raise ValueError(
+            "prior_box: max_sizes pairs with min_sizes by index "
+            f"(got {len(min_sizes)} min_sizes, {len(max_sizes)} max_sizes)")
+    full_ars = _expand_aspect_ratios(ars, flip)
     boxes = []
-    for ms in min_sizes:
-        for ar in full_ars:
-            bw = ms * (ar ** 0.5) / 2.0
-            bh = ms / (ar ** 0.5) / 2.0
-            boxes.append((bw, bh))
-        for Ms in max_sizes:
-            s = (ms * Ms) ** 0.5 / 2.0
-            boxes.append((s, s))
+    for s, ms in enumerate(min_sizes):
+        ratio_boxes = [(ms * ar ** 0.5 / 2.0, ms / ar ** 0.5 / 2.0)
+                       for ar in full_ars]
+        if mm_order:
+            boxes.append((ms / 2.0, ms / 2.0))
+            if max_sizes:
+                sq = (ms * max_sizes[s]) ** 0.5 / 2.0
+                boxes.append((sq, sq))
+            boxes += [b for ar, b in zip(full_ars, ratio_boxes)
+                      if abs(ar - 1.0) >= 1e-6]
+        else:
+            boxes += ratio_boxes
+            if max_sizes:
+                sq = (ms * max_sizes[s]) ** 0.5 / 2.0
+                boxes.append((sq, sq))
     cx = (jnp.arange(w) + offset) * sw
     cy = (jnp.arange(h) + offset) * sh
     cxg, cyg = jnp.meshgrid(cx, cy)
@@ -314,30 +344,41 @@ def density_prior_box(ctx):
     offset = ctx.attr("offset", 0.5)
     h, w = inp.shape[2], inp.shape[3]
     img_h, img_w = image.shape[2], image.shape[3]
-    sw = step_w or img_w / w
-    sh = step_h or img_h / h
+    if step_w == 0 or step_h == 0:  # either zero discards both (op.h:66)
+        sw, sh = img_w / w, img_h / h
+    else:
+        sw, sh = step_w, step_h
+    # density_prior_box_op.h:69-101: a single INTEGER step_average drives
+    # both axes, shift is the integer quotient step_average // density,
+    # and every coordinate is clamped to [0, 1] inline in the generation
+    # loop regardless of `clip` (which only adds a redundant second pass).
+    step_average = int((sw + sh) * 0.5)
     cx = (jnp.arange(w) + offset) * sw
     cy = (jnp.arange(h) + offset) * sh
     cxg, cyg = jnp.meshgrid(cx, cy)
     out = []
     for size, density in zip(fixed_sizes, densities):
-        shift_w = sw / density
-        shift_h = sh / density
+        shift = step_average // density
         for ratio in fixed_ratios:
             bw = size * (ratio ** 0.5)
             bh = size / (ratio ** 0.5)
             for di in range(density):
                 for dj in range(density):
-                    ccx = cxg - sw / 2.0 + shift_w / 2.0 + dj * shift_w
-                    ccy = cyg - sh / 2.0 + shift_h / 2.0 + di * shift_h
+                    ccx = cxg - step_average / 2.0 + shift / 2.0 + dj * shift
+                    ccy = cyg - step_average / 2.0 + shift / 2.0 + di * shift
                     out.append(jnp.stack(
-                        [(ccx - bw / 2.0) / img_w, (ccy - bh / 2.0) / img_h,
-                         (ccx + bw / 2.0) / img_w, (ccy + bh / 2.0) / img_h],
+                        [jnp.maximum((ccx - bw / 2.0) / img_w, 0.0),
+                         jnp.maximum((ccy - bh / 2.0) / img_h, 0.0),
+                         jnp.minimum((ccx + bw / 2.0) / img_w, 1.0),
+                         jnp.minimum((ccy + bh / 2.0) / img_h, 1.0)],
                         axis=-1))
     priors = jnp.stack(out, axis=2)  # (H, W, num_priors, 4)
-    if clip:
+    if clip:  # redundant second clamp pass, kept for reference parity
         priors = jnp.clip(priors, 0.0, 1.0)
     var = jnp.broadcast_to(jnp.asarray(variances), priors.shape)
+    if ctx.attr("flatten_to_2d", False):
+        priors = priors.reshape(-1, 4)
+        var = var.reshape(-1, 4)
     return {"Boxes": priors, "Variances": var}
 
 

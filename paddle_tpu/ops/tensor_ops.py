@@ -382,7 +382,15 @@ def shard_index(ctx):
     nshards = ctx.attr("nshards")
     shard_id = ctx.attr("shard_id")
     ignore_value = ctx.attr("ignore_value", -1)
-    shard_size = (index_num + nshards - 1) // nshards
+    # shard_index_op.h:37 and the op docstring (shard_index_op.cc:77) —
+    # FLOOR division (ceiling is a paddle-2.x change); when nshards does
+    # not divide index_num the tail ids land past shard nshards-1 and
+    # every shard maps them to ignore_value.
+    shard_size = index_num // nshards
+    if shard_size == 0:
+        raise ValueError(
+            f"shard_index: nshards ({nshards}) > index_num ({index_num}) "
+            "gives an empty shard_size (the reference divides by zero here)")
     in_shard = (x // shard_size) == shard_id
     return {"Out": jnp.where(in_shard, x % shard_size, ignore_value)}
 

@@ -1,0 +1,338 @@
+"""Uncovered-ops parity sweep, round 4 batch 4 — ops with no prior
+numeric test. Caught and fixed this sweep:
+
+- prior_box emitted a CROSS PRODUCT of min_sizes x max_sizes sqrt boxes
+  and never injected aspect ratio 1.0; the reference pairs max_sizes[s]
+  with min_sizes[s] (one square box per min size) and ExpandAspectRatios
+  always leads with 1.0 + dedupes (prior_box_op.h:28-50,105-165). Also
+  min_max_aspect_ratios_order was accepted by the layer but dropped.
+- density_prior_box used per-axis float shifts; the reference drives BOTH
+  axes from one integer step_average with integer shift =
+  step_average // density, and clamps coords to [0,1] unconditionally
+  (density_prior_box_op.h:69-109). flatten_to_2d was dropped.
+- shard_index used a ceil split; the reference is floor division
+  (shard_index_op.h:37) — tail ids map to ignore_value in EVERY shard.
+
+Goldens below are numpy transcriptions of the reference loops.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+from paddle_tpu.ops import _REGISTRY
+
+from test_uncovered_ops_r4 import _run_kernel
+
+
+# ---------------------------------------------------------------------------
+# prior_box (prior_box_op.h:53-170)
+
+def _expand_ars_ref(ars, flip):
+    out = [1.0]
+    for ar in ars:
+        if any(abs(ar - e) < 1e-6 for e in out):
+            continue
+        out.append(ar)
+        if flip:
+            out.append(1.0 / ar)
+    return out
+
+
+def _prior_box_ref(feat_hw, img_hw, min_sizes, max_sizes, ars, flip,
+                   clip, steps, offset, mm_order):
+    fh, fw = feat_hw
+    ih, iw = img_hw
+    sw = steps[0] or iw / fw
+    sh = steps[1] or ih / fh
+    full = _expand_ars_ref(ars, flip)
+    num = len(full) * len(min_sizes) + len(max_sizes)
+    out = np.zeros((fh, fw, num, 4), np.float64)
+    for h in range(fh):
+        for w in range(fw):
+            cx = (w + offset) * sw
+            cy = (h + offset) * sh
+            b = []
+            for s, ms in enumerate(min_sizes):
+                if mm_order:
+                    b.append((ms / 2.0, ms / 2.0))
+                    if max_sizes:
+                        sq = np.sqrt(ms * max_sizes[s]) / 2.0
+                        b.append((sq, sq))
+                    for ar in full:
+                        if abs(ar - 1.0) < 1e-6:
+                            continue
+                        b.append((ms * np.sqrt(ar) / 2, ms / np.sqrt(ar) / 2))
+                else:
+                    for ar in full:
+                        b.append((ms * np.sqrt(ar) / 2, ms / np.sqrt(ar) / 2))
+                    if max_sizes:
+                        sq = np.sqrt(ms * max_sizes[s]) / 2.0
+                        b.append((sq, sq))
+            for i, (bw, bh) in enumerate(b):
+                out[h, w, i] = [(cx - bw) / iw, (cy - bh) / ih,
+                                (cx + bw) / iw, (cy + bh) / ih]
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    return out
+
+
+@pytest.mark.parametrize("mm_order", [False, True])
+def test_prior_box_matches_reference_loop(mm_order):
+    feat = np.zeros((1, 8, 3, 4), np.float32)
+    img = np.zeros((1, 3, 48, 64), np.float32)
+    attrs = dict(min_sizes=[20.0, 30.0], max_sizes=[40.0, 60.0],
+                 aspect_ratios=[1.0, 2.0, 0.5], variances=[0.1, 0.1, 0.2, 0.2],
+                 flip=True, clip=True, step_w=0.0, step_h=0.0, offset=0.5,
+                 min_max_aspect_ratios_order=mm_order)
+    got = _run_kernel("prior_box", {"Input": feat, "Image": img}, attrs)
+    ref = _prior_box_ref((3, 4), (48, 64), [20.0, 30.0], [40.0, 60.0],
+                         [1.0, 2.0, 0.5], True, True, (0.0, 0.0), 0.5,
+                         mm_order)
+    # flip must NOT duplicate ar=1.0, and max boxes pair by index:
+    # 2 min sizes x 4 expanded ratios (1, 2, 1/2, 0.5->dup dropped... )
+    assert got["Boxes"].shape == ref.shape, (got["Boxes"].shape, ref.shape)
+    np.testing.assert_allclose(np.asarray(got["Boxes"]), ref, rtol=1e-5,
+                               atol=1e-6)
+    assert got["Variances"].shape == ref.shape
+
+
+def test_prior_box_expand_dedupes_and_leads_with_one():
+    # aspect_ratios already containing 1.0 must not double it; flip of a
+    # near-duplicate ratio is skipped entirely (prior_box_op.h:34-48).
+    feat = np.zeros((1, 4, 2, 2), np.float32)
+    img = np.zeros((1, 3, 32, 32), np.float32)
+    got = _run_kernel("prior_box", {"Input": feat, "Image": img},
+                      dict(min_sizes=[16.0], max_sizes=[], flip=True,
+                           aspect_ratios=[2.0, 2.0000001, 1.0],
+                           variances=[0.1, 0.1, 0.2, 0.2], clip=False,
+                           step_w=0.0, step_h=0.0, offset=0.5))
+    # expanded = [1.0, 2.0, 0.5] -> 3 priors per cell
+    assert got["Boxes"].shape == (2, 2, 3, 4)
+
+
+# ---------------------------------------------------------------------------
+# density_prior_box (density_prior_box_op.h:69-109)
+
+def _density_prior_box_ref(feat_hw, img_hw, fixed_sizes, fixed_ratios,
+                           densities, steps, offset):
+    fh, fw = feat_hw
+    ih, iw = img_hw
+    sw = steps[0] or iw / fw
+    sh = steps[1] or ih / fh
+    step_average = int((sw + sh) * 0.5)
+    num = sum(len(fixed_ratios) * d * d for d in densities)
+    out = np.zeros((fh, fw, num, 4), np.float64)
+    for h in range(fh):
+        for w in range(fw):
+            cx = (w + offset) * sw
+            cy = (h + offset) * sh
+            idx = 0
+            for s, size in enumerate(fixed_sizes):
+                density = densities[s]
+                shift = step_average // density
+                for ratio in fixed_ratios:
+                    bw = size * np.sqrt(ratio)
+                    bh = size / np.sqrt(ratio)
+                    dcx = cx - step_average / 2.0 + shift / 2.0
+                    dcy = cy - step_average / 2.0 + shift / 2.0
+                    for di in range(density):
+                        for dj in range(density):
+                            ctx_ = dcx + dj * shift
+                            cty = dcy + di * shift
+                            out[h, w, idx] = [
+                                max((ctx_ - bw / 2.0) / iw, 0.0),
+                                max((cty - bh / 2.0) / ih, 0.0),
+                                min((ctx_ + bw / 2.0) / iw, 1.0),
+                                min((cty + bh / 2.0) / ih, 1.0)]
+                            idx += 1
+    return out
+
+
+def test_density_prior_box_matches_reference_loop():
+    feat = np.zeros((1, 8, 2, 3), np.float32)
+    img = np.zeros((1, 3, 30, 45), np.float32)
+    fixed_sizes, fixed_ratios, densities = [8.0, 16.0], [1.0, 4.0], [2, 1]
+    got = _run_kernel(
+        "density_prior_box", {"Input": feat, "Image": img},
+        dict(fixed_sizes=fixed_sizes, fixed_ratios=fixed_ratios,
+             densities=densities, variances=[0.1, 0.1, 0.2, 0.2],
+             clip=False, step_w=0.0, step_h=0.0, offset=0.5))
+    ref = _density_prior_box_ref((2, 3), (30, 45), fixed_sizes,
+                                 fixed_ratios, densities, (0.0, 0.0), 0.5)
+    assert got["Boxes"].shape == ref.shape
+    np.testing.assert_allclose(np.asarray(got["Boxes"]), ref, rtol=1e-5,
+                               atol=1e-6)
+    # coords clamp to [0,1] even with clip=False (inline in the ref loop)
+    assert float(np.asarray(got["Boxes"]).min()) >= 0.0
+    assert float(np.asarray(got["Boxes"]).max()) <= 1.0
+
+
+def test_density_prior_box_flatten_to_2d():
+    feat = np.zeros((1, 8, 2, 2), np.float32)
+    img = np.zeros((1, 3, 16, 16), np.float32)
+    got = _run_kernel(
+        "density_prior_box", {"Input": feat, "Image": img},
+        dict(fixed_sizes=[4.0], fixed_ratios=[1.0], densities=[2],
+             variances=[0.1, 0.1, 0.2, 0.2], clip=False, step_w=0.0,
+             step_h=0.0, offset=0.5, flatten_to_2d=True))
+    assert got["Boxes"].shape == (2 * 2 * 4, 4)
+    assert got["Variances"].shape == (2 * 2 * 4, 4)
+
+
+# ---------------------------------------------------------------------------
+# shard_index (shard_index_op.h:31-53)
+
+def test_shard_index_floor_split_and_ignore():
+    # index_num=20, nshards=3 -> shard_size = 6 (floor), ids >= 18 match
+    # no shard and become ignore_value everywhere.
+    x = np.array([[0], [5], [6], [17], [18], [19]], np.int64)
+    for shard_id in range(3):
+        got = np.asarray(_run_kernel(
+            "shard_index", {"X": x},
+            dict(index_num=20, nshards=3, shard_id=shard_id,
+                 ignore_value=-1))["Out"])
+        ref = np.where(x // 6 == shard_id, x % 6, -1)
+        np.testing.assert_array_equal(got, ref)
+        assert (got[-2:] == -1).all()
+
+
+# ---------------------------------------------------------------------------
+# sequence_mask (sequence_mask_op.h: y[i][j] = j < x[i])
+
+def test_sequence_mask_values_and_dtype():
+    x = np.array([0, 2, 3, 5], np.int64)
+    got = _run_kernel("sequence_mask", {"X": x},
+                      dict(maxlen=6, out_dtype="int64"))["Y"]
+    ref = (np.arange(6)[None, :] < x[:, None]).astype(np.int64)
+    np.testing.assert_array_equal(np.asarray(got), ref)
+    # int64 requests land as int32 on device (the documented int64
+    # policy, MIGRATION.md §"Integer dtypes").
+    assert np.asarray(got).dtype == np.int32
+
+
+def test_sequence_mask_maxlen_defaults_to_data_max():
+    x = np.array([1, 4, 2], np.int64)
+    got = _run_kernel("sequence_mask", {"X": x},
+                      dict(maxlen=-1, out_dtype="float32"))["Y"]
+    assert np.asarray(got).shape == (3, 4)
+    np.testing.assert_allclose(np.asarray(got).sum(axis=1), [1, 4, 2])
+
+
+# ---------------------------------------------------------------------------
+# logical / reduce-bool family
+
+def test_logical_and_or_not_xor():
+    x = np.array([True, True, False, False])
+    y = np.array([True, False, True, False])
+    assert (np.asarray(_run_kernel("logical_and", {"X": x, "Y": y})["Out"])
+            == (x & y)).all()
+    assert (np.asarray(_run_kernel("logical_or", {"X": x, "Y": y})["Out"])
+            == (x | y)).all()
+
+
+def test_reduce_all_any_axes():
+    x = np.array([[True, False], [True, True]])
+    got_all = _run_kernel("reduce_all", {"X": x},
+                          dict(dim=[1], keep_dim=False, reduce_all=False))
+    got_any = _run_kernel("reduce_any", {"X": x},
+                          dict(dim=[0], keep_dim=True, reduce_all=False))
+    np.testing.assert_array_equal(np.asarray(got_all["Out"]), [False, True])
+    np.testing.assert_array_equal(np.asarray(got_any["Out"]),
+                                  [[True, True]])
+
+
+# ---------------------------------------------------------------------------
+# clip_by_norm (clip_by_norm_op.h:74-82)
+
+def test_clip_by_norm_over_and_under():
+    x = np.array([3.0, 4.0], np.float32)          # norm 5
+    got = np.asarray(_run_kernel("clip_by_norm", {"X": x},
+                                 dict(max_norm=1.0))["Out"])
+    np.testing.assert_allclose(got, x / 5.0, rtol=1e-6)
+    got2 = np.asarray(_run_kernel("clip_by_norm", {"X": x},
+                                  dict(max_norm=10.0))["Out"])
+    np.testing.assert_allclose(got2, x, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# fill_constant_batch_size_like / assign_value
+
+def test_fill_constant_batch_size_like_copies_batch_dim():
+    ref_in = np.zeros((7, 3), np.float32)
+    got = _run_kernel("fill_constant_batch_size_like", {"Input": ref_in},
+                      dict(shape=[1, 5], input_dim_idx=0, output_dim_idx=0,
+                           value=2.5, dtype="float32"))["Out"]
+    assert np.asarray(got).shape == (7, 5)
+    assert (np.asarray(got) == 2.5).all()
+
+
+def test_assign_value_shape_and_dtype():
+    got = _run_kernel("assign_value", {},
+                      dict(shape=[2, 3], values=[1, 2, 3, 4, 5, 6],
+                           dtype="int32"))["Out"]
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.arange(1, 7, dtype=np.int32).reshape(2, 3))
+
+
+# ---------------------------------------------------------------------------
+# trig tail (acos / atan)
+
+def test_acos_atan_match_numpy():
+    x = np.linspace(-0.9, 0.9, 7).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(_run_kernel("acos", {"X": x})["Out"]), np.arccos(x),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(_run_kernel("atan", {"X": x})["Out"]), np.arctan(x),
+        rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# adamw == torch.optim.AdamW single step (decoupled decay)
+
+def test_adamw_matches_torch_step():
+    rng = np.random.RandomState(0)
+    p0 = rng.randn(4, 3).astype(np.float32)
+    g = rng.randn(4, 3).astype(np.float32)
+    lr, wd, b1, b2, eps = 0.01, 0.1, 0.9, 0.999, 1e-12
+    got = _run_kernel(
+        "adamw",
+        {"Param": p0, "Grad": g, "Moment1": np.zeros_like(p0),
+         "Moment2": np.zeros_like(p0), "Beta1Pow": np.float32(b1),
+         "Beta2Pow": np.float32(b2),
+         "LearningRate": np.float32(lr)},
+        dict(beta1=b1, beta2=b2, epsilon=eps, weight_decay=wd))
+    tp = torch.nn.Parameter(torch.tensor(p0))
+    opt = torch.optim.AdamW([tp], lr=lr, betas=(b1, b2), eps=eps,
+                            weight_decay=wd)
+    tp.grad = torch.tensor(g)
+    opt.step()
+    # eps placement differs (fluid: eps outside the bias correction);
+    # with eps ~ 0 the two formulations coincide.
+    np.testing.assert_allclose(np.asarray(got["ParamOut"]),
+                               tp.detach().numpy(), rtol=2e-4, atol=2e-6)
+
+
+# ---------------------------------------------------------------------------
+# multihead_attention fused op == manual projections + softmax attention
+
+def test_multihead_attention_matches_manual():
+    rng = np.random.RandomState(1)
+    B, T, M, H = 2, 5, 8, 2
+    q = rng.randn(B, T, M).astype(np.float32)
+    wq, wk, wv, wo = [rng.randn(M, M).astype(np.float32) for _ in range(4)]
+    got = np.asarray(_run_kernel(
+        "multihead_attention",
+        {"Query": q, "WQ": wq, "WK": wk, "WV": wv, "WO": wo},
+        dict(num_heads=H))["Out"])
+
+    def split(x):
+        return x.reshape(B, T, H, M // H).transpose(0, 2, 1, 3)
+
+    qh, kh, vh = split(q @ wq), split(q @ wk), split(q @ wv)
+    s = (qh @ kh.transpose(0, 1, 3, 2)) / np.sqrt(M // H)
+    s = np.exp(s - s.max(-1, keepdims=True))
+    s /= s.sum(-1, keepdims=True)
+    ref = ((s @ vh).transpose(0, 2, 1, 3).reshape(B, T, M)) @ wo
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
