@@ -180,7 +180,7 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
     feature maps: per-map 3x3 convs predicting loc (4A) + conf (CA), plus
     the matching prior boxes, all flattened and concatenated."""
     from . import nn as nn_layers
-    from ..ops.detection_ops import _expand_aspect_ratios
+    from ..ops.detection_ops import expand_aspect_ratios
     if min_sizes is None:
         # reference formula: evenly spaced ratios between min_ratio/max_ratio
         num_layer = len(inputs)
@@ -206,7 +206,7 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
                              min_max_aspect_ratios_order=min_max_aspect_ratios_order)
         # prior_box_op.h:94-97: priors per cell = expanded ratios (1.0
         # leads, dedup, flip) x min sizes + ONE sqrt box per max size.
-        num_priors = len(ms) * len(_expand_aspect_ratios(ar, flip)) + len(Ms)
+        num_priors = len(ms) * len(expand_aspect_ratios(ar, flip)) + len(Ms)
         loc = nn_layers.conv2d(feat, num_priors * 4, kernel_size,
                                padding=pad, stride=stride)
         conf = nn_layers.conv2d(feat, num_priors * num_classes, kernel_size,

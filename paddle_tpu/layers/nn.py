@@ -1347,13 +1347,21 @@ def deformable_roi_pooling(input, rois, trans, no_trans=False,
     ins = {"Input": input, "ROIs": rois}
     if not no_trans and trans is not None:
         ins["Trans"] = trans
+    # reference layer (nn.py:13400-13405): PS mode divides the input
+    # channels by the pooled area to get the output channel count.
+    channels = input.shape[1]
+    output_dim = channels // (pooled_height * pooled_width) \
+        if position_sensitive else channels
     out = helper.create_variable_for_type_inference(input.dtype)
     helper.append_op("deformable_psroi_pooling", ins, {"Output": out},
-                     {"spatial_scale": spatial_scale,
-                      "group_size": group_size,
+                     {"no_trans": no_trans,
+                      "spatial_scale": spatial_scale,
+                      "output_dim": output_dim,
+                      "group_size": list(group_size),
                       "pooled_height": pooled_height,
                       "pooled_width": pooled_width,
-                      "part_size": part_size or [pooled_height],
+                      "part_size": list(part_size or [pooled_height, pooled_width]),
+                      "sample_per_part": sample_per_part,
                       "trans_std": trans_std})
     return out
 

@@ -54,12 +54,13 @@ def c_allreduce_min(ctx):
 @register("c_allreduce_prod")
 def c_allreduce_prod(ctx):
     x = ctx.in_("X")
-    axis = _axis(ctx)
-    try:
+
+    def pprod(v, ax):
+        # no lax.pprod primitive: gather the ring then reduce. An
+        # exp(psum(log)) trick would NaN on negatives and -inf on zeros.
         import jax.numpy as jnp
-        return {"Out": jnp.exp(lax.psum(jnp.log(x), axis))}
-    except NameError:
-        return {"Out": x}
+        return jnp.prod(lax.all_gather(v, ax, axis=0), axis=0)
+    return {"Out": _maybe(pprod, x, _axis(ctx))}
 
 
 @register("c_broadcast", "broadcast")

@@ -253,7 +253,7 @@ def yolo_box(ctx):
     return {"Boxes": boxes * mask, "Scores": probs * mask}
 
 
-def _expand_aspect_ratios(ars, flip):
+def expand_aspect_ratios(ars, flip):
     """Parity: prior_box_op.h:28 ExpandAspectRatios — 1.0 always leads,
     near-duplicates (eps 1e-6) are dropped, flip appends 1/ar."""
     out = [1.0]
@@ -297,7 +297,7 @@ def prior_box(ctx):
         raise ValueError(
             "prior_box: max_sizes pairs with min_sizes by index "
             f"(got {len(min_sizes)} min_sizes, {len(max_sizes)} max_sizes)")
-    full_ars = _expand_aspect_ratios(ars, flip)
+    full_ars = expand_aspect_ratios(ars, flip)
     boxes = []
     for s, ms in enumerate(min_sizes):
         ratio_boxes = [(ms * ar ** 0.5 / 2.0, ms / ar ** 0.5 / 2.0)
@@ -348,6 +348,10 @@ def density_prior_box(ctx):
         sw, sh = img_w / w, img_h / h
     else:
         sw, sh = step_w, step_h
+    if len(densities) != len(fixed_sizes):
+        raise ValueError(
+            "density_prior_box: densities pairs with fixed_sizes by index "
+            f"(got {len(fixed_sizes)} fixed_sizes, {len(densities)} densities)")
     # density_prior_box_op.h:69-101: a single INTEGER step_average drives
     # both axes, shift is the integer quotient step_average // density,
     # and every coordinate is clamped to [0, 1] inline in the generation
@@ -836,56 +840,110 @@ def collect_fpn_proposals(ctx):
     return {"FpnRois": allr[idx], "RoisNum": jnp.asarray([k], jnp.int32)}
 
 
+def _pair(v, default):
+    if v is None:
+        return default
+    if isinstance(v, (list, tuple)):
+        return (int(v[0]), int(v[1])) if len(v) > 1 else (int(v[0]), int(v[0]))
+    return int(v), int(v)
+
+
 @register("deformable_psroi_pooling", "deformable_roi_pooling")
 def deformable_roi_pooling(ctx):
-    """Deformable PS-RoI pooling (reference: deformable_psroi_pooling_op):
-    position-sensitive RoI bins with learned per-bin offsets, bilinear
-    sampled."""
+    """Parity: deformable_psroi_pooling_op.h:57-153 — position-sensitive
+    RoI bins with learned per-part offsets. Bin (i,j) of output channel
+    ctop averages sample_per_part^2 bilinear samples of input channel
+    (ctop*group_h + gh)*group_w + gw; samples outside [-0.5, dim-0.5]
+    are skipped (count-normalised); RoI corners round, the far edge gets
+    +1, and both shift by -0.5 after scaling. RoIs: (R, 5) with a batch
+    index leading, or (R, 4) = all batch 0 (the reference carries batch
+    ids in LoD, which is host-side metadata here)."""
     x = ctx.in_("Input")               # (N, C, H, W)
-    rois = ctx.in_("ROIs")             # (R, 4) xyxy in input coords
+    rois = ctx.in_("ROIs")
     trans = ctx.in_("Trans") if ctx.has_in("Trans") else None
-    spatial_scale = ctx.attr("spatial_scale", 1.0)
-    group = _to_int(ctx.attr("group_size", [1]))
-    pooled = _to_int(ctx.attr("pooled_height", 7)), _to_int(ctx.attr("pooled_width", 7))
+    no_trans = bool(ctx.attr("no_trans", trans is None)) or trans is None
+    scale = ctx.attr("spatial_scale", 1.0)
+    gh_, gw_ = _pair(ctx.attr("group_size", [1, 1]), (1, 1))
+    ph = _to_int(ctx.attr("pooled_height", 1))
+    pw = _to_int(ctx.attr("pooled_width", 1))
+    part_h, part_w = _pair(ctx.attr("part_size"), (ph, pw))
+    spp = _to_int(ctx.attr("sample_per_part", 1))
     trans_std = ctx.attr("trans_std", 0.1)
     n, c, h, w = x.shape
-    ph, pw = pooled
-    r = rois.shape[0]
-    x1 = rois[:, 0] * spatial_scale
-    y1 = rois[:, 1] * spatial_scale
-    x2 = rois[:, 2] * spatial_scale
-    y2 = rois[:, 3] * spatial_scale
-    rw = jnp.maximum(x2 - x1, 0.1)
-    rh = jnp.maximum(y2 - y1, 0.1)
-    bin_w = rw / pw
-    bin_h = rh / ph
-    iy = jnp.arange(ph)
-    ix = jnp.arange(pw)
-    cy = y1[:, None] + (iy[None] + 0.5) * bin_h[:, None]   # (R, ph)
-    cx = x1[:, None] + (ix[None] + 0.5) * bin_w[:, None]   # (R, pw)
-    if trans is not None:
-        dy = trans[:, 0].reshape(r, -1)[:, :ph * pw].reshape(r, ph, pw) * trans_std
-        dx = trans[:, 1].reshape(r, -1)[:, :ph * pw].reshape(r, ph, pw) * trans_std
+    out_dim = _to_int(ctx.attr("output_dim", c // (gh_ * gw_)))
+    if rois.shape[1] == 5:
+        bidx, boxes = rois[:, 0].astype(jnp.int32), rois[:, 1:]
     else:
-        dy = dx = jnp.zeros((r, ph, pw))
-    py = cy[:, :, None] + dy * rh[:, None, None]           # (R, ph, pw)
-    px = cx[:, None, :] + dx * rw[:, None, None]
-    y0 = jnp.floor(py); x0 = jnp.floor(px)
-    wy = py - y0; wx = px - x0
+        bidx, boxes = jnp.zeros(rois.shape[0], jnp.int32), rois
+    r = boxes.shape[0]
+    start_w = jnp.round(boxes[:, 0]) * scale - 0.5
+    start_h = jnp.round(boxes[:, 1]) * scale - 0.5
+    roi_w = jnp.maximum((jnp.round(boxes[:, 2]) + 1.0) * scale - 0.5 - start_w, 0.1)
+    roi_h = jnp.maximum((jnp.round(boxes[:, 3]) + 1.0) * scale - 0.5 - start_h, 0.1)
+    bin_w, bin_h = roi_w / pw, roi_h / ph
+    sub_w, sub_h = bin_w / spp, bin_h / spp
 
-    def samp(yy, xx):
-        yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
-        xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
-        flat = x[0].reshape(c, h * w)   # single image assumption (RoIs abs)
-        idx = (yi * w + xi).reshape(-1)
-        return flat[:, idx].reshape(c, r, ph, pw)
+    # static per-bin lookup tables (pooled dims are compile-time)
+    part_hi = np.floor(np.arange(ph) / ph * part_h).astype(np.int32)
+    part_wi = np.floor(np.arange(pw) / pw * part_w).astype(np.int32)
+    ghi = np.clip(np.floor(np.arange(ph) * gh_ / ph), 0, gh_ - 1).astype(np.int32)
+    gwi = np.clip(np.floor(np.arange(pw) * gw_ / pw), 0, gw_ - 1).astype(np.int32)
+    num_classes = 1 if no_trans else max(int(trans.shape[1]) // 2, 1)
+    ch_each = max(out_dim // num_classes, 1)
+    ctops = np.arange(out_dim)
+    class_ids = np.minimum(ctops // ch_each, num_classes - 1)
+    cmap = ((ctops[:, None, None] * gh_ + ghi[None, :, None]) * gw_
+            + gwi[None, None, :])                     # (out_dim, ph, pw)
 
-    v = (samp(y0, x0) * ((1 - wy) * (1 - wx))[None] +
-         samp(y0, x0 + 1) * ((1 - wy) * wx)[None] +
-         samp(y0 + 1, x0) * (wy * (1 - wx))[None] +
-         samp(y0 + 1, x0 + 1) * (wy * wx)[None])
-    out = v.transpose(1, 0, 2, 3)      # (R, C, ph, pw)
-    return {"Output": out, "Out": out, "TopCount": jnp.ones_like(out)}
+    if no_trans:
+        tx = ty = jnp.zeros((r, num_classes, ph, pw), x.dtype)
+    else:
+        tgrid = trans[:, :, part_hi][:, :, :, part_wi]  # (R, 2nc, ph, pw)
+        tv = tgrid.reshape(r, num_classes, 2, ph, pw) * trans_std
+        tx, ty = tv[:, :, 0], tv[:, :, 1]
+
+    # bin start per (R, class, ph, pw), then broadcast classes -> ctop
+    jj = jnp.arange(pw, dtype=x.dtype)
+    ii = jnp.arange(ph, dtype=x.dtype)
+    wstart = (jj[None, None, None, :] * bin_w[:, None, None, None]
+              + start_w[:, None, None, None] + tx * roi_w[:, None, None, None])
+    hstart = (ii[None, None, :, None] * bin_h[:, None, None, None]
+              + start_h[:, None, None, None] + ty * roi_h[:, None, None, None])
+    wstart = wstart[:, class_ids]                     # (R, out_dim, ph, pw)
+    hstart = hstart[:, class_ids]
+
+    def per_roi(b, ws, hs, sw_, sh_):
+        img = x[b]                                    # (C, H, W)
+        acc = jnp.zeros((out_dim, ph, pw), x.dtype)
+        cnt = jnp.zeros((out_dim, ph, pw), x.dtype)
+        for ihs in range(spp):
+            for iws in range(spp):
+                wc = ws + iws * sw_
+                hc = hs + ihs * sh_
+                ok = ((wc >= -0.5) & (wc <= w - 0.5)
+                      & (hc >= -0.5) & (hc <= h - 0.5))
+                wcl = jnp.clip(wc, 0.0, w - 1.0)
+                hcl = jnp.clip(hc, 0.0, h - 1.0)
+                x1i = jnp.floor(wcl).astype(jnp.int32)
+                x2i = jnp.ceil(wcl).astype(jnp.int32)
+                y1i = jnp.floor(hcl).astype(jnp.int32)
+                y2i = jnp.ceil(hcl).astype(jnp.int32)
+                dx_ = wcl - x1i
+                dy_ = hcl - y1i
+                v = (img[cmap, y1i, x1i] * (1 - dx_) * (1 - dy_)
+                     + img[cmap, y2i, x1i] * (1 - dx_) * dy_
+                     + img[cmap, y1i, x2i] * dx_ * (1 - dy_)
+                     + img[cmap, y2i, x2i] * dx_ * dy_)
+                acc = acc + jnp.where(ok, v, 0.0)
+                cnt = cnt + ok.astype(x.dtype)
+        out = jnp.where(cnt > 0, acc / jnp.maximum(cnt, 1.0), 0.0)
+        return out, cnt
+
+    out, cnt = jax.vmap(per_roi)(
+        bidx, wstart, hstart,
+        jnp.broadcast_to(sub_w[:, None, None, None], wstart.shape),
+        jnp.broadcast_to(sub_h[:, None, None, None], hstart.shape))
+    return {"Output": out, "Out": out, "TopCount": cnt}
 
 
 def _to_int(v):

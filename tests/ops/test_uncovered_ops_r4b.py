@@ -336,3 +336,93 @@ def test_multihead_attention_matches_manual():
     s /= s.sum(-1, keepdims=True)
     ref = ((s @ vh).transpose(0, 2, 1, 3).reshape(B, T, M)) @ wo
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# deformable_psroi_pooling (deformable_psroi_pooling_op.h:38-154)
+
+def _def_psroi_ref(x, rois, trans, no_trans, scale, out_dim, gh, gw,
+                   ph, pw, part_h, part_w, spp, trans_std):
+    n, c, hh, ww = x.shape
+    r = rois.shape[0]
+    num_classes = 1 if no_trans else trans.shape[1] // 2
+    ch_each = max(out_dim // num_classes, 1)
+    out = np.zeros((r, out_dim, ph, pw))
+    cnt = np.zeros((r, out_dim, ph, pw))
+
+    def bilin(data, xx, yy):
+        x1, x2 = int(np.floor(xx)), int(np.ceil(xx))
+        y1, y2 = int(np.floor(yy)), int(np.ceil(yy))
+        dx, dy = xx - x1, yy - y1
+        return ((1 - dx) * (1 - dy) * data[y1, x1]
+                + (1 - dx) * dy * data[y2, x1]
+                + dx * (1 - dy) * data[y1, x2]
+                + dx * dy * data[y2, x2])
+
+    for ri in range(r):
+        b = 0
+        sw_ = round(rois[ri, 0]) * scale - 0.5
+        sh_ = round(rois[ri, 1]) * scale - 0.5
+        ew = (round(rois[ri, 2]) + 1.0) * scale - 0.5
+        eh = (round(rois[ri, 3]) + 1.0) * scale - 0.5
+        rw_ = max(ew - sw_, 0.1)
+        rh_ = max(eh - sh_, 0.1)
+        bw_, bh_ = rw_ / pw, rh_ / ph
+        subw, subh = bw_ / spp, bh_ / spp
+        for ctop in range(out_dim):
+            cls = min(ctop // ch_each, num_classes - 1)
+            for i in range(ph):
+                for j in range(pw):
+                    p_h = int(np.floor(i / ph * part_h))
+                    p_w = int(np.floor(j / pw * part_w))
+                    if no_trans:
+                        tx = ty = 0.0
+                    else:
+                        tx = trans[ri, cls * 2, p_h, p_w] * trans_std
+                        ty = trans[ri, cls * 2 + 1, p_h, p_w] * trans_std
+                    ws = j * bw_ + sw_ + tx * rw_
+                    hs = i * bh_ + sh_ + ty * rh_
+                    g_w = min(max(int(np.floor(j * gw / pw)), 0), gw - 1)
+                    g_h = min(max(int(np.floor(i * gh / ph)), 0), gh - 1)
+                    cc = (ctop * gh + g_h) * gw + g_w
+                    s, m = 0.0, 0
+                    for ih in range(spp):
+                        for iw in range(spp):
+                            wx = ws + iw * subw
+                            hy = hs + ih * subh
+                            if wx < -0.5 or wx > ww - 0.5 or \
+                               hy < -0.5 or hy > hh - 0.5:
+                                continue
+                            wx = min(max(wx, 0.0), ww - 1.0)
+                            hy = min(max(hy, 0.0), hh - 1.0)
+                            s += bilin(x[b, cc], wx, hy)
+                            m += 1
+                    out[ri, ctop, i, j] = 0.0 if m == 0 else s / m
+                    cnt[ri, ctop, i, j] = m
+    return out, cnt
+
+
+@pytest.mark.parametrize("no_trans", [True, False])
+def test_deformable_psroi_matches_reference_loop(no_trans):
+    rng = np.random.RandomState(7)
+    gh = gw = 2
+    out_dim, ph, pw, spp = 3, 2, 2, 2
+    c = out_dim * gh * gw
+    x = rng.randn(1, c, 9, 11).astype(np.float32)
+    # one roi partially outside (exercises the skip/count path)
+    rois = np.array([[2, 1, 8, 7], [-3, -2, 4, 5]], np.float32)
+    trans = (rng.rand(2, 2, 2, 2).astype(np.float32) - 0.5)
+    ins = {"Input": x, "ROIs": rois}
+    if not no_trans:
+        ins["Trans"] = trans
+    got = _run_kernel(
+        "deformable_psroi_pooling", ins,
+        dict(no_trans=no_trans, spatial_scale=0.5, output_dim=out_dim,
+             group_size=[gh, gw], pooled_height=ph, pooled_width=pw,
+             part_size=[2, 2], sample_per_part=spp, trans_std=0.2))
+    ref_out, ref_cnt = _def_psroi_ref(
+        x.astype(np.float64), rois, None if no_trans else trans, no_trans,
+        0.5, out_dim, gh, gw, ph, pw, 2, 2, spp, 0.2)
+    np.testing.assert_allclose(np.asarray(got["Output"]), ref_out,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(got["TopCount"]), ref_cnt)

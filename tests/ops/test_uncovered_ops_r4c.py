@@ -1,0 +1,171 @@
+"""Uncovered-ops parity sweep, round 4 batch 5: the TensorArray op
+family (create/write/read/length), the py_func host-callback escape
+hatch, and the QAT scale-observer kernels — none had a direct numeric
+test before this sweep."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core import framework
+from paddle_tpu.core.executor import Scope, scope_guard
+
+from test_uncovered_ops_r4 import _run_kernel
+
+
+def _run(build, feed=None):
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        outs = build()
+    exe = fluid.Executor()
+    with scope_guard(Scope()):
+        exe.run(startup)
+        return exe.run(main, feed=feed or {}, fetch_list=list(outs))
+
+
+# ---------------------------------------------------------------------------
+# TensorArray ops through the program path (array_ops in
+# controlflow/tensor_array_*: write i, read i, length)
+
+def test_array_write_read_length():
+    def build():
+        x = layers.data("x", [2, 3], append_batch_size=False)
+        arr = layers.array_write(x, 0)
+        arr = layers.array_write(x * 2.0, 1, array=arr)
+        r0 = layers.array_read(arr, 0)
+        r1 = layers.array_read(arr, 1)
+        ln = layers.array_length(arr)
+        return r0, r1, ln
+
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    r0, r1, ln = _run(build, {"x": x})
+    np.testing.assert_allclose(r0, x)
+    np.testing.assert_allclose(r1, 2.0 * x)
+    assert int(ln) == 2
+
+
+def test_array_write_overwrite_and_dense_rule():
+    def build():
+        x = layers.data("x", [3], append_batch_size=False)
+        arr = layers.array_write(x, 0)
+        arr = layers.array_write(x + 1.0, 0, array=arr)   # overwrite
+        return (layers.array_read(arr, 0), layers.array_length(arr))
+
+    x = np.zeros(3, np.float32)
+    r0, ln = _run(build, {"x": x})
+    np.testing.assert_allclose(r0, x + 1.0)
+    assert int(ln) == 1
+    # sparse write (skipping an index) must fail loudly at trace time
+    with pytest.raises(ValueError, match="dense"):
+        _run(lambda: (layers.array_read(
+            layers.array_write(layers.fill_constant([1], "float32", 1.0), 5),
+            5),), {})
+
+
+# ---------------------------------------------------------------------------
+# py_func (fluid.layers.py_func -> jax.pure_callback)
+
+def test_py_func_host_callback_roundtrip():
+    def host_fn(a):
+        # arbitrary host-side numpy the device graph can't express
+        return np.sort(np.asarray(a), axis=-1).astype(np.float32)
+
+    def build():
+        x = layers.data("x", [2, 4], append_batch_size=False)
+        out = layers.create_global_var([2, 4], 0.0, "float32", name="pyout")
+        return (layers.py_func(host_fn, x, out),)
+
+    x = np.array([[3, 1, 2, 0], [9, 7, 8, 6]], np.float32)
+    (got,) = _run(build, {"x": x})
+    np.testing.assert_allclose(got, np.sort(x, axis=-1))
+
+
+# ---------------------------------------------------------------------------
+# QAT scale observers (quant_ops.py: EMA design-reduction of the
+# reference's window ring, documented in the kernel docstrings)
+
+def test_fake_quantize_range_abs_max_train_and_test():
+    x = np.array([-3.0, 0.5, 2.0], np.float32)
+    # first training step: zero InScale adopts the batch abs-max
+    got = _run_kernel("fake_quantize_range_abs_max",
+                      {"X": x, "InScale": np.float32(0.0)},
+                      dict(bit_length=8, moving_rate=0.9))
+    assert np.asarray(got["OutScale"]) == pytest.approx(3.0)
+    # quant-dequant at scale 3: x -> round(x/3*127)/127*3
+    ref = np.round(x / 3.0 * 127.0) / 127.0 * 3.0
+    np.testing.assert_allclose(np.asarray(got["Out"]), ref, rtol=1e-5)
+    # later step: EMA of the running scale
+    got2 = _run_kernel("fake_quantize_range_abs_max",
+                       {"X": x, "InScale": np.float32(4.0)},
+                       dict(bit_length=8, moving_rate=0.9))
+    assert np.asarray(got2["OutScale"]) == pytest.approx(0.9 * 4.0 + 0.1 * 3.0)
+    # inference: InScale frozen
+    got3 = _run_kernel("fake_quantize_range_abs_max",
+                       {"X": x, "InScale": np.float32(4.0)},
+                       dict(bit_length=8, moving_rate=0.9), is_test=True)
+    assert np.asarray(got3["OutScale"]) == pytest.approx(4.0)
+
+
+def test_moving_average_abs_max_scale_passthrough():
+    x = np.array([[-6.0, 1.0], [2.0, 3.0]], np.float32)
+    got = _run_kernel("moving_average_abs_max_scale",
+                      {"X": x, "InScale": np.float32(2.0)},
+                      dict(moving_rate=0.5))
+    np.testing.assert_allclose(np.asarray(got["Out"]), x)  # observer only
+    assert np.asarray(got["OutScale"]) == pytest.approx(0.5 * 2.0 + 0.5 * 6.0)
+
+
+def test_fake_channel_wise_dequantize_max_abs():
+    # two-level dequant: per-channel weight scale then activation scale
+    # (fake_dequantize_op.cc: Out = X * Scales[0][c] / max_range chained
+    # with Scales[1]/(2^(bits1-1)-1))
+    x = np.array([[127, -127], [64, 0]], np.float32)      # quantized int8
+    ch_scale = np.array([2.0, 4.0], np.float32)
+    got = _run_kernel("fake_channel_wise_dequantize_max_abs",
+                      {"X": x, "Scales": [ch_scale]},
+                      dict(quant_bits=[8], quant_axis=0))
+    ref = x * ch_scale[:, None] / 127.0
+    np.testing.assert_allclose(np.asarray(got["Out"]), ref, rtol=1e-6)
+    act_scale = np.float32(3.0)
+    got2 = _run_kernel("fake_channel_wise_dequantize_max_abs",
+                       {"X": x, "Scales": [ch_scale, act_scale]},
+                       dict(quant_bits=[8, 8], quant_axis=0))
+    ref2 = ref * 3.0 / 127.0
+    np.testing.assert_allclose(np.asarray(got2["Out"]), ref2, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# small remaining registry entries
+
+def test_conditional_select_and_is_empty():
+    x = np.array([1.0, 2.0], np.float32)
+    y = np.array([9.0, 8.0], np.float32)
+    got = _run_kernel("conditional_select",
+                      {"Cond": np.array([True]), "X": x, "Y": y})["Out"]
+    np.testing.assert_allclose(np.asarray(got), x)
+    assert bool(np.asarray(_run_kernel("is_empty",
+                                       {"X": np.zeros((0, 3))})["Out"]))
+    assert not bool(np.asarray(_run_kernel("is_empty", {"X": x})["Out"]))
+
+
+def test_tensor_array_sizes():
+    xs = [np.zeros((2, 3)), np.zeros((5, 3)), np.zeros((1, 3))]
+    got = _run_kernel("tensor_array_sizes", {"X": xs}, dict(axis=0))["Out"]
+    np.testing.assert_array_equal(np.asarray(got), [2, 5, 1])
+
+
+def test_depthwise_conv2d_transpose_matches_torch():
+    import torch
+    rng = np.random.RandomState(3)
+    c = 4
+    x = rng.randn(2, c, 5, 5).astype(np.float32)
+    wt = rng.randn(c, 1, 3, 3).astype(np.float32)   # (C_in, C_out/g, kh, kw)
+    got = np.asarray(_run_kernel(
+        "depthwise_conv2d_transpose", {"Input": x, "Filter": wt},
+        dict(strides=[2, 2], paddings=[1, 1], dilations=[1, 1],
+             groups=c))["Output"])
+    ref = torch.nn.functional.conv_transpose2d(
+        torch.tensor(x), torch.tensor(wt), stride=2, padding=1,
+        groups=c).numpy()
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
