@@ -25,3 +25,5 @@ from . import gan
 from . import detection_demo
 from . import label_semantic_roles
 from . import mobilenet
+from . import ocr_recognition
+from . import deeplab

@@ -10,6 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import register, DEVICE_INT
+from .nn_ops import _pair
 
 
 @register("iou_similarity")
@@ -112,10 +113,10 @@ def psroi_pool(ctx):
     scale = ctx.attr("spatial_scale", 1.0)
     n, c, h, w = x.shape
     bidx = rois[:, 0].astype(jnp.int32)
-    xs = jnp.round(rois[:, 1]) * scale
-    ys = jnp.round(rois[:, 2]) * scale
-    xe = (jnp.round(rois[:, 3]) + 1.0) * scale
-    ye = (jnp.round(rois[:, 4]) + 1.0) * scale
+    xs = _round_away(rois[:, 1]) * scale
+    ys = _round_away(rois[:, 2]) * scale
+    xe = (_round_away(rois[:, 3]) + 1.0) * scale
+    ye = (_round_away(rois[:, 4]) + 1.0) * scale
     rw = jnp.maximum(xe - xs, 0.1)
     rh = jnp.maximum(ye - ys, 0.1)
     bh = rh / ph                        # (R,)
@@ -840,12 +841,10 @@ def collect_fpn_proposals(ctx):
     return {"FpnRois": allr[idx], "RoisNum": jnp.asarray([k], jnp.int32)}
 
 
-def _pair(v, default):
-    if v is None:
-        return default
-    if isinstance(v, (list, tuple)):
-        return (int(v[0]), int(v[1])) if len(v) > 1 else (int(v[0]), int(v[0]))
-    return int(v), int(v)
+def _round_away(x):
+    """std::round parity: half rounds AWAY from zero (numpy/jnp round is
+    half-to-even, which lands .5 coords one pixel off)."""
+    return jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5)
 
 
 @register("deformable_psroi_pooling", "deformable_roi_pooling")
@@ -863,23 +862,28 @@ def deformable_roi_pooling(ctx):
     trans = ctx.in_("Trans") if ctx.has_in("Trans") else None
     no_trans = bool(ctx.attr("no_trans", trans is None)) or trans is None
     scale = ctx.attr("spatial_scale", 1.0)
-    gh_, gw_ = _pair(ctx.attr("group_size", [1, 1]), (1, 1))
+    gh_, gw_ = _pair(ctx.attr("group_size", [1, 1]))
     ph = _to_int(ctx.attr("pooled_height", 1))
     pw = _to_int(ctx.attr("pooled_width", 1))
-    part_h, part_w = _pair(ctx.attr("part_size"), (ph, pw))
+    part_h, part_w = _pair(ctx.attr("part_size") or [ph, pw])
     spp = _to_int(ctx.attr("sample_per_part", 1))
     trans_std = ctx.attr("trans_std", 0.1)
     n, c, h, w = x.shape
     out_dim = _to_int(ctx.attr("output_dim", c // (gh_ * gw_)))
+    if out_dim * gh_ * gw_ > c:
+        raise ValueError(
+            "deformable_psroi_pooling: the PS channel map needs "
+            f"output_dim*group_h*group_w <= C (got {out_dim}*{gh_}*{gw_} "
+            f"> {c}); a clipped gather would silently read channel {c - 1}")
     if rois.shape[1] == 5:
         bidx, boxes = rois[:, 0].astype(jnp.int32), rois[:, 1:]
     else:
         bidx, boxes = jnp.zeros(rois.shape[0], jnp.int32), rois
     r = boxes.shape[0]
-    start_w = jnp.round(boxes[:, 0]) * scale - 0.5
-    start_h = jnp.round(boxes[:, 1]) * scale - 0.5
-    roi_w = jnp.maximum((jnp.round(boxes[:, 2]) + 1.0) * scale - 0.5 - start_w, 0.1)
-    roi_h = jnp.maximum((jnp.round(boxes[:, 3]) + 1.0) * scale - 0.5 - start_h, 0.1)
+    start_w = _round_away(boxes[:, 0]) * scale - 0.5
+    start_h = _round_away(boxes[:, 1]) * scale - 0.5
+    roi_w = jnp.maximum((_round_away(boxes[:, 2]) + 1.0) * scale - 0.5 - start_w, 0.1)
+    roi_h = jnp.maximum((_round_away(boxes[:, 3]) + 1.0) * scale - 0.5 - start_h, 0.1)
     bin_w, bin_h = roi_w / pw, roi_h / ph
     sub_w, sub_h = bin_w / spp, bin_h / spp
 

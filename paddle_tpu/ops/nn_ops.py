@@ -16,7 +16,8 @@ from . import register
 
 def _pair(v, n=2):
     if isinstance(v, (list, tuple)):
-        return tuple(int(x) for x in v)
+        t = tuple(int(x) for x in v)
+        return t * n if len(t) == 1 else t
     return (int(v),) * n
 
 

@@ -1,0 +1,41 @@
+"""DeepLabV3+ segmentation family: overfit a fixed batch (real
+convergence gate) and check the predicted mask + mean IoU on it."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core import framework
+from paddle_tpu.core.executor import Scope, scope_guard
+from paddle_tpu.models import deeplab
+
+
+def test_deeplab_overfits_fixed_batch():
+    rng = np.random.RandomState(1)
+    B, NC, H, W = 2, 4, 16, 16
+    imgs = rng.rand(B, 3, H, W).astype(np.float32)
+    # learnable structured masks: quadrant labels, shifted per image
+    yy, xx = np.meshgrid(np.arange(H), np.arange(W), indexing="ij")
+    base = (yy // (H // 2)) * 2 + (xx // (W // 2))
+    masks = np.stack([base % NC, (base + 1) % NC]).astype(np.int64)
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        images, label, loss, logits = deeplab.build_train_net(
+            img_shape=(3, H, W), num_classes=NC, base_filters=8)
+        pred = layers.transpose(logits, [0, 2, 3, 1])
+        miou, _, _ = layers.mean_iou(
+            layers.reshape(layers.argmax(pred, axis=-1), [-1, H, W]),
+            label, NC)
+        fluid.optimizer.AdamOptimizer(learning_rate=3e-3).minimize(loss)
+
+    exe = fluid.Executor()
+    feed = {"pixels": imgs, "label": masks}
+    with scope_guard(Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(80):
+            lv, mv = exe.run(main, feed=feed, fetch_list=[loss, miou])
+            losses.append(float(lv))
+        assert losses[-1] < 0.05 * losses[0], (losses[0], losses[-1])
+        assert float(np.ravel(mv)[0]) > 0.95, mv

@@ -350,6 +350,10 @@ def _def_psroi_ref(x, rois, trans, no_trans, scale, out_dim, gh, gw,
     out = np.zeros((r, out_dim, ph, pw))
     cnt = np.zeros((r, out_dim, ph, pw))
 
+    def rnd(v):
+        # std::round: half away from zero (python round() is half-even)
+        return np.floor(abs(v) + 0.5) * np.sign(v)
+
     def bilin(data, xx, yy):
         x1, x2 = int(np.floor(xx)), int(np.ceil(xx))
         y1, y2 = int(np.floor(yy)), int(np.ceil(yy))
@@ -361,10 +365,10 @@ def _def_psroi_ref(x, rois, trans, no_trans, scale, out_dim, gh, gw,
 
     for ri in range(r):
         b = 0
-        sw_ = round(rois[ri, 0]) * scale - 0.5
-        sh_ = round(rois[ri, 1]) * scale - 0.5
-        ew = (round(rois[ri, 2]) + 1.0) * scale - 0.5
-        eh = (round(rois[ri, 3]) + 1.0) * scale - 0.5
+        sw_ = rnd(rois[ri, 0]) * scale - 0.5
+        sh_ = rnd(rois[ri, 1]) * scale - 0.5
+        ew = (rnd(rois[ri, 2]) + 1.0) * scale - 0.5
+        eh = (rnd(rois[ri, 3]) + 1.0) * scale - 0.5
         rw_ = max(ew - sw_, 0.1)
         rh_ = max(eh - sh_, 0.1)
         bw_, bh_ = rw_ / pw, rh_ / ph
@@ -409,8 +413,9 @@ def test_deformable_psroi_matches_reference_loop(no_trans):
     out_dim, ph, pw, spp = 3, 2, 2, 2
     c = out_dim * gh * gw
     x = rng.randn(1, c, 9, 11).astype(np.float32)
-    # one roi partially outside (exercises the skip/count path)
-    rois = np.array([[2, 1, 8, 7], [-3, -2, 4, 5]], np.float32)
+    # .5 corners exercise the half-away-from-zero rounding; the second
+    # roi sits partially outside (exercises the skip/count path)
+    rois = np.array([[2.5, 1.5, 8, 7], [-3, -2, 4.5, 5]], np.float32)
     trans = (rng.rand(2, 2, 2, 2).astype(np.float32) - 0.5)
     ins = {"Input": x, "ROIs": rois}
     if not no_trans:
