@@ -134,7 +134,7 @@ class Parameter(Variable):
 
     def __init__(self, block, name, shape, dtype, trainable=True,
                  optimize_attr=None, regularizer=None, gradient_clip_attr=None,
-                 do_model_average=False, **kwargs):
+                 do_model_average=True, **kwargs):
         super().__init__(block, name=name, shape=shape, dtype=dtype,
                          persistable=True, stop_gradient=not trainable, **kwargs)
         self.trainable = trainable

@@ -39,20 +39,35 @@ class PyReader:
         self.capacity = capacity
         self._reader = None
         self._places = None
+        self._sample_list = False
 
     def decorate_sample_list_generator(self, reader, places=None):
+        """Reader yields LISTS OF SAMPLES per iteration (paddle.batch
+        output); a DataFeeder stacks them into batch arrays."""
         self._reader = reader
         self._places = places
+        self._sample_list = True
 
-    decorate_batch_generator = decorate_sample_list_generator
+    def decorate_batch_generator(self, reader, places=None):
+        """Reader yields already-batched arrays (tuple/list/dict)."""
+        self._reader = reader
+        self._places = places
+        self._sample_list = False
+
+    # reference alias pairs (layers/io.py:515-519): tensor_provider ==
+    # batch_generator (pre-batched), paddle_reader == sample_list
     decorate_paddle_reader = decorate_sample_list_generator
+    decorate_tensor_provider = decorate_batch_generator
 
     def __iter__(self):
         from ..reader.dataloader import DataLoader
         if self._reader is None:
             raise RuntimeError("PyReader: call decorate_*_generator first")
         loader = DataLoader(self.feed_list, capacity=self.capacity)
-        loader.set_batch_generator(self._reader, self._places)
+        if self._sample_list:
+            loader.set_sample_list_generator(self._reader, self._places)
+        else:
+            loader.set_batch_generator(self._reader, self._places)
         names = [v.name for v in self.feed_list]
         for batch in loader:
             if isinstance(batch, dict):

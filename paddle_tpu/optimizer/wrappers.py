@@ -19,11 +19,19 @@ from .optimizers import Optimizer
 
 
 class ExponentialMovingAverage:
+    """Parity: fluid.optimizer.ExponentialMovingAverage (optimizer.py:
+    EMA_t = decay*EMA_{t-1} + (1-decay)*theta_t, apply() divides by the
+    bias correction (1 - decay^t), thres_steps schedules the effective
+    decay to min(decay, (t+1)/(t+10)))."""
+
     def __init__(self, decay=0.999, thres_steps=None, name=None):
         self._decay = decay
+        self._thres_steps = thres_steps
         self._name = name or ""
         self._ema_vars = {}
         self._params = []
+        self._count_name = None
+        self._decay_name = None
 
     def update(self):
         """Append EMA update ops for every trainable param (call after
@@ -31,8 +39,45 @@ class ExponentialMovingAverage:
         helper = LayerHelper("ema")
         program = default_main_program()
         block = program.global_block()
+        cnt = helper.create_global_variable(
+            persistable=True, name=unique_name.generate("ema_step"),
+            shape=(), dtype="float32")
+        cnt.stop_gradient = True
+        init_mod.ConstantInitializer(0.0)(cnt)
+        self._count_name = cnt.name
+        block.append_op("increment", {"X": cnt}, {"Out": cnt}, {"step": 1.0})
+        # scheduled decay var: min(decay, (thres+1)/(thres+10)) when
+        # thres_steps rides along (reference _get_ema_decay's Switch)
+        decay_var = helper.create_global_variable(
+            persistable=True, name=unique_name.generate("ema_decay"),
+            shape=(), dtype="float32")
+        decay_var.stop_gradient = True
+        init_mod.ConstantInitializer(self._decay)(decay_var)
+        self._decay_name = decay_var.name
+        if self._thres_steps is not None:
+            t = self._thres_steps
+            num = helper.create_variable_for_type_inference("float32", t.shape)
+            den = helper.create_variable_for_type_inference("float32", t.shape)
+            block.append_op("cast", {"X": t}, {"Out": num},
+                            {"out_dtype": "float32"})
+            block.append_op("scale", {"X": num}, {"Out": den},
+                            {"scale": 1.0, "bias": 10.0})
+            block.append_op("scale", {"X": num}, {"Out": num},
+                            {"scale": 1.0, "bias": 1.0})
+            ratio = helper.create_variable_for_type_inference("float32", t.shape)
+            block.append_op("elementwise_div", {"X": num, "Y": den},
+                            {"Out": ratio}, {"axis": -1})
+            cap = helper.create_variable_for_type_inference("float32", ())
+            block.append_op("fill_constant", {}, {"Out": cap},
+                            {"shape": [], "dtype": "float32",
+                             "value": self._decay})
+            block.append_op("elementwise_min", {"X": ratio, "Y": cap},
+                            {"Out": decay_var}, {"axis": -1})
+        omd = helper.create_variable_for_type_inference("float32", ())
+        block.append_op("scale", {"X": decay_var}, {"Out": omd},
+                        {"scale": -1.0, "bias": 1.0})
         for p in program.all_parameters():
-            if not p.trainable:
+            if not p.trainable or getattr(p, "do_model_average", None) is False:
                 continue
             ema = helper.create_global_variable(
                 persistable=True,
@@ -42,13 +87,14 @@ class ExponentialMovingAverage:
             init_mod.ConstantInitializer(0.0)(ema)
             self._ema_vars[p.name] = ema.name
             self._params.append(p)
-            # ema = decay*ema + (1-decay)*p
+            # ema = decay*ema + (1-decay)*p, decay read from the
+            # (possibly scheduled) decay var
             scaled = helper.create_variable_for_type_inference(p.dtype, p.shape)
-            block.append_op("scale", {"X": ema}, {"Out": scaled},
-                            {"scale": self._decay})
+            block.append_op("elementwise_mul", {"X": ema, "Y": decay_var},
+                            {"Out": scaled}, {"axis": -1})
             contrib = helper.create_variable_for_type_inference(p.dtype, p.shape)
-            block.append_op("scale", {"X": p}, {"Out": contrib},
-                            {"scale": 1.0 - self._decay})
+            block.append_op("elementwise_mul", {"X": p, "Y": omd},
+                            {"Out": contrib}, {"axis": -1})
             block.append_op("elementwise_add", {"X": scaled, "Y": contrib},
                             {"Out": ema}, {"axis": -1})
 
@@ -56,12 +102,20 @@ class ExponentialMovingAverage:
     def apply(self, executor=None, need_restore=True):
         scope = global_scope()
         backup = {}
+        t = float(np.asarray(scope.get(self._count_name)).reshape(-1)[0]) \
+            if self._count_name and scope.get(self._count_name) is not None \
+            else 0.0
+        d = float(np.asarray(scope.get(self._decay_name)).reshape(-1)[0]) \
+            if self._decay_name and scope.get(self._decay_name) is not None \
+            else self._decay
+        # reference bias correction: EMA_t / (1 - decay^t)
+        corr = 1.0 - d ** t if t > 0 else 1.0
         for p in self._params:
             ema_name = self._ema_vars[p.name]
             if scope.get(ema_name) is None or scope.get(p.name) is None:
                 continue
             backup[p.name] = scope.get(p.name)
-            scope.set(p.name, scope.get(ema_name))
+            scope.set(p.name, scope.get(ema_name) / corr)
         try:
             yield
         finally:
@@ -73,7 +127,13 @@ class ExponentialMovingAverage:
 
 
 class ModelAverage:
-    """Parity: fluid.optimizer.ModelAverage — running average of params."""
+    """Parity: fluid.optimizer.ModelAverage — running average of params.
+
+    Design reduction: the reference maintains a 3-tier shifting window
+    (sum_1/2/3 restricted to ~max_average_window updates); here apply()
+    averages over ALL updates since startup. Same fixed point for the
+    common eval-at-end-of-training use; pass smaller training runs if the
+    windowing matters."""
 
     def __init__(self, average_window_rate=0.15, min_average_window=10000,
                  max_average_window=10000, **kwargs):
@@ -90,7 +150,8 @@ class ModelAverage:
         init_mod.ConstantInitializer(0.0)(cnt)
         block.append_op("increment", {"X": cnt}, {"Out": cnt}, {"step": 1.0})
         for p in program.all_parameters():
-            if not p.trainable:
+            # reference ModelAverage honors ParamAttr(do_model_average)
+            if not p.trainable or getattr(p, "do_model_average", None) is False:
                 continue
             s = helper.create_global_variable(
                 persistable=True, name=unique_name.generate(p.name + ".sum"),
@@ -181,12 +242,25 @@ class LookaheadOptimizer:
         program = loss.block.program
         block = program.global_block()
         sync, inv = _periodic_flag(helper, block, self.k, "lookahead_step")
+        from ..core.framework import Variable, default_startup_program
+        sblock = (startup_program or default_startup_program()).global_block()
         for p, _ in params_grads:
             slow = helper.create_global_variable(
                 persistable=True, name=unique_name.generate(p.name + ".slow"),
                 shape=p.shape, dtype=p.dtype)
             slow.stop_gradient = True
-            init_mod.ConstantInitializer(0.0)(slow)
+            # reference startup: slow starts AT the param (optimizer.py
+            # LookaheadOptimizer startup assign), not at zero — a zero
+            # slow would drag params toward 0 at the first sync.
+            s_out = Variable(sblock, name=slow.name, shape=slow.shape,
+                             dtype=slow.dtype, persistable=True)
+            sblock.vars[slow.name] = s_out
+            if p.name not in sblock.vars:
+                raise RuntimeError(
+                    f"LookaheadOptimizer: param {p.name} has no startup "
+                    "initializer; call minimize after building the net")
+            sblock.append_op("assign", {"X": sblock.vars[p.name]},
+                             {"Out": s_out})
             # slow' = slow + alpha*(fast-slow); applied only on sync steps
             diff = helper.create_variable_for_type_inference(p.dtype, p.shape)
             block.append_op("elementwise_sub", {"X": p, "Y": slow},

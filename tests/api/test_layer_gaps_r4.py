@@ -1,0 +1,151 @@
+"""Round-4 layer-surface gap tests: public fluid.layers functions that
+had no direct test (py_reader family, step counter, sequence
+first/last, sums, multi_box_head channel math)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core import framework
+from paddle_tpu.core.executor import Scope, scope_guard
+
+
+def test_autoincreased_step_counter_bumps_per_run():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        counter = layers.autoincreased_step_counter(begin=1, step=1)
+    exe = fluid.Executor()
+    with scope_guard(Scope()):
+        exe.run(startup)
+        got = [int(exe.run(main, feed={}, fetch_list=[counter])[0][0])
+               for _ in range(3)]
+    assert got == [1, 2, 3], got
+
+
+def test_py_reader_feeds_a_training_graph():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        reader = layers.py_reader(capacity=4, shapes=[(-1, 3), (-1, 1)],
+                                  dtypes=["float32", "float32"])
+        x, y = layers.read_file(reader)
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+
+    def gen():
+        rng = np.random.RandomState(0)
+        for _ in range(5):
+            xb = rng.rand(8, 3).astype(np.float32)
+            yield xb, xb.sum(1, keepdims=True).astype(np.float32)
+
+    reader.decorate_tensor_provider(gen)
+    exe = fluid.Executor()
+    with scope_guard(Scope()):
+        exe.run(startup)
+        reader.start()
+        n = 0
+        for feed in reader:
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+            n += 1
+        assert n == 5
+        assert np.isfinite(lv).all()
+
+
+def test_create_py_reader_by_data_and_double_buffer():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = layers.data("xin", [2], append_batch_size=True)
+        reader = layers.create_py_reader_by_data(capacity=2, feed_list=[x])
+        reader = layers.double_buffer(reader)     # identity marker
+        (slot,) = layers.read_file(reader)
+        out = layers.scale(slot, scale=2.0)
+    reader.decorate_tensor_provider(
+        lambda: iter([(np.ones((1, 2), np.float32),)]))
+    exe = fluid.Executor()
+    with scope_guard(Scope()):
+        exe.run(startup)
+        for feed in reader:
+            (o,) = exe.run(main, feed=feed, fetch_list=[out])
+        np.testing.assert_allclose(o, 2.0 * np.ones((1, 2)))
+
+
+def test_sequence_first_last_step():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = layers.data("x", [4, 3], append_batch_size=True)
+        ln = layers.data("len", [1], dtype="int64", append_batch_size=True)
+        first = layers.sequence_first_step(x, length=ln)
+        last = layers.sequence_last_step(x, length=ln)
+    xv = np.arange(24, dtype=np.float32).reshape(2, 4, 3)
+    lens = np.array([[2], [4]], np.int64)
+    exe = fluid.Executor()
+    with scope_guard(Scope()):
+        exe.run(startup)
+        f, l = exe.run(main, feed={"x": xv, "len": lens},
+                       fetch_list=[first, last])
+    np.testing.assert_allclose(f, xv[:, 0])         # first step per row
+    np.testing.assert_allclose(l[0], xv[0, 1])      # len 2 -> index 1
+    np.testing.assert_allclose(l[1], xv[1, 3])      # len 4 -> index 3
+
+
+def test_sums_accumulates_list():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        a = layers.fill_constant([2, 2], "float32", 1.0)
+        b = layers.fill_constant([2, 2], "float32", 2.5)
+        s = layers.sums([a, b])
+    exe = fluid.Executor()
+    with scope_guard(Scope()):
+        exe.run(startup)
+        (got,) = exe.run(main, feed={}, fetch_list=[s])
+    np.testing.assert_allclose(got, 3.5 * np.ones((2, 2)))
+
+
+def test_multi_box_head_channel_math_matches_priors():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        img = layers.data("image", [3, 64, 64], append_batch_size=True)
+        f1 = layers.data("f1", [8, 8, 8], append_batch_size=True)
+        f2 = layers.data("f2", [8, 4, 4], append_batch_size=True)
+        locs, confs, boxes, vars_ = layers.multi_box_head(
+            [f1, f2], img, base_size=64, num_classes=3,
+            aspect_ratios=[[2.0], [2.0, 3.0]],
+            min_sizes=[12.0, 24.0], max_sizes=[24.0, 48.0], flip=True)
+    exe = fluid.Executor()
+    feed = {"image": np.zeros((1, 3, 64, 64), np.float32),
+            "f1": np.zeros((1, 8, 8, 8), np.float32),
+            "f2": np.zeros((1, 8, 4, 4), np.float32)}
+    with scope_guard(Scope()):
+        exe.run(startup)
+        lv, bv = exe.run(main, feed=feed, fetch_list=[locs, boxes])
+    # priors per cell: map1 = 1*(1+2)+1 = 4, map2 = 1*(1+4)+1 = 6
+    expect = 8 * 8 * 4 + 4 * 4 * 6
+    assert bv.shape[0] == expect, (bv.shape, expect)
+    assert lv.shape[1] == expect, (lv.shape, expect)
+
+
+def test_py_reader_sample_list_path_stacks_batches():
+    # decorate_sample_list_generator receives paddle.batch-style output
+    # (lists of per-sample tuples) and must stack them via DataFeeder
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        reader = layers.py_reader(capacity=2, shapes=[(-1, 3), (-1, 1)],
+                                  dtypes=["float32", "int64"])
+        x, y = layers.read_file(reader)
+        out = layers.reduce_sum(x, dim=[0, 1])
+
+    def sample_batches():
+        for _ in range(2):
+            yield [(np.ones(3, np.float32), np.array([1], np.int64)),
+                   (2 * np.ones(3, np.float32), np.array([0], np.int64))]
+
+    reader.decorate_sample_list_generator(sample_batches)
+    exe = fluid.Executor()
+    with scope_guard(Scope()):
+        exe.run(startup)
+        n = 0
+        for feed in reader:
+            (s,) = exe.run(main, feed=feed, fetch_list=[out])
+            assert float(np.ravel(s)[0]) == pytest.approx(9.0)  # (1+2)*3
+            n += 1
+        assert n == 2

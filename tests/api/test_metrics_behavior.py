@@ -1,0 +1,103 @@
+"""Behavioral tests for the host-side streaming metrics
+(paddle_tpu/metrics.py) against the reference's documented semantics
+(python/paddle/fluid/metrics.py) — previously only presence-audited."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import metrics
+
+
+def test_recall_reference_docstring_example():
+    # fluid metrics.py Recall docstring: expected 3/4
+    preds = np.array([[0.1], [0.7], [0.8], [0.9], [0.2],
+                      [0.2], [0.3], [0.5], [0.8], [0.6]])
+    labels = np.array([[0], [1], [1], [1], [1],
+                       [0], [0], [0], [0], [0]])
+    m = metrics.Recall()
+    m.update(preds=preds, labels=labels)
+    assert m.eval() == pytest.approx(3.0 / 4.0)
+
+
+def test_precision_binary_counts_accumulate():
+    m = metrics.Precision()
+    m.update(np.array([1.0, 1.0, 0.0]), np.array([1, 0, 1]))
+    assert m.eval() == pytest.approx(1 / 2)          # tp=1, fp=1
+    m.update(np.array([0.9, 0.8]), np.array([1, 1]))  # +2 tp
+    assert m.eval() == pytest.approx(3 / 4)
+    m.reset()
+    m.update(np.array([0.0]), np.array([0]))
+    assert m.eval() == 0.0                           # no positives predicted
+
+
+def test_accuracy_weighted_mean():
+    m = metrics.Accuracy()
+    m.update(value=0.5, weight=2.0)
+    m.update(value=1.0, weight=1.0)
+    assert m.eval() == pytest.approx(2.0 / 3.0)
+    m.reset()
+    with pytest.raises(ValueError):
+        m.eval()
+
+
+def test_edit_distance_average_and_instance_error():
+    m = metrics.EditDistance()
+    m.update(np.array([0.0, 2.0, 1.0]), seq_num=3)
+    avg, err = m.eval()
+    assert avg == pytest.approx(1.0)
+    assert err == pytest.approx(2.0 / 3.0)
+    m.update(np.array([0.0]), seq_num=1)
+    avg2, err2 = m.eval()
+    assert avg2 == pytest.approx(3.0 / 4.0)
+    assert err2 == pytest.approx(2.0 / 4.0)
+
+
+def test_chunk_evaluator_f1():
+    m = metrics.ChunkEvaluator()
+    m.update(num_infer_chunks=10, num_label_chunks=8, num_correct_chunks=4)
+    p, r, f1 = m.eval()
+    assert p == pytest.approx(0.4)
+    assert r == pytest.approx(0.5)
+    assert f1 == pytest.approx(2 * 0.4 * 0.5 / 0.9)
+
+
+def test_auc_separates_perfect_ranking():
+    m = metrics.Auc(num_thresholds=1023)
+    preds = np.array([[0.9, 0.1], [0.8, 0.2], [0.3, 0.7], [0.1, 0.9]])
+    labels = np.array([0, 0, 1, 1])
+    m.update(preds, labels)
+    assert m.eval() == pytest.approx(1.0, abs=1e-3)
+    m.reset()
+    # reversed ranking -> AUC ~ 0
+    m.update(preds[::-1], labels)
+    assert m.eval() == pytest.approx(0.0, abs=1e-3)
+
+
+def test_composite_metric_fans_out():
+    c = metrics.CompositeMetric()
+    p, r = metrics.Precision(), metrics.Recall()
+    c.add_metric(p)
+    c.add_metric(r)
+    c.update(np.array([1.0, 0.0]), np.array([1, 1]))
+    got = c.eval()
+    assert got == [1.0, 0.5]
+
+
+def test_detection_map_hand_case():
+    m = metrics.DetectionMAP(overlap_threshold=0.5)
+    # 2 gt boxes of class 0; detections: one perfect match (score .9),
+    # one miss (score .8, wrong place), one duplicate on the matched gt
+    gt = np.array([[0, 0, 0, 10, 10], [0, 20, 20, 30, 30]], np.float32)
+    det = np.array([
+        [0, 0.9, 0, 0, 10, 10],      # tp
+        [0, 0.8, 50, 50, 60, 60],    # fp
+        [0, 0.7, 0, 0, 10, 10],      # duplicate -> fp
+    ], np.float32)
+    m.update(det, gt)
+    # recall points: after tp@.9 recall=.5 precision=1; never reaches 1.0
+    # 11-point AP = (6 levels <= 0.5) * 1.0 / 11
+    assert m.eval() == pytest.approx(6 / 11, abs=1e-6)
+    # second image: the missed gt found -> recall improves
+    m.update(np.array([[0, 0.95, 0, 0, 10, 10]], np.float32),
+             np.array([[0, 0, 0, 10, 10]], np.float32))
+    assert m.eval() > 6 / 11

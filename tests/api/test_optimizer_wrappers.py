@@ -1,0 +1,149 @@
+"""Behavioral tests for the wrapper optimizers (EMA / ModelAverage /
+Lookahead / LARS) — previously only presence-audited. Goldens are
+host-side transcriptions of the reference formulas
+(fluid/optimizer.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core import framework
+from paddle_tpu.core.executor import Scope, scope_guard, global_scope
+
+
+def _build_sgd_net(lr=0.1):
+    x = layers.data("x", [2], append_batch_size=False)
+    w = layers.create_parameter([2], "float32", name="w",
+                               default_initializer=fluid.initializer.ConstantInitializer(1.0))
+    loss = layers.mean(layers.elementwise_mul(w, x))
+    opt = fluid.optimizer.SGDOptimizer(learning_rate=lr)
+    return x, w, loss, opt
+
+
+def test_ema_bias_corrected_apply_and_restore():
+    decay = 0.5
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x, w, loss, opt = _build_sgd_net(lr=0.1)
+        opt.minimize(loss)
+        ema = fluid.optimizer.ExponentialMovingAverage(decay)
+        ema.update()
+    exe = fluid.Executor()
+    xv = np.array([1.0, 2.0], np.float32)     # grad of mean(w*x) wrt w = x/2
+    with scope_guard(Scope()):
+        exe.run(startup)
+        w_hist, ema_ref = [], np.zeros(2)
+        for _ in range(3):
+            exe.run(main, feed={"x": xv}, fetch_list=[loss])
+            w_now = np.asarray(global_scope().get("w"))
+            ema_ref = decay * ema_ref + (1 - decay) * w_now
+            w_hist.append(w_now)
+        w_before = np.asarray(global_scope().get("w"))
+        with ema.apply():
+            applied = np.asarray(global_scope().get("w"))
+            # reference bias correction: EMA_t / (1 - decay^t), t = 3
+            np.testing.assert_allclose(
+                applied, ema_ref / (1 - decay ** 3), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(global_scope().get("w")), w_before, rtol=1e-6)
+
+
+def test_model_average_applies_mean():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x, w, loss, opt = _build_sgd_net(lr=0.1)
+        opt.minimize(loss)
+        ma = fluid.optimizer.ModelAverage(0.15)
+    exe = fluid.Executor()
+    xv = np.array([2.0, 4.0], np.float32)
+    with scope_guard(Scope()):
+        exe.run(startup)
+        seen = []
+        for _ in range(4):
+            exe.run(main, feed={"x": xv}, fetch_list=[loss])
+            seen.append(np.asarray(global_scope().get("w")))
+        with ma.apply():
+            np.testing.assert_allclose(
+                np.asarray(global_scope().get("w")),
+                np.mean(seen, axis=0), rtol=1e-5)
+
+
+def test_lookahead_slow_starts_at_param_and_syncs():
+    alpha, k, lr = 0.5, 2, 0.1
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x, w, loss, opt = _build_sgd_net(lr=lr)
+        fluid.optimizer.LookaheadOptimizer(opt, alpha=alpha, k=k).minimize(loss)
+    exe = fluid.Executor()
+    xv = np.array([1.0, 1.0], np.float32)
+    with scope_guard(Scope()):
+        exe.run(startup)
+        # reference recurrence: fast steps by SGD each step; every k-th
+        # step slow += alpha*(fast-slow) and fast snaps to slow
+        fast = np.ones(2)
+        slow = fast.copy()                     # startup assign, NOT zero
+        for step in range(1, 5):
+            exe.run(main, feed={"x": xv}, fetch_list=[loss])
+            fast = fast - lr * xv / 2.0
+            if step % k == 0:
+                slow = slow + alpha * (fast - slow)
+                fast = slow.copy()
+            np.testing.assert_allclose(
+                np.asarray(global_scope().get("w")), fast, rtol=1e-5,
+                err_msg=f"step {step}")
+
+
+def test_lars_momentum_matches_formula():
+    # lars_momentum_op: local_lr = lr * lars_coeff * ||p|| /
+    #   (||g|| + lars_weight_decay * ||p||);
+    # v = mu*v + local_lr*(g + wd*p); p -= v
+    lr, mu, coeff, wd = 0.1, 0.9, 0.001, 0.0005
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = layers.data("x", [2], append_batch_size=False)
+        w = layers.create_parameter([2], "float32", name="w",
+                                   default_initializer=fluid.initializer.ConstantInitializer(2.0))
+        loss = layers.mean(layers.elementwise_mul(w, x))
+        fluid.optimizer.LarsMomentumOptimizer(
+            learning_rate=lr, momentum=mu, lars_coeff=coeff,
+            lars_weight_decay=wd).minimize(loss)
+    exe = fluid.Executor()
+    xv = np.array([1.0, 3.0], np.float32)
+    with scope_guard(Scope()):
+        exe.run(startup)
+        p = np.full(2, 2.0)
+        v = np.zeros(2)
+        for step in range(2):
+            exe.run(main, feed={"x": xv}, fetch_list=[loss])
+            g = xv / 2.0
+            local_lr = lr * coeff * np.linalg.norm(p) / (
+                np.linalg.norm(g) + wd * np.linalg.norm(p))
+            v = mu * v + local_lr * (g + wd * p)
+            p = p - v
+            np.testing.assert_allclose(
+                np.asarray(global_scope().get("w")), p, rtol=1e-5,
+                err_msg=f"step {step}")
+
+
+def test_ema_thres_steps_schedules_decay():
+    # reference: effective decay = min(decay, (t+1)/(t+10)); with
+    # thres_steps counting 0,1,2 the schedule stays below decay=0.999
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x, w, loss, opt = _build_sgd_net(lr=0.1)
+        opt.minimize(loss)
+        thres = layers.autoincreased_step_counter(begin=0, step=1)
+        ema = fluid.optimizer.ExponentialMovingAverage(0.999,
+                                                       thres_steps=thres)
+        ema.update()
+    exe = fluid.Executor()
+    xv = np.array([1.0, 2.0], np.float32)
+    with scope_guard(Scope()):
+        exe.run(startup)
+        for t in range(3):
+            exe.run(main, feed={"x": xv}, fetch_list=[loss])
+            got = float(np.ravel(np.asarray(
+                global_scope().get(ema._decay_name)))[0])
+            want = min(0.999, (t + 1.0) / (t + 10.0))
+            assert got == pytest.approx(want, rel=1e-6), (t, got, want)
