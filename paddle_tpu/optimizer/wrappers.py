@@ -6,8 +6,6 @@ Lookahead sync is a branch-free select on a step counter (TPU-friendly —
 no host round-trip, stays inside the jitted step).
 """
 
-import contextlib
-
 import numpy as np
 
 from ..core import unique_name
@@ -16,6 +14,29 @@ from ..core.layer_helper import LayerHelper
 from ..core.executor import global_scope
 from .. import initializer as init_mod
 from .optimizers import Optimizer
+
+
+class _SwapContext:
+    """Returned by the wrappers' apply(): the param swap has ALREADY
+    happened by the time this object exists (fluid's apply(executor)
+    runs its swap program eagerly), so both fluid call styles work:
+
+        with ema.apply(exe): evaluate()            # auto-restore
+        ema.apply(exe, need_restore=False)         # bare call is effective
+        evaluate(); ema.restore(exe)
+    """
+
+    def __init__(self, owner, need_restore):
+        self._owner = owner
+        self._need_restore = need_restore
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._need_restore:
+            self._owner.restore()
+        return False
 
 
 class ExponentialMovingAverage:
@@ -79,29 +100,41 @@ class ExponentialMovingAverage:
         for p in program.all_parameters():
             if not p.trainable or getattr(p, "do_model_average", None) is False:
                 continue
+            # accumulator held in float32 regardless of param dtype:
+            # decay=0.999 is not representable in bf16 (rounds to
+            # 0.996) and mixed-dtype muls would promote the scope slot
+            # anyway; apply() casts back to the param dtype.
             ema = helper.create_global_variable(
                 persistable=True,
                 name=unique_name.generate(p.name + ".ema"),
-                shape=p.shape, dtype=p.dtype)
+                shape=p.shape, dtype="float32")
             ema.stop_gradient = True
             init_mod.ConstantInitializer(0.0)(ema)
             self._ema_vars[p.name] = ema.name
             self._params.append(p)
             # ema = decay*ema + (1-decay)*p, decay read from the
             # (possibly scheduled) decay var
-            scaled = helper.create_variable_for_type_inference(p.dtype, p.shape)
+            pf = helper.create_variable_for_type_inference("float32", p.shape)
+            block.append_op("cast", {"X": p}, {"Out": pf},
+                            {"out_dtype": "float32"})
+            scaled = helper.create_variable_for_type_inference(
+                "float32", p.shape)
             block.append_op("elementwise_mul", {"X": ema, "Y": decay_var},
                             {"Out": scaled}, {"axis": -1})
-            contrib = helper.create_variable_for_type_inference(p.dtype, p.shape)
-            block.append_op("elementwise_mul", {"X": p, "Y": omd},
+            contrib = helper.create_variable_for_type_inference(
+                "float32", p.shape)
+            block.append_op("elementwise_mul", {"X": pf, "Y": omd},
                             {"Out": contrib}, {"axis": -1})
             block.append_op("elementwise_add", {"X": scaled, "Y": contrib},
                             {"Out": ema}, {"axis": -1})
 
-    @contextlib.contextmanager
     def apply(self, executor=None, need_restore=True):
+        """Swap params to bias-corrected EMA values NOW (fluid parity:
+        apply(executor) runs its swap program eagerly); returns a
+        context that restores on exit unless need_restore=False, in
+        which case call restore() when done."""
+        import jax.numpy as jnp
         scope = global_scope()
-        backup = {}
         t = float(np.asarray(scope.get(self._count_name)).reshape(-1)[0]) \
             if self._count_name and scope.get(self._count_name) is not None \
             else 0.0
@@ -110,20 +143,28 @@ class ExponentialMovingAverage:
             else self._decay
         # reference bias correction: EMA_t / (1 - decay^t)
         corr = 1.0 - d ** t if t > 0 else 1.0
+        # merge into any live backup rather than overwrite: a repeated
+        # or nested apply() must never clobber the stashed TRAINING
+        # weights with already-swapped values
+        backup = dict(getattr(self, "_backup", {}) or {})
         for p in self._params:
             ema_name = self._ema_vars[p.name]
-            if scope.get(ema_name) is None or scope.get(p.name) is None:
+            cur = scope.get(p.name)
+            if scope.get(ema_name) is None or cur is None:
                 continue
-            backup[p.name] = scope.get(p.name)
-            scope.set(p.name, scope.get(ema_name) / corr)
-        try:
-            yield
-        finally:
-            if need_restore:
-                for name, val in backup.items():
-                    scope.set(name, val)
+            backup.setdefault(p.name, cur)
+            scope.set(p.name, jnp.asarray(
+                scope.get(ema_name) / corr, dtype=cur.dtype))
+        self._backup = backup
+        return _SwapContext(self, need_restore)
 
-    restore = apply
+    def restore(self, executor=None):
+        """Parity: fluid ExponentialMovingAverage.restore(executor) —
+        bring back the training weights stashed by the last apply()."""
+        scope = global_scope()
+        for name, val in getattr(self, "_backup", {}).items():
+            scope.set(name, val)
+        self._backup = {}
 
 
 class ModelAverage:
@@ -153,36 +194,48 @@ class ModelAverage:
             # reference ModelAverage honors ParamAttr(do_model_average)
             if not p.trainable or getattr(p, "do_model_average", None) is False:
                 continue
+            # float32 running sum: a bf16 sum saturates its mantissa
+            # after ~256 steps; apply() casts back to the param dtype
             s = helper.create_global_variable(
                 persistable=True, name=unique_name.generate(p.name + ".sum"),
-                shape=p.shape, dtype=p.dtype)
+                shape=p.shape, dtype="float32")
             s.stop_gradient = True
             init_mod.ConstantInitializer(0.0)(s)
-            block.append_op("elementwise_add", {"X": s, "Y": p}, {"Out": s},
+            pf = helper.create_variable_for_type_inference("float32", p.shape)
+            block.append_op("cast", {"X": p}, {"Out": pf},
+                            {"out_dtype": "float32"})
+            block.append_op("elementwise_add", {"X": s, "Y": pf}, {"Out": s},
                             {"axis": -1})
             self._sums[p.name] = s.name
             self._params.append(p)
 
-    @contextlib.contextmanager
     def apply(self, executor=None, need_restore=True):
+        """Swap params to their running average NOW (fluid parity);
+        restore on context exit, or via restore() after a bare
+        apply(need_restore=False) call."""
         import jax.numpy as jnp
         scope = global_scope()
-        backup = {}
-        cnt = np.maximum(np.asarray(scope.get(self._count_name)), 1.0)
+        cnt_arr = scope.get(self._count_name)
+        cnt = np.maximum(np.asarray(cnt_arr), 1.0) \
+            if cnt_arr is not None else 1.0
+        backup = dict(getattr(self, "_backup", {}) or {})
         for p in self._params:
-            if scope.get(self._sums[p.name]) is None:
+            cur = scope.get(p.name)
+            if scope.get(self._sums[p.name]) is None or cur is None:
                 continue
-            backup[p.name] = scope.get(p.name)
-            scope.set(p.name, scope.get(self._sums[p.name]) / cnt)
-        try:
-            yield
-        finally:
-            if need_restore:
-                for name, val in backup.items():
-                    scope.set(name, val)
+            backup.setdefault(p.name, cur)
+            scope.set(p.name, jnp.asarray(
+                scope.get(self._sums[p.name]) / cnt, dtype=cur.dtype))
+        self._backup = backup
+        return _SwapContext(self, need_restore)
 
     def restore(self, executor=None):
-        pass
+        """Parity: fluid ModelAverage.restore(executor) — bring back
+        the training weights stashed by the last apply()."""
+        scope = global_scope()
+        for name, val in getattr(self, "_backup", {}).items():
+            scope.set(name, val)
+        self._backup = {}
 
 
 def _periodic_flag(helper, block, k, counter_name):

@@ -49,6 +49,93 @@ def test_ema_bias_corrected_apply_and_restore():
             np.asarray(global_scope().get("w")), w_before, rtol=1e-6)
 
 
+def test_ema_fluid_style_restore_method():
+    """Fluid eval flow: apply(need_restore=False); evaluate();
+    restore(exe). restore must be a plain method that brings back the
+    stashed training weights — not an alias of the apply context
+    manager (which as a bare call would be a silent no-op)."""
+    decay = 0.5
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x, w, loss, opt = _build_sgd_net(lr=0.1)
+        opt.minimize(loss)
+        ema = fluid.optimizer.ExponentialMovingAverage(decay)
+        ema.update()
+    exe = fluid.Executor()
+    xv = np.array([1.0, 2.0], np.float32)
+    with scope_guard(Scope()):
+        exe.run(startup)
+        for _ in range(2):
+            exe.run(main, feed={"x": xv}, fetch_list=[loss])
+        w_train = np.asarray(global_scope().get("w"))
+        # fluid style: a BARE apply() call must take effect eagerly
+        ema.apply(exe, need_restore=False)
+        w_applied = np.asarray(global_scope().get("w"))
+        assert not np.allclose(w_applied, w_train)
+        # exiting with need_restore=False left EMA weights in place
+        np.testing.assert_allclose(
+            np.asarray(global_scope().get("w")), w_applied, rtol=1e-6)
+        ema.restore(exe)
+        np.testing.assert_allclose(
+            np.asarray(global_scope().get("w")), w_train, rtol=1e-6)
+        # idempotent second restore keeps training weights
+        ema.restore(exe)
+        np.testing.assert_allclose(
+            np.asarray(global_scope().get("w")), w_train, rtol=1e-6)
+        # applied values must keep the param dtype (EMA accumulator is
+        # f32 internally)
+        ema.apply(exe, need_restore=False)
+        assert global_scope().get("w").dtype == np.float32
+        ema.restore(exe)
+
+
+def test_ema_repeated_apply_never_loses_training_weights():
+    """A second apply() before restore() must not clobber the stashed
+    TRAINING weights with already-swapped EMA values."""
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x, w, loss, opt = _build_sgd_net(lr=0.1)
+        opt.minimize(loss)
+        ema = fluid.optimizer.ExponentialMovingAverage(0.5)
+        ema.update()
+    exe = fluid.Executor()
+    xv = np.array([1.0, 2.0], np.float32)
+    with scope_guard(Scope()):
+        exe.run(startup)
+        for _ in range(2):
+            exe.run(main, feed={"x": xv}, fetch_list=[loss])
+        w_train = np.asarray(global_scope().get("w"))
+        ema.apply(exe, need_restore=False)
+        ema.apply(exe, need_restore=False)   # repeated, no restore between
+        ema.restore(exe)
+        np.testing.assert_allclose(
+            np.asarray(global_scope().get("w")), w_train, rtol=1e-6)
+
+
+def test_model_average_bare_apply_and_restore():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x, w, loss, opt = _build_sgd_net(lr=0.1)
+        opt.minimize(loss)
+        ma = fluid.optimizer.ModelAverage(0.15)
+    exe = fluid.Executor()
+    xv = np.array([1.0, 2.0], np.float32)
+    with scope_guard(Scope()):
+        exe.run(startup)
+        ws = []
+        for _ in range(3):
+            exe.run(main, feed={"x": xv}, fetch_list=[loss])
+            ws.append(np.asarray(global_scope().get("w")))
+        w_train = ws[-1]
+        ma.apply(exe, need_restore=False)
+        np.testing.assert_allclose(
+            np.asarray(global_scope().get("w")),
+            np.mean(ws, axis=0), rtol=1e-5)
+        ma.restore(exe)
+        np.testing.assert_allclose(
+            np.asarray(global_scope().get("w")), w_train, rtol=1e-6)
+
+
 def test_model_average_applies_mean():
     main, startup = framework.Program(), framework.Program()
     with framework.program_guard(main, startup):

@@ -5,15 +5,15 @@ Run on a real TPU when the tunnel is up:
 
     python tools/tune_flash.py --seq 512 --batch 8 --heads 12 --dim 64
 
-Then export the winner for bench/training runs:
-
-    export PADDLE_TPU_FLASH_BLOCK_Q=... PADDLE_TPU_FLASH_BLOCK_K=...
-
-(ops/pallas/flash.py default_blocks() reads those knobs.)
+The winner is persisted to perf/flash_tuned.json, which
+ops/pallas/flash.py default_blocks() reads in every later process —
+the end-of-round bench picks up the tuned blocks with no env plumbing.
+PADDLE_TPU_FLASH_BLOCK_Q / _K env vars still override both.
 """
 
 import argparse
 import itertools
+import json
 import os
 import sys
 import time
@@ -85,6 +85,21 @@ def main():
     dt, bq, bk = min(results)
     print(f"\nbest: PADDLE_TPU_FLASH_BLOCK_Q={bq} "
           f"PADDLE_TPU_FLASH_BLOCK_K={bk}  ({dt * 1e3:.3f} ms/step)")
+    # persist only results measured on real hardware — a CPU smoke run
+    # must not steer TPU block sizes
+    backend = jax.default_backend()
+    if backend == "tpu":
+        # the reader's own path helper: writer and reader cannot diverge
+        path = flash.tuned_blocks_path()
+        with open(path, "w") as f:
+            json.dump({"block_q": bq, "block_k": bk,
+                       "ms_per_step": round(dt * 1e3, 3),
+                       "backend": backend,
+                       "device_kind": jax.devices()[0].device_kind,
+                       "seq": args.seq, "batch": args.batch,
+                       "heads": args.heads, "dim": args.dim,
+                       "backward": bool(args.backward)}, f, indent=1)
+        print(f"persisted -> {os.path.normpath(path)}")
     return 0
 
 
