@@ -24,7 +24,10 @@ class _SwapContext:
         with ema.apply(exe): evaluate()            # auto-restore
         ema.apply(exe, need_restore=False)         # bare call is effective
         evaluate(); ema.restore(exe)
-    """
+
+    Context exit unwinds ONE apply frame (so nested `with` blocks keep
+    the outer swap live); a bare restore() unwinds the whole stack back
+    to the original training weights."""
 
     def __init__(self, owner, need_restore):
         self._owner = owner
@@ -35,11 +38,35 @@ class _SwapContext:
 
     def __exit__(self, exc_type, exc, tb):
         if self._need_restore:
-            self._owner.restore()
+            self._owner._restore_frame()
         return False
 
 
-class ExponentialMovingAverage:
+class _SwapStackMixin:
+    """Backup bookkeeping shared by EMA / ModelAverage: each apply()
+    pushes {param: pre-swap value}; frames unwind LIFO so the oldest
+    (true training) weights always land last."""
+
+    def _push_frame(self, frame):
+        if not hasattr(self, "_backup_stack"):
+            self._backup_stack = []
+        self._backup_stack.append(frame)
+
+    def _restore_frame(self):
+        scope = global_scope()
+        stack = getattr(self, "_backup_stack", [])
+        if stack:
+            for name, val in stack.pop().items():
+                scope.set(name, val)
+
+    def restore(self, executor=None):
+        """Parity: fluid's restore(executor) — bring back the training
+        weights stashed by apply(), however many applies deep."""
+        while getattr(self, "_backup_stack", []):
+            self._restore_frame()
+
+
+class ExponentialMovingAverage(_SwapStackMixin):
     """Parity: fluid.optimizer.ExponentialMovingAverage (optimizer.py:
     EMA_t = decay*EMA_{t-1} + (1-decay)*theta_t, apply() divides by the
     bias correction (1 - decay^t), thres_steps schedules the effective
@@ -143,31 +170,20 @@ class ExponentialMovingAverage:
             else self._decay
         # reference bias correction: EMA_t / (1 - decay^t)
         corr = 1.0 - d ** t if t > 0 else 1.0
-        # merge into any live backup rather than overwrite: a repeated
-        # or nested apply() must never clobber the stashed TRAINING
-        # weights with already-swapped values
-        backup = dict(getattr(self, "_backup", {}) or {})
+        frame = {}
         for p in self._params:
             ema_name = self._ema_vars[p.name]
             cur = scope.get(p.name)
             if scope.get(ema_name) is None or cur is None:
                 continue
-            backup.setdefault(p.name, cur)
+            frame[p.name] = cur
             scope.set(p.name, jnp.asarray(
                 scope.get(ema_name) / corr, dtype=cur.dtype))
-        self._backup = backup
+        self._push_frame(frame)
         return _SwapContext(self, need_restore)
 
-    def restore(self, executor=None):
-        """Parity: fluid ExponentialMovingAverage.restore(executor) —
-        bring back the training weights stashed by the last apply()."""
-        scope = global_scope()
-        for name, val in getattr(self, "_backup", {}).items():
-            scope.set(name, val)
-        self._backup = {}
 
-
-class ModelAverage:
+class ModelAverage(_SwapStackMixin):
     """Parity: fluid.optimizer.ModelAverage — running average of params.
 
     Design reduction: the reference maintains a 3-tier shifting window
@@ -218,24 +234,16 @@ class ModelAverage:
         cnt_arr = scope.get(self._count_name)
         cnt = np.maximum(np.asarray(cnt_arr), 1.0) \
             if cnt_arr is not None else 1.0
-        backup = dict(getattr(self, "_backup", {}) or {})
+        frame = {}
         for p in self._params:
             cur = scope.get(p.name)
             if scope.get(self._sums[p.name]) is None or cur is None:
                 continue
-            backup.setdefault(p.name, cur)
+            frame[p.name] = cur
             scope.set(p.name, jnp.asarray(
                 scope.get(self._sums[p.name]) / cnt, dtype=cur.dtype))
-        self._backup = backup
+        self._push_frame(frame)
         return _SwapContext(self, need_restore)
-
-    def restore(self, executor=None):
-        """Parity: fluid ModelAverage.restore(executor) — bring back
-        the training weights stashed by the last apply()."""
-        scope = global_scope()
-        for name, val in getattr(self, "_backup", {}).items():
-            scope.set(name, val)
-        self._backup = {}
 
 
 def _periodic_flag(helper, block, k, counter_name):

@@ -112,6 +112,33 @@ def test_ema_repeated_apply_never_loses_training_weights():
             np.asarray(global_scope().get("w")), w_train, rtol=1e-6)
 
 
+def test_ema_nested_apply_contexts_unwind_one_level_each():
+    """Inner `with apply()` exit must return to the OUTER swap's values
+    (still EMA), not unwind all the way to training weights."""
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x, w, loss, opt = _build_sgd_net(lr=0.1)
+        opt.minimize(loss)
+        ema = fluid.optimizer.ExponentialMovingAverage(0.5)
+        ema.update()
+    exe = fluid.Executor()
+    xv = np.array([1.0, 2.0], np.float32)
+    with scope_guard(Scope()):
+        exe.run(startup)
+        for _ in range(2):
+            exe.run(main, feed={"x": xv}, fetch_list=[loss])
+        w_train = np.asarray(global_scope().get("w"))
+        with ema.apply(exe):
+            w_outer = np.asarray(global_scope().get("w"))
+            with ema.apply(exe):
+                pass
+            # still inside the outer context: EMA weights must be live
+            np.testing.assert_allclose(
+                np.asarray(global_scope().get("w")), w_outer, rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(global_scope().get("w")), w_train, rtol=1e-6)
+
+
 def test_model_average_bare_apply_and_restore():
     main, startup = framework.Program(), framework.Program()
     with framework.program_guard(main, startup):
