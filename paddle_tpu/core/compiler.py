@@ -41,6 +41,14 @@ class BuildStrategy:
         self.enable_inplace = True
         self.fuse_all_reduce_ops = True
         self.fuse_elewise_add_act_ops = True  # XLA always fuses; flag is a no-op
+        # Parity: reference compiler.py:322 swaps batch_norm ->
+        # sync_batch_norm ops when set. Under GSPMD the swap doesn't
+        # change numerics — the jitted step computes batch stats over
+        # the GLOBAL (all-device) batch either way, which is exactly
+        # what sync BN asks for (tests/parallel/test_sync_batch_norm.py
+        # proves dp-sharded == full-batch single-device) — but the op
+        # rewrite is still applied so serialized programs record intent.
+        self.sync_batch_norm = False
 
 
 class ExecutionStrategy:
@@ -68,6 +76,15 @@ class CompiledProgram:
             p.jax_device() for p in places]
         self._mesh = Mesh(np.array(devices), ("dp",))
         self.places = places
+        if getattr(self.build_strategy, "sync_batch_norm", False):
+            # reference pass parity (compiler.py:322): mark BN ops as
+            # the sync variant; same kernel under GSPMD (see
+            # BuildStrategy.sync_batch_norm comment), rewrite recorded
+            # in the program for serialization/inspection
+            for block in self.program.blocks:
+                for op in block.ops:
+                    if op.type == "batch_norm":
+                        op.type = "sync_batch_norm"
         return self
 
     def with_mesh(self, mesh):
