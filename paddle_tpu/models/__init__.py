@@ -27,3 +27,4 @@ from . import label_semantic_roles
 from . import mobilenet
 from . import ocr_recognition
 from . import deeplab
+from . import ctr_models
