@@ -168,11 +168,23 @@ def _compile_train_step(build_net, make_feed, make_opt, batch):
     with scope_guard(scope):
         exe.run(startup)
     _phase("startup done; making feed")
-    feed = make_feed()
+    import jax
+    from paddle_tpu.core.executor import _canon_feed
+    # move the static bench batch to device ONCE (int64 policy applied
+    # at the boundary first): the timed loop then measures the train
+    # step itself, not N re-uploads of the same buffers through the
+    # tunnel — the framework's device_prefetch path gives real input
+    # pipelines the same overlap (core/executor.py train_from_dataset)
+    feed = {k: jax.device_put(_canon_feed(k, v))
+            for k, v in make_feed().items()}
 
     def step():
+        # return_numpy=False keeps fetches as jax.Arrays so successive
+        # steps pipeline under async dispatch; callers block once at
+        # the end of the timed window (the standard JAX measurement)
         with scope_guard(scope):
-            return exe.run(main, feed=feed, fetch_list=[loss])
+            return exe.run(main, feed=feed, fetch_list=[loss],
+                           return_numpy=False)
 
     step.executor = exe
     return step, 3 * fwd_flops
@@ -354,15 +366,22 @@ def bench_one(batch, seq_len, n_steps):
     import numpy as np
     from paddle_tpu.ops.pallas import flash
 
+    import jax
+
+    def _phase(msg):
+        print(f"bench: [{time.strftime('%H:%M:%S')}] b{batch} {msg}",
+              file=sys.stderr, flush=True)
+
     trace0 = flash.TRACE_COUNT
     t_build0 = time.perf_counter()
     step, tokens_per_step, step_flops = build_step(batch, seq_len)
     t_build = time.perf_counter() - t_build0
     # warmup: first call compiles (~20-40s on TPU), second confirms cache
+    _phase("tracing + XLA compile (first step)")
     t_c0 = time.perf_counter()
-    step()
+    jax.block_until_ready(step())
     t_compile = time.perf_counter() - t_c0
-    step()
+    jax.block_until_ready(step())
     print(f"bench: batch={batch} build {t_build:.1f}s "
           f"compile+first-step {t_compile:.1f}s", file=sys.stderr)
     flash_engaged = flash.TRACE_COUNT > trace0
@@ -371,13 +390,19 @@ def bench_one(batch, seq_len, n_steps):
     out = None
     for _ in range(n_steps):
         out = step()
-    # out is numpy (return_numpy) so the step is host-synchronized
+    # steps dispatched asynchronously (return_numpy=False); one block
+    # closes the timed window — per-step host sync would serialize the
+    # tunnel RTT into every step
+    jax.block_until_ready(out)
     dt = time.perf_counter() - t0
-    assert np.isfinite(out[0]).all(), "loss went non-finite during bench"
+    _phase(f"timed loop done: {n_steps} steps in {dt:.1f}s")
+    assert np.isfinite(np.asarray(out[0])).all(), \
+        "loss went non-finite during bench"
     # cross-check the analytic FLOPs/step against XLA's own cost model;
     # a big gap means the MFU denominator (and so MFU itself) is suspect
     xla_flops = None
     try:
+        _phase("fetching cost analysis")
         exe = getattr(step, "executor", None)
         if exe is not None:
             xla_flops = float(exe.last_cost_analysis().get("flops", 0)) or None
@@ -404,7 +429,9 @@ def bench_one(batch, seq_len, n_steps):
         try:
             # cheap: _last_compiled() is already memoized by the
             # cost-analysis call above
+            _phase("serializing optimized HLO text")
             hlo_text = step.executor.last_compiled_text()
+            _phase(f"HLO text {len(hlo_text) / 2**20:.1f} MiB")
         except Exception as e:
             print(f"bench: HLO dump unavailable: {e}", file=sys.stderr)
     return {

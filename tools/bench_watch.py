@@ -77,10 +77,19 @@ def run_step(name, cmd, env=None, timeout_s=3600, stdout_path=None):
         rc = -1
         stdout = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) \
             else (e.stdout or "")
-        stderr = f"timeout after {timeout_s}s"
+        # keep the partial stderr: bench.py's phase logs are the only
+        # way to see WHERE a timed-out run stalled
+        partial = (e.stderr or b"").decode() if isinstance(e.stderr, bytes) \
+            else (e.stderr or "")
+        stderr = partial + f"\ntimeout after {timeout_s}s"
     if stdout_path:
         with open(os.path.join(PERF, stdout_path), "w") as f:
             f.write(stdout)
+        # archive stderr too: bench.py's phase logs live there, and
+        # they are the only way to see WHERE a hard-timeout run stalled
+        # (the r4 ernie step died with 0 batches and no archived phases)
+        with open(os.path.join(PERF, stdout_path + ".stderr"), "w") as f:
+            f.write(stderr if isinstance(stderr, str) else str(stderr))
     log(f"step {name}: rc={rc} in {time.time() - t0:.0f}s "
         f"(stderr tail: {stderr.strip().splitlines()[-1] if stderr.strip() else ''!r})")
     return rc
