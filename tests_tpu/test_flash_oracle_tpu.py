@@ -107,6 +107,42 @@ def test_flash_bwd_tpu(bias_kind):
     assert err <= tol, f"max grad err {err} > {tol}"
 
 
+def test_flash_bench_shape_bwd_runs_promptly():
+    """The r4 ernie bench died with zero completed batches on hardware.
+    This isolates the headline attention shape (BERT-base: h=12, t=512,
+    d=64, bf16, fwd+bwd) from the rest of the bench: if the Mosaic
+    kernel compiles and steps in seconds here, a future bench stall is
+    not the flash kernel's fault. The bound is a hang tripwire (minutes
+    of slack), not a perf assertion."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas import flash
+
+    b, h, t, d = 8, 12, 512, 64
+    q, k, v = (_rand((b, h, t, d), s, jnp.bfloat16) for s in (0, 1, 2))
+
+    def loss(q, k, v):
+        o = flash.flash_attention(q, k, v, causal=True)
+        return jnp.sum(o.astype(jnp.float32))
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    t0 = time.time()
+    jax.block_until_ready(g(q, k, v))       # compile + first step
+    t_compile = time.time() - t0
+    t0 = time.time()
+    for _ in range(5):
+        out = g(q, k, v)
+    jax.block_until_ready(out)
+    t_steps = time.time() - t0
+    _RESULTS.append({"case": "bench_shape_bwd_bf16",
+                     "compile_s": round(t_compile, 2),
+                     "steps5_s": round(t_steps, 2),
+                     "shapes": {"b": b, "h": h, "t": t, "d": d},
+                     "passed": t_compile < 300 and t_steps < 60})
+    assert t_compile < 300, f"flash compile took {t_compile:.0f}s"
+    assert t_steps < 60, f"5 fwd+bwd steps took {t_steps:.0f}s"
+
+
 def test_flash_actually_compiled_not_interpreted():
     """On a real TPU the kernel must take the compiled Mosaic path, not
     the interpreter fallback — otherwise the perf story is fiction."""
