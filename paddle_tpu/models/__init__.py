@@ -28,3 +28,4 @@ from . import mobilenet
 from . import ocr_recognition
 from . import deeplab
 from . import ctr_models
+from . import tsm

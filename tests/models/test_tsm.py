@@ -1,0 +1,65 @@
+"""TSM video model: temporal_shift semantics golden + overfit gate."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core import framework
+from paddle_tpu.core.executor import Scope, scope_guard
+from paddle_tpu.models import tsm
+
+
+def _shift_ref(x, seg_num, ratio=0.25):
+    """Numpy transcription of the reference temporal_shift_op.h:52-72:
+    channels [0, c*ratio) read frame t-1 (forward shift), channels
+    [c*ratio, 2*c*ratio) read frame t+1 (backward shift), the rest
+    pass through; out-of-range frames contribute zero."""
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    c1 = int(c * ratio)
+    x5 = x.reshape(n, seg_num, c, h, w)
+    out = np.zeros_like(x5)
+    out[:, 1:, :c1] = x5[:, :-1, :c1]
+    out[:, :-1, c1:2 * c1] = x5[:, 1:, c1:2 * c1]
+    out[:, :, 2 * c1:] = x5[:, :, 2 * c1:]
+    return out.reshape(nt, c, h, w)
+
+
+@pytest.mark.parametrize("ratio", [0.25, 0.125])
+def test_temporal_shift_matches_reference_semantics(ratio):
+    rng = np.random.RandomState(0)
+    n, t, c, h, w = 2, 4, 16, 3, 3
+    x = rng.randn(n * t, c, h, w).astype(np.float32)
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        xv = layers.data("x", shape=[c, h, w], dtype="float32")
+        y = layers.temporal_shift(xv, seg_num=t, shift_ratio=ratio)
+    with scope_guard(Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        got, = exe.run(main, feed={"x": x}, fetch_list=[y])
+    np.testing.assert_allclose(np.asarray(got), _shift_ref(x, t, ratio),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_tsm_overfits_fixed_batch():
+    rng = np.random.RandomState(1)
+    b, t, s, classes = 8, 4, 16, 4
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        video, label, loss, pred = tsm.build_train_net(
+            seg_num=t, class_dim=classes, image_size=s)
+        fluid.optimizer.AdamOptimizer(learning_rate=3e-3).minimize(loss)
+    feed = {
+        "video": rng.randn(b, t, 3, s, s).astype(np.float32),
+        "label": rng.randint(0, classes, (b, 1)).astype(np.int64),
+    }
+    losses = []
+    with scope_guard(Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        for _ in range(60):
+            out, = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(out).reshape(-1)[0]))
+    assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
