@@ -22,6 +22,7 @@ PERF = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "perf")
 
 _RESULTS = []
+_TIMING = []
 
 
 def _record(name, max_err, tol, shapes):
@@ -33,7 +34,7 @@ def _record(name, max_err, tol, shapes):
 @pytest.fixture(scope="session", autouse=True)
 def _evidence_file():
     yield
-    if not _RESULTS:
+    if not _RESULTS and not _TIMING:
         return
     os.makedirs(PERF, exist_ok=True)
     import jax
@@ -44,6 +45,7 @@ def _evidence_file():
             "device_kind": getattr(dev, "device_kind", str(dev)),
             "platform": dev.platform,
             "cases": _RESULTS,
+            "timing": _TIMING,
         }, f, indent=1)
 
 
@@ -134,11 +136,13 @@ def test_flash_bench_shape_bwd_runs_promptly():
         out = g(q, k, v)
     jax.block_until_ready(out)
     t_steps = time.time() - t0
-    _RESULTS.append({"case": "bench_shape_bwd_bf16",
-                     "compile_s": round(t_compile, 2),
-                     "steps5_s": round(t_steps, 2),
-                     "shapes": {"b": b, "h": h, "t": t, "d": d},
-                     "passed": t_compile < 300 and t_steps < 60})
+    # timing cases live in their own list: "cases" entries all carry
+    # max_abs_err/tol and tools iterate them as such
+    _TIMING.append({"case": "bench_shape_bwd_bf16",
+                    "compile_s": round(t_compile, 2),
+                    "steps5_s": round(t_steps, 2),
+                    "shapes": {"b": b, "h": h, "t": t, "d": d},
+                    "passed": t_compile < 300 and t_steps < 60})
     assert t_compile < 300, f"flash compile took {t_compile:.0f}s"
     assert t_steps < 60, f"5 fwd+bwd steps took {t_steps:.0f}s"
 

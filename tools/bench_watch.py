@@ -83,6 +83,15 @@ def run_step(name, cmd, env=None, timeout_s=3600, stdout_path=None):
             else (e.stderr or "")
         stderr = partial + f"\ntimeout after {timeout_s}s"
     if stdout_path:
+        if not stdout.strip() and rc != 0:
+            # never leave a zero-byte "evidence" file: a failed step
+            # records WHY as parseable JSON instead (same schema as the
+            # hand-written failure artifacts: an 'error' reason string)
+            tail = stderr.strip().splitlines()[-1] if stderr.strip() else ""
+            stdout = json.dumps({"failed": True, "rc": rc, "step": name,
+                                 "error": tail,
+                                 "stderr_file": "perf/" + stdout_path
+                                                + ".stderr"}) + "\n"
         with open(os.path.join(PERF, stdout_path), "w") as f:
             f.write(stdout)
         # archive stderr too: bench.py's phase logs live there, and
