@@ -390,3 +390,14 @@ def test_mobilenet_v2_builds_and_steps():
 
     losses = _train(feed, loss, steps=3, lr=1e-3)
     assert np.isfinite(losses).all()
+
+
+def test_se_resnext_overfits_fixed_batch():
+    np.random.seed(5)
+    image, label, loss, pred = resnet.build_se_resnext_train_net(
+        class_dim=4, image_shape=(3, 16, 16))
+    xs = np.random.randn(16, 3, 16, 16).astype(np.float32)
+    ys = np.random.randint(0, 4, (16, 1)).astype(np.int64)
+    losses = _train(lambda i: {"image": xs, "label": ys}, loss, steps=80,
+                    lr=2e-3)
+    assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
