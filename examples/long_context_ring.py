@@ -18,6 +18,15 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu" \
+        and "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    # a 1-device CPU run would silently demo sp=1 (no ring at all) —
+    # give the example its 8 virtual devices like distributed_training.py
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8"
+                               ).strip()
+
 import jax  # noqa: E402
 
 if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":

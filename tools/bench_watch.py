@@ -104,6 +104,19 @@ def run_step(name, cmd, env=None, timeout_s=3600, stdout_path=None):
     return rc
 
 
+def _tunnel_still_ok(after_step):
+    """Quick (<=120s) wedge-safe re-probe between ladder steps. The r4
+    window died mid-ladder and every later step burned its full init
+    watchdog (600s) or subprocess budget (2400s) against a wedged
+    tunnel — ~100 minutes of guaranteed hangs. A failed probe aborts
+    the rest of the ladder instead; the watcher commits what landed."""
+    if probe() is not None:
+        return True
+    log(f"tunnel wedged after step {after_step} — aborting remaining "
+        f"ladder steps (partial artifacts committed)")
+    return False
+
+
 def run_suite():
     py = sys.executable
     bench = os.path.join(REPO, "bench.py")
@@ -114,6 +127,8 @@ def run_suite():
              env={"BENCH_TINY": "1", "BENCH_BATCHES": "8",
                   "BENCH_STEPS": "5", "BENCH_HARD_TIMEOUT": "900"},
              timeout_s=1200, stdout_path="bench_tiny.json")
+    if not _tunnel_still_ok("tiny"):
+        return
     # 2. headline: ERNIE-base, full sweep, HLO of the best batch archived
     rc = run_step("ernie", [py, bench],
                   env={"BENCH_DUMP_HLO": os.path.join(PERF, "hlo",
@@ -122,18 +137,26 @@ def run_suite():
     if rc != 0:
         log("headline failed — continuing with secondaries anyway")
     # 3. secondaries (SURVEY §6 / BASELINE configs)
+    prev = "ernie"
     for model, budget in (("resnet", 2400), ("transformer", 2400),
                           ("deepfm", 1800), ("gpt", 2400)):
+        if not _tunnel_still_ok(prev):
+            return
         run_step(model, [py, bench],
                  env={"BENCH_MODEL": model,
                       "BENCH_HARD_TIMEOUT": str(budget)},
                  timeout_s=budget + 600, stdout_path=f"bench_{model}.json")
-    # 4. flash block-size tuner (exports the winner for future runs)
+        prev = model
+    # 4. flash block-size tuner (persists the winner for future runs)
+    if not _tunnel_still_ok("secondaries"):
+        return
     run_step("tune_flash",
              [py, os.path.join(REPO, "tools", "tune_flash.py"),
               "--backward"],
              timeout_s=2400, stdout_path="tune_flash.txt")
     # 5. hardware flash-vs-oracle tier (writes perf/flash_oracle_tpu.json)
+    if not _tunnel_still_ok("tune_flash"):
+        return
     run_step("tpu_tier",
              [py, "-m", "pytest", os.path.join(REPO, "tests_tpu"),
               "-q", "-m", "tpu"],
