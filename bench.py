@@ -338,6 +338,8 @@ def build_step(batch, seq_len):
         return build_deepfm_step(batch)
     if model == "gpt":
         return build_gpt_step(batch, seq_len)
+    if model == "gpt_decode":
+        return build_gpt_decode_step(batch, seq_len)
     # "ernie" (default — BASELINE.json's named headline) and "bert" share
     # the encoder graph; ernie feeds go through the knowledge-masking
     # pipeline (models/ernie.py), bert feeds are uniform random.
@@ -360,6 +362,50 @@ def build_step(batch, seq_len):
                                             dtype=np.int32),
         lambda: fluid.optimizer.AdamOptimizer(learning_rate=1e-4), batch)
     return step, batch * seq_len, flops          # units = tokens
+
+
+def build_gpt_decode_step(batch, seq_len):
+    """Inference benchmark: KV-cache greedy decode, tokens generated
+    per second per chip (the serving-side complement to the training
+    headline; rides inference/decoding.py's lax.scan loop). Decode is
+    memory-bandwidth-bound, so the reported MFU is expectedly tiny —
+    tokens/s is the figure of merit."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as fluid
+    from paddle_tpu.core import framework
+    from paddle_tpu.core.executor import Scope, scope_guard
+    from paddle_tpu.models import gpt
+
+    tiny = os.environ.get("BENCH_TINY") == "1"
+    cfg = gpt.gpt_tiny() if tiny else gpt.GPTConfig()
+    max_len = min(seq_len, cfg.max_position)
+    RUN_INFO["seq_len"] = max_len
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        gpt.build_lm_net(cfg, seq_len=8)     # materialize the params
+    scope = Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    with scope_guard(scope):
+        exe.run(startup)
+        params = gpt.load_params(scope, cfg)
+    # the tested inference wiring, in serving dtype (bf16 weights+cache,
+    # f32 softmax inside)
+    decode = gpt.make_greedy_decoder(params, cfg, max_len,
+                                     dtype=jnp.bfloat16)
+    bos = jnp.zeros((batch,), jnp.int32)
+
+    def step():
+        return [decode(bos)[1]]     # scores (B,) f32
+
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+    # fwd-only matmul FLOPs: 2 * params * tokens (attention-cache reads
+    # are bandwidth, not FLOPs, at this scale)
+    flops = 2.0 * n_params * batch * max_len
+    return step, batch * max_len, flops
 
 
 def bench_one(batch, seq_len, n_steps):
@@ -530,6 +576,14 @@ def _emit(sweep, seq_len, kind, peak):
         if not best["flash_engaged"]:
             print("bench: WARNING — Pallas flash attention did NOT "
                   "engage on the causal LM path", file=sys.stderr)
+    elif model == "gpt_decode":
+        # single-token KV-cache steps never touch the flash kernel;
+        # decode is bandwidth-bound so tokens/s is the figure of merit
+        metric = ("gpt_tiny" if tiny else "gpt_base") \
+            + "_kv_decode_tokens_per_sec_per_chip"
+        unit = "tokens/s/chip"
+        rate_key = "tokens_per_sec"
+        baseline = None
     else:
         # ernie and bert share the BERT-base-sized graph; name what ran
         arch = "ernie" if model == "ernie" else "bert"

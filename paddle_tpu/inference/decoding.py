@@ -36,9 +36,13 @@ def init_kv_cache(batch, num_layers, num_heads, max_len, head_dim,
 
 def update_kv_cache(layer_cache, k_t, v_t, t):
     """Write this step's K/V (B, H, 1, D) at time t. Returns new cache +
-    full (B, H, T_max, D) views for attention (mask out > t)."""
-    k = jax.lax.dynamic_update_slice(layer_cache["k"], k_t, (0, 0, t, 0))
-    v = jax.lax.dynamic_update_slice(layer_cache["v"], v_t, (0, 0, t, 0))
+    full (B, H, T_max, D) views for attention (mask out > t). The cache
+    dtype wins: a bf16 serving cache accepts K/V computed through f32
+    residual paths without the caller micro-managing casts."""
+    k = jax.lax.dynamic_update_slice(
+        layer_cache["k"], k_t.astype(layer_cache["k"].dtype), (0, 0, t, 0))
+    v = jax.lax.dynamic_update_slice(
+        layer_cache["v"], v_t.astype(layer_cache["v"].dtype), (0, 0, t, 0))
     return {"k": k, "v": v}
 
 

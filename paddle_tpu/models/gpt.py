@@ -162,11 +162,17 @@ def build_kv_step(params, cfg, max_len):
             k = (hn @ lp["wk"] + lp["bk"]).reshape(b, h_, 1, d)
             v = (hn @ lp["wv"] + lp["bv"]).reshape(b, h_, 1, d)
             cache[i] = dec.update_kv_cache(cache[i], k, v, t)
+            # scores + softmax deliberately in f32 (np scalar + f32 bias
+            # promote); probs cast BACK to the cache dtype so a bf16
+            # serving path keeps its activations/residual in bf16 —
+            # without the cast, layer 0's f32 output silently promoted
+            # every later layer to f32
             s = (jnp.einsum("bhd,bhld->bhl", q[:, :, 0], cache[i]["k"])
                  / np.sqrt(d)) + bias
-            o = jnp.einsum("bhl,bhld->bhd", jax.nn.softmax(s, -1),
+            p = jax.nn.softmax(s, -1).astype(cache[i]["v"].dtype)
+            o = jnp.einsum("bhl,bhld->bhd", p,
                            cache[i]["v"]).reshape(b, cfg.hidden_size)
-            x = x + (o @ lp["wo"] + lp["bo"])
+            x = x + (o @ lp["wo"] + lp["bo"]).astype(x.dtype)
             hn = _ln(x, lp["ln2_s"], lp["ln2_b"])
             f = jax.nn.gelu(hn @ lp["f0w"] + lp["f0b"], approximate=False)
             x = x + (f @ lp["f1w"] + lp["f1b"])
@@ -174,6 +180,33 @@ def build_kv_step(params, cfg, max_len):
         return x @ params["word_emb"].T, cache
 
     return step
+
+
+def make_greedy_decoder(params, cfg, max_len, eos_id=None, dtype=None):
+    """Jit-compiled greedy KV-cache decoder: decode(bos_ids (B,)) ->
+    (ids (B, max_len), scores (B,)). `dtype` casts f32 params AND the
+    cache for serving (bf16 halves the bandwidth decode is bound by);
+    scores/softmax stay f32 inside (build_kv_step). The single wiring
+    point for cache-init + greedy_decode — generate() and bench.py's
+    gpt_decode mode both ride it, so they cannot drift apart."""
+    import jax
+    from ..inference import decoding as dec
+    if dtype is not None:
+        params = jax.tree_util.tree_map(
+            lambda a: a.astype(dtype) if a.dtype == jnp.float32 else a,
+            params)
+    step = build_kv_step(params, cfg, max_len)
+    d = cfg.hidden_size // cfg.num_heads
+
+    @jax.jit
+    def decode(bos_ids):
+        cache = dec.init_kv_cache(bos_ids.shape[0], cfg.num_layers,
+                                  cfg.num_heads, max_len, d,
+                                  dtype=dtype or jnp.float32)
+        return dec.greedy_decode(step, cache, bos_ids, max_len,
+                                 eos_id=eos_id)
+
+    return decode
 
 
 def generate(scope, cfg, bos_ids, max_len, eos_id=None, beam_size=None,
@@ -184,12 +217,10 @@ def generate(scope, cfg, bos_ids, max_len, eos_id=None, beam_size=None,
     params = load_params(scope, cfg)
     d = cfg.hidden_size // cfg.num_heads
     b = len(np.asarray(bos_ids))
-    step = build_kv_step(params, cfg, max_len)
     if beam_size is None:
-        cache = dec.init_kv_cache(b, cfg.num_layers, cfg.num_heads,
-                                  max_len, d)
-        return dec.greedy_decode(step, cache, jnp.asarray(bos_ids),
-                                 max_len, eos_id=eos_id)
+        decode = make_greedy_decoder(params, cfg, max_len, eos_id=eos_id)
+        return decode(jnp.asarray(bos_ids))
+    step = build_kv_step(params, cfg, max_len)
     cache = dec.init_kv_cache(b * beam_size, cfg.num_layers,
                               cfg.num_heads, max_len, d)
     return dec.beam_decode(step, cache, jnp.asarray(bos_ids), max_len,

@@ -109,11 +109,13 @@ def _tunnel_still_ok(after_step):
     window died mid-ladder and every later step burned its full init
     watchdog (600s) or subprocess budget (2400s) against a wedged
     tunnel — ~100 minutes of guaranteed hangs. A failed probe aborts
-    the rest of the ladder instead; the watcher commits what landed."""
+    the rest of the ladder instead; the watcher commits what landed
+    and KEEPS CYCLING (run_suite returns incomplete)."""
     if probe() is not None:
         return True
     log(f"tunnel wedged after step {after_step} — aborting remaining "
-        f"ladder steps (partial artifacts committed)")
+        f"ladder steps (partial artifacts committed; watcher keeps "
+        f"probing)")
     return False
 
 
@@ -128,7 +130,7 @@ def run_suite():
                   "BENCH_STEPS": "5", "BENCH_HARD_TIMEOUT": "900"},
              timeout_s=1200, stdout_path="bench_tiny.json")
     if not _tunnel_still_ok("tiny"):
-        return
+        return False
     # 2. headline: ERNIE-base, full sweep, HLO of the best batch archived
     rc = run_step("ernie", [py, bench],
                   env={"BENCH_DUMP_HLO": os.path.join(PERF, "hlo",
@@ -139,9 +141,10 @@ def run_suite():
     # 3. secondaries (SURVEY §6 / BASELINE configs)
     prev = "ernie"
     for model, budget in (("resnet", 2400), ("transformer", 2400),
-                          ("deepfm", 1800), ("gpt", 2400)):
+                          ("deepfm", 1800), ("gpt", 2400),
+                          ("gpt_decode", 1500)):
         if not _tunnel_still_ok(prev):
-            return
+            return False
         run_step(model, [py, bench],
                  env={"BENCH_MODEL": model,
                       "BENCH_HARD_TIMEOUT": str(budget)},
@@ -149,18 +152,19 @@ def run_suite():
         prev = model
     # 4. flash block-size tuner (persists the winner for future runs)
     if not _tunnel_still_ok("secondaries"):
-        return
+        return False
     run_step("tune_flash",
              [py, os.path.join(REPO, "tools", "tune_flash.py"),
               "--backward"],
              timeout_s=2400, stdout_path="tune_flash.txt")
     # 5. hardware flash-vs-oracle tier (writes perf/flash_oracle_tpu.json)
     if not _tunnel_still_ok("tune_flash"):
-        return
+        return False
     run_step("tpu_tier",
              [py, "-m", "pytest", os.path.join(REPO, "tests_tpu"),
               "-q", "-m", "tpu"],
              timeout_s=2400, stdout_path="tpu_tier.txt")
+    return True
 
 
 def commit_perf(msg):
@@ -205,8 +209,16 @@ def main():
             time.sleep(INTERVAL_S)
             continue
         log(f"cycle {cycle}: TUNNEL OK ({dev}) — running perf suite")
-        run_suite()
-        commit_perf("Archive TPU bench artifacts from hardware window")
+        complete = run_suite()
+        commit_perf("Archive TPU bench artifacts from hardware window"
+                    if complete else
+                    "Archive partial TPU bench artifacts (window died "
+                    "mid-ladder)")
+        if not complete:
+            # the window died mid-ladder: keep probing — a reopened
+            # tunnel minutes later must not be missed (the r4 failure)
+            time.sleep(INTERVAL_S)
+            continue
         log("suite complete — watcher exiting")
         return 0
     log("cycle budget exhausted — exiting")
