@@ -262,3 +262,47 @@ def test_donated_state_is_aliased(mesh_kwargs, n_dev):
     assert n_alias >= n_params, (
         f"only {n_alias} aliased buffers for {n_params} params in the "
         f"{mesh_kwargs} step — donation is not reaching XLA")
+
+
+def test_kv_decode_scan_stays_on_device():
+    """The KV-cache decode loop (bench gpt_decode / gpt.generate) must
+    compile to one on-device scan: a host transfer per generated token
+    would turn serving latency into tunnel RTT x max_len."""
+    import jax.numpy as jnp
+    from paddle_tpu.core.executor import Scope, scope_guard
+    from paddle_tpu.models import gpt
+
+    cfg = gpt.gpt_tiny()
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        gpt.build_lm_net(cfg, seq_len=8)
+    with scope_guard(Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        from paddle_tpu.core.executor import global_scope
+        params = gpt.load_params(global_scope(), cfg)
+    decode = gpt.make_greedy_decoder(params, cfg, max_len=16,
+                                     dtype=jnp.bfloat16)
+    import jax
+    bos = jnp.zeros((2,), jnp.int32)
+    lowered = jax.jit(decode).lower(bos)
+    txt = lowered.compile().as_text()
+    for marker in ("infeed", "outfeed", " send(", " recv(",
+                   "send-start", "recv-start", "S(5)",
+                   "MoveToHost", "MoveToDevice"):
+        assert marker not in txt, (
+            f"host-transfer marker {marker!r} in the decode loop")
+    # bf16 serving: the KV-cache scan carry itself must be bf16 — the
+    # bf16 WEIGHTS alone would satisfy a bare "bf16 in txt" check while
+    # an f32 cache silently doubles the bandwidth decode is bound by.
+    # Assert on the LOWERED (source-truth) IR: the CPU backend's
+    # compiled HLO legalizes bf16 compute through f32 scratch buffers,
+    # which is backend detail, not the serving dtype.
+    # cache shape = (batch=2, heads=4, max_len=16, d=128/4=32)
+    src = lowered.as_text()
+    assert "bf16[2,4,16,32]" in src.replace("tensor<2x4x16x32xbf16>",
+                                            "bf16[2,4,16,32]"), \
+        "KV cache is not bf16 in the lowered IR"
+    assert "tensor<2x4x16x32xf32>" not in src and \
+        "f32[2,4,16,32]" not in src, \
+        "f32 cache-shaped tensors in the bf16-serving decode source"
