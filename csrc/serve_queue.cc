@@ -1,0 +1,124 @@
+// Micro-batching request queue for the serving loop (parity target:
+// the reference's C++ inference server groups concurrent requests into
+// batches before hitting the engine; here the engine is one jitted XLA
+// executable per batch bucket, so grouping is what keeps the MXU fed).
+//
+// Policy: a batch is released when EITHER max_batch requests are queued
+// OR the oldest queued request has waited max_delay_us — the standard
+// latency/throughput knob pair. All waiting happens here, off the GIL;
+// Python threads only enqueue ids and pop ready batches.
+//
+// ctypes ABI (all int64 ids; see inference/serving.py):
+//   sq_create(max_batch, max_delay_us) -> handle (void*)
+//   sq_submit(h, req_id)               -> 0 ok / -1 closed
+//   sq_next_batch(h, out_ids, cap, timeout_us) -> n (0 on timeout,
+//        -1 closed-and-drained)
+//   sq_pending(h) -> queued count
+//   sq_close(h)   (wakes everyone; next_batch drains then returns -1)
+//   sq_destroy(h)
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <mutex>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Pending {
+  int64_t id;
+  Clock::time_point enqueued;
+};
+
+struct ServeQueue {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<Pending> q;
+  int64_t max_batch;
+  int64_t max_delay_us;
+  bool closed = false;
+};
+
+// A batch is ready when the bucket is full or the head request's
+// deadline passed. Caller holds the lock.
+bool batch_ready(const ServeQueue& sq, Clock::time_point now) {
+  if (sq.q.empty()) return false;
+  if (static_cast<int64_t>(sq.q.size()) >= sq.max_batch) return true;
+  auto waited = std::chrono::duration_cast<std::chrono::microseconds>(
+                    now - sq.q.front().enqueued)
+                    .count();
+  return waited >= sq.max_delay_us;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* sq_create(int64_t max_batch, int64_t max_delay_us) {
+  if (max_batch < 1) max_batch = 1;
+  auto* sq = new ServeQueue();
+  sq->max_batch = max_batch;
+  sq->max_delay_us = max_delay_us < 0 ? 0 : max_delay_us;
+  return sq;
+}
+
+int sq_submit(void* h, int64_t req_id) {
+  auto* sq = static_cast<ServeQueue*>(h);
+  {
+    std::lock_guard<std::mutex> lk(sq->mu);
+    if (sq->closed) return -1;
+    sq->q.push_back({req_id, Clock::now()});
+  }
+  sq->cv.notify_all();
+  return 0;
+}
+
+int64_t sq_next_batch(void* h, int64_t* out_ids, int64_t cap,
+                      int64_t timeout_us) {
+  auto* sq = static_cast<ServeQueue*>(h);
+  std::unique_lock<std::mutex> lk(sq->mu);
+  auto give_up = Clock::now() + std::chrono::microseconds(timeout_us);
+  for (;;) {
+    auto now = Clock::now();
+    if (batch_ready(*sq, now) || (sq->closed && !sq->q.empty())) {
+      int64_t n = 0;
+      while (!sq->q.empty() && n < cap && n < sq->max_batch) {
+        out_ids[n++] = sq->q.front().id;
+        sq->q.pop_front();
+      }
+      return n;
+    }
+    if (sq->closed) return -1;  // closed and drained
+    if (now >= give_up) return 0;
+    // sleep until: batch deadline of the head request, the caller's
+    // timeout, or a submit notification — whichever is first
+    auto until = give_up;
+    if (!sq->q.empty()) {
+      auto head_deadline = sq->q.front().enqueued +
+                           std::chrono::microseconds(sq->max_delay_us);
+      if (head_deadline < until) until = head_deadline;
+    }
+    sq->cv.wait_until(lk, until);
+  }
+}
+
+int64_t sq_pending(void* h) {
+  auto* sq = static_cast<ServeQueue*>(h);
+  std::lock_guard<std::mutex> lk(sq->mu);
+  return static_cast<int64_t>(sq->q.size());
+}
+
+void sq_close(void* h) {
+  auto* sq = static_cast<ServeQueue*>(h);
+  {
+    std::lock_guard<std::mutex> lk(sq->mu);
+    sq->closed = true;
+  }
+  sq->cv.notify_all();
+}
+
+void sq_destroy(void* h) { delete static_cast<ServeQueue*>(h); }
+
+}  // extern "C"

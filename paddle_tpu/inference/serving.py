@@ -1,0 +1,164 @@
+"""Micro-batching serving loop over the native request queue
+(csrc/serve_queue.cc).
+
+Parity target: the reference's C++ inference server (paddle/fluid/
+inference/api — AnalysisPredictor behind a request-grouping service
+loop). TPU-native twist: the engine is one cached XLA executable per
+batch bucket (Predictor.predict_batch), so grouping concurrent
+requests into buckets is what keeps the MXU fed; singles would leave
+it >90% idle.
+
+The latency/throughput contract is the standard pair: a batch launches
+when `max_batch` requests are queued OR the oldest has waited
+`max_delay_ms`. All waiting happens in C++ off the GIL; request
+payloads stay in Python (the queue moves int64 ticket ids only).
+
+    server = BatchingServer(predictor, max_batch=8, max_delay_ms=2.0)
+    fut = server.submit({"x": np.array([[...]])})   # any thread
+    out = fut.result()                              # this request's rows
+    server.close()
+"""
+
+import ctypes
+import threading
+from concurrent.futures import Future
+
+import numpy as np
+
+from ..utils.native import build_and_load
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def load_library():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        lib = build_and_load("serve_queue.cc", "libservequeue.so")
+        lib.sq_create.restype = ctypes.c_void_p
+        lib.sq_create.argtypes = [ctypes.c_int64, ctypes.c_int64]
+        lib.sq_submit.restype = ctypes.c_int
+        lib.sq_submit.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.sq_next_batch.restype = ctypes.c_int64
+        lib.sq_next_batch.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_int64),
+                                      ctypes.c_int64, ctypes.c_int64]
+        lib.sq_pending.restype = ctypes.c_int64
+        lib.sq_pending.argtypes = [ctypes.c_void_p]
+        lib.sq_close.argtypes = [ctypes.c_void_p]
+        lib.sq_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available():
+    try:
+        load_library()
+        return True
+    except Exception:
+        return False
+
+
+class BatchingServer:
+    """Group concurrent single-request predicts into bucket-sized
+    batches. One worker thread owns the predictor (XLA dispatch is not
+    re-entrant-friendly anyway); any number of client threads submit."""
+
+    def __init__(self, predictor, max_batch=8, max_delay_ms=2.0):
+        self._lib = load_library()
+        self._pred = predictor
+        self._q = self._lib.sq_create(int(max_batch),
+                                      int(max_delay_ms * 1000))
+        self._reqs = {}
+        self._reqs_lock = threading.Lock()
+        self._next_id = 0
+        self._max_batch = int(max_batch)
+        self._closed = False
+        self._worker = threading.Thread(target=self._serve, daemon=True)
+        self._worker.start()
+
+    def submit(self, feeds):
+        """feeds: dict name -> (1, ...) or (k, ...) array. Returns a
+        Future resolving to this request's output rows (list, one array
+        per model output)."""
+        feeds = {k: np.asarray(v) for k, v in feeds.items()}
+        fut = Future()
+        # sq_submit runs INSIDE the lock (it never blocks) so close()
+        # cannot destroy the native handle between our closed-check and
+        # the call
+        with self._reqs_lock:
+            if self._closed or self._q is None:
+                raise RuntimeError("BatchingServer is closed")
+            rid = self._next_id
+            self._next_id += 1
+            self._reqs[rid] = (feeds, fut)
+            if self._lib.sq_submit(self._q, rid) != 0:
+                self._reqs.pop(rid, None)
+                raise RuntimeError("BatchingServer is closed")
+        return fut
+
+    def _serve(self):
+        ids = (ctypes.c_int64 * self._max_batch)()
+        while True:
+            n = self._lib.sq_next_batch(self._q, ids, self._max_batch,
+                                        200_000)
+            if n < 0:
+                return                      # closed and drained
+            if n == 0:
+                continue                    # poll timeout — loop
+            batch = []
+            with self._reqs_lock:
+                for i in range(n):
+                    rid = ids[i]
+                    feeds_i, fut = self._reqs.pop(rid)
+                    # a client may have cancelled while queued; claiming
+                    # the future here also makes a later set_result safe
+                    if fut.set_running_or_notify_cancel():
+                        batch.append((rid, feeds_i, fut))
+            if not batch:
+                continue
+            try:
+                feeds = {
+                    k: np.concatenate([f[k] for _, f, _ in batch])
+                    for k in batch[0][1]
+                }
+                outs = self._pred.predict_batch(feeds)
+                row = 0
+                for _, f, fut in batch:
+                    k = next(iter(f))
+                    rows = f[k].shape[0]
+                    fut.set_result([o[row:row + rows] for o in outs])
+                    row += rows
+            except Exception as e:          # noqa: BLE001 — fan the error out
+                for _, _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)
+
+    def pending(self):
+        with self._reqs_lock:
+            if self._q is None:
+                return 0
+            return int(self._lib.sq_pending(self._q))
+
+    def close(self, join_timeout=30):
+        """Drain and stop. The native queue is freed ONLY once the
+        worker thread has exited — if the worker is stuck inside a long
+        engine call (an XLA bucket compile can take minutes) the handle
+        is deliberately leaked instead of freed under its feet."""
+        with self._reqs_lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._lib.sq_close(self._q)
+        self._worker.join(timeout=join_timeout)
+        if self._worker.is_alive():
+            import warnings
+            warnings.warn("BatchingServer worker still busy after "
+                          f"{join_timeout}s — leaking the native queue "
+                          "handle rather than freeing it mid-use")
+            return
+        with self._reqs_lock:
+            self._lib.sq_destroy(self._q)
+            self._q = None

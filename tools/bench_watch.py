@@ -61,7 +61,8 @@ def log(msg, to_file=True):
         f.write(line + "\n")
 
 
-def run_step(name, cmd, env=None, timeout_s=3600, stdout_path=None):
+def run_step(name, cmd, env=None, timeout_s=3600, stdout_path=None,
+             good_marker=None):
     """Run one suite step in a subprocess; archive stdout; never raise."""
     log(f"step {name}: {' '.join(cmd)}")
     full_env = dict(os.environ)
@@ -84,7 +85,7 @@ def run_step(name, cmd, env=None, timeout_s=3600, stdout_path=None):
         stderr = partial + f"\ntimeout after {timeout_s}s"
     if stdout_path:
         if not stdout.strip() and rc != 0:
-            if _artifact_ok(stdout_path):
+            if _artifact_ok(stdout_path, good_marker=good_marker):
                 # a retry cycle must never clobber a previously GOOD
                 # artifact with a failure record — keep the old number
                 log(f"step {name}: failed, keeping existing good "
@@ -110,13 +111,19 @@ def run_step(name, cmd, env=None, timeout_s=3600, stdout_path=None):
     return rc
 
 
-def _artifact_ok(stdout_path):
-    """True if a prior cycle already landed a GOOD (parseable, not
-    failed) artifact at this path — retry cycles skip those steps and
-    never overwrite them with failure records."""
+def _artifact_ok(stdout_path, good_marker=None):
+    """True if a prior cycle already landed a GOOD artifact at this
+    path — retry cycles skip those steps and never overwrite them with
+    failure records. JSON artifacts are good when they parse without
+    "failed"; text artifacts (tune_flash/tpu_tier) need an explicit
+    `good_marker` substring, since any non-empty text would otherwise
+    read as success."""
     try:
         with open(os.path.join(PERF, stdout_path)) as f:
-            d = json.loads(f.read().strip().splitlines()[-1])
+            text = f.read()
+        if good_marker is not None:
+            return good_marker in text
+        d = json.loads(text.strip().splitlines()[-1])
         return not d.get("failed", False)
     except (OSError, ValueError, IndexError, AttributeError):
         return False
@@ -179,19 +186,27 @@ def run_suite():
                  timeout_s=budget + 600, stdout_path=f"bench_{model}.json")
         prev = model
     # 4. flash block-size tuner (persists the winner for future runs)
-    if not _tunnel_still_ok("secondaries"):
-        return False
-    run_step("tune_flash",
-             [py, os.path.join(REPO, "tools", "tune_flash.py"),
-              "--backward"],
-             timeout_s=2400, stdout_path="tune_flash.txt")
+    if _artifact_ok("tune_flash.txt", good_marker="best: "):
+        log("step tune_flash: already landed in a prior cycle — skipping")
+    else:
+        if not _tunnel_still_ok("secondaries"):
+            return False
+        run_step("tune_flash",
+                 [py, os.path.join(REPO, "tools", "tune_flash.py"),
+                  "--backward"],
+                 timeout_s=2400, stdout_path="tune_flash.txt",
+                 good_marker="best: ")
     # 5. hardware flash-vs-oracle tier (writes perf/flash_oracle_tpu.json)
-    if not _tunnel_still_ok("tune_flash"):
-        return False
-    run_step("tpu_tier",
-             [py, "-m", "pytest", os.path.join(REPO, "tests_tpu"),
-              "-q", "-m", "tpu"],
-             timeout_s=2400, stdout_path="tpu_tier.txt")
+    if _artifact_ok("tpu_tier.txt", good_marker=" passed"):
+        log("step tpu_tier: already landed in a prior cycle — skipping")
+    else:
+        if not _tunnel_still_ok("tune_flash"):
+            return False
+        run_step("tpu_tier",
+                 [py, "-m", "pytest", os.path.join(REPO, "tests_tpu"),
+                  "-q", "-m", "tpu"],
+                 timeout_s=2400, stdout_path="tpu_tier.txt",
+                 good_marker=" passed")
     return True
 
 
