@@ -647,9 +647,14 @@ def main():
     peak = _peak_flops(kind)
 
     seq_len = int(os.environ.get("BENCH_SEQ_LEN", 512))
-    n_steps = int(os.environ.get("BENCH_STEPS", 20))
+    # defaults favor landing A number inside a fragile tunnel window:
+    # two batch configs, a short timed loop (one full-sweep attempt ate
+    # the r4 window's 50 minutes and landed nothing). BENCH_BATCHES /
+    # BENCH_STEPS widen the sweep when the window is known-healthy; the
+    # persistent XLA cache makes the second, fuller run cheap.
+    n_steps = int(os.environ.get("BENCH_STEPS", 15))
     batches = [int(b) for b in
-               os.environ.get("BENCH_BATCHES", "8,16,32").split(",")]
+               os.environ.get("BENCH_BATCHES", "8,16").split(",")]
     # soft budget: stop sweeping more batch sizes once exceeded
     budget = float(os.environ.get("BENCH_TIME_BUDGET", 1500))
     # hard watchdog: if a later compile wedges, emit what we have and exit
