@@ -18,9 +18,10 @@ The whole train step (fwd + grad + adam) runs as ONE donated XLA executable
 via the framework Executor; matmul path is bf16 (amp cast_model_to_bf16),
 params/accum fp32.
 
-Env knobs: BENCH_MODEL (ernie [default] | bert | gpt — encoders share a graph; uniform-random
-feed | resnet — secondary images/sec metric),
-BENCH_SEQ_LEN, BENCH_BATCHES ("8,16,32"), BENCH_STEPS,
+Env knobs: BENCH_MODEL (ernie [default] | bert | gpt | gpt_decode — encoders
+share a graph; uniform-random feed | resnet — secondary images/sec metric),
+BENCH_SEQ_LEN, BENCH_BATCHES (default "8,16" — window-sized; pass
+"8,16,32" for the full sweep), BENCH_STEPS (default 15),
 BENCH_RECOMPUTE (remat policy: dots|nothing|offload),
 BENCH_TINY=1 (bert_tiny config for off-TPU smoke tests), BENCH_PEAK_TFLOPS
 (override the per-chip peak), BENCH_DEVICE_TIMEOUT, BENCH_INIT_RETRIES,

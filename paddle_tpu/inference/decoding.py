@@ -14,7 +14,6 @@ Helpers `init_kv_cache` / `update_kv_cache` build that cache the standard
 way so model code stays three lines.
 """
 
-import functools
 
 import jax
 import jax.numpy as jnp

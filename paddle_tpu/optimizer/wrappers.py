@@ -9,11 +9,10 @@ no host round-trip, stays inside the jitted step).
 import numpy as np
 
 from ..core import unique_name
-from ..core.framework import default_main_program, grad_var_name
+from ..core.framework import default_main_program
 from ..core.layer_helper import LayerHelper
 from ..core.executor import global_scope
 from .. import initializer as init_mod
-from .optimizers import Optimizer
 
 
 class _SwapContext:
