@@ -54,15 +54,21 @@ def main():
     lat = []
     lock = threading.Lock()
 
+    errors = []
+
     def client(seed):
         rs = np.random.RandomState(seed)
-        for _ in range(per_client):
-            t0 = time.perf_counter()
-            out = server.submit(
-                {"x": rs.randn(1, 16).astype(np.float32)}).result(30)
+        try:
+            for _ in range(per_client):
+                t0 = time.perf_counter()
+                out = server.submit(
+                    {"x": rs.randn(1, 16).astype(np.float32)}).result(30)
+                with lock:
+                    lat.append(time.perf_counter() - t0)
+                assert out[0].shape == (1, 4)
+        except Exception as e:  # noqa: BLE001 — surface in main thread
             with lock:
-                lat.append(time.perf_counter() - t0)
-            assert out[0].shape == (1, 4)
+                errors.append(e)
 
     threads = [threading.Thread(target=client, args=(s,))
                for s in range(n_clients)]
@@ -72,7 +78,10 @@ def main():
     wall = time.perf_counter() - t0
     server.close()
 
+    if errors:
+        raise errors[0]
     n = n_clients * per_client
+    assert len(lat) == n, f"only {len(lat)}/{n} requests completed"
     lat_ms = sorted(v * 1e3 for v in lat)
     print(f"served {n} requests in {wall:.2f}s "
           f"({n / wall:.0f} req/s through batch buckets)")

@@ -29,3 +29,4 @@ from . import ocr_recognition
 from . import deeplab
 from . import ctr_models
 from . import tsm
+from . import simnet
