@@ -1,0 +1,85 @@
+"""Worker for the cross-process PIPELINE test: 2 processes x 2 virtual
+CPU devices form a pp=4 mesh, so microbatch activations ppermute across
+the process boundary — the multi-host pipelined-DCN deployment shape.
+Validates pipeline_1f1b numerics against the locally-computed reference
+(identical on both ranks by construction).
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+
+    from paddle_tpu.parallel import fleet as fleet_mod
+    from paddle_tpu.parallel import pipeline as pp_mod
+
+    flt = fleet_mod.Fleet()
+    flt.init()
+    assert jax.process_count() == 2, jax.process_count()
+
+    S, M, mb, d = 4, 4, 2, 8
+    devs = np.array(jax.devices()[:S])          # spans both processes
+    mesh = Mesh(devs, ("pp",))
+    # the pp axis MUST cross the process boundary for this test to mean
+    # anything
+    pids = {dev.process_index for dev in devs}
+    assert len(pids) == 2, f"pp axis stayed process-local: {pids}"
+
+    ws = jax.random.normal(jax.random.PRNGKey(0), (S, d, d)) * 0.5
+    xm = jax.random.normal(jax.random.PRNGKey(1), (M, mb, d))
+    aux = jax.random.normal(jax.random.PRNGKey(2), (M, mb, d))
+
+    def stage_fn(w, x):
+        return jnp.tanh(x @ w)
+
+    def loss_fn(y, a):
+        return jnp.mean((y - a) ** 2)
+
+    loss, grads = jax.jit(lambda ws_: pp_mod.pipeline_1f1b(
+        stage_fn, loss_fn, ws_, xm, aux, mesh))(ws)
+
+    def ref(ws_):
+        total = 0.0
+        for k in range(M):
+            h = xm[k]
+            for s in range(S):
+                h = stage_fn(ws_[s], h)
+            total = total + loss_fn(h, aux[k])
+        return total / M
+
+    ref_loss = float(ref(ws))
+    ref_grads = jax.grad(ref)(ws)
+    # outputs span both processes: assemble them with the multihost
+    # gather (a plain device_get on non-addressable shards raises)
+    from jax.experimental import multihost_utils
+    got_loss = float(np.asarray(
+        multihost_utils.process_allgather(loss,
+                                          tiled=True)).reshape(-1)[0])
+    got_grads = np.asarray(multihost_utils.process_allgather(grads,
+                                                            tiled=True))
+    assert abs(got_loss - ref_loss) < 1e-5 * max(1.0, abs(ref_loss)), \
+        (got_loss, ref_loss)
+    np.testing.assert_allclose(got_grads, np.asarray(ref_grads),
+                               rtol=1e-4, atol=1e-5)
+
+    flt.barrier_worker()
+    print(f"MH_PP_OK rank={rank} loss={got_loss:.6f}")
+
+
+if __name__ == "__main__":
+    main()

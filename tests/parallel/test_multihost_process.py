@@ -21,22 +21,19 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def test_two_process_fleet_cluster(tmp_path):
-    """The 2-process cluster now bootstraps through the user-facing
-    launcher (paddle_tpu.distributed.launch — parity: reference
-    launch.py:132 start_procs), which exports the PaddleCloud env the
-    workers' fleet.init consumes."""
+def _run_launcher(worker_name, tmp_path, ok_marker, n_ranks=2):
+    """Shared scaffolding: spawn the user-facing launcher on a worker
+    script, reap the whole session group on timeout (a plain kill would
+    orphan workers holding the rendezvous port), and assert every rank
+    printed its OK marker."""
     port = _free_port()
-    worker = os.path.join(os.path.dirname(__file__), "_mh_worker.py")
+    worker = os.path.join(os.path.dirname(__file__), worker_name)
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("PADDLE_", "XLA_", "JAX_"))}
     log_dir = str(tmp_path / "logs")
-    # own session group: on timeout, killpg reaps the launcher AND its
-    # worker grandchildren (a plain kill would orphan workers holding
-    # the rendezvous port)
     proc = subprocess.Popen(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
-         "--nproc_per_node=2", f"--started_port={port}",
+         f"--nproc_per_node={n_ranks}", f"--started_port={port}",
          f"--log_dir={log_dir}", worker],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
         start_new_session=True,
@@ -47,12 +44,28 @@ def test_two_process_fleet_cluster(tmp_path):
         os.killpg(os.getpgid(proc.pid), 9)
         stdout, _ = proc.communicate()
     logs = []
-    for rank in range(2):
+    for rank in range(n_ranks):
         p = os.path.join(log_dir, f"workerlog.{rank}")
         logs.append(open(p).read() if os.path.exists(p) else "<missing>")
     assert proc.returncode == 0, \
         f"launcher failed:\n{stdout.decode()[-500:]}\n" \
         f"w0:\n{logs[0][-1500:]}\nw1:\n{logs[1][-1500:]}"
-    for rank in range(2):
-        assert f"MH_OK rank={rank} total=10.0" in logs[rank], \
+    for rank in range(n_ranks):
+        assert ok_marker.format(rank=rank) in logs[rank], \
             logs[rank][-2000:]
+
+
+def test_two_process_fleet_cluster(tmp_path):
+    """The 2-process cluster now bootstraps through the user-facing
+    launcher (paddle_tpu.distributed.launch — parity: reference
+    launch.py:132 start_procs), which exports the PaddleCloud env the
+    workers' fleet.init consumes."""
+    _run_launcher("_mh_worker.py", tmp_path,
+                  "MH_OK rank={rank} total=10.0")
+
+
+def test_two_process_pipeline_over_dcn(tmp_path):
+    """pp=4 mesh spanning 2 processes x 2 devices: the 1F1B microbatch
+    ring ppermutes activations ACROSS the process boundary — the
+    multi-host pipelined deployment the dp-only test doesn't cover."""
+    _run_launcher("_mh_pp_worker.py", tmp_path, "MH_PP_OK rank={rank}")

@@ -47,13 +47,14 @@ last_batch = None
 for batch in reader.batch(dataset.mnist.train(), 64)():
     l, a = exe.run(feed=feeder.feed(batch), fetch_list=[loss, acc])
     last_batch = batch
-print("train acc", float(np.asarray(a)))
-assert float(np.asarray(a)) >= 0.95, "synthetic mnist should hit ~1.0"
+acc_val = np.asarray(a).reshape(-1)[0].item()
+print("train acc", acc_val)
+assert acc_val >= 0.95, "synthetic mnist should hit ~1.0"
 
 # eval on the cloned test program
 l_eval, a_eval = exe.run(test_prog, feed=feeder.feed(last_batch),
                          fetch_list=[loss, acc])
-print("eval acc", float(np.asarray(a_eval)))
+print("eval acc", np.asarray(a_eval).reshape(-1)[0].item())
 
 # EMA fluid-style eval flow (the change under test this commit)
 from paddle_tpu.core.executor import global_scope  # noqa: E402
