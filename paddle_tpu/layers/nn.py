@@ -1647,28 +1647,43 @@ def nce(input, label, num_total_classes, sample_weight=None,
 def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
              name=None, path_table=None, path_code=None, is_custom=False,
              is_sparse=False):
-    """Parity: fluid.layers.hsigmoid (ref layers/nn.py:6169) — default
-    complete-binary-tree form. Custom trees (path_table/path_code) are
-    not supported; the default SimpleCode tree covers the book usage."""
-    if is_custom or path_table is not None or path_code is not None:
-        raise NotImplementedError(
-            "hsigmoid: custom trees are not supported; use the default "
-            "complete binary tree")
+    """Parity: fluid.layers.hsigmoid (ref layers/nn.py:6169), both the
+    default complete-binary-tree form and custom trees via
+    path_table/path_code (is_custom). Custom mode follows the reference
+    weight shapes: W is [num_classes, dim] (one row per internal node id
+    the path_table references), bias [num_classes, 1]."""
+    if is_custom and (path_table is None or path_code is None
+                      or num_classes is None):
+        raise ValueError(
+            "hsigmoid custom tree needs path_table, path_code and "
+            "num_classes (ref layers/nn.py hsigmoid checks)")
+    if not is_custom and (path_table is not None or path_code is not None):
+        raise ValueError(
+            "hsigmoid: path_table/path_code given without is_custom=True; "
+            "pass is_custom=True to use the custom tree (W becomes "
+            "[num_classes, dim])")
     helper = LayerHelper("hsigmoid", param_attr=param_attr,
                          bias_attr=bias_attr, name=name)
     dim = input.shape[-1]
+    rows = num_classes if is_custom else num_classes - 1
     w = helper.create_parameter(attr=helper.param_attr,
-                                shape=[num_classes - 1, dim],
+                                shape=[rows, dim],
                                 dtype=input.dtype)
     b = helper.create_parameter(attr=helper.bias_attr,
-                                shape=[num_classes - 1], dtype=input.dtype,
+                                shape=[rows], dtype=input.dtype,
                                 is_bias=True)
     batch = input.shape[0]
-    max_depth = max(int(num_classes - 1).bit_length(), 1)
+    if is_custom:
+        max_depth = path_table.shape[-1]
+    else:
+        max_depth = max(int(num_classes - 1).bit_length(), 1)
     out = helper.create_variable_for_type_inference("float32", (batch, 1))
     pre = helper.create_variable_for_type_inference("float32",
                                                     (batch, max_depth))
     inputs = {"X": input, "W": w, "Label": label}
+    if is_custom:
+        inputs["PathTable"] = path_table
+        inputs["PathCode"] = path_code
     if b is not None:
         inputs["Bias"] = b
     helper.append_op("hierarchical_sigmoid", inputs,
@@ -1765,8 +1780,10 @@ def var_conv_2d(input, row, col, input_channel, output_channel, filter_size,
 def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
               max_depth=2, act="tanh", param_attr=None, bias_attr=None,
               name=None):
-    """Parity: fluid.layers.tree_conv (TBCNN). nodes_vector (B, N, D),
-    edge_set (B, E, 2) (parent, child) int pairs padded with -1.
+    """Parity: fluid.layers.tree_conv (TBCNN, any max_depth).
+    nodes_vector (B, N, D) with node ids 1-based (row id-1 is the
+    feature); edge_set (B, E, 2) (parent, child) int pairs padded with
+    0, the reference's convention (math/tree2col.cc:72).
     Returns (B, N, output_size, num_filters)."""
     helper = LayerHelper("tree_conv", param_attr=param_attr,
                          bias_attr=bias_attr, act=act, name=name)

@@ -110,46 +110,83 @@ def sequence_topk_avg_pooling(ctx):
 
 @register("tree_conv")
 def tree_conv(ctx):
-    """TBCNN tree convolution (continuous binary tree, Mou et al. — the
-    design the reference's tree_conv_op implements). NodesVector
-    (B, N, D); EdgeSet (B, E, 2) (parent, child) pairs, -1 padded;
-    Filter (D, 3, H, F). The window is each node + its direct children;
-    weights mix W_top for the parent and a left/right-interpolated pair
-    for children by position. Out (B, N, H, F)."""
-    if ctx.attr("max_depth", 2) != 2:
-        raise NotImplementedError(
-            "tree_conv: only max_depth=2 (node + direct children) is "
-            "implemented; deeper windows need multi-hop aggregation")
+    """TBCNN tree convolution (continuous binary tree, Mou et al.),
+    exact reference semantics for ANY max_depth (tree_conv_op.h:27,
+    math/tree2col.cc:23,85; eta formulas tree2col.h:34-52):
+
+    - NodesVector (B, N, D); node ids are 1-based, row id-1 holds the
+      feature. EdgeSet (B, E, 2) (parent, child) pairs, 0-padded;
+      construct_tree stops at the FIRST u==0||v==0 pair
+      (tree2col.cc:72-77 breaks, not skips), so interior zeros
+      terminate the edge list here too.
+    - Filter (D, 3, H, F); slot order along axis 1 is
+      [W_left, W_right, W_top] — tree2col.cc:121-126 writes the patch
+      as (eta_l, eta_r, eta_t) per feature and the op flattens Filter
+      to (3D, H*F) against it.
+    - For each root u the window is every descendant v at tree depth
+      k < max_depth, weighted eta_t=(d-k)/d, eta_l=(1-eta_t)*temp,
+      eta_r=(1-eta_t)*(1-eta_l) with temp = (index-1)/(pclen-1) by
+      sibling position (0.5 for an only child). Rows past
+      node_count = #valid_edges + 1 stay zero (MatMul writes only
+      patch_count rows, tree_conv_op.h:72).
+
+    TPU form: depth-k reachability via boolean adjacency powers — one
+    (N,N)x(N,D) matmul pair per depth level, no per-node DFS, fully
+    static shapes; the reference's stack walk has no XLA analog."""
     nodes = ctx.in_("NodesVector").astype(jnp.float32)   # (B, N, D)
     edges = ctx.in_("EdgeSet").astype(jnp.int32)         # (B, E, 2)
     filt = ctx.in_("Filter").astype(jnp.float32)         # (D, 3, H, F)
-    b, n, d = nodes.shape
-    w_top, w_left, w_right = filt[:, 0], filt[:, 1], filt[:, 2]  # (D, H, F)
+    max_depth = int(ctx.attr("max_depth", 2))
+    b, n, d_feat = nodes.shape
+    w_left, w_right, w_top = filt[:, 0], filt[:, 1], filt[:, 2]  # (D, H, F)
+    dd = float(max_depth)
 
     def per_sample(nv, ed):
-        parent, child = ed[:, 0], ed[:, 1]               # (E,)
-        valid = (parent >= 0) & (child >= 0)
-        p = jnp.where(valid, parent, 0)
-        ch = jnp.where(valid, child, 0)
+        parent, child = ed[:, 0], ed[:, 1]               # (E,) 1-based
+        # prefix-valid: the reference breaks at the first padded pair
+        valid = jnp.cumprod(
+            ((parent > 0) & (child > 0)).astype(jnp.int32)) == 1
         vf = valid.astype(jnp.float32)
-        # child position among its siblings: rank by edge order
-        ones = jnp.where(valid, 1.0, 0.0)
-        # cumulative count of previous children of the same parent
-        same = (p[:, None] == p[None, :]) & (jnp.arange(len(p))[None, :]
-                                             < jnp.arange(len(p))[:, None])
-        pos = (same * ones[None, :]).sum(-1)             # (E,)
-        cnt = jax.ops.segment_sum(ones, p, num_segments=n)[p]  # siblings
-        denom = jnp.maximum(cnt - 1.0, 1.0)
-        eta_r = jnp.where(cnt > 1, pos / denom, 0.5)
-        eta_l = 1.0 - eta_r
-        cx = nv[ch]                                       # (E, D)
-        contrib = (jnp.einsum("ed,dhf->ehf", cx * (eta_l * vf)[:, None],
-                              w_left)
-                   + jnp.einsum("ed,dhf->ehf", cx * (eta_r * vf)[:, None],
-                                w_right))
-        agg = jax.ops.segment_sum(contrib, p, num_segments=n)  # (N, H, F)
-        self_term = jnp.einsum("nd,dhf->nhf", nv, w_top)
-        return self_term + agg
+        p = jnp.where(valid, parent - 1, 0)              # 0-based rows
+        ch = jnp.where(valid, child - 1, 0)
+        # adjacency parent->child; a tree, so entries are 0/1
+        adj = jnp.zeros((n, n), jnp.float32).at[p, ch].add(vf)
+        adj = jnp.minimum(adj, 1.0)
+        # per-node sibling stats (independent of the patch root):
+        # index = 1-based position among the parent's children in edge
+        # order; pclen = that parent's child count (tree2col.cc:40-44)
+        earlier = (p[:, None] == p[None, :]) & (
+            jnp.arange(len(p))[None, :] < jnp.arange(len(p))[:, None])
+        idx0 = (earlier * vf[None, :]).sum(-1)           # 0-based index
+        cnt = jax.ops.segment_sum(vf, p, num_segments=n)[p]
+        temp_e = jnp.where(cnt > 1, idx0 / jnp.maximum(cnt - 1.0, 1.0), 0.5)
+        # scatter per-edge temp onto the child node (unique parent)
+        temp = jnp.zeros((n,)).at[ch].add(temp_e * vf)   # (N,)
+
+        node_count = vf.sum() + 1.0
+        row_ok = (jnp.arange(n) < node_count).astype(jnp.float32)
+
+        # With s = k/d: eta_t = 1-s, eta_l = s*temp,
+        # eta_r = s*(1 - s*temp) — every level's weighted feature sum is
+        # a linear combo of reach@nv and reach@(nv*temp), so accumulate
+        # those per level and project through the three filters ONCE.
+        nvl = nv * temp[:, None]
+        reach = jnp.eye(n)                               # depth-0
+        ft = jnp.zeros_like(nv)
+        fl = jnp.zeros_like(nv)
+        fr = jnp.zeros_like(nv)
+        for k in range(max_depth):
+            s = k / dd
+            rn, rnl = reach @ nv, reach @ nvl            # (N, D)
+            ft = ft + (1.0 - s) * rn
+            fl = fl + s * rnl
+            fr = fr + s * rn - s * s * rnl
+            if k + 1 < max_depth:
+                reach = jnp.minimum(reach @ adj, 1.0)
+        out = (jnp.einsum("nd,dhf->nhf", ft, w_top)
+               + jnp.einsum("nd,dhf->nhf", fl, w_left)
+               + jnp.einsum("nd,dhf->nhf", fr, w_right))
+        return out * row_ok[:, None, None]
 
     out = jax.vmap(per_sample)(nodes, edges)
     bias = ctx.in_("Bias")

@@ -89,23 +89,40 @@ def nce(ctx):
 
 @register("hierarchical_sigmoid")
 def hierarchical_sigmoid(ctx):
-    """Default complete-binary-tree hsigmoid (the reference's SimpleCode:
-    code = label + num_classes; bit i of the path tests code's bit, the
-    internal node index is (code >> (i+1)) - 1). All paths are walked at
-    the static max depth with a validity mask — no per-sample loop."""
+    """Hierarchical sigmoid, both tree forms of the reference
+    (hierarchical_sigmoid_op.h:62, matrix_bit_code.h:116,143):
+
+    - default complete binary tree (SimpleCode: code = label +
+      num_classes; bit i of the path tests code's bit, the internal
+      node index is (code >> (i+1)) - 1);
+    - custom tree (CustomCode: PathTable (B, L) holds the per-step
+      internal-node row into W, PathCode (B, L) the binary targets;
+      the path ends at the first negative PathTable entry).
+
+    Either way all paths are walked at the static max depth with a
+    validity mask — no per-sample loop."""
     x = ctx.in_("X").astype(jnp.float32)               # (B, D)
-    w = ctx.in_("W").astype(jnp.float32)               # (C-1, D)
+    w = ctx.in_("W").astype(jnp.float32)               # (C-1, D) | (C, D)
     bias = ctx.in_("Bias")
     label = ctx.in_("Label").reshape(-1).astype(jnp.int32)
     num_classes = ctx.attr("num_classes")
-    max_depth = max(int(num_classes - 1).bit_length(), 1)
 
-    code = label + num_classes                          # (B,)
-    bits = jnp.arange(max_depth)                        # (L,)
-    node = (code[:, None] >> (bits[None] + 1)) - 1      # (B, L)
-    valid = node >= 0
-    node_safe = jnp.maximum(node, 0)
-    bit = (code[:, None] >> bits[None]) & 1             # (B, L)
+    if ctx.has_in("PathTable"):
+        node = ctx.in_("PathTable").astype(jnp.int32)   # (B, L)
+        bit = ctx.in_("PathCode").astype(jnp.int32)     # (B, L)
+        if node.ndim == 1:
+            node, bit = node[None], bit[None]
+        valid = node >= 0                               # CustomCode length
+        node_safe = jnp.maximum(node, 0)
+        bit = jnp.maximum(bit, 0)
+    else:
+        max_depth = max(int(num_classes - 1).bit_length(), 1)
+        code = label + num_classes                      # (B,)
+        bits = jnp.arange(max_depth)                    # (L,)
+        node = (code[:, None] >> (bits[None] + 1)) - 1  # (B, L)
+        valid = node >= 0
+        node_safe = jnp.maximum(node, 0)
+        bit = (code[:, None] >> bits[None]) & 1         # (B, L)
 
     s = jnp.einsum("bd,bld->bl", x, w[node_safe])       # (B, L)
     if bias is not None:

@@ -23,18 +23,32 @@ def _run(build, feed):
         return exe.run(main, feed=feed, fetch_list=list(outs))
 
 
-def test_hsigmoid_custom_tree_names_workaround():
+def test_hsigmoid_custom_tree_requires_tables():
+    """Custom trees are now implemented (r4); what remains contractual
+    is the reference's own argument check — is_custom without
+    path_table/path_code is a loud ValueError, not a silent default."""
     main, startup = framework.Program(), framework.Program()
     with framework.program_guard(main, startup):
         x = layers.data("x", [4, 8], append_batch_size=False)
         y = layers.data("y", [4, 1], dtype="int64",
                         append_batch_size=False)
-        with pytest.raises(NotImplementedError,
-                           match="default.*tree|complete"):
+        with pytest.raises(ValueError, match="path_table"):
             layers.hsigmoid(x, y, num_classes=6, is_custom=True)
+        # and the converse: tables without is_custom=True must not be
+        # silently dropped onto the default-tree objective
+        t = layers.data("t", [4, 3], dtype="int64",
+                        append_batch_size=False)
+        c = layers.data("c", [4, 3], dtype="int64",
+                        append_batch_size=False)
+        with pytest.raises(ValueError, match="is_custom"):
+            layers.hsigmoid(x, y, num_classes=6, path_table=t,
+                            path_code=c)
 
 
-def test_tree_conv_depth_names_workaround():
+def test_tree_conv_deep_window_runs():
+    """max_depth > 2 is now implemented (r4) — depth-4 windows execute;
+    exact numerics vs the reference algorithm live in
+    tests/ops/test_match_ops.py."""
     def build():
         nodes = layers.data("nodes", [2, 5, 4], append_batch_size=False)
         edges = layers.data("edges", [2, 4, 2], dtype="int32",
@@ -42,10 +56,13 @@ def test_tree_conv_depth_names_workaround():
         return (layers.tree_conv(nodes, edges, output_size=3,
                                  max_depth=4),)
 
-    with pytest.raises(NotImplementedError, match="max_depth=2"):
-        _run(build, {
-            "nodes": np.zeros((2, 5, 4), np.float32),
-            "edges": np.zeros((2, 4, 2), np.int32)})
+    out, = _run(build, {
+        "nodes": np.random.default_rng(0).standard_normal(
+            (2, 5, 4)).astype(np.float32),
+        "edges": np.array([[[1, 2], [2, 3], [3, 4], [0, 0]]] * 2,
+                          np.int32)})
+    assert np.asarray(out).shape == (2, 5, 3, 1)
+    assert np.abs(np.asarray(out)[:, :4]).sum() > 0
 
 
 def test_im2sequence_dynamic_size_names_workaround():

@@ -115,38 +115,77 @@ def test_var_conv_2d_masks_invalid_region():
     assert np.abs(got[1, :, :4, :5]).sum() > 0
 
 
-def test_tree_conv_aggregates_children():
-    # star tree: node 0 is root with children 1, 2, 3; plus isolated 4
-    B, N, D, H, F = 1, 5, 3, 4, 2
-    rng = np.random.default_rng(3)
+def _tree_conv_golden(nodes, edges, filt, max_depth):
+    """Independent numpy port of the reference algorithm: construct_tree
+    (tree2col.cc:54 — 1-based ids, stop at the first 0-padded edge),
+    construct_patch (tree2col.cc:23 — window = descendants at depth <
+    max_depth, child index 1-based in edge order), eta formulas
+    (tree2col.h:34-52), patch slots (eta_l, eta_r, eta_t) against
+    Filter[:, (0,1,2)] (tree2col.cc:121-126), rows past node_count zero
+    (tree_conv_op.h:72)."""
+    N = nodes.shape[0]
+    tr, ecount = {}, 0
+    for u, v in edges:
+        if u == 0 or v == 0:
+            break
+        tr.setdefault(int(u), []).append(int(v))
+        ecount += 1
+    node_count = ecount + 1
+    dd = float(max_depth)
+    wl, wr, wt = filt[:, 0], filt[:, 1], filt[:, 2]
+    out = np.zeros((N,) + wl.shape[1:], np.float32)
+    for root in range(1, node_count + 1):
+        patch = [(root, 1, 1, 0)]
+        frontier = [(root, 0)]
+        while frontier:
+            nxt = []
+            for u, dep in frontier:
+                if dep + 1 < max_depth:
+                    kids = tr.get(u, [])
+                    for i, v in enumerate(kids):
+                        patch.append((v, i + 1, len(kids), dep + 1))
+                        nxt.append((v, dep + 1))
+            frontier = nxt
+        acc = np.zeros(wl.shape[1:], np.float32)
+        for v, index, pclen, dep in patch:
+            eta_t = (dd - dep) / dd
+            temp = 0.5 if pclen == 1 else (index - 1.0) / (pclen - 1.0)
+            eta_l = (1.0 - eta_t) * temp
+            eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+            acc += np.einsum("d,dhf->hf", nodes[v - 1],
+                             eta_l * wl + eta_r * wr + eta_t * wt)
+        out[root - 1] = acc
+    return out
+
+
+@pytest.mark.parametrize("max_depth", [2, 3, 4])
+def test_tree_conv_matches_reference_algorithm(max_depth):
+    # tree (1-based ids, 0-padded edges):
+    #   1 -> 2, 3 ; 2 -> 4, 5, 6 ; 4 -> 7     (depth-3 chain 1-2-4-7)
+    B, N, D, H, F = 2, 8, 3, 4, 2
+    rng = np.random.default_rng(3 + max_depth)
     nodes = rng.standard_normal((B, N, D)).astype(np.float32)
-    edges = np.full((B, 6, 2), -1, np.int64)
-    edges[0, :3] = [[0, 1], [0, 2], [0, 3]]
+    filt = rng.standard_normal((D, 3, H, F)).astype(np.float32)
+    edges = np.zeros((B, 8, 2), np.int64)
+    edges[0, :6] = [[1, 2], [1, 3], [2, 4], [2, 5], [2, 6], [4, 7]]
+    # interior zero pair: the reference BREAKS there (tree2col.cc:76),
+    # so [3, 4] after it must be ignored and node_count stay 3
+    edges[1, :4] = [[1, 2], [1, 3], [0, 0], [3, 4]]
 
     def build():
         nv = fluid.data(name="n", shape=[B, N, D], dtype="float32")
-        ev = fluid.data(name="e", shape=[B, 6, 2], dtype="int64")
-        return layers.tree_conv(nv, ev, output_size=H, num_filters=F,
-                                act=None, bias_attr=False)
+        ev = fluid.data(name="e", shape=[B, 8, 2], dtype="int64")
+        return layers.tree_conv(
+            nv, ev, output_size=H, num_filters=F, max_depth=max_depth,
+            act=None, bias_attr=False,
+            param_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.NumpyArrayInitializer(filt)))
 
     got, = _run(build, {"n": nodes, "e": edges})
     assert got.shape == (B, N, H, F)
-    # leaves (no children) see only the self term -> identical structure:
-    # out_leaf = nodes @ W_top; root != its self term (children added)
-    w = None  # grab the parameter for a reference computation
-    main, startup = framework.Program(), framework.Program()
-    # simpler invariant: node 4 (isolated) equals node 4 with zero edges
-    edges2 = np.full((B, 6, 2), -1, np.int64)
-    def build2():
-        nv = fluid.data(name="n", shape=[B, N, D], dtype="float32")
-        ev = fluid.data(name="e", shape=[B, 6, 2], dtype="int64")
-        return layers.tree_conv(nv, ev, output_size=H, num_filters=F,
-                                act=None, bias_attr=False)
-    got2, = _run(build2, {"n": nodes, "e": edges2})
-    # isolated node output matches across edge sets (params differ per
-    # program, so compare structure instead: leaf rows are nonzero)
-    assert np.abs(got[0, 4]).sum() > 0
-    assert np.abs(got[0, 0]).sum() > 0
+    want = np.stack([_tree_conv_golden(nodes[i], edges[i], filt, max_depth)
+                     for i in range(B)])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
 
 def test_roi_perspective_transform_identity_quad():

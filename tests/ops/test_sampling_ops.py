@@ -73,13 +73,72 @@ def test_hsigmoid_trains_and_beats_chance():
     assert losses[-1] < 0.3 * losses[0], (losses[0], losses[-1])
 
 
-def test_hsigmoid_custom_tree_rejected():
-    with pytest.raises(NotImplementedError):
-        main, startup = framework.Program(), framework.Program()
-        with framework.program_guard(main, startup):
-            xv = fluid.data(name="x", shape=[4, 4], dtype="float32")
-            yv = fluid.data(name="y", shape=[4, 1], dtype="int64")
-            layers.hsigmoid(xv, yv, num_classes=6, is_custom=True)
+def test_hsigmoid_custom_tree_matches_formula():
+    """Custom-tree hsigmoid (ref matrix_bit_code.h:143 CustomCode):
+    PathTable rows are W indices per step, PathCode the binary targets,
+    path ends at the first negative table entry. Golden: per-step
+    sigmoid CE softplus(s) - bit*s summed over the valid prefix."""
+    rng = np.random.default_rng(7)
+    B, D, C, L = 4, 6, 5, 3
+    x = rng.standard_normal((B, D)).astype(np.float32)
+    y = rng.integers(0, 4, (B, 1)).astype(np.int64)
+    w = rng.standard_normal((C, D)).astype(np.float32)
+    bias = rng.standard_normal((C,)).astype(np.float32)
+    table = np.array([[0, 1, -1], [0, 2, 4], [3, -1, -1], [0, 1, 2]],
+                     np.int64)
+    code = np.array([[1, 0, 0], [0, 1, 1], [1, 0, 0], [0, 0, 1]],
+                    np.int64)
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        xv = fluid.data(name="x", shape=[B, D], dtype="float32")
+        yv = fluid.data(name="y", shape=[B, 1], dtype="int64")
+        tv = fluid.data(name="t", shape=[B, L], dtype="int64")
+        cv = fluid.data(name="c", shape=[B, L], dtype="int64")
+        out = layers.hsigmoid(
+            xv, yv, num_classes=C, path_table=tv, path_code=cv,
+            is_custom=True,
+            param_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.NumpyArrayInitializer(w)),
+            bias_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.NumpyArrayInitializer(bias)))
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        got, = exe.run(main, feed={"x": x, "y": y, "t": table, "c": code},
+                       fetch_list=[out])
+
+    want = np.zeros((B, 1), np.float32)
+    for b in range(B):
+        for t in range(L):
+            if table[b, t] < 0:
+                break
+            s = x[b] @ w[table[b, t]] + bias[table[b, t]]
+            want[b, 0] += np.logaddexp(0.0, s) - code[b, t] * s
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+def test_hsigmoid_custom_tree_trains_down():
+    rng = np.random.default_rng(8)
+    B, D, C, L = 16, 8, 7, 3
+    x = rng.standard_normal((B, D)).astype(np.float32)
+    y = rng.integers(0, 4, (B, 1)).astype(np.int64)
+    table = rng.integers(0, C, (B, L)).astype(np.int64)
+    table[:, -1] = -1                       # ragged path lengths
+    code = rng.integers(0, 2, (B, L)).astype(np.int64)
+
+    def build():
+        xv = fluid.data(name="x", shape=[B, D], dtype="float32")
+        yv = fluid.data(name="y", shape=[B, 1], dtype="int64")
+        tv = fluid.data(name="t", shape=[B, L], dtype="int64")
+        cv = fluid.data(name="c", shape=[B, L], dtype="int64")
+        return layers.mean(layers.hsigmoid(
+            xv, yv, num_classes=C, path_table=tv, path_code=cv,
+            is_custom=True))
+
+    losses = _train(build, {"x": x, "y": y, "t": table, "c": code},
+                    steps=80)
+    assert losses[-1] < 0.3 * losses[0], (losses[0], losses[-1])
 
 
 def test_sampled_softmax_approximates_full():
