@@ -15,7 +15,7 @@ __all__ = ["scaled_dot_product_attention", "multi_head_attention",
 
 def scaled_dot_product_attention(queries, keys, values, num_heads=1,
                                  dropout_rate=0.0, attn_bias=None,
-                                 causal=False, name=None):
+                                 causal=False, segment_ids=None, name=None):
     """Parity: fluid.nets.scaled_dot_product_attention.
 
     queries/keys/values: (B, T, d_model). Splits into num_heads, attends
@@ -35,6 +35,8 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
     inputs = {"Q": q, "K": k, "V": v}
     if attn_bias is not None:
         inputs["Bias"] = attn_bias
+    if segment_ids is not None:
+        inputs["SegmentIds"] = segment_ids
     helper.append_op("scaled_dot_product_attention", inputs, {"Out": out},
                      {"causal": causal})
     merged = nn_layers.transpose(out, [0, 2, 1, 3])
@@ -47,7 +49,7 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
 def multi_head_attention(queries, keys=None, values=None, num_heads=8,
                          d_model=None, attn_bias=None, causal=False,
                          param_attr=None, bias_attr=None, dropout_rate=0.0,
-                         name=None):
+                         segment_ids=None, name=None):
     """Full multi-head block: QKV + output projections fused into one op so
     the TPU path can keep everything in one flash kernel + 4 MXU matmuls."""
     from . import nn as nn_layers
@@ -90,6 +92,8 @@ def multi_head_attention(queries, keys=None, values=None, num_heads=8,
             inputs[nm] = v_
     if attn_bias is not None:
         inputs["Bias"] = attn_bias
+    if segment_ids is not None:
+        inputs["SegmentIds"] = segment_ids
     helper.append_op("multihead_attention", inputs, {"Out": out},
                      {"num_heads": num_heads, "causal": causal})
     if dropout_rate:

@@ -22,6 +22,8 @@ ErnieConfig = bert.BertConfig
 ernie_tiny = bert.bert_tiny
 build_pretrain_net = bert.build_pretrain_net
 build_classifier_net = bert.build_classifier_net
+build_packed_pretrain_net = bert.build_packed_pretrain_net
+make_packed_pretrain_feed = bert.make_packed_pretrain_feed
 
 MASK_TOKEN_RATE = 0.8    # of selected positions: replaced with [MASK]
 RANDOM_TOKEN_RATE = 0.1  # ... replaced with a random token (rest kept)

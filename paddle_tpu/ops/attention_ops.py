@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from . import register
 
 _flash_warned = False
+_ring_seg_warned = False
 
 
 def _use_pallas():
@@ -77,12 +78,30 @@ def _active_sp_mesh(q, k, bias):
     return mesh
 
 
-def dot_product_attention(q, k, v, bias=None, scale=None, causal=False):
+def dot_product_attention(q, k, v, bias=None, scale=None, causal=False,
+                          segment_ids=None):
     """Dispatch: ring attention over 'sp' when the Executor activated a
     sequence-parallel mesh (the framework path to long context — K/V and
     the key-side bias rotate over ICI, O(T/sp) memory per chip); else the
-    Pallas flash kernel on TPU; else the XLA composition."""
-    sp_mesh = _active_sp_mesh(q, k, bias)
+    Pallas flash kernel on TPU; else the XLA composition.
+
+    segment_ids (B, T) int enables packed-sequence attention (tokens only
+    attend within their own segment). On the flash path the ids are
+    compared blockwise inside the kernels (O(T) HBM); the XLA fallback
+    materializes the mask (it materializes scores anyway). The ring path
+    cannot rotate a per-query mask — packed inputs take the dense paths."""
+    if segment_ids is not None and _active_sp_mesh(q, k, bias) is not None:
+        global _ring_seg_warned
+        if not _ring_seg_warned:
+            warnings.warn(
+                "packed (segment_ids) attention cannot ride the 'sp' ring "
+                "path — the per-query segment mask does not rotate; taking "
+                "the dense flash path, so K/V are full-length per chip. "
+                "Unpack or drop the sp axis for long-context training.",
+                RuntimeWarning, stacklevel=2)
+            _ring_seg_warned = True
+    sp_mesh = (_active_sp_mesh(q, k, bias)
+               if segment_ids is None else None)
     if sp_mesh is not None:
         from ..parallel.ring_attention import ring_attention_sharded
         return ring_attention_sharded(q, k, v, sp_mesh, causal=causal,
@@ -91,7 +110,7 @@ def dot_product_attention(q, k, v, bias=None, scale=None, causal=False):
         try:
             from .pallas.flash import flash_attention
             return flash_attention(q, k, v, bias=bias, scale=scale,
-                                   causal=causal)
+                                   causal=causal, segment_ids=segment_ids)
         except Exception as e:
             # Never degrade silently: on TPU a dead flash kernel means the
             # hot path quietly became O(T^2) (VERDICT r1 weak #7).
@@ -105,17 +124,25 @@ def dot_product_attention(q, k, v, bias=None, scale=None, causal=False):
                     "PADDLE_TPU_STRICT_FLASH=1 to make this fatal.",
                     RuntimeWarning, stacklevel=2)
                 _flash_warned = True
+    if segment_ids is not None:
+        from .pallas.flash import segment_mask_bias
+        seg_b = (segment_mask_bias(*segment_ids)
+                 if isinstance(segment_ids, (tuple, list))
+                 else segment_mask_bias(segment_ids))
+        bias = seg_b if bias is None else bias + seg_b
     return _xla_attention(q, k, v, bias=bias, scale=scale, causal=causal)
 
 
 @register("scaled_dot_product_attention")
 def scaled_dot_product_attention_op(ctx):
-    """Q/K/V: (B, H, T, D). Optional Bias broadcastable to (B, H, Tq, Tk)."""
+    """Q/K/V: (B, H, T, D). Optional Bias broadcastable to (B, H, Tq, Tk);
+    optional SegmentIds (B, T) for packed-sequence attention."""
     q, k, v = ctx.in_("Q"), ctx.in_("K"), ctx.in_("V")
     bias = ctx.in_("Bias")
+    seg = ctx.in_("SegmentIds")
     out = dot_product_attention(
         q, k, v, bias=bias, scale=ctx.attr("scale"),
-        causal=bool(ctx.attr("causal", False)))
+        causal=bool(ctx.attr("causal", False)), segment_ids=seg)
     return {"Out": out}
 
 
@@ -147,8 +174,10 @@ def multihead_attention_op(ctx):
     q = split_heads(proj(q_in, wq, bq))
     k = split_heads(proj(k_in, wk, bk))
     v = split_heads(proj(v_in, wv, bv))
+    seg = ctx.in_("SegmentIds")
     o = dot_product_attention(q, k, v, bias=bias,
-                              causal=bool(ctx.attr("causal", False)))
+                              causal=bool(ctx.attr("causal", False)),
+                              segment_ids=seg)
     b_, h, t, d = o.shape
     o = o.transpose(0, 2, 1, 3).reshape(b_, t, h * d)
     return {"Out": proj(o, wo, bo)}

@@ -77,17 +77,24 @@ def _bias_index_fn(bb, hb, h):
     return lambda bh: 0
 
 
-def _mask(s, q0, block_q, kb, block_k, q_len, kv_len, causal):
-    """Apply validity + causal masking to a (block_q, block_k) score tile.
-    Causal convention matches the XLA oracle: key j visible to query i iff
-    j <= i + (kv_len - q_len) (bottom-right aligned, = lower-triangular
-    when q_len == kv_len)."""
+def _mask(s, q0, block_q, kb, block_k, q_len, kv_len, causal,
+          qseg=None, kseg=None):
+    """Apply validity + causal + segment masking to a (block_q, block_k)
+    score tile. Causal convention matches the XLA oracle: key j visible to
+    query i iff j <= i + (kv_len - q_len) (bottom-right aligned, =
+    lower-triangular when q_len == kv_len). qseg (block_q,) / kseg
+    (block_k,) int32: packed-sequence mode — visibility additionally
+    requires equal segment ids, keeping each packed document's attention
+    independent with only O(T) segment vectors in HBM (never a (T, T)
+    mask tensor)."""
     q_pos = q0 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
     k_pos = kb * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
     valid = (k_pos < kv_len) & (q_pos < q_len)
     if causal:
         valid &= k_pos <= q_pos + (kv_len - q_len)
+    if qseg is not None:
+        valid &= qseg[:, None] == kseg[None, :]
     return jnp.where(valid, s, NEG_INF)
 
 
@@ -96,16 +103,18 @@ def _mask(s, q0, block_q, kb, block_k, q_len, kv_len, causal):
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(*refs, scale, causal, block_k, q_len, kv_len,
-                has_bias, bias_per_q):
-    if has_bias:
-        q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref = refs
-    else:
-        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
-        b_ref = None
+                has_bias, bias_per_q, has_seg):
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    del refs[:3]
+    b_ref = refs.pop(0) if has_bias else None
+    qs_ref, ks_ref = (refs.pop(0), refs.pop(0)) if has_seg else (None, None)
+    o_ref, lse_ref = refs
     q = q_ref[0].astype(jnp.float32) * scale
     block_q, d = q.shape
     q0 = pl.program_id(1) * block_q
     num_kb = pl.cdiv(kv_len, block_k)
+    qseg = qs_ref[0][:, 0] if has_seg else None
 
     def body(kb, carry):
         acc, m_prev, l_prev = carry
@@ -118,7 +127,10 @@ def _fwd_kernel(*refs, scale, causal, block_k, q_len, kv_len,
             else:
                 bblk = b_ref[0, 0:1, pl.ds(kb * block_k, block_k)]
             s = s + bblk.astype(jnp.float32)
-        s = _mask(s, q0, block_q, kb, block_k, q_len, kv_len, causal)
+        kseg = (ks_ref[0, pl.ds(kb * block_k, block_k), 0]
+                if has_seg else None)
+        s = _mask(s, q0, block_q, kb, block_k, q_len, kv_len, causal,
+                  qseg=qseg, kseg=kseg)
         m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
@@ -158,7 +170,19 @@ def _prep_qkv_bias(q, k, v, bias, block_q, block_k):
     return q3, k3, v3, bias3, bidx, per_q, bq, bk
 
 
-def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k):
+def _prep_seg(segq, segk, bq, bk):
+    """Lane-pad (B, Tq)/(B, Tk) int segment ids to the kernels' tile
+    layout: (B, T_padded, LSE_LANES) int32, same escape hatch as the lse.
+    Pad values are arbitrary — padded q rows are sliced off and padded k
+    columns are already masked by k_pos < kv_len."""
+    if segq is None:
+        return None, None
+    qs = _lane_pad(jnp.asarray(segq).astype(jnp.int32), bq)
+    ks = _lane_pad(jnp.asarray(segk).astype(jnp.int32), bk)
+    return qs, ks
+
+
+def _flash_fwd(q, k, v, bias, segq, segk, scale, causal, block_q, block_k):
     b, h, tq, d = q.shape
     tk = k.shape[2]
     q3, k3, v3, bias3, bidx, per_q, bq, bk = _prep_qkv_bias(
@@ -181,11 +205,22 @@ def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k):
             in_specs.append(pl.BlockSpec(
                 (1, 1, tk_p), lambda bh, i, f=bidx: (f(bh), 0, 0)))
         operands.append(bias3)
+    has_seg = segq is not None
+    if has_seg:
+        qs3, ks3 = _prep_seg(segq, segk, bq, bk)
+        in_specs += [
+            pl.BlockSpec((1, bq, LSE_LANES),
+                         lambda bh, i, hh=h: (bh // hh, i, 0)),
+            pl.BlockSpec((1, tk_p, LSE_LANES),
+                         lambda bh, i, hh=h: (bh // hh, 0, 0)),
+        ]
+        operands += [qs3, ks3]
 
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
                           block_k=bk, q_len=tq, kv_len=tk,
-                          has_bias=has_bias, bias_per_q=per_q),
+                          has_bias=has_bias, bias_per_q=per_q,
+                          has_seg=has_seg),
         grid=grid,
         in_specs=in_specs,
         out_specs=[pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
@@ -206,18 +241,18 @@ def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k):
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel_kgrid(*refs, scale, causal, q_len, kv_len, num_kb,
-                      has_bias, bias_per_q):
+                      has_bias, bias_per_q, has_seg):
     """One (bh, q_block, k_block) grid step. The TPU grid runs the
     innermost dimension sequentially on a core, so the online-softmax
     state lives in VMEM scratch across k steps — K/V stream through
     block-sized windows instead of residing whole in VMEM, lifting the
     sequence-length ceiling from VMEM capacity to HBM."""
-    if has_bias:
-        (q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
-         acc_ref, m_ref, l_ref) = refs
-    else:
-        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
-        b_ref = None
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    del refs[:3]
+    b_ref = refs.pop(0) if has_bias else None
+    qs_ref, ks_ref = (refs.pop(0), refs.pop(0)) if has_seg else (None, None)
+    o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     kb = pl.program_id(2)
     q = q_ref[0].astype(jnp.float32) * scale
     block_q, d = q.shape
@@ -236,7 +271,9 @@ def _fwd_kernel_kgrid(*refs, scale, causal, q_len, kv_len, num_kb,
     if b_ref is not None:
         bblk = b_ref[0] if bias_per_q else b_ref[0, 0:1]
         s = s + bblk.astype(jnp.float32)
-    s = _mask(s, q0, block_q, kb, block_k, q_len, kv_len, causal)
+    s = _mask(s, q0, block_q, kb, block_k, q_len, kv_len, causal,
+              qseg=qs_ref[0][:, 0] if has_seg else None,
+              kseg=ks_ref[0][:, 0] if has_seg else None)
 
     m_prev = m_ref[:, 0:1]
     l_prev = l_ref[:, 0:1]
@@ -258,7 +295,8 @@ def _fwd_kernel_kgrid(*refs, scale, causal, q_len, kv_len, num_kb,
         lse_ref[0] = jnp.broadcast_to(lse, (block_q, LSE_LANES))
 
 
-def _flash_fwd_kgrid(q, k, v, bias, scale, causal, block_q, block_k):
+def _flash_fwd_kgrid(q, k, v, bias, segq, segk, scale, causal, block_q,
+                     block_k):
     """Forward with K/V streamed by the grid. Same contract as
     _flash_fwd; selected for long contexts (see flash_attention_with_lse)
     or forced with PT_FLASH_KGRID=1."""
@@ -287,11 +325,22 @@ def _flash_fwd_kgrid(q, k, v, bias, scale, causal, block_q, block_k):
             in_specs.append(pl.BlockSpec(
                 (1, 1, bk), lambda bh, i, j, f=bidx: (f(bh), 0, j)))
         operands.append(bias3)
+    has_seg = segq is not None
+    if has_seg:
+        qs3, ks3 = _prep_seg(segq, segk, bq, bk)
+        in_specs += [
+            pl.BlockSpec((1, bq, LSE_LANES),
+                         lambda bh, i, j, hh=h: (bh // hh, i, 0)),
+            pl.BlockSpec((1, bk, LSE_LANES),
+                         lambda bh, i, j, hh=h: (bh // hh, j, 0)),
+        ]
+        operands += [qs3, ks3]
 
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel_kgrid, scale=scale, causal=causal,
                           q_len=tq, kv_len=tk, num_kb=num_kb,
-                          has_bias=has_bias, bias_per_q=per_q),
+                          has_bias=has_bias, bias_per_q=per_q,
+                          has_seg=has_seg),
         grid=grid,
         in_specs=in_specs,
         out_specs=[pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
@@ -330,12 +379,13 @@ def _use_kgrid(tk_p, d):
 # ---------------------------------------------------------------------------
 
 def _dq_kernel(*refs, scale, causal, block_k, q_len, kv_len,
-               has_bias, bias_per_q):
-    if has_bias:
-        q_ref, k_ref, v_ref, b_ref, lse_ref, dlt_ref, do_ref, dq_ref = refs
-    else:
-        q_ref, k_ref, v_ref, lse_ref, dlt_ref, do_ref, dq_ref = refs
-        b_ref = None
+               has_bias, bias_per_q, has_seg):
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    del refs[:3]
+    b_ref = refs.pop(0) if has_bias else None
+    qs_ref, ks_ref = (refs.pop(0), refs.pop(0)) if has_seg else (None, None)
+    lse_ref, dlt_ref, do_ref, dq_ref = refs
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0][:, 0:1]
@@ -343,6 +393,7 @@ def _dq_kernel(*refs, scale, causal, block_k, q_len, kv_len,
     block_q, d = q.shape
     q0 = pl.program_id(1) * block_q
     num_kb = pl.cdiv(kv_len, block_k)
+    qseg = qs_ref[0][:, 0] if has_seg else None
 
     def body(kb, acc):
         k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
@@ -354,7 +405,10 @@ def _dq_kernel(*refs, scale, causal, block_k, q_len, kv_len,
             else:
                 bblk = b_ref[0, 0:1, pl.ds(kb * block_k, block_k)]
             s = s + bblk.astype(jnp.float32)
-        s = _mask(s, q0, block_q, kb, block_k, q_len, kv_len, causal)
+        kseg = (ks_ref[0, pl.ds(kb * block_k, block_k), 0]
+                if has_seg else None)
+        s = _mask(s, q0, block_q, kb, block_k, q_len, kv_len, causal,
+                  qseg=qseg, kseg=kseg)
         p = jnp.exp(s - lse)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - dlt)
@@ -366,18 +420,19 @@ def _dq_kernel(*refs, scale, causal, block_k, q_len, kv_len,
 
 
 def _dkv_kernel(*refs, scale, causal, block_q, q_len, kv_len,
-                has_bias, bias_per_q):
-    if has_bias:
-        q_ref, k_ref, v_ref, b_ref, lse_ref, dlt_ref, do_ref, \
-            dk_ref, dv_ref = refs
-    else:
-        q_ref, k_ref, v_ref, lse_ref, dlt_ref, do_ref, dk_ref, dv_ref = refs
-        b_ref = None
+                has_bias, bias_per_q, has_seg):
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    del refs[:3]
+    b_ref = refs.pop(0) if has_bias else None
+    qs_ref, ks_ref = (refs.pop(0), refs.pop(0)) if has_seg else (None, None)
+    lse_ref, dlt_ref, do_ref, dk_ref, dv_ref = refs
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
     block_k, d = k.shape
     kb = pl.program_id(1)
     num_qb = pl.cdiv(q_len, block_q)
+    kseg = ks_ref[0][:, 0] if has_seg else None
 
     def body(qb, carry):
         dk_acc, dv_acc = carry
@@ -393,8 +448,10 @@ def _dkv_kernel(*refs, scale, causal, block_q, q_len, kv_len,
             else:
                 bblk = b_ref[0, 0:1, :]
             s = s + bblk.astype(jnp.float32)
+        qseg_blk = (qs_ref[0, pl.ds(qb * block_q, block_q), 0]
+                    if has_seg else None)
         s = _mask(s, qb * block_q, block_q, kb, block_k, q_len, kv_len,
-                  causal)
+                  causal, qseg=qseg_blk, kseg=kseg)
         p = jnp.exp(s - lse_blk)
         dv_acc = dv_acc + jnp.dot(p.T, do_blk,
                                   preferred_element_type=jnp.float32)
@@ -411,16 +468,15 @@ def _dkv_kernel(*refs, scale, causal, block_q, q_len, kv_len,
 
 
 def _dq_kernel_kgrid(*refs, scale, causal, q_len, kv_len, num_kb,
-                     has_bias, bias_per_q):
+                     has_bias, bias_per_q, has_seg):
     """dQ with K/V streamed by the grid: grid (bh, q_block, k_block),
     the dq accumulator carried in VMEM scratch across k steps."""
-    if has_bias:
-        q_ref, k_ref, v_ref, b_ref, lse_ref, dlt_ref, do_ref, dq_ref, \
-            acc_ref = refs
-    else:
-        q_ref, k_ref, v_ref, lse_ref, dlt_ref, do_ref, dq_ref, \
-            acc_ref = refs
-        b_ref = None
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    del refs[:3]
+    b_ref = refs.pop(0) if has_bias else None
+    qs_ref, ks_ref = (refs.pop(0), refs.pop(0)) if has_seg else (None, None)
+    lse_ref, dlt_ref, do_ref, dq_ref, acc_ref = refs
     kb = pl.program_id(2)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
@@ -440,7 +496,9 @@ def _dq_kernel_kgrid(*refs, scale, causal, q_len, kv_len, num_kb,
     if b_ref is not None:
         bblk = b_ref[0] if bias_per_q else b_ref[0, 0:1]
         s = s + bblk.astype(jnp.float32)
-    s = _mask(s, q0, block_q, kb, block_k, q_len, kv_len, causal)
+    s = _mask(s, q0, block_q, kb, block_k, q_len, kv_len, causal,
+              qseg=qs_ref[0][:, 0] if has_seg else None,
+              kseg=ks_ref[0][:, 0] if has_seg else None)
     p = jnp.exp(s - lse)
     dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
     ds = p * (dp - dlt)
@@ -452,16 +510,15 @@ def _dq_kernel_kgrid(*refs, scale, causal, q_len, kv_len, num_kb,
 
 
 def _dkv_kernel_kgrid(*refs, scale, causal, q_len, kv_len, num_qb,
-                      has_bias, bias_per_q):
+                      has_bias, bias_per_q, has_seg):
     """dK/dV with Q/dO streamed by the grid: grid (bh, k_block, q_block),
     dk/dv accumulators carried in VMEM scratch across q steps."""
-    if has_bias:
-        q_ref, k_ref, v_ref, b_ref, lse_ref, dlt_ref, do_ref, \
-            dk_ref, dv_ref, dk_acc, dv_acc = refs
-    else:
-        q_ref, k_ref, v_ref, lse_ref, dlt_ref, do_ref, \
-            dk_ref, dv_ref, dk_acc, dv_acc = refs
-        b_ref = None
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    del refs[:3]
+    b_ref = refs.pop(0) if has_bias else None
+    qs_ref, ks_ref = (refs.pop(0), refs.pop(0)) if has_seg else (None, None)
+    lse_ref, dlt_ref, do_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
     kb = pl.program_id(1)
     qb = pl.program_id(2)
     k = k_ref[0].astype(jnp.float32)
@@ -482,7 +539,9 @@ def _dkv_kernel_kgrid(*refs, scale, causal, q_len, kv_len, num_qb,
     if b_ref is not None:
         bblk = b_ref[0] if bias_per_q else b_ref[0, 0:1]
         s = s + bblk.astype(jnp.float32)
-    s = _mask(s, qb * block_q, block_q, kb, block_k, q_len, kv_len, causal)
+    s = _mask(s, qb * block_q, block_q, kb, block_k, q_len, kv_len, causal,
+              qseg=qs_ref[0][:, 0] if has_seg else None,
+              kseg=ks_ref[0][:, 0] if has_seg else None)
     p = jnp.exp(s - lse_blk)
     dv_acc[...] += jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
     dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
@@ -495,8 +554,8 @@ def _dkv_kernel_kgrid(*refs, scale, causal, q_len, kv_len, num_qb,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd_kgrid(q, k, v, bias, lse, out, do, scale, causal, block_q,
-                     block_k, dlse=None):
+def _flash_bwd_kgrid(q, k, v, bias, segq, segk, lse, out, do, scale,
+                     causal, block_q, block_k, dlse=None):
     """Backward with the SAME VMEM discipline as _flash_fwd_kgrid —
     everything streams through block-sized grid windows, so long-context
     TRAINING fits too, not just the forward."""
@@ -518,6 +577,8 @@ def _flash_bwd_kgrid(q, k, v, bias, lse, out, do, scale, causal, block_q,
     lse_p = _lane_pad(lse.reshape(b * h, tq), bq)
     dlt_p = _lane_pad(delta.reshape(b * h, tq), bq)
     has_bias = bias is not None
+    has_seg = segq is not None
+    qs3, ks3 = _prep_seg(segq, segk, bq, bk)
 
     # -- dQ: grid (bh, qb, kb) ------------------------------------------
     in_specs = [
@@ -534,6 +595,14 @@ def _flash_bwd_kgrid(q, k, v, bias, lse, out, do, scale, causal, block_q,
             in_specs.append(pl.BlockSpec(
                 (1, 1, bk), lambda bh, i, j, f=bidx: (f(bh), 0, j)))
         operands.append(bias3)
+    if has_seg:
+        in_specs += [
+            pl.BlockSpec((1, bq, LSE_LANES),
+                         lambda bh, i, j, hh=h: (bh // hh, i, 0)),
+            pl.BlockSpec((1, bk, LSE_LANES),
+                         lambda bh, i, j, hh=h: (bh // hh, j, 0)),
+        ]
+        operands += [qs3, ks3]
     in_specs += [
         pl.BlockSpec((1, bq, LSE_LANES), lambda bh, i, j: (bh, i, 0)),
         pl.BlockSpec((1, bq, LSE_LANES), lambda bh, i, j: (bh, i, 0)),
@@ -543,7 +612,8 @@ def _flash_bwd_kgrid(q, k, v, bias, lse, out, do, scale, causal, block_q,
     dq = pl.pallas_call(
         functools.partial(_dq_kernel_kgrid, scale=scale, causal=causal,
                           q_len=tq, kv_len=tk, num_kb=num_kb,
-                          has_bias=has_bias, bias_per_q=per_q),
+                          has_bias=has_bias, bias_per_q=per_q,
+                          has_seg=has_seg),
         grid=(b * h, num_qb, num_kb),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
@@ -567,6 +637,14 @@ def _flash_bwd_kgrid(q, k, v, bias, lse, out, do, scale, causal, block_q,
             in_specs.append(pl.BlockSpec(
                 (1, 1, bk), lambda bh, j, i, f=bidx: (f(bh), 0, j)))
         operands.append(bias3)
+    if has_seg:
+        in_specs += [
+            pl.BlockSpec((1, bq, LSE_LANES),
+                         lambda bh, j, i, hh=h: (bh // hh, i, 0)),
+            pl.BlockSpec((1, bk, LSE_LANES),
+                         lambda bh, j, i, hh=h: (bh // hh, j, 0)),
+        ]
+        operands += [qs3, ks3]
     in_specs += [
         pl.BlockSpec((1, bq, LSE_LANES), lambda bh, j, i: (bh, i, 0)),
         pl.BlockSpec((1, bq, LSE_LANES), lambda bh, j, i: (bh, i, 0)),
@@ -576,7 +654,8 @@ def _flash_bwd_kgrid(q, k, v, bias, lse, out, do, scale, causal, block_q,
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel_kgrid, scale=scale, causal=causal,
                           q_len=tq, kv_len=tk, num_qb=num_qb,
-                          has_bias=has_bias, bias_per_q=per_q),
+                          has_bias=has_bias, bias_per_q=per_q,
+                          has_seg=has_seg),
         grid=(b * h, num_kb, num_qb),
         in_specs=in_specs,
         out_specs=[pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
@@ -594,8 +673,8 @@ def _flash_bwd_kgrid(q, k, v, bias, lse, out, do, scale, causal, block_q,
     return dq, dk, dv, delta
 
 
-def _flash_bwd(q, k, v, bias, lse, out, do, scale, causal, block_q, block_k,
-               dlse=None):
+def _flash_bwd(q, k, v, bias, segq, segk, lse, out, do, scale, causal,
+               block_q, block_k, dlse=None):
     b, h, tq, d = q.shape
     tk = k.shape[2]
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
@@ -611,6 +690,8 @@ def _flash_bwd(q, k, v, bias, lse, out, do, scale, causal, block_q, block_k,
     dlt_p = _lane_pad(delta.reshape(b * h, tq), bq)
     tq_p, tk_p = q_p.shape[1], k_p.shape[1]
     has_bias = bias is not None
+    has_seg = segq is not None
+    qs3, ks3 = _prep_seg(segq, segk, bq, bk)
 
     # -- dQ: grid over q blocks, loop over k blocks.
     in_specs = [
@@ -627,6 +708,14 @@ def _flash_bwd(q, k, v, bias, lse, out, do, scale, causal, block_q, block_k,
             in_specs.append(pl.BlockSpec(
                 (1, 1, tk_p), lambda bh, i, f=bidx: (f(bh), 0, 0)))
         operands.append(bias3)
+    if has_seg:
+        in_specs += [
+            pl.BlockSpec((1, bq, LSE_LANES),
+                         lambda bh, i, hh=h: (bh // hh, i, 0)),
+            pl.BlockSpec((1, tk_p, LSE_LANES),
+                         lambda bh, i, hh=h: (bh // hh, 0, 0)),
+        ]
+        operands += [qs3, ks3]
     in_specs += [
         pl.BlockSpec((1, bq, LSE_LANES), lambda bh, i: (bh, i, 0)),
         pl.BlockSpec((1, bq, LSE_LANES), lambda bh, i: (bh, i, 0)),
@@ -636,7 +725,8 @@ def _flash_bwd(q, k, v, bias, lse, out, do, scale, causal, block_q, block_k,
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           block_k=bk, q_len=tq, kv_len=tk,
-                          has_bias=has_bias, bias_per_q=per_q),
+                          has_bias=has_bias, bias_per_q=per_q,
+                          has_seg=has_seg),
         grid=(b * h, tq_p // bq),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
@@ -659,6 +749,14 @@ def _flash_bwd(q, k, v, bias, lse, out, do, scale, causal, block_q, block_k,
             in_specs.append(pl.BlockSpec(
                 (1, 1, bk), lambda bh, j, f=bidx: (f(bh), 0, j)))
         operands.append(bias3)
+    if has_seg:
+        in_specs += [
+            pl.BlockSpec((1, tq_p, LSE_LANES),
+                         lambda bh, j, hh=h: (bh // hh, 0, 0)),
+            pl.BlockSpec((1, bk, LSE_LANES),
+                         lambda bh, j, hh=h: (bh // hh, j, 0)),
+        ]
+        operands += [qs3, ks3]
     in_specs += [
         pl.BlockSpec((1, tq_p, LSE_LANES), lambda bh, j: (bh, 0, 0)),
         pl.BlockSpec((1, tq_p, LSE_LANES), lambda bh, j: (bh, 0, 0)),
@@ -668,7 +766,8 @@ def _flash_bwd(q, k, v, bias, lse, out, do, scale, causal, block_q, block_k,
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           block_q=bq, q_len=tq, kv_len=tk,
-                          has_bias=has_bias, bias_per_q=per_q),
+                          has_bias=has_bias, bias_per_q=per_q,
+                          has_seg=has_seg),
         grid=(b * h, tk_p // bk),
         in_specs=in_specs,
         out_specs=[pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
@@ -684,7 +783,8 @@ def _flash_bwd(q, k, v, bias, lse, out, do, scale, causal, block_q, block_k,
     return dq, dk, dv, delta
 
 
-def _dbias_xla(q, k, v, bias, lse, do, delta, scale, causal):
+def _dbias_xla(q, k, v, bias, lse, do, delta, scale, causal,
+               segq=None, segk=None):
     """Bias cotangent, straight from the flash identities:
     dS = P * (dP - delta). O(T^2) — but this expression is only kept alive
     by XLA when something downstream actually differentiates w.r.t. the
@@ -696,6 +796,9 @@ def _dbias_xla(q, k, v, bias, lse, do, delta, scale, causal):
         i = jnp.arange(tq)[:, None]
         j = jnp.arange(tk)[None, :]
         s = jnp.where(j <= i + (tk - tq), s, NEG_INF)
+    if segq is not None:
+        same = segq[:, None, :, None] == segk[:, None, None, :]
+        s = jnp.where(same, s, NEG_INF)
     p = jnp.exp(s - lse[..., None])
     dp = jnp.einsum("bhqd,bhkd->bhqk", do.astype(jnp.float32),
                     v.astype(jnp.float32))
@@ -715,42 +818,59 @@ def _padded_len(n, block):
     return n + (-n) % blk
 
 
-def _fwd_dispatch(q, k, v, bias, scale, causal, block_q, block_k):
+def _fwd_dispatch(q, k, v, bias, segq, segk, scale, causal, block_q,
+                  block_k):
     # long contexts stream K/V through the grid (full-KV VMEM residency
     # is the ceiling of the default kernel); short ones keep the
     # hardware-proven path
     if _use_kgrid(_padded_len(k.shape[2], block_k), q.shape[-1]):
-        return _flash_fwd_kgrid(q, k, v, bias, scale, causal, block_q,
-                                block_k)
-    return _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k)
+        return _flash_fwd_kgrid(q, k, v, bias, segq, segk, scale, causal,
+                                block_q, block_k)
+    return _flash_fwd(q, k, v, bias, segq, segk, scale, causal, block_q,
+                      block_k)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, bias, scale, causal, block_q, block_k):
+def _int_zero_cotangent(x):
+    """custom_vjp cotangent for an integer primal (segment ids): float0
+    zeros, the JAX-sanctioned 'no gradient' for non-inexact inputs."""
+    if x is None:
+        return None
+    import numpy as np
+    return np.zeros(x.shape, jax.dtypes.float0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9))
+def _flash(q, k, v, bias, segq, segk, scale, causal, block_q, block_k):
     """Differentiable (out, lse). The lse output is what makes the ring-
     attention online combine differentiable: its cotangent folds into the
-    backward's delta term (ds = p*(dp - delta + dlse))."""
-    return _fwd_dispatch(q, k, v, bias, scale, causal, block_q, block_k)
+    backward's delta term (ds = p*(dp - delta + dlse)). segq/segk are
+    integer segment ids (packed-sequence masking, applied inside every
+    kernel) — non-differentiable by construction."""
+    return _fwd_dispatch(q, k, v, bias, segq, segk, scale, causal,
+                         block_q, block_k)
 
 
-def _flash_vjp_fwd(q, k, v, bias, scale, causal, block_q, block_k):
-    out, lse = _fwd_dispatch(q, k, v, bias, scale, causal, block_q,
-                             block_k)
-    return (out, lse), (q, k, v, bias, lse, out)
+def _flash_vjp_fwd(q, k, v, bias, segq, segk, scale, causal, block_q,
+                   block_k):
+    out, lse = _fwd_dispatch(q, k, v, bias, segq, segk, scale, causal,
+                             block_q, block_k)
+    return (out, lse), (q, k, v, bias, segq, segk, lse, out)
 
 
 def _flash_vjp_bwd(scale, causal, block_q, block_k, res, g):
-    q, k, v, bias, lse, out = res
+    q, k, v, bias, segq, segk, lse, out = res
     do, dlse = g
     bwd = (_flash_bwd_kgrid
            if _use_kgrid(_padded_len(k.shape[2], block_k), q.shape[-1])
            else _flash_bwd)
-    dq, dk, dv, delta = bwd(q, k, v, bias, lse, out, do, scale,
-                            causal, block_q, block_k, dlse=dlse)
+    dq, dk, dv, delta = bwd(q, k, v, bias, segq, segk, lse, out, do,
+                            scale, causal, block_q, block_k, dlse=dlse)
+    dsq, dsk = _int_zero_cotangent(segq), _int_zero_cotangent(segk)
     if bias is None:
-        return dq, dk, dv, None
-    db = _dbias_xla(q, k, v, bias, lse, do, delta, scale, causal)
-    return dq, dk, dv, db
+        return dq, dk, dv, None, dsq, dsk
+    db = _dbias_xla(q, k, v, bias, lse, do, delta, scale, causal,
+                    segq=segq, segk=segk)
+    return dq, dk, dv, db, dsq, dsk
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -855,17 +975,55 @@ def default_blocks():
     return tuple(out)
 
 
+def segment_mask_bias(segment_ids_q, segment_ids_k=None):
+    """Additive attention bias (B, 1, Tq, Tk) that blocks cross-segment
+    attention: 0 inside a segment, NEG_INF across. The packed-sequence
+    building block — several short documents share one row and this bias
+    keeps their attentions independent, so no FLOPs are wasted on pad
+    tokens (reserve one segment id, e.g. 0, for padding). Rides the
+    in-kernel bias path (fwd + bwd), the same mechanism as any user
+    bias."""
+    sq = jnp.asarray(segment_ids_q)
+    sk = sq if segment_ids_k is None else jnp.asarray(segment_ids_k)
+    same = sq[:, None, :, None] == sk[:, None, None, :]
+    return jnp.where(same, 0.0, NEG_INF).astype(jnp.float32)
+
+
+def _canonical_seg(segment_ids, b, tq, tk):
+    """Normalize the segment_ids argument to (segq (B, Tq), segk (B, Tk))
+    int32 arrays. Accepts a single (B, T) array (self-attention) or a
+    (seg_q, seg_k) pair (cross-attention over a packed memory)."""
+    if segment_ids is None:
+        return None, None
+    if isinstance(segment_ids, (tuple, list)):
+        sq, sk = segment_ids
+    else:
+        sq = sk = segment_ids
+    sq = jnp.asarray(sq).astype(jnp.int32)
+    sk = jnp.asarray(sk).astype(jnp.int32)
+    if sq.shape != (b, tq) or sk.shape != (b, tk):
+        raise ValueError(
+            f"segment_ids shapes {sq.shape}/{sk.shape} do not match "
+            f"attention (B={b}, Tq={tq}, Tk={tk})")
+    return sq, sk
+
+
 def flash_attention(q, k, v, bias=None, scale=None, causal=False,
-                    block_q=None, block_k=None):
+                    block_q=None, block_k=None, segment_ids=None):
     """Fused blockwise attention. q/k/v: (B, H, T, D); bias broadcastable to
-    (B, H, Tq, Tk) is applied inside the kernel (additive, pre-softmax)."""
+    (B, H, Tq, Tk) is applied inside the kernel (additive, pre-softmax).
+    segment_ids (B, T) int (or a (seg_q, seg_k) pair): packed-sequence
+    mode — tokens only attend within their own segment; the ids are
+    compared blockwise INSIDE the kernels, so HBM holds O(T) id vectors,
+    never a (T, T) mask."""
     return flash_attention_with_lse(q, k, v, bias=bias, scale=scale,
                                     causal=causal, block_q=block_q,
-                                    block_k=block_k)[0]
+                                    block_k=block_k,
+                                    segment_ids=segment_ids)[0]
 
 
 def flash_attention_with_lse(q, k, v, bias=None, scale=None, causal=False,
-                             block_q=None, block_k=None):
+                             block_q=None, block_k=None, segment_ids=None):
     """Variant returning (out, logsumexp (B,H,Tq) fp32) — the building block
     for ring attention's cross-device online combine. Fully differentiable
     (the lse cotangent rides the same Pallas backward kernels)."""
@@ -876,8 +1034,10 @@ def flash_attention_with_lse(q, k, v, bias=None, scale=None, causal=False,
     TRACE_COUNT += 1
     d = q.shape[-1]
     scale = float(scale) if scale is not None else 1.0 / (d ** 0.5)
+    segq, segk = _canonical_seg(segment_ids, q.shape[0], q.shape[2],
+                                k.shape[2])
     if bias is not None:
         bias = _canonical_bias(bias, q.shape[0], q.shape[1], q.shape[2],
                                k.shape[2])
-    return _flash(q, k, v, bias, scale, bool(causal), int(block_q),
-                  int(block_k))
+    return _flash(q, k, v, bias, segq, segk, scale, bool(causal),
+                  int(block_q), int(block_k))

@@ -112,7 +112,10 @@ def hierarchical_sigmoid(ctx):
         bit = ctx.in_("PathCode").astype(jnp.int32)     # (B, L)
         if node.ndim == 1:
             node, bit = node[None], bit[None]
-        valid = node >= 0                               # CustomCode length
+        # CustomCode::get_length is find-first-negative: the path is the
+        # PREFIX before the first negative entry, so an interior negative
+        # ends the walk (matrix_bit_code.h:147-155)
+        valid = jnp.cumprod((node >= 0).astype(jnp.int32), axis=1) == 1
         node_safe = jnp.maximum(node, 0)
         bit = jnp.maximum(bit, 0)
     else:

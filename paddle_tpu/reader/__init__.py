@@ -3,3 +3,4 @@ from .decorator import (batch, shuffle, buffered, cache, chain, compose,
                         multiprocess_reader, ComposeNotAligned, Fake,
                         PipeReader)
 from .dataloader import DataLoader, device_prefetch
+from .packing import pack_sequences, packing_efficiency

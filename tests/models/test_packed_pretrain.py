@@ -1,0 +1,182 @@
+"""Packed-sequence pretraining: host-side packing (reader.pack_sequences)
++ device-side segment-mask attention must reproduce the per-document
+numerics of the unpacked net exactly — packing is a throughput
+transform, not a model change."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core import framework
+from paddle_tpu.models import bert
+from paddle_tpu.reader.packing import pack_sequences, packing_efficiency
+
+
+def test_pack_sequences_layout():
+    samples = [(np.arange(5),), (np.arange(3),), (np.arange(4),),
+               (np.arange(2),)]
+    packed = pack_sequences(samples, max_len=8)
+    toks, seg, pos = (packed["field_0"], packed["segment_ids"],
+                      packed["positions"])
+    # FFD: 5+3 fill row 0 exactly; 4+2 share row 1 with 2 pad slots
+    assert toks.shape == (2, 8)
+    assert abs(packing_efficiency(packed) - 14 / 16) < 1e-9
+    # each segment's tokens are contiguous, 1-based ids, positions reset
+    assert seg[0].tolist() == [1] * 5 + [2] * 3
+    assert pos[0].tolist() == [0, 1, 2, 3, 4, 0, 1, 2]
+    np.testing.assert_array_equal(toks[0, :5], np.arange(5))
+    np.testing.assert_array_equal(toks[0, 5:], np.arange(3))
+
+
+def test_pack_sequences_padding_and_errors():
+    packed = pack_sequences([(np.arange(5),), (np.arange(5),)], max_len=8)
+    assert packed["field_0"].shape == (2, 8)
+    assert packed["segment_ids"][0].tolist() == [1] * 5 + [0] * 3
+    assert abs(packing_efficiency(packed) - 10 / 16) < 1e-9
+    with pytest.raises(ValueError, match="max_len"):
+        pack_sequences([(np.arange(9),)], max_len=8)
+    with pytest.raises(ValueError, match="unequal"):
+        pack_sequences([(np.arange(3), np.arange(2))], max_len=8)
+
+
+def test_segment_mask_attention_equals_per_segment():
+    """One packed row [seg1 | seg2 | pad] attends identically to the two
+    segments run alone — through the real op path (and the Pallas
+    interpreter, exercising the in-kernel bias lowering)."""
+    from paddle_tpu.ops.attention_ops import dot_product_attention
+    import jax.numpy as jnp
+    rng = np.random.default_rng(0)
+    H, D, n1, n2, T = 2, 8, 5, 4, 12
+    q = rng.standard_normal((1, H, T, D)).astype(np.float32)
+    k = rng.standard_normal((1, H, T, D)).astype(np.float32)
+    v = rng.standard_normal((1, H, T, D)).astype(np.float32)
+    seg = np.array([[1] * n1 + [2] * n2 + [0] * (T - n1 - n2)])
+
+    for force in ("0", "1"):
+        os.environ["PADDLE_TPU_FORCE_FLASH"] = force
+        try:
+            out = np.asarray(dot_product_attention(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                segment_ids=jnp.asarray(seg)))
+            ref1 = np.asarray(dot_product_attention(
+                jnp.asarray(q[:, :, :n1]), jnp.asarray(k[:, :, :n1]),
+                jnp.asarray(v[:, :, :n1])))
+            ref2 = np.asarray(dot_product_attention(
+                jnp.asarray(q[:, :, n1:n1 + n2]),
+                jnp.asarray(k[:, :, n1:n1 + n2]),
+                jnp.asarray(v[:, :, n1:n1 + n2])))
+        finally:
+            os.environ.pop("PADDLE_TPU_FORCE_FLASH", None)
+        np.testing.assert_allclose(out[:, :, :n1], ref1, rtol=2e-5,
+                                   atol=2e-5, err_msg=f"force={force}")
+        np.testing.assert_allclose(out[:, :, n1:n1 + n2], ref2, rtol=2e-5,
+                                   atol=2e-5, err_msg=f"force={force}")
+
+
+def _no_dropout_tiny():
+    cfg = bert.bert_tiny()
+    cfg.hidden_dropout_prob = 0.0
+    cfg.attention_probs_dropout_prob = 0.0
+    cfg.num_hidden_layers = 2
+    return cfg
+
+
+def test_packed_mlm_loss_matches_unpacked():
+    """The packed net's MLM loss over N documents equals the unpacked
+    net's loss on the same documents padded one-per-row: same parameter
+    set (shared by name in one Scope), same predictions, same weighted
+    mean. Also locks the feed contract of make_packed_pretrain_feed."""
+    cfg = _no_dropout_tiny()
+    T = 64
+    feed, n_rows = bert.make_packed_pretrain_feed(cfg, T, n_docs=6, seed=3)
+    assert n_rows < 6, "packing should shrink 6 short docs below 6 rows"
+
+    packed_prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(packed_prog, startup):
+        _feeds, packed_loss = bert.build_packed_pretrain_net(
+            cfg, seq_len=T, max_predictions=feed["mask_pos"].shape[1])
+
+    # unpack the same documents one per row for the reference net
+    seg, pos = feed["segment_ids"], feed["positions"]
+    rows = []
+    for r in range(n_rows):
+        for s in np.unique(seg[r]):
+            if s == 0:
+                continue
+            idx = np.nonzero(seg[r] == s)[0]
+            rows.append((r, idx))
+    B = len(rows)
+    assert B == 6
+    P = cfg.max_predictions_per_seq
+    u = {"src_ids": np.zeros((B, T), np.int64),
+         "sent_ids": np.zeros((B, T), np.int64),
+         "input_mask": np.zeros((B, T), np.float32),
+         "mask_pos": np.zeros((B, P), np.int64),
+         "mask_label": np.zeros((B, P), np.int64),
+         "mask_weight": np.zeros((B, P), np.float32),
+         "nsp_label": np.zeros((B, 1), np.int64)}
+    flat_pos = feed["mask_pos"].reshape(-1)
+    flat_label = feed["mask_label"].reshape(-1)
+    flat_w = feed["mask_weight"].reshape(-1)
+    n_used = 0
+    for b, (r, idx) in enumerate(rows):
+        n = len(idx)
+        u["src_ids"][b, :n] = feed["src_ids"][r, idx]
+        u["sent_ids"][b, :n] = feed["sent_ids"][r, idx]
+        u["input_mask"][b, :n] = 1.0
+        # this doc's predictions: packed flat positions falling in idx
+        sel = [j for j in range(len(flat_pos))
+               if flat_w[j] > 0 and flat_pos[j] // T == r
+               and (flat_pos[j] % T) in idx]
+        local = {g: l for l, g in enumerate(idx)}
+        for m, j in enumerate(sel):
+            u["mask_pos"][b, m] = b * T + local[flat_pos[j] % T]
+            u["mask_label"][b, m] = flat_label[j]
+            u["mask_weight"][b, m] = 1.0
+        n_used += len(sel)
+    # every packed prediction is accounted for — nothing was truncated
+    assert n_used == int(feed["mask_weight"].sum())
+
+    unpacked_prog, startup2 = framework.Program(), framework.Program()
+    with framework.program_guard(unpacked_prog, startup2):
+        _f2, _total, unpacked_mlm, _acc = bert.build_pretrain_net(
+            cfg, seq_len=T)
+
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        # every flagship param is explicitly named, so the two programs
+        # share one parameter set; the second startup re-inits the shared
+        # names and adds the NSP head only the unpacked net has
+        exe.run(startup)
+        exe.run(startup2)
+        got_packed, = exe.run(packed_prog, feed=feed,
+                              fetch_list=[packed_loss])
+        got_unpacked, = exe.run(unpacked_prog, feed=u,
+                                fetch_list=[unpacked_mlm])
+    np.testing.assert_allclose(np.asarray(got_packed),
+                               np.asarray(got_unpacked),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_packed_pretrain_trains_down():
+    """Overfit gate on the packed path (same bar as the flagship nets:
+    loss < 0.1x initial on a fixed batch)."""
+    cfg = _no_dropout_tiny()
+    T = 64
+    feed, _n_rows = bert.make_packed_pretrain_feed(cfg, T, n_docs=4, seed=1)
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        _feeds, loss = bert.build_packed_pretrain_net(
+            cfg, seq_len=T, max_predictions=feed["mask_pos"].shape[1])
+        fluid.optimizer.AdamOptimizer(learning_rate=1e-3).minimize(loss)
+    exe = fluid.Executor()
+    losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(60):
+            out, = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(out).reshape(())))
+    assert losses[-1] < 0.1 * losses[0], (losses[0], losses[-1])

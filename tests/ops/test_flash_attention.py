@@ -237,3 +237,105 @@ def test_kgrid_auto_selected_for_long_context(monkeypatch):
     # 2 * T * D * 4 bytes over the 4MB limit -> kgrid
     assert flash._use_kgrid(tk_p=16384, d=64)
     assert not flash._use_kgrid(tk_p=2048, d=64)
+
+
+# ---------------------------------------------------------------------------
+# In-kernel segment masking (packed sequences)
+# ---------------------------------------------------------------------------
+
+def _seg_oracle(q, k, v, scale, causal, segq, segk, bias=None):
+    seg_bias = flash.segment_mask_bias(segq, segk)
+    full = seg_bias if bias is None else seg_bias + bias
+    return flash._xla_ref(q, k, v, scale, causal, bias=full)
+
+
+@pytest.mark.parametrize("kgrid", ["0", "1"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_segment_ids_match_oracle(kgrid, causal, monkeypatch):
+    """Segment ids compared INSIDE the kernels (both grid variants) must
+    equal the oracle with an explicit cross-segment -inf bias; grads too.
+    Layout mirrors packing: [doc1 | doc2 | pad], plus a second row with
+    different boundaries so the per-batch indexing (bh -> b) is hit."""
+    monkeypatch.setenv("PT_FLASH_KGRID", kgrid)
+    b, h, t, d = 2, 3, 64, 16
+    q, k, v = _rand((b, h, t, d), 3), _rand((b, h, t, d), 4), \
+        _rand((b, h, t, d), 5)
+    seg = np.zeros((b, t), np.int32)
+    seg[0, :30] = 1
+    seg[0, 30:50] = 2          # 14 pad slots, id 0
+    seg[1, :7] = 1             # boundaries straddle the 32-blocks
+    seg[1, 7:64] = 2
+    seg = jnp.asarray(seg)
+    scale = 1.0 / d ** 0.5
+
+    got = flash.flash_attention(q, k, v, scale=scale, causal=causal,
+                                block_q=32, block_k=32, segment_ids=seg)
+    want = _seg_oracle(q, k, v, scale, causal, seg, seg)
+    # pad-slot rows attend only among pads; compare real tokens
+    np.testing.assert_allclose(np.asarray(got)[0, :, :50],
+                               np.asarray(want)[0, :, :50],
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(got)[1], np.asarray(want)[1],
+                               atol=2e-5, rtol=2e-5)
+
+    def f_loss(q, k, v):
+        o = flash.flash_attention(q, k, v, scale=scale, causal=causal,
+                                  block_q=32, block_k=32, segment_ids=seg)
+        return jnp.sum(jnp.sin(o[:, :, :50]))
+
+    def o_loss(q, k, v):
+        o = _seg_oracle(q, k, v, scale, causal, seg, seg)
+        return jnp.sum(jnp.sin(o[:, :, :50]))
+
+    gf = jax.grad(f_loss, argnums=(0, 1, 2))(q, k, v)
+    go = jax.grad(o_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, go):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=3e-5, rtol=3e-5)
+
+
+def test_flash_segment_ids_compose_with_bias():
+    """segment_ids + additive bias must both apply (bias inside segments,
+    -inf across), including the bias cotangent path."""
+    b, h, t, d = 1, 2, 48, 8
+    q, k, v = _rand((b, h, t, d), 6), _rand((b, h, t, d), 7), \
+        _rand((b, h, t, d), 8)
+    seg = jnp.asarray(np.repeat([[1, 2, 3]], 1, 0).repeat(16, 1))
+    bias = _rand((b, h, t, t), 9) * 0.5
+    scale = 1.0 / d ** 0.5
+
+    got = flash.flash_attention(q, k, v, bias=bias, scale=scale,
+                                block_q=16, block_k=16, segment_ids=seg)
+    want = _seg_oracle(q, k, v, scale, False, seg, seg, bias=bias)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+    def f_loss(bias):
+        o = flash.flash_attention(q, k, v, bias=bias, scale=scale,
+                                  block_q=16, block_k=16, segment_ids=seg)
+        return jnp.sum(jnp.cos(o))
+
+    def o_loss(bias):
+        return jnp.sum(jnp.cos(_seg_oracle(q, k, v, scale, False, seg, seg,
+                                           bias=bias)))
+
+    gb = jax.grad(f_loss)(bias)
+    go = jax.grad(o_loss)(bias)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(go),
+                               atol=3e-5, rtol=3e-5)
+
+
+def test_flash_segment_ids_cross_attention_pair():
+    """(seg_q, seg_k) pair form for cross-attention over a packed memory
+    with different lengths."""
+    b, h, tq, tk, d = 1, 2, 32, 48, 8
+    q, k, v = _rand((b, h, tq, d), 10), _rand((b, h, tk, d), 11), \
+        _rand((b, h, tk, d), 12)
+    sq = jnp.asarray(np.repeat([[1, 2]], 1, 0).repeat(16, 1))
+    sk = jnp.asarray(np.repeat([[1, 2, 2]], 1, 0).repeat(16, 1))
+    scale = 1.0 / d ** 0.5
+    got = flash.flash_attention(q, k, v, scale=scale, block_q=16,
+                                block_k=16, segment_ids=(sq, sk))
+    want = _seg_oracle(q, k, v, scale, False, sq, sk)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)

@@ -84,9 +84,12 @@ def test_hsigmoid_custom_tree_matches_formula():
     y = rng.integers(0, 4, (B, 1)).astype(np.int64)
     w = rng.standard_normal((C, D)).astype(np.float32)
     bias = rng.standard_normal((C,)).astype(np.float32)
-    table = np.array([[0, 1, -1], [0, 2, 4], [3, -1, -1], [0, 1, 2]],
+    # row 2 has an INTERIOR negative: the walk must stop there and
+    # ignore the trailing 4 (CustomCode::get_length is
+    # find-first-negative, matrix_bit_code.h:147)
+    table = np.array([[0, 1, -1], [0, 2, 4], [3, -1, 4], [0, 1, 2]],
                      np.int64)
-    code = np.array([[1, 0, 0], [0, 1, 1], [1, 0, 0], [0, 0, 1]],
+    code = np.array([[1, 0, 0], [0, 1, 1], [1, 0, 1], [0, 0, 1]],
                     np.int64)
 
     main, startup = framework.Program(), framework.Program()
