@@ -101,7 +101,15 @@ def _mask(s, q0, block_q, kb, block_k, q_len, kv_len, causal,
 def _last_visible_kb(q0, block_q, block_k, q_len, kv_len, num_kb):
     """Exclusive upper k-block bound for a causal q block: every k block
     at or past it has p = 0 exactly. MUST stay consistent with _mask's
-    convention k_pos <= q_pos + (kv_len - q_len)."""
+    convention k_pos <= q_pos + (kv_len - q_len).
+
+    Degenerate rows with NO visible key (causal q_len > kv_len, rows
+    i < q_len - kv_len) output exactly 0 here: the pruned loop never
+    runs, so acc = l = 0. The unpruned kernel (and _xla_ref) instead
+    emit a uniform average of V — an exp(-inf - (-inf)) = 1 softmax
+    artifact, not a meaningful attention. Zero is the deliberate,
+    documented semantics for this out-of-contract regime (locked by
+    test_flash_causal_no_visible_keys_outputs_zero)."""
     return jnp.clip(
         (q0 + block_q - 1 + (kv_len - q_len)) // block_k + 1, 0, num_kb)
 

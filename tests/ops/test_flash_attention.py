@@ -339,3 +339,23 @@ def test_flash_segment_ids_cross_attention_pair():
     want = _seg_oracle(q, k, v, scale, False, sq, sk)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_flash_causal_no_visible_keys_outputs_zero():
+    """Causal with q_len > kv_len leaves rows i < q_len - kv_len with NO
+    visible key. The pruned kernels output exactly 0 there (deliberate:
+    the oracle's uniform-average is an exp(-inf - (-inf)) softmax
+    artifact, see _last_visible_kb). Rows with visible keys must still
+    match the oracle exactly."""
+    b, h, tq, tk, d = 1, 2, 16, 8, 8
+    q, k, v = _rand((b, h, tq, d), 20), _rand((b, h, tk, d), 21), \
+        _rand((b, h, tk, d), 22)
+    scale = 1.0 / d ** 0.5
+    got = np.asarray(flash.flash_attention(q, k, v, scale=scale,
+                                           causal=True, block_q=8,
+                                           block_k=8))
+    dead = tq - tk                          # rows with no visible key
+    np.testing.assert_array_equal(got[:, :, :dead], 0.0)
+    want = np.asarray(flash._xla_ref(q, k, v, scale, True))
+    np.testing.assert_allclose(got[:, :, dead:], want[:, :, dead:],
+                               atol=2e-5, rtol=2e-5)

@@ -177,7 +177,8 @@ def run_suite():
             log("headline failed — continuing with secondaries anyway")
     # 3. secondaries (SURVEY §6 / BASELINE configs)
     prev = "ernie"
-    for model, budget in (("resnet", 2400), ("transformer", 2400),
+    for model, budget in (("packed", 2400), ("resnet", 2400),
+                          ("transformer", 2400),
                           ("deepfm", 1800), ("gpt", 2400),
                           ("gpt_decode", 1500)):
         if _artifact_ok(f"bench_{model}.json"):
