@@ -126,6 +126,15 @@ def _kb_visible(kb, block_k, q0, block_q, q_len, kv_len):
     return kb * block_k <= q0 + block_q - 1 + (kv_len - q_len)
 
 
+def _seg_overlap(qseg, kseg):
+    """Scalar: does any (q, k) pair in this tile share a segment id?
+    Packed rows make visibility block-diagonal — for ~n docs per row,
+    ~(n-1)/n of tiles have no overlap and their two MXU matmuls can be
+    skipped outright (VPU-cheap test, exact: a no-overlap tile is
+    all-masked, p = 0 everywhere)."""
+    return jnp.any(qseg[:, None] == kseg[None, :])
+
+
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
@@ -151,27 +160,37 @@ def _fwd_kernel(*refs, scale, causal, block_k, q_len, kv_len,
     qseg = qs_ref[0][:, 0] if has_seg else None
 
     def body(kb, carry):
-        acc, m_prev, l_prev = carry
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
-        if b_ref is not None:
-            if bias_per_q:
-                bblk = b_ref[0, :, pl.ds(kb * block_k, block_k)]
-            else:
-                bblk = b_ref[0, 0:1, pl.ds(kb * block_k, block_k)]
-            s = s + bblk.astype(jnp.float32)
         kseg = (ks_ref[0, pl.ds(kb * block_k, block_k), 0]
                 if has_seg else None)
-        s = _mask(s, q0, block_q, kb, block_k, q_len, kv_len, causal,
-                  qseg=qseg, kseg=kseg)
-        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        l_new = alpha * l_prev + p.sum(axis=-1, keepdims=True)
-        acc = acc * alpha + jnp.dot(p, v_blk,
-                                    preferred_element_type=jnp.float32)
-        return acc, m_new, l_new
+
+        def compute(carry):
+            acc, m_prev, l_prev = carry
+            k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(
+                jnp.float32)
+            v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(
+                jnp.float32)
+            s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+            if b_ref is not None:
+                if bias_per_q:
+                    bblk = b_ref[0, :, pl.ds(kb * block_k, block_k)]
+                else:
+                    bblk = b_ref[0, 0:1, pl.ds(kb * block_k, block_k)]
+                s = s + bblk.astype(jnp.float32)
+            s = _mask(s, q0, block_q, kb, block_k, q_len, kv_len, causal,
+                      qseg=qseg, kseg=kseg)
+            m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)
+            l_new = alpha * l_prev + p.sum(axis=-1, keepdims=True)
+            acc = acc * alpha + jnp.dot(p, v_blk,
+                                        preferred_element_type=jnp.float32)
+            return acc, m_new, l_new
+
+        if has_seg:
+            # no-overlap tile: p = 0 everywhere, carry passes unchanged
+            return jax.lax.cond(_seg_overlap(qseg, kseg), compute,
+                                lambda c: c, carry)
+        return compute(carry)
 
     acc0 = jnp.zeros((block_q, d), jnp.float32)
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
@@ -321,10 +340,16 @@ def _fwd_kernel_kgrid(*refs, scale, causal, q_len, kv_len, num_kb,
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
+    # grid steps cannot be skipped, but the MXU work can: causally
+    # invisible and segment-disjoint tiles contribute p = 0 exactly
+    cond = None
     if causal:
-        # grid steps cannot be skipped, but the MXU work can: blocks
-        # fully above the diagonal contribute p = 0 exactly
-        pl.when(_kb_visible(kb, block_k, q0, block_q, q_len, kv_len))(_step)
+        cond = _kb_visible(kb, block_k, q0, block_q, q_len, kv_len)
+    if has_seg:
+        ov = _seg_overlap(qs_ref[0][:, 0], ks_ref[0][:, 0])
+        cond = ov if cond is None else cond & ov
+    if cond is not None:
+        pl.when(cond)(_step)
     else:
         _step()
 
@@ -443,23 +468,34 @@ def _dq_kernel(*refs, scale, causal, block_k, q_len, kv_len,
     qseg = qs_ref[0][:, 0] if has_seg else None
 
     def body(kb, acc):
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
-        if b_ref is not None:
-            if bias_per_q:
-                bblk = b_ref[0, :, pl.ds(kb * block_k, block_k)]
-            else:
-                bblk = b_ref[0, 0:1, pl.ds(kb * block_k, block_k)]
-            s = s + bblk.astype(jnp.float32)
         kseg = (ks_ref[0, pl.ds(kb * block_k, block_k), 0]
                 if has_seg else None)
-        s = _mask(s, q0, block_q, kb, block_k, q_len, kv_len, causal,
-                  qseg=qseg, kseg=kseg)
-        p = jnp.exp(s - lse)
-        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - dlt)
-        return acc + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+        def compute(acc):
+            k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(
+                jnp.float32)
+            v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(
+                jnp.float32)
+            s = jnp.dot(q, k_blk.T,
+                        preferred_element_type=jnp.float32) * scale
+            if b_ref is not None:
+                if bias_per_q:
+                    bblk = b_ref[0, :, pl.ds(kb * block_k, block_k)]
+                else:
+                    bblk = b_ref[0, 0:1, pl.ds(kb * block_k, block_k)]
+                s = s + bblk.astype(jnp.float32)
+            s = _mask(s, q0, block_q, kb, block_k, q_len, kv_len, causal,
+                      qseg=qseg, kseg=kseg)
+            p = jnp.exp(s - lse)
+            dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+            ds = p * (dp - dlt)
+            return acc + jnp.dot(ds, k_blk,
+                                 preferred_element_type=jnp.float32)
+
+        if has_seg:
+            return jax.lax.cond(_seg_overlap(qseg, kseg), compute,
+                                lambda a: a, acc)
+        return compute(acc)
 
     acc = jax.lax.fori_loop(0, num_kb, body, jnp.zeros((block_q, d),
                                                        jnp.float32))
@@ -488,31 +524,40 @@ def _dkv_kernel(*refs, scale, causal, block_q, q_len, kv_len,
     kseg = ks_ref[0][:, 0] if has_seg else None
 
     def body(qb, carry):
-        dk_acc, dv_acc = carry
-        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(
-            jnp.float32)
-        lse_blk = lse_ref[0, pl.ds(qb * block_q, block_q), 0:1]
-        dlt_blk = dlt_ref[0, pl.ds(qb * block_q, block_q), 0:1]
-        s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * scale
-        if b_ref is not None:
-            if bias_per_q:
-                bblk = b_ref[0, pl.ds(qb * block_q, block_q), :]
-            else:
-                bblk = b_ref[0, 0:1, :]
-            s = s + bblk.astype(jnp.float32)
         qseg_blk = (qs_ref[0, pl.ds(qb * block_q, block_q), 0]
                     if has_seg else None)
-        s = _mask(s, qb * block_q, block_q, kb, block_k, q_len, kv_len,
-                  causal, qseg=qseg_blk, kseg=kseg)
-        p = jnp.exp(s - lse_blk)
-        dv_acc = dv_acc + jnp.dot(p.T, do_blk,
-                                  preferred_element_type=jnp.float32)
-        dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - dlt_blk)
-        dk_acc = dk_acc + jnp.dot(ds.T, q_blk,
-                                  preferred_element_type=jnp.float32)
-        return dk_acc, dv_acc
+
+        def compute(carry):
+            dk_acc, dv_acc = carry
+            q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(
+                jnp.float32)
+            do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(
+                jnp.float32)
+            lse_blk = lse_ref[0, pl.ds(qb * block_q, block_q), 0:1]
+            dlt_blk = dlt_ref[0, pl.ds(qb * block_q, block_q), 0:1]
+            s = jnp.dot(q_blk, k.T,
+                        preferred_element_type=jnp.float32) * scale
+            if b_ref is not None:
+                if bias_per_q:
+                    bblk = b_ref[0, pl.ds(qb * block_q, block_q), :]
+                else:
+                    bblk = b_ref[0, 0:1, :]
+                s = s + bblk.astype(jnp.float32)
+            s = _mask(s, qb * block_q, block_q, kb, block_k, q_len, kv_len,
+                      causal, qseg=qseg_blk, kseg=kseg)
+            p = jnp.exp(s - lse_blk)
+            dv_acc = dv_acc + jnp.dot(p.T, do_blk,
+                                      preferred_element_type=jnp.float32)
+            dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
+            ds = p * (dp - dlt_blk)
+            dk_acc = dk_acc + jnp.dot(ds.T, q_blk,
+                                      preferred_element_type=jnp.float32)
+            return dk_acc, dv_acc
+
+        if has_seg:
+            return jax.lax.cond(_seg_overlap(qseg_blk, kseg), compute,
+                                lambda c: c, carry)
+        return compute(carry)
 
     z = jnp.zeros((block_k, d), jnp.float32)
     dk_acc, dv_acc = jax.lax.fori_loop(qb_lo, num_qb, body, (z, z))
@@ -559,8 +604,14 @@ def _dq_kernel_kgrid(*refs, scale, causal, q_len, kv_len, num_kb,
         acc_ref[...] += jnp.dot(ds, k_blk,
                                 preferred_element_type=jnp.float32)
 
+    cond = None
     if causal:
-        pl.when(_kb_visible(kb, block_k, q0, block_q, q_len, kv_len))(_step)
+        cond = _kb_visible(kb, block_k, q0, block_q, q_len, kv_len)
+    if has_seg:
+        ov = _seg_overlap(qs_ref[0][:, 0], ks_ref[0][:, 0])
+        cond = ov if cond is None else cond & ov
+    if cond is not None:
+        pl.when(cond)(_step)
     else:
         _step()
 
@@ -612,11 +663,17 @@ def _dkv_kernel_kgrid(*refs, scale, causal, q_len, kv_len, num_qb,
         dk_acc[...] += jnp.dot(ds.T, q_blk,
                                preferred_element_type=jnp.float32)
 
+    cond = None
     if causal:
         # q blocks fully above this k block's diagonal see none of it —
         # the guard is _first_visible_qb in scalar form
-        pl.when(qb >= _first_visible_qb(kb, block_k, block_q, q_len,
-                                        kv_len, num_qb))(_step)
+        cond = qb >= _first_visible_qb(kb, block_k, block_q, q_len,
+                                       kv_len, num_qb)
+    if has_seg:
+        ov = _seg_overlap(qs_ref[0][:, 0], ks_ref[0][:, 0])
+        cond = ov if cond is None else cond & ov
+    if cond is not None:
+        pl.when(cond)(_step)
     else:
         _step()
 

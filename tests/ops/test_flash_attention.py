@@ -359,3 +359,40 @@ def test_flash_causal_no_visible_keys_outputs_zero():
     want = np.asarray(flash._xla_ref(q, k, v, scale, True))
     np.testing.assert_allclose(got[:, :, dead:], want[:, :, dead:],
                                atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("kgrid", ["0", "1"])
+def test_flash_segment_skip_tiles_grads(kgrid, monkeypatch):
+    """Block-ALIGNED disjoint segments (16|16 with block 16) force
+    _seg_overlap to actually skip tiles in every kernel; the cond
+    pass-through branches must leave gradients exactly equal to the
+    oracle's. (The straddling-layout test never skips — every tile
+    shares a segment — so this locks the skip branch itself.)"""
+    monkeypatch.setenv("PT_FLASH_KGRID", kgrid)
+    b, h, t, d = 2, 2, 32, 8
+    q, k, v = _rand((b, h, t, d), 30), _rand((b, h, t, d), 31), \
+        _rand((b, h, t, d), 32)
+    seg = jnp.asarray(np.repeat([[1, 2]], b, 0).repeat(16, 1))
+    scale = 1.0 / d ** 0.5
+
+    def f_loss(q, k, v):
+        o = flash.flash_attention(q, k, v, scale=scale, block_q=16,
+                                  block_k=16, segment_ids=seg)
+        return jnp.sum(jnp.sin(o))
+
+    def o_loss(q, k, v):
+        o = flash._xla_ref(q, k, v, scale, False,
+                           bias=flash.segment_mask_bias(seg, seg))
+        return jnp.sum(jnp.sin(o))
+
+    got = flash.flash_attention(q, k, v, scale=scale, block_q=16,
+                                block_k=16, segment_ids=seg)
+    want = flash._xla_ref(q, k, v, scale, False,
+                          bias=flash.segment_mask_bias(seg, seg))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+    gf = jax.grad(f_loss, argnums=(0, 1, 2))(q, k, v)
+    go = jax.grad(o_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, go):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=3e-5, rtol=3e-5)
