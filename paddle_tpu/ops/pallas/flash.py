@@ -135,6 +135,32 @@ def _seg_overlap(qseg, kseg):
     return jnp.any(qseg[:, None] == kseg[None, :])
 
 
+def _seg_gate(qseg, kseg, compute, carry):
+    """Loop-body skip gate (resident-KV kernels): run `compute` on the
+    carry only if the tile has segment overlap, else pass the carry
+    through unchanged. The ONE place the skip-branch semantics live for
+    the fori_loop kernels — fwd and both backward bodies must gate
+    identically or gradients desynchronize from the forward."""
+    if qseg is None:
+        return compute(carry)
+    return jax.lax.cond(_seg_overlap(qseg, kseg), compute,
+                        lambda c: c, carry)
+
+
+def _tile_guard(causal_cond, qseg, kseg, step):
+    """Grid-step skip gate (kgrid kernels): run `step` under pl.when
+    only when the tile is causally visible AND segment-overlapping —
+    the single definition of how the two prune conditions compose."""
+    cond = causal_cond
+    if qseg is not None:
+        ov = _seg_overlap(qseg, kseg)
+        cond = ov if cond is None else cond & ov
+    if cond is not None:
+        pl.when(cond)(step)
+    else:
+        step()
+
+
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
@@ -186,11 +212,7 @@ def _fwd_kernel(*refs, scale, causal, block_k, q_len, kv_len,
                                         preferred_element_type=jnp.float32)
             return acc, m_new, l_new
 
-        if has_seg:
-            # no-overlap tile: p = 0 everywhere, carry passes unchanged
-            return jax.lax.cond(_seg_overlap(qseg, kseg), compute,
-                                lambda c: c, carry)
-        return compute(carry)
+        return _seg_gate(qseg, kseg, compute, carry)
 
     acc0 = jnp.zeros((block_q, d), jnp.float32)
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
@@ -342,16 +364,11 @@ def _fwd_kernel_kgrid(*refs, scale, causal, q_len, kv_len, num_kb,
 
     # grid steps cannot be skipped, but the MXU work can: causally
     # invisible and segment-disjoint tiles contribute p = 0 exactly
-    cond = None
-    if causal:
-        cond = _kb_visible(kb, block_k, q0, block_q, q_len, kv_len)
-    if has_seg:
-        ov = _seg_overlap(qs_ref[0][:, 0], ks_ref[0][:, 0])
-        cond = ov if cond is None else cond & ov
-    if cond is not None:
-        pl.when(cond)(_step)
-    else:
-        _step()
+    _tile_guard(
+        _kb_visible(kb, block_k, q0, block_q, q_len, kv_len)
+        if causal else None,
+        qs_ref[0][:, 0] if has_seg else None,
+        ks_ref[0][:, 0] if has_seg else None, _step)
 
     @pl.when(kb == num_kb - 1)
     def _flush():
@@ -492,10 +509,7 @@ def _dq_kernel(*refs, scale, causal, block_k, q_len, kv_len,
             return acc + jnp.dot(ds, k_blk,
                                  preferred_element_type=jnp.float32)
 
-        if has_seg:
-            return jax.lax.cond(_seg_overlap(qseg, kseg), compute,
-                                lambda a: a, acc)
-        return compute(acc)
+        return _seg_gate(qseg, kseg, compute, acc)
 
     acc = jax.lax.fori_loop(0, num_kb, body, jnp.zeros((block_q, d),
                                                        jnp.float32))
@@ -554,10 +568,7 @@ def _dkv_kernel(*refs, scale, causal, block_q, q_len, kv_len,
                                       preferred_element_type=jnp.float32)
             return dk_acc, dv_acc
 
-        if has_seg:
-            return jax.lax.cond(_seg_overlap(qseg_blk, kseg), compute,
-                                lambda c: c, carry)
-        return compute(carry)
+        return _seg_gate(qseg_blk, kseg, compute, carry)
 
     z = jnp.zeros((block_k, d), jnp.float32)
     dk_acc, dv_acc = jax.lax.fori_loop(qb_lo, num_qb, body, (z, z))
@@ -604,16 +615,11 @@ def _dq_kernel_kgrid(*refs, scale, causal, q_len, kv_len, num_kb,
         acc_ref[...] += jnp.dot(ds, k_blk,
                                 preferred_element_type=jnp.float32)
 
-    cond = None
-    if causal:
-        cond = _kb_visible(kb, block_k, q0, block_q, q_len, kv_len)
-    if has_seg:
-        ov = _seg_overlap(qs_ref[0][:, 0], ks_ref[0][:, 0])
-        cond = ov if cond is None else cond & ov
-    if cond is not None:
-        pl.when(cond)(_step)
-    else:
-        _step()
+    _tile_guard(
+        _kb_visible(kb, block_k, q0, block_q, q_len, kv_len)
+        if causal else None,
+        qs_ref[0][:, 0] if has_seg else None,
+        ks_ref[0][:, 0] if has_seg else None, _step)
 
     @pl.when(kb == num_kb - 1)
     def _flush():
@@ -663,19 +669,13 @@ def _dkv_kernel_kgrid(*refs, scale, causal, q_len, kv_len, num_qb,
         dk_acc[...] += jnp.dot(ds.T, q_blk,
                                preferred_element_type=jnp.float32)
 
-    cond = None
-    if causal:
-        # q blocks fully above this k block's diagonal see none of it —
-        # the guard is _first_visible_qb in scalar form
-        cond = qb >= _first_visible_qb(kb, block_k, block_q, q_len,
-                                       kv_len, num_qb)
-    if has_seg:
-        ov = _seg_overlap(qs_ref[0][:, 0], ks_ref[0][:, 0])
-        cond = ov if cond is None else cond & ov
-    if cond is not None:
-        pl.when(cond)(_step)
-    else:
-        _step()
+    # causal guard is _first_visible_qb in scalar form
+    _tile_guard(
+        qb >= _first_visible_qb(kb, block_k, block_q, q_len, kv_len,
+                                num_qb)
+        if causal else None,
+        qs_ref[0][:, 0] if has_seg else None,
+        ks_ref[0][:, 0] if has_seg else None, _step)
 
     @pl.when(qb == num_qb - 1)
     def _flush():

@@ -180,3 +180,75 @@ def test_packed_pretrain_trains_down():
             out, = exe.run(main, feed=feed, fetch_list=[loss])
             losses.append(float(np.asarray(out).reshape(())))
     assert losses[-1] < 0.1 * losses[0], (losses[0], losses[-1])
+
+
+def test_packed_causal_lm_matches_per_document():
+    """Packed GPT: the document-masked next-token loss over packed rows
+    equals the pair-count-weighted mean of each document's own causal
+    LM loss (params shared by name across the per-length programs)."""
+    from paddle_tpu.models import gpt
+
+    cfg = gpt.gpt_tiny()
+    cfg.num_layers = 2
+    T = 24
+    rng = np.random.default_rng(5)
+    lens = [12, 9, 7, 5]
+    docs = [rng.integers(1, cfg.vocab_size, n) for n in lens]
+    packed = pack_sequences([(d,) for d in docs], T)
+    feed = {"tokens": packed["field_0"],
+            "segment_ids": packed["segment_ids"],
+            "positions": packed["positions"]}
+
+    packed_prog, startup = framework.Program(), framework.Program()
+    with framework.program_guard(packed_prog, startup):
+        _feeds, packed_loss = gpt.build_packed_lm_net(cfg, seq_len=T)
+
+    per_doc = []
+    for n in sorted(set(lens)):
+        prog, st = framework.Program(), framework.Program()
+        with framework.program_guard(prog, st):
+            _tok, loss, _lg = gpt.build_lm_net(cfg, seq_len=n)
+        per_doc.append((n, prog, st, loss))
+
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        got_packed, = exe.run(packed_prog, feed=feed,
+                              fetch_list=[packed_loss])
+        num = den = 0.0
+        for d in docs:
+            n = len(d)
+            _n, prog, _st, loss = next(e for e in per_doc if e[0] == n)
+            out, = exe.run(prog, feed={"tokens": d[None, :]},
+                           fetch_list=[loss])
+            num += float(np.asarray(out).reshape(())) * (n - 1)
+            den += n - 1
+    np.testing.assert_allclose(float(np.asarray(got_packed).reshape(())),
+                               num / den, rtol=2e-4, atol=2e-4)
+
+
+def test_packed_causal_lm_trains_down():
+    from paddle_tpu.models import gpt
+
+    cfg = gpt.gpt_tiny()
+    cfg.num_layers = 2
+    T = 32
+    rng = np.random.default_rng(6)
+    docs = [rng.integers(1, cfg.vocab_size, int(n))
+            for n in rng.integers(6, 16, 6)]
+    packed = pack_sequences([(d,) for d in docs], T)
+    feed = {"tokens": packed["field_0"],
+            "segment_ids": packed["segment_ids"],
+            "positions": packed["positions"]}
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        _feeds, loss = gpt.build_packed_lm_net(cfg, seq_len=T)
+        fluid.optimizer.AdamOptimizer(learning_rate=2e-3).minimize(loss)
+    exe = fluid.Executor()
+    losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(80):
+            out, = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(out).reshape(())))
+    assert losses[-1] < 0.1 * losses[0], (losses[0], losses[-1])

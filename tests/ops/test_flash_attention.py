@@ -361,13 +361,16 @@ def test_flash_causal_no_visible_keys_outputs_zero():
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("kgrid", ["0", "1"])
-def test_flash_segment_skip_tiles_grads(kgrid, monkeypatch):
+def test_flash_segment_skip_tiles_grads(kgrid, causal, monkeypatch):
     """Block-ALIGNED disjoint segments (16|16 with block 16) force
     _seg_overlap to actually skip tiles in every kernel; the cond
     pass-through branches must leave gradients exactly equal to the
     oracle's. (The straddling-layout test never skips — every tile
-    shares a segment — so this locks the skip branch itself.)"""
+    shares a segment — so this locks the skip branch itself.) The
+    causal=True leg exercises the COMPOSED causal-AND-overlap guard,
+    the packed-GPT hot path."""
     monkeypatch.setenv("PT_FLASH_KGRID", kgrid)
     b, h, t, d = 2, 2, 32, 8
     q, k, v = _rand((b, h, t, d), 30), _rand((b, h, t, d), 31), \
@@ -376,18 +379,18 @@ def test_flash_segment_skip_tiles_grads(kgrid, monkeypatch):
     scale = 1.0 / d ** 0.5
 
     def f_loss(q, k, v):
-        o = flash.flash_attention(q, k, v, scale=scale, block_q=16,
-                                  block_k=16, segment_ids=seg)
+        o = flash.flash_attention(q, k, v, scale=scale, causal=causal,
+                                  block_q=16, block_k=16, segment_ids=seg)
         return jnp.sum(jnp.sin(o))
 
     def o_loss(q, k, v):
-        o = flash._xla_ref(q, k, v, scale, False,
+        o = flash._xla_ref(q, k, v, scale, causal,
                            bias=flash.segment_mask_bias(seg, seg))
         return jnp.sum(jnp.sin(o))
 
-    got = flash.flash_attention(q, k, v, scale=scale, block_q=16,
-                                block_k=16, segment_ids=seg)
-    want = flash._xla_ref(q, k, v, scale, False,
+    got = flash.flash_attention(q, k, v, scale=scale, causal=causal,
+                                block_q=16, block_k=16, segment_ids=seg)
+    want = flash._xla_ref(q, k, v, scale, causal,
                           bias=flash.segment_mask_bias(seg, seg))
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=2e-5, rtol=2e-5)

@@ -306,3 +306,41 @@ def test_kv_decode_scan_stays_on_device():
     assert "tensor<2x4x16x32xf32>" not in src and \
         "f32[2,4,16,32]" not in src, \
         "f32 cache-shaped tensors in the bf16-serving decode source"
+
+
+def test_packed_step_materializes_no_quadratic_mask(monkeypatch):
+    """(h) packed-sequence attention must keep O(T) segment-id vectors
+    in HBM — if the (T, T) cross-segment mask ever materializes in the
+    compiled step (e.g. someone reroutes segment_ids through
+    segment_mask_bias on the flash path), every encoder layer pays a
+    quadratic HBM tensor and the packing win evaporates. T=96 collides
+    with no other dimension of the tiny config (hidden 256, d_head 64,
+    ffn 1024, vocab 1024), so any '96,96]' shape in the HLO is the
+    mask."""
+    from paddle_tpu.models import bert
+
+    monkeypatch.setenv("PADDLE_TPU_FORCE_FLASH", "1")
+    # keep the kernel's own score TILE below (T, T): with the default
+    # block (128, clamped to T) the blockwise tile would itself be
+    # (96, 96) and trip the scan
+    monkeypatch.setenv("PADDLE_TPU_FLASH_BLOCK_Q", "32")
+    monkeypatch.setenv("PADDLE_TPU_FLASH_BLOCK_K", "32")
+    cfg = bert.bert_tiny()
+    cfg.num_hidden_layers = 2
+    T = 96
+    feed, _n_rows = bert.make_packed_pretrain_feed(cfg, T, n_docs=6,
+                                                   seed=0)
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        _feeds, loss = bert.build_packed_pretrain_net(
+            cfg, seq_len=T, max_predictions=feed["mask_pos"].shape[1])
+        fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+    scope = Scope()
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss])
+    txt = exe.last_compiled_text()
+    quad = re.findall(r"\S*96,96\]\S*", txt)
+    assert not quad, (
+        f"(T, T) tensors materialized on the packed path: {quad[:3]}")
