@@ -706,6 +706,12 @@ def _emit(sweep, seq_len, kind, peak):
 
 def main():
     _enable_compile_cache()
+    # OS-level device-init interlock BEFORE the watchdog timer starts:
+    # waiting for another process to release the chip must not be
+    # mistaken for a wedged tunnel (r4 lost its window to exactly that
+    # concurrent-init wedge; see paddle_tpu/utils/device_lock.py)
+    from paddle_tpu.utils import device_lock
+    device_lock.ensure_device_lock()
     devs = _device_watchdog()
     kind = getattr(devs[0], "device_kind", str(devs[0]))
     peak = _peak_flops(kind)

@@ -156,6 +156,11 @@ class Executor:
 
     def __init__(self, place=None):
         from .place import TPUPlace
+        from ..utils import device_lock
+        # OS-level interlock: two processes initializing the axon TPU
+        # backend concurrently wedge the tunnel for ~an hour; block here
+        # (no-op on the cpu platform) instead of wedging it.
+        device_lock.ensure_device_lock()
         self.place = place if place is not None else TPUPlace(0)
         self._cache = {}
         self._meta_cache = {}   # static per-(program, feeds, fetches) work

@@ -2,6 +2,7 @@
 determinism, memory introspection, self-test, model stats."""
 
 from . import debugger
+from . import device_lock
 from . import nan_check
 from . import determinism
 from . import memory

@@ -1,0 +1,170 @@
+"""Machine-wide exclusive lock around TPU (axon) device initialization.
+
+The axon TPU tunnel in this environment wedges for ~an hour when two
+processes initialize the backend concurrently (round-4 post-mortem:
+`perf/README.md` — one unguarded verification script burned the only
+open hardware window in four rounds).  Env-var guards are advisory; the
+only thing that makes a concurrent init a non-event is an OS-level
+exclusive lock held for as long as a process owns the backend.
+
+This module is the single source of truth for that lock:
+
+* ``ensure_device_lock()`` — call BEFORE anything that can trigger jax
+  backend init (``jax.devices()``, first op dispatch, ``Executor``
+  construction).  No-op when the process is pinned to the cpu platform
+  (the 721-test CPU suite never touches the lock).  Otherwise BLOCKS
+  until the lock is free — an unguarded concurrent process now waits
+  instead of wedging the tunnel — and holds it for process lifetime
+  (``flock`` auto-releases on exit/kill, so a dead holder can never
+  leave the lock stuck).
+* ``try_device_lock()`` — non-blocking variant for probes: returns
+  False immediately when another process owns the backend, so a probe
+  can report "busy" instead of queueing behind an hour-long bench.
+
+Deliberately dependency-free (no jax import at module level) so it can
+be loaded by path from subprocess snippets::
+
+    import importlib.util as u
+    s = u.spec_from_file_location(
+        "device_lock", "<repo>/paddle_tpu/utils/device_lock.py")
+    m = u.module_from_spec(s); s.loader.exec_module(m)
+    if not m.try_device_lock(): sys.exit(3)
+
+Lock path: ``$PADDLE_TPU_DEVICE_LOCK`` (default
+``/tmp/paddle_tpu_device.lock`` — same host-scoped /tmp convention as
+the XLA compile cache).  The holder's pid+argv are written into the
+file for post-mortem diagnosis; they are informational only (flock
+state, not file content, is the lock).
+"""
+
+import errno
+import os
+import sys
+import time
+
+LOCK_PATH_ENV = "PADDLE_TPU_DEVICE_LOCK"
+DEFAULT_LOCK_PATH = "/tmp/paddle_tpu_device.lock"
+
+_lock_file = None          # keep the fd alive => hold the lock
+
+
+def lock_path():
+    return os.environ.get(LOCK_PATH_ENV, DEFAULT_LOCK_PATH)
+
+
+def _platform_is_cpu():
+    """True when this process is pinned to the cpu platform and can
+    never touch the TPU tunnel.  The ONLY trusted signal is the live
+    jax config: the force-registered axon plugin sets
+    ``jax_platforms='axon,cpu'`` from sitecustomize, which OVERRIDES
+    the ``JAX_PLATFORMS=cpu`` env var — an env-only "cpu" process still
+    initializes the tunnel (exactly the r4 window-burning bug), so it
+    must take the lock.  Processes that re-assert
+    ``jax.config.update("jax_platforms", "cpu")`` (tests/conftest.py,
+    every tools/ script, the dryrun) are genuinely cpu-pinned and skip
+    the lock.  The env var is consulted only when jax isn't imported at
+    all (no sitecustomize — nothing can force a TPU platform)."""
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            cfg = getattr(jax.config, "jax_platforms", None)
+        except Exception:
+            return False
+        if cfg:
+            return "tpu" not in cfg and "axon" not in cfg
+        return False      # default platform resolution may pick the TPU
+    return os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+
+
+def held():
+    return _lock_file is not None
+
+
+def _open_and_flock(blocking):
+    import fcntl
+    # world-writable create (subject to umask-independent chmod below):
+    # the lock coordinates EVERY process on the host, so a file created
+    # by one user must remain openable by another — a 0644 default would
+    # turn a cross-user contention into a PermissionError crash
+    fd = os.open(lock_path(), os.O_RDWR | os.O_CREAT, 0o666)
+    try:
+        os.fchmod(fd, 0o666)
+    except OSError:
+        pass        # not the owner: perms were set at create time
+    f = os.fdopen(fd, "r+")
+    flags = fcntl.LOCK_EX | (0 if blocking else fcntl.LOCK_NB)
+    try:
+        fcntl.flock(f.fileno(), flags)
+    except OSError as e:
+        f.close()
+        if e.errno in (errno.EAGAIN, errno.EACCES):
+            return None
+        raise
+    return f
+
+
+def _record_holder(f):
+    try:
+        f.seek(0)
+        f.truncate()
+        f.write(f"pid={os.getpid()} argv={' '.join(sys.argv)} "
+                f"t={time.strftime('%Y-%m-%d %H:%M:%S')}\n")
+        f.flush()
+    except OSError:
+        pass        # informational only
+
+
+def read_holder():
+    """Best-effort: who wrote the lock file last (the current or most
+    recent holder). For log messages only — never for lock decisions."""
+    try:
+        with open(lock_path()) as f:
+            return f.read().strip() or "<unknown>"
+    except OSError:
+        return "<unknown>"
+
+
+def try_device_lock():
+    """Non-blocking acquire. True if this process now holds (or already
+    held) the device lock, or doesn't need it (cpu platform); False if
+    another process owns the backend right now."""
+    global _lock_file
+    if _platform_is_cpu() or _lock_file is not None:
+        return True
+    f = _open_and_flock(blocking=False)
+    if f is None:
+        return False
+    _record_holder(f)
+    _lock_file = f
+    return True
+
+
+def ensure_device_lock(warn_after_s=20.0):
+    """Blocking acquire, held for process lifetime.  Call before any
+    jax backend init when the platform may be TPU.  Logs to stderr when
+    the wait exceeds ``warn_after_s`` so a blocked process is visibly
+    waiting, not silently hung."""
+    global _lock_file
+    if _platform_is_cpu() or _lock_file is not None:
+        return
+    f = _open_and_flock(blocking=False)
+    if f is None:
+        print(f"device_lock: TPU backend busy (holder: {read_holder()}) "
+              f"— waiting for {lock_path()}", file=sys.stderr, flush=True)
+        t0 = time.time()
+        f = _open_and_flock(blocking=True)
+        waited = time.time() - t0
+        if waited > warn_after_s:
+            print(f"device_lock: acquired after {waited:.0f}s wait",
+                  file=sys.stderr, flush=True)
+    _record_holder(f)
+    _lock_file = f
+
+
+def release_device_lock():
+    """Explicit release (tests / long-lived daemons between windows).
+    Normal processes just exit — the kernel drops the flock."""
+    global _lock_file
+    if _lock_file is not None:
+        _lock_file.close()      # close drops the flock
+        _lock_file = None

@@ -13,9 +13,11 @@ import sys
 
 import pytest
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "tools"))
-from tpu_probe import probe  # noqa: E402  (shared wedge-safe probe)
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+sys.path.insert(0, _REPO)
+from tpu_probe import BUSY, probe  # noqa: E402  (shared wedge-safe probe)
+from paddle_tpu.utils import device_lock  # noqa: E402
 
 
 def pytest_configure(config):
@@ -32,8 +34,19 @@ def pytest_configure(config):
 def pytest_collection_modifyitems(config, items):
     if not items:
         return
-    if probe() is None:
+    p = probe()
+    if p is BUSY:
+        skip = pytest.mark.skip(reason="device lock busy — another "
+                                       "process owns the TPU backend")
+    elif p is None:
         skip = pytest.mark.skip(reason="TPU tunnel unavailable/wedged "
                                        "(subprocess probe failed)")
-        for item in items:
-            item.add_marker(skip)
+    # probe OK: take the lock for the whole pytest session before any
+    # in-process jax backend init (a concurrent init wedges the tunnel)
+    elif not device_lock.try_device_lock():
+        skip = pytest.mark.skip(reason="device lock lost to a concurrent "
+                                       "process between probe and session")
+    else:
+        return
+    for item in items:
+        item.add_marker(skip)

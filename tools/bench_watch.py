@@ -44,6 +44,7 @@ LOG = os.path.join(PERF, "watch_log.txt")
 STOP = os.path.join(PERF, "watch_stop")
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from tpu_probe import BUSY  # noqa: E402
 from tpu_probe import DEFAULT_TIMEOUT_S as PROBE_TIMEOUT_S  # noqa: E402
 from tpu_probe import probe  # noqa: E402  (shared wedge-safe probe)
 
@@ -136,7 +137,13 @@ def _tunnel_still_ok(after_step):
     tunnel — ~100 minutes of guaranteed hangs. A failed probe aborts
     the rest of the ladder instead; the watcher commits what landed
     and KEEPS CYCLING (run_suite returns incomplete)."""
-    if probe() is not None:
+    p = probe()
+    if p is BUSY:
+        log(f"device lock busy after step {after_step} (another process "
+            f"owns the backend) — aborting remaining ladder steps; "
+            f"watcher keeps probing")
+        return False
+    if p is not None:
         return True
     log(f"tunnel wedged after step {after_step} — aborting remaining "
         f"ladder steps (partial artifacts committed; watcher keeps "
@@ -263,6 +270,12 @@ def main():
             commit_perf("Record bench-watcher tunnel probe log")
             return 0
         dev = probe()
+        if dev is BUSY:
+            log(f"cycle {cycle}/{MAX_CYCLES}: device lock busy (another "
+                f"process owns the backend — e.g. the driver's bench); "
+                f"standing by")
+            time.sleep(INTERVAL_S)
+            continue
         if dev is None:
             log(f"cycle {cycle}/{MAX_CYCLES}: tunnel wedged")
             # commit the attempt log every 6 cycles so a killed session

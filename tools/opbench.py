@@ -62,6 +62,8 @@ def main():
     import jax
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.utils import device_lock
+    device_lock.ensure_device_lock()    # no-op on cpu; blocks, not wedges
     import jax.numpy as jnp
 
     dtype = jnp.dtype(args.dtype)

@@ -3,6 +3,12 @@ timeout. The single source of truth for the wedge-safety rules (the
 axon plugin wedges ~an hour on a hung or concurrent device init, so
 probes must be subprocess-only, sequential, and killable).
 
+Round-5 addition: the subprocess takes the machine-wide device lock
+(paddle_tpu/utils/device_lock.py) NON-blocking before touching jax.
+If another process owns the backend (a bench mid-run), the probe
+reports "busy" instead of initializing concurrently — the exact
+failure that burned the round-4 hardware window.
+
 Used by tools/bench_watch.py and tests_tpu/conftest.py.
 """
 
@@ -12,23 +18,41 @@ import sys
 
 DEFAULT_TIMEOUT_S = int(os.environ.get("WATCH_PROBE_TIMEOUT_S", 120))
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Loads the lock module BY PATH (no package import — the probe budget is
+# tight) and exits 3 without touching jax when the backend is owned.
+_SNIPPET = """
+import importlib.util as u, sys
+s = u.spec_from_file_location("device_lock", {lock_py!r})
+m = u.module_from_spec(s); s.loader.exec_module(m)
+if not m.try_device_lock():
+    print("LOCKED"); sys.exit(3)
+import jax
+d = jax.devices()
+print(d[0].platform, getattr(d[0], 'device_kind', ''), len(d))
+"""
+
+BUSY = "BUSY"        # sentinel: backend owned by another process
+
 
 def probe(timeout_s=None):
     """Return a 'platform device_kind n_devices' string when a live TPU
-    backend answers device init within the timeout, else None. The
+    backend answers device init within the timeout; the BUSY sentinel
+    when another process holds the device lock; else None. The
     subprocess is killed at the timeout so a wedged init never blocks
     the caller."""
+    lock_py = os.path.join(REPO, "paddle_tpu", "utils", "device_lock.py")
     try:
         out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; d = jax.devices(); "
-             "print(d[0].platform, getattr(d[0], 'device_kind', ''), "
-             "len(d))"],
+            [sys.executable, "-c", _SNIPPET.format(lock_py=lock_py)],
             capture_output=True, text=True,
             timeout=timeout_s or DEFAULT_TIMEOUT_S)
     except subprocess.TimeoutExpired:
         return None
     tail = (out.stdout.strip().splitlines() or [""])[-1]
+    if out.returncode == 3:
+        return BUSY
     low = tail.lower()
     if out.returncode == 0 and ("tpu" in low or "axon" in low):
         return tail

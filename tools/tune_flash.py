@@ -21,6 +21,11 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
+def _log(msg):
+    print(f"tune_flash: [{time.strftime('%H:%M:%S')}] {msg}",
+          file=sys.stderr, flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seq", type=int, default=512)
@@ -41,6 +46,17 @@ def main():
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         # a force-registered TPU plugin (axon) overrides the env var
         jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.utils import device_lock
+    device_lock.ensure_device_lock()    # no-op on cpu; blocks, not wedges
+    # Bounded device init under bench.py's watchdog: the r4 window ran
+    # this tuner against a re-wedged tunnel and it hung ~25 minutes in
+    # first array creation with no artifact (perf/watch_log.txt
+    # 04:47:46, rc=1 in 1510s). A wedged init must fail FAST and
+    # structured instead.
+    from bench import _device_watchdog
+    devs = _device_watchdog()
+    _log(f"device: {getattr(devs[0], 'device_kind', devs[0])} "
+         f"x{len(devs)} ({jax.default_backend()})")
     import jax.numpy as jnp
     from paddle_tpu.ops.pallas import flash
 
@@ -65,6 +81,7 @@ def main():
         else:
             fn = jax.jit(lambda q, k, v, bq=bq, bk=bk: flash.flash_attention(
                 q, k, v, causal=True, block_q=bq, block_k=bk))
+        _log(f"compile+run bq={bq} bk={bk}")
         try:
             out = fn(q, k, v)
             jax.block_until_ready(out)
@@ -74,12 +91,22 @@ def main():
             jax.block_until_ready(out)
             dt = (time.perf_counter() - t0) / args.steps
         except Exception as e:
+            # stdout TOO: the archived artifact must show which configs
+            # failed and why (the r4 artifact was empty because failures
+            # went only to stderr)
+            short = str(e).strip().splitlines()[0][:200] if str(e).strip() \
+                else repr(e)[:200]
+            print(f"bq={bq:4d} bk={bk:4d}  FAILED: {short}", flush=True)
             print(f"bq={bq:4d} bk={bk:4d}  FAILED: {e}", file=sys.stderr)
             continue
         results.append((dt, bq, bk))
-        print(f"bq={bq:4d} bk={bk:4d}  {dt * 1e3:8.3f} ms/step")
+        print(f"bq={bq:4d} bk={bk:4d}  {dt * 1e3:8.3f} ms/step", flush=True)
 
     if not results:
+        # parseable failure record in the artifact (never a 0-byte file)
+        print(json.dumps({"failed": True, "error": "no config ran",
+                          "swept": blocks, "backward": bool(args.backward)}),
+              flush=True)
         print("no config ran", file=sys.stderr)
         return 1
     dt, bq, bk = min(results)
