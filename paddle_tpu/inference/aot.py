@@ -159,3 +159,121 @@ def load_aot_model(dirname):
         with open(os.path.join(dirname, sig["file"]), "rb") as f:
             exported[sig["batch"]] = jax.export.deserialize(f.read())
     return AotModel(meta, exported)
+
+
+# ---------------------------------------------------------------------------
+# Train-step export: training without the Python framework
+# ---------------------------------------------------------------------------
+# Parity: paddle/fluid/train/ (demo/demo_trainer.cc trains a saved
+# ProgramDesc from a process with no Python at all — C++ Executor +
+# feed/fetch). The TPU-native equivalent exports the WHOLE train step
+# (fwd + jax.grad + optimizer update) as one serialized StableHLO
+# artifact plus an .npz of the initial state; any process that can
+# deserialize jax.export artifacts (python+jax today; the PJRT C API
+# for C++ runtimes) trains the model with NO paddle_tpu import, no op
+# registry, no Program rebuild — the same "training stack not required
+# at the training site" property the reference's standalone trainer
+# provides (tests/io/test_train_export.py proves it in a subprocess
+# that imports only jax+numpy).
+
+def save_train_step(dirname, program, feed_names, fetch_names,
+                    scope=None, batch=1):
+    """Export a TRAINING step of `program` (must contain backward +
+    optimizer ops, i.e. minimize() was called) as a self-contained
+    artifact:
+
+        train_step.jaxexp  — exported fn(state, feeds, rng) ->
+                             (new_state, fetches)
+        train_state.npz    — initial values of every persistable
+                             (params, opt-state, LR counters)
+        train_meta.json    — names/shapes/dtypes glue
+
+    The state threads through calls exactly like the Executor's donated
+    state pytree, so step semantics (including batch-norm stats and lr
+    schedules) match exe.run()."""
+    from ..core.executor import Executor, global_scope
+
+    scope = scope or global_scope()
+    fetch_names = [v.name if hasattr(v, "name") else v
+                   for v in fetch_names]
+    gb = program.global_block()
+    persist_names = sorted(
+        v.name for v in gb.vars.values()
+        if v.persistable and v.name not in ("feed", "fetch"))
+    state = {}
+    for n in persist_names:
+        val = scope.get(n)
+        if val is None:
+            raise ValueError(f"persistable '{n}' has no value in scope — "
+                             f"run the startup program first")
+        state[n] = jnp.asarray(val)
+
+    exe = Executor()
+    step = exe._build(program, tuple(fetch_names), tuple(persist_names),
+                      tuple(sorted(state)))
+    state_specs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                   for k, v in state.items()}
+    feed_specs = _feed_specs(program, feed_names, batch)
+    # (seed, step) pair, same carrier the Executor uses (run():~380)
+    rng_spec = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    exp = jax.export.export(step)(state_specs, feed_specs, rng_spec)
+
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "train_step.jaxexp"), "wb") as f:
+        f.write(exp.serialize())
+    np.savez(os.path.join(dirname, "train_state.npz"),
+             **{k: np.asarray(v) for k, v in state.items()})
+    from ..core import framework as _framework
+    meta = {
+        "feed_names": list(feed_names),
+        "fetch_names": fetch_names,
+        "state_names": persist_names,
+        "batch": int(batch),
+        # the Executor derives rng as (program seed, step); record the
+        # seed so the artifact's dropout/rng stream matches exe.run()
+        "random_seed": int(program.random_seed
+                           or _framework.default_seed()),
+        "feed_shapes": {k: list(s.shape) for k, s in feed_specs.items()},
+        "feed_dtypes": {k: str(np.dtype(s.dtype))
+                        for k, s in feed_specs.items()},
+    }
+    with open(os.path.join(dirname, "train_meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    return persist_names
+
+
+class TrainStepArtifact:
+    """Standalone trainer handle: state lives here, each run() is one
+    optimizer step. Deserializable with ONLY jax+numpy installed."""
+
+    def __init__(self, meta, exported, state):
+        self.feed_names = meta["feed_names"]
+        self.fetch_names = meta["fetch_names"]
+        self._dtypes = meta.get("feed_dtypes", {})
+        self._exp = exported
+        self.state = state
+        self._step = 0
+        self._seed = int(meta.get("random_seed", 0))
+
+    def run(self, feeds):
+        args = {k: jnp.asarray(np.asarray(feeds[k]).astype(
+            self._dtypes.get(k, np.asarray(feeds[k]).dtype)))
+            for k in self.feed_names}
+        rng = jnp.asarray([self._seed & 0xFFFFFFFF,
+                           self._step & 0xFFFFFFFF], jnp.uint32)
+        self.state, fetches = self._exp.call(self.state, args, rng)
+        self._step += 1
+        return [np.asarray(f) for f in fetches]
+
+    def save_state(self, path):
+        np.savez(path, **{k: np.asarray(v) for k, v in self.state.items()})
+
+
+def load_train_step(dirname):
+    with open(os.path.join(dirname, "train_meta.json")) as f:
+        meta = json.load(f)
+    with open(os.path.join(dirname, "train_step.jaxexp"), "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    npz = np.load(os.path.join(dirname, "train_state.npz"))
+    state = {k: jnp.asarray(npz[k]) for k in npz.files}
+    return TrainStepArtifact(meta, exported, state)

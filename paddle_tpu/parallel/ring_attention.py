@@ -8,7 +8,7 @@ attention memory is O(T/sp) per chip and comm overlaps compute).
 
 References (public technique): RingAttention (Liu et al.), blockwise
 flash-style online softmax. Implemented in pure lax (runs on TPU and the
-CPU test mesh); the Pallas fused kernel lives in ops/pallas_attention.py
+CPU test mesh); the Pallas fused kernel lives in ops/pallas/flash.py
 and is used automatically on TPU for the local block math.
 """
 

@@ -7,12 +7,14 @@ of the reference's CUDA event timeline.
 """
 
 import contextlib
+import json
+import threading
 import time
 
 import jax
 
 
-_timings = []
+_timings = []      # (name, duration_s, start_epoch_s, thread_id)
 _trace_dir = None
 _active = False
 
@@ -30,6 +32,10 @@ def start_profiler(state="All", tracer_option="Default",
 
 
 def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
+    """Stop tracing, print the host-side timing table, and write the
+    raw event records (JSON) to `profile_path` — the input format of
+    paddle_tpu.utils.timeline's chrome-trace converter (the reference's
+    tools/timeline.py reads the serialized profile the same way)."""
     global _active
     if _active:
         jax.profiler.stop_trace()
@@ -38,8 +44,21 @@ def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
         rows = sorted(_timings, key=lambda r: -r[1])
         total = sum(r[1] for r in rows)
         print(f"{'Event':<40}{'Time(ms)':>12}{'Ratio':>8}")
-        for name, dt in rows[:50]:
+        for name, dt, _start, _tid in rows[:50]:
             print(f"{name:<40}{dt * 1e3:>12.3f}{dt / max(total, 1e-12):>8.2%}")
+        if profile_path:
+            try:
+                save_profiler_records(profile_path)
+            except OSError:
+                pass        # timing table already printed; path optional
+
+
+def save_profiler_records(path):
+    """Write the recorded host events as JSON:
+    [{"name", "start_s", "dur_s", "tid"}, ...]."""
+    with open(path, "w") as f:
+        json.dump([{"name": n, "start_s": s, "dur_s": d, "tid": t}
+                   for n, d, s, t in _timings], f)
 
 
 def reset_profiler():
@@ -58,10 +77,12 @@ def profiler(state="All", sorted_key=None, profile_path='/tmp/profile'):
 @contextlib.contextmanager
 def record_event(name):
     """Host-side timing of a region (also annotates the XLA trace)."""
+    start = time.time()
     t0 = time.perf_counter()
     with jax.profiler.TraceAnnotation(name):
         yield
-    _timings.append((name, time.perf_counter() - t0))
+    _timings.append((name, time.perf_counter() - t0, start,
+                     threading.get_ident()))
 
 
 @contextlib.contextmanager

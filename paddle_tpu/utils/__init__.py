@@ -3,6 +3,10 @@ determinism, memory introspection, self-test, model stats."""
 
 from . import debugger
 from . import device_lock
+from . import image_util
+from . import plot
+from . import show_pb
+from . import timeline
 from . import nan_check
 from . import determinism
 from . import memory

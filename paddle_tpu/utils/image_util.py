@@ -1,0 +1,185 @@
+"""Image preprocessing utilities.
+
+Parity: python/paddle/utils/image_util.py (reference file:line cited per
+function). Re-implemented for numpy/PIL on python3 — the reference is
+python2-era and several of its index computations are float divisions
+that no longer run (e.g. image_util.py:60-62 uses `/ 2` results as
+slice bounds); this port implements the documented behavior with
+integer arithmetic.
+
+These are HOST-side helpers feeding the input pipeline; device-side
+augmentation belongs in the reader/dataset path where it can overlap
+with the train step.
+"""
+
+import io
+
+import numpy as np
+
+__all__ = [
+    "resize_image", "flip", "crop_img", "decode_jpeg", "preprocess_img",
+    "load_meta", "load_image", "oversample", "ImageTransformer",
+]
+
+
+def resize_image(img, target_size):
+    """Resize a PIL image so the SHORTER edge equals target_size
+    (aspect preserved). Parity: image_util.py:20-30."""
+    from PIL import Image
+    percent = target_size / float(min(img.size[0], img.size[1]))
+    size = (int(round(img.size[0] * percent)),
+            int(round(img.size[1] * percent)))
+    # LANCZOS is PIL's current name for the reference's ANTIALIAS filter
+    return img.resize(size, Image.LANCZOS)
+
+
+def flip(im):
+    """Horizontal flip. im: (K, H, W) color or (H, W) gray ndarray.
+    Parity: image_util.py:33-42."""
+    if im.ndim == 3:
+        return im[:, :, ::-1]
+    return im[:, ::-1]
+
+
+def crop_img(im, inner_size, color=True, test=True):
+    """Crop to inner_size x inner_size — center crop in test mode,
+    random crop + random horizontal flip in train mode; images smaller
+    than inner_size are zero-padded to fit. im: (K, H, W) if color else
+    (H, W). Parity: image_util.py:45-86."""
+    im = np.asarray(im, np.float32)
+    if color:
+        h, w = max(inner_size, im.shape[1]), max(inner_size, im.shape[2])
+        padded = np.zeros((3, h, w), np.float32)
+        y0, x0 = (h - im.shape[1]) // 2, (w - im.shape[2]) // 2
+        padded[:, y0:y0 + im.shape[1], x0:x0 + im.shape[2]] = im
+    else:
+        h, w = max(inner_size, im.shape[0]), max(inner_size, im.shape[1])
+        padded = np.zeros((h, w), np.float32)
+        y0, x0 = (h - im.shape[0]) // 2, (w - im.shape[1]) // 2
+        padded[y0:y0 + im.shape[0], x0:x0 + im.shape[1]] = im
+    if test:
+        y0, x0 = (h - inner_size) // 2, (w - inner_size) // 2
+    else:
+        y0 = np.random.randint(0, h - inner_size + 1)
+        x0 = np.random.randint(0, w - inner_size + 1)
+    pic = (padded[:, y0:y0 + inner_size, x0:x0 + inner_size] if color
+           else padded[y0:y0 + inner_size, x0:x0 + inner_size])
+    if not test and np.random.randint(2) == 0:
+        pic = flip(pic)
+    return pic
+
+
+def decode_jpeg(jpeg_string):
+    """JPEG bytes -> (K, H, W) ndarray (color) or (H, W) (gray).
+    Parity: image_util.py:89-93."""
+    from PIL import Image
+    arr = np.array(Image.open(io.BytesIO(jpeg_string)))
+    if arr.ndim == 3:
+        arr = np.transpose(arr, (2, 0, 1))
+    return arr
+
+
+def preprocess_img(im, img_mean, crop_size, is_train, color=True):
+    """Crop (+ train-mode augmentation), subtract mean, flatten.
+    Parity: image_util.py:96-108."""
+    pic = crop_img(np.asarray(im, np.float32), crop_size, color,
+                   test=not is_train)
+    pic -= img_mean
+    return pic.flatten()
+
+
+def load_meta(meta_path, mean_img_size, crop_size, color=True):
+    """Load a pickled mean image and center-crop it to crop_size.
+    Parity: image_util.py:111-130."""
+    import pickle
+    with open(meta_path, "rb") as f:
+        mean = pickle.load(f, encoding="latin1")
+    border = (mean_img_size - crop_size) // 2
+    if color:
+        mean = np.asarray(mean, np.float32).reshape(
+            3, mean_img_size, mean_img_size)
+        return np.ascontiguousarray(
+            mean[:, border:border + crop_size, border:border + crop_size])
+    mean = np.asarray(mean, np.float32).reshape(mean_img_size,
+                                                mean_img_size)
+    return np.ascontiguousarray(
+        mean[border:border + crop_size, border:border + crop_size])
+
+
+def load_image(img_path, is_color=True):
+    """Open an image file as PIL RGB (or L). Parity:
+    image_util.py:133-141."""
+    from PIL import Image
+    img = Image.open(img_path)
+    return img.convert("RGB" if is_color else "L")
+
+
+def oversample(img, crop_dims):
+    """Ten-crop: 4 corners + center, each plus its mirror, for every
+    (H, W, K) image in `img`. Returns (10*N, ch, cw, K). Parity:
+    image_util.py:144-180."""
+    im_shape = np.array(img[0].shape)
+    crop_dims = np.array(crop_dims)
+    center = im_shape[:2] / 2.0
+    h_ix = (0, im_shape[0] - crop_dims[0])
+    w_ix = (0, im_shape[1] - crop_dims[1])
+    crops_ix = np.empty((5, 4), int)
+    cur = 0
+    for i in h_ix:
+        for j in w_ix:
+            crops_ix[cur] = (i, j, i + crop_dims[0], j + crop_dims[1])
+            cur += 1
+    crops_ix[4] = np.concatenate([np.floor(center - crop_dims / 2.0),
+                                  np.floor(center + crop_dims / 2.0)]
+                                 ).astype(int)
+    crops_ix = np.tile(crops_ix, (2, 1))
+    out = np.empty((10 * len(img), crop_dims[0], crop_dims[1],
+                    im_shape[-1]), np.float32)
+    ix = 0
+    for im in img:
+        for y0, x0, y1, x1 in crops_ix:
+            out[ix] = im[y0:y1, x0:x1, :]
+            ix += 1
+        out[ix - 5:ix] = out[ix - 5:ix, :, ::-1, :]    # mirrors
+    return out
+
+
+class ImageTransformer:
+    """Configurable transpose / channel-swap / mean-subtract pipeline.
+    Parity: image_util.py:183-229."""
+
+    def __init__(self, transpose=None, channel_swap=None, mean=None,
+                 is_color=True):
+        self.is_color = is_color
+        self.set_transpose(transpose)
+        self.set_channel_swap(channel_swap)
+        self.set_mean(mean)
+
+    def set_transpose(self, order):
+        if order is not None and self.is_color:
+            assert len(order) == 3
+        self.transpose = order
+
+    def set_channel_swap(self, order):
+        if order is not None and self.is_color:
+            assert len(order) == 3
+        self.channel_swap = order
+
+    def set_mean(self, mean):
+        if mean is not None:
+            mean = np.asarray(mean, np.float32)
+            if mean.ndim == 1:
+                mean = mean[:, np.newaxis, np.newaxis]
+            elif self.is_color:
+                assert mean.ndim == 3
+        self.mean = mean
+
+    def transformer(self, data):
+        data = np.asarray(data, np.float32)
+        if self.transpose is not None:
+            data = data.transpose(self.transpose)
+        if self.channel_swap is not None:
+            data = data[np.asarray(self.channel_swap), :, :]
+        if self.mean is not None:
+            data = data - self.mean
+        return data

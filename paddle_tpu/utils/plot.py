@@ -1,0 +1,87 @@
+"""Training-curve plotting helper.
+
+Parity: python/paddle/utils/plot.py:19-110 (PlotData, Ploter). Same
+surface — named series of (step, value) points, `append`, `plot(path)`,
+DISABLE_PLOT env gate — re-done without the hard IPython dependency:
+matplotlib/IPython import lazily at plot() time and their absence (or
+DISABLE_PLOT=True) degrades to a no-op instead of an import crash, so
+the class is safe in headless training jobs.
+
+For production metric tracking prefer the profiler/TensorBoard path
+(paddle_tpu.profiler, MIGRATION.md); this exists for notebook parity.
+"""
+
+import os
+
+__all__ = ["PlotData", "Ploter"]
+
+
+class PlotData:
+    def __init__(self):
+        self.step = []
+        self.value = []
+
+    def append(self, step, value):
+        self.step.append(step)
+        self.value.append(value)
+
+    def reset(self):
+        self.step = []
+        self.value = []
+
+
+class Ploter:
+    """Collect named 2D series and render them in one figure.
+
+    >>> curve = Ploter("train cost", "test cost")
+    >>> curve.append("train cost", 1, 0.6)
+    >>> curve.plot("/tmp/cost.png")
+    """
+
+    def __init__(self, *titles):
+        self.__titles__ = titles
+        self.__plot_data__ = {t: PlotData() for t in titles}
+
+    def __plot_is_disabled__(self):
+        return os.environ.get("DISABLE_PLOT") == "True"
+
+    def append(self, title, step, value):
+        if title not in self.__plot_data__:
+            raise KeyError(f"unknown series {title!r}; declared: "
+                           f"{list(self.__plot_data__)}")
+        self.__plot_data__[title].append(step, value)
+
+    def reset(self):
+        for data in self.__plot_data__.values():
+            data.reset()
+
+    def plot(self, path=None):
+        """Render all non-empty series; save to `path` or display
+        inline (IPython). No-op when plotting is disabled or backends
+        are missing."""
+        if self.__plot_is_disabled__():
+            return
+        try:
+            import matplotlib
+            if path is not None:
+                matplotlib.use("Agg")      # headless save needs no GUI
+            import matplotlib.pyplot as plt
+        except ImportError:
+            return
+        titles = []
+        for title in self.__titles__:
+            data = self.__plot_data__[title]
+            if data.step:
+                titles.append(title)
+                plt.plot(data.step, data.value)
+        plt.legend(titles, loc="upper left")
+        if path is None:
+            try:
+                from IPython import display
+                display.clear_output(wait=True)
+                display.display(plt.gcf())
+            except ImportError:
+                plt.show()
+        else:
+            plt.savefig(path)
+        plt.gcf().clear()

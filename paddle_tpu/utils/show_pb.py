@@ -1,0 +1,61 @@
+"""Show the content of a Paddle binary protobuf file.
+
+Parity: python/paddle/utils/show_pb.py (a CLI that pretty-prints Paddle
+binary files). The reference targets v2-era DataFormat record files
+(DataHeader/DataSample) — a format that predates Fluid and has no
+producer in this framework's interop story — so this port re-targets
+the tool at the binary Paddle artifact we DO exchange: Fluid
+`__model__` ProgramDesc files (read/written by io/fluid_format.py,
+parsed by io/fluid_proto.py without a protobuf dependency).
+
+Usage: python -m paddle_tpu.utils.show_pb /path/to/__model__
+"""
+
+import sys
+
+__all__ = ["show_program_desc", "format_program_desc"]
+
+
+def format_program_desc(raw):
+    """Human-readable dump of a serialized Fluid ProgramDesc: blocks,
+    vars (dtype/shape/persistable), ops (type, in/out, attrs)."""
+    from ..io.fluid_proto import parse_program_desc
+    prog = parse_program_desc(raw)
+    lines = []
+    for bi, block in enumerate(prog.blocks):
+        parent = getattr(block, "parent_idx", -1)
+        lines.append(f"block {bi} (parent {parent}):")
+        lines.append("  vars:")
+        for name in sorted(block.vars):
+            v = block.vars[name]
+            persist = " persistable" if v.persistable else ""
+            lines.append(f"    {name}: dtype={v.dtype} "
+                         f"shape={list(v.shape)}{persist}")
+        lines.append("  ops:")
+        for op in block.ops:
+            ins = {k: v for k, v in op.inputs.items() if v}
+            outs = {k: v for k, v in op.outputs.items() if v}
+            lines.append(f"    {op.type}: {ins} -> {outs}")
+            if op.attrs:
+                body = ", ".join(f"{k}={v!r}" for k, v in
+                                 sorted(op.attrs.items()))
+                lines.append(f"      attrs: {body}")
+    return "\n".join(lines)
+
+
+def show_program_desc(path, file=None):
+    with open(path, "rb") as f:
+        raw = f.read()
+    print(format_program_desc(raw), file=file or sys.stdout)
+
+
+def _usage():
+    print("Usage: python -m paddle_tpu.utils.show_pb "
+          "/path/to/__model__", file=sys.stderr)
+    raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) < 2:
+        _usage()
+    show_program_desc(sys.argv[1])

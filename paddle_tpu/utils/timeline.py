@@ -1,0 +1,100 @@
+"""Chrome-trace timeline conversion for profiler output.
+
+Parity: tools/timeline.py in the reference (its _ChromeTraceFormatter /
+Timeline classes convert a serialized profiler proto into a JSON file
+loadable in chrome://tracing). Here the profiler's host-side event
+records (written by `paddle_tpu.profiler.stop_profiler(profile_path=)`)
+convert the same way; DEVICE-side op timelines come from the
+jax.profiler trace directory viewed in TensorBoard/XProf, which
+supersedes hand-rolled device event conversion (MIGRATION.md).
+
+Usage:
+    python -m paddle_tpu.utils.timeline --profile_path /tmp/profile \
+        --timeline_path /tmp/timeline.json
+then open chrome://tracing (or https://ui.perfetto.dev) and load it.
+"""
+
+import argparse
+import json
+
+__all__ = ["ChromeTraceFormatter", "Timeline"]
+
+
+class ChromeTraceFormatter:
+    """Builds trace-event-format JSON (the chrome://tracing schema:
+    complete events 'X' with microsecond ts/dur, process/thread
+    metadata events 'M')."""
+
+    def __init__(self):
+        self._events = []
+        self._metadata = []
+
+    def emit_pid(self, name, pid):
+        self._metadata.append({"name": "process_name", "ph": "M",
+                               "pid": pid, "tid": 0,
+                               "args": {"name": name}})
+
+    def emit_tid(self, name, pid, tid):
+        self._metadata.append({"name": "thread_name", "ph": "M",
+                               "pid": pid, "tid": tid,
+                               "args": {"name": name}})
+
+    def emit_region(self, timestamp_us, duration_us, pid, tid, category,
+                    name, args=None):
+        self._events.append({"ph": "X", "cat": category, "name": name,
+                             "pid": pid, "tid": tid,
+                             "ts": timestamp_us, "dur": duration_us,
+                             "args": args or {}})
+
+    def format_to_string(self, pretty=False):
+        trace = {"traceEvents": self._metadata + self._events}
+        return json.dumps(trace, indent=4 if pretty else None,
+                          separators=None if pretty else (",", ":"))
+
+
+class Timeline:
+    """Convert profiler event records into a chrome trace.
+
+    records: list of {"name", "start_s", "dur_s", "tid"} dicts (the
+    profiler's JSON format) or a path to such a file.
+    """
+
+    def __init__(self, records):
+        if isinstance(records, str):
+            with open(records) as f:
+                records = json.load(f)
+        self._records = records
+
+    def generate_chrome_trace(self, pretty=False):
+        chrome = ChromeTraceFormatter()
+        chrome.emit_pid("paddle_tpu host", 0)
+        tids = {}
+        t0 = min((r["start_s"] for r in self._records), default=0.0)
+        for r in self._records:
+            tid = tids.setdefault(r.get("tid", 0), len(tids))
+            chrome.emit_region(
+                timestamp_us=(r["start_s"] - t0) * 1e6,
+                duration_us=r["dur_s"] * 1e6,
+                pid=0, tid=tid, category="host", name=r["name"])
+        for raw, tid in tids.items():
+            chrome.emit_tid(f"thread {raw}", 0, tid)
+        return chrome.format_to_string(pretty)
+
+    def save(self, path, pretty=False):
+        with open(path, "w") as f:
+            f.write(self.generate_chrome_trace(pretty))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--profile_path", required=True,
+                    help="JSON records from profiler.stop_profiler")
+    ap.add_argument("--timeline_path", required=True,
+                    help="output chrome trace json")
+    args = ap.parse_args()
+    Timeline(args.profile_path).save(args.timeline_path)
+    print(f"wrote {args.timeline_path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -194,15 +194,23 @@ def test_probe_reports_busy_while_lock_held(tmp_path):
             import tpu_probe
         finally:
             sys.path.pop(0)
-        old = os.environ.get("PADDLE_TPU_DEVICE_LOCK")
+        # the probe subprocess inherits os.environ: point it at the tmp
+        # lock AND drop the suite's JAX_PLATFORMS=cpu pin — on a host
+        # without the forced axon plugin the env var would let the
+        # subprocess skip the lock and report the platform instead of
+        # BUSY (the lock path must be exercised everywhere)
+        old = {k: os.environ.get(k)
+               for k in ("PADDLE_TPU_DEVICE_LOCK", "JAX_PLATFORMS")}
         os.environ["PADDLE_TPU_DEVICE_LOCK"] = lock
+        os.environ.pop("JAX_PLATFORMS", None)
         try:
             assert tpu_probe.probe(timeout_s=30) is tpu_probe.BUSY
         finally:
-            if old is None:
-                del os.environ["PADDLE_TPU_DEVICE_LOCK"]
-            else:
-                os.environ["PADDLE_TPU_DEVICE_LOCK"] = old
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
     finally:
         holder.kill()
         holder.wait()

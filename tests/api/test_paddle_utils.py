@@ -1,0 +1,195 @@
+"""paddle.utils parity ports (VERDICT r4 missing #2/#4):
+image_util, plot.Ploter, show_pb, utils.timeline (+ the profiler
+records that feed it). Reference files cited in each module docstring.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# image_util
+# ---------------------------------------------------------------------------
+
+def test_resize_image_shorter_edge():
+    from PIL import Image
+    from paddle_tpu.utils import image_util
+
+    img = Image.fromarray(np.zeros((40, 80, 3), np.uint8))
+    out = image_util.resize_image(img, 20)
+    # PIL size is (W, H): shorter edge (H=40) -> 20, aspect kept
+    assert out.size == (40, 20)
+
+
+def test_crop_img_center_and_random():
+    from paddle_tpu.utils import image_util
+
+    im = np.arange(3 * 8 * 8, dtype=np.float32).reshape(3, 8, 8)
+    center = image_util.crop_img(im, 4, color=True, test=True)
+    assert center.shape == (3, 4, 4)
+    np.testing.assert_array_equal(center, im[:, 2:6, 2:6])
+    # gray path + padding when the image is smaller than inner_size
+    gray = np.ones((3, 3), np.float32)
+    padded = image_util.crop_img(gray, 5, color=False, test=True)
+    assert padded.shape == (5, 5)
+    assert padded.sum() == gray.sum()          # content preserved, zero pad
+    np.random.seed(0)
+    rand = image_util.crop_img(im, 4, color=True, test=False)
+    assert rand.shape == (3, 4, 4)
+
+
+def test_preprocess_img_subtracts_mean_and_flattens():
+    from paddle_tpu.utils import image_util
+
+    im = np.ones((3, 6, 6), np.float32) * 7.0
+    mean = np.ones((3, 4, 4), np.float32) * 2.0
+    out = image_util.preprocess_img(im, mean, 4, is_train=False)
+    assert out.shape == (3 * 4 * 4,)
+    np.testing.assert_allclose(out, 5.0)
+
+
+def test_oversample_ten_crops():
+    from paddle_tpu.utils import image_util
+
+    img = np.random.default_rng(0).standard_normal((8, 8, 3)).astype(
+        np.float32)
+    crops = image_util.oversample([img], (4, 4))
+    assert crops.shape == (10, 4, 4, 3)
+    # first 5 are the corner/center crops; last 5 their mirrors
+    np.testing.assert_array_equal(crops[5], crops[0][:, ::-1, :])
+    np.testing.assert_array_equal(crops[9], crops[4][:, ::-1, :])
+    # center crop is the middle patch
+    np.testing.assert_array_equal(crops[4], img[2:6, 2:6, :])
+
+
+def test_image_transformer_pipeline():
+    from paddle_tpu.utils import image_util
+
+    t = image_util.ImageTransformer(transpose=(2, 0, 1),
+                                    channel_swap=(2, 1, 0),
+                                    mean=np.array([1.0, 2.0, 3.0]))
+    data = np.random.default_rng(1).standard_normal((5, 4, 3)).astype(
+        np.float32)
+    out = t.transformer(data)
+    want = data.transpose(2, 0, 1)[[2, 1, 0]] \
+        - np.array([1.0, 2.0, 3.0], np.float32)[:, None, None]
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def test_decode_jpeg_roundtrip():
+    import io as _io
+    from PIL import Image
+    from paddle_tpu.utils import image_util
+
+    arr = (np.random.default_rng(2).random((10, 12, 3)) * 255).astype(
+        np.uint8)
+    buf = _io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG")
+    out = image_util.decode_jpeg(buf.getvalue())
+    assert out.shape == (3, 10, 12)            # CHW
+
+
+# ---------------------------------------------------------------------------
+# plot.Ploter
+# ---------------------------------------------------------------------------
+
+def test_ploter_append_and_save(tmp_path):
+    from paddle_tpu.utils.plot import Ploter
+
+    p = Ploter("train", "test")
+    for i in range(5):
+        p.append("train", i, 1.0 / (i + 1))
+        p.append("test", i, 2.0 / (i + 1))
+    out = tmp_path / "curve.png"
+    p.plot(str(out))
+    assert out.exists() and out.stat().st_size > 0
+    with pytest.raises(KeyError):
+        p.append("unknown", 0, 0.0)
+    p.reset()
+    assert p.__plot_data__["train"].step == []
+
+
+def test_ploter_disable_env(tmp_path, monkeypatch):
+    from paddle_tpu.utils.plot import Ploter
+
+    monkeypatch.setenv("DISABLE_PLOT", "True")
+    p = Ploter("a")
+    p.append("a", 0, 1.0)
+    out = tmp_path / "none.png"
+    p.plot(str(out))
+    assert not out.exists()
+
+
+# ---------------------------------------------------------------------------
+# show_pb
+# ---------------------------------------------------------------------------
+
+def test_show_pb_formats_fluid_model(tmp_path):
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.core import framework
+    from paddle_tpu.io.fluid_proto import encode_program_desc
+    from paddle_tpu.utils import show_pb
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.fc(x, size=3, act="relu")
+    raw = encode_program_desc(main, feed_names=["x"],
+                              fetch_names=[y.name])
+    text = show_pb.format_program_desc(raw)
+    assert "block 0" in text
+    assert "mul" in text or "fc" in text or "matmul" in text
+    path = tmp_path / "__model__"
+    path.write_bytes(raw)
+    import io as _io
+    buf = _io.StringIO()
+    show_pb.show_program_desc(str(path), file=buf)
+    assert "ops:" in buf.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# profiler records -> timeline chrome trace
+# ---------------------------------------------------------------------------
+
+def test_profiler_records_to_chrome_trace(tmp_path):
+    import time
+    from paddle_tpu import profiler
+    from paddle_tpu.utils.timeline import Timeline
+
+    profiler.reset_profiler()
+    with profiler.record_event("step_a"):
+        time.sleep(0.01)
+    with profiler.record_event("step_b"):
+        time.sleep(0.005)
+    rec_path = tmp_path / "profile.json"
+    profiler.save_profiler_records(str(rec_path))
+    records = json.loads(rec_path.read_text())
+    assert {r["name"] for r in records} >= {"step_a", "step_b"}
+    assert all(r["dur_s"] > 0 for r in records)
+
+    out = tmp_path / "timeline.json"
+    Timeline(str(rec_path)).save(str(out))
+    trace = json.loads(out.read_text())
+    events = trace["traceEvents"]
+    xs = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in xs} >= {"step_a", "step_b"}
+    a = next(e for e in xs if e["name"] == "step_a")
+    assert a["dur"] >= 9e3                     # ~10ms in microseconds
+    assert any(e["ph"] == "M" for e in events)  # process/thread metadata
+
+
+def test_stop_profiler_writes_records(tmp_path, capsys):
+    import time
+    from paddle_tpu import profiler
+
+    profiler.reset_profiler()
+    with profiler.record_event("region"):
+        time.sleep(0.002)
+    path = tmp_path / "profile"
+    profiler.stop_profiler(profile_path=str(path))
+    assert "region" in capsys.readouterr().out
+    assert json.loads(path.read_text())[0]["name"] == "region"

@@ -147,6 +147,161 @@ def test_flash_bench_shape_bwd_runs_promptly():
     assert t_steps < 60, f"5 fwd+bwd steps took {t_steps:.0f}s"
 
 
+def test_flash_bwd_causal_pruning_tpu():
+    """Causal BACKWARD on the compiled Mosaic kernel: the r4 causal
+    block-pruning rewrite (commit 0b87708) skips fully-masked K/Q tiles
+    in the bwd kernels too, and had never executed on hardware. Grads
+    must equal the XLA oracle's."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas import flash
+
+    b, h, t, d = 2, 4, 512, 64
+    q, k, v = (_rand((b, h, t, d), s, jnp.float32) for s in (10, 11, 12))
+    scale = 1.0 / d ** 0.5
+
+    def floss(q, k, v):
+        o = flash.flash_attention(q, k, v, scale=scale, causal=True)
+        return jnp.sum(jnp.sin(o))
+
+    def oloss(q, k, v):
+        o = flash._xla_ref(q, k, v, scale, True)
+        return jnp.sum(jnp.sin(o))
+
+    gf = jax.grad(floss, argnums=(0, 1, 2))(q, k, v)
+    go = jax.grad(oloss, argnums=(0, 1, 2))(q, k, v)
+    err = max(float(np.max(np.abs(np.asarray(a) - np.asarray(b_))))
+              for a, b_ in zip(gf, go))
+    tol = 5e-4
+    _record("bwd_f32_causal_pruned", err, tol,
+            {"b": b, "h": h, "t": t, "d": d})
+    assert err <= tol, f"max grad err {err} > {tol}"
+
+
+def test_flash_packed_rows_segment_ids_tpu():
+    """Packed-row segment masking (r4 commits 0dbe37c/cc7ed0a) on real
+    hardware: boundaries STRADDLE the 128-wide blocks (no tile is
+    skippable), fwd and grads vs the explicit cross-segment -inf oracle.
+    Pad slots (id 0) excluded from the comparison as in the CPU tier."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas import flash
+
+    b, h, t, d = 2, 4, 512, 64
+    q, k, v = (_rand((b, h, t, d), s, jnp.float32) for s in (13, 14, 15))
+    seg = np.zeros((b, t), np.int32)
+    seg[0, :200] = 1
+    seg[0, 200:440] = 2            # 72 pad slots
+    seg[1, :130] = 1               # boundaries straddle the 128-blocks
+    seg[1, 130:512] = 2
+    seg = jnp.asarray(seg)
+    scale = 1.0 / d ** 0.5
+
+    got = flash.flash_attention(q, k, v, scale=scale, segment_ids=seg)
+    want = flash._xla_ref(q, k, v, scale, False,
+                          bias=flash.segment_mask_bias(seg, seg))
+    err = max(
+        float(np.max(np.abs(np.asarray(got)[0, :, :440]
+                            - np.asarray(want)[0, :, :440]))),
+        float(np.max(np.abs(np.asarray(got)[1] - np.asarray(want)[1]))))
+    tol = 2e-5
+    _record("fwd_f32_packed_straddle", err, tol,
+            {"b": b, "h": h, "t": t, "d": d})
+    assert err <= tol, f"max_abs_err {err} > {tol}"
+
+    def floss(q, k, v):
+        o = flash.flash_attention(q, k, v, scale=scale, segment_ids=seg)
+        return jnp.sum(jnp.sin(o[0, :, :440])) + jnp.sum(jnp.sin(o[1]))
+
+    def oloss(q, k, v):
+        o = flash._xla_ref(q, k, v, scale, False,
+                           bias=flash.segment_mask_bias(seg, seg))
+        return jnp.sum(jnp.sin(o[0, :, :440])) + jnp.sum(jnp.sin(o[1]))
+
+    gf = jax.grad(floss, argnums=(0, 1, 2))(q, k, v)
+    go = jax.grad(oloss, argnums=(0, 1, 2))(q, k, v)
+    gerr = max(float(np.max(np.abs(np.asarray(a) - np.asarray(b_))))
+               for a, b_ in zip(gf, go))
+    gtol = 5e-4
+    _record("bwd_f32_packed_straddle", gerr, gtol,
+            {"b": b, "h": h, "t": t, "d": d})
+    assert gerr <= gtol, f"max grad err {gerr} > {gtol}"
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_segment_skip_tiles_tpu(causal):
+    """Block-ALIGNED disjoint segments (4x128 with block 128) force the
+    segment-tile SKIP branch in the compiled kernels — the packed-row
+    block-sparsity path (commit 0dbe37c) that had only ever run under
+    the CPU interpreter. causal=True composes the causal-AND-overlap
+    guard (the packed-GPT hot path, commit cc7ed0a)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas import flash
+
+    b, h, t, d = 2, 4, 512, 64
+    q, k, v = (_rand((b, h, t, d), s, jnp.float32) for s in (16, 17, 18))
+    seg = jnp.asarray(np.repeat([[1, 2, 3, 4]], b, 0).repeat(128, 1))
+    scale = 1.0 / d ** 0.5
+
+    got = flash.flash_attention(q, k, v, scale=scale, causal=causal,
+                                block_q=128, block_k=128, segment_ids=seg)
+    want = flash._xla_ref(q, k, v, scale, causal,
+                          bias=flash.segment_mask_bias(seg, seg))
+    err = float(np.max(np.abs(np.asarray(got) - np.asarray(want))))
+    tol = 2e-5
+    _record(f"fwd_f32_seg_skip_causal={causal}", err, tol,
+            {"b": b, "h": h, "t": t, "d": d})
+    assert err <= tol, f"max_abs_err {err} > {tol}"
+
+    def floss(q, k, v):
+        o = flash.flash_attention(q, k, v, scale=scale, causal=causal,
+                                  block_q=128, block_k=128,
+                                  segment_ids=seg)
+        return jnp.sum(jnp.sin(o))
+
+    def oloss(q, k, v):
+        o = flash._xla_ref(q, k, v, scale, causal,
+                           bias=flash.segment_mask_bias(seg, seg))
+        return jnp.sum(jnp.sin(o))
+
+    gf = jax.grad(floss, argnums=(0, 1, 2))(q, k, v)
+    go = jax.grad(oloss, argnums=(0, 1, 2))(q, k, v)
+    gerr = max(float(np.max(np.abs(np.asarray(a) - np.asarray(b_))))
+               for a, b_ in zip(gf, go))
+    gtol = 5e-4
+    _record(f"bwd_f32_seg_skip_causal={causal}", gerr, gtol,
+            {"b": b, "h": h, "t": t, "d": d})
+    assert gerr <= gtol, f"max grad err {gerr} > {gtol}"
+
+
+def test_flash_causal_no_visible_keys_tpu():
+    """Zero-visible-row semantics (commit a4f6691) on hardware: causal
+    q_len > kv_len leaves rows with NO visible key; the compiled pruned
+    kernel must output exactly 0 there and match the oracle on rows
+    that do have visible keys."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas import flash
+
+    b, h, tq, tk, d = 1, 4, 256, 128, 64
+    q = _rand((b, h, tq, d), 20, jnp.float32)
+    k = _rand((b, h, tk, d), 21, jnp.float32)
+    v = _rand((b, h, tk, d), 22, jnp.float32)
+    scale = 1.0 / d ** 0.5
+    got = np.asarray(flash.flash_attention(q, k, v, scale=scale,
+                                           causal=True))
+    dead = tq - tk
+    zero_err = float(np.max(np.abs(got[:, :, :dead])))
+    want = np.asarray(flash._xla_ref(q, k, v, scale, True))
+    live_err = float(np.max(np.abs(got[:, :, dead:] - want[:, :, dead:])))
+    tol = 2e-5
+    _record("fwd_f32_zero_visible_rows", max(zero_err, live_err), tol,
+            {"b": b, "h": h, "tq": tq, "tk": tk, "d": d,
+             "dead_rows": dead})
+    assert zero_err == 0.0, f"dead rows not exactly zero: {zero_err}"
+    assert live_err <= tol, f"live-row err {live_err} > {tol}"
+
+
 def test_flash_actually_compiled_not_interpreted():
     """On a real TPU the kernel must take the compiled Mosaic path, not
     the interpreter fallback — otherwise the perf story is fiction."""

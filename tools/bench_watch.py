@@ -230,8 +230,11 @@ def run_suite():
     else:
         if not _tunnel_still_ok("tpu_tier"):
             return False
+        # sweep past batch 16: the roofline projection
+        # (perf/roofline_ernie.json) shows arithmetic intensity rising
+        # with batch; the HBM pre-flight prunes what can't fit
         run_step("ernie_full", [py, bench],
-                 env={"BENCH_BATCHES": "8,16,32", "BENCH_STEPS": "30",
+                 env={"BENCH_BATCHES": "8,16,32,64", "BENCH_STEPS": "30",
                       "BENCH_HARD_TIMEOUT": "2100"},
                  timeout_s=2700, stdout_path="bench_ernie_full.json")
     return True
