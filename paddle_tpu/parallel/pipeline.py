@@ -82,6 +82,62 @@ def pipeline_apply(stage_fn, params_stacked, x, mesh, microbatches,
     return ym.reshape((b,) + ym.shape[2:])
 
 
+def bubble_fraction(microbatches, stages):
+    """Analytic 1F1B bubble fraction of THIS scheduler: the scan runs
+    2M + 2S - 2 ticks of which each stage does useful work in 2M, so
+    (2S - 2) / (2M + 2S - 2) of the step is ramp-up/drain bubble.
+    The hardware tuning knob is M (more microbatches amortize the
+    bubble; in-flight activations stay S-bounded regardless — see
+    tests/perf/test_pipeline_schedule.py). Matches the classic
+    (S - 1) / (M + S - 1) 1F1B figure."""
+    m, s = int(microbatches), int(stages)
+    if m <= 0 or s <= 0:
+        raise ValueError(f"need positive M, S; got M={m}, S={s}")
+    return (2 * s - 2) / (2 * m + 2 * s - 2)
+
+
+def schedule_stats(stage_fn, loss_fn, stacked_params, x_microbatches, aux,
+                   mesh, axis_name="pp"):
+    """Introspect the 1F1B schedule WITHOUT running it: trace
+    pipeline_1f1b to a jaxpr, find the schedule scan, and report
+    {"ticks", "carry_bytes", "bubble_fraction"}. The tuning/debugging
+    companion to bubble_fraction() — carry_bytes is the per-stage
+    in-flight state (S-bounded; independent of the microbatch count),
+    ticks the scan length. Used by tests/perf/test_pipeline_schedule.py
+    and the cross-process worker to pin the schedule shape."""
+    import jax as _jax
+
+    jaxpr = _jax.make_jaxpr(lambda w: pipeline_1f1b(
+        stage_fn, loss_fn, w, x_microbatches, aux, mesh,
+        axis_name=axis_name))(stacked_params)
+    scans = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "scan":
+                scans.append(eqn)
+            for v in eqn.params.values():
+                for item in (v if isinstance(v, (list, tuple)) else [v]):
+                    # params hold ClosedJaxpr (.jaxpr) or raw Jaxpr (.eqns)
+                    if hasattr(item, "jaxpr"):
+                        walk(item.jaxpr)
+                    elif hasattr(item, "eqns"):
+                        walk(item)
+
+    walk(jaxpr.jaxpr)
+    if not scans:
+        raise AssertionError("1F1B no longer lowers to a lax.scan "
+                             "schedule")
+    eqn = max(scans, key=lambda e: int(e.params["length"]))
+    nc, nconst = eqn.params["num_carry"], eqn.params["num_consts"]
+    carry = eqn.invars[nconst:nconst + nc]
+    nbytes = sum(int(v.aval.size) * v.aval.dtype.itemsize for v in carry)
+    ticks = int(eqn.params["length"])
+    m = x_microbatches.shape[0]
+    return {"ticks": ticks, "carry_bytes": nbytes,
+            "bubble_fraction": (ticks - 2 * m) / ticks}
+
+
 def pipeline_1f1b(stage_fn, loss_fn, stacked_params, x_microbatches, aux,
                   mesh, axis_name="pp"):
     """1F1B interleaved pipeline training step (homogeneous stages).

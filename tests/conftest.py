@@ -33,6 +33,56 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
 
+# ---------------------------------------------------------------------------
+# Suite tiering (VERDICT r4 #6): tests whose RECORDED duration exceeds
+# the threshold are auto-marked `slow`, so the inner loop runs
+# `pytest tests/ -m "not slow"` in minutes while plain `pytest tests/`
+# (CI/judging) still runs everything. The record is committed at
+# tests/.durations.json; regenerate after big suite changes with
+#   PT_WRITE_DURATIONS=1 python -m pytest tests/ -q
+# Unrecorded (new) tests default to the fast tier.
+# ---------------------------------------------------------------------------
+
+_DURATIONS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               ".durations.json")
+_SLOW_THRESHOLD_S = float(os.environ.get("PT_SLOW_THRESHOLD_S", 3.0))
+_observed_durations = {}
+
+
+def pytest_collection_modifyitems(config, items):
+    import json
+    try:
+        with open(_DURATIONS_PATH) as f:
+            recorded = json.load(f)
+    except (OSError, ValueError):
+        return
+    slow = pytest.mark.slow
+    for item in items:
+        if recorded.get(item.nodeid, 0.0) >= _SLOW_THRESHOLD_S:
+            item.add_marker(slow)
+
+
+def pytest_runtest_logreport(report):
+    if report.when == "call" and os.environ.get("PT_WRITE_DURATIONS"):
+        _observed_durations[report.nodeid] = round(report.duration, 3)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not (os.environ.get("PT_WRITE_DURATIONS") and _observed_durations):
+        return
+    import json
+    # deselected runs (-k/-m/path args) would drop every other test's
+    # record; merge instead of overwrite
+    try:
+        with open(_DURATIONS_PATH) as f:
+            merged = json.load(f)
+    except (OSError, ValueError):
+        merged = {}
+    merged.update(_observed_durations)
+    with open(_DURATIONS_PATH, "w") as f:
+        json.dump(dict(sorted(merged.items())), f, indent=0)
+
+
 @pytest.fixture(autouse=True)
 def _fresh_programs():
     """Each test gets fresh default programs + scope (fluid tests reset

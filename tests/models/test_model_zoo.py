@@ -23,7 +23,9 @@ def _train(feed_fn, loss_var, steps=8, lr=0.01, fetch_extra=(),
     losses = []
     for i in range(steps):
         out = exe.run(feed=feed_fn(i), fetch_list=[loss_var, *fetch_extra])
-        losses.append(float(out[0]))
+        arr = np.asarray(out[0])
+        assert arr.size == 1, f"loss fetch must be scalar-sized, got {arr.shape}"
+        losses.append(float(arr.reshape(())))
     return losses
 
 

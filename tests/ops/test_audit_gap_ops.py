@@ -191,7 +191,9 @@ def test_positive_negative_pair():
                        "q": qid.reshape(-1, 1)})
     # q0: label pairs (0,1),(0,2) -> scores agree both; q1: (3,4) label
     # says 4>3 but score says 3>4 -> negative
-    assert float(gp) == 2.0 and float(gn) == 1.0 and float(gu) == 0.0
+    assert all(np.asarray(v).size == 1 for v in (gp, gn, gu))
+    gp, gn, gu = (float(np.asarray(v).reshape(())) for v in (gp, gn, gu))
+    assert gp == 2.0 and gn == 1.0 and gu == 0.0
 
 
 def test_fake_dequantize_max_abs():

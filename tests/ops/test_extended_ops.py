@@ -45,7 +45,9 @@ def test_while_loop():
         body=lambda i, s: [i + 1.0, s + i],
         loop_vars=[i, s])
     got_i, got_s = _run([iv, sv], {})
-    assert float(got_i) == 5.0 and float(got_s) == 10.0
+    assert all(np.asarray(v).size == 1 for v in (got_i, got_s))
+    got_i, got_s = (float(np.asarray(v).reshape(())) for v in (got_i, got_s))
+    assert got_i == 5.0 and got_s == 10.0
 
 
 def test_edit_distance():

@@ -77,6 +77,24 @@ def main():
     np.testing.assert_allclose(got_grads, np.asarray(ref_grads),
                                rtol=1e-4, atol=1e-5)
 
+    # Schedule bounds THROUGH the real cross-process mesh (VERDICT r4
+    # #7): at pp=4 the bubble fraction must match the analytic figure
+    # at the M=8/M=16 hardware operating points, and the scan carry
+    # (in-flight state) must be IDENTICAL across M — S-bounded, so
+    # tuning M on hardware costs zero extra HBM.
+    carries = {}
+    for m in (8, 16):
+        stats = pp_mod.schedule_stats(
+            stage_fn, loss_fn, ws, jnp.zeros((m, mb, d)),
+            jnp.zeros((m, mb, d)), mesh)
+        assert stats["bubble_fraction"] == pp_mod.bubble_fraction(m, S), \
+            (m, stats)
+        carries[m] = stats["carry_bytes"]
+    assert carries[8] == carries[16], (
+        f"in-flight state grew with M on the cross-process mesh: "
+        f"{carries}")
+    assert pp_mod.bubble_fraction(16, S) < pp_mod.bubble_fraction(8, S)
+
     flt.barrier_worker()
     print(f"MH_PP_OK rank={rank} loss={got_loss:.6f}")
 
