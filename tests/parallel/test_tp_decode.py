@@ -1,0 +1,104 @@
+"""Tensor-parallel KV-cache decode (models/gpt.py
+make_tp_greedy_decoder): the Megatron serving layout — heads and ffn
+hidden sharded over tp, KV cache sharded over heads — must reproduce
+the single-chip decoder exactly, and the compiled step must contain
+the tp collectives (one all-reduce family per block pair), proving
+GSPMD partitioned the decode instead of replicating it.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import paddle_tpu as fluid
+from paddle_tpu.core import framework
+from paddle_tpu.core.executor import Scope, scope_guard
+from paddle_tpu.models import gpt
+
+
+def _trained_tiny_params():
+    """Build + briefly train the tiny GPT so greedy argmax is decisive
+    (an untrained model's near-tied logits could flip under tp's
+    different reduction order)."""
+    cfg = gpt.gpt_tiny()
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        tokens, loss, _ = gpt.build_lm_net(cfg, seq_len=16)
+        fluid.optimizer.AdamOptimizer(learning_rate=1e-2).minimize(loss)
+    scope = Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    rng = np.random.default_rng(0)
+    seq = rng.integers(3, cfg.vocab_size, (4, 16)).astype(np.int32)
+    with scope_guard(scope):
+        exe.run(startup)
+        for _ in range(30):
+            exe.run(main, feed={"tokens": seq}, fetch_list=[loss])
+        params = gpt.load_params(scope, cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def trained():
+    return _trained_tiny_params()
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_tp_decode_matches_single_chip(trained, tp):
+    cfg, params = trained
+    max_len = 24
+    bos = jnp.asarray(np.array([5, 9, 17], np.int32))
+
+    ref_ids, ref_scores = gpt.make_greedy_decoder(params, cfg,
+                                                  max_len)(bos)
+    mesh = Mesh(np.array(jax.devices()[:tp]), ("tp",))
+    tp_decode = gpt.make_tp_greedy_decoder(params, cfg, mesh, max_len)
+    got_ids, got_scores = tp_decode(bos)
+
+    np.testing.assert_array_equal(np.asarray(got_ids),
+                                  np.asarray(ref_ids))
+    np.testing.assert_allclose(np.asarray(got_scores),
+                               np.asarray(ref_scores), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_tp_decode_emits_collectives(trained):
+    """The partitioned step must communicate (all-reduce after o-proj /
+    ffn-down). A compiled text without collectives means GSPMD
+    replicated the whole decode and the 'tp serving' story is fiction."""
+    cfg, params = trained
+    mesh = Mesh(np.array(jax.devices()[:4]), ("tp",))
+    decode = gpt.make_tp_greedy_decoder(params, cfg, mesh, 16)
+    bos = jnp.asarray(np.array([5], np.int32))
+    text = decode.lower(bos).compile().as_text()
+    assert "all-reduce" in text or "all_reduce" in text, \
+        "tp decode compiled without any all-reduce"
+
+
+def test_tp_decode_cache_is_head_sharded(trained):
+    """White-box: the KV cache inside the compiled module must be
+    sharded over heads (the bandwidth win), not replicated — check the
+    sharding annotation on the cache-shaped tensors."""
+    cfg, params = trained
+    tp = 4
+    mesh = Mesh(np.array(jax.devices()[:tp]), ("tp",))
+    max_len = 16
+    decode = gpt.make_tp_greedy_decoder(params, cfg, mesh, max_len)
+    bos = jnp.asarray(np.array([2, 3], np.int32))
+    ids, _ = decode(bos)
+    assert ids.shape == (2, max_len)
+    # the compiled text's cache tensors: (B, H/tp, L, D) per shard
+    text = decode.lower(bos).compile().as_text()
+    b, h, d = 2, cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    sharded_cache = f"f32[{b},{h // tp},{max_len},{d}]"
+    assert sharded_cache in text, \
+        f"no head-sharded cache tensor {sharded_cache} in compiled step"
+
+
+def test_tp_validates_divisibility(trained):
+    cfg, params = trained
+    mesh = Mesh(np.array(jax.devices()[:3]), ("tp",))
+    with pytest.raises(ValueError, match="must divide"):
+        gpt.make_tp_greedy_decoder(params, cfg, mesh, 16)
