@@ -26,6 +26,8 @@ class AnalysisConfig:
         self.use_bf16 = False
         self.fixed_batch_sizes = ()   # pad-to-bucket batch sizes
         self.donate_inputs = False
+        self.mesh = None              # tensor-parallel serving mesh
+        self.shard_rules = None
 
     def enable_bf16(self):
         self.use_bf16 = True
@@ -33,6 +35,16 @@ class AnalysisConfig:
 
     def set_batch_buckets(self, sizes):
         self.fixed_batch_sizes = tuple(sorted(sizes))
+        return self
+
+    def enable_tensor_parallel(self, mesh, rules=None):
+        """Serve the model sharded over `mesh`'s tp axis: params get
+        dist_attr annotations (parallel/tensor_parallel.ShardRules —
+        pass `rules` to customize) and the forward runs as one GSPMD-
+        partitioned executable, XLA inserting the tp collectives. The
+        multi-chip analogue of the reference's multi-stream serving."""
+        self.mesh = mesh
+        self.shard_rules = rules
         return self
 
 
@@ -57,6 +69,33 @@ class Predictor:
                 self.fetch_names = [v.name for v in fetch_vars]
         if config.use_bf16:
             self._cast_params_bf16()
+        # tensor-parallel serving: annotate params + attach the mesh so
+        # the Executor's pjit path shards state and partitions the step.
+        # Annotate every persistable VAR (not Parameter objects): the
+        # reference-__model__ protobuf branch above rebuilds weights as
+        # plain Variables, which program.all_parameters() misses — that
+        # branch would otherwise serve silently replicated.
+        self._run_prog = self.program
+        if config.mesh is not None:
+            from ..core.compiler import CompiledProgram
+            from ..parallel.tensor_parallel import ShardRules
+            rules = config.shard_rules or ShardRules()
+            annotated = 0
+            for v in self.program.list_vars():
+                if v.persistable and v.name not in ("feed", "fetch"):
+                    v.dist_attr = rules.spec_for(v.name, v.shape)
+                    if tuple(v.dist_attr or ()):
+                        annotated += 1
+            if annotated == 0:
+                import warnings
+                warnings.warn(
+                    "enable_tensor_parallel: no parameter matched the "
+                    "shard rules (param naming may not follow the "
+                    "attn_*/ffn* conventions) — serving will run "
+                    "REPLICATED; pass AnalysisConfig.shard_rules with "
+                    "patterns for this model's names")
+            self._run_prog = CompiledProgram(self.program).with_mesh(
+                config.mesh)
 
     def _cast_params_bf16(self):
         # Param tensors move to bf16; XLA keeps matmuls on the MXU in bf16.
@@ -76,7 +115,7 @@ class Predictor:
                          if np.asarray(v).dtype.kind == "f" else v)
                      for k, v in feeds.items()}
         with scope_guard(self.scope):
-            return self._exe.run(self.program, feed=feeds,
+            return self._exe.run(self._run_prog, feed=feeds,
                                  fetch_list=self.fetch_names)
 
     __call__ = run

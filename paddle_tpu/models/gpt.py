@@ -283,14 +283,15 @@ def gpt_tp_shardings(cfg, mesh, axis="tp"):
     return tree
 
 
-def make_tp_greedy_decoder(params, cfg, mesh, max_len, eos_id=None,
-                           dtype=None, axis="tp"):
-    """Tensor-parallel KV-cache greedy decoder: same contract as
-    make_greedy_decoder but sharded over the mesh's `axis` — params in
-    the Megatron layout (gpt_tp_shardings), the KV cache sharded over
-    HEADS, so per-chip cache bandwidth (the decode bottleneck) drops by
-    the tp degree. Tokens are bitwise-checked against the single-chip
-    decoder in tests/parallel/test_tp_decode.py.
+def make_tp_decoder(params, cfg, mesh, max_len, eos_id=None, dtype=None,
+                    axis="tp", beam_size=None, length_penalty=0.6):
+    """Tensor-parallel KV-cache decoder (greedy, or beam search with
+    `beam_size`): same contracts as make_greedy_decoder / beam_decode
+    but sharded over the mesh's `axis` — params in the Megatron layout
+    (gpt_tp_shardings), the KV cache sharded over HEADS, so per-chip
+    cache bandwidth (the decode bottleneck) drops by the tp degree.
+    Outputs are checked against the single-chip decoders in
+    tests/parallel/test_tp_decode.py.
 
     The tp degree must divide cfg.num_heads and the ffn inner dim."""
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -309,20 +310,36 @@ def make_tp_greedy_decoder(params, cfg, mesh, max_len, eos_id=None,
     step = build_kv_step(params, cfg, max_len)
     cache_ns = NamedSharding(mesh, P(None, axis, None, None))
 
-    def decode(bos_ids):
+    def _sharded_cache(rows):
         from ..inference import decoding as dec
-        cache = dec.init_kv_cache(bos_ids.shape[0], cfg.num_layers,
-                                  cfg.num_heads, max_len, d,
-                                  dtype=dtype or jnp.float32)
+        cache = dec.init_kv_cache(rows, cfg.num_layers, cfg.num_heads,
+                                  max_len, d, dtype=dtype or jnp.float32)
         # pin the head-sharded cache layout; everything else propagates
-        cache = jax.tree_util.tree_map(
+        return jax.tree_util.tree_map(
             lambda a: jax.lax.with_sharding_constraint(a, cache_ns),
             cache)
-        return dec.greedy_decode(step, cache, bos_ids, max_len,
-                                 eos_id=eos_id)
+
+    def decode(bos_ids):
+        from ..inference import decoding as dec
+        if beam_size is None:
+            return dec.greedy_decode(step, _sharded_cache(
+                bos_ids.shape[0]), bos_ids, max_len, eos_id=eos_id)
+        # beam lanes ride the (replicated) batch dim: (B*K) rows
+        return dec.beam_decode(
+            step, _sharded_cache(bos_ids.shape[0] * beam_size), bos_ids,
+            max_len, beam_size,
+            eos_id if eos_id is not None else -1,
+            length_penalty=length_penalty)
 
     rep = NamedSharding(mesh, P())
     return jax.jit(decode, in_shardings=rep, out_shardings=(rep, rep))
+
+
+def make_tp_greedy_decoder(params, cfg, mesh, max_len, eos_id=None,
+                           dtype=None, axis="tp"):
+    """Greedy-only alias of make_tp_decoder (the benched serving path)."""
+    return make_tp_decoder(params, cfg, mesh, max_len, eos_id=eos_id,
+                           dtype=dtype, axis=axis)
 
 
 def generate(scope, cfg, bos_ids, max_len, eos_id=None, beam_size=None,

@@ -97,6 +97,32 @@ def test_tp_decode_cache_is_head_sharded(trained):
         f"no head-sharded cache tensor {sharded_cache} in compiled step"
 
 
+def test_tp_beam_decode_matches_single_chip(trained):
+    """Beam search through the same tp shardings: sequences AND scores
+    must match the single-chip beam decoder (beam lanes ride the
+    replicated batch dim; the cache stays head-sharded)."""
+    from paddle_tpu.inference import decoding as dec
+
+    cfg, params = trained
+    max_len, K = 12, 3
+    bos = jnp.asarray(np.array([5, 9], np.int32))
+
+    step = gpt.build_kv_step(params, cfg, max_len)
+    d = cfg.hidden_size // cfg.num_heads
+    cache = dec.init_kv_cache(2 * K, cfg.num_layers, cfg.num_heads,
+                              max_len, d)
+    ref_ids, ref_scores = dec.beam_decode(step, cache, bos, max_len, K,
+                                          eos_id=-1)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("tp",))
+    tp_ids, tp_scores = gpt.make_tp_decoder(params, cfg, mesh, max_len,
+                                            beam_size=K)(bos)
+    np.testing.assert_array_equal(np.asarray(tp_ids),
+                                  np.asarray(ref_ids))
+    np.testing.assert_allclose(np.asarray(tp_scores),
+                               np.asarray(ref_scores), rtol=2e-5,
+                               atol=2e-5)
+
+
 def test_tp_validates_divisibility(trained):
     cfg, params = trained
     mesh = Mesh(np.array(jax.devices()[:3]), ("tp",))

@@ -1,0 +1,135 @@
+"""Tensor-parallel SERVING through the standard Predictor API
+(inference/predictor.py AnalysisConfig.enable_tensor_parallel):
+save_inference_model -> create_predictor on a tp mesh must reproduce
+the single-device forward, run as ONE partitioned executable (tp
+collectives present), and keep the served params sharded in the scope.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+
+import paddle_tpu as fluid
+from paddle_tpu import inference, layers
+from paddle_tpu.core import framework
+from paddle_tpu.models import bert
+from paddle_tpu.parallel.mesh import make_mesh
+
+
+def _save_bert_classifier(tmp_path):
+    cfg = bert.bert_tiny()
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        feeds, _loss, _acc, probs = bert.build_classifier_net(
+            cfg, seq_len=32, num_labels=3)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    full = bert.make_pretrain_feed(cfg, 32, 4)
+    # the inference inputs: what the classifier FORWARD reads (label
+    # only feeds the loss/acc heads, pruned at save time)
+    infer_names = ["input_mask", "sent_ids", "src_ids"]
+    infer_feed = {k: full[k] for k in infer_names}
+    ref_feed = dict(infer_feed,
+                    label=np.zeros((4, 1), np.int64))
+    test_prog = main.clone(for_test=True)   # dropout off, like serving
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(
+            str(tmp_path / "m"), infer_names, [probs], exe,
+            main_program=main)
+        ref_out = np.asarray(exe.run(test_prog, feed=ref_feed,
+                                     fetch_list=[probs])[0])
+    return str(tmp_path / "m"), infer_feed, ref_out
+
+
+def test_tp_predictor_matches_single_device(tmp_path):
+    model_dir, feed, ref_out = _save_bert_classifier(tmp_path)
+    mesh = make_mesh(tp=2, devices=jax.devices()[:2])
+    cfg = inference.AnalysisConfig(model_dir).enable_tensor_parallel(mesh)
+    predictor = inference.create_predictor(cfg)
+    out = predictor.run(feed)
+    np.testing.assert_allclose(np.asarray(out[0]), ref_out,
+                               rtol=2e-5, atol=2e-6)
+    # serve twice: state stays sharded, results stable
+    out2 = predictor.run(feed)
+    np.testing.assert_allclose(np.asarray(out2[0]), np.asarray(out[0]),
+                               rtol=0, atol=0)
+
+
+def test_tp_predictor_state_is_sharded_and_step_communicates(tmp_path):
+    model_dir, feed, ref_out = _save_bert_classifier(tmp_path)
+    mesh = make_mesh(tp=2, devices=jax.devices()[:2])
+    cfg = inference.AnalysisConfig(model_dir).enable_tensor_parallel(mesh)
+    predictor = inference.create_predictor(cfg)
+    predictor.run(feed)
+    # a column-parallel ffn weight must live sharded over tp in the
+    # serving scope (half the weight per chip — the memory win)
+    sharded = 0
+    for name in predictor.scope.names():
+        val = predictor.scope.get(name)
+        sh = getattr(val, "sharding", None)
+        if isinstance(sh, NamedSharding) and "tp" in str(sh.spec):
+            sharded += 1
+    assert sharded >= 4, f"only {sharded} tp-sharded params in scope"
+    # and the compiled forward must contain the tp collectives
+    text = predictor._exe.last_compiled_text()
+    assert "all-reduce" in text or "all_reduce" in text, \
+        "tp predictor compiled without any all-reduce"
+
+
+def test_tp_predictor_serves_fluid_protobuf_export(tmp_path):
+    """The reference-__model__ branch: weights rebuilt as plain
+    Variables (no Parameter objects) must STILL shard — a regression
+    here serves silently replicated (r5 review finding)."""
+    import warnings as _warnings
+    cfg = bert.bert_tiny()
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        feeds, _loss, _acc, probs = bert.build_classifier_net(
+            cfg, seq_len=32, num_labels=3)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    full = bert.make_pretrain_feed(cfg, 32, 4)
+    infer_names = ["input_mask", "sent_ids", "src_ids"]
+    infer_feed = {k: full[k] for k in infer_names}
+    test_prog = main.clone(for_test=True)
+    from paddle_tpu.io.fluid_proto import save_fluid_inference_model
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        save_fluid_inference_model(
+            str(tmp_path / "ref"), infer_names, [probs], exe,
+            main_program=main)
+        ref_out = np.asarray(exe.run(
+            test_prog, feed=dict(infer_feed,
+                                 label=np.zeros((4, 1), np.int64)),
+            fetch_list=[probs])[0])
+    mesh = make_mesh(tp=2, devices=jax.devices()[:2])
+    cfg2 = inference.AnalysisConfig(
+        str(tmp_path / "ref")).enable_tensor_parallel(mesh)
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")      # no 'serving REPLICATED'
+        predictor = inference.create_predictor(cfg2)
+    out = predictor.run(infer_feed)
+    np.testing.assert_allclose(np.asarray(out[0]), ref_out,
+                               rtol=2e-5, atol=2e-6)
+    # the protobuf-loaded weights must actually be sharded in scope
+    sharded = sum(
+        1 for name in predictor.scope.names()
+        if isinstance(getattr(predictor.scope.get(name), "sharding",
+                              None), NamedSharding)
+        and "tp" in str(predictor.scope.get(name).sharding.spec))
+    assert sharded >= 4, f"only {sharded} tp-sharded vars (protobuf path)"
+
+
+def test_tp_predictor_composes_with_bf16(tmp_path):
+    model_dir, feed, ref_out = _save_bert_classifier(tmp_path)
+    mesh = make_mesh(tp=2, devices=jax.devices()[:2])
+    cfg = (inference.AnalysisConfig(model_dir)
+           .enable_bf16().enable_tensor_parallel(mesh))
+    predictor = inference.create_predictor(cfg)
+    out = predictor.run(feed)
+    # bf16 params: looser tolerance, same answer
+    np.testing.assert_allclose(np.asarray(out[0]), ref_out,
+                               rtol=3e-2, atol=3e-2)
