@@ -26,8 +26,11 @@ Interpretation notes (also embedded in the JSON):
   archived HLO for excessive transposes), (4) flash kernel not engaged
   (bench.py prints flash_engaged).
 
-Usage: JAX_PLATFORMS=cpu python tools/roofline.py [--batches 8,16,32]
-Writes perf/roofline_ernie.json.
+Usage: JAX_PLATFORMS=cpu python tools/roofline.py [--model ernie]
+       [--batches 8,16,32]
+Writes perf/roofline_<model>.json. Committed projections: ernie (the
+headline; bert shares its graph — ernie's artifact covers both),
+packed, gpt, transformer, resnet, deepfm.
 """
 
 import argparse
@@ -45,43 +48,42 @@ V5E_PEAK_FLOPS = 197e12
 V5E_HBM_BYTES_PER_S = 819e9
 
 
-def measure(batch, seq_len=512):
-    """Build + compile + run ONE ERNIE-base train step at this batch on
-    the cpu backend; return XLA cost-model facts."""
-    import numpy as np
-    import paddle_tpu as fluid
-    from paddle_tpu import amp
-    from paddle_tpu.core import framework
-    from paddle_tpu.core.executor import Scope, scope_guard
-    from paddle_tpu.models import bert, ernie
-    from paddle_tpu.utils import model_stat
+def measure(batch, seq_len=512, model="ernie"):
+    """Build + compile + run ONE train step of any bench config
+    (BENCH_MODEL: ernie|bert|packed|gpt|transformer|resnet|deepfm) at
+    this batch on the cpu backend, through bench.py's OWN builders —
+    the projection describes exactly the step the hardware bench times.
+    Returns XLA cost-model facts."""
+    import jax
 
-    cfg = bert.BertConfig(max_position_embeddings=seq_len)
-    main, startup = framework.Program(), framework.Program()
-    with framework.program_guard(main, startup):
-        _feeds, total_loss, _mlm, _acc = bert.build_pretrain_net(
-            cfg, seq_len=seq_len)
-        fluid.optimizer.AdamOptimizer(learning_rate=1e-4).minimize(
-            total_loss)
-    fwd_flops, _ = model_stat.count_flops(main, batch_size=batch)
-    amp.cast_model_to_bf16(main)
-    scope = Scope()
-    exe = fluid.Executor(fluid.TPUPlace(0))
-    with scope_guard(scope):
-        exe.run(startup)
-        feed = ernie.make_pretrain_feed(cfg, seq_len, batch,
-                                        dtype=np.int32)
+    import bench
+    prev = os.environ.get("BENCH_MODEL")
+    os.environ["BENCH_MODEL"] = model
+    try:
         t0 = time.time()
-        exe.run(main, feed=feed, fetch_list=[total_loss],
-                return_numpy=False)
+        step, units_per_step, analytic_flops = bench.build_step(batch,
+                                                                seq_len)
+        build_s = time.time() - t0
+        t0 = time.time()
+        jax.block_until_ready(step())
         compile_s = time.time() - t0
-    ca = exe.last_cost_analysis()
+    finally:
+        # measure() is imported by the test suite: never leak the model
+        # selection into the caller's environment
+        if prev is None:
+            os.environ.pop("BENCH_MODEL", None)
+        else:
+            os.environ["BENCH_MODEL"] = prev
+    ca = step.executor.last_cost_analysis()
     return {
+        "model": model,
         "batch": batch,
-        "seq_len": seq_len,
+        "seq_len": bench.RUN_INFO.get("seq_len", seq_len),
+        "units_per_step": units_per_step,
         "xla_flops_per_step": float(ca.get("flops", 0.0)),
         "xla_bytes_per_step": float(ca.get("bytes accessed", 0.0)),
-        "analytic_train_flops": 3.0 * fwd_flops,
+        "analytic_train_flops": float(analytic_flops),
+        "cpu_build_s": round(build_s, 1),
         "cpu_compile_plus_step_s": round(compile_s, 1),
     }
 
@@ -106,10 +108,11 @@ def project(m, peak=V5E_PEAK_FLOPS, bw=V5E_HBM_BYTES_PER_S):
         "projected_step_s_bf16_bytes": round(step_bf16, 5),
         "mfu_lower_bound": round(flops / peak / step_lower, 4),
         "mfu_bf16_bytes": round(flops / peak / step_bf16, 4),
-        "tokens_per_sec_lower_bound": round(
-            m["batch"] * m["seq_len"] / step_lower, 1),
-        "tokens_per_sec_bf16_bytes": round(
-            m["batch"] * m["seq_len"] / step_bf16, 1),
+        # tokens (or images/examples, per the model's unit) per second
+        "units_per_sec_lower_bound": round(
+            m["units_per_step"] / step_lower, 1),
+        "units_per_sec_bf16_bytes": round(
+            m["units_per_step"] / step_bf16, 1),
         "flops_ratio_analytic_over_xla": round(
             m["analytic_train_flops"] / flops, 3) if flops else None,
     }
@@ -128,11 +131,19 @@ SUSPECTS = [
 
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="ernie",
+                    choices=["ernie", "bert", "packed", "gpt",
+                             "transformer", "resnet", "deepfm"],
+                    help="bench.py TRAIN configs only (gpt_decode has "
+                         "no cost-analysis hook and decode is "
+                         "bandwidth-bound by design)")
     ap.add_argument("--batches", default="8,16,32")
     ap.add_argument("--seq", type=int, default=512)
-    ap.add_argument("--out", default=os.path.join(REPO, "perf",
-                                                  "roofline_ernie.json"))
+    ap.add_argument("--out", default=None,
+                    help="default: perf/roofline_<model>.json")
     args = ap.parse_args()
+    out_path = args.out or os.path.join(REPO, "perf",
+                                        f"roofline_{args.model}.json")
 
     import jax
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
@@ -144,7 +155,7 @@ def main():
 
     rows = []
     for b in (int(x) for x in args.batches.split(",")):
-        r = project(measure(b, args.seq))
+        r = project(measure(b, args.seq, args.model))
         rows.append(r)
         print(f"batch={r['batch']}: AI={r['arithmetic_intensity']} "
               f"flops/byte (ridge {r['ridge_point']}), projected MFU "
@@ -153,7 +164,7 @@ def main():
               f"{r['projected_step_s_lower_bound']}s]", flush=True)
 
     out = {
-        "model": "ernie_base_pretrain",
+        "model": args.model,
         "chip": "v5e (197 bf16 TFLOP/s, 819 GB/s HBM)",
         "notes": "bytes from the CPU executable are an UPPER bound on "
                  "TPU HBM traffic (f32 legalization + weaker fusion): "
@@ -163,10 +174,10 @@ def main():
         "suspect_ranking": SUSPECTS,
         "sweep": rows,
     }
-    os.makedirs(os.path.dirname(args.out), exist_ok=True)
-    with open(args.out, "w") as f:
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
         json.dump(out, f, indent=1)
-    print(f"wrote {args.out}")
+    print(f"wrote {out_path}")
     return 0
 
 
