@@ -193,6 +193,8 @@ def save_train_step(dirname, program, feed_names, fetch_names,
     schedules) match exe.run()."""
     from ..core.executor import Executor, global_scope
 
+    from ..core import framework as _framework
+
     scope = scope or global_scope()
     fetch_names = [v.name if hasattr(v, "name") else v
                    for v in fetch_names]
@@ -223,7 +225,6 @@ def save_train_step(dirname, program, feed_names, fetch_names,
         f.write(exp.serialize())
     np.savez(os.path.join(dirname, "train_state.npz"),
              **{k: np.asarray(v) for k, v in state.items()})
-    from ..core import framework as _framework
     meta = {
         "feed_names": list(feed_names),
         "fetch_names": fetch_names,
@@ -256,9 +257,9 @@ class TrainStepArtifact:
         self._seed = int(meta.get("random_seed", 0))
 
     def run(self, feeds):
-        args = {k: jnp.asarray(np.asarray(feeds[k]).astype(
-            self._dtypes.get(k, np.asarray(feeds[k]).dtype)))
-            for k in self.feed_names}
+        arrs = {k: np.asarray(feeds[k]) for k in self.feed_names}
+        args = {k: jnp.asarray(a.astype(self._dtypes.get(k, a.dtype)))
+                for k, a in arrs.items()}
         rng = jnp.asarray([self._seed & 0xFFFFFFFF,
                            self._step & 0xFFFFFFFF], jnp.uint32)
         self.state, fetches = self._exp.call(self.state, args, rng)

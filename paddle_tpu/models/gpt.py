@@ -310,8 +310,9 @@ def make_tp_decoder(params, cfg, mesh, max_len, eos_id=None, dtype=None,
     step = build_kv_step(params, cfg, max_len)
     cache_ns = NamedSharding(mesh, P(None, axis, None, None))
 
+    from ..inference import decoding as dec
+
     def _sharded_cache(rows):
-        from ..inference import decoding as dec
         cache = dec.init_kv_cache(rows, cfg.num_layers, cfg.num_heads,
                                   max_len, d, dtype=dtype or jnp.float32)
         # pin the head-sharded cache layout; everything else propagates
@@ -320,7 +321,6 @@ def make_tp_decoder(params, cfg, mesh, max_len, eos_id=None, dtype=None,
             cache)
 
     def decode(bos_ids):
-        from ..inference import decoding as dec
         if beam_size is None:
             return dec.greedy_decode(step, _sharded_cache(
                 bos_ids.shape[0]), bos_ids, max_len, eos_id=eos_id)

@@ -105,9 +105,7 @@ def schedule_stats(stage_fn, loss_fn, stacked_params, x_microbatches, aux,
     in-flight state (S-bounded; independent of the microbatch count),
     ticks the scan length. Used by tests/perf/test_pipeline_schedule.py
     and the cross-process worker to pin the schedule shape."""
-    import jax as _jax
-
-    jaxpr = _jax.make_jaxpr(lambda w: pipeline_1f1b(
+    jaxpr = jax.make_jaxpr(lambda w: pipeline_1f1b(
         stage_fn, loss_fn, w, x_microbatches, aux, mesh,
         axis_name=axis_name))(stacked_params)
     scans = []
