@@ -284,16 +284,20 @@ def gpt_tp_shardings(cfg, mesh, axis="tp"):
 
 
 def make_tp_decoder(params, cfg, mesh, max_len, eos_id=None, dtype=None,
-                    axis="tp", beam_size=None, length_penalty=0.6):
+                    axis="tp", beam_size=None, length_penalty=0.6,
+                    dp_axis=None):
     """Tensor-parallel KV-cache decoder (greedy, or beam search with
     `beam_size`): same contracts as make_greedy_decoder / beam_decode
     but sharded over the mesh's `axis` — params in the Megatron layout
     (gpt_tp_shardings), the KV cache sharded over HEADS, so per-chip
     cache bandwidth (the decode bottleneck) drops by the tp degree.
-    Outputs are checked against the single-chip decoders in
-    tests/parallel/test_tp_decode.py.
+    With `dp_axis` the BATCH additionally shards over that mesh axis
+    (cache rows and inputs split; outputs gathered back replicated) —
+    the dp x tp throughput-serving layout. Outputs are checked against
+    the single-chip decoders in tests/parallel/test_tp_decode.py.
 
-    The tp degree must divide cfg.num_heads and the ffn inner dim."""
+    The tp degree must divide cfg.num_heads and the ffn inner dim; the
+    dp degree must divide the batch itself (bos_ids rides P(dp_axis))."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     tp = mesh.shape[axis]
@@ -308,23 +312,27 @@ def make_tp_decoder(params, cfg, mesh, max_len, eos_id=None, dtype=None,
             params)
     params = jax.device_put(params, gpt_tp_shardings(cfg, mesh, axis))
     step = build_kv_step(params, cfg, max_len)
-    cache_ns = NamedSharding(mesh, P(None, axis, None, None))
+    cache_ns = NamedSharding(mesh, P(dp_axis, axis, None, None))
 
     from ..inference import decoding as dec
 
     def _sharded_cache(rows):
         cache = dec.init_kv_cache(rows, cfg.num_layers, cfg.num_heads,
                                   max_len, d, dtype=dtype or jnp.float32)
-        # pin the head-sharded cache layout; everything else propagates
+        # pin the (batch-, )head-sharded cache layout; everything else
+        # propagates
         return jax.tree_util.tree_map(
             lambda a: jax.lax.with_sharding_constraint(a, cache_ns),
             cache)
 
+    # dp|batch is validated by pjit itself before tracing: a non-divisible
+    # batch raises "size of its dimension 0 should be divisible by <dp>"
+    # naming the bos_ids argument (asserted in test_tp_validates_divisibility)
     def decode(bos_ids):
         if beam_size is None:
             return dec.greedy_decode(step, _sharded_cache(
                 bos_ids.shape[0]), bos_ids, max_len, eos_id=eos_id)
-        # beam lanes ride the (replicated) batch dim: (B*K) rows
+        # beam lanes ride the batch dim: (B*K) rows
         return dec.beam_decode(
             step, _sharded_cache(bos_ids.shape[0] * beam_size), bos_ids,
             max_len, beam_size,
@@ -332,7 +340,8 @@ def make_tp_decoder(params, cfg, mesh, max_len, eos_id=None, dtype=None,
             length_penalty=length_penalty)
 
     rep = NamedSharding(mesh, P())
-    return jax.jit(decode, in_shardings=rep, out_shardings=(rep, rep))
+    in_ns = rep if dp_axis is None else NamedSharding(mesh, P(dp_axis))
+    return jax.jit(decode, in_shardings=in_ns, out_shardings=(rep, rep))
 
 
 def make_tp_greedy_decoder(params, cfg, mesh, max_len, eos_id=None,

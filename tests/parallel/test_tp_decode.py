@@ -123,8 +123,50 @@ def test_tp_beam_decode_matches_single_chip(trained):
                                atol=2e-5)
 
 
+def test_dp_tp_decode_matches_single_chip(trained):
+    """The throughput-serving layout: batch over dp=2 AND heads over
+    tp=2 on one 4-device mesh — tokens must still match the single-chip
+    decoder exactly, for greedy and beam."""
+    cfg, params = trained
+    max_len = 16
+    bos = jnp.asarray(np.array([5, 9, 17, 23], np.int32))
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
+
+    ref_ids, ref_scores = gpt.make_greedy_decoder(params, cfg,
+                                                  max_len)(bos)
+    got_ids, got_scores = gpt.make_tp_decoder(
+        params, cfg, mesh, max_len, dp_axis="dp")(bos)
+    np.testing.assert_array_equal(np.asarray(got_ids),
+                                  np.asarray(ref_ids))
+    np.testing.assert_allclose(np.asarray(got_scores),
+                               np.asarray(ref_scores), rtol=2e-5,
+                               atol=2e-5)
+
+    from paddle_tpu.inference import decoding as dec
+    K = 2
+    step = gpt.build_kv_step(params, cfg, max_len)
+    d = cfg.hidden_size // cfg.num_heads
+    cache = dec.init_kv_cache(4 * K, cfg.num_layers, cfg.num_heads,
+                              max_len, d)
+    ref_b_ids, ref_b_scores = dec.beam_decode(step, cache, bos, max_len,
+                                              K, eos_id=-1)
+    tp_b_ids, tp_b_scores = gpt.make_tp_decoder(
+        params, cfg, mesh, max_len, beam_size=K, dp_axis="dp")(bos)
+    np.testing.assert_array_equal(np.asarray(tp_b_ids),
+                                  np.asarray(ref_b_ids))
+    np.testing.assert_allclose(np.asarray(tp_b_scores),
+                               np.asarray(ref_b_scores), rtol=2e-5,
+                               atol=2e-5)
+
+
 def test_tp_validates_divisibility(trained):
     cfg, params = trained
     mesh = Mesh(np.array(jax.devices()[:3]), ("tp",))
     with pytest.raises(ValueError, match="must divide"):
         gpt.make_tp_greedy_decoder(params, cfg, mesh, 16)
+    # dp must divide the BATCH: pjit's in_shardings validation raises a
+    # clear pre-trace error naming bos_ids and the divisor
+    mesh2 = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
+    dec2 = gpt.make_tp_decoder(params, cfg, mesh2, 16, dp_axis="dp")
+    with pytest.raises(ValueError, match="divisible by"):
+        dec2(jnp.asarray(np.array([1, 2, 3], np.int32)))
