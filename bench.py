@@ -20,7 +20,8 @@ params/accum fp32.
 
 Env knobs: BENCH_MODEL (ernie [default] | bert | packed — packed-sequence
 MLM, value counts REAL tokens/sec | gpt | gpt_decode — encoders
-share a graph; uniform-random feed | resnet — secondary images/sec metric),
+share a graph; uniform-random feed | gpt_prefill — whole-prompt KV fill,
+MXU-bound serving metric | resnet — secondary images/sec metric),
 BENCH_SEQ_LEN, BENCH_BATCHES (default "8,16" — window-sized; pass
 "8,16,32" for the full sweep), BENCH_STEPS (default 15),
 BENCH_RECOMPUTE (remat policy: dots|nothing|offload),
@@ -387,6 +388,8 @@ def build_step(batch, seq_len):
         return build_gpt_step(batch, seq_len)
     if model == "gpt_decode":
         return build_gpt_decode_step(batch, seq_len)
+    if model == "gpt_prefill":
+        return build_gpt_prefill_step(batch, seq_len)
     # "ernie" (default — BASELINE.json's named headline) and "bert" share
     # the encoder graph; ernie feeds go through the knowledge-masking
     # pipeline (models/ernie.py), bert feeds are uniform random.
@@ -409,6 +412,54 @@ def build_step(batch, seq_len):
                                             dtype=np.int32),
         lambda: fluid.optimizer.AdamOptimizer(learning_rate=1e-4), batch)
     return step, batch * seq_len, flops          # units = tokens
+
+
+def build_gpt_prefill_step(batch, seq_len):
+    """Serving prefill benchmark: whole-prompt KV-cache fill in ONE
+    flash forward (models/gpt.py build_prefill), prompt tokens/sec per
+    chip. Compute-bound (MXU) unlike the bandwidth-bound decode — its
+    MFU is meaningful."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as fluid
+    from paddle_tpu.core import framework
+    from paddle_tpu.core.executor import Scope, scope_guard
+    from paddle_tpu.models import gpt
+
+    tiny = os.environ.get("BENCH_TINY") == "1"
+    cfg = gpt.gpt_tiny() if tiny else gpt.GPTConfig(
+        max_position=max(seq_len, 1024), dropout=0.0)
+    p = min(seq_len, cfg.max_position)
+    RUN_INFO["seq_len"] = p
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        gpt.build_lm_net(cfg, seq_len=8)     # materialize the params
+    scope = Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    with scope_guard(scope):
+        exe.run(startup)
+        params = gpt.load_params(scope, cfg)
+    params = gpt._cast_params(params, jnp.bfloat16)
+    prefill = jax.jit(gpt.build_prefill(params, cfg, p))
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(
+        3, cfg.vocab_size, (batch, p)).astype(np.int32))
+
+    def step():
+        cache, logits = prefill(prompt)
+        return [logits[:, -1].astype(jnp.float32)]
+
+    n_params = sum(int(np.prod(a.shape))
+                   for a in jax.tree_util.tree_leaves(params))
+    d = cfg.hidden_size // cfg.num_heads
+    # fwd-only: dense matmuls (2*N*tokens) + the causal attention term
+    # (qk^T and pv: 4*H*P^2*D MACs/layer, x2 flops, /2 causal)
+    flops = (2.0 * n_params * batch * p
+             + cfg.num_layers * 4.0 * batch * cfg.num_heads * p * p * d
+             / 2.0)
+    return step, batch * p, flops
 
 
 def build_gpt_decode_step(batch, seq_len):
@@ -646,6 +697,15 @@ def _emit(sweep, seq_len, kind, peak):
         unit = "tokens/s/chip"
         rate_key = "tokens_per_sec"
         baseline = None
+    elif model == "gpt_prefill":
+        metric = ("gpt_tiny" if tiny else "gpt_base") \
+            + "_prefill_prompt_tokens_per_sec_per_chip"
+        unit = "tokens/s/chip"
+        rate_key = "tokens_per_sec"
+        baseline = None
+        if not best["flash_engaged"]:
+            print("bench: WARNING — Pallas flash attention did NOT "
+                  "engage on the prefill path", file=sys.stderr)
     else:
         # ernie and bert share the BERT-base-sized graph; name what ran
         arch = "ernie" if model == "ernie" else "bert"

@@ -55,9 +55,14 @@ def cache_attention_bias(max_len, t):
 # Greedy
 # ---------------------------------------------------------------------------
 
-def greedy_decode(step_fn, init_cache, bos_ids, max_len, eos_id=None):
+def greedy_decode(step_fn, init_cache, bos_ids, max_len, eos_id=None,
+                  start_t=0):
     """Returns (ids (B, max_len), scores (B,)). Stops contributing after
-    EOS (lanes keep stepping — static shapes — but emit eos/score 0)."""
+    EOS (lanes keep stepping — static shapes — but emit eos/score 0).
+    `start_t` begins the scan at a later position — the continuation
+    path after a parallel prompt prefill has filled cache[..., :start_t]
+    (models/gpt.py build_prefill / generate_with_prompt); max_len then counts GENERATED
+    steps, not absolute positions."""
     batch = bos_ids.shape[0]
 
     def body(carry, t):
@@ -77,7 +82,7 @@ def greedy_decode(step_fn, init_cache, bos_ids, max_len, eos_id=None):
     carry0 = (bos_ids, init_cache, jnp.zeros(batch, bool),
               jnp.zeros(batch, jnp.float32))
     (_, _, _, scores), ids = jax.lax.scan(body, carry0,
-                                          jnp.arange(max_len))
+                                          jnp.arange(max_len) + start_t)
     return ids.T, scores
 
 

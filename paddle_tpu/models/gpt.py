@@ -228,6 +228,116 @@ def build_kv_step(params, cfg, max_len):
     return step
 
 
+def _cast_params(params, dtype):
+    """Serving-dtype cast: f32 leaves -> dtype, everything else as-is
+    (the shared policy of every decoder factory and the bench)."""
+    if dtype is None:
+        return params
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(dtype) if a.dtype == jnp.float32 else a,
+        params)
+
+
+def build_prefill(params, cfg, max_len):
+    """prefill(prompt_ids (B, P)) -> (cache, logits (B, P, V)):
+    process the WHOLE prompt in one parallel forward (the flash kernel
+    over (B, H, P, D) — MXU-shaped work) and write K/V for positions
+    0..P-1 into a max_len cache. The serving complement of
+    build_kv_step: a P-token prompt costs ONE forward instead of P
+    sequential cache steps; inference/decoding.greedy_decode then
+    continues from start_t=P. Math identical to build_kv_step's
+    (tests/models/test_gpt_prefill.py pins cache and logits)."""
+    from ..ops.pallas import flash
+    h_, d = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+
+    def prefill(prompt_ids):
+        b, p = prompt_ids.shape
+        x = params["word_emb"][prompt_ids] + params["pos_emb"][:p][None]
+        blk = min(128, p)
+        cache = []
+        for i in range(cfg.num_layers):
+            lp = params[f"l{i}"]
+            hn = _ln(x, lp["ln1_s"], lp["ln1_b"])
+
+            def heads(w, bias):
+                return (hn @ w + bias).reshape(b, p, h_, d).transpose(
+                    0, 2, 1, 3)
+
+            q = heads(lp["wq"], lp["bq"])
+            k = heads(lp["wk"], lp["bk"])
+            v = heads(lp["wv"], lp["bv"])
+            o = flash.flash_attention(q, k, v, causal=True,
+                                      scale=1.0 / np.sqrt(d),
+                                      block_q=blk, block_k=blk)
+            o = o.transpose(0, 2, 1, 3).reshape(b, p, cfg.hidden_size)
+            x = x + (o @ lp["wo"] + lp["bo"]).astype(x.dtype)
+            hn = _ln(x, lp["ln2_s"], lp["ln2_b"])
+            f = jax.nn.gelu(hn @ lp["f0w"] + lp["f0b"], approximate=False)
+            x = x + (f @ lp["f1w"] + lp["f1b"])
+            # park this layer's K/V at positions 0..P-1 of the cache
+            zeros = jnp.zeros((b, h_, max_len, d), k.dtype)
+            cache.append({
+                "k": jax.lax.dynamic_update_slice(zeros, k, (0, 0, 0, 0)),
+                "v": jax.lax.dynamic_update_slice(zeros, v, (0, 0, 0, 0)),
+            })
+        x = _ln(x, params["lnf_s"], params["lnf_b"])
+        return cache, x @ params["word_emb"].T
+
+    return prefill
+
+
+def make_prompt_decoder(params, cfg, prompt_len, max_len, eos_id=None,
+                        dtype=None):
+    """Jit-compiled prompt-conditioned greedy decoder (compile ONCE,
+    serve many requests of the same (B, P) shape): parallel prefill of
+    the prompt (ONE flash forward), then KV-cache continuation.
+    decode(prompt_ids (B, prompt_len)) -> (gen_ids (B, max_len - P),
+    scores (B,)) — the continuation after the prompt; scores sum the
+    generated tokens' log-probs, matching a token-by-token
+    teacher-forced rollout exactly."""
+    from ..inference import decoding as dec
+
+    p = int(prompt_len)
+    gen = max_len - p
+    if gen <= 0:
+        raise ValueError(f"max_len={max_len} must exceed the prompt "
+                         f"length {p}")
+    params = _cast_params(params, dtype)
+    prefill = build_prefill(params, cfg, max_len)
+    step = build_kv_step(params, cfg, max_len)
+
+    @jax.jit
+    def decode(prompt_ids):
+        cache, logits = prefill(prompt_ids)
+        logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))
+        first = jnp.argmax(logp, axis=-1)
+        score0 = jnp.take_along_axis(logp, first[:, None], -1)[:, 0]
+        if eos_id is not None:
+            done0 = first == eos_id
+        ids, scores = dec.greedy_decode(step, cache, first, gen - 1,
+                                        eos_id=eos_id, start_t=p)
+        out = jnp.concatenate([first[:, None], ids], axis=1)
+        if eos_id is not None:
+            # tokens after the first-step EOS must read as EOS too
+            out = jnp.where(jnp.logical_and(done0[:, None],
+                                            jnp.arange(gen)[None] > 0),
+                            eos_id, out)
+            scores = jnp.where(done0, 0.0, scores)
+        return out, score0 + scores
+
+    return decode
+
+
+def generate_with_prompt(params, cfg, prompt_ids, max_len, eos_id=None,
+                         dtype=None):
+    """One-shot convenience over make_prompt_decoder (which serving
+    loops should hold onto — it compiles once per (B, P) shape)."""
+    prompt_ids = jnp.asarray(prompt_ids)
+    decode = make_prompt_decoder(params, cfg, prompt_ids.shape[1],
+                                 max_len, eos_id=eos_id, dtype=dtype)
+    return decode(prompt_ids)
+
+
 def make_greedy_decoder(params, cfg, max_len, eos_id=None, dtype=None):
     """Jit-compiled greedy KV-cache decoder: decode(bos_ids (B,)) ->
     (ids (B, max_len), scores (B,)). `dtype` casts f32 params AND the
@@ -237,10 +347,7 @@ def make_greedy_decoder(params, cfg, max_len, eos_id=None, dtype=None):
     gpt_decode mode both ride it, so they cannot drift apart."""
     import jax
     from ..inference import decoding as dec
-    if dtype is not None:
-        params = jax.tree_util.tree_map(
-            lambda a: a.astype(dtype) if a.dtype == jnp.float32 else a,
-            params)
+    params = _cast_params(params, dtype)
     step = build_kv_step(params, cfg, max_len)
     d = cfg.hidden_size // cfg.num_heads
 
@@ -306,10 +413,7 @@ def make_tp_decoder(params, cfg, mesh, max_len, eos_id=None, dtype=None,
         raise ValueError(
             f"tp={tp} must divide both num_heads={cfg.num_heads} and "
             f"inner_size={cfg.inner_size}")
-    if dtype is not None:
-        params = jax.tree_util.tree_map(
-            lambda a: a.astype(dtype) if a.dtype == jnp.float32 else a,
-            params)
+    params = _cast_params(params, dtype)
     params = jax.device_put(params, gpt_tp_shardings(cfg, mesh, axis))
     step = build_kv_step(params, cfg, max_len)
     cache_ns = NamedSharding(mesh, P(dp_axis, axis, None, None))

@@ -187,7 +187,7 @@ def run_suite():
     for model, budget in (("packed", 2400), ("resnet", 2400),
                           ("transformer", 2400),
                           ("deepfm", 1800), ("gpt", 2400),
-                          ("gpt_decode", 1500)):
+                          ("gpt_decode", 1500), ("gpt_prefill", 1500)):
         if _artifact_ok(f"bench_{model}.json"):
             log(f"step {model}: already landed in a prior cycle — skipping")
             prev = model
