@@ -274,12 +274,10 @@ def build_prefill(params, cfg, max_len):
             hn = _ln(x, lp["ln2_s"], lp["ln2_b"])
             f = jax.nn.gelu(hn @ lp["f0w"] + lp["f0b"], approximate=False)
             x = x + (f @ lp["f1w"] + lp["f1b"])
-            # park this layer's K/V at positions 0..P-1 of the cache
-            zeros = jnp.zeros((b, h_, max_len, d), k.dtype)
-            cache.append({
-                "k": jax.lax.dynamic_update_slice(zeros, k, (0, 0, 0, 0)),
-                "v": jax.lax.dynamic_update_slice(zeros, v, (0, 0, 0, 0)),
-            })
+            # park this layer's K/V at positions 0..P-1: zero-pad the
+            # time axis out to the cache length
+            pad = ((0, 0), (0, 0), (0, max_len - p), (0, 0))
+            cache.append({"k": jnp.pad(k, pad), "v": jnp.pad(v, pad)})
         x = _ln(x, params["lnf_s"], params["lnf_b"])
         return cache, x @ params["word_emb"].T
 
@@ -455,12 +453,28 @@ def make_tp_greedy_decoder(params, cfg, mesh, max_len, eos_id=None,
                            dtype=dtype, axis=axis)
 
 
-def generate(scope, cfg, bos_ids, max_len, eos_id=None, beam_size=None,
-             length_penalty=0.6):
+def generate(scope, cfg, bos_ids=None, max_len=None, eos_id=None,
+             beam_size=None, length_penalty=0.6, prompt_ids=None):
     """KV-cache generation from trained scope params: greedy by default,
-    beam search (dense lanes, GNMT length penalty) with beam_size."""
+    beam search (dense lanes, GNMT length penalty) with beam_size.
+    `prompt_ids` (B, P) conditions on a whole prompt via the parallel
+    prefill (greedy only); `bos_ids` (B,) starts from single tokens."""
     from ..inference import decoding as dec
+    if bos_ids is None and prompt_ids is None:
+        raise ValueError("generate() needs bos_ids (B,) or "
+                         "prompt_ids (B, P)")
+    if max_len is None:
+        raise ValueError("generate() needs max_len (total sequence "
+                         "positions, prompt included)")
     params = load_params(scope, cfg)
+    if prompt_ids is not None:
+        if beam_size is not None:
+            raise NotImplementedError(
+                "prompt-conditioned beam search: prefill the cache with "
+                "build_prefill and run beam_decode over tiled lanes, or "
+                "use greedy (prompt_ids without beam_size)")
+        return generate_with_prompt(params, cfg, prompt_ids, max_len,
+                                    eos_id=eos_id)
     d = cfg.hidden_size // cfg.num_heads
     b = len(np.asarray(bos_ids))
     if beam_size is None:

@@ -74,7 +74,9 @@ def measure(batch, seq_len=512, model="ernie"):
             os.environ.pop("BENCH_MODEL", None)
         else:
             os.environ["BENCH_MODEL"] = prev
-    ca = step.executor.last_cost_analysis()
+    exe = getattr(step, "executor", None)
+    ca = (exe.last_cost_analysis() if exe is not None
+          else step.cost_analysis())    # non-Executor steps (gpt_prefill)
     return {
         "model": model,
         "batch": batch,
@@ -133,10 +135,11 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="ernie",
                     choices=["ernie", "bert", "packed", "gpt",
-                             "transformer", "resnet", "deepfm"],
-                    help="bench.py TRAIN configs only (gpt_decode has "
-                         "no cost-analysis hook and decode is "
-                         "bandwidth-bound by design)")
+                             "transformer", "resnet", "deepfm",
+                             "gpt_prefill"],
+                    help="bench.py train configs + the prefill serving "
+                         "step (gpt_decode stays out: bandwidth-bound "
+                         "by design, MFU is not its figure of merit)")
     ap.add_argument("--batches", default="8,16,32")
     ap.add_argument("--seq", type=int, default=512)
     ap.add_argument("--out", default=None,
