@@ -560,6 +560,9 @@ def bench_one(batch, seq_len, n_steps):
         exe = getattr(step, "executor", None)
         if exe is not None:
             xla_flops = float(exe.last_cost_analysis().get("flops", 0)) or None
+        elif hasattr(step, "cost_analysis"):
+            # non-Executor steps (gpt_prefill) expose their own hook
+            xla_flops = float(step.cost_analysis().get("flops", 0)) or None
     except Exception as e:
         print(f"bench: cost_analysis unavailable: {e}", file=sys.stderr)
     if xla_flops:

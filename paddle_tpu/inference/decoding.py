@@ -97,14 +97,17 @@ def _gather_beams(tree, parent, batch, beams):
 
 
 def beam_decode(step_fn, init_cache, bos_ids, max_len, beam_size, eos_id,
-                length_penalty=0.6):
+                length_penalty=0.6, start_t=0):
     """Standard beam search, dense lanes, GNMT length penalty.
 
     init_cache leaves must already be (B*K, ...) — tile with
     `jax.tree_util.tree_map(lambda x: jnp.repeat(x, K, 0), cache)`.
     bos_ids: (B,). Returns (ids (B, K, max_len), scores (B, K)) sorted
-    best-first.
-    """
+    best-first. `start_t` begins the scan at a later position — the
+    prompt-conditioned path feeds the prompt's LAST token with a
+    prefilled cache and start_t = P - 1 (the step re-writes that
+    position's K/V with identical values and emits position P's
+    token); max_len then counts GENERATED steps."""
     batch = bos_ids.shape[0]
     K = beam_size
 
@@ -138,7 +141,7 @@ def beam_decode(step_fn, init_cache, bos_ids, max_len, beam_size, eos_id,
 
     carry0 = (ids0, init_cache, done0, scores0)
     (_, _, _, final_scores), (tokens, parents) = jax.lax.scan(
-        body, carry0, jnp.arange(max_len))
+        body, carry0, jnp.arange(max_len) + start_t)
     # tokens/parents: (T, B, K). Backtrack parent pointers into sequences.
 
     def backtrack(carry, xs):

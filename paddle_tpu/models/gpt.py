@@ -285,14 +285,17 @@ def build_prefill(params, cfg, max_len):
 
 
 def make_prompt_decoder(params, cfg, prompt_len, max_len, eos_id=None,
-                        dtype=None):
-    """Jit-compiled prompt-conditioned greedy decoder (compile ONCE,
-    serve many requests of the same (B, P) shape): parallel prefill of
-    the prompt (ONE flash forward), then KV-cache continuation.
-    decode(prompt_ids (B, prompt_len)) -> (gen_ids (B, max_len - P),
-    scores (B,)) — the continuation after the prompt; scores sum the
-    generated tokens' log-probs, matching a token-by-token
-    teacher-forced rollout exactly."""
+                        dtype=None, beam_size=None, length_penalty=0.6):
+    """Jit-compiled prompt-conditioned decoder (compile ONCE, serve
+    many requests of the same (B, P) shape): parallel prefill of the
+    prompt (ONE flash forward), then KV-cache continuation — greedy by
+    default, beam search with `beam_size`.
+
+    decode(prompt_ids (B, P)) -> greedy: (gen_ids (B, max_len - P),
+    scores (B,)) — scores sum the generated tokens' log-probs, matching
+    a token-by-token teacher-forced rollout exactly; beam:
+    (ids (B, K, max_len - P), scores (B, K)) best-first, via the
+    start_t = P - 1 trick (see beam_decode)."""
     from ..inference import decoding as dec
 
     p = int(prompt_len)
@@ -303,6 +306,24 @@ def make_prompt_decoder(params, cfg, prompt_len, max_len, eos_id=None,
     params = _cast_params(params, dtype)
     prefill = build_prefill(params, cfg, max_len)
     step = build_kv_step(params, cfg, max_len)
+
+    if beam_size is not None:
+        K = beam_size
+
+        @jax.jit
+        def decode(prompt_ids):
+            cache, _logits = prefill(prompt_ids)
+            cache = jax.tree_util.tree_map(
+                lambda x: jnp.repeat(x, K, 0), cache)
+            # feed the last prompt token at start_t = P-1: the step
+            # re-writes that position's K/V (identical values) and the
+            # scan emits gen tokens starting at position P
+            return dec.beam_decode(
+                step, cache, prompt_ids[:, -1], gen, K,
+                eos_id if eos_id is not None else -1,
+                length_penalty=length_penalty, start_t=p - 1)
+
+        return decode
 
     @jax.jit
     def decode(prompt_ids):
@@ -327,12 +348,13 @@ def make_prompt_decoder(params, cfg, prompt_len, max_len, eos_id=None,
 
 
 def generate_with_prompt(params, cfg, prompt_ids, max_len, eos_id=None,
-                         dtype=None):
+                         dtype=None, beam_size=None, length_penalty=0.6):
     """One-shot convenience over make_prompt_decoder (which serving
     loops should hold onto — it compiles once per (B, P) shape)."""
     prompt_ids = jnp.asarray(prompt_ids)
-    decode = make_prompt_decoder(params, cfg, prompt_ids.shape[1],
-                                 max_len, eos_id=eos_id, dtype=dtype)
+    decode = make_prompt_decoder(
+        params, cfg, prompt_ids.shape[1], max_len, eos_id=eos_id,
+        dtype=dtype, beam_size=beam_size, length_penalty=length_penalty)
     return decode(prompt_ids)
 
 
@@ -458,7 +480,8 @@ def generate(scope, cfg, bos_ids=None, max_len=None, eos_id=None,
     """KV-cache generation from trained scope params: greedy by default,
     beam search (dense lanes, GNMT length penalty) with beam_size.
     `prompt_ids` (B, P) conditions on a whole prompt via the parallel
-    prefill (greedy only); `bos_ids` (B,) starts from single tokens."""
+    prefill (greedy or beam); `bos_ids` (B,) starts from single
+    tokens."""
     from ..inference import decoding as dec
     if bos_ids is None and prompt_ids is None:
         raise ValueError("generate() needs bos_ids (B,) or "
@@ -468,13 +491,9 @@ def generate(scope, cfg, bos_ids=None, max_len=None, eos_id=None,
                          "positions, prompt included)")
     params = load_params(scope, cfg)
     if prompt_ids is not None:
-        if beam_size is not None:
-            raise NotImplementedError(
-                "prompt-conditioned beam search: prefill the cache with "
-                "build_prefill and run beam_decode over tiled lanes, or "
-                "use greedy (prompt_ids without beam_size)")
         return generate_with_prompt(params, cfg, prompt_ids, max_len,
-                                    eos_id=eos_id)
+                                    eos_id=eos_id, beam_size=beam_size,
+                                    length_penalty=length_penalty)
     d = cfg.hidden_size // cfg.num_heads
     b = len(np.asarray(bos_ids))
     if beam_size is None:

@@ -109,6 +109,46 @@ def test_prompt_continuation_reproduces_memorized_tail(trained):
                          f"reproduced"
 
 
+def test_prompt_beam_k1_equals_prompt_greedy(trained):
+    """Beam search with K=1 through the prefilled cache must reproduce
+    the greedy prompt continuation exactly (tokens; the beam score
+    differs only by the GNMT length-penalty normalization)."""
+    cfg, params, seq = trained
+    prompt = seq[:, :8]
+    max_len = 20
+    greedy_ids, _ = gpt.generate_with_prompt(params, cfg, prompt,
+                                             max_len)
+    beam_ids, beam_scores = gpt.generate_with_prompt(
+        params, cfg, prompt, max_len, beam_size=1)
+    assert beam_ids.shape == (prompt.shape[0], 1, max_len - 8)
+    np.testing.assert_array_equal(np.asarray(beam_ids)[:, 0],
+                                  np.asarray(greedy_ids))
+
+
+def test_prompt_beam_matches_stepwise_prefill_beam(trained):
+    """Parallel-prefill beam == sequential teacher-forced prefill beam:
+    same sequences, same scores (the prefill path changes WHERE the
+    cache comes from, never the search)."""
+    cfg, params, seq = trained
+    prompt = seq[:, :8]
+    max_len, K = 18, 3
+    p = prompt.shape[1]
+    got_ids, got_scores = gpt.generate_with_prompt(
+        params, cfg, prompt, max_len, beam_size=K)
+
+    step = gpt.build_kv_step(params, cfg, max_len)
+    cache, _ = _stepwise_cache(params, cfg, prompt, max_len)
+    cache = jax.tree_util.tree_map(lambda x: jnp.repeat(x, K, 0), cache)
+    ref_ids, ref_scores = dec.beam_decode(
+        step, cache, jnp.asarray(prompt[:, -1]), max_len - p, K,
+        eos_id=-1, start_t=p - 1)
+    np.testing.assert_array_equal(np.asarray(got_ids),
+                                  np.asarray(ref_ids))
+    np.testing.assert_allclose(np.asarray(got_scores),
+                               np.asarray(ref_scores), rtol=2e-5,
+                               atol=2e-5)
+
+
 def test_generate_with_prompt_validates_length(trained):
     cfg, params, seq = trained
     with pytest.raises(ValueError, match="must exceed"):
