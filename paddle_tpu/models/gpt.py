@@ -307,10 +307,20 @@ def make_prompt_decoder(params, cfg, prompt_len, max_len, eos_id=None,
     prefill = build_prefill(params, cfg, max_len)
     step = build_kv_step(params, cfg, max_len)
 
+    return jax.jit(_prompt_continuation(prefill, step, p, gen, eos_id,
+                                        beam_size, length_penalty))
+
+
+def _prompt_continuation(prefill, step, p, gen, eos_id, beam_size,
+                         length_penalty):
+    """Shared continuation over any prefill(prompt) -> (cache, logits)
+    — single-chip and tp prompt decoders run EXACTLY this logic (drift
+    here would break their pinned equivalence)."""
+    from ..inference import decoding as dec
+
     if beam_size is not None:
         K = beam_size
 
-        @jax.jit
         def decode(prompt_ids):
             cache, _logits = prefill(prompt_ids)
             cache = jax.tree_util.tree_map(
@@ -325,7 +335,6 @@ def make_prompt_decoder(params, cfg, prompt_len, max_len, eos_id=None,
 
         return decode
 
-    @jax.jit
     def decode(prompt_ids):
         cache, logits = prefill(prompt_ids)
         logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))
@@ -473,6 +482,103 @@ def make_tp_greedy_decoder(params, cfg, mesh, max_len, eos_id=None,
     """Greedy-only alias of make_tp_decoder (the benched serving path)."""
     return make_tp_decoder(params, cfg, mesh, max_len, eos_id=eos_id,
                            dtype=dtype, axis=axis)
+
+
+def build_tp_prefill(params, cfg, mesh, max_len, axis="tp"):
+    """Tensor-parallel prompt prefill under shard_map: every chip runs
+    the flash kernel on ITS heads (attention is head-independent — the
+    same pattern ring attention uses for the sp axis) with exactly one
+    psum per block pair (o-proj + ffn-down), and keeps only its cache
+    shard. `params` must already be laid out per gpt_tp_shardings.
+    prefill(params, prompt (B, P)) -> (head-sharded cache, replicated
+    logits (B, P, V))."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from ..ops.pallas import flash
+
+    tp = mesh.shape[axis]
+    h_loc = cfg.num_heads // tp
+    d = cfg.hidden_size // cfg.num_heads
+
+    def local(lp_all, prompt):
+        b, p = prompt.shape
+        x = lp_all["word_emb"][prompt] + lp_all["pos_emb"][:p][None]
+        blk = min(128, p)
+        cache = []
+        for i in range(cfg.num_layers):
+            lp = lp_all[f"l{i}"]
+            hn = _ln(x, lp["ln1_s"], lp["ln1_b"])
+
+            def heads(w, bias):
+                # local slice: (M, M/tp) -> (B, P, h_loc, d)
+                return (hn @ w + bias).reshape(b, p, h_loc, d).transpose(
+                    0, 2, 1, 3)
+
+            q = heads(lp["wq"], lp["bq"])
+            k = heads(lp["wk"], lp["bk"])
+            v = heads(lp["wv"], lp["bv"])
+            o = flash.flash_attention(q, k, v, causal=True,
+                                      scale=1.0 / np.sqrt(d),
+                                      block_q=blk, block_k=blk)
+            o = o.transpose(0, 2, 1, 3).reshape(b, p, h_loc * d)
+            # row-parallel o-proj: partial sums -> ONE psum; replicated
+            # bias added after the reduction
+            att = jax.lax.psum(o @ lp["wo"], axis) + lp["bo"]
+            x = x + att.astype(x.dtype)
+            hn = _ln(x, lp["ln2_s"], lp["ln2_b"])
+            f = jax.nn.gelu(hn @ lp["f0w"] + lp["f0b"], approximate=False)
+            ffn = jax.lax.psum(f @ lp["f1w"], axis) + lp["f1b"]
+            x = x + ffn
+            pad = ((0, 0), (0, 0), (0, max_len - p), (0, 0))
+            cache.append({"k": jnp.pad(k, pad), "v": jnp.pad(v, pad)})
+        x = _ln(x, lp_all["lnf_s"], lp_all["lnf_b"])
+        return cache, x @ lp_all["word_emb"].T
+
+    param_specs = jax.tree_util.tree_map(
+        lambda ns: ns.spec, gpt_tp_shardings(cfg, mesh, axis))
+    cache_specs = [{"k": P(None, axis, None, None),
+                    "v": P(None, axis, None, None)}
+                   for _ in range(cfg.num_layers)]
+    fn = shard_map(local, mesh=mesh, in_specs=(param_specs, P()),
+                   out_specs=(cache_specs, P()), check_vma=False)
+    # close over params (build_prefill's contract): one binding site,
+    # no chance of a differently-laid-out tree at call time
+    return lambda prompt_ids: fn(params, prompt_ids)
+
+
+def make_tp_prompt_decoder(params, cfg, mesh, prompt_len, max_len,
+                           eos_id=None, dtype=None, axis="tp",
+                           beam_size=None, length_penalty=0.6):
+    """Tensor-parallel prompt serving end-to-end: shard_map prefill
+    (build_tp_prefill) fills the head-sharded cache in one parallel
+    forward, then the GSPMD continuation decodes greedily (or with beam
+    search). Same contracts as make_prompt_decoder; outputs pinned
+    against it in tests/parallel/test_tp_decode.py. Batch is
+    replicated here — compose dp via make_tp_decoder's layout if
+    sharded-batch prompt serving is needed."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..inference import decoding as dec
+
+    tp = mesh.shape[axis]
+    if cfg.num_heads % tp or cfg.inner_size % tp:
+        raise ValueError(
+            f"tp={tp} must divide both num_heads={cfg.num_heads} and "
+            f"inner_size={cfg.inner_size}")
+    p = int(prompt_len)
+    gen = max_len - p
+    if gen <= 0:
+        raise ValueError(f"max_len={max_len} must exceed the prompt "
+                         f"length {p}")
+    params = _cast_params(params, dtype)
+    params = jax.device_put(params, gpt_tp_shardings(cfg, mesh, axis))
+    prefill = build_tp_prefill(params, cfg, mesh, max_len, axis)
+    step = build_kv_step(params, cfg, max_len)
+    # the SAME continuation the single-chip factory compiles — only the
+    # prefill (shard_map) and the io shardings differ
+    decode = _prompt_continuation(prefill, step, p, gen, eos_id,
+                                  beam_size, length_penalty)
+    rep = NamedSharding(mesh, P())
+    return jax.jit(decode, in_shardings=rep, out_shardings=(rep, rep))
 
 
 def generate(scope, cfg, bos_ids=None, max_len=None, eos_id=None,

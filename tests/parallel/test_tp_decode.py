@@ -159,6 +159,43 @@ def test_dp_tp_decode_matches_single_chip(trained):
                                atol=2e-5)
 
 
+def test_tp_prompt_decoder_matches_single_chip(trained):
+    """End-to-end tp prompt serving: shard_map prefill (flash on local
+    heads + one psum per block pair) + GSPMD continuation must match
+    the single-chip prompt decoder — greedy tokens/scores and beam
+    sequences/scores."""
+    cfg, params = trained
+    max_len, P_len = 20, 8
+    rng = np.random.default_rng(3)
+    prompt = jnp.asarray(rng.integers(
+        3, cfg.vocab_size, (3, P_len)).astype(np.int32))
+    mesh = Mesh(np.array(jax.devices()[:4]), ("tp",))
+
+    ref = gpt.make_prompt_decoder(params, cfg, P_len, max_len)
+    ref_ids, ref_scores = ref(prompt)
+    tp_dec = gpt.make_tp_prompt_decoder(params, cfg, mesh, P_len,
+                                        max_len)
+    got_ids, got_scores = tp_dec(prompt)
+    np.testing.assert_array_equal(np.asarray(got_ids),
+                                  np.asarray(ref_ids))
+    np.testing.assert_allclose(np.asarray(got_scores),
+                               np.asarray(ref_scores), rtol=2e-5,
+                               atol=2e-5)
+
+    K = 2
+    ref_b = gpt.make_prompt_decoder(params, cfg, P_len, max_len,
+                                    beam_size=K)
+    rb_ids, rb_scores = ref_b(prompt)
+    tp_b = gpt.make_tp_prompt_decoder(params, cfg, mesh, P_len, max_len,
+                                      beam_size=K)
+    tb_ids, tb_scores = tp_b(prompt)
+    np.testing.assert_array_equal(np.asarray(tb_ids),
+                                  np.asarray(rb_ids))
+    np.testing.assert_allclose(np.asarray(tb_scores),
+                               np.asarray(rb_scores), rtol=2e-5,
+                               atol=2e-5)
+
+
 def test_tp_validates_divisibility(trained):
     cfg, params = trained
     mesh = Mesh(np.array(jax.devices()[:3]), ("tp",))
