@@ -86,6 +86,70 @@ def greedy_decode(step_fn, init_cache, bos_ids, max_len, eos_id=None,
     return ids.T, scores
 
 
+def _filter_logits(logits, top_k=None, top_p=None):
+    """Standard sampling filters over (B, V) f32 logits: keep the top_k
+    highest, then the smallest prefix of the sorted distribution whose
+    cumulative probability reaches top_p (the nucleus); everything else
+    -> NEG_INF. Static shapes throughout (TPU-compilable)."""
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+    if top_p is not None:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep ranks whose PRECEDING mass is < top_p (always >= 1 token)
+        keep_sorted = jnp.concatenate(
+            [jnp.ones_like(cum[:, :1], bool), cum[:, :-1] < top_p], -1)
+        # threshold logit: the smallest kept logit per row
+        thresh = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
+                         axis=-1, keepdims=True)
+        logits = jnp.where(logits < thresh, NEG_INF, logits)
+    return logits
+
+
+def sample_decode(step_fn, init_cache, bos_ids, max_len, rng_key,
+                  temperature=1.0, top_k=None, top_p=None, eos_id=None,
+                  start_t=0):
+    """Stochastic decoding with a KV cache: temperature scaling, then
+    top-k and/or nucleus (top-p) filtering, then categorical sampling —
+    the serving-side complement of greedy_decode (same carry/eos/start_t
+    conventions; parity root: the reference's sampling_id op, here
+    composed with the cache loop). temperature <= 0 degenerates to
+    greedy argmax. Returns (ids (B, max_len), scores (B,)) where score
+    sums the chosen tokens' log-probs under the FILTERED distribution."""
+    batch = bos_ids.shape[0]
+    greedy = temperature is None or temperature <= 0.0
+
+    def body(carry, t):
+        ids_t, cache, done, score, key = carry
+        logits, cache = step_fn(ids_t, cache, t)
+        logits = logits.astype(jnp.float32)
+        if greedy:
+            filtered = logits
+            nxt = jnp.argmax(filtered, axis=-1)
+        else:
+            filtered = _filter_logits(logits / temperature,
+                                      top_k=top_k, top_p=top_p)
+            key, sub = jax.random.split(key)
+            nxt = jax.random.categorical(sub, filtered, axis=-1)
+        logp = jax.nn.log_softmax(filtered)
+        step_lp = jnp.take_along_axis(logp, nxt[:, None], -1)[:, 0]
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            score = score + jnp.where(done, 0.0, step_lp)
+            done = done | (nxt == eos_id)
+        else:
+            score = score + step_lp
+        return (nxt, cache, done, score, key), nxt
+
+    carry0 = (bos_ids, init_cache, jnp.zeros(batch, bool),
+              jnp.zeros(batch, jnp.float32), rng_key)
+    (_, _, _, scores, _), ids = jax.lax.scan(
+        body, carry0, jnp.arange(max_len) + start_t)
+    return ids.T, scores
+
+
 # ---------------------------------------------------------------------------
 # Beam search
 # ---------------------------------------------------------------------------

@@ -238,6 +238,47 @@ def _cast_params(params, dtype):
         params)
 
 
+def _prefill_forward(lp_all, prompt_ids, cfg, max_len, h_count,
+                     reduce_fn):
+    """The ONE prefill body (math identical to build_kv_step's), shared
+    by the single-chip and tensor-parallel prefills: `h_count` is the
+    head count THIS caller computes (H, or H/tp inside shard_map) and
+    `reduce_fn` finishes the row-parallel o-proj / ffn-down matmuls
+    (identity single-chip; one psum per block pair under tp)."""
+    from ..ops.pallas import flash
+
+    d = cfg.hidden_size // cfg.num_heads
+    b, p = prompt_ids.shape
+    x = lp_all["word_emb"][prompt_ids] + lp_all["pos_emb"][:p][None]
+    blk = min(128, p)
+    cache = []
+    for i in range(cfg.num_layers):
+        lp = lp_all[f"l{i}"]
+        hn = _ln(x, lp["ln1_s"], lp["ln1_b"])
+
+        def heads(w, bias):
+            return (hn @ w + bias).reshape(b, p, h_count, d).transpose(
+                0, 2, 1, 3)
+
+        q = heads(lp["wq"], lp["bq"])
+        k = heads(lp["wk"], lp["bk"])
+        v = heads(lp["wv"], lp["bv"])
+        o = flash.flash_attention(q, k, v, causal=True,
+                                  scale=1.0 / np.sqrt(d),
+                                  block_q=blk, block_k=blk)
+        o = o.transpose(0, 2, 1, 3).reshape(b, p, h_count * d)
+        x = x + (reduce_fn(o @ lp["wo"]) + lp["bo"]).astype(x.dtype)
+        hn = _ln(x, lp["ln2_s"], lp["ln2_b"])
+        f = jax.nn.gelu(hn @ lp["f0w"] + lp["f0b"], approximate=False)
+        x = x + (reduce_fn(f @ lp["f1w"]) + lp["f1b"])
+        # park this layer's K/V at positions 0..P-1: zero-pad the time
+        # axis out to the cache length
+        pad = ((0, 0), (0, 0), (0, max_len - p), (0, 0))
+        cache.append({"k": jnp.pad(k, pad), "v": jnp.pad(v, pad)})
+    x = _ln(x, lp_all["lnf_s"], lp_all["lnf_b"])
+    return cache, x @ lp_all["word_emb"].T
+
+
 def build_prefill(params, cfg, max_len):
     """prefill(prompt_ids (B, P)) -> (cache, logits (B, P, V)):
     process the WHOLE prompt in one parallel forward (the flash kernel
@@ -247,39 +288,10 @@ def build_prefill(params, cfg, max_len):
     sequential cache steps; inference/decoding.greedy_decode then
     continues from start_t=P. Math identical to build_kv_step's
     (tests/models/test_gpt_prefill.py pins cache and logits)."""
-    from ..ops.pallas import flash
-    h_, d = cfg.num_heads, cfg.hidden_size // cfg.num_heads
 
     def prefill(prompt_ids):
-        b, p = prompt_ids.shape
-        x = params["word_emb"][prompt_ids] + params["pos_emb"][:p][None]
-        blk = min(128, p)
-        cache = []
-        for i in range(cfg.num_layers):
-            lp = params[f"l{i}"]
-            hn = _ln(x, lp["ln1_s"], lp["ln1_b"])
-
-            def heads(w, bias):
-                return (hn @ w + bias).reshape(b, p, h_, d).transpose(
-                    0, 2, 1, 3)
-
-            q = heads(lp["wq"], lp["bq"])
-            k = heads(lp["wk"], lp["bk"])
-            v = heads(lp["wv"], lp["bv"])
-            o = flash.flash_attention(q, k, v, causal=True,
-                                      scale=1.0 / np.sqrt(d),
-                                      block_q=blk, block_k=blk)
-            o = o.transpose(0, 2, 1, 3).reshape(b, p, cfg.hidden_size)
-            x = x + (o @ lp["wo"] + lp["bo"]).astype(x.dtype)
-            hn = _ln(x, lp["ln2_s"], lp["ln2_b"])
-            f = jax.nn.gelu(hn @ lp["f0w"] + lp["f0b"], approximate=False)
-            x = x + (f @ lp["f1w"] + lp["f1b"])
-            # park this layer's K/V at positions 0..P-1: zero-pad the
-            # time axis out to the cache length
-            pad = ((0, 0), (0, 0), (0, max_len - p), (0, 0))
-            cache.append({"k": jnp.pad(k, pad), "v": jnp.pad(v, pad)})
-        x = _ln(x, params["lnf_s"], params["lnf_b"])
-        return cache, x @ params["word_emb"].T
+        return _prefill_forward(params, prompt_ids, cfg, max_len,
+                                cfg.num_heads, lambda z: z)
 
     return prefill
 
@@ -311,6 +323,41 @@ def make_prompt_decoder(params, cfg, prompt_len, max_len, eos_id=None,
                                         beam_size, length_penalty))
 
 
+def _select_first(logits_last, temperature, top_k, top_p, key):
+    """First generated token from the prefill's last-position logits:
+    argmax when temperature is None/<=0, else filtered categorical.
+    Returns (first, score0, key) — ONE implementation for the greedy
+    and sampled prompt paths."""
+    from ..inference import decoding as dec
+
+    logits = logits_last.astype(jnp.float32)
+    if temperature is None or temperature <= 0.0:
+        filtered = logits
+        first = jnp.argmax(filtered, axis=-1)
+    else:
+        filtered = dec._filter_logits(logits / temperature, top_k=top_k,
+                                      top_p=top_p)
+        key, sub = jax.random.split(key)
+        first = jax.random.categorical(sub, filtered, axis=-1)
+    logp = jax.nn.log_softmax(filtered)
+    score0 = jnp.take_along_axis(logp, first[:, None], -1)[:, 0]
+    return first, score0, key
+
+
+def _stitch_prompt_output(first, score0, ids, scores, gen, eos_id):
+    """Prepend the first token and apply the first-step-EOS patch —
+    the drift-prone tail every prompt decoder must share."""
+    out = jnp.concatenate([first[:, None], ids], axis=1)
+    if eos_id is not None:
+        done0 = first == eos_id
+        # tokens after a first-step EOS must read as EOS too
+        out = jnp.where(jnp.logical_and(done0[:, None],
+                                        jnp.arange(gen)[None] > 0),
+                        eos_id, out)
+        scores = jnp.where(done0, 0.0, scores)
+    return out, score0 + scores
+
+
 def _prompt_continuation(prefill, step, p, gen, eos_id, beam_size,
                          length_penalty):
     """Shared continuation over any prefill(prompt) -> (cache, logits)
@@ -337,21 +384,12 @@ def _prompt_continuation(prefill, step, p, gen, eos_id, beam_size,
 
     def decode(prompt_ids):
         cache, logits = prefill(prompt_ids)
-        logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))
-        first = jnp.argmax(logp, axis=-1)
-        score0 = jnp.take_along_axis(logp, first[:, None], -1)[:, 0]
-        if eos_id is not None:
-            done0 = first == eos_id
+        first, score0, _ = _select_first(logits[:, -1], None, None,
+                                         None, None)
         ids, scores = dec.greedy_decode(step, cache, first, gen - 1,
                                         eos_id=eos_id, start_t=p)
-        out = jnp.concatenate([first[:, None], ids], axis=1)
-        if eos_id is not None:
-            # tokens after the first-step EOS must read as EOS too
-            out = jnp.where(jnp.logical_and(done0[:, None],
-                                            jnp.arange(gen)[None] > 0),
-                            eos_id, out)
-            scores = jnp.where(done0, 0.0, scores)
-        return out, score0 + scores
+        return _stitch_prompt_output(first, score0, ids, scores, gen,
+                                     eos_id)
 
     return decode
 
@@ -389,6 +427,56 @@ def make_greedy_decoder(params, cfg, max_len, eos_id=None, dtype=None):
                                  eos_id=eos_id)
 
     return decode
+
+
+def make_sampler(params, cfg, max_len, temperature=1.0, top_k=None,
+                 top_p=None, eos_id=None, dtype=None, prompt_len=None):
+    """Jit-compiled stochastic decoder (temperature / top-k / nucleus;
+    inference/decoding.sample_decode). Without prompt_len:
+    sample(bos_ids (B,), rng_key) -> (ids (B, max_len), scores). With
+    prompt_len: parallel prefill first, then sampled continuation —
+    sample(prompt_ids (B, P), rng_key) -> (ids (B, max_len - P),
+    scores); the first generated token is sampled from the prefill's
+    last-position logits."""
+    from ..inference import decoding as dec
+
+    params = _cast_params(params, dtype)
+    step = build_kv_step(params, cfg, max_len)
+    d = cfg.hidden_size // cfg.num_heads
+
+    if prompt_len is None:
+        @jax.jit
+        def sample(bos_ids, rng_key):
+            cache = dec.init_kv_cache(bos_ids.shape[0], cfg.num_layers,
+                                      cfg.num_heads, max_len, d,
+                                      dtype=dtype or jnp.float32)
+            return dec.sample_decode(step, cache, bos_ids, max_len,
+                                     rng_key, temperature=temperature,
+                                     top_k=top_k, top_p=top_p,
+                                     eos_id=eos_id)
+
+        return sample
+
+    p = int(prompt_len)
+    gen = max_len - p
+    if gen <= 0:
+        raise ValueError(f"max_len={max_len} must exceed the prompt "
+                         f"length {p}")
+    prefill = build_prefill(params, cfg, max_len)
+
+    @jax.jit
+    def sample(prompt_ids, rng_key):
+        cache, logits = prefill(prompt_ids)
+        first, score0, rng_key = _select_first(
+            logits[:, -1], temperature, top_k, top_p, rng_key)
+        ids, scores = dec.sample_decode(
+            step, cache, first, gen - 1, rng_key,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_id=eos_id, start_t=p)
+        return _stitch_prompt_output(first, score0, ids, scores, gen,
+                                     eos_id)
+
+    return sample
 
 
 def gpt_tp_shardings(cfg, mesh, axis="tp"):
@@ -489,50 +577,20 @@ def build_tp_prefill(params, cfg, mesh, max_len, axis="tp"):
     the flash kernel on ITS heads (attention is head-independent — the
     same pattern ring attention uses for the sp axis) with exactly one
     psum per block pair (o-proj + ffn-down), and keeps only its cache
-    shard. `params` must already be laid out per gpt_tp_shardings.
-    prefill(params, prompt (B, P)) -> (head-sharded cache, replicated
-    logits (B, P, V))."""
+    shard. `params` must already be laid out per gpt_tp_shardings and
+    is closed over here (one binding site). Returns
+    prefill(prompt_ids (B, P)) -> (head-sharded cache, replicated
+    logits (B, P, V)) — the SAME body as build_prefill
+    (_prefill_forward) with local head count + psum reduction."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
-    from ..ops.pallas import flash
 
     tp = mesh.shape[axis]
     h_loc = cfg.num_heads // tp
-    d = cfg.hidden_size // cfg.num_heads
 
-    def local(lp_all, prompt):
-        b, p = prompt.shape
-        x = lp_all["word_emb"][prompt] + lp_all["pos_emb"][:p][None]
-        blk = min(128, p)
-        cache = []
-        for i in range(cfg.num_layers):
-            lp = lp_all[f"l{i}"]
-            hn = _ln(x, lp["ln1_s"], lp["ln1_b"])
-
-            def heads(w, bias):
-                # local slice: (M, M/tp) -> (B, P, h_loc, d)
-                return (hn @ w + bias).reshape(b, p, h_loc, d).transpose(
-                    0, 2, 1, 3)
-
-            q = heads(lp["wq"], lp["bq"])
-            k = heads(lp["wk"], lp["bk"])
-            v = heads(lp["wv"], lp["bv"])
-            o = flash.flash_attention(q, k, v, causal=True,
-                                      scale=1.0 / np.sqrt(d),
-                                      block_q=blk, block_k=blk)
-            o = o.transpose(0, 2, 1, 3).reshape(b, p, h_loc * d)
-            # row-parallel o-proj: partial sums -> ONE psum; replicated
-            # bias added after the reduction
-            att = jax.lax.psum(o @ lp["wo"], axis) + lp["bo"]
-            x = x + att.astype(x.dtype)
-            hn = _ln(x, lp["ln2_s"], lp["ln2_b"])
-            f = jax.nn.gelu(hn @ lp["f0w"] + lp["f0b"], approximate=False)
-            ffn = jax.lax.psum(f @ lp["f1w"], axis) + lp["f1b"]
-            x = x + ffn
-            pad = ((0, 0), (0, 0), (0, max_len - p), (0, 0))
-            cache.append({"k": jnp.pad(k, pad), "v": jnp.pad(v, pad)})
-        x = _ln(x, lp_all["lnf_s"], lp_all["lnf_b"])
-        return cache, x @ lp_all["word_emb"].T
+    def local(lp_all, prompt_ids):
+        return _prefill_forward(lp_all, prompt_ids, cfg, max_len, h_loc,
+                                lambda z: jax.lax.psum(z, axis))
 
     param_specs = jax.tree_util.tree_map(
         lambda ns: ns.spec, gpt_tp_shardings(cfg, mesh, axis))
@@ -541,8 +599,6 @@ def build_tp_prefill(params, cfg, mesh, max_len, axis="tp"):
                    for _ in range(cfg.num_layers)]
     fn = shard_map(local, mesh=mesh, in_specs=(param_specs, P()),
                    out_specs=(cache_specs, P()), check_vma=False)
-    # close over params (build_prefill's contract): one binding site,
-    # no chance of a differently-laid-out tree at call time
     return lambda prompt_ids: fn(params, prompt_ids)
 
 

@@ -1,0 +1,188 @@
+"""Sampled decoding (inference/decoding.sample_decode + gpt.make_sampler):
+temperature / top-k / nucleus filtering over the KV cache.
+
+Filter semantics are unit-tested against synthetic logits where the
+legal token sets are known exactly; the decode loop is pinned to greedy
+in its degenerate settings; end-to-end sampling on the memorized tiny
+GPT checks reproducibility and distribution sanity.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu.core import framework
+from paddle_tpu.core.executor import Scope, scope_guard
+from paddle_tpu.inference import decoding as dec
+from paddle_tpu.models import gpt
+
+
+# ---------------------------------------------------------------------------
+# _filter_logits unit semantics
+# ---------------------------------------------------------------------------
+
+def test_top_k_filter_keeps_exactly_k():
+    logits = jnp.asarray([[1.0, 5.0, 3.0, 4.0, 2.0]])
+    out = np.asarray(dec._filter_logits(logits, top_k=2))
+    kept = np.where(out[0] > dec.NEG_INF / 2)[0]
+    np.testing.assert_array_equal(sorted(kept), [1, 3])   # logits 5, 4
+
+
+def test_top_p_filter_nucleus_set():
+    # softmax of [4, 2, 0, -2] ~ [0.867, 0.117, 0.0158, 0.002]
+    logits = jnp.asarray([[4.0, 2.0, 0.0, -2.0]])
+    # p=0.9: token 0 (0.867) < 0.9 so token 1 also kept; cum before
+    # token 2 is 0.984 >= 0.9 -> dropped
+    out = np.asarray(dec._filter_logits(logits, top_p=0.9))
+    kept = np.where(out[0] > dec.NEG_INF / 2)[0]
+    np.testing.assert_array_equal(kept, [0, 1])
+    # p tiny: only the argmax survives (nucleus always >= 1 token)
+    out = np.asarray(dec._filter_logits(logits, top_p=1e-6))
+    kept = np.where(out[0] > dec.NEG_INF / 2)[0]
+    np.testing.assert_array_equal(kept, [0])
+
+
+def test_filters_compose_per_row():
+    logits = jnp.asarray([[1.0, 5.0, 3.0, 4.0, 2.0],
+                          [9.0, 0.0, 8.0, 1.0, 2.0]])
+    out = np.asarray(dec._filter_logits(logits, top_k=3, top_p=0.95))
+    for row, want_subset in ((0, {1, 3, 2}), (1, {0, 2})):
+        kept = set(np.where(out[row] > dec.NEG_INF / 2)[0])
+        assert kept <= want_subset and kept, (row, kept)
+
+
+# ---------------------------------------------------------------------------
+# decode-loop semantics on a synthetic step (no model needed)
+# ---------------------------------------------------------------------------
+
+def _const_step(logit_rows):
+    """step_fn emitting fixed logits regardless of input (cache is a
+    dummy scalar)."""
+    table = jnp.asarray(logit_rows, jnp.float32)
+
+    def step(ids_t, cache, t):
+        return jnp.tile(table, (ids_t.shape[0], 1)), cache
+
+    return step
+
+
+def test_sampled_tokens_respect_top_k_set():
+    step = _const_step([[0.0, 3.0, 2.9, 2.8, -1.0]])
+    ids, _ = dec.sample_decode(step, jnp.zeros(()), jnp.zeros(64, jnp.int32),
+                               8, jax.random.PRNGKey(0), temperature=1.0,
+                               top_k=3)
+    assert set(np.asarray(ids).ravel()) <= {1, 2, 3}
+
+
+def test_temperature_zero_equals_greedy():
+    step = _const_step([[0.0, 3.0, 2.9, 2.8, -1.0]])
+    ids, scores = dec.sample_decode(step, jnp.zeros(()),
+                                    jnp.zeros(4, jnp.int32), 6,
+                                    jax.random.PRNGKey(0), temperature=0.0)
+    g_ids, g_scores = dec.greedy_decode(step, jnp.zeros(()),
+                                        jnp.zeros(4, jnp.int32), 6)
+    np.testing.assert_array_equal(np.asarray(ids), np.asarray(g_ids))
+    np.testing.assert_allclose(np.asarray(scores), np.asarray(g_scores),
+                               rtol=1e-6)
+
+
+def test_top_k1_equals_greedy_tokens():
+    step = _const_step([[0.0, 3.0, 2.9, 2.8, -1.0]])
+    ids, _ = dec.sample_decode(step, jnp.zeros(()), jnp.zeros(4, jnp.int32),
+                               6, jax.random.PRNGKey(7), temperature=1.0,
+                               top_k=1)
+    assert set(np.asarray(ids).ravel()) == {1}
+
+
+def test_low_temperature_concentrates_high_spreads():
+    step = _const_step([[0.0, 2.0, 1.5, 1.0, 0.5]])
+    bos = jnp.zeros(256, jnp.int32)
+    cold, _ = dec.sample_decode(step, jnp.zeros(()), bos, 1,
+                                jax.random.PRNGKey(1), temperature=0.1)
+    hot, _ = dec.sample_decode(step, jnp.zeros(()), bos, 1,
+                               jax.random.PRNGKey(1), temperature=10.0)
+    frac_cold = (np.asarray(cold) == 1).mean()
+    frac_hot = (np.asarray(hot) == 1).mean()
+    assert frac_cold > 0.95, frac_cold
+    assert frac_hot < 0.6, frac_hot
+
+
+def test_eos_stops_scoring():
+    step = _const_step([[0.0, 5.0, 0.0]])        # always emits token 1
+    ids, scores = dec.sample_decode(step, jnp.zeros(()),
+                                    jnp.zeros(2, jnp.int32), 5,
+                                    jax.random.PRNGKey(0),
+                                    temperature=0.0, eos_id=1)
+    got = np.asarray(ids)
+    np.testing.assert_array_equal(got, np.full((2, 5), 1))
+    # only the FIRST token contributed to the score
+    one_step = float(jax.nn.log_softmax(
+        jnp.asarray([0.0, 5.0, 0.0]))[1])
+    np.testing.assert_allclose(np.asarray(scores), one_step, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end on the tiny GPT
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def trained():
+    cfg = gpt.gpt_tiny()
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        _t, loss, _ = gpt.build_lm_net(cfg, seq_len=16)
+        fluid.optimizer.AdamOptimizer(learning_rate=2e-2).minimize(loss)
+    scope = Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    rng = np.random.default_rng(0)
+    seq = rng.integers(3, cfg.vocab_size, (4, 16)).astype(np.int32)
+    with scope_guard(scope):
+        exe.run(startup)
+        for _ in range(40):
+            exe.run(main, feed={"tokens": seq}, fetch_list=[loss])
+        params = gpt.load_params(scope, cfg)
+    return cfg, params, seq
+
+
+def test_sampler_reproducible_and_cold_matches_greedy(trained):
+    cfg, params, _ = trained
+    bos = jnp.asarray(np.array([5, 9], np.int32))
+    sampler = gpt.make_sampler(params, cfg, 12, temperature=0.7,
+                               top_k=20)
+    a1, s1 = sampler(bos, jax.random.PRNGKey(42))
+    a2, s2 = sampler(bos, jax.random.PRNGKey(42))
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s2))
+
+    cold = gpt.make_sampler(params, cfg, 12, temperature=0.0)
+    c_ids, _ = cold(bos, jax.random.PRNGKey(0))
+    g_ids, _ = gpt.make_greedy_decoder(params, cfg, 12)(bos)
+    np.testing.assert_array_equal(np.asarray(c_ids), np.asarray(g_ids))
+
+
+def test_prompt_sampler_cold_matches_prompt_greedy(trained):
+    cfg, params, seq = trained
+    prompt = jnp.asarray(seq[:, :8])
+    max_len = 16
+    cold = gpt.make_sampler(params, cfg, max_len, temperature=0.0,
+                            prompt_len=8)
+    c_ids, c_scores = cold(prompt, jax.random.PRNGKey(0))
+    ref = gpt.make_prompt_decoder(params, cfg, 8, max_len)
+    r_ids, r_scores = ref(prompt)
+    np.testing.assert_array_equal(np.asarray(c_ids), np.asarray(r_ids))
+    np.testing.assert_allclose(np.asarray(c_scores),
+                               np.asarray(r_scores), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_prompt_sampler_low_temp_reproduces_memorized_tail(trained):
+    cfg, params, seq = trained
+    prompt = jnp.asarray(seq[:, :8])
+    sampler = gpt.make_sampler(params, cfg, 16, temperature=0.2,
+                               top_k=5, prompt_len=8)
+    ids, _ = sampler(prompt, jax.random.PRNGKey(3))
+    match = (np.asarray(ids) == seq[:, 8:16]).mean()
+    assert match >= 0.8, match
