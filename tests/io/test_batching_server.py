@@ -169,3 +169,37 @@ def test_batching_server_over_real_predictor(tmp_path):
                                        ref[i:i + 1], rtol=1e-5, atol=1e-6)
     finally:
         srv.close()
+
+
+def test_batching_server_over_tp_predictor(tmp_path):
+    """The full distributed-serving stack composed: C++ micro-batching
+    queue -> bucket-padded Predictor -> GSPMD tensor-parallel execution
+    on a tp=2 mesh. Every concurrent client must get its own rows back,
+    identical to the single-device forward."""
+    import jax
+    from paddle_tpu import inference
+    from paddle_tpu.parallel.mesh import make_mesh
+    # shared model-export + reference-forward recipe (one copy)
+    import sys as _sys, os as _os
+    _sys.path.insert(0, _os.path.join(_os.path.dirname(__file__), "..",
+                                      "parallel"))
+    from test_tp_predictor import _save_bert_classifier
+
+    model_dir, infer_feed, ref = _save_bert_classifier(tmp_path)
+
+    mesh = make_mesh(tp=2, devices=jax.devices()[:2])
+    cfg = (inference.AnalysisConfig(model_dir)
+           .set_batch_buckets([4, 8]).enable_tensor_parallel(mesh))
+    predictor = inference.create_predictor(cfg)
+    srv = serving.BatchingServer(predictor, max_batch=8,
+                                 max_delay_ms=20.0)
+    try:
+        n = next(iter(infer_feed.values())).shape[0]
+        futs = [srv.submit({k: v[i:i + 1] for k, v in infer_feed.items()})
+                for i in range(n)]
+        for i, f in enumerate(futs):
+            np.testing.assert_allclose(np.asarray(f.result(120)[0]),
+                                       ref[i:i + 1], rtol=2e-5,
+                                       atol=2e-6)
+    finally:
+        srv.close()
