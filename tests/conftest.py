@@ -88,6 +88,41 @@ def pytest_sessionfinish(session, exitstatus):
         json.dump(dict(sorted(merged.items())), f, indent=0)
 
 
+@pytest.fixture
+def bert_classifier_export(tmp_path):
+    """(model_dir, infer_feed, ref_probs): ONE copy of the shared
+    save_inference_model + reference-forward recipe (tiny BERT
+    classifier, dropout-off reference) used by the tp-predictor and
+    batching-server serving tests."""
+    import numpy as _np
+    import paddle_tpu as fluid
+    from paddle_tpu.core import framework as _fw
+    from paddle_tpu.models import bert as _bert
+
+    cfg = _bert.bert_tiny()
+    main, startup = _fw.Program(), _fw.Program()
+    with _fw.program_guard(main, startup):
+        _feeds, _loss, _acc, probs = _bert.build_classifier_net(
+            cfg, seq_len=32, num_labels=3)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    full = _bert.make_pretrain_feed(cfg, 32, 4)
+    # the inference inputs: what the classifier FORWARD reads (label
+    # only feeds the loss/acc heads, pruned at save time)
+    infer_names = ["input_mask", "sent_ids", "src_ids"]
+    infer_feed = {k: full[k] for k in infer_names}
+    ref_feed = dict(infer_feed, label=_np.zeros((4, 1), _np.int64))
+    test_prog = main.clone(for_test=True)   # dropout off, like serving
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(
+            str(tmp_path / "m"), infer_names, [probs], exe,
+            main_program=main)
+        ref_out = _np.asarray(exe.run(test_prog, feed=ref_feed,
+                                      fetch_list=[probs])[0])
+    return str(tmp_path / "m"), infer_feed, ref_out
+
+
 @pytest.fixture(autouse=True)
 def _fresh_programs():
     """Each test gets fresh default programs + scope (fluid tests reset

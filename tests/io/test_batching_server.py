@@ -171,7 +171,7 @@ def test_batching_server_over_real_predictor(tmp_path):
         srv.close()
 
 
-def test_batching_server_over_tp_predictor(tmp_path):
+def test_batching_server_over_tp_predictor(bert_classifier_export):
     """The full distributed-serving stack composed: C++ micro-batching
     queue -> bucket-padded Predictor -> GSPMD tensor-parallel execution
     on a tp=2 mesh. Every concurrent client must get its own rows back,
@@ -179,13 +179,8 @@ def test_batching_server_over_tp_predictor(tmp_path):
     import jax
     from paddle_tpu import inference
     from paddle_tpu.parallel.mesh import make_mesh
-    # shared model-export + reference-forward recipe (one copy)
-    import sys as _sys, os as _os
-    _sys.path.insert(0, _os.path.join(_os.path.dirname(__file__), "..",
-                                      "parallel"))
-    from test_tp_predictor import _save_bert_classifier
 
-    model_dir, infer_feed, ref = _save_bert_classifier(tmp_path)
+    model_dir, infer_feed, ref = bert_classifier_export
 
     mesh = make_mesh(tp=2, devices=jax.devices()[:2])
     cfg = (inference.AnalysisConfig(model_dir)

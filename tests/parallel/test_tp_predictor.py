@@ -18,34 +18,8 @@ from paddle_tpu.models import bert
 from paddle_tpu.parallel.mesh import make_mesh
 
 
-def _save_bert_classifier(tmp_path):
-    cfg = bert.bert_tiny()
-    main, startup = framework.Program(), framework.Program()
-    with framework.program_guard(main, startup):
-        feeds, _loss, _acc, probs = bert.build_classifier_net(
-            cfg, seq_len=32, num_labels=3)
-    exe = fluid.Executor()
-    scope = fluid.Scope()
-    full = bert.make_pretrain_feed(cfg, 32, 4)
-    # the inference inputs: what the classifier FORWARD reads (label
-    # only feeds the loss/acc heads, pruned at save time)
-    infer_names = ["input_mask", "sent_ids", "src_ids"]
-    infer_feed = {k: full[k] for k in infer_names}
-    ref_feed = dict(infer_feed,
-                    label=np.zeros((4, 1), np.int64))
-    test_prog = main.clone(for_test=True)   # dropout off, like serving
-    with fluid.scope_guard(scope):
-        exe.run(startup)
-        fluid.io.save_inference_model(
-            str(tmp_path / "m"), infer_names, [probs], exe,
-            main_program=main)
-        ref_out = np.asarray(exe.run(test_prog, feed=ref_feed,
-                                     fetch_list=[probs])[0])
-    return str(tmp_path / "m"), infer_feed, ref_out
-
-
-def test_tp_predictor_matches_single_device(tmp_path):
-    model_dir, feed, ref_out = _save_bert_classifier(tmp_path)
+def test_tp_predictor_matches_single_device(bert_classifier_export):
+    model_dir, feed, ref_out = bert_classifier_export
     mesh = make_mesh(tp=2, devices=jax.devices()[:2])
     cfg = inference.AnalysisConfig(model_dir).enable_tensor_parallel(mesh)
     predictor = inference.create_predictor(cfg)
@@ -58,8 +32,9 @@ def test_tp_predictor_matches_single_device(tmp_path):
                                rtol=0, atol=0)
 
 
-def test_tp_predictor_state_is_sharded_and_step_communicates(tmp_path):
-    model_dir, feed, ref_out = _save_bert_classifier(tmp_path)
+def test_tp_predictor_state_is_sharded_and_step_communicates(
+        bert_classifier_export):
+    model_dir, feed, ref_out = bert_classifier_export
     mesh = make_mesh(tp=2, devices=jax.devices()[:2])
     cfg = inference.AnalysisConfig(model_dir).enable_tensor_parallel(mesh)
     predictor = inference.create_predictor(cfg)
@@ -123,8 +98,8 @@ def test_tp_predictor_serves_fluid_protobuf_export(tmp_path):
     assert sharded >= 4, f"only {sharded} tp-sharded vars (protobuf path)"
 
 
-def test_tp_predictor_composes_with_bf16(tmp_path):
-    model_dir, feed, ref_out = _save_bert_classifier(tmp_path)
+def test_tp_predictor_composes_with_bf16(bert_classifier_export):
+    model_dir, feed, ref_out = bert_classifier_export
     mesh = make_mesh(tp=2, devices=jax.devices()[:2])
     cfg = (inference.AnalysisConfig(model_dir)
            .enable_bf16().enable_tensor_parallel(mesh))
