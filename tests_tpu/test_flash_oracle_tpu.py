@@ -302,6 +302,88 @@ def test_flash_causal_no_visible_keys_tpu():
     assert live_err <= tol, f"live-row err {live_err} > {tol}"
 
 
+def test_prefill_matches_stepwise_on_tpu():
+    """Serving prefill on the compiled Mosaic kernels: the parallel
+    prompt forward (models/gpt.py build_prefill — ONE flash call per
+    layer) must reproduce the sequential KV-cache rollout's cache and
+    last-position logits on real hardware. f32 end-to-end (exact-
+    comparison tier, like the rest of this file); the bf16 serving
+    dtype's kernel behavior is covered by the bf16 flash cases above."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.inference import decoding as dec
+    from paddle_tpu.models import gpt
+
+    cfg = gpt.GPTConfig(vocab_size=1024, hidden_size=256, num_layers=2,
+                        num_heads=4, inner_size=512, max_position=512,
+                        dropout=0.0)
+    d = cfg.hidden_size // cfg.num_heads
+    key = jax.random.PRNGKey(0)
+    params = {"word_emb": jax.random.normal(
+        key, (cfg.vocab_size, cfg.hidden_size), jnp.float32) * 0.02,
+        "pos_emb": jax.random.normal(
+            jax.random.fold_in(key, 1),
+            (cfg.max_position, cfg.hidden_size), jnp.float32) * 0.02,
+        "lnf_s": jnp.ones((cfg.hidden_size,)),
+        "lnf_b": jnp.zeros((cfg.hidden_size,))}
+    for i in range(cfg.num_layers):
+        lk = jax.random.fold_in(key, 10 + i)
+        m, inner = cfg.hidden_size, cfg.inner_size
+        params[f"l{i}"] = {
+            "ln1_s": jnp.ones((m,)), "ln1_b": jnp.zeros((m,)),
+            "ln2_s": jnp.ones((m,)), "ln2_b": jnp.zeros((m,)),
+            "wq": jax.random.normal(lk, (m, m)) * 0.02,
+            "wk": jax.random.normal(jax.random.fold_in(lk, 1),
+                                    (m, m)) * 0.02,
+            "wv": jax.random.normal(jax.random.fold_in(lk, 2),
+                                    (m, m)) * 0.02,
+            "wo": jax.random.normal(jax.random.fold_in(lk, 3),
+                                    (m, m)) * 0.02,
+            "bq": jnp.zeros((m,)), "bk": jnp.zeros((m,)),
+            "bv": jnp.zeros((m,)), "bo": jnp.zeros((m,)),
+            "f0w": jax.random.normal(jax.random.fold_in(lk, 4),
+                                     (m, inner)) * 0.02,
+            "f0b": jnp.zeros((inner,)),
+            "f1w": jax.random.normal(jax.random.fold_in(lk, 5),
+                                     (inner, m)) * 0.02,
+            "f1b": jnp.zeros((m,)),
+        }
+
+    max_len, p = 512, 384
+    prompt = jax.random.randint(jax.random.fold_in(key, 99), (2, p),
+                                3, cfg.vocab_size, jnp.int32)
+    prefill = jax.jit(gpt.build_prefill(params, cfg, max_len))
+    got_cache, got_logits = prefill(prompt)
+
+    step = gpt.build_kv_step(params, cfg, max_len)
+    cache = dec.init_kv_cache(2, cfg.num_layers, cfg.num_heads, max_len,
+                              d)
+
+    def roll(cache, prompt):
+        # scan, NOT a python loop: unrolling p sequential steps into
+        # one graph would take minutes of TPU compile (the window is
+        # precious — this file's own timing test treats that as a hang)
+        def body(c, t):
+            logits, c = step(jnp.take(prompt, t, axis=1), c, t)
+            return c, logits
+
+        cache, logits_seq = jax.lax.scan(body, cache, jnp.arange(p))
+        return cache, logits_seq[-1]
+
+    ref_cache, ref_logits = jax.jit(roll)(cache, prompt)
+    err = max(
+        float(np.max(np.abs(np.asarray(got_cache[i][kv])
+                            - np.asarray(ref_cache[i][kv]))))
+        for i in range(cfg.num_layers) for kv in ("k", "v"))
+    lerr = float(np.max(np.abs(np.asarray(got_logits[:, -1])
+                               - np.asarray(ref_logits))))
+    tol = 5e-4
+    _record("prefill_vs_stepwise_f32", max(err, lerr), tol,
+            {"b": 2, "p": p, "layers": cfg.num_layers,
+             "h": cfg.num_heads, "d": d})
+    assert err <= tol and lerr <= tol, (err, lerr)
+
+
 def test_flash_actually_compiled_not_interpreted():
     """On a real TPU the kernel must take the compiled Mosaic path, not
     the interpreter fallback — otherwise the perf story is fiction."""
