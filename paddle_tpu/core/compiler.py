@@ -201,19 +201,24 @@ def _run_data_parallel(self, compiled, feed, fetch_list, scope, **kwargs):
     mesh = compiled.mesh
     scope = scope if scope is not None else global_scope()
     feed = feed or {}
-    feeds = {k: jnp.asarray(v) for k, v in feed.items()}
-    in_specs = _shard_feeds_spec(feeds, mesh)
-    feeds = {k: jax.device_put(v, in_specs[k]) for k, v in feeds.items()}
-    # Place state across the mesh once; afterwards it stays sharded.
-    program = compiled.program
-    for v in program.list_vars():
-        if v.persistable:
-            val = scope.get(v.name)
-            if val is None:
-                continue
-            want = _var_sharding(v, val, mesh)
-            if not _has_sharding(val, want):
-                scope.set(v.name, jax.device_put(jnp.asarray(val), want))
+    self._stats.count("executor.dp.runs")
+    # feed/state device placement is host work the step can't hide;
+    # span it so dp steps show where their extra ms go
+    with self._stats.span("executor.dp.shard_state",
+                          "executor.dp.shard_state_ms"):
+        feeds = {k: jnp.asarray(v) for k, v in feed.items()}
+        in_specs = _shard_feeds_spec(feeds, mesh)
+        feeds = {k: jax.device_put(v, in_specs[k]) for k, v in feeds.items()}
+        # Place state across the mesh once; afterwards it stays sharded.
+        program = compiled.program
+        for v in program.list_vars():
+            if v.persistable:
+                val = scope.get(v.name)
+                if val is None:
+                    continue
+                want = _var_sharding(v, val, mesh)
+                if not _has_sharding(val, want):
+                    scope.set(v.name, jax.device_put(jnp.asarray(val), want))
     self._active_mesh = mesh
     try:
         with mesh:

@@ -20,6 +20,9 @@ single jitted step function per (program version, feed signature):
   Each random op then folds in its own static op_seed (ops/random_ops.py).
 """
 
+import itertools
+import time
+
 import numpy as np
 
 import jax
@@ -29,6 +32,7 @@ from . import framework
 from .framework import (Program, Variable, grad_var_name, BACKWARD_MARKER,
                         default_main_program)
 from .. import ops as ops_registry
+from ..observability import ComponentStats
 
 
 def _canon_feed(name, value):
@@ -151,6 +155,21 @@ def _lower_block(block, env, program, is_test):
         ops_registry.run_op(op, env, program, is_test)
 
 
+_EXECUTOR_SEQ = itertools.count()
+
+
+def _program_label(program):
+    """Stable-within-process label for compile-time histograms."""
+    return f"program_{id(program) & 0xFFFFFF:06x}_v{program.version}"
+
+
+def _shapes_label(feed_sig):
+    """Compact feed-signature label: 'x:32x4:float32;y:32x1:float32'."""
+    parts = [f"{k}:{'x'.join(map(str, shape)) or 'scalar'}:{dt}"
+             for k, shape, dt in feed_sig]
+    return ";".join(parts)[:160] or "nofeeds"
+
+
 class Executor:
     """Parity: fluid.Executor. place selects the device; XLA owns streams."""
 
@@ -166,13 +185,86 @@ class Executor:
         self._meta_cache = {}   # static per-(program, feeds, fetches) work
         self._step_counter = 0
         self._last_call = None
+        # observability: per-instance counters/histograms mirrored into
+        # the process-wide registry; gauges labeled per-executor there
+        self._exe_id = f"exe{next(_EXECUTOR_SEQ)}"
+        self._stats = ComponentStats(gauge_labels={"executor": self._exe_id})
 
     # ------------------------------------------------------------------
-    def close(self):
+    def clear_caches(self):
+        """Drop the step-fn and metadata caches (counted as evictions)
+        and zero the cache-size gauges."""
+        if self._cache:
+            self._stats.count("executor.jit_cache.evictions",
+                              len(self._cache))
+        if self._meta_cache:
+            self._stats.count("executor.meta_cache.evictions",
+                              len(self._meta_cache))
         self._cache.clear()
         self._meta_cache.clear()
+        self._update_cache_gauges()
+
+    def close(self):
+        self.clear_caches()
+        # a closed executor must not keep reporting cache sizes from the
+        # process-wide registry (stale gauges in long-lived processes)
+        self._stats.drop_gauges("executor.jit_cache.size",
+                                "executor.meta_cache.size")
         self._last_call = None
         self._compiled_pair = None
+
+    def _update_cache_gauges(self):
+        self._stats.set_gauge("executor.jit_cache.size", len(self._cache))
+        self._stats.set_gauge("executor.meta_cache.size",
+                              len(self._meta_cache))
+
+    # -- observability --------------------------------------------------
+    def get_stats(self):
+        """Structured snapshot of this executor's counters and span
+        histograms (docs/observability.md). Cheap; safe to call every
+        step."""
+        local = self._stats.local
+
+        def c(name):
+            m = local.get(name)
+            return int(m.value()) if m is not None else 0
+
+        def h(name):
+            m = local.get(name)
+            return m.summary() if m is not None else \
+                {"count": 0, "sum": 0.0, "min": None, "max": None,
+                 "avg": 0.0}
+
+        compile_hist = local.get("executor.compile_ms")
+        per_key = []
+        if compile_hist is not None:
+            for labels, summ in compile_hist.summaries():
+                if summ["count"]:   # reset_stats keeps zeroed label series
+                    per_key.append(dict(labels, **summ))
+        return {
+            "executor": self._exe_id,
+            "steps": c("executor.steps"),
+            "compiles": c("executor.compiles"),
+            "jit_cache": {"hits": c("executor.jit_cache.hits"),
+                          "misses": c("executor.jit_cache.misses"),
+                          "evictions": c("executor.jit_cache.evictions"),
+                          "size": len(self._cache)},
+            "meta_cache": {"hits": c("executor.meta_cache.hits"),
+                           "misses": c("executor.meta_cache.misses"),
+                           "evictions": c("executor.meta_cache.evictions"),
+                           "size": len(self._meta_cache)},
+            "step_ms": h("executor.step_ms"),
+            "spans": {k: h(f"executor.span.{k}_ms")
+                      for k in ("key_build", "trace", "compile",
+                                "execute", "fetch")},
+            "compile_ms": per_key,
+        }
+
+    def reset_stats(self):
+        """Zero this executor's local counters/histograms (the process-
+        wide registry keeps its cumulative totals)."""
+        self._stats.reset()
+        self._update_cache_gauges()
 
     def _last_compiled(self):
         """AOT-compiled object for the most recent step, memoized for
@@ -319,55 +411,83 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             feed_var_name="feed", fetch_var_name="fetch", return_numpy=True,
             use_program_cache=True):
+        t_step0 = time.perf_counter()
         program = program if program is not None else default_main_program()
         scope = scope if scope is not None else global_scope()
         feed = feed or {}
         fetch_names = tuple(_as_fetch_name(f) for f in (fetch_list or []))
 
-        feeds = {k: _canon_feed(k, v) for k, v in feed.items()}
-        feed_sig = tuple(sorted((k, v.shape, str(v.dtype)) for k, v in feeds.items()))
+        with self._stats.span("executor.key_build",
+                              "executor.span.key_build_ms"):
+            feeds = {k: _canon_feed(k, v) for k, v in feed.items()}
+            feed_sig = tuple(sorted((k, v.shape, str(v.dtype))
+                                    for k, v in feeds.items()))
 
-        # validation + persistable enumeration are static per (program
-        # version, feed keys, fetches) — walking every op each run() cost
-        # ~0.5ms/step on cached small-model steps
-        meta_key = (id(program), program.version,
-                    tuple(sorted(feed)), fetch_names)
-        persist_names = (self._meta_cache.get(meta_key)
-                         if use_program_cache else None)
-        if persist_names is None:
-            # early, friendly validation (parity: fluid's
-            # check_feed_shape_type)
-            gb = program.global_block()
-            for f in fetch_names:
-                base = f[:-5] if f.endswith("@GRAD") else f
-                if not gb.has_var(base):
-                    raise ValueError(
-                        f"fetch target '{f}' is not a variable of this "
-                        f"program")
-            live_ops = gb.ops if program.backward_marker() is not None \
-                else _slice_ops(gb, fetch_names)
-            for v in program.list_vars():
-                if v.is_data and v.name not in feeds and not v.persistable:
-                    if any(v.name in op.input_names for op in live_ops):
+            # validation + persistable enumeration are static per (program
+            # version, feed keys, fetches) — walking every op each run()
+            # cost ~0.5ms/step on cached small-model steps
+            meta_key = (id(program), program.version,
+                        tuple(sorted(feed)), fetch_names)
+            persist_names = (self._meta_cache.get(meta_key)
+                             if use_program_cache else None)
+            if persist_names is None:
+                # a bypassed cache (use_program_cache=False) is not a
+                # miss — counting it would fake a churn problem
+                if use_program_cache:
+                    self._stats.count("executor.meta_cache.misses")
+                # early, friendly validation (parity: fluid's
+                # check_feed_shape_type)
+                gb = program.global_block()
+                for f in fetch_names:
+                    base = f[:-5] if f.endswith("@GRAD") else f
+                    if not gb.has_var(base):
                         raise ValueError(
-                            f"feed variable '{v.name}' is required by the "
-                            f"program but missing from feed={{...}}")
-            persist_names = tuple(sorted(
-                v.name for v in program.list_vars() if v.persistable))
-            if use_program_cache:
-                self._meta_cache[meta_key] = persist_names
-        state = {n: scope.get(n) for n in persist_names if scope.get(n) is not None}
-        state_sig = tuple(sorted(state))
+                            f"fetch target '{f}' is not a variable of this "
+                            f"program")
+                live_ops = gb.ops if program.backward_marker() is not None \
+                    else _slice_ops(gb, fetch_names)
+                for v in program.list_vars():
+                    if v.is_data and v.name not in feeds and not v.persistable:
+                        if any(v.name in op.input_names for op in live_ops):
+                            raise ValueError(
+                                f"feed variable '{v.name}' is required by "
+                                f"the program but missing from feed={{...}}")
+                persist_names = tuple(sorted(
+                    v.name for v in program.list_vars() if v.persistable))
+                if use_program_cache:
+                    self._meta_cache[meta_key] = persist_names
+            else:
+                self._stats.count("executor.meta_cache.hits")
+            state = {n: scope.get(n) for n in persist_names
+                     if scope.get(n) is not None}
+            state_sig = tuple(sorted(state))
 
-        mesh = getattr(self, "_active_mesh", None)
-        mesh_key = None if mesh is None else (id(mesh), tuple(mesh.axis_names))
-        key = (id(program), program.version, feed_sig, fetch_names, state_sig,
-               mesh_key)
+            mesh = getattr(self, "_active_mesh", None)
+            mesh_key = None if mesh is None \
+                else (id(mesh), tuple(mesh.axis_names))
+            key = (id(program), program.version, feed_sig, fetch_names,
+                   state_sig, mesh_key)
         entry = self._cache.get(key) if use_program_cache else None
-        if entry is None:
-            entry = self._build(program, fetch_names, persist_names, state_sig)
+        fresh = entry is None
+        if fresh:
+            if use_program_cache:
+                self._stats.count("executor.jit_cache.misses")
+            else:
+                self._stats.count("executor.uncached_runs")
+            # "trace" span: program -> step-closure construction; the
+            # jaxpr trace + XLA compile happen lazily inside the first
+            # invocation (the "compile" span below)
+            with self._stats.span("executor.trace",
+                                  "executor.span.trace_ms"):
+                entry = self._build(program, fetch_names, persist_names,
+                                    state_sig)
             if use_program_cache:
                 self._cache[key] = entry
+            # sizes only change on an insert (or clear_caches); a pure
+            # hit must not pay two gauge writes
+            self._update_cache_gauges()
+        else:
+            self._stats.count("executor.jit_cache.hits")
         step_fn = entry
 
         seed = program.random_seed or framework.default_seed()
@@ -382,13 +502,34 @@ class Executor:
         self._step_counter += 1
 
         self._last_call = (step_fn, (state, feeds, rng))
-        new_state, fetches = step_fn(state, feeds, rng)
+        if fresh:
+            labels = {"program": _program_label(program),
+                      "shapes": _shapes_label(feed_sig)}
+            t_c0 = time.perf_counter()
+            with self._stats.span("executor.compile",
+                                  "executor.span.compile_ms",
+                                  trace_args=labels):
+                new_state, fetches = step_fn(state, feeds, rng)
+            self._stats.count("executor.compiles")
+            self._stats.observe("executor.compile_ms",
+                                (time.perf_counter() - t_c0) * 1e3,
+                                labels=labels)
+        else:
+            with self._stats.span("executor.execute",
+                                  "executor.span.execute_ms"):
+                new_state, fetches = step_fn(state, feeds, rng)
         for n, v in new_state.items():
             scope.set(n, v)
 
-        if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return list(fetches)
+        with self._stats.span("executor.fetch", "executor.span.fetch_ms"):
+            if return_numpy:
+                out = [np.asarray(f) for f in fetches]
+            else:
+                out = list(fetches)
+        self._stats.count("executor.steps")
+        self._stats.observe("executor.step_ms",
+                            (time.perf_counter() - t_step0) * 1e3)
+        return out
 
     # ------------------------------------------------------------------
     def _build(self, program, fetch_names, persist_names, state_sig):

@@ -1,0 +1,40 @@
+"""Compatibility shims for older jax (this container: jax 0.4.37).
+
+The codebase targets a newer jax surface; on 0.4.37:
+
+- `jax.shard_map` does not exist at top level (it lives under
+  `jax.experimental.shard_map`) and takes `check_rep` where newer jax
+  takes `check_vma`. A translating wrapper is installed as
+  `jax.shard_map`.
+- `jax.export` is a real submodule but is not imported by `import jax`;
+  force the import so attribute access works everywhere.
+
+Import this module FIRST (paddle_tpu/__init__.py and tests/conftest.py
+do) and extend it here rather than try/excepting at call sites.
+"""
+
+import functools
+
+import jax
+
+if not hasattr(jax, "shard_map"):
+    try:
+        from jax.experimental.shard_map import shard_map as _shard_map
+        import inspect as _inspect
+
+        _params = _inspect.signature(_shard_map).parameters
+
+        @functools.wraps(_shard_map)
+        def _compat_shard_map(*args, **kwargs):
+            if "check_vma" in kwargs and "check_vma" not in _params:
+                kwargs["check_rep"] = kwargs.pop("check_vma")
+            return _shard_map(*args, **kwargs)
+
+        jax.shard_map = _compat_shard_map
+    except ImportError:
+        pass
+
+try:
+    import jax.export  # noqa: F401  (binds the lazy submodule attribute)
+except ImportError:
+    pass

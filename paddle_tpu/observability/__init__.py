@@ -1,0 +1,155 @@
+"""Runtime observability: metrics, tracing, and component stats.
+
+Three pieces, wired through the execution stack:
+
+- metrics.py — process-wide MetricsRegistry (counters / gauges /
+  ms-histograms; JSON + Prometheus export; METRIC_SPECS namespace lint).
+- tracing.py — Chrome trace_event recorder (Perfetto-loadable), off by
+  default, enabled by paddle_tpu.profiler.
+- ComponentStats (here) — the per-component view an instrumented object
+  (the Executor) holds: every update lands in BOTH the component's
+  private registry (so Executor.get_stats() answers per-instance
+  questions) and the process-wide one (so an exporter scrapes one place).
+
+jax's own compile-time telemetry is bridged in: a jax.monitoring
+duration listener feeds `executor.backend_compile_ms`, so genuine XLA
+backend-compile seconds are visible next to the framework's wall-clock
+compile span. See docs/observability.md.
+"""
+
+import contextlib
+import time
+
+from . import metrics
+from . import tracing
+from .metrics import (MetricsRegistry, global_registry, METRIC_SPECS,
+                      DEFAULT_MS_BUCKETS)
+from .tracing import TraceRecorder, get_recorder
+
+__all__ = ["metrics", "tracing", "MetricsRegistry", "global_registry",
+           "METRIC_SPECS", "DEFAULT_MS_BUCKETS", "TraceRecorder",
+           "get_recorder", "ComponentStats"]
+
+
+class ComponentStats:
+    """Local + global metrics fan-out for one instrumented component.
+
+    `gauge_labels` (e.g. {"executor": "exe0"}) distinguish this
+    component's gauge series in the process-wide registry — two live
+    Executors must not stomp each other's cache-size gauges. Counters
+    and histograms aggregate unlabeled globally (per-instance numbers
+    come from the local registry via get_stats())."""
+
+    def __init__(self, gauge_labels=None):
+        self.local = MetricsRegistry()
+        self.gauge_labels = dict(gauge_labels or {})
+        # name -> (local metric, global metric) handles, resolved once:
+        # the cached-step hot path calls count/observe ~10x per run and
+        # must not pay registry lock + name-regex on each (the Executor
+        # optimized this path at the ~0.5ms level). reset() zeroes values
+        # in place, so cached handles stay valid across it.
+        self._handles = {}
+
+    def _pair(self, name, method):
+        pair = self._handles.get((name, method))
+        if pair is None:
+            make_local = getattr(self.local, method)
+            make_global = getattr(global_registry(), method)
+            pair = (make_local(name, _help(name)),
+                    make_global(name, _help(name)))
+            self._handles[(name, method)] = pair
+        return pair
+
+    def count(self, name, n=1):
+        local, glob = self._pair(name, "counter")
+        local.inc(n)
+        glob.inc(n)
+
+    def observe(self, name, ms, labels=None):
+        local, glob = self._pair(name, "histogram")
+        (local.labels(**labels) if labels else local).observe(ms)
+        # the global side aggregates UNLABELED: per-(program, shapes)
+        # label sets are unbounded in a long-lived process, so labeled
+        # series live only in this component's registry, which dies with
+        # the component (bounded-cardinality labels like the jax event
+        # names on backend_compile_ms go to the global registry directly)
+        glob.observe(ms)
+
+    def set_gauge(self, name, value):
+        local, glob = self._pair(name, "gauge")
+        local.set(value)
+        (glob.labels(**self.gauge_labels) if self.gauge_labels
+         else glob).set(value)
+
+    def drop_gauges(self, *names):
+        """Zero local gauges and REMOVE this component's global gauge
+        series — a closed executor must not report stale cache sizes
+        forever from a long-lived process."""
+        for name in names:
+            m = self.local.get(name)
+            if m is not None:
+                m.reset()
+            g = global_registry().get(name)
+            if g is not None:
+                if self.gauge_labels:
+                    g.remove(**self.gauge_labels)
+                else:
+                    g.reset()
+
+    @contextlib.contextmanager
+    def span(self, trace_name, metric_name, trace_args=None):
+        """Time a region into metric_name (both registries) and, when a
+        capture is live, into the global Chrome-trace recorder. The
+        metric observes even when the body raises (the trace recorder
+        records the event in its own finally) so timeline and histograms
+        never disagree about what happened."""
+        t0 = time.perf_counter()
+        try:
+            with get_recorder().span(trace_name, cat="executor",
+                                     args=trace_args):
+                yield
+        finally:
+            self.observe(metric_name, (time.perf_counter() - t0) * 1e3)
+
+    def reset(self):
+        self.local.reset()
+
+
+_HELP = {name: help for name, _kind, help in METRIC_SPECS}
+
+
+def _help(name):
+    return _HELP.get(name, "")
+
+
+_monitoring_installed = False
+
+
+def _install_jax_monitoring():
+    """Bridge jax.monitoring duration events (XLA backend compile time
+    etc.) into the global registry. Idempotent; never raises — the
+    runtime must work on jax builds without the monitoring module."""
+    global _monitoring_installed
+    if _monitoring_installed:
+        return
+    try:
+        from jax import monitoring as _jm
+
+        def _on_duration(event, duration, **kwargs):
+            try:
+                if "compile" in event:
+                    global_registry().histogram(
+                        "executor.backend_compile_ms",
+                        _help("executor.backend_compile_ms")).labels(
+                            event=event.strip("/").rsplit("/", 1)[-1]
+                        ).observe(duration * 1e3)
+            except Exception:
+                pass    # telemetry must never break a compile
+
+        _jm.register_event_duration_secs_listener(_on_duration)
+        _monitoring_installed = True
+    except Exception:
+        pass
+
+
+_install_jax_monitoring()

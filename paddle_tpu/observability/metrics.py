@@ -1,0 +1,420 @@
+"""Process-wide metrics: counters, gauges, ms-bucket histograms.
+
+The reference framework exposes per-op profiler events; the TPU-native
+runtime's unit of work is a whole jitted step, so what matters instead is
+*where steps spend time* (key build vs trace vs compile vs execute) and
+*how the compile caches behave* (hit/miss/evict churn is the difference
+between 1ms and 30s steps). This module is the zero-dependency store for
+those numbers: thread-safe, label-aware, exportable as JSON (one line,
+machine-diffable — perf/ artifacts and tools/trace_report.py read it) and
+as Prometheus text exposition (dots sanitized to underscores).
+
+Every metric name the runtime emits is declared in METRIC_SPECS; the
+tier-1 lint (tests/api/test_observability.py) fails on an unregistered or
+duplicate name, so the namespace stays curated as the system grows.
+"""
+
+import contextlib
+import json
+import re
+import threading
+import time
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "global_registry", "DEFAULT_MS_BUCKETS", "METRIC_SPECS",
+]
+
+# Wall-clock millisecond buckets spanning host-dispatch overhead (~0.1ms)
+# through big-model XLA compiles (minutes).
+DEFAULT_MS_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                      100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
+                      10000.0, 30000.0, 60000.0, 120000.0, float("inf"))
+
+
+# Canonical metric namespace: (name, kind, help). The instrumentation
+# and tools/trace_report.py both key off these names; the lint test
+# asserts uniqueness here and that the live registry never strays.
+METRIC_SPECS = [
+    ("ops.traced", "counter",
+     "op dispatches into the jax trace (trace-time, not run-time)"),
+    ("executor.steps", "counter", "Executor.run() calls"),
+    ("executor.step_ms", "histogram", "wall ms of a whole Executor.run()"),
+    ("executor.compiles", "counter",
+     "step functions built AND executed for the first time"),
+    ("executor.compile_ms", "histogram",
+     "first-execution wall ms per (program, shapes): jax trace + XLA "
+     "compile + first device run"),
+    ("executor.backend_compile_ms", "histogram",
+     "XLA backend compile time reported by jax.monitoring, per event"),
+    ("executor.span.key_build_ms", "histogram",
+     "feed canonicalization + cache-key build + program validation"),
+    ("executor.span.trace_ms", "histogram",
+     "program -> step-closure construction on a jit-cache miss"),
+    ("executor.span.compile_ms", "histogram",
+     "first invocation of a fresh step fn (trace+compile+run)"),
+    ("executor.span.execute_ms", "histogram",
+     "cached step fn invocation"),
+    ("executor.span.fetch_ms", "histogram",
+     "fetch conversion (device sync + numpy copy)"),
+    ("executor.jit_cache.hits", "counter", "step-fn cache hits"),
+    ("executor.jit_cache.misses", "counter", "step-fn cache misses"),
+    ("executor.jit_cache.evictions", "counter",
+     "step-fn cache entries dropped (close()/clear_caches())"),
+    ("executor.jit_cache.size", "gauge", "live step-fn cache entries"),
+    ("executor.meta_cache.hits", "counter",
+     "static (program, feed-keys, fetches) metadata cache hits"),
+    ("executor.meta_cache.misses", "counter", "metadata cache misses"),
+    ("executor.meta_cache.evictions", "counter",
+     "metadata cache entries dropped"),
+    ("executor.meta_cache.size", "gauge", "live metadata cache entries"),
+    ("executor.uncached_runs", "counter",
+     "run() calls with use_program_cache=False (caches bypassed, not "
+     "missed)"),
+    ("executor.dp.runs", "counter", "data-parallel (mesh) run() calls"),
+    ("executor.dp.shard_state_ms", "histogram",
+     "feed/state device placement on the data-parallel path"),
+    ("profiler.events", "counter", "profiler.record_event regions"),
+]
+
+
+def _label_key(labels):
+    return tuple(sorted(labels.items())) if labels else ()
+
+
+class _Child:
+    """One (metric, label-set) time series."""
+
+    __slots__ = ("_lock",)
+
+    def __init__(self):
+        self._lock = threading.Lock()
+
+
+class _CounterChild(_Child):
+    __slots__ = ("_value",)
+
+    def __init__(self):
+        super().__init__()
+        self._value = 0
+
+    def inc(self, n=1):
+        if n < 0:
+            raise ValueError("counters only go up; use a gauge")
+        with self._lock:
+            self._value += n
+
+    def value(self):
+        return self._value
+
+    def reset(self):
+        with self._lock:
+            self._value = 0
+
+    def snapshot(self):
+        return {"value": self._value}
+
+
+class _GaugeChild(_Child):
+    __slots__ = ("_value",)
+
+    def __init__(self):
+        super().__init__()
+        self._value = 0
+
+    def set(self, v):
+        with self._lock:
+            self._value = v
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+
+    def dec(self, n=1):
+        self.inc(-n)
+
+    def value(self):
+        return self._value
+
+    def reset(self):
+        self.set(0)
+
+    def snapshot(self):
+        return {"value": self._value}
+
+
+class _HistogramChild(_Child):
+    __slots__ = ("_buckets", "_counts", "_count", "_sum", "_min", "_max")
+
+    def __init__(self, buckets=DEFAULT_MS_BUCKETS):
+        super().__init__()
+        bs = tuple(sorted(buckets))
+        if not bs or bs[-1] != float("inf"):
+            bs = bs + (float("inf"),)
+        self._buckets = bs
+        self._counts = [0] * len(bs)
+        self._count = 0
+        self._sum = 0.0
+        self._min = None
+        self._max = None
+
+    def observe(self, v):
+        v = float(v)
+        with self._lock:
+            for i, le in enumerate(self._buckets):
+                if v <= le:
+                    self._counts[i] += 1
+                    break
+            self._count += 1
+            self._sum += v
+            self._min = v if self._min is None else min(self._min, v)
+            self._max = v if self._max is None else max(self._max, v)
+
+    @contextlib.contextmanager
+    def time_ms(self):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe((time.perf_counter() - t0) * 1e3)
+
+    def value(self):
+        return self._count
+
+    def reset(self):
+        with self._lock:
+            self._counts = [0] * len(self._buckets)
+            self._count = 0
+            self._sum = 0.0
+            self._min = None
+            self._max = None
+
+    def summary(self):
+        with self._lock:
+            avg = self._sum / self._count if self._count else 0.0
+            return {"count": self._count, "sum": round(self._sum, 6),
+                    "min": self._min, "max": self._max,
+                    "avg": round(avg, 6)}
+
+    def snapshot(self):
+        with self._lock:
+            cum, buckets = 0, []
+            for le, c in zip(self._buckets, self._counts):
+                cum += c
+                buckets.append([le if le != float("inf") else "+Inf", cum])
+        out = self.summary()
+        out["buckets"] = buckets
+        return out
+
+
+class _Metric:
+    kind = None
+    _child_cls = None
+
+    def __init__(self, name, help=""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._children = {}
+        self._default = None    # lazily-created no-label child
+
+    def _make_child(self):
+        return self._child_cls()
+
+    def labels(self, **labels):
+        key = _label_key(labels)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._make_child()
+                self._children[key] = child
+                if not key:
+                    self._default = child
+        return child
+
+    def remove(self, **labels):
+        """Drop one label-set's series (e.g. a closed executor's gauges)."""
+        with self._lock:
+            self._children.pop(_label_key(labels), None)
+
+    def _base(self):
+        d = self._default
+        return d if d is not None else self.labels()
+
+    # no-label convenience: metric acts as its own unlabeled child
+    def value(self):
+        return self._base().value()
+
+    def reset(self):
+        with self._lock:
+            children = list(self._children.values())
+        for c in children:
+            c.reset()
+
+    def series(self):
+        """[(labels_dict, child), ...] snapshot."""
+        with self._lock:
+            return [(dict(k), c) for k, c in self._children.items()]
+
+    def snapshot(self):
+        return {"name": self.name, "type": self.kind, "help": self.help,
+                "values": [dict(labels=lbl, **c.snapshot())
+                           for lbl, c in self.series()]}
+
+
+class Counter(_Metric):
+    kind = "counter"
+    _child_cls = _CounterChild
+
+    def inc(self, n=1):
+        self._base().inc(n)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+    _child_cls = _GaugeChild
+
+    def set(self, v):
+        self._base().set(v)
+
+    def inc(self, n=1):
+        self._base().inc(n)
+
+    def dec(self, n=1):
+        self._base().dec(n)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+    _child_cls = _HistogramChild
+
+    def __init__(self, name, help="", buckets=DEFAULT_MS_BUCKETS):
+        super().__init__(name, help)
+        self._buckets_spec = buckets
+
+    def _make_child(self):
+        return _HistogramChild(self._buckets_spec)
+
+    def observe(self, v):
+        self._base().observe(v)
+
+    def time_ms(self):
+        return self._base().time_ms()
+
+    def summary(self):
+        return self._base().summary()
+
+    def summaries(self):
+        return [(lbl, c.summary()) for lbl, c in self.series()]
+
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_.]*$")
+
+
+class MetricsRegistry:
+    """Name -> metric store. Process-wide singleton via global_registry();
+    components (each Executor) also keep a private instance so
+    get_stats() can answer per-instance questions."""
+
+    def __init__(self):
+        self._metrics = {}
+        self._lock = threading.RLock()
+
+    def _get_or_create(self, name, cls, help, **kw):
+        if not _NAME_RE.match(name):
+            raise ValueError(
+                f"bad metric name {name!r}: lowercase dotted identifiers "
+                f"only (pattern {_NAME_RE.pattern})")
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, **kw)
+                self._metrics[name] = m
+            elif type(m) is not cls:
+                raise ValueError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested {cls.kind}")
+            return m
+
+    def counter(self, name, help=""):
+        return self._get_or_create(name, Counter, help)
+
+    def gauge(self, name, help=""):
+        return self._get_or_create(name, Gauge, help)
+
+    def histogram(self, name, help="", buckets=DEFAULT_MS_BUCKETS):
+        return self._get_or_create(name, Histogram, help, buckets=buckets)
+
+    def get(self, name):
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self):
+        with self._lock:
+            return sorted(self._metrics)
+
+    def reset(self):
+        """Zero every series (keeps registrations)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            m.reset()
+
+    def clear(self):
+        """Drop every metric (tests only)."""
+        with self._lock:
+            self._metrics.clear()
+
+    # -- export -------------------------------------------------------------
+    def to_dict(self):
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return {"metrics": [m.snapshot() for m in
+                            sorted(metrics, key=lambda m: m.name)]}
+
+    def to_json(self, indent=None):
+        """One-line JSON by default: perf/ artifacts are parsed line-wise
+        (tools/bench_watch.py _artifact_ok reads the LAST line)."""
+        return json.dumps(self.to_dict(), indent=indent, sort_keys=True)
+
+    def to_prometheus(self):
+        """Prometheus text exposition format, 'name.with.dots' sanitized
+        to legal underscore form."""
+        lines = []
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        for m in metrics:
+            pname = re.sub(r"[^a-zA-Z0-9_:]", "_", m.name)
+            if m.help:
+                lines.append(f"# HELP {pname} {m.help}")
+            lines.append(f"# TYPE {pname} {m.kind}")
+            for lbl, child in m.series():
+                base_lbl = _fmt_labels(lbl)
+                if m.kind in ("counter", "gauge"):
+                    lines.append(f"{pname}{base_lbl} {child.value()}")
+                else:
+                    snap = child.snapshot()
+                    for le, cum in snap["buckets"]:
+                        le_lbl = _fmt_labels(dict(lbl, le=str(le)))
+                        lines.append(f"{pname}_bucket{le_lbl} {cum}")
+                    lines.append(f"{pname}_sum{base_lbl} {snap['sum']}")
+                    lines.append(f"{pname}_count{base_lbl} {snap['count']}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt_labels(labels):
+    if not labels:
+        return ""
+    body = ",".join(f'{k}="{_escape(v)}"' for k, v in sorted(labels.items()))
+    return "{" + body + "}"
+
+
+def _escape(v):
+    return str(v).replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+_GLOBAL = MetricsRegistry()
+
+
+def global_registry():
+    return _GLOBAL

@@ -1,0 +1,49 @@
+"""Shared fluid-style sorted-key event table formatting.
+
+One implementation of the Calls/Total/Min/Max/Ave/Ratio report consumed
+by both paddle_tpu.profiler (live report on stop_profiler) and
+tools/trace_report.py (offline report over a trace dump) — the two must
+never drift apart in columns or sort semantics.
+"""
+
+SORT_KEYS = ("calls", "total", "max", "min", "ave")
+
+__all__ = ["SORT_KEYS", "aggregate_events", "format_event_table"]
+
+
+def aggregate_events(events_ms):
+    """(name, dur_ms) iterable -> insertion-ordered
+    {name: [calls, total_ms, max_ms, min_ms]}."""
+    agg = {}
+    for name, dur_ms in events_ms:
+        row = agg.setdefault(name, [0, 0.0, 0.0, float("inf")])
+        row[0] += 1
+        row[1] += dur_ms
+        row[2] = max(row[2], dur_ms)
+        row[3] = min(row[3], dur_ms)
+    return agg
+
+
+def format_event_table(agg, sorted_key=None, title="Profiling Report",
+                       subtitle=None, limit=50):
+    """-> list of report lines. sorted_key None keeps insertion order;
+    'calls'/'total'/'max'/'min'/'ave' sort descending (fluid parity)."""
+    total = sum(row[1] for row in agg.values()) or 1e-12
+    rows = list(agg.items())
+    if sorted_key is not None:
+        keyfn = {"calls": lambda r: r[1][0],
+                 "total": lambda r: r[1][1],
+                 "max": lambda r: r[1][2],
+                 "min": lambda r: r[1][3],
+                 "ave": lambda r: r[1][1] / r[1][0]}[sorted_key]
+        rows.sort(key=keyfn, reverse=True)
+    lines = [f"------------------------->     {title}     "
+             f"<-------------------------"]
+    if subtitle:
+        lines.append(subtitle)
+    lines.append(f"{'Event':<40}{'Calls':>8}{'Total(ms)':>12}{'Min(ms)':>12}"
+                 f"{'Max(ms)':>12}{'Ave(ms)':>12}{'Ratio':>8}")
+    for name, (calls, tot, mx, mn) in rows[:limit]:
+        lines.append(f"{name[:39]:<40}{calls:>8}{tot:>12.3f}{mn:>12.3f}"
+                     f"{mx:>12.3f}{tot / calls:>12.3f}{tot / total:>8.2%}")
+    return lines

@@ -1,0 +1,119 @@
+"""Structured trace recorder: Chrome trace_event JSON.
+
+Host-side spans (Executor step phases, profiler.record_event regions,
+per-op trace-time dispatch) land here as complete ('X') events and export
+in the chrome://tracing / Perfetto schema, the same format
+utils/timeline.py emits for legacy profiler records. Device-side op
+timelines still come from the jax.profiler trace directory (XProf);
+because ops/__init__.py wraps every dispatch in jax.named_scope, the XLA
+HLO op names in that device trace line up with the framework spans here.
+
+The recorder is OFF by default: a disabled span() costs one attribute
+check, so the Executor can call it unconditionally on the hot path.
+profiler.start_profiler() (or recorder.start()) turns it on.
+"""
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+__all__ = ["TraceRecorder", "get_recorder"]
+
+
+class TraceRecorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events = []
+        self._enabled = False
+        self._t0 = 0.0          # perf_counter origin of ts=0
+        self._epoch0 = 0.0      # wall clock at start() (metadata only)
+        self._pid = os.getpid()
+
+    @property
+    def enabled(self):
+        return self._enabled
+
+    def start(self):
+        """Begin a capture (clears any previous one)."""
+        with self._lock:
+            self._events = []
+            self._t0 = time.perf_counter()
+            self._epoch0 = time.time()
+            self._enabled = True
+
+    def stop(self):
+        self._enabled = False
+
+    def clear(self):
+        with self._lock:
+            self._events = []
+
+    def events(self):
+        with self._lock:
+            return list(self._events)
+
+    # -- recording ----------------------------------------------------------
+    @contextlib.contextmanager
+    def span(self, name, cat="host", args=None):
+        """Time a region into a complete event. No-op while disabled."""
+        if not self._enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            t1 = time.perf_counter()
+            if self._enabled:   # capture may have stopped mid-span: drop
+                self._emit(name, cat, (t0 - self._t0) * 1e6,
+                           (t1 - t0) * 1e6, args)
+
+    def instant(self, name, cat="host", args=None):
+        if not self._enabled:
+            return
+        with self._lock:
+            self._events.append({
+                "ph": "i", "s": "t", "cat": cat, "name": name,
+                "pid": self._pid, "tid": threading.get_ident(),
+                "ts": (time.perf_counter() - self._t0) * 1e6,
+                "args": args or {}})
+
+    def _emit(self, name, cat, ts_us, dur_us, args):
+        evt = {"ph": "X", "cat": cat, "name": name, "pid": self._pid,
+               "tid": threading.get_ident(),
+               "ts": round(ts_us, 3), "dur": round(dur_us, 3),
+               "args": args or {}}
+        with self._lock:
+            self._events.append(evt)
+
+    # -- export -------------------------------------------------------------
+    def to_chrome(self):
+        """{"traceEvents": [...]} with thread ids renumbered small and
+        process/thread metadata ('M') events prepended."""
+        with self._lock:
+            events = [dict(e) for e in self._events]
+        tids = {}
+        for e in events:
+            e["tid"] = tids.setdefault(e["tid"], len(tids))
+        meta = [{"name": "process_name", "ph": "M", "pid": self._pid,
+                 "tid": 0, "args": {"name": "paddle_tpu host"}}]
+        for raw, small in tids.items():
+            meta.append({"name": "thread_name", "ph": "M",
+                         "pid": self._pid, "tid": small,
+                         "args": {"name": f"thread {raw}"}})
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms",
+                "otherData": {"start_epoch_s": self._epoch0}}
+
+    def save(self, path, pretty=False):
+        with open(path, "w") as f:
+            json.dump(self.to_chrome(), f, indent=2 if pretty else None,
+                      separators=None if pretty else (",", ":"))
+
+
+_GLOBAL = TraceRecorder()
+
+
+def get_recorder():
+    return _GLOBAL

@@ -444,8 +444,10 @@ def multiplex(ctx):
     xs = ctx.in_list("X")
     ids = ctx.in_("Ids").reshape(-1).astype(jnp.int32)
     stacked = jnp.stack(xs, axis=0)           # (K, B, ...)
-    return {"Out": jnp.take_along_axis(
-        stacked, ids[None, :, *([None] * (stacked.ndim - 2))], axis=0)[0]}
+    # (None, slice(:), None...) index tuple spelled out — the starred
+    # subscript form needs py3.11+
+    idx = (None, slice(None)) + (None,) * (stacked.ndim - 2)
+    return {"Out": jnp.take_along_axis(stacked, ids[idx], axis=0)[0]}
 
 
 @register("crop", "crop_tensor")

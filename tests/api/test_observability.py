@@ -1,0 +1,344 @@
+"""Observability layer: metrics registry, executor instrumentation,
+Chrome-trace schema, metric-name lint, and the trace_report CLI."""
+
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.observability.metrics import (
+    METRIC_SPECS, MetricsRegistry, global_registry)
+from paddle_tpu.observability.tracing import TraceRecorder, get_recorder
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+
+# ---------------------------------------------------------------------------
+# MetricsRegistry
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("test.hits", "help text")
+    c.inc()
+    c.inc(4)
+    assert c.value() == 5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("test.size")
+    g.set(7)
+    g.dec(2)
+    assert g.value() == 5
+    # same name returns the SAME metric; conflicting kind raises
+    assert reg.counter("test.hits") is c
+    with pytest.raises(ValueError):
+        reg.gauge("test.hits")
+
+
+def test_histogram_buckets_summary_and_timer():
+    reg = MetricsRegistry()
+    h = reg.histogram("test.lat_ms", buckets=(1.0, 10.0, 100.0))
+    for v in (0.5, 5.0, 50.0, 500.0):
+        h.observe(v)
+    s = h.summary()
+    assert s["count"] == 4 and s["min"] == 0.5 and s["max"] == 500.0
+    assert s["sum"] == pytest.approx(555.5)
+    snap = h.snapshot()["values"][0]
+    # cumulative bucket counts, +Inf terminated
+    assert snap["buckets"] == [[1.0, 1], [10.0, 2], [100.0, 3], ["+Inf", 4]]
+    with h.time_ms():
+        pass
+    assert h.summary()["count"] == 5
+
+
+def test_histogram_labels_are_independent_series():
+    reg = MetricsRegistry()
+    h = reg.histogram("test.compile_ms")
+    h.labels(program="a").observe(10.0)
+    h.labels(program="b").observe(20.0)
+    by_label = {lbl.get("program"): s for lbl, s in h.summaries()}
+    assert by_label["a"]["count"] == 1 and by_label["b"]["sum"] == 20.0
+
+
+def test_registry_json_and_prometheus_export():
+    reg = MetricsRegistry()
+    reg.counter("test.hits", "hit count").inc(3)
+    reg.histogram("test.ms", buckets=(1.0,)).observe(0.5)
+    reg.gauge("test.size").labels(executor="exe0").set(2)
+    dump = json.loads(reg.to_json())
+    by_name = {m["name"]: m for m in dump["metrics"]}
+    assert by_name["test.hits"]["values"][0]["value"] == 3
+    assert by_name["test.size"]["values"][0]["labels"] == {"executor": "exe0"}
+    prom = reg.to_prometheus()
+    assert "# TYPE test_hits counter" in prom
+    assert "test_hits 3" in prom
+    assert 'test_size{executor="exe0"} 2' in prom
+    assert 'test_ms_bucket{le="+Inf"} 1' in prom
+    assert "test_ms_count 1" in prom
+
+
+def test_registry_rejects_bad_names():
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError):
+        reg.counter("Bad Name!")
+
+
+def test_registry_thread_safety_smoke():
+    reg = MetricsRegistry()
+    c = reg.counter("test.n")
+
+    def spin():
+        for _ in range(1000):
+            c.inc()
+    ts = [threading.Thread(target=spin) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert c.value() == 4000
+
+
+# ---------------------------------------------------------------------------
+# TraceRecorder / Chrome trace schema
+# ---------------------------------------------------------------------------
+
+def test_trace_recorder_chrome_schema_roundtrip(tmp_path):
+    rec = TraceRecorder()
+    with rec.span("ignored_before_start"):
+        pass
+    assert rec.events() == []          # disabled spans record nothing
+    rec.start()
+    with rec.span("phase_a", cat="executor", args={"k": "v"}):
+        with rec.span("inner"):
+            pass
+    rec.instant("marker")
+    rec.stop()
+    path = tmp_path / "trace.json"
+    rec.save(str(path))
+    trace = json.loads(path.read_text())
+    events = trace["traceEvents"]
+    xs = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"phase_a", "inner"}
+    a = next(e for e in xs if e["name"] == "phase_a")
+    assert a["cat"] == "executor" and a["args"] == {"k": "v"}
+    assert a["dur"] >= 0 and a["ts"] >= 0
+    assert any(e["ph"] == "M" and e["name"] == "process_name"
+               for e in events)
+    assert any(e["ph"] == "i" and e["name"] == "marker" for e in events)
+    # thread ids are renumbered small for readable Perfetto tracks
+    assert all(e["tid"] < 64 for e in xs)
+
+
+# ---------------------------------------------------------------------------
+# Executor instrumentation (the ISSUE acceptance scenario)
+# ---------------------------------------------------------------------------
+
+def _build_train_program():
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    loss = layers.mean(layers.square_error_cost(layers.fc(x, size=8), y))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def _feed(batch=8):
+    return {"x": np.ones((batch, 4), np.float32),
+            "y": np.zeros((batch, 1), np.float32)}
+
+
+def test_cached_three_step_loop_stats():
+    loss = _build_train_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    exe.reset_stats()
+    for _ in range(3):
+        exe.run(feed=_feed(), fetch_list=[loss])
+    s = exe.get_stats()
+    assert s["steps"] == 3
+    assert s["compiles"] == 1
+    # size 2: the startup-program entry + the train-step entry (caches
+    # survive reset_stats; only counters were zeroed)
+    assert s["jit_cache"] == {"hits": 2, "misses": 1, "evictions": 0,
+                              "size": 2}
+    assert s["meta_cache"]["hits"] == 2 and s["meta_cache"]["misses"] == 1
+    # non-zero step-span histograms
+    assert s["step_ms"]["count"] == 3 and s["step_ms"]["sum"] > 0
+    assert s["spans"]["key_build"]["count"] == 3
+    assert s["spans"]["trace"]["count"] == 1
+    assert s["spans"]["compile"]["count"] == 1
+    assert s["spans"]["execute"]["count"] == 2
+    assert s["spans"]["fetch"]["count"] == 3
+    assert all(s["spans"][k]["sum"] > 0 for k in s["spans"])
+    # per-(program, shapes) compile histogram
+    assert len(s["compile_ms"]) == 1
+    entry = s["compile_ms"][0]
+    assert entry["count"] == 1 and entry["sum"] > 0
+    assert "x:8x4:float32" in entry["shapes"]
+
+
+def test_shape_change_is_a_miss_and_new_compile():
+    loss = _build_train_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    exe.reset_stats()
+    exe.run(feed=_feed(8), fetch_list=[loss])
+    exe.run(feed=_feed(16), fetch_list=[loss])
+    s = exe.get_stats()
+    assert s["compiles"] == 2
+    assert s["jit_cache"]["misses"] == 2 and s["jit_cache"]["hits"] == 0
+    assert len(s["compile_ms"]) == 2
+
+
+def test_close_counts_evictions_and_resets_gauges():
+    loss = _build_train_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    exe.run(feed=_feed(), fetch_list=[loss])
+    assert exe.get_stats()["jit_cache"]["size"] == 2
+    exe_id = exe._exe_id
+    exe.close()
+    s = exe.get_stats()
+    assert s["jit_cache"]["size"] == 0 and s["meta_cache"]["size"] == 0
+    assert s["jit_cache"]["evictions"] == 2
+    assert s["meta_cache"]["evictions"] == 2
+    # the process-wide gauge series for this executor is GONE, not stale
+    g = global_registry().get("executor.jit_cache.size")
+    assert not any(lbl.get("executor") == exe_id for lbl, _ in g.series())
+
+
+def test_reset_stats_zeroes_counters_but_keeps_cache():
+    loss = _build_train_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    exe.run(feed=_feed(), fetch_list=[loss])
+    exe.reset_stats()
+    s = exe.get_stats()
+    assert s["steps"] == 0 and s["compiles"] == 0
+    # caches survived: the next identical run is a pure hit
+    exe.run(feed=_feed(), fetch_list=[loss])
+    s = exe.get_stats()
+    assert s["jit_cache"]["hits"] == 1 and s["compiles"] == 0
+
+
+def test_executor_spans_land_in_trace_capture():
+    loss = _build_train_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rec = get_recorder()
+    rec.start()
+    try:
+        exe.run(feed=_feed(), fetch_list=[loss])
+        exe.run(feed=_feed(), fetch_list=[loss])
+    finally:
+        rec.stop()
+    names = [e["name"] for e in rec.events()]
+    rec.clear()
+    for expected in ("executor.key_build", "executor.trace",
+                     "executor.compile", "executor.execute",
+                     "executor.fetch"):
+        assert expected in names, names
+    # per-op trace-time dispatch is captured too (ops registry spans)
+    assert any(n.startswith("op:") for n in names)
+
+
+# ---------------------------------------------------------------------------
+# metric-name lint: the registry namespace stays declared & duplicate-free
+# ---------------------------------------------------------------------------
+
+def test_sort_keys_stay_in_sync_across_consumers():
+    # observability.report is the source of truth; trace_report keeps a
+    # literal copy so its --help avoids the framework import
+    import trace_report as tr
+    from paddle_tpu import profiler
+    from paddle_tpu.observability.report import SORT_KEYS
+    assert tr.SORT_KEYS == SORT_KEYS
+    assert profiler._VALID_SORT_KEYS == (None,) + SORT_KEYS
+
+
+def test_metric_specs_have_no_duplicates():
+    names = [n for n, _k, _h in METRIC_SPECS]
+    assert len(names) == len(set(names)), "duplicate metric declared"
+
+
+def test_live_registry_names_are_all_declared():
+    # drive every instrumented path once so the registry is populated
+    loss = _build_train_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    exe.run(feed=_feed(), fetch_list=[loss])
+    from paddle_tpu import profiler
+    with profiler.record_event("lint_probe"):
+        pass
+    spec = {n: k for n, k, _h in METRIC_SPECS}
+    reg = global_registry()
+    for name in reg.names():
+        assert name in spec, f"metric {name!r} not declared in METRIC_SPECS"
+        assert reg.get(name).kind == spec[name], name
+    # and both instance registries obey the same contract
+    for name in exe._stats.local.names():
+        assert name in spec, name
+
+
+# ---------------------------------------------------------------------------
+# trace_report CLI
+# ---------------------------------------------------------------------------
+
+def test_trace_report_on_profiler_output(tmp_path, capsys):
+    import trace_report as tr
+    from paddle_tpu import profiler
+
+    loss = _build_train_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    exe.reset_stats()
+    base = tmp_path / "prof"
+    with profiler.profiler(state="CPU", sorted_key="total",
+                           profile_path=str(base)):
+        for _ in range(3):
+            exe.run(feed=_feed(), fetch_list=[loss])
+    metrics_path = tmp_path / "metrics.json"
+    dump = global_registry().to_dict()
+    dump["executor_stats"] = exe.get_stats()
+    metrics_path.write_text(json.dumps(dump))
+    capsys.readouterr()
+
+    rc = tr.main([str(base) + ".timeline.json",
+                  "--metrics", str(metrics_path),
+                  "--sorted-key", "total"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Trace Report" in out
+    assert "executor.compile" in out
+    assert "Cache Efficiency" in out
+    assert "jit_cache" in out and "hit-rate" in out
+
+
+def test_trace_report_parses_legacy_record_format(tmp_path, capsys):
+    import trace_report as tr
+    path = tmp_path / "legacy.json"
+    path.write_text(json.dumps(
+        [{"name": "old_style", "start_s": 0.0, "dur_s": 0.25, "tid": 1}]))
+    assert tr.main([str(path)]) == 0
+    assert "old_style" in capsys.readouterr().out
+
+
+def test_trace_report_demo_smoke(tmp_path, capsys):
+    import trace_report as tr
+    rc = tr.main(["--demo", "--out-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert (tmp_path / "metrics_sample.json").exists()
+    assert (tmp_path / "trace_sample.timeline.json").exists()
+    # sample dump is single-line JSON (bench_watch parses line-wise)
+    text = (tmp_path / "metrics_sample.json").read_text()
+    assert len(text.strip().splitlines()) == 1
+    stats = json.loads(text)["executor_stats"]
+    assert stats["compiles"] == 1 and stats["jit_cache"]["hits"] == 2
+    assert "Cache Efficiency" in out

@@ -1,0 +1,83 @@
+"""Fluid 1.5 profiler API compatibility of the rewritten backend."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, profiler
+
+
+def test_profiler_context_prints_sorted_table_and_writes_trace(
+        tmp_path, capsys):
+    base = tmp_path / "prof"
+    with profiler.profiler(state="CPU", sorted_key="total",
+                           profile_path=str(base)):
+        with profiler.record_event("alpha"):
+            time.sleep(0.01)
+        with profiler.record_event("beta"):
+            time.sleep(0.002)
+    out = capsys.readouterr().out
+    assert "Profiling Report" in out
+    assert "Sorted by: total" in out
+    assert "alpha" in out and "beta" in out
+    assert "Calls" in out and "Ratio" in out
+    # sorted_key='total' puts the slower event first
+    assert out.index("alpha") < out.index("beta")
+
+    # legacy record format at profile_path (utils.timeline input)
+    records = json.loads(base.read_text())
+    assert {r["name"] for r in records} == {"alpha", "beta"}
+    # chrome trace alongside, loadable in Perfetto
+    trace = json.loads((tmp_path / "prof.timeline.json").read_text())
+    names = {e["name"] for e in trace["traceEvents"] if e["ph"] == "X"}
+    assert {"alpha", "beta"} <= names
+
+
+def test_profiler_trace_includes_executor_spans(tmp_path):
+    x = layers.data("x", shape=[4], dtype="float32")
+    out_v = layers.fc(x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    base = tmp_path / "prof"
+    with profiler.profiler(state="CPU", profile_path=str(base)):
+        with profiler.record_event("run_region"):
+            exe.run(feed={"x": np.ones((2, 4), np.float32)},
+                    fetch_list=[out_v])
+    trace = json.loads((tmp_path / "prof.timeline.json").read_text())
+    names = {e["name"] for e in trace["traceEvents"] if e["ph"] == "X"}
+    assert "run_region" in names
+    assert "executor.compile" in names and "executor.key_build" in names
+
+
+def test_invalid_state_and_sorted_key_raise():
+    with pytest.raises(ValueError):
+        profiler.start_profiler(state="TPUZ")
+    with pytest.raises(ValueError):
+        profiler.stop_profiler(sorted_key="bogus")
+    with pytest.raises(ValueError):
+        with profiler.profiler(sorted_key="bogus"):
+            pass
+
+
+def test_cuda_and_npu_profiler_deprecation_warnings(capsys):
+    with pytest.warns(DeprecationWarning, match="cuda_profiler is "
+                      "deprecated on paddle_tpu"):
+        with profiler.cuda_profiler():
+            with profiler.record_event("cuda_region"):
+                pass
+    assert "cuda_region" in capsys.readouterr().out
+    with pytest.warns(DeprecationWarning, match="npu_profiler is "
+                      "deprecated on paddle_tpu"):
+        with profiler.npu_profiler():
+            pass
+
+
+def test_reset_profiler_clears_events(capsys):
+    with profiler.record_event("gone"):
+        pass
+    profiler.reset_profiler()
+    profiler.stop_profiler(profile_path=None)
+    assert "gone" not in capsys.readouterr().out

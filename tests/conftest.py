@@ -21,6 +21,8 @@ import pytest
 
 import jax
 
+import paddle_tpu.jax_compat  # noqa: F401  (shims for this jax version)
+
 # A TPU plugin registered by the interpreter's sitecustomize (e.g. axon)
 # may have force-set jax_platforms via config.update, which overrides the
 # JAX_PLATFORMS env var above. Re-assert cpu-only AFTER importing jax so

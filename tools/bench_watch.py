@@ -166,6 +166,18 @@ def run_suite():
                  timeout_s=1200, stdout_path="bench_tiny.json")
     if not _tunnel_still_ok("tiny"):
         return False
+    # 1b. observability sample: metrics dump + chrome trace from a tiny
+    #     cached 3-step loop (tools/trace_report.py --demo). Runs on the
+    #     CPU backend on purpose — deterministic, and never a second
+    #     concurrent TPU init racing the ladder.
+    if _artifact_ok("metrics_sample.json"):
+        log("step metrics_sample: already landed in a prior cycle — skipping")
+    else:
+        run_step("metrics_sample",
+                 [py, os.path.join(REPO, "tools", "trace_report.py"),
+                  "--demo", "--out-dir", PERF],
+                 env={"JAX_PLATFORMS": "cpu"},
+                 timeout_s=600, stdout_path="metrics_report.txt")
     # 2. headline: ERNIE-base, full sweep, HLO of the best batch archived
     if _artifact_ok("bench_ernie.json"):
         log("step ernie: already landed in a prior cycle — skipping")

@@ -1,0 +1,214 @@
+"""Offline trace/metrics report: sorted-key table + cache efficiency.
+
+Loads a Chrome trace_event JSON (written by paddle_tpu.profiler /
+observability.tracing, or the legacy record-list format) and/or a
+metrics dump (observability MetricsRegistry.to_json()) and prints:
+
+- a fluid-style sorted-key table (Calls/Total/Min/Max/Ave/Ratio per
+  event name), and
+- a cache-efficiency summary (jit/meta cache hit rates, compile count
+  and total compile time) from the executor metrics.
+
+Usage:
+    python tools/trace_report.py TRACE.json [--metrics METRICS.json]
+        [--sorted-key total] [--limit 30]
+    python tools/trace_report.py --demo [--out-dir perf]
+
+--demo runs a tiny cached 3-step training loop on CPU, writes
+`trace_sample.timeline.json` + `metrics_sample.json` into --out-dir,
+then reports on them — the zero-to-trace smoke path, also invoked by
+tools/bench_watch.py so every hardware window refreshes the committed
+sample under perf/.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# mirror of paddle_tpu.observability.report.SORT_KEYS, duplicated so
+# `--help` never pays the full framework import; a drift guard in
+# tests/api/test_observability.py keeps them identical
+SORT_KEYS = ("calls", "total", "max", "min", "ave")
+
+
+# ---------------------------------------------------------------------------
+# loading
+# ---------------------------------------------------------------------------
+
+def load_trace_events(path):
+    """-> [(name, dur_ms, cat)] from any of the three on-disk shapes:
+    {"traceEvents": [...]}, a bare event list, or the legacy profiler
+    record list [{"name","start_s","dur_s","tid"}]."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        events = data.get("traceEvents")
+        if events is None:
+            raise ValueError(f"{path}: no 'traceEvents' key")
+    elif isinstance(data, list):
+        events = data
+    else:
+        raise ValueError(f"{path}: expected JSON object or array")
+    out = []
+    for e in events:
+        if not isinstance(e, dict):
+            continue
+        if "dur_s" in e:                      # legacy record format
+            out.append((e["name"], float(e["dur_s"]) * 1e3, "host"))
+        elif e.get("ph") == "X":
+            out.append((e["name"], float(e.get("dur", 0.0)) / 1e3,
+                        e.get("cat", "")))
+    return out
+
+
+def load_metrics(path):
+    """-> {name: snapshot} from MetricsRegistry.to_dict() JSON."""
+    with open(path) as f:
+        data = json.load(f)
+    metrics = data.get("metrics", []) if isinstance(data, dict) else []
+    return {m["name"]: m for m in metrics if isinstance(m, dict)}
+
+
+# ---------------------------------------------------------------------------
+# reporting
+# ---------------------------------------------------------------------------
+
+def print_event_table(events, sorted_key="total", limit=30, file=None):
+    file = file if file is not None else sys.stdout
+    # shared formatter with paddle_tpu.profiler (imported lazily so
+    # `--help` stays instant; a report run imports the framework anyway)
+    from paddle_tpu.observability.report import (aggregate_events,
+                                                 format_event_table)
+    agg = aggregate_events((name, dur_ms) for name, dur_ms, _cat in events)
+    for line in format_event_table(
+            agg, sorted_key, title="Trace Report",
+            subtitle=f"Events: {len(events)}    "
+                     f"Sorted by: {sorted_key or 'order'}", limit=limit):
+        print(line, file=file)
+
+
+def _counter_total(metrics, name):
+    m = metrics.get(name)
+    if not m:
+        return 0
+    return sum(v.get("value", 0) for v in m.get("values", []))
+
+
+def _hist_totals(metrics, name):
+    m = metrics.get(name)
+    if not m:
+        return 0, 0.0
+    count = sum(v.get("count", 0) for v in m.get("values", []))
+    total = sum(v.get("sum", 0.0) for v in m.get("values", []))
+    return count, total
+
+
+def print_cache_summary(metrics, file=None):
+    file = file if file is not None else sys.stdout
+    print("--------------------->    Cache Efficiency    <---------------------",
+          file=file)
+    for cache in ("jit_cache", "meta_cache"):
+        hits = _counter_total(metrics, f"executor.{cache}.hits")
+        misses = _counter_total(metrics, f"executor.{cache}.misses")
+        evict = _counter_total(metrics, f"executor.{cache}.evictions")
+        lookups = hits + misses
+        rate = hits / lookups if lookups else 0.0
+        print(f"{cache:<12} hits={hits:<8} misses={misses:<8} "
+              f"evictions={evict:<6} hit-rate={rate:.1%}", file=file)
+    compiles = _counter_total(metrics, "executor.compiles")
+    ccount, ctotal = _hist_totals(metrics, "executor.compile_ms")
+    bcount, btotal = _hist_totals(metrics, "executor.backend_compile_ms")
+    steps = _counter_total(metrics, "executor.steps")
+    scount, stotal = _hist_totals(metrics, "executor.step_ms")
+    print(f"compiles={compiles} compile_time={ctotal / 1e3:.2f}s "
+          f"(xla backend events: {bcount}, {btotal / 1e3:.2f}s)", file=file)
+    if steps:
+        print(f"steps={steps} avg_step={stotal / max(scount, 1):.3f}ms",
+              file=file)
+    if steps and compiles:
+        amort = ctotal / steps
+        print(f"amortized compile cost: {amort:.3f}ms/step over this run",
+              file=file)
+
+
+# ---------------------------------------------------------------------------
+# --demo: generate a sample trace + metrics dump from a tiny cached loop
+# ---------------------------------------------------------------------------
+
+def run_demo(out_dir):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, profiler
+    from paddle_tpu.observability.metrics import global_registry
+
+    os.makedirs(out_dir, exist_ok=True)
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    loss = layers.mean(layers.square_error_cost(layers.fc(x, size=8), y))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    exe.reset_stats()
+
+    trace_base = os.path.join(out_dir, "trace_sample")
+    rng = np.random.RandomState(0)
+    with profiler.profiler(state="CPU", sorted_key="total",
+                           profile_path=trace_base):
+        for _ in range(3):      # 1 compile + 2 jit-cache hits
+            with profiler.record_event("demo_step"):
+                exe.run(feed={"x": rng.randn(8, 4).astype(np.float32),
+                              "y": rng.randn(8, 1).astype(np.float32)},
+                        fetch_list=[loss])
+
+    metrics_path = os.path.join(out_dir, "metrics_sample.json")
+    dump = global_registry().to_dict()
+    dump["executor_stats"] = exe.get_stats()
+    with open(metrics_path, "w") as f:
+        # single line: perf/ artifacts are parsed line-wise by
+        # tools/bench_watch.py's _artifact_ok
+        json.dump(dump, f, sort_keys=True)
+        f.write("\n")
+    return trace_base + ".timeline.json", metrics_path
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="sorted-key table + cache summary from a trace/metrics "
+                    "dump")
+    ap.add_argument("trace", nargs="?", help="Chrome trace JSON (or legacy "
+                    "profiler records)")
+    ap.add_argument("--metrics", help="metrics dump JSON "
+                    "(MetricsRegistry.to_json())")
+    ap.add_argument("--sorted-key", default="total",
+                    choices=SORT_KEYS, help="table sort column")
+    ap.add_argument("--limit", type=int, default=30,
+                    help="max table rows")
+    ap.add_argument("--demo", action="store_true",
+                    help="generate sample trace+metrics from a tiny cached "
+                    "loop, then report on them")
+    ap.add_argument("--out-dir", default="/tmp/paddle_tpu_obs",
+                    help="--demo output directory")
+    args = ap.parse_args(argv)
+
+    trace_path, metrics_path = args.trace, args.metrics
+    if args.demo:
+        trace_path, metrics_path = run_demo(args.out_dir)
+        print(f"demo artifacts: {trace_path} {metrics_path}")
+    if not trace_path and not metrics_path:
+        ap.error("nothing to report: pass a trace file, --metrics, "
+                 "or --demo")
+    if trace_path:
+        events = load_trace_events(trace_path)
+        print_event_table(events, sorted_key=args.sorted_key,
+                          limit=args.limit)
+    if metrics_path:
+        print_cache_summary(load_metrics(metrics_path))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
