@@ -516,6 +516,177 @@ def build_gpt_decode_step(batch, seq_len):
     return step, batch * max_len, flops
 
 
+def run_async_compare(kind):
+    """BENCH_ASYNC_COMPARE=1: the async-pipeline acceptance micro-bench
+    (CPU backend, tiny MLP). Two comparisons, one JSON line:
+
+    - headline `value`: steps/sec over a DYNAMIC-batch stream (32
+      distinct batch sizes, several epochs) — the naive sync loop
+      recompiles once per distinct shape, async+FeedBucketer holds the
+      jit cache at <= 6 power-of-2 entries and pipelines dispatch.
+      This is the workload the tentpole exists for, and the ratio is
+      dominated by compile counts (32 vs 6), so it is robust to the
+      +-15% scheduler noise of a shared 2-core container.
+    - steady state: fixed-shape steps/sec for sync vs async vs
+      async+bucketed (interleaved best-of-N rounds), reported alongside
+      — the dispatch-overlap win alone. Expect ~0.9-1.3x HERE: the CPU
+      "device" competes with the host for the same two cores, so there
+      is no independent resource to overlap against (on TPU the device
+      is separate silicon; see docs/performance.md).
+    """
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.core import framework
+    from paddle_tpu.core.bucketing import FeedBucketer
+    from paddle_tpu.core.executor import Scope, scope_guard
+
+    # small-model regime on purpose: the per-step host sync the async
+    # window removes is a FIXED cost, so the tiny config is where the
+    # pipeline's effect is visible (and the acceptance bar lives)
+    hidden = int(os.environ.get("BENCH_ASYNC_HIDDEN", 64))
+    batch = int(os.environ.get("BENCH_ASYNC_BATCH", 64))
+    steps = int(os.environ.get("BENCH_ASYNC_STEPS", 600))
+    depth = int(os.environ.get("BENCH_ASYNC_LAYERS", 8))
+    window = int(os.environ.get("BENCH_ASYNC_WINDOW", 2))
+    rng = np.random.default_rng(0)
+
+    # masked loss so the same program serves the fixed-shape loops AND
+    # the bucketed dynamic-batch sweep: padded rows carry mask 0 and are
+    # exact no-ops for loss and gradients
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = layers.data("x", shape=[hidden], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        m = layers.data("batch_mask", shape=[1], dtype="float32")
+        h = x
+        for _ in range(depth):
+            h = layers.fc(h, size=hidden, act="relu")
+        per = layers.square_error_cost(layers.fc(h, size=1), y)
+        loss = layers.reduce_sum(per * m) / layers.reduce_sum(m)
+        fluid.optimizer.SGDOptimizer(learning_rate=0.01).minimize(loss)
+
+    def fresh_exe():
+        scope = Scope()
+        exe = fluid.Executor(fluid.TPUPlace(0), async_window=window)
+        with scope_guard(scope):
+            exe.run(startup)
+        return exe, scope
+
+    def make_feed(n):
+        return {"x": rng.standard_normal((n, hidden)).astype(np.float32),
+                "y": rng.standard_normal((n, 1)).astype(np.float32),
+                "batch_mask": np.ones((n, 1), np.float32)}
+
+    feeds = [make_feed(batch) for _ in range(8)]   # rotate: no same-array
+    #                                               shortcuts across modes
+
+    def timed(fn, exe, scope, batches):
+        with scope_guard(scope):
+            fn(batches[0])                         # warm (compile done)
+            exe.drain()                            # settle before timing
+            t0 = time.perf_counter()
+            for i in range(steps):
+                fn(batches[i % len(batches)])
+            exe.drain()   # close the window: dispatched steps completed
+        return steps / (time.perf_counter() - t0)
+
+    # three persistent mode setups, measured in interleaved rounds with
+    # the per-mode BEST kept: a 2-core container shares its cycles with
+    # whatever else runs, and one background burst must not decide which
+    # MODE looks faster
+    exe_s, scope_s = fresh_exe()           # 1. sync: numpy loss in hand
+    exe_a, scope_a = fresh_exe()           # 2. async window
+    exe_b, scope_b = fresh_exe()           # 3. async + FeedBucketer
+    bucketer = FeedBucketer(mask_name="batch_mask")
+    nomask = [{k: v for k, v in f.items() if k != "batch_mask"}
+              for f in feeds]
+    modes = {
+        "sync": lambda r: timed(lambda f: exe_s.run(
+            main, feed=f, fetch_list=[loss]), exe_s, scope_s, feeds),
+        "async": lambda r: timed(lambda f: exe_a.run_async(
+            main, feed=f, fetch_list=[loss]), exe_a, scope_a, feeds),
+        "bucketed": lambda r: timed(lambda f: exe_b.run_async(
+            main, feed=f, fetch_list=[loss], bucketer=bucketer),
+            exe_b, scope_b, nomask),
+    }
+    rates = {name: 0.0 for name in modes}
+    for _round in range(int(os.environ.get("BENCH_ASYNC_ROUNDS", 3))):
+        for name, fn in modes.items():
+            rates[name] = max(rates[name], fn(_round))
+    sync_sps = rates["sync"]
+    async_sps = rates["async"]
+    bucketed_sps = rates["bucketed"]
+
+    # 4. dynamic-batch stream — THE acceptance comparison. 32 DISTINCT
+    #    batch sizes cycled for `epochs` passes:
+    #    - naive sync loop: one XLA compile per distinct shape (32),
+    #      numpy fetch + device sync every step;
+    #    - async + FeedBucketer: power-of-2 padding holds the jit cache
+    #      at <= 6 entries (1..32 -> {1,2,4,8,16,32}) and the in-flight
+    #      window pipelines dispatch.
+    sizes = list(range(1, 33))
+    epochs = int(os.environ.get("BENCH_ASYNC_EPOCHS", 4))
+    dyn_masked = [make_feed(n) for n in sizes]              # mask of ones
+    dyn_nomask = [{k: v for k, v in f.items() if k != "batch_mask"}
+                  for f in dyn_masked]
+    n_dyn = len(sizes) * epochs
+
+    exe_ds, scope_ds = fresh_exe()                          # sync baseline
+    with scope_guard(scope_ds):
+        t0 = time.perf_counter()
+        for i in range(n_dyn):
+            exe_ds.run(main, feed=dyn_masked[i % len(sizes)],
+                       fetch_list=[loss])
+        dyn_sync_sps = n_dyn / (time.perf_counter() - t0)
+    sync_entries = exe_ds.get_stats()["jit_cache"]["size"] - 1  # - startup
+
+    exe_d, scope_d = fresh_exe()                            # async+bucketed
+    sweep_bucketer = FeedBucketer(mask_name="batch_mask")
+    base_entries = exe_d.get_stats()["jit_cache"]["size"]       # startup fn
+    with scope_guard(scope_d):
+        t0 = time.perf_counter()
+        stream = (dyn_nomask[i % len(sizes)] for i in range(n_dyn))
+        dyn_out = list(exe_d.run_pipelined(
+            main, stream, fetch_list=[loss], bucketer=sweep_bucketer,
+            window=window, return_numpy=False))
+        exe_d.drain()
+        dyn_bucketed_sps = n_dyn / (time.perf_counter() - t0)
+    dyn_entries = exe_d.get_stats()["jit_cache"]["size"] - base_entries
+    assert len(dyn_out) == n_dyn
+
+    speedup = dyn_bucketed_sps / dyn_sync_sps if dyn_sync_sps else None
+    result = {
+        "metric": "async_bucketed_speedup_vs_sync_dynamic_batches",
+        "value": round(speedup, 3) if speedup else None,
+        "unit": "x (async+bucketed steps/sec over the naive sync loop, "
+                "32 distinct batch sizes)",
+        "dynamic_batch_sizes": len(sizes),
+        "dynamic_epochs": epochs,
+        "dynamic_sync_steps_per_sec": round(dyn_sync_sps, 2),
+        "dynamic_bucketed_steps_per_sec": round(dyn_bucketed_sps, 2),
+        "dynamic_jit_cache_entries": dyn_entries,
+        "dynamic_sync_jit_cache_entries": sync_entries,
+        # steady-state fixed-shape rates (dispatch-overlap win alone)
+        "steady_sync_steps_per_sec": round(sync_sps, 2),
+        "steady_async_steps_per_sec": round(async_sps, 2),
+        "steady_async_bucketed_steps_per_sec": round(bucketed_sps, 2),
+        "steady_speedup": round(bucketed_sps / sync_sps, 3)
+                          if sync_sps else None,
+        "window": window, "batch": batch, "hidden": hidden,
+        "steps": steps,
+        "bucket_stats": sweep_bucketer.get_stats(),
+        # provenance: each async-metrics block names the executor whose
+        # workload it describes — the dynamic sweep (the headline) and
+        # the steady fixed-shape loop are different runs
+        "dynamic_async_metrics": exe_d.get_stats()["async"],
+        "steady_async_metrics": exe_b.get_stats()["async"],
+        "device_kind": kind,
+    }
+    print(json.dumps(result), flush=True)
+    return 0
+
+
 def bench_one(batch, seq_len, n_steps):
     import numpy as np
     from paddle_tpu.ops.pallas import flash
@@ -788,6 +959,11 @@ def main():
     devs = _device_watchdog()
     kind = getattr(devs[0], "device_kind", str(devs[0]))
     peak = _peak_flops(kind)
+
+    if os.environ.get("BENCH_ASYNC_COMPARE") == "1":
+        # async-pipeline micro-comparison: its own emission path; the
+        # MFU/sweep scaffold below is for the model benches
+        return run_async_compare(kind)
 
     seq_len = int(os.environ.get("BENCH_SEQ_LEN", 512))
     # defaults favor landing A number inside a fragile tunnel window:

@@ -19,7 +19,9 @@ from .core.place import (cuda_pinned_places,
                          CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace,
                          cpu_places, cuda_places, tpu_places,
                          is_compiled_with_cuda, is_compiled_with_tpu)
-from .core.executor import Executor, Scope, global_scope, scope_guard
+from .core.executor import (Executor, FetchHandle, Scope, global_scope,
+                            scope_guard)
+from .core.bucketing import FeedBucketer
 from .core.lod import (LoDTensor, create_lod_tensor,
                        create_random_int_lodtensor)
 from .core.backward import append_backward, gradients

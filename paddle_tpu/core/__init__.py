@@ -5,7 +5,9 @@ from .framework import (Program, Block, Operator, Variable, Parameter,
 from .place import (CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace,
                     cpu_places, cuda_places, tpu_places,
                     is_compiled_with_cuda, is_compiled_with_tpu)
-from .executor import Executor, Scope, global_scope, scope_guard
+from .executor import (Executor, FetchHandle, Scope, global_scope,
+                       scope_guard)
+from .bucketing import FeedBucketer, bucket_size
 from .backward import append_backward, gradients
 from .param_attr import ParamAttr, WeightNormParamAttr
 from .layer_helper import LayerHelper

@@ -9,7 +9,7 @@ from .framework import Variable, convert_dtype
 
 
 class DataFeeder:
-    def __init__(self, feed_list, place=None, program=None):
+    def __init__(self, feed_list, place=None, program=None, bucketer=None):
         self.feed_vars = []
         for v in feed_list:
             if isinstance(v, str):
@@ -17,6 +17,10 @@ class DataFeeder:
                 v = (program or default_main_program()).global_block().var(v)
             self.feed_vars.append(v)
         self.place = place
+        # optional core.bucketing.FeedBucketer: sample-list readers yield
+        # ragged tail batches — padding them here keeps the jit cache at
+        # O(log n) entries without touching the reader
+        self._bucketer = bucketer
 
     def feed(self, iterable):
         """iterable: list of rows, each row a tuple aligned with feed_list."""
@@ -33,4 +37,6 @@ class DataFeeder:
                 arr = arr.reshape((arr.shape[0],) + tuple(
                     s if s > 0 else -1 for s in want))
             out[var.name] = arr
+        if self._bucketer is not None:
+            out = self._bucketer.bucket(out)
         return out

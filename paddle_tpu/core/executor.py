@@ -20,7 +20,9 @@ single jitted step function per (program version, feed signature):
   Each random op then folds in its own static op_seed (ops/random_ops.py).
 """
 
+import collections
 import itertools
+import os
 import time
 
 import numpy as np
@@ -35,33 +37,76 @@ from .. import ops as ops_registry
 from ..observability import ComponentStats
 
 
+def _canon_host(name, a):
+    """Host half of the int64 policy (MIGRATION.md "Integer dtypes"):
+    device integers are int32. int64 values — fluid's contract for
+    ids/labels — are VALIDATED to fit and converted explicitly; a value
+    past 2^31 raises instead of silently truncating (the jax default
+    would wrap). float64 narrows to float32 (x64 off). numpy in/out —
+    device placement is the caller's job."""
+    if a.dtype == np.int64 or a.dtype == np.uint64:
+        lo, hi = (np.iinfo(np.int32).min, np.iinfo(np.int32).max) \
+            if a.dtype == np.int64 else (0, np.iinfo(np.uint32).max)
+        if a.size:
+            # ONE combined validation pass: min+max computed once and
+            # reused in the error message (the old path re-scanned the
+            # whole array inside the f-string on failure)
+            mn, mx = int(a.min()), int(a.max())
+            if mx > hi or mn < lo:
+                raise OverflowError(
+                    f"feed '{name}' carries {a.dtype} values outside the "
+                    f"32-bit device integer range [{lo}, {hi}] (seen: "
+                    f"[{mn}, {mx}]). Device integers are int32 by policy "
+                    f"— re-index ids below 2**31 or split the vocab. See "
+                    f"MIGRATION.md 'Integer dtypes'.")
+        a = a.astype(np.int32 if a.dtype == np.int64 else np.uint32)
+    elif a.dtype == np.float64:
+        a = a.astype(np.float32)
+    return a
+
+
 def _canon_feed(name, value):
-    """int64 policy (MIGRATION.md "Integer dtypes"): device integers are
-    int32. int64 feeds — fluid's contract for ids/labels — are accepted
-    here at the boundary, VALIDATED to fit, and converted explicitly; a
-    value past 2^31 raises instead of silently truncating (the jax
-    default would wrap). float64 narrows to float32 (x64 off)."""
+    """Single-value canonicalization (dp path, bench helpers)."""
     if isinstance(value, jax.Array):
         # already on device (e.g. the compiled path device_put the feed
         # with its mesh sharding) — converting via numpy would pull it
         # to host and DESTROY the placement; 64-bit dtypes can't exist
         # on device with x64 off, so there is nothing to canonicalize
         return value
-    a = np.asarray(value)
-    if a.dtype == np.int64 or a.dtype == np.uint64:
-        lo, hi = (np.iinfo(np.int32).min, np.iinfo(np.int32).max) \
-            if a.dtype == np.int64 else (0, np.iinfo(np.uint32).max)
-        if a.size and (int(a.max()) > hi or int(a.min()) < lo):
-            raise OverflowError(
-                f"feed '{name}' carries {a.dtype} values outside the "
-                f"32-bit device integer range [{lo}, {hi}] (max seen: "
-                f"{int(a.max())}). Device integers are int32 by policy — "
-                f"re-index ids below 2**31 or split the vocab. See "
-                f"MIGRATION.md 'Integer dtypes'.")
-        a = a.astype(np.int32 if a.dtype == np.int64 else np.uint32)
-    elif a.dtype == np.float64:
-        a = a.astype(np.float32)
-    return jnp.asarray(a)
+    return jnp.asarray(_canon_host(name, np.asarray(value)))
+
+
+def _canon_feeds(feed):
+    """Canonicalize a whole feed dict.
+
+    Two hot-path properties the per-value loop didn't have:
+    - per-step identity cache: the same host array fed under several
+      names (tied inputs, shared masks) pays its O(n) int64 validation
+      scan and upload ONCE; strong refs live only for this call, so
+      id() can't be recycled under the cache;
+    - ONE batched jax.device_put for every host value: per-feed
+      jnp.asarray paid jax's full dispatch overhead per array (~half
+      the cached-step host cost for small models).
+    """
+    out = {}
+    host = {}      # name -> canonical numpy, one batched upload below
+    seen = {}      # id -> (obj, first name)
+    dups = []
+    for k, v in feed.items():
+        if isinstance(v, jax.Array):
+            out[k] = v        # placed already (prefetch/mesh path)
+            continue
+        hit = seen.get(id(v))
+        if hit is not None and hit[0] is v:
+            dups.append((k, hit[1]))
+            continue
+        seen[id(v)] = (v, k)
+        host[k] = _canon_host(k, np.asarray(v))
+    if host:
+        out.update(jax.device_put(host))
+    for k, first in dups:
+        out[k] = out[first]
+    return out
 
 
 class Scope:
@@ -159,21 +204,98 @@ _EXECUTOR_SEQ = itertools.count()
 
 
 def _program_label(program):
-    """Stable-within-process label for compile-time histograms."""
-    return f"program_{id(program) & 0xFFFFFF:06x}_v{program.version}"
+    """Stable-within-process label for compile-time histograms (uid is
+    never recycled, unlike id())."""
+    return f"program_{program.uid}_v{program.version}"
 
 
 def _shapes_label(feed_sig):
-    """Compact feed-signature label: 'x:32x4:float32;y:32x1:float32'."""
+    """Compact feed-signature label: 'x:32x4:float32;y:32x1:float32'.
+    Only built on the compile (cache-miss) path — feed_sig carries raw
+    np.dtype objects so the per-step key build never pays str()."""
     parts = [f"{k}:{'x'.join(map(str, shape)) or 'scalar'}:{dt}"
              for k, shape, dt in feed_sig]
     return ";".join(parts)[:160] or "nofeeds"
 
 
-class Executor:
-    """Parity: fluid.Executor. place selects the device; XLA owns streams."""
+class FetchHandle:
+    """Future for one in-flight `Executor.run_async` step.
 
-    def __init__(self, place=None):
+    The XLA call was already dispatched when the handle was created; the
+    device arrays inside materialize on XLA's schedule while the host
+    keeps running. `result()` blocks until this step's fetches are ready
+    and returns them (numpy by default, matching `exe.run`); `wait()`
+    blocks without converting. An exception — raised at dispatch (bad
+    feed, unknown fetch) or surfaced by the device when the step ran —
+    re-raises HERE, at resolution, not inside the dispatching
+    `run_async` call. Handles resolve independently and in any order;
+    each carries exactly the fetches of its own step.
+    """
+
+    __slots__ = ("_exe", "_fetches", "_error", "_finished", "step")
+
+    def __init__(self, exe, step, fetches=None, error=None):
+        self._exe = exe
+        self.step = step            # executor-wide async sequence number
+        self._fetches = fetches
+        self._error = error
+        self._finished = error is not None
+
+    def done(self):
+        """True once every fetch materialized (never blocks);
+        best-effort True when the backend can't answer."""
+        if self._finished:
+            return True
+        try:
+            return all(f.is_ready() for f in self._fetches
+                       if hasattr(f, "is_ready"))
+        except Exception:
+            return True
+
+    def wait(self):
+        """Block until the step completed; re-raise its error if it
+        failed. Retires the handle from the executor's in-flight
+        window. Idempotent — a failed handle re-raises every time."""
+        if not self._finished:
+            t0 = time.perf_counter()
+            try:
+                jax.block_until_ready(self._fetches)
+            except Exception as e:      # device-side failure surfaces here
+                self._error = e
+                self._exe._stats.count("executor.async.errors")
+            self._finished = True
+            self._exe._stats.observe("executor.async.host_sync_wait_ms",
+                                     (time.perf_counter() - t0) * 1e3)
+            self._exe._retire(self)
+        if self._error is not None:
+            raise self._error
+        return self
+
+    def result(self, return_numpy=True):
+        """Blocking resolution to the step's fetch list (exe.run's
+        return shape): numpy copies by default, live device arrays with
+        return_numpy=False."""
+        self.wait()
+        with self._exe._stats.span("executor.fetch",
+                                   "executor.span.fetch_ms"):
+            if return_numpy:
+                return [np.asarray(f) for f in self._fetches]
+            return list(self._fetches)
+
+
+class Executor:
+    """Parity: fluid.Executor. place selects the device; XLA owns streams.
+
+    Two dispatch surfaces share one compiled-step cache:
+      run()       — synchronous fluid semantics (numpy fetches in hand
+                    when the call returns);
+      run_async() — non-blocking: returns a FetchHandle immediately and
+                    keeps up to `async_window` donated step executables
+                    in flight, so the device never waits for the host's
+                    feed preparation (docs/performance.md).
+    """
+
+    def __init__(self, place=None, async_window=None):
         from .place import TPUPlace
         from ..utils import device_lock
         # OS-level interlock: two processes initializing the axon TPU
@@ -185,6 +307,14 @@ class Executor:
         self._meta_cache = {}   # static per-(program, feeds, fetches) work
         self._step_counter = 0
         self._last_call = None
+        # async pipeline: bounded window of dispatched-but-unresolved
+        # steps (depth 2 overlaps host prep with device compute without
+        # piling up feed buffers in HBM)
+        self.async_window = int(
+            async_window if async_window is not None
+            else os.environ.get("PADDLE_TPU_ASYNC_WINDOW", 2))
+        self._inflight = collections.deque()
+        self._async_seq = 0
         # observability: per-instance counters/histograms mirrored into
         # the process-wide registry; gauges labeled per-executor there
         self._exe_id = f"exe{next(_EXECUTOR_SEQ)}"
@@ -205,13 +335,65 @@ class Executor:
         self._update_cache_gauges()
 
     def close(self):
+        # drain first: in-flight steps still own donated state buffers
+        # and their owners may still resolve handles after close()
+        self.drain(raise_errors=False)
         self.clear_caches()
         # a closed executor must not keep reporting cache sizes from the
         # process-wide registry (stale gauges in long-lived processes)
         self._stats.drop_gauges("executor.jit_cache.size",
-                                "executor.meta_cache.size")
+                                "executor.meta_cache.size",
+                                "executor.async.inflight")
         self._last_call = None
         self._compiled_pair = None
+
+    # -- async pipeline -------------------------------------------------
+    def _update_inflight_gauge(self):
+        self._stats.set_gauge("executor.async.inflight",
+                              len(self._inflight))
+
+    def _retire(self, handle):
+        """Drop a finished handle from the in-flight window (called by
+        FetchHandle.wait; resolution order is the caller's choice)."""
+        try:
+            self._inflight.remove(handle)
+        except ValueError:
+            return                      # already retired (drain raced)
+        self._update_inflight_gauge()
+
+    def _wait_oldest(self):
+        """Window admission: block on the OLDEST in-flight step. An
+        error it captured stays in ITS handle (re-raised at that
+        handle's result()), never in the step being admitted."""
+        h = self._inflight[0]
+        try:
+            h.wait()
+        except Exception:
+            pass
+        if self._inflight and self._inflight[0] is h:
+            # wait() normally retires; belt-and-braces against a handle
+            # whose fetches can't be blocked on
+            self._inflight.popleft()
+            self._update_inflight_gauge()
+
+    def drain(self, raise_errors=True):
+        """Block until every in-flight async step has completed (FIFO).
+        The first captured error re-raises AFTER the pipeline is empty
+        (raise_errors=False keeps it in its handle instead — close()'s
+        mode)."""
+        first_err = None
+        while self._inflight:
+            h = self._inflight[0]
+            try:
+                h.wait()
+            except Exception as e:
+                if first_err is None:
+                    first_err = e
+            if self._inflight and self._inflight[0] is h:
+                self._inflight.popleft()
+                self._update_inflight_gauge()
+        if first_err is not None and raise_errors:
+            raise first_err
 
     def _update_cache_gauges(self):
         self._stats.set_gauge("executor.jit_cache.size", len(self._cache))
@@ -257,6 +439,14 @@ class Executor:
             "spans": {k: h(f"executor.span.{k}_ms")
                       for k in ("key_build", "trace", "compile",
                                 "execute", "fetch")},
+            "async": {"dispatches": c("executor.async.dispatches"),
+                      "errors": c("executor.async.errors"),
+                      "window_waits": c("executor.async.window_waits"),
+                      "inflight": len(self._inflight),
+                      "window": self.async_window,
+                      "dispatch_ms": h("executor.async.dispatch_ms"),
+                      "host_sync_wait_ms":
+                          h("executor.async.host_sync_wait_ms")},
             "compile_ms": per_key,
         }
 
@@ -412,6 +602,121 @@ class Executor:
             feed_var_name="feed", fetch_var_name="fetch", return_numpy=True,
             use_program_cache=True):
         t_step0 = time.perf_counter()
+        fetches = self._dispatch(program, feed, fetch_list, scope,
+                                 use_program_cache)
+        with self._stats.span("executor.fetch", "executor.span.fetch_ms"):
+            if return_numpy:
+                out = [np.asarray(f) for f in fetches]
+            else:
+                out = list(fetches)
+        self._stats.observe("executor.step_ms",
+                            (time.perf_counter() - t_step0) * 1e3)
+        return out
+
+    def run_async(self, program=None, feed=None, fetch_list=None,
+                  scope=None, window=None, use_program_cache=True,
+                  bucketer=None):
+        """Non-blocking run(): dispatch the step and return a
+        FetchHandle immediately.
+
+        At most `window` (default: self.async_window) steps stay in
+        flight; when the window is full this call first blocks on the
+        OLDEST outstanding step — the bounded pipeline that overlaps
+        host-side feed preparation with device compute without letting
+        feed buffers pile up in HBM. Errors (a bad feed, an unknown
+        fetch, a device-side failure) are captured into the returned
+        handle and re-raised at its result()/wait(), keeping dispatch
+        order == feed order even through a failed step. `bucketer` (a
+        core.bucketing.FeedBucketer) pads the feed before dispatch so a
+        dynamic-batch loop stays within O(log n) jit-cache entries.
+
+        State semantics match run(): the scope's persistables are
+        updated at dispatch time with the (asynchronously materializing)
+        output arrays, so back-to-back dispatches chain on-device.
+        """
+        win = max(1, int(self.async_window if window is None else window))
+        if getattr(program, "_data_parallel", False):
+            raise NotImplementedError(
+                "run_async does not take a data-parallel CompiledProgram "
+                "— the dp path places feeds/state synchronously; use "
+                "run(), whose XLA dispatch is already async under the "
+                "hood")
+        program = getattr(program, "program", program)   # CompiledProgram
+        while len(self._inflight) >= win:
+            self._stats.count("executor.async.window_waits")
+            self._wait_oldest()
+        t0 = time.perf_counter()
+        step = self._async_seq
+        self._async_seq += 1
+        try:
+            if bucketer is not None:
+                feed = bucketer.bucket(feed or {})
+            fetches = self._dispatch(program, feed, fetch_list, scope,
+                                     use_program_cache)
+        except Exception as e:
+            # dispatch never ran on device: deliver the error through
+            # the handle (async contract — the CALLER of result() owns
+            # failure handling, not whatever loop happened to dispatch)
+            self._stats.count("executor.async.errors")
+            return FetchHandle(self, step, error=e)
+        handle = FetchHandle(self, step, fetches)
+        self._inflight.append(handle)
+        self._update_inflight_gauge()
+        self._stats.count("executor.async.dispatches")
+        self._stats.observe("executor.async.dispatch_ms",
+                            (time.perf_counter() - t0) * 1e3)
+        return handle
+
+    def run_pipelined(self, program=None, feed_iter=None, fetch_list=None,
+                      scope=None, window=None, prefetch_depth=2,
+                      bucketer=None, return_numpy=True):
+        """Drive a whole feed stream through the async pipeline,
+        yielding one resolved fetch list per feed, in feed order.
+
+        Three overlapped stages, the same machinery train_from_dataset
+        uses but for a plain python feed iterable:
+          host:   optional FeedBucketer padding (power-of-2 shapes),
+          copy:   reader.dataloader.device_prefetch — the NEXT batches
+                  are device_put while the current step computes,
+          device: run_async's bounded in-flight window.
+        Results lag dispatch by `window` steps; the generator drains the
+        window at stream end. A step's error raises at ITS yield point.
+        """
+        from ..reader.dataloader import device_prefetch
+        win = max(1, int(self.async_window if window is None else window))
+
+        def canon(feed):
+            # the int64 policy must hold on THIS path too: a raw
+            # device_put would silently wrap out-of-range int64 ids
+            # where run()/run_async raise (MIGRATION.md "Integer
+            # dtypes") — canonicalize host-side, before upload
+            return {k: v if isinstance(v, jax.Array)
+                    else _canon_host(k, np.asarray(v))
+                    for k, v in feed.items()}
+
+        if bucketer is not None:
+            def transform(feed, _b=bucketer.bucket):
+                return canon(_b(feed))
+        else:
+            transform = canon
+        pending = collections.deque()
+        for feed in device_prefetch(feed_iter, depth=prefetch_depth,
+                                    transform=transform):
+            pending.append(self.run_async(
+                program, feed=feed, fetch_list=fetch_list, scope=scope,
+                window=win))
+            if len(pending) > win:
+                yield pending.popleft().result(return_numpy=return_numpy)
+        while pending:
+            yield pending.popleft().result(return_numpy=return_numpy)
+
+    def _dispatch(self, program, feed, fetch_list, scope,
+                  use_program_cache):
+        """Shared front half of run()/run_async(): canonicalize feeds,
+        build or fetch the cached step fn, invoke it (XLA dispatch is
+        asynchronous), write the new state into the scope. Returns the
+        step's fetch tuple as device arrays — synchronization and
+        numpy-conversion policy belong to the caller."""
         program = program if program is not None else default_main_program()
         scope = scope if scope is not None else global_scope()
         feed = feed or {}
@@ -419,14 +724,18 @@ class Executor:
 
         with self._stats.span("executor.key_build",
                               "executor.span.key_build_ms"):
-            feeds = {k: _canon_feed(k, v) for k, v in feed.items()}
-            feed_sig = tuple(sorted((k, v.shape, str(v.dtype))
+            feeds = _canon_feeds(feed)
+            # np.dtype objects hash/compare fine and cost nothing; the
+            # human-readable str(dtype) is built only in _shapes_label
+            # on the compile path (str() per feed per step was ~10% of
+            # the cached-step key build)
+            feed_sig = tuple(sorted((k, v.shape, v.dtype)
                                     for k, v in feeds.items()))
 
             # validation + persistable enumeration are static per (program
             # version, feed keys, fetches) — walking every op each run()
             # cost ~0.5ms/step on cached small-model steps
-            meta_key = (id(program), program.version,
+            meta_key = (program.uid, program.version,
                         tuple(sorted(feed)), fetch_names)
             persist_names = (self._meta_cache.get(meta_key)
                              if use_program_cache else None)
@@ -465,7 +774,7 @@ class Executor:
             mesh = getattr(self, "_active_mesh", None)
             mesh_key = None if mesh is None \
                 else (id(mesh), tuple(mesh.axis_names))
-            key = (id(program), program.version, feed_sig, fetch_names,
+            key = (program.uid, program.version, feed_sig, fetch_names,
                    state_sig, mesh_key)
         entry = self._cache.get(key) if use_program_cache else None
         fresh = entry is None
@@ -520,16 +829,8 @@ class Executor:
                 new_state, fetches = step_fn(state, feeds, rng)
         for n, v in new_state.items():
             scope.set(n, v)
-
-        with self._stats.span("executor.fetch", "executor.span.fetch_ms"):
-            if return_numpy:
-                out = [np.asarray(f) for f in fetches]
-            else:
-                out = list(fetches)
         self._stats.count("executor.steps")
-        self._stats.observe("executor.step_ms",
-                            (time.perf_counter() - t_step0) * 1e3)
-        return out
+        return fetches
 
     # ------------------------------------------------------------------
     def _build(self, program, fetch_names, persist_names, state_sig):

@@ -14,6 +14,7 @@ optimizer updates included. See core/executor.py.
 
 import contextlib
 import copy
+import itertools
 import json
 
 import numpy as np
@@ -302,6 +303,12 @@ class Block:
 
 BACKWARD_MARKER = "backward_marker"
 
+# Monotonic process-wide Program ids: the Executor's jit/meta cache keys
+# must not alias a garbage-collected Program whose id() the allocator
+# recycled — a recycled address plus an equal version would silently
+# serve a stale step function for a brand-new Program.
+_PROGRAM_UID = itertools.count(1)
+
 
 class Program:
     """A whole computation graph (possibly with sub-blocks for control flow).
@@ -314,6 +321,7 @@ class Program:
         self.current_block_idx = 0
         self.random_seed = default_seed()
         self._version = 0           # bumped on any mutation; part of jit key
+        self._uid = next(_PROGRAM_UID)
         self._seed_counter = 0      # per-program op seed allocator
         self._is_test = False
 
@@ -341,6 +349,11 @@ class Program:
     @property
     def version(self):
         return self._version
+
+    @property
+    def uid(self):
+        """Never-recycled process-unique id (unlike id(self))."""
+        return self._uid
 
     def next_op_seed(self):
         self._seed_counter += 1
@@ -392,6 +405,7 @@ class Program:
         p.current_block_idx = self.current_block_idx
         p.random_seed = self.random_seed
         p._version = self._version
+        p._uid = next(_PROGRAM_UID)   # a clone is a distinct cache identity
         p._seed_counter = self._seed_counter
         p._is_test = self._is_test
         for blk in self.blocks:

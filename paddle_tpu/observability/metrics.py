@@ -71,6 +71,26 @@ METRIC_SPECS = [
     ("executor.uncached_runs", "counter",
      "run() calls with use_program_cache=False (caches bypassed, not "
      "missed)"),
+    ("executor.async.dispatches", "counter",
+     "run_async() steps dispatched into the in-flight window"),
+    ("executor.async.dispatch_ms", "histogram",
+     "host wall ms of one run_async dispatch (no device sync)"),
+    ("executor.async.inflight", "gauge",
+     "async steps dispatched but not yet resolved"),
+    ("executor.async.window_waits", "counter",
+     "dispatches that found the window full and blocked on the oldest "
+     "in-flight step"),
+    ("executor.async.host_sync_wait_ms", "histogram",
+     "host wall ms blocked on an in-flight step (window admission + "
+     "FetchHandle.wait)"),
+    ("executor.async.errors", "counter",
+     "exceptions captured into FetchHandles (dispatch or device)"),
+    ("executor.bucket.batches", "counter",
+     "feed dicts padded by a FeedBucketer"),
+    ("executor.bucket.pad_waste_elems", "counter",
+     "padding elements FeedBucketer added (bucketed minus real size)"),
+    ("executor.bucket.shapes", "gauge",
+     "distinct post-bucketing feed signatures a FeedBucketer produced"),
     ("executor.dp.runs", "counter", "data-parallel (mesh) run() calls"),
     ("executor.dp.shard_state_ms", "histogram",
      "feed/state device placement on the data-parallel path"),

@@ -55,7 +55,8 @@ class DataLoader:
         return iter(_Prefetcher(self._batch_reader, self.capacity))
 
 
-def device_prefetch(batch_iter, depth=2, sharding=None, sharding_fn=None):
+def device_prefetch(batch_iter, depth=2, sharding=None, sharding_fn=None,
+                    transform=None):
     """Overlap host->device transfer with device compute: while step N
     runs, batch N+1 is already being device_put in the background.
 
@@ -65,6 +66,11 @@ def device_prefetch(batch_iter, depth=2, sharding=None, sharding_fn=None):
     deque of in-flight device batches gives the same overlap without
     pinned-memory plumbing. `sharding` (e.g. a NamedSharding with
     P('dp')) places the batch straight into its mesh layout.
+
+    `transform` is a host-side hook applied to each batch BEFORE upload
+    — the seam Executor.run_pipelined uses for FeedBucketer padding
+    (padding a device array would round-trip the host, so it must
+    happen here, ahead of device_put).
 
     Works on dict or list batches of numpy arrays; yields the same
     structure holding device arrays.
@@ -77,6 +83,8 @@ def device_prefetch(batch_iter, depth=2, sharding=None, sharding_fn=None):
         it = iter(batch_iter() if callable(batch_iter) else batch_iter)
         try:
             for batch in it:
+                if transform is not None:
+                    batch = transform(batch)
                 # device_put maps over pytrees (dict/list/tuple/nested)
                 # itself; async dispatch returns at once. sharding_fn
                 # (when given) picks per-batch placement — the mesh

@@ -1,0 +1,322 @@
+"""Async step pipeline (ISSUE 3 tentpole): FetchHandle ordering and
+resolution, bounded in-flight window, error propagation through a
+handle, close()/drain() semantics, var@GRAD fetches in flight, the
+run_pipelined + FeedBucketer jit-cache bound, and the Program-uid /
+feed-identity-cache satellites."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core import framework
+from paddle_tpu.core import executor as executor_mod
+from paddle_tpu.core.bucketing import FeedBucketer
+from paddle_tpu.core.executor import FetchHandle, Scope, scope_guard
+from paddle_tpu.core.framework import grad_var_name
+
+# `async` is a python keyword, so the marker rides getattr (registered
+# in pytest.ini; tier-1 runs it — none of this is slow)
+pytestmark = [getattr(pytest.mark, "async")]
+
+
+def _build_train(hidden=8):
+    """Tiny train program on the DEFAULT programs (the autouse
+    _fresh_programs fixture isolates tests)."""
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    loss = layers.mean(layers.square_error_cost(
+        layers.fc(x, size=hidden), y))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def _feed(batch=8, seed=0):
+    r = np.random.default_rng(seed)
+    return {"x": r.standard_normal((batch, 4)).astype(np.float32),
+            "y": r.standard_normal((batch, 1)).astype(np.float32)}
+
+
+def _fresh_exe(window=2):
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace(), async_window=window)
+    with scope_guard(scope):
+        exe.run(fluid.default_startup_program())
+    return exe, scope
+
+
+# ---------------------------------------------------------------------------
+# correctness: async == sync, in order and out of order
+# ---------------------------------------------------------------------------
+
+def test_async_losses_match_sync_exactly():
+    loss = _build_train()
+    exe_s, scope_s = _fresh_exe()
+    exe_a, scope_a = _fresh_exe()
+    feeds = [_feed(seed=i) for i in range(4)]
+    with scope_guard(scope_s):
+        ref = [exe_s.run(feed=f, fetch_list=[loss])[0] for f in feeds]
+    with scope_guard(scope_a):
+        handles = [exe_a.run_async(feed=f, fetch_list=[loss])
+                   for f in feeds]
+    got = [h.result()[0] for h in handles]
+    # same program, same seed, same init, same feeds -> bitwise equal
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(r, g)
+
+
+def test_handles_resolve_out_of_order():
+    loss = _build_train()
+    exe_s, scope_s = _fresh_exe()
+    exe_a, scope_a = _fresh_exe(window=4)
+    feeds = [_feed(seed=i) for i in range(3)]
+    with scope_guard(scope_s):
+        ref = [exe_s.run(feed=f, fetch_list=[loss])[0] for f in feeds]
+    with scope_guard(scope_a):
+        hs = [exe_a.run_async(feed=f, fetch_list=[loss]) for f in feeds]
+    # resolve newest first: each handle still carries ITS OWN step
+    np.testing.assert_array_equal(hs[2].result()[0], ref[2])
+    np.testing.assert_array_equal(hs[0].result()[0], ref[0])
+    np.testing.assert_array_equal(hs[1].result()[0], ref[1])
+    # a resolved handle is idempotent
+    np.testing.assert_array_equal(hs[1].result()[0], ref[1])
+    assert hs[0].done() and exe_a.get_stats()["async"]["inflight"] == 0
+
+
+def test_result_return_numpy_false_keeps_device_arrays():
+    import jax
+    loss = _build_train()
+    exe, scope = _fresh_exe()
+    with scope_guard(scope):
+        h = exe.run_async(feed=_feed(), fetch_list=[loss])
+    out = h.result(return_numpy=False)
+    assert isinstance(out[0], jax.Array)
+
+
+# ---------------------------------------------------------------------------
+# the bounded window
+# ---------------------------------------------------------------------------
+
+def test_window_bounds_inflight_depth():
+    loss = _build_train()
+    exe, scope = _fresh_exe(window=2)
+    with scope_guard(scope):
+        for i in range(6):
+            exe.run_async(feed=_feed(seed=i), fetch_list=[loss])
+            assert len(exe._inflight) <= 2
+    s = exe.get_stats()["async"]
+    assert s["dispatches"] == 6
+    assert s["window"] == 2
+    # dispatches past the window admission-blocked on the oldest step
+    assert s["window_waits"] >= 4
+    assert s["host_sync_wait_ms"]["count"] >= 4
+    exe.drain()
+    assert exe.get_stats()["async"]["inflight"] == 0
+
+
+def test_per_call_window_override():
+    loss = _build_train()
+    exe, scope = _fresh_exe(window=4)
+    with scope_guard(scope):
+        for i in range(5):
+            exe.run_async(feed=_feed(seed=i), fetch_list=[loss],
+                          window=1)
+            assert len(exe._inflight) <= 1
+
+
+# ---------------------------------------------------------------------------
+# error propagation
+# ---------------------------------------------------------------------------
+
+def test_dispatch_error_raises_at_result_not_dispatch():
+    loss = _build_train()
+    exe, scope = _fresh_exe()
+    bad = {"x": np.full((8, 4), 2**40, np.int64),     # int64 overflow
+           "y": np.zeros((8, 1), np.float32)}
+    with scope_guard(scope):
+        h_bad = exe.run_async(feed=bad, fetch_list=[loss])   # no raise here
+        assert isinstance(h_bad, FetchHandle)
+        # the pipeline stays usable: later steps dispatch and resolve
+        h_ok = exe.run_async(feed=_feed(), fetch_list=[loss])
+    with pytest.raises(OverflowError, match="Integer dtypes"):
+        h_bad.result()
+    with pytest.raises(OverflowError):
+        h_bad.wait()          # failed handles re-raise on every wait
+    assert np.isfinite(h_ok.result()[0]).all()
+    assert exe.get_stats()["async"]["errors"] == 1
+
+
+def test_unknown_fetch_error_lands_in_handle():
+    _build_train()
+    exe, scope = _fresh_exe()
+    with scope_guard(scope):
+        h = exe.run_async(feed=_feed(), fetch_list=["nope"])
+    with pytest.raises(ValueError, match="not a variable"):
+        h.result()
+
+
+def test_drain_empties_pipeline_and_errors_stay_with_their_handle():
+    loss = _build_train()
+    exe, scope = _fresh_exe(window=4)
+    with scope_guard(scope):
+        h0 = exe.run_async(feed=_feed(), fetch_list=[loss])
+        # a dispatch failure never ENTERS the pipeline: its handle owns
+        # the error, drain() of the healthy steps is unaffected
+        h_bad = exe.run_async(
+            feed={"x": np.full((8, 4), 2**40, np.int64),
+                  "y": np.zeros((8, 1), np.float32)},
+            fetch_list=[loss])
+        h2 = exe.run_async(feed=_feed(seed=1), fetch_list=[loss])
+        exe.drain()
+        assert exe.get_stats()["async"]["inflight"] == 0
+    assert np.isfinite(h0.result()[0]).all()
+    assert np.isfinite(h2.result()[0]).all()
+    with pytest.raises(OverflowError):
+        h_bad.result()
+
+
+# ---------------------------------------------------------------------------
+# close() drains the pipeline
+# ---------------------------------------------------------------------------
+
+def test_close_drains_pipeline_and_drops_gauges():
+    loss = _build_train()
+    exe, scope = _fresh_exe(window=4)
+    with scope_guard(scope):
+        hs = [exe.run_async(feed=_feed(seed=i), fetch_list=[loss])
+              for i in range(3)]
+    exe.close()
+    assert exe.get_stats()["async"]["inflight"] == 0
+    assert exe.get_stats()["jit_cache"]["size"] == 0
+    # handles dispatched before close still resolve (the step already ran)
+    assert np.isfinite(hs[0].result()[0]).all()
+    from paddle_tpu.observability import global_registry
+    g = global_registry().get("executor.async.inflight")
+    assert not any(lbl.get("executor") == exe._exe_id
+                   for lbl, _ in g.series())
+
+
+# ---------------------------------------------------------------------------
+# var@GRAD fetches with in-flight steps (docs/performance.md)
+# ---------------------------------------------------------------------------
+
+def test_grad_fetch_async_matches_sync():
+    loss = _build_train()
+    w = fluid.default_main_program().all_parameters()[0].name
+    fetches = [loss, grad_var_name(w)]
+    exe_s, scope_s = _fresh_exe()
+    exe_a, scope_a = _fresh_exe()
+    feeds = [_feed(seed=i) for i in range(3)]
+    with scope_guard(scope_s):
+        ref = [exe_s.run(feed=f, fetch_list=fetches) for f in feeds]
+    with scope_guard(scope_a):
+        hs = [exe_a.run_async(feed=f, fetch_list=fetches) for f in feeds]
+    for r, h in zip(ref, hs):
+        got = h.result()
+        np.testing.assert_array_equal(r[0], got[0])
+        # each in-flight step's grad belongs to ITS feed, not the last one
+        np.testing.assert_array_equal(r[1], got[1])
+
+
+# ---------------------------------------------------------------------------
+# run_pipelined + FeedBucketer: the O(log n) jit-cache bound end-to-end
+# ---------------------------------------------------------------------------
+
+def _build_masked_train():
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    m = layers.data("batch_mask", shape=[1], dtype="float32")
+    per = layers.square_error_cost(layers.fc(x, size=8), y)
+    loss = layers.reduce_sum(per * m) / layers.reduce_sum(m)
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def test_pipelined_dynamic_batches_bounded_cache():
+    loss = _build_masked_train()
+    exe, scope = _fresh_exe()
+    base = exe.get_stats()["jit_cache"]["size"]       # the startup entry
+    bucketer = FeedBucketer(mask_name="batch_mask")
+    sizes = list(range(1, 33))                        # 32 DISTINCT sizes
+    feeds = (_feed(batch=n, seed=n) for n in sizes)
+    with scope_guard(scope):
+        outs = list(exe.run_pipelined(None, feeds, fetch_list=[loss],
+                                      bucketer=bucketer))
+    assert len(outs) == len(sizes)
+    assert all(np.isfinite(o[0]).all() for o in outs)
+    # power-of-2 buckets: {1,2,4,8,16,32} -> at most 6 new entries
+    assert exe.get_stats()["jit_cache"]["size"] - base <= 6
+    assert bucketer.get_stats()["shapes"] <= 6
+    assert exe.get_stats()["async"]["dispatches"] == len(sizes)
+
+
+def test_pipelined_enforces_int64_policy():
+    # the prefetch upload path must not silently wrap out-of-range
+    # int64 where run()/run_async raise (MIGRATION.md "Integer dtypes")
+    loss = _build_train()
+    exe, scope = _fresh_exe()
+    bad = {"x": np.full((8, 4), 2**40, np.int64),
+           "y": np.zeros((8, 1), np.float32)}
+    with scope_guard(scope):
+        with pytest.raises(OverflowError, match="Integer dtypes"):
+            list(exe.run_pipelined(None, [bad], fetch_list=[loss]))
+
+
+def test_pipelined_results_in_feed_order():
+    loss = _build_train()
+    exe, scope = _fresh_exe()
+    feeds = [_feed(seed=i) for i in range(5)]
+    exe_ref, scope_ref = _fresh_exe()
+    with scope_guard(scope_ref):
+        ref = [exe_ref.run(feed=f, fetch_list=[loss])[0] for f in feeds]
+    with scope_guard(scope):
+        outs = list(exe.run_pipelined(None, feeds, fetch_list=[loss]))
+    for r, o in zip(ref, outs):
+        np.testing.assert_array_equal(r, o[0])
+
+
+# ---------------------------------------------------------------------------
+# satellites: Program.uid cache keys, per-step feed identity cache
+# ---------------------------------------------------------------------------
+
+def test_program_uid_monotonic_and_survives_clone():
+    p1, p2 = framework.Program(), framework.Program()
+    assert p2.uid > p1.uid > 0
+    c = p1.clone()
+    assert c.uid not in (p1.uid, p2.uid)
+    # uid is id()-recycling-proof by construction: a fresh Program never
+    # reuses a dead Program's uid, so (uid, version) can't alias
+    assert framework.Program().uid > c.uid
+
+
+def test_jit_cache_keys_use_uid_not_id():
+    loss = _build_train()
+    exe, scope = _fresh_exe()
+    with scope_guard(scope):
+        exe.run(feed=_feed(), fetch_list=[loss])
+    prog = fluid.default_main_program()
+    keys = [k for k in exe._cache if k[0] == prog.uid]
+    assert keys, "jit cache key does not start with program.uid"
+    assert all(k[0] != id(prog) or id(prog) == prog.uid
+               for k in exe._cache)
+    meta = [k for k in exe._meta_cache if k[0] == prog.uid]
+    assert meta, "meta cache key does not start with program.uid"
+
+
+def test_feed_identity_cache_canonicalizes_shared_array_once(monkeypatch):
+    calls = []
+    real = executor_mod._canon_host
+
+    def counting(name, a):
+        calls.append(name)
+        return real(name, a)
+
+    monkeypatch.setattr(executor_mod, "_canon_host", counting)
+    shared = np.ones((8, 4), np.float32)
+    out = executor_mod._canon_feeds({"a": shared, "b": shared,
+                                     "c": np.ones((8, 1), np.float32)})
+    # the shared object was validated/uploaded once; both names resolve
+    # to the SAME device array
+    assert calls.count("a") + calls.count("b") == 1
+    assert out["a"] is out["b"]
+    assert out["c"].shape == (8, 1)

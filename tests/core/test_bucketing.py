@@ -1,0 +1,208 @@
+"""FeedBucketer (ISSUE 3): power-of-2 bucket math, padding + mask
+generation, O(log n) signature growth, pad-waste accounting, and mask
+correctness — the bucketed loss and its gradients must equal the
+unpadded run exactly."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.bucketing import FeedBucketer, bucket_size
+from paddle_tpu.core.executor import Scope, scope_guard
+
+pytestmark = [getattr(pytest.mark, "async")]
+
+
+# ---------------------------------------------------------------------------
+# bucket_size
+# ---------------------------------------------------------------------------
+
+def test_bucket_size_power_of_two():
+    assert [bucket_size(n) for n in (0, 1, 2, 3, 5, 8, 9, 33, 1000)] == \
+        [1, 1, 2, 4, 8, 8, 16, 64, 1024]
+
+
+def test_bucket_size_min_floor_and_max_cap():
+    assert bucket_size(3, min_size=16) == 16
+    assert bucket_size(20, min_size=16) == 32
+    # a cap that the value fits under clamps to the cap
+    assert bucket_size(20, max_size=24) == 24
+    with pytest.raises(ValueError, match="exceeds the bucket cap"):
+        bucket_size(33, max_size=32)
+    with pytest.raises(ValueError):
+        bucket_size(-1)
+
+
+# ---------------------------------------------------------------------------
+# bucket(): padding, mask, passthrough
+# ---------------------------------------------------------------------------
+
+def test_bucket_pads_batch_and_emits_mask():
+    b = FeedBucketer(mask_name="batch_mask")
+    out = b.bucket({"x": np.ones((5, 4), np.float32),
+                    "y": np.full((5, 1), 7, np.int32)})
+    assert out["x"].shape == (8, 4) and out["y"].shape == (8, 1)
+    np.testing.assert_array_equal(out["x"][5:], 0)       # default pad 0
+    np.testing.assert_array_equal(out["y"][:5], 7)
+    mask = out["batch_mask"]
+    assert mask.shape == (8, 1) and mask.dtype == np.float32
+    np.testing.assert_array_equal(mask.ravel(),
+                                  [1, 1, 1, 1, 1, 0, 0, 0])
+
+
+def test_mask_present_even_without_padding():
+    # shape-stable signature: a power-of-2 batch still carries the mask
+    b = FeedBucketer(mask_name="batch_mask")
+    out = b.bucket({"x": np.ones((8, 4), np.float32)})
+    assert out["batch_mask"].shape == (8, 1)
+    assert out["batch_mask"].all()
+
+
+def test_custom_pad_values_and_disagreeing_batch_raises():
+    b = FeedBucketer(pad_values={"ids": -1})
+    out = b.bucket({"ids": np.zeros((3, 2), np.int32)})
+    np.testing.assert_array_equal(out["ids"][3:], -1)
+    with pytest.raises(ValueError, match="disagrees"):
+        b.bucket({"a": np.ones((3, 2)), "b": np.ones((5, 2))})
+
+
+def test_dynamic_axes_sequence_padding_and_passthrough():
+    b = FeedBucketer(dynamic_axes={"tok": (0, 1)}, mask_name=None)
+    out = b.bucket({"tok": np.ones((3, 10), np.int32),
+                    "aux": np.ones((3, 9), np.float32)})   # not listed
+    assert out["tok"].shape == (4, 16)                     # both axes pow2
+    assert out["aux"].shape == (3, 9)                      # untouched
+    assert "batch_mask" not in out
+
+
+def test_device_array_rejected_with_guidance():
+    b = FeedBucketer()
+    dev = jax.device_put(np.ones((3, 2), np.float32))
+    with pytest.raises(TypeError, match="before device_put"):
+        b.bucket({"x": dev})
+
+
+def test_user_supplied_mask_preserved_not_overwritten():
+    # a caller-provided mask (partially-masked rows) must survive
+    # bucketing: zero-padded, never replaced by the generated all-ones
+    b = FeedBucketer(mask_name="batch_mask")
+    user_mask = np.array([[1], [1], [1], [1], [0], [0]], np.float32)
+    out = b.bucket({"x": np.ones((6, 4), np.float32),
+                    "batch_mask": user_mask})
+    np.testing.assert_array_equal(out["batch_mask"].ravel(),
+                                  [1, 1, 1, 1, 0, 0, 0, 0])
+    with pytest.raises(ValueError, match="batch dim"):
+        b.bucket({"x": np.ones((6, 4), np.float32),
+                  "batch_mask": np.ones((4, 1), np.float32)})
+
+
+def test_sequence_only_axes_emit_no_mask():
+    # no axis-0 entry -> no batch to size a mask on (documented): the
+    # bucketer must not invent one
+    b = FeedBucketer(dynamic_axes={"tok": (1,)}, mask_name="batch_mask")
+    out = b.bucket({"tok": np.ones((3, 10), np.int32)})
+    assert out["tok"].shape == (3, 16)
+    assert "batch_mask" not in out
+
+
+def test_scalar_feeds_pass_through():
+    b = FeedBucketer(mask_name="batch_mask")
+    out = b.bucket({"x": np.ones((3, 2), np.float32), "lr": 0.1})
+    assert out["lr"] == 0.1
+    assert out["x"].shape == (4, 2)
+
+
+# ---------------------------------------------------------------------------
+# accounting: O(log n) signatures, pad waste
+# ---------------------------------------------------------------------------
+
+def test_32_distinct_batches_at_most_6_signatures():
+    b = FeedBucketer(mask_name="batch_mask")
+    for n in range(1, 33):
+        b.bucket({"x": np.ones((n, 4), np.float32)})
+    s = b.get_stats()
+    assert s["batches"] == 32
+    assert s["shapes"] <= 6          # {1,2,4,8,16,32}
+    assert s["pad_waste_elems"] > 0
+
+
+def test_pad_waste_counter_exact():
+    b = FeedBucketer(mask_name=None)
+    b.bucket({"x": np.ones((5, 4), np.float32)})     # 8x4 padded: +12
+    b.bucket({"x": np.ones((8, 4), np.float32)})     # exact fit: +0
+    assert b.get_stats()["pad_waste_elems"] == 12
+
+
+# ---------------------------------------------------------------------------
+# mask correctness: padded rows are exact no-ops for loss AND grads
+# ---------------------------------------------------------------------------
+
+def _build_masked_train():
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    m = layers.data("batch_mask", shape=[1], dtype="float32")
+    per = layers.square_error_cost(layers.fc(x, size=8), y)
+    loss = layers.reduce_sum(per * m) / layers.reduce_sum(m)
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    w = fluid.default_main_program().all_parameters()[0].name
+    return loss, w
+
+
+def _fresh_exe():
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(scope):
+        exe.run(fluid.default_startup_program())
+    return exe, scope
+
+
+def test_bucketed_loss_and_update_match_unpadded():
+    loss, w = _build_masked_train()
+    r = np.random.default_rng(3)
+    feed = {"x": r.standard_normal((5, 4)).astype(np.float32),
+            "y": r.standard_normal((5, 1)).astype(np.float32)}
+
+    # reference: unpadded batch 5, mask of ones
+    exe_a, scope_a = _fresh_exe()
+    with scope_guard(scope_a):
+        ref_loss = exe_a.run(
+            feed=dict(feed, batch_mask=np.ones((5, 1), np.float32)),
+            fetch_list=[loss])[0]
+        ref_w = np.asarray(scope_a.get(w))
+
+    # bucketed: padded to 8 with 3 masked-off rows
+    exe_b, scope_b = _fresh_exe()
+    bucketer = FeedBucketer(mask_name="batch_mask")
+    with scope_guard(scope_b):
+        got_loss = exe_b.run(feed=bucketer.bucket(feed),
+                             fetch_list=[loss])[0]
+        got_w = np.asarray(scope_b.get(w))
+
+    np.testing.assert_allclose(got_loss, ref_loss, rtol=1e-6)
+    # one SGD step on each: masked grads must match the unpadded grads
+    np.testing.assert_allclose(got_w, ref_w, rtol=1e-5, atol=1e-7)
+
+
+def test_data_feeder_bucketer_integration():
+    from paddle_tpu.core.data_feeder import DataFeeder
+    layers.data("x", shape=[4], dtype="float32")
+    layers.data("y", shape=[1], dtype="float32")
+    feeder = DataFeeder(feed_list=["x", "y"],
+                        bucketer=FeedBucketer(mask_name="batch_mask"))
+    rows = [(np.ones(4, np.float32), np.zeros(1, np.float32))] * 5
+    out = feeder.feed(rows)
+    assert out["x"].shape == (8, 4)
+    assert out["batch_mask"].shape == (8, 1)
+
+
+def test_device_prefetch_transform_applies_bucketing_before_upload():
+    from paddle_tpu.reader.dataloader import device_prefetch
+    b = FeedBucketer(mask_name="batch_mask")
+    batches = [{"x": np.ones((n, 4), np.float32)} for n in (3, 5, 9)]
+    out = list(device_prefetch(batches, depth=2, transform=b.bucket))
+    assert [o["x"].shape[0] for o in out] == [4, 8, 16]
+    assert all(isinstance(o["x"], jax.Array) for o in out)
+    assert all(o["batch_mask"].shape == (o["x"].shape[0], 1) for o in out)

@@ -178,6 +178,16 @@ def run_suite():
                   "--demo", "--out-dir", PERF],
                  env={"JAX_PLATFORMS": "cpu"},
                  timeout_s=600, stdout_path="metrics_report.txt")
+    # 1c. async-pipeline comparison (ISSUE 3): dynamic-batch sync vs
+    #     async+bucketed steps/sec + jit-cache bound, on the CPU backend
+    #     (deterministic, and never a second concurrent TPU init racing
+    #     the ladder; executor.async.* metrics ride metrics_sample.json)
+    if _artifact_ok("bench_async.json"):
+        log("step async_compare: already landed in a prior cycle — skipping")
+    else:
+        run_step("async_compare", [py, bench],
+                 env={"JAX_PLATFORMS": "cpu", "BENCH_ASYNC_COMPARE": "1"},
+                 timeout_s=900, stdout_path="bench_async.json")
     # 2. headline: ERNIE-base, full sweep, HLO of the best batch archived
     if _artifact_ok("bench_ernie.json"):
         log("step ernie: already landed in a prior cycle — skipping")

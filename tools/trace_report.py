@@ -132,6 +132,18 @@ def print_cache_summary(metrics, file=None):
         amort = ctotal / steps
         print(f"amortized compile cost: {amort:.3f}ms/step over this run",
               file=file)
+    disp = _counter_total(metrics, "executor.async.dispatches")
+    if disp:
+        waits = _counter_total(metrics, "executor.async.window_waits")
+        _wc, wtotal = _hist_totals(metrics, "executor.async.host_sync_wait_ms")
+        print(f"async: dispatches={disp} window_waits={waits} "
+              f"host_sync_wait={wtotal / 1e3:.2f}s "
+              f"errors={_counter_total(metrics, 'executor.async.errors')}",
+              file=file)
+    bb = _counter_total(metrics, "executor.bucket.batches")
+    if bb:
+        waste = _counter_total(metrics, "executor.bucket.pad_waste_elems")
+        print(f"bucketing: batches={bb} pad_waste_elems={waste}", file=file)
 
 
 # ---------------------------------------------------------------------------
@@ -164,9 +176,37 @@ def run_demo(out_dir):
                               "y": rng.randn(8, 1).astype(np.float32)},
                         fetch_list=[loss])
 
+    # async + bucketed demo loop: a second tiny program driven through
+    # run_pipelined with a FeedBucketer, so executor.async.* and
+    # executor.bucket.* series land in the committed sample dump and the
+    # BENCH_* trajectory shows the pipeline's metrics round over round
+    from paddle_tpu.core import framework
+    from paddle_tpu.core.bucketing import FeedBucketer
+    amain, astart = framework.Program(), framework.Program()
+    with framework.program_guard(amain, astart):
+        ax = layers.data("x", shape=[4], dtype="float32")
+        ay = layers.data("y", shape=[1], dtype="float32")
+        am = layers.data("batch_mask", shape=[1], dtype="float32")
+        per = layers.square_error_cost(layers.fc(ax, size=8), ay)
+        aloss = layers.reduce_sum(per * am) / layers.reduce_sum(am)
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(aloss)
+    ascope = fluid.Scope()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(ascope):
+        exe2.run(astart)
+        bucketer = FeedBucketer(mask_name="batch_mask")
+        feeds = [{"x": rng.randn(n, 4).astype(np.float32),
+                  "y": rng.randn(n, 1).astype(np.float32)}
+                 for n in (3, 5, 6, 7)]       # buckets {4, 8}: 2 compiles
+        for _ in exe2.run_pipelined(amain, feeds, fetch_list=[aloss],
+                                    bucketer=bucketer, window=2):
+            pass
+
     metrics_path = os.path.join(out_dir, "metrics_sample.json")
     dump = global_registry().to_dict()
     dump["executor_stats"] = exe.get_stats()
+    dump["async_stats"] = exe2.get_stats()["async"]
+    dump["bucket_stats"] = bucketer.get_stats()
     with open(metrics_path, "w") as f:
         # single line: perf/ artifacts are parsed line-wise by
         # tools/bench_watch.py's _artifact_ok
