@@ -687,6 +687,86 @@ def run_async_compare(kind):
     return 0
 
 
+def run_guard_compare(kind):
+    """BENCH_GUARD_COMPARE=1: the robustness acceptance micro-bench
+    (CPU backend, tiny MLP). Guarded vs unguarded steady-state step
+    rate: the NaN/Inf sentinel is one fused isfinite reduction folded
+    into the compiled step plus a one-bool-per-var host check riding
+    the fetch, so the acceptance bar is overhead < 5%. Interleaved
+    best-of-N rounds for the same reason as the async bench: a shared
+    2-core container must not let one background burst decide which
+    MODE looks faster."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.core import framework
+    from paddle_tpu.core.executor import Scope, scope_guard
+
+    hidden = int(os.environ.get("BENCH_GUARD_HIDDEN", 64))
+    batch = int(os.environ.get("BENCH_GUARD_BATCH", 64))
+    steps = int(os.environ.get("BENCH_GUARD_STEPS", 400))
+    depth = int(os.environ.get("BENCH_GUARD_LAYERS", 8))
+    rng = np.random.default_rng(0)
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = layers.data("x", shape=[hidden], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        h = x
+        for _ in range(depth):
+            h = layers.fc(h, size=hidden, act="relu")
+        loss = layers.mean(layers.square_error_cost(
+            layers.fc(h, size=1), y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.01).minimize(loss)
+
+    def fresh_exe(guard):
+        scope = Scope()
+        exe = fluid.Executor(fluid.TPUPlace(0), guard=guard)
+        with scope_guard(scope):
+            exe.run(startup)
+        return exe, scope
+
+    feeds = [{"x": rng.standard_normal((batch, hidden)).astype(np.float32),
+              "y": rng.standard_normal((batch, 1)).astype(np.float32)}
+             for _ in range(8)]
+
+    def timed(exe, scope):
+        with scope_guard(scope):
+            exe.run(main, feed=feeds[0], fetch_list=[loss])   # warm
+            t0 = time.perf_counter()
+            for i in range(steps):
+                exe.run(main, feed=feeds[i % len(feeds)],
+                        fetch_list=[loss])
+        return steps / (time.perf_counter() - t0)
+
+    exe_u, scope_u = fresh_exe(guard=False)
+    exe_g, scope_g = fresh_exe(guard=True)
+    rates = {"unguarded": 0.0, "guarded": 0.0}
+    modes = [("unguarded", exe_u, scope_u), ("guarded", exe_g, scope_g)]
+    for _round in range(int(os.environ.get("BENCH_GUARD_ROUNDS", 5))):
+        # alternate mode order each round: a monotone background load
+        # ramp must not systematically favor whichever mode runs first
+        for name, exe, scope in (modes if _round % 2 == 0
+                                 else reversed(modes)):
+            rates[name] = max(rates[name], timed(exe, scope))
+    overhead = (rates["unguarded"] / rates["guarded"] - 1.0) \
+        if rates["guarded"] else None
+    result = {
+        "metric": "guard_steady_state_overhead",
+        "value": round(overhead, 4) if overhead is not None else None,
+        "unit": "fractional slowdown of guarded vs unguarded steady-"
+                "state steps/sec (acceptance: < 0.05)",
+        "unguarded_steps_per_sec": round(rates["unguarded"], 2),
+        "guarded_steps_per_sec": round(rates["guarded"], 2),
+        "guard_stats": exe_g.get_stats()["fault"],
+        "batch": batch, "hidden": hidden, "layers": depth,
+        "steps": steps,
+        "device_kind": kind,
+    }
+    print(json.dumps(result), flush=True)
+    return 0
+
+
 def bench_one(batch, seq_len, n_steps):
     import numpy as np
     from paddle_tpu.ops.pallas import flash
@@ -964,6 +1044,10 @@ def main():
         # async-pipeline micro-comparison: its own emission path; the
         # MFU/sweep scaffold below is for the model benches
         return run_async_compare(kind)
+
+    if os.environ.get("BENCH_GUARD_COMPARE") == "1":
+        # NaN/Inf-sentinel overhead micro-comparison (robustness layer)
+        return run_guard_compare(kind)
 
     seq_len = int(os.environ.get("BENCH_SEQ_LEN", 512))
     # defaults favor landing A number inside a fragile tunnel window:

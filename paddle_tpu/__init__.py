@@ -61,6 +61,7 @@ from .transpiler import (DistributeTranspiler,
                          memory_optimize, release_memory)
 from . import profiler
 from . import amp
+from . import robustness
 from . import models
 from . import utils
 from .utils import install_check   # fluid.install_check.run_check() parity

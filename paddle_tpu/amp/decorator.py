@@ -54,6 +54,27 @@ class OptimizerWithMixedPrecision:
     def get_loss_scaling(self):
         return self._loss_scaling
 
+    def rollback_hook(self, factor=None):
+        """Recovery hook for robustness.RecoveryPolicy(on_rollback=...):
+        after a NonFiniteError rollback, multiply the live loss-scaling
+        scope var by `factor` (default: this optimizer's decr_ratio).
+        The in-step dynamic update already decays the scale on overflow
+        steps, but a rollback RESTORES the pre-fault scale from the
+        checkpoint — without this hook the retry replays at exactly the
+        scale that just overflowed."""
+        factor = self._decr_ratio if factor is None else float(factor)
+
+        def hook(scope, fault):
+            import numpy as _np
+            import jax.numpy as _jnp
+            if self._loss_scaling is None:
+                return
+            val = scope.get(self._loss_scaling.name)
+            if val is not None:
+                scope.set(self._loss_scaling.name,
+                          _jnp.asarray(_np.asarray(val) * factor))
+        return hook
+
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
         from .policy import cast_model_to_bf16

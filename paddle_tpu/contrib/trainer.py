@@ -133,7 +133,27 @@ class Trainer:
         import os as _os
         serial = f"{epoch}.{step}"
         path = _os.path.join(cfg.checkpoint_dir, f"checkpoint_{serial}")
-        save_params(self.exe, path, main_program=self.train_program)
+        # directory-level atomic commit (robustness layer): params land
+        # in a temp dir, then one rename — a crash mid-save never leaves
+        # a half-written checkpoint_<serial> that load_serial would
+        # happily restore from. Re-saving an existing serial parks the
+        # old dir aside FIRST (rename is atomic; delete-then-replace
+        # would open a no-checkpoint crash window) and deletes it only
+        # after the new one is installed. Suffixes are DETERMINISTIC
+        # (no pid): a restart's save of the same serial cleans up a
+        # crashed predecessor's leftovers, and a crash between the two
+        # renames leaves the previous params recoverable at the known
+        # `<path>.old` location.
+        import shutil
+        tmp = f"{path}.tmp"
+        old = f"{path}.old"
+        shutil.rmtree(tmp, ignore_errors=True)
+        shutil.rmtree(old, ignore_errors=True)
+        save_params(self.exe, tmp, main_program=self.train_program)
+        if _os.path.exists(path):
+            _os.replace(path, old)
+        _os.replace(tmp, path)
+        shutil.rmtree(old, ignore_errors=True)
         cfg.epoch_id, cfg.step_id = epoch, step
         # retention over THIS trainer's saves only — checkpoint_dir
         # defaults to cwd, which may hold unrelated user directories

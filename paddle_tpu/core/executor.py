@@ -232,14 +232,16 @@ class FetchHandle:
     each carries exactly the fetches of its own step.
     """
 
-    __slots__ = ("_exe", "_fetches", "_error", "_finished", "step")
+    __slots__ = ("_exe", "_fetches", "_error", "_finished", "step",
+                 "_guard")
 
-    def __init__(self, exe, step, fetches=None, error=None):
+    def __init__(self, exe, step, fetches=None, error=None, guard=None):
         self._exe = exe
         self.step = step            # executor-wide async sequence number
         self._fetches = fetches
         self._error = error
         self._finished = error is not None
+        self._guard = guard         # (vec, names, step_id) sentinel ride
 
     def done(self):
         """True once every fetch materialized (never blocks);
@@ -263,6 +265,16 @@ class FetchHandle:
             except Exception as e:      # device-side failure surfaces here
                 self._error = e
                 self._exe._stats.count("executor.async.errors")
+            else:
+                if self._guard is not None:
+                    # NaN/Inf sentinel: the guard vec materialized with
+                    # the fetches; the host check re-raises HERE (and at
+                    # result()/drain()), never inside dispatch
+                    g, self._guard = self._guard, None
+                    try:
+                        self._exe._check_guard(g)
+                    except Exception as e:
+                        self._error = e
             self._finished = True
             self._exe._stats.observe("executor.async.host_sync_wait_ms",
                                      (time.perf_counter() - t0) * 1e3)
@@ -293,9 +305,18 @@ class Executor:
                     keeps up to `async_window` donated step executables
                     in flight, so the device never waits for the host's
                     feed preparation (docs/performance.md).
+
+    `guard=True` (or PADDLE_TPU_GUARD=1, or a robustness.GuardConfig)
+    folds a NaN/Inf sentinel into every compiled step: one fused
+    isfinite reduction over the loss, the param grads, and the float
+    fetches, checked host-side where results are observed — run()
+    raises robustness.NonFiniteError directly, async steps re-raise it
+    at FetchHandle.result()/wait()/drain() (docs/robustness.md). The
+    guard is fixed for the executor's lifetime (it is baked into the
+    compiled step functions).
     """
 
-    def __init__(self, place=None, async_window=None):
+    def __init__(self, place=None, async_window=None, guard=None):
         from .place import TPUPlace
         from ..utils import device_lock
         # OS-level interlock: two processes initializing the axon TPU
@@ -315,6 +336,13 @@ class Executor:
             else os.environ.get("PADDLE_TPU_ASYNC_WINDOW", 2))
         self._inflight = collections.deque()
         self._async_seq = 0
+        # NaN/Inf sentinel (robustness/guard.py): resolved once, then
+        # immutable — the sentinel reduction is baked into every step
+        # function this executor compiles
+        from ..robustness.guard import GuardConfig
+        self._guard = GuardConfig.resolve(
+            guard if guard is not None
+            else os.environ.get("PADDLE_TPU_GUARD"))
         # observability: per-instance counters/histograms mirrored into
         # the process-wide registry; gauges labeled per-executor there
         self._exe_id = f"exe{next(_EXECUTOR_SEQ)}"
@@ -400,6 +428,23 @@ class Executor:
         self._stats.set_gauge("executor.meta_cache.size",
                               len(self._meta_cache))
 
+    # -- NaN/Inf sentinel ----------------------------------------------
+    def _check_guard(self, guard):
+        """Host half of the sentinel: `guard` is (vec, names, step_id)
+        from a guarded step — vec[i] is the in-graph all-isfinite of
+        names[i]. The np.asarray is a tiny sync that rides the fetch
+        the caller was about to pay anyway."""
+        if guard is None:
+            return
+        vec, names, step_id = guard
+        self._stats.count("executor.fault.guard_steps")
+        flags = np.asarray(vec)
+        if flags.size and not flags.all():
+            bad = [names[i] for i in np.nonzero(~flags)[0]]
+            self._stats.count("executor.fault.nonfinite")
+            from ..robustness.guard import NonFiniteError
+            raise NonFiniteError(bad[0], step_id, bad)
+
     # -- observability --------------------------------------------------
     def get_stats(self):
         """Structured snapshot of this executor's counters and span
@@ -439,6 +484,9 @@ class Executor:
             "spans": {k: h(f"executor.span.{k}_ms")
                       for k in ("key_build", "trace", "compile",
                                 "execute", "fetch")},
+            "fault": {"guard_steps": c("executor.fault.guard_steps"),
+                      "nonfinite": c("executor.fault.nonfinite"),
+                      "guarded": self._guard is not None},
             "async": {"dispatches": c("executor.async.dispatches"),
                       "errors": c("executor.async.errors"),
                       "window_waits": c("executor.async.window_waits"),
@@ -602,8 +650,11 @@ class Executor:
             feed_var_name="feed", fetch_var_name="fetch", return_numpy=True,
             use_program_cache=True):
         t_step0 = time.perf_counter()
-        fetches = self._dispatch(program, feed, fetch_list, scope,
-                                 use_program_cache)
+        fetches, guard = self._dispatch(program, feed, fetch_list, scope,
+                                        use_program_cache)
+        # sentinel check BEFORE conversion: sync semantics put the
+        # NonFiniteError in the caller's hands, not in the fetch copies
+        self._check_guard(guard)
         with self._stats.span("executor.fetch", "executor.span.fetch_ms"):
             if return_numpy:
                 out = [np.asarray(f) for f in fetches]
@@ -651,15 +702,15 @@ class Executor:
         try:
             if bucketer is not None:
                 feed = bucketer.bucket(feed or {})
-            fetches = self._dispatch(program, feed, fetch_list, scope,
-                                     use_program_cache)
+            fetches, guard = self._dispatch(program, feed, fetch_list,
+                                            scope, use_program_cache)
         except Exception as e:
             # dispatch never ran on device: deliver the error through
             # the handle (async contract — the CALLER of result() owns
             # failure handling, not whatever loop happened to dispatch)
             self._stats.count("executor.async.errors")
             return FetchHandle(self, step, error=e)
-        handle = FetchHandle(self, step, fetches)
+        handle = FetchHandle(self, step, fetches, guard=guard)
         self._inflight.append(handle)
         self._update_inflight_gauge()
         self._stats.count("executor.async.dispatches")
@@ -714,9 +765,11 @@ class Executor:
                   use_program_cache):
         """Shared front half of run()/run_async(): canonicalize feeds,
         build or fetch the cached step fn, invoke it (XLA dispatch is
-        asynchronous), write the new state into the scope. Returns the
-        step's fetch tuple as device arrays — synchronization and
-        numpy-conversion policy belong to the caller."""
+        asynchronous), write the new state into the scope. Returns
+        (fetches, guard): the step's fetch tuple as device arrays, and
+        the sentinel ride-along for _check_guard (None unguarded) —
+        synchronization, numpy conversion and the guard check belong to
+        the caller."""
         program = program if program is not None else default_main_program()
         scope = scope if scope is not None else global_scope()
         feed = feed or {}
@@ -778,7 +831,7 @@ class Executor:
                    state_sig, mesh_key)
         entry = self._cache.get(key) if use_program_cache else None
         fresh = entry is None
-        if fresh:
+        if fresh:  # entry = (step_fn, guard_cell)
             if use_program_cache:
                 self._stats.count("executor.jit_cache.misses")
             else:
@@ -797,7 +850,7 @@ class Executor:
             self._update_cache_gauges()
         else:
             self._stats.count("executor.jit_cache.hits")
-        step_fn = entry
+        step_fn, guard_cell = entry
 
         seed = program.random_seed or framework.default_seed()
         # (seed, step) ride in as a tiny host array; the key derivation
@@ -806,8 +859,10 @@ class Executor:
         # (half the cached-step overhead)
         # mask to uint32: PRNGKey accepted negative/wide seeds and numpy 2
         # would raise where jax silently wrapped
+        step_id = self._step_counter     # what the RNG folds in; what a
+        #                                  NonFiniteError reports
         rng = np.asarray([seed & 0xFFFFFFFF,
-                          self._step_counter & 0xFFFFFFFF], np.uint32)
+                          step_id & 0xFFFFFFFF], np.uint32)
         self._step_counter += 1
 
         self._last_call = (step_fn, (state, feeds, rng))
@@ -830,7 +885,15 @@ class Executor:
         for n, v in new_state.items():
             scope.set(n, v)
         self._stats.count("executor.steps")
-        return fetches
+        guard = None
+        if self._guard is not None:
+            # the step appended its sentinel vector as an extra fetch;
+            # guard_cell was filled (with the monitored-name order) at
+            # trace time, so it is populated by now even on a fresh entry
+            gvec, fetches = fetches[-1], fetches[:-1]
+            if guard_cell:
+                guard = (gvec, tuple(guard_cell), step_id)
+        return fetches, guard
 
     # ------------------------------------------------------------------
     def _build(self, program, fetch_names, persist_names, state_sig):
@@ -842,6 +905,10 @@ class Executor:
                 break
         is_test = program._is_test
         state_keys = set(state_sig)
+        guard_cfg = self._guard
+        # filled at trace time with the monitored-name order (one trace
+        # per cache entry, so the cell and its step fn stay consistent)
+        guard_cell = []
 
         # Pipeline parallelism: when PipelineOptimizer attached a config and
         # the active mesh has a pp axis, lower the forward section to the
@@ -954,12 +1021,39 @@ class Executor:
 
             new_state = {n: env[n] for n in persist_names if n in env}
             fetches = tuple(env[f] for f in fetch_names)
+            if guard_cfg is not None:
+                # NaN/Inf sentinel folded INTO the step: one fused
+                # isfinite reduction per monitored var (loss, grads,
+                # float fetches), returned as a (n,)-bool extra fetch —
+                # a device-side check, not a host scan of the arrays
+                if marker_idx is not None:
+                    marker = gb.ops[marker_idx]
+                    g_loss = marker.attr("loss")
+                    g_grads = [grad_var_name(n)
+                               for n in marker.attr("params")]
+                else:
+                    g_loss, g_grads = None, []
+                names, flags = [], []
+                for n in guard_cfg.candidates(g_loss, g_grads,
+                                              fetch_names):
+                    v = env.get(n)
+                    if v is None:
+                        continue
+                    v = jnp.asarray(v)
+                    if not jnp.issubdtype(v.dtype, jnp.floating):
+                        continue
+                    names.append(n)
+                    flags.append(jnp.all(jnp.isfinite(v)))
+                guard_cell[:] = names
+                gvec = jnp.stack(flags) if flags \
+                    else jnp.zeros((0,), jnp.bool_)
+                fetches = fetches + (gvec,)
             return new_state, fetches
 
         # Donate the state pytree: param/opt-state updates reuse HBM buffers,
         # matching fluid's in-place update semantics with zero copies.
         donate = (0,) if marker_idx is not None and state_keys else ()
-        return jax.jit(step, donate_argnums=donate)
+        return jax.jit(step, donate_argnums=donate), guard_cell
 
 
 # Convenience mirroring fluid.executor._run helpers -------------------------

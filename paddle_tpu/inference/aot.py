@@ -211,8 +211,14 @@ def save_train_step(dirname, program, feed_names, fetch_names,
         state[n] = jnp.asarray(val)
 
     exe = Executor()
-    step = exe._build(program, tuple(fetch_names), tuple(persist_names),
-                      tuple(sorted(state)))
+    # the export artifact is ALWAYS unguarded: the sentinel would add an
+    # extra output to the serialized calling convention, and guarding
+    # (PADDLE_TPU_GUARD may be set in this process) belongs to the
+    # training loop, not the frozen artifact
+    exe._guard = None
+    step, _guard_cell = exe._build(
+        program, tuple(fetch_names), tuple(persist_names),
+        tuple(sorted(state)))
     state_specs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
                    for k, v in state.items()}
     feed_specs = _feed_specs(program, feed_names, batch)

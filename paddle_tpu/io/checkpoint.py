@@ -4,6 +4,15 @@ Parity: fluid checkpointing (io.py save/load_persistables + trainer state) —
 persistables include optimizer accumulators, LR counters and batch-norm
 stats, so save/load_checkpoint round-trips a training run exactly.
 
+Durability contract (robustness layer, docs/robustness.md): every
+checkpoint write is ATOMIC — payload files land via temp file + fsync +
+os.replace, and a manifest/commit marker is written LAST (meta.json for
+the single-file layout, index.json for the sharded layout), so a crash
+at ANY byte leaves either the previous complete checkpoint or an
+obviously-incomplete directory, never a loadable-looking torn one. The
+manifest carries a per-array CRC32; load validates it and raises
+CheckpointCorruptError instead of resuming from silently-corrupt state.
+
 For big sharded models, save_checkpoint_sharded writes one file PER DEVICE
 SHARD keyed by the array's NamedSharding (orbax-style layout, self-contained
 format: index.json + shards/*.npy) — no single file ever holds the full
@@ -11,52 +20,296 @@ model, saves can run async behind a completion barrier, and restore is
 bitwise and supports partial (per-var) loading onto a new mesh.
 """
 
+import atexit
+import glob
 import json
 import os
 import re
 import threading
+import time
+import warnings
+import zlib
 
 import numpy as np
 
 from ..core.framework import default_main_program
 from ..core.executor import global_scope
-from .state import save_persistables, load_persistables
+from ..observability import ComponentStats
+from .state import _atomic_save, load_persistables
+
+_stats = ComponentStats()
+
+CHECKPOINT_FORMAT = 2
 
 
-def save_checkpoint(executor, dirname, main_program=None, step=0, extra=None):
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed manifest/CRC validation (torn write, bit rot,
+    or a partial copy). Callers with a retention directory should fall
+    back to the previous good checkpoint (CheckpointManager does)."""
+
+
+# ---------------------------------------------------------------------------
+# write-fault hook (robustness/chaos.py): called before every physical
+# checkpoint file write so the chaos tier can fail the Nth write
+# deterministically on the REAL write path
+# ---------------------------------------------------------------------------
+
+_WRITE_FAULT_HOOK = None
+
+
+def set_write_fault_hook(hook):
+    """Install `hook(kind, path)` to run before each physical checkpoint
+    write (kinds: 'state', 'meta', 'shard', 'index'). Raising from the
+    hook makes that write fail. Pass None to uninstall. Test-only."""
+    global _WRITE_FAULT_HOOK
+    _WRITE_FAULT_HOOK = hook
+
+
+def _fault(kind, path):
+    if _WRITE_FAULT_HOOK is not None:
+        _WRITE_FAULT_HOOK(kind, path)
+
+
+# ---------------------------------------------------------------------------
+# atomic write primitive: the shared temp+fsync+os.replace sequence
+# lives in io/state.py (ONE copy); checkpoint writes add the fault hook
+# ---------------------------------------------------------------------------
+
+def _atomic_write(path, writer, kind):
+    """temp file + fsync + os.replace: `path` either keeps its old
+    content or atomically becomes the complete new content."""
+    _fault(kind, path)
+    _atomic_save(path, writer)
+
+
+def _crc32(arr):
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
+def _manifest_entry(arr):
+    return {"crc32": _crc32(arr), "shape": [int(s) for s in arr.shape],
+            "dtype": str(arr.dtype)}
+
+
+def _snapshot(scope, program):
+    """Persistables as host numpy — the device->host sync happens HERE,
+    on the caller's thread (the arrays may be donated by the next step),
+    never inside a background writer."""
+    names = [v.name for v in program.list_vars() if v.persistable]
+    return {n: np.asarray(scope.get(n)) for n in sorted(set(names))
+            if scope.get(n) is not None}
+
+
+def _write_state(dirname, snapshot, meta):
+    """The single-file layout's committed write sequence: state.npz
+    first, then meta.json (manifest + commit marker) LAST."""
     os.makedirs(dirname, exist_ok=True)
-    save_persistables(executor, dirname, main_program, filename="state.npz")
-    meta = {"step": int(step), "extra": extra or {}}
-    with open(os.path.join(dirname, "meta.json"), "w") as f:
-        json.dump(meta, f)
+    t0 = time.perf_counter()
+    _atomic_write(os.path.join(dirname, "state.npz"),
+                  lambda f: np.savez(f, **snapshot), "state")
+    meta = dict(meta)
+    meta["format"] = CHECKPOINT_FORMAT
+    meta["manifest"] = {n: _manifest_entry(a) for n, a in snapshot.items()}
+    _atomic_write(os.path.join(dirname, "meta.json"),
+                  lambda f: f.write(json.dumps(meta).encode()), "meta")
+    _stats.count("checkpoint.saves")
+    _stats.observe("checkpoint.save_ms", (time.perf_counter() - t0) * 1e3)
 
 
-def load_checkpoint(executor, dirname, main_program=None):
-    load_persistables(executor, dirname, main_program, filename="state.npz")
+def save_checkpoint(executor, dirname, main_program=None, step=0,
+                    extra=None, scope=None):
+    scope = scope or global_scope()
+    program = main_program or default_main_program()
+    _write_state(dirname, _snapshot(scope, program),
+                 {"step": int(step), "extra": extra or {}})
+
+
+def load_checkpoint(executor, dirname, main_program=None, scope=None,
+                    validate=True):
+    """Restore a checkpoint directory. Validates the meta.json manifest
+    (per-array CRC32) before touching the scope and raises
+    CheckpointCorruptError on mismatch. When `dirname` is a retention
+    root holding `ckpt-*` subdirectories (CheckpointManager layout),
+    the newest VALID checkpoint is loaded, falling back past corrupt or
+    incomplete ones with a warning."""
+    scope = scope or global_scope()
+    program = main_program or default_main_program()
     meta_path = os.path.join(dirname, "meta.json")
+    if not os.path.exists(meta_path):
+        subs = sorted(glob.glob(os.path.join(dirname, "ckpt-*")),
+                      reverse=True)
+        if subs:
+            last_err = None
+            for sub in subs:
+                # a ckpt-* dir without meta.json is an ABORTED save
+                # (the marker is written last) — it must not reach the
+                # single-dir path below, where a bare state.npz would
+                # load as a fake committed step-0 checkpoint
+                if not os.path.exists(os.path.join(sub, "meta.json")):
+                    _stats.count("checkpoint.fallbacks")
+                    warnings.warn(
+                        f"checkpoint {sub} has no commit marker "
+                        f"(aborted save); falling back to the previous "
+                        f"one", stacklevel=2)
+                    continue
+                try:
+                    return load_checkpoint(executor, sub, main_program,
+                                           scope=scope, validate=validate)
+                except (OSError, ValueError, CheckpointCorruptError) as e:
+                    _stats.count("checkpoint.fallbacks")
+                    warnings.warn(
+                        f"checkpoint {sub} unusable ({e}); falling back "
+                        f"to the previous one", stacklevel=2)
+                    last_err = e
+            raise CheckpointCorruptError(
+                f"{dirname}: no loadable checkpoint among "
+                f"{len(subs)} candidates") from last_err
+    t0 = time.perf_counter()
+    state_path = os.path.join(dirname, "state.npz")
     if os.path.exists(meta_path):
         with open(meta_path) as f:
-            return json.load(f)
-    return {"step": 0, "extra": {}}
+            meta = json.load(f)
+    else:
+        meta = {"step": 0, "extra": {}}
+    if validate and os.path.exists(state_path):
+        # ONE read of the archive: validate every array against the
+        # manifest, then place the already-decompressed copies straight
+        # into the scope (re-reading via load_persistables would double
+        # the restore I/O of a large checkpoint)
+        import jax.numpy as jnp
+        wanted = {v.name for v in program.list_vars() if v.persistable}
+        staged = []
+        try:
+            with np.load(state_path, allow_pickle=False) as data:
+                manifest = meta.get("manifest")
+                for n in data.files:
+                    arr = data[n]
+                    if manifest is not None:
+                        entry = manifest.get(n)
+                        if entry is None or _crc32(arr) != entry["crc32"]:
+                            _stats.count("checkpoint.crc_failures")
+                            raise CheckpointCorruptError(
+                                f"{dirname}: CRC mismatch for '{n}' — "
+                                f"torn or corrupt checkpoint")
+                    if n in wanted:
+                        staged.append((n, arr))
+                if manifest is not None:
+                    missing = set(manifest) - set(data.files)
+                    if missing:
+                        _stats.count("checkpoint.crc_failures")
+                        raise CheckpointCorruptError(
+                            f"{dirname}: manifest lists "
+                            f"{sorted(missing)} but state.npz lacks "
+                            f"them")
+        except CheckpointCorruptError:
+            raise
+        except Exception as e:
+            # a torn .npz fails INSIDE numpy/zipfile (BadZipFile, EOF,
+            # bad zip CRC) before our manifest check even runs
+            _stats.count("checkpoint.crc_failures")
+            raise CheckpointCorruptError(
+                f"{dirname}: state.npz unreadable ({e}) — torn or "
+                f"corrupt checkpoint") from e
+        # validation passed over the WHOLE archive before any mutation
+        for n, arr in staged:
+            scope.set(n, jnp.asarray(arr))
+    else:
+        load_persistables(executor, dirname, program,
+                          filename="state.npz", scope=scope)
+    _stats.count("checkpoint.restores")
+    _stats.observe("checkpoint.restore_ms",
+                   (time.perf_counter() - t0) * 1e3)
+    return meta
 
 
-def save_checkpoint_async(executor, dirname, main_program=None, step=0):
-    """Async save: snapshot to host in a thread (orbax-style async)."""
-    scope = global_scope()
+# ---------------------------------------------------------------------------
+# async writers: non-daemon + error box + join-at-exit
+# ---------------------------------------------------------------------------
+
+_LIVE_WRITERS = []          # CheckpointHandles with a running thread
+_writers_lock = threading.Lock()
+
+
+def _track(handle):
+    with _writers_lock:
+        _LIVE_WRITERS.append(handle)
+
+
+def _untrack(handle):
+    with _writers_lock:
+        try:
+            _LIVE_WRITERS.remove(handle)
+        except ValueError:
+            pass
+
+
+@atexit.register
+def _join_writers_at_exit():
+    """A daemon writer dying mid-write at interpreter exit is exactly
+    the torn-checkpoint bug; join every outstanding writer instead and
+    surface swallowed errors as a warning (raising is useless here)."""
+    with _writers_lock:
+        pending = list(_LIVE_WRITERS)
+    for h in pending:
+        try:
+            h.wait()
+        except BaseException as e:          # noqa: BLE001 — exit path
+            warnings.warn(f"async checkpoint write failed: {e!r}")
+
+
+class CheckpointHandle:
+    """Completion barrier for an (async) checkpoint save. `wait()` joins
+    the writer and RE-RAISES anything the write path threw — an async
+    save error is never silently swallowed."""
+
+    def __init__(self, thread=None, error_box=None):
+        self._thread = thread
+        # `error_box or []` would DROP the writer's box while it is
+        # still empty — the exact silently-swallowed-error bug this
+        # handle exists to fix
+        self._error_box = error_box if error_box is not None else []
+
+    def wait(self):
+        if self._thread is not None:
+            self._thread.join()
+        _untrack(self)
+        if self._error_box:
+            raise self._error_box[0]
+        return True
+
+    result = wait
+    join = wait         # Thread-API compat (save_checkpoint_async < fmt 2)
+
+    def done(self):
+        return self._thread is None or not self._thread.is_alive()
+
+
+def save_checkpoint_async(executor, dirname, main_program=None, step=0,
+                          extra=None, scope=None):
+    """Async save: snapshot to host on the CALLING thread (orbax-style),
+    file I/O in a non-daemon background thread. Returns a
+    CheckpointHandle — `wait()` is the completion barrier and re-raises
+    any write error; un-waited handles are joined at interpreter exit."""
+    scope = scope or global_scope()
     program = main_program or default_main_program()
-    names = [v.name for v in program.list_vars() if v.persistable]
-    snapshot = {n: np.asarray(scope.get(n)) for n in names
-                if scope.get(n) is not None}
+    snapshot = _snapshot(scope, program)
+    meta = {"step": int(step), "extra": extra or {}}
+    err_box = []
+    handle = CheckpointHandle(None, err_box)
 
     def _write():
-        os.makedirs(dirname, exist_ok=True)
-        np.savez(os.path.join(dirname, "state.npz"), **snapshot)
-        with open(os.path.join(dirname, "meta.json"), "w") as f:
-            json.dump({"step": int(step), "extra": {}}, f)
+        try:
+            _write_state(dirname, snapshot, meta)
+        except BaseException as e:      # surfaced at .wait()/atexit
+            _stats.count("checkpoint.write_failures")
+            err_box.append(e)
 
-    t = threading.Thread(target=_write, daemon=True)
+    t = threading.Thread(target=_write, daemon=False,
+                         name=f"ckpt-writer-{os.path.basename(dirname)}")
+    handle._thread = t
+    _track(handle)
     t.start()
-    return t
+    return handle
 
 
 # ---------------------------------------------------------------------------
@@ -82,23 +335,6 @@ def _spec_from_json(entries):
     return P(*[tuple(e) if isinstance(e, list) else e for e in entries])
 
 
-class CheckpointHandle:
-    """Completion barrier for an (async) sharded save."""
-
-    def __init__(self, thread=None, error_box=None):
-        self._thread = thread
-        self._error_box = error_box or []
-
-    def wait(self):
-        if self._thread is not None:
-            self._thread.join()
-        if self._error_box:
-            raise self._error_box[0]
-        return True
-
-    result = wait
-
-
 def save_checkpoint_sharded(executor, dirname, main_program=None, step=0,
                             extra=None, async_save=False, scope=None):
     """Write every persistable as per-shard .npy files.
@@ -107,8 +343,11 @@ def save_checkpoint_sharded(executor, dirname, main_program=None, step=0,
     device shard (its global index recorded in index.json); replicated vars
     contribute one file. Device->host transfers happen synchronously (the
     arrays may be donated by the next step); file IO runs in a background
-    thread when async_save=True. Returns a CheckpointHandle — call .wait()
-    as the completion barrier before relying on the checkpoint.
+    non-daemon thread when async_save=True. Returns a CheckpointHandle —
+    call .wait() as the completion barrier before relying on the
+    checkpoint. Each shard file lands atomically with a CRC32 recorded in
+    index.json, which is itself written LAST and atomically: its presence
+    is the directory-level commit marker.
     """
     from jax.sharding import NamedSharding
 
@@ -140,50 +379,71 @@ def save_checkpoint_sharded(executor, dirname, main_program=None, step=0,
                 rel = f"shards/{_safe_name(n)}--{len(entry['shards'])}.npy"
                 data = np.asarray(sh.data)
                 entry["shards"].append({"file": rel, "start": list(start),
-                                        "shape": list(data.shape)})
+                                        "shape": list(data.shape),
+                                        "crc32": _crc32(data)})
                 payloads.append((rel, data))
         else:
             rel = f"shards/{_safe_name(n)}--full.npy"
             data = np.asarray(val)
             entry["shards"].append({"file": rel,
                                     "start": [0] * data.ndim,
-                                    "shape": list(data.shape)})
+                                    "shape": list(data.shape),
+                                    "crc32": _crc32(data)})
             payloads.append((rel, data))
         index[n] = entry
 
-    meta = {"step": int(step), "extra": extra or {}}
+    meta = {"step": int(step), "extra": extra or {},
+            "format": CHECKPOINT_FORMAT}
     err_box = []
+    handle = CheckpointHandle(None, err_box)
 
     def _write():
         try:
+            t0 = time.perf_counter()
             os.makedirs(os.path.join(dirname, "shards"), exist_ok=True)
             for rel, data in payloads:
-                np.save(os.path.join(dirname, rel), data)
-            # index written LAST: its presence marks a complete checkpoint.
-            with open(os.path.join(dirname, "index.json"), "w") as f:
-                json.dump({"meta": meta, "vars": index}, f)
+                _atomic_write(os.path.join(dirname, rel),
+                              lambda f, d=data: np.save(f, d), "shard")
+            # index written LAST + atomically: its presence marks a
+            # complete checkpoint (the directory-level commit marker)
+            _atomic_write(
+                os.path.join(dirname, "index.json"),
+                lambda f: f.write(json.dumps(
+                    {"meta": meta, "vars": index}).encode()), "index")
+            _stats.count("checkpoint.saves")
+            _stats.observe("checkpoint.save_ms",
+                           (time.perf_counter() - t0) * 1e3)
         except BaseException as e:  # surfaced at .wait()
+            _stats.count("checkpoint.write_failures")
             err_box.append(e)
 
     if async_save:
-        t = threading.Thread(target=_write, daemon=True)
+        t = threading.Thread(target=_write, daemon=False,
+                             name="ckpt-shard-writer")
+        handle._thread = t
+        _track(handle)
         t.start()
-        return CheckpointHandle(t, err_box)
+        return handle
     _write()
-    return CheckpointHandle(None, err_box)
+    if err_box:
+        raise err_box[0]
+    return handle
 
 
 def load_checkpoint_sharded(executor, dirname, main_program=None, mesh=None,
-                            var_names=None, scope=None):
+                            var_names=None, scope=None, validate=True):
     """Restore from a sharded checkpoint. Assembles each var from its shard
     files (bitwise) and places it back: with `mesh` given, vars that were
     saved sharded are device_put with their recorded PartitionSpec on that
     mesh; otherwise they land replicated/unsharded. var_names restores a
-    subset (partial restore). Returns the meta dict ({step, extra})."""
+    subset (partial restore). Shard CRCs recorded by a format-2 save are
+    verified before anything lands in the scope (validate=False skips).
+    Returns the meta dict ({step, extra})."""
     import jax
     from jax.sharding import NamedSharding
 
     scope = scope or global_scope()
+    t0 = time.perf_counter()
     index_path = os.path.join(dirname, "index.json")
     if not os.path.exists(index_path):
         raise FileNotFoundError(
@@ -191,19 +451,32 @@ def load_checkpoint_sharded(executor, dirname, main_program=None, mesh=None,
     with open(index_path) as f:
         blob = json.load(f)
     wanted = set(var_names) if var_names is not None else None
+    staged = []
     for n, entry in blob["vars"].items():
         if wanted is not None and n not in wanted:
             continue
         full = np.empty(entry["shape"], dtype=np.dtype(entry["dtype"]))
         for sh in entry["shards"]:
             data = np.load(os.path.join(dirname, sh["file"]))
+            if validate and "crc32" in sh and _crc32(data) != sh["crc32"]:
+                _stats.count("checkpoint.crc_failures")
+                raise CheckpointCorruptError(
+                    f"{dirname}: CRC mismatch in shard {sh['file']} of "
+                    f"'{n}' — torn or corrupt checkpoint")
             idx = tuple(slice(st, st + ln)
                         for st, ln in zip(sh["start"], data.shape))
             full[idx] = data
+        staged.append((n, entry, full))
+    # validation happened above, BEFORE any scope mutation: a corrupt
+    # checkpoint must not leave the scope half-restored
+    for n, entry, full in staged:
         if mesh is not None and "spec" in entry:
             arr = jax.device_put(
                 full, NamedSharding(mesh, _spec_from_json(entry["spec"])))
         else:
             arr = jax.numpy.asarray(full)
         scope.set(n, arr)
+    _stats.count("checkpoint.restores")
+    _stats.observe("checkpoint.restore_ms",
+                   (time.perf_counter() - t0) * 1e3)
     return blob["meta"]

@@ -4,6 +4,12 @@ Parity: python/paddle/fluid/io.py (save_vars/save_params/save_persistables,
 load_*). Storage format: one .npy per var under dirname, or a single
 combined .npz when filename is given — a portable host-side format (the
 reference writes LoDTensor protobufs).
+
+Robustness: every file save_vars writes lands ATOMICALLY (temp file +
+fsync + os.replace) — a crash mid-save leaves the previous file intact,
+never a torn .npy/.npz that numpy would half-load. `scope=` selects the
+variable store (checkpoint rollback restores into a GuardedTrainer's
+private scope, not whatever the global scope happens to be).
 """
 
 import os
@@ -22,18 +28,42 @@ def is_persistable(var):
     return bool(getattr(var, "persistable", False))
 
 
-def _resolve(executor, dirname, main_program, predicate, filename, save):
-    program = main_program or default_main_program()
-    scope = global_scope()
-    names = [v.name for v in program.list_vars() if predicate(v)]
-    os.makedirs(dirname, exist_ok=True)
-    return program, scope, names
+def _fsync_dir(dirname):
+    try:
+        fd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _atomic_save(path, writer):
+    """temp + fsync + os.replace: the destination either keeps its old
+    bytes or atomically becomes the complete new file."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            writer(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(os.path.dirname(path) or ".")
 
 
 def save_vars(executor, dirname, main_program=None, vars=None,
-              predicate=None, filename=None):
+              predicate=None, filename=None, scope=None):
     program = main_program or default_main_program()
-    scope = global_scope()
+    scope = scope or global_scope()
     if vars is None:
         vars = [v for v in program.list_vars() if (predicate or is_persistable)(v)]
     os.makedirs(dirname, exist_ok=True)
@@ -45,27 +75,33 @@ def save_vars(executor, dirname, main_program=None, vars=None,
             continue
         arrays[name] = np.asarray(val)
     if filename is not None:
-        np.savez(os.path.join(dirname, filename), **arrays)
+        _atomic_save(os.path.join(dirname, filename),
+                     lambda f: np.savez(f, **arrays))
     else:
         for name, arr in arrays.items():
-            np.save(os.path.join(dirname, name.replace("/", "__") + ".npy"), arr)
+            _atomic_save(
+                os.path.join(dirname, name.replace("/", "__") + ".npy"),
+                lambda f, a=arr: np.save(f, a))
 
 
-def save_params(executor, dirname, main_program=None, filename=None):
+def save_params(executor, dirname, main_program=None, filename=None,
+                scope=None):
     return save_vars(executor, dirname, main_program,
-                     predicate=is_parameter, filename=filename)
+                     predicate=is_parameter, filename=filename, scope=scope)
 
 
-def save_persistables(executor, dirname, main_program=None, filename=None):
+def save_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
     return save_vars(executor, dirname, main_program,
-                     predicate=is_persistable, filename=filename)
+                     predicate=is_persistable, filename=filename,
+                     scope=scope)
 
 
 def load_vars(executor, dirname, main_program=None, vars=None,
-              predicate=None, filename=None):
+              predicate=None, filename=None, scope=None):
     import jax.numpy as jnp
     program = main_program or default_main_program()
-    scope = global_scope()
+    scope = scope or global_scope()
     if vars is None:
         vars = [v for v in program.list_vars() if (predicate or is_persistable)(v)]
     if filename is not None:
@@ -84,14 +120,17 @@ def load_vars(executor, dirname, main_program=None, vars=None,
             scope.set(name, jnp.asarray(np.load(path)))
 
 
-def load_params(executor, dirname, main_program=None, filename=None):
+def load_params(executor, dirname, main_program=None, filename=None,
+                scope=None):
     return load_vars(executor, dirname, main_program,
-                     predicate=is_parameter, filename=filename)
+                     predicate=is_parameter, filename=filename, scope=scope)
 
 
-def load_persistables(executor, dirname, main_program=None, filename=None):
+def load_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
     return load_vars(executor, dirname, main_program,
-                     predicate=is_persistable, filename=filename)
+                     predicate=is_persistable, filename=filename,
+                     scope=scope)
 
 
 def get_parameter_value(para, executor=None):

@@ -91,6 +91,36 @@ METRIC_SPECS = [
      "padding elements FeedBucketer added (bucketed minus real size)"),
     ("executor.bucket.shapes", "gauge",
      "distinct post-bucketing feed signatures a FeedBucketer produced"),
+    ("executor.fault.guard_steps", "counter",
+     "guarded steps whose NaN/Inf sentinel vector was checked"),
+    ("executor.fault.nonfinite", "counter",
+     "steps on which the NaN/Inf sentinel tripped (NonFiniteError)"),
+    ("executor.fault.rollbacks", "counter",
+     "GuardedTrainer checkpoint rollbacks after a fault"),
+    ("executor.fault.skipped_batches", "counter",
+     "offending feeds dropped by RecoveryPolicy(skip_bad_batch=True)"),
+    ("executor.fault.preemptions", "counter",
+     "preemption requests honored (drain + emergency checkpoint)"),
+    ("checkpoint.saves", "counter",
+     "checkpoints committed (atomic rename + manifest landed)"),
+    ("checkpoint.save_ms", "histogram",
+     "wall ms of one committed checkpoint write (payload + manifest)"),
+    ("checkpoint.restores", "counter", "checkpoint restores completed"),
+    ("checkpoint.restore_ms", "histogram",
+     "wall ms of one checkpoint restore (load + CRC validation)"),
+    ("checkpoint.write_failures", "counter",
+     "checkpoint write attempts that raised (counted before any retry)"),
+    ("checkpoint.crc_failures", "counter",
+     "manifest/CRC validation failures at load (torn or corrupt file)"),
+    ("checkpoint.fallbacks", "counter",
+     "restores that skipped a corrupt/incomplete checkpoint and fell "
+     "back to an older one"),
+    ("checkpoint.evictions", "counter",
+     "checkpoints pruned by a CheckpointManager retention policy"),
+    ("checkpoint.emergency_saves", "counter",
+     "checkpoints written by the preemption drain path"),
+    ("checkpoint.retained", "gauge",
+     "checkpoints currently retained by a CheckpointManager"),
     ("executor.dp.runs", "counter", "data-parallel (mesh) run() calls"),
     ("executor.dp.shard_state_ms", "histogram",
      "feed/state device placement on the data-parallel path"),

@@ -1,0 +1,35 @@
+"""Fault-tolerant training: sentinels, rollback, preemption, chaos.
+
+The whole-Program trace+jit Executor (core/executor.py) makes a step
+cheap; this layer makes a RUN survivable. Four pieces, wired through
+the Executor and checkpoint I/O (docs/robustness.md):
+
+- guard.py    — NaN/Inf sentinels folded into the compiled step
+                (`Executor(guard=True)` / PADDLE_TPU_GUARD=1); a
+                tripped sentinel raises NonFiniteError where results
+                are observed, naming the first bad var and step.
+- checkpoint_manager.py — last-K-good retention over io.checkpoint's
+                atomic (temp + fsync + os.replace, CRC32 manifest)
+                write path, with retry/backoff and fall-back restore.
+- trainer.py  — GuardedTrainer: checkpoint-segmented training loop
+                that rolls back, runs recovery hooks (LR backoff, AMP
+                loss-scale reduction), replays, and bounds retries.
+- preemption.py / chaos.py — SIGTERM/SIGINT drain-and-save, and the
+                deterministic fault injector the chaos test tier uses
+                to exercise every recovery path without flaky timing.
+"""
+
+from .guard import GuardConfig, NonFiniteError
+from .chaos import ChaosInjector, CheckpointWriteFault
+from .checkpoint_manager import CheckpointError, CheckpointManager
+from .preemption import PreemptionHandler
+from .trainer import (GuardedTrainer, RecoveryPolicy, TrainResult,
+                      lr_backoff)
+
+__all__ = [
+    "GuardConfig", "NonFiniteError",
+    "ChaosInjector", "CheckpointWriteFault",
+    "CheckpointError", "CheckpointManager",
+    "PreemptionHandler",
+    "GuardedTrainer", "RecoveryPolicy", "TrainResult", "lr_backoff",
+]

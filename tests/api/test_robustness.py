@@ -1,0 +1,529 @@
+"""Fault-tolerant training (ISSUE 4 tentpole): NaN/Inf sentinels in the
+compiled step, GuardedTrainer checkpoint rollback + bitwise replay,
+preemption drain-and-save, CheckpointManager retention/backoff, and the
+recovery hooks (LR backoff, AMP loss-scale reduction). Every fault is
+injected deterministically (robustness/chaos.py) — no sleeps, no
+timing."""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core import framework, unique_name
+from paddle_tpu.core.executor import Scope, scope_guard
+from paddle_tpu.robustness import (ChaosInjector, CheckpointError,
+                                   CheckpointManager, GuardConfig,
+                                   GuardedTrainer, NonFiniteError,
+                                   PreemptionHandler, RecoveryPolicy,
+                                   lr_backoff)
+
+pytestmark = [pytest.mark.chaos]
+
+
+def _build():
+    """Fresh, name-isolated train program (two builds of this function
+    produce IDENTICAL var names, so runs are comparable)."""
+    main, startup = framework.Program(), framework.Program()
+    with unique_name.guard(), framework.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        loss = layers.mean(layers.square_error_cost(
+            layers.fc(x, size=8), y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _feeds(n, batch=8, seed=0):
+    r = np.random.default_rng(seed)
+    return [{"x": r.standard_normal((batch, 4)).astype(np.float32),
+             "y": r.standard_normal((batch, 1)).astype(np.float32)}
+            for _ in range(n)]
+
+
+def _fresh(guard=True):
+    main, startup, loss = _build()
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace(), guard=guard)
+    with scope_guard(scope):
+        exe.run(startup)
+    return exe, main, loss, scope
+
+
+def _run_clean(feeds, guard=True):
+    exe, main, loss, scope = _fresh(guard=guard)
+    for f in feeds:
+        exe.run(main, feed=f, fetch_list=[loss], scope=scope)
+    return {n: np.asarray(scope.get(n)) for n in scope.names()}
+
+
+def _poisoned(feed):
+    bad = dict(feed)
+    x = feed["x"].copy()
+    x[0, 0] = np.nan
+    bad["x"] = x
+    return bad
+
+
+def _sticky_poison(chaos, target_feed):
+    """Make `chaos` poison every dispatch of `target_feed` (original
+    and replays) — a PERSISTENT fault, unlike poison_grad_at's
+    fire-once transient. Tracks feed identity, which the trainer's
+    replay buffer preserves."""
+    orig = chaos.on_dispatch
+
+    def sticky(step, feed):
+        if feed is target_feed:
+            chaos.poison_grad_at(step)      # arm for THIS dispatch
+        return orig(step, feed)
+    chaos.on_dispatch = sticky
+    return chaos
+
+
+# ---------------------------------------------------------------------------
+# sentinel: sync, async, structure, overhead-freedom
+# ---------------------------------------------------------------------------
+
+def test_sync_guard_raises_structured_error():
+    exe, main, loss, scope = _fresh()
+    feeds = _feeds(2)
+    exe.run(main, feed=feeds[0], fetch_list=[loss], scope=scope)
+    with pytest.raises(NonFiniteError) as ei:
+        exe.run(main, feed=_poisoned(feeds[1]), fetch_list=[loss],
+                scope=scope)
+    err = ei.value
+    # first bad var in monitor order (loss first), step identified,
+    # grads listed among the casualties
+    assert err.var == loss.name
+    assert err.step == 2                 # startup=0, clean=1, bad=2
+    assert any(b.endswith("@GRAD") for b in err.bad_vars)
+    s = exe.get_stats()["fault"]
+    assert s == {"guard_steps": 2, "nonfinite": 1, "guarded": True}
+
+
+def test_async_guard_raises_at_result_not_dispatch():
+    exe, main, loss, scope = _fresh()
+    feeds = _feeds(3)
+    hs = [exe.run_async(main, feed=f, fetch_list=[loss], scope=scope,
+                        window=4)
+          for f in (feeds[0], _poisoned(feeds[1]), feeds[2])]
+    hs[0].result()                       # clean step resolves fine
+    with pytest.raises(NonFiniteError):
+        hs[1].result()
+    with pytest.raises(NonFiniteError):
+        hs[1].wait()                     # idempotent re-raise
+    # the NaN flowed through the donated state: the NEXT step's own
+    # sentinel trips too (each handle reports its own step)
+    with pytest.raises(NonFiniteError) as ei:
+        hs[2].wait()
+    assert ei.value.step == 3    # counter: startup=0, then steps 1,2,3
+    exe.drain()
+    assert exe.get_stats()["async"]["inflight"] == 0
+
+
+def test_drain_reraises_first_guard_error():
+    exe, main, loss, scope = _fresh()
+    feeds = _feeds(2)
+    exe.run_async(main, feed=_poisoned(feeds[0]), fetch_list=[loss],
+                  scope=scope, window=4)
+    exe.run_async(main, feed=feeds[1], fetch_list=[loss], scope=scope,
+                  window=4)
+    with pytest.raises(NonFiniteError):
+        exe.drain()
+    assert exe.get_stats()["async"]["inflight"] == 0
+
+
+def test_unguarded_executor_sails_through_nan():
+    exe, main, loss, scope = _fresh(guard=False)
+    out = exe.run(main, feed=_poisoned(_feeds(1)[0]), fetch_list=[loss],
+                  scope=scope)
+    assert np.isnan(out[0]).any()
+    assert exe.get_stats()["fault"]["guarded"] is False
+
+
+def test_guard_env_var_opt_in(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_GUARD", "1")
+    exe = fluid.Executor(fluid.CPUPlace())
+    assert exe._guard is not None
+    monkeypatch.setenv("PADDLE_TPU_GUARD", "0")
+    assert fluid.Executor(fluid.CPUPlace())._guard is None
+
+
+def test_guard_checks_fetches_on_forward_only_program():
+    main, startup = framework.Program(), framework.Program()
+    with unique_name.guard(), framework.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        out = layers.log(x)              # log(-1) = nan, no optimizer
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace(), guard=True)
+    with scope_guard(scope):
+        exe.run(startup)
+    ok = exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                 fetch_list=[out], scope=scope)
+    assert np.isfinite(ok[0]).all()
+    with pytest.raises(NonFiniteError) as ei:
+        exe.run(main, feed={"x": -np.ones((2, 4), np.float32)},
+                fetch_list=[out], scope=scope)
+    assert ei.value.var == out.name
+
+
+def test_guarded_matches_unguarded_bitwise():
+    # the sentinel is a pure extra fetch: it must not change a single
+    # bit of the training arithmetic
+    feeds = _feeds(4)
+    ref = _run_clean(feeds, guard=False)
+    got = _run_clean(feeds, guard=True)
+    assert sorted(ref) == sorted(got)
+    for n in ref:
+        np.testing.assert_array_equal(ref[n], got[n], err_msg=n)
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance chaos test: poisoned grad at step k + one failed
+# checkpoint write -> exactly one rollback, bitwise-identical finish
+# ---------------------------------------------------------------------------
+
+def test_rollback_resumes_bitwise_with_failed_checkpoint_write(tmp_path):
+    n = 10
+    feeds = _feeds(n)
+    ref = _run_clean(feeds)
+
+    exe, main, loss, scope = _fresh()
+    manager = CheckpointManager(str(tmp_path / "ck"), keep=3,
+                                program=main, sleep_fn=lambda s: None)
+    # poison the grads at step 5 AND fail one physical checkpoint write
+    # (the manager's retry absorbs it); both recoveries in one run
+    chaos = ChaosInjector().poison_grad_at(5).fail_checkpoint_write(nth=3)
+    with chaos:
+        trainer = GuardedTrainer(exe, main, fetch_list=[loss],
+                                 scope=scope, manager=manager,
+                                 checkpoint_every=2, chaos=chaos,
+                                 window=2)
+        res = trainer.train(feeds)
+    assert res.steps == n
+    assert res.rollbacks == 1
+    assert len(res.faults) == 1 and res.faults[0].var == loss.name
+    assert chaos.fired["poison"] == 1
+    assert chaos.fired["write_fault"] == 1
+    # final params match the uninterrupted run BITWISE (same
+    # post-rollback feed sequence, RNG counter rewound by the manager)
+    for name, want in ref.items():
+        np.testing.assert_array_equal(
+            np.asarray(scope.get(name)), want, err_msg=name)
+
+
+def test_rollback_retries_exhaust_then_surface(tmp_path):
+    feeds = _feeds(8)
+    exe, main, loss, scope = _fresh()
+    # PERSISTENT poison: fires on the original dispatch of feeds[3] and
+    # on every replay of it (max_retries=2 -> the third fault surfaces)
+    chaos = _sticky_poison(ChaosInjector(), feeds[3])
+    trainer = GuardedTrainer(
+        exe, main, fetch_list=[loss], scope=scope,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=4,
+        chaos=chaos, window=2,
+        policy=RecoveryPolicy(max_retries=2))
+    with pytest.raises(NonFiniteError):
+        trainer.train(feeds)
+    # exactly max_retries RESTORES happened before surfacing
+    assert trainer._stats.local.get(
+        "executor.fault.rollbacks").value() == 2
+
+
+def test_skip_bad_batch_policy_drops_offender(tmp_path):
+    n = 8
+    feeds = _feeds(n)
+    # reference: the same stream with feed 3 REMOVED
+    ref = _run_clean(feeds[:3] + feeds[4:])
+
+    exe, main, loss, scope = _fresh()
+    # poison survives replay (tracks the FEED, not the step index):
+    # only the skip policy can get past it
+    chaos = _sticky_poison(ChaosInjector(), feeds[3])
+    trainer = GuardedTrainer(
+        exe, main, fetch_list=[loss], scope=scope,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=4,
+        chaos=chaos, window=2,
+        policy=RecoveryPolicy(max_retries=2, skip_bad_batch=True))
+    res = trainer.train(feeds)
+    assert res.steps == n - 1
+    assert res.skipped == [3]
+    for name, want in ref.items():
+        np.testing.assert_array_equal(
+            np.asarray(scope.get(name)), want, err_msg=name)
+
+
+def test_lr_backoff_hook_halves_lr_on_rollback(tmp_path):
+    feeds = _feeds(6)
+    exe, main, loss, scope = _fresh()
+    lr_name = [n for n in scope.names() if "learning_rate" in n][0]
+    chaos = ChaosInjector().poison_grad_at(2)
+    trainer = GuardedTrainer(
+        exe, main, fetch_list=[loss], scope=scope,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+        chaos=chaos, window=1,
+        policy=RecoveryPolicy(on_rollback=[lr_backoff(lr_name, 0.5)]))
+    res = trainer.train(feeds)
+    assert res.rollbacks == 1
+    assert np.asarray(scope.get(lr_name)) == pytest.approx(0.05)
+
+
+def test_amp_rollback_hook_reduces_loss_scaling():
+    from paddle_tpu.amp.decorator import decorate
+    main, startup = framework.Program(), framework.Program()
+    with unique_name.guard(), framework.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        loss = layers.mean(layers.square_error_cost(
+            layers.fc(x, size=8), y))
+        opt = decorate(fluid.optimizer.SGDOptimizer(learning_rate=0.1),
+                       init_loss_scaling=128.0)
+        opt.minimize(loss)
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(scope):
+        exe.run(startup)
+    hook = opt.rollback_hook()           # default: decr_ratio (0.8)
+    scale_name = opt.get_loss_scaling().name
+    before = float(np.asarray(scope.get(scale_name)))
+    hook(scope, None)
+    assert float(np.asarray(scope.get(scale_name))) \
+        == pytest.approx(before * 0.8)
+
+
+# ---------------------------------------------------------------------------
+# preemption: chaos SIGTERM mid-window + real signal; emergency save
+# ---------------------------------------------------------------------------
+
+def test_chaos_preemption_drains_and_saves_then_resumes(tmp_path):
+    n = 10
+    feeds = _feeds(n)
+    ref = _run_clean(feeds)
+
+    exe, main, loss, scope = _fresh()
+    chaos = ChaosInjector().sigterm_at(6)
+    trainer = GuardedTrainer(exe, main, fetch_list=[loss], scope=scope,
+                             checkpoint_dir=str(tmp_path / "ck"),
+                             checkpoint_every=4, chaos=chaos, window=2)
+    res = trainer.train(feeds)
+    assert res.preempted
+    assert res.emergency_dir is not None
+    assert res.steps == 6                # in-flight steps drained, not lost
+    # the emergency checkpoint is complete and valid
+    from paddle_tpu.io.checkpoint import load_checkpoint
+    s2 = Scope()
+    meta = load_checkpoint(exe, res.emergency_dir, main_program=main,
+                           scope=s2)
+    assert meta["extra"]["emergency"] is True and meta["step"] == 6
+    # resume from it and finish: bitwise-identical to uninterrupted
+    trainer2 = GuardedTrainer(exe, main, fetch_list=[loss], scope=scope,
+                              checkpoint_dir=str(tmp_path / "ck"),
+                              checkpoint_every=4, window=2)
+    meta2 = trainer2.resume()
+    assert meta2["step"] == 6
+    res2 = trainer2.train(iter(feeds[6:]))
+    assert res2.steps == n
+    for name, want in ref.items():
+        np.testing.assert_array_equal(
+            np.asarray(scope.get(name)), want, err_msg=name)
+
+
+def test_real_sigterm_honored_between_steps(tmp_path):
+    feeds = _feeds(10)
+    exe, main, loss, scope = _fresh()
+    handler = PreemptionHandler(signals=(signal.SIGTERM,)).install()
+    try:
+        fired = []
+
+        def cb(idx, out):
+            if idx == 3 and not fired:
+                fired.append(1)
+                os.kill(os.getpid(), signal.SIGTERM)
+        trainer = GuardedTrainer(exe, main, fetch_list=[loss],
+                                 scope=scope,
+                                 checkpoint_dir=str(tmp_path / "ck"),
+                                 checkpoint_every=4, window=2,
+                                 preemption=handler,
+                                 result_callback=cb)
+        res = trainer.train(feeds)
+    finally:
+        handler.uninstall()
+    assert res.preempted and res.emergency_dir is not None
+    assert 4 <= res.steps < 10
+    assert not handler.requested()       # trainer cleared for resume
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: backoff, retention, fallback restore
+# ---------------------------------------------------------------------------
+
+def test_manager_write_retries_backoff_then_succeed(tmp_path):
+    exe, main, loss, scope = _fresh(guard=False)
+    delays = []
+    m = CheckpointManager(str(tmp_path / "ck"), program=main, retries=3,
+                          backoff_s=0.1, backoff_factor=2.0,
+                          sleep_fn=delays.append)
+    with ChaosInjector().fail_checkpoint_write(nth=1, times=2):
+        d = m.save(exe, 1, scope=scope)
+    assert os.path.exists(os.path.join(d, "meta.json"))
+    assert delays == [0.1, 0.2]          # deterministic exponential
+
+
+def test_manager_write_retries_exhaust_then_surface(tmp_path):
+    exe, main, loss, scope = _fresh(guard=False)
+    delays = []
+    m = CheckpointManager(str(tmp_path / "ck"), program=main, retries=2,
+                          sleep_fn=delays.append)
+    with ChaosInjector().fail_checkpoint_write(nth=1, times=99):
+        with pytest.raises(CheckpointError):
+            m.save(exe, 1, scope=scope)
+    assert len(delays) == 2              # bounded: retries, then surface
+
+
+def test_manager_retention_keeps_last_k(tmp_path):
+    exe, main, loss, scope = _fresh(guard=False)
+    m = CheckpointManager(str(tmp_path / "ck"), keep=2, program=main)
+    for step in (1, 2, 3, 4):
+        m.save(exe, step, scope=scope)
+    kept = [os.path.basename(d) for d in m.checkpoints()]
+    assert kept == ["ckpt-00000003", "ckpt-00000004"]
+
+
+def test_manager_restore_falls_back_past_corrupt(tmp_path):
+    exe, main, loss, scope = _fresh(guard=False)
+    m = CheckpointManager(str(tmp_path / "ck"), keep=3, program=main)
+    m.save(exe, 1, scope=scope)
+    w1 = np.asarray(scope.get("fc_0.w_0"))
+    exe.run(main, feed=_feeds(1, seed=7)[0], fetch_list=[loss],
+            scope=scope)
+    d2 = m.save(exe, 2, scope=scope)
+    with open(os.path.join(d2, "state.npz"), "r+b") as f:
+        f.seek(100)
+        f.write(b"\x00" * 8)
+    s2 = Scope()
+    meta = m.restore(exe, scope=s2)
+    assert meta["step"] == 1
+    np.testing.assert_array_equal(np.asarray(s2.get("fc_0.w_0")), w1)
+
+
+def test_reused_checkpoint_root_without_resume_refused(tmp_path):
+    feeds = _feeds(4)
+    exe, main, loss, scope = _fresh()
+    t1 = GuardedTrainer(exe, main, fetch_list=[loss], scope=scope,
+                        checkpoint_dir=str(tmp_path / "ck"),
+                        checkpoint_every=2, window=1)
+    t1.train(feeds)
+    # a FRESH trainer (step 0) over the same root: rolling back would
+    # restore the OLD run's weights — refuse instead of training into it
+    exe2, _, loss2, scope2 = _fresh()
+    t2 = GuardedTrainer(exe2, main, fetch_list=[loss], scope=scope2,
+                        checkpoint_dir=str(tmp_path / "ck"),
+                        checkpoint_every=2, window=1)
+    with pytest.raises(RuntimeError, match="resume"):
+        t2.train(feeds)
+    # resume() makes the same construction legal
+    t2.resume()
+    assert t2.step == 4
+
+
+def test_fallback_past_segment_base_warns_and_continues(tmp_path):
+    n = 4
+    feeds = _feeds(n)
+    exe, main, loss, scope = _fresh()
+    root = tmp_path / "ck"
+    chaos = ChaosInjector().poison_grad_at(3)
+
+    def corrupt_latest(idx, out):
+        if idx == 2:     # ckpt-00000002 committed before this resolved
+            p = root / "ckpt-00000002" / "state.npz"
+            with open(p, "r+b") as f:
+                f.seek(100)
+                f.write(b"\x00" * 8)
+    trainer = GuardedTrainer(exe, main, fetch_list=[loss], scope=scope,
+                             checkpoint_dir=str(root),
+                             checkpoint_every=2, chaos=chaos, window=1,
+                             result_callback=corrupt_latest)
+    # restore falls back past the corrupt segment base to ckpt-0: the
+    # run must SAY the pruned feeds are unreplayable, then finish
+    with pytest.warns(UserWarning, match="LOST"):
+        res = trainer.train(feeds)
+    assert res.rollbacks == 1
+    assert res.steps == n
+
+
+def test_equal_step_foreign_baseline_is_overwritten(tmp_path):
+    import jax.numpy as jnp
+    exe1, main1, loss1, scope1 = _fresh()
+    t1 = GuardedTrainer(exe1, main1, fetch_list=[loss1], scope=scope1,
+                        checkpoint_dir=str(tmp_path / "ck"),
+                        checkpoint_every=2, window=1)
+    t1.train([])                 # dead run A: baseline ckpt-00000000
+    # fresh run B at the same step 0 over the same root, with
+    # DISTINGUISHABLE weights
+    exe2, main2, loss2, scope2 = _fresh()
+    w_name = [n for n in scope2.names() if n.endswith("w_0")][0]
+    scope2.set(w_name, jnp.zeros_like(scope2.get(w_name)))
+    seen = []
+    chaos = ChaosInjector().poison_grad_at(0)
+    t2 = GuardedTrainer(
+        exe2, main2, fetch_list=[loss2], scope=scope2,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+        chaos=chaos, window=1,
+        policy=RecoveryPolicy(on_rollback=[
+            lambda s, f: seen.append(np.asarray(s.get(w_name)))]))
+    res = t2.train(_feeds(2))
+    assert res.rollbacks == 1 and res.steps == 2
+    # the rollback restored run B's zeros, not run A's random init:
+    # the baseline save overwrote the foreign equal-step checkpoint
+    assert seen and not seen[0].any()
+
+
+def test_rollback_hooks_compound_across_retries(tmp_path):
+    feeds = _feeds(8)
+    exe, main, loss, scope = _fresh()
+    lr_name = [n for n in scope.names() if "learning_rate" in n][0]
+    chaos = _sticky_poison(ChaosInjector(), feeds[2])
+    trainer = GuardedTrainer(
+        exe, main, fetch_list=[loss], scope=scope,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=4,
+        chaos=chaos, window=1,
+        policy=RecoveryPolicy(max_retries=2, skip_bad_batch=False,
+                              on_rollback=[lr_backoff(lr_name, 0.5)]))
+    with pytest.raises(NonFiniteError):
+        trainer.train(feeds)        # persistent poison: retries exhaust
+    # restore undoes the previous retry's decay, so retry n re-applies
+    # the hook n times: after the 2nd (last) rollback LR = 0.1 * 0.5^2
+    assert np.asarray(scope.get(lr_name)) == pytest.approx(0.025)
+
+
+def test_manager_restores_executor_step_counter(tmp_path):
+    exe, main, loss, scope = _fresh(guard=False)
+    for f in _feeds(3):
+        exe.run(main, feed=f, fetch_list=[loss], scope=scope)
+    m = CheckpointManager(str(tmp_path / "ck"), program=main)
+    m.save(exe, 3, scope=scope)
+    counter = exe._step_counter
+    exe.run(main, feed=_feeds(1, seed=9)[0], fetch_list=[loss],
+            scope=scope)
+    assert exe._step_counter == counter + 1
+    m.restore(exe, scope=scope)
+    assert exe._step_counter == counter  # RNG folds replay identically
+
+
+# ---------------------------------------------------------------------------
+# GuardConfig surface
+# ---------------------------------------------------------------------------
+
+def test_guard_config_resolution():
+    assert GuardConfig.resolve(None) is None
+    assert GuardConfig.resolve(False) is None
+    assert GuardConfig.resolve("0") is None
+    assert GuardConfig.resolve("") is None
+    assert isinstance(GuardConfig.resolve(True), GuardConfig)
+    assert isinstance(GuardConfig.resolve("1"), GuardConfig)
+    cfg = GuardConfig(check_fetches=False, extra_vars=("v",))
+    assert GuardConfig.resolve(cfg) is cfg
+    assert cfg.candidates("l", ["g1"], ["f1"]) == ["l", "g1", "v"]

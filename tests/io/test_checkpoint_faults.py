@@ -1,0 +1,259 @@
+"""Checkpoint durability under injected faults (ISSUE 4 satellites):
+atomic single-file and sharded saves (a crash never leaves a
+loadable-looking torn checkpoint), CRC-validated loads with fallback,
+and async-save error propagation through a joinable non-daemon writer.
+
+Everything here is deterministic (chaos marker): faults fire on exact
+write ordinals via io.checkpoint's write-fault hook, never on timing.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core import framework
+from paddle_tpu.core.executor import Scope, scope_guard
+from paddle_tpu.io import checkpoint as ckpt
+from paddle_tpu.robustness import ChaosInjector, CheckpointWriteFault
+
+pytestmark = [pytest.mark.chaos]
+
+
+def _build_train():
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    loss = layers.mean(layers.square_error_cost(layers.fc(x, size=8), y))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def _feed(seed=0):
+    r = np.random.default_rng(seed)
+    return {"x": r.standard_normal((8, 4)).astype(np.float32),
+            "y": r.standard_normal((8, 1)).astype(np.float32)}
+
+
+def _trained_exe(steps=2):
+    loss = _build_train()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    for i in range(steps):
+        exe.run(feed=_feed(i), fetch_list=[loss])
+    return exe, loss
+
+
+# ---------------------------------------------------------------------------
+# atomic single-file layout
+# ---------------------------------------------------------------------------
+
+def test_save_checkpoint_round_trip_with_manifest(tmp_path):
+    exe, _ = _trained_exe()
+    d = str(tmp_path / "ck")
+    ckpt.save_checkpoint(exe, d, step=2, extra={"tag": "t"})
+    with open(os.path.join(d, "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["format"] == ckpt.CHECKPOINT_FORMAT
+    assert meta["manifest"]          # per-array CRC32 recorded
+    for entry in meta["manifest"].values():
+        assert set(entry) == {"crc32", "shape", "dtype"}
+    w_before = np.asarray(fluid.global_scope().get("fc_0.w_0"))
+    exe.run(feed=_feed(9), fetch_list=[])        # mutate state
+    meta2 = ckpt.load_checkpoint(exe, d)
+    assert meta2["step"] == 2 and meta2["extra"]["tag"] == "t"
+    np.testing.assert_array_equal(
+        np.asarray(fluid.global_scope().get("fc_0.w_0")), w_before)
+
+
+def test_torn_state_write_leaves_previous_checkpoint_intact(tmp_path):
+    exe, _ = _trained_exe()
+    d = str(tmp_path / "ck")
+    ckpt.save_checkpoint(exe, d, step=1)
+    with open(os.path.join(d, "state.npz"), "rb") as f:
+        good_bytes = f.read()
+    # crash on the NEXT state.npz write: the old file must survive
+    # untouched (temp + os.replace, no in-place truncation)
+    with ChaosInjector().fail_checkpoint_write(nth=1):
+        with pytest.raises(CheckpointWriteFault):
+            ckpt.save_checkpoint(exe, d, step=2)
+    with open(os.path.join(d, "state.npz"), "rb") as f:
+        assert f.read() == good_bytes
+    assert ckpt.load_checkpoint(exe, d)["step"] == 1
+    assert not [p for p in os.listdir(d) if ".tmp." in p]
+
+
+def test_torn_meta_write_keeps_old_commit(tmp_path):
+    exe, _ = _trained_exe()
+    d = str(tmp_path / "ck")
+    ckpt.save_checkpoint(exe, d, step=1)
+    # fail write #2 of the next save = meta.json: the new state.npz
+    # landed but the checkpoint still reads as the OLD committed step
+    # (meta.json is the commit marker) ... and its manifest then catches
+    # the state/meta mismatch via CRC
+    with ChaosInjector().fail_checkpoint_write(nth=2):
+        with pytest.raises(CheckpointWriteFault):
+            ckpt.save_checkpoint(exe, d, step=2)
+    with open(os.path.join(d, "meta.json")) as f:
+        assert json.load(f)["step"] == 1
+
+
+def test_crc_mismatch_raises_corrupt_error(tmp_path):
+    exe, _ = _trained_exe()
+    d = str(tmp_path / "ck")
+    ckpt.save_checkpoint(exe, d, step=1)
+    with open(os.path.join(d, "meta.json")) as f:
+        meta = json.load(f)
+    first = sorted(meta["manifest"])[0]
+    meta["manifest"][first]["crc32"] ^= 0xDEADBEEF
+    with open(os.path.join(d, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(ckpt.CheckpointCorruptError):
+        ckpt.load_checkpoint(exe, d)
+    # validate=False restores anyway (explicit escape hatch)
+    assert ckpt.load_checkpoint(exe, d, validate=False)["step"] == 1
+
+
+def test_load_from_retention_root_falls_back_past_corrupt(tmp_path):
+    exe, _ = _trained_exe()
+    root = tmp_path / "root"
+    ckpt.save_checkpoint(exe, str(root / "ckpt-00000001"), step=1)
+    w1 = np.asarray(fluid.global_scope().get("fc_0.w_0"))
+    exe.run(feed=_feed(5), fetch_list=[])
+    ckpt.save_checkpoint(exe, str(root / "ckpt-00000002"), step=2)
+    # corrupt the NEWEST checkpoint's payload
+    p = root / "ckpt-00000002" / "state.npz"
+    with open(p, "r+b") as f:
+        f.seek(128)
+        f.write(b"\xff\xff\xff\xff")
+    with pytest.warns(UserWarning, match="falling back"):
+        meta = ckpt.load_checkpoint(exe, str(root))
+    assert meta["step"] == 1
+    np.testing.assert_array_equal(
+        np.asarray(fluid.global_scope().get("fc_0.w_0")), w1)
+
+
+def test_load_from_retention_root_skips_uncommitted_dir(tmp_path):
+    exe, _ = _trained_exe()
+    root = tmp_path / "root"
+    ckpt.save_checkpoint(exe, str(root / "ckpt-00000001"), step=1)
+    # an aborted save: state.npz landed, the commit marker never did —
+    # it must NOT load as a fake committed step-0 checkpoint
+    ckpt.save_checkpoint(exe, str(root / "ckpt-00000002"), step=2)
+    os.unlink(root / "ckpt-00000002" / "meta.json")
+    with pytest.warns(UserWarning, match="no commit marker"):
+        meta = ckpt.load_checkpoint(exe, str(root))
+    assert meta["step"] == 1
+
+
+# ---------------------------------------------------------------------------
+# async writer: non-daemon, error box, join-at-exit registry
+# ---------------------------------------------------------------------------
+
+def test_async_save_round_trip_and_thread_discipline(tmp_path):
+    exe, _ = _trained_exe()
+    d = str(tmp_path / "ck")
+    h = ckpt.save_checkpoint_async(exe, d, step=3)
+    assert isinstance(h, ckpt.CheckpointHandle)
+    assert h._thread.daemon is False     # must survive interpreter exit
+    assert h.wait() is True
+    assert h not in ckpt._LIVE_WRITERS   # wait() untracks
+    assert ckpt.load_checkpoint(exe, d)["step"] == 3
+
+
+def test_async_save_error_reraises_at_wait(tmp_path):
+    exe, _ = _trained_exe()
+    d = str(tmp_path / "ck")
+    with ChaosInjector().fail_checkpoint_write(nth=1):
+        h = ckpt.save_checkpoint_async(exe, d, step=1)
+        with pytest.raises(CheckpointWriteFault):
+            h.wait()
+    # idempotent: the error stays in the handle
+    with pytest.raises(CheckpointWriteFault):
+        h.wait()
+    assert not os.path.exists(os.path.join(d, "meta.json"))
+
+
+def test_async_writers_tracked_for_atexit_join(tmp_path):
+    exe, _ = _trained_exe()
+    gate = threading.Event()
+    ckpt.set_write_fault_hook(lambda kind, path: gate.wait(5))
+    try:
+        h = ckpt.save_checkpoint_async(exe, str(tmp_path / "ck"), step=1)
+        assert h in ckpt._LIVE_WRITERS   # would be joined at exit
+        gate.set()
+        assert h.wait() is True
+    finally:
+        ckpt.set_write_fault_hook(None)
+        gate.set()
+    assert h not in ckpt._LIVE_WRITERS
+
+
+# ---------------------------------------------------------------------------
+# sharded layout: crash between shard files, CRC, commit marker
+# ---------------------------------------------------------------------------
+
+def _sharded_setup(tmp_path, steps=2):
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        loss = _build_train()
+    scope = Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(scope):
+        exe.run(startup)
+        for i in range(steps):
+            exe.run(main, feed=_feed(i), fetch_list=[loss])
+    return exe, main, scope
+
+
+def test_sharded_crash_between_shards_is_not_loadable(tmp_path):
+    exe, main, scope = _sharded_setup(tmp_path)
+    d1 = str(tmp_path / "good")
+    ckpt.save_checkpoint_sharded(exe, d1, main_program=main, step=1,
+                                 scope=scope).wait()
+    w_good = np.asarray(scope.get("fc_0.w_0"))
+    with scope_guard(scope):
+        exe.run(main, feed=_feed(7), fetch_list=[])
+    d2 = str(tmp_path / "torn")
+    # kill the writer between shard files: some .npy land, index.json
+    # (the commit marker) never does
+    with ChaosInjector().fail_checkpoint_write(nth=3):
+        h = ckpt.save_checkpoint_sharded(exe, d2, main_program=main,
+                                         step=2, async_save=True,
+                                         scope=scope)
+        with pytest.raises(CheckpointWriteFault):
+            h.wait()
+    assert not os.path.exists(os.path.join(d2, "index.json"))
+    with pytest.raises(FileNotFoundError):
+        ckpt.load_checkpoint_sharded(exe, d2, main_program=main,
+                                     scope=scope)
+    # recovery: the previous good checkpoint restores bitwise
+    meta = ckpt.load_checkpoint_sharded(exe, d1, main_program=main,
+                                        scope=scope)
+    assert meta["step"] == 1
+    np.testing.assert_array_equal(np.asarray(scope.get("fc_0.w_0")),
+                                  w_good)
+
+
+def test_sharded_crc_validation(tmp_path):
+    exe, main, scope = _sharded_setup(tmp_path)
+    d = str(tmp_path / "ck")
+    ckpt.save_checkpoint_sharded(exe, d, main_program=main, step=1,
+                                 scope=scope).wait()
+    shard = sorted(os.listdir(os.path.join(d, "shards")))[0]
+    with open(os.path.join(d, "shards", shard), "r+b") as f:
+        f.seek(-4, os.SEEK_END)
+        f.write(b"\x13\x37\x13\x37")
+    before = np.asarray(scope.get("fc_0.w_0"))
+    with pytest.raises(ckpt.CheckpointCorruptError):
+        ckpt.load_checkpoint_sharded(exe, d, main_program=main,
+                                     scope=scope)
+    # validation failed BEFORE any scope mutation
+    np.testing.assert_array_equal(np.asarray(scope.get("fc_0.w_0")),
+                                  before)
+    assert ckpt.load_checkpoint_sharded(
+        exe, d, main_program=main, scope=scope,
+        validate=False)["step"] == 1

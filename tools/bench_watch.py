@@ -188,6 +188,15 @@ def run_suite():
         run_step("async_compare", [py, bench],
                  env={"JAX_PLATFORMS": "cpu", "BENCH_ASYNC_COMPARE": "1"},
                  timeout_s=900, stdout_path="bench_async.json")
+    # 1d. guard-overhead comparison (ISSUE 4): NaN/Inf-sentinel steady-
+    #     state overhead, guarded vs unguarded, on the CPU backend
+    #     (deterministic; acceptance bar: overhead < 5%)
+    if _artifact_ok("bench_guard.json"):
+        log("step guard_compare: already landed in a prior cycle — skipping")
+    else:
+        run_step("guard_compare", [py, bench],
+                 env={"JAX_PLATFORMS": "cpu", "BENCH_GUARD_COMPARE": "1"},
+                 timeout_s=900, stdout_path="bench_guard.json")
     # 2. headline: ERNIE-base, full sweep, HLO of the best batch archived
     if _artifact_ok("bench_ernie.json"):
         log("step ernie: already landed in a prior cycle — skipping")

@@ -146,6 +146,30 @@ def print_cache_summary(metrics, file=None):
         print(f"bucketing: batches={bb} pad_waste_elems={waste}", file=file)
 
 
+def print_fault_summary(metrics, file=None):
+    """Fault/recovery summary (robustness layer): printed only when a
+    guarded executor / CheckpointManager left metrics behind."""
+    file = file if file is not None else sys.stdout
+    guard_steps = _counter_total(metrics, "executor.fault.guard_steps")
+    saves = _counter_total(metrics, "checkpoint.saves")
+    if not guard_steps and not saves:
+        return
+    nonfinite = _counter_total(metrics, "executor.fault.nonfinite")
+    rollbacks = _counter_total(metrics, "executor.fault.rollbacks")
+    preempt = _counter_total(metrics, "executor.fault.preemptions")
+    print(f"faults: guard_steps={guard_steps} nonfinite={nonfinite} "
+          f"rollbacks={rollbacks} preemptions={preempt}", file=file)
+    scount, stotal = _hist_totals(metrics, "checkpoint.save_ms")
+    rcount, rtotal = _hist_totals(metrics, "checkpoint.restore_ms")
+    wfail = _counter_total(metrics, "checkpoint.write_failures")
+    crc = _counter_total(metrics, "checkpoint.crc_failures")
+    fb = _counter_total(metrics, "checkpoint.fallbacks")
+    print(f"checkpoints: saves={saves} "
+          f"(avg {stotal / max(scount, 1):.2f}ms) restores={rcount} "
+          f"(avg {rtotal / max(rcount, 1):.2f}ms) write_failures={wfail} "
+          f"crc_failures={crc} fallbacks={fb}", file=file)
+
+
 # ---------------------------------------------------------------------------
 # --demo: generate a sample trace + metrics dump from a tiny cached loop
 # ---------------------------------------------------------------------------
@@ -202,11 +226,44 @@ def run_demo(out_dir):
                                     bucketer=bucketer, window=2):
             pass
 
+    # guarded-recovery demo loop: chaos poisons one grad, the sentinel
+    # trips, GuardedTrainer rolls back to its checkpoint and replays —
+    # so executor.fault.* / checkpoint.* series land in the committed
+    # sample dump (and the fault summary line below has data)
+    import tempfile
+    from paddle_tpu.robustness import ChaosInjector, GuardedTrainer
+    gmain, gstart = framework.Program(), framework.Program()
+    with framework.program_guard(gmain, gstart):
+        gx = layers.data("x", shape=[4], dtype="float32")
+        gy = layers.data("y", shape=[1], dtype="float32")
+        gloss = layers.mean(layers.square_error_cost(
+            layers.fc(gx, size=8), gy))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(gloss)
+    gscope = fluid.Scope()
+    exe3 = fluid.Executor(fluid.CPUPlace(), guard=True)
+    with fluid.scope_guard(gscope):
+        exe3.run(gstart)
+    gfeeds = [{"x": rng.randn(8, 4).astype(np.float32),
+               "y": rng.randn(8, 1).astype(np.float32)} for _ in range(6)]
+    with tempfile.TemporaryDirectory() as ckdir:
+        # fixed-name subdir: the CheckpointManager gauge label is
+        # basename(root), and a random tempdir name would commit a
+        # different label into perf/metrics_sample.json on every run
+        trainer = GuardedTrainer(
+            exe3, gmain, fetch_list=[gloss], scope=gscope,
+            checkpoint_dir=os.path.join(ckdir, "demo_ckpts"),
+            checkpoint_every=2,
+            chaos=ChaosInjector().poison_grad_at(3), window=2)
+        guard_result = trainer.train(gfeeds)
+
     metrics_path = os.path.join(out_dir, "metrics_sample.json")
     dump = global_registry().to_dict()
     dump["executor_stats"] = exe.get_stats()
     dump["async_stats"] = exe2.get_stats()["async"]
     dump["bucket_stats"] = bucketer.get_stats()
+    dump["fault_stats"] = dict(exe3.get_stats()["fault"],
+                               rollbacks=guard_result.rollbacks,
+                               steps=guard_result.steps)
     with open(metrics_path, "w") as f:
         # single line: perf/ artifacts are parsed line-wise by
         # tools/bench_watch.py's _artifact_ok
@@ -246,7 +303,9 @@ def main(argv=None):
         print_event_table(events, sorted_key=args.sorted_key,
                           limit=args.limit)
     if metrics_path:
-        print_cache_summary(load_metrics(metrics_path))
+        metrics = load_metrics(metrics_path)
+        print_cache_summary(metrics)
+        print_fault_summary(metrics)
     return 0
 
 
