@@ -28,7 +28,13 @@ BENCH_RECOMPUTE (remat policy: dots|nothing|offload),
 BENCH_TINY=1 (bert_tiny config for off-TPU smoke tests), BENCH_PEAK_TFLOPS
 (override the per-chip peak), BENCH_DEVICE_TIMEOUT, BENCH_INIT_RETRIES,
 BENCH_DUMP_HLO=<path> (archive the best batch's optimized HLO),
-BENCH_HBM_FRACTION (pre-flight prune threshold, default 0.92).
+BENCH_HBM_FRACTION (pre-flight prune threshold, default 0.92),
+BENCH_CPU_FALLBACK (default 1: a wedged/failed TPU init re-execs on
+the CPU backend and marks every JSON line "degraded": true instead of
+dying numberless; 0 restores rc=2), BENCH_DEVICE_TIMEOUT (init
+watchdog, default 300s), BENCH_SERVING_COMPARE=1 (continuous vs static
+batching on a mixed-length generation stream; knobs
+BENCH_SERVING_{REQUESTS,SLOTS,CHUNK,BLOCK,ROUNDS}).
 """
 
 import json
@@ -52,7 +58,39 @@ PEAK_TFLOPS = [
     ("v6e", 918.0),
 ]
 
-DEVICE_INIT_TIMEOUT_S = int(os.environ.get("BENCH_DEVICE_TIMEOUT", 600))
+DEVICE_INIT_TIMEOUT_S = int(os.environ.get("BENCH_DEVICE_TIMEOUT", 300))
+
+
+def _degraded():
+    """True when this process fell back to the CPU backend after a
+    wedged/failed TPU init (see _fallback_to_cpu) — every emitted JSON
+    line then carries "degraded": true so a reader never mistakes a
+    CPU fallback number for a hardware number."""
+    return os.environ.get("BENCH_DEGRADED") == "1"
+
+
+def _mark_degraded(result):
+    if _degraded():
+        result["degraded"] = True
+    return result
+
+
+def _fallback_to_cpu(reason):
+    """Re-exec this bench pinned to the CPU backend instead of dying
+    numberless (BENCH_r05: rc=2, parsed=null after a 600s TPU-tunnel
+    wedge). A hung C init call cannot be recovered in-process, so the
+    fallback is a fresh interpreter with JAX_PLATFORMS=cpu; the child
+    marks every emitted line "degraded": true. BENCH_CPU_FALLBACK=0
+    restores the old die-with-rc-2 behavior."""
+    if os.environ.get("BENCH_CPU_FALLBACK", "1") == "0":
+        return False
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return False                # already on cpu: a real failure
+    print(f"bench: {reason} — falling back to JAX_PLATFORMS=cpu "
+          f"(degraded run)", file=sys.stderr, flush=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_DEGRADED="1")
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+    return True                     # not reached
 
 
 def _peak_flops(device_kind):
@@ -103,6 +141,8 @@ def _device_watchdog():
         print("bench: jax device init exceeded "
               f"{DEVICE_INIT_TIMEOUT_S}s (TPU tunnel wedged?)",
               file=sys.stderr)
+        # exec replaces the whole process, hung init thread included
+        _fallback_to_cpu(f"device init hung > {DEVICE_INIT_TIMEOUT_S}s")
         os._exit(2)
 
     timer = threading.Timer(DEVICE_INIT_TIMEOUT_S, _abort)
@@ -132,6 +172,7 @@ def _device_watchdog():
     timer.cancel()
     print(f"bench: device init failed after {attempts} attempts: {last_err}",
           file=sys.stderr)
+    _fallback_to_cpu(f"device init failed {attempts}x ({last_err})")
     os._exit(2)
 
 
@@ -683,7 +724,7 @@ def run_async_compare(kind):
         "steady_async_metrics": exe_b.get_stats()["async"],
         "device_kind": kind,
     }
-    print(json.dumps(result), flush=True)
+    print(json.dumps(_mark_degraded(result)), flush=True)
     return 0
 
 
@@ -763,7 +804,138 @@ def run_guard_compare(kind):
         "steps": steps,
         "device_kind": kind,
     }
-    print(json.dumps(result), flush=True)
+    print(json.dumps(_mark_degraded(result)), flush=True)
+    return 0
+
+
+def run_serving_compare(kind):
+    """BENCH_SERVING_COMPARE=1: continuous batching (GenerationServer,
+    paged KV cache) vs static batching (fixed groups over the dense
+    cache) on a MIXED-LENGTH generation stream — tiny GPT on the CPU
+    backend, same params, same requests, greedy both sides.
+
+    The static baseline groups requests `slots` at a time and steps the
+    whole group until its LAST lane finishes: short requests idle
+    behind long ones (the tail waste continuous batching exists to
+    remove), and prompts teacher-force one token per step. The
+    continuous engine retires lanes the moment they finish and admits
+    the next request into the freed slot. Both modes pay one host
+    round-trip per step, so the comparison isolates scheduling.
+
+    BENCH_SERVING_CHUNK defaults to 1: on the compute-bound CPU backend
+    every chunk column costs real FLOPs, so a wider chunk taxes decode
+    iterations; on TPU, where decode is bandwidth-bound, wider chunks
+    accelerate prefill mostly for free (docs/serving.md). Honest
+    reporting: tokens/sec for BOTH modes plus the iteration counts the
+    speedup comes from."""
+    import numpy as np
+    import paddle_tpu as fluid
+    import jax.numpy as jnp
+    from paddle_tpu.core import framework
+    from paddle_tpu.core.executor import Scope, scope_guard
+    from paddle_tpu.inference import decoding as dec
+    from paddle_tpu.models import gpt
+    from paddle_tpu.serving import GenerationServer, GPTServingModel
+
+    n_req = int(os.environ.get("BENCH_SERVING_REQUESTS", 24))
+    slots = int(os.environ.get("BENCH_SERVING_SLOTS", 4))
+    chunk = int(os.environ.get("BENCH_SERVING_CHUNK", 1))
+    block_size = int(os.environ.get("BENCH_SERVING_BLOCK", 8))
+    rounds = int(os.environ.get("BENCH_SERVING_ROUNDS", 2))
+    max_context = 96
+
+    cfg = gpt.gpt_tiny()
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 7
+    with framework.program_guard(main, startup):
+        gpt.build_lm_net(cfg, seq_len=8)
+    scope = Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    with scope_guard(scope):
+        exe.run(startup)
+        params = gpt.load_params(scope, cfg)
+
+    # mixed-length stream: prompts 4..28, outputs 4..44 (seeded)
+    rng = np.random.default_rng(0)
+    reqs = [(rng.integers(3, cfg.vocab_size,
+                          rng.integers(4, 29)).astype(np.int32),
+             int(rng.integers(4, 45))) for _ in range(n_req)]
+    total_gen = sum(g for _p, g in reqs)
+
+    # -- static baseline: groups of `slots` over the dense cache -------
+    import jax
+    d = cfg.hidden_size // cfg.num_heads
+    raw_step = gpt.build_kv_step(params, cfg, max_context)
+    step = jax.jit(lambda ids, cache, t: raw_step(ids, cache, t))
+
+    def run_static():
+        iters = 0
+        for g in range(0, len(reqs), slots):
+            group = reqs[g:g + slots]
+            lanes = len(group)
+            cache = dec.init_kv_cache(lanes, cfg.num_layers,
+                                      cfg.num_heads, max_context, d)
+            tok = np.array([p[0] for p, _g in group], np.int32)
+            # every lane steps until the group's LAST lane finishes
+            horizon = max(len(p) + gen - 1 for p, gen in group)
+            for t in range(horizon):
+                logits, cache = step(jnp.asarray(tok), cache,
+                                     jnp.asarray(t, jnp.int32))
+                nxt = np.asarray(jnp.argmax(logits, -1), np.int32)
+                iters += 1
+                for i, (p, _gen) in enumerate(group):
+                    tok[i] = p[t + 1] if t + 1 < len(p) else nxt[i]
+        return iters
+
+    # -- continuous engine (one server reused across rounds: the fused
+    #    step stays compiled, like a long-lived production server) -----
+    server = GenerationServer(GPTServingModel(params, cfg),
+                              num_slots=slots, block_size=block_size,
+                              max_context=max_context, chunk=chunk,
+                              start=False)
+
+    def run_continuous():
+        it0 = server.get_stats()["iteration"]
+        futs = [server.submit(p, max_new_tokens=g) for p, g in reqs]
+        server.run_until_idle()
+        for f in futs:
+            assert len(f.result(timeout=5).token_ids) > 0
+        return server.get_stats()["iteration"] - it0
+
+    run_static()                    # warm both compiles before timing
+    run_continuous()
+    static_s = cont_s = float("inf")
+    static_iters = cont_iters = 0
+    for _ in range(rounds):         # interleaved best-of rounds
+        t0 = time.perf_counter()
+        static_iters = run_static()
+        static_s = min(static_s, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        cont_iters = run_continuous()
+        cont_s = min(cont_s, time.perf_counter() - t0)
+
+    st = server.get_stats()
+    result = {
+        "metric": "serving_continuous_vs_static_batching_speedup",
+        "value": round(static_s / cont_s, 3),
+        "unit": "x (generated tokens/sec, continuous over static, "
+                "mixed-length greedy stream)",
+        "continuous_tokens_per_sec": round(total_gen / cont_s, 2),
+        "static_tokens_per_sec": round(total_gen / static_s, 2),
+        "continuous_iterations": cont_iters,
+        "static_iterations": static_iters,
+        "requests": n_req,
+        "generated_tokens": total_gen,
+        "prompt_len_range": [min(len(p) for p, _ in reqs),
+                             max(len(p) for p, _ in reqs)],
+        "output_len_range": [min(g for _, g in reqs),
+                             max(g for _, g in reqs)],
+        "slots": slots, "chunk": chunk, "block_size": block_size,
+        "fused_step_signatures": st["fused_step_signatures"],
+        "block_utilization_final": st["block_utilization"],
+        "device_kind": kind,
+    }
+    print(json.dumps(_mark_degraded(result)), flush=True)
     return 0
 
 
@@ -1014,6 +1186,7 @@ def _emit(sweep, seq_len, kind, peak):
             result["hlo_path"] = hlo_path
         except OSError as e:
             print(f"bench: HLO dump write failed: {e}", file=sys.stderr)
+    _mark_degraded(result)
     if tiny:
         result["tiny"] = True
     if model == "resnet":
@@ -1048,6 +1221,11 @@ def main():
     if os.environ.get("BENCH_GUARD_COMPARE") == "1":
         # NaN/Inf-sentinel overhead micro-comparison (robustness layer)
         return run_guard_compare(kind)
+
+    if os.environ.get("BENCH_SERVING_COMPARE") == "1":
+        # continuous-batching vs static-batching on a mixed-length
+        # generation stream (serving layer)
+        return run_serving_compare(kind)
 
     seq_len = int(os.environ.get("BENCH_SEQ_LEN", 512))
     # defaults favor landing A number inside a fragile tunnel window:

@@ -5,7 +5,9 @@ The reference's engine loads a ProgramDesc, runs IR passes, and executes
 op-by-op on a stream; TPU-native the 'engine' is: load program+params ->
 trace once -> one AOT-compiled XLA executable per input signature, with
 donated buffers and optional bf16. KV-cache autoregressive decoding lives in
-decoding.py.
+decoding.py; ragged generation traffic (continuous batching over a paged
+KV cache) lives in paddle_tpu.serving, reachable from here via
+AnalysisConfig.enable_generation + Predictor.generation_server.
 """
 
 from .predictor import Predictor, create_predictor, AnalysisConfig

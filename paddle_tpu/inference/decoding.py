@@ -37,7 +37,15 @@ def update_kv_cache(layer_cache, k_t, v_t, t):
     """Write this step's K/V (B, H, 1, D) at time t. Returns new cache +
     full (B, H, T_max, D) views for attention (mask out > t). The cache
     dtype wins: a bf16 serving cache accepts K/V computed through f32
-    residual paths without the caller micro-managing casts."""
+    residual paths without the caller micro-managing casts.
+
+    Layer caches exposing `paged_update` (serving.kv_cache's
+    PagedDecodeLayer) route through it instead: the same step_fn then
+    decodes against a block-pooled paged cache unchanged — the adapter
+    presents the gathered dense view via the same {'k','v'} mapping
+    interface."""
+    if hasattr(layer_cache, "paged_update"):
+        return layer_cache.paged_update(k_t, v_t, t)
     k = jax.lax.dynamic_update_slice(
         layer_cache["k"], k_t.astype(layer_cache["k"].dtype), (0, 0, t, 0))
     v = jax.lax.dynamic_update_slice(

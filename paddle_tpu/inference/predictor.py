@@ -28,9 +28,22 @@ class AnalysisConfig:
         self.donate_inputs = False
         self.mesh = None              # tensor-parallel serving mesh
         self.shard_rules = None
+        self.generation = None        # enable_generation() options
 
     def enable_bf16(self):
         self.use_bf16 = True
+        return self
+
+    def enable_generation(self, gpt_config, **server_opts):
+        """Serve this model as a continuous-batching generation engine
+        (paddle_tpu.serving.GenerationServer over a paged KV cache).
+        `gpt_config` names the decoder architecture the exported
+        gpt_* parameters follow (models/gpt.GPTConfig); `server_opts`
+        pass through to GenerationServer (num_slots, block_size,
+        max_context, chunk, watermark_blocks, ...). The server itself
+        is built lazily by `Predictor.generation_server()`."""
+        self.generation = dict(server_opts)
+        self.generation["gpt_config"] = gpt_config
         return self
 
     def set_batch_buckets(self, sizes):
@@ -152,6 +165,27 @@ class Predictor:
         for feeds in example_feeds_list:
             self.run(feeds)
         return self
+
+    def generation_server(self, **overrides):
+        """Build a paddle_tpu.serving.GenerationServer over this
+        predictor's loaded parameters (requires
+        AnalysisConfig.enable_generation). The loaded scope must hold
+        models/gpt.py's gpt_* parameter names — i.e. the export came
+        from a GPT-family program. bf16 serving (enable_bf16) casts
+        the KV pool and activations to bf16."""
+        if self.config.generation is None:
+            raise RuntimeError(
+                "generation not enabled: call "
+                "AnalysisConfig.enable_generation(gpt_config, ...) "
+                "before create_predictor")
+        from ..serving import GenerationServer, GPTServingModel
+        opts = dict(self.config.generation)
+        opts.update(overrides)
+        gpt_cfg = opts.pop("gpt_config")
+        dtype = jnp.bfloat16 if self.config.use_bf16 else None
+        model = GPTServingModel.from_scope(self.scope, gpt_cfg,
+                                           dtype=dtype)
+        return GenerationServer(model, **opts)
 
     def get_input_names(self):
         return list(self.feed_names)

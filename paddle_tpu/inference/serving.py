@@ -17,10 +17,18 @@ payloads stay in Python (the queue moves int64 ticket ids only).
     fut = server.submit({"x": np.array([[...]])})   # any thread
     out = fut.result()                              # this request's rows
     server.close()
+
+Containers without a C++ toolchain (`available() == False`) fall back
+to a pure-Python queue with the same batch/deadline contract, so the
+serving stack runs (and its tests run) everywhere. Generation
+workloads with ragged lengths belong to `paddle_tpu.serving`'s
+continuous-batching GenerationServer instead; this loop batches
+fixed-shape one-shot predicts.
 """
 
 import ctypes
 import threading
+import time
 from concurrent.futures import Future
 
 import numpy as np
@@ -61,16 +69,127 @@ def available():
         return False
 
 
+class _PyQueue:
+    """Pure-Python fallback for csrc/serve_queue.cc — same contract
+    (batch launches at max_batch OR when the oldest request has waited
+    max_delay), built on a Condition instead of the off-GIL C++ wait.
+    Used when the container has no compiler (`available() == False`),
+    so the serving stack and its tests run everywhere; the native queue
+    stays the default where it builds."""
+
+    def __init__(self, max_batch, max_delay_us):
+        self._max_batch = int(max_batch)
+        self._max_delay_s = max_delay_us / 1e6
+        self._cv = threading.Condition()
+        self._items = []                    # (rid, enqueue_time)
+        self._closed = False
+
+    def submit(self, rid):
+        with self._cv:
+            if self._closed:
+                return -1
+            self._items.append((rid, time.monotonic()))
+            self._cv.notify_all()
+            return 0
+
+    def next_batch(self, max_n, poll_timeout_us):
+        """-> list of rids ([] on poll timeout), or None once closed
+        AND drained — mirrors sq_next_batch's n / 0 / -1."""
+        poll_deadline = time.monotonic() + poll_timeout_us / 1e6
+        with self._cv:
+            while True:
+                now = time.monotonic()
+                if self._items:
+                    oldest = self._items[0][1]
+                    if (len(self._items) >= self._max_batch
+                            or now - oldest >= self._max_delay_s
+                            or self._closed):
+                        n = min(len(self._items), max_n,
+                                self._max_batch)
+                        out = [rid for rid, _ in self._items[:n]]
+                        del self._items[:n]
+                        return out
+                    wait = min(oldest + self._max_delay_s,
+                               poll_deadline) - now
+                elif self._closed:
+                    return None             # closed and drained
+                else:
+                    wait = poll_deadline - now
+                if now >= poll_deadline:
+                    return []               # poll timeout — caller loops
+                self._cv.wait(timeout=max(wait, 0.0))
+
+    def pending(self):
+        with self._cv:
+            return len(self._items)
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def destroy(self):
+        pass
+
+
+class _NativeQueue:
+    """ctypes adapter giving csrc/serve_queue.cc the same Python-level
+    interface as _PyQueue (all waiting stays in C++ off the GIL)."""
+
+    def __init__(self, max_batch, max_delay_us):
+        self._lib = load_library()
+        self._q = self._lib.sq_create(int(max_batch), int(max_delay_us))
+        self._max_batch = int(max_batch)
+        self._ids = (ctypes.c_int64 * self._max_batch)()
+
+    def submit(self, rid):
+        return self._lib.sq_submit(self._q, rid)
+
+    def next_batch(self, max_n, poll_timeout_us):
+        n = self._lib.sq_next_batch(self._q, self._ids,
+                                    min(max_n, self._max_batch),
+                                    poll_timeout_us)
+        if n < 0:
+            return None
+        return [self._ids[i] for i in range(n)]
+
+    def pending(self):
+        return int(self._lib.sq_pending(self._q))
+
+    def close(self):
+        self._lib.sq_close(self._q)
+
+    def destroy(self):
+        self._lib.sq_destroy(self._q)
+
+
+def _feed_sig(feeds):
+    """(keys, per-key trailing dims + dtype) — everything a batch
+    np.concatenate over axis 0 requires to agree across requests."""
+    return tuple(sorted(
+        (k, tuple(v.shape[1:]), v.dtype.str) for k, v in feeds.items()))
+
+
 class BatchingServer:
     """Group concurrent single-request predicts into bucket-sized
     batches. One worker thread owns the predictor (XLA dispatch is not
-    re-entrant-friendly anyway); any number of client threads submit."""
+    re-entrant-friendly anyway); any number of client threads submit.
 
-    def __init__(self, predictor, max_batch=8, max_delay_ms=2.0):
-        self._lib = load_library()
+    backend: "native" (csrc serve_queue), "python" (fallback), or
+    "auto" — native when the toolchain can build it, python otherwise."""
+
+    def __init__(self, predictor, max_batch=8, max_delay_ms=2.0,
+                 backend="auto"):
+        if backend == "auto":
+            backend = "native" if available() else "python"
+        if backend == "native":
+            self._q = _NativeQueue(max_batch, int(max_delay_ms * 1000))
+        elif backend == "python":
+            self._q = _PyQueue(max_batch, int(max_delay_ms * 1000))
+        else:
+            raise ValueError(f"unknown backend {backend!r}")
+        self.backend = backend
         self._pred = predictor
-        self._q = self._lib.sq_create(int(max_batch),
-                                      int(max_delay_ms * 1000))
         self._reqs = {}
         self._reqs_lock = threading.Lock()
         self._next_id = 0
@@ -82,37 +201,49 @@ class BatchingServer:
     def submit(self, feeds):
         """feeds: dict name -> (1, ...) or (k, ...) array. Returns a
         Future resolving to this request's output rows (list, one array
-        per model output)."""
+        per model output).
+
+        The feed signature (key set + trailing dims + dtype) must match
+        every request currently queued: co-batched feeds concatenate on
+        axis 0, so a mismatch is THIS caller's error and raises here,
+        instead of poisoning the whole batch and fanning one confusing
+        concatenate exception to every co-batched future."""
         feeds = {k: np.asarray(v) for k, v in feeds.items()}
+        sig = _feed_sig(feeds)
         fut = Future()
-        # sq_submit runs INSIDE the lock (it never blocks) so close()
+        # queue submit runs INSIDE the lock (it never blocks) so close()
         # cannot destroy the native handle between our closed-check and
         # the call
         with self._reqs_lock:
             if self._closed or self._q is None:
                 raise RuntimeError("BatchingServer is closed")
+            if self._reqs:
+                first_sig = next(iter(self._reqs.values()))[2]
+                if sig != first_sig:
+                    raise ValueError(
+                        "feed signature mismatch with the queued batch: "
+                        f"queued {first_sig} vs submitted {sig} — keys, "
+                        "trailing dims and dtypes must agree for "
+                        "requests to co-batch")
             rid = self._next_id
             self._next_id += 1
-            self._reqs[rid] = (feeds, fut)
-            if self._lib.sq_submit(self._q, rid) != 0:
+            self._reqs[rid] = (feeds, fut, sig)
+            if self._q.submit(rid) != 0:
                 self._reqs.pop(rid, None)
                 raise RuntimeError("BatchingServer is closed")
         return fut
 
     def _serve(self):
-        ids = (ctypes.c_int64 * self._max_batch)()
         while True:
-            n = self._lib.sq_next_batch(self._q, ids, self._max_batch,
-                                        200_000)
-            if n < 0:
+            rids = self._q.next_batch(self._max_batch, 200_000)
+            if rids is None:
                 return                      # closed and drained
-            if n == 0:
+            if not rids:
                 continue                    # poll timeout — loop
             batch = []
             with self._reqs_lock:
-                for i in range(n):
-                    rid = ids[i]
-                    feeds_i, fut = self._reqs.pop(rid)
+                for rid in rids:
+                    feeds_i, fut, _sig = self._reqs.pop(rid)
                     # a client may have cancelled while queued; claiming
                     # the future here also makes a later set_result safe
                     if fut.set_running_or_notify_cancel():
@@ -140,7 +271,7 @@ class BatchingServer:
         with self._reqs_lock:
             if self._q is None:
                 return 0
-            return int(self._lib.sq_pending(self._q))
+            return self._q.pending()
 
     def close(self, join_timeout=30):
         """Drain and stop. The native queue is freed ONLY once the
@@ -151,7 +282,7 @@ class BatchingServer:
             if self._closed:
                 return
             self._closed = True
-        self._lib.sq_close(self._q)
+        self._q.close()
         self._worker.join(timeout=join_timeout)
         if self._worker.is_alive():
             import warnings
@@ -160,5 +291,5 @@ class BatchingServer:
                           "handle rather than freeing it mid-use")
             return
         with self._reqs_lock:
-            self._lib.sq_destroy(self._q)
+            self._q.destroy()
             self._q = None

@@ -121,6 +121,34 @@ METRIC_SPECS = [
      "checkpoints written by the preemption drain path"),
     ("checkpoint.retained", "gauge",
      "checkpoints currently retained by a CheckpointManager"),
+    ("serving.requests", "counter",
+     "generation requests submitted to a GenerationServer"),
+    ("serving.admitted", "counter",
+     "requests admitted from the queue into a decode slot"),
+    ("serving.retired", "counter",
+     "requests finished and resolved (eos or length)"),
+    ("serving.cancelled", "counter",
+     "requests cancelled by the client (queued or mid-stream)"),
+    ("serving.deadline_cancels", "counter",
+     "requests cancelled because their deadline passed"),
+    ("serving.iterations", "counter",
+     "scheduler iterations (one fused prefill/decode step each)"),
+    ("serving.step_ms", "histogram",
+     "wall ms of one serving iteration (plan + fused step + commit)"),
+    ("serving.generated_tokens", "counter",
+     "tokens emitted across all requests (tokens/s numerator)"),
+    ("serving.prefill_tokens", "counter",
+     "prompt tokens chunk-prefilled into the paged cache"),
+    ("serving.queue_depth", "gauge",
+     "requests waiting for a decode slot"),
+    ("serving.active_slots", "gauge",
+     "decode slots currently owned by a request"),
+    ("serving.blocks_in_use", "gauge",
+     "KV pool blocks currently allocated (pool utilization numerator)"),
+    ("serving.ttft_ms", "histogram",
+     "time to first token: submit -> first generated token"),
+    ("serving.itl_ms", "histogram",
+     "inter-token latency between consecutive generated tokens"),
     ("executor.dp.runs", "counter", "data-parallel (mesh) run() calls"),
     ("executor.dp.shard_state_ms", "histogram",
      "feed/state device placement on the data-parallel path"),

@@ -33,8 +33,14 @@ class ChaosInjector:
         self._sigterm_steps = set()
         self._fail_writes = set()    # 1-based physical-write ordinals
         self._write_count = 0
-        self.fired = {"poison": 0, "sigterm": 0, "write_fault": 0}
+        self.fired = {"poison": 0, "sigterm": 0, "write_fault": 0,
+                      "cancel": 0, "clock_advance": 0}
         self._installed = False
+        # serving-engine plan: iteration -> actions (scheduler hooks)
+        self._serving_cancels = {}   # iteration -> [active-request index]
+        self._clock_advances = {}    # iteration -> seconds to advance
+        self._fake_now_s = 0.0
+        self._drives_clock = False
 
     # -- plan ----------------------------------------------------------
     def poison_grad_at(self, step, var=None):
@@ -90,6 +96,44 @@ class ChaosInjector:
     @property
     def write_count(self):
         return self._write_count
+
+    # -- serving hooks (serving/scheduler.py) --------------------------
+    def cancel_request_at(self, iteration, index=0):
+        """Cancel the index-th OLDEST active request at the start of
+        scheduler iteration `iteration` (1-based, like the scheduler's
+        own counter) — the deterministic mid-stream-cancel path for the
+        continuous-batching engine."""
+        self._serving_cancels.setdefault(int(iteration), []).append(
+            int(index))
+        return self
+
+    def advance_clock_at(self, iteration, ms):
+        """Advance the injected serving clock by `ms` at the start of
+        iteration `iteration`. Pair with `serving_clock` as the
+        scheduler's clock so deadline expiry is an exact iteration
+        count, never a sleep."""
+        self._clock_advances[int(iteration)] = \
+            self._clock_advances.get(int(iteration), 0.0) + ms / 1e3
+        self._drives_clock = True
+        return self
+
+    def serving_clock(self):
+        """Deterministic clock (seconds) driven by advance_clock_at."""
+        return self._fake_now_s
+
+    def drives_clock(self):
+        return self._drives_clock
+
+    def on_serving_iteration(self, iteration):
+        adv = self._clock_advances.pop(int(iteration), None)
+        if adv is not None:
+            self._fake_now_s += adv
+            self.fired["clock_advance"] += 1
+
+    def serving_cancels_at(self, iteration):
+        idxs = self._serving_cancels.pop(int(iteration), [])
+        self.fired["cancel"] += len(idxs)
+        return idxs
 
     # -- trainer hooks -------------------------------------------------
     def should_preempt(self, step):

@@ -1,0 +1,40 @@
+"""Continuous-batching serving engine with a paged KV cache.
+
+The ROADMAP north star serves heavy traffic from millions of users; the
+static path (inference/serving.BatchingServer over Predictor buckets)
+groups one-shot predicts, but generation workloads are RAGGED — every
+request has its own prompt length, output length, arrival time, and
+deadline. This package is the TPU-native answer:
+
+- kv_cache.py   — PagedKVCache block pool + block tables +
+                  paged_attention (pure-JAX reference, Pallas-ready
+                  signature) + dense-interface adapters for
+                  inference/decoding.py step_fns;
+- scheduler.py  — iteration-level continuous batching: fixed decode
+                  slots, chunked prefill admission, EOS/length
+                  retirement, watermark backpressure, priorities,
+                  deadlines (injectable clock);
+- engine.py     — GenerationServer: one jitted fused prefill/decode
+                  step for the server lifetime, submit/Future surface,
+                  streaming token callbacks, graceful drain.
+
+Entry points: `GenerationServer(GPTServingModel.from_scope(scope, cfg))`
+directly, or `AnalysisConfig.enable_generation(...)` +
+`Predictor.generation_server()` from a saved model dir. docs/serving.md
+has the block-table layout and tuning guide.
+"""
+
+from .kv_cache import (NULL_BLOCK, PagedDecodeLayer, PagedKVCache,
+                       build_paged_decode_cache, gather_block_kv,
+                       paged_attention)
+from .scheduler import (ContinuousBatchingScheduler, DeadlineExceeded,
+                        GenerationResult, RequestCancelled)
+from .engine import GenerationFuture, GenerationServer, GPTServingModel
+
+__all__ = [
+    "PagedKVCache", "PagedDecodeLayer", "paged_attention",
+    "gather_block_kv", "build_paged_decode_cache", "NULL_BLOCK",
+    "ContinuousBatchingScheduler", "GenerationResult",
+    "DeadlineExceeded", "RequestCancelled",
+    "GenerationServer", "GenerationFuture", "GPTServingModel",
+]

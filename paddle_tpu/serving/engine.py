@@ -1,0 +1,342 @@
+"""GenerationServer: continuous-batching generation behind the
+BatchingServer submit/Future surface.
+
+The whole serve loop is ONE jitted fused prefill/decode step:
+
+    fused(pools, tokens (S, C), positions (S, C), valid (S, C),
+          tables (S, M)) -> (pools, next_ids (S,), next_logps (S,))
+
+S decode slots x C chunk columns, shapes fixed for the server lifetime
+— a prefilling lane feeds up to C prompt tokens per iteration, a
+decoding lane feeds its one in-flight token, an idle lane is masked.
+Requests of any length mix freely in one executable; after warmup the
+jit cache holds exactly one signature (asserted via get_stats()).
+
+The model side is pluggable; GPTServingModel adapts models/gpt.py
+params (same math as gpt.build_kv_step, vectorized over the chunk
+axis, KV routed through serving.kv_cache.paged_attention/write).
+"""
+
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..models.gpt import _cast_params, _ln, load_params
+from ..observability import _help
+from ..observability.metrics import global_registry
+from ..observability.tracing import get_recorder
+from .kv_cache import (NULL_BLOCK, PagedKVCache, paged_attention,
+                       write_block_kv)
+from .scheduler import ContinuousBatchingScheduler, RequestCancelled, _Request
+
+__all__ = ["GenerationServer", "GenerationFuture", "GPTServingModel"]
+
+
+class GPTServingModel:
+    """models/gpt.py parameters behind the engine's model interface:
+    config facts + `build_fused_step(block_size)`. The step math is
+    build_kv_step's, re-expressed over (S, C) ragged lanes with paged
+    KV — tests pin the two token-for-token."""
+
+    def __init__(self, params, cfg, dtype=None):
+        self.params = _cast_params(params, dtype)
+        self.cfg = cfg
+        self.num_layers = cfg.num_layers
+        self.num_heads = cfg.num_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        self.max_position = cfg.max_position
+        self.kv_dtype = dtype or jnp.float32
+
+    @classmethod
+    def from_scope(cls, scope, cfg, dtype=None):
+        return cls(load_params(scope, cfg), cfg, dtype=dtype)
+
+    def build_fused_step(self, block_size):
+        params, cfg = self.params, self.cfg
+        h_, d = self.num_heads, self.head_dim
+
+        def fused(pools, tokens, positions, valid, tables):
+            s, c = tokens.shape
+            pos = jnp.where(valid, positions, 0)
+            x = params["word_emb"][tokens] + params["pos_emb"][pos]
+            # write targets: masked lanes route to the NULL block
+            bidx = jnp.take_along_axis(tables, pos // block_size, axis=1)
+            bidx = jnp.where(valid, bidx, NULL_BLOCK)
+            off = jnp.where(valid, pos % block_size, 0)
+            new_pools = []
+            for i in range(cfg.num_layers):
+                lp = params[f"l{i}"]
+                kp, vp = pools[i]["k"], pools[i]["v"]
+                hn = _ln(x, lp["ln1_s"], lp["ln1_b"])
+                q = (hn @ lp["wq"] + lp["bq"]).reshape(s, c, h_, d)
+                k = (hn @ lp["wk"] + lp["bk"]).reshape(s, c, h_, d)
+                v = (hn @ lp["wv"] + lp["bv"]).reshape(s, c, h_, d)
+                kp = write_block_kv(kp, k, bidx, off)
+                vp = write_block_kv(vp, v, bidx, off)
+                o = paged_attention(q.transpose(0, 2, 1, 3), kp, vp,
+                                    tables, pos)
+                o = o.transpose(0, 2, 1, 3).reshape(s, c, cfg.hidden_size)
+                x = x + (o @ lp["wo"] + lp["bo"]).astype(x.dtype)
+                hn = _ln(x, lp["ln2_s"], lp["ln2_b"])
+                f = jax.nn.gelu(hn @ lp["f0w"] + lp["f0b"],
+                                approximate=False)
+                x = x + (f @ lp["f1w"] + lp["f1b"])
+                new_pools.append({"k": kp, "v": vp})
+            x = _ln(x, params["lnf_s"], params["lnf_b"])
+            # next token comes from each lane's LAST valid column only
+            last = jnp.clip(valid.sum(1) - 1, 0, c - 1)
+            xl = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+            logits = xl @ params["word_emb"].T
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            nxt = jnp.argmax(logp, axis=-1)
+            chosen = jnp.take_along_axis(logp, nxt[:, None], -1)[:, 0]
+            return new_pools, nxt.astype(jnp.int32), chosen
+
+        return fused
+
+
+class GenerationFuture(Future):
+    """A Future whose cancel() also tells the scheduler to reclaim the
+    request's slot and blocks (a plain Future can only cancel while
+    queued; generation requests are cancellable mid-stream)."""
+
+    def __init__(self, server, request_id):
+        super().__init__()
+        self._server = server
+        self.request_id = request_id
+
+    def cancel(self):
+        if self.done():
+            return False
+        self._server._request_cancel(self.request_id)
+        # the request may retire between the done() check and here; the
+        # scheduler clears the stale cancel flag as a no-op next plan()
+        if not super().cancel():
+            return False
+        self.set_running_or_notify_cancel()     # notify waiters now
+        return True
+
+
+class GenerationServer:
+    """Continuous-batching generation engine: submit() from any thread,
+    a single worker pumps scheduler iterations, results arrive as
+    GenerationResult futures, tokens stream via per-request callbacks.
+
+        server = GenerationServer(GPTServingModel.from_scope(scope, cfg))
+        fut = server.submit(prompt_ids, max_new_tokens=32, eos_id=2,
+                            stream=lambda rid, tok: print(tok))
+        out = fut.result()          # GenerationResult
+        server.close()              # graceful drain
+
+    `start=False` skips the worker thread; tests then pump `step()`
+    manually under an injected clock (no sleeps in the serving tier)."""
+
+    def __init__(self, model, *, num_slots=4, block_size=16,
+                 num_blocks=None, max_context=None, chunk=4, clock=None,
+                 watermark_blocks=0, chaos=None, start=True):
+        self.model = model
+        self.block_size = int(block_size)
+        max_context = int(max_context or model.max_position)
+        if max_context > model.max_position:
+            raise ValueError(
+                f"max_context {max_context} exceeds the model's "
+                f"max_position {model.max_position}")
+        blocks_per_seq = -(-max_context // self.block_size)
+        if num_blocks is None:
+            num_blocks = num_slots * blocks_per_seq + 1   # +1: NULL block
+        self.cache = PagedKVCache(model.num_layers, model.num_heads,
+                                  model.head_dim, num_blocks,
+                                  block_size=self.block_size,
+                                  dtype=model.kv_dtype)
+        if chaos is not None and clock is None and \
+                getattr(chaos, "drives_clock", lambda: False)():
+            clock = chaos.serving_clock
+        self._sched = ContinuousBatchingScheduler(
+            self.cache, num_slots=num_slots, chunk=chunk,
+            max_context=max_context, clock=clock,
+            watermark_blocks=watermark_blocks, chaos=chaos)
+        self.max_context = max_context
+        self._fused = jax.jit(model.build_fused_step(self.block_size))
+        self._signatures = set()
+        self._next_rid = 0
+        self._rid_lock = threading.Lock()
+        self._closed = False
+        self._step_lock = threading.Lock()
+        self._cv = threading.Condition()
+        reg = global_registry()
+        self._m = {
+            "requests": reg.counter("serving.requests",
+                                    _help("serving.requests")),
+            "iterations": reg.counter("serving.iterations",
+                                      _help("serving.iterations")),
+            "step_ms": reg.histogram("serving.step_ms",
+                                     _help("serving.step_ms")),
+            "queue_depth": reg.gauge("serving.queue_depth",
+                                     _help("serving.queue_depth")),
+            "active_slots": reg.gauge("serving.active_slots",
+                                      _help("serving.active_slots")),
+            "blocks_in_use": reg.gauge("serving.blocks_in_use",
+                                       _help("serving.blocks_in_use")),
+        }
+        self._worker = None
+        if start:
+            self._worker = threading.Thread(target=self._serve,
+                                            daemon=True)
+            self._worker.start()
+
+    # -- client surface ----------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens=32, eos_id=None,
+               priority=0, deadline_ms=None, stream=None):
+        """prompt_ids: 1-D int token ids. Returns a GenerationFuture
+        resolving to a GenerationResult (or raising DeadlineExceeded /
+        RequestCancelled). `stream(request_id, token)` fires on the
+        serve thread for every generated token. Lower `priority` values
+        run first (FIFO within a priority)."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = int(prompt.size) + int(max_new_tokens)
+        if total > self.max_context:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) = {total} exceeds max_context "
+                f"{self.max_context}")
+        need = self.cache.blocks_for_tokens(total)
+        if need > self.cache.usable_blocks:
+            raise ValueError(
+                f"request needs {need} blocks but the pool only has "
+                f"{self.cache.usable_blocks}")
+        with self._rid_lock:
+            if self._closed:
+                raise RuntimeError("GenerationServer is closed")
+            rid = self._next_rid
+            self._next_rid += 1
+        fut = GenerationFuture(self, rid)
+        deadline = None
+        if deadline_ms is not None:
+            deadline = self._sched.now() + deadline_ms / 1e3
+        req = _Request(rid, prompt, int(max_new_tokens), eos_id,
+                       priority, deadline, stream, fut,
+                       self._sched.now())
+        self._sched.enqueue(req)
+        self._m["requests"].inc()
+        with self._cv:
+            self._cv.notify()
+        return fut
+
+    def _request_cancel(self, rid):
+        self._sched.request_cancel(rid)
+        with self._cv:
+            self._cv.notify()
+
+    def pending(self):
+        return self._sched.queue_depth + self._sched.active_count
+
+    # -- serve loop --------------------------------------------------------
+    def step(self):
+        """Run one scheduler iteration + fused device step. Returns
+        True if any lane did work. Public so tests (and the bench) can
+        pump the engine deterministically without the worker thread."""
+        with self._step_lock:
+            plan = self._sched.plan()
+            self._publish_gauges()
+            if plan is None:
+                return False
+            rec = get_recorder()
+            t0 = time.perf_counter()
+            with rec.span("serving.iteration", cat="serving",
+                          args={"iteration": self._sched.iteration,
+                                "lanes": len(plan.slot_ids),
+                                "prefill_tokens": plan.prefill_tokens}):
+                args = (jnp.asarray(plan.tokens),
+                        jnp.asarray(plan.positions),
+                        jnp.asarray(plan.valid),
+                        jnp.asarray(plan.tables))
+                self._signatures.add(
+                    tuple((a.shape, str(a.dtype)) for a in args))
+                # the cache object always holds the LIVE device pools:
+                # the functional update replaces them in place of the
+                # consumed ones (keeping both would pin 2x the KV HBM)
+                pools, nxt, logps = self._fused(self.cache.pools, *args)
+                self.cache.pools = pools
+                nxt, logps = np.asarray(nxt), np.asarray(logps)
+            self._sched.commit(plan, nxt, logps)
+            self._m["iterations"].inc()
+            self._m["step_ms"].observe((time.perf_counter() - t0) * 1e3)
+            self._publish_gauges()
+            return True
+
+    def run_until_idle(self, max_iterations=100000):
+        """Pump step() until no lane has work (manual-drive mode)."""
+        n = 0
+        while self.step():
+            n += 1
+            if n >= max_iterations:
+                raise RuntimeError(
+                    f"serving loop did not drain in {max_iterations} "
+                    f"iterations")
+        return n
+
+    def _publish_gauges(self):
+        st = self._sched
+        self._m["queue_depth"].set(st.queue_depth)
+        self._m["active_slots"].set(st.active_count)
+        self._m["blocks_in_use"].set(self.cache.num_used)
+
+    def _serve(self):
+        while True:
+            did = self.step()
+            if did:
+                continue
+            with self._cv:
+                if self._closed:
+                    return
+                if not self._sched.has_work():
+                    # short timeout: queued-request deadlines under a
+                    # REAL clock must still fire while the pool idles
+                    self._cv.wait(timeout=0.05)
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self, drain=True, timeout=60):
+        """Stop accepting submits; by default finish every in-flight
+        and queued request first (graceful drain), then stop the
+        worker. drain=False fails outstanding requests instead."""
+        with self._rid_lock:
+            if self._closed:
+                return
+            if not drain:
+                self._sched.cancel_all(RequestCancelled(
+                    "GenerationServer closed without drain"))
+            self._closed = True
+        if self._worker is not None:
+            deadline = time.monotonic() + timeout
+            while drain and self._sched.has_work() and \
+                    time.monotonic() < deadline:
+                with self._cv:
+                    self._cv.notify()
+                time.sleep(0.01)
+            with self._cv:
+                self._cv.notify()
+            self._worker.join(timeout=max(0.0,
+                                          deadline - time.monotonic()))
+        elif drain:
+            self.run_until_idle()
+        self._publish_gauges()
+
+    def get_stats(self):
+        """Scheduler + engine stats; `fused_step_signatures` is the jit
+        signature count — the shape-static design's acceptance gauge
+        (exactly 1 after warmup, whatever the request mix)."""
+        st = self._sched.stats()
+        st["fused_step_signatures"] = len(self._signatures)
+        st["chunk"] = self._sched.chunk
+        st["block_size"] = self.block_size
+        st["max_context"] = self.max_context
+        return st

@@ -1,0 +1,235 @@
+"""Paged KV cache: a block-pooled KV store with per-request block tables.
+
+The dense serving cache (`inference/decoding.init_kv_cache`) reserves
+(B, H, T_max, D) per lane — every request pays for the longest request's
+worst case, and a new batch shape means a new executable. The paged
+layout (PAPERS.md "Ragged Paged Attention") pools KV in fixed-size
+blocks instead:
+
+    per layer:  k_pool, v_pool : (num_blocks, H, block_size, D)
+    per request: block_table   : (max_blocks,) int32 — logical position
+                 p lives in pool block table[p // block_size] at row
+                 p % block_size.
+
+Requests of wildly different lengths then share ONE pool (and one
+compiled step): length is data (positions + tables), never shape. Block
+0 is the reserved NULL block — table padding and masked-token writes
+land there, and the attention mask guarantees it is never read.
+
+`paged_attention` is the pure-JAX reference implementation of the op
+(gather blocks by table -> masked attention). Its signature — query,
+pools, tables, positions — is the contract a Pallas kernel drops into
+later; everything above it (scheduler, engine) is kernel-agnostic.
+
+`PagedDecodeLayer` adapts a layer's pool slice to the dense mapping
+interface `decoding.py` step_fns consume (`cache[i]["k"]`,
+`update_kv_cache`), so an existing step_fn decodes against either cache
+unchanged (beam search still needs the dense cache: `_gather_beams`
+reorders lanes by leading dim, which a shared pool does not have).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["PagedKVCache", "PagedDecodeLayer", "paged_attention",
+           "gather_block_kv", "build_paged_decode_cache", "NULL_BLOCK"]
+
+NULL_BLOCK = 0          # reserved: never allocated, never attended
+NEG_INF = -1e9
+
+
+# ---------------------------------------------------------------------------
+# functional ops (jit-traceable; Pallas-ready signatures)
+# ---------------------------------------------------------------------------
+
+def gather_block_kv(pool, block_table):
+    """pool (N, H, bs, D) gathered by table (B, M) -> dense
+    (B, H, M*bs, D) view in logical-position order."""
+    b, m = block_table.shape
+    n, h, bs, d = pool.shape
+    g = pool[block_table]                       # (B, M, H, bs, D)
+    return jnp.moveaxis(g, 2, 1).reshape(b, h, m * bs, d)
+
+
+def paged_attention(q, k_pool, v_pool, block_table, q_positions):
+    """Reference paged attention: gather blocks by table, mask keys
+    beyond each query's position, softmax in f32, weighted sum.
+
+    q:           (B, H, C, D) — C query tokens per request lane
+    k/v_pool:    (N, H, bs, D)
+    block_table: (B, M) int32
+    q_positions: (B, C) int32 — logical position of each query token
+    returns      (B, H, C, D) in v_pool's dtype
+
+    The numerics deliberately mirror the dense cache path in
+    models/gpt.build_kv_step: scores and softmax in f32, probabilities
+    cast back to the value dtype before the PV contraction — so a paged
+    decode is bitwise-comparable to the dense one. This pure-JAX body is
+    the semantic spec for a future Pallas kernel with the same
+    signature (the kernel would walk the table instead of gathering)."""
+    d = q.shape[-1]
+    gk = gather_block_kv(k_pool, block_table)           # (B, H, T, D)
+    gv = gather_block_kv(v_pool, block_table)
+    s = jnp.einsum("bhcd,bhtd->bhct", q, gk) / np.sqrt(d)
+    t = gk.shape[2]
+    key_pos = jnp.arange(t)
+    mask = key_pos[None, None, None, :] <= q_positions[:, None, :, None]
+    s = jnp.where(mask, s.astype(jnp.float32), NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(gv.dtype)
+    return jnp.einsum("bhct,bhtd->bhcd", p, gv)
+
+
+def write_block_kv(pool, vals, block_idx, offset):
+    """Scatter vals (B, C, H, D) into pool (N, H, bs, D) at
+    (block_idx (B, C), :, offset (B, C), :). Masked tokens should be
+    routed to (NULL_BLOCK, 0) by the caller. The pool dtype wins (same
+    contract as decoding.update_kv_cache)."""
+    return pool.at[block_idx, :, offset, :].set(vals.astype(pool.dtype))
+
+
+# ---------------------------------------------------------------------------
+# pool manager (host side)
+# ---------------------------------------------------------------------------
+
+class PagedKVCache:
+    """Device block pools (one k/v pair per layer) + a host free list.
+
+    Allocation is host-side bookkeeping only (ints in a list); the
+    device arrays are fixed-shape for the process lifetime, so every
+    scheduler iteration hits the same compiled step regardless of which
+    requests hold which blocks."""
+
+    def __init__(self, num_layers, num_heads, head_dim, num_blocks,
+                 block_size=16, dtype=jnp.float32):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is reserved NULL)")
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.dtype = dtype
+        shape = (self.num_blocks, self.num_heads, self.block_size,
+                 self.head_dim)
+        self.pools = [{"k": jnp.zeros(shape, dtype),
+                       "v": jnp.zeros(shape, dtype)}
+                      for _ in range(self.num_layers)]
+        # LIFO free list; block 0 (NULL) is never handed out
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+
+    # -- allocation --------------------------------------------------------
+    @property
+    def usable_blocks(self):
+        return self.num_blocks - 1
+
+    @property
+    def num_free(self):
+        return len(self._free)
+
+    @property
+    def num_used(self):
+        return self.usable_blocks - len(self._free)
+
+    def utilization(self):
+        return self.num_used / self.usable_blocks
+
+    def blocks_for_tokens(self, n_tokens):
+        return -(-int(n_tokens) // self.block_size)
+
+    def allocate(self, n):
+        """n blocks or None (caller backs off; nothing partial)."""
+        if n > len(self._free):
+            return None
+        taken = [self._free.pop() for _ in range(n)]
+        return taken
+
+    def free(self, blocks):
+        for b in blocks:
+            if b == NULL_BLOCK:
+                raise ValueError("freeing the reserved NULL block")
+            self._free.append(b)
+
+    # -- layout helpers ----------------------------------------------------
+    def make_table(self, blocks, max_blocks):
+        """Host block list -> fixed-width int32 row, NULL-padded."""
+        t = np.full((max_blocks,), NULL_BLOCK, np.int32)
+        t[:len(blocks)] = blocks
+        return t
+
+
+# ---------------------------------------------------------------------------
+# dense-interface adapter for decoding.py step_fns
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_pytree_node_class
+class PagedDecodeLayer:
+    """One layer's paged cache behind the dense {'k','v'} mapping
+    interface: `layer["k"]` gathers the table's blocks into a dense
+    (B, H, M*bs, D) view (positions past t are NULL-block rows, masked
+    by the step_fn's own cache_attention_bias), and
+    `decoding.update_kv_cache` routes to `paged_update`, which writes
+    this step's K/V into the right (block, offset) slot. A pytree, so
+    it rides lax.scan carries like the dense dict does."""
+
+    def __init__(self, k_pool, v_pool, block_table):
+        self.k_pool = k_pool
+        self.v_pool = v_pool
+        self.block_table = block_table          # (B, M) int32
+
+    # pytree protocol -------------------------------------------------------
+    def tree_flatten(self):
+        return (self.k_pool, self.v_pool, self.block_table), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves)
+
+    # dense mapping interface ----------------------------------------------
+    def __getitem__(self, key):
+        if key == "k":
+            return gather_block_kv(self.k_pool, self.block_table)
+        if key == "v":
+            return gather_block_kv(self.v_pool, self.block_table)
+        raise KeyError(key)
+
+    def paged_update(self, k_t, v_t, t):
+        """Write this step's K/V (B, H, 1, D) at logical position t
+        (same t for every lane — the lax.scan decode contract). Returns
+        a new adapter over the updated pools; the pool dtype wins, same
+        as the dense path."""
+        bs = self.k_pool.shape[2]
+        block_idx = jnp.take_along_axis(
+            self.block_table,
+            jnp.broadcast_to(t // bs, (self.block_table.shape[0], 1)),
+            axis=1)[:, 0]                           # (B,)
+        off = t % bs
+        kp = self.k_pool.at[block_idx, :, off, :].set(
+            k_t[:, :, 0, :].astype(self.k_pool.dtype))
+        vp = self.v_pool.at[block_idx, :, off, :].set(
+            v_t[:, :, 0, :].astype(self.v_pool.dtype))
+        return PagedDecodeLayer(kp, vp, self.block_table)
+
+
+def build_paged_decode_cache(cache, batch, max_len):
+    """Allocate `batch` rows of `max_len` logical positions out of a
+    PagedKVCache and return (cache_pytree, tables, blocks): the pytree
+    is a list of PagedDecodeLayer drop-in-compatible with
+    decoding.greedy_decode / sample_decode step_fns; `blocks` is the
+    flat allocation to hand back to `cache.free` afterwards."""
+    m = cache.blocks_for_tokens(max_len)
+    rows, flat = [], []
+    for _ in range(batch):
+        blocks = cache.allocate(m)
+        if blocks is None:
+            cache.free(flat)
+            raise MemoryError(
+                f"paged pool exhausted: {batch} x {m} blocks requested, "
+                f"{cache.num_free} free")
+        rows.append(cache.make_table(blocks, m))
+        flat.extend(blocks)
+    tables = jnp.asarray(np.stack(rows))
+    layers = [PagedDecodeLayer(p["k"], p["v"], tables)
+              for p in cache.pools]
+    return layers, tables, flat

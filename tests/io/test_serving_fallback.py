@@ -1,0 +1,216 @@
+"""BatchingServer portability satellites:
+
+- the pure-Python fallback queue honors the same max_batch/max_delay
+  contract as csrc/serve_queue.cc, so serving runs on containers
+  without a compiler (these tests force backend="python" regardless of
+  native availability);
+- submit() validates the feed signature against the queued batch —
+  a mismatched request fails AT SUBMIT instead of poisoning the whole
+  batch's np.concatenate and fanning one confusing exception to every
+  co-batched future.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import serving
+
+
+class _CountingEngine:
+    def __init__(self, delay_s=0.0):
+        self.batch_sizes = []
+        self.delay_s = delay_s
+        self.calls = 0
+
+    def predict_batch(self, feeds):
+        self.calls += 1
+        x = feeds["x"]
+        self.batch_sizes.append(x.shape[0])
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return [x + 1.0]
+
+
+# ---------------------------------------------------------------------------
+# pure-Python fallback queue
+# ---------------------------------------------------------------------------
+
+def test_python_backend_selected_and_reported():
+    srv = serving.BatchingServer(_CountingEngine(), max_batch=4,
+                                 max_delay_ms=10.0, backend="python")
+    try:
+        assert srv.backend == "python"
+    finally:
+        srv.close()
+
+
+def test_python_backend_groups_concurrent_submits():
+    eng = _CountingEngine(delay_s=0.05)
+    srv = serving.BatchingServer(eng, max_batch=8, max_delay_ms=50.0,
+                                 backend="python")
+    try:
+        futs = [srv.submit({"x": np.full((1, 4), float(i), np.float32)})
+                for i in range(16)]
+        outs = [f.result(timeout=30) for f in futs]
+        for i, out in enumerate(outs):
+            np.testing.assert_allclose(out[0], np.full((1, 4), i + 1.0))
+        assert eng.calls < 16, eng.batch_sizes
+        assert max(eng.batch_sizes) > 1, eng.batch_sizes
+    finally:
+        srv.close()
+
+
+def test_python_backend_lone_request_released_by_deadline():
+    eng = _CountingEngine()
+    srv = serving.BatchingServer(eng, max_batch=64, max_delay_ms=30.0,
+                                 backend="python")
+    try:
+        t0 = time.perf_counter()
+        out = srv.submit({"x": np.ones((1, 2), np.float32)}).result(
+            timeout=30)
+        dt = time.perf_counter() - t0
+        np.testing.assert_allclose(out[0], 2.0 * np.ones((1, 2)))
+        assert dt < 5.0, dt          # deadline fired, not max_batch
+        assert eng.batch_sizes == [1]
+    finally:
+        srv.close()
+
+
+def test_python_backend_error_fans_out_and_close_drains():
+    class Boom:
+        def predict_batch(self, feeds):
+            raise ValueError("engine exploded")
+
+    srv = serving.BatchingServer(Boom(), max_batch=4, max_delay_ms=10.0,
+                                 backend="python")
+    try:
+        futs = [srv.submit({"x": np.ones((1, 1), np.float32)})
+                for _ in range(3)]
+        for f in futs:
+            with pytest.raises(ValueError, match="engine exploded"):
+                f.result(timeout=30)
+    finally:
+        srv.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        srv.submit({"x": np.ones((1, 1), np.float32)})
+
+
+def test_python_backend_many_threads():
+    eng = _CountingEngine()
+    srv = serving.BatchingServer(eng, max_batch=8, max_delay_ms=5.0,
+                                 backend="python")
+    results = {}
+    lock = threading.Lock()
+
+    def client(tid):
+        out = srv.submit(
+            {"x": np.full((1, 2), float(tid), np.float32)}).result(30)
+        with lock:
+            results[tid] = out[0]
+
+    try:
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(24)]
+        [t.start() for t in threads]
+        [t.join(timeout=60) for t in threads]
+        assert len(results) == 24
+        for tid, out in results.items():
+            np.testing.assert_allclose(out, np.full((1, 2), tid + 1.0))
+    finally:
+        srv.close()
+
+
+def test_auto_backend_never_fails():
+    """auto picks native when the toolchain builds it, python
+    otherwise — constructing a server must work either way."""
+    srv = serving.BatchingServer(_CountingEngine(), max_batch=2,
+                                 max_delay_ms=5.0, backend="auto")
+    try:
+        assert srv.backend in ("native", "python")
+        out = srv.submit({"x": np.zeros((1, 2), np.float32)}).result(30)
+        np.testing.assert_allclose(out[0], np.ones((1, 2)))
+    finally:
+        srv.close()
+
+
+def test_unknown_backend_rejected():
+    with pytest.raises(ValueError, match="backend"):
+        serving.BatchingServer(_CountingEngine(), backend="cuda")
+
+
+# ---------------------------------------------------------------------------
+# submit-time feed signature validation
+# ---------------------------------------------------------------------------
+
+def _slow_server(eng=None):
+    # long delay + big batch: submits stay queued long enough for the
+    # validation to see them (deterministic — the worker cannot launch
+    # until max_delay passes)
+    return serving.BatchingServer(eng or _CountingEngine(delay_s=0.01),
+                                  max_batch=64, max_delay_ms=200.0,
+                                  backend="python")
+
+
+def test_mismatched_trailing_dims_rejected_at_submit():
+    srv = _slow_server()
+    try:
+        ok = srv.submit({"x": np.ones((1, 4), np.float32)})
+        with pytest.raises(ValueError, match="feed signature mismatch"):
+            srv.submit({"x": np.ones((1, 5), np.float32)})
+        # the queued batch is NOT poisoned: the first request completes
+        np.testing.assert_allclose(ok.result(timeout=30)[0],
+                                   2.0 * np.ones((1, 4)))
+    finally:
+        srv.close()
+
+
+def test_mismatched_keys_rejected_at_submit():
+    srv = _slow_server()
+    try:
+        srv.submit({"x": np.ones((1, 4), np.float32)})
+        with pytest.raises(ValueError, match="feed signature mismatch"):
+            srv.submit({"x": np.ones((1, 4), np.float32),
+                        "y": np.ones((1, 1), np.float32)})
+    finally:
+        srv.close()
+
+
+def test_mismatched_dtype_rejected_at_submit():
+    srv = _slow_server()
+    try:
+        srv.submit({"x": np.ones((1, 4), np.float32)})
+        with pytest.raises(ValueError, match="feed signature mismatch"):
+            srv.submit({"x": np.ones((1, 4), np.float64)})
+    finally:
+        srv.close()
+
+
+def test_different_row_counts_still_cobatch():
+    """Row count (axis 0) is NOT part of the signature — multi-row
+    requests co-batch with single-row ones by design."""
+    srv = _slow_server()
+    try:
+        f1 = srv.submit({"x": np.zeros((2, 3), np.float32)})
+        f2 = srv.submit({"x": np.full((3, 3), 9.0, np.float32)})
+        np.testing.assert_allclose(f1.result(30)[0], np.ones((2, 3)))
+        np.testing.assert_allclose(f2.result(30)[0], np.full((3, 3), 10.0))
+    finally:
+        srv.close()
+
+
+def test_signature_resets_once_queue_drains():
+    """Validation compares against requests CURRENTLY queued: after the
+    batch flushes, a new shape is a fresh first request, not an error."""
+    eng = _CountingEngine()
+    srv = serving.BatchingServer(eng, max_batch=2, max_delay_ms=5.0,
+                                 backend="python")
+    try:
+        srv.submit({"x": np.ones((1, 4), np.float32)}).result(timeout=30)
+        out = srv.submit({"x": np.ones((1, 7), np.float32)}).result(
+            timeout=30)
+        np.testing.assert_allclose(out[0], 2.0 * np.ones((1, 7)))
+    finally:
+        srv.close()

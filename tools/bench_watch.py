@@ -197,6 +197,17 @@ def run_suite():
         run_step("guard_compare", [py, bench],
                  env={"JAX_PLATFORMS": "cpu", "BENCH_GUARD_COMPARE": "1"},
                  timeout_s=900, stdout_path="bench_guard.json")
+    # 1e. serving comparison (ISSUE 5): continuous batching (paged-KV
+    #     GenerationServer) vs static batching on a mixed-length
+    #     generation stream, on the CPU backend (deterministic;
+    #     serving.* metrics ride metrics_sample.json)
+    if _artifact_ok("bench_serving.json"):
+        log("step serving_compare: already landed in a prior cycle — "
+            "skipping")
+    else:
+        run_step("serving_compare", [py, bench],
+                 env={"JAX_PLATFORMS": "cpu", "BENCH_SERVING_COMPARE": "1"},
+                 timeout_s=900, stdout_path="bench_serving.json")
     # 2. headline: ERNIE-base, full sweep, HLO of the best batch archived
     if _artifact_ok("bench_ernie.json"):
         log("step ernie: already landed in a prior cycle — skipping")

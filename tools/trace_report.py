@@ -170,6 +170,31 @@ def print_fault_summary(metrics, file=None):
           f"crc_failures={crc} fallbacks={fb}", file=file)
 
 
+def print_serving_summary(metrics, file=None):
+    """Continuous-batching serving summary: printed only when a
+    GenerationServer left serving.* metrics behind."""
+    file = file if file is not None else sys.stdout
+    reqs = _counter_total(metrics, "serving.requests")
+    if not reqs:
+        return
+    toks = _counter_total(metrics, "serving.generated_tokens")
+    iters = _counter_total(metrics, "serving.iterations")
+    retired = _counter_total(metrics, "serving.retired")
+    cancelled = _counter_total(metrics, "serving.cancelled")
+    deadline = _counter_total(metrics, "serving.deadline_cancels")
+    prefill = _counter_total(metrics, "serving.prefill_tokens")
+    tc, tt = _hist_totals(metrics, "serving.ttft_ms")
+    ic, it = _hist_totals(metrics, "serving.itl_ms")
+    sc, stot = _hist_totals(metrics, "serving.step_ms")
+    print(f"serving: requests={reqs} retired={retired} "
+          f"cancelled={cancelled} deadline_cancels={deadline} "
+          f"iterations={iters}", file=file)
+    print(f"serving: generated_tokens={toks} prefill_tokens={prefill} "
+          f"avg_step={stot / max(sc, 1):.2f}ms "
+          f"ttft_avg={tt / max(tc, 1):.2f}ms "
+          f"itl_avg={it / max(ic, 1):.2f}ms", file=file)
+
+
 # ---------------------------------------------------------------------------
 # --demo: generate a sample trace + metrics dump from a tiny cached loop
 # ---------------------------------------------------------------------------
@@ -256,6 +281,35 @@ def run_demo(out_dir):
             chaos=ChaosInjector().poison_grad_at(3), window=2)
         guard_result = trainer.train(gfeeds)
 
+    # continuous-batching serving demo: a short mixed-length greedy run
+    # through the paged-KV GenerationServer (manual pump, no threads) so
+    # serving.* series land in the committed sample dump — one request
+    # cancels mid-stream via the deterministic chaos path
+    from paddle_tpu.models import gpt
+    from paddle_tpu.serving import GenerationServer, GPTServingModel
+    scfg = gpt.gpt_tiny()
+    smain, sstart = framework.Program(), framework.Program()
+    smain.random_seed = sstart.random_seed = 7
+    with framework.program_guard(smain, sstart):
+        gpt.build_lm_net(scfg, seq_len=8)
+    sscope = fluid.Scope()
+    exe4 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(sscope):
+        exe4.run(sstart)
+        sparams = gpt.load_params(sscope, scfg)
+    server = GenerationServer(
+        GPTServingModel(sparams, scfg), num_slots=2, block_size=8,
+        max_context=64, chunk=4, start=False,
+        chaos=ChaosInjector().cancel_request_at(4, index=0))
+    victim = server.submit(np.arange(3, 15, dtype=np.int32),
+                           max_new_tokens=30)
+    survivors = [server.submit([5 + i, 9, 11], max_new_tokens=4 + i)
+                 for i in range(3)]
+    server.run_until_idle()
+    assert victim.cancelled() or victim.exception(timeout=1) is not None
+    for f in survivors:
+        f.result(timeout=5)
+
     metrics_path = os.path.join(out_dir, "metrics_sample.json")
     dump = global_registry().to_dict()
     dump["executor_stats"] = exe.get_stats()
@@ -264,6 +318,7 @@ def run_demo(out_dir):
     dump["fault_stats"] = dict(exe3.get_stats()["fault"],
                                rollbacks=guard_result.rollbacks,
                                steps=guard_result.steps)
+    dump["serving_stats"] = server.get_stats()
     with open(metrics_path, "w") as f:
         # single line: perf/ artifacts are parsed line-wise by
         # tools/bench_watch.py's _artifact_ok
@@ -306,6 +361,7 @@ def main(argv=None):
         metrics = load_metrics(metrics_path)
         print_cache_summary(metrics)
         print_fault_summary(metrics)
+        print_serving_summary(metrics)
     return 0
 
 
