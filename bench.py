@@ -33,7 +33,8 @@ BENCH_CPU_FALLBACK (default 1: a wedged/failed TPU init re-execs on
 the CPU backend and marks every JSON line "degraded": true instead of
 dying numberless; 0 restores rc=2), BENCH_DEVICE_TIMEOUT (init
 watchdog, default 300s), BENCH_SERVING_COMPARE=1 (continuous vs static
-batching on a mixed-length generation stream; knobs
+batching on a mixed-length generation stream, plus the paged-attention
+Pallas-kernel vs pure-JAX-reference step-time comparison; knobs
 BENCH_SERVING_{REQUESTS,SLOTS,CHUNK,BLOCK,ROUNDS}).
 """
 
@@ -827,7 +828,15 @@ def run_serving_compare(kind):
     iterations; on TPU, where decode is bandwidth-bound, wider chunks
     accelerate prefill mostly for free (docs/serving.md). Honest
     reporting: tokens/sec for BOTH modes plus the iteration counts the
-    speedup comes from."""
+    speedup comes from.
+
+    ISSUE 6 addition: the continuous engine runs the Pallas ragged
+    paged attention kernel (engagement asserted), and the same stream
+    is re-run on a reference-pinned server
+    (PADDLE_TPU_PAGED_KERNEL=0) — per-step time and tokens/s for both
+    land under "paged_attention_kernel_vs_reference", with the caveat
+    that interpret-mode CPU numbers measure overhead parity, not the
+    TPU HBM-traffic win."""
     import numpy as np
     import paddle_tpu as fluid
     import jax.numpy as jnp
@@ -915,6 +924,100 @@ def run_serving_compare(kind):
         cont_s = min(cont_s, time.perf_counter() - t0)
 
     st = server.get_stats()
+    # -- kernel vs reference (ISSUE 6): the continuous server above
+    #    already runs the Pallas ragged-paged-attention kernel (auto
+    #    dispatch) — assert it ENGAGED, then drive the same stream
+    #    through a reference-pinned server and compare per-step time.
+    #    Honest caveat: under the Pallas interpreter on CPU both paths
+    #    lower to XLA HLO, so these numbers measure overhead PARITY of
+    #    the kernel path (dispatch, DMA loop, scratch), not the TPU
+    #    HBM-traffic win the kernel exists for.
+    if st["kernel"]["mode"] == "off":
+        # the operator pinned the reference path: the comparison is
+        # meaningless, but the bench must still emit its JSON line —
+        # dying numberless is the failure mode this file exists to
+        # avoid. An unexpected fallback under auto/force still asserts.
+        result_kernel_skip = ("PADDLE_TPU_PAGED_KERNEL=0 pinned the "
+                              "reference path; kernel comparison "
+                              "skipped")
+        print(json.dumps(_mark_degraded({
+            "metric": "serving_continuous_vs_static_batching_speedup",
+            "value": round(static_s / cont_s, 3),
+            "unit": "x (generated tokens/sec, continuous over static, "
+                    "mixed-length greedy stream)",
+            "continuous_tokens_per_sec": round(total_gen / cont_s, 2),
+            "static_tokens_per_sec": round(total_gen / static_s, 2),
+            "continuous_iterations": cont_iters,
+            "static_iterations": static_iters,
+            "paged_attention_kernel_vs_reference": {
+                "skipped": result_kernel_skip},
+            "device_kind": kind,
+        })), flush=True)
+        return 0
+    assert st["kernel"]["engaged"] is True, st["kernel"]
+    prev = os.environ.get("PADDLE_TPU_PAGED_KERNEL")
+    try:
+        os.environ["PADDLE_TPU_PAGED_KERNEL"] = "0"
+        ref_server = GenerationServer(GPTServingModel(params, cfg),
+                                      num_slots=slots,
+                                      block_size=block_size,
+                                      max_context=max_context,
+                                      chunk=chunk, start=False)
+
+        def run_reference():
+            it0 = ref_server.get_stats()["iteration"]
+            futs = [ref_server.submit(p, max_new_tokens=g)
+                    for p, g in reqs]
+            ref_server.run_until_idle()
+            for f in futs:
+                assert len(f.result(timeout=5).token_ids) > 0
+            return ref_server.get_stats()["iteration"] - it0
+
+        run_reference()             # warm the reference-path compile
+    finally:
+        if prev is None:
+            os.environ.pop("PADDLE_TPU_PAGED_KERNEL", None)
+        else:
+            os.environ["PADDLE_TPU_PAGED_KERNEL"] = prev
+    rst = ref_server.get_stats()
+    assert rst["kernel"]["engaged"] is False, rst["kernel"]
+
+    # order-alternating best-of rounds (the BENCH_GUARD_COMPARE
+    # pattern): both paths see the same shared-core load drift, so a
+    # background blip cannot land entirely on one side and read as a
+    # kernel regression. Dispatch modes are baked into each server's
+    # compiled step — the env var no longer matters here.
+    ker_s = ref_s = float("inf")
+    ker_iters = ref_iters = 0
+    for r in range(max(rounds, 2)):
+        pair = [("k", run_continuous), ("r", run_reference)]
+        if r % 2:
+            pair.reverse()
+        for tag, fn in pair:
+            t0 = time.perf_counter()
+            iters = fn()
+            dt = time.perf_counter() - t0
+            if tag == "k":
+                ker_iters, ker_s = iters, min(ker_s, dt)
+            else:
+                ref_iters, ref_s = iters, min(ref_s, dt)
+    kernel_cmp = {
+        "kernel_step_ms": round(ker_s / max(ker_iters, 1) * 1e3, 3),
+        "reference_step_ms": round(ref_s / max(ref_iters, 1) * 1e3, 3),
+        "kernel_tokens_per_sec": round(total_gen / ker_s, 2),
+        "reference_tokens_per_sec": round(total_gen / ref_s, 2),
+        "step_time_ratio_ref_over_kernel": round(
+            (ref_s / max(ref_iters, 1)) / (ker_s / max(ker_iters, 1)),
+            3),
+        "kernel_iterations": ker_iters,
+        "reference_iterations": ref_iters,
+        "kernel_engaged": st["kernel"]["engaged"],
+        "kernel_dispatches": st["kernel"]["kernel_dispatches"],
+        "caveat": "interpret-mode CPU: both paths lower to XLA HLO, so "
+                  "this measures overhead parity of the kernel path, "
+                  "not the TPU HBM-traffic win (O(true length) vs "
+                  "O(max_blocks) pool reads per lane per step)",
+    }
     result = {
         "metric": "serving_continuous_vs_static_batching_speedup",
         "value": round(static_s / cont_s, 3),
@@ -933,6 +1036,7 @@ def run_serving_compare(kind):
         "slots": slots, "chunk": chunk, "block_size": block_size,
         "fused_step_signatures": st["fused_step_signatures"],
         "block_utilization_final": st["block_utilization"],
+        "paged_attention_kernel_vs_reference": kernel_cmp,
         "device_kind": kind,
     }
     print(json.dumps(_mark_degraded(result)), flush=True)

@@ -149,6 +149,14 @@ METRIC_SPECS = [
      "time to first token: submit -> first generated token"),
     ("serving.itl_ms", "histogram",
      "inter-token latency between consecutive generated tokens"),
+    ("serving.kernel.traced", "counter",
+     "paged_attention dispatches that traced the Pallas ragged paged "
+     "attention kernel (one per layer per fused-step trace)"),
+    ("serving.kernel.fallback", "counter",
+     "paged_attention dispatches that took the pure-JAX reference path"),
+    ("serving.kernel.interpret", "gauge",
+     "1 when the paged kernel runs under the Pallas interpreter "
+     "(off-TPU), 0 when compiled for a real TPU"),
     ("executor.dp.runs", "counter", "data-parallel (mesh) run() calls"),
     ("executor.dp.shard_state_ms", "histogram",
      "feed/state device placement on the data-parallel path"),

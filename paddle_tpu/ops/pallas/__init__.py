@@ -1,3 +1,35 @@
-"""Pallas TPU kernels for the hot ops (flash attention, ring attention
-blocks). Imported lazily — CPU test runs never touch these; the XLA
-fallback in ops/attention_ops.py covers correctness there."""
+"""Pallas TPU kernels for the hot ops: flash attention (training) and
+ragged paged attention (serving decode). Everything is lazy — importing
+this package touches neither kernel module, so CPU test collection and
+non-attention workloads never pay the Pallas import; attribute access
+(`pallas.flash`, `pallas.paged`, `pallas.flash_attention`,
+`pallas.ragged_paged_attention`) resolves on first use (PEP 562)."""
+
+import importlib
+
+_SUBMODULES = ("flash", "paged")
+_FUNCTIONS = {
+    "flash_attention": "flash",
+    "flash_attention_with_lse": "flash",
+    "segment_mask_bias": "flash",
+    "ragged_paged_attention": "paged",
+}
+
+__all__ = list(_SUBMODULES) + list(_FUNCTIONS)
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
+    if name in _FUNCTIONS:
+        mod = importlib.import_module("." + _FUNCTIONS[name], __name__)
+        fn = getattr(mod, name)
+        globals()[name] = fn
+        return fn
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -7,9 +7,11 @@ request has its own prompt length, output length, arrival time, and
 deadline. This package is the TPU-native answer:
 
 - kv_cache.py   — PagedKVCache block pool + block tables +
-                  paged_attention (pure-JAX reference, Pallas-ready
-                  signature) + dense-interface adapters for
-                  inference/decoding.py step_fns;
+                  paged_attention (dispatches to the Pallas ragged
+                  paged attention kernel, ops/pallas/paged.py, with the
+                  pure-JAX reference as documented fallback —
+                  PADDLE_TPU_PAGED_KERNEL=0/1/auto) + dense-interface
+                  adapters for inference/decoding.py step_fns;
 - scheduler.py  — iteration-level continuous batching: fixed decode
                   slots, chunked prefill admission, EOS/length
                   retirement, watermark backpressure, priorities,
@@ -26,14 +28,15 @@ has the block-table layout and tuning guide.
 
 from .kv_cache import (NULL_BLOCK, PagedDecodeLayer, PagedKVCache,
                        build_paged_decode_cache, gather_block_kv,
-                       paged_attention)
+                       paged_attention, paged_attention_reference)
 from .scheduler import (ContinuousBatchingScheduler, DeadlineExceeded,
                         GenerationResult, RequestCancelled)
 from .engine import GenerationFuture, GenerationServer, GPTServingModel
 
 __all__ = [
     "PagedKVCache", "PagedDecodeLayer", "paged_attention",
-    "gather_block_kv", "build_paged_decode_cache", "NULL_BLOCK",
+    "paged_attention_reference", "gather_block_kv",
+    "build_paged_decode_cache", "NULL_BLOCK",
     "ContinuousBatchingScheduler", "GenerationResult",
     "DeadlineExceeded", "RequestCancelled",
     "GenerationServer", "GenerationFuture", "GPTServingModel",

@@ -30,6 +30,7 @@ from ..models.gpt import _cast_params, _ln, load_params
 from ..observability import _help
 from ..observability.metrics import global_registry
 from ..observability.tracing import get_recorder
+from . import kv_cache as _kvc
 from .kv_cache import (NULL_BLOCK, PagedKVCache, paged_attention,
                        write_block_kv)
 from .scheduler import ContinuousBatchingScheduler, RequestCancelled, _Request
@@ -136,6 +137,12 @@ class GenerationServer:
     `start=False` skips the worker thread; tests then pump `step()`
     manually under an injected clock (no sleeps in the serving tier)."""
 
+    # serializes FIRST fused-step traces process-wide: the kernel
+    # dispatch counters in kv_cache are module globals, and two servers
+    # tracing concurrently would read each other's dispatches into
+    # their engagement verdicts
+    _first_trace_lock = threading.Lock()
+
     def __init__(self, model, *, num_slots=4, block_size=16,
                  num_blocks=None, max_context=None, chunk=4, clock=None,
                  watermark_blocks=0, chaos=None, start=True):
@@ -163,6 +170,17 @@ class GenerationServer:
         self.max_context = max_context
         self._fused = jax.jit(model.build_fused_step(self.block_size))
         self._signatures = set()
+        # paged-kernel engagement accounting: the fused step traces
+        # ONCE; the module dispatch counters' delta across that trace
+        # proves which attention path this server actually compiled
+        # (flash.py's TRACE_COUNT lesson — a silent fallback must not
+        # masquerade as the kernel). The delta is measured around the
+        # first fused call under a process-wide lock (see step()), so
+        # neither other servers' dispatches nor concurrent first-step
+        # traces can corrupt this server's verdict.
+        self._kernel_engaged = None     # unknown until the first step
+        self._kernel_mode = None        # mode the step traced under
+        self._kernel_counts = (0, 0)    # this server's trace dispatches
         self._next_rid = 0
         self._rid_lock = threading.Lock()
         self._closed = False
@@ -264,7 +282,24 @@ class GenerationServer:
                 # the cache object always holds the LIVE device pools:
                 # the functional update replaces them in place of the
                 # consumed ones (keeping both would pin 2x the KV HBM)
-                pools, nxt, logps = self._fused(self.cache.pools, *args)
+                if self._kernel_engaged is None:
+                    # first fused call is about to TRACE: serialize it
+                    # against other servers' first traces and snapshot
+                    # the dispatch mode + counters right around it, so
+                    # the delta covers exactly THIS trace
+                    with GenerationServer._first_trace_lock:
+                        self._kernel_mode = _kvc.paged_kernel_mode()
+                        k0, f0 = (_kvc.KERNEL_DISPATCHES,
+                                  _kvc.FALLBACK_DISPATCHES)
+                        pools, nxt, logps = self._fused(
+                            self.cache.pools, *args)
+                        self._kernel_counts = (
+                            _kvc.KERNEL_DISPATCHES - k0,
+                            _kvc.FALLBACK_DISPATCHES - f0)
+                    self._check_kernel_engagement()
+                else:
+                    pools, nxt, logps = self._fused(self.cache.pools,
+                                                    *args)
                 self.cache.pools = pools
                 nxt, logps = np.asarray(nxt), np.asarray(logps)
             self._sched.commit(plan, nxt, logps)
@@ -283,6 +318,29 @@ class GenerationServer:
                     f"serving loop did not drain in {max_iterations} "
                     f"iterations")
         return n
+
+    def _check_kernel_engagement(self):
+        """Runs once, right after the first fused-step trace: if the
+        dispatch mode says the Pallas kernel should serve this pool
+        dtype but the trace took the reference path (or vice versa when
+        it is pinned off), fail LOUDLY now — not after a bench round
+        reports reference numbers as kernel numbers."""
+        traced, fell_back = self._kernel_counts
+        self._kernel_engaged = traced > 0 and fell_back == 0
+        kp = self.cache.pools[0]["k"]
+        expected = (self._kernel_mode != "off" and
+                    _kvc.paged_kernel_supported(
+                        jnp.zeros((1, 1, 1, 1), kp.dtype), kp, kp))
+        if expected and not self._kernel_engaged:
+            raise RuntimeError(
+                "paged attention kernel was expected "
+                f"(PADDLE_TPU_PAGED_KERNEL={self._kernel_mode}, "
+                f"pool dtype {kp.dtype}) but the fused step traced "
+                f"{traced} kernel / {fell_back} reference dispatches")
+        if not expected and traced > 0:
+            raise RuntimeError(
+                "paged attention kernel engaged although the dispatch "
+                "mode pinned it off")
 
     def _publish_gauges(self):
         st = self._sched
@@ -339,4 +397,14 @@ class GenerationServer:
         st["chunk"] = self._sched.chunk
         st["block_size"] = self.block_size
         st["max_context"] = self.max_context
+        traced, fell_back = self._kernel_counts
+        st["kernel"] = {
+            # the mode the fused step actually TRACED under — a later
+            # env flip must not make a server misreport its compiled
+            # path (None until the first step)
+            "mode": self._kernel_mode,
+            "engaged": self._kernel_engaged,
+            "kernel_dispatches": traced,
+            "fallback_dispatches": fell_back,
+        }
         return st

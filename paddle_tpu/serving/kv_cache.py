@@ -16,10 +16,15 @@ compiled step): length is data (positions + tables), never shape. Block
 0 is the reserved NULL block — table padding and masked-token writes
 land there, and the attention mask guarantees it is never read.
 
-`paged_attention` is the pure-JAX reference implementation of the op
-(gather blocks by table -> masked attention). Its signature — query,
-pools, tables, positions — is the contract a Pallas kernel drops into
-later; everything above it (scheduler, engine) is kernel-agnostic.
+`paged_attention` is the op's dispatcher: by default it routes to the
+Pallas ragged paged attention kernel (`ops/pallas/paged.py` — the table
+walk fused into the kernel, early stop at each lane's true length,
+bf16 KV with f32 accumulation), falling back to
+`paged_attention_reference`, the pure-JAX semantic spec (gather blocks
+by table -> masked attention) that the kernel is pinned bitwise against
+in interpret mode. `PADDLE_TPU_PAGED_KERNEL` (0/1/auto) overrides the
+routing; everything above the op (scheduler, engine) is
+kernel-agnostic.
 
 `PagedDecodeLayer` adapts a layer's pool slice to the dense mapping
 interface `decoding.py` step_fns consume (`cache[i]["k"]`,
@@ -28,33 +33,68 @@ unchanged (beam search still needs the dense cache: `_gather_beams`
 reorders lanes by leading dim, which a shared pool does not have).
 """
 
+import os
+
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
 __all__ = ["PagedKVCache", "PagedDecodeLayer", "paged_attention",
-           "gather_block_kv", "build_paged_decode_cache", "NULL_BLOCK"]
+           "paged_attention_reference", "gather_block_kv",
+           "gather_block_kv_pair", "build_paged_decode_cache",
+           "NULL_BLOCK", "paged_kernel_mode", "paged_kernel_supported",
+           "kernel_dispatch_stats"]
 
 NULL_BLOCK = 0          # reserved: never allocated, never attended
 NEG_INF = -1e9
 
+# Trace-time dispatch accounting (flash.py's TRACE_COUNT idiom): how
+# many paged_attention dispatches routed to the Pallas kernel vs the
+# pure-JAX reference. The engine and bench assert engagement off these
+# so a silent fallback can never masquerade as a kernel win.
+KERNEL_DISPATCHES = 0
+FALLBACK_DISPATCHES = 0
+
 
 # ---------------------------------------------------------------------------
-# functional ops (jit-traceable; Pallas-ready signatures)
+# functional ops (jit-traceable; the Pallas kernel contract)
 # ---------------------------------------------------------------------------
+
+def gather_block_kv_pair(k_pool, v_pool, block_table):
+    """Gather BOTH pools dense in one indexed pass: the (B, M) table is
+    flattened into a single gather-index plan applied to k and v, so the
+    reference pays one index build instead of two per layer per step.
+    The two dense (B, H, M*bs, D) materializations themselves are the
+    reference's inherent O(M*bs) HBM cost per lane per step — every
+    decode iteration copies each request's FULL table width regardless
+    of its true length. That is exactly the traffic the Pallas kernel
+    (ops/pallas/paged.py) removes by walking the table in-kernel with a
+    per-lane early stop."""
+    b, m = block_table.shape
+    n, h, bs, d = k_pool.shape
+    flat = block_table.reshape(-1)              # ONE index plan
+
+    def _take(pool):
+        g = jnp.take(pool, flat, axis=0).reshape(b, m, h, bs, d)
+        return jnp.moveaxis(g, 2, 1).reshape(b, h, m * bs, d)
+
+    return _take(k_pool), _take(v_pool)
+
 
 def gather_block_kv(pool, block_table):
     """pool (N, H, bs, D) gathered by table (B, M) -> dense
     (B, H, M*bs, D) view in logical-position order."""
     b, m = block_table.shape
     n, h, bs, d = pool.shape
-    g = pool[block_table]                       # (B, M, H, bs, D)
+    g = jnp.take(pool, block_table.reshape(-1), axis=0)
+    g = g.reshape(b, m, h, bs, d)
     return jnp.moveaxis(g, 2, 1).reshape(b, h, m * bs, d)
 
 
-def paged_attention(q, k_pool, v_pool, block_table, q_positions):
-    """Reference paged attention: gather blocks by table, mask keys
+def paged_attention_reference(q, k_pool, v_pool, block_table,
+                              q_positions):
+    """Pure-JAX paged attention: gather blocks by table, mask keys
     beyond each query's position, softmax in f32, weighted sum.
 
     q:           (B, H, C, D) — C query tokens per request lane
@@ -66,12 +106,13 @@ def paged_attention(q, k_pool, v_pool, block_table, q_positions):
     The numerics deliberately mirror the dense cache path in
     models/gpt.build_kv_step: scores and softmax in f32, probabilities
     cast back to the value dtype before the PV contraction — so a paged
-    decode is bitwise-comparable to the dense one. This pure-JAX body is
-    the semantic spec for a future Pallas kernel with the same
-    signature (the kernel would walk the table instead of gathering)."""
+    decode is bitwise-comparable to the dense one. This body is the
+    SEMANTIC SPEC for the Pallas kernel: ops/pallas/paged.py walks the
+    table in-kernel instead of materializing the dense gather and is
+    pinned bitwise against this function for f32 pools in interpret
+    mode (tests/ops/test_paged_kernel.py)."""
     d = q.shape[-1]
-    gk = gather_block_kv(k_pool, block_table)           # (B, H, T, D)
-    gv = gather_block_kv(v_pool, block_table)
+    gk, gv = gather_block_kv_pair(k_pool, v_pool, block_table)
     s = jnp.einsum("bhcd,bhtd->bhct", q, gk) / np.sqrt(d)
     t = gk.shape[2]
     key_pos = jnp.arange(t)
@@ -79,6 +120,92 @@ def paged_attention(q, k_pool, v_pool, block_table, q_positions):
     s = jnp.where(mask, s.astype(jnp.float32), NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(gv.dtype)
     return jnp.einsum("bhct,bhtd->bhcd", p, gv)
+
+
+def paged_kernel_mode():
+    """Resolve PADDLE_TPU_PAGED_KERNEL -> 'off' | 'force' | 'auto'.
+    Unset/'auto': use the kernel whenever the operands qualify (the
+    default — tier-1 exercises the real kernel under the Pallas
+    interpreter on CPU). '0' pins the reference path, '1' demands the
+    kernel and raises on unsupported operands instead of silently
+    degrading."""
+    raw = os.environ.get("PADDLE_TPU_PAGED_KERNEL", "auto").lower()
+    if raw in ("0", "off", "false"):
+        return "off"
+    if raw in ("1", "force", "true"):
+        return "force"
+    if raw in ("auto", ""):
+        return "auto"
+    raise ValueError(
+        f"PADDLE_TPU_PAGED_KERNEL={raw!r}: expected 0, 1 or auto")
+
+
+def paged_kernel_supported(q, k_pool, v_pool):
+    """Shapes/dtypes the kernel handles: 4-D operands with matching
+    same-dtype f32 or bf16 pools (int8 pools arrive with ROADMAP item
+    5's quantized KV blocks)."""
+    if q.ndim != 4 or k_pool.ndim != 4 or v_pool.ndim != 4:
+        return False
+    if k_pool.dtype != v_pool.dtype:
+        return False
+    return k_pool.dtype in (jnp.float32, jnp.bfloat16)
+
+
+def _record_dispatch(kernel):
+    """Trace-time metrics: dispatch counters + the interpret-mode gauge
+    land in the global registry so GenerationServer.get_stats() and the
+    trace_report serving summary can prove the kernel engaged."""
+    global KERNEL_DISPATCHES, FALLBACK_DISPATCHES
+    from ..observability import _help
+    from ..observability.metrics import global_registry
+    reg = global_registry()
+    if kernel:
+        KERNEL_DISPATCHES += 1
+        reg.counter("serving.kernel.traced",
+                    _help("serving.kernel.traced")).inc()
+        from ..ops.pallas import paged as _paged
+        reg.gauge("serving.kernel.interpret",
+                  _help("serving.kernel.interpret")).set(
+                      1 if _paged._interpret() else 0)
+    else:
+        FALLBACK_DISPATCHES += 1
+        reg.counter("serving.kernel.fallback",
+                    _help("serving.kernel.fallback")).inc()
+
+
+def kernel_dispatch_stats():
+    """Module-level dispatch counters as a dict (engine/bench surface)."""
+    return {"kernel_dispatches": KERNEL_DISPATCHES,
+            "fallback_dispatches": FALLBACK_DISPATCHES,
+            "mode": paged_kernel_mode()}
+
+
+def paged_attention(q, k_pool, v_pool, block_table, q_positions):
+    """Paged attention dispatcher — the frozen serving contract.
+
+    Routes to the Pallas ragged paged attention kernel
+    (ops/pallas/paged.ragged_paged_attention: in-kernel table walk,
+    per-lane early stop, NULL block never read, bf16 KV with f32
+    accumulation) whenever `PADDLE_TPU_PAGED_KERNEL` allows it and the
+    operands qualify; otherwise falls back to
+    `paged_attention_reference`, the documented pure-JAX spec. The
+    decision happens at TRACE time (shapes/dtypes are static under
+    jit), so a compiled fused step pays zero dispatch overhead."""
+    mode = paged_kernel_mode()
+    supported = paged_kernel_supported(q, k_pool, v_pool)
+    if mode == "force" and not supported:
+        raise ValueError(
+            "PADDLE_TPU_PAGED_KERNEL=1 but operands do not qualify "
+            f"(q {q.shape} {q.dtype}, pools {k_pool.shape} "
+            f"{k_pool.dtype}/{v_pool.dtype})")
+    if mode != "off" and supported:
+        from ..ops.pallas.paged import ragged_paged_attention
+        _record_dispatch(kernel=True)
+        return ragged_paged_attention(q, k_pool, v_pool, block_table,
+                                      q_positions)
+    _record_dispatch(kernel=False)
+    return paged_attention_reference(q, k_pool, v_pool, block_table,
+                                     q_positions)
 
 
 def write_block_kv(pool, vals, block_idx, offset):

@@ -193,6 +193,14 @@ def print_serving_summary(metrics, file=None):
           f"avg_step={stot / max(sc, 1):.2f}ms "
           f"ttft_avg={tt / max(tc, 1):.2f}ms "
           f"itl_avg={it / max(ic, 1):.2f}ms", file=file)
+    ker = _counter_total(metrics, "serving.kernel.traced")
+    fb = _counter_total(metrics, "serving.kernel.fallback")
+    if ker or fb:
+        interp = metrics.get("serving.kernel.interpret", {})
+        ivals = interp.get("values", [])
+        imode = ivals[0].get("value") if ivals else None
+        print(f"serving: paged_kernel traced={ker} fallback={fb} "
+              f"interpret={imode}", file=file)
 
 
 # ---------------------------------------------------------------------------
