@@ -35,7 +35,12 @@ dying numberless; 0 restores rc=2), BENCH_DEVICE_TIMEOUT (init
 watchdog, default 300s), BENCH_SERVING_COMPARE=1 (continuous vs static
 batching on a mixed-length generation stream, plus the paged-attention
 Pallas-kernel vs pure-JAX-reference step-time comparison; knobs
-BENCH_SERVING_{REQUESTS,SLOTS,CHUNK,BLOCK,ROUNDS}).
+BENCH_SERVING_{REQUESTS,SLOTS,CHUNK,BLOCK,ROUNDS};
+BENCH_SLO_SAMPLE=<path> additionally scrapes the live /metrics + /slo
+endpoint mid-bench and lands the sample there),
+BENCH_TELEMETRY_COMPARE=1 (request-level telemetry on-vs-off engine
+overhead; knobs BENCH_TELEMETRY_{REQUESTS,SLOTS,ROUNDS}; acceptance
+< 5%).
 """
 
 import json
@@ -809,6 +814,63 @@ def run_guard_compare(kind):
     return 0
 
 
+def _scrape_slo_sample(server, kind):
+    """BENCH_SLO_SAMPLE=<path>: mount the telemetry endpoint on the
+    (still-warm) continuous server, scrape /metrics + /slo + /healthz
+    over real loopback HTTP, and land the evidence at <path> (the
+    bench_watch serving_compare step points it at perf/slo_sample.json).
+    NEVER raises: a failed scrape must not cost the bench its result
+    line (the dying-numberless failure mode this file exists to avoid)
+    — it logs, records a failure sample, and returns."""
+    sample_path = os.environ.get("BENCH_SLO_SAMPLE")
+    if not sample_path:
+        return None
+    exp = None
+    try:
+        import urllib.request
+        exp = server.serve_metrics(port=0)
+        t_scrape = time.perf_counter()
+        prom = urllib.request.urlopen(f"{exp.url}/metrics",
+                                      timeout=30).read().decode()
+        slo = json.loads(urllib.request.urlopen(
+            f"{exp.url}/slo", timeout=30).read().decode())
+        health = json.loads(urllib.request.urlopen(
+            f"{exp.url}/healthz", timeout=30).read().decode())
+        scrape_ms = (time.perf_counter() - t_scrape) * 1e3
+        sample = {
+            "source": "live /metrics scrape during "
+                      "BENCH_SERVING_COMPARE (GenerationServer."
+                      "serve_metrics, loopback HTTP)",
+            "scrape_ms": round(scrape_ms, 2),
+            "health": health,
+            "slo": slo,
+            "metrics_bytes": len(prom),
+            "serving_series": [ln for ln in prom.splitlines()
+                               if ln.startswith("serving_")
+                               and not ln.startswith("#")][:60],
+            "device_kind": kind,
+        }
+        with open(sample_path, "w") as f:
+            json.dump(_mark_degraded(sample), f, sort_keys=True)
+            f.write("\n")
+        print(f"bench: slo sample scraped ({len(prom)} bytes) -> "
+              f"{sample_path}", file=sys.stderr)
+        return sample_path
+    except Exception as e:      # noqa: BLE001 — evidence, not a gate
+        print(f"bench: slo sample scrape FAILED ({e!r}) — continuing "
+              f"without it", file=sys.stderr)
+        try:
+            with open(sample_path, "w") as f:
+                json.dump({"failed": True, "error": repr(e)}, f)
+                f.write("\n")
+        except OSError:
+            pass
+        return None
+    finally:
+        if exp is not None:
+            exp.close()
+
+
 def run_serving_compare(kind):
     """BENCH_SERVING_COMPARE=1: continuous batching (GenerationServer,
     paged KV cache) vs static batching (fixed groups over the dense
@@ -949,6 +1011,7 @@ def run_serving_compare(kind):
             "static_tokens_per_sec": round(total_gen / static_s, 2),
             "continuous_iterations": cont_iters,
             "static_iterations": static_iters,
+            "slo_sample_file": _scrape_slo_sample(server, kind),
             "paged_attention_kernel_vs_reference": {
                 "skipped": result_kernel_skip},
             "device_kind": kind,
@@ -1018,6 +1081,7 @@ def run_serving_compare(kind):
                   "not the TPU HBM-traffic win (O(true length) vs "
                   "O(max_blocks) pool reads per lane per step)",
     }
+    slo_sample_file = _scrape_slo_sample(server, kind)
     result = {
         "metric": "serving_continuous_vs_static_batching_speedup",
         "value": round(static_s / cont_s, 3),
@@ -1027,6 +1091,7 @@ def run_serving_compare(kind):
         "static_tokens_per_sec": round(total_gen / static_s, 2),
         "continuous_iterations": cont_iters,
         "static_iterations": static_iters,
+        "slo_sample_file": slo_sample_file,
         "requests": n_req,
         "generated_tokens": total_gen,
         "prompt_len_range": [min(len(p) for p, _ in reqs),
@@ -1037,6 +1102,138 @@ def run_serving_compare(kind):
         "fused_step_signatures": st["fused_step_signatures"],
         "block_utilization_final": st["block_utilization"],
         "paged_attention_kernel_vs_reference": kernel_cmp,
+        "device_kind": kind,
+    }
+    print(json.dumps(_mark_degraded(result)), flush=True)
+    return 0
+
+
+def run_telemetry_compare(kind):
+    """BENCH_TELEMETRY_COMPARE=1: request-level telemetry overhead —
+    the SAME mixed-length greedy stream through two GenerationServers,
+    telemetry on (lifecycle hooks + SLO digests + flight ring; the
+    default) vs telemetry=False (the bare PR-6 engine), order-
+    alternating rounds (the BENCH_GUARD_COMPARE pattern so shared-core
+    load drift cannot land on one side). Acceptance (ISSUE 7):
+    overhead < 5%. Trace-request sampling stays at its env default but
+    the recorder is OFF (production posture: hooks live, capture
+    idle); SLO digests and the flight ring run at full rate."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.core import framework
+    from paddle_tpu.core.executor import Scope, scope_guard
+    from paddle_tpu.models import gpt
+    from paddle_tpu.serving import GenerationServer, GPTServingModel
+
+    # the true effect (~2-4% on this backend) is well below the
+    # per-stream noise (±10% bursts on the shared container), so the
+    # workload is sized for the estimator: 48 requests ≈ 0.4 s per
+    # stream and 30 alternating rounds give each mode's minimum enough
+    # samples to converge on its uncontended floor through the bursts
+    n_req = int(os.environ.get("BENCH_TELEMETRY_REQUESTS", 48))
+    slots = int(os.environ.get("BENCH_TELEMETRY_SLOTS", 4))
+    # floor of 1: a tiny BENCH_TELEMETRY_ROUNDS must degrade to fewer/
+    # smaller blocks, never die numberless on an empty ratio list
+    rounds = max(1, int(os.environ.get("BENCH_TELEMETRY_ROUNDS", 30)))
+    max_context = 96
+
+    cfg = gpt.gpt_tiny()
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 7
+    with framework.program_guard(main, startup):
+        gpt.build_lm_net(cfg, seq_len=8)
+    scope = Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    with scope_guard(scope):
+        exe.run(startup)
+        params = gpt.load_params(scope, cfg)
+
+    rng = np.random.default_rng(0)
+    reqs = [(rng.integers(3, cfg.vocab_size,
+                          rng.integers(4, 29)).astype(np.int32),
+             int(rng.integers(4, 45))) for _ in range(n_req)]
+    total_gen = sum(g for _p, g in reqs)
+
+    servers = {
+        "on": GenerationServer(GPTServingModel(params, cfg),
+                               num_slots=slots, block_size=8,
+                               max_context=max_context, chunk=1,
+                               start=False, telemetry=True),
+        "off": GenerationServer(GPTServingModel(params, cfg),
+                                num_slots=slots, block_size=8,
+                                max_context=max_context, chunk=1,
+                                start=False, telemetry=False),
+    }
+
+    def run_stream(server):
+        futs = [server.submit(p, max_new_tokens=g) for p, g in reqs]
+        server.run_until_idle()
+        for f in futs:
+            assert len(f.result(timeout=5).token_ids) > 0
+
+    for s in servers.values():      # warm both compiles before timing
+        run_stream(s)
+    best = {"on": float("inf"), "off": float("inf")}
+    ratios = []
+    per_round = {}
+    order = list(servers.items())
+    for r in range(rounds):
+        pair = order if r % 2 == 0 else list(reversed(order))
+        times = {}
+        for name, s in pair:
+            t0 = time.perf_counter()
+            run_stream(s)
+            times[name] = time.perf_counter() - t0
+            best[name] = min(best[name], times[name])
+        ratios.append(times["on"] / times["off"])
+        for name in servers:
+            per_round.setdefault(name, []).append(times[name])
+    # headline: median of BLOCK-PAIRED best-of ratios. Contention on
+    # this shared-core container only ever ADDS time, so a per-mode
+    # MINIMUM recovers that mode's uncontended floor — but a global
+    # min-of-all-rounds needs both modes to catch a quiet moment
+    # (asymmetric luck reads as overhead), and a per-round paired
+    # median's ~0.35 s windows are shorter than the bursts (adjacent-
+    # pair ratios stay burst-correlated; observed spread −7%..+26%).
+    # So: take per-mode minima within each block of 6 time-adjacent
+    # alternating rounds (recovers floors under bursts shorter than a
+    # block), ratio the two minima per block (time-adjacent, immune to
+    # slow drift), and take the median across blocks (robust to a
+    # fully-contended block). Global best-of and the paired per-round
+    # median ride along as cross-checks.
+    block = min(6, rounds)      # < 6 rounds: one (degenerate) block
+    # range(0, rounds, block): a non-multiple round count yields a
+    # shorter (noisier) tail block rather than silently dropping those
+    # measured rounds from the acceptance-gated headline
+    block_ratios = sorted(
+        min(per_round["on"][i:i + block]) /
+        min(per_round["off"][i:i + block])
+        for i in range(0, rounds, block))
+    overhead = block_ratios[len(block_ratios) // 2] - 1.0
+    ratios.sort()
+    paired_median = ratios[len(ratios) // 2] - 1.0
+    st_on = servers["on"].get_stats()
+    result = {
+        "metric": "serving_telemetry_overhead",
+        "value": round(overhead, 4),
+        "unit": "fractional slowdown of telemetry-on vs telemetry-off, "
+                "median of block-paired best-of-6-rounds ratios, mixed-"
+                "length greedy stream (acceptance: < 0.05)",
+        "block_ratios": [round(x - 1.0, 4) for x in block_ratios],
+        "best_of_overhead": round(best["on"] / best["off"] - 1.0, 4),
+        "paired_median_overhead": round(paired_median, 4),
+        "round_ratios": [round(x - 1.0, 4) for x in ratios],
+        "telemetry_on_tokens_per_sec": round(total_gen / best["on"], 2),
+        "telemetry_off_tokens_per_sec": round(total_gen / best["off"],
+                                              2),
+        "requests": n_req, "generated_tokens": total_gen,
+        "slots": slots, "rounds": rounds,
+        "slo_windows_completed":
+            st_on["slo"]["windows_completed"],
+        "slo_cumulative_ttft_p99_ms":
+            st_on["slo"]["cumulative"].get("ttft_ms", {}).get("p99"),
+        "flight_entries": st_on["slo"]["flight"]["entries"],
+        "trace_requests_mode": st_on["slo"]["trace_requests"]["mode"],
         "device_kind": kind,
     }
     print(json.dumps(_mark_degraded(result)), flush=True)
@@ -1330,6 +1527,10 @@ def main():
         # continuous-batching vs static-batching on a mixed-length
         # generation stream (serving layer)
         return run_serving_compare(kind)
+
+    if os.environ.get("BENCH_TELEMETRY_COMPARE") == "1":
+        # request-level telemetry overhead (observability layer)
+        return run_telemetry_compare(kind)
 
     seq_len = int(os.environ.get("BENCH_SEQ_LEN", 512))
     # defaults favor landing A number inside a fragile tunnel window:

@@ -347,6 +347,7 @@ class Executor:
         # the process-wide registry; gauges labeled per-executor there
         self._exe_id = f"exe{next(_EXECUTOR_SEQ)}"
         self._stats = ComponentStats(gauge_labels={"executor": self._exe_id})
+        self._telemetry_server = None   # serve_metrics() mount
 
     # ------------------------------------------------------------------
     def clear_caches(self):
@@ -372,6 +373,9 @@ class Executor:
         self._stats.drop_gauges("executor.jit_cache.size",
                                 "executor.meta_cache.size",
                                 "executor.async.inflight")
+        if self._telemetry_server is not None:
+            self._telemetry_server.close()
+            self._telemetry_server = None
         self._last_call = None
         self._compiled_pair = None
 
@@ -446,6 +450,34 @@ class Executor:
             raise NonFiniteError(bad[0], step_id, bad)
 
     # -- observability --------------------------------------------------
+    def serve_metrics(self, port=0, host=None):
+        """Mount the stdlib telemetry endpoint (/metrics Prometheus
+        exposition of the process-wide registry, /healthz with this
+        executor's vitals) — the training-side twin of
+        GenerationServer.serve_metrics. Binds loopback by default
+        (docs/observability.md security note); idempotent while a mount
+        is live, but an explicit port/host that differs from the live
+        mount raises instead of silently returning the old endpoint;
+        closed with the executor."""
+        from ..observability.exporter import (check_remount,
+                                              serve_metrics as _serve)
+        if self._telemetry_server is not None and \
+                not self._telemetry_server.closed:
+            check_remount(self._telemetry_server, port, host)
+            return self._telemetry_server    # live mount: idempotent
+
+        def _health():
+            s = self.get_stats()
+            return {"executor": s["executor"], "steps": s["steps"],
+                    "compiles": s["compiles"],
+                    "inflight": s["async"]["inflight"],
+                    "guarded": s["fault"]["guarded"]}
+
+        self._telemetry_server = _serve(port=port,
+                                        host=host or "127.0.0.1",
+                                        health_fn=_health)
+        return self._telemetry_server
+
     def get_stats(self):
         """Structured snapshot of this executor's counters and span
         histograms (docs/observability.md). Cheap; safe to call every

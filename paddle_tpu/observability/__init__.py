@@ -1,11 +1,18 @@
 """Runtime observability: metrics, tracing, and component stats.
 
-Three pieces, wired through the execution stack:
+Six pieces, wired through the execution stack:
 
 - metrics.py — process-wide MetricsRegistry (counters / gauges /
   ms-histograms; JSON + Prometheus export; METRIC_SPECS namespace lint).
 - tracing.py — Chrome trace_event recorder (Perfetto-loadable), off by
-  default, enabled by paddle_tpu.profiler.
+  default, enabled by paddle_tpu.profiler; bounded drop-oldest ring.
+- sketch.py — deterministic mergeable quantile digest (the SLO
+  p50/p90/p99 backend; no external deps).
+- serving_telemetry.py — request-level serving telemetry: lifecycle
+  span trees, SLO digests, and the fault flight recorder
+  (GenerationServer wires it; GuardedTrainer reuses the recorder).
+- exporter.py — stdlib HTTP /metrics (Prometheus), /healthz, /slo
+  endpoint any component mounts via serve_metrics(port=...).
 - ComponentStats (here) — the per-component view an instrumented object
   (the Executor) holds: every update lands in BOTH the component's
   private registry (so Executor.get_stats() answers per-instance
@@ -22,13 +29,22 @@ import time
 
 from . import metrics
 from . import tracing
+from . import sketch
 from .metrics import (MetricsRegistry, global_registry, METRIC_SPECS,
                       DEFAULT_MS_BUCKETS)
+from .sketch import QuantileSketch
 from .tracing import TraceRecorder, get_recorder
 
-__all__ = ["metrics", "tracing", "MetricsRegistry", "global_registry",
-           "METRIC_SPECS", "DEFAULT_MS_BUCKETS", "TraceRecorder",
-           "get_recorder", "ComponentStats"]
+__all__ = ["metrics", "tracing", "sketch", "MetricsRegistry",
+           "global_registry", "METRIC_SPECS", "DEFAULT_MS_BUCKETS",
+           "QuantileSketch", "TraceRecorder", "get_recorder",
+           "ComponentStats"]
+
+# serving_telemetry and exporter import lazily from here (they need
+# _help below); they are reached as paddle_tpu.observability.<module>
+# by the serving engine and tests without being imported at package
+# import time (the exporter pulls http.server in, which the training
+# path never needs).
 
 
 class ComponentStats:

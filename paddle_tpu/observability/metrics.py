@@ -157,6 +157,32 @@ METRIC_SPECS = [
     ("serving.kernel.interpret", "gauge",
      "1 when the paged kernel runs under the Pallas interpreter "
      "(off-TPU), 0 when compiled for a real TPU"),
+    ("tracing.dropped_events", "counter",
+     "trace events dropped by the bounded ring buffer (drop-oldest)"),
+    ("serving.queue_wait_ms", "histogram",
+     "submit -> decode-slot admission wait"),
+    ("serving.e2e_ms", "histogram",
+     "submit -> retirement end-to-end latency (retired requests only)"),
+    ("serving.slo.quantile_ms", "gauge",
+     "per-window latency quantiles from the SLO digests (labels: "
+     "metric=ttft|itl|e2e|queue_wait, q=p50|p90|p99, server=<per-"
+     "tracker id> so concurrent servers never clobber each other)"),
+    ("serving.slo.tokens_per_s", "gauge",
+     "generated tokens/sec over the last completed SLO window "
+     "(label: server)"),
+    ("serving.slo.windows", "counter", "completed SLO digest windows"),
+    ("serving.requests_traced", "counter",
+     "requests whose lifecycle span tree was emitted into the trace "
+     "recorder (PADDLE_TPU_TRACE_REQUESTS sampling knob)"),
+    ("serving.faults", "counter",
+     "engine fault events (non-finite logits, deadline storms) that "
+     "dumped the flight recorder"),
+    ("flight.dumps", "counter",
+     "flight-recorder JSON artifacts written (engine faults, "
+     "GuardedTrainer NaN rollbacks)"),
+    ("exporter.requests", "counter",
+     "telemetry HTTP endpoint requests served (labels: path, code; "
+     "plus an unlabeled aggregate)"),
     ("executor.dp.runs", "counter", "data-parallel (mesh) run() calls"),
     ("executor.dp.shard_state_ms", "histogram",
      "feed/state device placement on the data-parallel path"),
@@ -465,14 +491,17 @@ class MetricsRegistry:
 
     def to_prometheus(self):
         """Prometheus text exposition format, 'name.with.dots' sanitized
-        to legal underscore form."""
+        to legal underscore form. Label values AND help text are escaped
+        per the format spec (labels: backslash/quote/newline; HELP:
+        backslash/newline) — a label like shape="(4, 8)" or a help
+        string spanning lines must never emit an unscrapeable line."""
         lines = []
         with self._lock:
             metrics = sorted(self._metrics.values(), key=lambda m: m.name)
         for m in metrics:
             pname = re.sub(r"[^a-zA-Z0-9_:]", "_", m.name)
             if m.help:
-                lines.append(f"# HELP {pname} {m.help}")
+                lines.append(f"# HELP {pname} {_escape_help(m.help)}")
             lines.append(f"# TYPE {pname} {m.kind}")
             for lbl, child in m.series():
                 base_lbl = _fmt_labels(lbl)
@@ -496,7 +525,15 @@ def _fmt_labels(labels):
 
 
 def _escape(v):
+    """Label-value escaping per the exposition format: backslash,
+    double-quote, newline."""
     return str(v).replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def _escape_help(v):
+    """HELP-line escaping per the exposition format: backslash and
+    newline only (quotes are legal in help text)."""
+    return str(v).replace("\\", r"\\").replace("\n", r"\n")
 
 
 _GLOBAL = MetricsRegistry()

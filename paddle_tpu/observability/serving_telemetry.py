@@ -1,0 +1,742 @@
+"""Request-level serving telemetry: lifecycle traces, SLO digests, and
+the fault flight recorder.
+
+PR 1's observability sees the world per-*step*; the serving engine's
+unit of work is a *request* that lives across many fused-step
+iterations. This module is the request-level layer the engine and
+scheduler call into:
+
+- **Lifecycle tracing** — every sampled request gets a retroactively
+  emitted span tree in the global TraceRecorder (Perfetto):
+  ``request <rid>`` root covering submit→end, with ``queue``,
+  ``prefill.chunk`` ×N and ``decode`` children, terminated by a
+  ``retire``/``cancel``/``deadline`` instant. Spans ride a dedicated
+  track per decode slot (``serving slot <n>``; never-admitted requests
+  land on ``serving queue``) and carry engine-iteration correlation
+  ids in their args, so a trace row lines up with the
+  ``serving.iteration`` engine spans and the flight-recorder entries.
+  Sampling knob: ``PADDLE_TPU_TRACE_REQUESTS=off|sampled:<rate>|all``
+  (deterministic per-request-id hash, no RNG).
+
+- **SLO digests** — TTFT / ITL / e2e / queue-wait land in mergeable
+  quantile sketches (sketch.py) twice: a cumulative digest and a
+  rolling window. Each completed window publishes
+  ``serving.slo.quantile_ms{metric=...,q=p50|p90|p99}`` gauges plus
+  ``serving.slo.tokens_per_s``; ``GenerationServer.get_stats()["slo"]``
+  snapshots all three views and ``check_slo(targets)`` turns them into
+  SRE burn rates.
+
+- **Flight recorder** — a bounded ring of the last K engine iterations
+  (scheduler decisions, slot occupancy, block-pool watermarks, per-lane
+  positions, kernel dispatch verdict). Engine faults (non-finite
+  logits, deadline storms) and GuardedTrainer NaN rollbacks dump it as
+  one ``flight-<step>.json`` artifact for postmortems
+  (docs/observability.md has the schema).
+
+Everything here is host-side bookkeeping — dict appends and float
+arithmetic, no jax — and the engine can switch the whole layer off
+(``GenerationServer(telemetry=False)``); the telemetry-on overhead is
+benched in perf/bench_telemetry.json (acceptance < 5%).
+"""
+
+import collections
+import itertools
+import json
+import math
+import os
+import threading
+import time
+import warnings
+
+from .metrics import global_registry
+from .sketch import QuantileSketch
+from .tracing import get_recorder
+
+__all__ = ["ServingTelemetry", "SLOTracker", "FlightRecorder",
+           "trace_request_mode"]
+
+
+def _help(name):
+    from . import _help as pkg_help
+    return pkg_help(name)
+
+
+# ---------------------------------------------------------------------------
+# sampling knob
+# ---------------------------------------------------------------------------
+
+def trace_request_mode(raw=None):
+    """-> (mode, rate) from PADDLE_TPU_TRACE_REQUESTS.
+
+    off | sampled:<rate in (0,1]> | all (default). Request-id sampling
+    is deterministic (splitmix-style integer hash), so a replayed
+    stream traces the same requests.
+
+    A malformed value raises ONLY when passed explicitly (programmer
+    error); a typo in the env var warns and falls back to the default —
+    an observability sampling knob must never be fatal to serving."""
+    from_env = raw is None
+    if from_env:
+        raw = os.environ.get("PADDLE_TPU_TRACE_REQUESTS", "all")
+    try:
+        return _parse_trace_request_mode(raw)
+    except ValueError:
+        if not from_env:
+            raise
+        warnings.warn(
+            f"ignoring bad PADDLE_TPU_TRACE_REQUESTS={raw!r} "
+            f"(expected off | sampled:<rate> | all); tracing all "
+            f"requests", RuntimeWarning, stacklevel=2)
+        return "all", 1.0
+
+
+def _parse_trace_request_mode(raw):
+    raw = str(raw).strip().lower()
+    if raw in ("", "all", "on", "1", "true"):
+        return "all", 1.0
+    if raw in ("off", "none", "0", "false"):
+        return "off", 0.0
+    if raw.startswith("sampled:"):
+        try:
+            rate = float(raw.split(":", 1)[1])
+        except ValueError:
+            raise ValueError(
+                f"bad PADDLE_TPU_TRACE_REQUESTS rate in {raw!r}")
+        if not 0.0 <= rate <= 1.0:
+            raise ValueError(
+                f"PADDLE_TPU_TRACE_REQUESTS rate must be in [0, 1], "
+                f"got {rate}")
+        return "sampled", rate
+    raise ValueError(
+        f"bad PADDLE_TPU_TRACE_REQUESTS {raw!r}: "
+        f"expected off | sampled:<rate> | all")
+
+
+_MASK64 = (1 << 64) - 1
+
+
+def _rid_hash01(rid):
+    """Deterministic rid -> [0, 1) (splitmix64 finalizer)."""
+    x = (int(rid) * 0x9E3779B97F4A7C15) & _MASK64
+    x ^= x >> 30
+    x = (x * 0xBF58476D1CE4E5B9) & _MASK64
+    x ^= x >> 27
+    x = (x * 0x94D049BB133111EB) & _MASK64
+    x ^= x >> 31
+    return x / 2.0 ** 64
+
+
+def _jsonable(v):
+    """Best-effort conversion of flight-entry values to JSON-safe types
+    (numpy scalars/arrays arrive from scheduler snapshots)."""
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple, set, frozenset)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, (str, bool)) or v is None:
+        return v
+    if isinstance(v, float):
+        return v if math.isfinite(v) else repr(v)
+    if isinstance(v, int):
+        return v
+    item = getattr(v, "item", None)
+    if item is not None:
+        try:
+            return _jsonable(item())
+        except Exception:
+            pass
+    tolist = getattr(v, "tolist", None)
+    if tolist is not None:
+        try:
+            return _jsonable(tolist())
+        except Exception:
+            pass
+    return repr(v)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+# Fixed schema of the engine's per-iteration hot-path entry
+# (FlightRecorder.record_iteration) and of one lane in lanes_detail
+# (ContinuousBatchingScheduler.occupancy_snapshot). The hot path stores
+# plain tuples in this field order — CPython untracks tuples of
+# scalars, so unlike per-iteration dicts they add no GC pressure next
+# to a ~0.25 ms fused step — and entries()/dump() expand them back to
+# the documented dict form.
+ITER_FIELDS = ("step_ms", "lanes", "emitting", "prefill_tokens",
+               "admitted", "retired", "queue_depth", "active_slots",
+               "blocks_free", "blocks_in_use", "watermark_blocks",
+               "lanes_detail", "kernel", "deadline_cancels")
+LANE_FIELDS = ("slot", "rid", "pos", "prefilling", "admit_seq",
+               "generated", "first_block")
+
+
+def _expand_lanes(lanes):
+    """lanes_detail tuples -> the documented list-of-dicts form."""
+    if lanes is None:
+        return None
+    return [dict(zip(LANE_FIELDS, lane)) if isinstance(lane, tuple)
+            else lane for lane in lanes]
+
+
+class FlightRecorder:
+    """Bounded ring of per-iteration engine/trainer state, dumped as one
+    JSON artifact on a fault.
+
+    Schema (``paddle_tpu.flight/1``)::
+
+        {"schema": "paddle_tpu.flight/1",
+         "reason": "non_finite_logits" | "deadline_storm" |
+                   "nonfinite_rollback" | ...,
+         "step": <step/iteration the fault fired on>,
+         "dumped_at_epoch_s": <wall clock>,
+         "capacity": K, "recorded": <entries ever recorded>,
+         "extra": {...fault detail...},
+         "entries": [{"step": ..., "kind": "iteration"|"dispatch"|...,
+                      "t_epoch_s": ..., ...recorder-specific fields...},
+                     ...]}          # oldest-first, last entry = newest
+
+    The newest entry is annotated with the fault detail before the dump,
+    so the LAST element always identifies the offending step."""
+
+    SCHEMA = "paddle_tpu.flight/1"
+
+    def __init__(self, capacity=256, out_dir=None):
+        self.capacity = max(1, int(capacity))
+        self.out_dir = out_dir if out_dir is not None else \
+            os.environ.get("PADDLE_TPU_FLIGHT_DIR", ".")
+        self._entries = collections.deque(maxlen=self.capacity)
+        self._recorded = 0
+        self._lock = threading.Lock()
+        self.dump_paths = []
+        self._dumps = global_registry().counter(
+            "flight.dumps", _help("flight.dumps"))
+
+    def record(self, step, kind="iteration", **fields):
+        self.record_fields(step, fields, kind)
+
+    def record_fields(self, step, fields, kind="iteration"):
+        """record() without the kwargs repack: `fields` is adopted as
+        the entry (mutated in place — pass a dict the caller is done
+        with). The engine records an entry every iteration next to a
+        ~0.25 ms fused step, where rebuilding an ~18-key dict per call
+        is a measurable slice of the <5% telemetry-overhead budget."""
+        fields["step"] = int(step)
+        fields["kind"] = kind
+        fields["t_epoch_s"] = round(time.time(), 6)
+        with self._lock:
+            self._entries.append(fields)
+            self._recorded += 1
+
+    def record_iteration(self, step, values):
+        """The engine's per-iteration hot path: `values` is a tuple in
+        ITER_FIELDS order (no dicts — a tuple of scalars is untracked
+        by the GC, so the ring's constant churn next to a ~0.25 ms
+        fused step stops promoting garbage into the older GC
+        generations). Expanded back to the documented dict form by
+        entries()/dump()/annotate_last()."""
+        with self._lock:
+            self._entries.append((int(step), round(time.time(), 6),
+                                  values))
+            self._recorded += 1
+
+    @staticmethod
+    def _expand(entry):
+        """Ring entry (hot-path tuple OR dict) -> the documented dict
+        form, lanes_detail normalized to list-of-dicts."""
+        if isinstance(entry, tuple):
+            step, t, values = entry
+            out = {"step": step, "kind": "iteration", "t_epoch_s": t}
+            out.update(zip(ITER_FIELDS, values))
+        else:
+            out = dict(entry)
+        if "lanes_detail" in out:
+            out["lanes_detail"] = _expand_lanes(out["lanes_detail"])
+        return out
+
+    def annotate_last(self, **fields):
+        """Attach fault detail to the newest entry (so the dump's last
+        element identifies the offending iteration)."""
+        with self._lock:
+            if not self._entries:
+                return
+            last = self._expand(self._entries[-1])
+            last.update(fields)
+            self._entries[-1] = last
+
+    def entries(self):
+        with self._lock:
+            return [self._expand(e) for e in self._entries]
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    def dump(self, reason, step=None, extra=None, path=None):
+        """Write flight-<step>.json into out_dir; returns the path."""
+        with self._lock:
+            entries = [self._expand(e) for e in self._entries]
+            recorded = self._recorded
+        if step is None:
+            step = entries[-1]["step"] if entries else 0
+        if path is None:
+            os.makedirs(self.out_dir, exist_ok=True)
+            path = os.path.join(self.out_dir, f"flight-{int(step):08d}.json")
+            # repeated faults at one step (e.g. GuardedTrainer retries of
+            # a deterministic NaN) must not overwrite earlier postmortems
+            n = 1
+            while os.path.exists(path):
+                path = os.path.join(
+                    self.out_dir, f"flight-{int(step):08d}-r{n}.json")
+                n += 1
+        payload = {"schema": self.SCHEMA, "reason": reason,
+                   "step": int(step),
+                   "dumped_at_epoch_s": round(time.time(), 6),
+                   "capacity": self.capacity, "recorded": recorded,
+                   "extra": _jsonable(extra or {}),
+                   "entries": _jsonable(entries)}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+        self.dump_paths.append(path)
+        self._dumps.inc()
+        return path
+
+
+# ---------------------------------------------------------------------------
+# SLO digests
+# ---------------------------------------------------------------------------
+
+SLO_METRICS = ("ttft_ms", "itl_ms", "e2e_ms", "queue_wait_ms")
+_QUANTS = ((0.5, "p50"), (0.9, "p90"), (0.99, "p99"))
+
+
+def _parse_qtag(tag):
+    """'p99' -> 0.99, 'p99.9' -> 0.999."""
+    t = str(tag).strip().lower()
+    if not t.startswith("p"):
+        raise ValueError(f"bad quantile tag {tag!r} (want e.g. 'p99')")
+    pct = float(t[1:])
+    if not 0.0 < pct < 100.0:
+        raise ValueError(f"quantile tag {tag!r} out of (0, 100)")
+    return pct / 100.0
+
+
+_TRACKER_SEQ = itertools.count()
+
+
+class SLOTracker:
+    """Cumulative + rolling-window quantile digests for the serving
+    latency metrics, published as gauges on each completed window.
+
+    Gauge series carry a per-tracker label (default
+    ``{"server": "srv<N>"}``) — two live GenerationServers in one
+    process must not clobber each other's window quantiles (the
+    Executor's per-instance gauge-label convention). drop_gauges()
+    removes this tracker's series from the process-wide registry."""
+
+    def __init__(self, clock=time.monotonic, window_s=60.0,
+                 compression=128, labels=None):
+        self._clock = clock
+        self.window_s = float(window_s)
+        self._compression = int(compression)
+        self.labels = dict(labels) if labels else \
+            {"server": f"srv{next(_TRACKER_SEQ)}"}
+        self._published = set()     # label tuples set on _g_quant
+        self._lock = threading.Lock()
+        self._cum = {m: QuantileSketch(compression) for m in SLO_METRICS}
+        self._win = {m: QuantileSketch(compression) for m in SLO_METRICS}
+        self._win_start = clock()
+        self._started = self._win_start
+        self._win_tokens = 0
+        self._cum_tokens = 0
+        self._last_window = None
+        self.windows_completed = 0
+        reg = global_registry()
+        self._g_quant = reg.gauge("serving.slo.quantile_ms",
+                                  _help("serving.slo.quantile_ms"))
+        self._g_tps = reg.gauge("serving.slo.tokens_per_s",
+                                _help("serving.slo.tokens_per_s"))
+        self._c_windows = reg.counter("serving.slo.windows",
+                                      _help("serving.slo.windows"))
+
+    def observe(self, metric, value_ms):
+        with self._lock:
+            self._cum[metric].add(value_ms)
+            self._win[metric].add(value_ms)
+
+    def count_tokens(self, n=1):
+        with self._lock:
+            self._win_tokens += n
+            self._cum_tokens += n
+
+    def observe_token(self, metric, value_ms):
+        """observe(metric) + count_tokens(1) under ONE lock, via the
+        sketches' validation-free add_unit — the per-token hot path
+        runs this for every generated token next to a ~0.25 ms CPU
+        fused step, where each microsecond is ~0.4% of the <5%
+        telemetry-overhead budget. value_ms is always an
+        engine-computed finite float (add_unit's contract)."""
+        v = float(value_ms)
+        with self._lock:
+            self._cum[metric].add_unit(v)
+            self._win[metric].add_unit(v)
+            self._win_tokens += 1
+            self._cum_tokens += 1
+
+    def digest(self, metric):
+        """A COPY of the cumulative sketch for `metric` (mergeable
+        across servers/processes via QuantileSketch.merge). A copy, not
+        the live object: quantile()/rank() compress in place, and the
+        engine worker concurrently observe()s into the original — the
+        caller gets a consistent snapshot instead of a race."""
+        with self._lock:
+            return QuantileSketch.from_dict(self._cum[metric].to_dict())
+
+    def maybe_roll(self):
+        """Close the window if window_s elapsed; publish its quantile
+        gauges. Returns True when a window completed."""
+        now = self._clock()
+        # lock-free fast path: _win_start is a float read; the worst a
+        # racing roll can do is make this read stale and the window
+        # close one engine iteration later — this runs every iteration
+        if now - self._win_start < self.window_s:
+            return False
+        with self._lock:
+            elapsed = now - self._win_start
+            if elapsed < self.window_s:
+                return False
+            summary = {}
+            for m in SLO_METRICS:
+                d = self._win[m]
+                if d.count:
+                    summary[m] = d.summary()
+                    for q, tag in _QUANTS:
+                        lbl = dict(self.labels, metric=m[:-3], q=tag)
+                        self._g_quant.labels(**lbl).set(
+                            round(d.quantile(q), 3))
+                        self._published.add(tuple(sorted(lbl.items())))
+            tps = self._win_tokens / max(elapsed, 1e-9)
+            summary["tokens_per_s"] = round(tps, 3)
+            summary["elapsed_s"] = round(elapsed, 6)
+            summary["tokens"] = self._win_tokens
+            self._g_tps.labels(**self.labels).set(round(tps, 3))
+            self._last_window = summary
+            self._win = {m: QuantileSketch(self._compression)
+                         for m in SLO_METRICS}
+            self._win_tokens = 0
+            self._win_start = now
+            self.windows_completed += 1
+            self._c_windows.inc()
+            return True
+
+    def drop_gauges(self):
+        """Remove this tracker's gauge series from the process-wide
+        registry — a closed server must not report stale window
+        quantiles forever (ComponentStats.drop_gauges convention)."""
+        with self._lock:
+            for lbl in self._published:
+                self._g_quant.remove(**dict(lbl))
+            self._published.clear()
+            self._g_tps.remove(**self.labels)
+
+    def snapshot(self):
+        with self._lock:
+            now = self._clock()
+            cum_elapsed = max(now - self._started, 1e-9)
+            return {
+                "window_s": self.window_s,
+                "windows_completed": self.windows_completed,
+                "cumulative": {
+                    **{m: self._cum[m].summary() for m in SLO_METRICS
+                       if self._cum[m].count},
+                    "tokens": self._cum_tokens,
+                    "tokens_per_s": round(self._cum_tokens / cum_elapsed,
+                                          3),
+                },
+                "last_window": self._last_window,
+                "current_window": {
+                    **{m: self._win[m].summary() for m in SLO_METRICS
+                       if self._win[m].count},
+                    "tokens": self._win_tokens,
+                    "elapsed_s": round(now - self._win_start, 6),
+                },
+            }
+
+    def check_slo(self, targets):
+        """targets: {"ttft_ms": {"p99": 200.0}, "itl_ms": {"p50": 20}}.
+
+        For each (metric, quantile, target) computes, over the
+        CUMULATIVE digest: the observed quantile, whether it meets the
+        target, the fraction of mass over the target, and the SRE burn
+        rate = frac_over / error_budget (budget = 1 - q; burn 1.0 means
+        exactly spending the budget, > 1 means burning it down)."""
+        checks = []
+        ok = True
+        for metric, qmap in targets.items():
+            if metric not in self._cum:
+                raise ValueError(
+                    f"unknown SLO metric {metric!r} "
+                    f"(know: {SLO_METRICS})")
+            # digest() snapshots under the lock: quantile()/rank()
+            # compress in place and must not race the worker's observe()
+            d = self.digest(metric)
+            for tag, target in qmap.items():
+                q = _parse_qtag(tag)
+                observed = d.quantile(q)
+                if observed is None:
+                    checks.append({"metric": metric, "quantile": tag,
+                                   "target_ms": float(target),
+                                   "observed_ms": None, "met": None,
+                                   "frac_over": None, "burn_rate": None})
+                    continue
+                frac_over = 1.0 - d.rank(float(target))
+                budget = 1.0 - q
+                burn = frac_over / budget if budget > 0 else None
+                met = observed <= float(target)
+                ok = ok and met
+                checks.append({"metric": metric, "quantile": tag,
+                               "target_ms": float(target),
+                               "observed_ms": round(observed, 3),
+                               "met": met,
+                               "frac_over": round(frac_over, 6),
+                               "burn_rate": round(burn, 4)
+                               if burn is not None else None})
+        return {"ok": ok, "checks": checks}
+
+
+# ---------------------------------------------------------------------------
+# per-request lifecycle state
+# ---------------------------------------------------------------------------
+
+class _ReqTrace:
+    __slots__ = ("rid", "sampled", "submit_perf", "admit_perf",
+                 "admit_iteration", "slot", "chunks", "first_token_perf",
+                 "first_token_iteration", "last_token_perf", "tokens")
+
+    def __init__(self, rid, sampled, submit_perf):
+        self.rid = rid
+        self.sampled = sampled
+        self.submit_perf = submit_perf
+        self.admit_perf = None
+        self.admit_iteration = None
+        self.slot = None
+        self.chunks = []            # [iteration, ntokens, t_start]
+        self.first_token_perf = None
+        self.first_token_iteration = None
+        self.last_token_perf = None
+        self.tokens = 0
+
+
+class ServingTelemetry:
+    """The engine/scheduler-facing facade: lifecycle hooks + SLO
+    tracker + flight recorder. All hooks are cheap host bookkeeping and
+    safe to call under the scheduler lock; span trees are emitted only
+    at request end (and only while a trace capture is live)."""
+
+    def __init__(self, clock=None, window_s=60.0, sample=None,
+                 flight_capacity=256, flight_dir=None, deadline_storm=3,
+                 compression=128, recorder=None):
+        self.mode, self.sample_rate = trace_request_mode(sample)
+        self.slo = SLOTracker(clock=clock or time.monotonic,
+                              window_s=window_s, compression=compression)
+        self.flight = FlightRecorder(capacity=flight_capacity,
+                                     out_dir=flight_dir)
+        self.deadline_storm = max(1, int(deadline_storm))
+        self._rec = recorder or get_recorder()
+        self._req = {}
+        self._lock = threading.Lock()
+        self._iter_deadline_cancels = 0
+        self._storm_latched = False
+        self._traced_local = 0      # THIS instance's emitted trees (the
+        #                             registry counter aggregates
+        #                             process-wide across servers)
+        reg = global_registry()
+        self._m_queue_wait = reg.histogram(
+            "serving.queue_wait_ms", _help("serving.queue_wait_ms"))
+        self._m_e2e = reg.histogram("serving.e2e_ms",
+                                    _help("serving.e2e_ms"))
+        self._m_traced = reg.counter("serving.requests_traced",
+                                     _help("serving.requests_traced"))
+        self._m_faults = reg.counter("serving.faults",
+                                     _help("serving.faults"))
+
+    # -- sampling ----------------------------------------------------------
+    def sampled(self, rid):
+        if self.mode == "all":
+            return True
+        if self.mode == "off":
+            return False
+        return _rid_hash01(rid) < self.sample_rate
+
+    # -- request lifecycle hooks (scheduler/engine) ------------------------
+    def on_submit(self, rid):
+        with self._lock:
+            self._req[rid] = _ReqTrace(rid, self.sampled(rid),
+                                       time.perf_counter())
+
+    def on_admit(self, rid, slot, iteration, queue_wait_ms):
+        self._m_queue_wait.observe(queue_wait_ms)
+        self.slo.observe("queue_wait_ms", queue_wait_ms)
+        with self._lock:
+            st = self._req.get(rid)
+            if st is None:
+                return
+            st.admit_perf = time.perf_counter()
+            st.admit_iteration = iteration
+            st.slot = slot
+
+    def on_prefill_chunk(self, rid, iteration, ntokens):
+        # lock-free: dict.get is GIL-atomic and every mutation of an
+        # existing _ReqTrace happens on the engine thread (on_submit
+        # inserts the rid from the client thread BEFORE it is enqueued,
+        # so the engine can never see a half-built entry)
+        st = self._req.get(rid)
+        if st is None:
+            return
+        st.chunks.append([iteration, int(ntokens), time.perf_counter()])
+
+    def on_first_token(self, rid, iteration, ttft_ms):
+        self.slo.observe_token("ttft_ms", ttft_ms)
+        st = self._req.get(rid)     # lock-free: see on_prefill_chunk
+        if st is None:
+            return
+        st.first_token_perf = st.last_token_perf = time.perf_counter()
+        st.first_token_iteration = iteration
+        st.tokens += 1
+
+    def on_token(self, rid, iteration, itl_ms):
+        self.slo.observe_token("itl_ms", itl_ms)
+        st = self._req.get(rid)     # lock-free: see on_prefill_chunk
+        if st is not None:
+            st.last_token_perf = time.perf_counter()
+            st.tokens += 1
+
+    def on_deadline_cancel(self, rid, iteration):
+        # no lock: iteration-scoped counter, engine-thread only (reset
+        # in begin_iteration, incremented from _fail during the same
+        # thread's plan(), read in end_iteration)
+        self._iter_deadline_cancels += 1
+
+    def on_finish(self, rid, iteration, outcome, reason=None, e2e_ms=None,
+                  prompt_len=None, generated=None):
+        """outcome: 'retire' | 'cancel' | 'deadline'. Emits the span
+        tree for sampled requests and drops the per-request state."""
+        if outcome == "retire" and e2e_ms is not None:
+            self._m_e2e.observe(e2e_ms)
+            self.slo.observe("e2e_ms", e2e_ms)
+        with self._lock:
+            st = self._req.pop(rid, None)
+        if st is None or not st.sampled or not self._rec.enabled:
+            return
+        self._emit_tree(st, iteration, outcome, reason, prompt_len,
+                        generated)
+        self._m_traced.inc()
+        with self._lock:
+            self._traced_local += 1
+
+    # -- span-tree emission ------------------------------------------------
+    def _emit_tree(self, st, end_iteration, outcome, reason, prompt_len,
+                   generated):
+        rec = self._rec
+        end = time.perf_counter()
+        track = (f"serving slot {st.slot}" if st.slot is not None
+                 else "serving queue")
+        root_args = {"rid": st.rid, "outcome": outcome,
+                     "finish_reason": reason,
+                     "prompt_len": prompt_len, "generated": generated,
+                     "admit_iteration": st.admit_iteration,
+                     "end_iteration": end_iteration,
+                     "slot": st.slot}
+        rec.complete(f"request {st.rid}", st.submit_perf, end,
+                     cat="serving.request", args=root_args, track=track)
+        queue_end = st.admit_perf if st.admit_perf is not None else end
+        rec.complete("queue", st.submit_perf, queue_end,
+                     cat="serving.request", args={"rid": st.rid},
+                     track=track)
+        # prefill chunks: each closes where the next one opens; the last
+        # closes at the first token (or the end, if cut short)
+        for i, (it, ntok, t0) in enumerate(st.chunks):
+            if i + 1 < len(st.chunks):
+                t1 = st.chunks[i + 1][2]
+            elif st.first_token_perf is not None:
+                t1 = st.first_token_perf
+            else:
+                t1 = end
+            rec.complete("prefill.chunk", t0, t1, cat="serving.request",
+                         args={"rid": st.rid, "iteration": it,
+                               "tokens": ntok}, track=track)
+        if st.first_token_perf is not None:
+            rec.complete(
+                "decode", st.first_token_perf,
+                st.last_token_perf or end, cat="serving.request",
+                args={"rid": st.rid, "tokens": st.tokens,
+                      "first_token_iteration": st.first_token_iteration},
+                track=track)
+        rec.instant(outcome, cat="serving.request",
+                    args={"rid": st.rid, "iteration": end_iteration},
+                    ts=end, track=track)
+
+    # -- engine iteration bracketing --------------------------------------
+    def begin_iteration(self, iteration):
+        self._iter_deadline_cancels = 0     # engine-thread only
+
+    def end_iteration(self, iteration, values=None, **flight_fields):
+        """Record the iteration into the flight ring, roll the SLO
+        window, and detect deadline storms. Returns a flight-dump path
+        when a storm fired (None otherwise).
+
+        Hot path: `values` is a tuple of the first len(ITER_FIELDS)-1
+        fields in ITER_FIELDS order (deadline_cancels is appended
+        here) — no per-iteration dicts. Keyword fields are the
+        readable fallback for cold callers."""
+        cancels = self._iter_deadline_cancels
+        if values is not None:
+            self.flight.record_iteration(iteration, values + (cancels,))
+        else:
+            flight_fields["deadline_cancels"] = cancels
+            # the **flight_fields kwargs dict is fresh per call; adopt
+            # it as the flight entry instead of repacking it
+            self.flight.record_fields(iteration, flight_fields)
+        self.slo.maybe_roll()
+        if cancels >= self.deadline_storm:
+            if self._storm_latched:
+                return None
+            self._storm_latched = True
+            return self.fault(iteration, "deadline_storm",
+                              {"deadline_cancels": cancels,
+                               "threshold": self.deadline_storm})
+        self._storm_latched = False
+        return None
+
+    def fault(self, step, kind, detail=None):
+        """Mark the newest flight entry with the fault and dump the
+        ring. Returns the dump path."""
+        self._m_faults.inc()
+        self.flight.annotate_last(fault={"kind": kind,
+                                         "detail": _jsonable(detail or {})})
+        return self.flight.dump(kind, step=step, extra=detail)
+
+    # -- introspection -----------------------------------------------------
+    def stats(self):
+        out = self.slo.snapshot()
+        out["server"] = self.slo.labels.get("server")
+        out["trace_requests"] = {
+            "mode": self.mode, "rate": self.sample_rate,
+            "traced": self._traced_local}
+        out["flight"] = {"capacity": self.flight.capacity,
+                         "entries": len(self.flight),
+                         "dumps": list(self.flight.dump_paths)}
+        return out
+
+    def check_slo(self, targets):
+        return self.slo.check_slo(targets)
+
+    def close(self):
+        """Retire this instance's gauge series (called by the engine's
+        close(); counters/histograms aggregate globally and stay)."""
+        self.slo.drop_gauges()

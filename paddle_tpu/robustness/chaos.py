@@ -34,10 +34,12 @@ class ChaosInjector:
         self._fail_writes = set()    # 1-based physical-write ordinals
         self._write_count = 0
         self.fired = {"poison": 0, "sigterm": 0, "write_fault": 0,
-                      "cancel": 0, "clock_advance": 0}
+                      "cancel": 0, "clock_advance": 0,
+                      "serving_poison": 0}
         self._installed = False
         # serving-engine plan: iteration -> actions (scheduler hooks)
         self._serving_cancels = {}   # iteration -> [active-request index]
+        self._serving_poisons = {}   # iteration -> KV layer to NaN
         self._clock_advances = {}    # iteration -> seconds to advance
         self._fake_now_s = 0.0
         self._drives_clock = False
@@ -134,6 +136,29 @@ class ChaosInjector:
         idxs = self._serving_cancels.pop(int(iteration), [])
         self.fired["cancel"] += len(idxs)
         return idxs
+
+    def poison_serving_at(self, iteration, layer=0):
+        """NaN the first live KV block of the oldest active lane just
+        before engine iteration `iteration` runs its fused step. The
+        NaN flows through REAL attention arithmetic into that lane's
+        logits, so the engine's non-finite guard trips on genuine
+        propagation (flight-recorder dump + NonFiniteError), not a
+        mocked output."""
+        self._serving_poisons[int(iteration)] = int(layer)
+        return self
+
+    def serving_poison_at(self, iteration):
+        """-> the KV layer to poison at this iteration, or None.
+        Consumed by GenerationServer.step(). `fired` counts only when
+        the engine reports the poison APPLIED (serving_poison_applied)
+        — a step with no lane past position 0 defers to the next
+        iteration instead of silently no-op'ing (a pos-0 lane's block
+        is fully overwritten by its own prefill write, so the NaN
+        could never propagate)."""
+        return self._serving_poisons.pop(int(iteration), None)
+
+    def serving_poison_applied(self):
+        self.fired["serving_poison"] += 1
 
     # -- trainer hooks -------------------------------------------------
     def should_preempt(self, step):

@@ -91,6 +91,7 @@ class TrainResult:
         self.preempted = False
         self.emergency_dir = None
         self.checkpoints_written = 0
+        self.flight_dumps = []       # flight-<step>.json paths written
 
     def __repr__(self):
         return (f"TrainResult(steps={self.steps}, "
@@ -109,7 +110,8 @@ class GuardedTrainer:
     def __init__(self, executor, program, fetch_list=None, scope=None,
                  checkpoint_dir=None, manager=None, checkpoint_every=100,
                  policy=None, chaos=None, preemption=None, window=None,
-                 result_callback=None, final_checkpoint=True):
+                 result_callback=None, final_checkpoint=True,
+                 flight=True):
         from ..core.executor import global_scope
         if manager is None:
             if checkpoint_dir is None:
@@ -136,6 +138,18 @@ class GuardedTrainer:
         self._step = 0              # committed+resolved optimizer steps
         self._resumed_from = None   # dir resume() restored, if any
         self._stats = ComponentStats()
+        # fault flight recorder (observability/serving_telemetry.py):
+        # a small ring of recent dispatch/resolve events, dumped as
+        # flight-<step>.json into the checkpoint root when the NaN/Inf
+        # sentinel trips — the postmortem answers "what was in flight
+        # when it went bad" without re-running. flight=False disables;
+        # a FlightRecorder instance redirects (e.g. a shared ring).
+        if flight is True:
+            from ..observability.serving_telemetry import FlightRecorder
+            flight = FlightRecorder(capacity=64, out_dir=self.manager.root)
+        elif flight is False:
+            flight = None
+        self.flight = flight
         if getattr(executor, "_guard", None) is None:
             warnings.warn(
                 "GuardedTrainer wraps an executor without the NaN/Inf "
@@ -214,6 +228,10 @@ class GuardedTrainer:
                 rollback(e, idx)
                 return
             self._step = idx + 1
+            if self.flight is not None:
+                self.flight.record(idx, kind="resolve",
+                                   committed_step=self._step,
+                                   inflight=len(pending))
             if self.result_callback is not None:
                 self.result_callback(idx, out)
 
@@ -221,6 +239,24 @@ class GuardedTrainer:
             nonlocal segment_rollbacks, dispatch_idx, target, last_ckpt
             res.faults.append(fault)
             segment_rollbacks += 1
+            if self.flight is not None:
+                # dump BEFORE restore mutates anything: the ring's last
+                # entry is the fault itself, identifying the offending
+                # step, the segment base, and the in-flight window
+                self.flight.record(
+                    fault_idx, kind="fault", var=fault.var,
+                    fault_step=fault.step, bad_vars=list(fault.bad_vars),
+                    segment_base=last_ckpt,
+                    rollbacks_this_segment=segment_rollbacks,
+                    inflight=len(pending))
+                res.flight_dumps.append(self.flight.dump(
+                    "nonfinite_rollback", step=fault_idx,
+                    extra={"var": fault.var, "step": fault.step,
+                           "bad_vars": list(fault.bad_vars),
+                           "segment_base": last_ckpt,
+                           "rollbacks_this_segment": segment_rollbacks,
+                           "will_surface": segment_rollbacks
+                           > self.policy.max_retries}))
             # later in-flight steps ran on poisoned state: retire them
             while pending:
                 _i, h = pending.popleft()
@@ -307,6 +343,7 @@ class GuardedTrainer:
                 break
             # fill the window up to the segment boundary
             while len(pending) < self.window:
+                was_replay = bool(replay)
                 nidx = replay[0] if replay else dispatch_idx
                 if self.chaos is not None \
                         and self.chaos.should_preempt(nidx):
@@ -323,6 +360,11 @@ class GuardedTrainer:
                                        scope=self.scope,
                                        window=self.window)
                 pending.append((idx, h))
+                if self.flight is not None:
+                    self.flight.record(idx, kind="dispatch",
+                                       inflight=len(pending),
+                                       segment_base=last_ckpt,
+                                       replay=was_replay)
             if preempt:
                 continue
             if pending:

@@ -17,6 +17,7 @@ params (same math as gpt.build_kv_step, vectorized over the chunk
 axis, KV routed through serving.kv_cache.paged_attention/write).
 """
 
+import math
 import threading
 import time
 from concurrent.futures import Future
@@ -145,7 +146,9 @@ class GenerationServer:
 
     def __init__(self, model, *, num_slots=4, block_size=16,
                  num_blocks=None, max_context=None, chunk=4, clock=None,
-                 watermark_blocks=0, chaos=None, start=True):
+                 watermark_blocks=0, chaos=None, start=True,
+                 telemetry=True, slo_window_s=60.0, flight_dir=None,
+                 flight_capacity=256, deadline_storm=3):
         self.model = model
         self.block_size = int(block_size)
         max_context = int(max_context or model.max_position)
@@ -163,10 +166,28 @@ class GenerationServer:
         if chaos is not None and clock is None and \
                 getattr(chaos, "drives_clock", lambda: False)():
             clock = chaos.serving_clock
+        # request-level telemetry (observability/serving_telemetry.py):
+        # lifecycle span trees, SLO digests, and the fault flight
+        # recorder. telemetry=False runs the bare PR-6 engine (the
+        # bench's baseline); an explicit ServingTelemetry instance lets
+        # tests inject clocks/sampling without env vars.
+        if telemetry is True:
+            from ..observability.serving_telemetry import ServingTelemetry
+            telemetry = ServingTelemetry(
+                clock=clock, window_s=slo_window_s,
+                flight_dir=flight_dir, flight_capacity=flight_capacity,
+                deadline_storm=deadline_storm)
+        elif telemetry is False:
+            telemetry = None
+        self._tel = telemetry
+        self._chaos = chaos
+        self._fault = None          # first engine fault (NonFiniteError)
+        self._exporter = None
         self._sched = ContinuousBatchingScheduler(
             self.cache, num_slots=num_slots, chunk=chunk,
             max_context=max_context, clock=clock,
-            watermark_blocks=watermark_blocks, chaos=chaos)
+            watermark_blocks=watermark_blocks, chaos=chaos,
+            telemetry=telemetry)
         self.max_context = max_context
         self._fused = jax.jit(model.build_fused_step(self.block_size))
         self._signatures = set()
@@ -236,6 +257,10 @@ class GenerationServer:
                 raise RuntimeError("GenerationServer is closed")
             rid = self._next_rid
             self._next_rid += 1
+        if self._tel is not None:
+            # before enqueue: the worker thread may admit the request
+            # the instant it lands, and on_admit needs the submit stamp
+            self._tel.on_submit(rid)
         fut = GenerationFuture(self, rid)
         deadline = None
         if deadline_ms is not None:
@@ -244,6 +269,18 @@ class GenerationServer:
                        priority, deadline, stream, fut,
                        self._sched.now())
         self._sched.enqueue(req)
+        with self._rid_lock:
+            raced_closed = self._closed
+        if raced_closed:
+            # lost the race with close()/_on_engine_fault: their
+            # cancel_all queue sweep may have run before this enqueue
+            # landed, which would leave the request (and its future)
+            # orphaned with no worker to plan it. Pull it back out and
+            # behave exactly as if the closed-check above had caught us.
+            self._sched.drop_queued_request(
+                rid, self._fault or
+                RequestCancelled("GenerationServer is closed"))
+            raise RuntimeError("GenerationServer is closed")
         self._m["requests"].inc()
         with self._cv:
             self._cv.notify()
@@ -263,16 +300,67 @@ class GenerationServer:
         True if any lane did work. Public so tests (and the bench) can
         pump the engine deterministically without the worker thread."""
         with self._step_lock:
+            tel = self._tel
+            if tel is not None:
+                # before plan(): the iteration's deadline cancels fire
+                # inside plan and must land on THIS iteration's flight
+                # entry (plan() increments the counter if non-idle)
+                tel.begin_iteration(self._sched.iteration + 1)
+            admitted0 = self._sched.counts["admitted"]
+            it0 = self._sched.iteration
             plan = self._sched.plan()
             self._publish_gauges()
             if plan is None:
+                it = self._sched.iteration
+                if self._chaos is not None and it > it0:
+                    # a poison keyed to a cancel/deadline-only
+                    # iteration (counted, but no lane ran) would be
+                    # popped by no one and silently lost — re-key it to
+                    # the next iteration instead
+                    poison_layer = self._chaos.serving_poison_at(it)
+                    if poison_layer is not None:
+                        self._chaos.poison_serving_at(it + 1,
+                                                      poison_layer)
+                if tel is not None and it > it0:
+                    # a cancel/deadline-only iteration (counted by the
+                    # scheduler, but no lane ran): the flight ring and
+                    # the deadline-storm detector must still see it
+                    tel.end_iteration(
+                        it, step_ms=0.0, lanes=[], emitting=[],
+                        prefill_tokens=0,
+                        admitted=self._sched.counts["admitted"]
+                        - admitted0,
+                        retired=[],
+                        queue_depth=self._sched.queue_depth,
+                        active_slots=self._sched.active_count,
+                        blocks_free=self.cache.num_free,
+                        blocks_in_use=self.cache.num_used,
+                        watermark_blocks=self._sched.watermark_blocks,
+                        lanes_detail=[],
+                        kernel={"mode": self._kernel_mode,
+                                "engaged": self._kernel_engaged})
                 return False
+            it = self._sched.iteration
+            # pre-step occupancy rides the plan (built inside plan()'s
+            # slot loop — no second scheduler-lock round-trip)
+            lanes = plan.lanes_detail
             rec = get_recorder()
             t0 = time.perf_counter()
             with rec.span("serving.iteration", cat="serving",
-                          args={"iteration": self._sched.iteration,
+                          args={"iteration": it,
                                 "lanes": len(plan.slot_ids),
                                 "prefill_tokens": plan.prefill_tokens}):
+                if self._chaos is not None:
+                    poison_layer = self._chaos.serving_poison_at(it)
+                    if poison_layer is not None:
+                        if self._poison_kv(poison_layer, lanes):
+                            self._chaos.serving_poison_applied()
+                        else:
+                            # no lane past pos 0 yet: its block would be
+                            # fully overwritten by its own prefill write
+                            # this iteration — defer, don't no-op
+                            self._chaos.poison_serving_at(
+                                it + 1, poison_layer)
                 args = (jnp.asarray(plan.tokens),
                         jnp.asarray(plan.positions),
                         jnp.asarray(plan.valid),
@@ -302,11 +390,113 @@ class GenerationServer:
                                                     *args)
                 self.cache.pools = pools
                 nxt, logps = np.asarray(nxt), np.asarray(logps)
-            self._sched.commit(plan, nxt, logps)
+            # non-finite logits guard: one reduce on the hot path (a
+            # NaN/Inf anywhere makes the sum non-finite; idle lanes
+            # hold finite garbage); the per-slot triage only runs on a
+            # trip, BEFORE commit() streams garbage tokens to clients.
+            # math.isfinite on the extracted scalar beats np.isfinite's
+            # ufunc dispatch on this every-iteration path. The
+            # fail-stop is a safety feature and runs regardless of
+            # telemetry — only the flight-recorder dump needs it
+            if plan.slot_ids and not math.isfinite(logps.sum()):
+                if not np.all(np.isfinite(logps[plan.slot_ids])):
+                    self._on_engine_fault(plan, it, logps, lanes)
+            retired = self._sched.commit(plan, nxt, logps)
             self._m["iterations"].inc()
-            self._m["step_ms"].observe((time.perf_counter() - t0) * 1e3)
+            step_ms = (time.perf_counter() - t0) * 1e3
+            self._m["step_ms"].observe(step_ms)
             self._publish_gauges()
+            if tel is not None:
+                st = self._sched
+                # hot path: one ITER_FIELDS-order tuple per iteration
+                # (tuples of scalars are GC-untracked; per-iteration
+                # dicts next to a ~0.25 ms fused step kept promoting
+                # ring garbage into the older GC generations)
+                tel.end_iteration(it, (
+                    round(step_ms, 3),              # step_ms
+                    tuple(plan.slot_ids),           # lanes
+                    tuple(plan.emitting),           # emitting
+                    plan.prefill_tokens,
+                    st.counts["admitted"] - admitted0,
+                    tuple(r.request_id for r in retired),
+                    plan.queue_depth,
+                    len(plan.slot_ids),             # active_slots
+                    self.cache.num_free,            # blocks_free
+                    self.cache.num_used,            # blocks_in_use
+                    st.watermark_blocks,
+                    lanes,                          # lanes_detail
+                    self._kernel_info()))
             return True
+
+    def _kernel_info(self):
+        # constant after the first step: built once, reused by every
+        # flight entry instead of a fresh dict per iteration
+        info = self.__dict__.get("_kernel_info_cache")
+        if info is None or info["engaged"] is None:
+            info = {"mode": self._kernel_mode,
+                    "engaged": self._kernel_engaged}
+            self._kernel_info_cache = info
+        return info
+
+    def _poison_kv(self, layer, lanes):
+        """Chaos hook: NaN the first KV block of the oldest ACTIVE lane
+        that has advanced past position 0 (its block 0 is attended by
+        every later position, so the NaN propagates through real
+        attention arithmetic into that lane's logits this iteration).
+        Returns False when no lane qualifies — the caller defers."""
+        lanes = lanes if lanes is not None else \
+            self._sched.lane_snapshot()
+        # lanes are LANE_FIELDS-order tuples:
+        # (slot, rid, pos, prefilling, admit_seq, generated, first_block)
+        victims = sorted((l for l in lanes if l[2] >= 1),
+                         key=lambda l: l[4])
+        if not victims:
+            return False
+        block = victims[0][6]
+        pool = self.cache.pools[layer]
+        pool["k"] = pool["k"].at[block].set(jnp.nan)
+        return True
+
+    def _on_engine_fault(self, plan, iteration, logps, lanes):
+        """A fused step produced non-finite logits on a live lane: dump
+        the flight recorder (its LAST entry is this iteration, fault-
+        annotated), fail every outstanding request, close the server,
+        and raise a structured NonFiniteError. A poisoned pool is
+        unrecoverable — every later step reads the bad blocks — so
+        fail-stop + postmortem artifact beats serving garbage."""
+        from ..robustness.guard import NonFiniteError
+        bad = [int(s) for s in plan.slot_ids
+               if not np.isfinite(logps[s])]
+        if lanes is None:       # telemetry off: plan carries no lane
+            lanes = self._sched.lane_snapshot()     # detail — cold path
+        # lanes are LANE_FIELDS-order tuples: l[0]=slot, l[1]=rid
+        by_slot = {l[0]: l for l in (lanes or ())}
+        bad_rids = [by_slot[s][1] for s in bad if s in by_slot]
+        tel = self._tel
+        dump = None
+        if tel is not None:     # postmortem artifact wants telemetry;
+            #                     the fail-stop itself does not
+            tel.flight.record(
+                iteration, kind="iteration", aborted=True,
+                lanes=list(plan.slot_ids),
+                emitting=sorted(plan.emitting),
+                prefill_tokens=plan.prefill_tokens, lanes_detail=lanes,
+                blocks_free=self.cache.num_free,
+                blocks_in_use=self.cache.num_used,
+                kernel={"mode": self._kernel_mode,
+                        "engaged": self._kernel_engaged})
+            dump = tel.fault(iteration, "non_finite_logits",
+                             {"bad_slots": bad, "bad_rids": bad_rids,
+                              "iteration": iteration})
+        err = NonFiniteError(
+            f"serving.logits[slot {bad[0]}]", iteration,
+            [f"serving.logits[slot {s}]" for s in bad])
+        err.flight_dump = dump
+        self._fault = err
+        with self._rid_lock:
+            self._closed = True
+        self._sched.cancel_all(err)
+        raise err
 
     def run_until_idle(self, max_iterations=100000):
         """Pump step() until no lane has work (manual-drive mode)."""
@@ -349,8 +539,16 @@ class GenerationServer:
         self._m["blocks_in_use"].set(self.cache.num_used)
 
     def _serve(self):
+        from ..robustness.guard import NonFiniteError
         while True:
-            did = self.step()
+            try:
+                did = self.step()
+            except NonFiniteError:
+                # _on_engine_fault already dumped the flight recorder,
+                # failed every future, and closed the server: the
+                # worker just exits (clients observe the error on their
+                # futures; get_stats()["engine_fault"] records it)
+                return
             if did:
                 continue
             with self._cv:
@@ -368,6 +566,17 @@ class GenerationServer:
         worker. drain=False fails outstanding requests instead."""
         with self._rid_lock:
             if self._closed:
+                # already closed (or fault-stopped): still release the
+                # telemetry endpoint if one is mounted and this
+                # server's SLO gauge series (_on_engine_fault sets
+                # _closed without reaching the normal teardown below —
+                # a dead server must not report stale window quantiles;
+                # both releases are idempotent)
+                if self._exporter is not None:
+                    self._exporter.close()
+                    self._exporter = None
+                if self._tel is not None:
+                    self._tel.close()
                 return
             if not drain:
                 self._sched.cancel_all(RequestCancelled(
@@ -387,6 +596,11 @@ class GenerationServer:
         elif drain:
             self.run_until_idle()
         self._publish_gauges()
+        if self._exporter is not None:
+            self._exporter.close()
+            self._exporter = None
+        if self._tel is not None:
+            self._tel.close()       # drop this server's SLO gauge series
 
     def get_stats(self):
         """Scheduler + engine stats; `fused_step_signatures` is the jit
@@ -407,4 +621,57 @@ class GenerationServer:
             "kernel_dispatches": traced,
             "fallback_dispatches": fell_back,
         }
+        st["telemetry_enabled"] = self._tel is not None
+        st["slo"] = self._tel.stats() if self._tel is not None else None
+        st["engine_fault"] = repr(self._fault) if self._fault else None
         return st
+
+    def check_slo(self, targets):
+        """Burn-rate check over the cumulative SLO digests, e.g.
+        ``check_slo({"ttft_ms": {"p99": 250.0}, "itl_ms": {"p50": 40}})``
+        -> {"ok": bool, "checks": [...]}; see SLOTracker.check_slo."""
+        if self._tel is None:
+            raise RuntimeError(
+                "check_slo needs telemetry; this server was built with "
+                "telemetry=False")
+        return self._tel.check_slo(targets)
+
+    @property
+    def telemetry(self):
+        """The ServingTelemetry (SLO digests + flight recorder), or
+        None when disabled."""
+        return self._tel
+
+    def serve_metrics(self, port=0, host=None):
+        """Mount the stdlib telemetry endpoint (/metrics Prometheus
+        exposition, /healthz, /slo) for this server. Binds loopback by
+        default (docs/observability.md security note); returns the
+        running TelemetryServer (.port, .url, .close()). Closed with
+        the engine. Idempotent while a mount is live — but asking for a
+        DIFFERENT explicit port/host than the live mount raises instead
+        of silently returning the old endpoint (a scrape config pointed
+        at the requested port would get connection-refused while this
+        call looked successful)."""
+        from ..observability.exporter import (check_remount,
+                                              serve_metrics as _serve)
+        if self._exporter is not None and not self._exporter.closed:
+            check_remount(self._exporter, port, host)
+            return self._exporter        # live mount: idempotent
+
+        def _health():
+            # overrides the handler's default "ok": a faulted or closed
+            # engine must not scrape healthy
+            status = ("fault" if self._fault
+                      else "closed" if self._closed else "ok")
+            return {"status": status,
+                    "engine_fault": repr(self._fault)
+                    if self._fault else None,
+                    "pending": self.pending(),
+                    "iteration": self._sched.iteration}
+
+        self._exporter = _serve(
+            port=port, host=host or "127.0.0.1",
+            slo_fn=lambda: (self._tel.stats()
+                            if self._tel is not None else {}),
+            health_fn=_health)
+        return self._exporter

@@ -96,6 +96,17 @@ class _Slot:
         return self.pos < len(self.req.prompt)
 
 
+def _lane_tuple(sid, slot):
+    """One lane's flight-recorder tuple, in EXACTLY
+    serving_telemetry.LANE_FIELDS order — the flight dump's
+    _expand_lanes zips these against that schema, so every producer
+    must go through this helper (plan()'s slot loop and
+    lane_snapshot())."""
+    return (sid, slot.req.rid, int(slot.pos), bool(slot.prefilling),
+            int(slot.admit_seq), len(slot.req.generated),
+            int(slot.blocks[0]) if slot.blocks else None)
+
+
 class IterationPlan:
     """One fused step's host-built inputs + the bookkeeping commit()
     needs. `emitting[s]` marks slots whose step output IS a generated
@@ -103,10 +114,12 @@ class IterationPlan:
     iteration)."""
 
     __slots__ = ("tokens", "positions", "valid", "tables", "slot_ids",
-                 "emitting", "prefill_tokens")
+                 "emitting", "prefill_tokens", "lanes_detail",
+                 "queue_depth")
 
     def __init__(self, tokens, positions, valid, tables, slot_ids,
-                 emitting, prefill_tokens):
+                 emitting, prefill_tokens, lanes_detail=None,
+                 queue_depth=None):
         self.tokens = tokens                # (S, C) int32
         self.positions = positions          # (S, C) int32
         self.valid = valid                  # (S, C) bool
@@ -114,6 +127,12 @@ class IterationPlan:
         self.slot_ids = slot_ids            # slots with work this iter
         self.emitting = emitting            # set of slot ids
         self.prefill_tokens = prefill_tokens
+        # telemetry-only (None otherwise): pre-step lane occupancy in
+        # serving_telemetry.LANE_FIELDS order + post-admit queue depth,
+        # captured inside plan()'s slot loop so the engine's flight
+        # entry needs no second lock round-trip over the slots
+        self.lanes_detail = lanes_detail
+        self.queue_depth = queue_depth
 
 
 class ContinuousBatchingScheduler:
@@ -122,8 +141,12 @@ class ContinuousBatchingScheduler:
     commit() are called by the single engine loop."""
 
     def __init__(self, cache, num_slots=4, chunk=4, max_context=None,
-                 clock=None, watermark_blocks=0, chaos=None):
+                 clock=None, watermark_blocks=0, chaos=None,
+                 telemetry=None):
         self._cache = cache
+        self._tel = telemetry       # ServingTelemetry or None (hooks
+        #                             are cheap host bookkeeping, called
+        #                             under self._lock)
         self.num_slots = int(num_slots)
         self.chunk = int(chunk)
         self.max_context = int(max_context or
@@ -200,6 +223,11 @@ class ContinuousBatchingScheduler:
         self._count("retired")
         if ttft is not None:
             self._ttft.observe(ttft)
+        if self._tel is not None:
+            self._tel.on_finish(
+                req.rid, self.iteration, "retire", reason=reason,
+                e2e_ms=(self.now() - req.submitted_at) * 1e3,
+                prompt_len=len(req.prompt), generated=len(req.generated))
         return res
 
     def _fail(self, req, exc, count_key):
@@ -209,6 +237,15 @@ class ContinuousBatchingScheduler:
         except InvalidStateError:
             pass        # client cancelled between the check and the set
         self._count(count_key)
+        if self._tel is not None:
+            outcome = ("deadline" if count_key == "deadline_cancels"
+                       else "cancel")
+            if outcome == "deadline":
+                self._tel.on_deadline_cancel(req.rid, self.iteration)
+            self._tel.on_finish(req.rid, self.iteration, outcome,
+                                reason=type(exc).__name__,
+                                prompt_len=len(req.prompt),
+                                generated=len(req.generated))
 
     def _release_slot(self, sid):
         slot = self._slots[sid]
@@ -226,6 +263,23 @@ class ContinuousBatchingScheduler:
         if len(kept) != len(self._queue):
             self._queue = kept
             heapq.heapify(self._queue)
+
+    def drop_queued_request(self, rid, exc):
+        """Remove ONE queued request and fail its future — submit()'s
+        lost-the-race-with-close sweep: an enqueue that landed after
+        cancel_all's queue sweep would otherwise sit forever with no
+        worker to plan it. If the request was instead already admitted
+        to a slot (close(drain=True) with a live worker), fall back to
+        a normal cancel mark for the next iteration. Returns True if it
+        was still queued."""
+        with self._lock:
+            before = len(self._queue)
+            self._drop_queued(lambda r: r.rid == rid, lambda r: exc,
+                              "cancelled")
+            if len(self._queue) != before:
+                return True
+            self._cancel_rids.add(rid)
+            return False
 
     def cancel_all(self, exc=None):
         """Server shutdown without drain: fail everything outstanding."""
@@ -276,7 +330,7 @@ class ContinuousBatchingScheduler:
                     "deadline_cancels")
                 self._release_slot(sid)
 
-    def _admit(self):
+    def _admit(self, now):
         while self._queue:
             free_sid = next((i for i, s in enumerate(self._slots)
                              if s is None), None)
@@ -299,6 +353,10 @@ class ContinuousBatchingScheduler:
                                           self._admit_seq)
             self._admit_seq += 1
             self._count("admitted")
+            if self._tel is not None:
+                self._tel.on_admit(
+                    req.rid, free_sid, self.iteration,
+                    (now - req.submitted_at) * 1e3)
 
     def plan(self):
         """Build one iteration's fused-step inputs, or None when idle.
@@ -314,8 +372,9 @@ class ContinuousBatchingScheduler:
             self.iteration += 1
             if self._chaos is not None:
                 self._chaos.on_serving_iteration(self.iteration)
-            self._apply_cancels_and_deadlines(self.now())
-            self._admit()
+            now = self.now()
+            self._apply_cancels_and_deadlines(now)
+            self._admit(now)
             s, c = self.num_slots, self.chunk
             tokens = np.zeros((s, c), np.int32)
             positions = np.zeros((s, c), np.int32)
@@ -323,16 +382,22 @@ class ContinuousBatchingScheduler:
             tables = np.full((s, self.max_blocks), 0, np.int32)
             slot_ids, emitting = [], set()
             prefill_tokens = 0
+            lanes = [] if self._tel is not None else None
             for sid, slot in enumerate(self._slots):
                 if slot is None:
                     continue
                 slot_ids.append(sid)
                 tables[sid] = slot.table
                 req = slot.req
+                if lanes is not None:
+                    lanes.append(_lane_tuple(sid, slot))
                 if slot.prefilling:
                     n = min(c, len(req.prompt) - slot.pos)
                     tokens[sid, :n] = req.prompt[slot.pos:slot.pos + n]
                     prefill_tokens += n
+                    if self._tel is not None:
+                        self._tel.on_prefill_chunk(req.rid,
+                                                   self.iteration, n)
                     if slot.pos + n == len(req.prompt):
                         emitting.add(sid)
                 else:
@@ -344,8 +409,12 @@ class ContinuousBatchingScheduler:
             if not slot_ids:
                 return None
             self._count("prefill_tokens", prefill_tokens)
-            return IterationPlan(tokens, positions, valid, tables,
-                                 slot_ids, emitting, prefill_tokens)
+            return IterationPlan(
+                tokens, positions, valid, tables, slot_ids, emitting,
+                prefill_tokens,
+                lanes_detail=tuple(lanes) if lanes is not None else None,
+                queue_depth=len(self._queue)
+                if lanes is not None else None)
 
     def commit(self, plan, next_ids, next_logps):
         """Apply one fused step's outputs: advance positions, record
@@ -370,8 +439,15 @@ class ContinuousBatchingScheduler:
                 self._count("generated_tokens")
                 if req.first_token_at is None:
                     req.first_token_at = now
+                    if self._tel is not None:
+                        self._tel.on_first_token(
+                            req.rid, self.iteration,
+                            (now - req.submitted_at) * 1e3)
                 else:
-                    self._itl.observe((now - req.last_token_at) * 1e3)
+                    itl = (now - req.last_token_at) * 1e3
+                    self._itl.observe(itl)
+                    if self._tel is not None:
+                        self._tel.on_token(req.rid, self.iteration, itl)
                 req.last_token_at = now
                 if req.stream is not None:
                     try:
@@ -386,6 +462,20 @@ class ContinuousBatchingScheduler:
         return retired
 
     # -- introspection -----------------------------------------------------
+    def lane_snapshot(self):
+        """Per-lane occupancy: one tuple per ACTIVE slot in
+        serving_telemetry.LANE_FIELDS order (slot, rid, pos,
+        prefilling, admit_seq, generated, first_block); the flight
+        dump expands these to dicts. Cold path only — the engine's
+        per-iteration flight entry takes its lane detail from
+        plan.lanes_detail (built inside plan()'s slot loop); this
+        exists for callers without a plan in hand (the chaos
+        poison fallback, telemetry-off fault triage)."""
+        with self._lock:
+            return tuple(_lane_tuple(sid, slot)
+                         for sid, slot in enumerate(self._slots)
+                         if slot is not None)
+
     def stats(self):
         with self._lock:
             return {

@@ -84,6 +84,42 @@ def test_registry_json_and_prometheus_export():
     assert "test_ms_count 1" in prom
 
 
+def test_prometheus_label_value_escaping():
+    """Exposition-format escaping: a label value holding backslash,
+    double-quote, or newline must emit the escaped sequence, never a
+    raw byte that truncates the line (a label like shape="(4, 8)" with
+    a stray quote inside is the classic unscrapeable case)."""
+    reg = MetricsRegistry()
+    reg.counter("test.hits").labels(
+        shape="(4, 8)", tricky='say "hi"\\there\nnewline').inc(2)
+    prom = reg.to_prometheus()
+    line = next(l for l in prom.splitlines() if l.startswith("test_hits{"))
+    assert line == ('test_hits{shape="(4, 8)",'
+                    'tricky="say \\"hi\\"\\\\there\\nnewline"} 2')
+    # every non-comment line still parses as  name{...} value
+    for l in prom.splitlines():
+        if l.startswith("#") or not l.strip():
+            continue
+        assert l.count(" ") >= 1 and "\n" not in l
+
+
+def test_prometheus_help_line_escaping():
+    """HELP text escapes backslash and newline per the format spec
+    (quotes are legal there); histograms with escaped labels still emit
+    well-formed bucket lines."""
+    reg = MetricsRegistry()
+    reg.counter("test.hits", help="path C:\\tmp\nsecond line").inc()
+    reg.histogram("test.ms", help="h", buckets=(1.0,)).labels(
+        shape="(4, 8)").observe(0.5)
+    prom = reg.to_prometheus()
+    assert "# HELP test_hits path C:\\\\tmp\\nsecond line" in prom
+    assert 'test_ms_bucket{le="1.0",shape="(4, 8)"} 1' in prom
+    assert 'test_ms_count{shape="(4, 8)"} 1' in prom
+    # exactly one physical line per HELP entry
+    helps = [l for l in prom.splitlines() if l.startswith("# HELP")]
+    assert len(helps) == 2
+
+
 def test_registry_rejects_bad_names():
     reg = MetricsRegistry()
     with pytest.raises(ValueError):
@@ -211,6 +247,22 @@ def test_close_counts_evictions_and_resets_gauges():
     # the process-wide gauge series for this executor is GONE, not stale
     g = global_registry().get("executor.jit_cache.size")
     assert not any(lbl.get("executor") == exe_id for lbl, _ in g.series())
+
+
+def test_uncached_run_counts_bypass_not_miss():
+    """run(use_program_cache=False) is a BYPASS: counted in
+    executor.uncached_runs, never as a jit-cache miss — hit rates must
+    stay truthful when a caller opts out of caching."""
+    loss = _build_train_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    exe.reset_stats()
+    exe.run(feed=_feed(), fetch_list=[loss], use_program_cache=False)
+    s = exe.get_stats()
+    local = exe._stats.local.get("executor.uncached_runs")
+    assert local is not None and local.value() == 1
+    assert s["jit_cache"]["misses"] == 0 and s["jit_cache"]["hits"] == 0
+    assert s["steps"] == 1
 
 
 def test_reset_stats_zeroes_counters_but_keeps_cache():
@@ -342,3 +394,27 @@ def test_trace_report_demo_smoke(tmp_path, capsys):
     stats = json.loads(text)["executor_stats"]
     assert stats["compiles"] == 1 and stats["jit_cache"]["hits"] == 2
     assert "Cache Efficiency" in out
+
+
+def test_retroactive_stamps_before_capture_start_are_clamped():
+    # a request already in flight when the capture starts has
+    # submit/admit perf_counter stamps predating the recorder's t0;
+    # its retroactive spans must clamp to the capture origin instead
+    # of emitting ts < 0 (Perfetto renders those off-viewport)
+    import time as _time
+    rec = TraceRecorder()
+    rec.start()
+    now = _time.perf_counter()
+    rec.complete("request 1", now - 5.0, now, track="serving slot 0")
+    rec.complete("queue", now - 5.0, now - 4.0, track="serving slot 0")
+    rec.instant("retire", ts=now - 5.0, track="serving slot 0")
+    rec.stop()
+    evts = [e for e in rec.events() if e["name"] in
+            ("request 1", "queue", "retire")]
+    assert len(evts) == 3
+    for e in evts:
+        assert e["ts"] >= 0.0
+        assert e.get("dur", 0.0) >= 0.0
+    # the fully-pre-capture span collapses to zero width at the origin
+    q = next(e for e in evts if e["name"] == "queue")
+    assert q["ts"] == 0.0 and q["dur"] == 0.0

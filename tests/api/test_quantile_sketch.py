@@ -1,0 +1,214 @@
+"""QuantileSketch (observability/sketch.py): the SLO digest backend.
+
+The contract under test: deterministic under a fixed insertion order
+(bitwise-identical serialized state — there is no RNG to hide behind),
+mergeable with grouping-independent accuracy, and rank-error bounded on
+adversarial streams. Rank error uses the standard interval metric: an
+estimate v is charged the distance from q to the interval
+[F(v-), F(v)] of the exact distribution — on atom-heavy data any
+correct estimate sits inside a point mass whose interval, not point,
+contains q.
+"""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.observability.sketch import QuantileSketch
+
+COMPRESSION = 128
+# theory: max rank error ~ 2*q*(1-q)/delta for the k1 scale function;
+# 2/delta is a safe uniform bound across q, x2 slack for interpolation
+BOUND = 2.0 / COMPRESSION
+
+QS = (0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99, 0.999)
+
+
+def _rank_interval_error(sorted_data, est, q):
+    """Distance from q to [frac strictly below est, frac <= est]."""
+    lo = np.searchsorted(sorted_data, est, side="left") / len(sorted_data)
+    hi = np.searchsorted(sorted_data, est, side="right") / len(sorted_data)
+    if lo <= q <= hi:
+        return 0.0
+    return min(abs(q - lo), abs(q - hi))
+
+
+def _adversarial_streams():
+    rng = np.random.default_rng(7)
+    n = 20000
+    return {
+        "uniform": rng.uniform(0, 100, n),
+        "sorted_ascending": np.sort(rng.uniform(0, 100, n)),
+        "sorted_descending": np.sort(rng.uniform(0, 100, n))[::-1],
+        "heavy_duplicates": np.repeat([1.0, 2.0, 50.0, 99.0], n // 4),
+        "bimodal": np.concatenate([rng.normal(10, 1, n // 2),
+                                   rng.normal(1000, 5, n // 2)]),
+        "log_tailed": rng.lognormal(3, 2, n),
+    }
+
+
+@pytest.mark.parametrize("name,data",
+                         list(_adversarial_streams().items()),
+                         ids=list(_adversarial_streams()))
+def test_rank_error_bound_on_adversarial_distributions(name, data):
+    s = QuantileSketch(COMPRESSION)
+    for v in data:
+        s.add(v)
+    srt = np.sort(data)
+    for q in QS:
+        est = s.quantile(q)
+        err = _rank_interval_error(srt, est, q)
+        assert err <= BOUND, (name, q, est, err)
+    # envelope invariants
+    assert s.min == srt[0] and s.max == srt[-1]
+    assert s.quantile(0.0) == s.min and s.quantile(1.0) == s.max
+    assert s.count == len(data)
+    assert s.mean == pytest.approx(float(np.mean(data)), rel=1e-9)
+
+
+def test_deterministic_under_fixed_insertion_order():
+    data = np.random.default_rng(3).lognormal(2, 1.5, 5000)
+    a, b = QuantileSketch(64), QuantileSketch(64)
+    for v in data:
+        a.add(v)
+        b.add(v)
+    # identical serialized state, not just close estimates: there is no
+    # randomness anywhere in the compression path
+    assert a.to_dict() == b.to_dict()
+    # and a DIFFERENT insertion order still meets the accuracy bound
+    c = QuantileSketch(64)
+    for v in data[::-1]:
+        c.add(v)
+    srt = np.sort(data)
+    for q in (0.5, 0.9, 0.99):
+        assert _rank_interval_error(srt, c.quantile(q), q) <= 2.0 / 64
+
+
+def test_merge_associativity_within_rank_error_bound():
+    """merge((a+b)+c) and merge(a+(b+c)) and the unmerged stream must
+    all estimate within the rank-error bound of the exact quantiles —
+    the mergeability contract windows/slots/processes rely on. (Bitwise
+    associativity is impossible for any bounded-memory summary; the
+    bound is the contract.)"""
+    data = np.random.default_rng(11).gamma(2.0, 30.0, 18000)
+    parts = np.array_split(data, 6)
+    sketches = []
+    for p in parts:
+        s = QuantileSketch(COMPRESSION)
+        for v in p:
+            s.add(v)
+        sketches.append(s)
+
+    def fold(group):
+        acc = QuantileSketch(COMPRESSION)
+        for s in group:
+            acc.merge(s)
+        return acc
+
+    left = fold(sketches)                        # ((((a+b)+c)+d)+e)+f
+    right = QuantileSketch(COMPRESSION)          # a+(b+(c+(d+(e+f))))
+    pair = fold(sketches[:3]).merge(fold(sketches[3:]))   # (abc)+(def)
+    for s in reversed(sketches):
+        tmp = QuantileSketch(COMPRESSION)
+        tmp.merge(s)
+        tmp.merge(right)
+        right = tmp
+    srt = np.sort(data)
+    for grouping in (left, right, pair):
+        assert grouping.count == pytest.approx(len(data))
+        for q in QS:
+            err = _rank_interval_error(srt, grouping.quantile(q), q)
+            assert err <= BOUND, (q, err)
+    # merge() must leave the source sketches untouched
+    assert sketches[0].count == len(parts[0])
+
+
+def test_rank_is_inverse_of_quantile():
+    data = np.random.default_rng(5).normal(50, 10, 10000)
+    s = QuantileSketch(COMPRESSION)
+    for v in data:
+        s.add(v)
+    for q in (0.1, 0.5, 0.9, 0.99):
+        assert s.rank(s.quantile(q)) == pytest.approx(q, abs=BOUND)
+    assert s.rank(s.min - 1) == 0.0
+    assert s.rank(s.max + 1) == 1.0
+
+
+def test_weighted_adds_and_serialization_roundtrip():
+    s = QuantileSketch(32)
+    s.add(10.0, weight=3)
+    s.add(20.0, weight=1)
+    assert s.count == 4 and s.mean == pytest.approx(12.5)
+    assert s.quantile(0.25) <= 10.0 + 1e-9
+    d = s.to_dict()
+    r = QuantileSketch.from_dict(d)
+    assert r.to_dict() == d
+    for q in (0.0, 0.5, 1.0):
+        assert r.quantile(q) == s.quantile(q)
+    # roundtripped sketch keeps ingesting
+    r.add(30.0)
+    assert r.count == 5 and r.max == 30.0
+
+
+def test_empty_and_invalid_inputs():
+    s = QuantileSketch()
+    assert s.quantile(0.5) is None
+    assert s.rank(1.0) is None
+    assert s.count == 0 and s.mean is None
+    with pytest.raises(ValueError):
+        s.add(float("nan"))
+    with pytest.raises(ValueError):
+        s.add(float("inf"))
+    with pytest.raises(ValueError):
+        s.add(1.0, weight=0)
+    with pytest.raises(ValueError):
+        QuantileSketch(compression=4)
+    # empty merge is a no-op
+    t = QuantileSketch()
+    t.add(5.0)
+    t.merge(s)
+    assert t.count == 1 and t.quantile(0.5) == 5.0
+
+
+def test_memory_stays_bounded():
+    s = QuantileSketch(COMPRESSION)
+    for i in range(50000):
+        s.add(float(i % 997))
+    s._compress()
+    # centroid count is O(compression), never O(n)
+    assert len(s._means) <= 2 * COMPRESSION
+    assert s.count == 50000
+
+
+def test_summary_shape():
+    s = QuantileSketch()
+    for v in range(1, 101):
+        s.add(float(v))
+    out = s.summary()
+    assert set(out) == {"count", "min", "max", "avg", "p50", "p90", "p99"}
+    assert out["min"] == 1.0 and out["max"] == 100.0
+    assert abs(out["p50"] - 50.5) <= 1.0
+
+
+def test_add_unit_matches_add():
+    # add_unit is the validation-free hot-path add(v, 1.0) (and
+    # SLOTracker.observe_token inlines its body): the resulting sketch
+    # state must be IDENTICAL to add() on the same stream, including
+    # the serialized centroid set after compression.
+    import numpy as np
+    rng = np.random.default_rng(11)
+    vals = [float(v) for v in rng.lognormal(3.0, 1.0, 3000)]
+    a, b = QuantileSketch(COMPRESSION), QuantileSketch(COMPRESSION)
+    for v in vals:
+        a.add(v)
+        b.add_unit(v)
+    assert a.to_dict() == b.to_dict()
+
+    # and the inlined copy in observe_token produces the same digests
+    from paddle_tpu.observability.serving_telemetry import SLOTracker
+    tr = SLOTracker(clock=lambda: 0.0, compression=COMPRESSION)
+    ref = QuantileSketch(COMPRESSION)
+    for v in vals[:500]:
+        tr.observe_token("itl_ms", v)
+        ref.add(v)
+    assert tr.digest("itl_ms").to_dict() == ref.to_dict()
+    tr.drop_gauges()

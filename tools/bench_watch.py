@@ -206,8 +206,24 @@ def run_suite():
             "skipping")
     else:
         run_step("serving_compare", [py, bench],
-                 env={"JAX_PLATFORMS": "cpu", "BENCH_SERVING_COMPARE": "1"},
+                 env={"JAX_PLATFORMS": "cpu", "BENCH_SERVING_COMPARE": "1",
+                      # scrape the live /metrics + /slo endpoint mid-
+                      # bench (ISSUE 7) and commit the sample
+                      "BENCH_SLO_SAMPLE": os.path.join(
+                          PERF, "slo_sample.json")},
                  timeout_s=900, stdout_path="bench_serving.json")
+    # 1f. telemetry-overhead comparison (ISSUE 7): request-level
+    #     telemetry (SLO digests + lifecycle hooks + flight ring) on vs
+    #     off through the same mixed-length stream, on the CPU backend
+    #     (deterministic; acceptance bar: overhead < 5%)
+    if _artifact_ok("bench_telemetry.json"):
+        log("step telemetry_compare: already landed in a prior cycle — "
+            "skipping")
+    else:
+        run_step("telemetry_compare", [py, bench],
+                 env={"JAX_PLATFORMS": "cpu",
+                      "BENCH_TELEMETRY_COMPARE": "1"},
+                 timeout_s=900, stdout_path="bench_telemetry.json")
     # 2. headline: ERNIE-base, full sweep, HLO of the best batch archived
     if _artifact_ok("bench_ernie.json"):
         log("step ernie: already landed in a prior cycle — skipping")

@@ -201,6 +201,38 @@ def print_serving_summary(metrics, file=None):
         imode = ivals[0].get("value") if ivals else None
         print(f"serving: paged_kernel traced={ker} fallback={fb} "
               f"interpret={imode}", file=file)
+    # request-level telemetry (ISSUE 7): queue-wait/e2e, SLO window
+    # gauges, lifecycle-trace sampling, and flight-recorder activity
+    qc, qt = _hist_totals(metrics, "serving.queue_wait_ms")
+    ec, et = _hist_totals(metrics, "serving.e2e_ms")
+    traced_reqs = _counter_total(metrics, "serving.requests_traced")
+    faults = _counter_total(metrics, "serving.faults")
+    dumps = _counter_total(metrics, "flight.dumps")
+    windows = _counter_total(metrics, "serving.slo.windows")
+    if qc or ec or windows or faults or dumps:
+        print(f"serving: queue_wait_avg={qt / max(qc, 1):.2f}ms "
+              f"e2e_avg={et / max(ec, 1):.2f}ms "
+              f"requests_traced={traced_reqs} faults={faults} "
+              f"flight_dumps={dumps}", file=file)
+    quant = metrics.get("serving.slo.quantile_ms")
+    if windows and quant:
+        # key on (server, metric): two live GenerationServers publish
+        # under distinct server= labels and must not be merged into one
+        # last-write-wins row
+        by_key = {}
+        for v in quant.get("values", []):
+            lbl = v.get("labels", {})
+            if "metric" in lbl and "q" in lbl:
+                key = (lbl.get("server", ""), lbl["metric"])
+                by_key.setdefault(key, {})[lbl["q"]] = v.get("value")
+        servers = {srv for srv, _ in by_key}
+        for srv, m in sorted(by_key):
+            qs = by_key[(srv, m)]
+            tag = f"{srv}:{m}" if len(servers) > 1 else m
+            print(f"serving: slo[{tag}] (last window, {windows} windows) "
+                  + " ".join(f"{q}={qs[q]:.2f}ms"
+                             for q in ("p50", "p90", "p99") if q in qs),
+                  file=file)
 
 
 # ---------------------------------------------------------------------------
@@ -292,7 +324,10 @@ def run_demo(out_dir):
     # continuous-batching serving demo: a short mixed-length greedy run
     # through the paged-KV GenerationServer (manual pump, no threads) so
     # serving.* series land in the committed sample dump — one request
-    # cancels mid-stream via the deterministic chaos path
+    # cancels mid-stream via the deterministic chaos path. The chaos
+    # clock ticks 20 ms per iteration and the SLO window is 100 ms, so
+    # request-level telemetry (queue-wait/e2e histograms, SLO quantile
+    # gauges, completed windows) lands in the sample too (ISSUE 7).
     from paddle_tpu.models import gpt
     from paddle_tpu.serving import GenerationServer, GPTServingModel
     scfg = gpt.gpt_tiny()
@@ -305,10 +340,13 @@ def run_demo(out_dir):
     with fluid.scope_guard(sscope):
         exe4.run(sstart)
         sparams = gpt.load_params(sscope, scfg)
+    schaos = ChaosInjector().cancel_request_at(4, index=0)
+    for sit in range(1, 60):
+        schaos.advance_clock_at(sit, ms=20)
     server = GenerationServer(
         GPTServingModel(sparams, scfg), num_slots=2, block_size=8,
-        max_context=64, chunk=4, start=False,
-        chaos=ChaosInjector().cancel_request_at(4, index=0))
+        max_context=64, chunk=4, start=False, chaos=schaos,
+        slo_window_s=0.1)
     victim = server.submit(np.arange(3, 15, dtype=np.int32),
                            max_new_tokens=30)
     survivors = [server.submit([5 + i, 9, 11], max_new_tokens=4 + i)
