@@ -40,7 +40,13 @@ BENCH_SLO_SAMPLE=<path> additionally scrapes the live /metrics + /slo
 endpoint mid-bench and lands the sample there),
 BENCH_TELEMETRY_COMPARE=1 (request-level telemetry on-vs-off engine
 overhead; knobs BENCH_TELEMETRY_{REQUESTS,SLOTS,ROUNDS}; acceptance
-< 5%).
+< 5%), BENCH_COMPILE_SAMPLE=1 (compile-observatory artifact: a tiny-GPT
+Executor.explain() report, a provoked recompile storm with its key
+diffs, the HBM-ledger snapshot, and the recompile-detector on-vs-off
+steady-state overhead; knobs BENCH_COMPILE_{STEPS,ROUNDS,SEQ};
+acceptance < 5% — the detector does NOTHING on cache hits, so the
+steady-state delta is pure noise floor, and per-miss bookkeeping is
+timed directly in microseconds).
 """
 
 import json
@@ -814,6 +820,160 @@ def run_guard_compare(kind):
     return 0
 
 
+def run_compile_sample(kind):
+    """BENCH_COMPILE_SAMPLE=1: the compile-observatory acceptance
+    artifact (CPU backend, tiny GPT). Four sections in one JSON line:
+
+    - explain: Executor.explain() for the tiny-GPT train step — FLOPs /
+      bytes / peak HBM with sources (xla vs static fallback) and the
+      per-primitive attribution.
+    - storm: a provoked recompile storm (2 warm shapes, then 3 fresh
+      unbucketed ones) — events, warnings, and the latest key diff.
+    - overhead: recompile-detector on-vs-off steady-state step rate
+      (order-alternating best-of rounds, the BENCH_GUARD_COMPARE
+      pattern; acceptance < 5%). The detector touches ONLY the
+      jit-cache miss path, so this measures the shared-container noise
+      floor — the honest claim is "collection is overhead-free on
+      hits"; per-miss bookkeeping cost is timed directly below.
+    - tracker_miss_cost_us: mean microseconds of one observe_miss()
+      against a 32-signature history — the actual price a recompile
+      pays for its key diff (vs the ~10^5x larger XLA compile).
+    """
+    import warnings
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.core import framework
+    from paddle_tpu.core.executor import Scope, scope_guard
+    from paddle_tpu.models import gpt
+    from paddle_tpu.observability.compile_insight import (
+        RecompileStormWarning, RecompileTracker, hbm_ledger)
+
+    seq = int(os.environ.get("BENCH_COMPILE_SEQ", 32))
+    steps = int(os.environ.get("BENCH_COMPILE_STEPS", 300))
+    rounds = int(os.environ.get("BENCH_COMPILE_ROUNDS", 5))
+    cfg = gpt.gpt_tiny()
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        _tokens, loss, _logits = gpt.build_lm_net(cfg, seq_len=seq)
+        fluid.optimizer.AdamOptimizer(learning_rate=1e-3).minimize(loss)
+    rng = np.random.default_rng(0)
+
+    def feed(b):
+        return {"tokens": rng.integers(0, cfg.vocab_size, (b, seq),
+                                       dtype=np.int64)}
+
+    def fresh_exe(detect):
+        prev = os.environ.get("PADDLE_TPU_RECOMPILE_DETECT")
+        os.environ["PADDLE_TPU_RECOMPILE_DETECT"] = "1" if detect else "0"
+        try:
+            scope = Scope()
+            exe = fluid.Executor(fluid.TPUPlace(0))
+        finally:
+            if prev is None:
+                os.environ.pop("PADDLE_TPU_RECOMPILE_DETECT", None)
+            else:
+                os.environ["PADDLE_TPU_RECOMPILE_DETECT"] = prev
+        with scope_guard(scope):
+            exe.run(startup)
+        return exe, scope
+
+    # -- storm + explain on the detector-on executor ---------------------
+    exe, scope = fresh_exe(detect=True)
+    storms = []
+    with scope_guard(scope):
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            for b in (4, 8, 6, 10, 12):     # 2 warm, then 3 recompiles
+                exe.run(main, feed=feed(b), fetch_list=[loss])
+        storms = [w for w in caught
+                  if issubclass(w.category, RecompileStormWarning)]
+        report = exe.explain(main, feed=feed(4), fetch_list=[loss])
+    rc = exe.get_stats()["recompile"]
+    storm_info = {
+        "events": rc["events"], "storms": rc["storms"],
+        "warnings_caught": len(storms),
+        "last_summary": rc["last_events"][-1]["summary"]
+        if rc["last_events"] else None,
+    }
+    # trim the report for the artifact: per-primitive tail adds little
+    per_prim = report["static"]["jaxpr"]["per_primitive"]
+    report["static"]["jaxpr"]["per_primitive"] = dict(
+        list(per_prim.items())[:12])
+
+    # -- steady-state overhead: detector on vs off -----------------------
+    # FRESH executor pair (the stormed one above carries extra cache
+    # entries/series — the comparison must differ in the detect flag
+    # and nothing else)
+    exe_on, scope_on = fresh_exe(detect=True)
+    exe_off, scope_off = fresh_exe(detect=False)
+
+    def timed(e, s):
+        f = feed(4)
+        with scope_guard(s):
+            e.run(main, feed=f, fetch_list=[loss])      # warm this shape
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                e.run(main, feed=f, fetch_list=[loss])
+        return steps / (time.perf_counter() - t0)
+
+    rates = {"detector_on": 0.0, "detector_off": 0.0}
+    modes = [("detector_on", exe_on, scope_on),
+             ("detector_off", exe_off, scope_off)]
+    for _round in range(rounds):
+        # alternate mode order each round: a monotone background ramp
+        # must not systematically favor whichever mode runs first
+        for name, e, s in (modes if _round % 2 == 0
+                           else reversed(modes)):
+            rates[name] = max(rates[name], timed(e, s))
+    overhead = (rates["detector_off"] / rates["detector_on"] - 1.0) \
+        if rates["detector_on"] else None
+
+    # -- per-miss bookkeeping cost, timed directly -----------------------
+    # 32-signature standing history (a realistic badly-bucketed stream;
+    # the tracker caps at MAX_SIGNATURES anyway): each probe diffs
+    # against it, then pops its own entry so the history — and thus the
+    # per-call cost being measured — stays fixed
+    tracker = RecompileTracker(stats=None, warm=1, window_s=0.0)
+    base_sig = tuple((f"v{i}", (8, 32), np.dtype(np.float32))
+                     for i in range(4))
+
+    def probe_sig(i):
+        return base_sig + (("x", (8 + i, 32), np.dtype(np.float32)),)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for i in range(32):
+            tracker.observe_miss(1, "bench_prog", probe_sig(i),
+                                 ("loss",), ("w",), i)
+        hist = tracker._history[1]
+        n_probe = 200
+        t0 = time.perf_counter()
+        for i in range(n_probe):
+            tracker.observe_miss(1, "bench_prog", probe_sig(100 + i),
+                                 ("loss",), ("w",), i)
+            hist.pop()
+        miss_us = (time.perf_counter() - t0) / n_probe * 1e6
+
+    result = {
+        "metric": "compile_detector_steady_state_overhead",
+        "value": round(overhead, 4) if overhead is not None else None,
+        "unit": "fractional slowdown of detector-on vs detector-off "
+                "steady-state steps/sec (acceptance: < 0.05; the "
+                "detector runs only on jit-cache misses, so this is "
+                "the noise floor)",
+        "detector_on_steps_per_sec": round(rates["detector_on"], 2),
+        "detector_off_steps_per_sec": round(rates["detector_off"], 2),
+        "tracker_miss_cost_us": round(miss_us, 1),
+        "explain": report,
+        "storm": storm_info,
+        "memory_ledger": hbm_ledger().snapshot(),
+        "seq_len": seq, "steps": steps, "rounds": rounds,
+        "device_kind": kind,
+    }
+    print(json.dumps(_mark_degraded(result)), flush=True)
+    return 0
+
+
 def _scrape_slo_sample(server, kind):
     """BENCH_SLO_SAMPLE=<path>: mount the telemetry endpoint on the
     (still-warm) continuous server, scrape /metrics + /slo + /healthz
@@ -1531,6 +1691,11 @@ def main():
     if os.environ.get("BENCH_TELEMETRY_COMPARE") == "1":
         # request-level telemetry overhead (observability layer)
         return run_telemetry_compare(kind)
+
+    if os.environ.get("BENCH_COMPILE_SAMPLE") == "1":
+        # compile-observatory artifact: explain() report + recompile
+        # storm + HBM ledger + detector overhead (observability layer)
+        return run_compile_sample(kind)
 
     seq_len = int(os.environ.get("BENCH_SEQ_LEN", 512))
     # defaults favor landing A number inside a fragile tunnel window:

@@ -219,6 +219,22 @@ def _run_data_parallel(self, compiled, feed, fetch_list, scope, **kwargs):
                 want = _var_sharding(v, val, mesh)
                 if not _has_sharding(val, want):
                     scope.set(v.name, jax.device_put(jnp.asarray(val), want))
+    # HBM ledger: the miss-path state registration inside _orig_run
+    # counts per-DEVICE shard bytes (compile_insight.
+    # array_nbytes_per_device), so record the mesh itself next to those
+    # rows — /memory readers need the device count to reconstruct
+    # whole-fleet totals from per-chip numbers. Mesh-change only, and
+    # tracked separately from _active_mesh (which the finally below
+    # clears every step): the upsert's lock + gauge refresh must not
+    # ride every dp step
+    if getattr(self, "_ledger_mesh", None) is not mesh:
+        self._ledger_mesh = mesh
+        from ..observability.compile_insight import hbm_ledger
+        hbm_ledger().register(
+            self._exe_id, f"mesh/{'x'.join(map(str, mesh.devices.shape))}",
+            "other", 0,
+            detail={"devices": int(mesh.size),
+                    "axes": {k: int(v) for k, v in mesh.shape.items()}})
     self._active_mesh = mesh
     try:
         with mesh:

@@ -348,17 +348,39 @@ class Executor:
         self._exe_id = f"exe{next(_EXECUTOR_SEQ)}"
         self._stats = ComponentStats(gauge_labels={"executor": self._exe_id})
         self._telemetry_server = None   # serve_metrics() mount
+        # compile-plane observability (observability/compile_insight.py):
+        # the recompile-storm detector rides the jit-cache miss path;
+        # _entry_meta remembers each cached entry's (program, shapes)
+        # labels so clear_caches can retire exactly its series
+        from ..observability.compile_insight import RecompileTracker
+        self._recompile = RecompileTracker(stats=self._stats)
+        self._entry_meta = {}           # cache key -> compile_ms labels
+        self._mem_vars = {}             # var name -> (nbytes, is_param)
 
     # ------------------------------------------------------------------
     def clear_caches(self):
-        """Drop the step-fn and metadata caches (counted as evictions)
-        and zero the cache-size gauges."""
+        """Drop the step-fn and metadata caches (counted as evictions),
+        zero the cache-size gauges, and retire the freed entries'
+        observability: their per-(program, shapes) compile-time
+        histogram series, this executor's HBM-ledger rows, and the
+        recompile tracker's signature history — a freed entry must
+        never keep reporting as live, and the next compile of the same
+        shape is cold, not a recompile."""
         if self._cache:
             self._stats.count("executor.jit_cache.evictions",
                               len(self._cache))
         if self._meta_cache:
             self._stats.count("executor.meta_cache.evictions",
                               len(self._meta_cache))
+        hist = self._stats.local.get("executor.compile_ms")
+        if hist is not None:
+            for labels in self._entry_meta.values():
+                hist.remove(**labels)
+        self._entry_meta.clear()
+        self._mem_vars.clear()
+        from ..observability.compile_insight import hbm_ledger
+        hbm_ledger().retire(self._exe_id)
+        self._recompile.reset()
         self._cache.clear()
         self._meta_cache.clear()
         self._update_cache_gauges()
@@ -372,7 +394,8 @@ class Executor:
         # process-wide registry (stale gauges in long-lived processes)
         self._stats.drop_gauges("executor.jit_cache.size",
                                 "executor.meta_cache.size",
-                                "executor.async.inflight")
+                                "executor.async.inflight",
+                                "executor.recompile.window_events")
         if self._telemetry_server is not None:
             self._telemetry_server.close()
             self._telemetry_server = None
@@ -528,7 +551,20 @@ class Executor:
                       "host_sync_wait_ms":
                           h("executor.async.host_sync_wait_ms")},
             "compile_ms": per_key,
+            "recompile": self._recompile.snapshot(),
+            "memory": self._memory_stats(),
         }
+
+    def _memory_stats(self):
+        """The HBM-ledger view get_stats()['memory'] exposes: this
+        executor's own rows plus the unified process-wide snapshot
+        (params + optimizer state + serving PagedKVCache pools +
+        compiled peak-HBM estimates)."""
+        from ..observability.compile_insight import hbm_ledger
+        led = hbm_ledger()
+        return {"component": self._exe_id,
+                "own": led.component_bytes(self._exe_id),
+                "ledger": led.snapshot()}
 
     def reset_stats(self):
         """Zero this executor's local counters/histograms (the process-
@@ -578,6 +614,76 @@ class Executor:
         if isinstance(costs, (list, tuple)):
             costs = costs[0] if costs else {}
         return dict(costs or {})
+
+    def static_cost_analysis(self):
+        """Backend-independent cost model of the most recent step: a
+        walk of its traced jaxpr (compile_insight.analyze_jaxpr) —
+        {'flops', 'per_primitive', 'intermediate_bytes', ...}. The
+        cross-check column next to last_cost_analysis(): when XLA's
+        number and this one disagree >2x, one of the tools is lying
+        (tools/roofline.py reports both)."""
+        if self._last_call is None:
+            raise RuntimeError("no program has been run yet")
+        step_fn, args = self._last_call
+        from ..observability.compile_insight import analyze_jaxpr
+        return analyze_jaxpr(jax.make_jaxpr(step_fn)(*args))
+
+    def explain(self, program=None, feed=None, fetch_list=None,
+                scope=None, backend=None):
+        """Full compile-plane report for (program, feed): FLOPs, bytes
+        accessed, peak HBM, per-primitive/per-op-type attribution,
+        param vs optimizer-state bytes, this entry's compile-time
+        history and the program's recorded recompile causes
+        (docs/observability.md "Compile & memory";
+        tools/compile_report.py renders the table).
+
+        On-demand and read-free: no step runs, the step counter does
+        not advance, and cache/recompile metrics are untouched — but a
+        fresh entry IS built and cached when none matches, pre-warming
+        the next run() (which then counts a hit whose miss was never
+        recorded). `backend=None` tries XLA's cost/memory analysis and
+        falls back to the static analyzer per field; `backend=False`
+        forces the static path; `backend=True` raises if the backend
+        reports nothing. The report's peak-HBM estimate is upserted
+        into the process-wide HBM ledger (kind ``peak_hbm``) so the
+        /memory endpoint carries it; clear_caches()/close() retire it.
+        """
+        from ..observability import compile_insight as _ci
+        program = program if program is not None else default_main_program()
+        if getattr(program, "_data_parallel", False):
+            raise NotImplementedError(
+                "explain() takes a plain Program — the data-parallel "
+                "CompiledProgram path places state per-mesh at run time")
+        program = getattr(program, "program", program)  # CompiledProgram
+        scope = scope if scope is not None else global_scope()
+        fetch_names = tuple(_as_fetch_name(f) for f in (fetch_list or []))
+        entry, state, feeds, feed_sig, _fresh, _diff = self._resolve_entry(
+            program, feed or {}, fetch_names, scope, record=False)
+        step_fn, _guard_cell = entry
+        seed = program.random_seed or framework.default_seed()
+        rng = np.asarray([seed & 0xFFFFFFFF,
+                          self._step_counter & 0xFFFFFFFF], np.uint32)
+        labels = {"program": _program_label(program),
+                  "shapes": _shapes_label(feed_sig)}
+        report = _ci.explain_entry(step_fn, (state, feeds, rng),
+                                   program=program, state=state,
+                                   feeds=feeds, labels=labels,
+                                   backend=backend)
+        report["executor"] = self._exe_id
+        report["fetches"] = list(fetch_names)
+        # compile history for exactly this (program, shapes) series
+        report["compile_ms"] = None
+        hist = self._stats.local.get("executor.compile_ms")
+        if hist is not None:
+            for lbl, summ in hist.summaries():
+                if lbl == labels and summ["count"]:
+                    report["compile_ms"] = summ
+        report["recompiles"] = self._recompile.events(labels["program"])
+        _ci.hbm_ledger().register(
+            self._exe_id, f"{labels['program']}/{labels['shapes']}/peak",
+            "peak_hbm", report["peak_hbm_bytes"],
+            detail={"source": report["source"]["peak_hbm"]})
+        return report
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
@@ -793,20 +899,17 @@ class Executor:
         while pending:
             yield pending.popleft().result(return_numpy=return_numpy)
 
-    def _dispatch(self, program, feed, fetch_list, scope,
-                  use_program_cache):
-        """Shared front half of run()/run_async(): canonicalize feeds,
-        build or fetch the cached step fn, invoke it (XLA dispatch is
-        asynchronous), write the new state into the scope. Returns
-        (fetches, guard): the step's fetch tuple as device arrays, and
-        the sentinel ride-along for _check_guard (None unguarded) —
-        synchronization, numpy conversion and the guard check belong to
-        the caller."""
-        program = program if program is not None else default_main_program()
-        scope = scope if scope is not None else global_scope()
-        feed = feed or {}
-        fetch_names = tuple(_as_fetch_name(f) for f in (fetch_list or []))
-
+    def _resolve_entry(self, program, feed, fetch_names, scope,
+                       use_program_cache=True, record=True):
+        """Canonicalize feeds, validate the (program, feed, fetch)
+        triple, assemble the persistable state, and build-or-fetch the
+        cached step fn. Returns (entry, state, feeds, feed_sig, fresh,
+        diff): `diff` is the recompile key diff when this miss happened
+        on an already-warm program (None otherwise). `record=False`
+        (explain()'s mode) builds/caches exactly the same entry but
+        skips the hit/miss counters and the recompile tracker — an
+        on-demand introspection call must not fire a storm warning or
+        skew cache-efficiency metrics."""
         with self._stats.span("executor.key_build",
                               "executor.span.key_build_ms"):
             feeds = _canon_feeds(feed)
@@ -827,7 +930,7 @@ class Executor:
             if persist_names is None:
                 # a bypassed cache (use_program_cache=False) is not a
                 # miss — counting it would fake a churn problem
-                if use_program_cache:
+                if use_program_cache and record:
                     self._stats.count("executor.meta_cache.misses")
                 # early, friendly validation (parity: fluid's
                 # check_feed_shape_type)
@@ -850,7 +953,7 @@ class Executor:
                     v.name for v in program.list_vars() if v.persistable))
                 if use_program_cache:
                     self._meta_cache[meta_key] = persist_names
-            else:
+            elif record:
                 self._stats.count("executor.meta_cache.hits")
             state = {n: scope.get(n) for n in persist_names
                      if scope.get(n) is not None}
@@ -863,11 +966,21 @@ class Executor:
                    state_sig, mesh_key)
         entry = self._cache.get(key) if use_program_cache else None
         fresh = entry is None
+        diff = None
         if fresh:  # entry = (step_fn, guard_cell)
-            if use_program_cache:
-                self._stats.count("executor.jit_cache.misses")
-            else:
-                self._stats.count("executor.uncached_runs")
+            if record:
+                if use_program_cache:
+                    self._stats.count("executor.jit_cache.misses")
+                    # recompile-storm detector: a miss on an already-warm
+                    # program records a key diff vs the nearest cached
+                    # signature (and may warn, rate-windowed)
+                    diff = self._recompile.observe_miss(
+                        program.uid, _program_label(program), feed_sig,
+                        fetch_names, state_sig, self._step_counter,
+                        extra_sig=(("program version", program.version),
+                                   ("mesh", mesh_key)))
+                else:
+                    self._stats.count("executor.uncached_runs")
             # "trace" span: program -> step-closure construction; the
             # jaxpr trace + XLA compile happen lazily inside the first
             # invocation (the "compile" span below)
@@ -877,11 +990,71 @@ class Executor:
                                     state_sig)
             if use_program_cache:
                 self._cache[key] = entry
+                self._entry_meta[key] = {
+                    "program": _program_label(program),
+                    "shapes": _shapes_label(feed_sig)}
             # sizes only change on an insert (or clear_caches); a pure
             # hit must not pay two gauge writes
             self._update_cache_gauges()
-        else:
+            # HBM ledger: param vs optimizer-state bytes of the state
+            # this entry closes over (miss-path-only bookkeeping;
+            # upserts, so re-compiles just refresh the numbers)
+            self._register_state_memory(program, state)
+        elif record:
             self._stats.count("executor.jit_cache.hits")
+        return entry, state, feeds, feed_sig, fresh, diff
+
+    def _register_state_memory(self, program, state):
+        """Register resident state in the process-wide HBM ledger,
+        split param vs optimizer-state (moments, LR counters,
+        batch-norm stats): the ledger's training-side rows.
+
+        The accounting unit is the VAR NAME, merged across programs
+        into two rows per executor: a train program and its
+        clone(for_test=True) eval program run over the SAME scope
+        arrays, so per-program rows would double-count every shared
+        parameter (the trade-off: distinct scopes feeding one executor
+        under-count, which is the rarer shape)."""
+        if not state:
+            return
+        from ..observability.compile_insight import (
+            array_nbytes_per_device, hbm_ledger)
+        pset = {p.name for p in program.all_parameters()}
+        for n, v in state.items():
+            # per-DEVICE bytes: under a dp/tp mesh a dist_attr-sharded
+            # var costs each chip only its shard
+            self._mem_vars[n] = (array_nbytes_per_device(v), n in pset)
+        param_b = opt_b = 0
+        n_params = n_opt = 0
+        for b, is_param in self._mem_vars.values():
+            if is_param:
+                param_b += b
+                n_params += 1
+            else:
+                opt_b += b
+                n_opt += 1
+        led = hbm_ledger()
+        led.register(self._exe_id, "state/params", "params", param_b,
+                     detail={"vars": n_params})
+        led.register(self._exe_id, "state/optimizer", "optimizer",
+                     opt_b, detail={"vars": n_opt})
+
+    def _dispatch(self, program, feed, fetch_list, scope,
+                  use_program_cache):
+        """Shared front half of run()/run_async(): canonicalize feeds,
+        build or fetch the cached step fn, invoke it (XLA dispatch is
+        asynchronous), write the new state into the scope. Returns
+        (fetches, guard): the step's fetch tuple as device arrays, and
+        the sentinel ride-along for _check_guard (None unguarded) —
+        synchronization, numpy conversion and the guard check belong to
+        the caller."""
+        program = program if program is not None else default_main_program()
+        scope = scope if scope is not None else global_scope()
+        feed = feed or {}
+        fetch_names = tuple(_as_fetch_name(f) for f in (fetch_list or []))
+
+        entry, state, feeds, feed_sig, fresh, diff = self._resolve_entry(
+            program, feed, fetch_names, scope, use_program_cache)
         step_fn, guard_cell = entry
 
         seed = program.random_seed or framework.default_seed()
@@ -901,10 +1074,16 @@ class Executor:
         if fresh:
             labels = {"program": _program_label(program),
                       "shapes": _shapes_label(feed_sig)}
+            # a post-warm recompile rides its key diff into the trace
+            # span args (NOT the metric labels — unbounded cardinality),
+            # so Perfetto shows WHY this compile happened, not just that
+            span_args = labels if diff is None else dict(
+                labels, key_diff=diff["summary"],
+                nearest_signature=diff["nearest"])
             t_c0 = time.perf_counter()
             with self._stats.span("executor.compile",
                                   "executor.span.compile_ms",
-                                  trace_args=labels):
+                                  trace_args=span_args):
                 new_state, fetches = step_fn(state, feeds, rng)
             self._stats.count("executor.compiles")
             self._stats.observe("executor.compile_ms",

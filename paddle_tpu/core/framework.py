@@ -51,6 +51,20 @@ def convert_dtype(dtype):
     raise ValueError(f"unsupported dtype: {dtype!r}")
 
 
+# bfloat16 has no portable numpy spelling (np.dtype("bfloat16") needs the
+# ml_dtypes registration), so byte-size questions about Program variables
+# go through this table instead of np.dtype(...).itemsize.
+_DTYPE_ITEMSIZE = {
+    "float64": 8, "float32": 4, "float16": 2, "bfloat16": 2,
+    "int64": 8, "int32": 4, "int16": 2, "int8": 1, "uint8": 1, "bool": 1,
+}
+
+
+def dtype_itemsize(dtype):
+    """Bytes per element for any dtype spec the framework accepts."""
+    return _DTYPE_ITEMSIZE[convert_dtype(dtype)]
+
+
 _global_seed = 0
 
 
@@ -117,6 +131,17 @@ class Variable:
     @property
     def ndim(self):
         return len(self.shape)
+
+    def numel(self, batch_size=1):
+        """Element count with dynamic (-1) dims resolved to batch_size."""
+        n = 1
+        for d in self.shape:
+            n *= batch_size if d in (-1, None) else int(d)
+        return n
+
+    def nbytes(self, batch_size=1):
+        """Static byte size (observability.compile_insight's unit)."""
+        return self.numel(batch_size) * dtype_itemsize(self.dtype)
 
     # Math operators are patched in by layers.math_op_patch (avoids an import
     # cycle, same trick as fluid.layers.math_op_patch).

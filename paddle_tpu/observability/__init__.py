@@ -1,6 +1,6 @@
 """Runtime observability: metrics, tracing, and component stats.
 
-Six pieces, wired through the execution stack:
+Seven pieces, wired through the execution stack:
 
 - metrics.py — process-wide MetricsRegistry (counters / gauges /
   ms-histograms; JSON + Prometheus export; METRIC_SPECS namespace lint).
@@ -11,8 +11,12 @@ Six pieces, wired through the execution stack:
 - serving_telemetry.py — request-level serving telemetry: lifecycle
   span trees, SLO digests, and the fault flight recorder
   (GenerationServer wires it; GuardedTrainer reuses the recorder).
-- exporter.py — stdlib HTTP /metrics (Prometheus), /healthz, /slo
-  endpoint any component mounts via serve_metrics(port=...).
+- exporter.py — stdlib HTTP /metrics (Prometheus), /healthz, /slo,
+  /memory endpoint any component mounts via serve_metrics(port=...).
+- compile_insight.py — the compile plane: XLA cost/memory extraction
+  with static-analyzer fallback, the process-wide HBM ledger
+  (memory.* gauges), and the recompile-storm detector the Executor
+  wires into its jit-cache miss path (Executor.explain's engine).
 - ComponentStats (here) — the per-component view an instrumented object
   (the Executor) holds: every update lands in BOTH the component's
   private registry (so Executor.get_stats() answers per-instance
@@ -40,11 +44,13 @@ __all__ = ["metrics", "tracing", "sketch", "MetricsRegistry",
            "QuantileSketch", "TraceRecorder", "get_recorder",
            "ComponentStats"]
 
-# serving_telemetry and exporter import lazily from here (they need
-# _help below); they are reached as paddle_tpu.observability.<module>
-# by the serving engine and tests without being imported at package
-# import time (the exporter pulls http.server in, which the training
-# path never needs).
+# serving_telemetry, exporter and compile_insight import lazily from
+# here (they need _help below); they are reached as
+# paddle_tpu.observability.<module> by the serving engine, the Executor
+# and tests without being imported at package import time (the exporter
+# pulls http.server in, which the training path never needs;
+# compile_insight pulls jax internals the bare metrics path never
+# needs).
 
 
 class ComponentStats:

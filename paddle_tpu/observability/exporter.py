@@ -1,4 +1,5 @@
-"""Stdlib-only HTTP telemetry endpoint: /metrics, /healthz, /slo.
+"""Stdlib-only HTTP telemetry endpoint: /metrics, /healthz, /slo,
+/memory.
 
 Any component can mount one — ``GenerationServer.serve_metrics(port=...)``
 and ``Executor.serve_metrics(port=...)`` wrap this; a bare
@@ -14,6 +15,10 @@ scrape target on every host, not a metrics SDK.
   500, so a wedged component reads as unhealthy instead of silent.
 - ``GET /slo`` — JSON from ``slo_fn()`` (the serving SLO digest
   snapshot), ``{}`` when the component has none.
+- ``GET /memory`` — JSON HBM-ledger snapshot (``memory_fn()``; default
+  is the process-wide ``compile_insight.hbm_ledger()``): param /
+  optimizer-state / PagedKVCache pool bytes and compiled peak-HBM
+  estimates per component (docs/observability.md "Compile & memory").
 
 Security note: binds 127.0.0.1 by default — the exposition includes
 program/shape names and the SLO surface leaks traffic patterns. Bind a
@@ -62,10 +67,20 @@ class _Handler(BaseHTTPRequestHandler):
                 body = (json.dumps(payload, sort_keys=True) + "\n").encode()
                 ctype = "application/json"
                 code = 200
+            elif path == "/memory":
+                if owner.memory_fn is not None:
+                    payload = owner.memory_fn()
+                else:
+                    from .compile_insight import hbm_ledger
+                    payload = hbm_ledger().snapshot()
+                body = (json.dumps(payload, sort_keys=True) + "\n").encode()
+                ctype = "application/json"
+                code = 200
             else:
                 body = (json.dumps(
                     {"error": "not found",
-                     "endpoints": ["/metrics", "/healthz", "/slo"]})
+                     "endpoints": ["/metrics", "/healthz", "/slo",
+                                   "/memory"]})
                     + "\n").encode()
                 ctype = "application/json"
                 code = 404
@@ -88,11 +103,14 @@ class TelemetryServer:
     daemon serve thread; close() shuts it down (idempotent)."""
 
     def __init__(self, registry=None, host="127.0.0.1", port=0,
-                 slo_fn=None, health_fn=None):
+                 slo_fn=None, health_fn=None, memory_fn=None):
         self.registry = registry if registry is not None \
             else global_registry()
         self.slo_fn = slo_fn
         self.health_fn = health_fn
+        # None -> the process-wide HBM ledger, resolved per request so
+        # a custom memory view stays injectable for tests
+        self.memory_fn = memory_fn
         self._requested = (host, int(port))
         self._httpd = None
         self._thread = None
@@ -102,7 +120,7 @@ class TelemetryServer:
         self._requests = self.registry.counter(
             "exporter.requests", _help("exporter.requests"))
 
-    _KNOWN_PATHS = ("/metrics", "/healthz", "/slo")
+    _KNOWN_PATHS = ("/metrics", "/healthz", "/slo", "/memory")
 
     def _count(self, path, code):
         # unknown paths collapse to one label value: a crawler probing
@@ -185,9 +203,10 @@ def check_remount(live, port, host):
 
 
 def serve_metrics(port=0, host="127.0.0.1", registry=None, slo_fn=None,
-                  health_fn=None):
+                  health_fn=None, memory_fn=None):
     """Mount and start a telemetry endpoint; returns the running
     TelemetryServer (``.port`` holds the bound port, ``.close()`` stops
     it). Binds loopback by default — see the module security note."""
     return TelemetryServer(registry=registry, host=host, port=port,
-                           slo_fn=slo_fn, health_fn=health_fn).start()
+                           slo_fn=slo_fn, health_fn=health_fn,
+                           memory_fn=memory_fn).start()

@@ -183,6 +183,25 @@ METRIC_SPECS = [
     ("exporter.requests", "counter",
      "telemetry HTTP endpoint requests served (labels: path, code; "
      "plus an unlabeled aggregate)"),
+    ("executor.recompile.events", "counter",
+     "post-warm jit-cache misses (recompiles) with a recorded key diff "
+     "(which feed var changed shape/dtype vs the nearest cached "
+     "signature)"),
+    ("executor.recompile.storms", "counter",
+     "recompile-storm warnings raised (>= storm-threshold recompiles "
+     "inside the rate window; see docs/observability.md)"),
+    ("executor.recompile.window_events", "gauge",
+     "recompiles inside the current rate window (labeled per "
+     "executor)"),
+    ("memory.bytes", "gauge",
+     "HBM-ledger bytes per (component, kind): params, optimizer, "
+     "kv_cache, other, peak_hbm (docs/observability.md 'Compile & "
+     "memory')"),
+    ("memory.total_bytes", "gauge",
+     "sum of live resident HBM-ledger bytes (params + optimizer + "
+     "kv_cache + other; peak_hbm estimates excluded — they overlap "
+     "the same buffers)"),
+    ("memory.entries", "gauge", "live HBM-ledger entries"),
     ("executor.dp.runs", "counter", "data-parallel (mesh) run() calls"),
     ("executor.dp.shard_state_ms", "histogram",
      "feed/state device placement on the data-parallel path"),

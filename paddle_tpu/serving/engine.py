@@ -17,6 +17,7 @@ params (same math as gpt.build_kv_step, vectorized over the chunk
 axis, KV routed through serving.kv_cache.paged_attention/write).
 """
 
+import itertools
 import math
 import threading
 import time
@@ -37,6 +38,9 @@ from .kv_cache import (NULL_BLOCK, PagedKVCache, paged_attention,
 from .scheduler import ContinuousBatchingScheduler, RequestCancelled, _Request
 
 __all__ = ["GenerationServer", "GenerationFuture", "GPTServingModel"]
+
+# HBM-ledger component ids ("serving0", ...): monotonic, never recycled
+_SERVER_SEQ = itertools.count()
 
 
 class GPTServingModel:
@@ -191,6 +195,36 @@ class GenerationServer:
         self.max_context = max_context
         self._fused = jax.jit(model.build_fused_step(self.block_size))
         self._signatures = set()
+        # HBM ledger (observability/compile_insight.py): the serving
+        # side of get_stats()["memory"] / the /memory endpoint — block
+        # pools + model params as resident rows, plus a static peak
+        # estimate for the fused step (pools and params dominate; the
+        # per-iteration activations are S x C x hidden per layer).
+        # close() retires the rows on BOTH teardown paths.
+        from ..observability.compile_insight import (array_nbytes,
+                                                     hbm_ledger)
+        self._ledger_id = f"serving{next(_SERVER_SEQ)}"
+        kv_bytes = sum(array_nbytes(p["k"]) + array_nbytes(p["v"])
+                       for p in self.cache.pools)
+        param_bytes = sum(array_nbytes(a) for a in
+                          jax.tree_util.tree_leaves(model.params))
+        hidden = model.num_heads * model.head_dim
+        act_est = num_slots * chunk * hidden * 4 * (2 * model.num_layers
+                                                    + 4)
+        led = hbm_ledger()
+        led.register(self._ledger_id, "kv_pool", "kv_cache", kv_bytes,
+                     detail={"layers": model.num_layers,
+                             "num_blocks": self.cache.num_blocks,
+                             "block_size": self.block_size,
+                             "heads": model.num_heads,
+                             "head_dim": model.head_dim,
+                             "dtype": str(np.dtype(model.kv_dtype))})
+        led.register(self._ledger_id, "model_params", "params",
+                     param_bytes, detail={"source": "serving model"})
+        led.register(self._ledger_id, "fused_step", "peak_hbm",
+                     param_bytes + kv_bytes + act_est,
+                     detail={"source": "static",
+                             "activation_bytes_est": act_est})
         # paged-kernel engagement accounting: the fused step traces
         # ONCE; the module dispatch counters' delta across that trace
         # proves which attention path this server actually compiled
@@ -567,16 +601,19 @@ class GenerationServer:
         with self._rid_lock:
             if self._closed:
                 # already closed (or fault-stopped): still release the
-                # telemetry endpoint if one is mounted and this
-                # server's SLO gauge series (_on_engine_fault sets
-                # _closed without reaching the normal teardown below —
-                # a dead server must not report stale window quantiles;
-                # both releases are idempotent)
+                # telemetry endpoint if one is mounted, this server's
+                # SLO gauge series, and its HBM-ledger rows
+                # (_on_engine_fault sets _closed without reaching the
+                # normal teardown below — a dead server must not report
+                # stale window quantiles or live pool bytes; every
+                # release here is idempotent)
                 if self._exporter is not None:
                     self._exporter.close()
                     self._exporter = None
                 if self._tel is not None:
                     self._tel.close()
+                from ..observability.compile_insight import hbm_ledger
+                hbm_ledger().retire(self._ledger_id)
                 return
             if not drain:
                 self._sched.cancel_all(RequestCancelled(
@@ -601,6 +638,8 @@ class GenerationServer:
             self._exporter = None
         if self._tel is not None:
             self._tel.close()       # drop this server's SLO gauge series
+        from ..observability.compile_insight import hbm_ledger
+        hbm_ledger().retire(self._ledger_id)    # and its memory.* rows
 
     def get_stats(self):
         """Scheduler + engine stats; `fused_step_signatures` is the jit
@@ -624,6 +663,10 @@ class GenerationServer:
         st["telemetry_enabled"] = self._tel is not None
         st["slo"] = self._tel.stats() if self._tel is not None else None
         st["engine_fault"] = repr(self._fault) if self._fault else None
+        from ..observability.compile_insight import hbm_ledger
+        # this server's HBM-ledger rows (kv_cache/params/peak_hbm);
+        # empty once close() retired them
+        st["memory"] = hbm_ledger().component_bytes(self._ledger_id)
         return st
 
     def check_slo(self, targets):

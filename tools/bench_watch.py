@@ -224,6 +224,18 @@ def run_suite():
                  env={"JAX_PLATFORMS": "cpu",
                       "BENCH_TELEMETRY_COMPARE": "1"},
                  timeout_s=900, stdout_path="bench_telemetry.json")
+    # 1g. compile-observatory sample (ISSUE 8): Executor.explain()
+    #     report + provoked recompile storm + HBM-ledger snapshot +
+    #     detector on-vs-off overhead, on the CPU backend
+    #     (deterministic; acceptance bar: overhead < 5%)
+    if _artifact_ok("compile_sample.json"):
+        log("step compile_sample: already landed in a prior cycle — "
+            "skipping")
+    else:
+        run_step("compile_sample", [py, bench],
+                 env={"JAX_PLATFORMS": "cpu",
+                      "BENCH_COMPILE_SAMPLE": "1"},
+                 timeout_s=900, stdout_path="compile_sample.json")
     # 2. headline: ERNIE-base, full sweep, HLO of the best batch archived
     if _artifact_ok("bench_ernie.json"):
         log("step ernie: already landed in a prior cycle — skipping")

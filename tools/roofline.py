@@ -11,8 +11,12 @@ committed expectation instead of a shrug.
 
 Interpretation notes (also embedded in the JSON):
 * flops: XLA's count for ONE whole train step (fwd+bwd+adam). Cross-
-  checked against the analytic count (utils/model_stat x3) — bench.py
-  prints the same ratio on hardware.
+  checked against TWO independent counts — the analytic hand-count
+  (utils/model_stat x3) and the static jaxpr walk
+  (observability/compile_insight.analyze_jaxpr); both columns are
+  reported, and a >2x analytic/static disagreement is flagged as a
+  suspected TOOL bug instead of silently trusting either (bench.py
+  prints the analytic/XLA ratio on hardware).
 * bytes: the CPU executable's "bytes accessed". This is an UPPER bound
   on real TPU HBM traffic — the CPU backend legalizes bf16 to f32
   (~2x) and fuses less than the TPU backend — so the implied MFU is a
@@ -77,6 +81,16 @@ def measure(batch, seq_len=512, model="ernie"):
     exe = getattr(step, "executor", None)
     ca = (exe.last_cost_analysis() if exe is not None
           else step.cost_analysis())    # non-Executor steps (gpt_prefill)
+    # independent third column: the static jaxpr walk
+    # (observability/compile_insight.py) — backend-free, backward
+    # included, no hand-count conventions shared with analytic_flops
+    static_flops = None
+    if exe is not None:
+        try:
+            static_flops = float(exe.static_cost_analysis()["flops"])
+        except Exception as e:
+            print(f"roofline: static analyzer failed ({e}); "
+                  f"reporting XLA/analytic columns only", file=sys.stderr)
     return {
         "model": model,
         "batch": batch,
@@ -85,6 +99,7 @@ def measure(batch, seq_len=512, model="ernie"):
         "xla_flops_per_step": float(ca.get("flops", 0.0)),
         "xla_bytes_per_step": float(ca.get("bytes accessed", 0.0)),
         "analytic_train_flops": float(analytic_flops),
+        "static_flops_per_step": static_flops,
         "cpu_build_s": round(build_s, 1),
         "cpu_compile_plus_step_s": round(compile_s, 1),
     }
@@ -117,7 +132,30 @@ def project(m, peak=V5E_PEAK_FLOPS, bw=V5E_HBM_BYTES_PER_S):
             m["units_per_step"] / step_bf16, 1),
         "flops_ratio_analytic_over_xla": round(
             m["analytic_train_flops"] / flops, 3) if flops else None,
+        "flops_ratio_analytic_over_static": round(
+            m["analytic_train_flops"] / m["static_flops_per_step"], 3)
+        if m.get("static_flops_per_step") else None,
+        "flops_crosscheck": _flops_crosscheck(m),
     }
+
+
+def _flops_crosscheck(m):
+    """Hand-counted (utils/model_stat x3) vs static-analyzer (jaxpr
+    walk) FLOPs: the two count the SAME step by independent rules, so
+    >2x disagreement means one of the TOOLS is wrong — flag it instead
+    of silently trusting either column (the MFU denominator would lie
+    by the same factor)."""
+    static = m.get("static_flops_per_step")
+    if not static:
+        return "static column unavailable (non-Executor step)"
+    ratio = m["analytic_train_flops"] / static
+    if not 0.5 <= ratio <= 2.0:
+        return (f"TOOL BUG SUSPECTED: hand-counted/static ratio "
+                f"{ratio:.2f} is outside [0.5, 2] — audit "
+                f"utils/model_stat.count_flops and "
+                f"observability/compile_insight.analyze_jaxpr before "
+                f"trusting any MFU number")
+    return f"ok (analytic/static = {ratio:.2f})"
 
 
 SUSPECTS = [
@@ -164,7 +202,8 @@ def main():
               f"flops/byte (ridge {r['ridge_point']}), projected MFU "
               f"[{r['mfu_lower_bound']}, {r['mfu_bf16_bytes']}] "
               f"step [{r['projected_step_s_bf16_bytes']}s, "
-              f"{r['projected_step_s_lower_bound']}s]", flush=True)
+              f"{r['projected_step_s_lower_bound']}s] "
+              f"crosscheck: {r['flops_crosscheck']}", flush=True)
 
     out = {
         "model": args.model,
