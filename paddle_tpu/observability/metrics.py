@@ -153,10 +153,23 @@ METRIC_SPECS = [
      "paged_attention dispatches that traced the Pallas ragged paged "
      "attention kernel (one per layer per fused-step trace)"),
     ("serving.kernel.fallback", "counter",
-     "paged_attention dispatches that took the pure-JAX reference path"),
+     "paged_attention dispatches that took the pure-JAX reference path "
+     "(unlabeled aggregate plus a reason label: pinned_off, "
+     "unsupported, vmap_trace, unsupported_under_shard_map)"),
     ("serving.kernel.interpret", "gauge",
      "1 when the paged kernel runs under the Pallas interpreter "
      "(off-TPU), 0 when compiled for a real TPU"),
+    ("serving.mesh.axis_size", "gauge",
+     "tensor-parallel mesh axis size a GenerationServer shards its "
+     "fused step and KV pools over (label: server; absent single-"
+     "device)"),
+    ("serving.mesh.shard_pool_bytes", "gauge",
+     "KV block-pool bytes ONE device commits under the serving mesh "
+     "(pool_bytes/tp — the capacity unit admission watermarks "
+     "protect; label: server)"),
+    ("serving.mesh.psums_per_step", "gauge",
+     "psum collectives one fused serving step pays (2 per layer: "
+     "attention o-proj + ffn down-projection; label: server)"),
     ("tracing.dropped_events", "counter",
      "trace events dropped by the bounded ring buffer (drop-oldest)"),
     ("serving.queue_wait_ms", "histogram",

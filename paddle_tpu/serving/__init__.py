@@ -18,7 +18,11 @@ deadline. This package is the TPU-native answer:
                   deadlines (injectable clock);
 - engine.py     — GenerationServer: one jitted fused prefill/decode
                   step for the server lifetime, submit/Future surface,
-                  streaming token callbacks, graceful drain.
+                  streaming token callbacks, graceful drain; with
+                  `mesh=` the pools shard over the head axis and the
+                  fused step runs under shard_map (one psum per
+                  sub-block, scheduler state replicated on the host —
+                  docs/serving.md "Serving on a mesh").
 
 Entry points: `GenerationServer(GPTServingModel.from_scope(scope, cfg))`
 directly, or `AnalysisConfig.enable_generation(...)` +

@@ -43,11 +43,61 @@ __all__ = ["GenerationServer", "GenerationFuture", "GPTServingModel"]
 _SERVER_SEQ = itertools.count()
 
 
+def _fused_step_body(params, cfg, block_size, h_count, d, reduce_fn,
+                     pools, tokens, positions, valid, tables):
+    """The ONE fused prefill/decode step body (build_kv_step's math over
+    (S, C) ragged lanes with paged KV), shared by the single-device and
+    tensor-parallel fused steps exactly like gpt._prefill_forward:
+    `h_count` is the head count THIS caller sees (H, or H/tp inside
+    shard_map over head-sharded params and pools) and `reduce_fn`
+    finishes the row-parallel o-proj / ffn-down contractions (identity
+    single-device; one psum per sub-block under tp — the partial sums
+    those matmuls leave are the ONLY cross-shard state the step has)."""
+    s, c = tokens.shape
+    pos = jnp.where(valid, positions, 0)
+    x = params["word_emb"][tokens] + params["pos_emb"][pos]
+    # write targets: masked lanes route to the NULL block
+    bidx = jnp.take_along_axis(tables, pos // block_size, axis=1)
+    bidx = jnp.where(valid, bidx, NULL_BLOCK)
+    off = jnp.where(valid, pos % block_size, 0)
+    new_pools = []
+    for i in range(cfg.num_layers):
+        lp = params[f"l{i}"]
+        kp, vp = pools[i]["k"], pools[i]["v"]
+        hn = _ln(x, lp["ln1_s"], lp["ln1_b"])
+        q = (hn @ lp["wq"] + lp["bq"]).reshape(s, c, h_count, d)
+        k = (hn @ lp["wk"] + lp["bk"]).reshape(s, c, h_count, d)
+        v = (hn @ lp["wv"] + lp["bv"]).reshape(s, c, h_count, d)
+        kp = write_block_kv(kp, k, bidx, off)
+        vp = write_block_kv(vp, v, bidx, off)
+        o = paged_attention(q.transpose(0, 2, 1, 3), kp, vp,
+                            tables, pos)
+        o = o.transpose(0, 2, 1, 3).reshape(s, c, h_count * d)
+        x = x + (reduce_fn(o @ lp["wo"]) + lp["bo"]).astype(x.dtype)
+        hn = _ln(x, lp["ln2_s"], lp["ln2_b"])
+        f = jax.nn.gelu(hn @ lp["f0w"] + lp["f0b"],
+                        approximate=False)
+        x = x + (reduce_fn(f @ lp["f1w"]) + lp["f1b"])
+        new_pools.append({"k": kp, "v": vp})
+    x = _ln(x, params["lnf_s"], params["lnf_b"])
+    # next token comes from each lane's LAST valid column only
+    last = jnp.clip(valid.sum(1) - 1, 0, c - 1)
+    xl = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+    logits = xl @ params["word_emb"].T
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    nxt = jnp.argmax(logp, axis=-1)
+    chosen = jnp.take_along_axis(logp, nxt[:, None], -1)[:, 0]
+    return new_pools, nxt.astype(jnp.int32), chosen
+
+
 class GPTServingModel:
     """models/gpt.py parameters behind the engine's model interface:
-    config facts + `build_fused_step(block_size)`. The step math is
-    build_kv_step's, re-expressed over (S, C) ragged lanes with paged
-    KV — tests pin the two token-for-token."""
+    config facts + `build_fused_step(block_size, mesh=None)`. The step
+    math is build_kv_step's, re-expressed over (S, C) ragged lanes with
+    paged KV — tests pin the two token-for-token. With a mesh the SAME
+    body runs under shard_map: params in the Megatron serving layout
+    (gpt.gpt_tp_shardings), pools head-sharded, one psum per sub-block
+    (attention o-proj + ffn down-projection)."""
 
     def __init__(self, params, cfg, dtype=None):
         self.params = _cast_params(params, dtype)
@@ -62,48 +112,81 @@ class GPTServingModel:
     def from_scope(cls, scope, cfg, dtype=None):
         return cls(load_params(scope, cfg), cfg, dtype=dtype)
 
-    def build_fused_step(self, block_size):
+    def build_fused_step(self, block_size, mesh=None, axis="tp"):
         params, cfg = self.params, self.cfg
         h_, d = self.num_heads, self.head_dim
 
+        if mesh is None:
+            def fused(pools, tokens, positions, valid, tables):
+                return _fused_step_body(
+                    params, cfg, block_size, h_, d, lambda z: z,
+                    pools, tokens, positions, valid, tables)
+
+            return fused
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        from ..models.gpt import gpt_tp_shardings
+
+        tp = mesh.shape[axis]
+        if self.num_heads % tp or cfg.inner_size % tp:
+            raise ValueError(
+                f"tp={tp} must divide both num_heads={self.num_heads} "
+                f"and inner_size={cfg.inner_size}")
+        h_loc = self.num_heads // tp
+        shardings = gpt_tp_shardings(cfg, mesh, axis)
+        sharded = jax.device_put(params, shardings)
+        # rebind to the sharded copy so THIS model holds no reference
+        # to the unsharded source tree — the caller can free theirs and
+        # halve the footprint (at the HBM edge that's the difference
+        # between fitting and OOM). Shape/dtype consumers
+        # (param_bytes*, the ledger) are unaffected; a later
+        # single-device build_fused_step on this instance would close
+        # over sharded arrays, so use one model per server layout.
+        self.params = sharded
+        del params
+
+        def local(lp_all, pools, tokens, positions, valid, tables):
+            return _fused_step_body(
+                lp_all, cfg, block_size, h_loc, d,
+                lambda z: jax.lax.psum(z, axis),
+                pools, tokens, positions, valid, tables)
+
+        param_specs = jax.tree_util.tree_map(
+            lambda ns: ns.spec, shardings)
+        pool_specs = [{"k": P(None, axis, None, None),
+                       "v": P(None, axis, None, None)}
+                      for _ in range(cfg.num_layers)]
+        rep = P()
+        fn = shard_map(local, mesh=mesh,
+                       in_specs=(param_specs, pool_specs, rep, rep,
+                                 rep, rep),
+                       out_specs=(pool_specs, rep, rep),
+                       check_vma=False)
+
         def fused(pools, tokens, positions, valid, tables):
-            s, c = tokens.shape
-            pos = jnp.where(valid, positions, 0)
-            x = params["word_emb"][tokens] + params["pos_emb"][pos]
-            # write targets: masked lanes route to the NULL block
-            bidx = jnp.take_along_axis(tables, pos // block_size, axis=1)
-            bidx = jnp.where(valid, bidx, NULL_BLOCK)
-            off = jnp.where(valid, pos % block_size, 0)
-            new_pools = []
-            for i in range(cfg.num_layers):
-                lp = params[f"l{i}"]
-                kp, vp = pools[i]["k"], pools[i]["v"]
-                hn = _ln(x, lp["ln1_s"], lp["ln1_b"])
-                q = (hn @ lp["wq"] + lp["bq"]).reshape(s, c, h_, d)
-                k = (hn @ lp["wk"] + lp["bk"]).reshape(s, c, h_, d)
-                v = (hn @ lp["wv"] + lp["bv"]).reshape(s, c, h_, d)
-                kp = write_block_kv(kp, k, bidx, off)
-                vp = write_block_kv(vp, v, bidx, off)
-                o = paged_attention(q.transpose(0, 2, 1, 3), kp, vp,
-                                    tables, pos)
-                o = o.transpose(0, 2, 1, 3).reshape(s, c, cfg.hidden_size)
-                x = x + (o @ lp["wo"] + lp["bo"]).astype(x.dtype)
-                hn = _ln(x, lp["ln2_s"], lp["ln2_b"])
-                f = jax.nn.gelu(hn @ lp["f0w"] + lp["f0b"],
-                                approximate=False)
-                x = x + (f @ lp["f1w"] + lp["f1b"])
-                new_pools.append({"k": kp, "v": vp})
-            x = _ln(x, params["lnf_s"], params["lnf_b"])
-            # next token comes from each lane's LAST valid column only
-            last = jnp.clip(valid.sum(1) - 1, 0, c - 1)
-            xl = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
-            logits = xl @ params["word_emb"].T
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
-            nxt = jnp.argmax(logp, axis=-1)
-            chosen = jnp.take_along_axis(logp, nxt[:, None], -1)[:, 0]
-            return new_pools, nxt.astype(jnp.int32), chosen
+            return fn(sharded, pools, tokens, positions, valid, tables)
 
         return fused
+
+    def param_bytes_per_device(self, mesh=None, axis="tp"):
+        """Bytes of the parameter tree ONE device holds under the
+        serving layout: sharded leaves (spec mentions `axis`) split by
+        tp, replicated leaves count full — the HBM ledger's per-device
+        unit. Without a mesh: the whole tree."""
+        from ..observability.compile_insight import array_nbytes
+        leaves = jax.tree_util.tree_leaves(self.params)
+        if mesh is None:
+            return sum(array_nbytes(a) for a in leaves)
+        from ..models.gpt import gpt_tp_shardings
+        tp = int(mesh.shape[axis])
+        # tree_map over BOTH trees so a params/shardings structure
+        # divergence fails loudly instead of zip-truncating silently
+        per_leaf = jax.tree_util.tree_map(
+            lambda a, ns: array_nbytes(a)
+            // (tp if axis in tuple(ns.spec) else 1),
+            self.params, gpt_tp_shardings(self.cfg, mesh, axis))
+        return sum(jax.tree_util.tree_leaves(per_leaf))
 
 
 class GenerationFuture(Future):
@@ -152,9 +235,25 @@ class GenerationServer:
                  num_blocks=None, max_context=None, chunk=4, clock=None,
                  watermark_blocks=0, chaos=None, start=True,
                  telemetry=True, slo_window_s=60.0, flight_dir=None,
-                 flight_capacity=256, deadline_storm=3):
+                 flight_capacity=256, deadline_storm=3, mesh=None,
+                 mesh_axis="tp"):
         self.model = model
         self.block_size = int(block_size)
+        self.mesh = mesh
+        self.mesh_axis = mesh_axis if mesh is not None else None
+        if mesh is not None and mesh_axis not in mesh.axis_names:
+            raise ValueError(
+                f"mesh_axis {mesh_axis!r} is not a mesh axis (mesh has "
+                f"{mesh.axis_names}) — pass mesh_axis=<the axis name>")
+        tp = int(mesh.shape[mesh_axis]) if mesh is not None else 1
+        # validate divisibility BEFORE anything allocates (pools,
+        # scheduler, telemetry): build_fused_step re-checks for direct
+        # callers, but by then the device pools already exist
+        inner = getattr(getattr(model, "cfg", None), "inner_size", None)
+        if mesh is not None and inner is not None and inner % tp:
+            raise ValueError(
+                f"tp={tp} must divide both num_heads={model.num_heads} "
+                f"and inner_size={inner}")
         max_context = int(max_context or model.max_position)
         if max_context > model.max_position:
             raise ValueError(
@@ -166,7 +265,8 @@ class GenerationServer:
         self.cache = PagedKVCache(model.num_layers, model.num_heads,
                                   model.head_dim, num_blocks,
                                   block_size=self.block_size,
-                                  dtype=model.kv_dtype)
+                                  dtype=model.kv_dtype, mesh=mesh,
+                                  axis=mesh_axis)
         if chaos is not None and clock is None and \
                 getattr(chaos, "drives_clock", lambda: False)():
             clock = chaos.serving_clock
@@ -193,38 +293,82 @@ class GenerationServer:
             watermark_blocks=watermark_blocks, chaos=chaos,
             telemetry=telemetry)
         self.max_context = max_context
-        self._fused = jax.jit(model.build_fused_step(self.block_size))
+        # mesh kwargs only when sharding: a custom model implementing
+        # the original build_fused_step(block_size) keeps working
+        self._fused = jax.jit(
+            model.build_fused_step(self.block_size) if mesh is None
+            else model.build_fused_step(self.block_size, mesh=mesh,
+                                        axis=mesh_axis))
         self._signatures = set()
         # HBM ledger (observability/compile_insight.py): the serving
         # side of get_stats()["memory"] / the /memory endpoint — block
         # pools + model params as resident rows, plus a static peak
         # estimate for the fused step (pools and params dominate; the
         # per-iteration activations are S x C x hidden per layer).
+        # Under a mesh the kv rows are PER DEVICE (one row per mesh
+        # position, each holding its H/tp shard's bytes) so the rows
+        # sum to the pool's logical bytes — never tp x overcounted —
+        # while still attributing capacity to the device that pays it.
         # close() retires the rows on BOTH teardown paths.
         from ..observability.compile_insight import (array_nbytes,
                                                      hbm_ledger)
         self._ledger_id = f"serving{next(_SERVER_SEQ)}"
-        kv_bytes = sum(array_nbytes(p["k"]) + array_nbytes(p["v"])
-                       for p in self.cache.pools)
+        kv_bytes = self.cache.pool_bytes()
+        shard_bytes = self.cache.shard_pool_bytes()
         param_bytes = sum(array_nbytes(a) for a in
                           jax.tree_util.tree_leaves(model.params))
         hidden = model.num_heads * model.head_dim
         act_est = num_slots * chunk * hidden * 4 * (2 * model.num_layers
                                                     + 4)
         led = hbm_ledger()
-        led.register(self._ledger_id, "kv_pool", "kv_cache", kv_bytes,
-                     detail={"layers": model.num_layers,
-                             "num_blocks": self.cache.num_blocks,
-                             "block_size": self.block_size,
-                             "heads": model.num_heads,
-                             "head_dim": model.head_dim,
-                             "dtype": str(np.dtype(model.kv_dtype))})
+        kv_detail = {"layers": model.num_layers,
+                     "num_blocks": self.cache.num_blocks,
+                     "block_size": self.block_size,
+                     "heads": model.num_heads,
+                     "head_dim": model.head_dim,
+                     "dtype": str(np.dtype(model.kv_dtype))}
+        if mesh is None:
+            led.register(self._ledger_id, "kv_pool", "kv_cache",
+                         kv_bytes, detail=kv_detail)
+            param_dev_bytes = param_bytes
+        else:
+            for i, dev in enumerate(mesh.devices.flat):
+                led.register(
+                    self._ledger_id, f"kv_pool/shard{i}", "kv_cache",
+                    shard_bytes,
+                    detail=dict(kv_detail, device=str(dev),
+                                mesh_index=i, axis=mesh_axis,
+                                heads_local=model.num_heads // tp))
+            param_dev_bytes = param_bytes
+            if hasattr(model, "param_bytes_per_device"):
+                param_dev_bytes = model.param_bytes_per_device(
+                    mesh, mesh_axis)
         led.register(self._ledger_id, "model_params", "params",
-                     param_bytes, detail={"source": "serving model"})
+                     param_bytes,
+                     detail={"source": "serving model",
+                             "per_device_bytes": param_dev_bytes})
+        # peak is PER DEVICE (compile_insight's unit): one shard's
+        # params + its kv shard + the replicated activations
         led.register(self._ledger_id, "fused_step", "peak_hbm",
-                     param_bytes + kv_bytes + act_est,
+                     param_dev_bytes + shard_bytes + act_est,
                      detail={"source": "static",
-                             "activation_bytes_est": act_est})
+                             "activation_bytes_est": act_est,
+                             "per_device": True})
+        # mesh gauges (serving.mesh.*): the tp degree, what one device
+        # commits to the pools, and the psums a fused step pays — the
+        # capacity facts a fleet dashboard sizes against. Removed on
+        # close (both paths) like the SLO gauges.
+        self._mesh_gauges = None
+        if mesh is not None:
+            reg0 = global_registry()
+            self._mesh_gauges = {
+                "serving.mesh.axis_size": tp,
+                "serving.mesh.shard_pool_bytes": shard_bytes,
+                "serving.mesh.psums_per_step": 2 * model.num_layers,
+            }
+            for name, val in self._mesh_gauges.items():
+                reg0.gauge(name, _help(name)).labels(
+                    server=self._ledger_id).set(val)
         # paged-kernel engagement accounting: the fused step traces
         # ONCE; the module dispatch counters' delta across that trace
         # proves which attention path this server actually compiled
@@ -614,6 +758,7 @@ class GenerationServer:
                     self._tel.close()
                 from ..observability.compile_insight import hbm_ledger
                 hbm_ledger().retire(self._ledger_id)
+                self._retire_mesh_gauges()
                 return
             if not drain:
                 self._sched.cancel_all(RequestCancelled(
@@ -640,6 +785,18 @@ class GenerationServer:
             self._tel.close()       # drop this server's SLO gauge series
         from ..observability.compile_insight import hbm_ledger
         hbm_ledger().retire(self._ledger_id)    # and its memory.* rows
+        self._retire_mesh_gauges()              # and its serving.mesh.*
+
+    def _retire_mesh_gauges(self):
+        """Drop this server's serving.mesh.* gauge series (idempotent;
+        called from BOTH close paths — a dead server must not keep
+        reporting a live shard footprint)."""
+        if not self._mesh_gauges:
+            return
+        reg = global_registry()
+        for name in self._mesh_gauges:
+            reg.gauge(name).remove(server=self._ledger_id)
+        self._mesh_gauges = None
 
     def get_stats(self):
         """Scheduler + engine stats; `fused_step_signatures` is the jit
@@ -663,6 +820,17 @@ class GenerationServer:
         st["telemetry_enabled"] = self._tel is not None
         st["slo"] = self._tel.stats() if self._tel is not None else None
         st["engine_fault"] = repr(self._fault) if self._fault else None
+        if self.mesh is None:
+            st["mesh"] = None
+        else:
+            st["mesh"] = {
+                "axis": self.mesh_axis,
+                "tp": int(self.mesh.shape[self.mesh_axis]),
+                "devices": [str(d) for d in self.mesh.devices.flat],
+                "pool_bytes": self.cache.pool_bytes(),
+                "shard_pool_bytes": self.cache.shard_pool_bytes(),
+                "psums_per_step": 2 * self.model.num_layers,
+            }
         from ..observability.compile_insight import hbm_ledger
         # this server's HBM-ledger rows (kv_cache/params/peak_hbm);
         # empty once close() retired them

@@ -53,8 +53,12 @@ NEG_INF = -1e9
 # many paged_attention dispatches routed to the Pallas kernel vs the
 # pure-JAX reference. The engine and bench assert engagement off these
 # so a silent fallback can never masquerade as a kernel win.
+# FALLBACK_REASONS mirrors the `serving.kernel.fallback{reason=...}`
+# labeled series so tests and get_stats can tell a deliberate pin
+# (pinned_off) from a degradation (unsupported, vmap_trace).
 KERNEL_DISPATCHES = 0
 FALLBACK_DISPATCHES = 0
+FALLBACK_REASONS = {}
 
 
 # ---------------------------------------------------------------------------
@@ -151,10 +155,40 @@ def paged_kernel_supported(q, k_pool, v_pool):
     return k_pool.dtype in (jnp.float32, jnp.bfloat16)
 
 
-def _record_dispatch(kernel):
+def _transform_trace_kind(*operands):
+    """'vmap' / 'shard_map' when any operand is mid-transform trace,
+    else None. Raising inside such a trace surfaces as an opaque
+    transform-internals stack, so the dispatcher degrades to the
+    reference there instead (vmap additionally because batching a
+    PrefetchScalarGridSpec pallas_call is outside the kernel's TPU
+    contract — the CPU interpreter happens to cope, the compiled path
+    is unvalidated). shard_map traces with QUALIFYING operands still
+    take the kernel: that is the tensor-parallel serving hot path."""
+    from jax.interpreters import batching
+    for x in operands:
+        if isinstance(x, batching.BatchTracer):
+            return "vmap"
+        if type(x).__name__ == "ShardMapTracer":
+            return "shard_map"
+    # jit(shard_map(...)) — the tp serving hot path — hands the body
+    # plain DynamicJaxprTracers, not ShardMapTracers; what marks the
+    # context is the mesh axis bound in the axis env (the same state
+    # psum resolves against). The probe-by-name API is version-fenced,
+    # so degrade to None (plain-jit behavior) when it's absent.
+    nonempty = getattr(jax.core, "nonempty_axis_env_DO_NOT_USE", None)
+    if nonempty is not None and nonempty():
+        return "shard_map"
+    return None
+
+
+def _record_dispatch(kernel, reason=None):
     """Trace-time metrics: dispatch counters + the interpret-mode gauge
     land in the global registry so GenerationServer.get_stats() and the
-    trace_report serving summary can prove the kernel engaged."""
+    trace_report serving summary can prove the kernel engaged.
+    Fallbacks carry a `reason` label (pinned_off / unsupported /
+    vmap_trace / unsupported_under_shard_map) on top of the unlabeled
+    aggregate, so a dashboard can tell an operator pin from a silent
+    degradation."""
     global KERNEL_DISPATCHES, FALLBACK_DISPATCHES
     from ..observability import _help
     from ..observability.metrics import global_registry
@@ -169,14 +203,19 @@ def _record_dispatch(kernel):
                       1 if _paged._interpret() else 0)
     else:
         FALLBACK_DISPATCHES += 1
-        reg.counter("serving.kernel.fallback",
-                    _help("serving.kernel.fallback")).inc()
+        reason = reason or "unsupported"
+        FALLBACK_REASONS[reason] = FALLBACK_REASONS.get(reason, 0) + 1
+        c = reg.counter("serving.kernel.fallback",
+                        _help("serving.kernel.fallback"))
+        c.inc()                             # unlabeled aggregate
+        c.labels(reason=reason).inc()       # per-reason series
 
 
 def kernel_dispatch_stats():
     """Module-level dispatch counters as a dict (engine/bench surface)."""
     return {"kernel_dispatches": KERNEL_DISPATCHES,
             "fallback_dispatches": FALLBACK_DISPATCHES,
+            "fallback_reasons": dict(FALLBACK_REASONS),
             "mode": paged_kernel_mode()}
 
 
@@ -190,22 +229,45 @@ def paged_attention(q, k_pool, v_pool, block_table, q_positions):
     operands qualify; otherwise falls back to
     `paged_attention_reference`, the documented pure-JAX spec. The
     decision happens at TRACE time (shapes/dtypes are static under
-    jit), so a compiled fused step pays zero dispatch overhead."""
+    jit), so a compiled fused step pays zero dispatch overhead.
+
+    Transform traces degrade instead of dying: under a vmap trace the
+    kernel is never taken (batched pallas_call is outside its TPU
+    contract), and unsupported operands inside a vmap/shard_map trace
+    fall back with a labeled `serving.kernel.fallback` reason even in
+    force mode — a ValueError mid-transform-trace would surface as
+    transform internals, not as this dispatcher's message. Plain
+    force-mode misuse (no transform) still raises loudly."""
     mode = paged_kernel_mode()
     supported = paged_kernel_supported(q, k_pool, v_pool)
-    if mode == "force" and not supported:
-        raise ValueError(
-            "PADDLE_TPU_PAGED_KERNEL=1 but operands do not qualify "
-            f"(q {q.shape} {q.dtype}, pools {k_pool.shape} "
-            f"{k_pool.dtype}/{v_pool.dtype})")
-    if mode != "off" and supported:
-        from ..ops.pallas.paged import ragged_paged_attention
-        _record_dispatch(kernel=True)
-        return ragged_paged_attention(q, k_pool, v_pool, block_table,
+    transform = _transform_trace_kind(q, k_pool, v_pool, block_table,
                                       q_positions)
-    _record_dispatch(kernel=False)
-    return paged_attention_reference(q, k_pool, v_pool, block_table,
-                                     q_positions)
+    # a deliberate operator pin dominates every other reason: off mode
+    # under a vmap trace is still pinned_off, so a dashboard alerting
+    # on non-pinned_off fallbacks never pages on the pin itself
+    if mode == "off":
+        _record_dispatch(kernel=False, reason="pinned_off")
+        return paged_attention_reference(q, k_pool, v_pool, block_table,
+                                         q_positions)
+    if transform == "vmap":
+        _record_dispatch(kernel=False, reason="vmap_trace")
+        return paged_attention_reference(q, k_pool, v_pool, block_table,
+                                         q_positions)
+    if not supported:
+        if mode == "force" and transform is None:
+            raise ValueError(
+                "PADDLE_TPU_PAGED_KERNEL=1 but operands do not qualify "
+                f"(q {q.shape} {q.dtype}, pools {k_pool.shape} "
+                f"{k_pool.dtype}/{v_pool.dtype})")
+        _record_dispatch(kernel=False,
+                         reason=f"unsupported_under_{transform}"
+                         if transform else "unsupported")
+        return paged_attention_reference(q, k_pool, v_pool, block_table,
+                                         q_positions)
+    from ..ops.pallas.paged import ragged_paged_attention
+    _record_dispatch(kernel=True)
+    return ragged_paged_attention(q, k_pool, v_pool, block_table,
+                                  q_positions)
 
 
 def write_block_kv(pool, vals, block_idx, offset):
@@ -226,10 +288,19 @@ class PagedKVCache:
     Allocation is host-side bookkeeping only (ints in a list); the
     device arrays are fixed-shape for the process lifetime, so every
     scheduler iteration hits the same compiled step regardless of which
-    requests hold which blocks."""
+    requests hold which blocks.
+
+    With `mesh=` the pools are laid out head-sharded over the mesh's
+    `axis` via NamedSharding — each device holds an
+    (num_blocks, H/tp, block_size, D) shard, the Megatron serving
+    layout the tp decoders already use for the dense cache. ONLY the
+    device layout moves: the free list, the block tables, and every
+    allocation decision stay replicated host state, so the scheduler
+    above is mesh-agnostic by construction (a block id means the same
+    rows on every shard)."""
 
     def __init__(self, num_layers, num_heads, head_dim, num_blocks,
-                 block_size=16, dtype=jnp.float32):
+                 block_size=16, dtype=jnp.float32, mesh=None, axis="tp"):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is reserved NULL)")
         self.num_layers = int(num_layers)
@@ -238,10 +309,42 @@ class PagedKVCache:
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self.dtype = dtype
+        self.mesh = mesh
+        self.axis = axis if mesh is not None else None
+        if mesh is not None and len(mesh.axis_names) != 1:
+            # the serving stack shards over exactly ONE (head) axis;
+            # data parallelism is separate server replicas, not a mesh
+            # axis here — and the per-device ledger rows / shard byte
+            # math (pool/tp each) are only truthful on a 1-D mesh
+            raise ValueError(
+                f"serving mesh must be 1-D (the head axis); got axes "
+                f"{mesh.axis_names} — run data-parallel replicas as "
+                f"separate GenerationServers instead")
+        if mesh is not None and axis not in mesh.axis_names:
+            raise ValueError(
+                f"axis {axis!r} is not a mesh axis (mesh has "
+                f"{mesh.axis_names}) — pass axis=<the mesh's axis name>")
+        self.tp = int(mesh.shape[axis]) if mesh is not None else 1
+        if self.num_heads % self.tp:
+            raise ValueError(
+                f"mesh axis {axis!r} size {self.tp} must divide "
+                f"num_heads={self.num_heads} (head-sharded pools)")
         shape = (self.num_blocks, self.num_heads, self.block_size,
                  self.head_dim)
-        self.pools = [{"k": jnp.zeros(shape, dtype),
-                       "v": jnp.zeros(shape, dtype)}
+        if mesh is None:
+            def make():
+                return jnp.zeros(shape, dtype)
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            ns = NamedSharding(mesh, P(None, axis, None, None))
+
+            def make():
+                # device= allocates each (N, H/tp, bs, D) shard in
+                # place — a zeros-then-device_put would materialize the
+                # FULL pool on device 0 first, OOMing at exactly the
+                # near-ceiling pool sizes tp serving exists for
+                return jnp.zeros(shape, dtype, device=ns)
+        self.pools = [{"k": make(), "v": make()}
                       for _ in range(self.num_layers)]
         # LIFO free list; block 0 (NULL) is never handed out
         self._free = list(range(self.num_blocks - 1, 0, -1))
@@ -250,6 +353,23 @@ class PagedKVCache:
     @property
     def usable_blocks(self):
         return self.num_blocks - 1
+
+    # -- byte accounting ---------------------------------------------------
+    def pool_bytes(self):
+        """LOGICAL bytes of every block pool (k+v across layers) —
+        what the whole mesh holds in total, identical to the
+        single-device footprint (sharding splits it, never copies)."""
+        per = (self.num_blocks * self.num_heads * self.block_size
+               * self.head_dim * np.dtype(self.dtype).itemsize)
+        return 2 * self.num_layers * per
+
+    def shard_pool_bytes(self):
+        """Bytes ONE device commits to the pools: pool_bytes()/tp under
+        a mesh (the head axis divides exactly), the full pool without
+        one. Capacity/watermark math must use THIS number — per-device
+        HBM is what admission headroom protects (the HBM ledger's unit,
+        compile_insight.array_nbytes_per_device)."""
+        return self.pool_bytes() // self.tp
 
     @property
     def num_free(self):

@@ -478,6 +478,14 @@ class ContinuousBatchingScheduler:
 
     def stats(self):
         with self._lock:
+            # watermark headroom in the unit it actually protects:
+            # bytes ONE device keeps free. Block ids are replicated host
+            # state, but under a head-sharded mesh each block costs
+            # shard_pool_bytes()/num_blocks per device — the watermark's
+            # byte value shrinks with the tp degree, the block count
+            # does not.
+            shard_block_bytes = (self._cache.shard_pool_bytes()
+                                 // self._cache.num_blocks)
             return {
                 "iteration": self.iteration,
                 "queue_depth": len(self._queue),
@@ -486,5 +494,10 @@ class ContinuousBatchingScheduler:
                 "blocks_total": self._cache.usable_blocks,
                 "blocks_free": self._cache.num_free,
                 "block_utilization": round(self._cache.utilization(), 4),
+                "watermark_blocks": self.watermark_blocks,
+                "watermark_shard_bytes": self.watermark_blocks
+                * shard_block_bytes,
+                "free_shard_bytes": self._cache.num_free
+                * shard_block_bytes,
                 **dict(self.counts),
             }

@@ -91,6 +91,41 @@ def pytest_sessionfinish(session, exitstatus):
 
 
 @pytest.fixture
+def tp_subprocess():
+    """Run a python snippet in a FRESH process pinned to an N-device
+    CPU topology (`XLA_FLAGS=--xla_force_host_platform_device_count=N`,
+    `JAX_PLATFORMS=cpu`) — the documented multi-device serving recipe
+    (docs/serving.md "Serving on a mesh"). The in-session suite already
+    runs on the 8-device mesh this conftest forces above; this fixture
+    exists so `tp`-marked tests can prove the standalone recipe works
+    WITHOUT re-initializing (and so poisoning) the current session's
+    jax backend. Returns run(code, devices=2, timeout=300) ->
+    CompletedProcess."""
+    import subprocess
+    import sys
+
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+
+    def run(code, devices=2, timeout=300):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        # appended, not overwritten: the session's other XLA flags
+        # survive, and XLA's last-occurrence-wins parsing still pins
+        # OUR device count (the fixture's whole point)
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count="
+                            f"{int(devices)}").strip()
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        return subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=timeout, env=env, cwd=repo_root)
+
+    return run
+
+
+@pytest.fixture
 def bert_classifier_export(tmp_path):
     """(model_dir, infer_feed, ref_probs): ONE copy of the shared
     save_inference_model + reference-forward recipe (tiny BERT

@@ -224,6 +224,69 @@ def test_bad_env_value_raises(monkeypatch):
         kvc.paged_kernel_mode()
 
 
+@pytest.mark.parametrize("env", [None, "1"], ids=["auto", "force"])
+def test_dispatch_vmap_trace_falls_back_with_reason(monkeypatch, env):
+    """ISSUE 9 satellite: a vmap trace must never take the kernel —
+    batching a PrefetchScalarGridSpec pallas_call is outside its TPU
+    contract (the CPU interpreter happens to cope, the compiled path
+    is unvalidated) — and must not raise mid-trace even under force.
+    The fallback lands with the distinct vmap_trace reason label so a
+    dashboard can tell this degradation from an operator pin."""
+    from paddle_tpu.observability.metrics import global_registry
+    if env is None:
+        monkeypatch.delenv("PADDLE_TPU_PAGED_KERNEL", raising=False)
+    else:
+        monkeypatch.setenv("PADDLE_TPU_PAGED_KERNEL", env)
+    q, k_pool, v_pool, tables, pos = make_case(b=2, c=1, m=3, seed=8)
+    qq = jnp.stack([q, q + 1])
+    k0, f0 = kvc.KERNEL_DISPATCHES, kvc.FALLBACK_DISPATCHES
+    reason = global_registry().counter(
+        "serving.kernel.fallback").labels(reason="vmap_trace")
+    r0 = reason.value()
+    out = jax.jit(jax.vmap(
+        lambda a: kvc.paged_attention(a, k_pool, v_pool, tables,
+                                      pos)))(qq)
+    assert kvc.KERNEL_DISPATCHES == k0      # kernel NOT taken
+    assert kvc.FALLBACK_DISPATCHES == f0 + 1
+    assert reason.value() == r0 + 1
+    assert kvc.kernel_dispatch_stats()["fallback_reasons"][
+        "vmap_trace"] >= 1
+    ref = jax.jit(jax.vmap(
+        lambda a: kvc.paged_attention_reference(
+            a, k_pool, v_pool, tables, pos)))(qq)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_dispatch_fallback_reason_labels(monkeypatch):
+    """The other fallback reasons ride the same labeled series:
+    pinned_off for PADDLE_TPU_PAGED_KERNEL=0, unsupported for
+    non-qualifying operands in auto mode."""
+    from paddle_tpu.observability.metrics import global_registry
+    reg = global_registry()
+    args = make_case(seed=12)
+    off = reg.counter("serving.kernel.fallback").labels(
+        reason="pinned_off")
+    uns = reg.counter("serving.kernel.fallback").labels(
+        reason="unsupported")
+    o0, u0 = off.value(), uns.value()
+    monkeypatch.setenv("PADDLE_TPU_PAGED_KERNEL", "0")
+    kvc.paged_attention(*args)
+    assert off.value() == o0 + 1 and uns.value() == u0
+    monkeypatch.delenv("PADDLE_TPU_PAGED_KERNEL", raising=False)
+    q, k_pool, v_pool, tables, pos = args
+    kvc.paged_attention(q, k_pool.astype(jnp.float16),
+                        v_pool.astype(jnp.float16), tables, pos)
+    assert uns.value() == u0 + 1
+    # a deliberate pin DOMINATES: off mode under a vmap trace still
+    # records pinned_off, never vmap_trace — a dashboard alerting on
+    # non-pinned_off fallback reasons must not page on the pin
+    monkeypatch.setenv("PADDLE_TPU_PAGED_KERNEL", "0")
+    o1 = off.value()
+    jax.vmap(lambda a: kvc.paged_attention(a, k_pool, v_pool, tables,
+                                           pos))(jnp.stack([q, q]))
+    assert off.value() == o1 + 1
+
+
 def test_kernel_validates_shapes():
     q, k_pool, v_pool, tables, pos = make_case(seed=6)
     with pytest.raises(ValueError, match="do not match"):

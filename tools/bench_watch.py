@@ -62,6 +62,18 @@ def log(msg, to_file=True):
         f.write(line + "\n")
 
 
+def _xla_flags_with_device_count(n):
+    """The operator's XLA_FLAGS with --xla_force_host_platform_device_
+    count=<n> appended — unless they already set a device count, which
+    wins (XLA parses last-occurrence-wins, so appending would silently
+    override theirs)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        return flags
+    return (flags
+            + f" --xla_force_host_platform_device_count={int(n)}").strip()
+
+
 def run_step(name, cmd, env=None, timeout_s=3600, stdout_path=None,
              good_marker=None):
     """Run one suite step in a subprocess; archive stdout; never raise."""
@@ -207,6 +219,14 @@ def run_suite():
     else:
         run_step("serving_compare", [py, bench],
                  env={"JAX_PLATFORMS": "cpu", "BENCH_SERVING_COMPARE": "1",
+                      # 2 virtual CPU devices so the tp=1-vs-tp=2
+                      # serving section (ISSUE 9) has a mesh to shard
+                      # over; the single-device sections are unaffected.
+                      # Appended so an operator's other XLA_FLAGS
+                      # survive — unless they already pin a device
+                      # count, which wins (XLA is last-occurrence-wins,
+                      # so appending ours would silently override it).
+                      "XLA_FLAGS": _xla_flags_with_device_count(2),
                       # scrape the live /metrics + /slo endpoint mid-
                       # bench (ISSUE 7) and commit the sample
                       "BENCH_SLO_SAMPLE": os.path.join(
