@@ -159,6 +159,31 @@ METRIC_SPECS = [
     ("serving.kernel.interpret", "gauge",
      "1 when the paged kernel runs under the Pallas interpreter "
      "(off-TPU), 0 when compiled for a real TPU"),
+    ("serving.prefix.hits", "counter",
+     "prefix-cache chunk probes that matched an indexed block (token-"
+     "verified; the bench's hit-rate numerator)"),
+    ("serving.prefix.misses", "counter",
+     "prefix-cache chunk probes that missed (absent key, or a hash "
+     "collision rejected by the token verify — the chain walk stops "
+     "at the first miss, so one admission counts at most one miss)"),
+    ("serving.prefix.shared_blocks", "gauge",
+     "indexed KV blocks currently referenced by at least one live "
+     "request on top of the index's own ref"),
+    ("serving.prefix.evictions", "counter",
+     "cached blocks evicted from the prefix index (LRU leaf-first, "
+     "under watermark pressure or chaos injection)"),
+    ("serving.prefix.cow_copies", "counter",
+     "copy-on-write block copies (a shared block about to be written "
+     "was copied to a fresh block and the table repointed)"),
+    ("serving.spec.proposed", "counter",
+     "draft tokens submitted to fused-step verification (columns "
+     "1..q-1 of speculative decode lanes)"),
+    ("serving.spec.accepted", "counter",
+     "verified draft tokens accepted (greedy: matched the target's "
+     "own argmax; rejection mode: passed the acceptance draw)"),
+    ("serving.spec.accept_rate", "gauge",
+     "process-cumulative accepted/proposed ratio across all "
+     "speculative schedulers"),
     ("serving.mesh.axis_size", "gauge",
      "tensor-parallel mesh axis size a GenerationServer shards its "
      "fused step and KV pools over (label: server; absent single-"

@@ -170,7 +170,8 @@ ITER_FIELDS = ("step_ms", "lanes", "emitting", "prefill_tokens",
                "blocks_free", "blocks_in_use", "watermark_blocks",
                "lanes_detail", "kernel", "deadline_cancels")
 LANE_FIELDS = ("slot", "rid", "pos", "prefilling", "admit_seq",
-               "generated", "first_block")
+               "generated", "first_block", "shared_blocks",
+               "cow_copies")
 
 
 def _expand_lanes(lanes):

@@ -35,7 +35,8 @@ class ChaosInjector:
         self._write_count = 0
         self.fired = {"poison": 0, "sigterm": 0, "write_fault": 0,
                       "cancel": 0, "clock_advance": 0,
-                      "serving_poison": 0}
+                      "serving_poison": 0, "evict": 0,
+                      "hash_collision": 0}
         self._installed = False
         # serving-engine plan: iteration -> actions (scheduler hooks)
         self._serving_cancels = {}   # iteration -> [active-request index]
@@ -43,6 +44,10 @@ class ChaosInjector:
         self._clock_advances = {}    # iteration -> seconds to advance
         self._fake_now_s = 0.0
         self._drives_clock = False
+        # prefix-cache plan (serving/prefix_cache.py hooks)
+        self._serving_evicts = {}    # iteration -> evictions to force
+        self._collide_hashes = set() # 1-based content-hash ordinals
+        self._hash_count = 0
 
     # -- plan ----------------------------------------------------------
     def poison_grad_at(self, step, var=None):
@@ -159,6 +164,48 @@ class ChaosInjector:
 
     def serving_poison_applied(self):
         self.fired["serving_poison"] += 1
+
+    # -- prefix-cache hooks (serving/prefix_cache.py) ------------------
+    def evict_block_at(self, iteration, n=1):
+        """Force `n` LRU prefix-cache evictions at the start of
+        scheduler iteration `iteration` (1-based) — the deterministic
+        eviction-under-pressure path, testable without streaming enough
+        requests to actually exhaust the pool. Fires only for blocks
+        that ARE evictable (idle leaf entries); a plan keyed to an
+        iteration with nothing evictable is a no-op by design (tests
+        arrange an idle entry first)."""
+        self._serving_evicts[int(iteration)] = \
+            self._serving_evicts.get(int(iteration), 0) + int(n)
+        return self
+
+    def serving_evictions_at(self, iteration):
+        """-> number of forced evictions planned for this iteration.
+        Consumed by the scheduler's plan(); `fired["evict"]` is counted
+        by `serving_eviction_applied` only when a block was actually
+        evicted."""
+        return self._serving_evicts.pop(int(iteration), 0)
+
+    def serving_eviction_applied(self):
+        self.fired["evict"] += 1
+
+    def hash_collision_at(self, nth, times=1):
+        """Make content-hash computations nth..nth+times-1 (1-based,
+        counted across every chunk the PrefixCacheIndex hashes while
+        this injector is attached) return the COLLISION SENTINEL
+        instead of the real hash. Two different chunks forced onto the
+        sentinel collide in the index, and the token-verify fallback
+        (collision -> miss, never another prompt's KV) is exercised on
+        the real lookup path."""
+        for i in range(int(nth), int(nth) + int(times)):
+            self._collide_hashes.add(i)
+        return self
+
+    def prefix_hash_collides(self):
+        self._hash_count += 1
+        if self._hash_count in self._collide_hashes:
+            self.fired["hash_collision"] += 1
+            return True
+        return False
 
     # -- trainer hooks -------------------------------------------------
     def should_preempt(self, step):

@@ -22,7 +22,15 @@ deadline. This package is the TPU-native answer:
                   `mesh=` the pools shard over the head axis and the
                   fused step runs under shard_map (one psum per
                   sub-block, scheduler state replicated on the host —
-                  docs/serving.md "Serving on a mesh").
+                  docs/serving.md "Serving on a mesh");
+- prefix_cache.py — cross-request KV block sharing: content-hash-chain
+                  index over prompt chunks, refcounted blocks,
+                  copy-on-write on divergence, LRU eviction under
+                  watermark pressure (`prefix_cache=True`);
+- spec_decode.py — speculative decoding: a draft model proposes k
+                  tokens, the fused step verifies them in one chunked
+                  call, greedy acceptance is bitwise-exact
+                  (`spec=SpecDecodeConfig(draft_model, k)`).
 
 Entry points: `GenerationServer(GPTServingModel.from_scope(scope, cfg))`
 directly, or `AnalysisConfig.enable_generation(...)` +
@@ -33,14 +41,17 @@ has the block-table layout and tuning guide.
 from .kv_cache import (NULL_BLOCK, PagedDecodeLayer, PagedKVCache,
                        build_paged_decode_cache, gather_block_kv,
                        paged_attention, paged_attention_reference)
+from .prefix_cache import PrefixCacheIndex
 from .scheduler import (ContinuousBatchingScheduler, DeadlineExceeded,
                         GenerationResult, RequestCancelled)
 from .engine import GenerationFuture, GenerationServer, GPTServingModel
+from .spec_decode import SpecDecodeConfig
 
 __all__ = [
     "PagedKVCache", "PagedDecodeLayer", "paged_attention",
     "paged_attention_reference", "gather_block_kv",
     "build_paged_decode_cache", "NULL_BLOCK",
+    "PrefixCacheIndex", "SpecDecodeConfig",
     "ContinuousBatchingScheduler", "GenerationResult",
     "DeadlineExceeded", "RequestCancelled",
     "GenerationServer", "GenerationFuture", "GPTServingModel",

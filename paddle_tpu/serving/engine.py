@@ -4,13 +4,21 @@ BatchingServer submit/Future surface.
 The whole serve loop is ONE jitted fused prefill/decode step:
 
     fused(pools, tokens (S, C), positions (S, C), valid (S, C),
-          tables (S, M)) -> (pools, next_ids (S,), next_logps (S,))
+          tables (S, M)) -> (pools, next_ids, next_logps[, fed_logps])
 
 S decode slots x C chunk columns, shapes fixed for the server lifetime
 — a prefilling lane feeds up to C prompt tokens per iteration, a
-decoding lane feeds its one in-flight token, an idle lane is masked.
-Requests of any length mix freely in one executable; after warmup the
-jit cache holds exactly one signature (asserted via get_stats()).
+decoding lane feeds its one in-flight token (or, in speculative mode,
+its token plus up to k draft proposals to verify in the same
+prefill-shaped call), an idle lane is masked. Plain serving projects
+each lane's LAST valid column only ((S,) outputs); a speculative
+server's step projects every column ((S, C) outputs plus fed-token
+logps) so acceptance can compare the target's choice at each draft
+position. Requests of any length mix freely in one executable; after
+warmup the jit cache holds exactly one fused signature (asserted via
+get_stats()), plus at most one draft-step signature when speculative
+decoding is on (spec_decode.py) — the whole server lifetime compiles
+at most two step functions.
 
 The model side is pluggable; GPTServingModel adapts models/gpt.py
 params (same math as gpt.build_kv_step, vectorized over the chunk
@@ -44,7 +52,8 @@ _SERVER_SEQ = itertools.count()
 
 
 def _fused_step_body(params, cfg, block_size, h_count, d, reduce_fn,
-                     pools, tokens, positions, valid, tables):
+                     pools, tokens, positions, valid, tables,
+                     per_column=False):
     """The ONE fused prefill/decode step body (build_kv_step's math over
     (S, C) ragged lanes with paged KV), shared by the single-device and
     tensor-parallel fused steps exactly like gpt._prefill_forward:
@@ -52,7 +61,19 @@ def _fused_step_body(params, cfg, block_size, h_count, d, reduce_fn,
     shard_map over head-sharded params and pools) and `reduce_fn`
     finishes the row-parallel o-proj / ffn-down contractions (identity
     single-device; one psum per sub-block under tp — the partial sums
-    those matmuls leave are the ONLY cross-shard state the step has)."""
+    those matmuls leave are the ONLY cross-shard state the step has).
+
+    `per_column=False` (plain serving): each lane's LAST valid column
+    is gathered before the lm-head projection — one (S, H) @ (H, V)
+    gemm, returns (pools, next_ids (S,), next_logps (S,)).
+    `per_column=True` (speculative verify): every column is projected —
+    (S*C, H) @ (H, V) — and a third `fed_logps` output carries the
+    target logp of each NEXT fed column's token (the draft under
+    verification; rejection-mode acceptance needs p_target(draft)).
+    Rows of the wide gemm are independent dot products, so a column's
+    outputs are bitwise the last-column gather's (the spec parity tests
+    pin this); plain servers keep the narrow gemm — C x fewer lm-head
+    FLOPs on the decode hot path."""
     s, c = tokens.shape
     pos = jnp.where(valid, positions, 0)
     x = params["word_emb"][tokens] + params["pos_emb"][pos]
@@ -80,14 +101,27 @@ def _fused_step_body(params, cfg, block_size, h_count, d, reduce_fn,
         x = x + (reduce_fn(f @ lp["f1w"]) + lp["f1b"])
         new_pools.append({"k": kp, "v": vp})
     x = _ln(x, params["lnf_s"], params["lnf_b"])
-    # next token comes from each lane's LAST valid column only
-    last = jnp.clip(valid.sum(1) - 1, 0, c - 1)
-    xl = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
-    logits = xl @ params["word_emb"].T
+    if not per_column:
+        # next token comes from each lane's LAST valid column only
+        last = jnp.clip(valid.sum(1) - 1, 0, c - 1)
+        xl = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+        logits = xl @ params["word_emb"].T
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        nxt = jnp.argmax(logp, axis=-1)
+        chosen = jnp.take_along_axis(logp, nxt[:, None], -1)[:, 0]
+        return new_pools, nxt.astype(jnp.int32), chosen
+    vocab = params["word_emb"].shape[0]
+    logits = (x.reshape(s * c, -1) @ params["word_emb"].T).reshape(
+        s, c, vocab)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32))
-    nxt = jnp.argmax(logp, axis=-1)
-    chosen = jnp.take_along_axis(logp, nxt[:, None], -1)[:, 0]
-    return new_pools, nxt.astype(jnp.int32), chosen
+    nxt = jnp.argmax(logp, axis=-1)                         # (S, C)
+    chosen = jnp.take_along_axis(logp, nxt[..., None], -1)[..., 0]
+    # target logp of the NEXT FED column's token — the draft under
+    # verification at this column; rejection-sampled acceptance needs
+    # p_target(draft). The last column's value wraps and is meaningless.
+    nt = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
+    fed = jnp.take_along_axis(logp, nt[..., None], -1)[..., 0]
+    return new_pools, nxt.astype(jnp.int32), chosen, fed
 
 
 class GPTServingModel:
@@ -112,7 +146,8 @@ class GPTServingModel:
     def from_scope(cls, scope, cfg, dtype=None):
         return cls(load_params(scope, cfg), cfg, dtype=dtype)
 
-    def build_fused_step(self, block_size, mesh=None, axis="tp"):
+    def build_fused_step(self, block_size, mesh=None, axis="tp",
+                         per_column=False):
         params, cfg = self.params, self.cfg
         h_, d = self.num_heads, self.head_dim
 
@@ -120,9 +155,14 @@ class GPTServingModel:
             def fused(pools, tokens, positions, valid, tables):
                 return _fused_step_body(
                     params, cfg, block_size, h_, d, lambda z: z,
-                    pools, tokens, positions, valid, tables)
+                    pools, tokens, positions, valid, tables,
+                    per_column=per_column)
 
             return fused
+        if per_column:
+            raise NotImplementedError(
+                "per-column outputs (speculative verify) are not "
+                "supported under a mesh yet")
 
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
@@ -236,7 +276,7 @@ class GenerationServer:
                  watermark_blocks=0, chaos=None, start=True,
                  telemetry=True, slo_window_s=60.0, flight_dir=None,
                  flight_capacity=256, deadline_storm=3, mesh=None,
-                 mesh_axis="tp"):
+                 mesh_axis="tp", prefix_cache=False, spec=None):
         self.model = model
         self.block_size = int(block_size)
         self.mesh = mesh
@@ -270,6 +310,37 @@ class GenerationServer:
         if chaos is not None and clock is None and \
                 getattr(chaos, "drives_clock", lambda: False)():
             clock = chaos.serving_clock
+        # HBM-ledger component id: assigned early — the prefix index
+        # labels its gauge series with it
+        self._ledger_id = f"serving{next(_SERVER_SEQ)}"
+        # prefix cache (serving/prefix_cache.py): cross-request block
+        # sharing by content hash. True builds a fresh index over this
+        # server's pool; tests may pass a pre-built PrefixCacheIndex.
+        self._prefix = None
+        if prefix_cache:
+            from .prefix_cache import PrefixCacheIndex
+            self._prefix = (prefix_cache if not isinstance(
+                prefix_cache, bool)
+                else PrefixCacheIndex(self.cache, chaos=chaos,
+                                      label=self._ledger_id))
+        # speculative decoding (serving/spec_decode.py)
+        self._spec = spec
+        self._draft_cache = None
+        self._draft = None
+        self._draft_signatures = set()
+        if spec is not None:
+            if mesh is not None:
+                raise NotImplementedError(
+                    "speculative decoding on a mesh is not supported "
+                    "yet — run spec servers single-device (the draft "
+                    "step under shard_map is follow-up work, "
+                    "docs/serving.md)")
+            dm = spec.draft_model
+            if dm.cfg.vocab_size != model.cfg.vocab_size:
+                raise ValueError(
+                    f"draft model vocab {dm.cfg.vocab_size} != target "
+                    f"vocab {model.cfg.vocab_size} — proposals are fed "
+                    f"straight into the target's verify step")
         # request-level telemetry (observability/serving_telemetry.py):
         # lifecycle span trees, SLO digests, and the fault flight
         # recorder. telemetry=False runs the bare PR-6 engine (the
@@ -291,14 +362,40 @@ class GenerationServer:
             self.cache, num_slots=num_slots, chunk=chunk,
             max_context=max_context, clock=clock,
             watermark_blocks=watermark_blocks, chaos=chaos,
-            telemetry=telemetry)
+            telemetry=telemetry, prefix_cache=self._prefix,
+            spec_k=spec.k if spec is not None else 0,
+            spec_mode=spec.mode if spec is not None else "greedy",
+            spec_seed=spec.seed if spec is not None else 0)
         self.max_context = max_context
-        # mesh kwargs only when sharding: a custom model implementing
-        # the original build_fused_step(block_size) keeps working
-        self._fused = jax.jit(
-            model.build_fused_step(self.block_size) if mesh is None
-            else model.build_fused_step(self.block_size, mesh=mesh,
-                                        axis=mesh_axis))
+        if spec is not None:
+            # draft pools mirror the target pool's block ids (same
+            # num_blocks/block_size, the draft's own head geometry) —
+            # one host allocation drives both, and cow_copy keeps the
+            # sibling rows consistent with every repointed table
+            dm = spec.draft_model
+            self._draft_cache = PagedKVCache(
+                dm.num_layers, dm.num_heads, dm.head_dim,
+                self.cache.num_blocks, block_size=self.block_size,
+                dtype=dm.kv_dtype)
+            self.cache.attach_sibling(self._draft_cache)
+            from .spec_decode import build_draft_step
+            self._draft = jax.jit(build_draft_step(
+                dm, self.block_size, spec.k))
+        # mesh/per_column kwargs only when needed: a custom model
+        # implementing the original build_fused_step(block_size) keeps
+        # working for plain single-device serving. Speculative servers
+        # are the ONLY ones that pay the per-column lm-head projection
+        # (C x the narrow gemm) — plain decode reads one column per
+        # lane, so it keeps the last-column gather.
+        if mesh is not None:
+            fused = model.build_fused_step(self.block_size, mesh=mesh,
+                                           axis=mesh_axis)
+        elif spec is not None:
+            fused = model.build_fused_step(self.block_size,
+                                           per_column=True)
+        else:
+            fused = model.build_fused_step(self.block_size)
+        self._fused = jax.jit(fused)
         self._signatures = set()
         # HBM ledger (observability/compile_insight.py): the serving
         # side of get_stats()["memory"] / the /memory endpoint — block
@@ -312,7 +409,6 @@ class GenerationServer:
         # close() retires the rows on BOTH teardown paths.
         from ..observability.compile_insight import (array_nbytes,
                                                      hbm_ledger)
-        self._ledger_id = f"serving{next(_SERVER_SEQ)}"
         kv_bytes = self.cache.pool_bytes()
         shard_bytes = self.cache.shard_pool_bytes()
         param_bytes = sum(array_nbytes(a) for a in
@@ -347,10 +443,36 @@ class GenerationServer:
                      param_bytes,
                      detail={"source": "serving model",
                              "per_device_bytes": param_dev_bytes})
+        # speculative decoding: the draft pools and draft params are
+        # REAL extra residency — their own rows, under this server's
+        # component id so close() retires them too. Shared prefix
+        # blocks, by contrast, are NOT extra bytes: the pool rows above
+        # are the preallocated pools' full footprint whoever holds the
+        # block refs, so sharing can never double-count a block.
+        draft_bytes = 0
+        if spec is not None:
+            draft_pool_bytes = self._draft_cache.pool_bytes()
+            draft_param_bytes = sum(
+                array_nbytes(a) for a in
+                jax.tree_util.tree_leaves(spec.draft_model.params))
+            led.register(self._ledger_id, "draft_kv_pool", "kv_cache",
+                         draft_pool_bytes,
+                         detail={"layers": spec.draft_model.num_layers,
+                                 "num_blocks": self.cache.num_blocks,
+                                 "block_size": self.block_size,
+                                 "heads": spec.draft_model.num_heads,
+                                 "head_dim": spec.draft_model.head_dim,
+                                 "spec_k": spec.k})
+            led.register(self._ledger_id, "draft_params", "params",
+                         draft_param_bytes,
+                         detail={"source": "spec draft model"})
+            draft_bytes = draft_pool_bytes + draft_param_bytes
         # peak is PER DEVICE (compile_insight's unit): one shard's
-        # params + its kv shard + the replicated activations
+        # params + its kv shard + the replicated activations (+ the
+        # draft model's pools and params when speculating)
         led.register(self._ledger_id, "fused_step", "peak_hbm",
-                     param_dev_bytes + shard_bytes + act_est,
+                     param_dev_bytes + shard_bytes + act_est
+                     + draft_bytes,
                      detail={"source": "static",
                              "activation_bytes_est": act_est,
                              "per_device": True})
@@ -539,6 +661,13 @@ class GenerationServer:
                             # this iteration — defer, don't no-op
                             self._chaos.poison_serving_at(
                                 it + 1, poison_layer)
+                # speculative mode: the draft step runs EVERY iteration
+                # (its KV must track prefill chunks too, not just
+                # decode lanes) and its proposals land in plan.tokens
+                # columns 1..q-1 before the fused step verifies them
+                draft_logps = None
+                if self._draft is not None:
+                    draft_logps = self._run_draft(plan)
                 args = (jnp.asarray(plan.tokens),
                         jnp.asarray(plan.positions),
                         jnp.asarray(plan.valid),
@@ -557,17 +686,32 @@ class GenerationServer:
                         self._kernel_mode = _kvc.paged_kernel_mode()
                         k0, f0 = (_kvc.KERNEL_DISPATCHES,
                                   _kvc.FALLBACK_DISPATCHES)
-                        pools, nxt, logps = self._fused(
-                            self.cache.pools, *args)
+                        out = self._fused(self.cache.pools, *args)
                         self._kernel_counts = (
                             _kvc.KERNEL_DISPATCHES - k0,
                             _kvc.FALLBACK_DISPATCHES - f0)
                     self._check_kernel_engagement()
                 else:
-                    pools, nxt, logps = self._fused(self.cache.pools,
-                                                    *args)
-                self.cache.pools = pools
-                nxt, logps = np.asarray(nxt), np.asarray(logps)
+                    out = self._fused(self.cache.pools, *args)
+                # plain mode: (pools, ids (S,), logps (S,)) from the
+                # last-column step; spec mode adds fed_logps and every
+                # output is per-column (S, C)
+                self.cache.pools = out[0]
+                nxt, logps = np.asarray(out[1]), np.asarray(out[2])
+                if nxt.ndim == 1:
+                    # commit() reads per-column arrays; a broadcast
+                    # VIEW puts the last-valid-column value at every
+                    # column (a prefill lane reads col n-1, a decode
+                    # lane col 0 — both ARE that value), zero copies
+                    s, c = plan.tokens.shape
+                    nxt = np.broadcast_to(nxt[:, None], (s, c))
+                    logps = np.broadcast_to(logps[:, None], (s, c))
+                # target-logp-of-fed-token only matters to the
+                # rejection-sampled acceptance; don't pay its host
+                # transfer otherwise
+                fed = (np.asarray(out[3])
+                       if self._spec is not None
+                       and self._spec.mode == "rejection" else None)
             # non-finite logits guard: one reduce on the hot path (a
             # NaN/Inf anywhere makes the sum non-finite; idle lanes
             # hold finite garbage); the per-slot triage only runs on a
@@ -576,10 +720,12 @@ class GenerationServer:
             # ufunc dispatch on this every-iteration path. The
             # fail-stop is a safety feature and runs regardless of
             # telemetry — only the flight-recorder dump needs it
-            if plan.slot_ids and not math.isfinite(logps.sum()):
+            if plan.slot_ids and not math.isfinite(float(logps.sum())):
                 if not np.all(np.isfinite(logps[plan.slot_ids])):
                     self._on_engine_fault(plan, it, logps, lanes)
-            retired = self._sched.commit(plan, nxt, logps)
+            retired = self._sched.commit(plan, nxt, logps,
+                                         fed_logps=fed,
+                                         draft_logps=draft_logps)
             self._m["iterations"].inc()
             step_ms = (time.perf_counter() - t0) * 1e3
             self._m["step_ms"].observe(step_ms)
@@ -605,6 +751,35 @@ class GenerationServer:
                     lanes,                          # lanes_detail
                     self._kernel_info()))
             return True
+
+    def _run_draft(self, plan):
+        """One draft-step call: sync the draft KV with this iteration's
+        feed (prefill chunks; each decode lane's committed token), roll
+        out k proposals per decode lane, and write the proposals into
+        plan.tokens columns 1..q-1 for the fused verify step. Returns
+        the draft's per-proposal logps (S, k) for rejection-mode
+        acceptance."""
+        valid_d = plan.valid.copy()
+        spec_go = plan.decode_cols >= 1
+        for sid in plan.slot_ids:
+            if int(plan.decode_cols[sid]) > 1:
+                # the draft's sync pass feeds ONLY the committed token;
+                # the verify columns belong to the target step
+                valid_d[sid, 1:] = False
+        dpools, props, dlps = self._draft(
+            self._draft_cache.pools, jnp.asarray(plan.tokens),
+            jnp.asarray(plan.positions), jnp.asarray(valid_d),
+            jnp.asarray(plan.tables), jnp.asarray(spec_go),
+            jnp.asarray(plan.limits))
+        self._draft_signatures.add(
+            (plan.tokens.shape, plan.tables.shape))
+        self._draft_cache.pools = dpools
+        props = np.asarray(props)
+        for sid in plan.slot_ids:
+            q = int(plan.decode_cols[sid])
+            if q > 1:
+                plan.tokens[sid, 1:q] = props[sid, :q - 1]
+        return np.asarray(dlps)
 
     def _kernel_info(self):
         # constant after the first step: built once, reused by every
@@ -644,7 +819,7 @@ class GenerationServer:
         fail-stop + postmortem artifact beats serving garbage."""
         from ..robustness.guard import NonFiniteError
         bad = [int(s) for s in plan.slot_ids
-               if not np.isfinite(logps[s])]
+               if not np.all(np.isfinite(logps[s]))]
         if lanes is None:       # telemetry off: plan carries no lane
             lanes = self._sched.lane_snapshot()     # detail — cold path
         # lanes are LANE_FIELDS-order tuples: l[0]=slot, l[1]=rid
@@ -759,6 +934,8 @@ class GenerationServer:
                 from ..observability.compile_insight import hbm_ledger
                 hbm_ledger().retire(self._ledger_id)
                 self._retire_mesh_gauges()
+                if self._prefix is not None:
+                    self._prefix.drop_gauges()
                 return
             if not drain:
                 self._sched.cancel_all(RequestCancelled(
@@ -786,6 +963,8 @@ class GenerationServer:
         from ..observability.compile_insight import hbm_ledger
         hbm_ledger().retire(self._ledger_id)    # and its memory.* rows
         self._retire_mesh_gauges()              # and its serving.mesh.*
+        if self._prefix is not None:            # and its prefix gauge
+            self._prefix.drop_gauges()
 
     def _retire_mesh_gauges(self):
         """Drop this server's serving.mesh.* gauge series (idempotent;
@@ -807,6 +986,25 @@ class GenerationServer:
         st["chunk"] = self._sched.chunk
         st["block_size"] = self.block_size
         st["max_context"] = self.max_context
+        # speculative decoding: the compiled-signature budget for the
+        # whole server lifetime is fused + draft (<= 2; the acceptance
+        # gauge alongside fused_step_signatures == 1)
+        st["draft_step_signatures"] = len(self._draft_signatures)
+        st["compiled_step_signatures"] = (len(self._signatures)
+                                          + len(self._draft_signatures))
+        proposed = st.pop("spec.proposed", 0)
+        accepted = st.pop("spec.accepted", 0)
+        if self._spec is not None:
+            st["spec"] = {
+                "k": self._spec.k,
+                "mode": self._spec.mode,
+                "proposed": proposed,
+                "accepted": accepted,
+                "accept_rate": round(accepted / max(proposed, 1), 4),
+                "draft_step_signatures": len(self._draft_signatures),
+            }
+        else:
+            st["spec"] = None
         traced, fell_back = self._kernel_counts
         st["kernel"] = {
             # the mode the fused step actually TRACED under — a later

@@ -31,6 +31,19 @@ interface `decoding.py` step_fns consume (`cache[i]["k"]`,
 `update_kv_cache`), so an existing step_fn decodes against either cache
 unchanged (beam search still needs the dense cache: `_gather_beams`
 reorders lanes by leading dim, which a shared pool does not have).
+
+Cross-request block sharing (ISSUE 10): every allocated block carries a
+host-side refcount. The prefix cache (serving/prefix_cache.py) refs a
+block it indexes and every request using a shared block refs it too;
+`unref` hands a block back to the free list only when the LAST
+reference drops, and `free` (the raw single-owner API) refuses both a
+double free and a free of a block somebody else still references —
+with refcounts in play a silent double free would hand one block to
+two requests and corrupt both. `cow_copy` is the copy-on-write
+primitive: copy one block's rows to a fresh block in every pool (and
+every attached sibling cache — the speculative-decoding draft pools
+share block ids) so the writer's table can be repointed while readers
+keep the original.
 """
 
 import os
@@ -348,6 +361,16 @@ class PagedKVCache:
                       for _ in range(self.num_layers)]
         # LIFO free list; block 0 (NULL) is never handed out
         self._free = list(range(self.num_blocks - 1, 0, -1))
+        # host-side refcounts: block -> live references (absent = free).
+        # allocate() hands a block out at refcount 1; the prefix cache
+        # and additional requests ref() shared blocks on top.
+        self._ref = {}
+        # sibling caches whose pools share THIS cache's block ids (the
+        # speculative-decoding draft pools): cow_copy copies their rows
+        # too, so a repointed table means the same thing in both.
+        self._siblings = []
+        self._cow_fn = None
+        self.cow_copies = 0
 
     # -- allocation --------------------------------------------------------
     @property
@@ -390,13 +413,95 @@ class PagedKVCache:
         if n > len(self._free):
             return None
         taken = [self._free.pop() for _ in range(n)]
+        for b in taken:
+            self._ref[b] = 1
         return taken
 
     def free(self, blocks):
+        """Single-owner release. Refuses a double free (block already
+        on the free list) and a free of a block with other live
+        references — both were silently accepted before refcounts
+        existed, and with cross-request sharing either one hands the
+        same block to two requests. Shared blocks go through unref()."""
         for b in blocks:
+            b = int(b)
             if b == NULL_BLOCK:
                 raise ValueError("freeing the reserved NULL block")
+            c = self._ref.get(b, 0)
+            if c == 0:
+                raise ValueError(
+                    f"double free of block {b}: it is already on the "
+                    f"free list")
+            if c > 1:
+                raise ValueError(
+                    f"freeing block {b} while {c - 1} other "
+                    f"reference(s) are live — shared blocks are "
+                    f"released with unref()")
+            del self._ref[b]
             self._free.append(b)
+
+    # -- refcounts (cross-request block sharing) ---------------------------
+    def ref(self, block):
+        """One more reference to an allocated block (a request matching
+        a cached prefix chunk, or the prefix index adopting a block)."""
+        block = int(block)
+        if block == NULL_BLOCK:
+            raise ValueError("ref of the reserved NULL block")
+        if block not in self._ref:
+            raise ValueError(f"ref of free block {block}")
+        self._ref[block] += 1
+
+    def unref(self, block):
+        """Drop one reference; the block returns to the free list only
+        when the LAST reference drops. Returns True when it was freed."""
+        block = int(block)
+        c = self._ref.get(block, 0)
+        if c == 0:
+            raise ValueError(f"unref of free block {block}")
+        if c == 1:
+            del self._ref[block]
+            self._free.append(block)
+            return True
+        self._ref[block] = c - 1
+        return False
+
+    def refcount(self, block):
+        return self._ref.get(int(block), 0)
+
+    def is_shared(self, block):
+        """True when more than one reference is live (another request
+        or the prefix index) — a write must copy-on-write first."""
+        return self._ref.get(int(block), 0) >= 2
+
+    # -- copy-on-write -----------------------------------------------------
+    def attach_sibling(self, sibling):
+        """Register a cache whose pools share this cache's block ids
+        (the spec-decode draft pools): cow_copy keeps them consistent."""
+        self._siblings.append(sibling)
+        self._cow_fn = None         # pytree layout changed: rebuild
+
+    def cow_copy(self, src, dst):
+        """Device-copy block `src`'s rows into block `dst` across every
+        layer of this cache's pools AND every sibling's (draft pools
+        share block ids, so a repointed table must mean the same rows
+        there too). One jitted signature for the cache lifetime: the
+        block ids ride as traced scalars, so distinct (src, dst) pairs
+        hit the same executable — the fused-step signature budget is
+        untouched."""
+        if self._cow_fn is None:
+            def _copy(pool_sets, s, d):
+                return [
+                    [{"k": p["k"].at[d].set(p["k"][s]),
+                      "v": p["v"].at[d].set(p["v"][s])} for p in pools]
+                    for pools in pool_sets]
+            self._cow_fn = jax.jit(_copy)
+        holders = [self] + self._siblings
+        new_sets = self._cow_fn([h.pools for h in holders],
+                                jnp.asarray(src, jnp.int32),
+                                jnp.asarray(dst, jnp.int32))
+        for h, pools in zip(holders, new_sets):
+            h.pools = pools
+        self.cow_copies += 1
 
     # -- layout helpers ----------------------------------------------------
     def make_table(self, blocks, max_blocks):

@@ -1,0 +1,303 @@
+"""Prefix cache: cross-request KV block sharing by content hash.
+
+Real fleets serve millions of requests that mostly share system
+prompts. The paged layout makes sharing nearly free: a prompt is a
+sequence of `block_size`-token chunks, each chunk's KV lives in exactly
+one pool block, and the fused step is deterministic — so two requests
+whose prompts share a leading chunk sequence can share the BLOCKS
+bitwise, not just semantically.
+
+The index is a hash *chain*: chunk i's key is
+``H(key(i-1), tokens[i*bs:(i+1)*bs])``, so a chunk is only ever matched
+under the exact prefix that produced its KV (position embeddings and
+causal attention make a chunk's KV depend on everything before it).
+Each entry stores the chunk's tokens verbatim — a lookup verifies them
+against the probing prompt before trusting the hash, so a hash
+collision degrades to a cache miss, never to silently serving another
+prompt's KV (`ChaosInjector.hash_collision_at` forces this path
+deterministically in tests).
+
+Lifecycle (refcounts live in PagedKVCache):
+
+- **register**: when a request's prefill completes a full prompt chunk,
+  the scheduler offers (chain key, tokens, block) here; the index takes
+  its own ref on the block. The request keeps its ref too — retirement
+  unrefs instead of frees, so an indexed block survives its author.
+- **match / claim**: admission probes the chain (`match` — pure, so a
+  backpressured retry moves no metrics and no LRU recency) and, when it
+  proceeds, `claim`s the matched blocks: one ref each for the admitting
+  request, recency touches, hit/miss counters. Only the UNSHARED suffix
+  of the prompt is newly allocated (and prefilled — matched positions
+  skip straight past the prefill queue).
+- **idle / LRU**: an indexed block whose only remaining ref is the
+  index's is *evictable*. Under pool pressure the scheduler evicts
+  least-recently-touched entries before backpressuring admission.
+  Eviction is leaf-first: an entry with a live indexed child is never
+  evicted (the chain walk could otherwise strand reachable children),
+  and since any request that refs a child refs its ancestors too, an
+  idle parent implies idle children — `evictable_total()` is simply the
+  idle-entry count.
+- **copy-on-write**: when an admitted request must WRITE into a shared
+  block (the full-cover case: its whole prompt matched, so the last
+  prompt token is re-fed into the last shared block to produce first-
+  token logits), the scheduler copies the block first
+  (`PagedKVCache.cow_copy`) and repoints the table; the index keeps the
+  original.
+
+Everything here is host bookkeeping under the scheduler lock — dict
+and hash work, no jax. Metrics: ``serving.prefix.{hits,misses,
+shared_blocks,evictions,cow_copies}`` (docs/serving.md has the tuning
+guide, docs/observability.md the metric semantics).
+"""
+
+import hashlib
+import itertools
+
+import numpy as np
+
+__all__ = ["PrefixCacheIndex"]
+
+_INDEX_SEQ = itertools.count()
+
+# sentinel chain key returned by a chaos-forced hash collision: a real
+# blake2b collision is not constructible in a test, so the injector
+# makes two DIFFERENT chunks hash to this value and the token-verify
+# fallback does the rest
+COLLISION_SENTINEL = "collision!"
+
+
+class _Entry:
+    __slots__ = ("key", "block", "tokens", "parent", "children",
+                 "last_touch")
+
+    def __init__(self, key, block, tokens, parent, touch):
+        self.key = key
+        self.block = block              # pool block id (index holds a ref)
+        self.tokens = tokens            # np.int32 (block_size,) — verified
+        self.parent = parent            # parent chain key or None
+        self.children = 0               # live indexed children
+        self.last_touch = touch
+
+
+class PrefixCacheIndex:
+    """Hash-chain prefix index over one PagedKVCache. NOT thread-safe
+    on its own: every call happens under the owning scheduler's lock."""
+
+    def __init__(self, cache, chaos=None, label=None):
+        self._cache = cache
+        self._chaos = chaos
+        self._entries = {}              # chain key -> _Entry
+        self._by_block = {}             # block id -> chain key
+        self._touch = 0
+        # gauge series carry a per-index server label (the engine
+        # passes its ledger id): two live prefix servers must not
+        # clobber each other's shared_blocks reading, and drop_gauges()
+        # retires the series when the server closes (the serving.mesh
+        # / SLO gauge convention)
+        self.labels = {"server": label if label is not None
+                       else f"prefix{next(_INDEX_SEQ)}"}
+        from ..observability import _help
+        from ..observability.metrics import global_registry
+        reg = global_registry()
+        self._m_hits = reg.counter("serving.prefix.hits",
+                                   _help("serving.prefix.hits"))
+        self._m_misses = reg.counter("serving.prefix.misses",
+                                     _help("serving.prefix.misses"))
+        self._m_evictions = reg.counter("serving.prefix.evictions",
+                                        _help("serving.prefix.evictions"))
+        self._m_cow = reg.counter("serving.prefix.cow_copies",
+                                  _help("serving.prefix.cow_copies"))
+        self._g_shared = reg.gauge("serving.prefix.shared_blocks",
+                                   _help("serving.prefix.shared_blocks"))
+        self.counts = {"hits": 0, "misses": 0, "evictions": 0,
+                       "cow_copies": 0, "collisions": 0}
+
+    # -- hashing -----------------------------------------------------------
+    def chunk_key(self, parent_key, tokens):
+        """Chain key for one chunk under its prefix. Deterministic
+        content hash (blake2b over the parent key bytes + the chunk's
+        int32 token bytes); the chaos injector can force the Nth
+        computation to return the collision sentinel."""
+        if self._chaos is not None and self._chaos.prefix_hash_collides():
+            self.counts["collisions"] += 1
+            return COLLISION_SENTINEL
+        h = hashlib.blake2b(digest_size=16)
+        h.update(b"" if parent_key is None else parent_key.encode())
+        h.update(np.ascontiguousarray(tokens, np.int32).tobytes())
+        return h.hexdigest()
+
+    def chain_keys(self, prompt, n_chunks, have=None):
+        """Chain keys for the first `n_chunks` full chunks of `prompt`,
+        extending an already-computed prefix `have` (each chunk is
+        hashed at most once per request — the chaos collision injector
+        counts on that)."""
+        bs = self._cache.block_size
+        keys = list(have) if have else []
+        prev = keys[-1] if keys else None
+        for i in range(len(keys), n_chunks):
+            prev = self.chunk_key(prev, prompt[i * bs:(i + 1) * bs])
+            keys.append(prev)
+        return keys
+
+    # -- lookup (admission) ------------------------------------------------
+    def match(self, prompt, keys):
+        """PURE probe: walk the chain over `prompt`'s full chunks
+        (using the precomputed `keys` — each request hashes its chunks
+        exactly once, however many admission attempts it takes), stop
+        at the first miss or token-verify failure (the collision
+        fallback). No refs, no recency touches, no metric movement —
+        the scheduler probes on EVERY backpressured admission retry,
+        and a retry must not masquerade as cache traffic or keep
+        entries artificially hot in the LRU. Returns the matched block
+        list; `claim()` commits the match when admission proceeds."""
+        bs = self._cache.block_size
+        blocks = []
+        for i in range(len(prompt) // bs):
+            e = self._entries.get(keys[i])
+            if e is None or not np.array_equal(
+                    e.tokens, prompt[i * bs:(i + 1) * bs]):
+                # absent, or present under a colliding key with other
+                # tokens: both are a miss (the verify step is what
+                # makes a collision harmless)
+                break
+            blocks.append(e.block)
+        return blocks
+
+    def claim(self, keys, blocks, probed):
+        """Commit a successful admission's match: one ref per matched
+        block for the admitting request, recency touches, and the
+        hit/miss counters (hits = matched chunks; ONE miss if the walk
+        stopped before probing all `probed` full chunks). Must run
+        under the same scheduler-lock hold as the match — entries
+        cannot be evicted in between."""
+        for key in keys[:len(blocks)]:
+            e = self._entries[key]
+            self._cache.ref(e.block)
+            self._touch += 1
+            e.last_touch = self._touch
+        self.counts["hits"] += len(blocks)
+        if len(blocks):
+            self._m_hits.inc(len(blocks))
+        if len(blocks) < probed:
+            self.counts["misses"] += 1
+            self._m_misses.inc()
+        self._publish_shared()
+
+    def release(self, blocks):
+        """Drop one request's refs on `blocks` (matched at admission or
+        rolled back on a failed admission). Indexed blocks keep the
+        index's ref and become evictable when it is the last one;
+        unindexed blocks free normally."""
+        for b in blocks:
+            self._cache.unref(b)
+        self._publish_shared()
+
+    # -- registration (prefill completion) ---------------------------------
+    def register(self, key, parent_key, tokens, block):
+        """Adopt `block` as the cached KV for chunk `tokens` under
+        chain key `key`. No-op (False) when the key is already indexed
+        (an identical concurrent prompt registered first — the caller's
+        block stays private) or when the parent entry is gone (evicted:
+        the chain walk could never reach this entry). On success the
+        index takes its own ref so the block outlives its author."""
+        if key in self._entries:
+            return False
+        if parent_key is not None and parent_key not in self._entries:
+            return False
+        self._cache.ref(block)
+        self._touch += 1
+        e = _Entry(key, int(block), np.array(tokens, np.int32, copy=True),
+                   parent_key, self._touch)
+        self._entries[key] = e
+        self._by_block[int(block)] = key
+        if parent_key is not None:
+            self._entries[parent_key].children += 1
+        self._publish_shared()
+        return True
+
+    def drop_block(self, block):
+        """A shared block left a request's table via copy-on-write: the
+        request's ref moves to the fresh copy; the index entry stays
+        (other requests / future lookups still want the original)."""
+        self._cache.unref(block)
+        self.counts["cow_copies"] += 1
+        self._m_cow.inc()
+        self._publish_shared()
+
+    # -- eviction (LRU, leaf-first) ----------------------------------------
+    def _idle(self, e):
+        # the index's own ref is the only one left
+        return self._cache.refcount(e.block) == 1
+
+    def evictable_total(self):
+        """Blocks reclaimable by eviction right now. Idle parents imply
+        idle children (a request refs its whole matched prefix), so the
+        idle count IS the transitively-evictable count."""
+        return sum(1 for e in self._entries.values() if self._idle(e))
+
+    def evict_lru(self, protect=frozenset()):
+        """Evict the least-recently-touched idle LEAF entry; its block
+        returns to the free list. Returns the block id, or None when
+        nothing is evictable. `protect` names chain keys that must
+        survive — an admission in progress has MATCHED (but not yet
+        claimed) those entries, and evicting them out from under it
+        would invalidate the match."""
+        victim = None
+        for e in self._entries.values():
+            if e.key in protect:
+                continue
+            if e.children == 0 and self._idle(e):
+                if victim is None or e.last_touch < victim.last_touch:
+                    victim = e
+        if victim is None:
+            return None
+        del self._entries[victim.key]
+        del self._by_block[victim.block]
+        if victim.parent is not None:
+            parent = self._entries.get(victim.parent)
+            if parent is not None:
+                parent.children -= 1
+        self._cache.unref(victim.block)
+        self.counts["evictions"] += 1
+        self._m_evictions.inc()
+        self._publish_shared()
+        return victim.block
+
+    def evict_for(self, need, protect=frozenset()):
+        """Evict until `need` blocks are free (or nothing evictable is
+        left). Returns the number of blocks evicted."""
+        n = 0
+        while self._cache.num_free < need:
+            if self.evict_lru(protect) is None:
+                break
+            n += 1
+        return n
+
+    # -- introspection -----------------------------------------------------
+    def shared_block_count(self):
+        """Indexed blocks referenced by at least one live request on
+        top of the index's own ref — the serving.prefix.shared_blocks
+        gauge."""
+        return sum(1 for e in self._entries.values()
+                   if self._cache.refcount(e.block) >= 2)
+
+    def _publish_shared(self):
+        self._g_shared.labels(**self.labels).set(
+            self.shared_block_count())
+
+    def drop_gauges(self):
+        """Remove this index's gauge series from the process-wide
+        registry — a closed server must not keep reporting a shared-
+        block footprint (idempotent; both engine close paths call it)."""
+        self._g_shared.remove(**self.labels)
+
+    def __len__(self):
+        return len(self._entries)
+
+    def stats(self):
+        return {
+            "entries": len(self._entries),
+            "evictable": self.evictable_total(),
+            "shared_blocks": self.shared_block_count(),
+            **dict(self.counts),
+        }

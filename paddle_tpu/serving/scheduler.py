@@ -8,7 +8,9 @@ decoding slots contribute their one in-flight token, idle lanes are
 masked — shapes never change, so the whole serving lifetime is one
 compiled executable.
 
-Host-side state machine only (numpy, no jax): admission from a
+Host-side state machine only (numpy, no jax — the one exception is
+copy-on-write, where the scheduler asks the cache for a device block
+copy before a shared block would be written): admission from a
 FIFO-with-priority queue gated by block-pool watermark backpressure
 (admitting a request reserves blocks for its whole prompt+output up
 front, so a running request can never OOM the pool mid-flight),
@@ -16,6 +18,25 @@ retirement of EOS/length-finished lanes, per-request deadlines that
 cancel and reclaim blocks, and client cancels. Time comes from an
 injectable `clock` (seconds, monotonic) so the chaos/serving test tier
 runs without sleeps.
+
+ISSUE 10 grows two modes on the same iteration loop:
+
+- **Prefix caching** (`prefix_cache=PrefixCacheIndex(...)`): admission
+  looks the prompt's full chunks up in the hash-chain index, reserves
+  only the UNSHARED suffix (+1 copy-on-write spare when the whole
+  prompt matched), starts prefill past the shared positions, registers
+  freshly-prefilled full chunks back into the index at commit, and
+  retirement UNREFS blocks instead of freeing them. Under watermark
+  pressure admission evicts idle cached blocks (LRU, leaf-first)
+  before it backpressures.
+- **Speculative decoding** (`spec_k=k`): decode lanes plan
+  q = min(k+1, chunk, remaining) columns instead of 1; the engine
+  fills columns 1..q-1 with draft-model proposals, the fused step
+  verifies all q columns in one prefill-shaped call, and commit()
+  accepts the longest matching draft prefix plus the target's own next
+  token — 1..q tokens per lane per iteration, ids bitwise-identical to
+  plain greedy decode (rejection-sampled acceptance sits behind
+  `spec_mode="rejection"`).
 """
 
 import heapq
@@ -62,7 +83,8 @@ class GenerationResult:
 class _Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_id", "priority",
                  "deadline", "stream", "future", "submitted_at",
-                 "generated", "score", "first_token_at", "last_token_at")
+                 "generated", "score", "first_token_at", "last_token_at",
+                 "chain_keys")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_id, priority,
                  deadline, stream, future, submitted_at):
@@ -79,17 +101,25 @@ class _Request:
         self.score = 0.0
         self.first_token_at = None
         self.last_token_at = None
+        self.chain_keys = None      # prefix chunk hashes, computed once
 
 
 class _Slot:
-    __slots__ = ("req", "blocks", "table", "pos", "admit_seq")
+    __slots__ = ("req", "blocks", "table", "pos", "admit_seq", "shared",
+                 "keys", "registered", "cow_spares", "cow_copies")
 
-    def __init__(self, req, blocks, table, admit_seq):
+    def __init__(self, req, blocks, table, admit_seq, shared=(),
+                 keys=(), registered=0, cow_spares=()):
         self.req = req
-        self.blocks = blocks
+        self.blocks = blocks                # every block to release
         self.table = table                  # np.int32 (max_blocks,)
         self.pos = 0                        # next logical position to feed
         self.admit_seq = admit_seq          # admission age (chaos targets)
+        self.shared = list(shared)          # prefix-cache blocks in table
+        self.keys = list(keys)              # chunk chain keys computed
+        self.registered = registered        # prompt chunks in the index
+        self.cow_spares = list(cow_spares)  # reserved copy-on-write blocks
+        self.cow_copies = 0
 
     @property
     def prefilling(self):
@@ -104,22 +134,28 @@ def _lane_tuple(sid, slot):
     lane_snapshot())."""
     return (sid, slot.req.rid, int(slot.pos), bool(slot.prefilling),
             int(slot.admit_seq), len(slot.req.generated),
-            int(slot.blocks[0]) if slot.blocks else None)
+            int(slot.blocks[0]) if slot.blocks else None,
+            len(slot.shared), int(slot.cow_copies))
 
 
 class IterationPlan:
     """One fused step's host-built inputs + the bookkeeping commit()
     needs. `emitting[s]` marks slots whose step output IS a generated
     token (decode slots, and prefill slots finishing their prompt this
-    iteration)."""
+    iteration). `decode_cols[s]` is the number of verify columns a
+    DECODE lane plans (1 in plain mode; up to spec_k+1 in speculative
+    mode, where the engine fills columns 1..q-1 with draft proposals
+    before the fused step runs); 0 marks a prefill lane. `limits[s]` is
+    the lane's reserved token horizon (prompt + max_new_tokens) — the
+    draft step's rollout must never write a position past it."""
 
     __slots__ = ("tokens", "positions", "valid", "tables", "slot_ids",
-                 "emitting", "prefill_tokens", "lanes_detail",
-                 "queue_depth")
+                 "emitting", "prefill_tokens", "decode_cols", "limits",
+                 "lanes_detail", "queue_depth")
 
     def __init__(self, tokens, positions, valid, tables, slot_ids,
-                 emitting, prefill_tokens, lanes_detail=None,
-                 queue_depth=None):
+                 emitting, prefill_tokens, decode_cols=None,
+                 limits=None, lanes_detail=None, queue_depth=None):
         self.tokens = tokens                # (S, C) int32
         self.positions = positions          # (S, C) int32
         self.valid = valid                  # (S, C) bool
@@ -127,6 +163,8 @@ class IterationPlan:
         self.slot_ids = slot_ids            # slots with work this iter
         self.emitting = emitting            # set of slot ids
         self.prefill_tokens = prefill_tokens
+        self.decode_cols = decode_cols      # (S,) int32
+        self.limits = limits                # (S,) int32
         # telemetry-only (None otherwise): pre-step lane occupancy in
         # serving_telemetry.LANE_FIELDS order + post-admit queue depth,
         # captured inside plan()'s slot loop so the engine's flight
@@ -142,13 +180,29 @@ class ContinuousBatchingScheduler:
 
     def __init__(self, cache, num_slots=4, chunk=4, max_context=None,
                  clock=None, watermark_blocks=0, chaos=None,
-                 telemetry=None):
+                 telemetry=None, prefix_cache=None, spec_k=0,
+                 spec_mode="greedy", spec_seed=0):
         self._cache = cache
         self._tel = telemetry       # ServingTelemetry or None (hooks
         #                             are cheap host bookkeeping, called
         #                             under self._lock)
         self.num_slots = int(num_slots)
         self.chunk = int(chunk)
+        self._prefix = prefix_cache  # PrefixCacheIndex or None
+        self.spec_k = int(spec_k)
+        self.spec_mode = spec_mode
+        if self.spec_k:
+            if spec_mode not in ("greedy", "rejection"):
+                raise ValueError(
+                    f"spec_mode {spec_mode!r}: expected 'greedy' or "
+                    f"'rejection'")
+            if self.chunk < self.spec_k + 1:
+                raise ValueError(
+                    f"spec_k={self.spec_k} needs chunk >= spec_k+1 "
+                    f"(the verify step feeds the committed token plus "
+                    f"k drafts in one chunked call); got chunk="
+                    f"{self.chunk}")
+        self._spec_rng = np.random.default_rng(spec_seed)
         self.max_context = int(max_context or
                                cache.usable_blocks * cache.block_size)
         self.max_blocks = cache.blocks_for_tokens(self.max_context)
@@ -164,7 +218,8 @@ class ContinuousBatchingScheduler:
         self.iteration = 0
         self.counts = {"admitted": 0, "retired": 0, "cancelled": 0,
                        "deadline_cancels": 0, "generated_tokens": 0,
-                       "prefill_tokens": 0}
+                       "prefill_tokens": 0, "spec.proposed": 0,
+                       "spec.accepted": 0}
         from ..observability import _help
         from ..observability.metrics import global_registry
         reg = global_registry()
@@ -174,6 +229,8 @@ class ContinuousBatchingScheduler:
                                    _help("serving.ttft_ms"))
         self._itl = reg.histogram("serving.itl_ms",
                                   _help("serving.itl_ms"))
+        self._g_accept = reg.gauge("serving.spec.accept_rate",
+                                   _help("serving.spec.accept_rate"))
 
     def _count(self, key, n=1):
         self.counts[key] += n
@@ -250,7 +307,14 @@ class ContinuousBatchingScheduler:
     def _release_slot(self, sid):
         slot = self._slots[sid]
         self._slots[sid] = None
-        self._cache.free(slot.blocks)
+        if self._prefix is not None:
+            # retirement UNREFS instead of frees: a block this request
+            # registered into (or matched from) the prefix index keeps
+            # the index's ref and becomes an evictable cached block;
+            # private blocks drop to refcount 0 and free normally
+            self._prefix.release(slot.blocks)
+        else:
+            self._cache.free(slot.blocks)
 
     def _drop_queued(self, pred, exc_fn, count_key):
         kept = []
@@ -337,26 +401,110 @@ class ContinuousBatchingScheduler:
             if free_sid is None:
                 return
             req = self._queue[0][2]
-            need = self._cache.blocks_for_tokens(
-                len(req.prompt) + req.max_new_tokens)
+            p_len = len(req.prompt)
+            n_full = p_len // self._cache.block_size
+            m_total = self._cache.blocks_for_tokens(
+                p_len + req.max_new_tokens)
+            # prefix probe (pure — no refs, no recency, no metric
+            # movement: a backpressured admission retries every
+            # iteration and must not read as cache traffic): only the
+            # unshared suffix is newly reserved. When the WHOLE prompt
+            # matched, prefill restarts at the last prompt token (its
+            # logits seed generation) — that token's write lands in the
+            # last shared block, so one extra block is reserved up
+            # front as the guaranteed copy-on-write target (the
+            # no-mid-flight-OOM invariant must survive COW). The chain
+            # is hashed ONCE per request, whatever the retry count.
+            shared, keys, protect = [], (), frozenset()
+            if self._prefix is not None:
+                if req.chain_keys is None:
+                    req.chain_keys = self._prefix.chain_keys(
+                        req.prompt, n_full)
+                keys = req.chain_keys
+                shared = self._prefix.match(req.prompt, keys)
+                protect = frozenset(keys[:len(shared)])
+            shared_tokens = len(shared) * self._cache.block_size
+            full_cover = shared_tokens == p_len and shared_tokens > 0
+            need = m_total - len(shared) + (1 if full_cover else 0)
             # watermark backpressure: keep headroom unless the pool is
-            # otherwise idle (an idle pool must admit or deadlock)
+            # otherwise idle (an idle pool must admit or deadlock).
+            # Evictable cached blocks count as available — eviction
+            # runs BEFORE backpressure — but the entries THIS match
+            # depends on are protected, so they neither count as
+            # supply nor get evicted out from under the admission.
             floor = self.watermark_blocks if self.active_count else 0
-            if self._cache.num_free - need < floor:
+            avail = self._cache.num_free
+            if self._prefix is not None:
+                protected_idle = sum(
+                    1 for b in shared if self._cache.refcount(b) == 1)
+                avail += (self._prefix.evictable_total()
+                          - protected_idle)
+            if avail - need < floor:
                 return
+            if self._prefix is not None and self._cache.num_free < need:
+                self._prefix.evict_for(need, protect)
             blocks = self._cache.allocate(need)
             if blocks is None:
                 return
+            if self._prefix is not None:
+                # commit the match: refs + LRU touches + hit/miss
+                # counters move exactly once per ADMISSION
+                self._prefix.claim(keys, shared, n_full)
             heapq.heappop(self._queue)
-            table = self._cache.make_table(blocks, self.max_blocks)
-            self._slots[free_sid] = _Slot(req, blocks, table,
-                                          self._admit_seq)
+            cow_spares = [blocks.pop()] if full_cover else []
+            table = self._cache.make_table(shared + blocks,
+                                           self.max_blocks)
+            slot = _Slot(req, shared + blocks + cow_spares, table,
+                         self._admit_seq, shared=shared, keys=keys,
+                         registered=len(shared), cow_spares=cow_spares)
+            # shared positions skip prefill entirely: their KV is
+            # already in the pool, bitwise what this request would have
+            # written (same tokens, same params, same executable)
+            slot.pos = p_len - 1 if full_cover else shared_tokens
+            self._slots[free_sid] = slot
             self._admit_seq += 1
             self._count("admitted")
             if self._tel is not None:
                 self._tel.on_admit(
                     req.rid, free_sid, self.iteration,
                     (now - req.submitted_at) * 1e3)
+
+    def _maybe_cow(self, slot, pos, n):
+        """Copy-on-write guard, called with the block range this lane
+        will WRITE this iteration ([pos, pos+n)): any shared block in
+        range is first copied to a reserved fresh block and the table
+        repointed; readers (the index, other requests) keep the
+        original. Only the full-cover admission path can actually hit
+        this — writes otherwise start past the shared prefix — but the
+        guard is general: a shared block is NEVER written in place."""
+        if self._prefix is None:
+            return
+        bs = self._cache.block_size
+        for bi in range(pos // bs, (pos + n - 1) // bs + 1):
+            b = int(slot.table[bi])
+            if b == 0 or not self._cache.is_shared(b):
+                continue
+            if slot.cow_spares:
+                nb = slot.cow_spares.pop()
+            else:
+                # unplanned COW (defensive): evict, then allocate
+                got = self._cache.allocate(1)
+                if got is None:
+                    self._prefix.evict_for(1)
+                    got = self._cache.allocate(1)
+                if got is None:
+                    raise MemoryError(
+                        f"copy-on-write of block {b} found no free "
+                        f"block (pool exhausted)")
+                nb = got[0]
+                slot.blocks.append(nb)
+            self._cache.cow_copy(b, nb)
+            slot.table[bi] = nb
+            slot.blocks.remove(b)
+            if b in slot.shared:
+                slot.shared.remove(b)
+            self._prefix.drop_block(b)      # this request's ref moves on
+            slot.cow_copies += 1
 
     def plan(self):
         """Build one iteration's fused-step inputs, or None when idle.
@@ -372,6 +520,14 @@ class ContinuousBatchingScheduler:
             self.iteration += 1
             if self._chaos is not None:
                 self._chaos.on_serving_iteration(self.iteration)
+                if self._prefix is not None:
+                    # deterministic eviction injection: the LRU path
+                    # runs at an exact iteration, no pool pressure (or
+                    # giant stream) required
+                    for _ in range(self._chaos.serving_evictions_at(
+                            self.iteration)):
+                        if self._prefix.evict_lru() is not None:
+                            self._chaos.serving_eviction_applied()
             now = self.now()
             self._apply_cancels_and_deadlines(now)
             self._admit(now)
@@ -380,6 +536,8 @@ class ContinuousBatchingScheduler:
             positions = np.zeros((s, c), np.int32)
             valid = np.zeros((s, c), bool)
             tables = np.full((s, self.max_blocks), 0, np.int32)
+            decode_cols = np.zeros((s,), np.int32)
+            limits = np.zeros((s,), np.int32)
             slot_ids, emitting = [], set()
             prefill_tokens = 0
             lanes = [] if self._tel is not None else None
@@ -387,8 +545,8 @@ class ContinuousBatchingScheduler:
                 if slot is None:
                     continue
                 slot_ids.append(sid)
-                tables[sid] = slot.table
                 req = slot.req
+                limits[sid] = len(req.prompt) + req.max_new_tokens
                 if lanes is not None:
                     lanes.append(_lane_tuple(sid, slot))
                 if slot.prefilling:
@@ -401,9 +559,23 @@ class ContinuousBatchingScheduler:
                     if slot.pos + n == len(req.prompt):
                         emitting.add(sid)
                 else:
+                    # decode lane: 1 column in plain mode; in spec mode
+                    # q = min(k+1, chunk, remaining) verify columns —
+                    # the engine fills 1..q-1 with draft proposals, and
+                    # commit() accepts 1..q of the per-column outputs
                     n = 1
+                    if self.spec_k:
+                        n = max(1, min(self.spec_k + 1, c,
+                                       req.max_new_tokens
+                                       - len(req.generated)))
+                    decode_cols[sid] = n
                     tokens[sid, 0] = req.generated[-1]
                     emitting.add(sid)
+                # a shared block is never written in place: copy (to a
+                # reserved spare) + repoint BEFORE the table row is
+                # captured into the plan
+                self._maybe_cow(slot, slot.pos, n)
+                tables[sid] = slot.table
                 positions[sid, :n] = np.arange(slot.pos, slot.pos + n)
                 valid[sid, :n] = True
             if not slot_ids:
@@ -411,17 +583,78 @@ class ContinuousBatchingScheduler:
             self._count("prefill_tokens", prefill_tokens)
             return IterationPlan(
                 tokens, positions, valid, tables, slot_ids, emitting,
-                prefill_tokens,
+                prefill_tokens, decode_cols=decode_cols, limits=limits,
                 lanes_detail=tuple(lanes) if lanes is not None else None,
                 queue_depth=len(self._queue)
                 if lanes is not None else None)
 
-    def commit(self, plan, next_ids, next_logps):
+    def _accept(self, plan, sid, ids, logps, fed_logps, draft_logps):
+        """One decode lane's committed (token, logp) list + position
+        advance. Column i's output is the target's next-token choice
+        after fed column i; the fed columns 1..q-1 are the drafts.
+
+        greedy: accept the longest prefix of drafts matching the
+        target's own per-column argmax, then commit the target's next
+        token after it — every committed id IS the target's greedy
+        choice under the same context, so the stream is bitwise
+        identical to plain decode (just fewer iterations).
+
+        rejection (flagged, experimental): accept draft i with
+        probability min(1, p_target(d_i)/p_draft(d_i)); on the first
+        rejection commit the target argmax as the correction token
+        (greedy correction stands in for residual resampling — see
+        docs/serving.md for the documented deviation)."""
+        q = int(plan.decode_cols[sid])
+        if q == 1:
+            return [(int(ids[sid, 0]), float(logps[sid, 0]))], 1
+        toks = plan.tokens[sid]
+        j = 0
+        if self.spec_mode == "greedy":
+            while j < q - 1 and int(toks[j + 1]) == int(ids[sid, j]):
+                j += 1
+            # along the accepted prefix ids[sid, i] == toks[i+1] (the
+            # drafts), and ids[sid, j] is the target's own next token
+            commits = [(int(ids[sid, i]), float(logps[sid, i]))
+                       for i in range(j + 1)]
+        else:
+            commits = []
+            while j < q - 1:
+                # p_t(d_{j+1}) rides the fused step's fed-token logp
+                # output; p_d from the draft step's proposal logps
+                ratio = float(fed_logps[sid, j]) - float(
+                    draft_logps[sid, j])
+                if self._spec_rng.random() >= min(1.0, np.exp(ratio)):
+                    break
+                # an accepted draft is committed AS the draft token
+                # (it may differ from the target argmax!) — the KV
+                # written at its position is the draft's, so emitting
+                # ids[sid, j] here would desynchronize the client
+                # stream from the context the model attends to
+                commits.append((int(toks[j + 1]),
+                                float(fed_logps[sid, j])))
+                j += 1
+            # correction/bonus token after the accepted prefix is the
+            # target's own choice (greedy correction — docs/serving.md)
+            commits.append((int(ids[sid, j]), float(logps[sid, j])))
+        self._count("spec.proposed", q - 1)
+        self._count("spec.accepted", j)
+        self._g_accept.set(
+            self._mc["spec.accepted"].value()
+            / max(self._mc["spec.proposed"].value(), 1))
+        return commits, j + 1
+
+    def commit(self, plan, next_ids, next_logps, fed_logps=None,
+               draft_logps=None):
         """Apply one fused step's outputs: advance positions, record
         emitted tokens (stream callbacks fire here), retire finished
-        lanes. Returns the list of GenerationResults retired this
+        lanes. `next_ids`/`next_logps` are the fused step's PER-COLUMN
+        argmax ids / chosen logps (S, C); a prefill lane reads its last
+        valid column, a decode lane accepts 1..q columns (see
+        _accept). Returns the list of GenerationResults retired this
         iteration."""
         retired = []
+        next_ids = np.asarray(next_ids)
+        next_logps = np.asarray(next_logps)
         with self._lock:
             now = self.now()
             for sid in plan.slot_ids:
@@ -429,37 +662,77 @@ class ContinuousBatchingScheduler:
                 if slot is None:        # raced with a cancel mid-step
                     continue
                 req = slot.req
-                n = int(plan.valid[sid].sum())
-                slot.pos += n
-                if sid not in plan.emitting:
-                    continue
-                tok = int(next_ids[sid])
-                req.score += float(next_logps[sid])
-                req.generated.append(tok)
-                self._count("generated_tokens")
-                if req.first_token_at is None:
-                    req.first_token_at = now
-                    if self._tel is not None:
-                        self._tel.on_first_token(
-                            req.rid, self.iteration,
-                            (now - req.submitted_at) * 1e3)
+                q = int(plan.decode_cols[sid]) if plan.decode_cols \
+                    is not None else 0
+                if q == 0:
+                    # prefill lane: advance by the chunk fed; register
+                    # freshly-completed full prompt chunks into the
+                    # prefix index; emit only when the prompt finished
+                    n = int(plan.valid[sid].sum())
+                    slot.pos += n
+                    self._register_chunks(slot)
+                    if sid not in plan.emitting:
+                        continue
+                    commits = [(int(next_ids[sid, n - 1]),
+                                float(next_logps[sid, n - 1]))]
                 else:
-                    itl = (now - req.last_token_at) * 1e3
-                    self._itl.observe(itl)
-                    if self._tel is not None:
-                        self._tel.on_token(req.rid, self.iteration, itl)
-                req.last_token_at = now
-                if req.stream is not None:
-                    try:
-                        req.stream(req.rid, tok)
-                    except Exception:   # noqa: BLE001 — a client callback
-                        pass            # must never kill the serve loop
-                done_eos = req.eos_id is not None and tok == req.eos_id
-                if done_eos or len(req.generated) >= req.max_new_tokens:
-                    retired.append(self._finish(
-                        req, "eos" if done_eos else "length"))
+                    commits, advance = self._accept(
+                        plan, sid, next_ids, next_logps, fed_logps,
+                        draft_logps)
+                    slot.pos += advance
+                finished = None
+                for tok, lp in commits:
+                    req.score += lp
+                    req.generated.append(tok)
+                    self._count("generated_tokens")
+                    if req.first_token_at is None:
+                        req.first_token_at = now
+                        if self._tel is not None:
+                            self._tel.on_first_token(
+                                req.rid, self.iteration,
+                                (now - req.submitted_at) * 1e3)
+                    else:
+                        itl = (now - req.last_token_at) * 1e3
+                        self._itl.observe(itl)
+                        if self._tel is not None:
+                            self._tel.on_token(req.rid, self.iteration,
+                                               itl)
+                    req.last_token_at = now
+                    if req.stream is not None:
+                        try:
+                            req.stream(req.rid, tok)
+                        except Exception:  # noqa: BLE001 — a client
+                            pass    # callback must never kill the loop
+                    done_eos = req.eos_id is not None and \
+                        tok == req.eos_id
+                    if done_eos or len(req.generated) >= \
+                            req.max_new_tokens:
+                        finished = "eos" if done_eos else "length"
+                        break       # later accepted tokens discarded
+                if finished is not None:
+                    retired.append(self._finish(req, finished))
                     self._release_slot(sid)
         return retired
+
+    def _register_chunks(self, slot):
+        """Offer every freshly-prefilled FULL prompt chunk to the
+        prefix index (the chain keys were computed once at admission —
+        registration never re-hashes)."""
+        if self._prefix is None:
+            return
+        bs = self._cache.block_size
+        done = min(slot.pos, len(slot.req.prompt)) // bs
+        if done <= slot.registered:
+            return
+        for i in range(slot.registered, done):
+            parent = slot.keys[i - 1] if i else None
+            if self._prefix.register(
+                    slot.keys[i], parent,
+                    slot.req.prompt[i * bs:(i + 1) * bs],
+                    int(slot.table[i])):
+                if int(slot.table[i]) not in slot.shared:
+                    slot.shared.append(int(slot.table[i]))
+        slot.registered = done
 
     # -- introspection -----------------------------------------------------
     def lane_snapshot(self):
@@ -499,5 +772,9 @@ class ContinuousBatchingScheduler:
                 * shard_block_bytes,
                 "free_shard_bytes": self._cache.num_free
                 * shard_block_bytes,
+                "prefix": self._prefix.stats()
+                if self._prefix is not None else None,
+                "spec_k": self.spec_k,
+                "spec_mode": self.spec_mode if self.spec_k else None,
                 **dict(self.counts),
             }

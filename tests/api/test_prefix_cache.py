@@ -1,0 +1,328 @@
+"""Prefix cache: cross-request KV block sharing (ISSUE 10 tentpole).
+
+The contract under test:
+
+- PagedKVCache refcounts: double free and free-while-shared raise
+  (the latent silent double free becomes data corruption once blocks
+  are shared), unref hands a block back only on the last reference;
+- block sharing: a second request over the same prompt reserves only
+  the unshared suffix, skips the shared prefill, and produces BITWISE
+  the ids of an unshared run;
+- copy-on-write: a fully-cached prompt re-feeds its last token into a
+  COPY of the last shared block (the original stays cached), pool
+  accounting exact;
+- LRU eviction: leaf-first, least-recently-touched first, runs under
+  watermark pressure BEFORE admission backpressures, and is
+  deterministically injectable (ChaosInjector.evict_block_at);
+- hash collisions degrade to a miss via the token verify
+  (ChaosInjector.hash_collision_at), never to another prompt's KV;
+- the HBM ledger never double-counts shared blocks and a shared block
+  is never freed while references are live.
+
+Everything is tier-1 (`serving` marker, manual pump, no sleeps).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core import framework
+from paddle_tpu.core.executor import Scope, scope_guard
+from paddle_tpu.models import gpt
+from paddle_tpu.observability.metrics import global_registry
+from paddle_tpu.robustness import ChaosInjector
+from paddle_tpu.serving import (GenerationServer, GPTServingModel,
+                                PagedKVCache, PrefixCacheIndex)
+
+pytestmark = pytest.mark.serving
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    cfg = gpt.gpt_tiny()
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 11
+    with framework.program_guard(main, startup):
+        gpt.build_lm_net(cfg, seq_len=8)
+    scope = Scope()
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+    return cfg, gpt.load_params(scope, cfg)
+
+
+def _server(params, cfg, **kw):
+    kw.setdefault("num_slots", 3)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_context", 64)
+    kw.setdefault("chunk", 4)
+    kw.setdefault("start", False)
+    return GenerationServer(GPTServingModel(params, cfg), **kw)
+
+
+def _run(srv, prompt, n, **kw):
+    fut = srv.submit(prompt, max_new_tokens=n, **kw)
+    srv.run_until_idle()
+    return list(fut.result(timeout=5).token_ids)
+
+
+# ---------------------------------------------------------------------------
+# refcount machinery (satellite bugfix: the double-free guard)
+# ---------------------------------------------------------------------------
+
+def test_double_free_raises():
+    pool = PagedKVCache(num_layers=1, num_heads=2, head_dim=4,
+                        num_blocks=9, block_size=4)
+    a = pool.allocate(3)
+    pool.free(a)
+    with pytest.raises(ValueError, match="double free"):
+        pool.free(a)
+    # partial overlap is just as dangerous
+    b = pool.allocate(2)
+    pool.free([b[0]])
+    with pytest.raises(ValueError, match="double free"):
+        pool.free(b)
+
+
+def test_free_while_shared_raises():
+    pool = PagedKVCache(num_layers=1, num_heads=2, head_dim=4,
+                        num_blocks=9, block_size=4)
+    (b,) = pool.allocate(1)
+    pool.ref(b)                     # a second holder appears
+    with pytest.raises(ValueError, match="unref"):
+        pool.free([b])              # never freed while refcount > 1
+    assert pool.refcount(b) == 2 and pool.is_shared(b)
+    assert pool.unref(b) is False   # second holder lets go: not freed
+    assert pool.unref(b) is True    # last reference: back to the pool
+    with pytest.raises(ValueError, match="unref of free block"):
+        pool.unref(b)
+    with pytest.raises(ValueError, match="ref of free block"):
+        pool.ref(b)
+
+
+# ---------------------------------------------------------------------------
+# block sharing
+# ---------------------------------------------------------------------------
+
+def test_second_request_shares_prefix_blocks_bitwise(tiny_gpt):
+    """Same 2-full-chunk prompt twice: the repeat matches both chunks,
+    skips their prefill, COWs the last shared block (full cover), and
+    reproduces the unshared ids bitwise with fewer iterations."""
+    cfg, params = tiny_gpt
+    prompt = np.arange(3, 19, dtype=np.int32)       # 16 = 2 x block 8
+    ref_ids = _run(_server(params, cfg), prompt, 6)
+
+    srv = _server(params, cfg, prefix_cache=True)
+    assert _run(srv, prompt, 6) == ref_ids
+    it_first = srv.get_stats()["iteration"]
+    assert _run(srv, prompt, 6) == ref_ids
+    st = srv.get_stats()
+    # the repeat matched both chunks and skipped their prefill
+    assert st["prefix"]["hits"] == 2
+    assert st["prefix"]["cow_copies"] == 1          # full cover
+    assert st["iteration"] - it_first < it_first
+    # prefill_tokens counts only tokens actually fed: 16 + 1 (re-fed
+    # last token of the fully-covered repeat)
+    assert st["prefill_tokens"] == 17
+
+
+def test_shared_then_diverge_concurrent_accounting_exact(tiny_gpt):
+    """Two live requests share a 2-chunk prefix then diverge: ids match
+    their unshared runs bitwise, the shared blocks carry refcounts > 1
+    while both run, and retirement returns every private block."""
+    cfg, params = tiny_gpt
+    shared = np.arange(3, 19, dtype=np.int32)
+    p_a = np.concatenate([shared, [30, 31]]).astype(np.int32)
+    p_b = np.concatenate([shared, [40, 41, 42]]).astype(np.int32)
+    ref_a = _run(_server(params, cfg), p_a, 5)
+    ref_b = _run(_server(params, cfg), p_b, 5)
+
+    srv = _server(params, cfg, prefix_cache=True)
+    seed = _run(srv, shared, 2)                     # populate the index
+    assert len(seed) == 2
+    fa = srv.submit(p_a, max_new_tokens=5)
+    fb = srv.submit(p_b, max_new_tokens=5)
+    srv.step()                                      # both admitted
+    st = srv.get_stats()
+    assert st["active_slots"] == 2
+    # both admissions matched the 2 shared chunks
+    assert st["prefix"]["hits"] == 4
+    assert st["prefix"]["shared_blocks"] == 2       # both live on them
+    assert global_registry().gauge(
+        "serving.prefix.shared_blocks").labels(
+        server=srv._ledger_id).value() == 2
+    srv.run_until_idle()
+    assert list(fa.result(5).token_ids) == ref_a
+    assert list(fb.result(5).token_ids) == ref_b
+    st = srv.get_stats()
+    # exact accounting: everything not cached is back on the free list
+    cached = st["prefix"]["entries"]
+    assert srv.cache.num_free == srv.cache.usable_blocks - cached
+    assert st["prefix"]["shared_blocks"] == 0
+    assert st["prefix"]["evictable"] == cached
+    # a closed server's shared_blocks series is retired (not a stale
+    # per-process gauge another server's dashboard would scrape)
+    srv.close()
+    assert not [lbl for lbl, _c in global_registry().get(
+        "serving.prefix.shared_blocks").series()
+        if lbl.get("server") == srv._ledger_id]
+
+
+def test_cow_divergence_bitwise_and_original_survives(tiny_gpt):
+    """Full-cover COW: the repeat writes its re-fed last token into a
+    COPY; the cached original still serves a third request afterwards
+    (bitwise), and cow_copies/block accounting are exact."""
+    cfg, params = tiny_gpt
+    prompt = np.arange(50, 66, dtype=np.int32)      # 2 full chunks
+    ref_ids = _run(_server(params, cfg), prompt, 4)
+    srv = _server(params, cfg, prefix_cache=True)
+    for i in range(3):
+        assert _run(srv, prompt, 4) == ref_ids, f"run {i}"
+    st = srv.get_stats()
+    assert st["prefix"]["cow_copies"] == 2          # runs 2 and 3
+    assert st["prefix"]["entries"] == 2             # original chunks
+    assert global_registry().counter(
+        "serving.prefix.cow_copies").value() >= 2
+
+
+# ---------------------------------------------------------------------------
+# LRU eviction
+# ---------------------------------------------------------------------------
+
+def test_chaos_evict_block_lru_leaf_first(tiny_gpt):
+    """Deterministic injected evictions drain the index leaf-first in
+    least-recently-used order: the untouched prompt's chain goes before
+    the recently re-used one, children before parents."""
+    cfg, params = tiny_gpt
+    p_old = np.arange(3, 19, dtype=np.int32)        # chunks A1 -> A2
+    p_new = np.arange(100, 116, dtype=np.int32)     # chunks B1 -> B2
+    chaos = ChaosInjector()
+    srv = _server(params, cfg, prefix_cache=True, chaos=chaos)
+    _run(srv, p_old, 2)
+    _run(srv, p_new, 2)
+    _run(srv, p_new, 2)             # touch B's chain again (LRU-fresh)
+    idx = srv._prefix
+    st0 = srv.get_stats()["prefix"]
+    assert st0["entries"] == 4 and st0["evictable"] == 4
+    # name the blocks before eviction: parent = chunk-1 entry (no
+    # parent key), chains told apart by their first token
+    ents = list(idx._entries.values())
+    blk = {("A" if e.tokens[0] < 100 else "B",
+            "parent" if e.parent is None else "child"): e.block
+           for e in ents}
+    # plan one eviction per upcoming iteration, then drive iterations
+    it0 = srv.get_stats()["iteration"]
+    for k in range(1, 5):
+        chaos.evict_block_at(it0 + k)
+    order = []
+    real_evict = idx.evict_lru
+    idx.evict_lru = lambda: order.append(real_evict()) or order[-1]
+    try:
+        fut = srv.submit([7, 8], max_new_tokens=8)
+        srv.run_until_idle()
+        fut.result(timeout=5)
+    finally:
+        idx.evict_lru = real_evict
+    assert chaos.fired["evict"] == 4
+    st = srv.get_stats()["prefix"]
+    assert st["entries"] == 0 and st["evictions"] == 4
+    # LRU leaf-first: A's child (oldest leaf), then A's parent (now a
+    # leaf, still older than anything of B), then B's chain child-first
+    assert order == [blk[("A", "child")], blk[("A", "parent")],
+                     blk[("B", "child")], blk[("B", "parent")]]
+
+
+def test_eviction_under_pressure_before_backpressure(tiny_gpt):
+    """A pool full of idle cached blocks admits a new request by
+    EVICTING instead of backpressuring (the old behavior would
+    deadlock-wait on blocks nothing was going to free)."""
+    cfg, params = tiny_gpt
+    # 8 usable blocks, max_context 32: one 16-token prompt caches 2
+    srv = _server(params, cfg, prefix_cache=True, num_blocks=9,
+                  max_context=32, num_slots=2)
+    for base in (3, 40, 80):                        # cache 6 blocks
+        _run(srv, np.arange(base, base + 16).astype(np.int32), 2)
+    st = srv.get_stats()
+    assert st["prefix"]["entries"] == 6
+    assert st["blocks_free"] == 2
+    # needs 4 blocks (16 prompt + 12 out) with only 2 free: must evict
+    ids = _run(srv, np.arange(200, 216).astype(np.int32), 12)
+    assert len(ids) == 12
+    st = srv.get_stats()
+    assert st["prefix"]["evictions"] >= 2
+    assert st["deadline_cancels"] == 0
+
+
+# ---------------------------------------------------------------------------
+# hash collisions
+# ---------------------------------------------------------------------------
+
+def test_hash_collision_degrades_to_miss(tiny_gpt):
+    """Two different first chunks forced onto the collision sentinel:
+    the second prompt FINDS the colliding entry, the token verify
+    rejects it, and generation proceeds (correctly) as a cache miss."""
+    cfg, params = tiny_gpt
+    p1 = np.arange(3, 19, dtype=np.int32)
+    p2 = np.arange(60, 76, dtype=np.int32)
+    ref2 = _run(_server(params, cfg), p2, 4)
+    # p1's admission hashes chunk 1 (miss) then registration reuses the
+    # chain -> computations 1..2; p2's admission hashes its chunk 1 as
+    # computation 3. Collide 1 and 3: p2's probe lands on p1's entry.
+    chaos = ChaosInjector().hash_collision_at(1).hash_collision_at(3)
+    srv = _server(params, cfg, prefix_cache=True, chaos=chaos)
+    _run(srv, p1, 4)
+    assert _run(srv, p2, 4) == ref2             # verified -> miss
+    st = srv.get_stats()["prefix"]
+    assert chaos.fired["hash_collision"] == 2
+    assert st["collisions"] == 2
+    assert st["hits"] == 0 and st["misses"] == 2
+
+
+# ---------------------------------------------------------------------------
+# ledger + flight-recorder integration
+# ---------------------------------------------------------------------------
+
+def test_ledger_never_double_counts_shared_blocks(tiny_gpt):
+    """The kv_cache ledger rows are the PREALLOCATED pool footprint:
+    sharing moves refs, never bytes — memory stays exactly pool_bytes
+    through sharing, COW and eviction, and close() retires it."""
+    cfg, params = tiny_gpt
+    from paddle_tpu.observability.compile_insight import hbm_ledger
+    chaos = ChaosInjector().evict_block_at(20, n=2)
+    srv = _server(params, cfg, prefix_cache=True, chaos=chaos)
+    prompt = np.arange(3, 19, dtype=np.int32)
+    expect = srv.cache.pool_bytes()
+
+    def kv_bytes():
+        return hbm_ledger().component_bytes(
+            srv._ledger_id).get("kv_cache", 0)
+
+    _run(srv, prompt, 2)
+    assert kv_bytes() == expect
+    _run(srv, prompt, 2)                    # shared + COW
+    assert kv_bytes() == expect
+    fut = srv.submit([7, 8], max_new_tokens=25)
+    srv.run_until_idle()                    # chaos evictions fire
+    fut.result(timeout=5)
+    assert chaos.fired["evict"] == 2
+    assert kv_bytes() == expect
+    srv.close()
+    assert hbm_ledger().component_bytes(srv._ledger_id) == {}
+
+
+def test_lane_tuple_matches_lane_fields_schema(tiny_gpt):
+    """The flight recorder zips lane tuples against LANE_FIELDS — the
+    shared/cow extension must stay in lockstep on both sides."""
+    from paddle_tpu.observability.serving_telemetry import LANE_FIELDS
+    cfg, params = tiny_gpt
+    srv = _server(params, cfg, prefix_cache=True)
+    prompt = np.arange(3, 19, dtype=np.int32)
+    _run(srv, prompt, 2)
+    fut = srv.submit(prompt, max_new_tokens=2)      # full cover -> COW
+    srv.step()
+    lanes = srv._sched.lane_snapshot()
+    assert lanes and all(len(t) == len(LANE_FIELDS) for t in lanes)
+    lane = dict(zip(LANE_FIELDS, lanes[0]))
+    assert lane["cow_copies"] == 1                  # COW already fired
+    srv.run_until_idle()
+    fut.result(timeout=5)

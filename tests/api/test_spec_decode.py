@@ -1,0 +1,273 @@
+"""Speculative decoding (ISSUE 10 tentpole, serving/spec_decode.py).
+
+The contract under test:
+
+- greedy acceptance is EXACT: the mixed-length staggered acceptance
+  stream (mid-stream cancel included) produces token ids BITWISE
+  identical to the plain engine — speculation changes iteration counts,
+  never content;
+- the compiled-signature budget holds for the server lifetime:
+  fused == 1, draft <= 1, compiled_step_signatures <= 2 (get_stats());
+- a perfect draft (draft == target) accepts everything and finishes in
+  strictly fewer iterations; a from-different-seed draft still decodes
+  bitwise (acceptance just drops);
+- EOS inside an accepted burst truncates exactly at the EOS token;
+- construction validates chunk >= k+1, vocab match, and mesh
+  (unsupported);
+- serving.spec.* metrics land in the global registry;
+- the rejection-sampled mode (flagged) runs and is deterministic under
+  a fixed seed.
+
+Tier-1 (`serving` marker, manual pump, no sleeps).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core import framework
+from paddle_tpu.core.executor import Scope, scope_guard
+from paddle_tpu.models import gpt
+from paddle_tpu.observability.metrics import global_registry
+from paddle_tpu.serving import (GenerationServer, GPTServingModel,
+                                SpecDecodeConfig)
+
+pytestmark = pytest.mark.serving
+
+
+@pytest.fixture(scope="module")
+def models():
+    """Target (gpt_tiny) + a genuinely smaller draft over the same
+    vocab, initialized from a different seed (imperfect proposals)."""
+    cfg = gpt.gpt_tiny()
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 11
+    with framework.program_guard(main, startup):
+        gpt.build_lm_net(cfg, seq_len=8)
+    scope = Scope()
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+    params = gpt.load_params(scope, cfg)
+
+    dcfg = gpt.GPTConfig(vocab_size=cfg.vocab_size, hidden_size=64,
+                         num_layers=2, num_heads=2, inner_size=128,
+                         max_position=128, dropout=0.0)
+    dmain, dstart = framework.Program(), framework.Program()
+    dmain.random_seed = dstart.random_seed = 99
+    with framework.program_guard(dmain, dstart):
+        gpt.build_lm_net(dcfg, seq_len=8)
+    dscope = Scope()
+    with scope_guard(dscope):
+        exe.run(dstart)
+    dparams = gpt.load_params(dscope, dcfg)
+    return (cfg, params), (dcfg, dparams)
+
+
+def _server(params, cfg, **kw):
+    kw.setdefault("num_slots", 3)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_context", 64)
+    kw.setdefault("chunk", 4)
+    kw.setdefault("start", False)
+    return GenerationServer(GPTServingModel(params, cfg), **kw)
+
+
+def _spec(models_tuple, k=3, **kw):
+    (cfg, params), (dcfg, dparams) = models_tuple
+    return _server(params, cfg,
+                   spec=SpecDecodeConfig(GPTServingModel(dparams, dcfg),
+                                         k=k, **kw))
+
+
+def _drive_staggered_stream(srv):
+    """The PR-5 acceptance scenario: staggered arrivals, mixed
+    prompt/output lengths, one mid-stream cancel."""
+    p1 = np.array([5, 9, 11, 2, 7], np.int32)
+    p2 = np.array([7] * 11, np.int32)
+    f1 = srv.submit(p1, max_new_tokens=8)
+    f2 = srv.submit(p2, max_new_tokens=6)
+    for _ in range(2):
+        srv.step()
+    f3 = srv.submit(np.array([3, 4], np.int32), max_new_tokens=10)
+    f4 = srv.submit(np.array([12, 13, 14, 15, 16, 17, 18], np.int32),
+                    max_new_tokens=12)
+    srv.step()
+    assert f4.cancel()
+    srv.run_until_idle()
+    assert f4.cancelled()
+    return [list(f.result(timeout=5).token_ids) for f in (f1, f2, f3)]
+
+
+# ---------------------------------------------------------------------------
+# the acceptance test: bitwise parity + the compiled-signature budget
+# ---------------------------------------------------------------------------
+
+def test_spec_greedy_bitwise_parity_staggered_stream_with_cancel(models):
+    (cfg, params), _ = models
+    plain = _server(params, cfg)
+    ref_ids = _drive_staggered_stream(plain)
+    plain_iters = plain.get_stats()["iteration"]
+
+    srv = _spec(models, k=3)
+    assert _drive_staggered_stream(srv) == ref_ids
+    st = srv.get_stats()
+    # the server lifetime compiled exactly: 1 fused step + 1 draft step
+    assert st["fused_step_signatures"] == 1, st
+    assert st["draft_step_signatures"] == 1, st
+    assert st["compiled_step_signatures"] <= 2
+    assert st["spec"]["k"] == 3 and st["spec"]["mode"] == "greedy"
+    assert st["spec"]["proposed"] > 0
+    # blocks reclaimed despite multi-token commits + cancel
+    assert st["blocks_free"] == st["blocks_total"]
+    assert st["cancelled"] == 1 and st["retired"] == 3
+    assert st["iteration"] > 0 and plain_iters > 0
+
+
+def test_perfect_draft_accepts_everything_fewer_iterations(models):
+    """Draft == target: every proposal matches, so each decode lane
+    commits k+1 tokens per verify call and the stream finishes in
+    strictly fewer iterations — with bitwise-identical ids."""
+    (cfg, params), _ = models
+    prompt = np.arange(3, 15, dtype=np.int32)
+    plain = _server(params, cfg)
+    f = plain.submit(prompt, max_new_tokens=9)
+    plain.run_until_idle()
+    ref = list(f.result(5).token_ids)
+    plain_iters = plain.get_stats()["iteration"]
+
+    srv = _server(params, cfg,
+                  spec=SpecDecodeConfig(GPTServingModel(params, cfg),
+                                        k=3))
+    f = srv.submit(prompt, max_new_tokens=9)
+    srv.run_until_idle()
+    assert list(f.result(5).token_ids) == ref
+    st = srv.get_stats()
+    assert st["spec"]["accept_rate"] == 1.0
+    assert st["iteration"] < plain_iters
+    assert global_registry().counter("serving.spec.accepted").value() > 0
+    assert global_registry().gauge("serving.spec.accept_rate").value() > 0
+
+
+def test_eos_inside_accepted_burst_truncates_exactly(models):
+    """A verify call can accept tokens past an EOS; commit must stop AT
+    the EOS (bitwise with the plain engine's eos behavior)."""
+    (cfg, params), _ = models
+    prompt = np.array([5, 9, 11], np.int32)
+    plain = _server(params, cfg)
+    f = plain.submit(prompt, max_new_tokens=8)
+    plain.run_until_idle()
+    ref = list(f.result(5).token_ids)
+    eos = ref[2]
+    k_stop = ref.index(eos)
+    plain2 = _server(params, cfg)
+    f = plain2.submit(prompt, max_new_tokens=8, eos_id=eos)
+    plain2.run_until_idle()
+    ref_eos = list(f.result(5).token_ids)
+    assert ref_eos == ref[:k_stop + 1]
+
+    # perfect draft maximizes burst length across the eos
+    srv = _server(params, cfg,
+                  spec=SpecDecodeConfig(GPTServingModel(params, cfg),
+                                        k=3))
+    f = srv.submit(prompt, max_new_tokens=8, eos_id=eos)
+    srv.run_until_idle()
+    out = f.result(5)
+    assert list(out.token_ids) == ref_eos
+    assert out.finish_reason == "eos"
+    assert srv.get_stats()["blocks_free"] == \
+        srv.get_stats()["blocks_total"]
+
+
+# ---------------------------------------------------------------------------
+# construction validation
+# ---------------------------------------------------------------------------
+
+def test_spec_k_needs_wide_enough_chunk(models):
+    with pytest.raises(ValueError, match="chunk"):
+        _spec(models, k=4)          # chunk 4 < k+1
+    with pytest.raises(ValueError, match="k must be"):
+        SpecDecodeConfig(None, k=0)
+    with pytest.raises(ValueError, match="mode"):
+        SpecDecodeConfig(None, k=2, mode="banana")
+
+
+def test_spec_vocab_mismatch_raises(models):
+    (cfg, params), (dcfg, dparams) = models
+    bad_cfg = gpt.GPTConfig(vocab_size=128, hidden_size=64,
+                            num_layers=2, num_heads=2, inner_size=128,
+                            max_position=128, dropout=0.0)
+    bad = GPTServingModel(dparams, bad_cfg)
+    with pytest.raises(ValueError, match="vocab"):
+        _server(params, cfg, spec=SpecDecodeConfig(bad, k=2))
+
+
+def test_spec_on_mesh_not_supported(models):
+    import jax
+    from jax.sharding import Mesh
+    (cfg, params), (dcfg, dparams) = models
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    with pytest.raises(NotImplementedError, match="mesh"):
+        _server(params, cfg, mesh=mesh,
+                spec=SpecDecodeConfig(GPTServingModel(dparams, dcfg),
+                                      k=2))
+
+
+# ---------------------------------------------------------------------------
+# rejection-sampled mode (flagged, experimental)
+# ---------------------------------------------------------------------------
+
+def test_rejection_mode_commits_the_draft_tokens(models):
+    """White-box _accept: an ACCEPTED draft must be committed AS the
+    draft token even when it differs from the target's argmax — the
+    verify step wrote the DRAFT's KV at that position, so emitting the
+    argmax would desynchronize the client stream from the context the
+    model attends to. The correction token after the accepted prefix
+    is the target argmax."""
+    import numpy as np
+    from paddle_tpu.serving.scheduler import (
+        ContinuousBatchingScheduler, IterationPlan)
+    from paddle_tpu.serving import PagedKVCache
+
+    cache = PagedKVCache(num_layers=1, num_heads=2, head_dim=4,
+                         num_blocks=9, block_size=4)
+    sched = ContinuousBatchingScheduler(cache, num_slots=1, chunk=4,
+                                        max_context=16, spec_k=3,
+                                        spec_mode="rejection")
+    # lane 0: fed [committed=7, d1=20, d2=21], q=3; target argmax
+    # DISAGREES everywhere (ids 30/31/32) but the acceptance draws
+    # pass (fed_logps == draft_logps -> ratio 1 -> always accept)
+    plan = IterationPlan(
+        tokens=np.array([[7, 20, 21, 0]], np.int32),
+        positions=np.zeros((1, 4), np.int32),
+        valid=np.array([[1, 1, 1, 0]], bool),
+        tables=np.zeros((1, 4), np.int32), slot_ids=[0],
+        emitting={0}, prefill_tokens=0,
+        decode_cols=np.array([3], np.int32),
+        limits=np.array([16], np.int32))
+    ids = np.array([[30, 31, 32, 33]], np.int32)
+    logps = np.full((1, 4), -1.0, np.float32)
+    fed = np.full((1, 4), -2.0, np.float32)
+    dlp = np.full((1, 3), -2.0, np.float32)
+    commits, advance = sched._accept(plan, 0, ids, logps, fed, dlp)
+    # both drafts accepted AS drafts, then the target's correction
+    assert [t for t, _lp in commits] == [20, 21, 32]
+    assert advance == 3
+    # accepted drafts are scored with the TARGET's logp of the draft
+    assert [lp for _t, lp in commits] == [-2.0, -2.0, -1.0]
+
+
+def test_rejection_mode_runs_and_is_seed_deterministic(models):
+    prompt = np.arange(3, 15, dtype=np.int32)
+    outs = []
+    for _ in range(2):
+        srv = _spec(models, k=3, mode="rejection", seed=123)
+        f = srv.submit(prompt, max_new_tokens=8)
+        srv.run_until_idle()
+        outs.append(list(f.result(5).token_ids))
+        st = srv.get_stats()
+        assert st["spec"]["mode"] == "rejection"
+        assert st["compiled_step_signatures"] <= 2
+        assert st["blocks_free"] == st["blocks_total"]
+    assert outs[0] == outs[1]       # same seed, same stream
+    assert len(outs[0]) == 8

@@ -118,6 +118,48 @@ def test_tp2_engine_bitwise_ids_one_signature(trained):
     srv.close()
 
 
+def test_tp2_shared_prefix_stream_bitwise_parity(trained):
+    """ISSUE 10: prefix caching composes with the mesh — block sharing
+    is replicated HOST state, so a shared-prefix stream (repeats +
+    divergent suffixes, full-cover COW included) through a tp=2 server
+    reproduces the tp=1 prefix server's ids bitwise, with the same
+    hit/COW accounting and exact block reclamation."""
+    cfg, params = trained
+
+    def drive(srv):
+        shared = np.arange(3, 19, dtype=np.int32)   # 2 full blocks
+        ids = []
+        f = srv.submit(shared, max_new_tokens=4)    # seeds the index
+        srv.run_until_idle()
+        ids.append(list(f.result(timeout=5).token_ids))
+        futs = [srv.submit(np.concatenate([shared, extra]).astype(
+            np.int32), max_new_tokens=5)
+            for extra in ([30, 31], [40, 41, 42])]  # live divergence
+        futs.append(srv.submit(shared, max_new_tokens=4))  # full cover
+        srv.run_until_idle()
+        ids += [list(f.result(timeout=5).token_ids) for f in futs]
+        return ids
+
+    ref_srv = _server(params, cfg, prefix_cache=True)
+    ref_ids = drive(ref_srv)
+    ref_prefix = ref_srv.get_stats()["prefix"]
+    ref_srv.close()
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    srv = _server(params, cfg, mesh=mesh, prefix_cache=True)
+    assert drive(srv) == ref_ids
+    st = srv.get_stats()
+    assert st["fused_step_signatures"] == 1
+    assert st["kernel"]["engaged"] is True
+    # identical host-side sharing decisions on the mesh
+    assert st["prefix"]["hits"] == ref_prefix["hits"] > 0
+    assert st["prefix"]["cow_copies"] == ref_prefix["cow_copies"] == 1
+    # exact reclamation: only the cached chunks stay resident
+    assert srv.cache.num_free == \
+        srv.cache.usable_blocks - st["prefix"]["entries"]
+    srv.close()
+
+
 def test_tp2_mesh_metrics_recorded_and_retired(trained):
     """serving.mesh.* gauges (satellite): axis size, per-shard pool
     bytes, psums per step — recorded per server, removed on close."""

@@ -244,6 +244,20 @@ def run_suite():
                  env={"JAX_PLATFORMS": "cpu",
                       "BENCH_TELEMETRY_COMPARE": "1"},
                  timeout_s=900, stdout_path="bench_telemetry.json")
+    # 1f2. prefix-cache + spec-decode comparison (ISSUE 10): block
+    #     sharing on-vs-off over a mixed-tenant 80%-shared-prefix
+    #     stream (blocks/request, hit rate, tokens/s) plus the
+    #     spec-decode parity/accept-rate section, on the CPU backend
+    #     (deterministic; acceptance: blocks/request strictly below the
+    #     no-sharing engine, hit rate > 0.5)
+    if _artifact_ok("bench_prefix.json"):
+        log("step prefix_compare: already landed in a prior cycle — "
+            "skipping")
+    else:
+        run_step("prefix_compare", [py, bench],
+                 env={"JAX_PLATFORMS": "cpu",
+                      "BENCH_PREFIX_COMPARE": "1"},
+                 timeout_s=900, stdout_path="bench_prefix.json")
     # 1g. compile-observatory sample (ISSUE 8): Executor.explain()
     #     report + provoked recompile storm + HBM-ledger snapshot +
     #     detector on-vs-off overhead, on the CPU backend

@@ -214,6 +214,24 @@ def print_serving_summary(metrics, file=None):
               f"e2e_avg={et / max(ec, 1):.2f}ms "
               f"requests_traced={traced_reqs} faults={faults} "
               f"flight_dumps={dumps}", file=file)
+    # prefix cache + speculative decoding (ISSUE 10)
+    ph = _counter_total(metrics, "serving.prefix.hits")
+    pm = _counter_total(metrics, "serving.prefix.misses")
+    if ph or pm:
+        pe = _counter_total(metrics, "serving.prefix.evictions")
+        pc = _counter_total(metrics, "serving.prefix.cow_copies")
+        sh = metrics.get("serving.prefix.shared_blocks", {})
+        svals = sh.get("values", [])
+        shared_now = svals[0].get("value") if svals else 0
+        print(f"serving: prefix hits={ph} misses={pm} "
+              f"hit-rate={ph / max(ph + pm, 1):.1%} evictions={pe} "
+              f"cow_copies={pc} shared_blocks_now={shared_now}",
+              file=file)
+    sp = _counter_total(metrics, "serving.spec.proposed")
+    if sp:
+        sa = _counter_total(metrics, "serving.spec.accepted")
+        print(f"serving: spec proposed={sp} accepted={sa} "
+              f"accept-rate={sa / max(sp, 1):.1%}", file=file)
     quant = metrics.get("serving.slo.quantile_ms")
     if windows and quant:
         # key on (server, metric): two live GenerationServers publish
@@ -329,7 +347,8 @@ def run_demo(out_dir):
     # request-level telemetry (queue-wait/e2e histograms, SLO quantile
     # gauges, completed windows) lands in the sample too (ISSUE 7).
     from paddle_tpu.models import gpt
-    from paddle_tpu.serving import GenerationServer, GPTServingModel
+    from paddle_tpu.serving import (GenerationServer, GPTServingModel,
+                                    SpecDecodeConfig)
     scfg = gpt.gpt_tiny()
     smain, sstart = framework.Program(), framework.Program()
     smain.random_seed = sstart.random_seed = 7
@@ -341,12 +360,17 @@ def run_demo(out_dir):
         exe4.run(sstart)
         sparams = gpt.load_params(sscope, scfg)
     schaos = ChaosInjector().cancel_request_at(4, index=0)
-    for sit in range(1, 60):
+    for sit in range(1, 90):
         schaos.advance_clock_at(sit, ms=20)
+    # prefix cache + speculative decoding on (ISSUE 10): the demo
+    # drives a shared-prefix stream below so serving.prefix.* and
+    # serving.spec.* series land in the committed sample (the draft is
+    # the target itself — a perfect-acceptance sample)
     server = GenerationServer(
         GPTServingModel(sparams, scfg), num_slots=2, block_size=8,
         max_context=64, chunk=4, start=False, chaos=schaos,
-        slo_window_s=0.1)
+        slo_window_s=0.1, prefix_cache=True,
+        spec=SpecDecodeConfig(GPTServingModel(sparams, scfg), k=3))
     victim = server.submit(np.arange(3, 15, dtype=np.int32),
                            max_new_tokens=30)
     survivors = [server.submit([5 + i, 9, 11], max_new_tokens=4 + i)
@@ -354,6 +378,15 @@ def run_demo(out_dir):
     server.run_until_idle()
     assert victim.cancelled() or victim.exception(timeout=1) is not None
     for f in survivors:
+        f.result(timeout=5)
+    # shared-prefix wave: the repeat matches both chunks (prefix hits)
+    # and, being fully covered, exercises the copy-on-write path too
+    shared_p = np.arange(3, 19, dtype=np.int32)     # 2 full blocks
+    w1 = server.submit(shared_p, max_new_tokens=6)
+    server.run_until_idle()
+    w2 = server.submit(shared_p, max_new_tokens=6)
+    server.run_until_idle()
+    for f in (w1, w2):
         f.result(timeout=5)
 
     metrics_path = os.path.join(out_dir, "metrics_sample.json")
