@@ -27,12 +27,13 @@ authn/network policy; the server itself adds none (docs/observability.md).
 """
 
 import json
+import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from .metrics import global_registry
+from .metrics import _escape, _escape_help, global_registry
 
-__all__ = ["TelemetryServer", "serve_metrics"]
+__all__ = ["TelemetryServer", "serve_metrics", "FleetRegistryView"]
 
 
 def _help(name):
@@ -182,6 +183,126 @@ class TelemetryServer:
     def __exit__(self, *_exc):
         self.close()
         return False
+
+
+class FleetRegistryView:
+    """Fleet-aware aggregate /metrics view (ISSUE 11 satellite).
+
+    Counters and histograms aggregate UNLABELED in the process-wide
+    registry (the PR 1 convention), so two GenerationServers in one
+    process were only separable by mounting one exporter PORT each —
+    a fleet of N replicas would need N scrape targets. This view is
+    ONE scrape target for the whole fleet: the base registry's
+    exposition (process aggregates, exactly as before) with every
+    replica's own ``serving.*`` numbers spliced INTO the same metric
+    families as additional ``replica="<name>"``-labeled samples. The
+    per-replica values come from each replica's ``get_stats()`` (the
+    scheduler's per-instance counts — the numbers the global
+    aggregate cannot attribute), re-read on every scrape, so a
+    dead/closed replica's series vanish instead of going stale.
+
+    Duck-types the registry surface TelemetryServer touches
+    (``to_prometheus``/``counter``/``to_dict``); mounted by
+    ``FleetRouter.serve_metrics``.
+    """
+
+    # stats() key -> exposition family, per kind
+    _COUNTERS = (("serving.iterations", "iteration"),
+                 ("serving.admitted", "admitted"),
+                 ("serving.retired", "retired"),
+                 ("serving.cancelled", "cancelled"),
+                 ("serving.deadline_cancels", "deadline_cancels"),
+                 ("serving.generated_tokens", "generated_tokens"),
+                 ("serving.prefill_tokens", "prefill_tokens"))
+    _GAUGES = (("serving.queue_depth", "queue_depth"),
+               ("serving.active_slots", "active_slots"))
+    _PREFIX_COUNTERS = (("serving.prefix.hits", "hits"),
+                        ("serving.prefix.misses", "misses"),
+                        ("serving.prefix.evictions", "evictions"),
+                        ("serving.prefix.cow_copies", "cow_copies"))
+
+    def __init__(self, fleet_fn, base=None):
+        self._base = base if base is not None else global_registry()
+        # -> [(replica_name, server.get_stats()), ...], re-read per
+        # scrape (live replicas only — the router's closure filters)
+        self._fleet_fn = fleet_fn
+
+    # -- registry facade (what TelemetryServer/_Handler touch) -------------
+    def counter(self, name, help=""):
+        return self._base.counter(name, help)
+
+    def gauge(self, name, help=""):
+        return self._base.gauge(name, help)
+
+    def get(self, name):
+        return self._base.get(name)
+
+    def to_dict(self):
+        out = self._base.to_dict()
+        out["fleet"] = {name: stats for name, stats in self._fleet_fn()}
+        return out
+
+    # -- exposition ---------------------------------------------------------
+    def _replica_samples(self, stats):
+        """-> (family, kind, value) triples for one replica's stats."""
+        for fam, key in self._COUNTERS:
+            if key in stats:
+                yield fam, "counter", stats[key]
+        for fam, key in self._GAUGES:
+            if key in stats:
+                yield fam, "gauge", stats[key]
+        if "blocks_total" in stats and "blocks_free" in stats:
+            yield ("serving.blocks_in_use", "gauge",
+                   stats["blocks_total"] - stats["blocks_free"])
+        pfx = stats.get("prefix")
+        if pfx:
+            for fam, key in self._PREFIX_COUNTERS:
+                if key in pfx:
+                    yield fam, "counter", pfx[key]
+            if "shared_blocks" in pfx:
+                yield ("serving.prefix.shared_blocks", "gauge",
+                       pfx["shared_blocks"])
+
+    def _collect(self):
+        from . import _help
+        extras = {}     # sanitized family -> [kind, help, [lines]]
+        for rep, stats in self._fleet_fn():
+            for fam, kind, value in self._replica_samples(stats):
+                pname = re.sub(r"[^a-zA-Z0-9_:]", "_", fam)
+                ent = extras.setdefault(
+                    pname, [kind, _escape_help(_help(fam)), []])
+                ent[2].append(
+                    f'{pname}{{replica="{_escape(rep)}"}} {value}')
+        return extras
+
+    def to_prometheus(self):
+        """The base exposition with per-replica samples spliced into
+        their families (all samples of one family stay contiguous —
+        the format's parser contract); families the base never
+        recorded are appended with their own HELP/TYPE header."""
+        extras = self._collect()
+        out, current = [], None
+
+        def _flush(next_family):
+            nonlocal current
+            if current is not None and current in extras:
+                out.extend(extras.pop(current)[2])
+            current = next_family
+
+        for line in self._base.to_prometheus().splitlines():
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                fam = line.split(" ", 3)[2]
+                if fam != current:
+                    _flush(fam)
+            out.append(line)
+        _flush(None)
+        for pname in sorted(extras):
+            kind, help_, lines = extras[pname]
+            if help_:
+                out.append(f"# HELP {pname} {help_}")
+            out.append(f"# TYPE {pname} {kind}")
+            out.extend(lines)
+        return "\n".join(out) + "\n"
 
 
 def check_remount(live, port, host):

@@ -195,6 +195,31 @@ METRIC_SPECS = [
     ("serving.mesh.psums_per_step", "gauge",
      "psum collectives one fused serving step pays (2 per layer: "
      "attention o-proj + ffn down-projection; label: server)"),
+    ("serving.fleet.routed", "counter",
+     "requests routed to a replica by the FleetRouter (unlabeled "
+     "aggregate plus a policy label: affinity, least_loaded, prefill, "
+     "decode)"),
+    ("serving.fleet.sheds", "counter",
+     "requests rejected by fleet admission control (AdmissionRejected "
+     "raised; unlabeled aggregate plus a scope label: fleet = SLO "
+     "burn-rate breach, capacity = no live replica)"),
+    ("serving.fleet.failovers", "counter",
+     "in-flight requests re-admitted on a surviving replica after "
+     "their replica died mid-stream"),
+    ("serving.fleet.handoffs", "counter",
+     "disaggregated prefill->decode migrations (one per request that "
+     "finished chunked prefill on the prefill pool and moved to a "
+     "decode replica)"),
+    ("serving.fleet.handoff_blocks", "counter",
+     "KV pool blocks copied across replica caches by disaggregated "
+     "handoffs (blocks the decode replica did NOT have to re-prefill)"),
+    ("serving.fleet.replicas", "gauge",
+     "live (ok or draining) replicas behind a FleetRouter (label: "
+     "router)"),
+    ("serving.fleet.replica_load", "gauge",
+     "per-replica live load the router balances on: queue_depth + "
+     "active_slots (labels: router, replica; series removed when the "
+     "replica dies or the router closes)"),
     ("tracing.dropped_events", "counter",
      "trace events dropped by the bounded ring buffer (drop-oldest)"),
     ("serving.queue_wait_ms", "histogram",

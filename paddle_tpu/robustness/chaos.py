@@ -36,7 +36,7 @@ class ChaosInjector:
         self.fired = {"poison": 0, "sigterm": 0, "write_fault": 0,
                       "cancel": 0, "clock_advance": 0,
                       "serving_poison": 0, "evict": 0,
-                      "hash_collision": 0}
+                      "hash_collision": 0, "replica_kill": 0}
         self._installed = False
         # serving-engine plan: iteration -> actions (scheduler hooks)
         self._serving_cancels = {}   # iteration -> [active-request index]
@@ -48,6 +48,8 @@ class ChaosInjector:
         self._serving_evicts = {}    # iteration -> evictions to force
         self._collide_hashes = set() # 1-based content-hash ordinals
         self._hash_count = 0
+        # fleet plan (serving/router.py hooks)
+        self._replica_kills = {}     # router iteration -> [replica idx]
 
     # -- plan ----------------------------------------------------------
     def poison_grad_at(self, step, var=None):
@@ -206,6 +208,30 @@ class ChaosInjector:
             self.fired["hash_collision"] += 1
             return True
         return False
+
+    # -- fleet hooks (serving/router.py) -------------------------------
+    def kill_replica_at(self, iteration, replica):
+        """Kill fleet replica index `replica` at the START of router
+        iteration `iteration` (1-based, the FleetRouter's own counter —
+        a router iteration only counts when the fleet has work, so the
+        plan is an exact point in the stream, never a wall-clock
+        race). The kill is the real death path: the engine closes
+        without drain, every in-flight future fails, and the router's
+        failover re-admits them on survivors — mirroring
+        poison_serving_at/evict_block_at, no sleeps anywhere."""
+        self._replica_kills.setdefault(int(iteration), []).append(
+            int(replica))
+        return self
+
+    def replica_kills_at(self, iteration):
+        """-> replica indices to kill at this router iteration.
+        Consumed by FleetRouter.step(); `fired["replica_kill"]` counts
+        via replica_kill_applied only when a LIVE replica was actually
+        torn down (a plan naming an already-dead replica is a no-op)."""
+        return self._replica_kills.pop(int(iteration), [])
+
+    def replica_kill_applied(self):
+        self.fired["replica_kill"] += 1
 
     # -- trainer hooks -------------------------------------------------
     def should_preempt(self, step):

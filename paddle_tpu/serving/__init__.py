@@ -30,7 +30,17 @@ deadline. This package is the TPU-native answer:
 - spec_decode.py — speculative decoding: a draft model proposes k
                   tokens, the fused step verifies them in one chunked
                   call, greedy acceptance is bitwise-exact
-                  (`spec=SpecDecodeConfig(draft_model, k)`).
+                  (`spec=SpecDecodeConfig(draft_model, k)`);
+- replica.py    — one GenerationServer behind the fleet lifecycle
+                  contract (health/load/affinity probes, drain, kill);
+- router.py     — FleetRouter: N replicas behind one submit() —
+                  prefix-affinity routing (the index chain keys ARE
+                  the affinity signal), SLO-burn-rate admission
+                  control (AdmissionRejected + retry-after), failover
+                  re-admission with stream dedupe, and a disaggregated
+                  prefill/decode RouterPolicy whose KV handoff is a
+                  cross-replica pool-slice transfer
+                  (docs/serving.md "Fleet serving").
 
 Entry points: `GenerationServer(GPTServingModel.from_scope(scope, cfg))`
 directly, or `AnalysisConfig.enable_generation(...)` +
@@ -41,18 +51,23 @@ has the block-table layout and tuning guide.
 from .kv_cache import (NULL_BLOCK, PagedDecodeLayer, PagedKVCache,
                        build_paged_decode_cache, gather_block_kv,
                        paged_attention, paged_attention_reference)
-from .prefix_cache import PrefixCacheIndex
+from .prefix_cache import PrefixCacheIndex, prompt_chain_keys
 from .scheduler import (ContinuousBatchingScheduler, DeadlineExceeded,
                         GenerationResult, RequestCancelled)
 from .engine import GenerationFuture, GenerationServer, GPTServingModel
 from .spec_decode import SpecDecodeConfig
+from .replica import Replica
+from .router import (AdmissionPolicy, AdmissionRejected, FleetFuture,
+                     FleetRouter, RouterPolicy)
 
 __all__ = [
     "PagedKVCache", "PagedDecodeLayer", "paged_attention",
     "paged_attention_reference", "gather_block_kv",
     "build_paged_decode_cache", "NULL_BLOCK",
-    "PrefixCacheIndex", "SpecDecodeConfig",
+    "PrefixCacheIndex", "prompt_chain_keys", "SpecDecodeConfig",
     "ContinuousBatchingScheduler", "GenerationResult",
     "DeadlineExceeded", "RequestCancelled",
     "GenerationServer", "GenerationFuture", "GPTServingModel",
+    "Replica", "FleetRouter", "FleetFuture", "RouterPolicy",
+    "AdmissionPolicy", "AdmissionRejected",
 ]

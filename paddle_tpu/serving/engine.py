@@ -1051,6 +1051,20 @@ class GenerationServer:
         None when disabled."""
         return self._tel
 
+    def health(self):
+        """The /healthz payload as a plain dict — the SAME semantics
+        in-process, so a fleet router health-checks its replicas
+        without HTTP round-trips (serving/replica.py): status is
+        "fault" once an engine fault latched, "closed" after close(),
+        "ok" otherwise."""
+        status = ("fault" if self._fault
+                  else "closed" if self._closed else "ok")
+        return {"status": status,
+                "engine_fault": repr(self._fault)
+                if self._fault else None,
+                "pending": self.pending(),
+                "iteration": self._sched.iteration}
+
     def serve_metrics(self, port=0, host=None):
         """Mount the stdlib telemetry endpoint (/metrics Prometheus
         exposition, /healthz, /slo) for this server. Binds loopback by
@@ -1066,21 +1080,12 @@ class GenerationServer:
         if self._exporter is not None and not self._exporter.closed:
             check_remount(self._exporter, port, host)
             return self._exporter        # live mount: idempotent
-
-        def _health():
-            # overrides the handler's default "ok": a faulted or closed
-            # engine must not scrape healthy
-            status = ("fault" if self._fault
-                      else "closed" if self._closed else "ok")
-            return {"status": status,
-                    "engine_fault": repr(self._fault)
-                    if self._fault else None,
-                    "pending": self.pending(),
-                    "iteration": self._sched.iteration}
-
+        # health_fn overrides the handler's default "ok": a faulted or
+        # closed engine must not scrape healthy (health() is the same
+        # payload the fleet router reads in-process)
         self._exporter = _serve(
             port=port, host=host or "127.0.0.1",
             slo_fn=lambda: (self._tel.stats()
                             if self._tel is not None else {}),
-            health_fn=_health)
+            health_fn=self.health)
         return self._exporter

@@ -370,6 +370,7 @@ class PagedKVCache:
         # too, so a repointed table means the same thing in both.
         self._siblings = []
         self._cow_fn = None
+        self._xfer_fn = None
         self.cow_copies = 0
 
     # -- allocation --------------------------------------------------------
@@ -502,6 +503,44 @@ class PagedKVCache:
         for h, pools in zip(holders, new_sets):
             h.pools = pools
         self.cow_copies += 1
+
+    def adopt_block_from(self, src_cache, src_block, dst_block):
+        """Pool-slice transfer BETWEEN caches: copy block `src_block`'s
+        rows out of `src_cache`'s pools into this cache's `dst_block`
+        across every layer — the disaggregated prefill/decode KV
+        handoff primitive (a prefill replica's finished prompt chunks
+        move into a decode replica's pool; serving/router.py). The
+        cow_copy idiom applied cross-cache: ONE jitted signature per
+        cache lifetime (block ids ride as traced scalars), so a
+        thousand handoffs compile once and the fused-step signature
+        budget is untouched. Geometry (layers/heads/head_dim/
+        block_size) must match — replicas of one model always do;
+        num_blocks may differ (it is a shape, not an id contract).
+        Sibling (draft) pools are NOT transferred: greedy speculative
+        decode stays bitwise-correct with a cold draft cache (accept
+        rate dips, ids cannot — every committed id is the target's)."""
+        if (src_cache.num_layers, src_cache.num_heads,
+                src_cache.head_dim, src_cache.block_size) != \
+                (self.num_layers, self.num_heads, self.head_dim,
+                 self.block_size):
+            raise ValueError(
+                f"adopt_block_from needs matching pool geometry; got "
+                f"src (L={src_cache.num_layers}, H={src_cache.num_heads},"
+                f" D={src_cache.head_dim}, bs={src_cache.block_size}) vs "
+                f"dst (L={self.num_layers}, H={self.num_heads}, "
+                f"D={self.head_dim}, bs={self.block_size})")
+        if self._xfer_fn is None:
+            def _xfer(src_pools, dst_pools, s, d):
+                return [
+                    {"k": dp["k"].at[d].set(
+                        sp["k"][s].astype(dp["k"].dtype)),
+                     "v": dp["v"].at[d].set(
+                         sp["v"][s].astype(dp["v"].dtype))}
+                    for sp, dp in zip(src_pools, dst_pools)]
+            self._xfer_fn = jax.jit(_xfer)
+        self.pools = self._xfer_fn(src_cache.pools, self.pools,
+                                   jnp.asarray(src_block, jnp.int32),
+                                   jnp.asarray(dst_block, jnp.int32))
 
     # -- layout helpers ----------------------------------------------------
     def make_table(self, blocks, max_blocks):

@@ -55,7 +55,7 @@ import itertools
 
 import numpy as np
 
-__all__ = ["PrefixCacheIndex"]
+__all__ = ["PrefixCacheIndex", "chain_hash", "prompt_chain_keys"]
 
 _INDEX_SEQ = itertools.count()
 
@@ -64,6 +64,35 @@ _INDEX_SEQ = itertools.count()
 # makes two DIFFERENT chunks hash to this value and the token-verify
 # fallback does the rest
 COLLISION_SENTINEL = "collision!"
+
+
+def chain_hash(parent_key, tokens):
+    """THE chunk chain hash (blake2b over the parent key bytes + the
+    chunk's int32 token bytes). Module-level so every consumer — the
+    index below AND the fleet router's affinity keys
+    (serving/router.py) — derives bitwise-identical keys from one
+    implementation; a second hasher would silently break
+    router-routes-to-the-replica-that-cached-it."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(b"" if parent_key is None else parent_key.encode())
+    h.update(np.ascontiguousarray(tokens, np.int32).tobytes())
+    return h.hexdigest()
+
+
+def prompt_chain_keys(prompt, block_size, n_chunks=None):
+    """Chain keys for `prompt`'s full `block_size` chunks — the
+    index-free form of PrefixCacheIndex.chain_keys the router uses for
+    affinity routing and the disaggregated KV handoff. Identical keys
+    by construction (same chain_hash, same chunking)."""
+    prompt = np.asarray(prompt, np.int32).reshape(-1)
+    if n_chunks is None:
+        n_chunks = len(prompt) // int(block_size)
+    keys, prev = [], None
+    for i in range(n_chunks):
+        prev = chain_hash(prev,
+                          prompt[i * block_size:(i + 1) * block_size])
+        keys.append(prev)
+    return keys
 
 
 class _Entry:
@@ -121,10 +150,7 @@ class PrefixCacheIndex:
         if self._chaos is not None and self._chaos.prefix_hash_collides():
             self.counts["collisions"] += 1
             return COLLISION_SENTINEL
-        h = hashlib.blake2b(digest_size=16)
-        h.update(b"" if parent_key is None else parent_key.encode())
-        h.update(np.ascontiguousarray(tokens, np.int32).tobytes())
-        return h.hexdigest()
+        return chain_hash(parent_key, tokens)
 
     def chain_keys(self, prompt, n_chunks, have=None):
         """Chain keys for the first `n_chunks` full chunks of `prompt`,
@@ -272,6 +298,18 @@ class PrefixCacheIndex:
                 break
             n += 1
         return n
+
+    def peek(self, key):
+        """-> (block, tokens, parent_key) for an indexed chain key, or
+        None. A read-only probe (no refs, no recency) — the fleet
+        router's disaggregated handoff walks a retired request's chain
+        through here to find WHICH pool blocks hold the prefix KV it
+        must transfer (serving/router.py). Call under the owning
+        scheduler's lock like every other method."""
+        e = self._entries.get(key)
+        if e is None:
+            return None
+        return e.block, e.tokens, e.parent
 
     # -- introspection -----------------------------------------------------
     def shared_block_count(self):

@@ -1,0 +1,156 @@
+"""Replica: one GenerationServer behind the fleet router's lifecycle
+contract.
+
+A fleet (serving/router.py) is N in-process GenerationServers — tp
+inside a replica, data parallelism ACROSS replicas (SNIPPETS [1]'s
+dp×fsdp×tp layout: the dp axis is this pool, never a mesh axis). The
+wrapper owns everything the router needs that the engine should not
+grow itself:
+
+- **health** — the engine's /healthz payload read in-process
+  (``GenerationServer.health()``), folded with the router-side state
+  machine: ``ok -> draining -> drained`` (graceful) or ``-> dead``
+  (kill/fault). A replica whose engine latched a fault reads ``dead``
+  even before the router noticed.
+- **load** — (queue_depth, active_slots, free_blocks) in one scheduler
+  lock hold, the power-of-two-choices comparison key.
+- **affinity** — how many leading prompt chunks this replica's prefix
+  index already holds (``PrefixCacheIndex.match``, the PURE probe: a
+  routing probe must not move hit/miss counters or LRU recency).
+- **shedding** — ``burn_rate(targets)``: the worst SRE burn rate over
+  the engine's cumulative SLO digests (PR 7 ``check_slo``); the router
+  sheds on THIS, never on queue depth.
+- **pump / kill / drain** — manual-drive step for the deterministic
+  tier (an engine NonFiniteError marks the replica dead instead of
+  propagating — the router fails over, it does not die), close(drain
+  =False) on kill so in-flight futures fail fast and the replica's
+  HBM-ledger rows / gauge series retire immediately.
+"""
+
+from .scheduler import RequestCancelled
+
+__all__ = ["Replica"]
+
+
+class Replica:
+    """One fleet member. States: ok (routing), draining (no new
+    routes, in-flight finishing), drained (empty + closed), dead
+    (killed or engine-faulted; in-flight failed over)."""
+
+    def __init__(self, index, server, name=None):
+        self.index = int(index)
+        self.server = server
+        self.name = name or f"r{index}"
+        self.state = "ok"
+        self.role = "mixed"         # "mixed" | "prefill" | "decode"
+
+    # -- health ------------------------------------------------------------
+    def health(self):
+        """The engine /healthz payload + the router-side state. An
+        engine fault or an unexpected close dominates: the wrapper may
+        learn of a death FROM this probe."""
+        h = self.server.health()
+        if self.state in ("dead", "drained"):
+            h["status"] = self.state
+        elif h["status"] in ("fault", "closed"):
+            h["status"] = "dead"
+        elif self.state == "draining":
+            h["status"] = "draining"
+        h["replica"] = self.name
+        h["role"] = self.role
+        return h
+
+    def alive(self):
+        """Engine still serviceable (ok or draining)."""
+        return (self.state in ("ok", "draining")
+                and self.server._fault is None
+                and not self.server._closed)
+
+    def accepting(self):
+        """May receive NEW routed requests."""
+        return self.state == "ok" and self.alive()
+
+    # -- routing signals ---------------------------------------------------
+    def load(self):
+        """(queue_depth, active_slots, free_blocks) — one lock hold."""
+        return self.server._sched.load_snapshot()
+
+    def affinity_depth(self, prompt, keys):
+        """Leading prompt chunks whose KV this replica's prefix cache
+        already holds (0 without a prefix cache). Pure — see
+        PrefixCacheIndex.match; taken under the scheduler lock because
+        the engine thread mutates the index under it."""
+        idx = self.server._prefix
+        if idx is None or not keys:
+            return 0
+        with self.server._sched._lock:
+            return len(idx.match(prompt, keys))
+
+    def burn_rate(self, targets):
+        """Worst burn rate over `targets` (check_slo semantics), or
+        None with no observations yet — a cold replica must read
+        healthy, not infinitely breached."""
+        if self.server.telemetry is None:
+            return None
+        worst = None
+        for c in self.server.check_slo(targets)["checks"]:
+            b = c["burn_rate"]
+            if b is not None and (worst is None or b > worst):
+                worst = b
+        return worst
+
+    # -- lifecycle ---------------------------------------------------------
+    def pump(self):
+        """One engine iteration in manual-drive mode. An engine fault
+        (NonFiniteError — e.g. a chaos KV poison) marks this replica
+        dead instead of propagating: a fleet outlives one replica, and
+        the router re-admits the in-flight requests the fault failed."""
+        from ..robustness.guard import NonFiniteError
+        try:
+            return self.server.step()
+        except NonFiniteError:
+            self.state = "dead"
+            return False
+
+    def has_work(self):
+        return self.alive() and self.server._sched.has_work()
+
+    def kill(self):
+        """Replica death (chaos kill_replica_at, or operator action):
+        fail every in-flight/queued request NOW (their futures raise
+        RequestCancelled — the router's failover hook re-admits them
+        elsewhere) and tear the engine down. close() retires the
+        replica's HBM-ledger rows, SLO gauge series, and prefix gauge —
+        a dead replica must not keep reporting live pool bytes."""
+        if self.state in ("dead", "drained"):
+            return
+        self.state = "dead"
+        self.server.close(drain=False)
+
+    def drain(self):
+        """Graceful: stop accepting routed requests; in-flight and
+        queued requests keep running to completion. The router's step()
+        closes the engine once the replica is empty (state 'drained')."""
+        if self.state == "ok":
+            self.state = "draining"
+
+    def finish_drain_if_idle(self):
+        """draining + empty -> close + 'drained'. Returns True when the
+        transition happened."""
+        if self.state != "draining" or self.server._sched.has_work():
+            return False
+        self.server.close(drain=False)
+        self.state = "drained"
+        return True
+
+    def close(self):
+        if self.state in ("dead", "drained"):
+            # engine close already ran; it is idempotent about gauges
+            self.server.close()
+            return
+        self.state = "drained"
+        self.server.close()
+
+    def __repr__(self):
+        return (f"Replica({self.name}, state={self.state!r}, "
+                f"role={self.role!r})")

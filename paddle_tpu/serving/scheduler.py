@@ -264,6 +264,17 @@ class ContinuousBatchingScheduler:
             return bool(self._queue) or any(
                 s is not None for s in self._slots)
 
+    def load_snapshot(self):
+        """(queue_depth, active_slots, free_blocks) under ONE lock hold
+        — the fleet router's power-of-two-choices load probe
+        (serving/router.py) reads all three per candidate per submit,
+        and three separate property reads would take the lock three
+        times AND could tear across an admission."""
+        with self._lock:
+            return (len(self._queue),
+                    sum(s is not None for s in self._slots),
+                    self._cache.num_free)
+
     # -- retirement --------------------------------------------------------
     def _finish(self, req, reason):
         ttft = None

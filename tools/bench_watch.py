@@ -258,6 +258,19 @@ def run_suite():
                  env={"JAX_PLATFORMS": "cpu",
                       "BENCH_PREFIX_COMPARE": "1"},
                  timeout_s=900, stdout_path="bench_prefix.json")
+    # 1f3. fleet-router comparison (ISSUE 11): affinity vs random
+    #     routing over a long-tail multi-tenant prefix storm (fleet
+    #     hit rate, blocks/request) + p99 TTFT under overload with vs
+    #     without SLO-burn-rate shedding (injected clocks,
+    #     deterministic), on the CPU backend
+    if _artifact_ok("bench_fleet.json"):
+        log("step fleet_compare: already landed in a prior cycle — "
+            "skipping")
+    else:
+        run_step("fleet_compare", [py, bench],
+                 env={"JAX_PLATFORMS": "cpu",
+                      "BENCH_FLEET_COMPARE": "1"},
+                 timeout_s=900, stdout_path="bench_fleet.json")
     # 1g. compile-observatory sample (ISSUE 8): Executor.explain()
     #     report + provoked recompile storm + HBM-ledger snapshot +
     #     detector on-vs-off overhead, on the CPU backend

@@ -232,6 +232,32 @@ def print_serving_summary(metrics, file=None):
         sa = _counter_total(metrics, "serving.spec.accepted")
         print(f"serving: spec proposed={sp} accepted={sa} "
               f"accept-rate={sa / max(sp, 1):.1%}", file=file)
+    # fleet router (ISSUE 11): routed-by-policy, shedding, failover,
+    # and disaggregated handoff traffic
+    routed_vals = metrics.get("serving.fleet.routed", {}).get(
+        "values", [])
+    # the unlabeled child is the aggregate; policy= children break it
+    # down (summing every child would double-count)
+    routed = sum(v.get("value", 0) for v in routed_vals
+                 if not v.get("labels"))
+    if routed:
+        by_policy = {}
+        for v in routed_vals:
+            pol = v.get("labels", {}).get("policy")
+            if pol:
+                by_policy[pol] = by_policy.get(pol, 0) + v.get(
+                    "value", 0)
+        sheds = sum(v.get("value", 0) for v in metrics.get(
+            "serving.fleet.sheds", {}).get("values", [])
+            if not v.get("labels"))
+        fo = _counter_total(metrics, "serving.fleet.failovers")
+        ho = _counter_total(metrics, "serving.fleet.handoffs")
+        hb = _counter_total(metrics, "serving.fleet.handoff_blocks")
+        pol_s = " ".join(f"{k}={v}" for k, v in sorted(
+            by_policy.items()))
+        print(f"serving: fleet routed={routed} ({pol_s}) sheds={sheds} "
+              f"failovers={fo} handoffs={ho} handoff_blocks={hb}",
+              file=file)
     quant = metrics.get("serving.slo.quantile_ms")
     if windows and quant:
         # key on (server, metric): two live GenerationServers publish
@@ -389,6 +415,27 @@ def run_demo(out_dir):
     for f in (w1, w2):
         f.result(timeout=5)
 
+    # fleet router demo (ISSUE 11): a 2-replica routed stream — the
+    # second wave repeats the first wave's prompts so prefix-affinity
+    # routing fires (serving.fleet.routed{policy=affinity} next to the
+    # least_loaded cold routes in the committed sample)
+    from paddle_tpu.serving import FleetRouter
+    freps = [GenerationServer(GPTServingModel(sparams, scfg),
+                              num_slots=2, block_size=8, max_context=64,
+                              chunk=4, start=False, prefix_cache=True)
+             for _ in range(2)]
+    frouter = FleetRouter(freps, start=False)
+    fprompts = [np.arange(3 + i, 19 + i, dtype=np.int32)
+                for i in range(2)]
+    waves = [frouter.submit(p, max_new_tokens=4) for p in fprompts]
+    frouter.run_until_idle()
+    waves += [frouter.submit(p, max_new_tokens=4) for p in fprompts]
+    frouter.run_until_idle()
+    for f in waves:
+        f.result(timeout=5)
+    fleet_stats = frouter.get_stats()
+    frouter.close()
+
     metrics_path = os.path.join(out_dir, "metrics_sample.json")
     dump = global_registry().to_dict()
     dump["executor_stats"] = exe.get_stats()
@@ -398,6 +445,7 @@ def run_demo(out_dir):
                                rollbacks=guard_result.rollbacks,
                                steps=guard_result.steps)
     dump["serving_stats"] = server.get_stats()
+    dump["fleet_stats"] = fleet_stats
     with open(metrics_path, "w") as f:
         # single line: perf/ artifacts are parsed line-wise by
         # tools/bench_watch.py's _artifact_ok
