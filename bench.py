@@ -51,7 +51,11 @@ blocks/request strictly below the no-sharing engine and hit rate
 routing hit rate/blocks per request over a multi-tenant hot/cold
 prefix storm + p99 TTFT under overload with vs without SLO-burn-rate
 shedding; knobs BENCH_FLEET_{REQUESTS,REPLICAS,SLOTS,OVERLOAD}),
-BENCH_COMPILE_SAMPLE=1 (compile-observatory artifact: a tiny-GPT
+BENCH_CHAOS_RECOVERY=1 (self-healing fleet under a scripted
+kill + hang + poison storm: worst time-to-full-strength in router
+iterations x 20 ms nominal, goodput fraction, quarantine facts;
+knobs BENCH_CHAOS_{REQUESTS,REPLICAS,SLOTS}; deterministic injected
+clocks), BENCH_COMPILE_SAMPLE=1 (compile-observatory artifact: a tiny-GPT
 Executor.explain() report, a provoked recompile storm with its key
 diffs, the HBM-ledger snapshot, and the recompile-detector on-vs-off
 steady-state overhead; knobs BENCH_COMPILE_{STEPS,ROUNDS,SEQ};
@@ -63,6 +67,7 @@ timed directly in microseconds).
 import json
 import os
 import sys
+import tempfile
 import time
 
 V100_BERT_BASE_TOKENS_PER_SEC = 2800.0
@@ -1822,6 +1827,204 @@ def run_fleet_compare(kind):
     return 0
 
 
+def run_chaos_recovery(kind):
+    """BENCH_CHAOS_RECOVERY=1: the self-healing fleet (ISSUE 13) under
+    a scripted kill + hang + poison storm — one JSON line
+    (perf/bench_chaos.json) recording how fast the fleet returns to
+    full strength and how much goodput survives the faults.
+
+    Fully deterministic: manual-drive replicas, heartbeats = router
+    iterations, engine clocks injected (20 ms per engine iteration),
+    recovery measured in ROUTER ITERATIONS with a nominal 20 ms/iter
+    conversion — queueing/recovery STRUCTURE, not wall-clock noise
+    (the honest CPU-backend caveat of every serving bench here). The
+    storm: replica 0 killed, replica 1 hung (watchdog must catch it),
+    and one poison request whose replay faults every engine that
+    serves it (quarantined after 2 deaths). Every dead slot
+    resurrects through spawn_fn under the crash-loop breaker with
+    prefix re-warm. Knobs: BENCH_CHAOS_{REQUESTS,REPLICAS,SLOTS}.
+    Never raises (failures are recorded, not fatal)."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.core import framework
+    from paddle_tpu.core.executor import Scope, scope_guard
+    from paddle_tpu.models import gpt
+    from paddle_tpu.robustness import (ChaosInjector, PoisonRequestError,
+                                       SupervisorConfig)
+    from paddle_tpu.serving import FleetRouter, GenerationServer, \
+        GPTServingModel
+
+    n_req = int(os.environ.get("BENCH_CHAOS_REQUESTS", 18))
+    n_rep = int(os.environ.get("BENCH_CHAOS_REPLICAS", 3))
+    slots = int(os.environ.get("BENCH_CHAOS_SLOTS", 2))
+    block_size, chunk, max_context = 8, 4, 96
+    ms_per_iter = 20.0      # the injected-clock convention of the
+    #                         fleet overload bench: latency = structure
+
+    cfg = gpt.gpt_tiny()
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 7
+    with framework.program_guard(main, startup):
+        gpt.build_lm_net(cfg, seq_len=8)
+    scope = Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    with scope_guard(scope):
+        exe.run(startup)
+        params = gpt.load_params(scope, cfg)
+
+    rng = np.random.default_rng(0)
+    tenant = rng.integers(3, cfg.vocab_size, 16).astype(np.int32)
+    reqs = []
+    for i in range(n_req):
+        gen = int(rng.integers(4, 10))
+        if i % 3 == 0:
+            reqs.append((np.concatenate([tenant, rng.integers(
+                3, cfg.vocab_size, 3).astype(np.int32)]), gen))
+        else:
+            reqs.append((rng.integers(
+                3, cfg.vocab_size,
+                int(rng.integers(9, 25))).astype(np.int32), gen))
+    poison = rng.integers(3, cfg.vocab_size, 12).astype(np.int32)
+
+    result = {"metric": "serving_fleet_chaos_recovery",
+              "requests": n_req, "replicas": n_rep, "slots": slots,
+              "ms_per_iteration_nominal": ms_per_iter,
+              "storm": {"kill_at_iteration": 3, "hang_at_iteration": 5,
+                        "poison_requests": 1},
+              "device_kind": kind}
+    # fault postmortems (engine NonFiniteError dumps, the quarantine
+    # dump) go to a scratch dir, never the cwd
+    flight_dir = tempfile.mkdtemp(prefix="bench_chaos_flight_")
+    try:
+        # kill and hang fire FIRST (their targets must still be alive
+        # when the plan lands); the poison request arrives mid-stream
+        # so its failover chain plays out against the healing fleet
+        chaos = (ChaosInjector()
+                 .kill_replica_at(3, 0)
+                 .hang_replica_at(5, 1)
+                 .poison_prompt(poison))
+
+        def spawn(_index):
+            return GenerationServer(
+                GPTServingModel(params, cfg), num_slots=slots,
+                block_size=block_size, max_context=max_context,
+                chunk=chunk, start=False, prefix_cache=True,
+                chaos=chaos, flight_dir=flight_dir)
+
+        servers = [spawn(i) for i in range(n_rep)]
+        router = FleetRouter(
+            servers, start=False, chaos=chaos, spawn_fn=spawn,
+            flight_dir=flight_dir,
+            supervisor=SupervisorConfig(hang_heartbeats=3,
+                                        backoff_heartbeats=2,
+                                        warm_chains=4))
+        futs = []
+        t0 = time.perf_counter()
+        # staggered arrival, poison injected early so its failover
+        # chain plays out inside the storm
+        live_trace = []         # (router step count, live replicas)
+        steps = 0
+
+        def pump():
+            nonlocal steps
+            router.step()
+            steps += 1
+            live_trace.append(
+                (steps, router.get_stats()["live_replicas"]))
+
+        for i, (p, g) in enumerate(reqs):
+            futs.append(router.submit(p, max_new_tokens=g))
+            if i == 7:
+                futs.append(router.submit(poison, max_new_tokens=6))
+            pump()
+        while router.step():
+            steps += 1
+            live_trace.append(
+                (steps, router.get_stats()["live_replicas"]))
+        wall_s = time.perf_counter() - t0
+
+        # recovery spans: every dip below full strength -> the step
+        # it returned; the worst span is the time-to-full-strength
+        spans, dip_start = [], None
+        for s, live in live_trace:
+            if live < n_rep and dip_start is None:
+                dip_start = s
+            elif live >= n_rep and dip_start is not None:
+                spans.append(s - dip_start)
+                dip_start = None
+        if dip_start is not None:       # never recovered (shouldn't)
+            spans.append(live_trace[-1][0] - dip_start)
+        completed, quarantined, good_tokens = 0, 0, 0
+        for f in futs:
+            try:
+                r = f.result(timeout=10)
+                completed += 1
+                good_tokens += len(r.token_ids)
+            except PoisonRequestError:
+                quarantined += 1
+            except Exception:   # noqa: BLE001 — counted as lost
+                pass
+        st = router.get_stats()
+        submitted_tokens = sum(g for _p, g in reqs) + 6
+        recovered = st["live_replicas"] == n_rep
+        dipped = [s for s, live in live_trace if live < n_rep]
+        # None when the fleet never returned to full strength — a
+        # dashboard must not see a recovery stamp that never happened
+        full_at = (max(dipped) + 1) if dipped and recovered else (
+            0 if recovered else None)
+        result.update({
+            "value": round(max(spans, default=0) * ms_per_iter, 1),
+            "unit": "worst time-to-full-strength, ms "
+                    "(router iterations x 20 ms nominal)",
+            "recovery": {
+                "deaths": (st["replica_kills"] + st["hangs"]
+                           + st["quarantines"] * 2),
+                "resurrections": st["resurrections"],
+                "crash_loops": st["crash_loops"],
+                "hangs_detected": st["hangs"],
+                "recovery_spans_iterations": spans,
+                "worst_span_iterations": max(spans, default=0),
+                "worst_span_ms_nominal": round(
+                    max(spans, default=0) * ms_per_iter, 1),
+                "fleet_full_strength_at_iteration": full_at,
+                "final_live_replicas": st["live_replicas"],
+                "total_router_iterations": steps,
+            },
+            "goodput": {
+                "submitted": len(futs),
+                "completed_non_poison": completed,
+                "quarantined": quarantined,
+                "failovers": st["failovers"],
+                "tokens_delivered": good_tokens,
+                "tokens_submitted": submitted_tokens,
+                "goodput_fraction": round(
+                    good_tokens / max(submitted_tokens, 1), 4),
+            },
+            "quarantine": {
+                "poison_threshold": st["poison_threshold"],
+                "quarantines": st["quarantines"],
+                "poison_faults_fired": chaos.fired["prompt_poison"],
+            },
+            "wall_s": round(wall_s, 3),
+            "caveat": "CPU backend, injected clocks: recovery spans "
+                      "are exact ITERATION counts (deterministic); the "
+                      "nominal ms conversion is for dashboard scale, "
+                      "wall_s is the contended-container wall time",
+            "fleet_back_to_full_strength":
+                st["live_replicas"] == n_rep,
+            "every_fault_fired": (
+                chaos.fired["replica_kill"] == 1
+                and chaos.fired["replica_hang"] == 1
+                and chaos.fired["prompt_poison"] >= 2),
+        })
+        router.close()
+    except Exception as e:      # noqa: BLE001 — evidence, not a gate
+        print(f"bench: chaos recovery FAILED ({e!r})", file=sys.stderr)
+        result.update({"failed": True, "error": repr(e)})
+    print(json.dumps(_mark_degraded(result)), flush=True)
+    return 0
+
+
 def run_telemetry_compare(kind):
     """BENCH_TELEMETRY_COMPARE=1: request-level telemetry overhead —
     the SAME mixed-length greedy stream through two GenerationServers,
@@ -2255,6 +2458,11 @@ def main():
         # fleet router: affinity-vs-random routing hit rate + p99 TTFT
         # under overload with/without SLO shedding (serving layer)
         return run_fleet_compare(kind)
+
+    if os.environ.get("BENCH_CHAOS_RECOVERY") == "1":
+        # self-healing fleet under a scripted kill/hang/poison storm:
+        # time-to-full-strength + goodput (robustness layer)
+        return run_chaos_recovery(kind)
 
     if os.environ.get("BENCH_COMPILE_SAMPLE") == "1":
         # compile-observatory artifact: explain() report + recompile

@@ -219,7 +219,26 @@ METRIC_SPECS = [
     ("serving.fleet.replica_load", "gauge",
      "per-replica live load the router balances on: queue_depth + "
      "active_slots (labels: router, replica; series removed when the "
-     "replica dies or the router closes)"),
+     "replica dies, is evicted by the crash-loop breaker, or the "
+     "router closes)"),
+    ("serving.fleet.hangs", "counter",
+     "replicas declared HUNG by the supervisor watchdog (progress "
+     "marks frozen for N heartbeats with work pending) and torn down "
+     "so failover re-admits their in-flight requests"),
+    ("serving.fleet.resurrections", "counter",
+     "dead replicas respawned by the fleet supervisor and returned "
+     "to rotation after a half-open probe, prefix cache re-warmed "
+     "from the router's chunk-popularity digest"),
+    ("serving.fleet.crash_loops", "counter",
+     "failed resurrection attempts (spawn/probe failure, or a "
+     "resurrected replica dying again before retiring a single "
+     "request); max_crash_loops consecutive trips permanently evict "
+     "the replica slot"),
+    ("serving.fleet.quarantines", "counter",
+     "poison requests quarantined by the router: implicated in >= "
+     "poison_threshold replica deaths (engine faults naming their "
+     "lane), failed with PoisonRequestError instead of re-admitted "
+     "onto another survivor"),
     ("tracing.dropped_events", "counter",
      "trace events dropped by the bounded ring buffer (drop-oldest)"),
     ("serving.queue_wait_ms", "histogram",

@@ -17,12 +17,21 @@ the Executor and checkpoint I/O (docs/robustness.md):
 - preemption.py / chaos.py — SIGTERM/SIGINT drain-and-save, and the
                 deterministic fault injector the chaos test tier uses
                 to exercise every recovery path without flaky timing.
+- supervisor.py — the SERVING side of the same story: the fleet
+                watchdog (hung-replica detection off progress marks),
+                replica resurrection under a crash-loop circuit
+                breaker with checkpoint weight reload and prefix-cache
+                re-warm, and the poison-request quarantine error
+                (docs/robustness.md "Self-healing fleet").
 """
 
 from .guard import GuardConfig, NonFiniteError
 from .chaos import ChaosInjector, CheckpointWriteFault
 from .checkpoint_manager import CheckpointError, CheckpointManager
 from .preemption import PreemptionHandler
+from .supervisor import (ChunkPopularityDigest, FleetSupervisor,
+                         PoisonRequestError, SupervisorConfig,
+                         make_checkpoint_spawn)
 from .trainer import (GuardedTrainer, RecoveryPolicy, TrainResult,
                       lr_backoff)
 
@@ -31,5 +40,7 @@ __all__ = [
     "ChaosInjector", "CheckpointWriteFault",
     "CheckpointError", "CheckpointManager",
     "PreemptionHandler",
+    "FleetSupervisor", "SupervisorConfig", "PoisonRequestError",
+    "ChunkPopularityDigest", "make_checkpoint_spawn",
     "GuardedTrainer", "RecoveryPolicy", "TrainResult", "lr_backoff",
 ]

@@ -36,7 +36,9 @@ class ChaosInjector:
         self.fired = {"poison": 0, "sigterm": 0, "write_fault": 0,
                       "cancel": 0, "clock_advance": 0,
                       "serving_poison": 0, "evict": 0,
-                      "hash_collision": 0, "replica_kill": 0}
+                      "hash_collision": 0, "replica_kill": 0,
+                      "replica_hang": 0, "replica_slow": 0,
+                      "prompt_poison": 0}
         self._installed = False
         # serving-engine plan: iteration -> actions (scheduler hooks)
         self._serving_cancels = {}   # iteration -> [active-request index]
@@ -50,6 +52,10 @@ class ChaosInjector:
         self._hash_count = 0
         # fleet plan (serving/router.py hooks)
         self._replica_kills = {}     # router iteration -> [replica idx]
+        self._replica_hangs = {}     # router iteration -> [replica idx]
+        self._replica_slow = {}      # replica idx -> ms per iteration
+        self._slow_counted = set()   # replicas whose slow plan fired
+        self._prompt_poisons = []    # (np.int32 prompt, kv layer)
 
     # -- plan ----------------------------------------------------------
     def poison_grad_at(self, step, var=None):
@@ -232,6 +238,71 @@ class ChaosInjector:
 
     def replica_kill_applied(self):
         self.fired["replica_kill"] += 1
+
+    def hang_replica_at(self, iteration, replica):
+        """Make fleet replica index `replica` HANG from the start of
+        router iteration `iteration` (1-based): the router stops
+        pumping its engine, so the replica stalls — queue and slots
+        frozen mid-stream — WITHOUT dying. Nothing fails a future, so
+        failover never triggers; only the supervisor's watchdog (stale
+        progress marks across N heartbeats) can catch it. The hang is
+        standing: it ends when the watchdog declares the replica hung
+        and tears it down."""
+        self._replica_hangs.setdefault(int(iteration), []).append(
+            int(replica))
+        return self
+
+    def replica_hangs_at(self, iteration):
+        """-> replica indices that begin hanging at this router
+        iteration. Consumed by FleetRouter.step();
+        `fired["replica_hang"]` counts via replica_hang_applied only
+        when a LIVE replica actually started stalling."""
+        return self._replica_hangs.pop(int(iteration), [])
+
+    def replica_hang_applied(self):
+        self.fired["replica_hang"] += 1
+
+    def slow_replica(self, replica, ms_per_iteration):
+        """Standing plan: every pump of fleet replica index `replica`
+        reports an extra `ms_per_iteration` of step time to the
+        router's per-replica timing (the watchdog's slow-classification
+        input). The replica still advances — progress marks move — so
+        the watchdog must label it `slow`, never hung or dead."""
+        self._replica_slow[int(replica)] = float(ms_per_iteration)
+        return self
+
+    def replica_slow_ms(self, replica):
+        """-> the injected extra ms for this replica's pumps, or None.
+        Counted once on first application (the plan is standing)."""
+        ms = self._replica_slow.get(int(replica))
+        if ms is not None and int(replica) not in self._slow_counted:
+            self._slow_counted.add(int(replica))
+            self.fired["replica_slow"] += 1
+        return ms
+
+    def poison_prompt(self, prompt_ids, layer=0):
+        """Mark a PROMPT as poison: whenever a lane serving exactly
+        this prompt has advanced past position 0, the engine NaNs the
+        lane's first KV block (layer `layer`) before its next fused
+        step — the NaN flows through real attention into that lane's
+        logits and trips the non-finite fail-stop. Unlike
+        poison_serving_at (keyed to one engine iteration), this plan is
+        STANDING and content-addressed, so the request's failover
+        REPLAY re-faults every replica it lands on — the deterministic
+        poison-request cascade the router's quarantine exists to stop
+        (fired["prompt_poison"] counts one per application, i.e. one
+        per replica death it causes)."""
+        self._prompt_poisons.append(
+            (np.asarray(prompt_ids, np.int32).reshape(-1), int(layer)))
+        return self
+
+    def prompt_poison_plan(self):
+        """-> the standing [(prompt, layer)] plan (empty list when no
+        prompt is marked). Consumed every engine step."""
+        return self._prompt_poisons
+
+    def prompt_poison_applied(self):
+        self.fired["prompt_poison"] += 1
 
     # -- trainer hooks -------------------------------------------------
     def should_preempt(self, step):

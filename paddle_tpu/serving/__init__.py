@@ -40,7 +40,13 @@ deadline. This package is the TPU-native answer:
                   re-admission with stream dedupe, and a disaggregated
                   prefill/decode RouterPolicy whose KV handoff is a
                   cross-replica pool-slice transfer
-                  (docs/serving.md "Fleet serving").
+                  (docs/serving.md "Fleet serving"); with
+                  `supervisor=`/`spawn_fn=` the fleet SELF-HEALS —
+                  hung-replica watchdog, replica resurrection under a
+                  crash-loop breaker with prefix re-warm, and
+                  poison-request quarantine
+                  (robustness/supervisor.py, docs/robustness.md
+                  "Self-healing fleet").
 
 Entry points: `GenerationServer(GPTServingModel.from_scope(scope, cfg))`
 directly, or `AnalysisConfig.enable_generation(...)` +

@@ -356,6 +356,8 @@ class GenerationServer:
             telemetry = None
         self._tel = telemetry
         self._chaos = chaos
+        self._prompt_poison_fired = set()   # plan entries this engine
+        #                                     already applied (chaos)
         self._fault = None          # first engine fault (NonFiniteError)
         self._exporter = None
         self._sched = ContinuousBatchingScheduler(
@@ -651,6 +653,25 @@ class GenerationServer:
                                 "lanes": len(plan.slot_ids),
                                 "prefill_tokens": plan.prefill_tokens}):
                 if self._chaos is not None:
+                    # content-addressed poison: a STANDING plan keyed
+                    # to a request's prompt bytes, so the fault follows
+                    # the request's failover replay onto every replica
+                    # it lands on (the quarantine cascade seed). Each
+                    # plan entry applies (and counts) at most once per
+                    # ENGINE — the fault kills the server the same
+                    # iteration, so fired == replica deaths caused,
+                    # never inflated by a lane sitting poisoned across
+                    # iterations
+                    for pi, (pp, pl) in enumerate(
+                            self._chaos.prompt_poison_plan()):
+                        if pi in self._prompt_poison_fired:
+                            continue
+                        blk = self._sched.lane_block_for_prompt(pp)
+                        if blk is not None:
+                            pool = self.cache.pools[pl]
+                            pool["k"] = pool["k"].at[blk].set(jnp.nan)
+                            self._prompt_poison_fired.add(pi)
+                            self._chaos.prompt_poison_applied()
                     poison_layer = self._chaos.serving_poison_at(it)
                     if poison_layer is not None:
                         if self._poison_kv(poison_layer, lanes):
@@ -845,6 +866,13 @@ class GenerationServer:
             f"serving.logits[slot {bad[0]}]", iteration,
             [f"serving.logits[slot {s}]" for s in bad])
         err.flight_dump = dump
+        # fault ATTRIBUTION for the fleet router: the replica-local
+        # request ids whose lanes actually went non-finite. cancel_all
+        # fails EVERY in-flight future with this same error, and the
+        # router's poison-quarantine lineage must implicate only the
+        # requests that were in the blast center — innocent bystanders
+        # fail over without a strike (serving/router.py)
+        err.bad_rids = bad_rids
         self._fault = err
         with self._rid_lock:
             self._closed = True

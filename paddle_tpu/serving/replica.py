@@ -35,7 +35,8 @@ __all__ = ["Replica"]
 class Replica:
     """One fleet member. States: ok (routing), draining (no new
     routes, in-flight finishing), drained (empty + closed), dead
-    (killed or engine-faulted; in-flight failed over)."""
+    (killed or engine-faulted; in-flight failed over), evicted
+    (crash-loop circuit breaker gave up — never respawned again)."""
 
     def __init__(self, index, server, name=None):
         self.index = int(index)
@@ -43,6 +44,9 @@ class Replica:
         self.name = name or f"r{index}"
         self.state = "ok"
         self.role = "mixed"         # "mixed" | "prefill" | "decode"
+        self.condition = "ok"       # "ok" | "slow" (watchdog verdict)
+        self.generation = 0         # resurrection count for this slot
+        self.step_ms_ema = None     # router-measured pump time (EMA)
 
     # -- health ------------------------------------------------------------
     def health(self):
@@ -50,7 +54,7 @@ class Replica:
         engine fault or an unexpected close dominates: the wrapper may
         learn of a death FROM this probe."""
         h = self.server.health()
-        if self.state in ("dead", "drained"):
+        if self.state in ("dead", "drained", "evicted"):
             h["status"] = self.state
         elif h["status"] in ("fault", "closed"):
             h["status"] = "dead"
@@ -58,6 +62,8 @@ class Replica:
             h["status"] = "draining"
         h["replica"] = self.name
         h["role"] = self.role
+        h["condition"] = self.condition
+        h["generation"] = self.generation
         return h
 
     def alive(self):
@@ -85,6 +91,29 @@ class Replica:
             return 0
         with self.server._sched._lock:
             return len(idx.match(prompt, keys))
+
+    def progress_mark(self):
+        """The watchdog's heartbeat sample: a tuple that MUST advance
+        whenever the engine does real work (scheduler iteration count +
+        token/admission/retirement counters). A replica whose mark is
+        frozen across N heartbeats while has_work() stays True is hung
+        — stuck inside (or never entering) an engine iteration — which
+        neither health() nor failover can see: the engine is not dead,
+        its futures never resolve, nothing raises. Pure counter reads,
+        no clocks — the supervisor's hang verdict is deterministic
+        under the injected serving clock."""
+        st = self.server._sched
+        c = st.counts
+        return (st.iteration, c["generated_tokens"],
+                c["prefill_tokens"], c["admitted"], c["retired"],
+                c["cancelled"], c["deadline_cancels"])
+
+    def note_step_ms(self, ms):
+        """Record one pump's duration (router-measured; chaos may
+        inflate it). EMA so one slow iteration does not flip the
+        slow verdict."""
+        self.step_ms_ema = (float(ms) if self.step_ms_ema is None
+                            else 0.5 * self.step_ms_ema + 0.5 * float(ms))
 
     def burn_rate(self, targets):
         """Worst burn rate over `targets` (check_slo semantics), or
@@ -122,7 +151,7 @@ class Replica:
         elsewhere) and tear the engine down. close() retires the
         replica's HBM-ledger rows, SLO gauge series, and prefix gauge —
         a dead replica must not keep reporting live pool bytes."""
-        if self.state in ("dead", "drained"):
+        if self.state in ("dead", "drained", "evicted"):
             return
         self.state = "dead"
         self.server.close(drain=False)
@@ -144,7 +173,7 @@ class Replica:
         return True
 
     def close(self):
-        if self.state in ("dead", "drained"):
+        if self.state in ("dead", "drained", "evicted"):
             # engine close already ran; it is idempotent about gauges
             self.server.close()
             return

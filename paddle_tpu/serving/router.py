@@ -41,12 +41,39 @@ needs already existed as loose parts; this module is the composition:
   the decode admission matches the chain and skips prefill for every
   transferred chunk. Only the tail partial chunk re-prefills.
 
+ISSUE 13 makes the fleet SELF-HEALING (robustness/supervisor.py,
+docs/robustness.md "Self-healing fleet"):
+
+- **Supervision** — ``supervisor=True`` (or a SupervisorConfig) runs a
+  FleetSupervisor heartbeat every router iteration: a hung replica
+  (progress marks frozen with work pending — chaos
+  ``hang_replica_at``) is detected and torn down by the WATCHDOG, not
+  failover; dead replicas are respawned through ``spawn_fn(index)``
+  under a crash-loop circuit breaker, probed half-open, and re-warmed
+  from the router's fleet-wide chunk-popularity digest before
+  rejoining.
+- **Poison quarantine** — every failover records the death in the
+  request's lineage; an engine fault IMPLICATES the requests its
+  NonFiniteError names (``bad_rids``), and a request implicated in
+  ``poison_threshold`` (default 2) deaths is failed with a structured
+  ``PoisonRequestError`` (recorded + dumped in the fleet flight
+  recorder) instead of cascading onto the next survivor. Every
+  re-admission already propagates only the REMAINING deadline; a
+  per-request ``retry_budget`` (submit kwarg) additionally caps the
+  failover allowance below the router-wide ``max_failovers``.
+- **Preemption** — ``preemption=PreemptionHandler(...)`` (or True)
+  polls the handler's flag each step: SIGTERM triggers a fleet-wide
+  graceful drain (close(drain=True) semantics — in-flight requests
+  and pending failovers finish, then every replica closes), the
+  serving twin of GuardedTrainer's drain-and-save.
+
 Threading mirrors the engine: ``start=True`` runs a router worker that
 pumps replica engines; ``start=False`` is the deterministic
 manual-drive mode (``step()``/``run_until_idle()``, injectable clocks,
 no sleeps) the fleet test tier uses. Metrics:
 ``serving.fleet.{routed,sheds,failovers,handoffs,handoff_blocks,
-replicas,replica_load}`` (docs/serving.md "Fleet serving").
+replicas,replica_load,hangs,resurrections,crash_loops,quarantines}``
+(docs/serving.md "Fleet serving").
 """
 
 import collections
@@ -61,7 +88,8 @@ from ..observability import _help
 from ..observability.metrics import global_registry
 from .prefix_cache import prompt_chain_keys
 from .replica import Replica
-from .scheduler import DeadlineExceeded, GenerationResult
+from .scheduler import (DeadlineExceeded, GenerationResult,
+                        RequestCancelled)
 
 __all__ = ["FleetRouter", "RouterPolicy", "AdmissionPolicy",
            "AdmissionRejected", "FleetFuture"]
@@ -162,7 +190,8 @@ class _Routed:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_id", "priority",
                  "deadline_ms", "stream", "future", "keys", "replica",
                  "rep_fut", "phase", "emitted", "seen", "attempts",
-                 "client_cancelled", "first_submit_mono")
+                 "client_cancelled", "first_submit_mono", "lineage",
+                 "implicated", "retry_budget")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_id, priority,
                  deadline_ms, stream, future, keys):
@@ -185,6 +214,11 @@ class _Routed:
         self.seen = 0       # tokens seen from the current attempt
         self.attempts = 0   # failover re-admissions so far
         self.client_cancelled = False
+        self.lineage = []   # replica deaths this request was in-flight
+        #                     on: {"replica", "kind", "implicated"}
+        self.implicated = 0     # deaths whose fault NAMED this request
+        self.retry_budget = None    # per-request failover cap (None ->
+        #                             the router-wide max_failovers)
 
 
 class FleetRouter:
@@ -206,7 +240,8 @@ class FleetRouter:
 
     def __init__(self, servers, *, policy=None, admission=None,
                  chaos=None, start=True, p2c_seed=0, name=None,
-                 max_failovers=None):
+                 max_failovers=None, spawn_fn=None, supervisor=None,
+                 preemption=None, poison_threshold=2, flight_dir=None):
         if not servers:
             raise ValueError("FleetRouter needs at least one replica")
         self.name = name or f"fleet{next(_ROUTER_SEQ)}"
@@ -267,9 +302,41 @@ class FleetRouter:
         self.iteration = 0
         self.max_failovers = (len(self._replicas) if max_failovers
                               is None else int(max_failovers))
+        # poison quarantine: a request implicated in this many replica
+        # deaths stops failing over and fails as PoisonRequestError —
+        # the fleet-size-independent cap that keeps one bad request
+        # from eating the whole fleet (max_failovers scales with N)
+        self.poison_threshold = int(poison_threshold)
+        self.spawn_fn = spawn_fn
+        from ..robustness.supervisor import (ChunkPopularityDigest,
+                                             FleetSupervisor,
+                                             SupervisorConfig)
+        # fleet-wide chunk popularity: fed on every submit, read by
+        # resurrection re-warm — it survives any replica's death
+        # because it lives here, not in a dead prefix index
+        self._digest = ChunkPopularityDigest()
+        if supervisor is True:
+            supervisor = FleetSupervisor(self)
+        elif isinstance(supervisor, SupervisorConfig):
+            supervisor = FleetSupervisor(self, supervisor)
+        self.supervisor = supervisor
+        self._preempt_owned = preemption is True
+        if preemption is True:
+            from ..robustness.preemption import PreemptionHandler
+            preemption = PreemptionHandler().install()
+        self._preempt = preemption
+        self._preempted = False
+        self._teardown_done = False
+        self._chaos_hung = set()    # replica indices chaos is stalling
+        # fleet flight recorder: kills/hangs/resurrections/quarantines
+        # as a bounded postmortem ring, dumped on a quarantine
+        from ..observability.serving_telemetry import FlightRecorder
+        self._flight = FlightRecorder(capacity=64, out_dir=flight_dir)
         self.counts = {"routed": 0, "sheds": 0, "failovers": 0,
                        "handoffs": 0, "handoff_blocks": 0,
-                       "replica_kills": 0}
+                       "replica_kills": 0, "hangs": 0,
+                       "resurrections": 0, "crash_loops": 0,
+                       "quarantines": 0, "preempt_drains": 0}
         reg = global_registry()
         self._m_routed = reg.counter("serving.fleet.routed",
                                      _help("serving.fleet.routed"))
@@ -286,6 +353,11 @@ class FleetRouter:
                                      _help("serving.fleet.replicas"))
         self._g_load = reg.gauge("serving.fleet.replica_load",
                                  _help("serving.fleet.replica_load"))
+        self._m_fleet = {
+            k: reg.counter(f"serving.fleet.{k}",
+                           _help(f"serving.fleet.{k}"))
+            for k in ("hangs", "resurrections", "crash_loops",
+                      "quarantines")}
         self._load_series = set()       # replica names with a live series
         self._publish_gauges()
         self._worker = None
@@ -296,12 +368,16 @@ class FleetRouter:
 
     # -- client surface ----------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=32, eos_id=None,
-               priority=0, deadline_ms=None, stream=None):
+               priority=0, deadline_ms=None, stream=None,
+               retry_budget=None):
         """Route one generation request into the fleet. Returns a
         FleetFuture resolving to a GenerationResult whose request_id is
         the ROUTER's id (replica-local ids are an implementation
         detail that changes on failover). Raises AdmissionRejected
-        (with .retry_after_ms) when admission control sheds."""
+        (with .retry_after_ms) when admission control sheds.
+        `retry_budget` caps THIS request's failover re-admissions below
+        the router-wide max_failovers (each re-admission also carries
+        only the REMAINING deadline budget)."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -312,9 +388,16 @@ class FleetRouter:
             self._next_rid += 1
         keys = prompt_chain_keys(prompt, self._block_size) \
             if self._any_prefix() else []
+        if keys:
+            # fleet-wide popularity digest (resurrection re-warm reads
+            # it): every routed prompt's full chunks count, wherever
+            # they land
+            self._digest.observe(keys, prompt, self._block_size)
         fut = FleetFuture(self, rid)
         rr = _Routed(rid, prompt, int(max_new_tokens), eos_id, priority,
                      deadline_ms, stream, fut, keys)
+        if retry_budget is not None:
+            rr.retry_budget = int(retry_budget)
         if self.policy.kind == "disaggregated" and keys:
             pool, phase = self._pool("prefill"), "prefill"
         elif self.policy.kind == "disaggregated":
@@ -570,16 +653,77 @@ class FleetRouter:
             pass
         self._notify()
 
+    def _note_lineage(self, rr, exc):
+        """Record a replica DEATH in the request's failover lineage
+        and quarantine the request when implicated in too many.
+
+        Death exceptions are RequestCancelled (a kill's cancel_all) and
+        NonFiniteError (an engine fault) — a submit-race RuntimeError
+        or a geometry ValueError re-pick is not a death and records
+        nothing. An engine fault IMPLICATES exactly the requests its
+        NonFiniteError names (bad_rids — the lanes that actually went
+        non-finite): the poison request collects a strike per replica
+        it faults, while innocent bystanders on the same replica fail
+        over strike-free. Kills and hangs implicate no one (no request
+        caused them). Returns True when the request was quarantined."""
+        from ..robustness.guard import NonFiniteError
+        if not isinstance(exc, (RequestCancelled, NonFiniteError)):
+            return False
+        name = rr.replica.name if rr.replica is not None else None
+        implicated = isinstance(exc, NonFiniteError)
+        if implicated and hasattr(exc, "bad_rids") and \
+                rr.rep_fut is not None:
+            implicated = rr.rep_fut.request_id in exc.bad_rids
+        rr.lineage.append({"replica": name,
+                           "kind": ("fault" if isinstance(
+                               exc, NonFiniteError) else "death"),
+                           "implicated": bool(implicated)})
+        if not implicated:
+            return False
+        rr.implicated += 1
+        if rr.implicated < self.poison_threshold:
+            return False
+        # quarantine: this request's replay predictably kills replicas
+        # — fail it HERE with the structured error instead of feeding
+        # it a third one, and leave a postmortem artifact
+        from ..robustness.supervisor import PoisonRequestError
+        self.counts["quarantines"] += 1
+        self._m_fleet["quarantines"].inc()
+        # the poison prompt's chains must not survive in the popularity
+        # digest: resurrection re-warm (or the half-open probe) would
+        # otherwise replay the exact payload that faults engines —
+        # the cascade re-entering through the healing path
+        self._digest.forget(rr.keys)
+        self._flight_event("quarantine", rid=rr.rid,
+                           attempts=rr.attempts,
+                           lineage=list(rr.lineage))
+        dump = self._flight.dump(
+            "poison_request_quarantined", step=self.iteration,
+            extra={"rid": rr.rid, "lineage": rr.lineage,
+                   "attempts": rr.attempts,
+                   "implicated_deaths": rr.implicated})
+        self._fail(rr, PoisonRequestError(
+            f"request {rr.rid} quarantined: implicated in "
+            f"{rr.implicated} replica deaths across {rr.attempts} "
+            f"failover(s) — not re-admitting a request whose replay "
+            f"deterministically faults the engine",
+            rr.rid, rr.lineage, rr.attempts, flight_dump=dump))
+        return True
+
     def _do_failover(self, rr, exc):
         if rr.client_cancelled or rr.future.done():
             with self._lock:
                 self._inflight.pop(rr.rid, None)
             return
+        if self._note_lineage(rr, exc):
+            return      # quarantined: future already failed
         # a draining close still honors its contract (finish every
         # in-flight request, including pending failovers); only a
         # non-drain close fails them fast
+        budget = (self.max_failovers if rr.retry_budget is None
+                  else min(rr.retry_budget, self.max_failovers))
         if (self._closed and not self._close_drain) or \
-                rr.attempts >= self.max_failovers:
+                rr.attempts >= budget:
             self._fail(rr, exc)
             return
         rr.attempts += 1
@@ -692,30 +836,71 @@ class FleetRouter:
     # -- serve loop --------------------------------------------------------
     def step(self):
         """One router iteration: process failover/handoff events, fire
-        chaos replica kills, pump every live replica one engine
-        iteration, finish drains. Returns True when anything happened
-        (the manual-drive / run_until_idle contract)."""
+        chaos replica kills/hangs, pump every live replica one engine
+        iteration, run the supervisor heartbeat (watchdog +
+        resurrection), finish drains. Returns True when anything
+        happened OR a supervision duty is pending (a resurrection
+        backoff) — the manual-drive / run_until_idle contract keeps
+        pumping until the fleet is healed, not merely drained."""
+        if self._teardown_done:
+            return False
+        if self._preempt is not None and not self._closed and \
+                self._preempt.requested():
+            self._begin_preempt_drain()
         did = self._drain_events()
-        work = [r for r in self._replicas if r.has_work()]
-        if not work:
+        any_work = any(r.has_work() for r in self._replicas)
+        if any_work:
+            self.iteration += 1
+            if self._chaos is not None:
+                for idx in self._chaos.replica_kills_at(self.iteration):
+                    self.kill_replica(idx)
+                    did = True
+                for idx in self._chaos.replica_hangs_at(self.iteration):
+                    if self._replicas[idx].alive():
+                        # the replica STALLS without dying: the router
+                        # stops pumping it, no future fails, failover
+                        # never fires — only the watchdog can see it
+                        self._chaos_hung.add(idx)
+                        self._chaos.replica_hang_applied()
+                        self._flight_event(
+                            "chaos_hang",
+                            replica=self._replicas[idx].name)
             for r in self._replicas:
-                if r.finish_drain_if_idle():
+                if not r.has_work():
+                    continue
+                if r.index in self._chaos_hung:
+                    # frozen mid-stream; with a supervisor aboard this
+                    # still counts as fleet activity (the watchdog owes
+                    # a verdict), without one the fleet simply never
+                    # notices — the failure mode ISSUE 13 closes
+                    if self.supervisor is not None:
+                        did = True
+                    continue
+                t0 = time.perf_counter()
+                pumped = r.pump()
+                ms = (time.perf_counter() - t0) * 1e3
+                if self._chaos is not None:
+                    extra = self._chaos.replica_slow_ms(r.index)
+                    if extra:
+                        ms += extra
+                r.note_step_ms(ms)
+                if pumped:
                     did = True
-            if did:
-                self._publish_gauges()
-            return did
-        self.iteration += 1
-        if self._chaos is not None:
-            for idx in self._chaos.replica_kills_at(self.iteration):
-                self.kill_replica(idx)
+            did = self._drain_events() or did
+        if self.supervisor is not None:
+            if self.supervisor.on_heartbeat():
                 did = True
+            # a hung-replica teardown enqueues failover re-admissions;
+            # land them THIS step so recovery latency is deterministic
+            did = self._drain_events() or did
         for r in self._replicas:
-            if r.has_work():
-                if r.pump():
-                    did = True
-        did = self._drain_events() or did
-        for r in self._replicas:
-            r.finish_drain_if_idle()
+            if r.finish_drain_if_idle():
+                did = True
+        if self._preempted and not self._teardown_done and \
+                not any(r.has_work() for r in self._replicas) and \
+                not self._events:
+            self._teardown(drain=True)
+            return True
         self._publish_gauges()
         return did
 
@@ -750,10 +935,17 @@ class FleetRouter:
     def _serve(self):
         while True:
             did = self.step()
-            if did:
+            # spin ONLY on real work: a pending supervision duty (a
+            # resurrection backoff) also returns True, but looping hot
+            # on it would tick heartbeats at CPU speed — collapsing the
+            # crash-loop breaker's backoff window to microseconds and
+            # pegging a core. Idle-with-duty falls through to the wait,
+            # so threaded heartbeats tick at ~wait-timeout rate.
+            if did and (self._events
+                        or any(r.has_work() for r in self._replicas)):
                 continue
             with self._cv:
-                if self._closed:
+                if self._closed or self._teardown_done:
                     return
                 if not (self._events
                         or any(r.has_work() for r in self._replicas)):
@@ -768,6 +960,10 @@ class FleetRouter:
         if not r.alive():
             return
         self.counts["replica_kills"] += 1
+        # a hung-then-killed replica must not leave its slot in the
+        # chaos stall set — the RESURRECTED replica there would never
+        # be pumped again
+        self._chaos_hung.discard(index)
         r.kill()
         if self._chaos is not None:
             self._chaos.replica_kill_applied()
@@ -778,6 +974,75 @@ class FleetRouter:
         """Graceful: stop routing to the replica; its in-flight and
         queued requests finish normally, then step() closes it."""
         self._replicas[index].drain()
+        self._notify()
+
+    def _declare_hung(self, index):
+        """The watchdog's verdict: progress marks frozen for N
+        heartbeats with work pending. The hung engine is torn down
+        exactly like a death — close(drain=False) fails its in-flight
+        futures (draining its stream registrations: the engine is
+        never pumped again, so no late token can reach a client) and
+        the failover path re-admits each request bitwise on a
+        survivor."""
+        r = self._replicas[index]
+        if not r.alive():
+            return
+        self.counts["hangs"] += 1
+        self._m_fleet["hangs"].inc()
+        self._flight_event("hung_replica", replica=r.name,
+                           iteration=self.iteration,
+                           pending=r.server.pending())
+        self._chaos_hung.discard(index)
+        r.kill()
+        self._publish_gauges()
+        self._notify()
+
+    def _count_fleet(self, key):
+        """Supervisor-side counter hook (resurrections, crash_loops):
+        the router owns the serving.fleet.* metric objects."""
+        self.counts[key] += 1
+        self._m_fleet[key].inc()
+
+    def _flight_event(self, kind, **fields):
+        """One fleet lifecycle event into the router's flight recorder
+        (kills, hangs, resurrections, quarantines — the postmortem
+        ring a quarantine dumps)."""
+        self._flight.record(self.iteration, kind=kind, **fields)
+
+    def _adopt_replica(self, index, server, generation=1):
+        """Swap a freshly-resurrected server into replica slot
+        `index` (supervisor-only; the old replica's engine is already
+        closed). The slot keeps its name — gauge series and routing
+        identity continue — and records its resurrection
+        generation."""
+        old = self._replicas[index]
+        rep = Replica(index, server, name=old.name)
+        rep.role = old.role
+        rep.generation = int(generation)
+        with self._lock:
+            self._replicas[index] = rep
+        self._chaos_hung.discard(index)     # a fresh engine is never
+        #                                     born into a chaos stall
+        self._publish_gauges()
+        self._notify()
+        return rep
+
+    # -- preemption --------------------------------------------------------
+    def _begin_preempt_drain(self):
+        """The PreemptionHandler flag is set (SIGTERM/SIGINT, or the
+        chaos tier's request()): begin a fleet-wide graceful drain —
+        close(drain=True) semantics without blocking the caller. New
+        submits raise immediately; in-flight requests, pending
+        failovers, and handoffs finish; then every replica closes and
+        the router tears down (step()/the worker complete it)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._close_drain = True
+            self._preempted = True
+        self.counts["preempt_drains"] += 1
+        self._flight_event("preempt_drain", pending=self.pending())
         self._notify()
 
     def replicas(self):
@@ -868,7 +1133,9 @@ class FleetRouter:
         for r in self._replicas:
             h = r.health()
             entry = {"name": r.name, "role": r.role,
-                     "status": h["status"], "pending": h.get("pending")}
+                     "status": h["status"], "pending": h.get("pending"),
+                     "condition": r.condition,
+                     "generation": r.generation}
             if r.alive():
                 q, a, f = r.load()
                 entry.update(queue_depth=q, active_slots=a,
@@ -885,6 +1152,10 @@ class FleetRouter:
                     "targets": self.admission.targets,
                     "burn_threshold": self.admission.burn_threshold,
                     "fleet_targets": self.admission.fleet_targets}),
+                "supervisor": (self.supervisor.stats()
+                               if self.supervisor is not None else None),
+                "popularity_digest": self._digest.stats(),
+                "poison_threshold": self.poison_threshold,
                 "replicas": reps, **counts}
 
     def serve_metrics(self, port=0, host=None):
@@ -928,9 +1199,15 @@ class FleetRouter:
         (a dead fleet must not keep reporting replica load)."""
         with self._lock:
             if self._closed:
-                return
-            self._closed = True
-            self._close_drain = bool(drain)
+                if self._teardown_done:
+                    return
+                # a preemption drain is in progress: this close joins
+                # it (waits it out / finishes the teardown) instead of
+                # returning while replicas still run
+                drain = True
+            else:
+                self._closed = True
+                self._close_drain = bool(drain)
         if self._worker is not None:
             deadline = time.monotonic() + timeout
             while drain and time.monotonic() < deadline and (
@@ -941,8 +1218,19 @@ class FleetRouter:
             self._notify()
             self._worker.join(timeout=max(
                 0.0, deadline - time.monotonic()))
-        elif drain:
+        elif drain and not self._teardown_done:
             self.run_until_idle()
+        self._teardown(drain)
+
+    def _teardown(self, drain):
+        """The one-shot tail of close(): close/kill replicas, drain
+        the event queue, release the exporter, and retire the router's
+        gauge series. Idempotent — reached from close() AND from the
+        preemption drain's final step()."""
+        with self._lock:
+            if self._teardown_done:
+                return
+            self._teardown_done = True
         for r in self._replicas:
             if drain:
                 r.close()
@@ -958,3 +1246,5 @@ class FleetRouter:
         for name in self._load_series:
             self._g_load.remove(router=self.name, replica=name)
         self._load_series.clear()
+        if self._preempt is not None and self._preempt_owned:
+            self._preempt.uninstall()

@@ -745,6 +745,23 @@ class ContinuousBatchingScheduler:
                     slot.shared.append(int(slot.table[i]))
         slot.registered = done
 
+    def lane_block_for_prompt(self, prompt):
+        """-> the FIRST table block of the active lane whose request
+        prompt equals `prompt` and has advanced past position 0, or
+        None. The chaos prompt-poison hook (engine.step) uses this to
+        NaN a poison request's own KV wherever its failover replay
+        lands — content-addressed, so the fault follows the request
+        across replicas. Position >= 1 mirrors _poison_kv: a pos-0
+        lane's block is fully overwritten by its own prefill write, so
+        the NaN could never propagate."""
+        with self._lock:
+            for slot in self._slots:
+                if slot is None or slot.pos < 1:
+                    continue
+                if np.array_equal(slot.req.prompt, prompt):
+                    return int(slot.table[0])
+        return None
+
     # -- introspection -----------------------------------------------------
     def lane_snapshot(self):
         """Per-lane occupancy: one tuple per ACTIVE slot in

@@ -271,6 +271,19 @@ def run_suite():
                  env={"JAX_PLATFORMS": "cpu",
                       "BENCH_FLEET_COMPARE": "1"},
                  timeout_s=900, stdout_path="bench_fleet.json")
+    # 1f4. chaos-recovery storm (ISSUE 13): the self-healing fleet
+    #     under a scripted kill + hang + poison storm — worst
+    #     time-to-full-strength (router iterations x 20 ms nominal),
+    #     goodput fraction, quarantine facts (injected clocks,
+    #     deterministic), on the CPU backend
+    if _artifact_ok("bench_chaos.json"):
+        log("step chaos_recovery: already landed in a prior cycle — "
+            "skipping")
+    else:
+        run_step("chaos_recovery", [py, bench],
+                 env={"JAX_PLATFORMS": "cpu",
+                      "BENCH_CHAOS_RECOVERY": "1"},
+                 timeout_s=900, stdout_path="bench_chaos.json")
     # 1g. compile-observatory sample (ISSUE 8): Executor.explain()
     #     report + provoked recompile storm + HBM-ledger snapshot +
     #     detector on-vs-off overhead, on the CPU backend

@@ -258,6 +258,16 @@ def print_serving_summary(metrics, file=None):
         print(f"serving: fleet routed={routed} ({pol_s}) sheds={sheds} "
               f"failovers={fo} handoffs={ho} handoff_blocks={hb}",
               file=file)
+    # fleet health (ISSUE 13): the self-healing loop's scoreboard —
+    # what the fleet survived, not just what it routed
+    hangs = _counter_total(metrics, "serving.fleet.hangs")
+    resur = _counter_total(metrics, "serving.fleet.resurrections")
+    loops = _counter_total(metrics, "serving.fleet.crash_loops")
+    quar = _counter_total(metrics, "serving.fleet.quarantines")
+    if hangs or resur or loops or quar:
+        print(f"serving: fleet-health hangs={hangs} "
+              f"resurrections={resur} crash_loops={loops} "
+              f"quarantines={quar}", file=file)
     quant = metrics.get("serving.slo.quantile_ms")
     if windows and quant:
         # key on (server, metric): two live GenerationServers publish
@@ -419,12 +429,24 @@ def run_demo(out_dir):
     # second wave repeats the first wave's prompts so prefix-affinity
     # routing fires (serving.fleet.routed{policy=affinity} next to the
     # least_loaded cold routes in the committed sample)
+    from paddle_tpu.robustness import ChaosInjector, SupervisorConfig
     from paddle_tpu.serving import FleetRouter
-    freps = [GenerationServer(GPTServingModel(sparams, scfg),
-                              num_slots=2, block_size=8, max_context=64,
-                              chunk=4, start=False, prefix_cache=True)
-             for _ in range(2)]
-    frouter = FleetRouter(freps, start=False)
+
+    def _spawn(_index):
+        return GenerationServer(GPTServingModel(sparams, scfg),
+                                num_slots=2, block_size=8,
+                                max_context=64, chunk=4, start=False,
+                                prefix_cache=True)
+
+    freps = [_spawn(i) for i in range(2)]
+    # self-healing demo (ISSUE 13): a chaos kill mid-stream, caught by
+    # the supervisor — the replica resurrects (probe + prefix re-warm)
+    # and the fleet-health counters land in the committed sample
+    fchaos = ChaosInjector().kill_replica_at(3, 0)
+    frouter = FleetRouter(freps, start=False, chaos=fchaos,
+                          spawn_fn=_spawn,
+                          supervisor=SupervisorConfig(
+                              backoff_heartbeats=1, warm_chains=2))
     fprompts = [np.arange(3 + i, 19 + i, dtype=np.int32)
                 for i in range(2)]
     waves = [frouter.submit(p, max_new_tokens=4) for p in fprompts]
@@ -434,6 +456,7 @@ def run_demo(out_dir):
     for f in waves:
         f.result(timeout=5)
     fleet_stats = frouter.get_stats()
+    assert fleet_stats["live_replicas"] == 2    # healed after the kill
     frouter.close()
 
     metrics_path = os.path.join(out_dir, "metrics_sample.json")
