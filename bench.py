@@ -1371,6 +1371,213 @@ def run_serving_compare(kind):
     return 0
 
 
+def run_quant_compare(kind):
+    """BENCH_QUANT_COMPARE=1: quantized vs dense serving (ISSUE 14) —
+    int8 KV pools (per-row f32 scales, dequant fused into the Pallas
+    kernel) against dense bf16 pools under the SAME HBM budget, one
+    JSON line (perf/bench_quant.json).
+
+    Three sections:
+    (1) capacity — both servers get the byte budget a dense-bf16 pool
+        of BENCH_QUANT_DENSE_BLOCKS blocks costs; the int8 side fits
+        ~1.9x the blocks (ledger-pinned bytes, scales included), and a
+        storm of identical requests ADMITS >= 1.8x the concurrent
+        lanes on the quantized server (measured active slots after one
+        admission pass, watermark 0 — pure block-pool arithmetic made
+        observable);
+    (2) accuracy — greedy exact-match rate of the int8 stream's ids vs
+        the dense stream's (>= 0.99 on a briefly-trained model whose
+        argmax is decisive; per-request bitwise flags recorded);
+    (3) throughput — tokens/s both sides via order-alternating best-of
+        rounds (BENCH_GUARD_COMPARE pattern), with the honest CPU
+        caveat: the compute-bound CPU backend pays the quant/dequant
+        ALU cost without the TPU's HBM-bandwidth win, so parity here
+        is the point — the capacity ratio is the headline.
+
+    head_dim 64 (not the test models' 8-32): the scale overhead is
+    4/D of the code bytes, and the acceptance ratio (<= 0.56x dense
+    bf16) needs a production-shaped head. Never raises — failures are
+    recorded, not fatal."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.core import framework
+    from paddle_tpu.core.executor import Scope, scope_guard
+    from paddle_tpu.models import gpt
+    from paddle_tpu.serving import GenerationServer, GPTServingModel
+
+    n_req = int(os.environ.get("BENCH_QUANT_REQUESTS", 24))
+    rounds = max(2, int(os.environ.get("BENCH_QUANT_ROUNDS", 2)))
+    dense_blocks = int(os.environ.get("BENCH_QUANT_DENSE_BLOCKS", 25))
+    block_size, chunk, max_context = 8, 4, 96
+
+    # production-shaped head (D=64) so the scale overhead is honest;
+    # trained to CONVERGENCE on a structured corpus (4 arithmetic
+    # token sequences, unambiguous continuations) so greedy argmax is
+    # decisive — a near-tied untrained argmax flips on ANY logit
+    # perturbation and measures tie-breaking, not quantization quality
+    cfg = gpt.GPTConfig(vocab_size=256, hidden_size=128, num_layers=3,
+                        num_heads=2, inner_size=512, max_position=128,
+                        dropout=0.0)
+    corpus = np.stack([(np.arange(16) * s + o) % 253 + 3
+                       for s, o in [(1, 0), (3, 40), (5, 90),
+                                    (7, 160)]]).astype(np.int32)
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 7
+    with framework.program_guard(main, startup):
+        _tokens, loss, _ = gpt.build_lm_net(cfg, seq_len=16)
+        fluid.optimizer.AdamOptimizer(learning_rate=1e-2).minimize(loss)
+    scope = Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    train_steps = int(os.environ.get("BENCH_QUANT_TRAIN_STEPS", 100))
+    with scope_guard(scope):
+        exe.run(startup)
+        for _ in range(train_steps):
+            exe.run(main, feed={"tokens": corpus}, fetch_list=[loss])
+        final_loss = float(np.asarray(exe.run(
+            main, feed={"tokens": corpus}, fetch_list=[loss])[0]))
+        params = gpt.load_params(scope, cfg)
+
+    # in-distribution stream: prefixes of the learned sequences with
+    # mixed prompt/output lengths (the serving shape), continuations
+    # known to the model — the regime quantized serving targets
+    rng = np.random.default_rng(5)
+    reqs = []
+    for _ in range(n_req):
+        row = corpus[int(rng.integers(len(corpus)))]
+        reqs.append((row[:int(rng.integers(9, 15))].astype(np.int32),
+                     int(rng.integers(6, 21))))
+    total_gen = sum(g for _p, g in reqs)
+
+    def budget_blocks(kv_dtype):
+        """Blocks that fit the dense-bf16 budget for this kv_dtype
+        (usable + the NULL block)."""
+        probe = _paged_cache(cfg, 2, block_size, kv_dtype)
+        per_block = probe.pool_bytes() // probe.num_blocks
+        budget = _paged_cache(cfg, dense_blocks + 1, block_size,
+                              None).pool_bytes()
+        return budget // per_block
+
+    def _paged_cache(cfg_, nb, bs, kv_dtype):
+        from paddle_tpu.serving import PagedKVCache
+        import jax.numpy as jnp
+        return PagedKVCache(cfg_.num_layers, cfg_.num_heads,
+                            cfg_.hidden_size // cfg_.num_heads, nb,
+                            block_size=bs, dtype=jnp.bfloat16,
+                            kv_dtype=kv_dtype)
+
+    def build(kv_dtype, num_blocks, num_slots):
+        import jax.numpy as jnp
+        return GenerationServer(
+            GPTServingModel(params, cfg, dtype=jnp.bfloat16),
+            num_slots=num_slots, block_size=block_size,
+            max_context=max_context, chunk=chunk, start=False,
+            num_blocks=int(num_blocks), kv_dtype=kv_dtype)
+
+    def run(srv):
+        futs = [srv.submit(p, max_new_tokens=g) for p, g in reqs]
+        srv.run_until_idle()
+        return [list(f.result(timeout=10).token_ids) for f in futs]
+
+    try:
+        nb_dense = budget_blocks(None)
+        nb_int8 = budget_blocks("int8")
+        # (1) capacity: identical-size storm (16-token prompt + 15 new
+        # = 31 positions = 4 blocks each), admissions in ONE pass
+        storm_prompt = np.arange(3, 19, dtype=np.int32)
+        storm_new = 15
+
+        def admitted(kv_dtype, nb):
+            srv = build(kv_dtype, nb, num_slots=64)
+            for _ in range(40):
+                srv.submit(storm_prompt, max_new_tokens=storm_new)
+            srv.step()
+            got = srv.get_stats()["active_slots"]
+            # byte facts captured BEFORE close: the bench must not
+            # depend on close() leaving the cache object intact
+            pool_bytes = srv.cache.pool_bytes()
+            per_block = pool_bytes // srv.cache.num_blocks
+            srv.close(drain=False)
+            return got, pool_bytes, per_block
+
+        dense_admit, dense_bytes, _ = admitted(None, nb_dense)
+        int8_admit, int8_bytes, bytes_per_block_int8 = \
+            admitted("int8", nb_int8)
+        # how much of the byte budget the bigger int8 pool actually
+        # uses (floor-division slack only; NOT the 0.56x pin — that is
+        # bytes_ratio_vs_dense below, same block count both sides)
+        budget_used = int8_bytes / dense_bytes
+
+        # (2) + (3): accuracy and throughput on the mixed stream
+        dense_srv = build(None, nb_dense, num_slots=4)
+        int8_srv = build("int8", nb_int8, num_slots=4)
+        dense_ids = run(dense_srv)          # warm both compiles
+        int8_ids = run(int8_srv)
+        flat_d = [t for s in dense_ids for t in s]
+        flat_q = [t for s in int8_ids for t in s]
+        match = sum(a == b for a, b in zip(flat_d, flat_q)) / \
+            max(len(flat_d), 1)
+        dense_s = int8_s = float("inf")
+        for r in range(rounds):
+            pair = [("int8", int8_srv), ("dense", dense_srv)]
+            if r % 2:
+                pair.reverse()
+            for tag, srv in pair:
+                t0 = time.perf_counter()
+                run(srv)
+                dt = time.perf_counter() - t0
+                if tag == "int8":
+                    int8_s = min(int8_s, dt)
+                else:
+                    dense_s = min(dense_s, dt)
+        qst = int8_srv.get_stats()
+        result = {
+            "metric": "serving_quant_int8_admitted_concurrency_ratio",
+            "value": round(int8_admit / max(dense_admit, 1), 3),
+            "unit": "x (concurrent requests admitted, int8 over dense "
+                    "bf16, same HBM budget)",
+            "hbm_budget_bytes": dense_bytes,
+            "dense_blocks": int(nb_dense),
+            "int8_blocks": int(nb_int8),
+            "block_capacity_ratio": round(nb_int8 / nb_dense, 3),
+            "int8_budget_utilization": round(budget_used, 4),
+            "int8_bytes_per_block": int(bytes_per_block_int8),
+            "train_steps": train_steps,
+            "train_loss_final": round(final_loss, 6),
+            "dense_admitted": int(dense_admit),
+            "int8_admitted": int(int8_admit),
+            "greedy_exact_match_rate": round(match, 4),
+            "requests_bitwise_identical": sum(
+                a == b for a, b in zip(dense_ids, int8_ids)),
+            "requests": n_req,
+            "generated_tokens": total_gen,
+            "int8_tokens_per_sec": round(total_gen / int8_s, 2),
+            "dense_tokens_per_sec": round(total_gen / dense_s, 2),
+            "fused_step_signatures": qst["fused_step_signatures"],
+            "kernel_engaged": qst["kernel"]["engaged"],
+            "kv_quant": qst["kv_quant"],
+            "head_dim": cfg.hidden_size // cfg.num_heads,
+            "slots": 4, "chunk": chunk, "block_size": block_size,
+            "caveat": "CPU backend is compute-bound: the quant/dequant "
+                      "ALU cost shows, the halved HBM read traffic "
+                      "does not — tokens/s parity is the bar here; "
+                      "the admitted-concurrency ratio is backend-"
+                      "independent block arithmetic and IS the TPU "
+                      "capacity win",
+        }
+        dense_srv.close()
+        int8_srv.close()
+    except Exception as e:      # noqa: BLE001 — evidence, not a gate
+        print(f"bench: quant compare FAILED ({e!r})", file=sys.stderr)
+        print(json.dumps(_mark_degraded(
+            {"metric": "serving_quant_int8_admitted_concurrency_ratio",
+             "failed": True, "error": repr(e), "device_kind": kind})),
+            flush=True)
+        return 0
+    result["device_kind"] = kind
+    print(json.dumps(_mark_degraded(result)), flush=True)
+    return 0
+
+
 def run_prefix_compare(kind):
     """BENCH_PREFIX_COMPARE=1: prefix-cache block sharing on vs off
     (today's engine) over a MIXED-TENANT generation stream with 80%
@@ -2453,6 +2660,11 @@ def main():
         # prefix-cache sharing + speculative decoding on a mixed-tenant
         # 80%-shared-prefix stream (serving layer)
         return run_prefix_compare(kind)
+
+    if os.environ.get("BENCH_QUANT_COMPARE") == "1":
+        # int8-vs-dense quantized serving: same-HBM-budget admitted
+        # concurrency, greedy exact-match rate, tokens/s (serving layer)
+        return run_quant_compare(kind)
 
     if os.environ.get("BENCH_FLEET_COMPARE") == "1":
         # fleet router: affinity-vs-random routing hit rate + p99 TTFT

@@ -29,9 +29,38 @@ class AnalysisConfig:
         self.mesh = None              # tensor-parallel serving mesh
         self.shard_rules = None
         self.generation = None        # enable_generation() options
+        self.use_int8 = False
+        self.int8_calibration_feeds = None
 
     def enable_bf16(self):
         self.use_bf16 = True
+        return self
+
+    def enable_int8(self, calibration_feeds=None):
+        """Quantized serving, end to end (the TPU successor to Fluid's
+        `quant/`+`slim/` PTQ surfaces — see MIGRATION.md):
+
+        - weight side: every quantizable matmul/conv weight in the
+          loaded program gets per-output-channel absmax int8
+          quant-dequant (quant/ptq.apply_ptq with
+          weight_granularity="channel"); pass `calibration_feeds`
+          (an iterable of feed dicts, a few hundred samples) to also
+          calibrate static activation scales the reference-PTQ way
+          (quant/ptq.calibrate_program). Data-free weight-only quant
+          is the default — absmax needs nothing but the weights.
+        - generation side: `generation_server()` folds per-channel
+          int8 weights into the fused step
+          (GPTServingModel.quantize_int8 — dequant inline, signature
+          budget unchanged) and defaults the KV pool to
+          kv_dtype="int8" (int8 blocks + f32 scales; override by
+          passing kv_dtype explicitly to enable_generation /
+          generation_server).
+
+        Accuracy deltas are pinned in tier-1
+        (tests/api/test_quant_serving.py); docs/serving.md "Quantized
+        serving" covers when NOT to quantize."""
+        self.use_int8 = True
+        self.int8_calibration_feeds = calibration_feeds
         return self
 
     def enable_generation(self, gpt_config, **server_opts):
@@ -82,6 +111,8 @@ class Predictor:
                 self.fetch_names = [v.name for v in fetch_vars]
         if config.use_bf16:
             self._cast_params_bf16()
+        if config.use_int8:
+            self._apply_int8()
         # tensor-parallel serving: annotate params + attach the mesh so
         # the Executor's pjit path shards state and partitions the step.
         # Annotate every persistable VAR (not Parameter objects): the
@@ -117,6 +148,42 @@ class Predictor:
             if v is not None and jnp.issubdtype(
                     jnp.asarray(v).dtype, jnp.floating):
                 self.scope.set(name, jnp.asarray(v, jnp.bfloat16))
+
+    def _apply_int8(self):
+        """The enable_int8 program rewrite: optional activation
+        calibration over the configured feeds, then per-output-channel
+        weight quant-dequant on every quantizable op (quant/ptq.py —
+        the machinery Fluid's slim PTQ used, routed at the TPU path).
+        Parameters only: the reference-__model__ protobuf branch
+        rebuilds weights as plain Variables, which apply_ptq skips —
+        that branch serves fp until re-exported with Parameters."""
+        from ..observability import _help
+        from ..observability.metrics import global_registry
+        from ..quant.ptq import apply_ptq, calibrate_program
+        scales = {}
+        feeds = self.config.int8_calibration_feeds
+        if feeds:
+            with scope_guard(self.scope):
+                scales = calibrate_program(self._exe, self.program,
+                                           feeds)
+        apply_ptq(self.program, scales, weight_granularity="channel")
+        ops = self.program.global_block().ops
+        self.int8_weight_tensors = sum(
+            1 for op in ops
+            if op.type in ("fake_quantize_dequantize_abs_max",
+                           "fake_channel_wise_quantize_dequantize_"
+                           "abs_max"))
+        self.int8_calibrated_activations = sum(
+            1 for op in ops
+            if op.type == "quantize_dequantize_static_scale")
+        reg = global_registry()
+        reg.counter("inference.int8.weights",
+                    _help("inference.int8.weights")).inc(
+                        self.int8_weight_tensors)
+        reg.counter(
+            "inference.int8.calibrated_activations",
+            _help("inference.int8.calibrated_activations")).inc(
+                self.int8_calibrated_activations)
 
     # -- the reference's ZeroCopyRun / run APIs ---------------------------
     def run(self, feeds):
@@ -185,6 +252,12 @@ class Predictor:
         dtype = jnp.bfloat16 if self.config.use_bf16 else None
         model = GPTServingModel.from_scope(self.scope, gpt_cfg,
                                            dtype=dtype)
+        if self.config.use_int8:
+            # enable_int8 means quantized SERVING end to end: int8
+            # weights folded into the fused step AND int8 KV blocks
+            # (kv_dtype stays overridable for the weights-only case)
+            model.quantize_int8()
+            opts.setdefault("kv_dtype", "int8")
         return GenerationServer(model, **opts)
 
     def get_input_names(self):

@@ -121,6 +121,15 @@ METRIC_SPECS = [
      "checkpoints written by the preemption drain path"),
     ("checkpoint.retained", "gauge",
      "checkpoints currently retained by a CheckpointManager"),
+    ("inference.int8.weights", "counter",
+     "weight tensors rewritten to int8 (per-output-channel absmax) by "
+     "AnalysisConfig.enable_int8 — the serving-model fold-in "
+     "(GPTServingModel.quantize_int8) and the program-path PTQ rewrite "
+     "both count here"),
+    ("inference.int8.calibrated_activations", "counter",
+     "activation tensors given a static quant-dequant scale by the "
+     "enable_int8 calibration pass (quant/ptq.calibrate_program over "
+     "the predictor's feeds)"),
     ("serving.requests", "counter",
      "generation requests submitted to a GenerationServer"),
     ("serving.admitted", "counter",
@@ -184,6 +193,13 @@ METRIC_SPECS = [
     ("serving.spec.accept_rate", "gauge",
      "process-cumulative accepted/proposed ratio across all "
      "speculative schedulers"),
+    ("serving.kv.quant.pool_bytes", "gauge",
+     "TRUE footprint of a quantized KV block pool: int8 codes plus the "
+     "f32 per-row scale pools, across k+v and every layer (label: "
+     "server; absent for dense pools)"),
+    ("serving.kv.quant.bytes_saved", "gauge",
+     "bytes the int8 KV pool saves vs the same block count dense in "
+     "the compute dtype (dense_equiv - int8+scales; label: server)"),
     ("serving.mesh.axis_size", "gauge",
      "tensor-parallel mesh axis size a GenerationServer shards its "
      "fused step and KV pools over (label: server; absent single-"

@@ -22,7 +22,16 @@ paper, PAPERS.md) walks the block table INSIDE the kernel instead:
 * bf16 pools are welcome: scores and softmax accumulate in f32 and the
   probabilities are cast back to the value dtype before the PV
   contraction, mirroring the reference spec (EQuARX-style
-  reduced-precision hot path with full-precision accumulation).
+  reduced-precision hot path with full-precision accumulation);
+* int8 pools (quantized serving, ISSUE 14) fuse the DEQUANT into the
+  gather: the DMA loop copies the int8 codes plus their (H, bs) f32
+  scale rows — roughly HALF the bytes a bf16 pool moves per block —
+  and the dequant multiply happens on the VMEM-resident gather right
+  where the value path consumes it. The decode-side HBM read traffic
+  this kernel exists to bound halves again on top of the capacity win;
+  score/softmax stay f32 and the output lands in the query dtype (the
+  model's activation dtype), mirroring the reference's int8 branch op
+  for op so the bitwise pin extends to quantized pools.
 
 Numerics are the reference's, op for op: after the gather loop the
 VMEM-resident blocks go through the SAME moveaxis/einsum/mask/softmax
@@ -78,23 +87,41 @@ def _interpret():
         return True
 
 
-def _paged_kernel(tbl_ref, pos_ref, q_ref, k_pool_ref, v_pool_ref, o_ref,
-                  gk_ref, gv_ref, sem_ref, *, bs, m, h, d):
-    """One grid step = one request lane, all heads.
+def _paged_kernel(tbl_ref, pos_ref, q_ref, k_pool_ref, v_pool_ref,
+                  *rest, bs, m, h, d, quantized=False):
+    """One grid step = one request lane, all heads — dense AND int8
+    pools share this walk (selected at trace time by `quantized`, so
+    the early-stop arithmetic, the NULL guard, the zero-fill the
+    bitwise pin depends on, and the mask/softmax tail exist exactly
+    once).
 
     tbl_ref (B, M) / pos_ref (B, C): scalar-prefetched SMEM.
     q_ref (1, H, C, D) VMEM; k/v_pool_ref (N, H, bs, D) HBM (ANY).
     gk/gv scratch (M, H, bs, D) VMEM in pool dtype — the lane's gathered
     view, laid out exactly like the reference's `pool[table]` row so the
-    value-path math below can mirror it op for op."""
+    value-path math below can mirror it op for op. Quantized adds the
+    (N, H, bs) f32 scale pools in HBM and (M, H, bs) scale scratch: the
+    DMA loop copies codes + scale rows per live block (~half a bf16
+    block's bytes) and the dequant multiply happens on the VMEM gather
+    right where the value path consumes it, mirroring the reference's
+    int8 branch op for op."""
+    if quantized:
+        (ks_pool_ref, vs_pool_ref, o_ref,
+         gk_ref, gv_ref, gks_ref, gvs_ref, sem_ref) = rest
+    else:
+        o_ref, gk_ref, gv_ref, sem_ref = rest
     b = pl.program_id(0)
     t = m * bs
 
     # the skipped tail must hold zeros, not stale VMEM: its (masked)
     # probabilities are exactly 0 and 0 * 0 keeps the PV partial sums
-    # bitwise-identical to the reference's 0 * null-block terms
+    # bitwise-identical to the reference's 0 * null-block terms (for
+    # int8, zero codes AND zero scales dequantize to exact 0.0)
     gk_ref[...] = jnp.zeros_like(gk_ref)
     gv_ref[...] = jnp.zeros_like(gv_ref)
+    if quantized:
+        gks_ref[...] = jnp.zeros_like(gks_ref)
+        gvs_ref[...] = jnp.zeros_like(gvs_ref)
 
     # per-lane early stop: the highest live block index comes from the
     # lane's query positions (scalar reads; C is static and small)
@@ -108,16 +135,23 @@ def _paged_kernel(tbl_ref, pos_ref, q_ref, k_pool_ref, v_pool_ref, o_ref,
         blk = tbl_ref[b, j]
 
         def do_copy(_):
-            # k and v blocks in flight together; the NULL guard below
-            # means block 0 is NEVER the DMA source
-            ck = pltpu.make_async_copy(k_pool_ref.at[blk], gk_ref.at[j],
-                                       sem_ref.at[0])
-            cv = pltpu.make_async_copy(v_pool_ref.at[blk], gv_ref.at[j],
-                                       sem_ref.at[1])
-            ck.start()
-            cv.start()
-            ck.wait()
-            cv.wait()
+            # all of one block's pieces in flight together; the NULL
+            # guard below means block 0 is NEVER the DMA source
+            copies = [
+                pltpu.make_async_copy(k_pool_ref.at[blk], gk_ref.at[j],
+                                      sem_ref.at[0]),
+                pltpu.make_async_copy(v_pool_ref.at[blk], gv_ref.at[j],
+                                      sem_ref.at[1])]
+            if quantized:
+                copies += [
+                    pltpu.make_async_copy(ks_pool_ref.at[blk],
+                                          gks_ref.at[j], sem_ref.at[2]),
+                    pltpu.make_async_copy(vs_pool_ref.at[blk],
+                                          gvs_ref.at[j], sem_ref.at[3])]
+            for cp in copies:
+                cp.start()
+            for cp in copies:
+                cp.wait()
             return 0
 
         # table padding and idle lanes route to NULL_BLOCK: skip the
@@ -129,10 +163,17 @@ def _paged_kernel(tbl_ref, pos_ref, q_ref, k_pool_ref, v_pool_ref, o_ref,
 
     # ---- value path: the reference body on the VMEM-resident gather --
     # (same moveaxis/reshape, same einsums batched over H, same mask
-    # constant, same jax.nn.softmax — the bitwise pin lives here)
+    # constant, same jax.nn.softmax — the bitwise pin lives here; the
+    # int8 dequant slots in exactly where the reference branch does it)
     q = q_ref[0]                                          # (H, C, D)
     gk = jnp.moveaxis(gk_ref[...], 1, 0).reshape(h, t, d)
     gv = jnp.moveaxis(gv_ref[...], 1, 0).reshape(h, t, d)
+    if quantized:
+        ks = jnp.moveaxis(gks_ref[...], 1, 0).reshape(h, t)
+        vs = jnp.moveaxis(gvs_ref[...], 1, 0).reshape(h, t)
+        gk = gk.astype(jnp.float32) * ks[..., None]
+        gv = (gv.astype(jnp.float32) * vs[..., None]).astype(
+            o_ref.dtype)
     s = jnp.einsum("hcd,htd->hct", q.astype(jnp.float32),
                    gk.astype(jnp.float32),
                    preferred_element_type=jnp.float32) / np.sqrt(d)
@@ -145,17 +186,21 @@ def _paged_kernel(tbl_ref, pos_ref, q_ref, k_pool_ref, v_pool_ref, o_ref,
 
 
 def ragged_paged_attention(q, k_pool, v_pool, block_table, q_positions,
-                           interpret=None):
+                           k_scale=None, v_scale=None, interpret=None):
     """Paged attention with the table walk fused into the kernel.
 
     Same contract as `serving.kv_cache.paged_attention` (which is the
     dispatcher that normally routes here):
 
         q:           (B, H, C, D) — C query tokens per request lane
-        k/v_pool:    (N, H, bs, D), same dtype (f32 or bf16)
+        k/v_pool:    (N, H, bs, D), same dtype (f32, bf16 or int8)
         block_table: (B, M) int32 (NULL_BLOCK-padded)
         q_positions: (B, C) int32
-        returns      (B, H, C, D) in v_pool's dtype
+        k/v_scale:   (N, H, bs) f32 — required for int8 pools (the
+                     per-row dequant scales; dequant is fused into the
+                     kernel's gather), absent otherwise
+        returns      (B, H, C, D) in v_pool's dtype (int8 pools: in
+                     q's dtype)
 
     `interpret` defaults to "off-TPU" (flash.py policy)."""
     global TRACE_COUNT
@@ -171,20 +216,62 @@ def ragged_paged_attention(q, k_pool, v_pool, block_table, q_positions,
         raise ValueError(
             f"table {block_table.shape} / positions {q_positions.shape} "
             f"do not match q {q.shape}")
+    quantized = k_pool.dtype == jnp.int8
+    if quantized:
+        if k_scale is None or v_scale is None:
+            raise ValueError(
+                "int8 pools need k_scale/v_scale (N, H, bs) f32 scale "
+                "pools — quantized KV is (codes, scales) pairs")
+        if (k_scale.shape != (n, hp, bs)
+                or v_scale.shape != (n, hp, bs)):
+            raise ValueError(
+                f"scale pools {k_scale.shape}/{v_scale.shape} do not "
+                f"match data pools {k_pool.shape} (want {(n, hp, bs)})")
+    elif k_scale is not None or v_scale is not None:
+        raise ValueError(
+            f"scale pools passed with non-int8 pools "
+            f"({k_pool.dtype}) — scales only mean something for "
+            f"quantized KV")
     if interpret is None:
         interpret = _interpret()
+
+    lane_spec = pl.BlockSpec((1, h, c, d),
+                             lambda b_, tbl, pos: (b_, 0, 0, 0))
+    any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+    if quantized:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,      # block_table, q_positions
+            grid=(b,),
+            in_specs=[lane_spec,
+                      any_spec, any_spec,       # k/v pools stay in HBM
+                      any_spec, any_spec],      # scale pools too
+            out_specs=lane_spec,
+            scratch_shapes=[
+                pltpu.VMEM((m, h, bs, d), jnp.int8),
+                pltpu.VMEM((m, h, bs, d), jnp.int8),
+                pltpu.VMEM((m, h, bs), jnp.float32),
+                pltpu.VMEM((m, h, bs), jnp.float32),
+                pltpu.SemaphoreType.DMA((4,)),
+            ],
+        )
+        return pl.pallas_call(
+            functools.partial(_paged_kernel, bs=bs, m=m, h=h, d=d,
+                              quantized=True),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((b, h, c, d), q.dtype),
+            interpret=interpret,
+        )(block_table.astype(jnp.int32), q_positions.astype(jnp.int32),
+          q, k_pool, v_pool, k_scale, v_scale)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,          # block_table, q_positions
         grid=(b,),
         in_specs=[
-            pl.BlockSpec((1, h, c, d),
-                         lambda b_, tbl, pos: (b_, 0, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),   # k pool stays in HBM
-            pl.BlockSpec(memory_space=pltpu.ANY),   # v pool stays in HBM
+            lane_spec,
+            any_spec,                               # k pool stays in HBM
+            any_spec,                               # v pool stays in HBM
         ],
-        out_specs=pl.BlockSpec((1, h, c, d),
-                               lambda b_, tbl, pos: (b_, 0, 0, 0)),
+        out_specs=lane_spec,
         scratch_shapes=[
             pltpu.VMEM((m, h, bs, d), k_pool.dtype),
             pltpu.VMEM((m, h, bs, d), v_pool.dtype),

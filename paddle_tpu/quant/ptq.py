@@ -49,9 +49,22 @@ def calibrate_program(exe, program, feed_list,
 
 
 def apply_ptq(program, scales, weight_bits=8, activation_bits=8,
-              quantizable_op_types=QUANTIZABLE_OP_TYPES):
+              quantizable_op_types=QUANTIZABLE_OP_TYPES,
+              weight_granularity="tensor"):
     """Insert fixed-scale quant-dequant on calibrated activations and
-    abs-max quant on weights. Rewrites in place; returns program."""
+    abs-max quant on weights. Rewrites in place; returns program.
+
+    `weight_granularity`: "tensor" keeps the reference fallback
+    (per-tensor abs_max on mul/matmul Y weights, channel-wise only on
+    conv filters); "channel" quantizes mul/matmul weights
+    PER OUTPUT CHANNEL too (abs-max over the input axis of the (in,
+    out) Y operand, quant_axis=1) — the AnalysisConfig.enable_int8
+    convention, one scale per output column so a single hot column
+    cannot flatten the whole weight's resolution."""
+    if weight_granularity not in ("tensor", "channel"):
+        raise ValueError(
+            f"weight_granularity {weight_granularity!r}: expected "
+            f"'tensor' or 'channel'")
     block = program.global_block()
     quantized = {}
     new_ops = []
@@ -70,20 +83,25 @@ def apply_ptq(program, scales, weight_bits=8, activation_bits=8,
                     block.create_var(name=qname, shape=var.shape,
                                      dtype=var.dtype)
                     sname = f"{name}.quant_scale"
-                    # channel-wise only on conv filters; mul/matmul (in,out)
-                    # weights get per-tensor abs_max (reference fallback)
+                    # conv filters: channel-wise over axis 0 always;
+                    # mul/matmul (in, out) weights: per-tensor abs_max
+                    # (reference fallback) or per-output-channel over
+                    # axis 1 (weight_granularity="channel")
                     if op.type in _CONV_OPS:
                         qtype = "fake_channel_wise_quantize_dequantize_abs_max"
-                        out_c = var.shape[0]
+                        out_c, qaxis = var.shape[0], 0
+                    elif weight_granularity == "channel":
+                        qtype = "fake_channel_wise_quantize_dequantize_abs_max"
+                        out_c, qaxis = var.shape[-1], len(var.shape) - 1
                     else:
                         qtype = "fake_quantize_dequantize_abs_max"
-                        out_c = 1
+                        out_c, qaxis = 1, 0
                     block.create_var(name=sname, shape=[out_c],
                                      dtype="float32")
                     new_ops.append(Operator(
                         block, qtype,
                         {"X": [name]}, {"Out": [qname], "OutScale": [sname]},
-                        {"bit_length": weight_bits, "quant_axis": 0}))
+                        {"bit_length": weight_bits, "quant_axis": qaxis}))
                     quantized[name] = qname
                 op.inputs[slot] = [quantized[name]]
             for slot in _ACT_SLOTS.get(op.type, ()):

@@ -11,7 +11,11 @@ deadline. This package is the TPU-native answer:
                   paged attention kernel, ops/pallas/paged.py, with the
                   pure-JAX reference as documented fallback —
                   PADDLE_TPU_PAGED_KERNEL=0/1/auto) + dense-interface
-                  adapters for inference/decoding.py step_fns;
+                  adapters for inference/decoding.py step_fns; with
+                  `kv_dtype="int8"` the pools store int8 codes + per-
+                  row f32 scales (quantize at write, dequant fused
+                  into the kernel's gather — ~2x blocks per chip,
+                  docs/serving.md "Quantized serving");
 - scheduler.py  — iteration-level continuous batching: fixed decode
                   slots, chunked prefill admission, EOS/length
                   retirement, watermark backpressure, priorities,

@@ -42,7 +42,7 @@ from ..observability.metrics import global_registry
 from ..observability.tracing import get_recorder
 from . import kv_cache as _kvc
 from .kv_cache import (NULL_BLOCK, PagedKVCache, paged_attention,
-                       write_block_kv)
+                       write_block_kv, write_block_kv_quant)
 from .scheduler import ContinuousBatchingScheduler, RequestCancelled, _Request
 
 __all__ = ["GenerationServer", "GenerationFuture", "GPTServingModel"]
@@ -73,8 +73,29 @@ def _fused_step_body(params, cfg, block_size, h_count, d, reduce_fn,
     Rows of the wide gemm are independent dot products, so a column's
     outputs are bitwise the last-column gather's (the spec parity tests
     pin this); plain servers keep the narrow gemm — C x fewer lm-head
-    FLOPs on the decode hot path."""
+    FLOPs on the decode hot path.
+
+    Quantized serving (ISSUE 14) rides the same body: a layer dict
+    carrying "k_scale"/"v_scale" pools takes the quantize-at-write path
+    and hands the scales to the paged_attention dispatcher (which fuses
+    the dequant into the Pallas kernel's gather); a layer dict carrying
+    "<w>@q8"/"<w>@scale" weight entries (GPTServingModel.quantize_int8)
+    gets its matmul weight dequantized INLINE — int8 codes times the
+    per-output-channel f32 scale, cast to the activation dtype — so the
+    step reads half the weight bytes from HBM and the jit signature
+    budget is untouched (the dequant is part of the one compiled
+    step, not a second executable)."""
     s, c = tokens.shape
+    wdt = params["word_emb"].dtype     # activation/compute dtype
+
+    def w(container, name):
+        # int8 weight entry -> inline dequant; plain entry -> as-is
+        q8 = container.get(name + "@q8")
+        if q8 is None:
+            return container[name]
+        return (q8.astype(jnp.float32)
+                * container[name + "@scale"]).astype(wdt)
+
     pos = jnp.where(valid, positions, 0)
     x = params["word_emb"][tokens] + params["pos_emb"][pos]
     # write targets: masked lanes route to the NULL block
@@ -85,21 +106,30 @@ def _fused_step_body(params, cfg, block_size, h_count, d, reduce_fn,
     for i in range(cfg.num_layers):
         lp = params[f"l{i}"]
         kp, vp = pools[i]["k"], pools[i]["v"]
+        ks, vs = pools[i].get("k_scale"), pools[i].get("v_scale")
         hn = _ln(x, lp["ln1_s"], lp["ln1_b"])
-        q = (hn @ lp["wq"] + lp["bq"]).reshape(s, c, h_count, d)
-        k = (hn @ lp["wk"] + lp["bk"]).reshape(s, c, h_count, d)
-        v = (hn @ lp["wv"] + lp["bv"]).reshape(s, c, h_count, d)
-        kp = write_block_kv(kp, k, bidx, off)
-        vp = write_block_kv(vp, v, bidx, off)
+        q = (hn @ w(lp, "wq") + lp["bq"]).reshape(s, c, h_count, d)
+        k = (hn @ w(lp, "wk") + lp["bk"]).reshape(s, c, h_count, d)
+        v = (hn @ w(lp, "wv") + lp["bv"]).reshape(s, c, h_count, d)
+        if ks is not None:
+            kp, ks = write_block_kv_quant(kp, ks, k, bidx, off)
+            vp, vs = write_block_kv_quant(vp, vs, v, bidx, off)
+        else:
+            kp = write_block_kv(kp, k, bidx, off)
+            vp = write_block_kv(vp, v, bidx, off)
         o = paged_attention(q.transpose(0, 2, 1, 3), kp, vp,
-                            tables, pos)
+                            tables, pos, k_scale=ks, v_scale=vs)
         o = o.transpose(0, 2, 1, 3).reshape(s, c, h_count * d)
-        x = x + (reduce_fn(o @ lp["wo"]) + lp["bo"]).astype(x.dtype)
+        x = x + (reduce_fn(o @ w(lp, "wo")) + lp["bo"]).astype(x.dtype)
         hn = _ln(x, lp["ln2_s"], lp["ln2_b"])
-        f = jax.nn.gelu(hn @ lp["f0w"] + lp["f0b"],
+        f = jax.nn.gelu(hn @ w(lp, "f0w") + lp["f0b"],
                         approximate=False)
-        x = x + (reduce_fn(f @ lp["f1w"]) + lp["f1b"])
-        new_pools.append({"k": kp, "v": vp})
+        x = x + (reduce_fn(f @ w(lp, "f1w")) + lp["f1b"]).astype(
+            x.dtype)
+        layer = {"k": kp, "v": vp}
+        if ks is not None:
+            layer["k_scale"], layer["v_scale"] = ks, vs
+        new_pools.append(layer)
     x = _ln(x, params["lnf_s"], params["lnf_b"])
     if not per_column:
         # next token comes from each lane's LAST valid column only
@@ -141,16 +171,71 @@ class GPTServingModel:
         self.head_dim = cfg.hidden_size // cfg.num_heads
         self.max_position = cfg.max_position
         self.kv_dtype = dtype or jnp.float32
+        self._int8_weights = 0
 
     @classmethod
     def from_scope(cls, scope, cfg, dtype=None):
         return cls(load_params(scope, cfg), cfg, dtype=dtype)
 
+    # int8 weight entries a quantize_int8'd layer dict carries in place
+    # of each matmul weight (the fused step dequantizes inline)
+    INT8_WEIGHT_NAMES = ("wq", "wk", "wv", "wo", "f0w", "f1w")
+
+    def quantize_int8(self):
+        """Per-output-channel absmax int8 quantization of every layer's
+        matmul weights (the AnalysisConfig.enable_int8 weight side):
+        each (in, out) weight w becomes w@q8 int8 codes + w@scale f32
+        (1, out) — absmax over the input axis, the reference PTQ
+        convention for mul/matmul Y operands (quant/ptq.py). The fused
+        step dequantizes inline (codes * scale -> activation dtype), so
+        HBM reads halve for these weights and the one-signature-per-
+        lifetime budget is untouched. Embeddings, biases and layernorms
+        stay float: the word embedding doubles as the lm head (rounding
+        it distorts every logit for <2% of the byte win), the rest are
+        O(hidden) vectors. Idempotent; returns self."""
+        if self._int8_weights:
+            return self
+        from ..observability import _help
+        from ..observability.metrics import global_registry
+        # rebind a fresh top-level dict BEFORE rewriting layers: the
+        # constructor may hold the caller's own params dict (dtype=None
+        # skips the cast-copy), and quantization must never mutate a
+        # tree the caller still serves dense elsewhere
+        self.params = dict(self.params)
+        n = 0
+        for i in range(self.cfg.num_layers):
+            lp = dict(self.params[f"l{i}"])
+            for name in self.INT8_WEIGHT_NAMES:
+                wf = lp.pop(name).astype(jnp.float32)
+                absmax = jnp.max(jnp.abs(wf), axis=0, keepdims=True)
+                scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+                lp[name + "@q8"] = jnp.clip(
+                    jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+                lp[name + "@scale"] = scale          # (1, out) f32
+                n += 1
+            self.params[f"l{i}"] = lp
+        self._int8_weights = n
+        global_registry().counter(
+            "inference.int8.weights",
+            _help("inference.int8.weights")).inc(n)
+        return self
+
+    @property
+    def int8_weights(self):
+        """Quantized weight-tensor count (0 = dense weights)."""
+        return self._int8_weights
+
     def build_fused_step(self, block_size, mesh=None, axis="tp",
-                         per_column=False):
+                         per_column=False, kv_quantized=False):
         params, cfg = self.params, self.cfg
         h_, d = self.num_heads, self.head_dim
 
+        if mesh is not None and self._int8_weights:
+            raise NotImplementedError(
+                "int8 weights under a mesh are not supported yet — the "
+                "tp shard rules name the dense weight keys; run int8-"
+                "weight servers single-device (int8 KV pools DO shard; "
+                "docs/serving.md)")
         if mesh is None:
             def fused(pools, tokens, positions, valid, tables):
                 return _fused_step_body(
@@ -194,9 +279,14 @@ class GPTServingModel:
 
         param_specs = jax.tree_util.tree_map(
             lambda ns: ns.spec, shardings)
-        pool_specs = [{"k": P(None, axis, None, None),
-                       "v": P(None, axis, None, None)}
-                      for _ in range(cfg.num_layers)]
+        layer_spec = {"k": P(None, axis, None, None),
+                      "v": P(None, axis, None, None)}
+        if kv_quantized:
+            # the (N, H, bs) scale pools shard on the SAME head axis as
+            # their code pools — a shard's rows carry their own scales
+            layer_spec["k_scale"] = P(None, axis, None)
+            layer_spec["v_scale"] = P(None, axis, None)
+        pool_specs = [dict(layer_spec) for _ in range(cfg.num_layers)]
         rep = P()
         fn = shard_map(local, mesh=mesh,
                        in_specs=(param_specs, pool_specs, rep, rep,
@@ -276,7 +366,8 @@ class GenerationServer:
                  watermark_blocks=0, chaos=None, start=True,
                  telemetry=True, slo_window_s=60.0, flight_dir=None,
                  flight_capacity=256, deadline_storm=3, mesh=None,
-                 mesh_axis="tp", prefix_cache=False, spec=None):
+                 mesh_axis="tp", prefix_cache=False, spec=None,
+                 kv_dtype=None):
         self.model = model
         self.block_size = int(block_size)
         self.mesh = mesh
@@ -302,11 +393,15 @@ class GenerationServer:
         blocks_per_seq = -(-max_context // self.block_size)
         if num_blocks is None:
             num_blocks = num_slots * blocks_per_seq + 1   # +1: NULL block
+        # kv_dtype: None serves dense pools in the model dtype (the
+        # pre-quantization behavior); "bf16"/"int8" select the pool
+        # storage format, with int8 reads dequantizing back to the
+        # model dtype (PagedKVCache docstring has the scale layout)
         self.cache = PagedKVCache(model.num_layers, model.num_heads,
                                   model.head_dim, num_blocks,
                                   block_size=self.block_size,
                                   dtype=model.kv_dtype, mesh=mesh,
-                                  axis=mesh_axis)
+                                  axis=mesh_axis, kv_dtype=kv_dtype)
         if chaos is not None and clock is None and \
                 getattr(chaos, "drives_clock", lambda: False)():
             clock = chaos.serving_clock
@@ -375,10 +470,14 @@ class GenerationServer:
             # one host allocation drives both, and cow_copy keeps the
             # sibling rows consistent with every repointed table
             dm = spec.draft_model
+            # the draft pools follow the target's kv_dtype: speculation
+            # exists to stretch the same HBM budget, and greedy
+            # acceptance keeps ids bitwise-correct whatever the draft's
+            # KV precision (every committed id is the target's)
             self._draft_cache = PagedKVCache(
                 dm.num_layers, dm.num_heads, dm.head_dim,
                 self.cache.num_blocks, block_size=self.block_size,
-                dtype=dm.kv_dtype)
+                dtype=dm.kv_dtype, kv_dtype=kv_dtype)
             self.cache.attach_sibling(self._draft_cache)
             from .spec_decode import build_draft_step
             self._draft = jax.jit(build_draft_step(
@@ -390,8 +489,13 @@ class GenerationServer:
         # (C x the narrow gemm) — plain decode reads one column per
         # lane, so it keeps the last-column gather.
         if mesh is not None:
-            fused = model.build_fused_step(self.block_size, mesh=mesh,
-                                           axis=mesh_axis)
+            mesh_kw = {"mesh": mesh, "axis": mesh_axis}
+            if self.cache.quantized:
+                # only passed when needed, so a custom model with the
+                # pre-quantization build_fused_step signature keeps
+                # working for dense mesh serving
+                mesh_kw["kv_quantized"] = True
+            fused = model.build_fused_step(self.block_size, **mesh_kw)
         elif spec is not None:
             fused = model.build_fused_step(self.block_size,
                                            per_column=True)
@@ -419,12 +523,21 @@ class GenerationServer:
         act_est = num_slots * chunk * hidden * 4 * (2 * model.num_layers
                                                     + 4)
         led = hbm_ledger()
+        # quantized pools report their TRUE int8+scales bytes (pool_
+        # bytes already counts the scale pools) plus the dense size the
+        # same block count would have cost — capacity dashboards read
+        # the saving straight off the row instead of recomputing it
         kv_detail = {"layers": model.num_layers,
                      "num_blocks": self.cache.num_blocks,
                      "block_size": self.block_size,
                      "heads": model.num_heads,
                      "head_dim": model.head_dim,
-                     "dtype": str(np.dtype(model.kv_dtype))}
+                     "dtype": str(np.dtype(self.cache.dtype)),
+                     "kv_dtype": kv_dtype}
+        if self.cache.quantized:
+            kv_detail["scale_bytes"] = self.cache.scale_bytes()
+            kv_detail["dense_equiv_bytes"] = \
+                self.cache.dense_pool_bytes()
         if mesh is None:
             led.register(self._ledger_id, "kv_pool", "kv_cache",
                          kv_bytes, detail=kv_detail)
@@ -491,6 +604,22 @@ class GenerationServer:
                 "serving.mesh.psums_per_step": 2 * model.num_layers,
             }
             for name, val in self._mesh_gauges.items():
+                reg0.gauge(name, _help(name)).labels(
+                    server=self._ledger_id).set(val)
+        # quantized-pool gauges (serving.kv.quant.*): the true
+        # int8+scales footprint and the bytes the quantization saved vs
+        # the dense compute-dtype pool — the capacity facts behind
+        # "~2x blocks per chip". Same label/retire discipline as the
+        # mesh gauges (a closed server must stop reporting savings).
+        self._quant_gauges = None
+        if self.cache.quantized:
+            reg0 = global_registry()
+            self._quant_gauges = {
+                "serving.kv.quant.pool_bytes": kv_bytes,
+                "serving.kv.quant.bytes_saved":
+                    self.cache.dense_pool_bytes() - kv_bytes,
+            }
+            for name, val in self._quant_gauges.items():
                 reg0.gauge(name, _help(name)).labels(
                     server=self._ledger_id).set(val)
         # paged-kernel engagement accounting: the fused step traces
@@ -668,8 +797,7 @@ class GenerationServer:
                             continue
                         blk = self._sched.lane_block_for_prompt(pp)
                         if blk is not None:
-                            pool = self.cache.pools[pl]
-                            pool["k"] = pool["k"].at[blk].set(jnp.nan)
+                            self._nan_block(pl, blk)
                             self._prompt_poison_fired.add(pi)
                             self._chaos.prompt_poison_applied()
                     poison_layer = self._chaos.serving_poison_at(it)
@@ -812,6 +940,18 @@ class GenerationServer:
             self._kernel_info_cache = info
         return info
 
+    def _nan_block(self, layer, block):
+        """Chaos primitive: make `block`'s keys read as NaN. Dense
+        pools take the NaN in the k rows; quantized pools take it in
+        the k_scale rows instead — an int8 array cannot hold a NaN, but
+        NaN * any code dequantizes to NaN, so the poison propagates
+        through the SAME attention arithmetic on both layouts."""
+        pool = self.cache.pools[layer]
+        if "k_scale" in pool:
+            pool["k_scale"] = pool["k_scale"].at[block].set(jnp.nan)
+        else:
+            pool["k"] = pool["k"].at[block].set(jnp.nan)
+
     def _poison_kv(self, layer, lanes):
         """Chaos hook: NaN the first KV block of the oldest ACTIVE lane
         that has advanced past position 0 (its block 0 is attended by
@@ -826,9 +966,7 @@ class GenerationServer:
                          key=lambda l: l[4])
         if not victims:
             return False
-        block = victims[0][6]
-        pool = self.cache.pools[layer]
-        pool["k"] = pool["k"].at[block].set(jnp.nan)
+        self._nan_block(layer, victims[0][6])
         return True
 
     def _on_engine_fault(self, plan, iteration, logps, lanes):
@@ -898,10 +1036,15 @@ class GenerationServer:
         reports reference numbers as kernel numbers."""
         traced, fell_back = self._kernel_counts
         self._kernel_engaged = traced > 0 and fell_back == 0
-        kp = self.cache.pools[0]["k"]
+        p0 = self.cache.pools[0]
+        kp = p0["k"]
+        # the probe q uses the COMPUTE dtype (what the fused step feeds
+        # the dispatcher) — an int8 pool's queries are never int8
         expected = (self._kernel_mode != "off" and
                     _kvc.paged_kernel_supported(
-                        jnp.zeros((1, 1, 1, 1), kp.dtype), kp, kp))
+                        jnp.zeros((1, 1, 1, 1),
+                                  self.cache.compute_dtype), kp, kp,
+                        p0.get("k_scale"), p0.get("v_scale")))
         if expected and not self._kernel_engaged:
             raise RuntimeError(
                 "paged attention kernel was expected "
@@ -995,15 +1138,17 @@ class GenerationServer:
             self._prefix.drop_gauges()
 
     def _retire_mesh_gauges(self):
-        """Drop this server's serving.mesh.* gauge series (idempotent;
-        called from BOTH close paths — a dead server must not keep
-        reporting a live shard footprint)."""
-        if not self._mesh_gauges:
-            return
+        """Drop this server's serving.mesh.* AND serving.kv.quant.*
+        gauge series (idempotent; called from BOTH close paths — a dead
+        server must not keep reporting a live shard footprint or a
+        quantization saving)."""
         reg = global_registry()
-        for name in self._mesh_gauges:
+        for name in (self._mesh_gauges or ()):
             reg.gauge(name).remove(server=self._ledger_id)
         self._mesh_gauges = None
+        for name in (self._quant_gauges or ()):
+            reg.gauge(name).remove(server=self._ledger_id)
+        self._quant_gauges = None
 
     def get_stats(self):
         """Scheduler + engine stats; `fused_step_signatures` is the jit
@@ -1043,6 +1188,25 @@ class GenerationServer:
             "kernel_dispatches": traced,
             "fallback_dispatches": fell_back,
         }
+        # quantized-pool facts (None when dense): the TRUE int8+scales
+        # footprint, the dense compute-dtype size the same blocks would
+        # cost, and their ratio — the acceptance gauge for the ~2x
+        # capacity claim (scales included, never hidden)
+        if self.cache.quantized:
+            pb, db = self.cache.pool_bytes(), \
+                self.cache.dense_pool_bytes()
+            st["kv_quant"] = {
+                "kv_dtype": self.cache.kv_dtype,
+                "compute_dtype": str(np.dtype(
+                    self.cache.compute_dtype)),
+                "pool_bytes": pb,
+                "scale_bytes": self.cache.scale_bytes(),
+                "dense_equiv_bytes": db,
+                "bytes_ratio_vs_dense": round(pb / db, 4),
+                "int8_weights": getattr(self.model, "int8_weights", 0),
+            }
+        else:
+            st["kv_quant"] = None
         st["telemetry_enabled"] = self._tel is not None
         st["slo"] = self._tel.stats() if self._tel is not None else None
         st["engine_fault"] = repr(self._fault) if self._fault else None

@@ -44,6 +44,22 @@ primitive: copy one block's rows to a fresh block in every pool (and
 every attached sibling cache — the speculative-decoding draft pools
 share block ids) so the writer's table can be repointed while readers
 keep the original.
+
+Quantized pools (ISSUE 14): ``PagedKVCache(kv_dtype="int8")`` stores
+the block pools as int8 with per-block-row, per-head f32 scales in a
+PARALLEL pool of shape (num_blocks, H, block_size) beside each
+(num_blocks, H, block_size, D) data pool. The write path quantizes
+(symmetric absmax over D, one scale per written token row per head —
+a full-block scale would force requantizing every resident row on
+every incremental write, which doubles write traffic and compounds
+rounding error); the read path dequantizes — in the Pallas kernel the
+int8 blocks are what the DMA copies, so decode HBM traffic drops ~2x
+on top of the capacity win. Scales ride block ids everywhere blocks
+do: `cow_copy`, `adopt_block_from`, and the prefix-cache chain index
+address pools BY BLOCK ID, so sharing, fleet handoff, and sibling
+draft pools compose with quantization without carrying any extra
+state. Score/softmax accumulation stays f32; the dequantized compute
+dtype follows the query dtype (the model's activation dtype).
 """
 
 import os
@@ -55,12 +71,16 @@ import jax.numpy as jnp
 
 __all__ = ["PagedKVCache", "PagedDecodeLayer", "paged_attention",
            "paged_attention_reference", "gather_block_kv",
-           "gather_block_kv_pair", "build_paged_decode_cache",
+           "gather_block_kv_pair", "gather_block_scales",
+           "build_paged_decode_cache", "quantize_kv_rows",
+           "write_block_kv_quant",
            "NULL_BLOCK", "paged_kernel_mode", "paged_kernel_supported",
            "kernel_dispatch_stats"]
 
 NULL_BLOCK = 0          # reserved: never allocated, never attended
 NEG_INF = -1e9
+KV_QMAX = 127.0         # symmetric int8 range; -128 is never produced,
+                        # so negation stays exact under quantization
 
 # Trace-time dispatch accounting (flash.py's TRACE_COUNT idiom): how
 # many paged_attention dispatches routed to the Pallas kernel vs the
@@ -109,8 +129,31 @@ def gather_block_kv(pool, block_table):
     return jnp.moveaxis(g, 2, 1).reshape(b, h, m * bs, d)
 
 
+def gather_block_scales(scale_pool, block_table):
+    """scale pool (N, H, bs) gathered by table (B, M) -> dense
+    (B, H, M*bs) f32 view aligned with gather_block_kv's rows."""
+    b, m = block_table.shape
+    n, h, bs = scale_pool.shape
+    g = jnp.take(scale_pool, block_table.reshape(-1), axis=0)
+    g = g.reshape(b, m, h, bs)
+    return jnp.moveaxis(g, 2, 1).reshape(b, h, m * bs)
+
+
+def quantize_kv_rows(vals):
+    """Symmetric absmax int8 quantization over the LAST axis: one f32
+    scale per leading-index row. vals (..., D) float ->
+    (int8 (..., D), f32 scales (...)). An all-zero row gets scale 1.0
+    (not 0 — dequant must not produce NaN via 0 * inf or 0/0 paths),
+    and quantizes to exact zeros either way."""
+    v = vals.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(v), axis=-1)
+    scale = jnp.where(absmax > 0, absmax / KV_QMAX, 1.0)
+    q = jnp.clip(jnp.round(v / scale[..., None]), -KV_QMAX, KV_QMAX)
+    return q.astype(jnp.int8), scale
+
+
 def paged_attention_reference(q, k_pool, v_pool, block_table,
-                              q_positions):
+                              q_positions, k_scale=None, v_scale=None):
     """Pure-JAX paged attention: gather blocks by table, mask keys
     beyond each query's position, softmax in f32, weighted sum.
 
@@ -118,7 +161,10 @@ def paged_attention_reference(q, k_pool, v_pool, block_table,
     k/v_pool:    (N, H, bs, D)
     block_table: (B, M) int32
     q_positions: (B, C) int32 — logical position of each query token
-    returns      (B, H, C, D) in v_pool's dtype
+    k/v_scale:   (N, H, bs) f32 per-row scales — REQUIRED for int8
+                 pools, absent otherwise
+    returns      (B, H, C, D) in v_pool's dtype (int8 pools: in q's
+                 dtype — the model's activation dtype)
 
     The numerics deliberately mirror the dense cache path in
     models/gpt.build_kv_step: scores and softmax in f32, probabilities
@@ -126,9 +172,42 @@ def paged_attention_reference(q, k_pool, v_pool, block_table,
     decode is bitwise-comparable to the dense one. This body is the
     SEMANTIC SPEC for the Pallas kernel: ops/pallas/paged.py walks the
     table in-kernel instead of materializing the dense gather and is
-    pinned bitwise against this function for f32 pools in interpret
-    mode (tests/ops/test_paged_kernel.py)."""
+    pinned bitwise against this function for f32 AND int8 pools in
+    interpret mode (tests/ops/test_paged_kernel.py). The int8 branch
+    dequantizes the gathered rows (int8 -> f32 multiply by the row
+    scale) exactly where the kernel dequantizes its VMEM-resident
+    gather: keys straight into the f32 score math, values cast to the
+    compute dtype the probabilities use."""
     d = q.shape[-1]
+    if k_pool.dtype != jnp.int8 and (k_scale is not None
+                                     or v_scale is not None):
+        # same guard as the kernel entry point, so the error does not
+        # depend on WHICH path the dispatcher happened to take (a
+        # PADDLE_TPU_PAGED_KERNEL=0 dev loop must not silently drop
+        # scales a TPU run would reject)
+        raise ValueError(
+            f"scale pools passed with non-int8 pools ({k_pool.dtype}) "
+            f"— scales only mean something for quantized KV")
+    if k_pool.dtype == jnp.int8:
+        if k_scale is None or v_scale is None:
+            raise ValueError(
+                "int8 pools need k_scale/v_scale (the per-row f32 "
+                "scale pools stored beside the blocks)")
+        cdt = q.dtype
+        gkq, gvq = gather_block_kv_pair(k_pool, v_pool, block_table)
+        gks = gather_block_scales(k_scale, block_table)
+        gvs = gather_block_scales(v_scale, block_table)
+        gk = gkq.astype(jnp.float32) * gks[..., None]
+        gv = (gvq.astype(jnp.float32) * gvs[..., None]).astype(cdt)
+        s = jnp.einsum("bhcd,bhtd->bhct", q.astype(jnp.float32),
+                       gk) / np.sqrt(d)
+        t = gk.shape[2]
+        key_pos = jnp.arange(t)
+        mask = (key_pos[None, None, None, :]
+                <= q_positions[:, None, :, None])
+        s = jnp.where(mask, s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1).astype(gv.dtype)
+        return jnp.einsum("bhct,bhtd->bhcd", p, gv)
     gk, gv = gather_block_kv_pair(k_pool, v_pool, block_table)
     s = jnp.einsum("bhcd,bhtd->bhct", q, gk) / np.sqrt(d)
     t = gk.shape[2]
@@ -157,14 +236,23 @@ def paged_kernel_mode():
         f"PADDLE_TPU_PAGED_KERNEL={raw!r}: expected 0, 1 or auto")
 
 
-def paged_kernel_supported(q, k_pool, v_pool):
+def paged_kernel_supported(q, k_pool, v_pool, k_scale=None,
+                           v_scale=None):
     """Shapes/dtypes the kernel handles: 4-D operands with matching
-    same-dtype f32 or bf16 pools (int8 pools arrive with ROADMAP item
-    5's quantized KV blocks)."""
+    same-dtype f32 or bf16 pools, or int8 pools accompanied by their
+    (N, H, bs) f32 scale pools (quantized serving — the kernel fuses
+    the dequant into its VMEM gather)."""
     if q.ndim != 4 or k_pool.ndim != 4 or v_pool.ndim != 4:
         return False
     if k_pool.dtype != v_pool.dtype:
         return False
+    if k_pool.dtype == jnp.int8:
+        return (k_scale is not None and v_scale is not None
+                and k_scale.ndim == 3 and v_scale.ndim == 3
+                and k_scale.shape == k_pool.shape[:3]
+                and v_scale.shape == v_pool.shape[:3]
+                and k_scale.dtype == jnp.float32
+                and v_scale.dtype == jnp.float32)
     return k_pool.dtype in (jnp.float32, jnp.bfloat16)
 
 
@@ -232,17 +320,20 @@ def kernel_dispatch_stats():
             "mode": paged_kernel_mode()}
 
 
-def paged_attention(q, k_pool, v_pool, block_table, q_positions):
+def paged_attention(q, k_pool, v_pool, block_table, q_positions,
+                    k_scale=None, v_scale=None):
     """Paged attention dispatcher — the frozen serving contract.
 
     Routes to the Pallas ragged paged attention kernel
     (ops/pallas/paged.ragged_paged_attention: in-kernel table walk,
     per-lane early stop, NULL block never read, bf16 KV with f32
-    accumulation) whenever `PADDLE_TPU_PAGED_KERNEL` allows it and the
-    operands qualify; otherwise falls back to
-    `paged_attention_reference`, the documented pure-JAX spec. The
-    decision happens at TRACE time (shapes/dtypes are static under
-    jit), so a compiled fused step pays zero dispatch overhead.
+    accumulation, int8 KV with the dequant fused into the VMEM gather)
+    whenever `PADDLE_TPU_PAGED_KERNEL` allows it and the operands
+    qualify; otherwise falls back to `paged_attention_reference`, the
+    documented pure-JAX spec. int8 pools ride the SAME auto mode: the
+    scale pools travel as two extra operands and the decision happens
+    at TRACE time (shapes/dtypes are static under jit), so a compiled
+    fused step pays zero dispatch overhead.
 
     Transform traces degrade instead of dying: under a vmap trace the
     kernel is never taken (batched pallas_call is outside its TPU
@@ -252,7 +343,8 @@ def paged_attention(q, k_pool, v_pool, block_table, q_positions):
     transform internals, not as this dispatcher's message. Plain
     force-mode misuse (no transform) still raises loudly."""
     mode = paged_kernel_mode()
-    supported = paged_kernel_supported(q, k_pool, v_pool)
+    supported = paged_kernel_supported(q, k_pool, v_pool, k_scale,
+                                       v_scale)
     transform = _transform_trace_kind(q, k_pool, v_pool, block_table,
                                       q_positions)
     # a deliberate operator pin dominates every other reason: off mode
@@ -261,26 +353,28 @@ def paged_attention(q, k_pool, v_pool, block_table, q_positions):
     if mode == "off":
         _record_dispatch(kernel=False, reason="pinned_off")
         return paged_attention_reference(q, k_pool, v_pool, block_table,
-                                         q_positions)
+                                         q_positions, k_scale, v_scale)
     if transform == "vmap":
         _record_dispatch(kernel=False, reason="vmap_trace")
         return paged_attention_reference(q, k_pool, v_pool, block_table,
-                                         q_positions)
+                                         q_positions, k_scale, v_scale)
     if not supported:
         if mode == "force" and transform is None:
             raise ValueError(
                 "PADDLE_TPU_PAGED_KERNEL=1 but operands do not qualify "
                 f"(q {q.shape} {q.dtype}, pools {k_pool.shape} "
-                f"{k_pool.dtype}/{v_pool.dtype})")
+                f"{k_pool.dtype}/{v_pool.dtype}, scales "
+                f"{'present' if k_scale is not None else 'absent'})")
         _record_dispatch(kernel=False,
                          reason=f"unsupported_under_{transform}"
                          if transform else "unsupported")
         return paged_attention_reference(q, k_pool, v_pool, block_table,
-                                         q_positions)
+                                         q_positions, k_scale, v_scale)
     from ..ops.pallas.paged import ragged_paged_attention
     _record_dispatch(kernel=True)
     return ragged_paged_attention(q, k_pool, v_pool, block_table,
-                                  q_positions)
+                                  q_positions, k_scale=k_scale,
+                                  v_scale=v_scale)
 
 
 def write_block_kv(pool, vals, block_idx, offset):
@@ -289,6 +383,21 @@ def write_block_kv(pool, vals, block_idx, offset):
     routed to (NULL_BLOCK, 0) by the caller. The pool dtype wins (same
     contract as decoding.update_kv_cache)."""
     return pool.at[block_idx, :, offset, :].set(vals.astype(pool.dtype))
+
+
+def write_block_kv_quant(pool, scale_pool, vals, block_idx, offset):
+    """write_block_kv for int8 pools: quantize-at-write. vals
+    (B, C, H, D) float are absmax-quantized per (lane, column, head)
+    row; the int8 codes land in pool (N, H, bs, D) and the f32 scales
+    in scale_pool (N, H, bs) at the same (block, row) address, so a
+    block id alone always names BOTH halves of its data. Returns
+    (pool, scale_pool). Masked tokens route to (NULL_BLOCK, 0) like the
+    dense write — the NULL block's codes/scales are garbage by design
+    and the kernel/reference never read them."""
+    q, s = quantize_kv_rows(vals)
+    pool = pool.at[block_idx, :, offset, :].set(q)
+    scale_pool = scale_pool.at[block_idx, :, offset].set(s)
+    return pool, scale_pool
 
 
 # ---------------------------------------------------------------------------
@@ -310,18 +419,42 @@ class PagedKVCache:
     device layout moves: the free list, the block tables, and every
     allocation decision stay replicated host state, so the scheduler
     above is mesh-agnostic by construction (a block id means the same
-    rows on every shard)."""
+    rows on every shard).
+
+    `kv_dtype` selects the POOL storage format on top of `dtype` (the
+    compute/activation dtype the dense path would use):
+
+    - None: dense pools in `dtype` (the pre-quantization behavior);
+    - "bf16": dense bf16 pools, whatever `dtype` says (a convenience
+      alias — identical to dtype=jnp.bfloat16);
+    - "int8": int8 pools + per-block-row per-head f32 scale pools
+      ("k_scale"/"v_scale" beside "k"/"v" in every layer dict, shape
+      (num_blocks, H, block_size), head-sharded the same way). Reads
+      dequantize to `dtype`; `pool_bytes()` counts codes AND scales."""
 
     def __init__(self, num_layers, num_heads, head_dim, num_blocks,
-                 block_size=16, dtype=jnp.float32, mesh=None, axis="tp"):
+                 block_size=16, dtype=jnp.float32, mesh=None, axis="tp",
+                 kv_dtype=None):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is reserved NULL)")
+        if kv_dtype not in (None, "bf16", "int8"):
+            raise ValueError(
+                f"kv_dtype {kv_dtype!r}: expected None, 'bf16' or "
+                f"'int8'")
         self.num_layers = int(num_layers)
         self.num_heads = int(num_heads)
         self.head_dim = int(head_dim)
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
-        self.dtype = dtype
+        self.kv_dtype = kv_dtype
+        self.quantized = kv_dtype == "int8"
+        # compute_dtype: what a dequantized read yields (and what the
+        # dense pools simply store). "bf16" overrides dtype for the
+        # dense case so PagedKVCache(kv_dtype="bf16") works standalone.
+        self.compute_dtype = (jnp.bfloat16 if kv_dtype == "bf16"
+                              else dtype)
+        self.dtype = jnp.int8 if self.quantized else self.compute_dtype
+        dtype = self.dtype
         self.mesh = mesh
         self.axis = axis if mesh is not None else None
         if mesh is not None and len(mesh.axis_names) != 1:
@@ -344,21 +477,35 @@ class PagedKVCache:
                 f"num_heads={self.num_heads} (head-sharded pools)")
         shape = (self.num_blocks, self.num_heads, self.block_size,
                  self.head_dim)
+        sshape = shape[:3]          # the (N, H, bs) scale pools
         if mesh is None:
-            def make():
-                return jnp.zeros(shape, dtype)
+            def make(shp=shape, dt=dtype):
+                return jnp.zeros(shp, dt)
         else:
             from jax.sharding import NamedSharding, PartitionSpec as P
             ns = NamedSharding(mesh, P(None, axis, None, None))
+            ns3 = NamedSharding(mesh, P(None, axis, None))
 
-            def make():
+            def make(shp=shape, dt=dtype):
                 # device= allocates each (N, H/tp, bs, D) shard in
                 # place — a zeros-then-device_put would materialize the
                 # FULL pool on device 0 first, OOMing at exactly the
                 # near-ceiling pool sizes tp serving exists for
-                return jnp.zeros(shape, dtype, device=ns)
-        self.pools = [{"k": make(), "v": make()}
-                      for _ in range(self.num_layers)]
+                return jnp.zeros(shp, dt,
+                                 device=ns if len(shp) == 4 else ns3)
+
+        def make_layer():
+            layer = {"k": make(), "v": make()}
+            if self.quantized:
+                # scale 1.0, not 0: an unwritten row dequantizes to
+                # exact zeros either way, but a zero scale would turn a
+                # chaos NaN-poison of the CODES into 0 * NaN = NaN in
+                # rows the mask is supposed to neutralize
+                layer["k_scale"] = make(sshape, jnp.float32) + 1.0
+                layer["v_scale"] = make(sshape, jnp.float32) + 1.0
+            return layer
+
+        self.pools = [make_layer() for _ in range(self.num_layers)]
         # LIFO free list; block 0 (NULL) is never handed out
         self._free = list(range(self.num_blocks - 1, 0, -1))
         # host-side refcounts: block -> live references (absent = free).
@@ -380,11 +527,31 @@ class PagedKVCache:
 
     # -- byte accounting ---------------------------------------------------
     def pool_bytes(self):
-        """LOGICAL bytes of every block pool (k+v across layers) —
-        what the whole mesh holds in total, identical to the
-        single-device footprint (sharding splits it, never copies)."""
+        """LOGICAL bytes of every block pool (k+v across layers,
+        INCLUDING the f32 scale pools when quantized) — what the whole
+        mesh holds in total, identical to the single-device footprint
+        (sharding splits it, never copies). Capacity math keys off this
+        number, so quantized pools must report their true int8+scales
+        size, never the dense equivalent."""
         per = (self.num_blocks * self.num_heads * self.block_size
                * self.head_dim * np.dtype(self.dtype).itemsize)
+        return 2 * self.num_layers * per + self.scale_bytes()
+
+    def scale_bytes(self):
+        """Bytes of the (N, H, bs) f32 scale pools across k+v and every
+        layer; 0 for dense pools."""
+        if not self.quantized:
+            return 0
+        return (2 * self.num_layers * self.num_blocks * self.num_heads
+                * self.block_size * 4)
+
+    def dense_pool_bytes(self, dtype=None):
+        """What the SAME block count would cost dense in `dtype`
+        (default: this cache's compute dtype) — the honest denominator
+        for the quantization capacity ratio."""
+        dt = dtype if dtype is not None else self.compute_dtype
+        per = (self.num_blocks * self.num_heads * self.block_size
+               * self.head_dim * np.dtype(dt).itemsize)
         return 2 * self.num_layers * per
 
     def shard_pool_bytes(self):
@@ -485,15 +652,20 @@ class PagedKVCache:
         """Device-copy block `src`'s rows into block `dst` across every
         layer of this cache's pools AND every sibling's (draft pools
         share block ids, so a repointed table must mean the same rows
-        there too). One jitted signature for the cache lifetime: the
-        block ids ride as traced scalars, so distinct (src, dst) pairs
-        hit the same executable — the fused-step signature budget is
+        there too). Every array in a layer dict is copied — for a
+        quantized cache that includes the k_scale/v_scale pools, so a
+        COW-repointed block carries its dequantization state with it
+        (mixed fleets work too: each holder copies ITS OWN keys, so a
+        dense draft sibling beside a quantized target just copies
+        k/v). One jitted signature for the cache lifetime: the block
+        ids ride as traced scalars, so distinct (src, dst) pairs hit
+        the same executable — the fused-step signature budget is
         untouched."""
         if self._cow_fn is None:
             def _copy(pool_sets, s, d):
                 return [
-                    [{"k": p["k"].at[d].set(p["k"][s]),
-                      "v": p["v"].at[d].set(p["v"][s])} for p in pools]
+                    [{name: a.at[d].set(a[s]) for name, a in p.items()}
+                     for p in pools]
                     for pools in pool_sets]
             self._cow_fn = jax.jit(_copy)
         holders = [self] + self._siblings
@@ -518,7 +690,16 @@ class PagedKVCache:
         num_blocks may differ (it is a shape, not an id contract).
         Sibling (draft) pools are NOT transferred: greedy speculative
         decode stays bitwise-correct with a cold draft cache (accept
-        rate dips, ids cannot — every committed id is the target's)."""
+        rate dips, ids cannot — every committed id is the target's).
+
+        Quantization must MATCH on both sides: a quantized block is an
+        (int8 codes, f32 scales) pair, and astype-copying codes into a
+        dense pool (or float rows into an int8 pool) would silently
+        manufacture garbage KV — exactly the failure this validates
+        away. Dense<->dense float dtype differences remain a cast (a
+        bf16 prefill tier feeding an f32 decode tier is legitimate);
+        quantized<->quantized carries the scale rows alongside the
+        codes in the same jitted transfer."""
         if (src_cache.num_layers, src_cache.num_heads,
                 src_cache.head_dim, src_cache.block_size) != \
                 (self.num_layers, self.num_heads, self.head_dim,
@@ -529,13 +710,23 @@ class PagedKVCache:
                 f" D={src_cache.head_dim}, bs={src_cache.block_size}) vs "
                 f"dst (L={self.num_layers}, H={self.num_heads}, "
                 f"D={self.head_dim}, bs={self.block_size})")
+        if getattr(src_cache, "quantized", False) != self.quantized:
+            def _fmt(c):
+                return ("int8+scales" if getattr(c, "quantized", False)
+                        else f"dense {np.dtype(c.dtype).name}")
+            raise ValueError(
+                f"adopt_block_from cannot transfer between a quantized "
+                f"and a dense pool: src is {_fmt(src_cache)}, dst is "
+                f"{_fmt(self)} — int8 codes are meaningless without "
+                f"their scale rows and there is no implicit requantize "
+                f"path. Build both tiers with the same kv_dtype (the "
+                f"fleet handoff contract, docs/serving.md)")
         if self._xfer_fn is None:
             def _xfer(src_pools, dst_pools, s, d):
                 return [
-                    {"k": dp["k"].at[d].set(
-                        sp["k"][s].astype(dp["k"].dtype)),
-                     "v": dp["v"].at[d].set(
-                         sp["v"][s].astype(dp["v"].dtype))}
+                    {name: dp[name].at[d].set(
+                        sp[name][s].astype(dp[name].dtype))
+                     for name in dp}
                     for sp, dp in zip(src_pools, dst_pools)]
             self._xfer_fn = jax.jit(_xfer)
         self.pools = self._xfer_fn(src_cache.pools, self.pools,
@@ -562,45 +753,76 @@ class PagedDecodeLayer:
     by the step_fn's own cache_attention_bias), and
     `decoding.update_kv_cache` routes to `paged_update`, which writes
     this step's K/V into the right (block, offset) slot. A pytree, so
-    it rides lax.scan carries like the dense dict does."""
+    it rides lax.scan carries like the dense dict does.
 
-    def __init__(self, k_pool, v_pool, block_table):
+    Quantized pools compose transparently: with k/v scale pools
+    attached, `layer["k"]` dequantizes its gathered view (so the dense
+    step_fn math never sees int8) and `paged_update` quantizes at
+    write — the existing greedy/sample decode loops run against int8
+    KV unchanged."""
+
+    def __init__(self, k_pool, v_pool, block_table, k_scale=None,
+                 v_scale=None, compute_dtype=None):
         self.k_pool = k_pool
         self.v_pool = v_pool
         self.block_table = block_table          # (B, M) int32
+        self.k_scale = k_scale                  # (N, H, bs) f32 or None
+        self.v_scale = v_scale
+        # aux (static, not a leaf): what a dequantized read yields
+        self.compute_dtype = compute_dtype
 
     # pytree protocol -------------------------------------------------------
     def tree_flatten(self):
-        return (self.k_pool, self.v_pool, self.block_table), None
+        return ((self.k_pool, self.v_pool, self.block_table,
+                 self.k_scale, self.v_scale), self.compute_dtype)
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
-        return cls(*leaves)
+        return cls(*leaves, compute_dtype=aux)
 
     # dense mapping interface ----------------------------------------------
     def __getitem__(self, key):
-        if key == "k":
-            return gather_block_kv(self.k_pool, self.block_table)
-        if key == "v":
-            return gather_block_kv(self.v_pool, self.block_table)
-        raise KeyError(key)
+        if key not in ("k", "v"):
+            raise KeyError(key)
+        pool = self.k_pool if key == "k" else self.v_pool
+        g = gather_block_kv(pool, self.block_table)
+        scale = self.k_scale if key == "k" else self.v_scale
+        if scale is None:
+            return g
+        gs = gather_block_scales(scale, self.block_table)
+        cdt = self.compute_dtype or jnp.float32
+        return (g.astype(jnp.float32) * gs[..., None]).astype(cdt)
 
     def paged_update(self, k_t, v_t, t):
         """Write this step's K/V (B, H, 1, D) at logical position t
         (same t for every lane — the lax.scan decode contract). Returns
         a new adapter over the updated pools; the pool dtype wins, same
-        as the dense path."""
+        as the dense path (int8 pools quantize-at-write)."""
         bs = self.k_pool.shape[2]
         block_idx = jnp.take_along_axis(
             self.block_table,
             jnp.broadcast_to(t // bs, (self.block_table.shape[0], 1)),
             axis=1)[:, 0]                           # (B,)
         off = t % bs
+        if self.k_scale is not None:
+            # (B, H, 1, D) -> the (B, C=1, H, D) layout the shared
+            # quantized write expects, then index with (B, 1) rows
+            bi = block_idx[:, None]
+            offs = jnp.broadcast_to(off, bi.shape)
+            kp, ks = write_block_kv_quant(
+                self.k_pool, self.k_scale, k_t.transpose(0, 2, 1, 3),
+                bi, offs)
+            vp, vs = write_block_kv_quant(
+                self.v_pool, self.v_scale, v_t.transpose(0, 2, 1, 3),
+                bi, offs)
+            return PagedDecodeLayer(kp, vp, self.block_table, ks, vs,
+                                    compute_dtype=self.compute_dtype)
         kp = self.k_pool.at[block_idx, :, off, :].set(
             k_t[:, :, 0, :].astype(self.k_pool.dtype))
         vp = self.v_pool.at[block_idx, :, off, :].set(
             v_t[:, :, 0, :].astype(self.v_pool.dtype))
-        return PagedDecodeLayer(kp, vp, self.block_table)
+        return PagedDecodeLayer(kp, vp, self.block_table,
+                                compute_dtype=self.compute_dtype)
 
 
 def build_paged_decode_cache(cache, batch, max_len):
@@ -621,6 +843,8 @@ def build_paged_decode_cache(cache, batch, max_len):
         rows.append(cache.make_table(blocks, m))
         flat.extend(blocks)
     tables = jnp.asarray(np.stack(rows))
-    layers = [PagedDecodeLayer(p["k"], p["v"], tables)
+    layers = [PagedDecodeLayer(p["k"], p["v"], tables,
+                               p.get("k_scale"), p.get("v_scale"),
+                               compute_dtype=cache.compute_dtype)
               for p in cache.pools]
     return layers, tables, flat

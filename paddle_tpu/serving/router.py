@@ -256,6 +256,21 @@ class FleetRouter:
                 f"replicas must share one block_size (affinity chain "
                 f"keys chunk by it); got {sorted(sizes)}")
         self._block_size = sizes.pop()
+        # ... and one quantization layout: the disaggregated KV handoff
+        # is a raw pool-slice transfer, and adopt_block_from refuses a
+        # quantized<->dense copy (int8 codes mean nothing without their
+        # scales; dense<->dense float casts remain fine). Failing here
+        # beats a mixed fleet that looks healthy until the first
+        # shared-prefix handoff kills the router worker mid-request
+        # (docs/serving.md "Quantized serving").
+        quant = {getattr(r.server.cache, "quantized", False)
+                 for r in self._replicas}
+        if len(quant) != 1:
+            raise ValueError(
+                "replicas mix quantized (kv_dtype='int8') and dense KV "
+                "pools — the disaggregated handoff transfers raw pool "
+                "blocks and quantized<->dense is not transferable; "
+                "build every tier with the same kv_dtype")
         if self.policy.kind == "disaggregated":
             n = len(self._replicas)
             for i in self.policy.prefill + self.policy.decode:

@@ -407,3 +407,39 @@ print("TP_RECIPE_OK")
     res = tp_subprocess(code, devices=2)
     assert res.returncode == 0, res.stderr[-2000:]
     assert "TP_RECIPE_OK" in res.stdout
+
+
+@pytest.mark.quant
+def test_tp2_int8_pools_bitwise_vs_tp1_int8(trained):
+    """ISSUE 14: quantized pools compose with the mesh. Quantization is
+    per (lane, column, head) row and the pools shard by HEAD, so each
+    shard quantizes exactly the rows it owns — a tp=2 int8 server must
+    reproduce the tp=1 int8 server's ids BITWISE on the acceptance
+    stream (mid-stream cancel included), with the kernel engaged per
+    shard, one fused signature, and the scale pools sharded beside the
+    code pools."""
+    cfg, params = trained
+    ref_srv = _server(params, cfg, kv_dtype="int8")
+    ref_ids = _drive_staggered_stream(ref_srv)
+    assert ref_srv.get_stats()["kernel"]["engaged"] is True
+    ref_srv.close()
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    srv = _server(params, cfg, mesh=mesh, kv_dtype="int8")
+    got_ids = _drive_staggered_stream(srv)
+    assert got_ids == ref_ids
+    st = srv.get_stats()
+    assert st["fused_step_signatures"] == 1, st
+    assert st["kernel"]["engaged"] is True, st["kernel"]
+    assert st["blocks_free"] == st["blocks_total"]
+    # scale pools shard with the code pools: (N, H/tp, bs) per device,
+    # and the per-shard byte math (codes + scales) halves exactly
+    ks = srv.cache.pools[0]["k_scale"]
+    shard = ks.sharding.shard_shape(tuple(ks.shape))
+    assert shard == (srv.cache.num_blocks, cfg.num_heads // 2,
+                     srv.cache.block_size)
+    assert srv.cache.shard_pool_bytes() * 2 == srv.cache.pool_bytes()
+    assert st["kv_quant"]["kv_dtype"] == "int8"
+    assert st["kv_quant"]["pool_bytes"] < \
+        st["kv_quant"]["dense_equiv_bytes"]
+    srv.close()

@@ -258,6 +258,20 @@ def run_suite():
                  env={"JAX_PLATFORMS": "cpu",
                       "BENCH_PREFIX_COMPARE": "1"},
                  timeout_s=900, stdout_path="bench_prefix.json")
+    # 1f2b. quantized-serving comparison (ISSUE 14): int8 KV pools
+    #     (+fused-dequant kernel) vs dense bf16 under the SAME HBM
+    #     budget — admitted-concurrency ratio, greedy exact-match
+    #     rate, tokens/s, ledger-pinned pool bytes, on the CPU backend
+    #     (deterministic; acceptance: >= 1.8x admitted, match >= 0.99,
+    #     int8 pool bytes <= 0.56x dense bf16)
+    if _artifact_ok("bench_quant.json"):
+        log("step quant_compare: already landed in a prior cycle — "
+            "skipping")
+    else:
+        run_step("quant_compare", [py, bench],
+                 env={"JAX_PLATFORMS": "cpu",
+                      "BENCH_QUANT_COMPARE": "1"},
+                 timeout_s=900, stdout_path="bench_quant.json")
     # 1f3. fleet-router comparison (ISSUE 11): affinity vs random
     #     routing over a long-tail multi-tenant prefix storm (fleet
     #     hit rate, blocks/request) + p99 TTFT under overload with vs
