@@ -1,5 +1,5 @@
 """Stdlib-only HTTP telemetry endpoint: /metrics, /healthz, /slo,
-/memory.
+/memory, /trace.
 
 Any component can mount one — ``GenerationServer.serve_metrics(port=...)``
 and ``Executor.serve_metrics(port=...)`` wrap this; a bare
@@ -19,6 +19,11 @@ scrape target on every host, not a metrics SDK.
   is the process-wide ``compile_insight.hbm_ledger()``): param /
   optimizer-state / PagedKVCache pool bytes and compiled peak-HBM
   estimates per component (docs/observability.md "Compile & memory").
+- ``GET /trace`` — JSON bounded ring of recently completed request
+  traces (``trace_fn()``; the fleet router mounts its completed-trace
+  ring — trace id, hops, lineage, outcome per request; see
+  docs/observability.md "Fleet tracing"). Components without a trace
+  plane serve an empty ring.
 
 Security note: binds 127.0.0.1 by default — the exposition includes
 program/shape names and the SLO surface leaks traffic patterns. Bind a
@@ -77,11 +82,28 @@ class _Handler(BaseHTTPRequestHandler):
                 body = (json.dumps(payload, sort_keys=True) + "\n").encode()
                 ctype = "application/json"
                 code = 200
+            elif path == "/trace":
+                # the fleet router's bounded ring of recent completed
+                # request traces (observability/fleet_trace.py); a
+                # component without a trace plane serves an empty ring
+                # so the route is always probeable
+                if owner.trace_fn is not None:
+                    payload = owner.trace_fn()
+                else:
+                    # the ONE definition of the schema's empty shape —
+                    # FleetTracer.completed_payload() builds on the
+                    # same helper, so the two producers of trace_ring/1
+                    # cannot diverge
+                    from .fleet_trace import empty_trace_ring
+                    payload = empty_trace_ring()
+                body = (json.dumps(payload, sort_keys=True) + "\n").encode()
+                ctype = "application/json"
+                code = 200
             else:
                 body = (json.dumps(
                     {"error": "not found",
                      "endpoints": ["/metrics", "/healthz", "/slo",
-                                   "/memory"]})
+                                   "/memory", "/trace"]})
                     + "\n").encode()
                 ctype = "application/json"
                 code = 404
@@ -104,7 +126,8 @@ class TelemetryServer:
     daemon serve thread; close() shuts it down (idempotent)."""
 
     def __init__(self, registry=None, host="127.0.0.1", port=0,
-                 slo_fn=None, health_fn=None, memory_fn=None):
+                 slo_fn=None, health_fn=None, memory_fn=None,
+                 trace_fn=None):
         self.registry = registry if registry is not None \
             else global_registry()
         self.slo_fn = slo_fn
@@ -112,6 +135,9 @@ class TelemetryServer:
         # None -> the process-wide HBM ledger, resolved per request so
         # a custom memory view stays injectable for tests
         self.memory_fn = memory_fn
+        # /trace body (the fleet router's completed-trace ring); None
+        # serves an always-probeable empty ring
+        self.trace_fn = trace_fn
         self._requested = (host, int(port))
         self._httpd = None
         self._thread = None
@@ -121,7 +147,7 @@ class TelemetryServer:
         self._requests = self.registry.counter(
             "exporter.requests", _help("exporter.requests"))
 
-    _KNOWN_PATHS = ("/metrics", "/healthz", "/slo", "/memory")
+    _KNOWN_PATHS = ("/metrics", "/healthz", "/slo", "/memory", "/trace")
 
     def _count(self, path, code):
         # unknown paths collapse to one label value: a crawler probing
@@ -324,10 +350,10 @@ def check_remount(live, port, host):
 
 
 def serve_metrics(port=0, host="127.0.0.1", registry=None, slo_fn=None,
-                  health_fn=None, memory_fn=None):
+                  health_fn=None, memory_fn=None, trace_fn=None):
     """Mount and start a telemetry endpoint; returns the running
     TelemetryServer (``.port`` holds the bound port, ``.close()`` stops
     it). Binds loopback by default — see the module security note."""
     return TelemetryServer(registry=registry, host=host, port=port,
                            slo_fn=slo_fn, health_fn=health_fn,
-                           memory_fn=memory_fn).start()
+                           memory_fn=memory_fn, trace_fn=trace_fn).start()

@@ -255,6 +255,16 @@ METRIC_SPECS = [
      "poison_threshold replica deaths (engine faults naming their "
      "lane), failed with PoisonRequestError instead of re-admitted "
      "onto another survivor"),
+    ("serving.fleet.trace.requests", "counter",
+     "requests the router minted a SAMPLED fleet trace context for "
+     "(one trace id + one sampling verdict per request, obeyed on "
+     "every hop across handoff/failover/resurrection)"),
+    ("serving.fleet.trace.completed", "counter",
+     "finished request traces recorded into the router's bounded "
+     "/trace ring (trace id, hops, lineage, outcome)"),
+    ("serving.fleet.trace.dumps", "counter",
+     "merged fleet Perfetto dumps produced by FleetRouter.dump_trace "
+     "(fleet track + per-replica captures incl. death snapshots)"),
     ("tracing.dropped_events", "counter",
      "trace events dropped by the bounded ring buffer (drop-oldest)"),
     ("serving.queue_wait_ms", "histogram",

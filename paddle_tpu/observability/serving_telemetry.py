@@ -517,12 +517,15 @@ class SLOTracker:
 class _ReqTrace:
     __slots__ = ("rid", "sampled", "submit_perf", "admit_perf",
                  "admit_iteration", "slot", "chunks", "first_token_perf",
-                 "first_token_iteration", "last_token_perf", "tokens")
+                 "first_token_iteration", "last_token_perf", "tokens",
+                 "trace_id", "hop")
 
     def __init__(self, rid, sampled, submit_perf):
         self.rid = rid
         self.sampled = sampled
         self.submit_perf = submit_perf
+        self.trace_id = None    # fleet trace correlation (router-minted
+        self.hop = 0            # TraceContext; None outside a fleet)
         self.admit_perf = None
         self.admit_iteration = None
         self.slot = None
@@ -574,11 +577,27 @@ class ServingTelemetry:
             return False
         return _rid_hash01(rid) < self.sample_rate
 
+    def set_recorder(self, recorder):
+        """Re-point span-tree emission at a dedicated recorder (the
+        fleet router gives every replica its own, so the merged
+        Perfetto dump renders per-replica process groups —
+        observability/fleet_trace.py)."""
+        self._rec = recorder
+
     # -- request lifecycle hooks (scheduler/engine) ------------------------
-    def on_submit(self, rid):
+    def on_submit(self, rid, ctx=None):
+        """`ctx` is a fleet TraceContext: its router-minted sampling
+        verdict WINS over this engine's own mode — the decision is
+        made once per request so every hop traces or none does (an
+        engine re-hashing its replica-local rid, which changes on
+        failover, would desync the hops)."""
+        sampled = ctx.sampled if ctx is not None else self.sampled(rid)
+        st = _ReqTrace(rid, sampled, time.perf_counter())
+        if ctx is not None:
+            st.trace_id = ctx.trace_id
+            st.hop = ctx.hop
         with self._lock:
-            self._req[rid] = _ReqTrace(rid, self.sampled(rid),
-                                       time.perf_counter())
+            self._req[rid] = st
 
     def on_admit(self, rid, slot, iteration, queue_wait_ms):
         self._m_queue_wait.observe(queue_wait_ms)
@@ -647,17 +666,22 @@ class ServingTelemetry:
         end = time.perf_counter()
         track = (f"serving slot {st.slot}" if st.slot is not None
                  else "serving queue")
-        root_args = {"rid": st.rid, "outcome": outcome,
-                     "finish_reason": reason,
-                     "prompt_len": prompt_len, "generated": generated,
-                     "admit_iteration": st.admit_iteration,
-                     "end_iteration": end_iteration,
-                     "slot": st.slot}
+        # fleet correlation rides EVERY span of the tree: the merged
+        # fleet dump is queried by trace_id, and a child span must be
+        # attributable without walking back to its root
+        base = {"rid": st.rid}
+        if st.trace_id is not None:
+            base["trace_id"] = st.trace_id
+            base["hop"] = st.hop
+        root_args = dict(base, outcome=outcome, finish_reason=reason,
+                         prompt_len=prompt_len, generated=generated,
+                         admit_iteration=st.admit_iteration,
+                         end_iteration=end_iteration, slot=st.slot)
         rec.complete(f"request {st.rid}", st.submit_perf, end,
                      cat="serving.request", args=root_args, track=track)
         queue_end = st.admit_perf if st.admit_perf is not None else end
         rec.complete("queue", st.submit_perf, queue_end,
-                     cat="serving.request", args={"rid": st.rid},
+                     cat="serving.request", args=dict(base),
                      track=track)
         # prefill chunks: each closes where the next one opens; the last
         # closes at the first token (or the end, if cut short)
@@ -669,17 +693,17 @@ class ServingTelemetry:
             else:
                 t1 = end
             rec.complete("prefill.chunk", t0, t1, cat="serving.request",
-                         args={"rid": st.rid, "iteration": it,
-                               "tokens": ntok}, track=track)
+                         args=dict(base, iteration=it, tokens=ntok),
+                         track=track)
         if st.first_token_perf is not None:
             rec.complete(
                 "decode", st.first_token_perf,
                 st.last_token_perf or end, cat="serving.request",
-                args={"rid": st.rid, "tokens": st.tokens,
-                      "first_token_iteration": st.first_token_iteration},
+                args=dict(base, tokens=st.tokens,
+                          first_token_iteration=st.first_token_iteration),
                 track=track)
         rec.instant(outcome, cat="serving.request",
-                    args={"rid": st.rid, "iteration": end_iteration},
+                    args=dict(base, iteration=end_iteration),
                     ts=end, track=track)
 
     # -- engine iteration bracketing --------------------------------------

@@ -83,8 +83,12 @@ class TraceRecorder:
         """Events dropped by the ring since the last start()/clear()."""
         return self._dropped
 
-    def start(self):
-        """Begin a capture (clears any previous one)."""
+    def start(self, origin=None):
+        """Begin a capture (clears any previous one). `origin` pins the
+        perf_counter instant that maps to ts=0 — the fleet tracer
+        starts every replica's recorder against ONE shared origin so
+        cross-replica stamps merge into a single comparable timeline
+        (observability/fleet_trace.py); default is "now"."""
         with self._lock:
             # the global recorder is built at import time; honour a
             # PADDLE_TPU_TRACE_BUFFER set programmatically afterwards by
@@ -97,7 +101,8 @@ class TraceRecorder:
                     self._events = collections.deque(maxlen=n)
             self._events.clear()
             self._dropped = 0
-            self._t0 = time.perf_counter()
+            self._t0 = (time.perf_counter() if origin is None
+                        else float(origin))
             self._epoch0 = time.time()
             self._enabled = True
 

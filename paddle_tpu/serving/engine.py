@@ -661,12 +661,17 @@ class GenerationServer:
 
     # -- client surface ----------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=32, eos_id=None,
-               priority=0, deadline_ms=None, stream=None):
+               priority=0, deadline_ms=None, stream=None,
+               trace_ctx=None):
         """prompt_ids: 1-D int token ids. Returns a GenerationFuture
         resolving to a GenerationResult (or raising DeadlineExceeded /
         RequestCancelled). `stream(request_id, token)` fires on the
         serve thread for every generated token. Lower `priority` values
-        run first (FIFO within a priority)."""
+        run first (FIFO within a priority). `trace_ctx` is the fleet
+        router's TraceContext (observability/fleet_trace.py): its
+        trace id/hop land on this request's span tree and its sampling
+        verdict overrides this engine's own — a request is traced on
+        all hops or none."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -691,7 +696,7 @@ class GenerationServer:
         if self._tel is not None:
             # before enqueue: the worker thread may admit the request
             # the instant it lands, and on_admit needs the submit stamp
-            self._tel.on_submit(rid)
+            self._tel.on_submit(rid, ctx=trace_ctx)
         fut = GenerationFuture(self, rid)
         deadline = None
         if deadline_ms is not None:

@@ -67,12 +67,27 @@ docs/robustness.md "Self-healing fleet"):
   and pending failovers finish, then every replica closes), the
   serving twin of GuardedTrainer's drain-and-save.
 
+ISSUE 15 adds **fleet-wide distributed tracing**
+(observability/fleet_trace.py, docs/observability.md "Fleet
+tracing"): every submit mints ONE deterministic trace context (trace
+id + hop counter + the sampling verdict, decided here once so a
+request traces on all hops or none), each replica's span trees carry
+``trace_id``/``hop``, router-level events (route decision, shed,
+handoff, failover, supervisor lifecycle) land on a dedicated fleet
+track, ``dump_trace()`` merges everything into one Perfetto JSON with
+per-replica process groups (a dying replica's capture is snapshotted
+at teardown so the victim's half of a failover survives), and the
+``/trace`` exporter endpoint serves a bounded ring of completed
+request traces (``tools/request_trace.py`` reconstructs one rid's
+lineage from it).
+
 Threading mirrors the engine: ``start=True`` runs a router worker that
 pumps replica engines; ``start=False`` is the deterministic
 manual-drive mode (``step()``/``run_until_idle()``, injectable clocks,
 no sleeps) the fleet test tier uses. Metrics:
 ``serving.fleet.{routed,sheds,failovers,handoffs,handoff_blocks,
 replicas,replica_load,hangs,resurrections,crash_loops,quarantines}``
+plus ``serving.fleet.trace.{requests,completed,dumps}``
 (docs/serving.md "Fleet serving").
 """
 
@@ -85,7 +100,9 @@ from concurrent.futures import Future, InvalidStateError
 import numpy as np
 
 from ..observability import _help
+from ..observability.fleet_trace import TraceContext, mint_trace_id
 from ..observability.metrics import global_registry
+from ..observability.serving_telemetry import _rid_hash01
 from .prefix_cache import prompt_chain_keys
 from .replica import Replica
 from .scheduler import (DeadlineExceeded, GenerationResult,
@@ -191,7 +208,8 @@ class _Routed:
                  "deadline_ms", "stream", "future", "keys", "replica",
                  "rep_fut", "phase", "emitted", "seen", "attempts",
                  "client_cancelled", "first_submit_mono", "lineage",
-                 "implicated", "retry_budget")
+                 "implicated", "retry_budget", "ctx", "hops",
+                 "submit_perf", "trace_done")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_id, priority,
                  deadline_ms, stream, future, keys):
@@ -219,6 +237,12 @@ class _Routed:
         self.implicated = 0     # deaths whose fault NAMED this request
         self.retry_budget = None    # per-request failover cap (None ->
         #                             the router-wide max_failovers)
+        self.ctx = None     # fleet TraceContext (one trace id, one
+        #                     sampling verdict — every hop rides it)
+        self.hops = []      # [{"hop", "replica", "phase", "policy"}]
+        self.submit_perf = None     # perf stamp of the client submit
+        #                             (the fleet-track request span)
+        self.trace_done = False     # /trace summary recorded (once)
 
 
 class FleetRouter:
@@ -241,10 +265,19 @@ class FleetRouter:
     def __init__(self, servers, *, policy=None, admission=None,
                  chaos=None, start=True, p2c_seed=0, name=None,
                  max_failovers=None, spawn_fn=None, supervisor=None,
-                 preemption=None, poison_threshold=2, flight_dir=None):
+                 preemption=None, poison_threshold=2, flight_dir=None,
+                 trace=False, trace_sample=None):
         if not servers:
             raise ValueError("FleetRouter needs at least one replica")
         self.name = name or f"fleet{next(_ROUTER_SEQ)}"
+        # trace-mint identity: auto names are process-unique by
+        # construction, but an EXPLICIT name may be reused across
+        # routers (dashboards often pin one) — duplicate names must
+        # not conflate two requests' lineages in /trace or merged
+        # dumps, so explicitly-named routers mint under a
+        # per-instance disambiguator
+        self._trace_ident = (self.name if name is None
+                             else f"{name}#{next(_ROUTER_SEQ)}")
         self.policy = policy or RouterPolicy()
         self.admission = admission
         self._chaos = chaos
@@ -347,6 +380,24 @@ class FleetRouter:
         # as a bounded postmortem ring, dumped on a quarantine
         from ..observability.serving_telemetry import FlightRecorder
         self._flight = FlightRecorder(capacity=64, out_dir=flight_dir)
+        # fleet-wide distributed tracing (observability/fleet_trace.py):
+        # the router mints ONE trace context per request (trace id +
+        # hop counter + the sampling verdict, evaluated HERE once from
+        # PADDLE_TPU_TRACE_REQUESTS / trace_sample so every hop of a
+        # request traces or none does), gives every replica slot its
+        # own TraceRecorder (per-replica process groups in the merged
+        # Perfetto dump), and records router-level events on a
+        # dedicated fleet track. dump_trace() merges it all.
+        from ..observability.fleet_trace import FleetTracer
+        from ..observability.serving_telemetry import trace_request_mode
+        self._trace_mode = trace_request_mode(trace_sample)
+        self._tracer = FleetTracer(self.name)
+        # replica recorders bind LAZILY at start_trace(): an untraced
+        # fleet keeps its replicas' span trees on the process-wide
+        # recorder, so the pre-existing global-capture workflow
+        # (profiler.start_profiler / get_recorder().start()) still
+        # sees fleet serving spans until fleet tracing is opted into
+        self._trace_bound = False
         self.counts = {"routed": 0, "sheds": 0, "failovers": 0,
                        "handoffs": 0, "handoff_blocks": 0,
                        "replica_kills": 0, "hangs": 0,
@@ -373,8 +424,14 @@ class FleetRouter:
                            _help(f"serving.fleet.{k}"))
             for k in ("hangs", "resurrections", "crash_loops",
                       "quarantines")}
+        self._m_trace = {
+            k: reg.counter(f"serving.fleet.trace.{k}",
+                           _help(f"serving.fleet.trace.{k}"))
+            for k in ("requests", "completed", "dumps")}
         self._load_series = set()       # replica names with a live series
         self._publish_gauges()
+        if trace:
+            self.start_trace()
         self._worker = None
         if start:
             self._worker = threading.Thread(target=self._serve,
@@ -413,6 +470,18 @@ class FleetRouter:
                      deadline_ms, stream, fut, keys)
         if retry_budget is not None:
             rr.retry_budget = int(retry_budget)
+        # ONE trace context per request, minted HERE: deterministic id
+        # (no clocks), hop counter, and the single sampling verdict
+        # every hop obeys — engines must never re-decide from their
+        # replica-local rid, which changes on failover
+        mode, rate = self._trace_mode
+        sampled = (mode == "all" or
+                   (mode == "sampled" and _rid_hash01(rid) < rate))
+        rr.ctx = TraceContext(mint_trace_id(self._trace_ident, rid),
+                              sampled=sampled)
+        rr.submit_perf = time.perf_counter()
+        if sampled:
+            self._m_trace["requests"].inc()
         if self.policy.kind == "disaggregated" and keys:
             pool, phase = self._pool("prefill"), "prefill"
         elif self.policy.kind == "disaggregated":
@@ -436,9 +505,34 @@ class FleetRouter:
                 except (RuntimeError, ValueError):
                     if attempt + 1 >= len(self._replicas):
                         raise
-        except BaseException:
+        except AdmissionRejected as e:
             with self._lock:
                 self._inflight.pop(rid, None)
+            # the shed lands on the fleet track with the facts a client
+            # postmortem needs: what breached, how hard, the backoff —
+            # sampled requests only (the verdict governs every artifact)
+            if rr.ctx.sampled:
+                self._tracer.fleet.instant(
+                    "shed", cat="serving.fleet",
+                    args=dict(rr.ctx.args(), rid=rid, scope=e.scope,
+                              burn_rate=e.burn_rate,
+                              retry_after_ms=e.retry_after_ms),
+                    track="fleet router")
+            # ... and closes its /trace ring summary like every other
+            # terminal outcome (the ring is the only live trace plane
+            # while the span capture is off)
+            self._note_trace_done(rr, "shed", reason=e.scope,
+                                  error=str(e)[:200])
+            raise
+        except BaseException as exc:
+            with self._lock:
+                self._inflight.pop(rid, None)
+            # any submit-time failure is a terminal outcome: the ring
+            # must not show a sampled request that simply vanished
+            # (trace.requests incremented, no completed record)
+            self._note_trace_done(rr, "failed",
+                                  reason=type(exc).__name__,
+                                  error=repr(exc)[:200])
             raise
 
     def _any_prefix(self):
@@ -578,23 +672,50 @@ class FleetRouter:
                     f"{rr.attempts} failover(s)"))
                 return
         srv = target.server
+        # this submission is one HOP of the request's fleet trace: the
+        # context the replica's telemetry stamps on its span tree, and
+        # the router-side hop record /trace serves
+        hop = len(rr.hops)
+        ctx = rr.ctx.at(hop) if rr.ctx is not None else None
         if phase == "prefill":
             # the prefill replica is a KV producer: one forced token
             # completes the prompt's chunks (ignored — the decode
             # replica regenerates it deterministically from the
             # handed-off KV), nothing streams to the client from here
             fut = srv.submit(rr.prompt, max_new_tokens=1,
-                             priority=rr.priority)
+                             priority=rr.priority, trace_ctx=ctx)
         else:
             fut = srv.submit(rr.prompt,
                              max_new_tokens=rr.max_new_tokens,
                              eos_id=rr.eos_id, priority=rr.priority,
                              deadline_ms=deadline_ms,
-                             stream=self._stream_cb(rr))
+                             stream=self._stream_cb(rr),
+                             trace_ctx=ctx)
+        rr.hops.append({"hop": hop, "replica": target.name,
+                        "phase": phase, "policy": label})
         rr.rep_fut = fut
         self.counts["routed"] += 1
         self._m_routed.inc()
         self._m_routed.labels(policy=label).inc()
+        if self._tracer.enabled and ctx is not None and ctx.sampled:
+            # the route decision on the fleet track: why THIS replica
+            # (policy + affinity depth) against what the alternatives
+            # looked like (candidate loads) — computed only while a
+            # capture is live AND only for sampled requests: the
+            # sampling verdict governs EVERY artifact of a trace, and
+            # unsampled traffic must not churn the bounded fleet ring
+            # out from under the requests sampling chose to keep
+            depth = (target.affinity_depth(rr.prompt, rr.keys)
+                     if rr.keys else 0)
+            loads = {r.name: list(r.load()) for r in self._replicas
+                     if r.alive()}
+            self._tracer.fleet.instant(
+                "route", cat="serving.fleet",
+                args=dict(ctx.args(), rid=rr.rid,
+                          replica=target.name, phase=phase,
+                          policy=label, affinity_depth=depth,
+                          candidate_loads=loads),
+                track="fleet router")
         fut.add_done_callback(lambda f, rr=rr: self._on_replica_done(
             rr, f))
         self._notify()
@@ -622,6 +743,7 @@ class FleetRouter:
         if f.cancelled() or rr.client_cancelled:
             with self._lock:
                 self._inflight.pop(rr.rid, None)
+            self._note_trace_done(rr, "cancelled")
             return
         exc = f.exception()
         if exc is None:
@@ -656,6 +778,8 @@ class FleetRouter:
                 rr.future.set_result(out)
         except InvalidStateError:
             pass
+        self._note_trace_done(rr, "retired", reason=res.finish_reason,
+                              generated=len(res.token_ids))
         self._notify()
 
     def _fail(self, rr, exc):
@@ -666,7 +790,49 @@ class FleetRouter:
                 rr.future.set_exception(exc)
         except InvalidStateError:
             pass
+        self._note_trace_done(rr, "failed",
+                              reason=type(exc).__name__,
+                              error=repr(exc)[:200])
         self._notify()
+
+    def _note_trace_done(self, rr, outcome, reason=None, error=None,
+                         generated=None):
+        """Close out one request's fleet trace: the router-side summary
+        (trace id, hops, lineage, outcome) lands in the /trace ring,
+        and a fleet-track root span covers submit→end. Sampled
+        requests only — the router's ONE verdict, same as the replica
+        span trees."""
+        ctx = rr.ctx
+        if ctx is None or not ctx.sampled:
+            return
+        with self._lock:
+            # once, under the lock: completion paths can race across
+            # threads (a client-thread cancel vs the worker draining a
+            # queued failover event) — the first verdict wins, the
+            # ring and trace.completed never double-count a request
+            if rr.trace_done:
+                return
+            rr.trace_done = True
+        self._tracer.note_completed({
+            "trace_id": ctx.trace_id, "rid": rr.rid,
+            "outcome": outcome, "reason": reason, "error": error,
+            "prompt_len": int(rr.prompt.size),
+            "generated": generated,
+            "hops": list(rr.hops), "attempts": rr.attempts,
+            "lineage": list(rr.lineage),
+            "implicated_deaths": rr.implicated})
+        self._m_trace["completed"].inc()
+        if self._tracer.enabled and rr.submit_perf is not None:
+            # the root span covers EVERY hop, so it carries no hop key
+            # of its own — just the trace id and the hop count
+            self._tracer.fleet.complete(
+                f"request {rr.rid}", rr.submit_perf,
+                time.perf_counter(), cat="serving.fleet",
+                args={"trace_id": ctx.trace_id, "rid": rr.rid,
+                      "outcome": outcome, "reason": reason,
+                      "generated": generated, "hops": len(rr.hops),
+                      "attempts": rr.attempts},
+                track="fleet requests")
 
     def _note_lineage(self, rr, exc):
         """Record a replica DEATH in the request's failover lineage
@@ -709,7 +875,14 @@ class FleetRouter:
         # otherwise replay the exact payload that faults engines —
         # the cascade re-entering through the healing path
         self._digest.forget(rr.keys)
+        # trace_id only when the request is SAMPLED: the verdict
+        # governs every per-request trace artifact, and the mirrored
+        # fleet-track instant must not mint an orphan trace id that
+        # /trace and the span trees know nothing about
         self._flight_event("quarantine", rid=rr.rid,
+                           trace_id=(rr.ctx.trace_id
+                                     if rr.ctx is not None
+                                     and rr.ctx.sampled else None),
                            attempts=rr.attempts,
                            lineage=list(rr.lineage))
         dump = self._flight.dump(
@@ -729,6 +902,10 @@ class FleetRouter:
         if rr.client_cancelled or rr.future.done():
             with self._lock:
                 self._inflight.pop(rr.rid, None)
+            # a request cancelled while its failover sat queued still
+            # closes its /trace summary (idempotent: a future already
+            # failed/finished kept its first verdict)
+            self._note_trace_done(rr, "cancelled")
             return
         if self._note_lineage(rr, exc):
             return      # quarantined: future already failed
@@ -753,6 +930,8 @@ class FleetRouter:
         except AdmissionRejected:
             self._fail(rr, exc)
             return
+        src_name = rr.replica.name if rr.replica is not None else None
+        hops_before = len(rr.hops)
         try:
             self._submit_to(
                 rr, target, rr.phase,
@@ -764,12 +943,29 @@ class FleetRouter:
             # either way, one more failover attempt re-picks among the
             # rest (bounded by max_failovers)
             self._enqueue(("failover", rr, sub_exc))
+            return
+        if self._tracer.enabled and rr.ctx is not None \
+                and rr.ctx.sampled and len(rr.hops) > hops_before:
+            # the re-admission on the fleet track: what killed the
+            # previous hop, and where the request moved — emitted only
+            # AFTER the re-submission actually landed (a raced/failed
+            # submit must not leave a phantom row naming a target that
+            # never received the request), stamped with the hop the
+            # route instant and span tree of the re-admission carry
+            self._tracer.fleet.instant(
+                "failover", cat="serving.fleet",
+                args=dict(rr.ctx.at(hops_before).args(), rid=rr.rid,
+                          cause=type(exc).__name__, source=src_name,
+                          target=rr.hops[-1]["replica"],
+                          attempt=rr.attempts),
+                track="fleet router")
 
     # -- disaggregated handoff ---------------------------------------------
     def _do_handoff(self, rr, _prefill_res):
         if rr.client_cancelled or rr.future.done():
             with self._lock:
                 self._inflight.pop(rr.rid, None)
+            self._note_trace_done(rr, "cancelled")
             return
         src = rr.replica
         try:
@@ -779,6 +975,9 @@ class FleetRouter:
             self._fail(rr, e)
             return
         moved = 0
+        t0 = time.perf_counter() if (
+            self._tracer.enabled and rr.ctx is not None
+            and rr.ctx.sampled) else None
         if src is not None and src.alive():
             moved = self._transfer_chain(src.server, target.server, rr)
         self.counts["handoffs"] += 1
@@ -786,6 +985,22 @@ class FleetRouter:
         self._m_handoffs.inc()
         if moved:
             self._m_handoff_blocks.inc(moved)
+        if t0 is not None and rr.ctx is not None:
+            # the disaggregated KV handoff, timed on the fleet track:
+            # one block per full prompt chunk, bytes = pool slice cost
+            # (stamped with the DECODE hop the transfer feeds into)
+            cache = target.server.cache
+            self._tracer.fleet.complete(
+                "kv_handoff", t0, time.perf_counter(),
+                cat="serving.fleet",
+                args=dict(rr.ctx.at(len(rr.hops)).args(), rid=rr.rid,
+                          source=(src.name if src is not None
+                                  else None),
+                          target=target.name, chunks=moved,
+                          blocks=moved,
+                          bytes=moved * (cache.pool_bytes()
+                                         // cache.num_blocks)),
+                track="fleet router")
         try:
             self._submit_to(rr, target, "decode", "decode")
         except (RuntimeError, ValueError) as sub_exc:
@@ -975,11 +1190,19 @@ class FleetRouter:
         if not r.alive():
             return
         self.counts["replica_kills"] += 1
+        self._flight_event("replica_kill", replica=r.name,
+                           pending=r.server.pending())
         # a hung-then-killed replica must not leave its slot in the
         # chaos stall set — the RESURRECTED replica there would never
         # be pumped again
         self._chaos_hung.discard(index)
         r.kill()
+        # kill() ran cancel_all, so the victim's in-flight span trees
+        # were just emitted into its recorder — freeze that capture
+        # NOW: the slot's resurrection swaps in a fresh recorder, and
+        # the victim's half of every failover must survive into the
+        # merged postmortem dump
+        self._tracer.snapshot_replica(r.name)
         if self._chaos is not None:
             self._chaos.replica_kill_applied()
         self._publish_gauges()      # drops the dead replica's series
@@ -1009,6 +1232,7 @@ class FleetRouter:
                            pending=r.server.pending())
         self._chaos_hung.discard(index)
         r.kill()
+        self._tracer.snapshot_replica(r.name)   # postmortem capture
         self._publish_gauges()
         self._notify()
 
@@ -1021,7 +1245,13 @@ class FleetRouter:
     def _flight_event(self, kind, **fields):
         """One fleet lifecycle event into the router's flight recorder
         (kills, hangs, resurrections, quarantines — the postmortem
-        ring a quarantine dumps)."""
+        ring a quarantine dumps) AND, while a trace capture is live,
+        an instant on the fleet track — supervisor events line up
+        against the request spans they explain."""
+        self._tracer.fleet.instant(
+            kind, cat="serving.fleet",
+            args=dict(fields, iteration=self.iteration),
+            track="fleet router")
         self._flight.record(self.iteration, kind=kind, **fields)
 
     def _adopt_replica(self, index, server, generation=1):
@@ -1034,6 +1264,15 @@ class FleetRouter:
         rep = Replica(index, server, name=old.name)
         rep.role = old.role
         rep.generation = int(generation)
+        # the dead generation's capture is frozen (idempotent if the
+        # kill/hang path already snapshotted it) and the slot's fresh
+        # engine traces into a NEW recorder under the same name — the
+        # merged dump shows both generations as separate process
+        # groups. Only once fleet tracing was engaged: an untraced
+        # fleet's resurrected replicas stay on the global recorder.
+        if self._trace_bound:
+            self._tracer.snapshot_replica(rep.name)
+            self._bind_replica_recorder(rep)
         with self._lock:
             self._replicas[index] = rep
         self._chaos_hung.discard(index)     # a fresh engine is never
@@ -1059,6 +1298,42 @@ class FleetRouter:
         self.counts["preempt_drains"] += 1
         self._flight_event("preempt_drain", pending=self.pending())
         self._notify()
+
+    # -- fleet tracing ------------------------------------------------------
+    def _bind_replica_recorder(self, rep):
+        if rep.server.telemetry is not None:
+            rep.server.telemetry.set_recorder(
+                self._tracer.recorder_for(rep.name, rep.generation))
+
+    def start_trace(self):
+        """Begin a fleet-wide trace capture: every replica's telemetry
+        is (re)bound to its own per-slot recorder — from here on the
+        fleet owns replica span emission; the process-wide recorder no
+        longer sees these replicas' trees — and all recorders start
+        against one shared time origin (docs/observability.md "Fleet
+        tracing"). Sampling is governed by PADDLE_TPU_TRACE_REQUESTS /
+        the trace_sample ctor arg — decided ONCE per request at the
+        router, obeyed on every hop."""
+        self._trace_bound = True
+        for r in self._replicas:
+            self._bind_replica_recorder(r)
+        self._tracer.start()
+
+    def stop_trace(self):
+        self._tracer.stop()
+
+    def dump_trace(self, path=None):
+        """Merge every capture — the fleet track, each live replica's
+        recorder, and the frozen captures of replicas that died
+        mid-capture — into ONE Perfetto JSON with per-replica process
+        groups. `otherData.truncated` marks a partial capture (any
+        ring dropped events, or a death snapshot was evicted). Writes
+        to `path` when given; returns the payload either way."""
+        payload = self._tracer.merge()
+        self._m_trace["dumps"].inc()
+        if path is not None:
+            self._tracer.save(path, payload)
+        return payload
 
     def replicas(self):
         return list(self._replicas)
@@ -1134,6 +1409,11 @@ class FleetRouter:
                     self._g_load.remove(router=self.name,
                                         replica=r.name)
                     self._load_series.discard(r.name)
+                    # same trigger freezes its trace capture: an
+                    # engine-fault death never passes through
+                    # kill_replica, but its span trees (emitted by the
+                    # fault's cancel_all) must survive resurrection
+                    self._tracer.snapshot_replica(r.name)
                 continue
             ld = r.load()
             self._g_load.labels(router=self.name,
@@ -1169,6 +1449,9 @@ class FleetRouter:
                     "fleet_targets": self.admission.fleet_targets}),
                 "supervisor": (self.supervisor.stats()
                                if self.supervisor is not None else None),
+                "trace": dict(self._tracer.stats(),
+                              sample_mode=self._trace_mode[0],
+                              sample_rate=self._trace_mode[1]),
                 "popularity_digest": self._digest.stats(),
                 "poison_threshold": self.poison_threshold,
                 "replicas": reps, **counts}
@@ -1202,7 +1485,8 @@ class FleetRouter:
         self._exporter = _serve(
             port=port, host=host or "127.0.0.1",
             registry=FleetRegistryView(_fleet_stats),
-            slo_fn=_slo, health_fn=self.health)
+            slo_fn=_slo, health_fn=self.health,
+            trace_fn=self._tracer.completed_payload)
         return self._exporter
 
     def close(self, drain=True, timeout=60):
@@ -1253,6 +1537,8 @@ class FleetRouter:
                 r.kill()    # fail in-flight now; the event drain below
                 #             routes their failovers into _fail (closed)
         self._drain_events()
+        self._tracer.stop()     # captures stay mergeable after close —
+        #                         dump_trace() still works for postmortems
         if self._exporter is not None:
             self._exporter.close()
             self._exporter = None

@@ -32,6 +32,7 @@ the watcher exit cleanly before its cycle budget (do this before
 anything else needs the tunnel — two concurrent axon inits wedge it).
 """
 
+import glob
 import json
 import os
 import subprocess
@@ -74,9 +75,21 @@ def _xla_flags_with_device_count(n):
             + f" --xla_force_host_platform_device_count={int(n)}").strip()
 
 
+# artifacts run_step actually wrote this process (artifact -> (step
+# name, good_marker)): the authoritative record suite_summary unions
+# with the static SUITE_STEPS table, so a step added to run_suite but
+# not registered there still surfaces in the status line the first
+# time it runs — whatever its artifact is named (json or txt; the
+# marker rides along so a text artifact's status is judged the same
+# way the ladder's skip logic judges it)
+_OBSERVED_STEPS = {}
+
+
 def run_step(name, cmd, env=None, timeout_s=3600, stdout_path=None,
              good_marker=None):
     """Run one suite step in a subprocess; archive stdout; never raise."""
+    if stdout_path is not None:
+        _OBSERVED_STEPS[stdout_path] = (name, good_marker)
     log(f"step {name}: {' '.join(cmd)}")
     full_env = dict(os.environ)
     if env:
@@ -124,6 +137,24 @@ def run_step(name, cmd, env=None, timeout_s=3600, stdout_path=None,
     return rc
 
 
+def _load_artifact(stdout_path):
+    """One read+parse per artifact: (text, last-line JSON dict) —
+    (None, None) when the file is absent, (text, None) when the last
+    line is not a JSON object. The ONLY artifact parser: both the
+    ladder's skip-step verdict (_artifact_ok) and the summary line
+    (_step_status) build on it, so they cannot drift."""
+    try:
+        with open(os.path.join(PERF, stdout_path)) as f:
+            text = f.read()
+    except OSError:
+        return None, None
+    try:
+        d = json.loads(text.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        d = None
+    return text, d if isinstance(d, dict) else None
+
+
 def _artifact_ok(stdout_path, good_marker=None):
     """True if a prior cycle already landed a GOOD artifact at this
     path — retry cycles skip those steps and never overwrite them with
@@ -131,15 +162,88 @@ def _artifact_ok(stdout_path, good_marker=None):
     "failed"; text artifacts (tune_flash/tpu_tier) need an explicit
     `good_marker` substring, since any non-empty text would otherwise
     read as success."""
-    try:
-        with open(os.path.join(PERF, stdout_path)) as f:
-            text = f.read()
-        if good_marker is not None:
-            return good_marker in text
-        d = json.loads(text.strip().splitlines()[-1])
-        return not d.get("failed", False)
-    except (OSError, ValueError, IndexError, AttributeError):
+    text, d = _load_artifact(stdout_path)
+    if text is None:
         return False
+    if good_marker is not None:
+        return good_marker in text
+    return d is not None and not d.get("failed", False)
+
+
+# every ladder step with its evidence artifact (and, for text
+# artifacts, the marker that distinguishes success from archived
+# failure output) — the one-line status summary walks this table
+SUITE_STEPS = (
+    ("tiny", "bench_tiny.json", None),
+    ("metrics_sample", "metrics_sample.json", None),
+    ("async_compare", "bench_async.json", None),
+    ("guard_compare", "bench_guard.json", None),
+    ("serving_compare", "bench_serving.json", None),
+    ("telemetry_compare", "bench_telemetry.json", None),
+    ("prefix_compare", "bench_prefix.json", None),
+    ("quant_compare", "bench_quant.json", None),
+    ("fleet_compare", "bench_fleet.json", None),
+    ("chaos_recovery", "bench_chaos.json", None),
+    ("trace_compare", "bench_trace.json", None),
+    ("compile_sample", "compile_sample.json", None),
+    ("ernie", "bench_ernie.json", None),
+    ("packed", "bench_packed.json", None),
+    ("resnet", "bench_resnet.json", None),
+    ("transformer", "bench_transformer.json", None),
+    ("deepfm", "bench_deepfm.json", None),
+    ("gpt", "bench_gpt.json", None),
+    ("gpt_decode", "bench_gpt_decode.json", None),
+    ("gpt_prefill", "bench_gpt_prefill.json", None),
+    ("tune_flash", "tune_flash.txt", "best: "),
+    ("tpu_tier", "tpu_tier.txt", " passed"),
+    ("ernie_full", "bench_ernie_full.json", None),
+)
+
+
+def _step_status(artifact, good_marker=None):
+    """One word per step: ok/degraded (+ backend) from a good artifact,
+    failed(rc=N) from a recorded failure, skipped when the step never
+    landed evidence — decoration over the same single parse
+    (_load_artifact) whose verdict drives the ladder's skip-step
+    logic, so the summary can never disagree with what the watcher
+    would rerun."""
+    text, d = _load_artifact(artifact)
+    if text is None:
+        return "skipped"
+    if good_marker is not None:
+        return "ok" if good_marker in text else "failed"
+    if d is None or d.get("failed"):
+        rc = (d or {}).get("rc")
+        return f"failed(rc={rc})" if rc is not None else "failed"
+    backend = d.get("device_kind") or ""
+    if d.get("degraded"):
+        return f"degraded({backend or 'cpu-fallback'})"
+    return f"ok({backend})" if backend else "ok"
+
+
+def suite_summary(to_file=True):
+    """ONE log line over the whole ladder — the standing state of every
+    step's evidence (ok/degraded/skipped/failed + backend) at a
+    glance, instead of buried in per-file caveats (the BENCH_r01–r05
+    rc=2 wedged-TPU era made this table hard-won knowledge)."""
+    parts = [f"{name}={_step_status(a, m)}" for name, a, m in SUITE_STEPS]
+    # drift guard: steps/artifacts SUITE_STEPS does not know about
+    # still surface (a step added to run_suite but not registered here
+    # must not silently vanish from the summary — that would be the
+    # exact buried-state failure this line fixes). Two sources: every
+    # artifact run_step wrote THIS process (any name, json or txt),
+    # plus the bench_*.json namespace on disk for standing state from
+    # prior cycles.
+    known = {a for _n, a, _m in SUITE_STEPS}
+    for art in sorted(set(_OBSERVED_STEPS) - known):
+        sname, marker = _OBSERVED_STEPS[art]
+        parts.append(f"{sname}={_step_status(art, marker)} "
+                     f"[unregistered]")
+    for path in sorted(glob.glob(os.path.join(PERF, "bench_*.json"))):
+        art = os.path.basename(path)
+        if art not in known and art not in _OBSERVED_STEPS:
+            parts.append(f"{art}={_step_status(art)} [unregistered]")
+    log("suite status: " + " ".join(parts), to_file=to_file)
 
 
 def _tunnel_still_ok(after_step):
@@ -298,6 +402,18 @@ def run_suite():
                  env={"JAX_PLATFORMS": "cpu",
                       "BENCH_CHAOS_RECOVERY": "1"},
                  timeout_s=900, stdout_path="bench_chaos.json")
+    # 1f5. fleet-trace comparison (ISSUE 15): fleet-wide distributed
+    #     tracing on-vs-off through the same mixed-length 2-replica
+    #     stream (ids pinned bitwise across modes), on the CPU backend
+    #     (deterministic; acceptance bar: overhead < 5%)
+    if _artifact_ok("bench_trace.json"):
+        log("step trace_compare: already landed in a prior cycle — "
+            "skipping")
+    else:
+        run_step("trace_compare", [py, bench],
+                 env={"JAX_PLATFORMS": "cpu",
+                      "BENCH_TRACE_COMPARE": "1"},
+                 timeout_s=900, stdout_path="bench_trace.json")
     # 1g. compile-observatory sample (ISSUE 8): Executor.explain()
     #     report + provoked recompile storm + HBM-ledger snapshot +
     #     detector on-vs-off overhead, on the CPU backend
@@ -409,8 +525,15 @@ def commit_perf(msg):
 
 def main():
     os.makedirs(PERF, exist_ok=True)
+    if "--summary" in sys.argv:
+        # operator shortcut: print the standing per-step status line
+        # and exit (no probe, no suite, no log write) — `python
+        # tools/bench_watch.py --summary`
+        suite_summary(to_file=False)
+        return 0
     log(f"watcher start (interval {INTERVAL_S}s, max {MAX_CYCLES} cycles, "
         f"probe timeout {PROBE_TIMEOUT_S}s)")
+    suite_summary()     # the standing state before any new attempt
     for cycle in range(1, MAX_CYCLES + 1):
         if os.path.exists(STOP):
             log("stop file present — exiting")
@@ -433,6 +556,7 @@ def main():
             continue
         log(f"cycle {cycle}: TUNNEL OK ({dev}) — running perf suite")
         complete = run_suite()
+        suite_summary()     # one line: what this window landed
         commit_perf("Archive TPU bench artifacts from hardware window"
                     if complete else
                     "Archive partial TPU bench artifacts (window died "

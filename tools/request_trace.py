@@ -1,0 +1,329 @@
+"""Request-lineage debugger: reconstruct one request's end-to-end
+fleet timeline from a merged fleet trace dump (+ flight rings).
+
+A fleet request's story spans processes: routed on the fleet track,
+span trees on every replica it visited (prefill chunks, decode,
+cancel/retire), failover re-admissions, KV handoffs, and — for a
+poison request — the quarantine verdict. ``FleetRouter.dump_trace()``
+merges all of it into one Perfetto JSON keyed by ``trace_id``
+(docs/observability.md "Fleet tracing"); this tool flattens that dump
+back into a single chronological lineage for one rid:
+
+    python tools/request_trace.py DUMP.json --rid 7
+    python tools/request_trace.py DUMP.json --trace-id 1aafb48d9f5046ed
+    python tools/request_trace.py DUMP.json --rid 7 --flight FLIGHT_DIR
+    python tools/request_trace.py --demo [--out-dir DIR]
+
+``--flight`` additionally scans ``flight-*.json`` dumps (the router's
+fleet ring and engine postmortems) for entries naming the rid — the
+quarantine artifact's lineage prints beside the trace rows.
+
+``--demo`` runs a supervised 3-replica fleet through a kill + poison
+storm with tracing on, writes the merged dump, and reconstructs both
+the quarantined request's lineage (ending in the quarantine verdict)
+and a failed-over innocent's (spans chaining across two replicas) —
+the zero-to-lineage smoke path.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# fleet-track lifecycle instants that contextualize ANY request's
+# timeline even without a trace_id of their own (a kill explains the
+# failover that follows it)
+LIFECYCLE_KINDS = ("replica_kill", "hung_replica", "chaos_hang",
+                   "resurrection", "crash_loop", "replica_evicted",
+                   "quarantine", "preempt_drain")
+
+
+def load_dump(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def process_names(dump):
+    """pid -> process label ("fleet router fleet0", "replica r1", ...)."""
+    out = {}
+    for e in dump.get("traceEvents", ()):
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            out[e["pid"]] = e.get("args", {}).get("name", str(e["pid"]))
+    return out
+
+
+def find_trace_id(dump, rid):
+    """The trace id the router minted for ROUTER rid `rid` (from the
+    fleet track's route instants / request spans), or None."""
+    for e in dump.get("traceEvents", ()):
+        args = e.get("args") or {}
+        if e.get("cat") == "serving.fleet" and args.get("rid") == rid \
+                and args.get("trace_id"):
+            return args["trace_id"]
+    return None
+
+
+def build_timeline(dump, trace_id):
+    """-> chronological rows for one trace: every event carrying the
+    trace id (fleet instants, per-replica span trees) plus fleet
+    lifecycle instants (kills, resurrections, the quarantine) that
+    frame them. Row: {ts_ms, end_ms, source, hop, name, detail}."""
+    pnames = process_names(dump)
+    rows = []
+    for e in dump.get("traceEvents", ()):
+        if e.get("ph") == "M":
+            continue
+        args = e.get("args") or {}
+        is_mine = args.get("trace_id") == trace_id
+        # keep the trace's own events, plus trace-id-less fleet
+        # lifecycle instants as framing context (a kill explains the
+        # failover that follows it); lifecycle events carrying a
+        # DIFFERENT trace id belong to another request's story
+        is_ctx = (e.get("cat") == "serving.fleet"
+                  and e.get("name") in LIFECYCLE_KINDS
+                  and args.get("trace_id") is None)
+        if not (is_mine or is_ctx):
+            continue
+        ts = e.get("ts", 0.0) / 1e3
+        dur = e.get("dur")
+        rows.append({
+            "ts_ms": round(ts, 3),
+            "end_ms": (round(ts + dur / 1e3, 3)
+                       if dur is not None else None),
+            "source": pnames.get(e.get("pid"), str(e.get("pid"))),
+            "hop": args.get("hop"),
+            "name": e.get("name"),
+            "context": is_ctx,
+            "detail": _detail(e.get("name"), args),
+        })
+    rows.sort(key=lambda r: (r["ts_ms"],
+                             r["hop"] if r["hop"] is not None else -1))
+    return rows
+
+
+def _detail(name, args):
+    """One human line of the args that matter per event kind."""
+    if name == "route":
+        return (f"-> {args.get('replica')} policy={args.get('policy')} "
+                f"phase={args.get('phase')} "
+                f"affinity_depth={args.get('affinity_depth')}")
+    if name == "failover":
+        return (f"{args.get('source')} -> {args.get('target')} "
+                f"cause={args.get('cause')} attempt={args.get('attempt')}")
+    if name == "kv_handoff":
+        return (f"{args.get('source')} -> {args.get('target')} "
+                f"blocks={args.get('blocks')} bytes={args.get('bytes')}")
+    if name == "shed":
+        return (f"scope={args.get('scope')} burn={args.get('burn_rate')} "
+                f"retry_after_ms={args.get('retry_after_ms')}")
+    if name == "quarantine":
+        deaths = sum(1 for d in (args.get("lineage") or ())
+                     if d.get("implicated"))
+        return (f"rid={args.get('rid')} implicated_deaths={deaths} "
+                f"attempts={args.get('attempts')}")
+    if name == "prefill.chunk":
+        return f"tokens={args.get('tokens')} iter={args.get('iteration')}"
+    if name == "decode":
+        return f"tokens={args.get('tokens')}"
+    if name.startswith("request"):
+        return (f"outcome={args.get('outcome')} "
+                f"reason={args.get('finish_reason') or args.get('reason')} "
+                f"generated={args.get('generated')}")
+    if name in LIFECYCLE_KINDS:
+        return " ".join(f"{k}={v}" for k, v in sorted(args.items())
+                        if k not in ("lineage",))
+    return ""
+
+
+def flight_entries_for_rid(flight_dir, rid):
+    """Scan flight-*.json under `flight_dir` for fleet-ring entries /
+    quarantine extras naming router rid `rid`."""
+    hits = []
+    for path in sorted(glob.glob(os.path.join(flight_dir,
+                                              "flight-*.json"))):
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if d.get("extra", {}).get("rid") == rid:
+            hits.append((path, {"reason": d.get("reason"),
+                                "extra": d.get("extra")}))
+            continue
+        for e in d.get("entries", ()):
+            if e.get("rid") == rid:
+                hits.append((path, e))
+    return hits
+
+
+def print_timeline(rows, trace_id, rid=None, file=None):
+    file = file if file is not None else sys.stdout
+    head = f"lineage of trace {trace_id}"
+    if rid is not None:
+        head += f" (router rid {rid})"
+    print(head, file=file)
+    print("-" * max(len(head), 72), file=file)
+    for r in rows:
+        span = (f"{r['ts_ms']:>12.3f}ms"
+                if r["end_ms"] is None else
+                f"{r['ts_ms']:>12.3f}ms..{r['end_ms']:.3f}ms")
+        hop = f" hop={r['hop']}" if r["hop"] is not None else ""
+        ctx = " [fleet context]" if r.get("context") else ""
+        print(f"{span}  {r['source']:<28} {r['name']:<16}{hop} "
+              f"{r['detail']}{ctx}", file=file)
+    if not rows:
+        print("(no events — was the capture started, and the request "
+              "sampled?)", file=file)
+
+
+# ---------------------------------------------------------------------------
+# --demo: kill + poison storm over a traced supervised fleet
+# ---------------------------------------------------------------------------
+
+def run_demo(out_dir):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.core import framework
+    from paddle_tpu.core.executor import Scope, scope_guard
+    from paddle_tpu.models import gpt
+    from paddle_tpu.robustness import (ChaosInjector, PoisonRequestError,
+                                       SupervisorConfig)
+    from paddle_tpu.serving import (FleetRouter, GenerationServer,
+                                    GPTServingModel)
+
+    os.makedirs(out_dir, exist_ok=True)
+    flight_dir = os.path.join(out_dir, "flight")
+
+    cfg = gpt.gpt_tiny()
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 11
+    with framework.program_guard(main, startup):
+        gpt.build_lm_net(cfg, seq_len=8)
+    scope = Scope()
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+        params = gpt.load_params(scope, cfg)
+
+    rng = np.random.default_rng(0)
+    good = [rng.integers(3, cfg.vocab_size,
+                         int(rng.integers(9, 18))).astype(np.int32)
+            for _ in range(5)]
+    poison = rng.integers(3, cfg.vocab_size, 12).astype(np.int32)
+    chaos = (ChaosInjector().kill_replica_at(3, 0)
+             .poison_prompt(poison))
+
+    def spawn(_index):
+        return GenerationServer(
+            GPTServingModel(params, cfg), num_slots=2, block_size=8,
+            max_context=64, chunk=4, start=False, prefix_cache=True,
+            chaos=chaos, flight_dir=flight_dir)
+
+    router = FleetRouter(
+        [spawn(i) for i in range(3)], start=False, chaos=chaos,
+        spawn_fn=spawn, flight_dir=flight_dir, trace=True,
+        supervisor=SupervisorConfig(backoff_heartbeats=1,
+                                    warm_chains=2))
+    futs = [router.submit(p, max_new_tokens=5) for p in good[:3]]
+    router.step()
+    pfut = router.submit(poison, max_new_tokens=5)
+    for p in good[3:]:
+        futs.append(router.submit(p, max_new_tokens=5))
+        router.step()
+    router.run_until_idle()
+    quarantined = False
+    try:
+        pfut.result(timeout=5)
+    except PoisonRequestError:
+        quarantined = True
+    for f in futs:
+        f.result(timeout=5)
+
+    dump_path = os.path.join(out_dir, "fleet_trace_demo.json")
+    dump = router.dump_trace(dump_path)
+    prid = pfut.request_id
+    # an innocent that actually failed over (rode a dying replica)
+    moved = [t for t in router._tracer.completed_payload()["traces"]
+             if t["attempts"] > 0 and t["rid"] != prid]
+    router.close()
+
+    print(f"demo dump: {dump_path} "
+          f"({len(dump['traceEvents'])} events, "
+          f"{len(dump['otherData']['sources'])} process groups, "
+          f"truncated={dump['otherData']['truncated']})")
+    tid = find_trace_id(dump, prid)
+    rows = print_demo_lineage(dump, tid, prid, "poison request")
+    assert quarantined, "demo poison request was not quarantined"
+    assert any(r["name"] == "quarantine" for r in rows), \
+        "quarantine verdict missing from the reconstructed lineage"
+    assert len({r["hop"] for r in rows
+                if r["hop"] is not None and r["name"] == "route"}) >= 2, \
+        "poison lineage should span at least two hops"
+    for t, label in [(m, "failed-over innocent") for m in moved[:1]]:
+        print_demo_lineage(dump, t["trace_id"], t["rid"], label)
+    print(f"flight artifacts for rid {prid}:")
+    for path, entry in flight_entries_for_rid(flight_dir, prid):
+        print(f"  {path}: {entry.get('reason') or entry.get('kind')}")
+    return dump_path
+
+
+def print_demo_lineage(dump, trace_id, rid, label):
+    print(f"\n== {label} ==")
+    rows = build_timeline(dump, trace_id)
+    print_timeline(rows, trace_id, rid=rid)
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="reconstruct one request's end-to-end fleet "
+                    "timeline from a merged fleet trace dump")
+    ap.add_argument("dump", nargs="?",
+                    help="merged Perfetto JSON from "
+                         "FleetRouter.dump_trace()")
+    ap.add_argument("--rid", type=int, help="router request id")
+    ap.add_argument("--trace-id", help="fleet trace id (hex)")
+    ap.add_argument("--flight",
+                    help="directory of flight-*.json dumps to scan "
+                         "for the rid")
+    ap.add_argument("--demo", action="store_true",
+                    help="run a traced kill+poison fleet storm, dump "
+                         "it, and reconstruct two lineages")
+    ap.add_argument("--out-dir", default="/tmp/paddle_tpu_fleet_trace",
+                    help="--demo output directory")
+    args = ap.parse_args(argv)
+
+    if args.demo:
+        run_demo(args.out_dir)
+        return 0
+    if not args.dump:
+        ap.error("pass a dump file (or --demo)")
+    dump = load_dump(args.dump)
+    trace_id = args.trace_id
+    if trace_id is None:
+        if args.rid is None:
+            ap.error("pass --rid or --trace-id")
+        trace_id = find_trace_id(dump, args.rid)
+        if trace_id is None:
+            print(f"no trace for rid {args.rid} in {args.dump} (was "
+                  f"the request sampled?)", file=sys.stderr)
+            return 1
+    rows = build_timeline(dump, trace_id)
+    print_timeline(rows, trace_id, rid=args.rid)
+    if args.flight and args.rid is not None:
+        print(f"\nflight artifacts for rid {args.rid}:")
+        hits = flight_entries_for_rid(args.flight, args.rid)
+        for path, entry in hits:
+            print(f"  {path}: "
+                  f"{json.dumps(entry, sort_keys=True, default=repr)}")
+        if not hits:
+            print("  (none)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

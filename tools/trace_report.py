@@ -268,6 +268,17 @@ def print_serving_summary(metrics, file=None):
         print(f"serving: fleet-health hangs={hangs} "
               f"resurrections={resur} crash_loops={loops} "
               f"quarantines={quar}", file=file)
+    # fleet-wide distributed tracing (ISSUE 15): sampled contexts
+    # minted, completed traces in the /trace ring, merged dumps, and
+    # ring drops (a nonzero drop count means captures were partial)
+    tr_req = _counter_total(metrics, "serving.fleet.trace.requests")
+    tr_done = _counter_total(metrics, "serving.fleet.trace.completed")
+    tr_dumps = _counter_total(metrics, "serving.fleet.trace.dumps")
+    if tr_req or tr_done or tr_dumps:
+        dropped = _counter_total(metrics, "tracing.dropped_events")
+        print(f"serving: fleet-trace requests={tr_req} "
+              f"completed={tr_done} dumps={tr_dumps} "
+              f"dropped_events={dropped}", file=file)
     quant = metrics.get("serving.slo.quantile_ms")
     if windows and quant:
         # key on (server, metric): two live GenerationServers publish
@@ -441,10 +452,14 @@ def run_demo(out_dir):
     freps = [_spawn(i) for i in range(2)]
     # self-healing demo (ISSUE 13): a chaos kill mid-stream, caught by
     # the supervisor — the replica resurrects (probe + prefix re-warm)
-    # and the fleet-health counters land in the committed sample
+    # and the fleet-health counters land in the committed sample.
+    # Fleet tracing on (ISSUE 15): every request rides one trace id
+    # across the kill's failover, and the merged dump (fleet track +
+    # both replica captures incl. the victim's death snapshot) is
+    # produced so serving.fleet.trace.* series land in the sample too
     fchaos = ChaosInjector().kill_replica_at(3, 0)
     frouter = FleetRouter(freps, start=False, chaos=fchaos,
-                          spawn_fn=_spawn,
+                          spawn_fn=_spawn, trace=True,
                           supervisor=SupervisorConfig(
                               backoff_heartbeats=1, warm_chains=2))
     fprompts = [np.arange(3 + i, 19 + i, dtype=np.int32)
@@ -455,6 +470,8 @@ def run_demo(out_dir):
     frouter.run_until_idle()
     for f in waves:
         f.result(timeout=5)
+    ftrace = frouter.dump_trace()
+    assert len(ftrace["otherData"]["sources"]) >= 3     # fleet + 2 reps
     fleet_stats = frouter.get_stats()
     assert fleet_stats["live_replicas"] == 2    # healed after the kill
     frouter.close()
