@@ -1583,6 +1583,225 @@ def run_quant_compare(kind):
     return 0
 
 
+def run_kernel_v2_compare(kind):
+    """BENCH_KERNEL_V2_COMPARE=1: paged-attention kernel v2 (ISSUE 16)
+    — the double-buffered streaming walk vs v1's full-table gather vs
+    the pure-JAX reference, plus the GQA capacity section, one JSON
+    line (perf/bench_kernel_v2.json).
+
+    Three sections:
+    (1) generations — the SAME trained model served three times with
+        PADDLE_TPU_PAGED_KERNEL pinned to v2 / v1 / 0: token ids must
+        be identical across all three (v2's online softmax is exact up
+        to fp reduction order; greedy argmax on a trained model is
+        decisive), tokens/s via order-alternating best-of rounds (the
+        BENCH_GUARD_COMPARE pattern);
+    (2) GQA capacity — a grouped-query pool (H_kv = H/2 via
+        gqa_slice_kv_params) against the MHA pool under the SAME HBM
+        budget: ~2x the blocks fit, and a storm of identical requests
+        ADMITS ~2x the concurrent lanes (block arithmetic made
+        observable, the backend-independent win — it compounds with
+        int8's factor from bench_quant);
+    (3) GQA fidelity — the GQA stream's ids vs the repeat-KV MHA
+        server's, bitwise (the param-helper round trip is exact).
+
+    The honest CPU caveat: under the Pallas interpreter the streamed
+    DMAs execute serially, so v2's HBM-latency-hiding does not show —
+    numerics and ids are the point here; the VMEM claim (O(2-block)
+    scratch vs v1's O(M)) is structural and TPU-true by construction.
+    Never raises — failures are recorded, not fatal."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.core import framework
+    from paddle_tpu.core.executor import Scope, scope_guard
+    from paddle_tpu.models import gpt
+    from paddle_tpu.serving import GenerationServer, GPTServingModel
+
+    n_req = int(os.environ.get("BENCH_KV2_REQUESTS", 16))
+    rounds = max(2, int(os.environ.get("BENCH_KV2_ROUNDS", 2)))
+    dense_blocks = int(os.environ.get("BENCH_KV2_DENSE_BLOCKS", 25))
+    block_size, chunk, max_context = 8, 4, 96
+
+    # 4 heads so GQA has a real group factor (H_kv=2, g=2); trained to
+    # a decisive greedy argmax (run_quant_compare's corpus idiom)
+    cfg = gpt.GPTConfig(vocab_size=256, hidden_size=128, num_layers=3,
+                        num_heads=4, inner_size=512, max_position=128,
+                        dropout=0.0)
+    corpus = np.stack([(np.arange(16) * s + o) % 253 + 3
+                       for s, o in [(1, 0), (3, 40), (5, 90),
+                                    (7, 160)]]).astype(np.int32)
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 7
+    with framework.program_guard(main, startup):
+        _tokens, loss, _ = gpt.build_lm_net(cfg, seq_len=16)
+        fluid.optimizer.AdamOptimizer(learning_rate=1e-2).minimize(loss)
+    scope = Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    train_steps = int(os.environ.get("BENCH_KV2_TRAIN_STEPS", 100))
+    with scope_guard(scope):
+        exe.run(startup)
+        for _ in range(train_steps):
+            exe.run(main, feed={"tokens": corpus}, fetch_list=[loss])
+        final_loss = float(np.asarray(exe.run(
+            main, feed={"tokens": corpus}, fetch_list=[loss])[0]))
+        params = gpt.load_params(scope, cfg)
+
+    rng = np.random.default_rng(5)
+    reqs = []
+    for _ in range(n_req):
+        row = corpus[int(rng.integers(len(corpus)))]
+        reqs.append((row[:int(rng.integers(9, 15))].astype(np.int32),
+                     int(rng.integers(6, 21))))
+    total_gen = sum(g for _p, g in reqs)
+
+    def build(p, c, num_slots=4, num_blocks=None):
+        kw = dict(num_slots=num_slots, block_size=block_size,
+                  max_context=max_context, chunk=chunk, start=False)
+        if num_blocks is not None:
+            kw["num_blocks"] = int(num_blocks)
+        return GenerationServer(GPTServingModel(p, c), **kw)
+
+    def run(srv):
+        futs = [srv.submit(p, max_new_tokens=g) for p, g in reqs]
+        srv.run_until_idle()
+        return [list(f.result(timeout=10).token_ids) for f in futs]
+
+    saved_env = {k: os.environ.get(k) for k in
+                 ("PADDLE_TPU_PAGED_KERNEL",
+                  "PADDLE_TPU_PAGED_V2_AUTO_BYTES")}
+    try:
+        # (1) generations: mode is latched at TRACE time, so pin the
+        # env BEFORE each server's warm-up run, then time freely
+        servers, ids, mode_of = {}, {}, {"v2": "v2", "v1": "v1",
+                                        "reference": "0"}
+        for tag, env in mode_of.items():
+            os.environ["PADDLE_TPU_PAGED_KERNEL"] = env
+            srv = build(params, cfg)
+            ids[tag] = run(srv)         # warm compile under the pin
+            servers[tag] = srv
+        assert ids["v2"] == ids["v1"] == ids["reference"], \
+            "kernel generations disagree on greedy ids"
+        best = {tag: float("inf") for tag in servers}
+        for r in range(rounds):
+            order = list(servers.items())
+            if r % 2:
+                order.reverse()
+            for tag, srv in order:
+                t0 = time.perf_counter()
+                run(srv)
+                best[tag] = min(best[tag],
+                                time.perf_counter() - t0)
+        v2_stats = servers["v2"].get_stats()["kernel"]
+        v1_stats = servers["v1"].get_stats()["kernel"]
+        for srv in servers.values():
+            srv.close()
+
+        # (2) GQA capacity at the same HBM budget
+        from paddle_tpu.serving import PagedKVCache
+        kv = cfg.num_heads // 2
+        gqa_params = gpt.gqa_slice_kv_params(params, cfg, kv)
+        gqa_cfg = gpt.GPTConfig(
+            vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+            num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+            inner_size=cfg.inner_size, max_position=cfg.max_position,
+            dropout=0.0, kv_heads=kv)
+        head_dim = cfg.hidden_size // cfg.num_heads
+
+        def pool_bytes_for(nb, kv_heads):
+            return PagedKVCache(cfg.num_layers, cfg.num_heads,
+                                head_dim, nb, block_size=block_size,
+                                num_kv_heads=kv_heads).pool_bytes()
+
+        budget = pool_bytes_for(dense_blocks + 1, cfg.num_heads)
+        per_block_gqa = pool_bytes_for(2, kv) // 2
+        nb_gqa = budget // per_block_gqa
+        storm_prompt = np.arange(3, 19, dtype=np.int32)
+
+        def admitted(p, c, nb):
+            os.environ["PADDLE_TPU_PAGED_KERNEL"] = "auto"
+            srv = build(p, c, num_slots=64, num_blocks=nb)
+            for _ in range(40):
+                srv.submit(storm_prompt, max_new_tokens=15)
+            srv.step()
+            got = srv.get_stats()["active_slots"]
+            pool_bytes = srv.cache.pool_bytes()
+            srv.close(drain=False)
+            return got, pool_bytes
+
+        mha_admit, mha_bytes = admitted(params, cfg, dense_blocks + 1)
+        gqa_admit, gqa_bytes = admitted(gqa_params, gqa_cfg, nb_gqa)
+
+        # (3) GQA fidelity: ids bitwise vs the repeat-KV MHA server
+        os.environ["PADDLE_TPU_PAGED_KERNEL"] = "auto"
+        rep_params = gpt.gqa_repeat_kv_params(gqa_params, cfg, kv)
+        srv_g = build(gqa_params, gqa_cfg)
+        srv_r = build(rep_params, cfg)
+        ids_g, ids_r = run(srv_g), run(srv_r)
+        gqa_kernel = srv_g.get_stats()["kernel"]
+        srv_g.close()
+        srv_r.close()
+
+        result = {
+            "metric": "serving_gqa_admitted_concurrency_ratio",
+            "value": round(gqa_admit / max(mha_admit, 1), 3),
+            "unit": "x (concurrent requests admitted, H_kv=H/2 over "
+                    "MHA, same HBM budget)",
+            "hbm_budget_bytes": int(budget),
+            "mha_blocks": int(dense_blocks + 1),
+            "gqa_blocks": int(nb_gqa),
+            "block_capacity_ratio": round(nb_gqa / (dense_blocks + 1),
+                                          3),
+            "mha_admitted": int(mha_admit),
+            "gqa_admitted": int(gqa_admit),
+            "mha_pool_bytes": int(mha_bytes),
+            "gqa_pool_bytes": int(gqa_bytes),
+            "gqa_ids_bitwise_vs_repeat_kv": ids_g == ids_r,
+            "gqa_kernel_engaged": gqa_kernel["engaged"],
+            "train_steps": train_steps,
+            "train_loss_final": round(final_loss, 6),
+            "requests": n_req,
+            "generated_tokens": total_gen,
+            "generations_ids_identical": True,
+            "v2_tokens_per_sec": round(total_gen / best["v2"], 2),
+            "v1_tokens_per_sec": round(total_gen / best["v1"], 2),
+            "reference_tokens_per_sec": round(
+                total_gen / best["reference"], 2),
+            "v2_step_ms_best": round(best["v2"] * 1000, 2),
+            "v1_step_ms_best": round(best["v1"] * 1000, 2),
+            "reference_step_ms_best": round(
+                best["reference"] * 1000, 2),
+            "v2_version_reported": v2_stats["version"],
+            "v1_version_reported": v1_stats["version"],
+            "kv_heads": kv, "q_heads": cfg.num_heads,
+            "head_dim": head_dim,
+            "slots": 4, "chunk": chunk, "block_size": block_size,
+            "caveat": "CPU Pallas interpreter executes the streamed "
+                      "DMAs serially, so v2's HBM-latency hiding does "
+                      "not show in tokens/s — ids/numerics are the "
+                      "bar here. The O(2-block)-vs-O(M) VMEM scratch "
+                      "gap is structural (white-box pinned) and the "
+                      "GQA admitted-concurrency ratio is backend-"
+                      "independent block arithmetic",
+        }
+    except Exception as e:      # noqa: BLE001 — evidence, not a gate
+        print(f"bench: kernel v2 compare FAILED ({e!r})",
+              file=sys.stderr)
+        print(json.dumps(_mark_degraded(
+            {"metric": "serving_gqa_admitted_concurrency_ratio",
+             "failed": True, "error": repr(e), "device_kind": kind})),
+            flush=True)
+        return 0
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    result["device_kind"] = kind
+    print(json.dumps(_mark_degraded(result)), flush=True)
+    return 0
+
+
 def run_prefix_compare(kind):
     """BENCH_PREFIX_COMPARE=1: prefix-cache block sharing on vs off
     (today's engine) over a MIXED-TENANT generation stream with 80%
@@ -2803,6 +3022,11 @@ def main():
         # int8-vs-dense quantized serving: same-HBM-budget admitted
         # concurrency, greedy exact-match rate, tokens/s (serving layer)
         return run_quant_compare(kind)
+
+    if os.environ.get("BENCH_KERNEL_V2_COMPARE") == "1":
+        # paged kernel v2 vs v1 vs reference + GQA capacity at the
+        # same HBM budget (serving layer)
+        return run_kernel_v2_compare(kind)
 
     if os.environ.get("BENCH_FLEET_COMPARE") == "1":
         # fleet router: affinity-vs-random routing hit rate + p99 TTFT

@@ -29,6 +29,11 @@ class GPTConfig:
     hidden_size = 768
     num_layers = 12
     num_heads = 12
+    # grouped-query attention (serving tier): kv_heads < num_heads
+    # shares each KV head across a group of num_heads/kv_heads query
+    # heads; None means MHA. Only the fused serving step and the paged
+    # KV pools consume this — the training graph stays full MHA.
+    kv_heads = None
     inner_size = 3072
     max_position = 1024
     dropout = 0.1
@@ -236,6 +241,72 @@ def _cast_params(params, dtype):
     return jax.tree_util.tree_map(
         lambda a: a.astype(dtype) if a.dtype == jnp.float32 else a,
         params)
+
+
+def gqa_slice_kv_params(params, cfg, kv_heads):
+    """Derive a grouped-query-attention parameter tree from a trained
+    MHA one: keep each query-head GROUP's first head's wk/wv columns
+    (and bk/bv rows), shrinking both projections to kv_heads * head_dim
+    outputs. Pair with ``GPTConfig(kv_heads=...)`` to serve the result.
+    This is the cheap-ablation GQA conversion (mean-pooling the group
+    is the published alternative) — tests and the bench use it because
+    composing with `gqa_repeat_kv_params` is an EXACT round trip: the
+    repeated tree projects bitwise-identical K/V to the sliced tree's
+    shared heads, which is what makes a repeat-KV dense server the
+    bitwise reference for a GQA paged server."""
+    h = cfg.num_heads
+    d = cfg.hidden_size // h
+    if kv_heads < 1 or h % kv_heads:
+        raise ValueError(
+            f"kv_heads={kv_heads} must divide num_heads={h}")
+    g = h // kv_heads
+
+    def slc_w(w):
+        return w.reshape(-1, kv_heads, g, d)[:, :, 0, :].reshape(
+            w.shape[0], kv_heads * d)
+
+    def slc_b(bvec):
+        return bvec.reshape(kv_heads, g, d)[:, 0, :].reshape(
+            kv_heads * d)
+
+    out = dict(params)
+    for i in range(cfg.num_layers):
+        lp = dict(out[f"l{i}"])
+        lp["wk"], lp["wv"] = slc_w(lp["wk"]), slc_w(lp["wv"])
+        lp["bk"], lp["bv"] = slc_b(lp["bk"]), slc_b(lp["bv"])
+        out[f"l{i}"] = lp
+    return out
+
+
+def gqa_repeat_kv_params(params, cfg, kv_heads):
+    """Inverse of `gqa_slice_kv_params`: expand a GQA tree (wk/wv with
+    kv_heads * head_dim outputs) back to full MHA width by repeating
+    each KV head's column block across its query-head group. The
+    expanded tree projects every query head's K/V bitwise-equal to its
+    group's shared KV head, so a plain MHA server over this tree is the
+    repeat-KV dense reference a GQA server must match id-for-id."""
+    h = cfg.num_heads
+    d = cfg.hidden_size // h
+    if kv_heads < 1 or h % kv_heads:
+        raise ValueError(
+            f"kv_heads={kv_heads} must divide num_heads={h}")
+    g = h // kv_heads
+
+    def rep_w(w):
+        return jnp.repeat(w.reshape(-1, kv_heads, d), g,
+                          axis=1).reshape(w.shape[0], h * d)
+
+    def rep_b(bvec):
+        return jnp.repeat(bvec.reshape(kv_heads, d), g,
+                          axis=0).reshape(h * d)
+
+    out = dict(params)
+    for i in range(cfg.num_layers):
+        lp = dict(out[f"l{i}"])
+        lp["wk"], lp["wv"] = rep_w(lp["wk"]), rep_w(lp["wv"])
+        lp["bk"], lp["bv"] = rep_b(lp["bk"]), rep_b(lp["bv"])
+        out[f"l{i}"] = lp
+    return out
 
 
 def _prefill_forward(lp_all, prompt_ids, cfg, max_len, h_count,

@@ -159,12 +159,19 @@ METRIC_SPECS = [
     ("serving.itl_ms", "histogram",
      "inter-token latency between consecutive generated tokens"),
     ("serving.kernel.traced", "counter",
-     "paged_attention dispatches that traced the Pallas ragged paged "
-     "attention kernel (one per layer per fused-step trace)"),
+     "paged_attention dispatches that traced a Pallas ragged paged "
+     "attention kernel (one per layer per fused-step trace; unlabeled "
+     "aggregate plus a version label: v1, v2)"),
     ("serving.kernel.fallback", "counter",
      "paged_attention dispatches that took the pure-JAX reference path "
-     "(unlabeled aggregate plus a reason label: pinned_off, "
-     "unsupported, vmap_trace, unsupported_under_shard_map)"),
+     "(unlabeled aggregate plus a reason label — pinned_off, "
+     "unsupported, vmap_trace, unsupported_under_shard_map — and a "
+     "version=reference label mirroring serving.kernel.traced's)"),
+    ("serving.kernel.version", "gauge",
+     "kernel generation the LAST paged_attention dispatch took: 1 = "
+     "v1 (gather-then-compute, bitwise-stable), 2 = v2 (double-"
+     "buffered block streaming + online softmax), 0 = reference "
+     "fallback"),
     ("serving.kernel.interpret", "gauge",
      "1 when the paged kernel runs under the Pallas interpreter "
      "(off-TPU), 0 when compiled for a real TPU"),

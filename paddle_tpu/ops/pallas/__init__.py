@@ -13,6 +13,7 @@ _FUNCTIONS = {
     "flash_attention_with_lse": "flash",
     "segment_mask_bias": "flash",
     "ragged_paged_attention": "paged",
+    "ragged_paged_attention_v2": "paged",
 }
 
 __all__ = list(_SUBMODULES) + list(_FUNCTIONS)

@@ -4,56 +4,63 @@ The pure-JAX reference (`serving/kv_cache.paged_attention_reference`)
 materializes a dense (B, H, M*bs, D) gather of every request's FULL
 block table on every fused step — each decode iteration pays
 O(max_blocks) HBM traffic per lane regardless of how many tokens the
-lane actually holds. This kernel (per the *Ragged Paged Attention* TPU
-paper, PAPERS.md) walks the block table INSIDE the kernel instead:
+lane actually holds. The kernels here (per the *Ragged Paged Attention*
+TPU paper, PAPERS.md) walk the block table INSIDE the kernel instead,
+in two generations:
 
-* the K/V pools stay in HBM (`memory_space=ANY`); per lane, a DMA loop
-  copies only the table's live blocks into VMEM scratch and STOPS past
-  the lane's highest live block — decode HBM traffic tracks each
-  request's true length, not the table width;
-* the block table and query positions ride scalar prefetch (SMEM), so
-  block indices are available for DMA address computation the way
-  jax's own paged-attention kernel does it;
+* **v1** (`ragged_paged_attention`): per lane, a DMA loop copies only
+  the table's live blocks into an (M, H_kv, bs, D) VMEM scratch and
+  STOPS past the lane's highest live block, then runs the reference's
+  exact op sequence on the VMEM-resident gather. f32 and int8 pools are
+  pinned BITWISE against the reference under jit in interpret mode —
+  the price is VMEM scratch proportional to the table width M.
+* **v2** (`ragged_paged_attention_v2`): a double-buffered
+  block-STREAMING walk. VMEM scratch holds O(2 blocks) of K/V —
+  independent of M, so context length is unbounded at fixed VMEM — and
+  each streamed block folds into a flash-style online-softmax
+  accumulator (running max, rescaled sum, rescaled PV partial). The
+  next block's `make_async_copy` is issued BEFORE the current block's
+  compute, so HBM latency hides behind the MXU work. Online softmax is
+  mathematically EXACT (every rescale is an identity in real
+  arithmetic) but reorders the floating-point reductions the reference
+  performs in one pass, so v2 is pinned allclose-at-f32-tightness plus
+  argmax-identical — v1 remains the bitwise-stable kernel and the
+  dispatcher's default for tables under the VMEM ceiling.
+
+Both kernels share the serving contract:
+
+* the K/V pools stay in HBM (`memory_space=ANY`); the block table and
+  query positions ride scalar prefetch (SMEM), so block indices are
+  available for DMA address computation the way jax's own
+  paged-attention kernel does it;
 * the NULL block (block 0 — table padding, masked-lane writes) is never
   read: padding entries and idle lanes contribute exactly nothing, even
-  if block 0 holds garbage (pinned by a NaN-poison test);
+  if block 0 holds garbage (pinned by NaN-poison tests);
 * chunked prefill (C > 1) and decode (C = 1) are ONE kernel — the
   engine's single fused-step signature survives unchanged;
-* bf16 pools are welcome: scores and softmax accumulate in f32 and the
-  probabilities are cast back to the value dtype before the PV
-  contraction, mirroring the reference spec (EQuARX-style
-  reduced-precision hot path with full-precision accumulation);
+* bf16 pools are welcome: scores and softmax accumulate in f32
+  (EQuARX-style reduced-precision hot path with full-precision
+  accumulation);
 * int8 pools (quantized serving, ISSUE 14) fuse the DEQUANT into the
-  gather: the DMA loop copies the int8 codes plus their (H, bs) f32
+  gather: the DMA loop copies the int8 codes plus their (H_kv, bs) f32
   scale rows — roughly HALF the bytes a bf16 pool moves per block —
-  and the dequant multiply happens on the VMEM-resident gather right
-  where the value path consumes it. The decode-side HBM read traffic
-  this kernel exists to bound halves again on top of the capacity win;
-  score/softmax stay f32 and the output lands in the query dtype (the
-  model's activation dtype), mirroring the reference's int8 branch op
-  for op so the bitwise pin extends to quantized pools.
+  and the dequant multiply happens on the VMEM-resident data right
+  where the value path consumes it;
+* grouped-query attention (ISSUE 16): pools may carry H_kv < H heads
+  (H % H_kv == 0). Query head j attends KV head j // (H/H_kv) — the
+  contiguous-group convention, so Megatron column-sharded projections
+  stay head-aligned. v1 repeats the gathered KV rows across each
+  group (a pure copy, so the bitwise pin extends to GQA); v2 batches
+  the einsums as (H_kv, group, ...) against the un-repeated blocks and
+  never materializes the repeat at all.
 
-Numerics are the reference's, op for op: after the gather loop the
-VMEM-resident blocks go through the SAME moveaxis/einsum/mask/softmax
-sequence the reference applies to its dense gathered view, so for f32
-pools the kernel is pinned BITWISE against the reference in interpret
-mode (tier-1, tests/ops/test_paged_kernel.py). The skipped tail of the
-scratch is zero-filled and masked to NEG_INF, which contributes exactly
-0 probability — identical partial sums, not just close ones. The price
-of that pin is that the in-VMEM compute stays fixed-width (softmax over
-the full M*bs row); the early stop bounds the HBM side, which is what
-dominates decode on TPU. bf16 pools get f32 accumulation instead of the
-reference's bf16 score math, so they are pinned allclose (documented
-tolerance), not bitwise.
+VMEM budget: v1 scratch holds one lane's full K+V working set,
+2 * M * bs * H_kv * D * itemsize — the full-KV-resident discipline of
+flash.py's default forward. v2 holds 2 * 2 * bs * H_kv * D * itemsize
+whatever M is; the dispatcher (serving/kv_cache.paged_attention) routes
+tables past the v1 ceiling to v2 automatically.
 
-VMEM budget: scratch holds one lane's full K+V working set,
-2 * M * bs * H * D * itemsize (e.g. 2048 ctx x 8 heads x 128 dim x bf16
-= 8 MB) — the same full-KV-resident discipline as flash.py's default
-forward. Streaming the block loop through double-buffered DMA windows
-(flash's kgrid analogue) is the documented follow-up for contexts past
-the VMEM ceiling.
-
-Off-TPU the kernel runs under the Pallas interpreter (same policy as
+Off-TPU the kernels run under the Pallas interpreter (same policy as
 flash.py) so the CPU suite exercises the real kernel code. All Pallas
 APIs used here (PrefetchScalarGridSpec, memory_space=ANY,
 make_async_copy, SemaphoreType.DMA) exist and interpret correctly on
@@ -73,11 +80,12 @@ NULL_BLOCK = 0          # mirrors serving.kv_cache.NULL_BLOCK
 NEG_INF = -1e9          # mirrors serving.kv_cache.NEG_INF (the masked
                         # score value the bitwise pin depends on)
 
-# Incremented each time the kernel is TRACED — the serving engine and
+# Incremented each time a kernel is TRACED — the serving engine and
 # bench assert the kernel path actually engaged instead of silently
 # falling back to the dense gather (flash.py's TRACE_COUNT /
-# VERDICT r1 weak #7 lesson).
+# VERDICT r1 weak #7 lesson). V2_TRACE_COUNT counts the v2 subset.
 TRACE_COUNT = 0
+V2_TRACE_COUNT = 0
 
 
 def _interpret():
@@ -87,8 +95,44 @@ def _interpret():
         return True
 
 
+def _validate_paged_args(q, k_pool, v_pool, block_table, q_positions,
+                         k_scale, v_scale):
+    """Shared v1/v2 operand validation. Returns
+    (b, h, c, d, n, hp, bs, m, quantized); `hp` is the pool (KV) head
+    count — equal to h for MHA, a divisor of h for GQA."""
+    b, h, c, d = q.shape
+    n, hp, bs, dp = k_pool.shape
+    if (dp != d or hp > h or h % hp != 0
+            or v_pool.shape != k_pool.shape):
+        raise ValueError(
+            f"pool shapes {k_pool.shape}/{v_pool.shape} do not match "
+            f"q {q.shape} (GQA needs q heads a multiple of pool heads)")
+    m = block_table.shape[1]
+    if block_table.shape[0] != b or q_positions.shape != (b, c):
+        raise ValueError(
+            f"table {block_table.shape} / positions {q_positions.shape} "
+            f"do not match q {q.shape}")
+    quantized = k_pool.dtype == jnp.int8
+    if quantized:
+        if k_scale is None or v_scale is None:
+            raise ValueError(
+                "int8 pools need k_scale/v_scale (N, H_kv, bs) f32 "
+                "scale pools — quantized KV is (codes, scales) pairs")
+        if (k_scale.shape != (n, hp, bs)
+                or v_scale.shape != (n, hp, bs)):
+            raise ValueError(
+                f"scale pools {k_scale.shape}/{v_scale.shape} do not "
+                f"match data pools {k_pool.shape} (want {(n, hp, bs)})")
+    elif k_scale is not None or v_scale is not None:
+        raise ValueError(
+            f"scale pools passed with non-int8 pools "
+            f"({k_pool.dtype}) — scales only mean something for "
+            f"quantized KV")
+    return b, h, c, d, n, hp, bs, m, quantized
+
+
 def _paged_kernel(tbl_ref, pos_ref, q_ref, k_pool_ref, v_pool_ref,
-                  *rest, bs, m, h, d, quantized=False):
+                  *rest, bs, m, h, hp, d, quantized=False):
     """One grid step = one request lane, all heads — dense AND int8
     pools share this walk (selected at trace time by `quantized`, so
     the early-stop arithmetic, the NULL guard, the zero-fill the
@@ -96,15 +140,14 @@ def _paged_kernel(tbl_ref, pos_ref, q_ref, k_pool_ref, v_pool_ref,
     once).
 
     tbl_ref (B, M) / pos_ref (B, C): scalar-prefetched SMEM.
-    q_ref (1, H, C, D) VMEM; k/v_pool_ref (N, H, bs, D) HBM (ANY).
-    gk/gv scratch (M, H, bs, D) VMEM in pool dtype — the lane's gathered
-    view, laid out exactly like the reference's `pool[table]` row so the
-    value-path math below can mirror it op for op. Quantized adds the
-    (N, H, bs) f32 scale pools in HBM and (M, H, bs) scale scratch: the
-    DMA loop copies codes + scale rows per live block (~half a bf16
-    block's bytes) and the dequant multiply happens on the VMEM gather
-    right where the value path consumes it, mirroring the reference's
-    int8 branch op for op."""
+    q_ref (1, H, C, D) VMEM; k/v_pool_ref (N, H_kv, bs, D) HBM (ANY).
+    gk/gv scratch (M, H_kv, bs, D) VMEM in pool dtype — the lane's
+    gathered view, laid out exactly like the reference's `pool[table]`
+    row so the value-path math below can mirror it op for op. Quantized
+    adds the (N, H_kv, bs) f32 scale pools in HBM and (M, H_kv, bs)
+    scale scratch. GQA (hp < h) repeats the gathered (and dequantized)
+    rows across each query-head group — a pure copy, identical to the
+    reference's repeat of its dense gather, so the bitwise pin holds."""
     if quantized:
         (ks_pool_ref, vs_pool_ref, o_ref,
          gk_ref, gv_ref, gks_ref, gvs_ref, sem_ref) = rest
@@ -166,14 +209,20 @@ def _paged_kernel(tbl_ref, pos_ref, q_ref, k_pool_ref, v_pool_ref,
     # constant, same jax.nn.softmax — the bitwise pin lives here; the
     # int8 dequant slots in exactly where the reference branch does it)
     q = q_ref[0]                                          # (H, C, D)
-    gk = jnp.moveaxis(gk_ref[...], 1, 0).reshape(h, t, d)
-    gv = jnp.moveaxis(gv_ref[...], 1, 0).reshape(h, t, d)
+    gk = jnp.moveaxis(gk_ref[...], 1, 0).reshape(hp, t, d)
+    gv = jnp.moveaxis(gv_ref[...], 1, 0).reshape(hp, t, d)
     if quantized:
-        ks = jnp.moveaxis(gks_ref[...], 1, 0).reshape(h, t)
-        vs = jnp.moveaxis(gvs_ref[...], 1, 0).reshape(h, t)
+        ks = jnp.moveaxis(gks_ref[...], 1, 0).reshape(hp, t)
+        vs = jnp.moveaxis(gvs_ref[...], 1, 0).reshape(hp, t)
         gk = gk.astype(jnp.float32) * ks[..., None]
         gv = (gv.astype(jnp.float32) * vs[..., None]).astype(
             o_ref.dtype)
+    if hp < h:
+        # GQA: query head j reads KV head j // group — repeat the
+        # gathered rows per group (pure copies, so the einsums below
+        # see exactly the values a repeat-KV dense pool would hold)
+        gk = jnp.repeat(gk, h // hp, axis=0)
+        gv = jnp.repeat(gv, h // hp, axis=0)
     s = jnp.einsum("hcd,htd->hct", q.astype(jnp.float32),
                    gk.astype(jnp.float32),
                    preferred_element_type=jnp.float32) / np.sqrt(d)
@@ -187,16 +236,17 @@ def _paged_kernel(tbl_ref, pos_ref, q_ref, k_pool_ref, v_pool_ref,
 
 def ragged_paged_attention(q, k_pool, v_pool, block_table, q_positions,
                            k_scale=None, v_scale=None, interpret=None):
-    """Paged attention with the table walk fused into the kernel.
+    """Paged attention kernel v1: gather-then-compute table walk.
 
     Same contract as `serving.kv_cache.paged_attention` (which is the
     dispatcher that normally routes here):
 
         q:           (B, H, C, D) — C query tokens per request lane
-        k/v_pool:    (N, H, bs, D), same dtype (f32, bf16 or int8)
+        k/v_pool:    (N, H_kv, bs, D), same dtype (f32, bf16 or int8);
+                     H_kv == H (MHA) or a divisor of H (GQA)
         block_table: (B, M) int32 (NULL_BLOCK-padded)
         q_positions: (B, C) int32
-        k/v_scale:   (N, H, bs) f32 — required for int8 pools (the
+        k/v_scale:   (N, H_kv, bs) f32 — required for int8 pools (the
                      per-row dequant scales; dequant is fused into the
                      kernel's gather), absent otherwise
         returns      (B, H, C, D) in v_pool's dtype (int8 pools: in
@@ -205,33 +255,8 @@ def ragged_paged_attention(q, k_pool, v_pool, block_table, q_positions,
     `interpret` defaults to "off-TPU" (flash.py policy)."""
     global TRACE_COUNT
     TRACE_COUNT += 1
-    b, h, c, d = q.shape
-    n, hp, bs, dp = k_pool.shape
-    if (hp, dp) != (h, d) or v_pool.shape != k_pool.shape:
-        raise ValueError(
-            f"pool shapes {k_pool.shape}/{v_pool.shape} do not match "
-            f"q {q.shape}")
-    m = block_table.shape[1]
-    if block_table.shape[0] != b or q_positions.shape != (b, c):
-        raise ValueError(
-            f"table {block_table.shape} / positions {q_positions.shape} "
-            f"do not match q {q.shape}")
-    quantized = k_pool.dtype == jnp.int8
-    if quantized:
-        if k_scale is None or v_scale is None:
-            raise ValueError(
-                "int8 pools need k_scale/v_scale (N, H, bs) f32 scale "
-                "pools — quantized KV is (codes, scales) pairs")
-        if (k_scale.shape != (n, hp, bs)
-                or v_scale.shape != (n, hp, bs)):
-            raise ValueError(
-                f"scale pools {k_scale.shape}/{v_scale.shape} do not "
-                f"match data pools {k_pool.shape} (want {(n, hp, bs)})")
-    elif k_scale is not None or v_scale is not None:
-        raise ValueError(
-            f"scale pools passed with non-int8 pools "
-            f"({k_pool.dtype}) — scales only mean something for "
-            f"quantized KV")
+    b, h, c, d, n, hp, bs, m, quantized = _validate_paged_args(
+        q, k_pool, v_pool, block_table, q_positions, k_scale, v_scale)
     if interpret is None:
         interpret = _interpret()
 
@@ -247,16 +272,16 @@ def ragged_paged_attention(q, k_pool, v_pool, block_table, q_positions,
                       any_spec, any_spec],      # scale pools too
             out_specs=lane_spec,
             scratch_shapes=[
-                pltpu.VMEM((m, h, bs, d), jnp.int8),
-                pltpu.VMEM((m, h, bs, d), jnp.int8),
-                pltpu.VMEM((m, h, bs), jnp.float32),
-                pltpu.VMEM((m, h, bs), jnp.float32),
+                pltpu.VMEM((m, hp, bs, d), jnp.int8),
+                pltpu.VMEM((m, hp, bs, d), jnp.int8),
+                pltpu.VMEM((m, hp, bs), jnp.float32),
+                pltpu.VMEM((m, hp, bs), jnp.float32),
                 pltpu.SemaphoreType.DMA((4,)),
             ],
         )
         return pl.pallas_call(
-            functools.partial(_paged_kernel, bs=bs, m=m, h=h, d=d,
-                              quantized=True),
+            functools.partial(_paged_kernel, bs=bs, m=m, h=h, hp=hp,
+                              d=d, quantized=True),
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((b, h, c, d), q.dtype),
             interpret=interpret,
@@ -273,15 +298,215 @@ def ragged_paged_attention(q, k_pool, v_pool, block_table, q_positions,
         ],
         out_specs=lane_spec,
         scratch_shapes=[
-            pltpu.VMEM((m, h, bs, d), k_pool.dtype),
-            pltpu.VMEM((m, h, bs, d), v_pool.dtype),
+            pltpu.VMEM((m, hp, bs, d), k_pool.dtype),
+            pltpu.VMEM((m, hp, bs, d), v_pool.dtype),
             pltpu.SemaphoreType.DMA((2,)),
         ],
     )
     return pl.pallas_call(
-        functools.partial(_paged_kernel, bs=bs, m=m, h=h, d=d),
+        functools.partial(_paged_kernel, bs=bs, m=m, h=h, hp=hp, d=d),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, h, c, d), v_pool.dtype),
         interpret=interpret,
     )(block_table.astype(jnp.int32), q_positions.astype(jnp.int32),
       q, k_pool, v_pool)
+
+
+# ---------------------------------------------------------------------------
+# kernel v2: double-buffered block streaming + online softmax
+# ---------------------------------------------------------------------------
+
+def _v2_scratch_shapes(hp, bs, d, pool_dtype, quantized):
+    """The v2 VMEM scratch contract, exposed for the white-box test:
+    every buffer's leading dim is 2 (the double-buffer slots) and NO
+    dimension depends on the table width M — that independence IS the
+    unbounded-context claim. Returns [(shape, dtype), ...] for the K
+    window, the V window, and (quantized only) their scale windows."""
+    shapes = [((2, hp, bs, d), pool_dtype),
+              ((2, hp, bs, d), pool_dtype)]
+    if quantized:
+        shapes += [((2, hp, bs), jnp.float32),
+                   ((2, hp, bs), jnp.float32)]
+    return shapes
+
+
+def _paged_kernel_v2(tbl_ref, pos_ref, q_ref, k_pool_ref, v_pool_ref,
+                     *rest, bs, m, h, hp, d, quantized=False):
+    """One grid step = one request lane, all heads, streaming the
+    lane's live blocks through a 2-slot VMEM window.
+
+    The walk: block 0's DMA is issued up front; each loop iteration
+    first issues block j+1's copy into the OTHER slot, then waits on
+    block j's and folds it into the online-softmax carry
+    (m: running row max, l: rescaled exp-sum, acc: rescaled PV partial,
+    all f32). NULL blocks (padding, idle lanes) are skipped on both the
+    issue and the wait side, and their mask zeroes the whole block's
+    probabilities — a skipped slot's stale-but-finite contents multiply
+    by exact 0 (both slots are zero-filled once at entry, so "stale"
+    can only ever mean a previous LIVE block's values, never
+    uninitialized VMEM or the NULL block's poison).
+
+    Two traps the masking dodges, pinned by tests:
+    * NEG_INF is finite (-1e9), so on an all-masked prefix
+      m_new == NEG_INF and exp(s - m_new) == exp(0) == 1 for masked
+      entries — probabilities MUST come from
+      `where(mask, exp(s - m_new), 0)`, never from the bare exp;
+    * an idle lane finishes with l == 0; dividing by
+      `where(l > 0, l, 1)` lands an exact 0 output instead of NaN (the
+      engine's non-finite-logits guard sums every lane's logps)."""
+    if quantized:
+        (ks_pool_ref, vs_pool_ref, o_ref, kbuf, vbuf, ksbuf, vsbuf,
+         sem_k, sem_v, sem_ks, sem_vs) = rest
+    else:
+        o_ref, kbuf, vbuf, sem_k, sem_v = rest
+    b = pl.program_id(0)
+    g = h // hp
+    c = pos_ref.shape[1]
+
+    # zero-fill BOTH slots once: a skipped (NULL) block leaves its slot
+    # untouched, and 0-probability times a finite stale value is an
+    # exact 0 — times uninitialized VMEM (or a NaN-poisoned NULL block,
+    # had we copied it) it would be NaN
+    kbuf[...] = jnp.zeros_like(kbuf)
+    vbuf[...] = jnp.zeros_like(vbuf)
+    if quantized:
+        ksbuf[...] = jnp.zeros_like(ksbuf)
+        vsbuf[...] = jnp.zeros_like(vsbuf)
+
+    max_pos = pos_ref[b, 0]
+    for ci in range(1, c):
+        max_pos = jnp.maximum(max_pos, pos_ref[b, ci])
+    n_live = jnp.minimum(max_pos // bs + 1, m)
+
+    def _copies(j, slot):
+        blk = tbl_ref[b, j]
+        copies = [
+            pltpu.make_async_copy(k_pool_ref.at[blk], kbuf.at[slot],
+                                  sem_k.at[slot]),
+            pltpu.make_async_copy(v_pool_ref.at[blk], vbuf.at[slot],
+                                  sem_v.at[slot])]
+        if quantized:
+            copies += [
+                pltpu.make_async_copy(ks_pool_ref.at[blk],
+                                      ksbuf.at[slot], sem_ks.at[slot]),
+                pltpu.make_async_copy(vs_pool_ref.at[blk],
+                                      vsbuf.at[slot], sem_vs.at[slot])]
+        return blk, copies
+
+    def _issue(j):
+        blk, copies = _copies(j, jax.lax.rem(j, 2))
+
+        def go(_):
+            for cp in copies:
+                cp.start()
+            return 0
+
+        jax.lax.cond(blk != NULL_BLOCK, go, lambda _: 0, 0)
+        return 0
+
+    def _wait(j):
+        blk, copies = _copies(j, jax.lax.rem(j, 2))
+
+        def go(_):
+            for cp in copies:
+                cp.wait()
+            return 0
+
+        jax.lax.cond(blk != NULL_BLOCK, go, lambda _: 0, 0)
+        return 0
+
+    # warm-up: block 0 in flight before the loop (n_live >= 1 always)
+    _issue(0)
+
+    q = q_ref[0].reshape(hp, g, c, d).astype(jnp.float32)
+    pos = jnp.stack([pos_ref[b, ci] for ci in range(c)])      # (C,)
+
+    def body(j, carry):
+        m_run, l_run, acc = carry
+        # the NEXT block's DMA goes out before this block's compute —
+        # that overlap is the whole point of the 2-slot window
+        jax.lax.cond(j + 1 < n_live,
+                     lambda _: _issue(j + 1), lambda _: 0, 0)
+        _wait(j)
+        slot = jax.lax.rem(j, 2)
+        blk = tbl_ref[b, j]
+        kb = kbuf[slot]                               # (H_kv, bs, D)
+        vb = vbuf[slot]
+        if quantized:
+            kb = kb.astype(jnp.float32) * ksbuf[slot][..., None]
+            vb = vb.astype(jnp.float32) * vsbuf[slot][..., None]
+        s = jnp.einsum("kgcd,kbd->kgcb", q,
+                       kb.astype(jnp.float32),
+                       preferred_element_type=jnp.float32) / np.sqrt(d)
+        key_pos = j * bs + jax.lax.broadcasted_iota(
+            jnp.int32, (c, bs), 1)
+        mask = ((key_pos <= pos[:, None])
+                & (blk != NULL_BLOCK))[None, None]    # (1, 1, C, bs)
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m_run, jnp.max(s, axis=-1))
+        # on an all-masked prefix both maxes sit at the finite NEG_INF,
+        # so m_run - m_new == 0 and corr == 1 exactly — the carry stays
+        # untouched instead of decaying through exp(-1e9)
+        corr = jnp.exp(m_run - m_new)
+        p = jnp.where(mask, jnp.exp(s - m_new[..., None]), 0.0)
+        l_new = l_run * corr + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("kgcb,kbd->kgcd", p, vb.astype(jnp.float32),
+                        preferred_element_type=jnp.float32)
+        return m_new, l_new, acc * corr[..., None] + pv
+
+    m0 = jnp.full((hp, g, c), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((hp, g, c), jnp.float32)
+    acc0 = jnp.zeros((hp, g, c, d), jnp.float32)
+    _, l_f, acc_f = jax.lax.fori_loop(0, n_live, body, (m0, l0, acc0))
+    # idle lanes (every key masked) land l == 0: divide by 1 and output
+    # an exact 0 — never NaN
+    l_safe = jnp.where(l_f > 0.0, l_f, 1.0)
+    o_ref[0] = (acc_f / l_safe[..., None]).reshape(h, c, d).astype(
+        o_ref.dtype)
+
+
+def ragged_paged_attention_v2(q, k_pool, v_pool, block_table,
+                              q_positions, k_scale=None, v_scale=None,
+                              interpret=None):
+    """Paged attention kernel v2: double-buffered block streaming with
+    a flash-style online softmax. Identical call contract to
+    `ragged_paged_attention` (v1); the difference is the resource
+    shape — VMEM scratch is O(2 blocks) regardless of the table width
+    (`_v2_scratch_shapes`), and scores/softmax/PV accumulate in f32 for
+    EVERY pool dtype, with the output cast once at the end. v2 is
+    mathematically exact vs the reference but reorders its fp
+    reductions (per-block partial sums + rescales), so the tier-1 pin
+    is tight-allclose + argmax-identical rather than v1's bitwise."""
+    global TRACE_COUNT, V2_TRACE_COUNT
+    TRACE_COUNT += 1
+    V2_TRACE_COUNT += 1
+    b, h, c, d, n, hp, bs, m, quantized = _validate_paged_args(
+        q, k_pool, v_pool, block_table, q_positions, k_scale, v_scale)
+    if interpret is None:
+        interpret = _interpret()
+
+    out_dtype = q.dtype if quantized else v_pool.dtype
+    lane_spec = pl.BlockSpec((1, h, c, d),
+                             lambda b_, tbl, pos: (b_, 0, 0, 0))
+    any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+    scratch = [pltpu.VMEM(shp, dt) for shp, dt in _v2_scratch_shapes(
+        hp, bs, d, k_pool.dtype, quantized)]
+    # one 2-slot semaphore array per streamed pool (k, v[, scales])
+    scratch += [pltpu.SemaphoreType.DMA((2,))
+                for _ in range(4 if quantized else 2)]
+    pools = [k_pool, v_pool] + ([k_scale, v_scale] if quantized else [])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,          # block_table, q_positions
+        grid=(b,),
+        in_specs=[lane_spec] + [any_spec] * len(pools),
+        out_specs=lane_spec,
+        scratch_shapes=scratch,
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_kernel_v2, bs=bs, m=m, h=h, hp=hp,
+                          d=d, quantized=quantized),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, c, d), out_dtype),
+        interpret=interpret,
+    )(block_table.astype(jnp.int32), q_positions.astype(jnp.int32),
+      q, *pools)

@@ -51,14 +51,18 @@ __all__ = ["GenerationServer", "GenerationFuture", "GPTServingModel"]
 _SERVER_SEQ = itertools.count()
 
 
-def _fused_step_body(params, cfg, block_size, h_count, d, reduce_fn,
-                     pools, tokens, positions, valid, tables,
+def _fused_step_body(params, cfg, block_size, h_count, kv_count, d,
+                     reduce_fn, pools, tokens, positions, valid, tables,
                      per_column=False):
     """The ONE fused prefill/decode step body (build_kv_step's math over
     (S, C) ragged lanes with paged KV), shared by the single-device and
     tensor-parallel fused steps exactly like gpt._prefill_forward:
-    `h_count` is the head count THIS caller sees (H, or H/tp inside
-    shard_map over head-sharded params and pools) and `reduce_fn`
+    `h_count` is the QUERY head count THIS caller sees (H, or H/tp
+    inside shard_map over head-sharded params and pools), `kv_count`
+    the KV head count (equal for MHA; H_kv or H_kv/tp for
+    grouped-query attention, where wk/wv project to kv_count * d
+    columns and the paged_attention dispatcher groups the query heads
+    onto the shared KV heads), and `reduce_fn`
     finishes the row-parallel o-proj / ffn-down contractions (identity
     single-device; one psum per sub-block under tp — the partial sums
     those matmuls leave are the ONLY cross-shard state the step has).
@@ -109,8 +113,8 @@ def _fused_step_body(params, cfg, block_size, h_count, d, reduce_fn,
         ks, vs = pools[i].get("k_scale"), pools[i].get("v_scale")
         hn = _ln(x, lp["ln1_s"], lp["ln1_b"])
         q = (hn @ w(lp, "wq") + lp["bq"]).reshape(s, c, h_count, d)
-        k = (hn @ w(lp, "wk") + lp["bk"]).reshape(s, c, h_count, d)
-        v = (hn @ w(lp, "wv") + lp["bv"]).reshape(s, c, h_count, d)
+        k = (hn @ w(lp, "wk") + lp["bk"]).reshape(s, c, kv_count, d)
+        v = (hn @ w(lp, "wv") + lp["bv"]).reshape(s, c, kv_count, d)
         if ks is not None:
             kp, ks = write_block_kv_quant(kp, ks, k, bidx, off)
             vp, vs = write_block_kv_quant(vp, vs, v, bidx, off)
@@ -168,6 +172,14 @@ class GPTServingModel:
         self.cfg = cfg
         self.num_layers = cfg.num_layers
         self.num_heads = cfg.num_heads
+        # GQA: cfg.kv_heads < num_heads shares each KV head across a
+        # group of query heads; None/absent means MHA (H_kv == H)
+        self.num_kv_heads = getattr(cfg, "kv_heads", None) or cfg.num_heads
+        if self.num_heads % self.num_kv_heads:
+            raise ValueError(
+                f"kv_heads={self.num_kv_heads} must divide "
+                f"num_heads={self.num_heads}: grouped-query attention "
+                f"needs an integral query-head group per KV head")
         self.head_dim = cfg.hidden_size // cfg.num_heads
         self.max_position = cfg.max_position
         self.kv_dtype = dtype or jnp.float32
@@ -228,7 +240,7 @@ class GPTServingModel:
     def build_fused_step(self, block_size, mesh=None, axis="tp",
                          per_column=False, kv_quantized=False):
         params, cfg = self.params, self.cfg
-        h_, d = self.num_heads, self.head_dim
+        h_, kv_, d = self.num_heads, self.num_kv_heads, self.head_dim
 
         if mesh is not None and self._int8_weights:
             raise NotImplementedError(
@@ -239,7 +251,7 @@ class GPTServingModel:
         if mesh is None:
             def fused(pools, tokens, positions, valid, tables):
                 return _fused_step_body(
-                    params, cfg, block_size, h_, d, lambda z: z,
+                    params, cfg, block_size, h_, kv_, d, lambda z: z,
                     pools, tokens, positions, valid, tables,
                     per_column=per_column)
 
@@ -258,7 +270,14 @@ class GPTServingModel:
             raise ValueError(
                 f"tp={tp} must divide both num_heads={self.num_heads} "
                 f"and inner_size={cfg.inner_size}")
+        if self.num_kv_heads % tp:
+            raise ValueError(
+                f"tp={tp} must divide kv_heads={self.num_kv_heads}: "
+                f"the KV pools (and wk/wv columns) shard on the KV "
+                f"head axis, so each device needs a whole number of "
+                f"KV-head groups")
         h_loc = self.num_heads // tp
+        kv_loc = self.num_kv_heads // tp
         shardings = gpt_tp_shardings(cfg, mesh, axis)
         sharded = jax.device_put(params, shardings)
         # rebind to the sharded copy so THIS model holds no reference
@@ -273,7 +292,7 @@ class GPTServingModel:
 
         def local(lp_all, pools, tokens, positions, valid, tables):
             return _fused_step_body(
-                lp_all, cfg, block_size, h_loc, d,
+                lp_all, cfg, block_size, h_loc, kv_loc, d,
                 lambda z: jax.lax.psum(z, axis),
                 pools, tokens, positions, valid, tables)
 
@@ -385,6 +404,21 @@ class GenerationServer:
             raise ValueError(
                 f"tp={tp} must divide both num_heads={model.num_heads} "
                 f"and inner_size={inner}")
+        # GQA geometry, also before allocation: H % H_kv for any model
+        # (GPTServingModel re-checks for direct construction) and
+        # H_kv % tp under a mesh (the pools shard the KV head axis)
+        kv_heads = getattr(model, "num_kv_heads", model.num_heads)
+        if model.num_heads % kv_heads:
+            raise ValueError(
+                f"kv_heads={kv_heads} must divide "
+                f"num_heads={model.num_heads}: grouped-query attention "
+                f"needs an integral query-head group per KV head")
+        if mesh is not None and kv_heads % tp:
+            raise ValueError(
+                f"tp={tp} must divide kv_heads={kv_heads}: the KV "
+                f"pools shard on the KV head axis (with GQA that is "
+                f"H_kv={kv_heads}, not the {model.num_heads} query "
+                f"heads)")
         max_context = int(max_context or model.max_position)
         if max_context > model.max_position:
             raise ValueError(
@@ -401,7 +435,8 @@ class GenerationServer:
                                   model.head_dim, num_blocks,
                                   block_size=self.block_size,
                                   dtype=model.kv_dtype, mesh=mesh,
-                                  axis=mesh_axis, kv_dtype=kv_dtype)
+                                  axis=mesh_axis, kv_dtype=kv_dtype,
+                                  num_kv_heads=kv_heads)
         if chaos is not None and clock is None and \
                 getattr(chaos, "drives_clock", lambda: False)():
             clock = chaos.serving_clock
@@ -477,7 +512,8 @@ class GenerationServer:
             self._draft_cache = PagedKVCache(
                 dm.num_layers, dm.num_heads, dm.head_dim,
                 self.cache.num_blocks, block_size=self.block_size,
-                dtype=dm.kv_dtype, kv_dtype=kv_dtype)
+                dtype=dm.kv_dtype, kv_dtype=kv_dtype,
+                num_kv_heads=getattr(dm, "num_kv_heads", dm.num_heads))
             self.cache.attach_sibling(self._draft_cache)
             from .spec_decode import build_draft_step
             self._draft = jax.jit(build_draft_step(
@@ -527,10 +563,14 @@ class GenerationServer:
         # bytes already counts the scale pools) plus the dense size the
         # same block count would have cost — capacity dashboards read
         # the saving straight off the row instead of recomputing it
+        # "heads" is the pools' PHYSICAL head count (H_kv under GQA —
+        # the byte truth); "q_heads" keeps the model-side head count on
+        # the row so the group factor is readable in place
         kv_detail = {"layers": model.num_layers,
                      "num_blocks": self.cache.num_blocks,
                      "block_size": self.block_size,
-                     "heads": model.num_heads,
+                     "heads": kv_heads,
+                     "q_heads": model.num_heads,
                      "head_dim": model.head_dim,
                      "dtype": str(np.dtype(self.cache.dtype)),
                      "kv_dtype": kv_dtype}
@@ -549,7 +589,7 @@ class GenerationServer:
                     shard_bytes,
                     detail=dict(kv_detail, device=str(dev),
                                 mesh_index=i, axis=mesh_axis,
-                                heads_local=model.num_heads // tp))
+                                heads_local=kv_heads // tp))
             param_dev_bytes = param_bytes
             if hasattr(model, "param_bytes_per_device"):
                 param_dev_bytes = model.param_bytes_per_device(
@@ -575,7 +615,8 @@ class GenerationServer:
                          detail={"layers": spec.draft_model.num_layers,
                                  "num_blocks": self.cache.num_blocks,
                                  "block_size": self.block_size,
-                                 "heads": spec.draft_model.num_heads,
+                                 "heads": self._draft_cache.num_kv_heads,
+                                 "q_heads": spec.draft_model.num_heads,
                                  "head_dim": spec.draft_model.head_dim,
                                  "spec_k": spec.k})
             led.register(self._ledger_id, "draft_params", "params",
@@ -633,6 +674,7 @@ class GenerationServer:
         self._kernel_engaged = None     # unknown until the first step
         self._kernel_mode = None        # mode the step traced under
         self._kernel_counts = (0, 0)    # this server's trace dispatches
+        self._kernel_version = None     # v1/v2 the trace dispatched to
         self._next_rid = 0
         self._rid_lock = threading.Lock()
         self._closed = False
@@ -840,10 +882,19 @@ class GenerationServer:
                         self._kernel_mode = _kvc.paged_kernel_mode()
                         k0, f0 = (_kvc.KERNEL_DISPATCHES,
                                   _kvc.FALLBACK_DISPATCHES)
+                        v0 = dict(_kvc.KERNEL_VERSIONS)
                         out = self._fused(self.cache.pools, *args)
                         self._kernel_counts = (
                             _kvc.KERNEL_DISPATCHES - k0,
                             _kvc.FALLBACK_DISPATCHES - f0)
+                        # which kernel GENERATION this trace's
+                        # dispatches took (None if none engaged)
+                        dv = [v for v in ("v1", "v2")
+                              if _kvc.KERNEL_VERSIONS.get(v, 0)
+                              > v0.get(v, 0)]
+                        self._kernel_version = (
+                            dv[0] if len(dv) == 1 else
+                            ("mixed" if dv else None))
                     self._check_kernel_engagement()
                 else:
                     out = self._fused(self.cache.pools, *args)
@@ -1045,9 +1096,13 @@ class GenerationServer:
         kp = p0["k"]
         # the probe q uses the COMPUTE dtype (what the fused step feeds
         # the dispatcher) — an int8 pool's queries are never int8
+        # the probe q is shaped like the real step's queries ((1, H, 1,
+        # D) — the GQA-relaxed supported() check needs the true head
+        # relation, a (1, 1, 1, 1) probe would fail it for any H_kv > 1)
         expected = (self._kernel_mode != "off" and
                     _kvc.paged_kernel_supported(
-                        jnp.zeros((1, 1, 1, 1),
+                        jnp.zeros((1, self.model.num_heads, 1,
+                                   self.cache.head_dim),
                                   self.cache.compute_dtype), kp, kp,
                         p0.get("k_scale"), p0.get("v_scale")))
         if expected and not self._kernel_engaged:
@@ -1190,6 +1245,10 @@ class GenerationServer:
             # path (None until the first step)
             "mode": self._kernel_mode,
             "engaged": self._kernel_engaged,
+            # kernel generation the first trace dispatched to ("v1" /
+            # "v2"; None when nothing engaged) — mirrors the
+            # serving.kernel.version gauge
+            "version": self._kernel_version,
             "kernel_dispatches": traced,
             "fallback_dispatches": fell_back,
         }

@@ -16,15 +16,26 @@ compiled step): length is data (positions + tables), never shape. Block
 0 is the reserved NULL block — table padding and masked-token writes
 land there, and the attention mask guarantees it is never read.
 
-`paged_attention` is the op's dispatcher: by default it routes to the
+`paged_attention` is the op's dispatcher: by default it routes to a
 Pallas ragged paged attention kernel (`ops/pallas/paged.py` — the table
 walk fused into the kernel, early stop at each lane's true length,
 bf16 KV with f32 accumulation), falling back to
 `paged_attention_reference`, the pure-JAX semantic spec (gather blocks
-by table -> masked attention) that the kernel is pinned bitwise against
-in interpret mode. `PADDLE_TPU_PAGED_KERNEL` (0/1/auto) overrides the
-routing; everything above the op (scheduler, engine) is
-kernel-agnostic.
+by table -> masked attention) that kernel v1 is pinned bitwise against
+in interpret mode. Two kernel generations exist: v1 (gather the live
+blocks to VMEM, then the reference math — bitwise-stable, VMEM scales
+with the table width) and v2 (double-buffered block STREAMING with an
+online softmax — O(2 blocks) of VMEM whatever the table width). Auto
+mode picks v1 while its scratch fits the VMEM ceiling and v2 past it;
+`PADDLE_TPU_PAGED_KERNEL` (0/1/auto/v1/v2) overrides the routing;
+everything above the op (scheduler, engine) is kernel-agnostic.
+
+Grouped-query attention (ISSUE 16): ``PagedKVCache(num_kv_heads=)``
+shrinks the pools to (num_blocks, H_kv, block_size, D) with
+H % H_kv == 0; query head j attends KV head j // (H/H_kv) (the
+contiguous-group convention). Every byte count — pool_bytes, shard
+bytes, ledger rows, handoff transfers — divides by the group factor,
+compounding with int8 quantization.
 
 `PagedDecodeLayer` adapts a layer's pool slice to the dense mapping
 interface `decoding.py` step_fns consume (`cache[i]["k"]`,
@@ -92,6 +103,16 @@ KV_QMAX = 127.0         # symmetric int8 range; -128 is never produced,
 KERNEL_DISPATCHES = 0
 FALLBACK_DISPATCHES = 0
 FALLBACK_REASONS = {}
+# which kernel generation each kernel dispatch took ({"v1": n, "v2": n})
+# — the engine's get_stats()["kernel"]["version"] reads the delta
+# across its first trace, mirroring serving.kernel.version
+KERNEL_VERSIONS = {}
+
+# v1 gathers a lane's whole table into VMEM: 2 pools x M blocks x
+# H_kv x bs x D x itemsize (+ f32 scale rows when quantized). Auto
+# mode streams through v2 once that estimate passes this ceiling —
+# env-overridable so tests (and unusual VMEM budgets) can move it.
+V2_AUTO_VMEM_BYTES = 8 * 1024 * 1024
 
 
 # ---------------------------------------------------------------------------
@@ -177,8 +198,21 @@ def paged_attention_reference(q, k_pool, v_pool, block_table,
     dequantizes the gathered rows (int8 -> f32 multiply by the row
     scale) exactly where the kernel dequantizes its VMEM-resident
     gather: keys straight into the f32 score math, values cast to the
-    compute dtype the probabilities use."""
+    compute dtype the probabilities use.
+
+    Grouped-query attention: pools with H_kv < H heads (H % H_kv == 0)
+    are gathered (and, for int8, dequantized) at H_kv and then
+    REPEATED across each query-head group — pure copies, so this is
+    bitwise-identical to running the dense math against a pool that
+    physically stored each KV head H/H_kv times (the repeat-KV
+    equivalence the GQA tests pin)."""
     d = q.shape[-1]
+    h, hp = q.shape[1], k_pool.shape[1]
+    if hp > h or h % hp:
+        raise ValueError(
+            f"pool heads {hp} do not match q heads {h} (GQA needs q "
+            f"heads a multiple of pool heads)")
+    rep = h // hp
     if k_pool.dtype != jnp.int8 and (k_scale is not None
                                      or v_scale is not None):
         # same guard as the kernel entry point, so the error does not
@@ -199,6 +233,9 @@ def paged_attention_reference(q, k_pool, v_pool, block_table,
         gvs = gather_block_scales(v_scale, block_table)
         gk = gkq.astype(jnp.float32) * gks[..., None]
         gv = (gvq.astype(jnp.float32) * gvs[..., None]).astype(cdt)
+        if rep > 1:
+            gk = jnp.repeat(gk, rep, axis=1)
+            gv = jnp.repeat(gv, rep, axis=1)
         s = jnp.einsum("bhcd,bhtd->bhct", q.astype(jnp.float32),
                        gk) / np.sqrt(d)
         t = gk.shape[2]
@@ -209,6 +246,9 @@ def paged_attention_reference(q, k_pool, v_pool, block_table,
         p = jax.nn.softmax(s, axis=-1).astype(gv.dtype)
         return jnp.einsum("bhct,bhtd->bhcd", p, gv)
     gk, gv = gather_block_kv_pair(k_pool, v_pool, block_table)
+    if rep > 1:
+        gk = jnp.repeat(gk, rep, axis=1)
+        gv = jnp.repeat(gv, rep, axis=1)
     s = jnp.einsum("bhcd,bhtd->bhct", q, gk) / np.sqrt(d)
     t = gk.shape[2]
     key_pos = jnp.arange(t)
@@ -219,12 +259,16 @@ def paged_attention_reference(q, k_pool, v_pool, block_table,
 
 
 def paged_kernel_mode():
-    """Resolve PADDLE_TPU_PAGED_KERNEL -> 'off' | 'force' | 'auto'.
-    Unset/'auto': use the kernel whenever the operands qualify (the
-    default — tier-1 exercises the real kernel under the Pallas
-    interpreter on CPU). '0' pins the reference path, '1' demands the
-    kernel and raises on unsupported operands instead of silently
-    degrading."""
+    """Resolve PADDLE_TPU_PAGED_KERNEL ->
+    'off' | 'force' | 'auto' | 'v1' | 'v2'.
+    Unset/'auto': use a kernel whenever the operands qualify (the
+    default — tier-1 exercises the real kernels under the Pallas
+    interpreter on CPU), choosing v1 while its full-table VMEM gather
+    fits the ceiling and the streaming v2 past it. '0' pins the
+    reference path; '1' demands a kernel (same v1/v2 choice as auto)
+    and raises on unsupported operands instead of silently degrading;
+    'v1'/'v2' pin the kernel GENERATION (degrading to the reference,
+    with a labeled fallback, when operands do not qualify)."""
     raw = os.environ.get("PADDLE_TPU_PAGED_KERNEL", "auto").lower()
     if raw in ("0", "off", "false"):
         return "off"
@@ -232,19 +276,54 @@ def paged_kernel_mode():
         return "force"
     if raw in ("auto", ""):
         return "auto"
+    if raw in ("v1", "v2"):
+        return raw
     raise ValueError(
-        f"PADDLE_TPU_PAGED_KERNEL={raw!r}: expected 0, 1 or auto")
+        f"PADDLE_TPU_PAGED_KERNEL={raw!r}: expected 0, 1, auto, v1 "
+        f"or v2")
+
+
+def _v1_scratch_bytes(k_pool, block_table):
+    """v1's VMEM scratch footprint for these operands: both gathered
+    pools at full table width, plus the f32 scale windows for int8."""
+    n, hp, bs, d = k_pool.shape
+    m = block_table.shape[1]
+    per = m * hp * bs * d * np.dtype(k_pool.dtype).itemsize
+    scales = (2 * m * hp * bs * 4) if k_pool.dtype == jnp.int8 else 0
+    return 2 * per + scales
+
+
+def _v2_auto_vmem_bytes():
+    raw = os.environ.get("PADDLE_TPU_PAGED_V2_AUTO_BYTES")
+    return int(raw) if raw else V2_AUTO_VMEM_BYTES
+
+
+def _kernel_version_for(mode, k_pool, block_table):
+    """Which kernel generation a kernel-bound dispatch takes. Explicit
+    'v1'/'v2' modes pin it; 'auto'/'force' keep the bitwise-stable v1
+    while its table-wide gather fits the VMEM ceiling and stream via
+    v2 past it (the whole point of v2: context length stops being a
+    VMEM problem)."""
+    if mode in ("v1", "v2"):
+        return mode
+    return ("v2" if _v1_scratch_bytes(k_pool, block_table)
+            > _v2_auto_vmem_bytes() else "v1")
 
 
 def paged_kernel_supported(q, k_pool, v_pool, k_scale=None,
                            v_scale=None):
-    """Shapes/dtypes the kernel handles: 4-D operands with matching
-    same-dtype f32 or bf16 pools, or int8 pools accompanied by their
-    (N, H, bs) f32 scale pools (quantized serving — the kernel fuses
-    the dequant into its VMEM gather)."""
+    """Shapes/dtypes the kernels handle: 4-D operands with matching
+    same-dtype f32 or bf16 pools — pool heads equal to q's heads (MHA)
+    or an exact divisor (GQA) — or int8 pools accompanied by their
+    (N, H_kv, bs) f32 scale pools (quantized serving — the kernels
+    fuse the dequant into the gather)."""
     if q.ndim != 4 or k_pool.ndim != 4 or v_pool.ndim != 4:
         return False
     if k_pool.dtype != v_pool.dtype:
+        return False
+    h, hp = q.shape[1], k_pool.shape[1]
+    if (hp > h or h % hp or q.shape[3] != k_pool.shape[3]
+            or k_pool.shape != v_pool.shape):
         return False
     if k_pool.dtype == jnp.int8:
         return (k_scale is not None and v_scale is not None
@@ -282,22 +361,32 @@ def _transform_trace_kind(*operands):
     return None
 
 
-def _record_dispatch(kernel, reason=None):
+def _record_dispatch(kernel, reason=None, version=None):
     """Trace-time metrics: dispatch counters + the interpret-mode gauge
     land in the global registry so GenerationServer.get_stats() and the
     trace_report serving summary can prove the kernel engaged.
     Fallbacks carry a `reason` label (pinned_off / unsupported /
     vmap_trace / unsupported_under_shard_map) on top of the unlabeled
     aggregate, so a dashboard can tell an operator pin from a silent
-    degradation."""
+    degradation. Kernel dispatches carry the kernel GENERATION: a
+    `version` label on `serving.kernel.traced` (and "reference" on the
+    fallback series), plus the `serving.kernel.version` gauge (1 = v1,
+    2 = v2, 0 = last dispatch fell back)."""
     global KERNEL_DISPATCHES, FALLBACK_DISPATCHES
     from ..observability import _help
     from ..observability.metrics import global_registry
     reg = global_registry()
+    vgauge = reg.gauge("serving.kernel.version",
+                       _help("serving.kernel.version"))
     if kernel:
         KERNEL_DISPATCHES += 1
-        reg.counter("serving.kernel.traced",
-                    _help("serving.kernel.traced")).inc()
+        version = version or "v1"
+        KERNEL_VERSIONS[version] = KERNEL_VERSIONS.get(version, 0) + 1
+        c = reg.counter("serving.kernel.traced",
+                        _help("serving.kernel.traced"))
+        c.inc()                             # unlabeled aggregate
+        c.labels(version=version).inc()     # per-generation series
+        vgauge.set(2 if version == "v2" else 1)
         from ..ops.pallas import paged as _paged
         reg.gauge("serving.kernel.interpret",
                   _help("serving.kernel.interpret")).set(
@@ -310,6 +399,8 @@ def _record_dispatch(kernel, reason=None):
                         _help("serving.kernel.fallback"))
         c.inc()                             # unlabeled aggregate
         c.labels(reason=reason).inc()       # per-reason series
+        c.labels(version="reference").inc()
+        vgauge.set(0)
 
 
 def kernel_dispatch_stats():
@@ -317,6 +408,7 @@ def kernel_dispatch_stats():
     return {"kernel_dispatches": KERNEL_DISPATCHES,
             "fallback_dispatches": FALLBACK_DISPATCHES,
             "fallback_reasons": dict(FALLBACK_REASONS),
+            "kernel_versions": dict(KERNEL_VERSIONS),
             "mode": paged_kernel_mode()}
 
 
@@ -370,11 +462,14 @@ def paged_attention(q, k_pool, v_pool, block_table, q_positions,
                          if transform else "unsupported")
         return paged_attention_reference(q, k_pool, v_pool, block_table,
                                          q_positions, k_scale, v_scale)
-    from ..ops.pallas.paged import ragged_paged_attention
-    _record_dispatch(kernel=True)
-    return ragged_paged_attention(q, k_pool, v_pool, block_table,
-                                  q_positions, k_scale=k_scale,
-                                  v_scale=v_scale)
+    from ..ops.pallas.paged import (ragged_paged_attention,
+                                    ragged_paged_attention_v2)
+    version = _kernel_version_for(mode, k_pool, block_table)
+    _record_dispatch(kernel=True, version=version)
+    fn = (ragged_paged_attention_v2 if version == "v2"
+          else ragged_paged_attention)
+    return fn(q, k_pool, v_pool, block_table, q_positions,
+              k_scale=k_scale, v_scale=v_scale)
 
 
 def write_block_kv(pool, vals, block_idx, offset):
@@ -421,6 +516,12 @@ class PagedKVCache:
     above is mesh-agnostic by construction (a block id means the same
     rows on every shard).
 
+    `num_kv_heads` (GQA, ISSUE 16) shrinks the pools' head dim to H_kv
+    (H % H_kv == 0; `num_heads` stays the query head count as
+    metadata). Every byte number this class reports — pool_bytes,
+    scale_bytes, shard_pool_bytes, dense_pool_bytes — is H_kv-true,
+    and under a mesh it is H_kv the axis must divide.
+
     `kv_dtype` selects the POOL storage format on top of `dtype` (the
     compute/activation dtype the dense path would use):
 
@@ -434,7 +535,7 @@ class PagedKVCache:
 
     def __init__(self, num_layers, num_heads, head_dim, num_blocks,
                  block_size=16, dtype=jnp.float32, mesh=None, axis="tp",
-                 kv_dtype=None):
+                 kv_dtype=None, num_kv_heads=None):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is reserved NULL)")
         if kv_dtype not in (None, "bf16", "int8"):
@@ -446,6 +547,18 @@ class PagedKVCache:
         self.head_dim = int(head_dim)
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
+        # GQA: pools physically hold num_kv_heads <= num_heads heads;
+        # num_heads stays the QUERY head count (metadata for capacity
+        # math and the attention contract above the cache)
+        self.num_kv_heads = (int(num_kv_heads) if num_kv_heads
+                             else self.num_heads)
+        if (self.num_kv_heads < 1
+                or self.num_heads % self.num_kv_heads):
+            raise ValueError(
+                f"num_kv_heads={self.num_kv_heads} must divide "
+                f"num_heads={self.num_heads}: grouped-query attention "
+                f"maps each group of H/H_kv query heads onto one "
+                f"shared KV head, so the group size must be integral")
         self.kv_dtype = kv_dtype
         self.quantized = kv_dtype == "int8"
         # compute_dtype: what a dequantized read yields (and what the
@@ -471,11 +584,13 @@ class PagedKVCache:
                 f"axis {axis!r} is not a mesh axis (mesh has "
                 f"{mesh.axis_names}) — pass axis=<the mesh's axis name>")
         self.tp = int(mesh.shape[axis]) if mesh is not None else 1
-        if self.num_heads % self.tp:
+        if self.num_kv_heads % self.tp:
             raise ValueError(
                 f"mesh axis {axis!r} size {self.tp} must divide "
-                f"num_heads={self.num_heads} (head-sharded pools)")
-        shape = (self.num_blocks, self.num_heads, self.block_size,
+                f"num_kv_heads={self.num_kv_heads} (head-sharded "
+                f"pools shard the KV heads; with GQA that is H_kv, "
+                f"not the {self.num_heads} query heads)")
+        shape = (self.num_blocks, self.num_kv_heads, self.block_size,
                  self.head_dim)
         sshape = shape[:3]          # the (N, H, bs) scale pools
         if mesh is None:
@@ -532,25 +647,29 @@ class PagedKVCache:
         mesh holds in total, identical to the single-device footprint
         (sharding splits it, never copies). Capacity math keys off this
         number, so quantized pools must report their true int8+scales
-        size, never the dense equivalent."""
-        per = (self.num_blocks * self.num_heads * self.block_size
+        size, never the dense equivalent — and GQA pools their true
+        H_kv row count, never the H-head overcount."""
+        per = (self.num_blocks * self.num_kv_heads * self.block_size
                * self.head_dim * np.dtype(self.dtype).itemsize)
         return 2 * self.num_layers * per + self.scale_bytes()
 
     def scale_bytes(self):
-        """Bytes of the (N, H, bs) f32 scale pools across k+v and every
-        layer; 0 for dense pools."""
+        """Bytes of the (N, H_kv, bs) f32 scale pools across k+v and
+        every layer; 0 for dense pools."""
         if not self.quantized:
             return 0
-        return (2 * self.num_layers * self.num_blocks * self.num_heads
-                * self.block_size * 4)
+        return (2 * self.num_layers * self.num_blocks
+                * self.num_kv_heads * self.block_size * 4)
 
     def dense_pool_bytes(self, dtype=None):
-        """What the SAME block count would cost dense in `dtype`
-        (default: this cache's compute dtype) — the honest denominator
-        for the quantization capacity ratio."""
+        """What the SAME block count would cost unquantized in `dtype`
+        (default: this cache's compute dtype) at this cache's OWN head
+        geometry (H_kv for GQA) — the honest denominator for the
+        quantization capacity ratio. The GQA saving is a separate
+        factor: multiply by num_heads/num_kv_heads for the MHA-dense
+        equivalent."""
         dt = dtype if dtype is not None else self.compute_dtype
-        per = (self.num_blocks * self.num_heads * self.block_size
+        per = (self.num_blocks * self.num_kv_heads * self.block_size
                * self.head_dim * np.dtype(dt).itemsize)
         return 2 * self.num_layers * per
 
@@ -700,16 +819,19 @@ class PagedKVCache:
         bf16 prefill tier feeding an f32 decode tier is legitimate);
         quantized<->quantized carries the scale rows alongside the
         codes in the same jitted transfer."""
-        if (src_cache.num_layers, src_cache.num_heads,
+        src_kv = getattr(src_cache, "num_kv_heads", src_cache.num_heads)
+        if (src_cache.num_layers, src_cache.num_heads, src_kv,
                 src_cache.head_dim, src_cache.block_size) != \
-                (self.num_layers, self.num_heads, self.head_dim,
-                 self.block_size):
+                (self.num_layers, self.num_heads, self.num_kv_heads,
+                 self.head_dim, self.block_size):
             raise ValueError(
                 f"adopt_block_from needs matching pool geometry; got "
                 f"src (L={src_cache.num_layers}, H={src_cache.num_heads},"
-                f" D={src_cache.head_dim}, bs={src_cache.block_size}) vs "
+                f" H_kv={src_kv}, D={src_cache.head_dim}, "
+                f"bs={src_cache.block_size}) vs "
                 f"dst (L={self.num_layers}, H={self.num_heads}, "
-                f"D={self.head_dim}, bs={self.block_size})")
+                f"H_kv={self.num_kv_heads}, D={self.head_dim}, "
+                f"bs={self.block_size})")
         if getattr(src_cache, "quantized", False) != self.quantized:
             def _fmt(c):
                 return ("int8+scales" if getattr(c, "quantized", False)

@@ -83,6 +83,7 @@ def build_draft_step(model, block_size, k):
     from .engine import _fused_step_body
     params, cfg = model.params, model.cfg
     h_, d = model.num_heads, model.head_dim
+    kv_ = getattr(model, "num_kv_heads", model.num_heads)
 
     def _ident(z):
         return z
@@ -94,7 +95,7 @@ def build_draft_step(model, block_size, k):
         # needs — no per-column projection here) is each decode lane's
         # first proposal d_1
         pools, cur, cur_lp = _fused_step_body(
-            params, cfg, block_size, h_, d, _ident,
+            params, cfg, block_size, h_, kv_, d, _ident,
             pools, tokens, positions, valid, tables)
         s, c = tokens.shape
         last = jnp.clip(valid.sum(1) - 1, 0, c - 1)
@@ -107,7 +108,7 @@ def build_draft_step(model, block_size, k):
             pos_i = base + i - 1
             v_i = (spec_go & (pos_i < limits))[:, None]
             pools, cur, cur_lp = _fused_step_body(
-                params, cfg, block_size, h_, d, _ident,
+                params, cfg, block_size, h_, kv_, d, _ident,
                 pools, cur[:, None], pos_i[:, None].astype(jnp.int32),
                 v_i, tables)
             props.append(cur)

@@ -443,3 +443,60 @@ def test_tp2_int8_pools_bitwise_vs_tp1_int8(trained):
     assert st["kv_quant"]["pool_bytes"] < \
         st["kv_quant"]["dense_equiv_bytes"]
     srv.close()
+
+
+def test_tp2_gqa_bitwise_vs_tp1_gqa(trained):
+    """ISSUE 16: grouped-query attention composes with the mesh. The
+    pools shard on the KV head axis (H_kv, not the query heads), and
+    the contiguous-group convention keeps each device's local q-head
+    groups aligned with its local KV heads — so a tp=2 GQA server must
+    reproduce the tp=1 GQA server's ids BITWISE on the acceptance
+    stream, with (N, H_kv/tp, bs, D) pool shards and H_kv-true byte
+    math."""
+    cfg, params = trained
+    kv = 2
+    gqa_params = gpt.gqa_slice_kv_params(params, cfg, kv)
+    gqa_cfg = gpt.GPTConfig(
+        **{k: getattr(cfg, k)
+           for k in ("vocab_size", "hidden_size", "num_layers",
+                     "num_heads", "inner_size", "max_position",
+                     "dropout")}, kv_heads=kv)
+
+    ref_srv = GenerationServer(GPTServingModel(gqa_params, gqa_cfg),
+                               num_slots=3, block_size=8,
+                               max_context=64, chunk=4, start=False)
+    ref_ids = _drive_staggered_stream(ref_srv)
+    assert ref_srv.get_stats()["kernel"]["engaged"] is True
+    ref_srv.close()
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    srv = GenerationServer(GPTServingModel(gqa_params, gqa_cfg),
+                           num_slots=3, block_size=8, max_context=64,
+                           chunk=4, start=False, mesh=mesh)
+    got_ids = _drive_staggered_stream(srv)
+    assert got_ids == ref_ids
+    st = srv.get_stats()
+    assert st["fused_step_signatures"] == 1, st
+    assert st["kernel"]["engaged"] is True, st["kernel"]
+    assert st["kernel"]["fallback_dispatches"] == 0
+    assert st["blocks_free"] == st["blocks_total"]
+    # the pool shards carry H_kv/tp heads — ONE KV head per device
+    # here, while each device computes 2 query heads against it
+    kp = srv.cache.pools[0]["k"]
+    shard = kp.sharding.shard_shape(tuple(kp.shape))
+    assert shard == (srv.cache.num_blocks, kv // 2,
+                     srv.cache.block_size, cfg.hidden_size
+                     // cfg.num_heads)
+    assert srv.cache.shard_pool_bytes() * 2 == srv.cache.pool_bytes()
+    srv.close()
+
+    # tp must divide H_kv, not just H: 4 devices over 2 KV heads is
+    # rejected at construction with the kv-heads message
+    mesh4 = Mesh(np.array(jax.devices()[:4]), ("tp",))
+    with pytest.raises(ValueError, match="divide kv_heads"):
+        GenerationServer(GPTServingModel(gqa_params, gqa_cfg),
+                         num_slots=3, block_size=8, max_context=64,
+                         chunk=4, start=False, mesh=mesh4)
+    with pytest.raises(ValueError, match="divide num_kv_heads"):
+        kvc.PagedKVCache(2, 4, 8, 9, block_size=4, mesh=mesh4,
+                         num_kv_heads=2)

@@ -182,6 +182,7 @@ SUITE_STEPS = (
     ("telemetry_compare", "bench_telemetry.json", None),
     ("prefix_compare", "bench_prefix.json", None),
     ("quant_compare", "bench_quant.json", None),
+    ("kernel_v2_compare", "bench_kernel_v2.json", None),
     ("fleet_compare", "bench_fleet.json", None),
     ("chaos_recovery", "bench_chaos.json", None),
     ("trace_compare", "bench_trace.json", None),
@@ -376,6 +377,19 @@ def run_suite():
                  env={"JAX_PLATFORMS": "cpu",
                       "BENCH_QUANT_COMPARE": "1"},
                  timeout_s=900, stdout_path="bench_quant.json")
+    # 1f2c. paged kernel v2 comparison (ISSUE 16): the streaming v2
+    #     kernel vs v1 vs the reference on identical greedy streams
+    #     (ids must agree across all three) + the GQA capacity ratio
+    #     at the same HBM budget (acceptance: ~2x admitted for
+    #     H_kv=H/2, ids bitwise vs repeat-KV dense)
+    if _artifact_ok("bench_kernel_v2.json"):
+        log("step kernel_v2_compare: already landed in a prior cycle "
+            "— skipping")
+    else:
+        run_step("kernel_v2_compare", [py, bench],
+                 env={"JAX_PLATFORMS": "cpu",
+                      "BENCH_KERNEL_V2_COMPARE": "1"},
+                 timeout_s=900, stdout_path="bench_kernel_v2.json")
     # 1f3. fleet-router comparison (ISSUE 11): affinity vs random
     #     routing over a long-tail multi-tenant prefix storm (fleet
     #     hit rate, blocks/request) + p99 TTFT under overload with vs
