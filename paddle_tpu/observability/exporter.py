@@ -1,5 +1,5 @@
 """Stdlib-only HTTP telemetry endpoint: /metrics, /healthz, /slo,
-/memory, /trace.
+/memory, /trace, /series, /alerts, /tenants.
 
 Any component can mount one — ``GenerationServer.serve_metrics(port=...)``
 and ``Executor.serve_metrics(port=...)`` wrap this; a bare
@@ -24,6 +24,16 @@ scrape target on every host, not a metrics SDK.
   ring — trace id, hops, lineage, outcome per request; see
   docs/observability.md "Fleet tracing"). Components without a trace
   plane serve an empty ring.
+- ``GET /series`` — JSON time-series payload (``series_fn()``; the
+  engine mounts its SeriesStore, the fleet router its merged
+  fleet+replica view incl. dead replicas' snapshots); empty schema
+  shape when the component has no signal plane.
+- ``GET /alerts`` — JSON alert lifecycle record (``alerts_fn()``; the
+  fleet router mounts its AlertManager — the ROADMAP-5 autoscaler's
+  input); empty schema shape otherwise.
+- ``GET /tenants`` — JSON per-tenant cost attribution
+  (``tenants_fn()``; ``{}`` when the component tracks none). See
+  docs/observability.md "Fleet health signals".
 
 Security note: binds 127.0.0.1 by default — the exposition includes
 program/shape names and the SLO surface leaks traffic patterns. Bind a
@@ -99,11 +109,46 @@ class _Handler(BaseHTTPRequestHandler):
                 body = (json.dumps(payload, sort_keys=True) + "\n").encode()
                 ctype = "application/json"
                 code = 200
+            elif path == "/series":
+                # the fleet-health time-series store
+                # (observability/timeseries.py); components without a
+                # signal plane serve the empty schema shape
+                if owner.series_fn is not None:
+                    payload = owner.series_fn()
+                else:
+                    payload = None
+                if payload is None:
+                    from .timeseries import empty_series
+                    payload = empty_series()
+                body = (json.dumps(payload, sort_keys=True) + "\n").encode()
+                ctype = "application/json"
+                code = 200
+            elif path == "/alerts":
+                # the alert rule engine's latched lifecycle record
+                # (observability/alerts.py) — the ROADMAP-5
+                # autoscaler's input
+                if owner.alerts_fn is not None:
+                    payload = owner.alerts_fn()
+                else:
+                    payload = None
+                if payload is None:
+                    from .alerts import empty_alerts
+                    payload = empty_alerts()
+                body = (json.dumps(payload, sort_keys=True) + "\n").encode()
+                ctype = "application/json"
+                code = 200
+            elif path == "/tenants":
+                payload = (owner.tenants_fn()
+                           if owner.tenants_fn is not None else {})
+                body = (json.dumps(payload, sort_keys=True) + "\n").encode()
+                ctype = "application/json"
+                code = 200
             else:
                 body = (json.dumps(
                     {"error": "not found",
                      "endpoints": ["/metrics", "/healthz", "/slo",
-                                   "/memory", "/trace"]})
+                                   "/memory", "/trace", "/series",
+                                   "/alerts", "/tenants"]})
                     + "\n").encode()
                 ctype = "application/json"
                 code = 404
@@ -127,7 +172,8 @@ class TelemetryServer:
 
     def __init__(self, registry=None, host="127.0.0.1", port=0,
                  slo_fn=None, health_fn=None, memory_fn=None,
-                 trace_fn=None):
+                 trace_fn=None, series_fn=None, alerts_fn=None,
+                 tenants_fn=None):
         self.registry = registry if registry is not None \
             else global_registry()
         self.slo_fn = slo_fn
@@ -138,6 +184,12 @@ class TelemetryServer:
         # /trace body (the fleet router's completed-trace ring); None
         # serves an always-probeable empty ring
         self.trace_fn = trace_fn
+        # fleet health signals (ISSUE 17): /series time-series store,
+        # /alerts rule engine, /tenants cost attribution; None serves
+        # the schema's empty shape (series/alerts) or {} (tenants)
+        self.series_fn = series_fn
+        self.alerts_fn = alerts_fn
+        self.tenants_fn = tenants_fn
         self._requested = (host, int(port))
         self._httpd = None
         self._thread = None
@@ -147,7 +199,8 @@ class TelemetryServer:
         self._requests = self.registry.counter(
             "exporter.requests", _help("exporter.requests"))
 
-    _KNOWN_PATHS = ("/metrics", "/healthz", "/slo", "/memory", "/trace")
+    _KNOWN_PATHS = ("/metrics", "/healthz", "/slo", "/memory", "/trace",
+                    "/series", "/alerts", "/tenants")
 
     def _count(self, path, code):
         # unknown paths collapse to one label value: a crawler probing
@@ -350,10 +403,13 @@ def check_remount(live, port, host):
 
 
 def serve_metrics(port=0, host="127.0.0.1", registry=None, slo_fn=None,
-                  health_fn=None, memory_fn=None, trace_fn=None):
+                  health_fn=None, memory_fn=None, trace_fn=None,
+                  series_fn=None, alerts_fn=None, tenants_fn=None):
     """Mount and start a telemetry endpoint; returns the running
     TelemetryServer (``.port`` holds the bound port, ``.close()`` stops
     it). Binds loopback by default — see the module security note."""
     return TelemetryServer(registry=registry, host=host, port=port,
                            slo_fn=slo_fn, health_fn=health_fn,
-                           memory_fn=memory_fn, trace_fn=trace_fn).start()
+                           memory_fn=memory_fn, trace_fn=trace_fn,
+                           series_fn=series_fn, alerts_fn=alerts_fn,
+                           tenants_fn=tenants_fn).start()

@@ -286,6 +286,27 @@ METRIC_SPECS = [
      "generated tokens/sec over the last completed SLO window "
      "(label: server)"),
     ("serving.slo.windows", "counter", "completed SLO digest windows"),
+    ("serving.series.points", "counter",
+     "time-series points recorded (all stores)"),
+    ("serving.series.dropped_points", "counter",
+     "time-series points evicted by ring wrap (all stores)"),
+    ("serving.alerts.fired", "counter",
+     "alert rule transitions to firing"),
+    ("serving.alerts.resolved", "counter",
+     "alert rule transitions firing -> resolved"),
+    ("serving.alerts.active", "gauge",
+     "alert rules currently firing (label: manager; plus an unlabeled "
+     "aggregate)"),
+    ("serving.tenant.requests", "counter",
+     "finished requests per tenant (label: tenant; bounded "
+     "cardinality, overflow collapses to <other>, untagged to <anon>)"),
+    ("serving.tenant.generated_tokens", "counter",
+     "decode tokens generated per tenant (label: tenant)"),
+    ("serving.tenant.block_iterations", "counter",
+     "KV block-residency per tenant in block*iterations — blocks "
+     "reserved x engine iterations held (label: tenant)"),
+    ("serving.tenant.sheds", "counter",
+     "router admission sheds per tenant (label: tenant)"),
     ("serving.requests_traced", "counter",
      "requests whose lifecycle span tree was emitted into the trace "
      "recorder (PADDLE_TPU_TRACE_REQUESTS sampling knob)"),

@@ -50,10 +50,11 @@ import warnings
 
 from .metrics import global_registry
 from .sketch import QuantileSketch
+from .timeseries import SeriesStore
 from .tracing import get_recorder
 
-__all__ = ["ServingTelemetry", "SLOTracker", "FlightRecorder",
-           "trace_request_mode"]
+__all__ = ["ServingTelemetry", "SLOTracker", "TenantLedger",
+           "FlightRecorder", "trace_request_mode"]
 
 
 def _help(name):
@@ -340,8 +341,11 @@ class SLOTracker:
     Executor's per-instance gauge-label convention). drop_gauges()
     removes this tracker's series from the process-wide registry."""
 
+    #: completed windows retained in snapshot()["recent_windows"]
+    RECENT_WINDOWS = 32
+
     def __init__(self, clock=time.monotonic, window_s=60.0,
-                 compression=128, labels=None):
+                 compression=128, labels=None, recent_windows=None):
         self._clock = clock
         self.window_s = float(window_s)
         self._compression = int(compression)
@@ -356,6 +360,11 @@ class SLOTracker:
         self._win_tokens = 0
         self._cum_tokens = 0
         self._last_window = None
+        self._last_win_sketches = None      # the previous window's raw
+        #                                     digests (window_digest())
+        self._recent = collections.deque(
+            maxlen=max(1, int(recent_windows if recent_windows
+                              is not None else self.RECENT_WINDOWS)))
         self.windows_completed = 0
         reg = global_registry()
         self._g_quant = reg.gauge("serving.slo.quantile_ms",
@@ -398,6 +407,45 @@ class SLOTracker:
         with self._lock:
             return QuantileSketch.from_dict(self._cum[metric].to_dict())
 
+    def window_digest(self, metric):
+        """A COPY of the last-completed-window sketch merged with the
+        live window for `metric` — the rolling ~2-window view the
+        fleet's burn-rate SERIES is computed from. Unlike the
+        cumulative digest (which never forgets a storm), this one
+        decays within two window lengths of recovery, so an alert on
+        it can actually resolve."""
+        with self._lock:
+            d = QuantileSketch.from_dict(self._win[metric].to_dict())
+            last = self._last_win_sketches
+            if last is not None and last[metric].count:
+                d.merge(QuantileSketch.from_dict(
+                    last[metric].to_dict()))
+            return d
+
+    def window_frac_over(self, metric, target):
+        """(fraction of rolling-window samples ABOVE target, sample
+        count) over the same ~2-window view as window_digest, but
+        with NO sketch copies or merges — rank() reads the sketches
+        in place. This is the router's per-heartbeat burn-rate feed;
+        the copy-and-merge cost of window_digest measured out as a
+        multi-percent serving tax at CPU-tiny step times
+        (perf/bench_signals.json). Returns (None, 0) when the window
+        holds no samples. Cross-sketch combination is exact: rank is
+        a fraction of mass, so the union's rank is the count-weighted
+        mean of member ranks."""
+        with self._lock:
+            live = self._win[metric]
+            last = (self._last_win_sketches[metric]
+                    if self._last_win_sketches is not None else None)
+            n = live.count + (last.count if last is not None else 0)
+            if not n:
+                return None, 0
+            over = 0.0
+            for sk in (live, last):
+                if sk is not None and sk.count:
+                    over += (1.0 - sk.rank(float(target))) * sk.count
+            return over / n, n
+
     def maybe_roll(self):
         """Close the window if window_s elapsed; publish its quantile
         gauges. Returns True when a window completed."""
@@ -425,8 +473,15 @@ class SLOTracker:
             summary["tokens_per_s"] = round(tps, 3)
             summary["elapsed_s"] = round(elapsed, 6)
             summary["tokens"] = self._win_tokens
+            summary["closed_at"] = round(now, 6)
             self._g_tps.labels(**self.labels).set(round(tps, 3))
             self._last_window = summary
+            self._recent.append(summary)
+            # keep the closed window's raw sketches (not just the
+            # summary): window_digest() merges them with the live
+            # window so windowed burn rates never go blind at a
+            # window boundary
+            self._last_win_sketches = self._win
             self._win = {m: QuantileSketch(self._compression)
                          for m in SLO_METRICS}
             self._win_tokens = 0
@@ -460,6 +515,7 @@ class SLOTracker:
                                           3),
                 },
                 "last_window": self._last_window,
+                "recent_windows": list(self._recent),
                 "current_window": {
                     **{m: self._win[m].summary() for m in SLO_METRICS
                        if self._win[m].count},
@@ -511,6 +567,177 @@ class SLOTracker:
 
 
 # ---------------------------------------------------------------------------
+# per-tenant cost attribution
+# ---------------------------------------------------------------------------
+
+class TenantLedger:
+    """Per-tenant cost vectors + SLO digests, bounded cardinality.
+
+    Requests carry an opaque ``tenant`` identity (``submit(tenant=)``,
+    threaded router→engine→scheduler→telemetry); this ledger is where
+    the costs they incur are attributed, using data that already
+    exists on the request path — prefill/decode tokens, KV
+    block-residency in block·iterations (the honest capacity unit:
+    blocks held × engine iterations held), queue wait, handoff bytes,
+    sheds and failovers. ``get_stats()["tenants"]`` and the
+    ``/tenants`` endpoint serve the snapshot, so "which tenant is
+    eating the fleet" is a one-scrape question.
+
+    Cardinality is bounded the exporter's way: beyond ``max_tenants``
+    distinct identities, new tenants collapse into ``<other>`` (and
+    the collapse is counted) — a tenant id is client-supplied input
+    and must never grow unbounded label sets. ``None`` attributes to
+    ``<anon>``, so un-tenanted traffic is still accounted.
+
+    Latency digests (ttft/e2e per tenant, mergeable QuantileSketch)
+    use a smaller default compression than the global SLOTracker:
+    there are up to max_tenants × 2 of them per server."""
+
+    ANON = "<anon>"
+    OTHER = "<other>"
+    #: per-tenant latency digests (per-token metrics stay global —
+    #: a per-token per-tenant sketch add would bust the hot path)
+    SLO = ("ttft_ms", "e2e_ms")
+
+    def __init__(self, max_tenants=32, compression=64):
+        self._max = max(1, int(max_tenants))
+        self._compression = int(compression)
+        self._lock = threading.Lock()
+        self._t = {}                # tenant key -> cost dict
+        self._slo = {}              # tenant key -> {metric: sketch}
+        self.collapsed = 0
+        reg = global_registry()
+        self._m_requests = reg.counter(
+            "serving.tenant.requests", _help("serving.tenant.requests"))
+        self._m_tokens = reg.counter(
+            "serving.tenant.generated_tokens",
+            _help("serving.tenant.generated_tokens"))
+        self._m_blocks = reg.counter(
+            "serving.tenant.block_iterations",
+            _help("serving.tenant.block_iterations"))
+        self._m_sheds = reg.counter(
+            "serving.tenant.sheds", _help("serving.tenant.sheds"))
+
+    def _key_locked(self, tenant):
+        key = self.ANON if tenant is None else str(tenant)
+        if key not in self._t:
+            if len(self._t) >= self._max and key != self.OTHER:
+                self.collapsed += 1
+                return self._key_locked(self.OTHER)
+            self._t[key] = {"requests": 0, "prefill_tokens": 0,
+                            "decode_tokens": 0, "block_iterations": 0,
+                            "queue_wait_ms": 0.0, "handoff_bytes": 0,
+                            "sheds": 0, "failovers": 0}
+            self._slo[key] = {m: QuantileSketch(self._compression)
+                              for m in self.SLO}
+        return key
+
+    # -- write side ---------------------------------------------------------
+    def finish(self, tenant, prefill_tokens=0, decode_tokens=0,
+               block_iterations=0, queue_wait_ms=0.0):
+        """One finished request's engine-side cost vector (every
+        outcome — a cancelled request's prefill still cost flops)."""
+        with self._lock:
+            key = self._key_locked(tenant)
+            c = self._t[key]
+            c["requests"] += 1
+            c["prefill_tokens"] += int(prefill_tokens)
+            c["decode_tokens"] += int(decode_tokens)
+            c["block_iterations"] += int(block_iterations)
+            c["queue_wait_ms"] += float(queue_wait_ms)
+        self._m_requests.labels(tenant=key).inc()
+        self._m_requests.inc()
+        if decode_tokens:
+            self._m_tokens.labels(tenant=key).inc(int(decode_tokens))
+            self._m_tokens.inc(int(decode_tokens))
+        if block_iterations:
+            self._m_blocks.labels(tenant=key).inc(int(block_iterations))
+            self._m_blocks.inc(int(block_iterations))
+
+    def observe(self, tenant, metric, value_ms):
+        """One latency sample into the tenant's digest (SLO tuple)."""
+        with self._lock:
+            key = self._key_locked(tenant)
+            self._slo[key][metric].add(float(value_ms))
+
+    def count(self, tenant, kind, n=1):
+        """Router-side cost events: sheds / failovers /
+        handoff_bytes."""
+        with self._lock:
+            key = self._key_locked(tenant)
+            self._t[key][kind] += n
+        if kind == "sheds":
+            self._m_sheds.labels(tenant=key).inc(n)
+            self._m_sheds.inc(n)
+
+    # -- read side ----------------------------------------------------------
+    def digest(self, tenant, metric):
+        """Mergeable COPY of one tenant digest (fleet aggregation)."""
+        with self._lock:
+            key = self.ANON if tenant is None else str(tenant)
+            sk = self._slo.get(key)
+            if sk is None:
+                return QuantileSketch(self._compression)
+            return QuantileSketch.from_dict(sk[metric].to_dict())
+
+    def snapshot(self):
+        with self._lock:
+            tenants = {}
+            for key in sorted(self._t):
+                entry = dict(self._t[key],
+                             queue_wait_ms=round(
+                                 self._t[key]["queue_wait_ms"], 3))
+                entry["slo"] = {m: self._slo[key][m].summary()
+                                for m in self.SLO
+                                if self._slo[key][m].count}
+                tenants[key] = entry
+            return {"max_tenants": self._max,
+                    "collapsed": self.collapsed,
+                    "tenants": tenants}
+
+
+def aggregate_tenant_snapshots(snapshots):
+    """Sum N TenantLedger.snapshot() payloads into one fleet view:
+    scalar costs add; per-tenant SLO summary fields merge
+    conservatively (count sums, min takes min, max/avg/quantiles take
+    the worst replica — the honest cross-replica read without the raw
+    sketches). The router's /tenants endpoint layers its own
+    router-side costs (sheds/failovers/handoff_bytes) on top."""
+    out = {"max_tenants": 0, "collapsed": 0, "tenants": {}}
+    for snap in snapshots:
+        if not snap:
+            continue
+        out["max_tenants"] = max(out["max_tenants"],
+                                 snap.get("max_tenants", 0))
+        out["collapsed"] += snap.get("collapsed", 0)
+        for key, entry in snap.get("tenants", {}).items():
+            agg = out["tenants"].setdefault(
+                key, {"requests": 0, "prefill_tokens": 0,
+                      "decode_tokens": 0, "block_iterations": 0,
+                      "queue_wait_ms": 0.0, "handoff_bytes": 0,
+                      "sheds": 0, "failovers": 0, "slo": {}})
+            for k, v in entry.items():
+                if k != "slo":
+                    agg[k] = round(agg.get(k, 0) + v, 3)
+                    continue
+                for m, s in v.items():
+                    cur = agg["slo"].setdefault(m, {})
+                    for f, fv in s.items():
+                        if fv is None:
+                            continue
+                        old = cur.get(f)
+                        if old is None:
+                            cur[f] = fv
+                        elif f == "count":
+                            cur[f] = round(old + fv, 6)
+                        elif f == "min":
+                            cur[f] = min(old, fv)
+                        else:
+                            cur[f] = max(old, fv)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # per-request lifecycle state
 # ---------------------------------------------------------------------------
 
@@ -518,7 +745,7 @@ class _ReqTrace:
     __slots__ = ("rid", "sampled", "submit_perf", "admit_perf",
                  "admit_iteration", "slot", "chunks", "first_token_perf",
                  "first_token_iteration", "last_token_perf", "tokens",
-                 "trace_id", "hop")
+                 "trace_id", "hop", "tenant", "blocks", "queue_wait_ms")
 
     def __init__(self, rid, sampled, submit_perf):
         self.rid = rid
@@ -526,6 +753,9 @@ class _ReqTrace:
         self.submit_perf = submit_perf
         self.trace_id = None    # fleet trace correlation (router-minted
         self.hop = 0            # TraceContext; None outside a fleet)
+        self.tenant = None      # cost-attribution identity
+        self.blocks = 0         # KV blocks reserved at admission
+        self.queue_wait_ms = 0.0
         self.admit_perf = None
         self.admit_iteration = None
         self.slot = None
@@ -544,10 +774,20 @@ class ServingTelemetry:
 
     def __init__(self, clock=None, window_s=60.0, sample=None,
                  flight_capacity=256, flight_dir=None, deadline_storm=3,
-                 compression=128, recorder=None):
+                 compression=128, recorder=None, series_capacity=512,
+                 max_tenants=32):
         self.mode, self.sample_rate = trace_request_mode(sample)
-        self.slo = SLOTracker(clock=clock or time.monotonic,
+        self._clock = clock or time.monotonic
+        self.slo = SLOTracker(clock=self._clock,
                               window_s=window_s, compression=compression)
+        # the signal plane: per-iteration scalars + SLO window closes
+        # land here as (t, value) points on the SAME injected clock;
+        # series_capacity=0 switches the store off (the bench's
+        # signals-off arm)
+        self.series = (SeriesStore(capacity=series_capacity,
+                                   label=self.slo.labels.get("server"))
+                       if series_capacity else None)
+        self.tenants = TenantLedger(max_tenants=max_tenants)
         self.flight = FlightRecorder(capacity=flight_capacity,
                                      out_dir=flight_dir)
         self.deadline_storm = max(1, int(deadline_storm))
@@ -585,7 +825,7 @@ class ServingTelemetry:
         self._rec = recorder
 
     # -- request lifecycle hooks (scheduler/engine) ------------------------
-    def on_submit(self, rid, ctx=None):
+    def on_submit(self, rid, ctx=None, tenant=None):
         """`ctx` is a fleet TraceContext: its router-minted sampling
         verdict WINS over this engine's own mode — the decision is
         made once per request so every hop traces or none does (an
@@ -596,10 +836,11 @@ class ServingTelemetry:
         if ctx is not None:
             st.trace_id = ctx.trace_id
             st.hop = ctx.hop
+        st.tenant = tenant
         with self._lock:
             self._req[rid] = st
 
-    def on_admit(self, rid, slot, iteration, queue_wait_ms):
+    def on_admit(self, rid, slot, iteration, queue_wait_ms, blocks=0):
         self._m_queue_wait.observe(queue_wait_ms)
         self.slo.observe("queue_wait_ms", queue_wait_ms)
         with self._lock:
@@ -609,6 +850,8 @@ class ServingTelemetry:
             st.admit_perf = time.perf_counter()
             st.admit_iteration = iteration
             st.slot = slot
+            st.blocks = int(blocks)
+            st.queue_wait_ms = float(queue_wait_ms)
 
     def on_prefill_chunk(self, rid, iteration, ntokens):
         # lock-free: dict.get is GIL-atomic and every mutation of an
@@ -628,6 +871,7 @@ class ServingTelemetry:
         st.first_token_perf = st.last_token_perf = time.perf_counter()
         st.first_token_iteration = iteration
         st.tokens += 1
+        self.tenants.observe(st.tenant, "ttft_ms", ttft_ms)
 
     def on_token(self, rid, iteration, itl_ms):
         self.slo.observe_token("itl_ms", itl_ms)
@@ -651,7 +895,22 @@ class ServingTelemetry:
             self.slo.observe("e2e_ms", e2e_ms)
         with self._lock:
             st = self._req.pop(rid, None)
-        if st is None or not st.sampled or not self._rec.enabled:
+        if st is None:
+            return
+        # tenant cost attribution happens for EVERY finished request
+        # (a cancelled request's prefill still cost flops), regardless
+        # of the trace-sampling verdict
+        iters = (max(int(iteration) - int(st.admit_iteration) + 1, 1)
+                 if st.admit_iteration is not None else 0)
+        self.tenants.finish(
+            st.tenant,
+            prefill_tokens=sum(c[1] for c in st.chunks),
+            decode_tokens=st.tokens,
+            block_iterations=st.blocks * iters,
+            queue_wait_ms=st.queue_wait_ms)
+        if outcome == "retire" and e2e_ms is not None:
+            self.tenants.observe(st.tenant, "e2e_ms", e2e_ms)
+        if not st.sampled or not self._rec.enabled:
             return
         self._emit_tree(st, iteration, outcome, reason, prompt_len,
                         generated)
@@ -727,7 +986,24 @@ class ServingTelemetry:
             # the **flight_fields kwargs dict is fresh per call; adopt
             # it as the flight entry instead of repacking it
             self.flight.record_fields(iteration, flight_fields)
-        self.slo.maybe_roll()
+        rolled = self.slo.maybe_roll()
+        if self.series is not None:
+            if values is not None:
+                step_ms, qd = values[0], values[6]
+                slots, in_use = values[7], values[9]
+            else:
+                step_ms = flight_fields.get("step_ms", 0.0)
+                qd = flight_fields.get("queue_depth", 0)
+                slots = flight_fields.get("active_slots", 0)
+                in_use = flight_fields.get("blocks_in_use", 0)
+            self.series.observe_many(
+                self._clock(),
+                (("engine.step_ms", step_ms),
+                 ("engine.queue_depth", qd),
+                 ("engine.active_slots", slots),
+                 ("engine.blocks_in_use", in_use)))
+            if rolled:
+                self._series_window_close()
         if cancels >= self.deadline_storm:
             if self._storm_latched:
                 return None
@@ -737,6 +1013,23 @@ class ServingTelemetry:
                                "threshold": self.deadline_storm})
         self._storm_latched = False
         return None
+
+    def _series_window_close(self):
+        """Feed the just-closed SLO window into the series store (the
+        recent-windows deque is the source — ISSUE 17 satellite): one
+        point per published quantile plus the window throughput, all
+        stamped at the window's close time."""
+        w = self.slo.snapshot()["recent_windows"][-1]
+        t = w["closed_at"]
+        pts = [("slo.tokens_per_s", w["tokens_per_s"])]
+        for m in SLO_METRICS:
+            s = w.get(m)
+            if not s:
+                continue
+            for tag in ("p50", "p90", "p99"):
+                if s.get(tag) is not None:
+                    pts.append((f"slo.{m}.{tag}", s[tag]))
+        self.series.observe_many(t, pts)
 
     def fault(self, step, kind, detail=None):
         """Mark the newest flight entry with the fault and dump the
@@ -756,6 +1049,10 @@ class ServingTelemetry:
         out["flight"] = {"capacity": self.flight.capacity,
                          "entries": len(self.flight),
                          "dumps": list(self.flight.dump_paths)}
+        out["series"] = (None if self.series is None else
+                         {"capacity": self.series.capacity,
+                          "names": len(self.series.names()),
+                          "points": self.series._points_total})
         return out
 
     def check_slo(self, targets):

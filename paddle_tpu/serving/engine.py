@@ -704,7 +704,7 @@ class GenerationServer:
     # -- client surface ----------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=32, eos_id=None,
                priority=0, deadline_ms=None, stream=None,
-               trace_ctx=None):
+               trace_ctx=None, tenant=None):
         """prompt_ids: 1-D int token ids. Returns a GenerationFuture
         resolving to a GenerationResult (or raising DeadlineExceeded /
         RequestCancelled). `stream(request_id, token)` fires on the
@@ -713,7 +713,9 @@ class GenerationServer:
         router's TraceContext (observability/fleet_trace.py): its
         trace id/hop land on this request's span tree and its sampling
         verdict overrides this engine's own — a request is traced on
-        all hops or none."""
+        all hops or none. `tenant` is an opaque cost-attribution
+        identity (get_stats()["tenants"], /tenants endpoint); it never
+        affects scheduling or token ids."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -738,14 +740,14 @@ class GenerationServer:
         if self._tel is not None:
             # before enqueue: the worker thread may admit the request
             # the instant it lands, and on_admit needs the submit stamp
-            self._tel.on_submit(rid, ctx=trace_ctx)
+            self._tel.on_submit(rid, ctx=trace_ctx, tenant=tenant)
         fut = GenerationFuture(self, rid)
         deadline = None
         if deadline_ms is not None:
             deadline = self._sched.now() + deadline_ms / 1e3
         req = _Request(rid, prompt, int(max_new_tokens), eos_id,
                        priority, deadline, stream, fut,
-                       self._sched.now())
+                       self._sched.now(), tenant=tenant)
         self._sched.enqueue(req)
         with self._rid_lock:
             raced_closed = self._closed
@@ -1273,6 +1275,8 @@ class GenerationServer:
             st["kv_quant"] = None
         st["telemetry_enabled"] = self._tel is not None
         st["slo"] = self._tel.stats() if self._tel is not None else None
+        st["tenants"] = (self._tel.tenants.snapshot()
+                         if self._tel is not None else None)
         st["engine_fault"] = repr(self._fault) if self._fault else None
         if self.mesh is None:
             st["mesh"] = None
@@ -1323,7 +1327,8 @@ class GenerationServer:
 
     def serve_metrics(self, port=0, host=None):
         """Mount the stdlib telemetry endpoint (/metrics Prometheus
-        exposition, /healthz, /slo) for this server. Binds loopback by
+        exposition, /healthz, /slo, /series, /tenants) for this
+        server. Binds loopback by
         default (docs/observability.md security note); returns the
         running TelemetryServer (.port, .url, .close()). Closed with
         the engine. Idempotent while a mount is live — but asking for a
@@ -1343,5 +1348,11 @@ class GenerationServer:
             port=port, host=host or "127.0.0.1",
             slo_fn=lambda: (self._tel.stats()
                             if self._tel is not None else {}),
-            health_fn=self.health)
+            health_fn=self.health,
+            series_fn=lambda: (
+                self._tel.series.payload()
+                if self._tel is not None and self._tel.series
+                is not None else None),
+            tenants_fn=lambda: (self._tel.tenants.snapshot()
+                                if self._tel is not None else {}))
         return self._exporter

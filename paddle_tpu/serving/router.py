@@ -100,9 +100,13 @@ from concurrent.futures import Future, InvalidStateError
 import numpy as np
 
 from ..observability import _help
+from ..observability.alerts import AlertManager, empty_alerts
 from ..observability.fleet_trace import TraceContext, mint_trace_id
 from ..observability.metrics import global_registry
-from ..observability.serving_telemetry import _rid_hash01
+from ..observability.serving_telemetry import (TenantLedger, _parse_qtag,
+                                               _rid_hash01,
+                                               aggregate_tenant_snapshots)
+from ..observability.timeseries import FleetSeriesStore
 from .prefix_cache import prompt_chain_keys
 from .replica import Replica
 from .scheduler import (DeadlineExceeded, GenerationResult,
@@ -209,7 +213,7 @@ class _Routed:
                  "rep_fut", "phase", "emitted", "seen", "attempts",
                  "client_cancelled", "first_submit_mono", "lineage",
                  "implicated", "retry_budget", "ctx", "hops",
-                 "submit_perf", "trace_done")
+                 "submit_perf", "trace_done", "tenant")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_id, priority,
                  deadline_ms, stream, future, keys):
@@ -243,6 +247,9 @@ class _Routed:
         self.submit_perf = None     # perf stamp of the client submit
         #                             (the fleet-track request span)
         self.trace_done = False     # /trace summary recorded (once)
+        self.tenant = None          # cost-attribution identity: every
+        #                             hop (prefill, decode, failover
+        #                             replay) bills the same tenant
 
 
 class FleetRouter:
@@ -266,7 +273,8 @@ class FleetRouter:
                  chaos=None, start=True, p2c_seed=0, name=None,
                  max_failovers=None, spawn_fn=None, supervisor=None,
                  preemption=None, poison_threshold=2, flight_dir=None,
-                 trace=False, trace_sample=None):
+                 trace=False, trace_sample=None, signals=True,
+                 alert_rules=None, signals_every=8):
         if not servers:
             raise ValueError("FleetRouter needs at least one replica")
         self.name = name or f"fleet{next(_ROUTER_SEQ)}"
@@ -429,6 +437,47 @@ class FleetRouter:
                            _help(f"serving.fleet.trace.{k}"))
             for k in ("requests", "completed", "dumps")}
         self._load_series = set()       # replica names with a live series
+        # fleet health signals (observability/timeseries.py + alerts.py):
+        # the router-side time-series store samples the shared registry
+        # at every heartbeat, replica engine stores attach for the
+        # merged /series view (dead generations freeze into bounded
+        # snapshots, same idiom as the fleet tracer), and the alert
+        # manager evaluates its rules against the router's own series
+        # — including the per-heartbeat windowed fleet burn rate fed
+        # by _sample_signals(). signals=False removes the whole plane
+        # (the bench off-arm).
+        self._tenants = TenantLedger()      # router-side costs only:
+        #                                     sheds/failovers/handoff
+        #                                     bytes (engines own the
+        #                                     token/block ledger)
+        self._dead_tenant_snaps = collections.deque(maxlen=16)
+        self._dead_snapped = set()          # (name, generation) seen
+        self._signals_clock = (chaos.serving_clock
+                               if chaos is not None
+                               and chaos.drives_clock()
+                               else time.monotonic)
+        # registry-sampling decimation: the per-heartbeat registry
+        # walk + burn-rate digest merge + alert evaluation cost real
+        # microseconds, and at CPU-tiny step times paying them every
+        # iteration is a double-digit tax (perf/bench_signals.json
+        # measures the <5% bar). Keyed to the iteration counter, so
+        # decimated timelines replay bit-identically under injected
+        # clocks; deterministic storm tests pin signals_every=1.
+        self._signals_every = max(1, int(signals_every))
+        if signals:
+            self._signals = FleetSeriesStore(self.name)
+            for r in self._replicas:
+                tel = r.server.telemetry
+                if tel is not None and tel.series is not None:
+                    self._signals.attach(r.name, tel.series,
+                                         r.generation)
+            self._alerts = AlertManager(self._signals.fleet,
+                                        rules=alert_rules or (),
+                                        label=self.name,
+                                        on_event=self._on_alert_event)
+        else:
+            self._signals = None
+            self._alerts = None
         self._publish_gauges()
         if trace:
             self.start_trace()
@@ -441,7 +490,7 @@ class FleetRouter:
     # -- client surface ----------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=32, eos_id=None,
                priority=0, deadline_ms=None, stream=None,
-               retry_budget=None):
+               retry_budget=None, tenant=None):
         """Route one generation request into the fleet. Returns a
         FleetFuture resolving to a GenerationResult whose request_id is
         the ROUTER's id (replica-local ids are an implementation
@@ -449,7 +498,10 @@ class FleetRouter:
         (with .retry_after_ms) when admission control sheds.
         `retry_budget` caps THIS request's failover re-admissions below
         the router-wide max_failovers (each re-admission also carries
-        only the REMAINING deadline budget)."""
+        only the REMAINING deadline budget). `tenant` is an opaque
+        cost-attribution identity threaded to every replica hop — it
+        never affects scheduling or token ids (docs/observability.md
+        "Fleet health signals")."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -468,6 +520,7 @@ class FleetRouter:
         fut = FleetFuture(self, rid)
         rr = _Routed(rid, prompt, int(max_new_tokens), eos_id, priority,
                      deadline_ms, stream, fut, keys)
+        rr.tenant = tenant
         if retry_budget is not None:
             rr.retry_budget = int(retry_budget)
         # ONE trace context per request, minted HERE: deterministic id
@@ -508,6 +561,7 @@ class FleetRouter:
         except AdmissionRejected as e:
             with self._lock:
                 self._inflight.pop(rid, None)
+            self._tenants.count(rr.tenant, "sheds")
             # the shed lands on the fleet track with the facts a client
             # postmortem needs: what breached, how hard, the backoff —
             # sampled requests only (the verdict governs every artifact)
@@ -683,14 +737,15 @@ class FleetRouter:
             # replica regenerates it deterministically from the
             # handed-off KV), nothing streams to the client from here
             fut = srv.submit(rr.prompt, max_new_tokens=1,
-                             priority=rr.priority, trace_ctx=ctx)
+                             priority=rr.priority, trace_ctx=ctx,
+                             tenant=rr.tenant)
         else:
             fut = srv.submit(rr.prompt,
                              max_new_tokens=rr.max_new_tokens,
                              eos_id=rr.eos_id, priority=rr.priority,
                              deadline_ms=deadline_ms,
                              stream=self._stream_cb(rr),
-                             trace_ctx=ctx)
+                             trace_ctx=ctx, tenant=rr.tenant)
         rr.hops.append({"hop": hop, "replica": target.name,
                         "phase": phase, "policy": label})
         rr.rep_fut = fut
@@ -921,6 +976,7 @@ class FleetRouter:
         rr.attempts += 1
         self.counts["failovers"] += 1
         self._m_failovers.inc()
+        self._tenants.count(rr.tenant, "failovers")
         pool = (self._pool(rr.phase)
                 if self.policy.kind == "disaggregated" else None)
         try:
@@ -985,6 +1041,10 @@ class FleetRouter:
         self._m_handoffs.inc()
         if moved:
             self._m_handoff_blocks.inc(moved)
+            cache = target.server.cache
+            self._tenants.count(
+                rr.tenant, "handoff_bytes",
+                moved * (cache.pool_bytes() // cache.num_blocks))
         if t0 is not None and rr.ctx is not None:
             # the disaggregated KV handoff, timed on the fleet track:
             # one block per full prompt chunk, bytes = pool slice cost
@@ -1132,6 +1192,14 @@ class FleetRouter:
             self._teardown(drain=True)
             return True
         self._publish_gauges()
+        if any_work and self.iteration % self._signals_every == 0:
+            # one signals heartbeat per signals_every WORKING
+            # iterations: registry gauges/counter-rates into the
+            # router series, the windowed fleet burn rate, then the
+            # alert rules — idle spins (the worker's wait loop) must
+            # not dilute the series or age absence rules faster than
+            # the fleet actually runs
+            self._sample_signals()
         return did
 
     def _drain_events(self):
@@ -1203,6 +1271,7 @@ class FleetRouter:
         # the victim's half of every failover must survive into the
         # merged postmortem dump
         self._tracer.snapshot_replica(r.name)
+        self._signals_replica_death(r)
         if self._chaos is not None:
             self._chaos.replica_kill_applied()
         self._publish_gauges()      # drops the dead replica's series
@@ -1233,6 +1302,7 @@ class FleetRouter:
         self._chaos_hung.discard(index)
         r.kill()
         self._tracer.snapshot_replica(r.name)   # postmortem capture
+        self._signals_replica_death(r)
         self._publish_gauges()
         self._notify()
 
@@ -1273,8 +1343,18 @@ class FleetRouter:
         if self._trace_bound:
             self._tracer.snapshot_replica(rep.name)
             self._bind_replica_recorder(rep)
+        # the dead generation's series store and tenant ledger freeze
+        # (idempotent with the kill/hang/gauge-sweep sites) before the
+        # slot's NEW store attaches under the same name — the merged
+        # /series view shows both generations
+        self._signals_replica_death(old)
         with self._lock:
             self._replicas[index] = rep
+        if self._signals is not None:
+            tel = rep.server.telemetry
+            if tel is not None and tel.series is not None:
+                self._signals.attach(rep.name, tel.series,
+                                     rep.generation)
         self._chaos_hung.discard(index)     # a fresh engine is never
         #                                     born into a chaos stall
         self._publish_gauges()
@@ -1333,6 +1413,124 @@ class FleetRouter:
         self._m_trace["dumps"].inc()
         if path is not None:
             self._tracer.save(path, payload)
+        return payload
+
+    # -- fleet health signals ------------------------------------------------
+    def _on_alert_event(self, kind, alert, t):
+        """An alert transition mirrors into BOTH postmortem planes —
+        a fleet-track instant (so the firing lines up against the
+        request spans and kill events that explain it) and the fleet
+        flight-recorder ring (the artifact a quarantine dumps)."""
+        self._flight_event(f"alert_{kind}", rule=alert["name"],
+                           series=alert["rule"]["series"],
+                           value=alert["last_value"], t=round(t, 6))
+
+    def _sample_signals(self):
+        """One health-signals heartbeat (step(), working iterations
+        only): sample the shared registry into the router series store
+        (gauges + counter rates), derive the WINDOWED fleet burn-rate
+        series for every admission SLO target, then run the alert
+        rules — all at one injected-clock timestamp, so a chaos storm
+        replays to the identical series and alert timeline."""
+        if self._signals is None:
+            return
+        t = self._signals_clock()
+        self._signals.fleet.sample(t)
+        adm = self.admission
+        if adm is not None:
+            targets = {m: dict(q) for m, q in adm.targets.items()}
+            if adm.fleet_targets:
+                for metric, qmap in adm.fleet_targets.items():
+                    targets.setdefault(metric, {}).update(qmap)
+            pts = []
+            live_tels = [r.server.telemetry for r in self._replicas
+                         if r.alive() and r.server.telemetry is not None]
+            for metric, qmap in targets.items():
+                # the ~2-window rolling view, count-weighted across
+                # live replicas — unlike check_slo's cumulative
+                # digests this view decays after recovery, so a
+                # burn-rate alert built on it can actually resolve.
+                # window_frac_over reads each replica's sketches in
+                # place (no copies/merges); the weighted mean of
+                # per-replica over-fractions IS the fleet fraction,
+                # since the sample sets are disjoint.
+                for tag, target in qmap.items():
+                    q = _parse_qtag(tag)
+                    budget = 1.0 - q
+                    if budget <= 0:
+                        continue
+                    over = total = 0.0
+                    for tel in live_tels:
+                        fo, n = tel.slo.window_frac_over(
+                            metric, float(target))
+                        if fo is not None:
+                            over += fo * n
+                            total += n
+                    if not total:
+                        continue
+                    pts.append((f"slo.window_burn.{metric}.{tag}",
+                                round(over / total / budget, 4)))
+            if pts:
+                self._signals.fleet.observe_many(t, pts)
+        if self._alerts is not None:
+            self._alerts.evaluate(t)
+
+    def _signals_replica_death(self, rep):
+        """Freeze a dying replica's health-signal state, idempotent
+        per (name, generation) — a death is noticed from several sites
+        (kill_replica, the watchdog verdict, the gauge sweep that
+        catches engine-fault deaths, resurrection's swap). Its series
+        store snapshots into the merged /series view and its tenant
+        ledger survives into tenant_stats() — cost attribution must
+        not lose the work a replica billed before it died."""
+        key = (rep.name, rep.generation)
+        if key in self._dead_snapped:
+            return
+        self._dead_snapped.add(key)
+        if self._signals is not None:
+            self._signals.snapshot_replica(rep.name)
+        tel = rep.server.telemetry
+        if tel is not None:
+            snap = tel.tenants.snapshot()
+            if snap.get("tenants"):
+                self._dead_tenant_snaps.append(snap)
+
+    def tenant_stats(self):
+        """Fleet per-tenant cost attribution (the /tenants body):
+        every live replica's engine-side ledger (tokens, block
+        residency, queue wait), the frozen ledgers of dead
+        generations, and the router's own ledger (sheds, failovers,
+        handoff bytes) aggregated into one snapshot. Engine ledgers
+        bill every replica hop — a failover replay costs real compute
+        and is attributed honestly."""
+        snaps = []
+        for r in self._replicas:
+            if not r.alive():
+                continue
+            tel = r.server.telemetry
+            if tel is not None:
+                snaps.append(tel.tenants.snapshot())
+        snaps.extend(self._dead_tenant_snaps)
+        snaps.append(self._tenants.snapshot())
+        return aggregate_tenant_snapshots(snaps)
+
+    def dump_signals(self, path=None):
+        """The health-signal postmortem artifact, sibling of
+        dump_trace(): ONE JSON with the merged fleet series (dead
+        replicas' frozen stores included), the alert record, and the
+        per-tenant cost attribution. Writes to `path` when given;
+        returns the payload either way."""
+        payload = {
+            "series": (self._signals.merged()
+                       if self._signals is not None else None),
+            "alerts": (self._alerts.payload()
+                       if self._alerts is not None else empty_alerts()),
+            "tenants": self.tenant_stats()}
+        if path is not None:
+            import json
+            with open(path, "w") as f:
+                json.dump(payload, f, sort_keys=True,
+                          separators=(",", ":"))
         return payload
 
     def replicas(self):
@@ -1414,6 +1612,7 @@ class FleetRouter:
                     # kill_replica, but its span trees (emitted by the
                     # fault's cancel_all) must survive resurrection
                     self._tracer.snapshot_replica(r.name)
+                    self._signals_replica_death(r)
                 continue
             ld = r.load()
             self._g_load.labels(router=self.name,
@@ -1452,6 +1651,11 @@ class FleetRouter:
                 "trace": dict(self._tracer.stats(),
                               sample_mode=self._trace_mode[0],
                               sample_rate=self._trace_mode[1]),
+                "signals": (None if self._signals is None else dict(
+                    self._signals.stats(),
+                    alerts=(self._alerts.stats()
+                            if self._alerts is not None else None))),
+                "tenants": self.tenant_stats(),
                 "popularity_digest": self._digest.stats(),
                 "poison_threshold": self.poison_threshold,
                 "replicas": reps, **counts}
@@ -1486,7 +1690,12 @@ class FleetRouter:
             port=port, host=host or "127.0.0.1",
             registry=FleetRegistryView(_fleet_stats),
             slo_fn=_slo, health_fn=self.health,
-            trace_fn=self._tracer.completed_payload)
+            trace_fn=self._tracer.completed_payload,
+            series_fn=(self._signals.merged
+                       if self._signals is not None else None),
+            alerts_fn=(self._alerts.payload
+                       if self._alerts is not None else None),
+            tenants_fn=self.tenant_stats)
         return self._exporter
 
     def close(self, drain=True, timeout=60):
@@ -1539,6 +1748,9 @@ class FleetRouter:
         self._drain_events()
         self._tracer.stop()     # captures stay mergeable after close —
         #                         dump_trace() still works for postmortems
+        if self._alerts is not None:
+            self._alerts.drop_gauges()      # a dead router must not
+            #                                 report stale alert gauges
         if self._exporter is not None:
             self._exporter.close()
             self._exporter = None

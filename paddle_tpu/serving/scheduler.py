@@ -82,12 +82,12 @@ class GenerationResult:
 
 class _Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_id", "priority",
-                 "deadline", "stream", "future", "submitted_at",
+                 "deadline", "stream", "future", "submitted_at", "tenant",
                  "generated", "score", "first_token_at", "last_token_at",
                  "chain_keys")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_id, priority,
-                 deadline, stream, future, submitted_at):
+                 deadline, stream, future, submitted_at, tenant=None):
         self.rid = rid
         self.prompt = prompt                # np.int32 (P,)
         self.max_new_tokens = max_new_tokens
@@ -97,6 +97,7 @@ class _Request:
         self.stream = stream                # callable(rid, token) or None
         self.future = future
         self.submitted_at = submitted_at
+        self.tenant = tenant                # cost-attribution identity
         self.generated = []
         self.score = 0.0
         self.first_token_at = None
@@ -478,7 +479,8 @@ class ContinuousBatchingScheduler:
             if self._tel is not None:
                 self._tel.on_admit(
                     req.rid, free_sid, self.iteration,
-                    (now - req.submitted_at) * 1e3)
+                    (now - req.submitted_at) * 1e3,
+                    blocks=len(slot.blocks))
 
     def _maybe_cow(self, slot, pos, n):
         """Copy-on-write guard, called with the block range this lane

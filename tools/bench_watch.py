@@ -186,6 +186,7 @@ SUITE_STEPS = (
     ("fleet_compare", "bench_fleet.json", None),
     ("chaos_recovery", "bench_chaos.json", None),
     ("trace_compare", "bench_trace.json", None),
+    ("signals_compare", "bench_signals.json", None),
     ("compile_sample", "compile_sample.json", None),
     ("ernie", "bench_ernie.json", None),
     ("packed", "bench_packed.json", None),
@@ -428,6 +429,19 @@ def run_suite():
                  env={"JAX_PLATFORMS": "cpu",
                       "BENCH_TRACE_COMPARE": "1"},
                  timeout_s=900, stdout_path="bench_trace.json")
+    # 1f6. fleet health signals comparison (ISSUE 17): series store +
+    #     burn-rate alerting + tenant ledgers on-vs-off through the
+    #     same tenant-tagged 2-replica stream (ids pinned bitwise
+    #     across modes), on the CPU backend (deterministic;
+    #     acceptance bar: overhead < 5%)
+    if _artifact_ok("bench_signals.json"):
+        log("step signals_compare: already landed in a prior cycle — "
+            "skipping")
+    else:
+        run_step("signals_compare", [py, bench],
+                 env={"JAX_PLATFORMS": "cpu",
+                      "BENCH_SIGNALS_COMPARE": "1"},
+                 timeout_s=900, stdout_path="bench_signals.json")
     # 1g. compile-observatory sample (ISSUE 8): Executor.explain()
     #     report + provoked recompile storm + HBM-ledger snapshot +
     #     detector on-vs-off overhead, on the CPU backend

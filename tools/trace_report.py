@@ -25,6 +25,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -69,7 +70,13 @@ def load_metrics(path):
     with open(path) as f:
         data = json.load(f)
     metrics = data.get("metrics", []) if isinstance(data, dict) else []
-    return {m["name"]: m for m in metrics if isinstance(m, dict)}
+    out = {m["name"]: m for m in metrics if isinstance(m, dict)}
+    if isinstance(data, dict) and isinstance(
+            data.get("signals_sample"), dict):
+        # the demo's dump_signals() payload rides the sample dump —
+        # the alert-timeline lines read the latched lifecycle records
+        out["signals_sample"] = data["signals_sample"]
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -279,6 +286,48 @@ def print_serving_summary(metrics, file=None):
         print(f"serving: fleet-trace requests={tr_req} "
               f"completed={tr_done} dumps={tr_dumps} "
               f"dropped_events={dropped}", file=file)
+    # fleet health signals (ISSUE 17): series volume, the alert
+    # timeline (one line per rule that ever fired — the latched
+    # lifecycle record), and top tenants by attributed cost
+    spts = _counter_total(metrics, "serving.series.points")
+    af = _counter_total(metrics, "serving.alerts.fired")
+    ar = _counter_total(metrics, "serving.alerts.resolved")
+    if spts or af or ar:
+        sdrop = _counter_total(metrics, "serving.series.dropped_points")
+        print(f"serving: signals series_points={spts} "
+              f"dropped={sdrop} alerts fired={af} resolved={ar}",
+              file=file)
+    sig = metrics.get("signals_sample") or {}
+    for a in (sig.get("alerts") or {}).get("alerts", []):
+        if not a.get("fired_count"):
+            continue
+        res = (f" resolved_at={a['resolved_at']:.3f}s"
+               if a.get("resolved_at") is not None else "")
+        print(f"serving: alert[{a['name']}] state={a['state']} "
+              f"fired_at={a['fired_at']:.3f}s{res} "
+              f"fired_count={a['fired_count']} "
+              f"series={a['rule']['series']}", file=file)
+    tenant_toks = {}
+    for v in metrics.get("serving.tenant.generated_tokens", {}).get(
+            "values", []):
+        ten = v.get("labels", {}).get("tenant")
+        if ten:
+            tenant_toks[ten] = tenant_toks.get(ten, 0) + v.get(
+                "value", 0)
+    if tenant_toks:
+        tenant_reqs = {}
+        for v in metrics.get("serving.tenant.requests", {}).get(
+                "values", []):
+            ten = v.get("labels", {}).get("tenant")
+            if ten:
+                tenant_reqs[ten] = tenant_reqs.get(ten, 0) + v.get(
+                    "value", 0)
+        top = sorted(tenant_toks.items(),
+                     key=lambda kv: (-kv[1], kv[0]))[:5]
+        print("serving: top-tenants "
+              + " ".join(f"{k}={int(v)}tok/"
+                         f"{int(tenant_reqs.get(k, 0))}req"
+                         for k, v in top), file=file)
     quant = metrics.get("serving.slo.quantile_ms")
     if windows and quant:
         # key on (server, metric): two live GenerationServers publish
@@ -458,22 +507,70 @@ def run_demo(out_dir):
     # both replica captures incl. the victim's death snapshot) is
     # produced so serving.fleet.trace.* series land in the sample too
     fchaos = ChaosInjector().kill_replica_at(3, 0)
+    # fleet health signals (ISSUE 17): an alert storm rides the chaos
+    # kill — "replica-down" (live replicas < 2) fires at the kill and
+    # resolves when the supervisor's resurrection heals the fleet, so
+    # serving.alerts.{fired,resolved,active} land in the committed
+    # sample with a real firing→resolved lifecycle behind them; the
+    # loose admission targets feed the slo.window_burn series the
+    # "slo-burn" rule watches (quiet here — no shedding in the demo)
+    from paddle_tpu.observability.alerts import AlertRule
+    from paddle_tpu.serving.router import AdmissionPolicy
     frouter = FleetRouter(freps, start=False, chaos=fchaos,
-                          spawn_fn=_spawn, trace=True,
+                          spawn_fn=_spawn, trace=True, name="sig-demo",
+                          signals_every=1,
+                          admission=AdmissionPolicy(
+                              {"ttft_ms": {"p99": 1e9}},
+                              burn_threshold=1e9),
+                          alert_rules=[
+                              AlertRule.threshold_rule(
+                                  "replica-down",
+                                  "serving.fleet.replicas{router=sig-demo}",
+                                  2.0, op="<"),
+                              AlertRule.burn_rate(
+                                  "slo-burn",
+                                  "slo.window_burn.ttft_ms.p99",
+                                  1.0, fast_s=0.5, slow_s=2.0)],
                           supervisor=SupervisorConfig(
                               backoff_heartbeats=1, warm_chains=2))
     fprompts = [np.arange(3 + i, 19 + i, dtype=np.int32)
                 for i in range(2)]
-    waves = [frouter.submit(p, max_new_tokens=4) for p in fprompts]
+    # per-tenant cost attribution: tagged and anonymous traffic mixed,
+    # so serving.tenant.* series (incl. the <anon> row) land too
+    ftenants = ("acme", "globex", None, "acme")
+    waves = [frouter.submit(p, max_new_tokens=4, tenant=t)
+             for p, t in zip(fprompts, ftenants)]
     frouter.run_until_idle()
-    waves += [frouter.submit(p, max_new_tokens=4) for p in fprompts]
+    waves += [frouter.submit(p, max_new_tokens=4, tenant=t)
+              for p, t in zip(fprompts, ftenants[2:])]
     frouter.run_until_idle()
     for f in waves:
         f.result(timeout=5)
+    # drive calm waves until the supervisor's resurrection lands AND a
+    # post-heal signal sample latches replica-down to resolved — the
+    # heartbeat rides wall clock, so the number of waves needed varies
+    # with machine load (outcome is deterministic, the count is not)
+    for _ in range(40):
+        down = next(a for a in frouter.dump_signals()["alerts"]["alerts"]
+                    if a["name"] == "replica-down")
+        if (frouter.get_stats()["live_replicas"] == 2
+                and down["fired_count"] >= 1
+                and down["state"] == "resolved"):
+            break
+        calm = [frouter.submit(np.arange(5 + i, 13 + i, dtype=np.int32),
+                               max_new_tokens=2) for i in range(2)]
+        frouter.run_until_idle()
+        for f in calm:
+            f.result(timeout=5)
+        time.sleep(0.02)
     ftrace = frouter.dump_trace()
     assert len(ftrace["otherData"]["sources"]) >= 3     # fleet + 2 reps
     fleet_stats = frouter.get_stats()
     assert fleet_stats["live_replicas"] == 2    # healed after the kill
+    signals_sample = frouter.dump_signals()
+    down = next(a for a in signals_sample["alerts"]["alerts"]
+                if a["name"] == "replica-down")
+    assert down["fired_count"] >= 1 and down["state"] == "resolved"
     frouter.close()
 
     metrics_path = os.path.join(out_dir, "metrics_sample.json")
@@ -486,6 +583,7 @@ def run_demo(out_dir):
                                steps=guard_result.steps)
     dump["serving_stats"] = server.get_stats()
     dump["fleet_stats"] = fleet_stats
+    dump["signals_sample"] = signals_sample
     with open(metrics_path, "w") as f:
         # single line: perf/ artifacts are parsed line-wise by
         # tools/bench_watch.py's _artifact_ok
