@@ -422,7 +422,10 @@ def explain_entry(step_fn, args, program=None, state=None, feeds=None,
 # ---------------------------------------------------------------------------
 
 RESIDENT_KINDS = ("params", "optimizer", "kv_cache", "other")
-LEDGER_KINDS = RESIDENT_KINDS + ("peak_hbm",)
+# "host_ram" rows account host-memory commitments (the serving KV
+# spill tier): real bytes a fleet sizes against, but deliberately NOT
+# a resident kind — memory.total_bytes stays per-device HBM truth.
+LEDGER_KINDS = RESIDENT_KINDS + ("peak_hbm", "host_ram")
 
 
 def _agg(kind, acc, nbytes):

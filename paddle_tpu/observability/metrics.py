@@ -207,6 +207,26 @@ METRIC_SPECS = [
     ("serving.kv.quant.bytes_saved", "gauge",
      "bytes the int8 KV pool saves vs the same block count dense in "
      "the compute dtype (dense_equiv - int8+scales; label: server)"),
+    ("serving.kv.tier.host_blocks", "gauge",
+     "host-RAM spill-pool capacity in blocks (the tier the device "
+     "pool evicts into and preemption parks in; label: server; "
+     "absent without a host tier)"),
+    ("serving.kv.tier.spills", "gauge",
+     "cumulative device->host block copies: evictions that kept the "
+     "prefix KV alive in the host tier plus preempted requests' "
+     "parked blocks (label: server)"),
+    ("serving.kv.tier.swap_ins", "gauge",
+     "cumulative host->device block copies: spilled chains re-adopted "
+     "on a prefix hit plus preempted requests resumed (label: server)"),
+    ("serving.kv.tier.preempts", "gauge",
+     "cumulative decode lanes parked in the host tier under block "
+     "pressure, position and stream state intact (label: server)"),
+    ("serving.kv.tier.resumes", "gauge",
+     "cumulative preempted requests swapped back into device blocks "
+     "and continued bitwise (label: server)"),
+    ("serving.kv.tier.reprefills_avoided", "gauge",
+     "cumulative prefix-chain blocks served by host-tier swap-in "
+     "instead of re-running prefill (label: server)"),
     ("serving.mesh.axis_size", "gauge",
      "tensor-parallel mesh axis size a GenerationServer shards its "
      "fused step and KV pools over (label: server; absent single-"

@@ -38,7 +38,7 @@ class ChaosInjector:
                       "serving_poison": 0, "evict": 0,
                       "hash_collision": 0, "replica_kill": 0,
                       "replica_hang": 0, "replica_slow": 0,
-                      "prompt_poison": 0}
+                      "prompt_poison": 0, "spill": 0, "preempt": 0}
         self._installed = False
         # serving-engine plan: iteration -> actions (scheduler hooks)
         self._serving_cancels = {}   # iteration -> [active-request index]
@@ -48,6 +48,9 @@ class ChaosInjector:
         self._drives_clock = False
         # prefix-cache plan (serving/prefix_cache.py hooks)
         self._serving_evicts = {}    # iteration -> evictions to force
+        # KV tier plan (serving/scheduler.py host-tier hooks)
+        self._serving_spills = {}    # iteration -> spills to force
+        self._serving_preempts = {}  # iteration -> [request id]
         self._collide_hashes = set() # 1-based content-hash ordinals
         self._hash_count = 0
         # fleet plan (serving/router.py hooks)
@@ -195,6 +198,50 @@ class ChaosInjector:
 
     def serving_eviction_applied(self):
         self.fired["evict"] += 1
+
+    # -- KV tier hooks (serving/scheduler.py host tier) ----------------
+    def spill_chain_at(self, iteration, n=1):
+        """Force `n` prefix-chain spills (device->host evictions) at
+        the start of scheduler iteration `iteration` (1-based) — the
+        deterministic tiering path, testable without streaming enough
+        requests to exhaust the device pool. Fires only when the
+        eviction actually took the spill path (host tier attached,
+        with space, and an idle leaf entry to take) — the plan is a
+        no-op otherwise, exactly like evict_block_at."""
+        self._serving_spills[int(iteration)] = \
+            self._serving_spills.get(int(iteration), 0) + int(n)
+        return self
+
+    def serving_spills_at(self, iteration):
+        """-> number of forced spills planned for this iteration.
+        Consumed by the scheduler's plan(); `fired["spill"]` is counted
+        by serving_spill_applied only when an eviction went
+        device->host (destroy-evictions don't count)."""
+        return self._serving_spills.pop(int(iteration), 0)
+
+    def serving_spill_applied(self):
+        self.fired["spill"] += 1
+
+    def preempt_request_at(self, iteration, rid):
+        """Park in-flight request `rid` in the host KV tier at the
+        start of scheduler iteration `iteration` (1-based): its blocks
+        swap out, its position/stream state stays queued, and the
+        normal resume path swaps it back in when blocks free up (next
+        iteration at the earliest — the park must span a real step).
+        Fires only when `rid` was an active DECODE lane and the host
+        pool held its blocks; no sleeps, injected-clock friendly."""
+        self._serving_preempts.setdefault(int(iteration), []).append(
+            rid)
+        return self
+
+    def serving_preempts_at(self, iteration):
+        """-> request ids to preempt at this iteration. Consumed by
+        the scheduler's plan(); `fired["preempt"]` counts via
+        serving_preempt_applied only when a lane was actually parked."""
+        return self._serving_preempts.pop(int(iteration), [])
+
+    def serving_preempt_applied(self):
+        self.fired["preempt"] += 1
 
     def hash_collision_at(self, nth, times=1):
         """Make content-hash computations nth..nth+times-1 (1-based,

@@ -386,7 +386,7 @@ class GenerationServer:
                  telemetry=True, slo_window_s=60.0, flight_dir=None,
                  flight_capacity=256, deadline_storm=3, mesh=None,
                  mesh_axis="tp", prefix_cache=False, spec=None,
-                 kv_dtype=None):
+                 kv_dtype=None, host_kv_blocks=0):
         self.model = model
         self.block_size = int(block_size)
         self.mesh = mesh
@@ -518,6 +518,12 @@ class GenerationServer:
             from .spec_decode import build_draft_step
             self._draft = jax.jit(build_draft_step(
                 dm, self.block_size, spec.k))
+        # host KV tier (tiered cache): a numpy block pool in host RAM
+        # that eviction spills to and preemption parks in. Enabled
+        # AFTER the draft sibling attaches so the tier mirrors onto the
+        # draft pools too (a parked spec request keeps its draft KV).
+        if host_kv_blocks:
+            self.cache.enable_host_tier(int(host_kv_blocks))
         # mesh/per_column kwargs only when needed: a custom model
         # implementing the original build_fused_step(block_size) keeps
         # working for plain single-device serving. Speculative servers
@@ -573,7 +579,8 @@ class GenerationServer:
                      "q_heads": model.num_heads,
                      "head_dim": model.head_dim,
                      "dtype": str(np.dtype(self.cache.dtype)),
-                     "kv_dtype": kv_dtype}
+                     "kv_dtype": kv_dtype,
+                     "tier": "device"}
         if self.cache.quantized:
             kv_detail["scale_bytes"] = self.cache.scale_bytes()
             kv_detail["dense_equiv_bytes"] = \
@@ -594,6 +601,18 @@ class GenerationServer:
             if hasattr(model, "param_bytes_per_device"):
                 param_dev_bytes = model.param_bytes_per_device(
                     mesh, mesh_axis)
+        # host tier: its own row under the NON-resident "host_ram"
+        # kind — host RAM is real memory the fleet sizes against, but
+        # it must never inflate the per-device HBM totals the resident
+        # kinds sum into (memory.total_bytes stays device truth). The
+        # device/host split is readable straight off the two rows'
+        # tier details.
+        if self.cache.host is not None:
+            led.register(
+                self._ledger_id, "kv_pool_host", "host_ram",
+                self.cache.host_pool_bytes(),
+                detail=dict(kv_detail, tier="host",
+                            num_blocks=self.cache.host.num_blocks))
         led.register(self._ledger_id, "model_params", "params",
                      param_bytes,
                      detail={"source": "serving model",
@@ -663,6 +682,27 @@ class GenerationServer:
             for name, val in self._quant_gauges.items():
                 reg0.gauge(name, _help(name)).labels(
                     server=self._ledger_id).set(val)
+        # host-tier gauges (serving.kv.tier.*): the tier's capacity
+        # plus its cumulative traffic (spills/swap-ins/preempts/
+        # resumes/re-prefills avoided), server-labeled and re-published
+        # every _publish_gauges tick. Same retire discipline as the
+        # mesh/quant gauges — a closed server must stop reporting a
+        # host-RAM footprint (both close paths).
+        self._tier_gauges = None
+        if self.cache.host is not None:
+            reg0 = global_registry()
+            self._tier_gauges = {
+                name: reg0.gauge(name, _help(name)).labels(
+                    server=self._ledger_id)
+                for name in ("serving.kv.tier.host_blocks",
+                             "serving.kv.tier.spills",
+                             "serving.kv.tier.swap_ins",
+                             "serving.kv.tier.preempts",
+                             "serving.kv.tier.resumes",
+                             "serving.kv.tier.reprefills_avoided")}
+            self._tier_gauges["serving.kv.tier.host_blocks"].set(
+                self.cache.host.num_blocks)
+            self._publish_tier_gauges()
         # paged-kernel engagement accounting: the fused step traces
         # ONCE; the module dispatch counters' delta across that trace
         # proves which attention path this server actually compiled
@@ -1123,6 +1163,19 @@ class GenerationServer:
         self._m["queue_depth"].set(st.queue_depth)
         self._m["active_slots"].set(st.active_count)
         self._m["blocks_in_use"].set(self.cache.num_used)
+        self._publish_tier_gauges()
+
+    def _publish_tier_gauges(self):
+        if self._tier_gauges is None:
+            return
+        g = self._tier_gauges
+        g["serving.kv.tier.spills"].set(self.cache.host_spills)
+        g["serving.kv.tier.swap_ins"].set(self.cache.host_swap_ins)
+        g["serving.kv.tier.preempts"].set(self._sched.preempts)
+        g["serving.kv.tier.resumes"].set(self._sched.resumes)
+        g["serving.kv.tier.reprefills_avoided"].set(
+            self._prefix.counts["reprefills_avoided"]
+            if self._prefix is not None else 0)
 
     def _serve(self):
         from ..robustness.guard import NonFiniteError
@@ -1200,10 +1253,10 @@ class GenerationServer:
             self._prefix.drop_gauges()
 
     def _retire_mesh_gauges(self):
-        """Drop this server's serving.mesh.* AND serving.kv.quant.*
-        gauge series (idempotent; called from BOTH close paths — a dead
-        server must not keep reporting a live shard footprint or a
-        quantization saving)."""
+        """Drop this server's serving.mesh.*, serving.kv.quant.* AND
+        serving.kv.tier.* gauge series (idempotent; called from BOTH
+        close paths — a dead server must not keep reporting a live
+        shard footprint, a quantization saving, or host-tier traffic)."""
         reg = global_registry()
         for name in (self._mesh_gauges or ()):
             reg.gauge(name).remove(server=self._ledger_id)
@@ -1211,6 +1264,9 @@ class GenerationServer:
         for name in (self._quant_gauges or ()):
             reg.gauge(name).remove(server=self._ledger_id)
         self._quant_gauges = None
+        for name in (self._tier_gauges or ()):
+            reg.gauge(name).remove(server=self._ledger_id)
+        self._tier_gauges = None
 
     def get_stats(self):
         """Scheduler + engine stats; `fused_step_signatures` is the jit
@@ -1273,6 +1329,27 @@ class GenerationServer:
             }
         else:
             st["kv_quant"] = None
+        # tiered-KV facts (None without a host tier): capacity, the
+        # device/host byte split, and the cumulative tier traffic —
+        # reprefills_avoided is the host tier's whole value proposition
+        # in one number
+        if self.cache.host is not None:
+            st["kv_tier"] = {
+                "host_blocks": self.cache.host.num_blocks,
+                "host_blocks_used": self.cache.host.num_used,
+                "host_pool_bytes": self.cache.host_pool_bytes(),
+                "device_pool_bytes": self.cache.pool_bytes(),
+                "spills": self.cache.host_spills,
+                "swap_ins": self.cache.host_swap_ins,
+                "preempts": self._sched.preempts,
+                "resumes": self._sched.resumes,
+                "preempted_depth": st.get("preempted_depth", 0),
+                "reprefills_avoided":
+                    self._prefix.counts["reprefills_avoided"]
+                    if self._prefix is not None else 0,
+            }
+        else:
+            st["kv_tier"] = None
         st["telemetry_enabled"] = self._tel is not None
         st["slo"] = self._tel.stats() if self._tel is not None else None
         st["tenants"] = (self._tel.tenants.snapshot()

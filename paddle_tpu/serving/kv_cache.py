@@ -80,7 +80,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["PagedKVCache", "PagedDecodeLayer", "paged_attention",
+__all__ = ["PagedKVCache", "HostKVTier", "PagedDecodeLayer",
+           "paged_attention",
            "paged_attention_reference", "gather_block_kv",
            "gather_block_kv_pair", "gather_block_scales",
            "build_paged_decode_cache", "quantize_kv_rows",
@@ -496,6 +497,96 @@ def write_block_kv_quant(pool, scale_pool, vals, block_idx, offset):
 
 
 # ---------------------------------------------------------------------------
+# host spill tier
+# ---------------------------------------------------------------------------
+
+class HostKVTier:
+    """Host-RAM block pool mirroring one PagedKVCache's geometry.
+
+    Same per-layer dict keys as the device pools ("k"/"v" plus
+    "k_scale"/"v_scale" for int8) with the same (N, H_kv, bs, D) block
+    shape, but numpy-backed: eviction under memory pressure becomes a
+    device->host copy (``PagedKVCache.spill_block``) that keeps the
+    prefix-chain KV alive, and a later hit swaps the block back in
+    (``swap_in_block``) instead of re-prefilling. Preempt-and-resume
+    scheduling parks a paused request's blocks here too — its host
+    blocks ARE its reservation, so the no-mid-flight-OOM invariant
+    survives the retirement of full-reservation admission.
+
+    Host block ids are a PRIVATE namespace: they never enter a block
+    table and are never attended, so there is no NULL block — all
+    `num_blocks` ids are usable (id 0 included). Single-owner free-list
+    accounting only (no refcounts: a host block always has exactly one
+    owner — a spilled prefix entry or a preempted request's record).
+    int8 pools spill as (codes, scales) pairs, so the host tier holds
+    ~2x the chains per byte exactly like the device tier (the int8
+    compounding noted in docs/serving.md)."""
+
+    def __init__(self, cache, num_blocks):
+        if int(num_blocks) < 1:
+            raise ValueError("host tier needs >= 1 block")
+        self.num_blocks = int(num_blocks)
+        self.block_size = cache.block_size
+        shape = (self.num_blocks, cache.num_kv_heads, cache.block_size,
+                 cache.head_dim)
+        # np.dtype() resolves bf16 via the ml_dtypes registration jax
+        # itself installs, so the host rows store the device bytes 1:1
+        dt = np.dtype(cache.dtype)
+        self._itemsize = dt.itemsize
+        self._quantized = cache.quantized
+        self._layer_elems = int(np.prod(shape))
+        self._scale_elems = int(np.prod(shape[:3]))
+        self.pools = []
+        for _ in range(cache.num_layers):
+            layer = {"k": np.zeros(shape, dt), "v": np.zeros(shape, dt)}
+            if cache.quantized:
+                # scale 1.0 like the device pools: an unwritten row
+                # dequantizes to exact zeros without a 0*NaN hazard
+                layer["k_scale"] = np.ones(shape[:3], np.float32)
+                layer["v_scale"] = np.ones(shape[:3], np.float32)
+            self.pools.append(layer)
+        # LIFO free list over ALL ids (no NULL reservation) + a used
+        # set so a double free fails loudly (the device pool's lesson)
+        self._free = list(range(self.num_blocks - 1, -1, -1))
+        self._used = set()
+
+    @property
+    def num_free(self):
+        return len(self._free)
+
+    @property
+    def num_used(self):
+        return len(self._used)
+
+    def allocate(self, n):
+        """n host blocks or None (nothing partial)."""
+        if n > len(self._free):
+            return None
+        taken = [self._free.pop() for _ in range(n)]
+        self._used.update(taken)
+        return taken
+
+    def free(self, blocks):
+        for b in blocks:
+            b = int(b)
+            if b not in self._used:
+                raise ValueError(
+                    f"double free of host block {b}: it is already on "
+                    f"the free list")
+            self._used.discard(b)
+            self._free.append(b)
+
+    def pool_bytes(self):
+        """Host-RAM bytes of every block pool (k+v across layers,
+        including the f32 scale pools when quantized) — the host half
+        of the ledger's device/host split."""
+        n = len(self.pools)
+        per = self._layer_elems * self._itemsize
+        scales = self._scale_elems * 4 if self._quantized else 0
+        return 2 * n * (per + scales)
+
+
+# ---------------------------------------------------------------------------
 # pool manager (host side)
 # ---------------------------------------------------------------------------
 
@@ -634,6 +725,14 @@ class PagedKVCache:
         self._cow_fn = None
         self._xfer_fn = None
         self.cow_copies = 0
+        # host spill tier (enable_host_tier): None until enabled. The
+        # two lazy jits are the tier's ENTIRE signature budget — one
+        # per direction for the cache lifetime, like _cow_fn/_xfer_fn.
+        self.host = None
+        self._spill_fn = None
+        self._swap_in_fn = None
+        self.host_spills = 0
+        self.host_swap_ins = 0
 
     # -- allocation --------------------------------------------------------
     @property
@@ -766,6 +865,14 @@ class PagedKVCache:
         (the spec-decode draft pools): cow_copy keeps them consistent."""
         self._siblings.append(sibling)
         self._cow_fn = None         # pytree layout changed: rebuild
+        if self.host is not None:
+            # host tier already on: the new sibling needs its own host
+            # pools at the SAME ids (spill/swap-in move every holder's
+            # rows together, draft KV included, so a resumed spec
+            # server keeps its warm draft cache)
+            self._spill_fn = None
+            self._swap_in_fn = None
+            sibling.host = HostKVTier(sibling, self.host.num_blocks)
 
     def cow_copy(self, src, dst):
         """Device-copy block `src`'s rows into block `dst` across every
@@ -854,6 +961,103 @@ class PagedKVCache:
         self.pools = self._xfer_fn(src_cache.pools, self.pools,
                                    jnp.asarray(src_block, jnp.int32),
                                    jnp.asarray(dst_block, jnp.int32))
+
+    # -- host spill tier ---------------------------------------------------
+    def enable_host_tier(self, num_blocks):
+        """Attach a HostKVTier of `num_blocks` host-RAM blocks to this
+        cache (and mirror one onto every sibling at the same ids, so a
+        spilled block carries its draft KV with it). Host block ids are
+        allocated ONLY from the primary tier's free list — sibling
+        tiers are pool storage at mirrored ids, their free lists
+        unused. Idempotent resize is NOT supported: one tier per cache
+        lifetime, like the pools themselves."""
+        if self.host is not None:
+            raise ValueError(
+                "host tier already enabled — it is sized once for the "
+                "cache lifetime, like the device pools")
+        self.host = HostKVTier(self, num_blocks)
+        for sib in self._siblings:
+            sib.host = HostKVTier(sib, num_blocks)
+        return self.host
+
+    def spill_block(self, block):
+        """Device->host copy of block `block`'s rows (every layer,
+        every holder — siblings included — scales alongside codes for
+        int8). Returns the host block id holding them, or None when
+        the host tier is full (caller sheds instead). Does NOT touch
+        the device block's refcount/free state: the caller decides
+        whether the device copy dies (prefix eviction) or the whole
+        request parks (preempt). ONE jitted extract signature for the
+        cache lifetime — the block id rides as a traced scalar — and
+        one device_get for the whole transfer."""
+        if self.host is None:
+            raise ValueError("spill_block without enable_host_tier")
+        hb = self.host.allocate(1)
+        if hb is None:
+            return None
+        hb = hb[0]
+        if self._spill_fn is None:
+            def _extract(pool_sets, s):
+                return [[{name: a[s] for name, a in p.items()}
+                         for p in pools]
+                        for pools in pool_sets]
+            self._spill_fn = jax.jit(_extract)
+        holders = [h for h in [self] + self._siblings
+                   if h.host is not None]
+        rows_sets = jax.device_get(
+            self._spill_fn([h.pools for h in holders],
+                           jnp.asarray(block, jnp.int32)))
+        for h, rows in zip(holders, rows_sets):
+            for layer, r in zip(h.host.pools, rows):
+                for name, arr in r.items():
+                    layer[name][hb] = arr
+        self.host_spills += 1
+        return hb
+
+    def swap_in_block(self, host_block, dst_block):
+        """Host->device copy of host block `host_block`'s rows into
+        device block `dst_block` (every layer, every holder) — the
+        adopt_block_from idiom pointed at the host pool. The numpy rows
+        ride as jit ARGUMENTS (fixed shapes, values not baked), so the
+        upload IS the H2D copy and there is ONE swap-in signature for
+        the cache lifetime. Does NOT free the host block: the owner
+        (prefix entry or preempt record) releases it."""
+        if self.host is None:
+            raise ValueError("swap_in_block without enable_host_tier")
+        host_block = int(host_block)
+        if self._swap_in_fn is None:
+            def _inject(pool_sets, rows_sets, d):
+                return [
+                    [{name: p[name].at[d].set(
+                        rows[name].astype(p[name].dtype))
+                      for name in p}
+                     for p, rows in zip(pools, rset)]
+                    for pools, rset in zip(pool_sets, rows_sets)]
+            self._swap_in_fn = jax.jit(_inject)
+        holders = [h for h in [self] + self._siblings
+                   if h.host is not None]
+        rows_sets = [
+            [{name: arr[host_block] for name, arr in layer.items()}
+             for layer in h.host.pools]
+            for h in holders]
+        new_sets = self._swap_in_fn([h.pools for h in holders],
+                                    rows_sets,
+                                    jnp.asarray(dst_block, jnp.int32))
+        for h, pools in zip(holders, new_sets):
+            h.pools = pools
+        self.host_swap_ins += 1
+
+    def host_pool_bytes(self):
+        """Host-RAM bytes of the attached tier(s) — this cache's plus
+        every sibling mirror's; 0 with no tier. The host half of the
+        ledger's device/host split."""
+        if self.host is None:
+            return 0
+        total = self.host.pool_bytes()
+        for sib in self._siblings:
+            if sib.host is not None:
+                total += sib.host.pool_bytes()
+        return total
 
     # -- layout helpers ----------------------------------------------------
     def make_table(self, blocks, max_blocks):

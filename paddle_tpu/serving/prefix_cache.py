@@ -97,7 +97,7 @@ def prompt_chain_keys(prompt, block_size, n_chunks=None):
 
 class _Entry:
     __slots__ = ("key", "block", "tokens", "parent", "children",
-                 "last_touch")
+                 "last_touch", "tier", "host_block", "host_children")
 
     def __init__(self, key, block, tokens, parent, touch):
         self.key = key
@@ -106,6 +106,16 @@ class _Entry:
         self.parent = parent            # parent chain key or None
         self.children = 0               # live indexed children
         self.last_touch = touch
+        # tiering (host spill pool): "device" entries hold a live pool
+        # block; "host" entries hold a HostKVTier block instead (block
+        # is None, the device ref was dropped at spill). host_children
+        # counts the children currently spilled — an entry whose only
+        # children are host-tier is still spill-eligible (the chain
+        # stays walkable either way), which is what lets a whole chain
+        # drain to host leaf-first instead of wedging after one leaf.
+        self.tier = "device"
+        self.host_block = None
+        self.host_children = 0
 
 
 class PrefixCacheIndex:
@@ -139,7 +149,9 @@ class PrefixCacheIndex:
         self._g_shared = reg.gauge("serving.prefix.shared_blocks",
                                    _help("serving.prefix.shared_blocks"))
         self.counts = {"hits": 0, "misses": 0, "evictions": 0,
-                       "cow_copies": 0, "collisions": 0}
+                       "cow_copies": 0, "collisions": 0, "spills": 0,
+                       "swap_ins": 0, "reprefills_avoided": 0,
+                       "host_drops": 0}
 
     # -- hashing -----------------------------------------------------------
     def chunk_key(self, parent_key, tokens):
@@ -175,7 +187,11 @@ class PrefixCacheIndex:
         the scheduler probes on EVERY backpressured admission retry,
         and a retry must not masquerade as cache traffic or keep
         entries artificially hot in the LRU. Returns the matched block
-        list; `claim()` commits the match when admission proceeds."""
+        list — a SPILLED (host-tier) entry matches as None in place of
+        a block id (still token-verified), so len(match) is the true
+        prefix depth (router affinity sees spilled chains) while the
+        Nones tell admission how many swap-ins `claim()` will need;
+        `claim()` commits the match when admission proceeds."""
         bs = self._cache.block_size
         blocks = []
         for i in range(len(prompt) // bs):
@@ -186,8 +202,36 @@ class PrefixCacheIndex:
                 # tokens: both are a miss (the verify step is what
                 # makes a collision harmless)
                 break
-            blocks.append(e.block)
+            blocks.append(e.block if e.tier == "device" else None)
         return blocks
+
+    def _materialize(self, e):
+        """Swap a host-tier entry's KV back into a fresh device block
+        (the adopt idiom pointed at the host pool) — the re-prefill the
+        host tier exists to avoid. The caller (scheduler admission /
+        router re-warm) must have budgeted a free device block; raising
+        here means its evict_for math was wrong, not a recoverable
+        miss."""
+        nb = self._cache.allocate(1)
+        if nb is None:
+            raise MemoryError(
+                "materializing a spilled chain entry with no free "
+                "device block — admission must evict_for the swap-in "
+                "count before claiming")
+        db = nb[0]
+        self._cache.swap_in_block(e.host_block, db)
+        self._cache.host.free([e.host_block])
+        e.tier = "device"
+        e.host_block = None
+        e.block = db
+        self._by_block[db] = e.key
+        if e.parent is not None:
+            p = self._entries.get(e.parent)
+            if p is not None:
+                p.host_children -= 1
+        self.counts["swap_ins"] += 1
+        self.counts["reprefills_avoided"] += 1
+        return db
 
     def claim(self, keys, blocks, probed):
         """Commit a successful admission's match: one ref per matched
@@ -195,9 +239,14 @@ class PrefixCacheIndex:
         hit/miss counters (hits = matched chunks; ONE miss if the walk
         stopped before probing all `probed` full chunks). Must run
         under the same scheduler-lock hold as the match — entries
-        cannot be evicted in between."""
-        for key in keys[:len(blocks)]:
+        cannot be evicted in between. Spilled entries in the match
+        (None placeholders) are materialized by swap-in here; returns
+        the fully-device block list the request's table should use."""
+        blocks = list(blocks)
+        for i, key in enumerate(keys[:len(blocks)]):
             e = self._entries[key]
+            if e.tier != "device":
+                blocks[i] = self._materialize(e)
             self._cache.ref(e.block)
             self._touch += 1
             e.last_touch = self._touch
@@ -208,6 +257,7 @@ class PrefixCacheIndex:
             self.counts["misses"] += 1
             self._m_misses.inc()
         self._publish_shared()
+        return blocks
 
     def release(self, blocks):
         """Drop one request's refs on `blocks` (matched at admission or
@@ -250,32 +300,72 @@ class PrefixCacheIndex:
         self._m_cow.inc()
         self._publish_shared()
 
-    # -- eviction (LRU, leaf-first) ----------------------------------------
+    # -- eviction (LRU, leaf-first, spill-before-destroy) ------------------
     def _idle(self, e):
-        # the index's own ref is the only one left
-        return self._cache.refcount(e.block) == 1
+        # the index's own ref is the only one left (host-tier entries
+        # hold no device ref and are never device-eviction victims)
+        return (e.tier == "device"
+                and self._cache.refcount(e.block) == 1)
 
     def evictable_total(self):
-        """Blocks reclaimable by eviction right now. Idle parents imply
-        idle children (a request refs its whole matched prefix), so the
-        idle count IS the transitively-evictable count."""
+        """DEVICE blocks reclaimable by eviction right now. Idle
+        parents imply idle children (a request refs its whole matched
+        prefix), so the idle count IS the transitively-evictable
+        count. Host-tier entries hold no device block — not counted."""
         return sum(1 for e in self._entries.values() if self._idle(e))
 
     def evict_lru(self, protect=frozenset()):
-        """Evict the least-recently-touched idle LEAF entry; its block
-        returns to the free list. Returns the block id, or None when
-        nothing is evictable. `protect` names chain keys that must
-        survive — an admission in progress has MATCHED (but not yet
-        claimed) those entries, and evicting them out from under it
-        would invalidate the match."""
+        """Evict the least-recently-touched idle LEAF entry; its
+        device block returns to the free list. Returns the block id,
+        or None when nothing is evictable. `protect` names chain keys
+        that must survive — an admission in progress has MATCHED (but
+        not yet claimed) those entries, and evicting them out from
+        under it would invalidate the match; the rule covers the HOST
+        tier too (a protected entry is neither destroyed nor dropped
+        from host — spilling it is fine, the match stays valid as a
+        swap-in).
+
+        With a host tier attached, eviction SPILLS instead of
+        destroying: the KV moves device->host, the entry survives
+        under tier="host", and a later hit swaps it back in instead of
+        re-prefilling. Leaf-first relaxes to device-leaf-first (an
+        entry whose remaining children are all host-tier may spill —
+        the chain stays walkable). Destruction only happens with no
+        host tier, or when the host pool is full even after dropping
+        its own LRU."""
         victim = None
         for e in self._entries.values():
-            if e.key in protect:
+            if e.key in protect or e.tier != "device":
                 continue
-            if e.children == 0 and self._idle(e):
+            if e.children - e.host_children == 0 and self._idle(e):
                 if victim is None or e.last_touch < victim.last_touch:
                     victim = e
         if victim is None:
+            return None
+        if getattr(self._cache, "host", None) is not None:
+            hb = self._cache.spill_block(victim.block)
+            if hb is None and self._drop_host_lru(protect) is not None:
+                hb = self._cache.spill_block(victim.block)
+            if hb is not None:
+                blk = victim.block
+                victim.tier = "host"
+                victim.host_block = hb
+                victim.block = None
+                del self._by_block[blk]
+                if victim.parent is not None:
+                    parent = self._entries.get(victim.parent)
+                    if parent is not None:
+                        parent.host_children += 1
+                self._cache.unref(blk)
+                self.counts["evictions"] += 1
+                self.counts["spills"] += 1
+                self._m_evictions.inc()
+                self._publish_shared()
+                return blk
+        if victim.children:
+            # can't destroy: host-tier children would be stranded
+            # unreachable (the chain walk dies at the missing parent).
+            # Only hit when the host pool is exhausted AND undroppable.
             return None
         del self._entries[victim.key]
         del self._by_block[victim.block]
@@ -288,6 +378,33 @@ class PrefixCacheIndex:
         self._m_evictions.inc()
         self._publish_shared()
         return victim.block
+
+    def _drop_host_lru(self, protect=frozenset()):
+        """Destroy the least-recently-touched host-tier LEAF entry to
+        free one host block (the host pool's own pressure valve —
+        host-tier entries age out for good once even the spill pool is
+        full). Respects `protect` exactly like device eviction: a
+        spilled entry a router-held match() still names must survive
+        until the claim lands (the PR 10 protected-entry rule extended
+        to the host tier). Returns the freed host block id or None."""
+        victim = None
+        for e in self._entries.values():
+            if e.key in protect or e.tier != "host":
+                continue
+            if e.children == 0:
+                if victim is None or e.last_touch < victim.last_touch:
+                    victim = e
+        if victim is None:
+            return None
+        del self._entries[victim.key]
+        if victim.parent is not None:
+            parent = self._entries.get(victim.parent)
+            if parent is not None:
+                parent.children -= 1
+                parent.host_children -= 1
+        self._cache.host.free([victim.host_block])
+        self.counts["host_drops"] += 1
+        return victim.host_block
 
     def evict_for(self, need, protect=frozenset()):
         """Evict until `need` blocks are free (or nothing evictable is
@@ -305,11 +422,33 @@ class PrefixCacheIndex:
         router's disaggregated handoff walks a retired request's chain
         through here to find WHICH pool blocks hold the prefix KV it
         must transfer (serving/router.py). Call under the owning
-        scheduler's lock like every other method."""
+        scheduler's lock like every other method. A host-tier entry
+        peeks as None — its KV is not in the device pool, so a handoff
+        walk cannot adopt from it directly; callers that can afford a
+        swap-in use `materialize_key()` first."""
         e = self._entries.get(key)
-        if e is None:
+        if e is None or e.tier != "device":
             return None
         return e.block, e.tokens, e.parent
+
+    def materialize_key(self, key):
+        """Swap a spilled chain entry back into the device pool (the
+        router's resurrection re-warm lifts host-tier chains through
+        here before adopting their blocks into the new replica).
+        Returns the device block id, or None when the key is absent,
+        already device-tier (use peek), or no device block is free."""
+        e = self._entries.get(key)
+        if e is None or e.tier != "host":
+            return None
+        if self._cache.num_free < 1:
+            return None
+        return self._materialize(e)
+
+    def host_entry_count(self):
+        """Live host-tier (spilled) entries — each holds exactly one
+        host block that a claim would hand back."""
+        return sum(1 for e in self._entries.values()
+                   if e.tier == "host")
 
     # -- introspection -----------------------------------------------------
     def shared_block_count(self):
@@ -317,7 +456,8 @@ class PrefixCacheIndex:
         top of the index's own ref — the serving.prefix.shared_blocks
         gauge."""
         return sum(1 for e in self._entries.values()
-                   if self._cache.refcount(e.block) >= 2)
+                   if e.tier == "device"
+                   and self._cache.refcount(e.block) >= 2)
 
     def _publish_shared(self):
         self._g_shared.labels(**self.labels).set(
@@ -337,5 +477,6 @@ class PrefixCacheIndex:
             "entries": len(self._entries),
             "evictable": self.evictable_total(),
             "shared_blocks": self.shared_block_count(),
+            "host_entries": self.host_entry_count(),
             **dict(self.counts),
         }

@@ -1076,7 +1076,13 @@ class FleetRouter:
         transfer's allocation ref is dropped). Chunks the decode index
         already holds are skipped — a hot tenant hands off only the
         suffix it is missing. Partial transfer is safe by construction:
-        whatever did not move simply re-prefills on the decode side."""
+        whatever did not move simply re-prefills on the decode side.
+        A chain chunk the source SPILLED to its host tier peeks as
+        None; rather than truncating the transfer there, lift it back
+        into the device pool (materialize_key — one swap-in, charged
+        against the source's free list) so the handoff serves spilled
+        chains too. A lift that cannot get a device block ends the
+        walk exactly like a missing entry."""
         bs = self._block_size
         pinned = []                 # (key, src_block, tokens)
         with src._sched._lock:
@@ -1084,6 +1090,9 @@ class FleetRouter:
                 return 0
             for i, key in enumerate(rr.keys):
                 got = src._prefix.peek(key)
+                if got is None and \
+                        src._prefix.materialize_key(key) is not None:
+                    got = src._prefix.peek(key)
                 if got is None:
                     break
                 block, tokens, _parent = got

@@ -107,10 +107,11 @@ class _Request:
 
 class _Slot:
     __slots__ = ("req", "blocks", "table", "pos", "admit_seq", "shared",
-                 "keys", "registered", "cow_spares", "cow_copies")
+                 "keys", "registered", "cow_spares", "cow_copies",
+                 "tier")
 
     def __init__(self, req, blocks, table, admit_seq, shared=(),
-                 keys=(), registered=0, cow_spares=()):
+                 keys=(), registered=0, cow_spares=(), tier="device"):
         self.req = req
         self.blocks = blocks                # every block to release
         self.table = table                  # np.int32 (max_blocks,)
@@ -121,10 +122,33 @@ class _Slot:
         self.registered = registered        # prompt chunks in the index
         self.cow_spares = list(cow_spares)  # reserved copy-on-write blocks
         self.cow_copies = 0
+        # "host" when this lane's KV crossed the host tier (admitted
+        # over swapped-in spilled chains, or resumed from a preempt) —
+        # the flight recorder's tier tag
+        self.tier = tier
 
     @property
     def prefilling(self):
         return self.pos < len(self.req.prompt)
+
+
+class _Preempted:
+    """A preempted request parked off-device: its KV sits in host-tier
+    blocks (its reservation — the no-mid-flight-OOM invariant), its
+    position/stream state rides the _Request untouched, and resume
+    swap-ins rebuild a slot that continues bitwise where it stopped."""
+
+    __slots__ = ("req", "pos", "host_blocks", "keys", "registered",
+                 "not_before")
+
+    def __init__(self, req, pos, host_blocks, keys, registered,
+                 not_before):
+        self.req = req
+        self.pos = pos
+        self.host_blocks = host_blocks
+        self.keys = keys
+        self.registered = registered
+        self.not_before = not_before    # earliest resume iteration
 
 
 def _lane_tuple(sid, slot):
@@ -136,7 +160,7 @@ def _lane_tuple(sid, slot):
     return (sid, slot.req.rid, int(slot.pos), bool(slot.prefilling),
             int(slot.admit_seq), len(slot.req.generated),
             int(slot.blocks[0]) if slot.blocks else None,
-            len(slot.shared), int(slot.cow_copies))
+            len(slot.shared), int(slot.cow_copies), slot.tier)
 
 
 class IterationPlan:
@@ -217,6 +241,20 @@ class ContinuousBatchingScheduler:
         self._cancel_rids = set()
         self._admit_seq = 0
         self.iteration = 0
+        # preempt-and-resume (host KV tier): FIFO of _Preempted
+        # records + host-block pledges. A request admitted LAZILY
+        # (blocks for prompt+1 instead of prompt+output) pledges its
+        # full worst-case block count against the host tier — worst
+        # case it parks there whole, which is what lets lazy admission
+        # retire the full-reservation concurrency ceiling without
+        # re-admitting mid-flight OOM. Plain attributes, not counts{}:
+        # the counts dict auto-registers serving.<key> counters, and
+        # these publish as the serving.kv.tier.* gauges instead.
+        self._preempted = []
+        self._host_pledged = 0
+        self._pledges = {}          # rid -> pledged block count
+        self.preempts = 0
+        self.resumes = 0
         self.counts = {"admitted": 0, "retired": 0, "cancelled": 0,
                        "deadline_cancels": 0, "generated_tokens": 0,
                        "prefill_tokens": 0, "spec.proposed": 0,
@@ -262,7 +300,7 @@ class ContinuousBatchingScheduler:
 
     def has_work(self):
         with self._lock:
-            return bool(self._queue) or any(
+            return bool(self._queue) or bool(self._preempted) or any(
                 s is not None for s in self._slots)
 
     def load_snapshot(self):
@@ -270,14 +308,22 @@ class ContinuousBatchingScheduler:
         — the fleet router's power-of-two-choices load probe
         (serving/router.py) reads all three per candidate per submit,
         and three separate property reads would take the lock three
-        times AND could tear across an admission."""
+        times AND could tear across an admission. Preempted requests
+        count as queued load: they are admitted work waiting for
+        blocks, invisible to the slot count."""
         with self._lock:
-            return (len(self._queue),
+            return (len(self._queue) + len(self._preempted),
                     sum(s is not None for s in self._slots),
                     self._cache.num_free)
 
     # -- retirement --------------------------------------------------------
+    def _unpledge(self, req):
+        m = self._pledges.pop(req.rid, None)
+        if m:
+            self._host_pledged -= m
+
     def _finish(self, req, reason):
+        self._unpledge(req)
         ttft = None
         if req.first_token_at is not None:
             ttft = (req.first_token_at - req.submitted_at) * 1e3
@@ -300,6 +346,7 @@ class ContinuousBatchingScheduler:
         return res
 
     def _fail(self, req, exc, count_key):
+        self._unpledge(req)
         try:
             if not req.future.cancelled():
                 req.future.set_exception(exc)
@@ -340,6 +387,20 @@ class ContinuousBatchingScheduler:
             self._queue = kept
             heapq.heapify(self._queue)
 
+    def _drop_preempted(self, pred, exc_fn, count_key):
+        """The _drop_queued sweep for parked requests: a cancel or
+        deadline must reach a preempted request too (its future is as
+        live as a queued one's), and its host-tier blocks — its
+        reservation — go back to the host pool."""
+        kept = []
+        for rec in self._preempted:
+            if pred(rec.req):
+                self._cache.host.free(rec.host_blocks)
+                self._fail(rec.req, exc_fn(rec.req), count_key)
+            else:
+                kept.append(rec)
+        self._preempted = kept
+
     def drop_queued_request(self, rid, exc):
         """Remove ONE queued request and fail its future — submit()'s
         lost-the-race-with-close sweep: an enqueue that landed after
@@ -362,6 +423,8 @@ class ContinuousBatchingScheduler:
         with self._lock:
             exc = exc or RequestCancelled("server closed")
             self._drop_queued(lambda r: True, lambda r: exc, "cancelled")
+            self._drop_preempted(lambda r: True, lambda r: exc,
+                                 "cancelled")
             for sid, slot in enumerate(self._slots):
                 if slot is not None:
                     self._fail(slot.req, exc, "cancelled")
@@ -385,6 +448,10 @@ class ContinuousBatchingScheduler:
                               lambda r: RequestCancelled(
                                   f"request {r.rid} cancelled"),
                               "cancelled")
+            self._drop_preempted(lambda r: r.rid in rids,
+                                 lambda r: RequestCancelled(
+                                     f"request {r.rid} cancelled"),
+                                 "cancelled")
             for sid, slot in enumerate(self._slots):
                 if slot is not None and slot.req.rid in rids:
                     self._fail(slot.req, RequestCancelled(
@@ -394,6 +461,11 @@ class ContinuousBatchingScheduler:
             lambda r: r.deadline is not None and now > r.deadline,
             lambda r: DeadlineExceeded(
                 f"request {r.rid} deadline passed while queued"),
+            "deadline_cancels")
+        self._drop_preempted(
+            lambda r: r.deadline is not None and now > r.deadline,
+            lambda r: DeadlineExceeded(
+                f"request {r.rid} deadline passed while preempted"),
             "deadline_cancels")
         for sid, slot in enumerate(self._slots):
             if slot is None:
@@ -407,6 +479,7 @@ class ContinuousBatchingScheduler:
                 self._release_slot(sid)
 
     def _admit(self, now):
+        self._try_resume(now)
         while self._queue:
             free_sid = next((i for i, s in enumerate(self._slots)
                              if s is None), None)
@@ -417,6 +490,32 @@ class ContinuousBatchingScheduler:
             n_full = p_len // self._cache.block_size
             m_total = self._cache.blocks_for_tokens(
                 p_len + req.max_new_tokens)
+            # lazy admission (host tier on): reserve blocks for the
+            # prompt + the first decode write only, and PLEDGE the full
+            # worst-case count against the host pool instead — if this
+            # request must ever give its device blocks back, preempt
+            # parks it in its pledged host space. The pledge is
+            # conservative (a parked request holds used <= m_total host
+            # blocks yet still pledges m_total), but it is what keeps
+            # the no-mid-flight-OOM invariant: lazy lanes can ALWAYS be
+            # preempted, so a mid-flight allocation can always be
+            # satisfied by preempting someone. A request whose worst
+            # case exceeds the whole host tier falls back to full
+            # reservation (it could never park, so it must never need
+            # to).
+            host = self._cache.host
+            lazy = (host is not None and m_total <= host.num_blocks)
+            if lazy:
+                host_avail = host.num_free - self._host_pledged
+                if self._prefix is not None:
+                    host_avail += self._prefix.host_entry_count()
+                if host_avail < m_total:
+                    # pledge pool exhausted: fall back to full
+                    # reservation (correct without host space — a
+                    # fully-reserved lane never grows mid-flight)
+                    lazy = False
+            m_admit = (self._cache.blocks_for_tokens(p_len + 1)
+                       if lazy else m_total)
             # prefix probe (pure — no refs, no recency, no metric
             # movement: a backpressured admission retries every
             # iteration and must not read as cache traffic): only the
@@ -437,7 +536,13 @@ class ContinuousBatchingScheduler:
                 protect = frozenset(keys[:len(shared)])
             shared_tokens = len(shared) * self._cache.block_size
             full_cover = shared_tokens == p_len and shared_tokens > 0
-            need = m_total - len(shared) + (1 if full_cover else 0)
+            # a None in the match is a SPILLED chain entry: it counts
+            # toward the matched depth (no re-prefill!) but claim()
+            # must swap it back in, which costs one fresh device block
+            n_spilled = sum(1 for b in shared if b is None)
+            need = (m_admit - len(shared)
+                    + (1 if full_cover else 0))
+            need_free = need + n_spilled
             # watermark backpressure: keep headroom unless the pool is
             # otherwise idle (an idle pool must admit or deadlock).
             # Evictable cached blocks count as available — eviction
@@ -448,27 +553,38 @@ class ContinuousBatchingScheduler:
             avail = self._cache.num_free
             if self._prefix is not None:
                 protected_idle = sum(
-                    1 for b in shared if self._cache.refcount(b) == 1)
+                    1 for b in shared
+                    if b is not None and self._cache.refcount(b) == 1)
                 avail += (self._prefix.evictable_total()
                           - protected_idle)
-            if avail - need < floor:
+            if avail - need_free < floor:
                 return
-            if self._prefix is not None and self._cache.num_free < need:
-                self._prefix.evict_for(need, protect)
+            if self._prefix is not None \
+                    and self._cache.num_free < need_free:
+                self._prefix.evict_for(need_free, protect)
+            if self._cache.num_free < need_free:
+                return
             blocks = self._cache.allocate(need)
             if blocks is None:
                 return
             if self._prefix is not None:
                 # commit the match: refs + LRU touches + hit/miss
-                # counters move exactly once per ADMISSION
-                self._prefix.claim(keys, shared, n_full)
+                # counters move exactly once per ADMISSION. Spilled
+                # entries are materialized by swap-in here (the free
+                # blocks were checked above), so the returned list is
+                # fully device-resident.
+                shared = self._prefix.claim(keys, shared, n_full)
             heapq.heappop(self._queue)
             cow_spares = [blocks.pop()] if full_cover else []
             table = self._cache.make_table(shared + blocks,
                                            self.max_blocks)
             slot = _Slot(req, shared + blocks + cow_spares, table,
                          self._admit_seq, shared=shared, keys=keys,
-                         registered=len(shared), cow_spares=cow_spares)
+                         registered=len(shared), cow_spares=cow_spares,
+                         tier="host" if n_spilled else "device")
+            if lazy:
+                self._host_pledged += m_total
+                self._pledges[req.rid] = m_total
             # shared positions skip prefill entirely: their KV is
             # already in the pool, bitwise what this request would have
             # written (same tokens, same params, same executable)
@@ -481,6 +597,134 @@ class ContinuousBatchingScheduler:
                     req.rid, free_sid, self.iteration,
                     (now - req.submitted_at) * 1e3,
                     blocks=len(slot.blocks))
+
+    # -- preempt and resume (host KV tier) ---------------------------------
+    def _try_resume(self, now):
+        """Swap parked requests back in, oldest first, BEFORE any new
+        admission — a preempted request already paid its queueing and
+        prefill, so it outranks fresh arrivals for freed blocks. Stops
+        at the first request that cannot be resumed (FIFO fairness: a
+        small request must not starve a big one forever)."""
+        while self._preempted:
+            rec = self._preempted[0]
+            if rec.not_before > self.iteration:
+                return
+            free_sid = next((i for i, s in enumerate(self._slots)
+                             if s is None), None)
+            if free_sid is None:
+                return
+            need = len(rec.host_blocks)
+            floor = self.watermark_blocks if self.active_count else 0
+            avail = self._cache.num_free
+            if self._prefix is not None:
+                avail += self._prefix.evictable_total()
+            if avail - need < floor:
+                return
+            if self._prefix is not None \
+                    and self._cache.num_free < need:
+                self._prefix.evict_for(need)
+            blocks = self._cache.allocate(need)
+            if blocks is None:
+                return
+            self._preempted.pop(0)
+            for hb, db in zip(rec.host_blocks, blocks):
+                self._cache.swap_in_block(hb, db)
+            self._cache.host.free(rec.host_blocks)
+            table = self._cache.make_table(blocks, self.max_blocks)
+            slot = _Slot(rec.req, list(blocks), table, self._admit_seq,
+                         shared=(), keys=rec.keys,
+                         registered=rec.registered, tier="host")
+            slot.pos = rec.pos
+            self._slots[free_sid] = slot
+            self._admit_seq += 1
+            self.resumes += 1
+            if self._tel is not None:
+                self._tel.on_admit(
+                    rec.req.rid, free_sid, self.iteration,
+                    (now - rec.req.submitted_at) * 1e3,
+                    blocks=len(blocks))
+
+    def _preempt_victim(self, exclude=None):
+        """Pick the slot to preempt under block pressure: the DECODE
+        lane with the longest remaining tail (most max_new_tokens left
+        to generate) — it will hold its blocks longest, so parking it
+        frees the most block-iterations per swap. Prefilling lanes are
+        never victims (their KV is cheapest to hold right now and
+        their position bookkeeping assumes an uninterrupted prompt
+        walk)."""
+        best, best_rem = None, -1
+        for sid, slot in enumerate(self._slots):
+            if slot is None or sid == exclude or slot.prefilling:
+                continue
+            rem = slot.req.max_new_tokens - len(slot.req.generated)
+            if rem > best_rem:
+                best_rem, best = rem, sid
+        return best
+
+    def _preempt_slot(self, sid):
+        """Park slot `sid`'s request in the host tier: spill every
+        written block device->host, release the slot (device blocks
+        free; shared prefix blocks keep the index's device copy — the
+        spill wrote a private host copy, so resume never depends on
+        index survival), and queue a _Preempted record. The request's
+        generated tokens, score, and stream state ride its _Request
+        untouched, so the resumed stream is bitwise the uninterrupted
+        one. `not_before` skips resume until the NEXT iteration — a
+        chaos-injected preempt must actually park across a step, not
+        bounce back inside the same plan(). Returns False (nothing
+        changed) when the host pool cannot hold the blocks."""
+        slot = self._slots[sid]
+        used = self._cache.blocks_for_tokens(slot.pos)
+        host_blocks = []
+        for i in range(used):
+            b = int(slot.table[i])
+            hb = self._cache.spill_block(b)
+            while hb is None and self._prefix is not None \
+                    and self._prefix._drop_host_lru() is not None:
+                hb = self._cache.spill_block(b)
+            if hb is None:
+                if host_blocks:
+                    self._cache.host.free(host_blocks)
+                return False
+            host_blocks.append(hb)
+        rec = _Preempted(
+            slot.req, slot.pos, host_blocks, slot.keys,
+            len(slot.req.prompt) // self._cache.block_size,
+            self.iteration + 1)
+        self._release_slot(sid)
+        self._preempted.append(rec)
+        self.preempts += 1
+        return True
+
+    def _ensure_blocks(self, sid, slot, n):
+        """Lazy-mode mid-flight block growth: make the table cover the
+        writes [pos, pos+n) before the plan captures it. Allocation
+        order under pressure: free list, then prefix eviction, then
+        preempting the longest-tail OTHER decode, then parking this
+        lane itself. Returns False when the lane must sit this
+        iteration out unplanned (or was itself preempted)."""
+        bs = self._cache.block_size
+        for bi in range((slot.pos + n - 1) // bs + 1):
+            if int(slot.table[bi]) != 0:        # NULL-padded tail
+                continue
+            got = self._cache.allocate(1)
+            if got is None and self._prefix is not None:
+                self._prefix.evict_for(1)
+                got = self._cache.allocate(1)
+            while got is None:
+                victim = self._preempt_victim(exclude=sid)
+                if victim is None or not self._preempt_slot(victim):
+                    break
+                got = self._cache.allocate(1)
+            if got is None:
+                # last resort: park THIS lane — its host pledge
+                # guarantees the space, and parked beats wedged
+                if not slot.prefilling:
+                    self._preempt_slot(sid)
+                return False
+            slot.table[bi] = got[0]
+            slot.blocks.append(got[0])
+        return True
 
     def _maybe_cow(self, slot, pos, n):
         """Copy-on-write guard, called with the block range this lane
@@ -527,7 +771,7 @@ class ContinuousBatchingScheduler:
         iteration — the background worker's poll loop must not inflate
         the counter chaos plans and the bench's accounting key off."""
         with self._lock:
-            if not (self._queue or self._cancel_rids
+            if not (self._queue or self._cancel_rids or self._preempted
                     or any(s is not None for s in self._slots)):
                 return None
             self.iteration += 1
@@ -541,10 +785,67 @@ class ContinuousBatchingScheduler:
                             self.iteration)):
                         if self._prefix.evict_lru() is not None:
                             self._chaos.serving_eviction_applied()
+                    # deterministic SPILL injection: same idea, but
+                    # only counts as applied when the eviction took the
+                    # device->host path (host tier attached and not
+                    # full), which is what the tier tests pin down
+                    for _ in range(self._chaos.serving_spills_at(
+                            self.iteration)):
+                        before = self._prefix.counts["spills"]
+                        if (self._prefix.evict_lru() is not None
+                                and self._prefix.counts["spills"]
+                                > before):
+                            self._chaos.serving_spill_applied()
+                if self._cache.host is not None:
+                    # deterministic preempt injection: park a NAMED
+                    # in-flight decode at an exact iteration (no pool
+                    # pressure required); it resumes through the normal
+                    # _try_resume path next iteration at the earliest
+                    for rid in self._chaos.serving_preempts_at(
+                            self.iteration):
+                        for sid, slot in enumerate(self._slots):
+                            if (slot is not None
+                                    and slot.req.rid == rid
+                                    and not slot.prefilling):
+                                if self._preempt_slot(sid):
+                                    self._chaos \
+                                        .serving_preempt_applied()
+                                break
             now = self.now()
             self._apply_cancels_and_deadlines(now)
             self._admit(now)
+            if self._preempted and not any(s is not None
+                                           for s in self._slots):
+                # a parked request is the only live work (a chaos
+                # preempt can park the sole decode): an empty plan
+                # would read as idle and stop the manual drive loop
+                # with the request stranded — advance one iteration
+                # (satisfying not_before) and resume right now
+                self.iteration += 1
+                self._admit(now)
             s, c = self.num_slots, self.chunk
+
+            def _plan_cols(slot):
+                if slot.prefilling:
+                    return min(c, len(slot.req.prompt) - slot.pos)
+                if self.spec_k:
+                    return max(1, min(self.spec_k + 1, c,
+                                      slot.req.max_new_tokens
+                                      - len(slot.req.generated)))
+                return 1
+
+            # lazy-mode growth PRE-PASS: every lane's block needs are
+            # settled before ANY table row is captured below — a
+            # preemption during the array loop would leave lower-sid
+            # rows pointing at blocks that were just spilled and freed
+            starved = set()
+            if self._cache.host is not None:
+                for sid, slot in enumerate(self._slots):
+                    if slot is None:
+                        continue
+                    if not self._ensure_blocks(sid, slot,
+                                               _plan_cols(slot)):
+                        starved.add(sid)
             tokens = np.zeros((s, c), np.int32)
             positions = np.zeros((s, c), np.int32)
             valid = np.zeros((s, c), bool)
@@ -555,15 +856,15 @@ class ContinuousBatchingScheduler:
             prefill_tokens = 0
             lanes = [] if self._tel is not None else None
             for sid, slot in enumerate(self._slots):
-                if slot is None:
+                if slot is None or sid in starved:
                     continue
                 slot_ids.append(sid)
                 req = slot.req
                 limits[sid] = len(req.prompt) + req.max_new_tokens
                 if lanes is not None:
                     lanes.append(_lane_tuple(sid, slot))
+                n = _plan_cols(slot)        # == the pre-pass's count
                 if slot.prefilling:
-                    n = min(c, len(req.prompt) - slot.pos)
                     tokens[sid, :n] = req.prompt[slot.pos:slot.pos + n]
                     prefill_tokens += n
                     if self._tel is not None:
@@ -576,11 +877,6 @@ class ContinuousBatchingScheduler:
                     # q = min(k+1, chunk, remaining) verify columns —
                     # the engine fills 1..q-1 with draft proposals, and
                     # commit() accepts 1..q of the per-column outputs
-                    n = 1
-                    if self.spec_k:
-                        n = max(1, min(self.spec_k + 1, c,
-                                       req.max_new_tokens
-                                       - len(req.generated)))
                     decode_cols[sid] = n
                     tokens[sid, 0] = req.generated[-1]
                     emitting.add(sid)
@@ -804,6 +1100,11 @@ class ContinuousBatchingScheduler:
                 * shard_block_bytes,
                 "prefix": self._prefix.stats()
                 if self._prefix is not None else None,
+                "preempts": self.preempts,
+                "resumes": self.resumes,
+                "preempted_depth": len(self._preempted),
+                "host_blocks_free": self._cache.host.num_free
+                if self._cache.host is not None else None,
                 "spec_k": self.spec_k,
                 "spec_mode": self.spec_mode if self.spec_k else None,
                 **dict(self.counts),

@@ -187,6 +187,7 @@ SUITE_STEPS = (
     ("chaos_recovery", "bench_chaos.json", None),
     ("trace_compare", "bench_trace.json", None),
     ("signals_compare", "bench_signals.json", None),
+    ("tier_compare", "bench_tier.json", None),
     ("compile_sample", "compile_sample.json", None),
     ("ernie", "bench_ernie.json", None),
     ("packed", "bench_packed.json", None),
@@ -223,12 +224,51 @@ def _step_status(artifact, good_marker=None):
     return f"ok({backend})" if backend else "ok"
 
 
+def _stale_artifacts(window=5):
+    """perf/bench_*.json artifacts whose last-touching commit predates
+    the repo's last `window` commits (one commit per PR in this repo's
+    history) — standing evidence that was measured against code that
+    has since moved several PRs. A stale artifact is not wrong, but the
+    summary must say it is old: an `ok` from five PRs ago quietly
+    vouches for code it never ran against. Uncommitted (just-landed)
+    artifacts are fresh by definition. Returns basenames; any git
+    failure returns [] — staleness is decoration, never a gate."""
+    def _git(*args):
+        return subprocess.run(
+            ["git", *args], cwd=REPO, capture_output=True, text=True,
+            timeout=30).stdout
+    try:
+        recent = set(_git("log", f"-{int(window)}",
+                          "--format=%H").split())
+        if not recent:
+            return []
+        stale = []
+        for path in sorted(glob.glob(os.path.join(PERF,
+                                                  "bench_*.json"))):
+            rel = os.path.relpath(path, REPO)
+            if _git("status", "--porcelain", "--", rel).strip():
+                continue        # uncommitted edit: fresh this cycle
+            last = _git("log", "-1", "--format=%H", "--", rel).strip()
+            if last and last not in recent:
+                stale.append(os.path.basename(path))
+        return stale
+    except Exception:           # noqa: BLE001 — decoration, not a gate
+        return []
+
+
 def suite_summary(to_file=True):
     """ONE log line over the whole ladder — the standing state of every
     step's evidence (ok/degraded/skipped/failed + backend) at a
     glance, instead of buried in per-file caveats (the BENCH_r01–r05
-    rc=2 wedged-TPU era made this table hard-won knowledge)."""
-    parts = [f"{name}={_step_status(a, m)}" for name, a, m in SUITE_STEPS]
+    rc=2 wedged-TPU era made this table hard-won knowledge). Artifacts
+    whose last commit predates the last 5 PRs get a [stale] tag."""
+    stale = set(_stale_artifacts())
+
+    def _tag(art):
+        return " [stale]" if art in stale else ""
+
+    parts = [f"{name}={_step_status(a, m)}{_tag(a)}"
+             for name, a, m in SUITE_STEPS]
     # drift guard: steps/artifacts SUITE_STEPS does not know about
     # still surface (a step added to run_suite but not registered here
     # must not silently vanish from the summary — that would be the
@@ -239,12 +279,13 @@ def suite_summary(to_file=True):
     known = {a for _n, a, _m in SUITE_STEPS}
     for art in sorted(set(_OBSERVED_STEPS) - known):
         sname, marker = _OBSERVED_STEPS[art]
-        parts.append(f"{sname}={_step_status(art, marker)} "
+        parts.append(f"{sname}={_step_status(art, marker)}{_tag(art)} "
                      f"[unregistered]")
     for path in sorted(glob.glob(os.path.join(PERF, "bench_*.json"))):
         art = os.path.basename(path)
         if art not in known and art not in _OBSERVED_STEPS:
-            parts.append(f"{art}={_step_status(art)} [unregistered]")
+            parts.append(f"{art}={_step_status(art)}{_tag(art)} "
+                         f"[unregistered]")
     log("suite status: " + " ".join(parts), to_file=to_file)
 
 
@@ -442,6 +483,20 @@ def run_suite():
                  env={"JAX_PLATFORMS": "cpu",
                       "BENCH_SIGNALS_COMPARE": "1"},
                  timeout_s=900, stdout_path="bench_signals.json")
+    # 1f7. tiered-KV comparison (ISSUE 18): host-RAM spill pool +
+    #     swap-aware preempt/resume on-vs-off through the same
+    #     mixed-tenant stream over a starved device pool (ids pinned
+    #     bitwise across arms), on the CPU backend (deterministic;
+    #     acceptance: hit rate up, re-prefills avoided > 0, admitted
+    #     concurrency above the full-reservation baseline)
+    if _artifact_ok("bench_tier.json"):
+        log("step tier_compare: already landed in a prior cycle — "
+            "skipping")
+    else:
+        run_step("tier_compare", [py, bench],
+                 env={"JAX_PLATFORMS": "cpu",
+                      "BENCH_TIER_COMPARE": "1"},
+                 timeout_s=900, stdout_path="bench_tier.json")
     # 1g. compile-observatory sample (ISSUE 8): Executor.explain()
     #     report + provoked recompile storm + HBM-ledger snapshot +
     #     detector on-vs-off overhead, on the CPU backend

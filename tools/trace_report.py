@@ -239,6 +239,21 @@ def print_serving_summary(metrics, file=None):
         sa = _counter_total(metrics, "serving.spec.accepted")
         print(f"serving: spec proposed={sp} accepted={sa} "
               f"accept-rate={sa / max(sp, 1):.1%}", file=file)
+    # tiered KV cache (ISSUE 18): host-RAM spill-pool traffic — chains
+    # that left HBM alive, came back via swap-in, and the re-prefills
+    # the host tier absorbed, plus preempt/resume churn
+    thb = _counter_total(metrics, "serving.kv.tier.host_blocks")
+    tsp = _counter_total(metrics, "serving.kv.tier.spills")
+    tsw = _counter_total(metrics, "serving.kv.tier.swap_ins")
+    if thb or tsp or tsw:
+        tra = _counter_total(metrics,
+                             "serving.kv.tier.reprefills_avoided")
+        tpr = _counter_total(metrics, "serving.kv.tier.preempts")
+        tre = _counter_total(metrics, "serving.kv.tier.resumes")
+        print(f"serving: kv-tier host_blocks={int(thb)} "
+              f"spills={int(tsp)} swap_ins={int(tsw)} "
+              f"reprefills_avoided={int(tra)} preempts={int(tpr)} "
+              f"resumes={int(tre)}", file=file)
     # fleet router (ISSUE 11): routed-by-policy, shedding, failover,
     # and disaggregated handoff traffic
     routed_vals = metrics.get("serving.fleet.routed", {}).get(
@@ -465,7 +480,7 @@ def run_demo(out_dir):
     server = GenerationServer(
         GPTServingModel(sparams, scfg), num_slots=2, block_size=8,
         max_context=64, chunk=4, start=False, chaos=schaos,
-        slo_window_s=0.1, prefix_cache=True,
+        slo_window_s=0.1, prefix_cache=True, host_kv_blocks=16,
         spec=SpecDecodeConfig(GPTServingModel(sparams, scfg), k=3))
     victim = server.submit(np.arange(3, 15, dtype=np.int32),
                            max_new_tokens=30)
@@ -480,10 +495,16 @@ def run_demo(out_dir):
     shared_p = np.arange(3, 19, dtype=np.int32)     # 2 full blocks
     w1 = server.submit(shared_p, max_new_tokens=6)
     server.run_until_idle()
+    # tiered KV (ISSUE 18): spill the now-idle shared chain to the
+    # host pool before the repeat — the second wave's prefix hit
+    # re-adopts both blocks via swap-in, so serving.kv.tier.* series
+    # land in the sample with real spills/swap-ins behind them
+    schaos.spill_chain_at(server._sched.iteration + 1, 2)
     w2 = server.submit(shared_p, max_new_tokens=6)
     server.run_until_idle()
     for f in (w1, w2):
         f.result(timeout=5)
+    assert server.get_stats()["kv_tier"]["swap_ins"] >= 2
 
     # fleet router demo (ISSUE 11): a 2-replica routed stream — the
     # second wave repeats the first wave's prompts so prefix-affinity
