@@ -292,6 +292,32 @@ METRIC_SPECS = [
     ("serving.fleet.trace.dumps", "counter",
      "merged fleet Perfetto dumps produced by FleetRouter.dump_trace "
      "(fleet track + per-replica captures incl. death snapshots)"),
+    ("serving.fleet.rpc.requests", "counter",
+     "RPC calls issued to subprocess replica workers over the "
+     "localhost socket transport (submit/step/cancel/handoff/...)"),
+    ("serving.fleet.rpc.retries", "counter",
+     "RPC attempts retried after a connection-level failure "
+     "(reset/refused/truncated frame) with exponential backoff; "
+     "exhausting the budget classifies the worker DEAD"),
+    ("serving.fleet.rpc.timeouts", "counter",
+     "RPC calls that hit their deadline with the connection still "
+     "open — never retried (the worker may be mid-step); classifies "
+     "the worker HUNG-suspect for the watchdog"),
+    ("serving.fleet.autoscale.scale_ups", "counter",
+     "replica slots added by the SLO-driven autoscaler (burn rate "
+     "above up_threshold for up_samples consecutive signal samples)"),
+    ("serving.fleet.autoscale.scale_downs", "counter",
+     "replicas drained by the autoscaler (burn rate below "
+     "down_threshold for down_samples consecutive samples — "
+     "scale-down-slow hysteresis)"),
+    ("serving.fleet.autoscale.blocked", "counter",
+     "autoscaler scale-ups refused by the safety rail: a crash-loop "
+     "breaker entry open, a slot evicted, or a death awaiting "
+     "resurrection — a crashing image must never trigger a spawn "
+     "storm"),
+    ("serving.fleet.autoscale.desired", "gauge",
+     "replica count the autoscaler currently wants (label: router); "
+     "compare with serving.fleet.replicas to watch convergence"),
     ("tracing.dropped_events", "counter",
      "trace events dropped by the bounded ring buffer (drop-oldest)"),
     ("serving.queue_wait_ms", "histogram",

@@ -38,7 +38,8 @@ class ChaosInjector:
                       "serving_poison": 0, "evict": 0,
                       "hash_collision": 0, "replica_kill": 0,
                       "replica_hang": 0, "replica_slow": 0,
-                      "prompt_poison": 0, "spill": 0, "preempt": 0}
+                      "prompt_poison": 0, "spill": 0, "preempt": 0,
+                      "process_kill": 0, "conn_drop": 0}
         self._installed = False
         # serving-engine plan: iteration -> actions (scheduler hooks)
         self._serving_cancels = {}   # iteration -> [active-request index]
@@ -59,6 +60,9 @@ class ChaosInjector:
         self._replica_slow = {}      # replica idx -> ms per iteration
         self._slow_counted = set()   # replicas whose slow plan fired
         self._prompt_poisons = []    # (np.int32 prompt, kv layer)
+        # out-of-process fleet plan (serving/router.py + transport.py)
+        self._process_kills = {}     # router iteration -> [replica idx]
+        self._conn_drops = {}        # 1-based rpc ordinal -> fault kind
 
     # -- plan ----------------------------------------------------------
     def poison_grad_at(self, step, var=None):
@@ -133,6 +137,19 @@ class ChaosInjector:
         self._clock_advances[int(iteration)] = \
             self._clock_advances.get(int(iteration), 0.0) + ms / 1e3
         self._drives_clock = True
+        return self
+
+    def tick_clock(self, ms):
+        """Advance the injected serving clock NOW — the unconditional
+        twin of advance_clock_at. A per-iteration plan pops each key
+        ONCE, so a fleet whose engine population changes mid-run (an
+        autoscaler spawning replicas whose fresh engines re-walk
+        iteration numbers the plan already consumed) starves the
+        clock; fleet tests tick it directly between requests
+        instead."""
+        self._fake_now_s += float(ms) / 1e3
+        self._drives_clock = True
+        self.fired["clock_advance"] += 1
         return self
 
     def serving_clock(self):
@@ -308,6 +325,54 @@ class ChaosInjector:
 
     def replica_hang_applied(self):
         self.fired["replica_hang"] += 1
+
+    def kill_process_at(self, iteration, replica):
+        """SIGKILL the subprocess behind fleet replica index `replica`
+        at the START of router iteration `iteration` (1-based, same
+        counter as kill_replica_at). Unlike kill_replica_at — which
+        closes an in-process engine through its own close path — this
+        is a REAL `os.kill(pid, SIGKILL)`: the worker gets no chance
+        to flush, the parent only learns of the death when the next
+        RPC fails, and the whole dead-replica pipeline (failover,
+        supervisor resurrection) must hold against an actual process
+        corpse. `fired["process_kill"]` counts via
+        process_kill_applied only when a live worker pid was
+        actually signalled."""
+        self._process_kills.setdefault(int(iteration), []).append(
+            int(replica))
+        return self
+
+    def process_kills_at(self, iteration):
+        """-> replica indices whose worker process to SIGKILL at this
+        router iteration. Consumed by FleetRouter.step()."""
+        return self._process_kills.pop(int(iteration), [])
+
+    def process_kill_applied(self):
+        self.fired["process_kill"] += 1
+
+    def drop_connection_at(self, nth, kind="reset"):
+        """Inject exactly one transport fault on the `nth` RPC call
+        (1-based, counted per RpcClient) of any client built with this
+        injector. kind="reset" drops the connection before the send —
+        the client's bounded-backoff retry path must recover; kind=
+        "timeout" makes the call time out — the proxy's hung-suspect
+        classification path must engage. Deterministic (keyed to the
+        call ordinal, no sleeps needed to hit the window)."""
+        if kind not in ("reset", "timeout"):
+            raise ValueError(
+                f"drop_connection_at kind must be 'reset' or "
+                f"'timeout', got {kind!r}")
+        self._conn_drops[int(nth)] = kind
+        return self
+
+    def conn_drop_for(self, ncall):
+        """-> the fault kind planned for this rpc ordinal, or None.
+        Consumed by transport.RpcClient; counts fired["conn_drop"]
+        when a fault is actually injected."""
+        kind = self._conn_drops.pop(int(ncall), None)
+        if kind is not None:
+            self.fired["conn_drop"] += 1
+        return kind
 
     def slow_replica(self, replica, ms_per_iteration):
         """Standing plan: every pump of fleet replica index `replica`

@@ -56,7 +56,8 @@ quarantines}``.
 import numpy as np
 
 __all__ = ["FleetSupervisor", "SupervisorConfig", "PoisonRequestError",
-           "ChunkPopularityDigest", "make_checkpoint_spawn"]
+           "ChunkPopularityDigest", "make_checkpoint_spawn",
+           "Autoscaler", "AutoscalerConfig"]
 
 
 class PoisonRequestError(RuntimeError):
@@ -439,6 +440,272 @@ class FleetSupervisor:
                 i: {"failures": b["failures"],
                     "retry_at_heartbeat": b["retry_at"] or None}
                 for i, b in self._breaker.items()},
+            **dict(self.counts),
+        }
+
+
+class AutoscalerConfig:
+    """Tuning knobs for the SLO-driven Autoscaler (docs/robustness.md
+    "Autoscaler safety rail" and docs/serving.md "Out-of-process
+    fleet" walk through each):
+
+    - min_replicas / max_replicas: hard fleet-size bounds; the
+      controller never drains below the floor or spawns past the
+      ceiling, no matter what the burn series says.
+    - targets: check_slo's shape ({"ttft_ms": {"p95": 250.0}, ...}) —
+      the SLOs whose windowed burn rates drive scaling. The router
+      folds these into its ``slo.window_burn.<metric>.<qtag>`` series
+      sampling, so an autoscaled fleet needs no AdmissionPolicy for
+      the series to exist.
+    - up_threshold / down_threshold: burn-rate hysteresis band. Above
+      up_threshold the error budget is actively burning (scale up);
+      below down_threshold the fleet is comfortably over-provisioned
+      (scale down); in between, hold. up > down is enforced — a
+      touching band would oscillate.
+    - up_samples / down_samples: consecutive NEW burn samples past the
+      threshold before acting. Scale-up-fast / scale-down-slow is
+      expressed here: the defaults react to 2 bad samples but demand 6
+      calm ones — adding capacity late costs user latency, removing it
+      early costs a re-spawn (and its cold caches) minutes later.
+    - cooldown_heartbeats: router heartbeats to hold after ANY scale
+      action before considering another — the new replica's effect
+      must reach the burn series before the controller trusts it.
+
+    Deterministic like everything in this module: samples are counted
+    by series-point arrival (the router's injected signals clock), the
+    cooldown in router heartbeats — no wall clocks."""
+
+    def __init__(self, min_replicas=1, max_replicas=4, targets=None,
+                 up_threshold=1.0, down_threshold=0.25,
+                 up_samples=2, down_samples=6, cooldown_heartbeats=8):
+        if min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if max_replicas < min_replicas:
+            raise ValueError(
+                f"max_replicas ({max_replicas}) must be >= "
+                f"min_replicas ({min_replicas})")
+        if not (up_threshold > down_threshold):
+            raise ValueError(
+                f"up_threshold ({up_threshold}) must exceed "
+                f"down_threshold ({down_threshold}) — the hysteresis "
+                f"band is what stops flapping")
+        if up_samples < 1 or down_samples < 1:
+            raise ValueError("up_samples/down_samples must be >= 1")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.targets = ({m: dict(q) for m, q in targets.items()}
+                        if targets else {"ttft_ms": {"p95": 250.0}})
+        self.up_threshold = float(up_threshold)
+        self.down_threshold = float(down_threshold)
+        self.up_samples = int(up_samples)
+        self.down_samples = int(down_samples)
+        self.cooldown_heartbeats = int(cooldown_heartbeats)
+
+
+class Autoscaler:
+    """SLO-driven fleet sizing: spawn and retire replica slots from
+    the live windowed burn-rate series, with the crash-loop breaker
+    as the safety rail.
+
+    Constructed by FleetRouter (``autoscale=True`` /
+    ``AutoscalerConfig(...)``) and driven by its step(): one
+    on_heartbeat() per router iteration, exactly like the supervisor.
+    The controller reads the fleet's ``slo.window_burn.<metric>.
+    <qtag>`` series (PR 17 health signals — the ~2-window rolling
+    view that decays after recovery, so a scale-up it causes can
+    actually register as relief). Streaks count NEW series points,
+    not heartbeats: idle heartbeats between signal samples neither
+    age the evidence nor fake more of it.
+
+    The safety rail: while any crash-loop breaker entry is open, any
+    slot is permanently evicted, or any death is still awaiting
+    resurrection, scale-ups are BLOCKED (counted in
+    ``serving.fleet.autoscale.blocked``). A crashing image makes its
+    own burn rate terrible — survivors absorb its load — and an
+    autoscaler without this rail would read that as demand and spawn
+    the same broken image in a storm. Capacity problems get capacity;
+    health problems stay the supervisor's.
+
+    Scale-up goes through ``router.add_replica_slot()`` (spawn_fn +
+    fleet-contract validation); scale-down drains the least-loaded
+    accepting replica — its in-flight work finishes, then the
+    router's step() closes it. Metrics:
+    ``serving.fleet.autoscale.{scale_ups,scale_downs,blocked}`` and
+    the ``serving.fleet.autoscale.desired`` gauge."""
+
+    def __init__(self, router, config=None):
+        from ..observability import _help
+        from ..observability.metrics import global_registry
+        self.router = router
+        self.config = config or AutoscalerConfig()
+        self.heartbeat = 0
+        self.desired = None         # set from live count, first tick
+        self._last_t = None         # newest burn sample consumed
+        self._up_streak = 0
+        self._down_streak = 0
+        self._cooldown_until = 0
+        self.counts = {"scale_ups": 0, "scale_downs": 0, "blocked": 0,
+                       "spawn_failures": 0, "samples": 0}
+        reg = global_registry()
+        self._m_ups = reg.counter(
+            "serving.fleet.autoscale.scale_ups",
+            _help("serving.fleet.autoscale.scale_ups"))
+        self._m_downs = reg.counter(
+            "serving.fleet.autoscale.scale_downs",
+            _help("serving.fleet.autoscale.scale_downs"))
+        self._m_blocked = reg.counter(
+            "serving.fleet.autoscale.blocked",
+            _help("serving.fleet.autoscale.blocked"))
+        self._g_desired = reg.gauge(
+            "serving.fleet.autoscale.desired",
+            _help("serving.fleet.autoscale.desired"))
+
+    # -- heartbeat ---------------------------------------------------------
+    def on_heartbeat(self):
+        """One control pass. Returns True when the fleet size changed
+        (the router's step() treats that as activity)."""
+        self.heartbeat += 1
+        router = self.router
+        live = [r for r in router._replicas if r.accepting()]
+        if self.desired is None:
+            self.desired = len(live)
+        self._g_desired.labels(router=router.name).set(self.desired)
+        point = self._worst_burn()
+        if point is not None:
+            t, worst = point
+            if t != self._last_t:       # a NEW sample — fresh evidence
+                self._last_t = t
+                self.counts["samples"] += 1
+                cfg = self.config
+                if worst > cfg.up_threshold:
+                    self._up_streak += 1
+                    self._down_streak = 0
+                elif worst < cfg.down_threshold:
+                    self._down_streak += 1
+                    self._up_streak = 0
+                else:                   # inside the hysteresis band
+                    self._up_streak = self._down_streak = 0
+        if self.heartbeat < self._cooldown_until:
+            return False
+        cfg = self.config
+        if self._up_streak >= cfg.up_samples:
+            return self._try_scale_up(live)
+        if self._down_streak >= cfg.down_samples:
+            return self._try_scale_down(live)
+        return False
+
+    def _worst_burn(self):
+        """The newest value across every configured burn series, as
+        (t, worst); None before the first sample lands. Worst-of is
+        the right fold: one breached SLO is a capacity problem even
+        while the others are green."""
+        store = getattr(self.router._signals, "fleet", None)
+        if store is None:
+            return None
+        newest_t = worst = None
+        for metric, qmap in self.config.targets.items():
+            for tag in qmap:
+                p = store.latest(f"slo.window_burn.{metric}.{tag}")
+                if p is None:
+                    continue
+                t, v = p
+                if newest_t is None or t > newest_t:
+                    newest_t = t
+                if worst is None or v > worst:
+                    worst = v
+        if newest_t is None:
+            return None
+        return (newest_t, worst)
+
+    def _rail_open(self):
+        """True while scale-ups must be blocked: an open crash-loop
+        breaker entry, a permanently evicted slot, or a death still
+        awaiting resurrection. All three mean the fleet is losing
+        replicas to something a fresh spawn would inherit."""
+        for r in self.router._replicas:
+            if r.state == "evicted":
+                return True
+            if not r.alive() and r.state not in ("drained",):
+                return True
+        sup = self.router.supervisor
+        if sup is not None:
+            for b in sup._breaker.values():
+                if b["failures"]:
+                    return True
+        return False
+
+    def _try_scale_up(self, live):
+        router = self.router
+        if len(live) >= self.config.max_replicas:
+            self._up_streak = 0     # at the ceiling: demand re-proves
+            return False
+        if self._rail_open():
+            self.counts["blocked"] += 1
+            self._m_blocked.inc()
+            self._up_streak = 0
+            router._flight_event(
+                "scale_up_blocked", live=len(live),
+                reason="crash-loop breaker / unresolved death")
+            return False
+        try:
+            rep = router.add_replica_slot()
+        except Exception as e:  # noqa: BLE001 — spawn_fn is user code
+            self.counts["spawn_failures"] += 1
+            self._up_streak = 0
+            self._cooldown_until = (self.heartbeat
+                                    + self.config.cooldown_heartbeats)
+            router._flight_event("scale_up_failed", why=repr(e))
+            return False
+        self.counts["scale_ups"] += 1
+        self._m_ups.inc()
+        self.desired = len(live) + 1
+        self._g_desired.labels(router=router.name).set(self.desired)
+        self._up_streak = self._down_streak = 0
+        self._cooldown_until = (self.heartbeat
+                                + self.config.cooldown_heartbeats)
+        router._flight_event("autoscale_up", replica=rep.name,
+                             desired=self.desired)
+        return True
+
+    def _try_scale_down(self, live):
+        router = self.router
+        if len(live) <= self.config.min_replicas:
+            self._down_streak = 0   # at the floor: calm re-proves
+            return False
+        # retire the least-loaded accepting replica: fewest in-flight
+        # requests to finish, so the drain completes soonest
+        victim = min(live, key=lambda r: r.load())
+        router.drain_replica(victim.index)
+        self.counts["scale_downs"] += 1
+        self._m_downs.inc()
+        self.desired = len(live) - 1
+        self._g_desired.labels(router=router.name).set(self.desired)
+        self._up_streak = self._down_streak = 0
+        self._cooldown_until = (self.heartbeat
+                                + self.config.cooldown_heartbeats)
+        router._flight_event("autoscale_down", replica=victim.name,
+                             desired=self.desired)
+        return True
+
+    def stats(self):
+        return {
+            "heartbeat": self.heartbeat,
+            "desired": self.desired,
+            "up_streak": self._up_streak,
+            "down_streak": self._down_streak,
+            "cooldown_until": self._cooldown_until or None,
+            "rail_open": self._rail_open(),
+            "config": {
+                "min_replicas": self.config.min_replicas,
+                "max_replicas": self.config.max_replicas,
+                "targets": {m: dict(q) for m, q in
+                            self.config.targets.items()},
+                "up_threshold": self.config.up_threshold,
+                "down_threshold": self.config.down_threshold,
+                "up_samples": self.config.up_samples,
+                "down_samples": self.config.down_samples,
+                "cooldown_heartbeats": self.config.cooldown_heartbeats,
+            },
             **dict(self.counts),
         }
 

@@ -37,6 +37,16 @@ deadline. This package is the TPU-native answer:
                   (`spec=SpecDecodeConfig(draft_model, k)`);
 - replica.py    — one GenerationServer behind the fleet lifecycle
                   contract (health/load/affinity probes, drain, kill);
+- transport.py / worker.py / remote.py — the out-of-process backend:
+                  a length-prefixed localhost-socket RPC (versioned
+                  frames, JSON header + raw tensor blobs), the worker
+                  process serving a GenerationServer behind it, and
+                  the parent-side WorkerProxy speaking the engine
+                  surface — `make_subprocess_spawn(...)` turns a
+                  checkpoint dir into a spawn_fn whose replicas are
+                  real processes (real SIGKILL chaos, SLO-driven
+                  autoscaling via `autoscale=`; docs/serving.md
+                  "Out-of-process fleet");
 - router.py     — FleetRouter: N replicas behind one submit() —
                   prefix-affinity routing (the index chain keys ARE
                   the affinity signal), SLO-burn-rate admission
@@ -69,6 +79,9 @@ from .spec_decode import SpecDecodeConfig
 from .replica import Replica
 from .router import (AdmissionPolicy, AdmissionRejected, FleetFuture,
                      FleetRouter, RouterPolicy)
+from .transport import (FrameError, RemoteError, RpcTimeout,
+                        TransportError, VersionMismatch)
+from .remote import WorkerProxy, make_subprocess_spawn, spawn_worker
 
 __all__ = [
     "PagedKVCache", "PagedDecodeLayer", "paged_attention",
@@ -80,4 +93,7 @@ __all__ = [
     "GenerationServer", "GenerationFuture", "GPTServingModel",
     "Replica", "FleetRouter", "FleetFuture", "RouterPolicy",
     "AdmissionPolicy", "AdmissionRejected",
+    "WorkerProxy", "make_subprocess_spawn", "spawn_worker",
+    "TransportError", "FrameError", "VersionMismatch", "RpcTimeout",
+    "RemoteError",
 ]

@@ -724,6 +724,7 @@ class PagedKVCache:
         self._siblings = []
         self._cow_fn = None
         self._xfer_fn = None
+        self._wire_in_fn = None
         self.cow_copies = 0
         # host spill tier (enable_host_tier): None until enabled. The
         # two lazy jits are the tier's ENTIRE signature budget — one
@@ -961,6 +962,90 @@ class PagedKVCache:
         self.pools = self._xfer_fn(src_cache.pools, self.pools,
                                    jnp.asarray(src_block, jnp.int32),
                                    jnp.asarray(dst_block, jnp.int32))
+
+    # -- wire handoff (out-of-process fleet, serving/transport.py) ---------
+    def wire_geometry(self):
+        """The block-shape contract a serialized block travels with:
+        receivers validate it before touching their pools (the same
+        tuple adopt_block_from checks in-process)."""
+        return {"num_layers": self.num_layers,
+                "num_heads": self.num_heads,
+                "num_kv_heads": self.num_kv_heads,
+                "head_dim": self.head_dim,
+                "block_size": self.block_size,
+                "quantized": bool(self.quantized)}
+
+    def serialize_block(self, block):
+        """-> (meta, arrays) for block `block`: meta carries the
+        wire_geometry + pool-entry names, arrays is one host numpy
+        array per (layer, name) — int8 codes next to their f32 scale
+        rows when quantized. This is the byte payload of a
+        cross-process ``adopt_block_from``; deserialize_block is the
+        receiving half."""
+        names = sorted(self.pools[0].keys())
+        arrays = [np.asarray(layer[name][block])
+                  for layer in self.pools for name in names]
+        return {"geometry": self.wire_geometry(), "names": names}, arrays
+
+    def deserialize_block(self, dst_block, meta, arrays):
+        """Write a serialize_block payload into local block
+        `dst_block`, geometry-validated first: a mismatched layout or
+        a quantized<->dense mix is rejected with the adopt_block_from
+        error contract rather than silently writing garbage KV. One
+        jitted write signature per cache lifetime (block id rides as a
+        traced scalar)."""
+        g = meta.get("geometry", {})
+        src_geo = (g.get("num_layers"), g.get("num_heads"),
+                   g.get("num_kv_heads"), g.get("head_dim"),
+                   g.get("block_size"))
+        if src_geo != (self.num_layers, self.num_heads,
+                       self.num_kv_heads, self.head_dim,
+                       self.block_size):
+            raise ValueError(
+                f"deserialize_block needs matching pool geometry; got "
+                f"src (L={g.get('num_layers')}, H={g.get('num_heads')}, "
+                f"H_kv={g.get('num_kv_heads')}, D={g.get('head_dim')}, "
+                f"bs={g.get('block_size')}) vs "
+                f"dst (L={self.num_layers}, H={self.num_heads}, "
+                f"H_kv={self.num_kv_heads}, D={self.head_dim}, "
+                f"bs={self.block_size})")
+        if bool(g.get("quantized", False)) != self.quantized:
+            src_fmt = ("int8+scales" if g.get("quantized")
+                       else "dense float")
+            dst_fmt = ("int8+scales" if self.quantized
+                       else f"dense {np.dtype(self.dtype).name}")
+            raise ValueError(
+                f"deserialize_block cannot transfer between a "
+                f"quantized and a dense pool: src is {src_fmt}, dst is "
+                f"{dst_fmt} — int8 codes are meaningless without their "
+                f"scale rows and there is no implicit requantize path. "
+                f"Build both tiers with the same kv_dtype (the fleet "
+                f"handoff contract, docs/serving.md)")
+        names = list(meta.get("names", ()))
+        want = sorted(self.pools[0].keys())
+        if names != want:
+            raise ValueError(
+                f"deserialize_block payload names {names} do not match "
+                f"this pool's entries {want}")
+        expect = self.num_layers * len(names)
+        if len(arrays) != expect:
+            raise ValueError(
+                f"deserialize_block expected {expect} arrays "
+                f"({self.num_layers} layers x {len(names)} entries), "
+                f"got {len(arrays)} — truncated handoff payload")
+        rows = [{name: arrays[li * len(names) + ni]
+                 for ni, name in enumerate(names)}
+                for li in range(self.num_layers)]
+        if self._wire_in_fn is None:
+            def _write(pools, rows, d):
+                return [
+                    {name: layer[name].at[d].set(
+                        row[name].astype(layer[name].dtype))
+                     for name in layer}
+                    for layer, row in zip(pools, rows)]
+            self._wire_in_fn = jax.jit(_write)
+        self.pools = self._wire_in_fn(
+            self.pools, rows, jnp.asarray(dst_block, jnp.int32))
 
     # -- host spill tier ---------------------------------------------------
     def enable_host_tier(self, num_blocks):

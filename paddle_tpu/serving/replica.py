@@ -47,6 +47,23 @@ class Replica:
         self.condition = "ok"       # "ok" | "slow" (watchdog verdict)
         self.generation = 0         # resurrection count for this slot
         self.step_ms_ema = None     # router-measured pump time (EMA)
+        # the transport seam: "inproc" wraps an in-process engine,
+        # "subprocess" a WorkerProxy speaking the socket RPC to a
+        # worker process (serving/remote.py). The wrapper itself is
+        # backend-blind — every probe below reads the same surface —
+        # but the router branches on it for the KV handoff (pool-slice
+        # copy vs serialized wire transfer) and stamps it into /trace
+        # hop records.
+        self.backend = ("subprocess" if getattr(server, "remote",
+                                                False) else "inproc")
+
+    @property
+    def pid(self):
+        """The process serving this replica: the worker's pid for the
+        subprocess backend, our own for inproc (trace hop records)."""
+        import os
+        return (self.server.pid if self.backend == "subprocess"
+                else os.getpid())
 
     # -- health ------------------------------------------------------------
     def health(self):
@@ -162,6 +179,16 @@ class Replica:
         closes the engine once the replica is empty (state 'drained')."""
         if self.state == "ok":
             self.state = "draining"
+
+    def notify_preempt(self):
+        """Fleet preempt drain reaching this replica: a no-op for the
+        in-process backend (the router's own drain covers it); the
+        subprocess backend forwards it so the WORKER finishes its
+        in-flight work, closes, and exits cleanly — SIGTERM semantics
+        across the process boundary (ISSUE 19 satellite)."""
+        fwd = getattr(self.server, "notify_preempt", None)
+        if fwd is not None and self.alive():
+            fwd()
 
     def finish_drain_if_idle(self):
         """draining + empty -> close + 'drained'. Returns True when the

@@ -274,7 +274,7 @@ class FleetRouter:
                  max_failovers=None, spawn_fn=None, supervisor=None,
                  preemption=None, poison_threshold=2, flight_dir=None,
                  trace=False, trace_sample=None, signals=True,
-                 alert_rules=None, signals_every=8):
+                 alert_rules=None, signals_every=8, autoscale=None):
         if not servers:
             raise ValueError("FleetRouter needs at least one replica")
         self.name = name or f"fleet{next(_ROUTER_SEQ)}"
@@ -376,6 +376,26 @@ class FleetRouter:
         elif isinstance(supervisor, SupervisorConfig):
             supervisor = FleetSupervisor(self, supervisor)
         self.supervisor = supervisor
+        # SLO-driven autoscaling (robustness/supervisor.py Autoscaler):
+        # spawn/retire replica slots from the live windowed burn-rate
+        # series, with the crash-loop breaker as the safety rail.
+        # Needs spawn_fn (how would it add capacity?) and the signals
+        # plane (where would it read burn from?).
+        from ..robustness.supervisor import Autoscaler, AutoscalerConfig
+        if autoscale is True:
+            autoscale = Autoscaler(self)
+        elif isinstance(autoscale, AutoscalerConfig):
+            autoscale = Autoscaler(self, autoscale)
+        self.autoscaler = autoscale
+        if autoscale is not None:
+            if spawn_fn is None:
+                raise ValueError(
+                    "autoscale= needs spawn_fn= — scaling up means "
+                    "spawning a replica")
+            if not signals:
+                raise ValueError(
+                    "autoscale= needs signals=True — the autoscaler "
+                    "reads the slo.window_burn.* series")
         self._preempt_owned = preemption is True
         if preemption is True:
             from ..robustness.preemption import PreemptionHandler
@@ -746,8 +766,13 @@ class FleetRouter:
                              deadline_ms=deadline_ms,
                              stream=self._stream_cb(rr),
                              trace_ctx=ctx, tenant=rr.tenant)
+        # pid + transport make the hop record process-true: a /trace
+        # lineage crossing a subprocess boundary names the worker pid
+        # that served each hop (tools/request_trace.py renders both)
         rr.hops.append({"hop": hop, "replica": target.name,
-                        "phase": phase, "policy": label})
+                        "phase": phase, "policy": label,
+                        "pid": target.pid,
+                        "transport": target.backend})
         rr.rep_fut = fut
         self.counts["routed"] += 1
         self._m_routed.inc()
@@ -769,6 +794,8 @@ class FleetRouter:
                 args=dict(ctx.args(), rid=rr.rid,
                           replica=target.name, phase=phase,
                           policy=label, affinity_depth=depth,
+                          served_by_pid=target.pid,
+                          transport=target.backend,
                           candidate_loads=loads),
                 track="fleet router")
         fut.add_done_callback(lambda f, rr=rr: self._on_replica_done(
@@ -1035,7 +1062,7 @@ class FleetRouter:
             self._tracer.enabled and rr.ctx is not None
             and rr.ctx.sampled) else None
         if src is not None and src.alive():
-            moved = self._transfer_chain(src.server, target.server, rr)
+            moved = self._transfer_chain(src, target, rr)
         self.counts["handoffs"] += 1
         self.counts["handoff_blocks"] += moved
         self._m_handoffs.inc()
@@ -1066,7 +1093,40 @@ class FleetRouter:
         except (RuntimeError, ValueError) as sub_exc:
             self._enqueue(("failover", rr, sub_exc))
 
-    def _transfer_chain(self, src, dst, rr):
+    def _transfer_chain(self, src_rep, dst_rep, rr):
+        """Dispatch the chain handoff by backend: two in-process
+        replicas take the direct pool-slice path (one jitted device
+        copy per block — no host round-trip); any subprocess end goes
+        through the serialized wire transfer (export_chain /
+        import_chain, serving/worker.py): codes + scales + chain keys
+        over the socket RPC, geometry-validated on receive. A worker
+        dying mid-handoff is survivable by construction — the export
+        half unrefs its pins in a finally BEFORE any bytes travel, so
+        the donor's refcounts/ledger stay consistent and the decode
+        side simply re-prefills what never arrived."""
+        src, dst = src_rep.server, dst_rep.server
+        if src_rep.backend == "inproc" and dst_rep.backend == "inproc":
+            return self._transfer_chain_local(src, dst, rr)
+        from ..serving.transport import TransportError
+        from .worker import export_chain, import_chain
+        try:
+            if src_rep.backend == "subprocess":
+                chunks, arrays = src.export_chain(rr.prompt, rr.keys)
+            else:
+                chunks, arrays = export_chain(src, rr.prompt, rr.keys)
+            if not chunks:
+                return 0
+            if dst_rep.backend == "subprocess":
+                return dst.import_chain(chunks, arrays)
+            return import_chain(dst, chunks, arrays)
+        except TransportError:
+            # a worker died mid-handoff: partial transfer is safe (the
+            # decode replica re-prefills); the death itself surfaces
+            # on that replica's next pump/RPC through the normal
+            # dead-classification path
+            return 0
+
+    def _transfer_chain_local(self, src, dst, rr):
         """Move the prompt's cached chunk KV from the prefill replica
         into the decode replica: walk the chain through the prefill
         index (peek — the handoff manifest), PIN each source block with
@@ -1154,6 +1214,20 @@ class FleetRouter:
                 for idx in self._chaos.replica_kills_at(self.iteration):
                     self.kill_replica(idx)
                     did = True
+                for idx in self._chaos.process_kills_at(self.iteration):
+                    # the REAL death path: SIGKILL the worker pid and
+                    # touch nothing parent-side — the proxy discovers
+                    # the corpse on its next RPC, classifies it dead,
+                    # and failover/resurrection run exactly as they
+                    # would for a production crash
+                    r = self._replicas[idx]
+                    if r.alive() and r.backend == "subprocess" and \
+                            r.server.kill_process():
+                        self._chaos.process_kill_applied()
+                        self._flight_event("chaos_process_kill",
+                                           replica=r.name,
+                                           pid=r.server.pid)
+                        did = True
                 for idx in self._chaos.replica_hangs_at(self.iteration):
                     if self._replicas[idx].alive():
                         # the replica STALLS without dying: the router
@@ -1192,6 +1266,12 @@ class FleetRouter:
             # a hung-replica teardown enqueues failover re-admissions;
             # land them THIS step so recovery latency is deterministic
             did = self._drain_events() or did
+        if self.autoscaler is not None and any_work and \
+                not self._closed:
+            # after the supervisor: the breaker state the safety rail
+            # reads is this heartbeat's verdict, not last iteration's
+            if self.autoscaler.on_heartbeat():
+                did = True
         for r in self._replicas:
             if r.finish_drain_if_idle():
                 did = True
@@ -1292,6 +1372,48 @@ class FleetRouter:
         self._replicas[index].drain()
         self._notify()
 
+    def add_replica_slot(self):
+        """Grow the fleet by one slot: spawn a fresh replica through
+        spawn_fn (a new worker process under the subprocess backend),
+        validate the fleet contracts a mixed pool would break
+        (block_size — affinity chain keys chunk by it; quantization
+        layout — the handoff is a raw pool transfer), and start
+        routing to it. The autoscaler's scale-up primitive, also
+        usable directly by an operator. Returns the new Replica."""
+        if self.spawn_fn is None:
+            raise ValueError("add_replica_slot needs spawn_fn=")
+        index = len(self._replicas)
+        server = self.spawn_fn(index)
+        if server.block_size != self._block_size:
+            server.close(drain=False)
+            raise ValueError(
+                f"spawned replica has block_size={server.block_size}, "
+                f"fleet uses {self._block_size}")
+        if bool(getattr(server.cache, "quantized", False)) != \
+                bool(getattr(self._replicas[0].server.cache,
+                             "quantized", False)):
+            server.close(drain=False)
+            raise ValueError(
+                "spawned replica's KV quantization layout does not "
+                "match the fleet — the handoff contract forbids a "
+                "mixed pool")
+        rep = Replica(index, server)
+        if self._trace_bound:
+            self._bind_replica_recorder(rep)
+        with self._lock:
+            self._replicas.append(rep)
+        if self._signals is not None:
+            tel = rep.server.telemetry
+            if tel is not None and tel.series is not None:
+                self._signals.attach(rep.name, tel.series,
+                                     rep.generation)
+        self._flight_event("scale_up", replica=rep.name,
+                           live=sum(1 for r in self._replicas
+                                    if r.alive()))
+        self._publish_gauges()
+        self._notify()
+        return rep
+
     def _declare_hung(self, index):
         """The watchdog's verdict: progress marks frozen for N
         heartbeats with work pending. The hung engine is torn down
@@ -1386,6 +1508,12 @@ class FleetRouter:
             self._preempted = True
         self.counts["preempt_drains"] += 1
         self._flight_event("preempt_drain", pending=self.pending())
+        # the drain must reach CHILD processes too: subprocess workers
+        # get the preempt forwarded (finish in-flight, close, exit 0)
+        # — before this, the SIGTERM flag only stopped the parent loop
+        # and orphaned the workers (ISSUE 19 satellite bugfix)
+        for r in self._replicas:
+            r.notify_preempt()
         self._notify()
 
     # -- fleet tracing ------------------------------------------------------
@@ -1446,14 +1574,33 @@ class FleetRouter:
         t = self._signals_clock()
         self._signals.fleet.sample(t)
         adm = self.admission
+        targets = {}
         if adm is not None:
             targets = {m: dict(q) for m, q in adm.targets.items()}
             if adm.fleet_targets:
                 for metric, qmap in adm.fleet_targets.items():
                     targets.setdefault(metric, {}).update(qmap)
+        if self.autoscaler is not None:
+            # the autoscaler's SLO targets feed the same burn series —
+            # an autoscaled fleet without admission control still needs
+            # slo.window_burn.* to exist before it can track it
+            for metric, qmap in self.autoscaler.config.targets.items():
+                targets.setdefault(metric, {}).update(qmap)
+        if targets:
             pts = []
             live_tels = [r.server.telemetry for r in self._replicas
                          if r.alive() and r.server.telemetry is not None]
+            for tel in live_tels:
+                # window rotation normally rides the engine step loop,
+                # so an IDLE replica's last breached window would pin
+                # the fleet burn rate high forever (and the autoscaler
+                # could never scale down) — the signals heartbeat
+                # rolls idle engines' windows by clock. Remote
+                # telemetries have no maybe_roll (the worker process
+                # rolls its own).
+                roll = getattr(tel.slo, "maybe_roll", None)
+                if roll is not None:
+                    roll()
             for metric, qmap in targets.items():
                 # the ~2-window rolling view, count-weighted across
                 # live replicas — unlike check_slo's cumulative

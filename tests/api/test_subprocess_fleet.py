@@ -1,0 +1,267 @@
+"""Out-of-process fleet e2e: REAL worker processes behind the
+transport seam (serving/remote.py spawn + proxy, serving/worker.py
+host loop).
+
+`proc`-marked: every test here spawns actual subprocess workers
+(checkpoint-reload spawn, localhost socket RPC) and the chaos kills
+are REAL ``os.kill(pid, SIGKILL)`` — no monkeypatched death. The
+``proc_fleet`` fixture SIGKILLs any leaked worker on teardown so a
+failing test cannot strand processes. The contract under test:
+
+- a subprocess replica reproduces the in-process engine BITWISE
+  (same checkpoint, same prompts, same streams), keeps ONE fused step
+  signature for its process lifetime, and reports pid + signature
+  count on its own /healthz HTTP endpoint;
+- the SIGKILL storm: a real process death mid-decode plus a poison
+  prompt — the PR 12 machinery (failover, crash-loop breaker,
+  resurrection-with-re-warm, poison quarantine) runs UNCHANGED over
+  the wire; non-poison requests complete bitwise vs a clean
+  in-process reference, the poison request is quarantined within 2
+  process deaths, and the fleet returns to full strength with fresh
+  pids at bumped generations;
+- SIGTERM (PreemptionHandler) propagates: workers drain in-flight
+  requests and their processes EXIT 0 — graceful, not reaped;
+- a receiver dying mid-handoff surfaces TransportError while the
+  donor's refcounts and free list stay exactly consistent (the
+  export half pins, serializes, and unrefs in a finally BEFORE any
+  bytes travel).
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core import framework
+from paddle_tpu.core.executor import Scope, scope_guard
+from paddle_tpu.models import gpt
+from paddle_tpu.robustness import (ChaosInjector, CheckpointManager,
+                                   PoisonRequestError, PreemptionHandler,
+                                   SupervisorConfig)
+from paddle_tpu.serving import (FleetRouter, GenerationServer,
+                                GPTServingModel, TransportError)
+from paddle_tpu.serving.prefix_cache import prompt_chain_keys
+from paddle_tpu.serving.remote import make_subprocess_spawn
+from paddle_tpu.serving.worker import export_chain
+
+pytestmark = [pytest.mark.fleet, pytest.mark.chaos, pytest.mark.proc]
+
+SERVER_KW = dict(num_slots=3, block_size=8, max_context=64, chunk=4,
+                 start=False, prefix_cache=True)
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    cfg = gpt.gpt_tiny()
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 13
+    with framework.program_guard(main, startup):
+        gpt.build_lm_net(cfg, seq_len=8)
+    scope = Scope()
+    exe = fluid.Executor()
+    with scope_guard(scope):
+        exe.run(startup)
+    return cfg, gpt.load_params(scope, cfg), main, scope, exe
+
+
+@pytest.fixture(scope="module")
+def ckpt_dir(tiny_gpt, tmp_path_factory):
+    """One checkpoint for every spawn in the module — saving it once
+    keeps per-test cost at process startup, not executor setup."""
+    cfg, params, main, scope, exe = tiny_gpt
+    root = str(tmp_path_factory.mktemp("fleet_ckpt"))
+    mgr = CheckpointManager(root, program=main)
+    with scope_guard(scope):
+        mgr.save(exe, step=0, scope=scope)
+    return root
+
+
+def _reference_ids(params, cfg, prompts, n_new):
+    srv = GenerationServer(GPTServingModel(params, cfg), **SERVER_KW)
+    futs = [srv.submit(p, max_new_tokens=n_new) for p in prompts]
+    srv.run_until_idle()
+    ids = [list(f.result(timeout=5).token_ids) for f in futs]
+    srv.close()
+    return ids
+
+
+def test_subprocess_worker_is_bitwise_with_one_fused_signature(
+        tiny_gpt, ckpt_dir, proc_fleet):
+    """Same checkpoint, same prompts: the subprocess backend must be
+    indistinguishable from the in-process engine — token ids bitwise,
+    stream callbacks in emission order — and its /healthz (over real
+    HTTP) pins pid + exactly ONE fused step signature for the process
+    lifetime."""
+    cfg, params, *_ = tiny_gpt
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(3, cfg.vocab_size,
+                            int(rng.integers(9, 20))).astype(np.int32)
+               for _ in range(3)]
+    ref = _reference_ids(params, cfg, prompts, 6)
+
+    spawn = make_subprocess_spawn(ckpt_dir, cfg, **SERVER_KW)
+    w = spawn(0)
+    try:
+        assert w.remote and w.pid != os.getpid()
+        toks = {}
+        futs = [w.submit(p, max_new_tokens=6,
+                         stream=lambda r, t: toks.setdefault(r, []).append(t))
+                for p in prompts]
+        w.run_until_idle()
+        got = [list(f.result(timeout=10).token_ids) for f in futs]
+        assert got == ref
+        for f, ids in zip(futs, got):
+            assert toks[f.request_id] == ids
+        # one jit signature per worker process lifetime, over its OWN
+        # http endpoint (the scrapers' view, not the proxy's)
+        import json
+        import urllib.request
+        body = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{w.http_port}/healthz", timeout=10).read())
+        assert body["pid"] == w.pid
+        assert body["fused_step_signatures"] == 1
+        assert w.get_stats()["fused_step_signatures"] == 1
+    finally:
+        w.close()
+    assert not proc_fleet(), "worker process leaked past close()"
+
+
+def test_sigkill_storm_bitwise_failover_and_poison_quarantine(
+        tiny_gpt, ckpt_dir, proc_fleet, tmp_path):
+    """The full storm with REAL process deaths: kill@3 on replica 0
+    (os.kill SIGKILL from inside the router step) plus a poison
+    prompt, on a 3-replica subprocess fleet with resurrection. Every
+    non-poison request must land bitwise vs a clean in-process
+    reference; the poison is quarantined within 2 deaths; the fleet
+    ends at full strength on NEW pids."""
+    cfg, params, *_ = tiny_gpt
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(3, cfg.vocab_size,
+                            int(rng.integers(9, 20))).astype(np.int32)
+               for _ in range(5)]
+    poison = rng.integers(3, cfg.vocab_size, 12).astype(np.int32)
+    ref = _reference_ids(params, cfg, prompts, 6)
+
+    chaos = (ChaosInjector()
+             .kill_process_at(3, 0)
+             .poison_prompt(poison))
+    # flight_dir on the WORKERS too: their fault postmortems must land
+    # in tmp, not the cwd (server_kwargs ride the spec into each proc).
+    spawn = make_subprocess_spawn(ckpt_dir, cfg, chaos=chaos,
+                                  flight_dir=str(tmp_path), **SERVER_KW)
+    workers = [spawn(i) for i in range(3)]
+    pid0 = workers[0].pid
+    router = FleetRouter(workers, start=False, chaos=chaos, spawn_fn=spawn,
+                         flight_dir=str(tmp_path),
+                         supervisor=SupervisorConfig(backoff_heartbeats=1,
+                                                     warm_chains=2))
+    futs = [router.submit(p, max_new_tokens=6) for p in prompts[:3]]
+    router.step()
+    router.step()
+    pfut = router.submit(poison, max_new_tokens=6)
+    for p in prompts[3:]:
+        futs.append(router.submit(p, max_new_tokens=6))
+        router.step()
+    router.run_until_idle()
+
+    ids = [list(f.result(timeout=10).token_ids) for f in futs]
+    assert ids == ref, "failover must be bitwise across process deaths"
+    with pytest.raises(PoisonRequestError) as ei:
+        pfut.result(timeout=10)
+    assert ei.value.deaths <= 2
+    assert chaos.fired["process_kill"] == 1
+
+    live = [r for r in router.replicas() if r.accepting()]
+    assert len(live) == 3, "fleet must return to full strength"
+    r0 = router.replicas()[0]
+    assert r0.backend == "subprocess"
+    assert r0.generation >= 1 and r0.pid != pid0, \
+        "slot 0 must be resurrected as a NEW process"
+    assert router.counts["resurrections"] >= 1
+    assert router.counts["quarantines"] == 1
+    router.close()
+    deadline = time.monotonic() + 10
+    while proc_fleet() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not proc_fleet(), "worker processes leaked past close()"
+
+
+def test_preempt_drain_propagates_and_workers_exit_zero(
+        tiny_gpt, ckpt_dir, proc_fleet):
+    """SIGTERM (the PreemptionHandler flag) must reach the worker
+    PROCESSES: in-flight requests finish, every replica reports
+    drained, and the workers exit rc=0 — a graceful shutdown, not the
+    teardown SIGKILL path."""
+    cfg, params, *_ = tiny_gpt
+    rng = np.random.default_rng(7)
+    spawn = make_subprocess_spawn(ckpt_dir, cfg, **SERVER_KW)
+    workers = [spawn(i) for i in range(2)]
+    procs = [w._proc for w in workers]
+    handler = PreemptionHandler()
+    router = FleetRouter(workers, start=False, preemption=handler)
+    futs = [router.submit(rng.integers(3, cfg.vocab_size,
+                                       12).astype(np.int32),
+                          max_new_tokens=8) for _ in range(4)]
+    router.step()
+    router.step()
+    handler.request()
+    router.run_until_idle()
+    for f in futs:
+        assert len(f.result(timeout=10).token_ids) == 8
+    assert router.counts["preempt_drains"] == 1
+    for r in router.replicas():
+        assert r.state == "drained"
+    router.close()
+    deadline = time.monotonic() + 15
+    for p in procs:
+        while p.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+    assert [p.poll() for p in procs] == [0, 0], \
+        "workers must EXIT cleanly on preempt, not be killed"
+    assert not proc_fleet()
+
+
+def test_receiver_death_mid_handoff_leaves_donor_consistent(
+        tiny_gpt, ckpt_dir, proc_fleet):
+    """Kill the receiving worker between export and import: the wire
+    call fails with TransportError, and the donor — whose export
+    pinned, serialized, and unreffed in a finally before any bytes
+    traveled — keeps exactly its pre-handoff refcounts/free list and
+    still serves bitwise."""
+    cfg, params, *_ = tiny_gpt
+    rng = np.random.default_rng(21)
+    prompt = rng.integers(3, cfg.vocab_size, 24).astype(np.int32)
+    ref = _reference_ids(params, cfg, [prompt], 4)
+
+    donor = GenerationServer(GPTServingModel(params, cfg), **SERVER_KW)
+    donor.submit(prompt, max_new_tokens=4)
+    donor.run_until_idle()
+    keys = prompt_chain_keys(prompt, 8)
+    free_before = len(donor.cache._free)
+    refs_before = dict(donor.cache._ref)
+
+    spawn = make_subprocess_spawn(ckpt_dir, cfg, **SERVER_KW)
+    w = spawn(0)
+    chunks, arrays = export_chain(donor, prompt, keys)
+    assert chunks
+    assert len(donor.cache._free) == free_before
+    assert dict(donor.cache._ref) == refs_before
+
+    os.kill(w.pid, signal.SIGKILL)
+    deadline = time.monotonic() + 10
+    while w._proc.poll() is None and time.monotonic() < deadline:
+        time.sleep(0.02)
+    with pytest.raises(TransportError):
+        w.import_chain(chunks, arrays)
+    # donor untouched, and still correct
+    assert len(donor.cache._free) == free_before
+    assert dict(donor.cache._ref) == refs_before
+    fut = donor.submit(prompt, max_new_tokens=4)
+    donor.run_until_idle()
+    assert list(fut.result(timeout=5).token_ids) == ref[0]
+    donor.close()
+    w.close()
+    assert not proc_fleet()

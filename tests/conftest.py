@@ -126,6 +126,34 @@ def tp_subprocess():
 
 
 @pytest.fixture
+def proc_fleet():
+    """Bounded-lifetime guard for `proc`-marked tests (subprocess
+    replica backend): on teardown, every worker process spawned
+    through serving/remote.py that is STILL alive is SIGKILLed and
+    reaped. A test that closes its fleet cleanly leaves nothing for
+    the sweep; a test that failed mid-storm cannot leak engines into
+    the rest of the suite (each worker holds a full jitted
+    GenerationServer — a leak is ~a core and ~a GiB, and a stuck one
+    would hang the session at exit). Yields remote.live_workers for
+    assertions."""
+    import signal
+    import time as _time
+    from paddle_tpu.serving import remote
+
+    yield remote.live_workers
+    leaked = remote.live_workers()
+    for p in leaked:
+        try:
+            p.send_signal(signal.SIGKILL)
+        except OSError:
+            pass
+    deadline = _time.monotonic() + 10.0
+    for p in leaked:
+        while p.poll() is None and _time.monotonic() < deadline:
+            _time.sleep(0.05)
+
+
+@pytest.fixture
 def bert_classifier_export(tmp_path):
     """(model_dir, infer_feed, ref_probs): ONE copy of the shared
     save_inference_model + reference-forward recipe (tiny BERT

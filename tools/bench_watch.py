@@ -185,6 +185,7 @@ SUITE_STEPS = (
     ("kernel_v2_compare", "bench_kernel_v2.json", None),
     ("fleet_compare", "bench_fleet.json", None),
     ("chaos_recovery", "bench_chaos.json", None),
+    ("autoscale_compare", "bench_autoscale.json", None),
     ("trace_compare", "bench_trace.json", None),
     ("signals_compare", "bench_signals.json", None),
     ("tier_compare", "bench_tier.json", None),
@@ -458,6 +459,18 @@ def run_suite():
                  env={"JAX_PLATFORMS": "cpu",
                       "BENCH_CHAOS_RECOVERY": "1"},
                  timeout_s=900, stdout_path="bench_chaos.json")
+    # 1f4b. autoscaler comparison (ISSUE 19): SLO-driven fleet sizing
+    #     over a diurnal load vs fleets fixed at the floor and the
+    #     ceiling — peak TTFT p99 + replica-iterations paid, on the
+    #     CPU backend (deterministic injected clocks)
+    if _artifact_ok("bench_autoscale.json"):
+        log("step autoscale_compare: already landed in a prior cycle — "
+            "skipping")
+    else:
+        run_step("autoscale_compare", [py, bench],
+                 env={"JAX_PLATFORMS": "cpu",
+                      "BENCH_AUTOSCALE_COMPARE": "1"},
+                 timeout_s=900, stdout_path="bench_autoscale.json")
     # 1f5. fleet-trace comparison (ISSUE 15): fleet-wide distributed
     #     tracing on-vs-off through the same mixed-length 2-replica
     #     stream (ids pinned bitwise across modes), on the CPU backend

@@ -107,9 +107,16 @@ def build_timeline(dump, trace_id):
 def _detail(name, args):
     """One human line of the args that matter per event kind."""
     if name == "route":
-        return (f"-> {args.get('replica')} policy={args.get('policy')} "
+        line = (f"-> {args.get('replica')} policy={args.get('policy')} "
                 f"phase={args.get('phase')} "
                 f"affinity_depth={args.get('affinity_depth')}")
+        # out-of-process hops name the worker process that served them
+        # (the router stamps pid + transport on every hop record)
+        if args.get("transport"):
+            line += f" transport={args['transport']}"
+            if args.get("served_by_pid") is not None:
+                line += f" pid={args['served_by_pid']}"
+        return line
     if name == "failover":
         return (f"{args.get('source')} -> {args.get('target')} "
                 f"cause={args.get('cause')} attempt={args.get('attempt')}")
