@@ -52,7 +52,12 @@ spill pool + swap-aware preempt/resume through a starved device
 pool — prefix hit rate, re-prefills avoided, peak admitted
 concurrency vs the full-reservation baseline, p99 TTFT, ids pinned
 bitwise across arms; knobs
-BENCH_TIER_{REQUESTS,ROUNDS,BLOCKS,HOST_BLOCKS}), BENCH_FLEET_COMPARE=1 (fleet router: affinity-vs-random
+BENCH_TIER_{REQUESTS,ROUNDS,BLOCKS,HOST_BLOCKS}), BENCH_FORK_COMPARE=1
+(COW-forked generation: submit(n=K) fork groups vs K independent
+submits of the same stream — peak-block ratio, tokens/s, COW copies —
+plus paged-beam-vs-dense bitwise parity and a guided-regex section on
+the same compiled signature; knobs BENCH_FORK_{K,PROMPTS,ROUNDS}),
+BENCH_FLEET_COMPARE=1 (fleet router: affinity-vs-random
 routing hit rate/blocks per request over a multi-tenant hot/cold
 prefix storm + p99 TTFT under overload with vs without SLO-burn-rate
 shedding; knobs BENCH_FLEET_{REQUESTS,REPLICAS,SLOTS,OVERLOAD}),
@@ -2224,6 +2229,226 @@ def run_tier_compare(kind):
     return 0
 
 
+def run_fork_compare(kind):
+    """BENCH_FORK_COMPARE=1: COW-forked generation (ISSUE 20) on the
+    CPU backend — three sections, one JSON line (perf/bench_fork.json).
+
+    1. fork vs independent: the SAME mixed-length prompt stream runs
+       once as submit(n=K) fork groups (K sampling lanes aliasing the
+       prompt's blocks via refcounts, copy-on-write on divergence) and
+       once as K independent submits per prompt. Headline: peak-block
+       ratio (fork over independent — at K=4 the lanes pay only their
+       private suffixes plus the pooled COW reserve, so the acceptance
+       bar is < 0.5), plus tokens/s both arms (order-alternating
+       best-of rounds, the BENCH_GUARD_COMPARE pattern) and the
+       group/COW counters.
+    2. beam: paged beam search on the server vs the dense K-tiled
+       beam_decode epilogue over the same prompt — ids BITWISE
+       identical, GNMT-normalized scores to float tolerance (the
+       no-dense-cache-only-decode-path acceptance), wall time both
+       sides.
+    3. guided: a regex-masked decode on the SAME server — the token
+       mask is data, never shape, so fused_step_signatures stays 1
+       across all three sections; masked steps and automaton
+       violations (must be 0) recorded.
+    Never raises: failures are recorded, not fatal."""
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as fluid
+    from paddle_tpu.core import framework
+    from paddle_tpu.core.executor import Scope, scope_guard
+    from paddle_tpu.inference import decoding as dec
+    from paddle_tpu.models import gpt
+    from paddle_tpu.serving import (BeamParams, GenerationServer,
+                                    GPTServingModel, RegexConstraint,
+                                    SamplingParams)
+
+    K = int(os.environ.get("BENCH_FORK_K", 4))
+    n_prompts = int(os.environ.get("BENCH_FORK_PROMPTS", 6))
+    rounds = max(2, int(os.environ.get("BENCH_FORK_ROUNDS", 2)))
+
+    cfg = gpt.gpt_tiny()
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = 13
+    with framework.program_guard(main, startup):
+        gpt.build_lm_net(cfg, seq_len=8)
+    scope = Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    with scope_guard(scope):
+        exe.run(startup)
+        params = gpt.load_params(scope, cfg)
+
+    rng = np.random.default_rng(3)
+    reqs = [(rng.integers(3, cfg.vocab_size,
+                          int(rng.integers(40, 89))).astype(np.int32),
+             int(rng.integers(8, 13)))
+            for _ in range(n_prompts)]
+    total_gen = K * sum(g for _p, g in reqs)
+
+    # num_slots = 2K so both arms run the same lane concurrency (two
+    # groups in flight vs 2K independent lanes); the pool is sized so
+    # the INDEPENDENT arm never blocks on watermarks — the peak-block
+    # gap is pure sharing, not admission throttling
+    def build():
+        return GenerationServer(
+            GPTServingModel(params, cfg), num_slots=2 * K,
+            block_size=8, num_blocks=2 * K * 14 + 40, max_context=128,
+            chunk=16, start=False)
+
+    def drain(srv, futs):
+        """-> peak blocks in use while driving the stream to idle."""
+        peak = 0
+        while srv.step():
+            st = srv.get_stats()
+            peak = max(peak, st["blocks_total"] - st["blocks_free"])
+        for f in futs:
+            f.result(timeout=30)
+        return peak
+
+    def run_fork(srv):
+        return drain(srv, [
+            srv.submit(p, max_new_tokens=g, n=K,
+                       sampling=SamplingParams(seed=i))
+            for i, (p, g) in enumerate(reqs)])
+
+    def run_indep(srv):
+        return drain(srv, [
+            srv.submit(p, max_new_tokens=g)
+            for p, g in reqs for _ in range(K)])
+
+    try:
+        fork_srv, ind_srv = build(), build()
+        fork_peak = run_fork(fork_srv)      # cold: warms the compile
+        ind_peak = run_indep(ind_srv)
+        fork_s = ind_s = float("inf")
+        for r in range(rounds):
+            pair = [("fork", fork_srv), ("indep", ind_srv)]
+            if r % 2:
+                pair.reverse()
+            for tag, srv in pair:
+                t0 = time.perf_counter()
+                peak = run_fork(srv) if tag == "fork" \
+                    else run_indep(srv)
+                dt = time.perf_counter() - t0
+                if tag == "fork":
+                    fork_peak = max(fork_peak, peak)
+                    fork_s = min(fork_s, dt)
+                else:
+                    ind_peak = max(ind_peak, peak)
+                    ind_s = min(ind_s, dt)
+        st = fork_srv.get_stats()
+        ind_srv.close()
+        result = {
+            "metric": "serving_fork_group_peak_block_ratio",
+            "value": round(fork_peak / max(ind_peak, 1), 3),
+            "unit": "x (peak KV blocks, n=K fork groups over K "
+                    "independent submits, same stream)",
+            "fork_k": K, "prompts": n_prompts,
+            "generated_tokens_per_pass": total_gen,
+            "peak_blocks_fork": fork_peak,
+            "peak_blocks_independent": ind_peak,
+            "blocks_per_request_fork": round(fork_peak / n_prompts, 2),
+            "blocks_per_request_independent": round(
+                ind_peak / n_prompts, 2),
+            "fork_tokens_per_sec": round(total_gen / fork_s, 2),
+            "independent_tokens_per_sec": round(total_gen / ind_s, 2),
+            "group_forks": st["group.forks"],
+            "group_cow_copies": st["group.cow_copies"],
+            "blocks_reclaimed_clean": st["blocks_free"]
+                == st["blocks_total"],
+        }
+    except Exception as e:      # noqa: BLE001 — evidence, not a gate
+        print(f"bench: fork compare FAILED ({e!r})", file=sys.stderr)
+        print(json.dumps(_mark_degraded(
+            {"metric": "serving_fork_group_peak_block_ratio",
+             "failed": True, "error": repr(e), "device_kind": kind})),
+            flush=True)
+        return 0
+
+    # -- paged beam vs the dense K-tiled epilogue (bitwise) -----------
+    def run_beam():
+        prompt, n_new, eos = reqs[0][0][:24], 8, 2
+        d = cfg.hidden_size // cfg.num_heads
+        t0 = time.perf_counter()
+        step = gpt.build_kv_step(params, cfg, 64)
+        cache = dec.init_kv_cache(K, cfg.num_layers, cfg.num_heads,
+                                  64, d)
+        for t, tok in enumerate(prompt[:-1]):
+            _, cache = step(jnp.full((K,), int(tok), jnp.int32),
+                            cache, t)
+        ids, norm = dec.beam_decode(
+            step, cache, jnp.asarray([int(prompt[-1])], jnp.int32),
+            n_new, K, eos, length_penalty=0.6,
+            start_t=len(prompt) - 1)
+        dense_s = time.perf_counter() - t0
+        ids, norm = np.asarray(ids[0]), np.asarray(norm[0])
+
+        t0 = time.perf_counter()
+        fut = fork_srv.submit(prompt, max_new_tokens=n_new,
+                              eos_id=eos, beam=BeamParams(K))
+        fork_srv.run_until_idle()
+        hyps = fut.result(timeout=30).hypotheses
+        paged_s = time.perf_counter() - t0
+        bitwise = all(
+            list(h.token_ids) == list(int(x) for x in ids[r])
+            for r, h in enumerate(hyps))
+        scores_ok = bool(np.allclose(
+            [h.norm_score for h in hyps], norm, rtol=1e-5))
+        return {
+            "beam_size": K, "new_tokens": n_new,
+            "ids_match_dense_bitwise": bitwise,
+            "norm_scores_match_dense": scores_ok,
+            "beam_reorders": fork_srv.get_stats()["beam.reorders"],
+            "paged_wall_s": round(paged_s, 3),
+            "dense_epilogue_wall_s": round(dense_s, 3),
+            "paged_tokens_per_sec": round(K * n_new / paged_s, 2),
+            "dense_tokens_per_sec": round(K * n_new / dense_s, 2),
+            "caveat": "dense wall time includes its own step compile; "
+                      "the paged side reuses the server's live fused "
+                      "step — the parity bit is the point, not speed",
+        }
+
+    try:
+        result["beam"] = run_beam()
+    except Exception as e:      # noqa: BLE001 — evidence, not a gate
+        print(f"bench: beam section FAILED ({e!r}) — recording and "
+              f"continuing", file=sys.stderr)
+        result["beam"] = {"failed": True, "error": repr(e)}
+
+    # -- guided regex on the same compiled signature ------------------
+    def run_guided():
+        digits = {i: str(i - 3) for i in range(3, 13)}
+        vocab = [digits.get(i, chr(0x4E00 + i))
+                 for i in range(cfg.vocab_size)]
+        c = RegexConstraint("[0-9]+", vocab)
+        fut = fork_srv.submit(np.array([5, 9, 11, 2], np.int32),
+                              max_new_tokens=12, eos_id=1, guided=c)
+        fork_srv.run_until_idle()
+        res = fut.result(timeout=30)
+        st = fork_srv.get_stats()
+        return {
+            "pattern": "[0-9]+", "emitted": len(res.token_ids),
+            "all_digits": all(3 <= t <= 12 for t in res.token_ids
+                              if t != 1),
+            "masked_steps": st["guided.masked_steps"],
+            "violations": st["guided.violations"],
+        }
+
+    try:
+        result["guided"] = run_guided()
+    except Exception as e:      # noqa: BLE001 — evidence, not a gate
+        print(f"bench: guided section FAILED ({e!r}) — recording and "
+              f"continuing", file=sys.stderr)
+        result["guided"] = {"failed": True, "error": repr(e)}
+
+    result["fused_step_signatures"] = \
+        fork_srv.get_stats()["fused_step_signatures"]
+    fork_srv.close()
+    result["device_kind"] = kind
+    print(json.dumps(_mark_degraded(result)), flush=True)
+    return 0
+
+
 def run_fleet_compare(kind):
     """BENCH_FLEET_COMPARE=1: the fleet front door (ISSUE 11) on the
     CPU backend — two sections, one JSON line (perf/bench_fleet.json).
@@ -3583,6 +3808,12 @@ def main():
         # tiered KV cache: host-RAM spill pool + preempt/resume on vs
         # off through a starved device pool (serving layer)
         return run_tier_compare(kind)
+
+    if os.environ.get("BENCH_FORK_COMPARE") == "1":
+        # COW-forked generation: fork groups vs independent submits
+        # (peak blocks + tokens/s) + paged-beam bitwise parity +
+        # guided regex, one compiled signature (serving layer)
+        return run_fork_compare(kind)
 
     if os.environ.get("BENCH_KERNEL_V2_COMPARE") == "1":
         # paged kernel v2 vs v1 vs reference + GQA capacity at the

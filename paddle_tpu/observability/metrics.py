@@ -191,6 +191,30 @@ METRIC_SPECS = [
     ("serving.prefix.cow_copies", "counter",
      "copy-on-write block copies (a shared block about to be written "
      "was copied to a fresh block and the table repointed)"),
+    ("serving.group.requests", "counter",
+     "fork-group submissions admitted (one per RequestGroup: n>1 "
+     "parallel sampling or beam search)"),
+    ("serving.group.lanes", "counter",
+     "lanes admitted on behalf of fork groups (K per group — the "
+     "per-lane cousin of serving.group.requests)"),
+    ("serving.group.forks", "counter",
+     "follower lanes forked off a completed leader prefill (table "
+     "aliases of the shared prompt blocks — K-1 per group, zero "
+     "block copies)"),
+    ("serving.group.cow_copies", "counter",
+     "copy-on-write copies taken by fork-group lanes diverging off "
+     "shared blocks (prompt boundary or post-reorder suffix)"),
+    ("serving.beam.reorders", "counter",
+     "beam-search steps whose top-K selection changed parent "
+     "hypotheses — each one is a host-side block-TABLE remap, not a "
+     "KV move"),
+    ("serving.guided.masked_steps", "counter",
+     "lane-iterations whose logits carried a guided-decoding "
+     "constraint mask (additive -inf rows inside the fused step)"),
+    ("serving.guided.violations", "counter",
+     "tokens committed with no automaton transition (unreachable "
+     "while masks are fed; counted, not raised, under chaos "
+     "mask-starve so the serving loop survives)"),
     ("serving.spec.proposed", "counter",
      "draft tokens submitted to fused-step verification (columns "
      "1..q-1 of speculative decode lanes)"),

@@ -172,7 +172,7 @@ ITER_FIELDS = ("step_ms", "lanes", "emitting", "prefill_tokens",
                "lanes_detail", "kernel", "deadline_cancels")
 LANE_FIELDS = ("slot", "rid", "pos", "prefilling", "admit_seq",
                "generated", "first_block", "shared_blocks",
-               "cow_copies", "tier")
+               "cow_copies", "tier", "group", "beam_rank")
 
 
 def _expand_lanes(lanes):

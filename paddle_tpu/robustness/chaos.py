@@ -39,7 +39,8 @@ class ChaosInjector:
                       "hash_collision": 0, "replica_kill": 0,
                       "replica_hang": 0, "replica_slow": 0,
                       "prompt_poison": 0, "spill": 0, "preempt": 0,
-                      "process_kill": 0, "conn_drop": 0}
+                      "process_kill": 0, "conn_drop": 0,
+                      "fork_storm": 0, "mask_starve": 0}
         self._installed = False
         # serving-engine plan: iteration -> actions (scheduler hooks)
         self._serving_cancels = {}   # iteration -> [active-request index]
@@ -63,6 +64,9 @@ class ChaosInjector:
         # out-of-process fleet plan (serving/router.py + transport.py)
         self._process_kills = {}     # router iteration -> [replica idx]
         self._conn_drops = {}        # 1-based rpc ordinal -> fault kind
+        # fork-group plan (serving/scheduler.py + engine.py, ISSUE 20)
+        self._fork_storms = {}       # iteration -> lanes to force-COW
+        self._mask_starves = set()   # iterations to starve guided masks
 
     # -- plan ----------------------------------------------------------
     def poison_grad_at(self, step, var=None):
@@ -259,6 +263,54 @@ class ChaosInjector:
 
     def serving_preempt_applied(self):
         self.fired["preempt"] += 1
+
+    # -- fork-group hooks (serving/scheduler.py + engine.py) -----------
+    def fork_storm_at(self, iteration, k=1):
+        """Force copy-on-write divergence on up to `k` live fork-group
+        lanes at the start of scheduler iteration `iteration` (1-based):
+        each targeted lane's current block is COW'd even though nothing
+        wrote it — the max-divergence burst path (every lane peels off
+        the shared prompt at once), testable without arranging K real
+        divergent writes. Fires (fork_storm_applied) only for lanes
+        whose current block was actually copied — held followers,
+        prefilling leaders, and lanes sitting on a NULL block are
+        skipped by design."""
+        self._fork_storms[int(iteration)] = \
+            self._fork_storms.get(int(iteration), 0) + int(k)
+        return self
+
+    def fork_storms_at(self, iteration):
+        """-> number of forced fork-COWs planned for this iteration.
+        Consumed by the scheduler's plan(); `fired["fork_storm"]`
+        counts via fork_storm_applied only for lanes actually COW'd."""
+        return self._fork_storms.pop(int(iteration), 0)
+
+    def fork_storm_applied(self, n=1):
+        self.fired["fork_storm"] += int(n)
+
+    def mask_starve_at(self, iteration):
+        """Starve every guided-decoding lane's token mask at engine
+        iteration `iteration` (1-based): the mask keeps exactly ONE
+        allowed token (the lowest-id member of the constraint's allowed
+        set), so generation stays conformant but the lane is forced
+        down a single path — the degenerate-mask resilience path (the
+        serving loop must keep stepping, never raise). Fires only when
+        a guided lane was planned that iteration."""
+        self._mask_starves.add(int(iteration))
+        return self
+
+    def mask_starves_at(self, iteration):
+        """-> True if guided masks should be starved this iteration.
+        Consumed by GenerationServer.step(); `fired["mask_starve"]`
+        counts via mask_starve_applied only when a guided lane's mask
+        was actually narrowed."""
+        if int(iteration) in self._mask_starves:
+            self._mask_starves.discard(int(iteration))
+            return True
+        return False
+
+    def mask_starve_applied(self):
+        self.fired["mask_starve"] += 1
 
     def hash_collision_at(self, nth, times=1):
         """Make content-hash computations nth..nth+times-1 (1-based,

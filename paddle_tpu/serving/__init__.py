@@ -47,6 +47,16 @@ deadline. This package is the TPU-native answer:
                   real processes (real SIGKILL chaos, SLO-driven
                   autoscaling via `autoscale=`; docs/serving.md
                   "Out-of-process fleet");
+- decode_strategies.py / guided.py — COW-forked generation on the
+                  shared KV cache: `submit(n=K)` / `SamplingParams`
+                  fork K sampling lanes that alias the prompt's blocks
+                  (refcounts, copy-on-write on divergence),
+                  `BeamParams` runs paged beam search bitwise-identical
+                  to the dense `beam_search` epilogue, and `guided=`
+                  (RegexConstraint / ChoiceConstraint / JsonConstraint)
+                  masks the fused step's sampling path with a
+                  host-automaton token mask (docs/serving.md "Forked
+                  generation & guided decoding");
 - router.py     — FleetRouter: N replicas behind one submit() —
                   prefix-affinity routing (the index chain keys ARE
                   the affinity signal), SLO-burn-rate admission
@@ -74,6 +84,10 @@ from .kv_cache import (NULL_BLOCK, PagedDecodeLayer, PagedKVCache,
 from .prefix_cache import PrefixCacheIndex, prompt_chain_keys
 from .scheduler import (ContinuousBatchingScheduler, DeadlineExceeded,
                         GenerationResult, RequestCancelled)
+from .decode_strategies import (BeamHypothesis, BeamParams, GroupFuture,
+                                GroupResult, SamplingParams)
+from .guided import (ChoiceConstraint, Constraint, JsonConstraint,
+                     RegexConstraint)
 from .engine import GenerationFuture, GenerationServer, GPTServingModel
 from .spec_decode import SpecDecodeConfig
 from .replica import Replica
@@ -88,6 +102,9 @@ __all__ = [
     "paged_attention_reference", "gather_block_kv",
     "build_paged_decode_cache", "NULL_BLOCK",
     "PrefixCacheIndex", "prompt_chain_keys", "SpecDecodeConfig",
+    "SamplingParams", "BeamParams", "BeamHypothesis", "GroupResult",
+    "GroupFuture", "Constraint", "RegexConstraint", "ChoiceConstraint",
+    "JsonConstraint",
     "ContinuousBatchingScheduler", "GenerationResult",
     "DeadlineExceeded", "RequestCancelled",
     "GenerationServer", "GenerationFuture", "GPTServingModel",

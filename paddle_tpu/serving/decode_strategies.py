@@ -1,0 +1,330 @@
+"""Forked decode strategies on the shared paged KV cache.
+
+One submitted request can fan out into K lanes that SHARE the prompt's
+KV blocks: `GenerationServer.submit(n=K)` / `SamplingParams(n=K)` for
+parallel sampling, `BeamParams(beam_size=K)` for beam search. The fork
+is a block-table operation — each lane's table aliases the prompt
+blocks under the pool's refcounts (`PagedKVCache.fork_table`), suffix
+blocks diverge per lane, and a write into a still-shared block goes
+through the ordinary copy-on-write guard. No pool data moves except at
+the COW sites.
+
+This module holds the host-side machinery the scheduler and engine
+compose:
+
+- `SamplingParams` / `BeamParams` — per-submit strategy knobs.
+- `RequestGroup` — the group's shared bookkeeping record (lane
+  requests, pooled COW spares, beam scores/done masks). Mutated only
+  under the scheduler lock.
+- `GroupFuture` / `GroupResult` / `BeamHypothesis` — the client
+  surface: one future per group, resolving to per-lane results
+  (sampling) or best-first hypotheses (beam).
+- `fold_key` / `gumbel_noise` / `host_sample` — counter-based RNG.
+  Sampling is Gumbel-argmax over the filtered logits with noise
+  derived by hashing (seed, lane rank, position): a pure function of
+  the lane's identity and progress, so sampled forks replay bitwise
+  across preempt/resume and router failover. `gumbel_noise` is
+  backend-parametric (numpy host-side, jax.numpy inside the fused
+  step) with identical integer math.
+- `beam_step` / `finalize_beam` — ONE beam-search step / the final
+  GNMT-penalty ranking, using the same jax ops in the same order as
+  `inference.decoding.beam_decode` so paged beam ids and scores are
+  BITWISE the dense reference's. The scheduler applies the step's
+  parent pointers as a block-table remap (beam reorder), not a cache
+  gather: `_gather_beams` moves O(cache) bytes per step, the remap
+  moves O(K * max_blocks) host integers.
+"""
+
+from concurrent.futures import Future
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .kv_cache import NEG_INF
+
+__all__ = ["SamplingParams", "BeamParams", "RequestGroup", "GroupFuture",
+           "GroupResult", "BeamHypothesis", "fold_key", "gumbel_noise",
+           "host_sample", "beam_step", "finalize_beam"]
+
+
+class SamplingParams:
+    """Stochastic decode knobs for one submit. `n` > 1 forks the
+    request into n lanes sharing the prompt KV. `temperature <= 0`
+    degenerates to greedy argmax (same convention as
+    inference.decoding.sample_decode) — useful for deterministic
+    fork-accounting tests. `seed` roots the per-lane counter RNG."""
+
+    __slots__ = ("n", "temperature", "top_k", "top_p", "seed")
+
+    def __init__(self, n=1, temperature=1.0, top_k=None, top_p=None,
+                 seed=0):
+        if int(n) < 1:
+            raise ValueError(f"n must be >= 1, got {n}")
+        if top_k is not None and int(top_k) < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        if top_p is not None and not 0.0 < float(top_p) <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        self.n = int(n)
+        self.temperature = None if temperature is None \
+            else float(temperature)
+        self.top_k = None if top_k is None else int(top_k)
+        self.top_p = None if top_p is None else float(top_p)
+        self.seed = int(seed)
+
+    @property
+    def do_sample(self):
+        return self.temperature is not None and self.temperature > 0.0
+
+
+class BeamParams:
+    """Beam-search knobs: GNMT length penalty, dense-reference
+    semantics (inference.decoding.beam_decode)."""
+
+    __slots__ = ("beam_size", "length_penalty")
+
+    def __init__(self, beam_size, length_penalty=0.6):
+        if int(beam_size) < 1:
+            raise ValueError(f"beam_size must be >= 1, got {beam_size}")
+        self.beam_size = int(beam_size)
+        self.length_penalty = float(length_penalty)
+
+
+class BeamHypothesis:
+    """One finished beam: raw ids (eos-padded to max_new_tokens, like
+    the dense reference's rows), cumulative logprob, and the GNMT
+    length-penalized score the ranking used."""
+
+    __slots__ = ("token_ids", "score", "norm_score")
+
+    def __init__(self, token_ids, score, norm_score):
+        self.token_ids = token_ids
+        self.score = score
+        self.norm_score = norm_score
+
+    def __repr__(self):
+        return (f"BeamHypothesis(n={len(self.token_ids)}, "
+                f"norm_score={self.norm_score:.3f})")
+
+
+class GroupResult:
+    """What a GroupFuture resolves to. Sampling groups fill `lanes`
+    (GenerationResults in lane-rank order); beam groups fill
+    `hypotheses` (best-first)."""
+
+    __slots__ = ("group_id", "kind", "lanes", "hypotheses", "prompt_len")
+
+    def __init__(self, group_id, kind, lanes=None, hypotheses=None,
+                 prompt_len=0):
+        self.group_id = group_id
+        self.kind = kind                    # "sample" | "beam"
+        self.lanes = lanes
+        self.hypotheses = hypotheses
+        self.prompt_len = prompt_len
+
+    def __repr__(self):
+        n = len(self.lanes or self.hypotheses or ())
+        return f"GroupResult(id={self.group_id}, kind={self.kind}, k={n})"
+
+
+class GroupFuture(Future):
+    """One future for the whole fork group. cancel() cancels every
+    lane (the group lives and dies as a unit); `lane_rids` exposes the
+    per-lane request ids in rank order (rank r's stream callbacks fire
+    with lane_rids[r])."""
+
+    def __init__(self, group_id, lane_rids, cancel_fn):
+        super().__init__()
+        self.group_id = group_id
+        self.lane_rids = tuple(lane_rids)
+        self._cancel_fn = cancel_fn
+        self.set_running_or_notify_cancel()
+
+    def cancel(self):
+        if self.done():
+            return False
+        self._cancel_fn()
+        return True
+
+
+class RequestGroup:
+    """Shared bookkeeping for one forked submit. Created by the
+    engine's submit path; every mutable field below is owned by the
+    scheduler and touched only under its lock.
+
+    `spares` is the group-pooled copy-on-write reserve: admission
+    reserves K spare blocks (one per lane's boundary-block divergence,
+    the worst case — lanes never write below the boundary, so deeper
+    prompt blocks stay single-copy). Beam reorders RETAIN an abandoned
+    block whose refcount hits 1 back into `spares` instead of freeing
+    it, keeping the group's worst case covered by its own reservation
+    (the no-mid-flight-OOM invariant: a concurrent admission can never
+    steal a block the group still needs)."""
+
+    __slots__ = ("gid", "kind", "k", "eos_id", "max_new_tokens",
+                 "sampling", "beam", "lanes", "future", "spares",
+                 "prefilled", "done", "scores", "results", "failed",
+                 "released", "lane_sids", "reorders", "cow_copies")
+
+    def __init__(self, gid, kind, k, eos_id, max_new_tokens,
+                 sampling=None, beam=None):
+        self.gid = gid
+        self.kind = kind                    # "sample" | "beam"
+        self.k = int(k)
+        self.eos_id = eos_id
+        self.max_new_tokens = int(max_new_tokens)
+        self.sampling = sampling            # SamplingParams or None
+        self.beam = beam                    # BeamParams or None
+        self.lanes = []                     # _Request per rank
+        self.future = None                  # GroupFuture
+        self.spares = []                    # pooled COW reserve blocks
+        self.prefilled = False              # leader prompt done + forked
+        self.done = np.zeros((self.k,), bool)       # beam eos mask
+        self.scores = np.zeros((self.k,), np.float32)
+        self.results = {}                   # rank -> GenerationResult
+        self.failed = False
+        self.released = 0                   # lane slots released so far
+        self.lane_sids = {}                 # rank -> slot id (active)
+        self.reorders = 0
+        self.cow_copies = 0
+
+    def lane_rids(self):
+        return [r.rid for r in self.lanes]
+
+
+# ---------------------------------------------------------------------------
+# Counter-based RNG: pure functions of (seed, lane, position)
+# ---------------------------------------------------------------------------
+
+_M64 = (1 << 64) - 1
+
+
+def _splitmix64(x):
+    x = (x + 0x9E3779B97F4A7C15) & _M64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _M64
+    return x ^ (x >> 31)
+
+
+def fold_key(seed, lane, pos):
+    """Fold (seed, lane rank, position) into a (2,) uint32 counter key.
+    Pure: a resumed, replayed, or failed-over lane at the same position
+    derives the same key — what makes sampled forks deterministic."""
+    z = _splitmix64(int(seed) & _M64)
+    z = _splitmix64(z ^ (int(lane) + 0x100))
+    z = _splitmix64(z ^ ((int(pos) + 1) << 8))
+    return np.array([z & 0xFFFFFFFF, z >> 32], np.uint32)
+
+
+def _mix32(h, xp):
+    h = h ^ (h >> xp.uint32(16))
+    h = h * xp.uint32(0x7FEB352D)
+    h = h ^ (h >> xp.uint32(15))
+    h = h * xp.uint32(0x846CA68B)
+    h = h ^ (h >> xp.uint32(16))
+    return h
+
+
+def gumbel_noise(key, vocab, xp=np):
+    """Standard-Gumbel noise rows from a counter hash: key (..., 2)
+    uint32 -> (..., vocab) f32. Backend-parametric (xp = numpy or
+    jax.numpy) with identical 32-bit integer math, so the host mirror
+    and the fused step agree on structure; the trailing float ops run
+    on whichever backend is asked."""
+    idx = xp.arange(vocab, dtype=xp.uint32)
+    h = _mix32(idx ^ key[..., 0:1], xp)
+    h = _mix32(h ^ key[..., 1:2], xp)
+    u = (h >> xp.uint32(8)).astype(xp.float32) \
+        * xp.float32(1.0 / (1 << 24))
+    u = xp.clip(u, xp.float32(1e-7), xp.float32(1.0 - 1e-7))
+    return -xp.log(-xp.log(u))
+
+
+def _log_softmax_np(x):
+    s = x - np.max(x)
+    return s - np.log(np.sum(np.exp(s), dtype=np.float32),
+                      dtype=np.float32)
+
+
+def host_sample(row, key, temperature=1.0, top_k=None, top_p=None):
+    """One host-side sample from a logits/logp row (V,) — the numpy
+    mirror of the fused step's sampled branch (temperature, top-k,
+    nucleus, Gumbel-argmax; filter semantics follow
+    inference.decoding._filter_logits). Shift-invariant, so a
+    log-softmaxed row samples identically to raw logits. Used at fork
+    time: the leader's prefill-final row seeds every lane's FIRST
+    token with that lane's own key. Returns (token, logp) with logp
+    under the filtered distribution; temperature <= 0 is greedy argmax
+    with the row's own value as logp."""
+    row = np.asarray(row, np.float32)
+    v = row.size
+    if temperature is None or temperature <= 0.0:
+        t = int(np.argmax(row))
+        return t, float(row[t])
+    scaled = row / np.float32(temperature)
+    if top_k is not None and 0 < int(top_k) < v:
+        kth = np.sort(scaled)[::-1][int(top_k) - 1]
+        scaled = np.where(scaled < kth, np.float32(NEG_INF), scaled)
+    if top_p is not None and 0.0 < float(top_p) < 1.0:
+        sd = np.sort(scaled)[::-1]
+        probs = np.exp(sd - sd[0])
+        probs = probs / probs.sum(dtype=np.float32)
+        cum = np.cumsum(probs, dtype=np.float32)
+        keep = np.concatenate(([True], cum[:-1] < np.float32(top_p)))
+        thresh = sd[keep][-1]
+        scaled = np.where(scaled < thresh, np.float32(NEG_INF), scaled)
+    g = gumbel_noise(key, v, xp=np)
+    t = int(np.argmax(scaled + g))
+    lp = float(_log_softmax_np(scaled)[t])
+    return t, lp
+
+
+# ---------------------------------------------------------------------------
+# Beam math — the dense beam_decode's per-step ops, batch=1, host-driven
+# ---------------------------------------------------------------------------
+
+def beam_step(rows, scores, done, eos_id):
+    """One beam-search step over the fused step's logp rows.
+
+    rows (K, V) f32: per-lane log-probs (log_softmax of the masked
+    logits — EXACTLY what the dense body computes per lane, since the
+    paged and dense caches hold bitwise-identical KV). scores (K,) /
+    done (K,): cumulative state. Mirrors
+    inference.decoding.beam_decode's body ops in order — eos_only
+    substitution for finished lanes, score broadcast, one
+    `jax.lax.top_k` over the flattened (K*V,) — so token/parent/score
+    selection (tie-breaking included) is bitwise the reference's.
+
+    Returns numpy (token (K,), parent (K,), new_scores (K,),
+    new_done (K,))."""
+    rows = jnp.asarray(np.asarray(rows, np.float32))
+    scores = jnp.asarray(np.asarray(scores, np.float32))
+    done = jnp.asarray(np.asarray(done, bool))
+    k, vocab = rows.shape
+    eos_only = jnp.full((vocab,), NEG_INF).at[eos_id].set(0.0)
+    logp = jnp.where(done[:, None], eos_only[None, :], rows)
+    total = scores[:, None] + logp
+    total = total.reshape(1, k * vocab)
+    top_scores, top_idx = jax.lax.top_k(total, k)
+    parent = top_idx // vocab
+    token = (top_idx % vocab).astype(jnp.int32)
+    new_done = done[parent[0]] | (token[0] == eos_id)
+    return (np.asarray(token[0]), np.asarray(parent[0]),
+            np.asarray(top_scores[0]), np.asarray(new_done))
+
+
+def finalize_beam(histories, scores, eos_id, length_penalty=0.6):
+    """Rank finished beams exactly as the dense reference's epilogue:
+    GNMT length penalty over non-eos length, argsort by penalized
+    score. histories (K, T) int32 eos-padded, scores (K,) f32.
+    Returns numpy (ids (K, T) best-first, norm_scores (K,),
+    order (K,))."""
+    ids = jnp.asarray(np.asarray(histories, np.int32))
+    scores = jnp.asarray(np.asarray(scores, np.float32))
+    lengths = jnp.sum(ids != eos_id, axis=-1).astype(jnp.float32) + 1.0
+    lp = ((5.0 + lengths) / 6.0) ** length_penalty
+    final = scores / lp
+    order = jnp.argsort(-final)
+    ids = jnp.take_along_axis(ids, order[:, None], axis=0)
+    final = jnp.take(final, order)
+    return np.asarray(ids), np.asarray(final), np.asarray(order)

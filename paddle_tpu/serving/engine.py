@@ -41,8 +41,11 @@ from ..observability import _help
 from ..observability.metrics import global_registry
 from ..observability.tracing import get_recorder
 from . import kv_cache as _kvc
-from .kv_cache import (NULL_BLOCK, PagedKVCache, paged_attention,
-                       write_block_kv, write_block_kv_quant)
+from .decode_strategies import (GroupFuture, RequestGroup,
+                                SamplingParams, gumbel_noise)
+from .kv_cache import (NEG_INF, NULL_BLOCK, PagedKVCache,
+                       paged_attention, write_block_kv,
+                       write_block_kv_quant)
 from .scheduler import ContinuousBatchingScheduler, RequestCancelled, _Request
 
 __all__ = ["GenerationServer", "GenerationFuture", "GPTServingModel"]
@@ -51,9 +54,40 @@ __all__ = ["GenerationServer", "GenerationFuture", "GPTServingModel"]
 _SERVER_SEQ = itertools.count()
 
 
+def _sample_rows(base, rng, temperature, do_top_k, top_p):
+    """Stochastic token choice over (S, V) log-prob rows INSIDE the one
+    fused step: temperature scale, top-k / nucleus filtering
+    (inference.decoding._filter_logits semantics), Gumbel-argmax draw
+    from per-lane counter keys. Every control is DATA — (S,) arrays, 0
+    meaning top-k off and 2.0 meaning top-p off — so sampled, greedy,
+    and mixed batches all share one jit signature. Returns
+    (sampled ids (S,), their logp under the filtered distribution)."""
+    s, v = base.shape
+    scaled = base / jnp.maximum(temperature, 1e-6)[:, None]
+    sorted_desc = -jnp.sort(-scaled, axis=-1)
+    k_eff = jnp.clip(jnp.where(do_top_k > 0, do_top_k, v), 1, v)
+    kth = jnp.take_along_axis(sorted_desc, (k_eff - 1)[:, None], -1)
+    filt = jnp.where(scaled < kth, jnp.float32(NEG_INF), scaled)
+    # nucleus over the top-k survivors (softmax subtracts the row max,
+    # so the NEG_INF entries contribute exp(-huge) = 0, never NaN)
+    sd = -jnp.sort(-filt, axis=-1)
+    cum = jnp.cumsum(jax.nn.softmax(sd, axis=-1), axis=-1)
+    keep = jnp.concatenate([jnp.ones((s, 1), bool),
+                            cum[:, :-1] < top_p[:, None]], axis=-1)
+    thresh = jnp.min(jnp.where(keep, sd, jnp.inf), axis=-1,
+                     keepdims=True)
+    filt = jnp.where(filt < thresh, jnp.float32(NEG_INF), filt)
+    samp = jnp.argmax(filt + gumbel_noise(rng, v, xp=jnp), axis=-1)
+    samp_lp = jnp.take_along_axis(
+        jax.nn.log_softmax(filt, axis=-1), samp[:, None], -1)[:, 0]
+    return samp.astype(jnp.int32), samp_lp
+
+
 def _fused_step_body(params, cfg, block_size, h_count, kv_count, d,
                      reduce_fn, pools, tokens, positions, valid, tables,
-                     per_column=False):
+                     per_column=False, sampling=False, mask=None,
+                     rng=None, temperature=None, do_sample=None,
+                     top_k=None, top_p=None):
     """The ONE fused prefill/decode step body (build_kv_step's math over
     (S, C) ragged lanes with paged KV), shared by the single-device and
     tensor-parallel fused steps exactly like gpt._prefill_forward:
@@ -140,14 +174,32 @@ def _fused_step_body(params, cfg, block_size, h_count, kv_count, d,
         last = jnp.clip(valid.sum(1) - 1, 0, c - 1)
         xl = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
         logits = xl @ params["word_emb"].T
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        logitsf = logits.astype(jnp.float32)
+        if sampling:
+            # guided-decoding constraint mask (S, V): additive 0 /
+            # NEG_INF rows, all-zero for unconstrained lanes — data,
+            # never shape, so the one-signature invariant holds
+            logitsf = logitsf + mask
+        logp = jax.nn.log_softmax(logitsf)
         nxt = jnp.argmax(logp, axis=-1)
         chosen = jnp.take_along_axis(logp, nxt[:, None], -1)[:, 0]
-        return new_pools, nxt.astype(jnp.int32), chosen
+        if not sampling:
+            return new_pools, nxt.astype(jnp.int32), chosen
+        samp, samp_lp = _sample_rows(logp, rng, temperature,
+                                     top_k, top_p)
+        nxt = jnp.where(do_sample, samp, nxt).astype(jnp.int32)
+        chosen = jnp.where(do_sample, samp_lp, chosen)
+        # 4th output: the full logp rows — fork-time host sampling and
+        # beam re-ranking read these (the host transfer is paid only
+        # when the plan says a group needs them)
+        return new_pools, nxt, chosen, logp
     vocab = params["word_emb"].shape[0]
     logits = (x.reshape(s * c, -1) @ params["word_emb"].T).reshape(
         s, c, vocab)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    logitsf = logits.astype(jnp.float32)
+    if sampling:
+        logitsf = logitsf + mask        # (S, C, V) per-column masks
+    logp = jax.nn.log_softmax(logitsf)
     nxt = jnp.argmax(logp, axis=-1)                         # (S, C)
     chosen = jnp.take_along_axis(logp, nxt[..., None], -1)[..., 0]
     # target logp of the NEXT FED column's token — the draft under
@@ -155,7 +207,16 @@ def _fused_step_body(params, cfg, block_size, h_count, kv_count, d,
     # p_target(draft). The last column's value wraps and is meaningless.
     nt = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
     fed = jnp.take_along_axis(logp, nt[..., None], -1)[..., 0]
-    return new_pools, nxt.astype(jnp.int32), chosen, fed
+    if not sampling:
+        return new_pools, nxt.astype(jnp.int32), chosen, fed
+    # sampled lanes run 1-column (the scheduler plans no drafts for
+    # them), so the stochastic draw applies to column 0 only
+    samp, samp_lp = _sample_rows(logp[:, 0], rng, temperature,
+                                 top_k, top_p)
+    nxt = nxt.at[:, 0].set(jnp.where(do_sample, samp, nxt[:, 0]))
+    chosen = chosen.at[:, 0].set(
+        jnp.where(do_sample, samp_lp, chosen[:, 0]))
+    return new_pools, nxt.astype(jnp.int32), chosen, fed, logp
 
 
 class GPTServingModel:
@@ -238,7 +299,8 @@ class GPTServingModel:
         return self._int8_weights
 
     def build_fused_step(self, block_size, mesh=None, axis="tp",
-                         per_column=False, kv_quantized=False):
+                         per_column=False, kv_quantized=False,
+                         sampling=False):
         params, cfg = self.params, self.cfg
         h_, kv_, d = self.num_heads, self.num_kv_heads, self.head_dim
 
@@ -248,7 +310,26 @@ class GPTServingModel:
                 "tp shard rules name the dense weight keys; run int8-"
                 "weight servers single-device (int8 KV pools DO shard; "
                 "docs/serving.md)")
+        if mesh is not None and sampling:
+            raise NotImplementedError(
+                "the sampling/guided step under a mesh is not "
+                "supported yet — run fork-group servers single-device "
+                "(replicating the mask/rng feeds through shard_map is "
+                "follow-up work, docs/serving.md)")
         if mesh is None:
+            if sampling:
+                def fused(pools, tokens, positions, valid, tables,
+                          mask, rng, temperature, do_sample,
+                          top_k, top_p):
+                    return _fused_step_body(
+                        params, cfg, block_size, h_, kv_, d,
+                        lambda z: z, pools, tokens, positions, valid,
+                        tables, per_column=per_column, sampling=True,
+                        mask=mask, rng=rng, temperature=temperature,
+                        do_sample=do_sample, top_k=top_k, top_p=top_p)
+
+                return fused
+
             def fused(pools, tokens, positions, valid, tables):
                 return _fused_step_body(
                     params, cfg, block_size, h_, kv_, d, lambda z: z,
@@ -530,6 +611,19 @@ class GenerationServer:
         # are the ONLY ones that pay the per-column lm-head projection
         # (C x the narrow gemm) — plain decode reads one column per
         # lane, so it keeps the last-column gather.
+        # decode strategies (ISSUE 20): single-device servers whose
+        # model's build_fused_step grew the `sampling` kwarg get the
+        # in-step sampling/guided-mask path — and with it fork groups
+        # (submit(n=K) / beam=) and guided decoding. Feature-detected so
+        # custom models with the original signature keep working; the
+        # vocab size must be readable for the mask rows.
+        import inspect
+        self._vocab = getattr(getattr(model, "cfg", None),
+                              "vocab_size", None)
+        self._strategies = (
+            mesh is None and self._vocab is not None
+            and "sampling" in inspect.signature(
+                model.build_fused_step).parameters)
         if mesh is not None:
             mesh_kw = {"mesh": mesh, "axis": mesh_axis}
             if self.cache.quantized:
@@ -538,11 +632,13 @@ class GenerationServer:
                 # working for dense mesh serving
                 mesh_kw["kv_quantized"] = True
             fused = model.build_fused_step(self.block_size, **mesh_kw)
-        elif spec is not None:
-            fused = model.build_fused_step(self.block_size,
-                                           per_column=True)
         else:
-            fused = model.build_fused_step(self.block_size)
+            step_kw = {}
+            if spec is not None:
+                step_kw["per_column"] = True
+            if self._strategies:
+                step_kw["sampling"] = True
+            fused = model.build_fused_step(self.block_size, **step_kw)
         self._fused = jax.jit(fused)
         self._signatures = set()
         # HBM ledger (observability/compile_insight.py): the serving
@@ -744,7 +840,8 @@ class GenerationServer:
     # -- client surface ----------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=32, eos_id=None,
                priority=0, deadline_ms=None, stream=None,
-               trace_ctx=None, tenant=None):
+               trace_ctx=None, tenant=None, n=1, sampling=None,
+               beam=None, guided=None):
         """prompt_ids: 1-D int token ids. Returns a GenerationFuture
         resolving to a GenerationResult (or raising DeadlineExceeded /
         RequestCancelled). `stream(request_id, token)` fires on the
@@ -755,7 +852,23 @@ class GenerationServer:
         verdict overrides this engine's own — a request is traced on
         all hops or none. `tenant` is an opaque cost-attribution
         identity (get_stats()["tenants"], /tenants endpoint); it never
-        affects scheduling or token ids."""
+        affects scheduling or token ids.
+
+        Decode strategies (ISSUE 20, single-device servers):
+
+        - `sampling=SamplingParams(...)` turns on stochastic decode for
+          this request (temperature / top-k / nucleus, counter-keyed so
+          replays resample identically).
+        - `n=K` (or SamplingParams(n=K)) forks the request into K lanes
+          sharing the prompt KV — ONE prefill, K streams; returns a
+          GroupFuture resolving to a GroupResult (per-lane stream
+          callbacks fire with GroupFuture.lane_rids[rank]).
+        - `beam=BeamParams(beam_size=K)` runs paged beam search
+          (requires eos_id; excludes sampling/stream; ids bitwise the
+          dense inference.decoding.beam_decode reference's).
+        - `guided=<Constraint>` (serving.guided) masks every emission
+          to the constraint's allowed set (requires eos_id; composes
+          with sampling and fork groups)."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -772,6 +885,66 @@ class GenerationServer:
             raise ValueError(
                 f"request needs {need} blocks but the pool only has "
                 f"{self.cache.usable_blocks}")
+        # -- decode-strategy validation ---------------------------------
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"n must be >= 1, got {n}")
+        if beam is not None:
+            if sampling is not None or n != 1:
+                raise ValueError(
+                    "beam search excludes sampling/n — beams are ranked "
+                    "deterministically by cumulative logprob")
+            if stream is not None:
+                raise ValueError(
+                    "beam search cannot stream: a beam-reorder rewrites "
+                    "lane streams retroactively")
+            if eos_id is None:
+                raise ValueError(
+                    "beam search requires eos_id (finished-hypothesis "
+                    "masking is defined by it)")
+            if self._spec is not None and self._spec.mode == "rejection":
+                raise NotImplementedError(
+                    "beam search composes with greedy speculative "
+                    "verification only — rejection-sampled acceptance "
+                    "has no beam analogue (docs/serving.md)")
+        if n > 1:
+            if sampling is None:
+                sampling = SamplingParams(n=n)
+            elif sampling.n not in (1, n):
+                raise ValueError(
+                    f"n={n} conflicts with SamplingParams(n="
+                    f"{sampling.n})")
+        k = beam.beam_size if beam is not None else \
+            max(n, sampling.n if sampling is not None else 1)
+        wants = (beam is not None or guided is not None or k > 1
+                 or (sampling is not None and sampling.do_sample))
+        if wants and not self._strategies:
+            raise NotImplementedError(
+                "decode strategies (sampling/n>1/beam/guided) need the "
+                "strategies fused step: single-device serving with a "
+                "model whose build_fused_step accepts `sampling` "
+                "(mesh servers are follow-up work, docs/serving.md)")
+        if guided is not None and eos_id is None:
+            raise ValueError(
+                "guided decoding requires eos_id (constraint "
+                "completion is signalled by unmasking eos)")
+        if k > 1:
+            if k > self._sched.num_slots:
+                raise ValueError(
+                    f"fork group of {k} lanes exceeds num_slots="
+                    f"{self._sched.num_slots} — the group admits "
+                    f"atomically and could never fit")
+            m_total = need
+            m_prompt = self.cache.blocks_for_tokens(int(prompt.size))
+            worst = m_total + (k - 1) * (m_total - m_prompt) + k
+            if worst > self.cache.usable_blocks:
+                raise ValueError(
+                    f"fork group needs up to {worst} blocks but the "
+                    f"pool only has {self.cache.usable_blocks}")
+            return self._submit_group(
+                prompt, int(max_new_tokens), eos_id, priority,
+                deadline_ms, stream, trace_ctx, tenant, k,
+                sampling if beam is None else None, beam, guided)
         with self._rid_lock:
             if self._closed:
                 raise RuntimeError("GenerationServer is closed")
@@ -787,7 +960,10 @@ class GenerationServer:
             deadline = self._sched.now() + deadline_ms / 1e3
         req = _Request(rid, prompt, int(max_new_tokens), eos_id,
                        priority, deadline, stream, fut,
-                       self._sched.now(), tenant=tenant)
+                       self._sched.now(), tenant=tenant,
+                       sampling=sampling, guided=guided)
+        if guided is not None:
+            req.guided_state = guided.initial_state()
         self._sched.enqueue(req)
         with self._rid_lock:
             raced_closed = self._closed
@@ -799,6 +975,59 @@ class GenerationServer:
             # behave exactly as if the closed-check above had caught us.
             self._sched.drop_queued_request(
                 rid, self._fault or
+                RequestCancelled("GenerationServer is closed"))
+            raise RuntimeError("GenerationServer is closed")
+        self._m["requests"].inc()
+        with self._cv:
+            self._cv.notify()
+        return fut
+
+    def _submit_group(self, prompt, max_new_tokens, eos_id, priority,
+                      deadline_ms, stream, trace_ctx, tenant, k,
+                      sampling, beam, guided):
+        """Build and enqueue one RequestGroup: K lane _Requests (rank 0
+        is the leader — the only one queued; the scheduler admits the
+        whole group atomically off it), one GroupFuture. Beam lanes
+        carry eos on the GROUP, never on the lane (finished hypotheses
+        pad with forced eos instead of retiring, exactly like the dense
+        reference), and never stream."""
+        kind = "beam" if beam is not None else "sample"
+        with self._rid_lock:
+            if self._closed:
+                raise RuntimeError("GenerationServer is closed")
+            rids = [self._next_rid + i for i in range(k)]
+            self._next_rid += k
+        if self._tel is not None:
+            for rid in rids:
+                # one on_submit per LANE: tenant billing counts every
+                # lane's tokens, not one K-th of the group
+                self._tel.on_submit(rid, ctx=trace_ctx, tenant=tenant)
+        group = RequestGroup(rids[0], kind, k, eos_id, max_new_tokens,
+                             sampling=sampling, beam=beam)
+        fut = GroupFuture(rids[0], rids,
+                          cancel_fn=lambda: [self._request_cancel(r)
+                                             for r in rids])
+        group.future = fut
+        now = self._sched.now()
+        deadline = None
+        if deadline_ms is not None:
+            deadline = now + deadline_ms / 1e3
+        for rank, rid in enumerate(rids):
+            req = _Request(rid, prompt, max_new_tokens,
+                           None if kind == "beam" else eos_id,
+                           priority, deadline,
+                           None if kind == "beam" else stream,
+                           Future(), now, tenant=tenant, group=group,
+                           lane=rank, sampling=sampling, guided=guided)
+            if guided is not None:
+                req.guided_state = guided.initial_state()
+            group.lanes.append(req)
+        self._sched.enqueue(group.lanes[0])
+        with self._rid_lock:
+            raced_closed = self._closed
+        if raced_closed:
+            self._sched.drop_queued_request(
+                rids[0], self._fault or
                 RequestCancelled("GenerationServer is closed"))
             raise RuntimeError("GenerationServer is closed")
         self._m["requests"].inc()
@@ -910,6 +1139,11 @@ class GenerationServer:
                         jnp.asarray(plan.positions),
                         jnp.asarray(plan.valid),
                         jnp.asarray(plan.tables))
+                if self._strategies:
+                    # mask/rng/temperature/do_sample/top_k/top_p are
+                    # DATA with constant shapes — the signature set
+                    # below still collapses to one entry
+                    args = args + self._strategies_args(plan, it)
                 self._signatures.add(
                     tuple((a.shape, str(a.dtype)) for a in args))
                 # the cache object always holds the LIVE device pools:
@@ -959,6 +1193,14 @@ class GenerationServer:
                 fed = (np.asarray(out[3])
                        if self._spec is not None
                        and self._spec.mode == "rejection" else None)
+                # full logp rows (last output when the strategies step
+                # is compiled in): fork-time host sampling and beam
+                # re-ranking read them — transferred only when this
+                # plan actually has a group that needs them
+                rows = None
+                if self._strategies and plan.needs_rows:
+                    rows = np.asarray(
+                        out[4] if self._spec is not None else out[3])
             # non-finite logits guard: one reduce on the hot path (a
             # NaN/Inf anywhere makes the sum non-finite; idle lanes
             # hold finite garbage); the per-slot triage only runs on a
@@ -972,7 +1214,8 @@ class GenerationServer:
                     self._on_engine_fault(plan, it, logps, lanes)
             retired = self._sched.commit(plan, nxt, logps,
                                          fed_logps=fed,
-                                         draft_logps=draft_logps)
+                                         draft_logps=draft_logps,
+                                         rows=rows)
             self._m["iterations"].inc()
             step_ms = (time.perf_counter() - t0) * 1e3
             self._m["step_ms"].observe(step_ms)
@@ -1027,6 +1270,65 @@ class GenerationServer:
             if q > 1:
                 plan.tokens[sid, 1:q] = props[sid, :q - 1]
         return np.asarray(dlps)
+
+    def _strategies_args(self, plan, iteration):
+        """The strategies step's extra feeds for one iteration: the
+        guided-decoding mask ((S, V) plain, (S, C, V) per-column —
+        all-zero rows for unconstrained lanes) plus the sampling
+        control arrays the scheduler planned. Per-column guided lanes
+        advance a SCRATCH automaton state through the fed draft tokens
+        so each verify column is masked under the context it would
+        commit under (the real state only advances in commit). Chaos
+        mask-starve narrows every guided row to its single lowest
+        allowed token — conformance holds, the loop must survive."""
+        s, c = plan.tokens.shape
+        per_col = self._spec is not None
+        mask = np.zeros((s, c, self._vocab) if per_col
+                        else (s, self._vocab), np.float32)
+        starve = (bool(plan.guided_lanes) and self._chaos is not None
+                  and self._chaos.mask_starves_at(iteration))
+        starved_any = False
+
+        def _narrow(row):
+            allowed = np.flatnonzero(row > NEG_INF / 2)
+            out = np.full_like(row, np.float32(NEG_INF))
+            if allowed.size:
+                out[allowed[0]] = 0.0
+            return out
+
+        for sid, req in plan.guided_lanes or ():
+            state = req.guided_state
+            if state is None:
+                continue        # dead automaton (chaos): unconstrained
+            eos = req.eos_id if req.group is None else req.group.eos_id
+            row = req.guided.mask_row(state, eos)
+            if starve:
+                row = _narrow(row)
+                starved_any = True
+            if not per_col:
+                mask[sid] = row
+                continue
+            q = int(plan.decode_cols[sid])
+            if q == 0:
+                # prefill lane: only its LAST valid column's row is
+                # read downstream; filling every column is harmless
+                mask[sid, :] = row
+                continue
+            mask[sid, 0] = row
+            st = state
+            for j in range(1, q):
+                if st is not None:
+                    st = req.guided.advance(st,
+                                            int(plan.tokens[sid, j]))
+                if st is not None:
+                    row = req.guided.mask_row(st, eos)
+                mask[sid, j] = row      # dead: repeat the last mask
+        if starved_any:
+            self._chaos.mask_starve_applied()
+        do_sample, temperature, top_k, top_p, keys = plan.sample_ctl
+        return (jnp.asarray(mask), jnp.asarray(keys),
+                jnp.asarray(temperature), jnp.asarray(do_sample),
+                jnp.asarray(top_k), jnp.asarray(top_p))
 
     def _kernel_info(self):
         # constant after the first step: built once, reused by every
@@ -1350,6 +1652,10 @@ class GenerationServer:
             }
         else:
             st["kv_tier"] = None
+        # decode strategies (ISSUE 20): whether this server compiled
+        # the sampling/guided step — fork groups, beam, and guided
+        # submits require it (NotImplementedError otherwise)
+        st["decode_strategies"] = self._strategies
         st["telemetry_enabled"] = self._tel is not None
         st["slo"] = self._tel.stats() if self._tel is not None else None
         st["tenants"] = (self._tel.tenants.snapshot()

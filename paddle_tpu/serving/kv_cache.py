@@ -40,8 +40,11 @@ compounding with int8 quantization.
 `PagedDecodeLayer` adapts a layer's pool slice to the dense mapping
 interface `decoding.py` step_fns consume (`cache[i]["k"]`,
 `update_kv_cache`), so an existing step_fn decodes against either cache
-unchanged (beam search still needs the dense cache: `_gather_beams`
-reorders lanes by leading dim, which a shared pool does not have).
+unchanged. Beam search runs paged too (ISSUE 20): the serving engine's
+request groups reorder beams by remapping block TABLES host-side —
+`fork_table` + `cow_copy` at divergence sites — instead of
+`_gather_beams`'s dense leading-dim gather, so the adapter exists for
+step_fn parity harnesses, not as a beam crutch.
 
 Cross-request block sharing (ISSUE 10): every allocated block carries a
 host-side refcount. The prefix cache (serving/prefix_cache.py) refs a
@@ -854,6 +857,30 @@ class PagedKVCache:
 
     def refcount(self, block):
         return self._ref.get(int(block), 0)
+
+    def fork_table(self, blocks):
+        """Take one additional reference on every listed block — a
+        forked lane's table adopting another lane's live blocks (the
+        prompt prefix at group fork, a parent beam's whole table at a
+        beam reorder). Pure refcount bookkeeping: no pool bytes move;
+        divergence later is the ordinary copy-on-write path. Returns
+        the blocks as a fresh list (the caller's private copy to put
+        in the new lane's release set)."""
+        out = [int(b) for b in blocks]
+        for b in out:
+            self.ref(b)
+        return out
+
+    def unref_blocks(self, blocks):
+        """unref() each block — releasing a forked lane, whose table
+        mixes private suffix blocks (last ref: freed) with blocks
+        sibling lanes or the prefix index still hold (ref drops, block
+        lives on). Returns how many were actually freed."""
+        freed = 0
+        for b in blocks:
+            if self.unref(b):
+                freed += 1
+        return freed
 
     def is_shared(self, block):
         """True when more than one reference is live (another request

@@ -300,6 +300,14 @@ class PrefixCacheIndex:
         self._m_cow.inc()
         self._publish_shared()
 
+    def owns_block(self, block):
+        """True when `block` is indexed under a chain key. The COW
+        guard routes an abandoned shared block through drop_block only
+        when the index actually holds it — a fork-group lane's block
+        can be shared purely between sibling lanes, and its release is
+        then a plain pool unref."""
+        return int(block) in self._by_block
+
     # -- eviction (LRU, leaf-first, spill-before-destroy) ------------------
     def _idle(self, e):
         # the index's own ref is the only one left (host-tier entries

@@ -311,7 +311,14 @@ class WorkerProxy:
     # -- the GenerationServer surface ----------------------------------
     def submit(self, prompt_ids, max_new_tokens=32, eos_id=None,
                priority=0, deadline_ms=None, stream=None,
-               trace_ctx=None, tenant=None):
+               trace_ctx=None, tenant=None, n=1, sampling=None,
+               beam=None, guided=None):
+        if n != 1 or sampling is not None or beam is not None \
+                or guided is not None:
+            raise NotImplementedError(
+                "forked generation is not wired through the subprocess "
+                "transport: fork groups need GroupFuture lane plumbing "
+                "in the wire protocol — use in-process replicas")
         if self._closed:
             raise RuntimeError("GenerationServer is closed")
         header = {"max_new_tokens": int(max_new_tokens),

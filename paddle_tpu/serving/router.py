@@ -107,6 +107,7 @@ from ..observability.serving_telemetry import (TenantLedger, _parse_qtag,
                                                _rid_hash01,
                                                aggregate_tenant_snapshots)
 from ..observability.timeseries import FleetSeriesStore
+from .decode_strategies import GroupResult
 from .prefix_cache import prompt_chain_keys
 from .replica import Replica
 from .scheduler import (DeadlineExceeded, GenerationResult,
@@ -213,7 +214,9 @@ class _Routed:
                  "rep_fut", "phase", "emitted", "seen", "attempts",
                  "client_cancelled", "first_submit_mono", "lineage",
                  "implicated", "retry_budget", "ctx", "hops",
-                 "submit_perf", "trace_done", "tenant")
+                 "submit_perf", "trace_done", "tenant", "group_k",
+                 "sampling", "beam", "guided", "lane_base", "lane_seen",
+                 "lane_emitted")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_id, priority,
                  deadline_ms, stream, future, keys):
@@ -250,6 +253,15 @@ class _Routed:
         self.tenant = None          # cost-attribution identity: every
         #                             hop (prefill, decode, failover
         #                             replay) bills the same tenant
+        self.group_k = 1    # fork-group width (1 = plain request)
+        self.sampling = None        # SamplingParams for forked lanes
+        self.beam = None            # BeamParams (paged beam search)
+        self.guided = None          # Constraint (guided decoding)
+        self.lane_base = None   # current attempt's lane_rids[0]: the
+        #                         replica allocates K consecutive lane
+        #                         rids, so rank = lane_rid - base
+        self.lane_seen = None       # per-rank tokens from this attempt
+        self.lane_emitted = None    # per-rank tokens DELIVERED
 
 
 class FleetRouter:
@@ -510,7 +522,8 @@ class FleetRouter:
     # -- client surface ----------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=32, eos_id=None,
                priority=0, deadline_ms=None, stream=None,
-               retry_budget=None, tenant=None):
+               retry_budget=None, tenant=None, n=1, sampling=None,
+               beam=None, guided=None):
         """Route one generation request into the fleet. Returns a
         FleetFuture resolving to a GenerationResult whose request_id is
         the ROUTER's id (replica-local ids are an implementation
@@ -521,10 +534,33 @@ class FleetRouter:
         only the REMAINING deadline budget). `tenant` is an opaque
         cost-attribution identity threaded to every replica hop — it
         never affects scheduling or token ids (docs/observability.md
-        "Fleet health signals")."""
+        "Fleet health signals").
+
+        `n` / `sampling` / `beam` / `guided` mirror the engine's forked
+        submit (docs/serving.md "Forked generation"): a fork group
+        routes AND fails over as a unit — one replica owns all K lanes
+        (the lanes share prompt KV, which cannot span replicas), a
+        failover replays the whole group on the survivor, and the
+        future resolves to a GroupResult whose group_id is the router's
+        rid. Group stream callbacks fire `stream(rid, rank, token)` —
+        the extra lane-rank argument replaces replica-local lane ids,
+        which change on failover; dedup on replay is per rank. `tenant`
+        billing counts every lane's tokens (the replica stamps each
+        lane with the same tenant). Groups route to decode replicas
+        directly — a disaggregated prefill handoff would strand the
+        fork boundary mid-transfer."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
+        if beam is not None:
+            if stream is not None:
+                raise ValueError("beam search does not stream")
+            if eos_id is None:
+                raise ValueError("beam search requires eos_id")
+            if sampling is not None or n != 1:
+                raise ValueError("beam excludes sampling/n")
+        group_k = (beam.beam_size if beam is not None
+                   else max(int(n), sampling.n if sampling else 1))
         with self._lock:
             if self._closed:
                 raise RuntimeError("FleetRouter is closed")
@@ -541,6 +577,10 @@ class FleetRouter:
         rr = _Routed(rid, prompt, int(max_new_tokens), eos_id, priority,
                      deadline_ms, stream, fut, keys)
         rr.tenant = tenant
+        rr.group_k = group_k
+        rr.sampling = sampling
+        rr.beam = beam
+        rr.guided = guided
         if retry_budget is not None:
             rr.retry_budget = int(retry_budget)
         # ONE trace context per request, minted HERE: deterministic id
@@ -555,7 +595,8 @@ class FleetRouter:
         rr.submit_perf = time.perf_counter()
         if sampled:
             self._m_trace["requests"].inc()
-        if self.policy.kind == "disaggregated" and keys:
+        grouped = beam is not None or group_k > 1
+        if self.policy.kind == "disaggregated" and keys and not grouped:
             pool, phase = self._pool("prefill"), "prefill"
         elif self.policy.kind == "disaggregated":
             pool, phase = self._pool("decode"), "decode"
@@ -727,6 +768,12 @@ class FleetRouter:
         rr.replica = target
         rr.phase = phase
         rr.seen = 0
+        grouped = rr.beam is not None or rr.group_k > 1
+        if grouped:
+            # replay dedup is PER RANK: lane r of the re-admitted group
+            # regenerates lane r's exact stream (per-lane RNG keys fold
+            # (seed, rank, position) — replica-independent)
+            rr.lane_seen = [0] * rr.group_k
         if rr.first_submit_mono is None:
             rr.first_submit_mono = time.monotonic()
         # a re-admission must not silently grant a fresh deadline
@@ -759,6 +806,26 @@ class FleetRouter:
             fut = srv.submit(rr.prompt, max_new_tokens=1,
                              priority=rr.priority, trace_ctx=ctx,
                              tenant=rr.tenant)
+        elif grouped or rr.sampling is not None or \
+                rr.guided is not None:
+            # the whole fork group lands on ONE replica: lanes alias
+            # the leader's prompt blocks, and a block table cannot
+            # reference another replica's pool
+            fut = srv.submit(rr.prompt,
+                             max_new_tokens=rr.max_new_tokens,
+                             eos_id=rr.eos_id, priority=rr.priority,
+                             deadline_ms=deadline_ms,
+                             stream=(self._group_stream_cb(rr)
+                                     if grouped else
+                                     self._stream_cb(rr)),
+                             trace_ctx=ctx, tenant=rr.tenant,
+                             n=rr.group_k if rr.beam is None else 1,
+                             sampling=rr.sampling, beam=rr.beam,
+                             guided=rr.guided)
+            if grouped:
+                rr.lane_base = fut.lane_rids[0]
+                if rr.lane_emitted is None:
+                    rr.lane_emitted = [0] * rr.group_k
         else:
             fut = srv.submit(rr.prompt,
                              max_new_tokens=rr.max_new_tokens,
@@ -817,6 +884,28 @@ class FleetRouter:
                 rr.stream(rr.rid, tok)
         return cb
 
+    def _group_stream_cb(self, rr):
+        if rr.stream is None:
+            return None
+
+        def cb(lane_rid, tok):
+            # the replica allocates K consecutive lane rids per group
+            # submit, so the rank is recoverable from the current
+            # attempt's base — the client sees STABLE (router rid,
+            # rank) coordinates while replica-local lane ids churn
+            # across failovers; dedup replays per rank
+            base = rr.lane_base
+            if base is None:
+                return
+            rank = int(lane_rid) - base
+            if not 0 <= rank < rr.group_k:
+                return
+            rr.lane_seen[rank] += 1
+            if rr.lane_seen[rank] > rr.lane_emitted[rank]:
+                rr.lane_emitted[rank] += 1
+                rr.stream(rr.rid, rank, tok)
+        return cb
+
     # -- completion / failover ---------------------------------------------
     def _on_replica_done(self, rr, f):
         """Replica-future done callback (runs on whatever thread
@@ -850,9 +939,23 @@ class FleetRouter:
         self._notify()
 
     def _finish(self, rr, res):
-        out = GenerationResult(rr.rid, res.token_ids, res.score,
-                               res.finish_reason, res.prompt_len,
-                               res.ttft_ms)
+        if isinstance(res, GroupResult):
+            # re-key the group under the ROUTER's rid (replica-local
+            # group/lane ids change on failover); lanes/hypotheses pass
+            # through untouched — the replica already assembled them
+            out = GroupResult(rr.rid, res.kind, lanes=res.lanes,
+                              hypotheses=res.hypotheses,
+                              prompt_len=res.prompt_len)
+            generated = sum(
+                len(x.token_ids)
+                for x in (res.lanes or res.hypotheses or ()))
+            reason = "group"
+        else:
+            out = GenerationResult(rr.rid, res.token_ids, res.score,
+                                   res.finish_reason, res.prompt_len,
+                                   res.ttft_ms)
+            generated = len(res.token_ids)
+            reason = res.finish_reason
         with self._lock:
             self._inflight.pop(rr.rid, None)
         try:
@@ -860,8 +963,8 @@ class FleetRouter:
                 rr.future.set_result(out)
         except InvalidStateError:
             pass
-        self._note_trace_done(rr, "retired", reason=res.finish_reason,
-                              generated=len(res.token_ids))
+        self._note_trace_done(rr, "retired", reason=reason,
+                              generated=generated)
         self._notify()
 
     def _fail(self, rr, exc):

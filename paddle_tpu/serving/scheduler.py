@@ -46,6 +46,10 @@ from concurrent.futures import InvalidStateError
 
 import numpy as np
 
+from .decode_strategies import (BeamHypothesis, GroupResult, beam_step,
+                                finalize_beam, fold_key, host_sample)
+from .kv_cache import NEG_INF
+
 __all__ = ["ContinuousBatchingScheduler", "GenerationResult",
            "DeadlineExceeded", "RequestCancelled", "IterationPlan"]
 
@@ -84,10 +88,12 @@ class _Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_id", "priority",
                  "deadline", "stream", "future", "submitted_at", "tenant",
                  "generated", "score", "first_token_at", "last_token_at",
-                 "chain_keys")
+                 "chain_keys", "group", "lane", "sampling", "guided",
+                 "guided_state")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_id, priority,
-                 deadline, stream, future, submitted_at, tenant=None):
+                 deadline, stream, future, submitted_at, tenant=None,
+                 group=None, lane=0, sampling=None, guided=None):
         self.rid = rid
         self.prompt = prompt                # np.int32 (P,)
         self.max_new_tokens = max_new_tokens
@@ -103,12 +109,17 @@ class _Request:
         self.first_token_at = None
         self.last_token_at = None
         self.chain_keys = None      # prefix chunk hashes, computed once
+        self.group = group          # RequestGroup when forked (n>1/beam)
+        self.lane = lane            # rank within the group (0 = leader)
+        self.sampling = sampling    # SamplingParams or None
+        self.guided = guided        # guided.Constraint or None
+        self.guided_state = None    # current automaton state
 
 
 class _Slot:
     __slots__ = ("req", "blocks", "table", "pos", "admit_seq", "shared",
                  "keys", "registered", "cow_spares", "cow_copies",
-                 "tier")
+                 "tier", "hold")
 
     def __init__(self, req, blocks, table, admit_seq, shared=(),
                  keys=(), registered=0, cow_spares=(), tier="device"):
@@ -126,6 +137,10 @@ class _Slot:
         # over swapped-in spilled chains, or resumed from a preempt) —
         # the flight recorder's tier tag
         self.tier = tier
+        # a held slot is a fork-group FOLLOWER waiting for its leader's
+        # prefill: it owns its suffix reservation but plans no work
+        # until the fork clears the hold (commit's _fork_group)
+        self.hold = False
 
     @property
     def prefilling(self):
@@ -157,10 +172,13 @@ def _lane_tuple(sid, slot):
     _expand_lanes zips these against that schema, so every producer
     must go through this helper (plan()'s slot loop and
     lane_snapshot())."""
+    group = slot.req.group
     return (sid, slot.req.rid, int(slot.pos), bool(slot.prefilling),
             int(slot.admit_seq), len(slot.req.generated),
             int(slot.blocks[0]) if slot.blocks else None,
-            len(slot.shared), int(slot.cow_copies), slot.tier)
+            len(slot.shared), int(slot.cow_copies), slot.tier,
+            group.gid if group is not None else None,
+            int(slot.req.lane) if group is not None else None)
 
 
 class IterationPlan:
@@ -176,11 +194,13 @@ class IterationPlan:
 
     __slots__ = ("tokens", "positions", "valid", "tables", "slot_ids",
                  "emitting", "prefill_tokens", "decode_cols", "limits",
-                 "lanes_detail", "queue_depth")
+                 "lanes_detail", "queue_depth", "sample_ctl",
+                 "guided_lanes", "needs_rows")
 
     def __init__(self, tokens, positions, valid, tables, slot_ids,
                  emitting, prefill_tokens, decode_cols=None,
-                 limits=None, lanes_detail=None, queue_depth=None):
+                 limits=None, lanes_detail=None, queue_depth=None,
+                 sample_ctl=None, guided_lanes=None, needs_rows=False):
         self.tokens = tokens                # (S, C) int32
         self.positions = positions          # (S, C) int32
         self.valid = valid                  # (S, C) bool
@@ -196,6 +216,16 @@ class IterationPlan:
         # entry needs no second lock round-trip over the slots
         self.lanes_detail = lanes_detail
         self.queue_depth = queue_depth
+        # strategies-step controls (None when the engine's step has no
+        # sampling path): (do_sample (S,) bool, temperature (S,) f32,
+        # top_k (S,) i32 0=off, top_p (S,) f32 2.0=off, keys (S,2) u32)
+        self.sample_ctl = sample_ctl
+        # [(sid, req)] lanes whose emission needs a constraint mask
+        self.guided_lanes = guided_lanes
+        # True when commit() will read the full logp rows (a beam step
+        # or a pending group fork) — the engine only materializes the
+        # (S, [C,] V) rows output host-side when asked
+        self.needs_rows = needs_rows
 
 
 class ContinuousBatchingScheduler:
@@ -258,7 +288,10 @@ class ContinuousBatchingScheduler:
         self.counts = {"admitted": 0, "retired": 0, "cancelled": 0,
                        "deadline_cancels": 0, "generated_tokens": 0,
                        "prefill_tokens": 0, "spec.proposed": 0,
-                       "spec.accepted": 0}
+                       "spec.accepted": 0, "group.requests": 0,
+                       "group.lanes": 0, "group.forks": 0,
+                       "group.cow_copies": 0, "beam.reorders": 0,
+                       "guided.masked_steps": 0, "guided.violations": 0}
         from ..observability import _help
         from ..observability.metrics import global_registry
         reg = global_registry()
@@ -343,6 +376,8 @@ class ContinuousBatchingScheduler:
                 req.rid, self.iteration, "retire", reason=reason,
                 e2e_ms=(self.now() - req.submitted_at) * 1e3,
                 prompt_len=len(req.prompt), generated=len(req.generated))
+        if req.group is not None:
+            self._on_group_finish(req, res)
         return res
 
     def _fail(self, req, exc, count_key):
@@ -362,10 +397,88 @@ class ContinuousBatchingScheduler:
                                 reason=type(exc).__name__,
                                 prompt_len=len(req.prompt),
                                 generated=len(req.generated))
+        if req.group is not None:
+            self._on_group_fail(req, exc, count_key)
+
+    # -- fork groups: finish/fail as a unit --------------------------------
+    def _on_group_finish(self, req, res):
+        group = req.group
+        group.results[req.lane] = res
+        group.lane_sids.pop(req.lane, None)
+        if group.failed or len(group.results) < group.k:
+            return
+        if group.kind == "beam":
+            # rank the finished beams exactly as the dense epilogue:
+            # lane r's generated list IS hypothesis r (eos-padded —
+            # done lanes keep committing eos at zero cost, mirroring
+            # the dense scan's masked emissions)
+            hist = np.stack([
+                np.asarray(group.results[r].token_ids, np.int32)
+                for r in range(group.k)])
+            ids, norm, order = finalize_beam(
+                hist, group.scores, group.eos_id,
+                group.beam.length_penalty)
+            hyps = [BeamHypothesis(ids[i],
+                                   float(group.scores[int(order[i])]),
+                                   float(norm[i]))
+                    for i in range(group.k)]
+            out = GroupResult(group.gid, "beam", hypotheses=hyps,
+                              prompt_len=len(req.prompt))
+        else:
+            out = GroupResult(
+                group.gid, "sample",
+                lanes=[group.results[r] for r in range(group.k)],
+                prompt_len=len(req.prompt))
+        try:
+            if not group.future.cancelled():
+                group.future.set_result(out)
+        except InvalidStateError:
+            pass
+
+    def _on_group_fail(self, req, exc, count_key):
+        group = req.group
+        group.lane_sids.pop(req.lane, None)
+        if group.failed:
+            return
+        group.failed = True
+        try:
+            if not group.future.cancelled():
+                group.future.set_exception(exc)
+        except InvalidStateError:
+            pass
+        # the group fails as a unit: siblings still flying are marked
+        # cancelled (their slots release through the normal sweep next
+        # iteration); siblings that never reached a slot (the leader
+        # died queued) fail here so no lane future dangles
+        for lane in group.lanes:
+            if lane is req or lane.future.done():
+                continue
+            if lane.lane in group.lane_sids:
+                self._cancel_rids.add(lane.rid)
+            else:
+                self._fail(lane, RequestCancelled(
+                    f"request {lane.rid} cancelled with its group"),
+                    "cancelled")
 
     def _release_slot(self, sid):
         slot = self._slots[sid]
         self._slots[sid] = None
+        group = slot.req.group
+        if group is not None:
+            # a forked lane's table mixes private suffix blocks with
+            # blocks sibling lanes (and maybe the index) still hold —
+            # release is unref-per-block, never the single-owner free
+            if self._prefix is not None:
+                self._prefix.release(slot.blocks)
+            else:
+                self._cache.unref_blocks(slot.blocks)
+            group.lane_sids.pop(slot.req.lane, None)
+            group.released += 1
+            if group.released >= group.k and group.spares:
+                # last lane out: the pooled COW reserve goes home
+                self._cache.free(group.spares)
+                group.spares = []
+            return
         if self._prefix is not None:
             # retirement UNREFS instead of frees: a block this request
             # registered into (or matched from) the prefix index keeps
@@ -486,6 +599,10 @@ class ContinuousBatchingScheduler:
             if free_sid is None:
                 return
             req = self._queue[0][2]
+            if req.group is not None:
+                if not self._admit_group(req, now):
+                    return
+                continue
             p_len = len(req.prompt)
             n_full = p_len // self._cache.block_size
             m_total = self._cache.blocks_for_tokens(
@@ -598,6 +715,112 @@ class ContinuousBatchingScheduler:
                     (now - req.submitted_at) * 1e3,
                     blocks=len(slot.blocks))
 
+    def _admit_group(self, leader, now):
+        """Group-atomic admission: the leader's queue entry stands for
+        all K lanes, and either every lane gets its slot and its whole
+        block reservation in one shot, or nothing moves (all-or-nothing
+        keeps the no-mid-flight-OOM invariant — a half-admitted group
+        could never finish). The reservation is FULL (no lazy pledging:
+        forked lanes are pinned, see _preempt_victim) and covers the
+        worst case exactly:
+
+            leader prompt+output blocks        (prefix-shared part free)
+          + (K-1) per-lane suffix extras       (each lane's divergence)
+          + K pooled COW spares                (one boundary-block copy
+                                               per lane — lanes never
+                                               write below the prompt's
+                                               last block, so deeper
+                                               prompt blocks stay
+                                               single-copy)
+
+        Followers are admitted HELD: they own their suffix blocks but
+        plan no work until the leader's prefill completes and commit's
+        _fork_group aliases the prompt table into them (refs taken at
+        fork time, not here — an earlier ref would make the leader's
+        own prefill writes look shared and trigger spurious COW)."""
+        group = leader.group
+        k = group.k
+        free_sids = [i for i, s in enumerate(self._slots) if s is None]
+        if len(free_sids) < k:
+            return False
+        bs = self._cache.block_size
+        p_len = len(leader.prompt)
+        n_full = p_len // bs
+        m_prompt = self._cache.blocks_for_tokens(p_len)
+        m_total = self._cache.blocks_for_tokens(
+            p_len + leader.max_new_tokens)
+        extra = m_total - m_prompt
+        shared, keys, protect = [], (), frozenset()
+        if self._prefix is not None:
+            if leader.chain_keys is None:
+                leader.chain_keys = self._prefix.chain_keys(
+                    leader.prompt, n_full)
+            keys = leader.chain_keys
+            shared = self._prefix.match(leader.prompt, keys)
+            protect = frozenset(keys[:len(shared)])
+        shared_tokens = len(shared) * bs
+        full_cover = shared_tokens == p_len and shared_tokens > 0
+        n_spilled = sum(1 for b in shared if b is None)
+        need = (m_total - len(shared)) + (k - 1) * extra + k
+        need_free = need + n_spilled
+        floor = self.watermark_blocks if self.active_count else 0
+        avail = self._cache.num_free
+        if self._prefix is not None:
+            protected_idle = sum(
+                1 for b in shared
+                if b is not None and self._cache.refcount(b) == 1)
+            avail += self._prefix.evictable_total() - protected_idle
+        if avail - need_free < floor:
+            return False
+        if self._prefix is not None \
+                and self._cache.num_free < need_free:
+            self._prefix.evict_for(need_free, protect)
+        if self._cache.num_free < need_free:
+            return False
+        blocks = self._cache.allocate(need)
+        if blocks is None:
+            return False
+        if self._prefix is not None:
+            shared = self._prefix.claim(keys, shared, n_full)
+        heapq.heappop(self._queue)
+        group.spares = [blocks.pop() for _ in range(k)]
+        lane_extras = [[blocks.pop() for _ in range(extra)]
+                       for _ in range(k - 1)]
+        # remaining blocks are the leader's unshared prompt + suffix
+        table = self._cache.make_table(shared + blocks, self.max_blocks)
+        slot = _Slot(leader, shared + blocks, table, self._admit_seq,
+                     shared=shared, keys=keys, registered=len(shared),
+                     tier="host" if n_spilled else "device")
+        slot.pos = p_len - 1 if full_cover else shared_tokens
+        self._slots[free_sids[0]] = slot
+        group.lane_sids[0] = free_sids[0]
+        self._admit_seq += 1
+        for r in range(1, k):
+            lane = group.lanes[r]
+            ext = lane_extras[r - 1]
+            ftable = np.zeros((self.max_blocks,), np.int32)
+            for j, b in enumerate(ext):
+                ftable[m_prompt + j] = b
+            # registered = n_full: the leader registers the shared
+            # prompt chunks ONCE for the whole group
+            fslot = _Slot(lane, list(ext), ftable, self._admit_seq,
+                          registered=n_full, tier=slot.tier)
+            fslot.hold = True
+            self._slots[free_sids[r]] = fslot
+            group.lane_sids[r] = free_sids[r]
+            self._admit_seq += 1
+        self._count("admitted", k)
+        self._count("group.requests")
+        self._count("group.lanes", k)
+        if self._tel is not None:
+            for r in range(k):
+                lane_blocks = len(self._slots[free_sids[r]].blocks)
+                self._tel.on_admit(
+                    group.lanes[r].rid, free_sids[r], self.iteration,
+                    (now - group.lanes[r].submitted_at) * 1e3,
+                    blocks=lane_blocks)
+        return True
+
     # -- preempt and resume (host KV tier) ---------------------------------
     def _try_resume(self, now):
         """Swap parked requests back in, oldest first, BEFORE any new
@@ -655,6 +878,12 @@ class ContinuousBatchingScheduler:
         best, best_rem = None, -1
         for sid, slot in enumerate(self._slots):
             if slot is None or sid == exclude or slot.prefilling:
+                continue
+            if slot.req.group is not None:
+                # forked lanes are pinned: a group was admitted with
+                # its FULL reservation (never lazily), parking one lane
+                # would strand its siblings' shared blocks, and the
+                # lockstep beam commit assumes every lane planned
                 continue
             rem = slot.req.max_new_tokens - len(slot.req.generated)
             if rem > best_rem:
@@ -726,42 +955,86 @@ class ContinuousBatchingScheduler:
             slot.blocks.append(got[0])
         return True
 
+    def _cow_block(self, slot, bi):
+        """Copy slot's table[bi] to a fresh block and repoint. Spare
+        priority: the group's pooled reserve, the slot's own admission
+        spare, then a defensive allocate/evict. The abandoned block's
+        ref routes by who else holds it: index-owned -> drop_block (the
+        index keeps it), group-shared -> plain unref — EXCEPT that a
+        group block whose refcount would hit zero is RETAINED into the
+        group's spare pool instead of freed, keeping the group's
+        worst-case divergence covered by its own reservation (a
+        concurrent admission must never be able to steal it)."""
+        b = int(slot.table[bi])
+        group = slot.req.group
+        if group is not None and group.spares:
+            nb = group.spares.pop()
+            slot.blocks.append(nb)
+        elif slot.cow_spares:
+            nb = slot.cow_spares.pop()
+        else:
+            # unplanned COW (defensive): evict, then allocate
+            got = self._cache.allocate(1)
+            if got is None and self._prefix is not None:
+                self._prefix.evict_for(1)
+                got = self._cache.allocate(1)
+            if got is None:
+                raise MemoryError(
+                    f"copy-on-write of block {b} found no free "
+                    f"block (pool exhausted)")
+            nb = got[0]
+            slot.blocks.append(nb)
+        self._cache.cow_copy(b, nb)
+        slot.table[bi] = nb
+        if b in slot.blocks:
+            slot.blocks.remove(b)
+        if b in slot.shared:
+            slot.shared.remove(b)
+        if self._prefix is not None and self._prefix.owns_block(b):
+            self._prefix.drop_block(b)  # this request's ref moves on
+        elif group is not None and self._cache.refcount(b) == 1:
+            group.spares.append(b)      # retain inside the reservation
+        else:
+            self._cache.unref(b)
+        slot.cow_copies += 1
+        if group is not None:
+            group.cow_copies += 1
+            self._count("group.cow_copies")
+        return nb
+
     def _maybe_cow(self, slot, pos, n):
         """Copy-on-write guard, called with the block range this lane
         will WRITE this iteration ([pos, pos+n)): any shared block in
         range is first copied to a reserved fresh block and the table
-        repointed; readers (the index, other requests) keep the
-        original. Only the full-cover admission path can actually hit
-        this — writes otherwise start past the shared prefix — but the
-        guard is general: a shared block is NEVER written in place."""
-        if self._prefix is None:
+        repointed; readers (the index, sibling lanes, other requests)
+        keep the original. The full-cover admission path and fork-group
+        lanes (prompt blocks aliased K ways, beam tables adopted at
+        reorders) are the live hitters — but the guard is general: a
+        shared block is NEVER written in place."""
+        if self._prefix is None and slot.req.group is None:
             return
         bs = self._cache.block_size
         for bi in range(pos // bs, (pos + n - 1) // bs + 1):
             b = int(slot.table[bi])
             if b == 0 or not self._cache.is_shared(b):
                 continue
-            if slot.cow_spares:
-                nb = slot.cow_spares.pop()
-            else:
-                # unplanned COW (defensive): evict, then allocate
-                got = self._cache.allocate(1)
-                if got is None:
-                    self._prefix.evict_for(1)
-                    got = self._cache.allocate(1)
-                if got is None:
-                    raise MemoryError(
-                        f"copy-on-write of block {b} found no free "
-                        f"block (pool exhausted)")
-                nb = got[0]
-                slot.blocks.append(nb)
-            self._cache.cow_copy(b, nb)
-            slot.table[bi] = nb
-            slot.blocks.remove(b)
-            if b in slot.shared:
-                slot.shared.remove(b)
-            self._prefix.drop_block(b)      # this request's ref moves on
-            slot.cow_copies += 1
+            self._cow_block(slot, bi)
+
+    def _force_cow(self, slot):
+        """Chaos fork-storm: force a max-divergence COW of the block
+        this lane will write next, shared or not — the burst path the
+        deterministic tests drive without arranging real divergence.
+        Returns True when a copy happened."""
+        bs = self._cache.block_size
+        bi = slot.pos // bs
+        if bi >= slot.table.size or int(slot.table[bi]) == 0:
+            return False
+        group = slot.req.group
+        if group is not None and not group.spares \
+                and not self._cache.num_free:
+            return False
+        self._cow_block(slot, bi)
+        return True
 
     def plan(self):
         """Build one iteration's fused-step inputs, or None when idle.
@@ -806,6 +1079,7 @@ class ContinuousBatchingScheduler:
                         for sid, slot in enumerate(self._slots):
                             if (slot is not None
                                     and slot.req.rid == rid
+                                    and slot.req.group is None
                                     and not slot.prefilling):
                                 if self._preempt_slot(sid):
                                     self._chaos \
@@ -823,12 +1097,34 @@ class ContinuousBatchingScheduler:
                 # (satisfying not_before) and resume right now
                 self.iteration += 1
                 self._admit(now)
+            if self._chaos is not None:
+                # fork-storm injection: force max-divergence COW bursts
+                # on up to k live forked lanes at an exact iteration —
+                # the burst path, testable without arranging real beam
+                # divergence
+                k_storm = self._chaos.fork_storms_at(self.iteration)
+                if k_storm:
+                    forced = 0
+                    for slot in self._slots:
+                        if forced >= k_storm:
+                            break
+                        if slot is None or slot.hold \
+                                or slot.req.group is None \
+                                or slot.prefilling:
+                            continue
+                        if self._force_cow(slot):
+                            forced += 1
+                    if forced:
+                        self._chaos.fork_storm_applied(forced)
             s, c = self.num_slots, self.chunk
 
             def _plan_cols(slot):
                 if slot.prefilling:
                     return min(c, len(slot.req.prompt) - slot.pos)
-                if self.spec_k:
+                sp = slot.req.sampling
+                if self.spec_k and not (sp is not None and sp.do_sample):
+                    # sampled lanes stay 1-column: draft acceptance is
+                    # defined against the target's deterministic choice
                     return max(1, min(self.spec_k + 1, c,
                                       slot.req.max_new_tokens
                                       - len(slot.req.generated)))
@@ -841,7 +1137,7 @@ class ContinuousBatchingScheduler:
             starved = set()
             if self._cache.host is not None:
                 for sid, slot in enumerate(self._slots):
-                    if slot is None:
+                    if slot is None or slot.hold:
                         continue
                     if not self._ensure_blocks(sid, slot,
                                                _plan_cols(slot)):
@@ -855,8 +1151,18 @@ class ContinuousBatchingScheduler:
             slot_ids, emitting = [], set()
             prefill_tokens = 0
             lanes = [] if self._tel is not None else None
+            do_sample = np.zeros((s,), bool)
+            temperature = np.ones((s,), np.float32)
+            top_k_arr = np.zeros((s,), np.int32)
+            top_p_arr = np.full((s,), 2.0, np.float32)
+            rng_keys = np.zeros((s, 2), np.uint32)
+            guided_lanes = []
+            needs_rows = False
             for sid, slot in enumerate(self._slots):
-                if slot is None or sid in starved:
+                # held slots are fork-group followers parked until the
+                # leader's prefill completes — they own suffix blocks
+                # but have no tokens to run yet
+                if slot is None or sid in starved or slot.hold:
                     continue
                 slot_ids.append(sid)
                 req = slot.req
@@ -880,6 +1186,28 @@ class ContinuousBatchingScheduler:
                     decode_cols[sid] = n
                     tokens[sid, 0] = req.generated[-1]
                     emitting.add(sid)
+                group = req.group
+                sp = req.sampling
+                if (sp is not None and sp.do_sample and sid in emitting
+                        and (group is None or group.prefilled)):
+                    # in-step stochastic sampling: the RNG key is a pure
+                    # fold of (seed, lane, emit position) so replays and
+                    # group failovers resample identically
+                    do_sample[sid] = True
+                    temperature[sid] = sp.temperature
+                    top_k_arr[sid] = sp.top_k or 0
+                    top_p_arr[sid] = (sp.top_p if sp.top_p is not None
+                                      else 2.0)
+                    rng_keys[sid] = fold_key(sp.seed, req.lane,
+                                             slot.pos + n - 1)
+                if req.guided is not None and sid in emitting:
+                    guided_lanes.append((sid, req))
+                    self._count("guided.masked_steps")
+                if group is not None:
+                    if group.kind == "beam" and not slot.prefilling:
+                        needs_rows = True
+                    if not group.prefilled and sid in emitting:
+                        needs_rows = True
                 # a shared block is never written in place: copy (to a
                 # reserved spare) + repoint BEFORE the table row is
                 # captured into the plan
@@ -895,7 +1223,11 @@ class ContinuousBatchingScheduler:
                 prefill_tokens, decode_cols=decode_cols, limits=limits,
                 lanes_detail=tuple(lanes) if lanes is not None else None,
                 queue_depth=len(self._queue)
-                if lanes is not None else None)
+                if lanes is not None else None,
+                sample_ctl=(do_sample, temperature, top_k_arr,
+                            top_p_arr, rng_keys),
+                guided_lanes=tuple(guided_lanes),
+                needs_rows=needs_rows)
 
     def _accept(self, plan, sid, ids, logps, fed_logps, draft_logps):
         """One decode lane's committed (token, logp) list + position
@@ -953,24 +1285,33 @@ class ContinuousBatchingScheduler:
         return commits, j + 1
 
     def commit(self, plan, next_ids, next_logps, fed_logps=None,
-               draft_logps=None):
+               draft_logps=None, rows=None):
         """Apply one fused step's outputs: advance positions, record
         emitted tokens (stream callbacks fire here), retire finished
         lanes. `next_ids`/`next_logps` are the fused step's PER-COLUMN
         argmax ids / chosen logps (S, C); a prefill lane reads its last
         valid column, a decode lane accepts 1..q columns (see
-        _accept). Returns the list of GenerationResults retired this
-        iteration."""
+        _accept). `rows` (only when plan.needs_rows) carries the full
+        log-prob rows — (S, V) plain or (S, C, V) per-column — that the
+        host-side group strategies consume: fork-time sampling/beam
+        seeding and per-iteration beam re-ranking. Returns the list of
+        GenerationResults retired this iteration."""
         retired = []
         next_ids = np.asarray(next_ids)
         next_logps = np.asarray(next_logps)
         with self._lock:
             now = self.now()
+            # beam groups re-rank across their K lanes BEFORE the
+            # per-lane loop: divergence remaps block tables and rewrites
+            # lane streams, so the generic path below only applies the
+            # pre-computed per-lane commits
+            beam_overrides = self._commit_beam_groups(plan, rows)
             for sid in plan.slot_ids:
                 slot = self._slots[sid]
                 if slot is None:        # raced with a cancel mid-step
                     continue
                 req = slot.req
+                group = req.group
                 q = int(plan.decode_cols[sid]) if plan.decode_cols \
                     is not None else 0
                 if q == 0:
@@ -982,8 +1323,22 @@ class ContinuousBatchingScheduler:
                     self._register_chunks(slot)
                     if sid not in plan.emitting:
                         continue
+                    if group is not None and not group.prefilled:
+                        # leader prefill complete: fork the group (K-1
+                        # table aliases of the prompt blocks) and emit
+                        # every lane's first token host-side
+                        retired.extend(self._fork_group(
+                            group, sid, slot, plan, rows,
+                            next_ids, next_logps, n, now))
+                        continue
                     commits = [(int(next_ids[sid, n - 1]),
                                 float(next_logps[sid, n - 1]))]
+                elif group is not None and group.kind == "beam":
+                    override = beam_overrides.get(sid)
+                    if override is None:
+                        continue    # group skipped this step (see above)
+                    commits, advance = override
+                    slot.pos += advance
                 else:
                     commits, advance = self._accept(
                         plan, sid, next_ids, next_logps, fed_logps,
@@ -991,37 +1346,248 @@ class ContinuousBatchingScheduler:
                     slot.pos += advance
                 finished = None
                 for tok, lp in commits:
-                    req.score += lp
-                    req.generated.append(tok)
-                    self._count("generated_tokens")
-                    if req.first_token_at is None:
-                        req.first_token_at = now
-                        if self._tel is not None:
-                            self._tel.on_first_token(
-                                req.rid, self.iteration,
-                                (now - req.submitted_at) * 1e3)
-                    else:
-                        itl = (now - req.last_token_at) * 1e3
-                        self._itl.observe(itl)
-                        if self._tel is not None:
-                            self._tel.on_token(req.rid, self.iteration,
-                                               itl)
-                    req.last_token_at = now
-                    if req.stream is not None:
-                        try:
-                            req.stream(req.rid, tok)
-                        except Exception:  # noqa: BLE001 — a client
-                            pass    # callback must never kill the loop
-                    done_eos = req.eos_id is not None and \
-                        tok == req.eos_id
-                    if done_eos or len(req.generated) >= \
-                            req.max_new_tokens:
-                        finished = "eos" if done_eos else "length"
+                    finished = self._emit_token(req, tok, lp, now)
+                    if finished is not None:
                         break       # later accepted tokens discarded
                 if finished is not None:
                     retired.append(self._finish(req, finished))
                     self._release_slot(sid)
         return retired
+
+    def _emit_token(self, req, tok, lp, now):
+        """Record ONE committed token on `req`: score/stream/telemetry
+        bookkeeping plus the guided-decoding automaton advance. Returns
+        the finish reason ("eos" | "length") or None."""
+        req.score += lp
+        req.generated.append(tok)
+        self._count("generated_tokens")
+        if req.first_token_at is None:
+            req.first_token_at = now
+            if self._tel is not None:
+                self._tel.on_first_token(
+                    req.rid, self.iteration,
+                    (now - req.submitted_at) * 1e3)
+        else:
+            itl = (now - req.last_token_at) * 1e3
+            self._itl.observe(itl)
+            if self._tel is not None:
+                self._tel.on_token(req.rid, self.iteration, itl)
+        req.last_token_at = now
+        if req.stream is not None:
+            try:
+                req.stream(req.rid, tok)
+            except Exception:  # noqa: BLE001 — a client
+                pass    # callback must never kill the loop
+        if req.guided is not None and req.guided_state is not None:
+            # beam lanes carry eos on the GROUP (the lane itself never
+            # eos-retires — finished hypotheses pad with forced eos
+            # exactly like the dense reference), so resolve eos there
+            eos = req.eos_id if req.group is None else req.group.eos_id
+            if eos is None or tok != eos:
+                nxt_state = req.guided.advance(req.guided_state, tok)
+                if nxt_state is None:
+                    # the in-step mask makes this unreachable in normal
+                    # operation; counted (not raised) so a chaos
+                    # mask-starve can't take the serving loop down
+                    self._count("guided.violations")
+                    req.guided_state = None
+                else:
+                    req.guided_state = nxt_state
+        done_eos = req.eos_id is not None and tok == req.eos_id
+        if done_eos:
+            return "eos"
+        if len(req.generated) >= req.max_new_tokens:
+            return "length"
+        return None
+
+    def _fork_group(self, group, sid, slot, plan, rows, next_ids,
+                    next_logps, n, now):
+        """The group leader's prefill just finished: fan out into K
+        lanes. Every follower's table adopts the leader's prompt blocks
+        by reference (`fork_table` — one refcount bump per block, zero
+        copies), each lane's first token is chosen host-side from the
+        leader's final logit row (per-lane folded RNG for sampling, one
+        k-way `beam_step` for beam), and followers leave `hold` so the
+        next plan() runs them as ordinary decode lanes. Divergence
+        after this point is handled by _maybe_cow: the first write into
+        a still-shared block copies it to one of the group's reserved
+        spares. Returns the GenerationResults retired at fork (only
+        possible when max_new_tokens == 1)."""
+        retired = []
+        if group.failed:
+            return retired      # cancel sweep will reclaim the slots
+        req = slot.req
+        p_len = len(req.prompt)
+        bs = self._cache.block_size
+        m_prompt = (p_len + bs - 1) // bs
+        k = group.k
+        row = None
+        if rows is not None:
+            row = np.asarray(rows[sid] if rows.ndim == 2
+                             else rows[sid, n - 1], np.float32)
+        # fork the tables BEFORE emitting: a lane retiring on its first
+        # token releases through the group path, which unrefs the
+        # prompt blocks it must therefore already hold
+        src = [int(slot.table[i]) for i in range(m_prompt)]
+        for rank in range(1, k):
+            fsid = group.lane_sids.get(rank)
+            if fsid is None:
+                continue
+            fslot = self._slots[fsid]
+            forked = self._cache.fork_table(src)
+            fslot.table[:m_prompt] = forked
+            fslot.blocks = forked + fslot.blocks
+            fslot.pos = p_len
+            fslot.hold = False
+        group.prefilled = True
+        self._count("group.forks", k - 1)
+        if group.kind == "beam":
+            # seed exactly like the dense reference: lane 0 carries the
+            # prompt at score 0, lanes 1..K-1 start at NEG_INF so the
+            # first step picks the top-K tokens of one distribution
+            rows_k = np.tile(row[None, :], (k, 1))
+            scores0 = np.full((k,), NEG_INF, np.float32)
+            scores0[0] = 0.0
+            toks, _parents, scores, done = beam_step(
+                rows_k, scores0, np.zeros((k,), bool), group.eos_id)
+            group.scores = scores
+            group.done = done
+            lane_toks = [(int(toks[r]), float(scores[r]))
+                         for r in range(k)]
+        else:
+            sp = group.sampling
+            lane_toks = []
+            for rank in range(k):
+                if sp is not None and sp.do_sample:
+                    key = fold_key(sp.seed, rank, p_len - 1)
+                    tok, lp = host_sample(row, key, sp.temperature,
+                                          sp.top_k, sp.top_p)
+                else:
+                    tok = int(next_ids[sid, n - 1])
+                    lp = float(next_logps[sid, n - 1])
+                lane_toks.append((int(tok), float(lp)))
+        for rank in range(k):
+            fsid = group.lane_sids.get(rank)
+            if fsid is None:
+                continue
+            lane_req = self._slots[fsid].req
+            tok, lp = lane_toks[rank]
+            finished = self._emit_token(lane_req, tok, lp, now)
+            if finished is not None:
+                retired.append(self._finish(lane_req, finished))
+                self._release_slot(fsid)
+        return retired
+
+    def _commit_beam_groups(self, plan, rows):
+        """Pre-pass over decode-phase beam groups: run the SAME top-K
+        selection as the dense reference (`beam_step` per verify
+        column), rewrite diverging lanes' streams/tables from their
+        parents, and return {sid: (commits, advance)} for the generic
+        commit loop. Beam reorder is pure host bookkeeping — parent
+        tables are adopted by reference (ref new, then unref old;
+        sole-ref leftovers are RETAINED as group spares so the
+        admission-time reservation keeps covering every future COW)."""
+        overrides = {}
+        if plan.decode_cols is None:
+            return overrides
+        by_group = {}
+        for sid in plan.slot_ids:
+            slot = self._slots[sid]
+            if slot is None or int(plan.decode_cols[sid]) == 0:
+                continue
+            g = slot.req.group
+            if g is not None and g.kind == "beam" and g.prefilled:
+                by_group.setdefault(g.gid, (g, []))[1].append(sid)
+        for g, sids in by_group.values():
+            if len(sids) != g.k or g.failed:
+                continue    # a lane raced with a cancel: skip the step
+                # (positions unchanged -> next iteration re-runs it)
+            sids.sort(key=lambda s: self._slots[s].req.lane)
+            k = g.k
+            lane_reqs = [self._slots[s].req for s in sids]
+            q = int(plan.decode_cols[sids[0]])
+            sc = np.asarray(g.scores, np.float32)
+            done = np.asarray(g.done, bool)
+            ident = np.arange(k)
+            steps = []      # (toks, parents, sc_after, sc_before)
+            for j in range(q):
+                rows_j = np.stack(
+                    [np.asarray(rows[s] if rows.ndim == 2
+                                else rows[s, j], np.float32)
+                     for s in sids])
+                toks, parents, sc_new, done_new = beam_step(
+                    rows_j, sc, done, g.eos_id)
+                steps.append((toks, parents, sc_new, sc))
+                sc, done = sc_new, done_new
+                if not bool(np.all(parents == ident)):
+                    break   # divergence: later verify columns are
+                    # conditioned on the wrong parent hypotheses
+                if j + 1 < q and not all(
+                        int(toks[i]) == int(plan.tokens[sids[i], j + 1])
+                        for i in range(k)):
+                    break   # a chosen token differs from the fed draft
+            g.scores, g.done = sc, done
+            n_steps = len(steps)
+            if q > 1:
+                self._count("spec.proposed", (q - 1) * k)
+                self._count("spec.accepted", (n_steps - 1) * k)
+            # snapshots BEFORE any mutation: a lane may adopt a parent
+            # that itself adopts a different parent this same step
+            snaps = [(list(r.generated), r.score, r.guided_state)
+                     for r in lane_reqs]
+            last_toks, last_parents, last_sc, last_prev = steps[-1]
+            commits_by_lane = []
+            for i in range(k):
+                p = int(last_parents[i])
+                if p == i:
+                    commits = [(int(st_t[i]), float(st_a[i] - st_b[i]))
+                               for st_t, _, st_a, st_b in steps[:-1]]
+                else:
+                    # adopt the parent's pre-step stream + state, then
+                    # commit the PARENT's identity-step tokens so the
+                    # appends reconstruct its chain
+                    lane_reqs[i].generated = list(snaps[p][0])
+                    lane_reqs[i].score = snaps[p][1]
+                    lane_reqs[i].guided_state = snaps[p][2]
+                    commits = [(int(st_t[p]), float(st_a[p] - st_b[p]))
+                               for st_t, _, st_a, st_b in steps[:-1]]
+                commits.append((int(last_toks[i]),
+                                float(last_sc[i] - last_prev[p])))
+                commits_by_lane.append(commits)
+            if not bool(np.all(last_parents == ident)):
+                self._reorder_beam_tables(g, sids, last_parents)
+            for i, s in enumerate(sids):
+                overrides[s] = (commits_by_lane[i], n_steps)
+        return overrides
+
+    def _reorder_beam_tables(self, group, sids, parents):
+        """Apply a beam reorder to the K lanes' block tables: lane i
+        whose parent p != i adopts a COPY of p's pre-step table, taking
+        one ref on every live block FIRST, then dropping its old refs
+        (sole-ref blocks are retained as group spares — returning them
+        to the pool would quietly shrink the group's no-mid-flight-OOM
+        reservation). The next write into any now-shared suffix block
+        COWs from those spares via _maybe_cow."""
+        old = [(self._slots[s].table.copy(), list(self._slots[s].blocks))
+               for s in sids]
+        moved = [i for i in range(group.k) if int(parents[i]) != i]
+        for i in moved:
+            new_tbl = old[int(parents[i])][0]
+            live = [int(b) for b in new_tbl if b != 0]
+            for b in live:
+                self._cache.ref(b)
+            sl = self._slots[sids[i]]
+            sl.table = new_tbl.copy()
+            sl.blocks = list(live)
+            sl.shared = [b for b in sl.shared if b in live]
+        for i in moved:
+            for b in old[i][1]:
+                if self._cache.refcount(b) == 1:
+                    group.spares.append(b)
+                else:
+                    self._cache.unref(b)
+        group.reorders += 1
+        self._count("beam.reorders")
 
     def _register_chunks(self, slot):
         """Offer every freshly-prefilled FULL prompt chunk to the

@@ -586,7 +586,7 @@ def test_lane_records_carry_tier_tag(tiny_gpt):
     """LANE_FIELDS grew a `tier` tag: fresh lanes snapshot as
     "device", a resumed (swapped-in) lane as "host"."""
     from paddle_tpu.observability.serving_telemetry import LANE_FIELDS
-    assert LANE_FIELDS[-1] == "tier"
+    assert LANE_FIELDS[-3:] == ("tier", "group", "beam_rank")
     cfg, params, *_ = tiny_gpt
     chaos = ChaosInjector()
     srv = _server(params, cfg, host_kv_blocks=16, chaos=chaos)

@@ -189,6 +189,7 @@ SUITE_STEPS = (
     ("trace_compare", "bench_trace.json", None),
     ("signals_compare", "bench_signals.json", None),
     ("tier_compare", "bench_tier.json", None),
+    ("fork_compare", "bench_fork.json", None),
     ("compile_sample", "compile_sample.json", None),
     ("ernie", "bench_ernie.json", None),
     ("packed", "bench_packed.json", None),
@@ -510,6 +511,21 @@ def run_suite():
                  env={"JAX_PLATFORMS": "cpu",
                       "BENCH_TIER_COMPARE": "1"},
                  timeout_s=900, stdout_path="bench_tier.json")
+    # 1f8. fork-group comparison (ISSUE 20): submit(n=K) COW fork
+    #     groups vs K independent submits of the same stream (peak
+    #     KV-block ratio + tokens/s), paged-beam-vs-dense bitwise
+    #     parity, and a guided-regex decode — all on one compiled
+    #     fused-step signature, on the CPU backend (deterministic;
+    #     acceptance: block ratio < 0.5 at K=4, beam ids bitwise,
+    #     guided violations == 0)
+    if _artifact_ok("bench_fork.json"):
+        log("step fork_compare: already landed in a prior cycle — "
+            "skipping")
+    else:
+        run_step("fork_compare", [py, bench],
+                 env={"JAX_PLATFORMS": "cpu",
+                      "BENCH_FORK_COMPARE": "1"},
+                 timeout_s=900, stdout_path="bench_fork.json")
     # 1g. compile-observatory sample (ISSUE 8): Executor.explain()
     #     report + provoked recompile storm + HBM-ledger snapshot +
     #     detector on-vs-off overhead, on the CPU backend

@@ -239,6 +239,23 @@ def print_serving_summary(metrics, file=None):
         sa = _counter_total(metrics, "serving.spec.accepted")
         print(f"serving: spec proposed={sp} accepted={sa} "
               f"accept-rate={sa / max(sp, 1):.1%}", file=file)
+    # forked generation (ISSUE 20): fork groups (submit(n=K) /
+    # BeamParams) sharing the prompt's blocks, COW divergence traffic,
+    # beam-lane reorders, and the guided-decoding mask counters
+    gr = _counter_total(metrics, "serving.group.requests")
+    if gr:
+        gl = _counter_total(metrics, "serving.group.lanes")
+        gf = _counter_total(metrics, "serving.group.forks")
+        gc = _counter_total(metrics, "serving.group.cow_copies")
+        br = _counter_total(metrics, "serving.beam.reorders")
+        print(f"serving: fork-groups requests={int(gr)} "
+              f"lanes={int(gl)} forks={int(gf)} cow_copies={int(gc)} "
+              f"beam_reorders={int(br)}", file=file)
+    gm = _counter_total(metrics, "serving.guided.masked_steps")
+    gv = _counter_total(metrics, "serving.guided.violations")
+    if gm or gv:
+        print(f"serving: guided masked_steps={int(gm)} "
+              f"violations={int(gv)}", file=file)
     # tiered KV cache (ISSUE 18): host-RAM spill-pool traffic — chains
     # that left HBM alive, came back via swap-in, and the re-prefills
     # the host tier absorbed, plus preempt/resume churn
@@ -477,8 +494,10 @@ def run_demo(out_dir):
     # drives a shared-prefix stream below so serving.prefix.* and
     # serving.spec.* series land in the committed sample (the draft is
     # the target itself — a perfect-acceptance sample)
+    # num_slots=4: the fork-group wave below needs room for its n=4
+    # lanes (groups admit atomically)
     server = GenerationServer(
-        GPTServingModel(sparams, scfg), num_slots=2, block_size=8,
+        GPTServingModel(sparams, scfg), num_slots=4, block_size=8,
         max_context=64, chunk=4, start=False, chaos=schaos,
         slo_window_s=0.1, prefix_cache=True, host_kv_blocks=16,
         spec=SpecDecodeConfig(GPTServingModel(sparams, scfg), k=3))
@@ -505,6 +524,34 @@ def run_demo(out_dir):
     for f in (w1, w2):
         f.result(timeout=5)
     assert server.get_stats()["kv_tier"]["swap_ins"] >= 2
+
+    # forked generation (ISSUE 20): an n=4 sampled fork group, a paged
+    # beam request, and a guided regex decode ride the SAME server —
+    # and the same compiled fused-step signature (mask/rng/ctl are
+    # data, never shape) — so serving.group.* / serving.beam.reorders /
+    # serving.guided.* series land in the committed sample with real
+    # forks, COW copies, and masked steps behind them
+    from paddle_tpu.serving import (BeamParams, RegexConstraint,
+                                    SamplingParams)
+    gfut = server.submit(np.arange(3, 19, dtype=np.int32),
+                         max_new_tokens=5, n=4,
+                         sampling=SamplingParams(seed=7))
+    server.run_until_idle()
+    assert len(gfut.result(timeout=5).lanes) == 4
+    bfut = server.submit(np.arange(3, 11, dtype=np.int32),
+                         max_new_tokens=5, eos_id=2,
+                         beam=BeamParams(2))
+    server.run_until_idle()
+    assert len(bfut.result(timeout=5).hypotheses) == 2
+    digits = {i: str(i - 3) for i in range(3, 13)}
+    rcon = RegexConstraint("[0-9]+", [digits.get(i, chr(0x4E00 + i))
+                                      for i in range(scfg.vocab_size)])
+    qfut = server.submit(np.array([5, 9, 11], np.int32),
+                         max_new_tokens=6, eos_id=1, guided=rcon)
+    server.run_until_idle()
+    assert all(3 <= t <= 12 for t in qfut.result(timeout=5).token_ids
+               if t != 1)
+    assert server.get_stats()["guided.violations"] == 0
 
     # fleet router demo (ISSUE 11): a 2-replica routed stream — the
     # second wave repeats the first wave's prompts so prefix-affinity
